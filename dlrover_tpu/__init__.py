@@ -1,0 +1,20 @@
+"""dlrover_tpu: a TPU-native automatic distributed deep learning system.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of DLRover
+(reference: we62/dlrover): elastic fault-tolerant training, Flash
+Checkpoint (in-memory checkpointing over shared memory), dynamic data
+sharding, auto-parallelism (``auto_accelerate``-equivalent emitting GSPMD
+mesh shardings), and a job master / elastic agent control plane.
+
+Layer map (cf. reference SURVEY.md):
+  - ``common``   : env contract, node model, IPC (shm/unix sockets), RPC messages
+  - ``master``   : job master (rendezvous, data sharding, scaling, monitoring)
+  - ``agent``    : per-host elastic agent (worker lifecycle, flash-ckpt saver)
+  - ``trainer``  : user-facing training APIs (elastic trainer, flash checkpoint)
+  - ``accel``    : auto_accelerate equivalent — strategy -> mesh + shardings
+  - ``models``   : flagship model families (llama, gpt2, MoE) in pure JAX
+  - ``ops``      : pallas TPU kernels (flash attention, fused CE, rmsnorm, quant)
+  - ``optimizers``: AGD / WSAM / bf16 / low-bit optimizers as optax transforms
+"""
+
+__version__ = "0.1.0"

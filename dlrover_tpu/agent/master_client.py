@@ -1,0 +1,412 @@
+"""Typed client of the job master RPC service.
+
+Counterpart of reference
+dlrover/python/elastic_agent/master_client.py:28-443: every call wraps the
+get/report envelope with retries; one singleton client per process.
+"""
+
+import os
+import socket
+import threading
+import time
+from functools import wraps
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName, TaskType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.rpc import RpcStub
+from dlrover_tpu.common.serialize import (
+    deserialize_message,
+    serialize_message,
+)
+
+
+def retry_rpc(retry: int = 10, interval: float = 3.0):
+    def decorator(func):
+        @wraps(func)
+        def wrapped(self, *args, **kwargs):
+            for i in range(retry):
+                try:
+                    return func(self, *args, **kwargs)
+                except Exception as e:
+                    if i == retry - 1:
+                        raise
+                    logger.warning(
+                        "%s failed (%s); retry %s/%s",
+                        func.__name__, e, i + 1, retry,
+                    )
+                    time.sleep(interval)
+
+        return wrapped
+
+    return decorator
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int, node_type: str,
+                 timeout: float = 30.0):
+        self._master_addr = master_addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._stub = RpcStub(master_addr, timeout=timeout)
+        self._host_name = socket.gethostname()
+        try:
+            self._host_ip = socket.gethostbyname(self._host_name)
+        except OSError:
+            self._host_ip = "127.0.0.1"
+
+    # ---------------------------------------------------------- envelope
+    def _get(self, message, timeout: float = 0):
+        req = comm.BaseRequest(
+            node_id=self._node_id,
+            node_type=self._node_type,
+            data=serialize_message(message),
+        )
+        resp_bytes = self._stub.get(serialize_message(req), timeout=timeout)
+        resp: comm.BaseResponse = deserialize_message(resp_bytes)
+        if not resp.success:
+            raise RuntimeError(resp.message or "master get failed")
+        return deserialize_message(resp.data)
+
+    def _report(self, message, timeout: float = 0):
+        req = comm.BaseRequest(
+            node_id=self._node_id,
+            node_type=self._node_type,
+            data=serialize_message(message),
+        )
+        resp_bytes = self._stub.report(
+            serialize_message(req), timeout=timeout
+        )
+        resp: comm.BaseResponse = deserialize_message(resp_bytes)
+        if not resp.success:
+            raise RuntimeError(resp.message or "master report failed")
+        return deserialize_message(resp.data)
+
+    # -------------------------------------------------------------- tasks
+    @retry_rpc()
+    def get_task(self, dataset_name: str) -> comm.Task:
+        return self._get(comm.TaskRequest(dataset_name=dataset_name))
+
+    @retry_rpc()
+    def report_task_result(
+        self, dataset_name: str, task_id: int, err_message: str = ""
+    ):
+        return self._report(
+            comm.TaskResult(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                err_message=err_message,
+            )
+        )
+
+    @retry_rpc()
+    def report_dataset_shard_params(
+        self,
+        batch_size: int,
+        num_epochs: int,
+        dataset_size: int,
+        shuffle: bool,
+        num_minibatches_per_shard: int,
+        dataset_name: str,
+        task_type: str = TaskType.TRAINING,
+        storage_type: str = "table",
+    ):
+        return self._report(
+            comm.DatasetShardParams(
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                dataset_size=dataset_size,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                dataset_name=dataset_name,
+                task_type=task_type,
+                storage_type=storage_type,
+            )
+        )
+
+    @retry_rpc()
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        reply = self._get(
+            comm.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+        return reply.content
+
+    @retry_rpc()
+    def report_shard_checkpoint(self, content: str):
+        return self._report(comm.ShardCheckpoint(content=content))
+
+    @retry_rpc()
+    def dataset_finished(self) -> bool:
+        reply = self._get(comm.TaskStatus())
+        return reply.finished
+
+    # --------------------------------------------------------- rendezvous
+    @retry_rpc()
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+        node_unit: int = 1,
+        slice_id: int = 0,
+    ) -> int:
+        reply = self._get(
+            comm.JoinRendezvousRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_unit=node_unit,
+                slice_id=slice_id,
+                node_ip=self._host_ip,
+            )
+        )
+        return reply.round
+
+    @retry_rpc()
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int], Dict[int, str]]:
+        reply = self._get(
+            comm.CommWorldRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                rdzv_name=rdzv_name,
+            )
+        )
+        return reply.round, reply.group, reply.world, reply.node_ips
+
+    @retry_rpc()
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
+    ) -> int:
+        reply = self._get(
+            comm.WaitingNodeNumRequest(
+                node_id=self._node_id, rdzv_name=rdzv_name
+            )
+        )
+        return reply.waiting_num
+
+    @retry_rpc()
+    def report_network_check_result(
+        self, node_rank: int, normal: bool, elapsed_time: float
+    ):
+        return self._report(
+            comm.NetworkCheckResult(
+                node_rank=node_rank,
+                normal=normal,
+                elapsed_time=elapsed_time,
+            )
+        )
+
+    @retry_rpc()
+    def network_check_success(self) -> Tuple[bool, str]:
+        reply = self._get(comm.NetworkStatusRequest())
+        return reply.normal, reply.reason
+
+    @retry_rpc()
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        reply = self._get(comm.FaultNodeRequest())
+        return reply.fault_nodes, reply.reason
+
+    @retry_rpc()
+    def check_straggler(self) -> Tuple[List[int], str]:
+        reply = self._get(comm.StragglerRequest())
+        return reply.straggler, reply.reason
+
+    # ----------------------------------------------------------- kv store
+    @retry_rpc()
+    def kv_store_set(self, key: str, value: bytes):
+        return self._report(comm.KeyValuePair(key=key, value=value))
+
+    @retry_rpc()
+    def kv_store_get(self, key: str) -> bytes:
+        reply = self._get(comm.KVStoreGetRequest(key=key))
+        return reply.value
+
+    @retry_rpc()
+    def kv_store_add(self, key: str, amount: int) -> int:
+        reply = self._get(comm.KVStoreAddRequest(key=key, amount=amount))
+        return reply.value
+
+    @retry_rpc()
+    def kv_store_multi_get(self, keys: List[str]) -> List[bytes]:
+        reply = self._get(comm.KVStoreMultiGetRequest(keys=keys))
+        return [kv.value for kv in reply.kvs]
+
+    @retry_rpc()
+    def kv_store_multi_set(self, keys: List[str], values: List[bytes]):
+        kvs = [
+            comm.KeyValuePair(key=k, value=v) for k, v in zip(keys, values)
+        ]
+        return self._report(comm.KVStoreMultiSetRequest(kvs=kvs))
+
+    def kv_store_wait(self, keys: List[str], timeout: float = 300.0) -> bool:
+        """Poll the master in short slices (the server caps each wait at a
+        few seconds so waiters never starve its RPC thread pool)."""
+        deadline = time.time() + timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return False
+            reply = self._get(
+                comm.KVStoreWaitRequest(
+                    keys=keys, timeout=min(remaining, 5.0)
+                ),
+                timeout=30,
+            )
+            if reply.success:
+                return True
+
+    @retry_rpc()
+    def kv_store_delete(self, key: str):
+        return self._report(comm.KVStoreDeleteRequest(key=key))
+
+    # ---------------------------------------------------------- reporting
+    def report_global_step(
+        self, step: int, timestamp: float = 0.0, elapsed: float = 0.0
+    ):
+        return self._report(
+            comm.GlobalStep(
+                step=step,
+                timestamp=timestamp or time.time(),
+                elapsed_time_per_step=elapsed,
+            )
+        )
+
+    def report_heart_beat(self, timestamp: float = 0.0) -> str:
+        reply = self._report(
+            comm.HeartBeat(
+                node_id=self._node_id,
+                timestamp=timestamp or time.time(),
+            )
+        )
+        return reply.action if reply else ""
+
+    def report_resource_stats(self, stats: comm.ResourceStats):
+        return self._report(stats)
+
+    @retry_rpc(retry=3, interval=1)
+    def report_failure(
+        self,
+        error_data: str,
+        level: str,
+        node_rank: int = 0,
+        restart_count: int = 0,
+    ):
+        return self._report(
+            comm.NodeFailure(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    def report_node_status(self, node_rank: int, status: str):
+        return self._report(
+            comm.NodeStatusReport(
+                node_id=self._node_id, node_rank=node_rank, status=status
+            )
+        )
+
+    def report_node_event(self, event: comm.NodeEventReport):
+        return self._report(event)
+
+    def report_diagnosis_data(self, data: comm.DiagnosisReportData):
+        return self._report(data)
+
+    # ------------------------------------------------------------- config
+    @retry_rpc()
+    def get_paral_config(self) -> comm.ParallelConfig:
+        return self._get(comm.ParallelConfigRequest(node_id=self._node_id))
+
+    @retry_rpc()
+    def get_elastic_run_config(self) -> Dict[str, str]:
+        reply = self._get(comm.ElasticRunConfigRequest())
+        return reply.configs
+
+    # ------------------------------------------------------------ PS path
+    @retry_rpc()
+    def query_ps_nodes(self):
+        reply = self._get(comm.PsNodesRequest())
+        return reply.nodes, reply.new_ps_ready, reply.ps_failure
+
+    @retry_rpc()
+    def update_cluster_version(
+        self, version_type: str, version: int, task_type: str, task_id: int
+    ):
+        return self._report(
+            comm.UpdateClusterVersionRequest(
+                task_type=task_type,
+                task_id=task_id,
+                version_type=version_type,
+                version=version,
+            )
+        )
+
+    @retry_rpc()
+    def query_cluster_version(
+        self, version_type: str, task_type: str, task_id: int
+    ) -> int:
+        reply = self._get(
+            comm.ClusterVersionRequest(
+                task_type=task_type,
+                task_id=task_id,
+                version_type=version_type,
+            )
+        )
+        return reply.version
+
+    # --------------------------------------------------------------- sync
+    def join_sync(self, sync_name: str) -> bool:
+        reply = self._report(
+            comm.SyncJoinRequest(
+                sync_name=sync_name,
+                node_type=self._node_type,
+                node_id=self._node_id,
+            )
+        )
+        return reply.success
+
+    def sync_finished(self, sync_name: str) -> bool:
+        reply = self._get(comm.SyncJoinRequest(sync_name=sync_name))
+        return reply.success
+
+    def barrier(self, barrier_name: str, notify: bool = False) -> bool:
+        if notify:
+            reply = self._report(
+                comm.SyncFinishRequest(sync_name=barrier_name)
+            )
+            return reply.success
+        reply = self._get(comm.BarrierRequest(barrier_name=barrier_name))
+        return reply.success
+
+    def close(self):
+        self._stub.close()
+
+    # ------------------------------------------------------------ factory
+    @classmethod
+    def singleton_instance(cls) -> "MasterClient":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+                    node_id = int(os.getenv(NodeEnv.NODE_ID, "0"))
+                    node_type = os.getenv(NodeEnv.NODE_TYPE, "worker")
+                    if not addr:
+                        raise RuntimeError(
+                            f"{NodeEnv.MASTER_ADDR} is not set"
+                        )
+                    cls._instance = cls(addr, node_id, node_type)
+        return cls._instance
+
+    @classmethod
+    def reset_singleton(cls):
+        with cls._instance_lock:
+            cls._instance = None

@@ -1,0 +1,197 @@
+"""Constants and the environment-variable contract of the control plane.
+
+TPU-native counterpart of the reference's env/constant catalog
+(reference: dlrover/python/common/constants.py). Values are re-designed for
+TPU pod-slice deployments: workers are per-host processes driving all local
+TPU chips via one JAX process, not per-GPU processes.
+"""
+
+
+class NodeType:
+    MASTER = "master"
+    PS = "ps"
+    WORKER = "worker"
+    EVALUATOR = "evaluator"
+    CHIEF = "chief"
+    # TPU host agent inside one pod slice.
+    TPU_HOST = "worker"
+
+
+class NodeStatus:
+    INITIAL = "Initial"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    DELETED = "Deleted"
+    FINISHED = "Finished"
+    BREAKDOWN = "Breakdown"
+    UNKNOWN = "Unknown"
+
+
+class NodeEventType:
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+class NodeExitReason:
+    KILLED = "Deleted"
+    OOM = "OOMKilled"
+    FATAL_ERROR = "Error"
+    HARDWARE_ERROR = "HardwareError"  # chip / ICI-link failure
+    PREEMPTED = "Preempted"
+    UNKNOWN_ERROR = "UnknownError"
+    RELAUNCHED = "Relaunched"
+
+    @classmethod
+    def relaunchable(cls, reason: str) -> bool:
+        return reason not in (cls.FATAL_ERROR,)
+
+
+class JobStage:
+    CREATED = "Created"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SCALING = "Scaling"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class JobExitReason:
+    SUCCEEDED = "Completed"
+    CODE_ERROR = "CodeError"
+    WORKER_OOM = "WorkerOOM"
+    WORKER_ERROR = "WorkerError"
+    PS_OOM_ERROR = "PSOOM"
+    PS_ERROR = "PSError"
+    EVALUATOR_OOM = "EvaluatorOOM"
+    EVALUATOR_ERROR = "EvaluatorError"
+    HANG_ERROR = "HangError"
+    UNKNOWN_ERROR = "UnknownError"
+
+
+class PlatformType:
+    KUBERNETES = "k8s"
+    RAY = "ray"
+    LOCAL = "local"
+    PYK8S = "pyk8s"
+
+
+class DistributionStrategy:
+    LOCAL = "Local"
+    PS = "ParameterServerStrategy"
+    ALLREDUCE = "AllreduceStrategy"  # SPMD over a jax Mesh
+    CUSTOM = "CustomStrategy"
+
+
+class NodeEnv:
+    """Env-var contract between master, agent and workers."""
+
+    MASTER_ADDR = "DLROVER_MASTER_ADDR"
+    JOB_NAME = "DLROVER_JOB_NAME"
+    JOB_UID = "DLROVER_JOB_UID"
+    NODE_TYPE = "DLROVER_NODE_TYPE"
+    NODE_ID = "DLROVER_NODE_ID"
+    NODE_RANK = "DLROVER_NODE_RANK"
+    NODE_NUM = "DLROVER_NODE_NUM"
+    POD_NAME = "DLROVER_POD_NAME"
+    MONITOR_ENABLED = "DLROVER_MONITOR_ENABLED"
+    # Rank of this host within its TPU pod slice, and slice index.
+    HOST_RANK_IN_SLICE = "DLROVER_HOST_RANK_IN_SLICE"
+    SLICE_ID = "DLROVER_SLICE_ID"
+    # JAX distributed coordinator (host 0 of the comm world).
+    COORDINATOR_ADDR = "DLROVER_COORDINATOR_ADDR"
+    # File the trainer writes runtime metrics into (read by the agent).
+    RUNTIME_METRICS_PATH = "DLROVER_RUNTIME_METRICS_PATH"
+    # File the agent writes mutable parallel config into (read by trainer).
+    PARAL_CONFIG_PATH = "DLROVER_PARAL_CONFIG_PATH"
+    AUTO_PARAL = "DLROVER_AUTO_PARAL"
+
+
+class ConfigPath:
+    ENV_PARAL_CONFIG = NodeEnv.PARAL_CONFIG_PATH
+    PARAL_CONFIG = "/tmp/dlrover_tpu/auto_paral_config.json"
+    ENV_RUNTIME_METRICS = NodeEnv.RUNTIME_METRICS_PATH
+    RUNTIME_METRICS = "/tmp/dlrover_tpu/runtime_metrics.json"
+
+
+class RendezvousName:
+    ELASTIC_TRAINING = "elastic-training"
+    NETWORK_CHECK = "network-check"
+
+
+class NetworkFailureReason:
+    NODE_FAILURE = "Node breakdown"
+    WAITING_NODE = "Waiting node join"
+    NO_INIT = "Not initialized"
+
+
+class TrainingExceptionLevel:
+    PROCESS_ERROR = "process"
+    NODE_ERROR = "node"
+    RDZV_ERROR = "rdzv"
+    WARNING = "warning"
+    ERROR = "error"
+
+
+class RendezvousParams:
+    MIN_NODES = "min_nodes"
+    MAX_NODES = "max_nodes"
+
+
+class GRPC:
+    # Max message size for the control-plane RPC (checkpoint metas etc.).
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class CheckpointConstant:
+    TRACKER_FILE = "latest_checkpointed_iteration.txt"
+    MODEL_STATES_NAME = "model_states"
+    TRAIN_STATE_NAME = "train_state"
+    SAVE_TIMEOUT = 600
+
+
+class SaverClassMeta:
+    """Queue name over which trainers ask the agent to build a saver."""
+
+    FACTORY_QUEUE = "dlrover_tpu_factory"
+
+
+class JobConstant:
+    RDZV_JOIN_TIMEOUT_DEFAULT = 600
+    # Master monitors node heartbeats; no heartbeat in this window => dead.
+    NODE_HEARTBEAT_TIMEOUT = 300
+    MASTER_MONITOR_INTERVAL = 15
+    TRAINING_AGENT_LOOP_INTERVAL = 5
+    # Max times the master relaunches one node.
+    MAX_NODE_RELAUNCH_COUNT = 5
+
+
+class TaskType:
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    WAIT = "wait"
+    TRAIN_END_CALLBACK = "train_end_callback"
+
+
+class TpuEnv:
+    """TPU runtime discovery (libtpu / cloud metadata style)."""
+
+    ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+    WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+    WORKER_ID = "TPU_WORKER_ID"
+    CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+
+
+class EventReportConstants:
+    TYPE_INFO = "info"
+    TYPE_WARN = "warn"
+    TYPE_ERROR = "error"
+    ACTION_STOP = "stop"
+    ACTION_RELAUNCH = "relaunch"
+
+
+DEFAULT_MASTER_PORT = 22225

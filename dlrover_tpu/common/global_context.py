@@ -1,0 +1,54 @@
+"""Global tunables of the control plane (singleton).
+
+Counterpart of reference dlrover/python/common/global_context.py.
+"""
+
+import os
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.constants import DEFAULT_MASTER_PORT
+
+
+class Singleton:
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def singleton_instance(cls, *args, **kwargs):
+        if not hasattr(cls, "_instance"):
+            with cls._instance_lock:
+                if not hasattr(cls, "_instance"):
+                    cls._instance = cls(*args, **kwargs)
+        return cls._instance
+
+
+class Context(Singleton):
+    def __init__(self):
+        self.master_port: Optional[int] = None
+        self.job_name = os.getenv("DLROVER_JOB_NAME", "local-job")
+        self.relaunch_on_worker_failure = 3
+        self.relaunch_always = False
+        self.train_speed_record_num = 50
+        self.seconds_to_wait_failed_ps = 600
+        self.hang_detection = 1
+        self.hang_downtime_seconds = 1800
+        self.seconds_to_wait_pending_pod = 900
+        self.seconds_interval_to_optimize = 300
+        self.auto_worker_enabled = False
+        self.auto_ps_enabled = False
+        self.is_tfv1_ps = False
+        self.master_service_timeout = 600
+        self.reporter_type = "log"
+
+    def config_master_port(self, port: int = 0) -> None:
+        if port > 0:
+            self.master_port = port
+        else:
+            self.master_port = int(
+                os.getenv("DLROVER_MASTER_PORT", DEFAULT_MASTER_PORT)
+            )
+
+
+class DefaultValues:
+    SERVICE_TYPE = "grpc"
+    MAX_METRIC_REC = 30

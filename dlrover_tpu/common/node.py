@@ -1,0 +1,196 @@
+"""Node / resource model used across master, scalers and watchers.
+
+Counterpart of the reference node model (reference:
+dlrover/python/common/node.py:1-358), re-shaped for TPU: a ``Node`` is one
+host of a pod slice; its accelerator resource is counted in TPU chips.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    JobConstant,
+    NodeExitReason,
+    NodeStatus,
+)
+
+
+@dataclass
+class NodeResource:
+    """Resources of one node (host)."""
+
+    cpu: float = 0.0
+    memory: int = 0  # MiB
+    tpu_chips: int = 0
+    tpu_type: str = ""  # e.g. "v5p", "v5e"
+    priority: str = ""
+
+    @classmethod
+    def resource_str_to_node_resource(cls, resource: str) -> "NodeResource":
+        """Parse "cpu=4,memory=8192,tpu=8" style strings."""
+        res = cls()
+        if not resource:
+            return res
+        for kv in resource.split(","):
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            k = k.strip().lower()
+            if k == "cpu":
+                res.cpu = float(v)
+            elif k == "memory":
+                res.memory = int(v.lower().replace("mi", ""))
+            elif k in ("tpu", "tpu_chips"):
+                res.tpu_chips = int(v)
+            elif k == "tpu_type":
+                res.tpu_type = v
+        return res
+
+    def to_resource_dict(self) -> Dict[str, object]:
+        return {
+            "cpu": self.cpu,
+            "memory": f"{self.memory}Mi",
+            "tpu_chips": self.tpu_chips,
+        }
+
+
+@dataclass
+class NodeGroupResource:
+    """Resource of a node group (e.g. all workers)."""
+
+    count: int = 0
+    node_resource: NodeResource = field(default_factory=NodeResource)
+
+    def update(self, count: int, cpu: float, memory: int) -> None:
+        if count > 0:
+            self.count = count
+        if cpu > 0:
+            self.node_resource.cpu = cpu
+        if memory > 0:
+            self.node_resource.memory = memory
+
+
+class Node:
+    """One schedulable node (TPU host) of the job."""
+
+    def __init__(
+        self,
+        node_type: str,
+        node_id: int,
+        config_resource: Optional[NodeResource] = None,
+        name: Optional[str] = None,
+        status: str = NodeStatus.INITIAL,
+        rank_index: Optional[int] = None,
+        relaunch_count: int = 0,
+        max_relaunch_count: int = JobConstant.MAX_NODE_RELAUNCH_COUNT,
+        relaunchable: bool = True,
+        service_addr: str = "",
+        slice_id: int = 0,
+    ):
+        self.type = node_type
+        self.id = node_id
+        self.name = name or f"{node_type}-{node_id}"
+        self.status = status
+        self.rank_index = rank_index if rank_index is not None else node_id
+        self.config_resource = config_resource or NodeResource()
+        self.used_resource = NodeResource()
+        self.relaunch_count = relaunch_count
+        self.max_relaunch_count = max_relaunch_count
+        self.relaunchable = relaunchable
+        self.service_addr = service_addr
+        self.slice_id = slice_id
+
+        self.create_time: Optional[float] = None
+        self.start_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.heartbeat_time: float = 0.0
+        self.exit_reason: str = ""
+        self.is_released = False
+        self.start_hang_time: float = 0.0
+        self.init_time = time.time()
+        self.paral_config: Dict = {}
+        self.reported_status: str = ""
+        self.hang = False
+
+    # -- status ----------------------------------------------------------
+    def update_info(
+        self,
+        name: Optional[str] = None,
+        start_time: Optional[float] = None,
+        create_time: Optional[float] = None,
+        host_name: str = "",
+        restart_training: bool = False,
+        relaunch_count: int = 0,
+        is_released: Optional[bool] = None,
+    ) -> None:
+        if name is not None:
+            self.name = name
+        if start_time is not None:
+            self.start_time = start_time
+        if create_time is not None:
+            self.create_time = create_time
+        if relaunch_count > self.relaunch_count:
+            self.relaunch_count = relaunch_count
+        if is_released is not None:
+            self.is_released = is_released
+
+    def update_status(self, status: str) -> None:
+        if status != NodeStatus.UNKNOWN:
+            self.status = status
+        if status == NodeStatus.RUNNING and self.start_time is None:
+            self.start_time = time.time()
+        if status in (NodeStatus.SUCCEEDED, NodeStatus.FAILED, NodeStatus.DELETED):
+            self.finish_time = self.finish_time or time.time()
+
+    def is_exited(self) -> bool:
+        return self.status in (
+            NodeStatus.FAILED,
+            NodeStatus.SUCCEEDED,
+            NodeStatus.FINISHED,
+            NodeStatus.DELETED,
+        )
+
+    def exited_on_error(self) -> bool:
+        return self.status == NodeStatus.FAILED
+
+    # -- relaunch policy -------------------------------------------------
+    def inc_relaunch_count(self) -> None:
+        self.relaunch_count += 1
+
+    def should_relaunch(self) -> bool:
+        if not self.relaunchable or self.is_released:
+            return False
+        if self.relaunch_count >= self.max_relaunch_count:
+            return False
+        return NodeExitReason.relaunchable(self.exit_reason)
+
+    def update_heartbeat(self, ts: Optional[float] = None) -> None:
+        self.heartbeat_time = ts if ts is not None else time.time()
+
+    def heartbeat_timeout(
+        self, window: float = JobConstant.NODE_HEARTBEAT_TIMEOUT
+    ) -> bool:
+        if self.heartbeat_time == 0:
+            return False
+        return time.time() - self.heartbeat_time > window
+
+    def get_relaunch_node_info(self, new_id: int) -> "Node":
+        """Build the replacement node after this node fails."""
+        new_node = Node(
+            node_type=self.type,
+            node_id=new_id,
+            config_resource=self.config_resource,
+            status=NodeStatus.INITIAL,
+            rank_index=self.rank_index,
+            relaunch_count=self.relaunch_count + 1,
+            max_relaunch_count=self.max_relaunch_count,
+            slice_id=self.slice_id,
+        )
+        return new_node
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"Node(type={self.type}, id={self.id}, rank={self.rank_index}, "
+            f"status={self.status})"
+        )

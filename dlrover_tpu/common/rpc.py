@@ -1,0 +1,107 @@
+"""Control-plane RPC transport: a 2-method generic gRPC service.
+
+The master exposes exactly two unary RPCs, ``get`` and ``report`` (the
+reference's envelope — reference: dlrover/proto/elastic_training.proto:26-29),
+carrying msgpack-encoded typed messages (common/comm.py). We register them
+as generic bytes->bytes handlers, so no protoc code generation is required.
+"""
+
+import socket
+from concurrent import futures
+from typing import Callable
+
+import grpc
+
+from dlrover_tpu.common.constants import GRPC
+
+SERVICE_NAME = "dlrover_tpu.Master"
+
+
+def find_free_port(port: int = 0) -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", port))
+        return s.getsockname()[1]
+
+
+def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
+    if not addr or ":" not in addr:
+        return False
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def build_server(
+    get_handler: Callable[[bytes, object], bytes],
+    report_handler: Callable[[bytes, object], bytes],
+    max_workers: int = 32,
+) -> grpc.Server:
+    """Create a gRPC server with generic get/report bytes handlers."""
+
+    rpc_methods = {
+        "get": grpc.unary_unary_rpc_method_handler(
+            get_handler,
+            request_deserializer=None,
+            response_serializer=None,
+        ),
+        "report": grpc.unary_unary_rpc_method_handler(
+            report_handler,
+            request_deserializer=None,
+            response_serializer=None,
+        ),
+    }
+    handler = grpc.method_handlers_generic_handler(SERVICE_NAME, rpc_methods)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+            (
+                "grpc.max_receive_message_length",
+                GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+            ),
+        ],
+    )
+    server.add_generic_rpc_handlers((handler,))
+    return server
+
+
+class RpcStub:
+    """Client stub for the get/report envelope."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[
+                ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+                (
+                    "grpc.max_receive_message_length",
+                    GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+                ),
+                ("grpc.enable_retries", 1),
+            ],
+        )
+        self._get = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/get",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        self._report = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/report",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+
+    def get(self, payload: bytes, timeout: float = 0) -> bytes:
+        return self._get(payload, timeout=timeout or self._timeout)
+
+    def report(self, payload: bytes, timeout: float = 0) -> bytes:
+        return self._report(payload, timeout=timeout or self._timeout)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,67 @@
+"""Explicit msgpack (de)serialization for control-plane messages.
+
+The reference ships pickled dataclasses over its RPC envelope (reference:
+dlrover/python/common/grpc.py:129-469). We instead tag each registered
+dataclass with its class name and encode recursively with msgpack: explicit,
+language-portable and safe to receive from untrusted peers.
+"""
+
+import dataclasses
+from typing import Any, Dict, Type
+
+import msgpack
+
+_CLS_KEY = "__mcls__"
+_REGISTRY: Dict[str, Type] = {}
+
+
+def comm_message(cls):
+    """Class decorator: register a dataclass as a wire message."""
+    cls = dataclasses.dataclass(cls)
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {_CLS_KEY: type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = _encode(getattr(obj, f.name))
+        return out
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    if isinstance(obj, (str, bytes, bool, int, float)) or obj is None:
+        return obj
+    raise TypeError(f"Unserializable type in message: {type(obj)}")
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        name = obj.get(_CLS_KEY)
+        if name is not None:
+            cls = _REGISTRY.get(name)
+            if cls is None:
+                raise ValueError(f"Unknown message class: {name}")
+            kwargs = {
+                k: _decode(v) for k, v in obj.items() if k != _CLS_KEY
+            }
+            # Tolerate version skew: drop unknown fields.
+            names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: v for k, v in kwargs.items() if k in names}
+            return cls(**kwargs)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def serialize_message(obj: Any) -> bytes:
+    return msgpack.packb(_encode(obj), use_bin_type=True)
+
+
+def deserialize_message(data: bytes) -> Any:
+    if not data:
+        return None
+    return _decode(msgpack.unpackb(data, raw=False, strict_map_key=False))

@@ -1,0 +1,146 @@
+"""Pluggable checkpoint storage backends.
+
+Counterpart of the reference storage ABC (reference:
+dlrover/python/common/storage.py:24-328). Persist targets are POSIX paths
+(local disk, NFS/GCS-fuse mounts); deletion strategies bound retention.
+"""
+
+import os
+import shutil
+from abc import ABCMeta, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointDeletionStrategy(metaclass=ABCMeta):
+    @abstractmethod
+    def clean_up(self, step: int, delete_func) -> None:
+        """Decide which old checkpoint dirs to remove after saving `step`."""
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep only checkpoints whose step % keep_interval == 0."""
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str):
+        self._keep_interval = keep_interval
+        self._checkpoint_dir = checkpoint_dir
+
+    def clean_up(self, step: int, delete_func) -> None:
+        if step % self._keep_interval == 0:
+            return
+        path = os.path.join(self._checkpoint_dir, str(step))
+        try:
+            delete_func(path)
+        except Exception as e:
+            logger.warning(f"Cleanup of {path} failed: {e}")
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep at most `max_to_keep` newest step dirs."""
+
+    def __init__(self, max_to_keep: int, checkpoint_dir: str):
+        self._max_to_keep = max(max_to_keep, 1)
+        self._checkpoint_dir = checkpoint_dir
+
+    def clean_up(self, step: int, delete_func) -> None:
+        steps: List[int] = []
+        if not os.path.isdir(self._checkpoint_dir):
+            return
+        for name in os.listdir(self._checkpoint_dir):
+            if name.isdigit() and int(name) <= step:
+                steps.append(int(name))
+        steps.sort()
+        for s in steps[: -self._max_to_keep]:
+            try:
+                delete_func(os.path.join(self._checkpoint_dir, str(s)))
+            except Exception as e:
+                logger.warning(f"Cleanup of step {s} failed: {e}")
+
+
+class CheckpointStorage(metaclass=ABCMeta):
+    @abstractmethod
+    def write(self, content, path: str) -> None: ...
+
+    @abstractmethod
+    def read(self, path: str, mode: str = "r"): ...
+
+    @abstractmethod
+    def safe_rmtree(self, dir_path: str) -> None: ...
+
+    @abstractmethod
+    def safe_remove(self, path: str) -> None: ...
+
+    @abstractmethod
+    def safe_makedirs(self, dir_path: str) -> None: ...
+
+    @abstractmethod
+    def safe_move(self, src: str, dst: str) -> None: ...
+
+    @abstractmethod
+    def commit(self, step: int, success: bool) -> None: ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool: ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> List[str]: ...
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local/NFS/fuse-mounted POSIX storage (reference: storage.py:128)."""
+
+    def __init__(
+        self,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+    ):
+        self._deletion_strategy = deletion_strategy
+
+    def write(self, content, path: str) -> None:
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read(self, path: str, mode: str = "r"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_rmtree(self, dir_path: str) -> None:
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def safe_remove(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def safe_makedirs(self, dir_path: str) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+
+    def safe_move(self, src: str, dst: str) -> None:
+        if os.path.exists(src) and not os.path.exists(dst):
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            os.replace(src, dst) if os.path.isfile(src) else shutil.move(src, dst)
+
+    def commit(self, step: int, success: bool) -> None:
+        if self._deletion_strategy and success:
+            self._deletion_strategy.clean_up(
+                step, lambda p: shutil.rmtree(p, ignore_errors=True)
+            )
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return os.listdir(path) if os.path.isdir(path) else []
+
+
+def get_checkpoint_storage(
+    deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+) -> CheckpointStorage:
+    return PosixDiskStorage(deletion_strategy)

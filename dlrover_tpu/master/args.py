@@ -1,0 +1,36 @@
+"""Argument parsing for the master entry (reference:
+dlrover/python/master/args.py)."""
+
+import argparse
+
+
+def str2bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("yes", "true", "t", "y", "1")
+
+
+def build_master_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="dlrover-tpu job master")
+    parser.add_argument("--job_name", default="local-job")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--platform",
+        default="local",
+        choices=["local", "k8s", "pyk8s", "ray"],
+    )
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument(
+        "--pending_timeout", type=int, default=900,
+        help="seconds to wait pending nodes before failing the job",
+    )
+    parser.add_argument(
+        "--distribution_strategy",
+        default="AllreduceStrategy",
+    )
+    return parser
+
+
+def parse_master_args(argv=None):
+    return build_master_parser().parse_args(argv)

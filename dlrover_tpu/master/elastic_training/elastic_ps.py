@@ -1,0 +1,62 @@
+"""PS cluster-version bookkeeping for the sparse/PS training path.
+
+Counterpart of reference
+dlrover/python/master/elastic_training/elastic_ps.py. Workers and PS nodes
+coordinate cluster membership changes through three version types:
+GLOBAL (the master-published cluster version), LOCAL (what each node is
+running with) and RESTORED (version a node restored a checkpoint from).
+"""
+
+import threading
+from typing import Dict
+
+
+class PSClusterVersionType:
+    GLOBAL = "GLOBAL"
+    LOCAL = "LOCAL"
+    RESTORED = "RESTORED"
+
+
+class ElasticPsService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._global_version = 0
+        self._node_versions: Dict[str, Dict[int, Dict[str, int]]] = {}
+
+    def inc_global_cluster_version(self) -> int:
+        with self._lock:
+            self._global_version += 1
+            return self._global_version
+
+    def get_global_cluster_version(self) -> int:
+        return self._global_version
+
+    def update_node_version(
+        self, node_type: str, node_id: int, version_type: str, version: int
+    ) -> None:
+        with self._lock:
+            self._node_versions.setdefault(node_type, {}).setdefault(
+                node_id, {}
+            )[version_type] = version
+
+    def get_node_version(
+        self, node_type: str, node_id: int, version_type: str
+    ) -> int:
+        if version_type == PSClusterVersionType.GLOBAL:
+            return self._global_version
+        return (
+            self._node_versions.get(node_type, {})
+            .get(node_id, {})
+            .get(version_type, 0)
+        )
+
+    def ps_cluster_ready(self, target_num: int) -> bool:
+        """All `target_num` PS report LOCAL == GLOBAL."""
+        with self._lock:
+            ps_versions = self._node_versions.get("ps", {})
+            if len(ps_versions) < target_num:
+                return False
+            return all(
+                v.get(PSClusterVersionType.LOCAL, -1) == self._global_version
+                for v in ps_versions.values()
+            )

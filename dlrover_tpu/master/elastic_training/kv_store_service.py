@@ -1,0 +1,62 @@
+"""In-master KV store backing the distributed rendezvous Store.
+
+Counterpart of reference
+dlrover/python/master/elastic_training/kv_store_service.py:20-90, extended
+with ``add`` (atomic counter) and ``wait`` semantics used by torch-style
+Store clients.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class KVStoreService:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._store: Dict[str, bytes] = {}
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._store[key] = bytes(value)
+            self._lock.notify_all()
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, amount: int) -> int:
+        with self._lock:
+            current = int(self._store.get(key, b"0") or b"0")
+            current += amount
+            self._store[key] = str(current).encode()
+            self._lock.notify_all()
+            return current
+
+    def multi_get(self, keys: List[str]) -> List[bytes]:
+        with self._lock:
+            return [self._store.get(k, b"") for k in keys]
+
+    def multi_set(self, keys: List[str], values: List[bytes]) -> None:
+        with self._lock:
+            for k, v in zip(keys, values):
+                self._store[k] = bytes(v)
+            self._lock.notify_all()
+
+    def wait(self, keys: List[str], timeout: float = 300.0) -> bool:
+        deadline = time.time() + timeout
+        with self._lock:
+            while not all(k in self._store for k in keys):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(remaining)
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._store.pop(key, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()

@@ -1,0 +1,42 @@
+"""Topology-aware ordering of nodes in a comm world.
+
+Counterpart of reference
+dlrover/python/master/elastic_training/net_topology.py:21-89. On TPU the
+locality domain is the pod slice (ICI) rather than the access switch:
+hosts of the same slice are placed at adjacent ranks so that data-parallel
+collectives ride ICI and only cross-slice traffic uses DCN.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class NodeTopologyMeta:
+    node_id: int = 0
+    node_rank: int = 0
+    process_num: int = 1  # local world size (TPU chips driven by this host)
+    slice_id: int = 0
+    node_ip: str = ""
+    asw: str = ""  # access switch, used for DCN locality between slices
+
+
+class DefaultTopologySorter:
+    def sort(
+        self, nodes: Dict[int, NodeTopologyMeta]
+    ) -> Dict[int, NodeTopologyMeta]:
+        return dict(sorted(nodes.items(), key=lambda kv: kv[0]))
+
+
+class SliceTopologySorter:
+    """Group hosts by (slice_id, asw, rank) — the TPU analog of
+    ``DpTopologySorter`` (reference: net_topology.py:62)."""
+
+    def sort(
+        self, nodes: Dict[int, NodeTopologyMeta]
+    ) -> Dict[int, NodeTopologyMeta]:
+        ordered = sorted(
+            nodes.values(),
+            key=lambda n: (n.slice_id, n.asw, n.node_rank),
+        )
+        return {n.node_rank: n for n in ordered}

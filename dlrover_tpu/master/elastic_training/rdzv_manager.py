@@ -1,0 +1,326 @@
+"""Master-side elastic rendezvous and network-check managers.
+
+Counterpart of reference
+dlrover/python/master/elastic_training/rdzv_manager.py:58-566.
+
+``ElasticTrainingRendezvousManager`` collects joining hosts into a waiting
+list and completes a round when (a) every alive host has joined, or (b) the
+waiting window expired with >= min_nodes joined, rounded down to a multiple
+of ``node_unit`` (on TPU, node_unit = hosts per pod slice: a partial slice
+cannot run an SPMD program).
+
+``NetworkCheckRendezvousManager`` pairs hosts into small check groups over
+two rounds so a faulty host/slice can be localized by intersecting the
+groups that failed (reference: rdzv_manager.py:349-530); stragglers are
+flagged by comparing per-node elapsed time to the median (reference:
+:550-565). On TPU this check exercises host<->chip liveness and ICI/DCN
+collectives between paired hosts.
+"""
+
+import math
+import time
+from abc import ABCMeta, abstractmethod
+from threading import Lock
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NetworkFailureReason
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.elastic_training.net_topology import (
+    NodeTopologyMeta,
+    SliceTopologySorter,
+)
+
+
+class RendezvousParameters:
+    def __init__(
+        self,
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+        join_timeout: float = 600.0,
+    ):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout
+        self.node_unit = node_unit
+        self.join_timeout = join_timeout
+
+
+class RendezvousManager(metaclass=ABCMeta):
+    def __init__(self):
+        self._lock = Lock()
+        self._name = ""
+        self._waiting_nodes: Dict[int, NodeTopologyMeta] = {}
+        self._rdzv_nodes: Dict[int, NodeTopologyMeta] = {}
+        self._lastcall_time: float = 0.0
+        self._rdzv_params = RendezvousParameters()
+        self._rdzv_round = 0
+        self._alive_nodes: set = set()
+        self._node_rdzv_times: Dict[int, float] = {}
+        self._latest_rdzv_nodes: List[int] = []
+        self._start_rdzv_ts = 0.0
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    def update_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+        join_timeout: float = 600.0,
+    ) -> None:
+        with self._lock:
+            self._rdzv_params = RendezvousParameters(
+                min_nodes, max_nodes, waiting_timeout, node_unit, join_timeout
+            )
+
+    def add_alive_node(self, node_rank: int) -> None:
+        with self._lock:
+            self._alive_nodes.add(node_rank)
+
+    def remove_alive_node(self, node_rank: int) -> None:
+        with self._lock:
+            self._alive_nodes.discard(node_rank)
+            if node_rank in self._waiting_nodes:
+                del self._waiting_nodes[node_rank]
+
+    def join_rendezvous(
+        self,
+        node_id: int,
+        node_rank: int,
+        local_world_size: int,
+        node_ip: str = "",
+        slice_id: int = 0,
+    ) -> int:
+        """Add a host to the waiting list; returns the next round id."""
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_ts = time.time()
+            self._waiting_nodes[node_rank] = NodeTopologyMeta(
+                node_id=node_id,
+                node_rank=node_rank,
+                process_num=local_world_size,
+                node_ip=node_ip,
+                slice_id=slice_id,
+            )
+            self._alive_nodes.add(node_rank)
+            self._node_rdzv_times[node_rank] = time.time()
+            self._lastcall_time = time.time()
+        return self._rdzv_round
+
+    def num_nodes_waiting(self) -> int:
+        """Agents poll this to notice membership growth (restart trigger)."""
+        with self._lock:
+            return len(self._waiting_nodes)
+
+    def _check_rdzv_completed(self) -> bool:
+        """Caller holds the lock."""
+        waiting = len(self._waiting_nodes)
+        params = self._rdzv_params
+        if waiting == 0:
+            return False
+        alive = max(len(self._alive_nodes), params.min_nodes)
+        target = min(alive, params.max_nodes)
+        if waiting >= target:
+            return True
+        since_lastcall = time.time() - self._lastcall_time
+        if (
+            waiting >= params.min_nodes
+            and since_lastcall >= params.waiting_timeout
+        ):
+            return True
+        return False
+
+    def _complete_rdzv(self) -> bool:
+        """Caller holds the lock: admit a node_unit-rounded set of nodes.
+        Returns False (and leaves state untouched) if rounding admits 0."""
+        params = self._rdzv_params
+        unit = max(params.node_unit, 1)
+        admitted_num = (len(self._waiting_nodes) // unit) * unit
+        admitted_num = min(admitted_num, params.max_nodes)
+        if admitted_num == 0:
+            return False
+        ranks = sorted(self._waiting_nodes.keys())[:admitted_num]
+        nodes = {r: self._waiting_nodes[r] for r in ranks}
+        sorter = SliceTopologySorter()
+        self._rdzv_nodes = sorter.sort(nodes)
+        self._latest_rdzv_nodes = list(self._rdzv_nodes.keys())
+        for r in ranks:
+            del self._waiting_nodes[r]
+        self._rdzv_round += 1
+        elapsed = time.time() - self._start_rdzv_ts
+        logger.info(
+            "Rendezvous %s round %s completed with %s nodes in %.1fs: %s",
+            self._name, self._rdzv_round, len(self._rdzv_nodes),
+            elapsed, list(self._rdzv_nodes.keys()),
+        )
+        return True
+
+    @abstractmethod
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, NodeTopologyMeta]]:
+        """Return (round, group, {rank: meta}) or an empty world if not
+        yet complete."""
+
+    def joined(self, node_rank: int) -> bool:
+        with self._lock:
+            return (
+                node_rank in self._waiting_nodes
+                or node_rank in self._rdzv_nodes
+            )
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """(reference: rdzv_manager.py:291-343)."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = "elastic-training"
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, NodeTopologyMeta]]:
+        with self._lock:
+            if self._waiting_nodes and self._check_rdzv_completed():
+                self._complete_rdzv()
+            if node_rank in self._rdzv_nodes:
+                return self._rdzv_round, 0, dict(self._rdzv_nodes)
+            return self._rdzv_round, 0, {}
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """(reference: rdzv_manager.py:349-565)."""
+
+    GROUP_SIZE = 2
+
+    def __init__(self):
+        super().__init__()
+        self._name = "network-check"
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._check_round = 2
+        self._node_groups: List[List[int]] = []
+        self._fault_nodes: set = set()
+        self._straggler_nodes: set = set()
+        self._reported_nodes: set = set()
+        self._round_idx = 0
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, NodeTopologyMeta]]:
+        with self._lock:
+            if self._waiting_nodes and self._check_rdzv_completed():
+                if self._complete_rdzv():
+                    self._build_node_groups()
+            for group_idx, group in enumerate(self._node_groups):
+                if node_rank in group:
+                    world = {
+                        r: self._rdzv_nodes[r]
+                        for r in group
+                        if r in self._rdzv_nodes
+                    }
+                    return self._rdzv_round, group_idx, world
+            return self._rdzv_round, 0, {}
+
+    def _build_node_groups(self) -> None:
+        """Pair nodes; in round 1 pair sequentially, in round 2 re-pair so
+        that a node that failed twice is definitively faulty (reference:
+        rdzv_manager.py:430-505)."""
+        ranks = list(self._rdzv_nodes.keys())
+        self._reported_nodes = set()
+        self._round_idx += 1
+        groups: List[List[int]] = []
+        if self._round_idx % 2 == 1 or not self._fault_nodes:
+            # Sequential pairing.
+            for i in range(0, len(ranks), self.GROUP_SIZE):
+                groups.append(ranks[i : i + self.GROUP_SIZE])
+        else:
+            # Re-pair each previously-abnormal node with a known-good peer.
+            normal = [r for r in ranks if r not in self._fault_nodes]
+            abnormal = [r for r in ranks if r in self._fault_nodes]
+            used_normal = list(normal)
+            groups = []
+            rest = []
+            for bad in abnormal:
+                if used_normal:
+                    groups.append([bad, used_normal.pop(0)])
+                else:
+                    rest.append(bad)
+            for i in range(0, len(used_normal), self.GROUP_SIZE):
+                groups.append(used_normal[i : i + self.GROUP_SIZE])
+            if rest:
+                groups.append(rest)
+        # Merge a trailing singleton into the previous group.
+        if len(groups) > 1 and len(groups[-1]) == 1:
+            groups[-2].extend(groups.pop())
+        self._node_groups = groups
+        logger.info("Network-check groups: %s", groups)
+
+    def report_network_check_result(
+        self, node_rank: int, normal: bool, elapsed_time: float
+    ) -> None:
+        with self._lock:
+            self._reported_nodes.add(node_rank)
+            prev = self._node_status.get(node_rank, True)
+            self._node_status[node_rank] = normal
+            self._node_times[node_rank] = elapsed_time
+            if not normal:
+                if node_rank in self._fault_nodes or not prev:
+                    pass  # stays faulty; check_fault_node intersects rounds
+                self._fault_nodes.add(node_rank)
+            else:
+                self._fault_nodes.discard(node_rank)
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        """(reference: rdzv_manager.py:507-548)."""
+        with self._lock:
+            if not self._rdzv_nodes:
+                return [], NetworkFailureReason.NO_INIT
+            all_reported = self._reported_nodes >= set(
+                self._rdzv_nodes.keys()
+            )
+            if not all_reported:
+                return [], NetworkFailureReason.WAITING_NODE
+            faults = sorted(self._fault_nodes)
+            if faults:
+                return faults, NetworkFailureReason.NODE_FAILURE
+            return [], ""
+
+    def check_straggler(self) -> Tuple[List[int], str]:
+        """Median rule (reference: rdzv_manager.py:550-565)."""
+        with self._lock:
+            times = [
+                t for r, t in self._node_times.items()
+                if r in self._rdzv_nodes
+            ]
+            if len(times) < 2:
+                return [], ""
+            sorted_times = sorted(times)
+            n = len(sorted_times)
+            median = (
+                sorted_times[n // 2]
+                if n % 2
+                else 0.5 * (sorted_times[n // 2 - 1] + sorted_times[n // 2])
+            )
+            stragglers = [
+                r
+                for r, t in self._node_times.items()
+                if r in self._rdzv_nodes and median > 0 and t > 2 * median
+            ]
+            self._straggler_nodes = set(stragglers)
+            return sorted(stragglers), ""
+
+    def network_check_success(self) -> Tuple[bool, str]:
+        faults, reason = self.check_fault_node()
+        if reason == NetworkFailureReason.WAITING_NODE:
+            return False, reason
+        return len(faults) == 0, reason

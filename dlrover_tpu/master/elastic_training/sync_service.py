@@ -1,0 +1,61 @@
+"""Named barriers / sync groups across workers.
+
+Counterpart of reference
+dlrover/python/master/elastic_training/sync_service.py:26+ (used by the PS
+path and any cross-worker coordination outside collectives).
+"""
+
+import threading
+from typing import Dict, Set, Tuple
+
+
+class SyncService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sync_objs_target: Dict[str, Set[Tuple[str, int]]] = {}
+        self._synced: Dict[str, Set[Tuple[str, int]]] = {}
+        self._finished_barriers: Set[str] = set()
+
+    def join_sync(
+        self, sync_name: str, node_type: str, node_id: int, target_num: int = 0
+    ) -> bool:
+        """A worker joins a named sync; returns True once all joined."""
+        with self._lock:
+            self._synced.setdefault(sync_name, set()).add(
+                (node_type, node_id)
+            )
+            if target_num:
+                return len(self._synced[sync_name]) >= target_num
+            target = self._sync_objs_target.get(sync_name)
+            if target is not None:
+                return self._synced[sync_name] >= target
+            return False
+
+    def set_sync_target(
+        self, sync_name: str, members: Set[Tuple[str, int]]
+    ) -> None:
+        with self._lock:
+            self._sync_objs_target[sync_name] = set(members)
+
+    def sync_finished(self, sync_name: str) -> bool:
+        with self._lock:
+            target = self._sync_objs_target.get(sync_name)
+            joined = self._synced.get(sync_name, set())
+            if target is None:
+                return bool(joined)
+            return joined >= target
+
+    def barrier(self, barrier_name: str) -> bool:
+        return barrier_name in self._finished_barriers
+
+    def notify_barrier(self, barrier_name: str) -> bool:
+        with self._lock:
+            self._finished_barriers.add(barrier_name)
+            return True
+
+    def remove_exited_worker_sync(self, node_type: str, node_id: int) -> None:
+        with self._lock:
+            for joined in self._synced.values():
+                joined.discard((node_type, node_id))
+            for target in self._sync_objs_target.values():
+                target.discard((node_type, node_id))

@@ -1,0 +1,35 @@
+"""Master entry point (reference: dlrover/python/master/main.py:43-63)."""
+
+import sys
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.rpc import find_free_port
+from dlrover_tpu.master.args import parse_master_args
+
+
+def run(args) -> int:
+    port = args.port or find_free_port()
+    if args.platform == "local":
+        from dlrover_tpu.master.local_master import LocalJobMaster
+
+        master = LocalJobMaster(port, node_num=args.node_num)
+    else:
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.master.job_args import new_job_args
+
+        job_args = new_job_args(args.platform, args.job_name, args.namespace)
+        master = DistributedJobMaster(port, job_args)
+    master.prepare()
+    logger.info(
+        "Master started: platform=%s port=%s", args.platform, port
+    )
+    return master.run()
+
+
+def main(argv=None) -> int:
+    args = parse_master_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

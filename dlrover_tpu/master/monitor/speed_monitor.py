@@ -1,0 +1,101 @@
+"""Training speed tracking and straggler-aware accounting.
+
+Counterpart of reference dlrover/python/master/monitor/speed_monitor.py:43-190:
+workers report (step, timestamp) samples; the monitor derives global speed
+(steps/sec), detects slow-downs and supplies the autoscaler with data.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+
+class GlobalStepRecord:
+    def __init__(self, global_step: int, timestamp: float, worker_num: int):
+        self.global_step = global_step
+        self.timestamp = timestamp
+        self.worker_num = worker_num
+
+
+class SpeedMonitor:
+    def __init__(self, max_records: int = 50):
+        self._lock = threading.Lock()
+        self._global_step_records: Deque[GlobalStepRecord] = deque(
+            maxlen=max_records
+        )
+        self._workers: Set[Tuple[str, int]] = set()
+        self._global_step = 0
+        self._init_time = time.time()
+        self._start_training_time: Optional[float] = None
+        self._sample_count = 0
+        self._worker_step_times: Dict[int, float] = {}
+        self.target_worker_num = 0
+
+    def set_target_worker_num(self, n: int) -> None:
+        self.target_worker_num = n
+
+    def add_running_worker(self, node_type: str, worker_id: int) -> None:
+        with self._lock:
+            self._workers.add((node_type, worker_id))
+
+    def remove_running_worker(self, node_type: str, worker_id: int) -> None:
+        with self._lock:
+            self._workers.discard((node_type, worker_id))
+
+    @property
+    def running_workers(self) -> Set[Tuple[str, int]]:
+        return self._workers
+
+    @property
+    def completed_global_step(self) -> int:
+        return self._global_step
+
+    def set_start_timestamp(self) -> None:
+        if self._global_step == 0 and not self._start_training_time:
+            self._start_training_time = time.time()
+
+    def sample_global_step(self, global_step: int, timestamp: float) -> None:
+        """Record a reported global step (reference: :81-125)."""
+        with self._lock:
+            if global_step < self._global_step:
+                return
+            self._global_step = global_step
+            if not self._start_training_time:
+                self._start_training_time = time.time()
+            self._sample_count += 1
+            self._global_step_records.append(
+                GlobalStepRecord(global_step, timestamp, len(self._workers))
+            )
+
+    def running_speed(self) -> float:
+        """steps/sec over the recent sample window."""
+        with self._lock:
+            if len(self._global_step_records) < 2:
+                return 0.0
+            first = self._global_step_records[0]
+            last = self._global_step_records[-1]
+            dt = last.timestamp - first.timestamp
+            if dt <= 0:
+                return 0.0
+            return (last.global_step - first.global_step) / dt
+
+    def init_training_speed_or_not(self) -> bool:
+        return self._sample_count >= 2
+
+    def worker_adjustment_finished(self) -> bool:
+        """All target workers are present in the recent records."""
+        if not self.target_worker_num:
+            return False
+        with self._lock:
+            if not self._global_step_records:
+                return False
+            return (
+                self._global_step_records[-1].worker_num
+                == self.target_worker_num
+            )
+
+    def reset_running_speed_monitor(self) -> None:
+        with self._lock:
+            self._global_step_records.clear()
+            self._sample_count = 0

@@ -1,0 +1,200 @@
+"""Per-dataset task queues for dynamic data sharding.
+
+Counterpart of reference dlrover/python/master/shard/{base,batch,streaming}_
+dataset_manager.py: shards become ``Task``s in a todo queue; workers check
+tasks out (doing set) and report completion; failed/timed-out tasks go back
+to todo — this is what makes data consumption elastic and fault-tolerant.
+"""
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.shard.dataset_splitter import (
+    DatasetSplitter,
+    Shard,
+    StreamingDatasetSplitter,
+)
+
+
+@dataclass
+class Task:
+    task_id: int
+    task_type: str
+    shard: Shard
+    retry_count: int = 0
+
+    @staticmethod
+    def create_invalid_task() -> "Task":
+        return Task(-1, "", Shard("", -1, -1))
+
+
+@dataclass
+class DoingTask:
+    task: Task
+    node_id: int
+    start_time: float
+
+
+class DatasetManager:
+    """Tasks of one logical dataset."""
+
+    def __init__(
+        self,
+        task_type: str,
+        batch_size: int,
+        dataset_splitter: DatasetSplitter,
+        max_task_retries: int = 3,
+    ):
+        self._task_type = task_type
+        self._batch_size = batch_size
+        self._splitter = dataset_splitter
+        self._max_task_retries = max_task_retries
+        self.todo: Deque[Task] = deque()
+        self.doing: "OrderedDict[int, DoingTask]" = OrderedDict()
+        self._task_id_counter = 0
+        self._completed_tasks = 0
+        self._dispatched_tasks = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ dispatch
+    def get_task(self, node_id: int) -> Task:
+        with self._lock:
+            if not self.todo and not self._splitter.epoch_finished():
+                self._create_tasks()
+            if not self.todo:
+                return Task.create_invalid_task()
+            task = self.todo.popleft()
+            self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+            self._dispatched_tasks += 1
+            return task
+
+    def _create_tasks(self) -> None:
+        if not self._splitter.create_shards():
+            return
+        for shard in self._splitter.get_shards():
+            self._task_id_counter += 1
+            self.todo.append(
+                Task(self._task_id_counter, self._task_type, shard)
+            )
+
+    # ------------------------------------------------------------ complete
+    def report_task_done(
+        self, task_id: int, success: bool
+    ) -> Tuple[bool, Optional[Task]]:
+        with self._lock:
+            doing = self.doing.pop(task_id, None)
+            if doing is None:
+                return False, None
+            if success:
+                self._completed_tasks += 1
+                return True, doing.task
+            doing.task.retry_count += 1
+            if doing.task.retry_count <= self._max_task_retries:
+                self.todo.appendleft(doing.task)
+            else:
+                logger.warning(
+                    "Task %s dropped after %s retries",
+                    task_id, doing.task.retry_count,
+                )
+            return False, doing.task
+
+    def recover_task(self, task: Task) -> None:
+        """Return a task of a dead worker to the todo queue."""
+        with self._lock:
+            self.todo.appendleft(task)
+
+    def recover_tasks_of_node(self, node_id: int) -> List[int]:
+        with self._lock:
+            ids = [
+                tid
+                for tid, dt in self.doing.items()
+                if dt.node_id == node_id
+            ]
+            for tid in ids:
+                dt = self.doing.pop(tid)
+                self.todo.appendleft(dt.task)
+            return ids
+
+    def reassign_timeout_tasks(self, timeout: float) -> List[int]:
+        now = time.time()
+        with self._lock:
+            ids = [
+                tid
+                for tid, dt in self.doing.items()
+                if now - dt.start_time > timeout
+            ]
+            for tid in ids:
+                dt = self.doing.pop(tid)
+                self.todo.appendleft(dt.task)
+            return ids
+
+    # ------------------------------------------------------------- status
+    def completed(self) -> bool:
+        return (
+            self._splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    def completed_step(self) -> int:
+        records = self._completed_tasks * self._splitter.shard_size
+        return records // self._batch_size if self._batch_size else 0
+
+    def get_epoch(self) -> int:
+        return self._splitter.get_epoch()
+
+    # --------------------------------------------------------- checkpoint
+    def checkpoint(self) -> str:
+        def _shard_entry(shard):
+            entry = [shard.start, shard.end]
+            if shard.record_indices:
+                entry.append(list(shard.record_indices))
+            return entry
+
+        with self._lock:
+            todo = [_shard_entry(t.shard) for t in list(self.todo)] + [
+                _shard_entry(dt.task.shard) for dt in self.doing.values()
+            ]
+            content = {
+                "dataset_name": self._splitter.dataset_name,
+                "todo": todo,
+                "epoch": self._splitter.get_epoch(),
+                "completed": self._completed_tasks,
+            }
+            if isinstance(self._splitter, StreamingDatasetSplitter):
+                content["splitter"] = self._splitter.to_checkpoint()
+            return json.dumps(content)
+
+    def restore_checkpoint(self, content: str) -> None:
+        d = json.loads(content)
+        with self._lock:
+            self.todo.clear()
+            self.doing.clear()
+            for entry in d.get("todo", []):
+                start, end = entry[0], entry[1]
+                indices = entry[2] if len(entry) > 2 else None
+                self._task_id_counter += 1
+                self.todo.append(
+                    Task(
+                        self._task_id_counter,
+                        self._task_type,
+                        Shard(
+                            self._splitter.dataset_name, start, end, indices
+                        ),
+                    )
+                )
+            self._splitter.epoch = d.get("epoch", 0)
+            self._completed_tasks = d.get("completed", 0)
+            if "splitter" in d and isinstance(
+                self._splitter, StreamingDatasetSplitter
+            ):
+                restored = StreamingDatasetSplitter.from_checkpoint(
+                    d["splitter"]
+                )
+                self._splitter = restored

@@ -1,0 +1,238 @@
+"""Dataset shard creation for the dynamic data-sharding service.
+
+Counterpart of reference dlrover/python/master/shard/dataset_splitter.py:90-481:
+``TableDatasetSplitter`` shards [0, dataset_size) into index ranges;
+``TextDatasetSplitter`` additionally materializes (optionally shuffled)
+record indices per shard; ``StreamingDatasetSplitter`` shards an unbounded
+stream and supports checkpoint/restore.
+"""
+
+import json
+import random
+from abc import ABCMeta, abstractmethod
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class Shard:
+    """A [start, end) range of one dataset, optionally with indices."""
+
+    name: str
+    start: int
+    end: int
+    record_indices: Optional[List[int]] = None
+
+
+class PartitionOffsets:
+    """Stream partition offsets for streaming sharding."""
+
+    def __init__(self, partition_offsets):
+        self.partition_offsets = partition_offsets
+
+
+class DatasetSplitter(metaclass=ABCMeta):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = shard_size
+        self._num_epochs = num_epochs
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> bool: ...
+
+    @abstractmethod
+    def get_shards(self) -> List[Shard]: ...
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self._num_epochs
+
+    def get_epoch(self) -> int:
+        return self.epoch
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Range shards over a table-like dataset (reference: :144)."""
+
+    STORAGE_TYPE = "table"
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        max_shard_count: int = 50000,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+        self._max_shard_count = max_shard_count
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> bool:
+        if self.epoch >= self._num_epochs:
+            return False
+        logger.info(
+            "Creating shards for dataset %s epoch %s",
+            self.dataset_name, self.epoch,
+        )
+        shard_count = (
+            self.dataset_size + self.shard_size - 1
+        ) // self.shard_size
+        if shard_count > self._max_shard_count:
+            raise ValueError(
+                f"{shard_count} shards exceeds max {self._max_shard_count}; "
+                f"increase shard size"
+            )
+        shards = []
+        for i in range(shard_count):
+            start = i * self.shard_size
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(Shard(self.dataset_name, start, end))
+        if self._shuffle:
+            random.shuffle(shards)
+        self._shards = shards
+        self.epoch += 1
+        return True
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards carrying per-record indices (reference: :257)."""
+
+    STORAGE_TYPE = "text"
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> bool:
+        if self.epoch >= self._num_epochs:
+            return False
+        indices = list(range(self.dataset_size))
+        if self._shuffle:
+            random.shuffle(indices)
+        shards = []
+        for start in range(0, self.dataset_size, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(self.dataset_name, start, end, indices[start:end])
+            )
+        self._shards = shards
+        self.epoch += 1
+        return True
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Shards over an unbounded stream with checkpointing (reference: :359).
+
+    ``dataset_size < 0`` means unbounded; shards are generated from a moving
+    offset, and `to_checkpoint`/`from_checkpoint` snapshot progress.
+    """
+
+    STORAGE_TYPE = "streaming"
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        data_size: int = -1,
+        fetch_data_size: int = 10000,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._data_size = data_size if data_size > 0 else dataset_size
+        self._fetch_data_size = fetch_data_size
+        self._offset = 0
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> bool:
+        remaining = (
+            self._data_size - self._offset if self._data_size > 0 else
+            self._fetch_data_size
+        )
+        if remaining <= 0:
+            self.epoch = self._num_epochs
+            return False
+        fetch = min(self._fetch_data_size, remaining)
+        shards = []
+        start = self._offset
+        while start < self._offset + fetch:
+            end = min(start + self.shard_size, self._offset + fetch)
+            shards.append(Shard(self.dataset_name, start, end))
+            start = end
+        self._offset += fetch
+        self._shards = shards
+        if self._data_size > 0 and self._offset >= self._data_size:
+            self.epoch = self._num_epochs
+        return True
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+    def to_checkpoint(self) -> str:
+        return json.dumps(
+            {
+                "dataset_name": self.dataset_name,
+                "dataset_size": self.dataset_size,
+                "shard_size": self.shard_size,
+                "data_size": self._data_size,
+                "offset": self._offset,
+                "epoch": self.epoch,
+            }
+        )
+
+    @classmethod
+    def from_checkpoint(cls, content: str) -> "StreamingDatasetSplitter":
+        d = json.loads(content)
+        splitter = cls(
+            dataset_name=d["dataset_name"],
+            dataset_size=d["dataset_size"],
+            shard_size=d["shard_size"],
+            data_size=d["data_size"],
+        )
+        splitter._offset = d["offset"]
+        splitter.epoch = d["epoch"]
+        return splitter
+
+
+def new_dataset_splitter(
+    shuffle: bool,
+    shard_size: int,
+    dataset_size: int,
+    num_epochs: int,
+    dataset_name: str,
+    storage_type: str = "table",
+) -> DatasetSplitter:
+    if storage_type in ("", TableDatasetSplitter.STORAGE_TYPE):
+        return TableDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == TextDatasetSplitter.STORAGE_TYPE:
+        return TextDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == StreamingDatasetSplitter.STORAGE_TYPE:
+        return StreamingDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs
+        )
+    raise ValueError(f"Unknown storage type {storage_type}")

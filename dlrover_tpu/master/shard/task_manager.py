@@ -1,0 +1,152 @@
+"""Master-side dynamic data sharding service.
+
+Counterpart of reference dlrover/python/master/shard/task_manager.py:37-292:
+registers datasets, dispatches shard tasks to workers, recovers shards of
+failed workers and reassigns timed-out tasks.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.shard.dataset_manager import DatasetManager, Task
+from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
+
+_TASK_TIMEOUT_SECS = 1800
+
+
+class TaskManager:
+    def __init__(
+        self,
+        worker_restart_timeout: int = 0,
+        speed_monitor: Optional[SpeedMonitor] = None,
+    ):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._worker_restart_timeout = worker_restart_timeout
+        self._speed_monitor = speed_monitor or SpeedMonitor()
+        self._task_timeout = _TASK_TIMEOUT_SECS
+        self.support_fault_tolerance = True
+        self._stopped = False
+
+    # ---------------------------------------------------------- datasets
+    def new_dataset(
+        self,
+        batch_size: int,
+        dataset_size: int,
+        dataset_name: str,
+        dataset_splitter=None,
+        task_type: str = TaskType.TRAINING,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+    ) -> None:
+        with self._lock:
+            if dataset_name in self._datasets:
+                logger.info("Dataset %s already registered", dataset_name)
+                return
+            if dataset_splitter is None:
+                shard_size = max(batch_size * num_minibatches_per_shard, 1)
+                dataset_splitter = new_dataset_splitter(
+                    shuffle,
+                    shard_size,
+                    dataset_size,
+                    num_epochs,
+                    dataset_name,
+                    storage_type,
+                )
+            self._datasets[dataset_name] = DatasetManager(
+                task_type, batch_size, dataset_splitter
+            )
+            logger.info(
+                "Registered dataset %s size=%s batch=%s",
+                dataset_name, dataset_size, batch_size,
+            )
+
+    def get_dataset(self, name: str) -> Optional[DatasetManager]:
+        return self._datasets.get(name)
+
+    # ------------------------------------------------------------ serving
+    def get_dataset_task(self, node_id: int, dataset_name: str) -> Task:
+        ds = self._datasets.get(dataset_name)
+        if ds is None:
+            return Task.create_invalid_task()
+        return ds.get_task(node_id)
+
+    def report_dataset_task(
+        self, dataset_name: str, task_id: int, success: bool
+    ):
+        ds = self._datasets.get(dataset_name)
+        if ds is None:
+            return False, None
+        return ds.report_task_done(task_id, success)
+
+    def finished(self) -> bool:
+        if not self._datasets:
+            return False
+        return all(ds.completed() for ds in self._datasets.values())
+
+    def recover_tasks(self, node_id: int) -> None:
+        """Requeue the doing tasks of a failed worker (reference: :165)."""
+        for name, ds in self._datasets.items():
+            ids = ds.recover_tasks_of_node(node_id)
+            if ids:
+                logger.info(
+                    "Recovered tasks %s of node %s in dataset %s",
+                    ids, node_id, name,
+                )
+
+    def reassign_timeout_tasks(self) -> None:
+        for name, ds in self._datasets.items():
+            ids = ds.reassign_timeout_tasks(self._task_timeout)
+            if ids:
+                logger.info(
+                    "Reassigned timed-out tasks %s of dataset %s", ids, name
+                )
+
+    def start(self) -> None:
+        t = threading.Thread(
+            target=self._check_timeout_loop,
+            name="task-timeout-check",
+            daemon=True,
+        )
+        t.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _check_timeout_loop(self) -> None:
+        while not self._stopped:
+            self.reassign_timeout_tasks()
+            time.sleep(30)
+
+    # --------------------------------------------------------- checkpoint
+    def get_dataset_checkpoint(self, dataset_name: str) -> str:
+        ds = self._datasets.get(dataset_name)
+        return ds.checkpoint() if ds else ""
+
+    def restore_dataset_from_checkpoint(
+        self, dataset_name: str, content: str
+    ) -> bool:
+        ds = self._datasets.get(dataset_name)
+        if ds is None or not content:
+            return False
+        ds.restore_checkpoint(content)
+        return True
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        ds = self._datasets.get(dataset_name)
+        return ds.get_epoch() if ds else 0
+
+    def training_started(self) -> bool:
+        return any(
+            ds._dispatched_tasks > 0 for ds in self._datasets.values()
+        )
+
+    @property
+    def speed_monitor(self) -> SpeedMonitor:
+        return self._speed_monitor

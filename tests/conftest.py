@@ -1,0 +1,45 @@
+"""Test configuration.
+
+Control-plane tests need no accelerator. Compute-path tests run on a
+virtual 8-device CPU mesh: the env vars below MUST be set before the first
+`import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DLROVER_LOG_LEVEL", "WARNING")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def local_master():
+    """In-process master + gRPC server on a free port; yields (master, addr).
+
+    Mirrors the reference's `start_local_master` test fixture (reference:
+    dlrover/python/tests/test_utils.py).
+    """
+    from dlrover_tpu.common.rpc import find_free_port
+    from dlrover_tpu.master.local_master import LocalJobMaster
+
+    port = find_free_port()
+    master = LocalJobMaster(port, node_num=1)
+    master.prepare()
+    yield master, f"127.0.0.1:{port}"
+    master.stop()
+
+
+@pytest.fixture()
+def master_client(local_master):
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    master, addr = local_master
+    client = MasterClient(addr, node_id=0, node_type="worker")
+    yield client
+    client.close()
