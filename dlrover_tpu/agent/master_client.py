@@ -9,6 +9,7 @@ import os
 import socket
 import threading
 import time
+import uuid
 from functools import wraps
 from typing import Dict, List, Optional, Tuple
 
@@ -228,10 +229,19 @@ class MasterClient:
         reply = self._get(comm.KVStoreGetRequest(key=key))
         return reply.value
 
-    @retry_rpc()
     def kv_store_add(self, key: str, amount: int) -> int:
-        reply = self._get(comm.KVStoreAddRequest(key=key, amount=amount))
-        return reply.value
+        # A unique op_id makes retransmitted adds idempotent server-side,
+        # so the retry decorator cannot double-count the atomic increment.
+        op_id = uuid.uuid4().hex
+
+        @retry_rpc()
+        def _do(self):
+            reply = self._get(
+                comm.KVStoreAddRequest(key=key, amount=amount, op_id=op_id)
+            )
+            return reply.value
+
+        return _do(self)
 
     @retry_rpc()
     def kv_store_multi_get(self, keys: List[str]) -> List[bytes]:

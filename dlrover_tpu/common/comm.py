@@ -213,6 +213,9 @@ class KVStoreGetRequest:
 class KVStoreAddRequest:
     key: str = ""
     amount: int = 0
+    # Client-generated unique id: lets the server deduplicate retransmitted
+    # adds so the atomic counter is exactly-once under RPC retries.
+    op_id: str = ""
 
 
 @comm_message

@@ -172,6 +172,10 @@ class SharedLock(LocalSocketComm):
             return acquired
         if method == "release":
             if self._lock.locked():
+                # Only the recorded owner may release; a non-holder whose
+                # acquire failed must not break mutual exclusion.
+                if self._owner is not None and request.get("owner") != self._owner:
+                    return False
                 self._owner = None
                 self._lock.release()
                 return True
@@ -195,8 +199,8 @@ class SharedLock(LocalSocketComm):
                 return False
             time.sleep(0.05)
 
-    def release(self) -> bool:
-        return self._call("release")
+    def release(self, owner: str = "") -> bool:
+        return self._call("release", owner=owner)
 
     def locked(self) -> bool:
         return self._call("locked")
@@ -233,7 +237,7 @@ class SharedQueue(LocalSocketComm):
         raise ValueError(method)
 
     def put(self, obj: Any, timeout: Optional[float] = None) -> None:
-        kwargs = {"timeout": timeout} if timeout else {}
+        kwargs = {"timeout": timeout} if timeout is not None else {}
         self._call("put", obj=obj, **kwargs)
 
     def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
@@ -245,7 +249,7 @@ class SharedQueue(LocalSocketComm):
             if resp.get("empty"):
                 raise queue.Empty()
             return resp["item"]
-        deadline = time.time() + (timeout or 600.0)
+        deadline = time.time() + (600.0 if timeout is None else timeout)
         while True:
             resp = self._call("get", block=False)
             if not resp.get("empty"):

@@ -12,9 +12,14 @@ from typing import Dict, List, Optional
 
 
 class KVStoreService:
+    # Cap on remembered add-op results (dedup under RPC retries).
+    _MAX_APPLIED_OPS = 65536
+
     def __init__(self):
         self._lock = threading.Condition()
         self._store: Dict[str, bytes] = {}
+        # op_id -> result of an applied add; insertion-ordered for pruning.
+        self._applied_adds: Dict[str, int] = {}
 
     def set(self, key: str, value: bytes) -> None:
         with self._lock:
@@ -25,11 +30,21 @@ class KVStoreService:
         with self._lock:
             return self._store.get(key, b"")
 
-    def add(self, key: str, amount: int) -> int:
+    def add(self, key: str, amount: int, op_id: str = "") -> int:
+        """Atomic increment; exactly-once when the caller passes a unique
+        ``op_id`` (retransmissions of an applied op return the first
+        result instead of double-counting)."""
         with self._lock:
+            if op_id and op_id in self._applied_adds:
+                return self._applied_adds[op_id]
             current = int(self._store.get(key, b"0") or b"0")
             current += amount
             self._store[key] = str(current).encode()
+            if op_id:
+                if len(self._applied_adds) >= self._MAX_APPLIED_OPS:
+                    oldest = next(iter(self._applied_adds))
+                    del self._applied_adds[oldest]
+                self._applied_adds[op_id] = current
             self._lock.notify_all()
             return current
 

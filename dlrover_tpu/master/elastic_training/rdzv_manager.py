@@ -270,12 +270,9 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     ) -> None:
         with self._lock:
             self._reported_nodes.add(node_rank)
-            prev = self._node_status.get(node_rank, True)
             self._node_status[node_rank] = normal
             self._node_times[node_rank] = elapsed_time
             if not normal:
-                if node_rank in self._fault_nodes or not prev:
-                    pass  # stays faulty; check_fault_node intersects rounds
                 self._fault_nodes.add(node_rank)
             else:
                 self._fault_nodes.discard(node_rank)

@@ -14,11 +14,10 @@ def run(args) -> int:
 
         master = LocalJobMaster(port, node_num=args.node_num)
     else:
-        from dlrover_tpu.master.dist_master import DistributedJobMaster
-        from dlrover_tpu.master.job_args import new_job_args
-
-        job_args = new_job_args(args.platform, args.job_name, args.namespace)
-        master = DistributedJobMaster(port, job_args)
+        raise NotImplementedError(
+            f"platform {args.platform!r} is not wired up yet; only 'local' "
+            "is supported (the distributed master is under construction)"
+        )
     master.prepare()
     logger.info(
         "Master started: platform=%s port=%s", args.platform, port

@@ -111,7 +111,9 @@ class MasterServicer:
             )
         if isinstance(message, comm.KVStoreAddRequest):
             return comm.KVStoreAddReply(
-                value=self._kv_store.add(message.key, message.amount)
+                value=self._kv_store.add(
+                    message.key, message.amount, op_id=message.op_id
+                )
             )
         if isinstance(message, comm.KVStoreMultiGetRequest):
             values = self._kv_store.multi_get(message.keys)

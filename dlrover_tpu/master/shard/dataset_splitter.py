@@ -195,7 +195,9 @@ class StreamingDatasetSplitter(DatasetSplitter):
                 "dataset_name": self.dataset_name,
                 "dataset_size": self.dataset_size,
                 "shard_size": self.shard_size,
+                "num_epochs": self._num_epochs,
                 "data_size": self._data_size,
+                "fetch_data_size": self._fetch_data_size,
                 "offset": self._offset,
                 "epoch": self.epoch,
             }
@@ -208,7 +210,9 @@ class StreamingDatasetSplitter(DatasetSplitter):
             dataset_name=d["dataset_name"],
             dataset_size=d["dataset_size"],
             shard_size=d["shard_size"],
+            num_epochs=d.get("num_epochs", 1),
             data_size=d["data_size"],
+            fetch_data_size=d.get("fetch_data_size", 10000),
         )
         splitter._offset = d["offset"]
         splitter.epoch = d["epoch"]

@@ -1,0 +1,121 @@
+"""Attention ops with backend dispatch, plus Ulysses sequence-parallel
+all-to-all.
+
+Parity targets in the reference:
+- FlashAttention-2 module integrations (reference:
+  atorch/atorch/modules/transformer/layers.py:1278 ``FlashAttnModule``) —
+  here the fast path is a Pallas TPU flash-attention kernel
+  (:mod:`dlrover_tpu.ops.pallas.flash_attention`) and the portable path is a
+  plain XLA softmax attention (which XLA fuses well on TPU anyway).
+- Ulysses-style sequence parallelism (reference:
+  atorch/atorch/distributed/distributed.py:474-501 ``_SeqAllToAll``) — here
+  an ``all_to_all`` over the ``sp`` mesh axis re-partitioning seq<->heads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    segment_ids: Optional[jax.Array],
+    scale: Optional[float],
+) -> jax.Array:
+    """Reference softmax attention in pure XLA ops.
+
+    Shapes: q [b, sq, hq, d]; k/v [b, skv, hkv, d] with hq % hkv == 0 (GQA).
+    Computed in float32 for numerical stability, cast back to q.dtype.
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    groups = hq // hkv
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [b, hkv, groups, sq, d] x [b, hkv, skv, d] -> [b, hkv, groups, sq, skv]
+    qf = qf.reshape(b, sq, hkv, groups, d).transpose(0, 2, 3, 1, 4)
+    kf = kf.transpose(0, 2, 1, 3)
+    vf = vf.transpose(0, 2, 1, 3)
+    logits = jnp.einsum("bhgqd,bhkd->bhgqk", qf, kf)
+    mask = None
+    if causal:
+        q_pos = jnp.arange(sq)[:, None] + (skv - sq)
+        kv_pos = jnp.arange(skv)[None, :]
+        mask = q_pos >= kv_pos
+    if segment_ids is not None:
+        seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+        seg = seg[:, None, None, :, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, vf)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, sq, hq, d)
+    return out.astype(q.dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-head attention with GQA; dispatches to the Pallas TPU kernel
+    when running on TPU (and shapes are kernel-friendly), else pure XLA.
+
+    q: [batch, q_seq, q_heads, head_dim]
+    k, v: [batch, kv_seq, kv_heads, head_dim]
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        try:
+            from dlrover_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+            )
+        except Exception:
+            pass
+    return _xla_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ulysses sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+def seq_to_heads_all_to_all(x: jax.Array, axis_name: str = "sp") -> jax.Array:
+    """Re-partition [b, seq/P, H, d] -> [b, seq, H/P, d] across the sp axis.
+
+    The TPU-native ``_SeqAllToAll`` (reference:
+    atorch/atorch/distributed/distributed.py:474-501): inside ``shard_map``
+    over the ``sp`` mesh axis, swap which dimension is distributed so
+    attention sees the full sequence with a head slice.
+    """
+    # Tiled all_to_all: split the head dim across sp peers, concatenate the
+    # received sequence chunks (in peer order = global seq order).
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def heads_to_seq_all_to_all(x: jax.Array, axis_name: str = "sp") -> jax.Array:
+    """Inverse of :func:`seq_to_heads_all_to_all`:
+    [b, seq, H/P, d] -> [b, seq/P, H, d]."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)

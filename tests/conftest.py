@@ -7,13 +7,21 @@ virtual 8-device CPU mesh: the env vars below MUST be set before the first
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment may point JAX at a real TPU
+# (JAX_PLATFORMS=axon, registered eagerly by a sitecustomize hook), so the
+# env var alone is not enough — override via jax.config before any backend
+# is initialized.  Tests always run on the virtual 8-device CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DLROVER_LOG_LEVEL", "WARNING")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,146 @@
+"""Compute-path tests on a virtual 8-device CPU mesh (conftest sets
+XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Mirrors the reference's testing trick of running distributed behavior in
+tiny worlds on CPU (reference: atorch/atorch/tests/common_tests/
+distributed_test.py — multiprocessing.spawn gloo worlds; here a single
+process with a multi-device CPU mesh, the JAX-native equivalent).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+from dlrover_tpu.accel.parallel.mesh import (
+    DEFAULT_LOGICAL_RULES,
+    MeshSpec,
+    logical_to_spec,
+)
+from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def test_mesh_spec_validation():
+    spec = MeshSpec(dp=2, fsdp=2, tp=2)
+    assert spec.size == 8
+    mesh = spec.build_mesh()
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).build_mesh()  # 3 != 8 devices
+    assert MeshSpec.for_device_count(8, tp=2).fsdp == 4
+
+
+def test_logical_to_spec_rules():
+    spec = logical_to_spec(("batch", "seq", "act_embed"))
+    assert spec == jax.sharding.PartitionSpec(("dp", "fsdp"), "sp")
+    # conflicting mesh axis: second user falls back to replication
+    spec = logical_to_spec(("heads", "vocab"))
+    assert spec == jax.sharding.PartitionSpec("tp")
+
+
+def test_model_forward_unjitted():
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    import flax.linen as nn
+
+    logits = model.apply(nn.unbox(variables), ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert jnp.isfinite(logits.astype(jnp.float32)).all()
+
+
+def test_scan_layers_matches_loop():
+    """scan-over-layers and the python loop build the same computation shape."""
+    ids = jnp.zeros((2, 16), jnp.int32)
+    for scan in (False, True):
+        cfg = LlamaConfig.tiny(scan_layers=scan)
+        model = LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        import flax.linen as nn
+
+        logits = model.apply(nn.unbox(variables), ids)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+def _make_batch(rng, batch, seq, vocab, accum=None):
+    shape = (batch, seq) if accum is None else (accum, batch, seq)
+    ids = jax.random.randint(rng, shape, 0, vocab).astype(jnp.int32)
+    return {"input_ids": ids}
+
+
+@pytest.mark.parametrize(
+    "mesh_spec",
+    [
+        MeshSpec(dp=8),
+        MeshSpec(fsdp=8),
+        MeshSpec(dp=2, fsdp=2, tp=2),
+        MeshSpec(fsdp=4, tp=2),
+    ],
+    ids=["dp8", "fsdp8", "dp2fsdp2tp2", "fsdp4tp2"],
+)
+def test_train_step_shards_and_learns(mesh_spec):
+    cfg = LlamaConfig.tiny(scan_layers=True, remat=True)
+    model = LlamaModel(cfg)
+    res = accelerate(
+        model,
+        config=AccelerateConfig(mesh_spec=mesh_spec),
+        batch_shape=(8, 32),
+    )
+    state = res.init_fn(jax.random.PRNGKey(0))
+    batch = _make_batch(jax.random.PRNGKey(1), 8, 32, cfg.vocab_size)
+    losses = []
+    for _ in range(3):
+        state, metrics = res.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    # same batch repeated => loss must drop
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 3
+
+    # param sharding actually applied: under tp, mlp kernels are split
+    if mesh_spec.tp > 1:
+        gate = state.params["layers"]["layer"]["mlp"]["gate_proj"]["kernel"]
+        specs = gate.sharding.spec
+        assert "tp" in str(specs)
+
+
+def test_grad_accumulation_fixed_global_batch():
+    """accum=2 over half-microbatches ~ one full batch (ElasticTrainer
+    fixed-global-batch parity, reference trainer.py:307-327)."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    spec = MeshSpec(dp=8)
+
+    res1 = accelerate(
+        model, config=AccelerateConfig(mesh_spec=spec), batch_shape=(16, 32)
+    )
+    res2 = accelerate(
+        model,
+        config=AccelerateConfig(mesh_spec=spec, grad_accum_steps=2),
+        batch_shape=(8, 32),
+    )
+    state1 = res1.init_fn(jax.random.PRNGKey(0))
+    state2 = res2.init_fn(jax.random.PRNGKey(0))
+
+    full = _make_batch(jax.random.PRNGKey(1), 16, 32, cfg.vocab_size)
+    micro = {"input_ids": full["input_ids"].reshape(2, 8, 32)}
+
+    state1, m1 = res1.train_step(state1, full)
+    state2, m2 = res2.train_step(state2, micro)
+    assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
+    p1 = state1.params["final_norm"]["scale"]
+    p2 = state2.params["final_norm"]["scale"]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-4)
+
+
+def test_eval_step():
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    res = accelerate(
+        model, config=AccelerateConfig(mesh_spec=MeshSpec(dp=8)), batch_shape=(8, 32)
+    )
+    state = res.init_fn(jax.random.PRNGKey(0))
+    out = res.eval_step(state, _make_batch(jax.random.PRNGKey(1), 8, 32, 256))
+    assert np.isfinite(float(out["loss"]))
