@@ -35,8 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from dlrover_tpu.accel.parallel.mesh import (
     DEFAULT_LOGICAL_RULES,
     MeshSpec,
+    logical_rules_context,
     logical_to_spec,
-    set_logical_rules,
 )
 from dlrover_tpu.ops.losses import masked_language_model_loss
 
@@ -76,7 +76,12 @@ class AccelerateResult:
 
 def default_loss_fn(model: nn.Module):
     """Next-token LM loss over a batch dict with ``input_ids`` and optional
-    ``loss_mask`` / ``segment_ids`` / ``positions``."""
+    ``loss_mask`` / ``segment_ids`` / ``positions``.
+
+    Loss-fn contract: ``loss_fn(params, batch) -> (loss, aux)`` where
+    ``aux["weight"]`` is the number of tokens the mean was taken over
+    (used to weight microbatches during gradient accumulation).
+    """
 
     def loss_fn(params, batch):
         logits = model.apply(
@@ -93,7 +98,10 @@ def default_loss_fn(model: nn.Module):
             mask = mask[:, 1:] if mask is not None else None
         else:
             mask = batch.get("loss_mask")
-        return masked_language_model_loss(logits, labels, mask)
+        loss, weight = masked_language_model_loss(
+            logits, labels, mask, return_weight=True
+        )
+        return loss, {"weight": weight}
 
     return loss_fn
 
@@ -124,7 +132,7 @@ def accelerate(
         optimizer = optax.chain(
             optax.clip_by_global_norm(config.max_grad_norm), optimizer
         )
-    set_logical_rules(config.logical_rules)
+    rules_ctx = lambda: logical_rules_context(config.logical_rules)  # noqa: E731
     mesh = config.mesh_spec.build_mesh(devices)
     loss_fn = loss_fn or default_loss_fn(model)
 
@@ -156,7 +164,7 @@ def accelerate(
     jit_init = jax.jit(init_state, out_shardings=state_sharding)
 
     def init_fn(rng: jax.Array) -> TrainState:
-        with mesh:
+        with rules_ctx(), mesh:
             state = jit_init(rng)
         # init returns flax Partitioned boxes (logical-axis metadata); the
         # training loop works on plain arrays.  The sharding tree from
@@ -165,24 +173,31 @@ def accelerate(
 
     # ---------------- train step ----------------
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):
-        grad_fn = jax.value_and_grad(loss_fn)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         if config.grad_accum_steps > 1:
+            # Per-microbatch losses are means over their own valid tokens;
+            # weighting by aux["weight"] (that token count) makes the
+            # accumulated step exactly equal to the full-batch step even
+            # when mask density varies across microbatches.
             def micro_step(carry, mb):
-                loss_acc, grad_acc = carry
-                loss, grads = grad_fn(state.params, mb)
-                return (loss_acc + loss, _tree_add(grad_acc, grads)), None
+                loss_acc, grad_acc, w_acc = carry
+                (loss, aux), grads = grad_fn(state.params, mb)
+                w = aux["weight"]
+                grads = jax.tree_util.tree_map(lambda g: g * w, grads)
+                return (loss_acc + loss * w, _tree_add(grad_acc, grads), w_acc + w), None
 
             zero_grads = jax.tree_util.tree_map(
                 lambda x: jnp.zeros(x.shape, jnp.float32), state.params
             )
-            (loss_sum, grads), _ = jax.lax.scan(
-                micro_step, (jnp.zeros((), jnp.float32), zero_grads), batch
+            zero = jnp.zeros((), jnp.float32)
+            (loss_sum, grads, w_sum), _ = jax.lax.scan(
+                micro_step, (zero, zero_grads, zero), batch
             )
-            inv = 1.0 / config.grad_accum_steps
+            inv = 1.0 / w_sum
             loss = loss_sum * inv
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         else:
-            loss, grads = grad_fn(state.params, batch)
+            (loss, _), grads = grad_fn(state.params, batch)
         new_state = state.apply_gradients(grads=grads)
         metrics = {
             "loss": loss,
@@ -200,13 +215,13 @@ def accelerate(
     )
 
     def train_step(state, batch):
-        with mesh:
+        with rules_ctx(), mesh:
             return jit_train(state, batch)
 
     # ---------------- eval step ----------------
     def _eval_step(state: TrainState, batch: Dict[str, jax.Array]):
-        loss = loss_fn(state.params, batch)
-        return {"loss": loss}
+        loss, aux = loss_fn(state.params, batch)
+        return {"loss": loss, "weight": aux["weight"]}
 
     eval_sharding = NamedSharding(mesh, micro_spec)
     jit_eval = jax.jit(
@@ -214,7 +229,7 @@ def accelerate(
     )
 
     def eval_step(state, batch):
-        with mesh:
+        with rules_ctx(), mesh:
             return jit_eval(state, batch)
 
     return AccelerateResult(

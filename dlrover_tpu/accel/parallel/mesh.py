@@ -150,24 +150,42 @@ DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
 )
 
 
-# Active rules used by with_logical_constraint when no explicit rules are
-# passed.  accelerate() installs its (possibly user-overridden) rules here so
-# model-internal activation constraints agree with the param shardings.
+# Active rules used by with_logical_constraint / logical_to_spec when no
+# explicit rules are passed.  accelerate() installs its rules around every
+# trace and call (logical_rules_context) so model-internal activation
+# constraints always agree with the param shardings of the model being run,
+# even when several accelerate() results with different rules coexist.
 _ACTIVE_RULES: Tuple[Tuple[str, Any], ...] = DEFAULT_LOGICAL_RULES
 
 
 def set_logical_rules(rules: Sequence[Tuple[str, Any]]) -> None:
     global _ACTIVE_RULES
-    _ACTIVE_RULES = tuple(rules)
+    _ACTIVE_RULES = tuple(tuple(r) for r in rules)
 
 
 def get_logical_rules() -> Tuple[Tuple[str, Any], ...]:
     return _ACTIVE_RULES
 
 
+class logical_rules_context:
+    """Temporarily install a rules table (re-entrant, restores on exit)."""
+
+    def __init__(self, rules: Sequence[Tuple[str, Any]]):
+        self._rules = rules
+        self._saved: Optional[Tuple[Tuple[str, Any], ...]] = None
+
+    def __enter__(self) -> "logical_rules_context":
+        self._saved = get_logical_rules()
+        set_logical_rules(self._rules)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        set_logical_rules(self._saved)
+
+
 def logical_to_spec(
     logical_axes: Sequence[Optional[str]],
-    rules: Sequence[Tuple[str, Any]] = DEFAULT_LOGICAL_RULES,
+    rules: Optional[Sequence[Tuple[str, Any]]] = None,
 ) -> PartitionSpec:
     """Map a tuple of logical axis names to a :class:`PartitionSpec`.
 
@@ -175,6 +193,8 @@ def logical_to_spec(
     would reuse a taken mesh axis fall back to replication (same resolution
     the reference's shard planners apply when a dim is already consumed).
     """
+    if rules is None:
+        rules = _ACTIVE_RULES
     table = dict(rules)
     used: set = set()
     out = []
@@ -199,7 +219,7 @@ def logical_to_spec(
 def named_sharding(
     mesh: Mesh,
     logical_axes: Sequence[Optional[str]],
-    rules: Sequence[Tuple[str, Any]] = DEFAULT_LOGICAL_RULES,
+    rules: Optional[Sequence[Tuple[str, Any]]] = None,
 ) -> NamedSharding:
     return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
 
@@ -229,7 +249,7 @@ def with_logical_constraint(
         return x
 
 
-def batch_spec(rules: Sequence[Tuple[str, Any]] = DEFAULT_LOGICAL_RULES) -> PartitionSpec:
+def batch_spec(rules: Optional[Sequence[Tuple[str, Any]]] = None) -> PartitionSpec:
     """PartitionSpec for a ``[batch, seq, ...]`` input array."""
     return logical_to_spec(("batch", "seq"), rules)
 

@@ -91,12 +91,6 @@ class LlamaConfig:
         return cls(**base)
 
 
-def _rms_norm_scale(name: str, size: int, param_dtype: Dtype):
-    return nn.with_logical_partitioning(
-        lambda key, shape, dtype: jnp.ones(shape, dtype), ("norm",)
-    )
-
-
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
@@ -124,10 +118,14 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jax.Array:
 
 
 def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
-    """x: [b, s, h, d]; angles: [s, d//2] (already sliced to the positions)."""
+    """x: [b, s, h, d]; angles: [s, d//2] (shared positions) or
+    [b, s, d//2] (per-example positions, e.g. packed sequences)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    # Insert the head axis; a leading batch axis broadcasts either way.
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
+    if angles.ndim == 2:
+        cos, sin = cos[None], sin[None]
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
 

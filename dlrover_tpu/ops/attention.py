@@ -14,11 +14,14 @@ Parity targets in the reference:
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_warned_fallback = False
 
 
 def _xla_attention(
@@ -82,16 +85,22 @@ def dot_product_attention(
     k, v: [batch, kv_seq, kv_heads, head_dim]
     """
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = jax.default_backend() not in ("cpu", "gpu")
     if use_pallas:
         try:
             from dlrover_tpu.ops.pallas.flash_attention import flash_attention
-
+        except ImportError:
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                logger.warning(
+                    "Pallas flash-attention kernel unavailable; using the "
+                    "O(s^2)-memory XLA attention path"
+                )
+        else:
             return flash_attention(
                 q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
             )
-        except Exception:
-            pass
     return _xla_attention(
         q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
     )

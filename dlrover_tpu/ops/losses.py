@@ -54,14 +54,25 @@ def masked_language_model_loss(
     mask: Optional[jax.Array] = None,
     *,
     z_loss_weight: float = 0.0,
-) -> jax.Array:
-    """Mean next-token loss over valid (mask != 0) positions."""
+    return_weight: bool = False,
+):
+    """Mean next-token loss over valid (mask != 0) positions.
+
+    With ``return_weight=True`` also returns the denominator (valid-token
+    count) — gradient accumulation weights microbatches by it so that
+    accumulated steps exactly match the full-batch step.
+    """
     loss, z_loss = cross_entropy_with_integer_labels(
         logits, labels, z_loss_weight=z_loss_weight
     )
     total = loss + z_loss
     if mask is None:
-        return jnp.mean(total)
-    mask = mask.astype(jnp.float32)
-    denom = jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.sum(total * mask) / denom
+        weight = jnp.float32(total.size)
+        mean = jnp.mean(total)
+    else:
+        mask = mask.astype(jnp.float32)
+        weight = jnp.maximum(jnp.sum(mask), 1.0)
+        mean = jnp.sum(total * mask) / weight
+    if return_weight:
+        return mean, weight
+    return mean

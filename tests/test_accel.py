@@ -135,6 +135,55 @@ def test_grad_accumulation_fixed_global_batch():
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-4)
 
 
+def test_grad_accum_with_uneven_loss_mask():
+    """Token-count weighting: accumulation must match the full-batch step
+    even when mask density differs across microbatches."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    spec = MeshSpec(dp=8)
+    res1 = accelerate(
+        model, config=AccelerateConfig(mesh_spec=spec), batch_shape=(16, 32)
+    )
+    res2 = accelerate(
+        model,
+        config=AccelerateConfig(mesh_spec=spec, grad_accum_steps=2),
+        batch_shape=(8, 32),
+    )
+    state1 = res1.init_fn(jax.random.PRNGKey(0))
+    state2 = res2.init_fn(jax.random.PRNGKey(0))
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, 256).astype(jnp.int32)
+    mask = jnp.zeros((16, 32), jnp.float32)
+    # first half: only 2 valid tokens per row; second half: all valid
+    mask = mask.at[:8, :2].set(1.0).at[8:, :].set(1.0)
+    full = {"input_ids": ids, "loss_mask": mask}
+    micro = {
+        "input_ids": ids.reshape(2, 8, 32),
+        "loss_mask": mask.reshape(2, 8, 32),
+    }
+    state1, m1 = res1.train_step(state1, full)
+    state2, m2 = res2.train_step(state2, micro)
+    assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
+    p1 = state1.params["final_norm"]["scale"]
+    p2 = state2.params["final_norm"]["scale"]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-4)
+
+
+def test_per_example_positions():
+    """2-D positions (packed sequences) must work through RoPE."""
+    import flax.linen as nn
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    variables = nn.unbox(model.init(jax.random.PRNGKey(0), ids))
+    positions = jnp.tile(jnp.arange(8), (2, 2))  # two packed segments
+    segs = jnp.repeat(jnp.array([[0, 1]]), 8, axis=1)
+    logits = model.apply(variables, ids, positions=positions, segment_ids=segs)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert jnp.isfinite(logits.astype(jnp.float32)).all()
+
+
 def test_eval_step():
     cfg = LlamaConfig.tiny()
     model = LlamaModel(cfg)
