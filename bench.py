@@ -49,6 +49,8 @@ def main() -> None:
             max_seq_len=1024,
             scan_layers=True,
             remat=True,
+            # measured best on v5e: keeps matmul outputs, recomputes the rest
+            remat_policy="dots_with_no_batch_dims_saveable",
         )
         batch, steps, warmup = 8, 10, 3
     else:

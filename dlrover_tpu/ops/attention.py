@@ -85,7 +85,24 @@ def dot_product_attention(
     k, v: [batch, kv_seq, kv_heads, head_dim]
     """
     if use_pallas is None:
-        use_pallas = jax.default_backend() not in ("cpu", "gpu")
+        # XLA's fused attention is competitive up to ~2k tokens; the pallas
+        # kernel wins (and avoids O(s^2) memory) beyond that.  The gate must
+        # match the kernel's block-divisibility requirement — there is no
+        # exception fallback once dispatched.
+        try:
+            from dlrover_tpu.ops.pallas.flash_attention import (
+                DEFAULT_BLOCK_K,
+                DEFAULT_BLOCK_Q,
+            )
+
+            use_pallas = (
+                jax.default_backend() not in ("cpu", "gpu")
+                and q.shape[1] >= 2048
+                and q.shape[1] % DEFAULT_BLOCK_Q == 0
+                and k.shape[1] % DEFAULT_BLOCK_K == 0
+            )
+        except ImportError:
+            use_pallas = False
     if use_pallas:
         try:
             from dlrover_tpu.ops.pallas.flash_attention import flash_attention

@@ -1,0 +1,140 @@
+"""Pallas flash-attention kernels vs the XLA reference (interpret mode on
+CPU; the same kernels compile for TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.attention import _xla_attention
+from dlrover_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _rand_qkv(key, b, sq, skv, hq, hkv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, hq, d), dtype)
+    k = jax.random.normal(kk, (b, skv, hkv, d), dtype)
+    v = jax.random.normal(kv, (b, skv, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 256, 256, 2, 2, 128)
+    ref = _xla_attention(q, k, v, causal=causal, segment_ids=None, scale=None)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_gqa():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 256, 256, 4, 2, 128)
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_segment_ids():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 2, 256, 256, 2, 2, 128)
+    segs = jnp.concatenate(
+        [jnp.zeros((2, 128), jnp.int32), jnp.ones((2, 128), jnp.int32)], axis=1
+    )
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=segs, scale=None)
+    out = flash_attention(
+        q, k, v, causal=True, segment_ids=segs, block_q=128, block_k=128,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_forward_segment_ids_noncausal_fully_masked_rows():
+    """Non-causal + segments: rows can be fully masked within a block."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 256, 256, 2, 2, 128)
+    segs = jnp.concatenate(
+        [jnp.zeros((1, 128), jnp.int32), jnp.ones((1, 128), jnp.int32)], axis=1
+    )
+    ref = _xla_attention(q, k, v, causal=False, segment_ids=segs, scale=None)
+    out = flash_attention(
+        q, k, v, causal=False, segment_ids=segs, block_q=128, block_k=128,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 256, 256, 2, 2, 128)
+
+    def ref_loss(q, k, v):
+        o = _xla_attention(q, k, v, causal=causal, segment_ids=None, scale=None)
+        return jnp.sum(o * o)
+
+    def flash_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+        )
+        return jnp.sum(o * o)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_gradients_with_segments():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 256, 256, 2, 2, 128)
+    segs = jnp.concatenate(
+        [jnp.zeros((1, 128), jnp.int32), jnp.ones((1, 128), jnp.int32)], axis=1
+    )
+
+    def ref_loss(q, k, v):
+        o = _xla_attention(q, k, v, causal=True, segment_ids=segs, scale=None)
+        return jnp.sum(jnp.square(o))
+
+    def flash_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, segment_ids=segs, block_q=128, block_k=128,
+            interpret=True,
+        )
+        return jnp.sum(jnp.square(o))
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_gradients_gqa():
+    """dk/dv accumulate over all query heads sharing a kv head."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, 256, 256, 4, 2, 128)
+
+    def ref_loss(q, k, v):
+        o = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+        return jnp.sum(o * o)
+
+    def flash_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+        )
+        return jnp.sum(o * o)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_bf16_forward_close():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), 1, 256, 256, 2, 2, 128, jnp.bfloat16)
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+    )
