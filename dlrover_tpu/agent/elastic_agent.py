@@ -1,0 +1,373 @@
+"""Elastic training agent: one per host, drives worker processes through
+master-coordinated rendezvous, restarts and failure reporting.
+
+Counterpart of the reference's ``ElasticTrainingAgent`` /
+``MasterRendezvousHandler`` / ``launch_agent`` (reference:
+dlrover/python/elastic_agent/torch/training.py:179,359-819) re-designed for
+TPU hosts:
+
+- A "worker" is one process per host driving all local TPU chips (the JAX
+  model), not one process per accelerator; ``nproc_per_node`` exists for
+  CPU tests and multi-slice hosts.
+- Rendezvous yields host ranks; the agent exports the
+  ``DLROVER_COORDINATOR_ADDR`` of host 0 so workers can call
+  ``jax.distributed.initialize`` (the trainer does this — TPU collectives
+  then ride ICI/DCN via XLA; there is no NCCL process-group setup).
+- Membership changes (scale-up detected via ``num_nodes_waiting``) and
+  worker failures both funnel into the same restart path, capped by
+  ``max_restarts`` (reference: training.py:594-728).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from enum import Enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeStatus,
+    RendezvousName,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """What to run on this host."""
+
+    entrypoint: Sequence[str]  # argv of the training program
+    nproc_per_node: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = 5.0
+    network_check: bool = False
+    coordinator_port: int = 52300
+    env: Optional[Dict[str, str]] = None
+
+
+class WorkerState(str, Enum):
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class RendezvousResult:
+    round: int
+    group: int
+    world: Dict[int, int]  # node_rank -> nproc on that node
+    node_ips: Dict[int, str]
+
+
+class MasterRendezvousHandler:
+    """Joins the master's elastic rendezvous and polls for the comm world
+    (reference: training.py:179-311)."""
+
+    def __init__(
+        self,
+        client: MasterClient,
+        node_rank: int,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+        local_world_size: int = 1,
+        timeout: float = 600.0,
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._rdzv_name = rdzv_name
+        self._local_world_size = local_world_size
+        self._timeout = timeout
+
+    def next_rendezvous(self) -> RendezvousResult:
+        self._client.join_rendezvous(
+            node_rank=self._node_rank,
+            local_world_size=self._local_world_size,
+            rdzv_name=self._rdzv_name,
+        )
+        start = time.time()
+        while True:
+            rnd, group, world, node_ips = self._client.get_comm_world(
+                self._rdzv_name, self._node_rank
+            )
+            if world:
+                if self._node_rank not in world:
+                    # completed without us (e.g. we were rounded out by
+                    # node_unit); re-join next round
+                    raise RendezvousOutError(rnd)
+                return RendezvousResult(rnd, group, world, node_ips)
+            if time.time() - start > self._timeout:
+                raise TimeoutError(
+                    f"rendezvous {self._rdzv_name!r} timed out after "
+                    f"{self._timeout}s"
+                )
+            time.sleep(0.2)
+
+
+class RendezvousOutError(RuntimeError):
+    def __init__(self, rnd: int):
+        super().__init__(f"excluded from rendezvous round {rnd}")
+        self.round = rnd
+
+
+class LocalWorkerGroup:
+    """The worker processes of this host."""
+
+    def __init__(self):
+        self.procs: List[subprocess.Popen] = []
+        self.restart_count = 0
+
+    def spawn(
+        self,
+        spec: WorkerSpec,
+        rdzv: RendezvousResult,
+        node_rank: int,
+        base_env: Dict[str, str],
+    ) -> None:
+        ranks = sorted(rdzv.world)
+        # global process ranks: prefix sum over node ranks
+        prefix = 0
+        starts: Dict[int, int] = {}
+        for r in ranks:
+            starts[r] = prefix
+            prefix += rdzv.world[r]
+        total_procs = prefix
+        coordinator_ip = rdzv.node_ips.get(ranks[0], "127.0.0.1") or "127.0.0.1"
+        # round-dependent port avoids TIME_WAIT collisions across restarts
+        port = spec.coordinator_port + (rdzv.round % 16)
+        coordinator = f"{coordinator_ip}:{port}"
+
+        for local_rank in range(spec.nproc_per_node):
+            env = dict(base_env)
+            env.update(spec.env or {})
+            env[NodeEnv.NODE_RANK] = str(node_rank)
+            env[NodeEnv.NODE_NUM] = str(len(ranks))
+            env[NodeEnv.COORDINATOR_ADDR] = coordinator
+            env["DLROVER_LOCAL_RANK"] = str(local_rank)
+            env["DLROVER_LOCAL_WORLD_SIZE"] = str(spec.nproc_per_node)
+            env["DLROVER_WORKER_RANK"] = str(starts[node_rank] + local_rank)
+            env["DLROVER_WORKER_NUM"] = str(total_procs)
+            env["DLROVER_RDZV_ROUND"] = str(rdzv.round)
+            proc = subprocess.Popen(  # noqa: S603
+                list(spec.entrypoint), env=env
+            )
+            self.procs.append(proc)
+        logger.info(
+            "Spawned %s worker(s): world=%s coordinator=%s round=%s",
+            spec.nproc_per_node, rdzv.world, coordinator, rdzv.round,
+        )
+
+    def state(self) -> Tuple[WorkerState, int]:
+        """Aggregate state and the first non-zero exit code (if failed)."""
+        any_running = False
+        for p in self.procs:
+            rc = p.poll()
+            if rc is None:
+                any_running = True
+            elif rc != 0:
+                return WorkerState.FAILED, rc
+        if any_running:
+            return WorkerState.RUNNING, 0
+        return WorkerState.SUCCEEDED, 0
+
+    def stop(self, timeout: float = 15.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + timeout
+        for p in self.procs:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                p.wait(remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(5)
+        self.procs = []
+
+
+class ElasticAgent:
+    """Per-host agent (reference ``ElasticTrainingAgent`` training.py:359)."""
+
+    def __init__(
+        self,
+        client: MasterClient,
+        node_rank: int,
+        spec: WorkerSpec,
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._spec = spec
+        self._handler = MasterRendezvousHandler(
+            client, node_rank, local_world_size=spec.nproc_per_node
+        )
+        self._group = LocalWorkerGroup()
+        self._stop_heartbeat = threading.Event()
+        self._heartbeat_thread: Optional[threading.Thread] = None
+
+    # -- heartbeats ------------------------------------------------------
+    def _heartbeat_loop(self, interval: float = 15.0) -> None:
+        while not self._stop_heartbeat.wait(interval):
+            try:
+                self._client.report_heart_beat(time.time())
+            except Exception as e:
+                logger.warning("heartbeat failed: %s", e)
+
+    def start_heartbeat(self) -> None:
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="agent-heartbeat"
+        )
+        self._heartbeat_thread.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def _initialize_workers(self) -> RendezvousResult:
+        while True:
+            try:
+                rdzv = self._handler.next_rendezvous()
+                break
+            except RendezvousOutError:
+                time.sleep(1.0)
+        self._group.spawn(self._spec, rdzv, self._node_rank, dict(os.environ))
+        self._client.report_node_status(self._node_rank, NodeStatus.RUNNING)
+        return rdzv
+
+    def _restart_workers(self, reason: str) -> RendezvousResult:
+        logger.info("Restarting workers: %s", reason)
+        self._group.stop()
+        self._group.restart_count += 1
+        return self._initialize_workers()
+
+    def run(self) -> int:
+        """Monitor loop (reference training.py:577-728). Returns exit code."""
+        self.start_heartbeat()
+        if self._spec.network_check:
+            ok, reason = run_network_check(self._client, self._node_rank,
+                                           self._spec)
+            if not ok:
+                logger.error("Network check failed: %s", reason)
+                self._client.report_node_status(
+                    self._node_rank, NodeStatus.FAILED
+                )
+                return 1
+        self._initialize_workers()
+        spec = self._spec
+        try:
+            while True:
+                time.sleep(spec.monitor_interval)
+                state, rc = self._group.state()
+                if state == WorkerState.SUCCEEDED:
+                    self._client.report_node_status(
+                        self._node_rank, NodeStatus.SUCCEEDED
+                    )
+                    logger.info("Workers finished successfully")
+                    return 0
+                if state == WorkerState.FAILED:
+                    self._client.report_failure(
+                        f"worker exit code {rc}",
+                        level="error",
+                        node_rank=self._node_rank,
+                        restart_count=self._group.restart_count,
+                    )
+                    if self._group.restart_count >= spec.max_restarts:
+                        self._client.report_node_status(
+                            self._node_rank, NodeStatus.FAILED
+                        )
+                        logger.error(
+                            "Exhausted %s restarts; failing", spec.max_restarts
+                        )
+                        return rc or 1
+                    self._restart_workers(f"worker failed rc={rc}")
+                    continue
+                # healthy: check membership growth
+                waiting = self._client.num_nodes_waiting(
+                    RendezvousName.ELASTIC_TRAINING
+                )
+                if waiting > 0:
+                    self._restart_workers(
+                        f"{waiting} node(s) waiting to join"
+                    )
+        finally:
+            self._stop_heartbeat.set()
+            self._group.stop()
+
+
+# ---------------------------------------------------------------------------
+# network / node check
+# ---------------------------------------------------------------------------
+
+
+def run_network_check(
+    client: MasterClient,
+    node_rank: int,
+    spec: WorkerSpec,
+    rounds: int = 2,
+    check_timeout: float = 300.0,
+    result_timeout: float = 120.0,
+    check_port: int = 52500,
+) -> Tuple[bool, str]:
+    """Two grouped check rounds; the master intersects failures to localize
+    the faulty host (reference: NodeCheckElasticAgent training.py:861-1010
+    and NetworkCheckRendezvousManager rdzv_manager.py:349-530).
+
+    The check workload runs a matmul on every local chip and — when the
+    rendezvous grouped us with peers — a cross-host collective over the
+    group (jax.distributed world of the group members), so DCN faults
+    between hosts are observable, not just local chip health.
+    """
+    from dlrover_tpu.common.constants import NetworkFailureReason
+
+    handler = MasterRendezvousHandler(
+        client,
+        node_rank,
+        rdzv_name=RendezvousName.NETWORK_CHECK,
+        local_world_size=spec.nproc_per_node,
+    )
+    for _ in range(rounds):
+        try:
+            rdzv = handler.next_rendezvous()
+        except (TimeoutError, RendezvousOutError) as e:
+            return False, f"check rendezvous failed: {e}"
+        group_ranks = sorted(rdzv.world)
+        coordinator_ip = rdzv.node_ips.get(group_ranks[0], "127.0.0.1") or "127.0.0.1"
+        env = {
+            **os.environ,
+            "DLROVER_CHECK_GROUP": str(rdzv.group),
+            "DLROVER_CHECK_RANK": str(group_ranks.index(node_rank)),
+            "DLROVER_CHECK_WORLD": str(len(group_ranks)),
+            "DLROVER_CHECK_COORDINATOR": (
+                f"{coordinator_ip}:{check_port + rdzv.round % 8}"
+            ),
+        }
+        start = time.time()
+        try:
+            proc = subprocess.run(  # noqa: S603
+                [sys.executable, "-m", "dlrover_tpu.trainer.node_check.tpu"],
+                env=env,
+                capture_output=True,
+                timeout=check_timeout,
+            )
+            ok = proc.returncode == 0
+            stderr = proc.stderr
+        except subprocess.TimeoutExpired:
+            # A hung runtime is exactly what the check exists to catch.
+            ok, stderr = False, b"node check timed out"
+        elapsed = time.time() - start
+        client.report_network_check_result(node_rank, ok, elapsed)
+        if not ok:
+            logger.warning(
+                "node check failed: %s", stderr[-500:].decode(errors="replace")
+            )
+    # Wait for peers' reports: success stays (False, WAITING_NODE) until
+    # every group member has reported its round.
+    deadline = time.time() + result_timeout
+    while True:
+        success, reason = client.network_check_success()
+        if success or reason != NetworkFailureReason.WAITING_NODE:
+            return success, reason
+        if time.time() > deadline:
+            return False, reason
+        time.sleep(1.0)

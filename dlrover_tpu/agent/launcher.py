@@ -1,0 +1,157 @@
+"""``dlrover-tpu-run`` — elastic launcher CLI.
+
+Counterpart of the reference's ``dlrover-run``
+(reference: dlrover/trainer/torch/elastic_run.py:125-394): extends a
+plain "run my training script" command with elastic rendezvous, automatic
+local-master spawning, network pre-checks and restart policy — but the
+workers are JAX/TPU host processes, not torchrun trees.
+
+Usage:
+    dlrover-tpu-run --nnodes=1:4 --network-check python train.py --lr 3e-4
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import os
+import socket
+import subprocess
+import sys
+import time
+import uuid
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.rpc import find_free_port
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="dlrover-tpu-run", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument(
+        "--nnodes", default="1",
+        help="number of hosts, fixed ('4') or elastic range ('1:4')",
+    )
+    p.add_argument(
+        "--nproc_per_node", type=int, default=1,
+        help="worker processes per host (1 for TPU: one process drives all "
+             "local chips)",
+    )
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.getenv(NodeEnv.NODE_RANK, "0")))
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--monitor-interval", type=float, default=5.0)
+    p.add_argument(
+        "--network-check", action="store_true",
+        help="run chip/ICI health-check rounds before training "
+             "(reference: dlrover-run --network-check)",
+    )
+    p.add_argument(
+        "--node_unit", type=int, default=1,
+        help="rendezvous admits node counts that are multiples of this "
+             "(TPU: hosts per pod slice)",
+    )
+    p.add_argument("--master-addr", default=os.getenv(NodeEnv.MASTER_ADDR, ""))
+    p.add_argument("training_script", help="program to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _parse_nnodes(s: str) -> Tuple[int, int]:
+    if ":" in s:
+        lo, hi = s.split(":", 1)
+        return int(lo), int(hi)
+    return int(s), int(s)
+
+
+def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
+    """Spawn an in-host master for standalone / single-host jobs
+    (reference: elastic_run.py:237-266)."""
+    port = find_free_port()
+    proc = subprocess.Popen(  # noqa: S603
+        [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--platform", "local", "--port", str(port),
+            "--node_num", str(node_num),
+        ],
+        env=dict(os.environ),
+    )
+    addr = f"127.0.0.1:{port}"
+    atexit.register(proc.terminate)
+    return proc, addr
+
+
+def _wait_master(addr: str, timeout: float = 60.0) -> None:
+    host, port = addr.rsplit(":", 1)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2):
+                return
+        except OSError:
+            time.sleep(0.5)
+    raise TimeoutError(f"master at {addr} not reachable")
+
+
+def run(args: argparse.Namespace) -> int:
+    min_nodes, max_nodes = _parse_nnodes(args.nnodes)
+    master_addr = args.master_addr
+    master_proc = None
+    if not master_addr:
+        if args.node_rank != 0:
+            raise SystemExit(
+                f"--master-addr (or {NodeEnv.MASTER_ADDR}) is required for "
+                "node_rank != 0"
+            )
+        master_proc, master_addr = _launch_local_master(max_nodes)
+        logger.info("Spawned local master at %s", master_addr)
+    _wait_master(master_addr)
+
+    os.environ.setdefault(NodeEnv.JOB_UID, uuid.uuid4().hex[:8])
+    os.environ[NodeEnv.MASTER_ADDR] = master_addr
+    os.environ[NodeEnv.NODE_RANK] = str(args.node_rank)
+
+    client = MasterClient(
+        master_addr, node_id=args.node_rank, node_type="worker"
+    )
+    client.report_rdzv_params(
+        min_nodes, max_nodes, waiting_timeout=30.0, node_unit=args.node_unit
+    )
+
+    script = args.training_script
+    script_args = list(args.training_script_args)
+    if script.endswith(".py"):
+        entrypoint = [sys.executable, "-u", script, *script_args]
+    else:
+        entrypoint = [script, *script_args]
+
+    spec = WorkerSpec(
+        entrypoint=entrypoint,
+        nproc_per_node=args.nproc_per_node,
+        max_restarts=args.max_restarts,
+        monitor_interval=args.monitor_interval,
+        network_check=args.network_check,
+    )
+    agent = ElasticAgent(client, args.node_rank, spec)
+    try:
+        return agent.run()
+    finally:
+        client.close()
+        if master_proc is not None:
+            # Give the master a moment to publish final job state.
+            time.sleep(0.5)
+            master_proc.terminate()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

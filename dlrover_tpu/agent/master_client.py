@@ -193,6 +193,25 @@ class MasterClient:
         return reply.waiting_num
 
     @retry_rpc()
+    def report_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+        join_timeout: float = 600.0,
+    ):
+        return self._report(
+            comm.RendezvousParamsReport(
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                waiting_timeout=waiting_timeout,
+                node_unit=node_unit,
+                join_timeout=join_timeout,
+            )
+        )
+
+    @retry_rpc()
     def report_network_check_result(
         self, node_rank: int, normal: bool, elapsed_time: float
     ):

@@ -171,6 +171,17 @@ class KVStoreWaitRequest:
 
 
 @comm_message
+class RendezvousParamsReport:
+    """Launcher -> master: elastic bounds for the job's rendezvous."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = 30.0
+    node_unit: int = 1
+    join_timeout: float = 600.0
+
+
+@comm_message
 class NetworkReadyRequest:
     node_id: int = 0
     node_rank: int = 0

@@ -102,6 +102,13 @@ class RendezvousManager(metaclass=ABCMeta):
     ) -> int:
         """Add a host to the waiting list; returns the next round id."""
         with self._lock:
+            if node_rank in self._rdzv_nodes:
+                # A member of the completed round re-joining means its
+                # workers restarted: invalidate the round so every member
+                # must re-rendezvous (reference: rdzv_manager.py join resets
+                # the node dict on every join).  A *new* node joining leaves
+                # the current round valid — it waits for the next one.
+                self._rdzv_nodes = {}
             if not self._waiting_nodes:
                 self._start_rdzv_ts = time.time()
             self._waiting_nodes[node_rank] = NodeTopologyMeta(
@@ -185,6 +192,22 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
     def __init__(self):
         super().__init__()
         self._name = "elastic-training"
+
+    def num_nodes_waiting(self) -> int:
+        """Only report waiting nodes that could actually enlarge the world
+        — otherwise agents restart in a loop for a node that can never be
+        admitted (node_unit rounding or max_nodes cap)."""
+        with self._lock:
+            waiting = len(self._waiting_nodes)
+            params = self._rdzv_params
+            unit = max(params.node_unit, 1)
+            if waiting < unit and self._rdzv_nodes:
+                return 0
+            cur = len(self._rdzv_nodes)
+            potential = min(((cur + waiting) // unit) * unit, params.max_nodes)
+            if cur and potential <= cur:
+                return 0
+            return waiting
 
     def get_comm_world(
         self, node_rank: int

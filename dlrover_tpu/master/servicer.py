@@ -298,6 +298,16 @@ class MasterServicer:
                     message.node_rank, message.normal, message.elapsed_time
                 )
             return None
+        if isinstance(message, comm.RendezvousParamsReport):
+            for mgr in self._rdzv_managers.values():
+                mgr.update_rdzv_params(
+                    message.min_nodes,
+                    message.max_nodes,
+                    message.waiting_timeout,
+                    message.node_unit,
+                    message.join_timeout,
+                )
+            return None
         if isinstance(message, comm.KeyValuePair):
             self._kv_store.set(message.key, message.value)
             return None

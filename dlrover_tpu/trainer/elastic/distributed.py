@@ -1,0 +1,70 @@
+"""Worker-side distributed bootstrap.
+
+The trainer process calls :func:`init_distributed` at startup; it reads the
+env contract exported by the elastic agent
+(:mod:`dlrover_tpu.agent.elastic_agent`) and initializes
+``jax.distributed`` so that all hosts of the rendezvous round form one JAX
+process group (GSPMD collectives then ride ICI/DCN).  The counterpart of
+the reference's torchelastic env consumption + ``init_process_group``
+(reference: dlrover/python/elastic_agent/torch/training.py:359-540), with
+XLA collectives instead of NCCL.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from dlrover_tpu.common.constants import NodeEnv
+
+
+@dataclass(frozen=True)
+class WorkerEnv:
+    node_rank: int
+    node_num: int
+    local_rank: int
+    local_world_size: int
+    worker_rank: int
+    worker_num: int
+    coordinator: str
+    master_addr: str
+    rdzv_round: int
+
+    @classmethod
+    def from_env(cls) -> "WorkerEnv":
+        e = os.environ
+        return cls(
+            node_rank=int(e.get(NodeEnv.NODE_RANK, "0")),
+            node_num=int(e.get(NodeEnv.NODE_NUM, "1")),
+            local_rank=int(e.get("DLROVER_LOCAL_RANK", "0")),
+            local_world_size=int(e.get("DLROVER_LOCAL_WORLD_SIZE", "1")),
+            worker_rank=int(e.get("DLROVER_WORKER_RANK", "0")),
+            worker_num=int(e.get("DLROVER_WORKER_NUM", "1")),
+            coordinator=e.get(NodeEnv.COORDINATOR_ADDR, ""),
+            master_addr=e.get(NodeEnv.MASTER_ADDR, ""),
+            rdzv_round=int(e.get("DLROVER_RDZV_ROUND", "0")),
+        )
+
+
+def init_distributed(timeout_s: int = 300) -> WorkerEnv:
+    """Initialize jax.distributed from the agent env (no-op for 1 process)."""
+    env = WorkerEnv.from_env()
+    if env.worker_num > 1 and env.coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.worker_num,
+            process_id=env.worker_rank,
+            initialization_timeout=timeout_s,
+        )
+    return env
+
+
+def shutdown_distributed() -> None:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass

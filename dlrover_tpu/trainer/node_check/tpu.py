@@ -1,0 +1,128 @@
+"""Node-check workload: prove this host's accelerators compute and
+communicate.
+
+Counterpart of the reference's node-check scripts (reference:
+dlrover/trainer/torch/node_check/nvidia_gpu.py:24-38 — a matmul plus an
+allreduce in a sub-world), TPU-native: a jitted matmul on every local
+device, a ``psum`` across local chips over ICI, and — when the agent's
+check rendezvous grouped this host with peers (env
+``DLROVER_CHECK_WORLD`` > 1) — a cross-host collective over DCN via a
+``jax.distributed`` world of the group members, so inter-host faults are
+observable by the master's group-intersection localization.
+
+Run as ``python -m dlrover_tpu.trainer.node_check.tpu``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def _init_group_world() -> bool:
+    """Join the check group's jax.distributed world if one was assigned."""
+    world = int(os.environ.get("DLROVER_CHECK_WORLD", "1"))
+    coordinator = os.environ.get("DLROVER_CHECK_COORDINATOR", "")
+    if world <= 1 or not coordinator:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world,
+        process_id=int(os.environ.get("DLROVER_CHECK_RANK", "0")),
+        initialization_timeout=120,
+    )
+    return True
+
+
+def run_check(matmul_size: int = 1024, iters: int = 3) -> float:
+    import jax
+
+    # Honor the env platform selection even when an eagerly-registered
+    # plugin (axon) overrides it — tests pin subprocesses to CPU this way.
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    multihost = _init_group_world()
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = jax.local_devices()
+    if not devices:
+        raise RuntimeError("no local accelerator devices")
+    start = time.time()
+
+    # per-device matmul (MXU exercise)
+    for dev in devices:
+        x = jax.device_put(
+            jnp.ones((matmul_size, matmul_size), jnp.bfloat16), dev
+        )
+        y = x
+        for _ in range(iters):
+            y = jnp.dot(y, x, preferred_element_type=jnp.float32).astype(
+                jnp.bfloat16
+            )
+        if not bool(jnp.isfinite(y.astype(jnp.float32)).all()):
+            raise RuntimeError(f"non-finite matmul result on {dev}")
+
+    # cross-device psum over ICI (collective exercise)
+    if len(devices) > 1:
+        mesh = Mesh(devices, ("x",))
+        data = jax.device_put(
+            jnp.arange(len(devices) * 128, dtype=jnp.float32).reshape(
+                len(devices), 128
+            ),
+            NamedSharding(mesh, PartitionSpec("x")),
+        )
+
+        @jax.jit
+        def reduce(d):
+            return jnp.sum(d, axis=0)
+
+        total = reduce(data)
+        expected = float(
+            jnp.sum(
+                jnp.arange(len(devices) * 128, dtype=jnp.float32).reshape(
+                    len(devices), 128
+                ),
+                axis=0,
+            )[0]
+        )
+        if abs(float(total[0]) - expected) > 1e-3:
+            raise RuntimeError("cross-device reduction mismatch")
+
+    # cross-host collective over DCN (group exercise)
+    if multihost:
+        from jax.experimental import multihost_utils
+
+        nprocs = jax.process_count()
+        me = jax.process_index()
+        gathered = multihost_utils.process_allgather(
+            jnp.full((8,), float(me), jnp.float32)
+        )
+        if gathered.shape[0] != nprocs:
+            raise RuntimeError(
+                f"group allgather returned {gathered.shape[0]} of {nprocs}"
+            )
+        if abs(float(gathered.sum()) - 8.0 * sum(range(nprocs))) > 1e-3:
+            raise RuntimeError("group allgather value mismatch")
+    return time.time() - start
+
+
+def main() -> int:
+    try:
+        elapsed = run_check()
+    except Exception as e:  # any failure = unhealthy node
+        print(f"node check FAILED: {e}", file=sys.stderr)
+        return 1
+    print(f"node check ok in {elapsed:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

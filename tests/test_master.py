@@ -143,7 +143,13 @@ class TestElasticRendezvous:
         mgr._alive_nodes.update({10, 11, 12, 13, 14})  # alive > waiting
         _, _, world = mgr.get_comm_world(0)
         assert len(world) == 2
-        assert mgr.num_nodes_waiting() == 1
+        # the leftover node alone cannot grow a unit-2 world: reporting it
+        # as waiting would make agents restart for a rendezvous that cannot
+        # enlarge the world (restart churn)
+        assert mgr.num_nodes_waiting() == 0
+        # ... but once a 4th node arrives the pair is admissible
+        mgr.join_rendezvous(3, 3, 4)
+        assert mgr.num_nodes_waiting() == 2
 
     def test_zero_admit_keeps_waiting(self):
         # fewer waiting nodes than node_unit: must NOT complete with an
