@@ -1,0 +1,274 @@
+"""Flash Checkpoint — agent-side async saver.
+
+Counterpart of the reference's ``AsyncCheckpointSaver``
+(reference: dlrover/python/elastic_agent/torch/ckpt_saver.py:344-1194):
+
+- the training process writes the state into shared memory and pushes a
+  ``CheckpointEvent`` onto a SharedQueue; this saver (living in the agent
+  process, or in-process for standalone mode) persists shm to storage
+  asynchronously so training resumes after one host copy;
+- commit protocol: write all shard files into a stage dir, drop per-shard
+  done-files, and only when every expected shard is present rename the
+  stage dir to its final name and update the tracker file — a reader never
+  sees a half-written checkpoint (reference: ckpt_saver.py:747-920);
+- ``save_shm_to_storage`` is invoked by the elastic agent when workers die
+  so the last in-memory checkpoint survives the restart (reference:
+  training.py:662-672, ckpt_saver.py:472-494).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.serialize import dumps, loads
+from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+from dlrover_tpu.trainer.flash_checkpoint.shm_handler import (
+    SharedMemoryHandler,
+)
+
+CKPT_DIR_PREFIX = "step-"
+TRACKER_FILE = "latest_step"
+STAGE_DIR = "._dlrover_stage"
+
+SAVE_EVENT = "save"
+EXIT_EVENT = "exit"
+
+
+class CheckpointEvent:
+    def __init__(self, kind: str, step: int = 0, sync: bool = False):
+        self.kind = kind
+        self.step = step
+        self.sync = sync
+
+    def to_dict(self):
+        return {"kind": self.kind, "step": self.step, "sync": self.sync}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["kind"], d.get("step", 0), d.get("sync", False))
+
+
+class AsyncCheckpointSaver:
+    """Persists shm checkpoints of all local ranks.
+
+    One instance per host; ``num_shards`` is the number of hosts in the
+    job (each host writes its own shard files; commit waits for all of
+    them via done-files on the shared checkpoint filesystem).
+    """
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _lock = threading.Lock()
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+        local_shard_num: int = 1,
+        global_shard_num: int = 1,
+        node_rank: int = 0,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.storage = storage or PosixDiskStorage()
+        self.local_shard_num = local_shard_num
+        self.global_shard_num = global_shard_num
+        self.node_rank = node_rank
+        self._shm_handlers = [
+            SharedMemoryHandler(i) for i in range(local_shard_num)
+        ]
+        self._shm_locks = [
+            SharedLock(f"ckpt_{i}", create=True) for i in range(local_shard_num)
+        ]
+        self._event_queue = SharedQueue("ckpt_event", create=True)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._persist_count = 0
+        self._last_persisted_step = -1
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._event_loop, daemon=True, name="ckpt-saver"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._event_queue.put(
+                dumps(CheckpointEvent(EXIT_EVENT).to_dict())
+            )
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for h in self._shm_handlers:
+            h.close()
+        for lk in self._shm_locks:
+            lk.close()
+        self._event_queue.close()
+
+    def _event_loop(self) -> None:
+        logger.info(
+            "Checkpoint saver started: dir=%s shards=%s/%s",
+            self.checkpoint_dir, self.local_shard_num, self.global_shard_num,
+        )
+        while not self._stop.is_set():
+            try:
+                raw = self._event_queue.get(timeout=1.0)
+            except Exception:
+                continue
+            event = CheckpointEvent.from_dict(loads(raw))
+            if event.kind == EXIT_EVENT:
+                break
+            if event.kind == SAVE_EVENT:
+                try:
+                    self._save_step_checkpoint(event.step)
+                except Exception:
+                    logger.exception("persist of step %s failed", event.step)
+
+    # -- persistence ------------------------------------------------------
+    def _stage_dir(self, step: int) -> str:
+        return os.path.join(
+            self.checkpoint_dir, STAGE_DIR, f"{CKPT_DIR_PREFIX}{step}"
+        )
+
+    def _final_dir(self, step: int) -> str:
+        return os.path.join(self.checkpoint_dir, f"{CKPT_DIR_PREFIX}{step}")
+
+    def _save_step_checkpoint(self, step: int) -> None:
+        stage = self._stage_dir(step)
+        self.storage.safe_makedirs(stage)
+        for local_rank, handler in enumerate(self._shm_handlers):
+            lock = self._shm_locks[local_rank]
+            acquired = lock.acquire(owner=f"saver{local_rank}", timeout=60)
+            try:
+                self._persist_shard(step, local_rank, handler, stage)
+            finally:
+                if acquired:
+                    lock.release(owner=f"saver{local_rank}")
+        self.commit_checkpoint(step)
+
+    def _persist_shard(
+        self,
+        step: int,
+        local_rank: int,
+        handler: SharedMemoryHandler,
+        stage: str,
+    ) -> None:
+        loaded = handler.load_arrays()
+        if loaded is None:
+            logger.warning("no shm state for local rank %s", local_rank)
+            return
+        shm_step, leaves, arrays = loaded
+        if shm_step != step:
+            logger.warning(
+                "shm holds step %s, requested %s; persisting shm step",
+                shm_step, step,
+            )
+            step = shm_step
+            stage = self._stage_dir(step)
+            self.storage.safe_makedirs(stage)
+        shard_id = self.node_rank * self.local_shard_num + local_rank
+        bin_path = os.path.join(stage, f"shard-{shard_id}.bin")
+        meta_path = os.path.join(stage, f"shard-{shard_id}.meta")
+        # one sequential write of the whole segment
+        with open(bin_path, "wb") as f:
+            offsets: Dict[str, List[Dict]] = {}
+            pos = 0
+            for (path, i), arr in arrays.items():
+                offsets.setdefault(path, []).append(
+                    {
+                        "shard": i,
+                        "offset": pos,
+                        "nbytes": arr.nbytes,
+                    }
+                )
+                f.write(arr.tobytes())
+                pos += arr.nbytes
+        self.storage.write(
+            dumps({"step": step, "leaves": leaves, "offsets": offsets}),
+            meta_path,
+        )
+        self.storage.write(b"", os.path.join(stage, f"done-{shard_id}"))
+        self._persist_count += 1
+
+    def commit_checkpoint(self, step: int, timeout: float = 600.0) -> None:
+        """Rename stage -> final once every global shard's done-file exists
+        (reference: ckpt_saver.py:860-920)."""
+        stage = self._stage_dir(step)
+        final = self._final_dir(step)
+        deadline = time.time() + timeout
+        expected = self.global_shard_num * self.local_shard_num
+        while True:
+            done = [
+                f for f in self.storage.listdir(stage)
+                if f.startswith("done-")
+            ]
+            if len(done) >= expected:
+                break
+            if time.time() > deadline:
+                logger.error(
+                    "commit of step %s timed out: %s/%s shards done",
+                    step, len(done), expected,
+                )
+                return
+            time.sleep(0.5)
+        # host 0 performs the rename + tracker update
+        if self.node_rank == 0:
+            if self.storage.exists(final):
+                self.storage.safe_rmtree(final)
+            self.storage.safe_move(stage, final)
+            self.storage.write(
+                str(step), os.path.join(self.checkpoint_dir, TRACKER_FILE)
+            )
+            self._last_persisted_step = step
+            logger.info("Committed checkpoint step %s", step)
+
+    # -- failure path -----------------------------------------------------
+    def save_shm_to_storage(self) -> None:
+        """Persist whatever valid state is in shm (called by the agent when
+        workers fail, so the in-memory checkpoint survives the restart)."""
+        steps = set()
+        for handler in self._shm_handlers:
+            meta = handler.get_meta()
+            if meta is not None and meta.valid:
+                steps.add(meta.step)
+        for step in steps:
+            if step != self._last_persisted_step:
+                self._save_step_checkpoint(step)
+
+    # -- singleton --------------------------------------------------------
+    @classmethod
+    def start_async_saving_ckpt(cls, **kwargs) -> "AsyncCheckpointSaver":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(**kwargs)
+                cls._instance.start()
+            return cls._instance
+
+    @classmethod
+    def get_ckpt_saver(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+                cls._instance = None
+
+
+def read_latest_step(storage: CheckpointStorage, checkpoint_dir: str) -> int:
+    tracker = os.path.join(checkpoint_dir, TRACKER_FILE)
+    if not storage.exists(tracker):
+        return -1
+    content = storage.read(tracker)
+    try:
+        return int(content.strip())
+    except (ValueError, AttributeError):
+        return -1

@@ -1,0 +1,224 @@
+"""Tensor pytree <-> POSIX shared memory, no pickle.
+
+Counterpart of the reference's ``SharedMemoryHandler``
+(reference: dlrover/python/elastic_agent/torch/ckpt_saver.py:209-341 and
+``_traverse_state_dict``:94): the training process lays every array of the
+train state out in one shm segment (device -> host copy only); the agent
+process maps the same segment and persists it without ever touching the
+training process again.  Metadata (paths, dtypes, shapes, shard indices)
+travels through a ``SharedDict`` as plain msgpack-able values.
+
+JAX specifics vs the torch reference:
+- leaves are ``jax.Array``s; per-host we save the *addressable shards* of
+  each global array with their index slices, so GSPMD-sharded state
+  (FSDP/TP equivalents) round-trips per host without gathering
+  (the analogue of the reference's DCP-metadata design,
+  fsdp_engine.py:70-157).
+- a fully-addressable array (single host or replicated) is one shard
+  covering the whole index space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedDict, SharedMemory
+
+_SHM_PREFIX = "dlrover_tpu_ckpt"
+
+
+def leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
+    """Flatten a pytree into (stable path string, leaf) pairs."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for keypath, leaf in flat:
+        path = "/".join(_key_name(k) for k in keypath)
+        out.append((path, leaf))
+    return out
+
+
+def _key_name(k) -> str:
+    import jax
+
+    if isinstance(k, jax.tree_util.DictKey):
+        return str(k.key)
+    if isinstance(k, jax.tree_util.GetAttrKey):
+        return k.name
+    if isinstance(k, jax.tree_util.SequenceKey):
+        return str(k.idx)
+    if isinstance(k, jax.tree_util.FlattenedIndexKey):
+        return str(k.key)
+    return str(k)
+
+
+def _local_shards(leaf) -> Tuple[Tuple[int, ...], str, List[Dict], List[np.ndarray]]:
+    """(global_shape, dtype, shard_metas, shard_arrays) for one leaf.
+
+    Each shard meta: {"index": [[start, stop], ...] per dim, "shape": [...]}.
+    Deduplicates replicated shards (one copy per distinct index).
+    """
+    import jax
+
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        global_shape = tuple(leaf.shape)
+        dtype = np.dtype(leaf.dtype).name
+        seen = set()
+        metas, arrays = [], []
+        for shard in leaf.addressable_shards:
+            idx = shard.index
+            key = tuple(
+                (s.start or 0, s.stop if s.stop is not None else dim)
+                for s, dim in zip(idx, global_shape)
+            )
+            if key in seen:
+                continue
+            seen.add(key)
+            data = np.asarray(shard.data)
+            metas.append(
+                {
+                    "index": [[a, b] for a, b in key],
+                    "shape": list(data.shape),
+                }
+            )
+            arrays.append(data)
+        if not metas:  # 0-dim / fully local fallback
+            data = np.asarray(leaf)
+            metas = [{"index": [], "shape": list(data.shape)}]
+            arrays = [data]
+        return global_shape, dtype, metas, arrays
+    data = np.asarray(leaf)
+    return (
+        tuple(data.shape),
+        np.dtype(data.dtype).name,
+        [{"index": [[0, d] for d in data.shape], "shape": list(data.shape)}],
+        [data],
+    )
+
+
+@dataclasses.dataclass
+class ShmMeta:
+    step: int
+    valid: bool
+    leaves: Dict[str, Dict]  # path -> {global_shape, dtype, shards:[...]}
+    total_bytes: int
+
+
+class SharedMemoryHandler:
+    """One shm segment per (job, local rank) holding the flattened state."""
+
+    def __init__(self, local_rank: int = 0, job_uid: str = "", create: bool = False):
+        import os
+
+        job = job_uid or os.getenv("DLROVER_JOB_UID", "local")
+        self._shm_name = f"{_SHM_PREFIX}_{job}_{local_rank}"
+        self._meta = SharedDict(f"ckpt_meta_{local_rank}", create=create)
+        self._shm: Optional[SharedMemory] = None
+
+    # -- write side (training process) ----------------------------------
+    def save_state_dict(self, state: Any, step: int) -> None:
+        pairs = leaf_paths(state)
+        metas: Dict[str, Dict] = {}
+        buffers: List[Tuple[int, np.ndarray]] = []
+        offset = 0
+        for path, leaf in pairs:
+            gshape, dtype, shard_metas, arrays = _local_shards(leaf)
+            for m, arr in zip(shard_metas, arrays):
+                arr = np.ascontiguousarray(arr)
+                m["offset"] = offset
+                m["nbytes"] = arr.nbytes
+                buffers.append((offset, arr))
+                offset += arr.nbytes
+            metas[path] = {
+                "global_shape": list(gshape),
+                "dtype": dtype,
+                "shards": shard_metas,
+            }
+        total = offset
+        self._ensure_shm(total)
+        mv = self._shm.buf
+        for off, arr in buffers:
+            mv[off:off + arr.nbytes] = arr.tobytes()  # host copy into shm
+        self._meta.set(
+            {
+                "step": int(step),
+                "valid": True,
+                "total_bytes": total,
+                "leaves": metas,
+            }
+        )
+
+    def mark_invalid(self) -> None:
+        self._meta.set({"valid": False})
+
+    # -- read side (agent process or restarted trainer) ------------------
+    def get_meta(self) -> Optional[ShmMeta]:
+        d = self._meta.get()
+        if not d or "leaves" not in d:
+            return None
+        return ShmMeta(
+            step=int(d.get("step", -1)),
+            valid=bool(d.get("valid", False)),
+            leaves=d["leaves"],
+            total_bytes=int(d.get("total_bytes", 0)),
+        )
+
+    def read_shard_bytes(self, offset: int, nbytes: int) -> memoryview:
+        self._attach_shm()
+        return self._shm.buf[offset:offset + nbytes]
+
+    def load_arrays(self) -> Optional[Tuple[int, Dict[str, Dict], Dict[Tuple[str, int], np.ndarray]]]:
+        """Returns (step, leaf metas, {(path, shard_i): np array}) or None."""
+        meta = self.get_meta()
+        if meta is None or not meta.valid:
+            return None
+        self._attach_shm()
+        out: Dict[Tuple[str, int], np.ndarray] = {}
+        for path, leaf_meta in meta.leaves.items():
+            for i, shard in enumerate(leaf_meta["shards"]):
+                raw = self._shm.buf[
+                    shard["offset"]:shard["offset"] + shard["nbytes"]
+                ]
+                arr = np.frombuffer(
+                    raw, dtype=np.dtype(leaf_meta["dtype"])
+                ).reshape(shard["shape"])
+                out[(path, i)] = arr
+        return meta.step, meta.leaves, out
+
+    # -- shm management ---------------------------------------------------
+    def _ensure_shm(self, size: int) -> None:
+        if self._shm is not None and self._shm.size >= size:
+            return
+        if self._shm is not None:
+            self._shm.close()
+            self._shm.unlink()
+            self._shm = None
+        try:
+            self._shm = SharedMemory(self._shm_name, create=True, size=max(size, 1))
+        except FileExistsError:
+            existing = SharedMemory(self._shm_name)
+            if existing.size >= size:
+                self._shm = existing
+            else:
+                existing.close()
+                existing.unlink()
+                self._shm = SharedMemory(
+                    self._shm_name, create=True, size=max(size, 1)
+                )
+
+    def _attach_shm(self) -> None:
+        if self._shm is None:
+            self._shm = SharedMemory(self._shm_name)
+
+    def close(self, unlink: bool = False) -> None:
+        if self._shm is not None:
+            self._shm.close()
+            if unlink:
+                self._shm.unlink()
+            self._shm = None
+        self._meta.close()
