@@ -77,8 +77,10 @@ class AsyncCheckpointSaver:
         self.local_shard_num = local_shard_num
         self.global_shard_num = global_shard_num
         self.node_rank = node_rank
+        # The saver owns the shm-meta dict servers so checkpoint metadata
+        # survives training-process restarts.
         self._shm_handlers = [
-            SharedMemoryHandler(i) for i in range(local_shard_num)
+            SharedMemoryHandler(i, create=True) for i in range(local_shard_num)
         ]
         self._shm_locks = [
             SharedLock(f"ckpt_{i}", create=True) for i in range(local_shard_num)
@@ -88,6 +90,9 @@ class AsyncCheckpointSaver:
         self._thread: Optional[threading.Thread] = None
         self._persist_count = 0
         self._last_persisted_step = -1
+        # Serializes persists between the event loop and the agent's
+        # failure-path save_shm_to_storage (monitor thread).
+        self._persist_mutex = threading.Lock()
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -126,6 +131,8 @@ class AsyncCheckpointSaver:
             if event.kind == EXIT_EVENT:
                 break
             if event.kind == SAVE_EVENT:
+                if event.step <= self._last_persisted_step:
+                    continue  # duplicate/stale event; already persisted
                 try:
                     self._save_step_checkpoint(event.step)
                 except Exception:
@@ -141,29 +148,41 @@ class AsyncCheckpointSaver:
         return os.path.join(self.checkpoint_dir, f"{CKPT_DIR_PREFIX}{step}")
 
     def _save_step_checkpoint(self, step: int) -> None:
-        stage = self._stage_dir(step)
-        self.storage.safe_makedirs(stage)
-        for local_rank, handler in enumerate(self._shm_handlers):
-            lock = self._shm_locks[local_rank]
-            acquired = lock.acquire(owner=f"saver{local_rank}", timeout=60)
-            try:
-                self._persist_shard(step, local_rank, handler, stage)
-            finally:
-                if acquired:
-                    lock.release(owner=f"saver{local_rank}")
-        self.commit_checkpoint(step)
+        with self._persist_mutex:
+            persisted_steps = set()
+            for local_rank, handler in enumerate(self._shm_handlers):
+                lock = self._shm_locks[local_rank]
+                owner = f"saver{local_rank}-{threading.get_ident()}"
+                if not lock.acquire(owner=owner, timeout=60):
+                    # a writer holds the shm mid-copy; skipping is safer
+                    # than persisting a torn shard
+                    logger.warning(
+                        "shm lock for rank %s busy; skipping shard", local_rank
+                    )
+                    continue
+                try:
+                    actual = self._persist_shard(step, local_rank, handler)
+                    if actual is not None:
+                        persisted_steps.add(actual)
+                finally:
+                    lock.release(owner=owner)
+            # Commit what was actually persisted: when shm held a newer step
+            # than requested, the shard landed in that step's stage dir and
+            # the commit must target it (not the stale requested step).
+            for actual in sorted(persisted_steps):
+                self.commit_checkpoint(actual)
 
     def _persist_shard(
         self,
         step: int,
         local_rank: int,
         handler: SharedMemoryHandler,
-        stage: str,
-    ) -> None:
+    ) -> Optional[int]:
+        """Persist one local shard; returns the step actually persisted."""
         loaded = handler.load_arrays()
         if loaded is None:
             logger.warning("no shm state for local rank %s", local_rank)
-            return
+            return None
         shm_step, leaves, arrays = loaded
         if shm_step != step:
             logger.warning(
@@ -171,8 +190,8 @@ class AsyncCheckpointSaver:
                 shm_step, step,
             )
             step = shm_step
-            stage = self._stage_dir(step)
-            self.storage.safe_makedirs(stage)
+        stage = self._stage_dir(step)
+        self.storage.safe_makedirs(stage)
         shard_id = self.node_rank * self.local_shard_num + local_rank
         bin_path = os.path.join(stage, f"shard-{shard_id}.bin")
         meta_path = os.path.join(stage, f"shard-{shard_id}.meta")
@@ -196,6 +215,7 @@ class AsyncCheckpointSaver:
         )
         self.storage.write(b"", os.path.join(stage, f"done-{shard_id}"))
         self._persist_count += 1
+        return step
 
     def commit_checkpoint(self, step: int, timeout: float = 600.0) -> None:
         """Rename stage -> final once every global shard's done-file exists
@@ -205,6 +225,13 @@ class AsyncCheckpointSaver:
         deadline = time.time() + timeout
         expected = self.global_shard_num * self.local_shard_num
         while True:
+            if self.storage.exists(final):
+                # Another host already renamed stage -> final; the commit
+                # happened — stop polling and drop any leftover stage dir
+                # a duplicate persist may have recreated.
+                if self.storage.exists(stage):
+                    self.storage.safe_rmtree(stage)
+                break
             done = [
                 f for f in self.storage.listdir(stage)
                 if f.startswith("done-")
@@ -219,28 +246,34 @@ class AsyncCheckpointSaver:
                 return
             time.sleep(0.5)
         # host 0 performs the rename + tracker update
-        if self.node_rank == 0:
-            if self.storage.exists(final):
-                self.storage.safe_rmtree(final)
+        if self.node_rank == 0 and not self.storage.exists(final):
             self.storage.safe_move(stage, final)
             self.storage.write(
                 str(step), os.path.join(self.checkpoint_dir, TRACKER_FILE)
             )
-            self._last_persisted_step = step
             logger.info("Committed checkpoint step %s", step)
+        # every host records the commit so save_shm_to_storage does not
+        # re-persist an already-committed step
+        self._last_persisted_step = step
+        self.storage.commit(step, True)
 
     # -- failure path -----------------------------------------------------
     def save_shm_to_storage(self) -> None:
         """Persist whatever valid state is in shm (called by the agent when
-        workers fail, so the in-memory checkpoint survives the restart)."""
+        workers fail, so the in-memory checkpoint survives the restart).
+
+        One pass over the local shards: ``_save_step_checkpoint`` persists
+        each shard at the step its shm actually holds and commits every
+        distinct step, so a single call covers mixed-step shards.
+        """
         steps = set()
         for handler in self._shm_handlers:
             meta = handler.get_meta()
             if meta is not None and meta.valid:
                 steps.add(meta.step)
-        for step in steps:
-            if step != self._last_persisted_step:
-                self._save_step_checkpoint(step)
+        if not steps or max(steps) <= self._last_persisted_step:
+            return
+        self._save_step_checkpoint(max(steps))
 
     # -- singleton --------------------------------------------------------
     @classmethod
@@ -261,6 +294,79 @@ class AsyncCheckpointSaver:
             if cls._instance is not None:
                 cls._instance.stop()
                 cls._instance = None
+
+
+class SaverFactory:
+    """Agent-side factory thread: trainers push saver-construction requests
+    onto a SharedQueue and the agent instantiates the saver in its own
+    process so shm metadata and the persist loop survive worker restarts
+    (reference: ckpt_saver.py:409-465 ``_factory`` thread over
+    ``SharedQueue("factory")``)."""
+
+    def __init__(self):
+        from dlrover_tpu.common.constants import SaverClassMeta
+
+        self._queue = SharedQueue(SaverClassMeta.FACTORY_QUEUE, create=True)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ckpt-saver-factory"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                raw = self._queue.get(timeout=1.0)
+            except Exception:
+                continue
+            try:
+                kwargs = loads(raw)
+                storage_cfg = kwargs.pop("storage_config", None)
+                if storage_cfg:
+                    from dlrover_tpu.common.storage import storage_from_config
+
+                    kwargs["storage"] = storage_from_config(storage_cfg)
+                AsyncCheckpointSaver.start_async_saving_ckpt(**kwargs)
+                logger.info("Saver created from factory request: %s", kwargs)
+            except Exception:
+                logger.exception("saver factory request failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._queue.close()
+
+
+def notify_agent_to_create_saver(
+    checkpoint_dir: str,
+    local_shard_num: int = 1,
+    global_shard_num: int = 1,
+    node_rank: int = 0,
+    storage_config: Optional[dict] = None,
+) -> None:
+    """Trainer-side half of the factory protocol (reference:
+    flash_checkpoint/engine.py:253-275 ``_notify_agent_to_create_saver``)."""
+    from dlrover_tpu.common.constants import SaverClassMeta
+
+    queue = SharedQueue(SaverClassMeta.FACTORY_QUEUE, create=False)
+    try:
+        queue.put(
+            dumps(
+                {
+                    "checkpoint_dir": checkpoint_dir,
+                    "local_shard_num": local_shard_num,
+                    "global_shard_num": global_shard_num,
+                    "node_rank": node_rank,
+                    "storage_config": storage_config,
+                }
+            )
+        )
+    finally:
+        queue.close()
 
 
 def read_latest_step(storage: CheckpointStorage, checkpoint_dir: str) -> int:

@@ -50,6 +50,9 @@ class WorkerSpec:
     network_check: bool = False
     coordinator_port: int = 52300
     env: Optional[Dict[str, str]] = None
+    # Host the flash-checkpoint saver factory so trainers can checkpoint
+    # into agent-owned shared memory (reference: training.py:580).
+    flash_ckpt: bool = True
 
 
 class WorkerState(str, Enum):
@@ -208,6 +211,29 @@ class ElasticAgent:
         self._group = LocalWorkerGroup()
         self._stop_heartbeat = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
+        self._saver_factory = None
+
+    # -- flash checkpoint -------------------------------------------------
+    def _start_ckpt_factory(self) -> None:
+        """Serve saver-creation requests from trainers (reference:
+        AsyncCheckpointSaver.start_async_saving_ckpt, training.py:580)."""
+        from dlrover_tpu.agent.ckpt_saver import SaverFactory
+
+        self._saver_factory = SaverFactory()
+        self._saver_factory.start()
+
+    def _save_shm_checkpoint(self) -> None:
+        """Persist any in-memory checkpoint before a restart/exit wipes the
+        workers (reference: training.py:662-672)."""
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        if saver is None:
+            return
+        try:
+            saver.save_shm_to_storage()
+        except Exception:
+            logger.exception("persisting shm checkpoint failed")
 
     # -- heartbeats ------------------------------------------------------
     def _heartbeat_loop(self, interval: float = 15.0) -> None:
@@ -244,6 +270,8 @@ class ElasticAgent:
     def run(self) -> int:
         """Monitor loop (reference training.py:577-728). Returns exit code."""
         self.start_heartbeat()
+        if self._spec.flash_ckpt:
+            self._start_ckpt_factory()
         if self._spec.network_check:
             ok, reason = run_network_check(self._client, self._node_rank,
                                            self._spec)
@@ -272,6 +300,9 @@ class ElasticAgent:
                         node_rank=self._node_rank,
                         restart_count=self._group.restart_count,
                     )
+                    # persist the in-memory checkpoint before the restart
+                    # (reference: training.py:662-672)
+                    self._save_shm_checkpoint()
                     if self._group.restart_count >= spec.max_restarts:
                         self._client.report_node_status(
                             self._node_rank, NodeStatus.FAILED
@@ -293,6 +324,9 @@ class ElasticAgent:
         finally:
             self._stop_heartbeat.set()
             self._group.stop()
+            self._save_shm_checkpoint()
+            if self._saver_factory is not None:
+                self._saver_factory.stop()
 
 
 # ---------------------------------------------------------------------------

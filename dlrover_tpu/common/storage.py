@@ -13,6 +13,16 @@ from typing import List, Optional
 from dlrover_tpu.common.log import default_logger as logger
 
 
+# Committed checkpoint dirs are named either "<step>" or "step-<step>"
+# (the flash-checkpoint saver uses the latter).
+def _step_of_dir(name: str) -> Optional[int]:
+    if name.isdigit():
+        return int(name)
+    if name.startswith("step-") and name[5:].isdigit():
+        return int(name[5:])
+    return None
+
+
 class CheckpointDeletionStrategy(metaclass=ABCMeta):
     @abstractmethod
     def clean_up(self, step: int, delete_func) -> None:
@@ -29,11 +39,15 @@ class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
     def clean_up(self, step: int, delete_func) -> None:
         if step % self._keep_interval == 0:
             return
-        path = os.path.join(self._checkpoint_dir, str(step))
-        try:
-            delete_func(path)
-        except Exception as e:
-            logger.warning(f"Cleanup of {path} failed: {e}")
+        for name in os.listdir(self._checkpoint_dir) if os.path.isdir(
+            self._checkpoint_dir
+        ) else []:
+            if _step_of_dir(name) == step:
+                path = os.path.join(self._checkpoint_dir, name)
+                try:
+                    delete_func(path)
+                except Exception as e:
+                    logger.warning(f"Cleanup of {path} failed: {e}")
 
 
 class KeepLatestStepStrategy(CheckpointDeletionStrategy):
@@ -44,21 +58,27 @@ class KeepLatestStepStrategy(CheckpointDeletionStrategy):
         self._checkpoint_dir = checkpoint_dir
 
     def clean_up(self, step: int, delete_func) -> None:
-        steps: List[int] = []
         if not os.path.isdir(self._checkpoint_dir):
             return
+        steps: List[tuple] = []
         for name in os.listdir(self._checkpoint_dir):
-            if name.isdigit() and int(name) <= step:
-                steps.append(int(name))
+            s = _step_of_dir(name)
+            if s is not None and s <= step:
+                steps.append((s, name))
         steps.sort()
-        for s in steps[: -self._max_to_keep]:
+        for s, name in steps[: -self._max_to_keep]:
             try:
-                delete_func(os.path.join(self._checkpoint_dir, str(s)))
+                delete_func(os.path.join(self._checkpoint_dir, name))
             except Exception as e:
                 logger.warning(f"Cleanup of step {s} failed: {e}")
 
 
 class CheckpointStorage(metaclass=ABCMeta):
+    def to_config(self) -> Optional[dict]:
+        """Msgpack-able description so a storage can be rebuilt in another
+        process (the agent-side saver).  None = not transferable."""
+        return None
+
     @abstractmethod
     def write(self, content, path: str) -> None: ...
 
@@ -95,6 +115,25 @@ class PosixDiskStorage(CheckpointStorage):
         deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
     ):
         self._deletion_strategy = deletion_strategy
+
+    def to_config(self) -> Optional[dict]:
+        cfg: dict = {"class": "PosixDiskStorage"}
+        st = self._deletion_strategy
+        if isinstance(st, KeepLatestStepStrategy):
+            cfg["deletion"] = {
+                "kind": "keep_latest",
+                "n": st._max_to_keep,
+                "dir": st._checkpoint_dir,
+            }
+        elif isinstance(st, KeepStepIntervalStrategy):
+            cfg["deletion"] = {
+                "kind": "keep_interval",
+                "n": st._keep_interval,
+                "dir": st._checkpoint_dir,
+            }
+        elif st is not None:
+            return None  # custom strategy: not transferable
+        return cfg
 
     def write(self, content, path: str) -> None:
         mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
@@ -144,3 +183,17 @@ def get_checkpoint_storage(
     deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
 ) -> CheckpointStorage:
     return PosixDiskStorage(deletion_strategy)
+
+
+def storage_from_config(cfg: Optional[dict]) -> CheckpointStorage:
+    """Rebuild a storage from :meth:`CheckpointStorage.to_config` output."""
+    if not cfg:
+        return PosixDiskStorage()
+    strategy: Optional[CheckpointDeletionStrategy] = None
+    d = cfg.get("deletion")
+    if d:
+        if d["kind"] == "keep_latest":
+            strategy = KeepLatestStepStrategy(d["n"], d["dir"])
+        elif d["kind"] == "keep_interval":
+            strategy = KeepStepIntervalStrategy(d["n"], d["dir"])
+    return PosixDiskStorage(strategy)

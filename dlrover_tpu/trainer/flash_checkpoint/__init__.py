@@ -1,0 +1,24 @@
+"""Flash Checkpoint — in-memory checkpointing with async persistence.
+
+The TPU-native counterpart of the reference's flash-checkpoint package
+(reference: dlrover/trainer/torch/flash_checkpoint/).
+
+Exports are lazy: the agent-side saver imports ``shm_handler`` from this
+package, and the engine imports the saver — eager re-exports here would
+create an import cycle.
+"""
+
+_EXPORTS = {
+    "Checkpointer": "dlrover_tpu.trainer.flash_checkpoint.checkpointer",
+    "StorageType": "dlrover_tpu.trainer.flash_checkpoint.checkpointer",
+    "CheckpointEngine": "dlrover_tpu.trainer.flash_checkpoint.engine",
+    "SaverMode": "dlrover_tpu.trainer.flash_checkpoint.engine",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(name)

@@ -1,0 +1,84 @@
+"""Flash Checkpoint — user-facing API.
+
+Counterpart of the reference's ``Checkpointer`` ABC + per-framework
+checkpointers (reference: dlrover/trainer/torch/flash_checkpoint/
+checkpointer.py:18-60, ddp.py:25, fsdp.py:36).  On TPU one class covers
+both: a flax/JAX train state is always a pytree of (possibly GSPMD-sharded)
+arrays, and the engine's shard metadata makes full and sharded states the
+same code path.
+
+Usage::
+
+    ckpt = Checkpointer("/tmp/ckpt")
+    step, state = ckpt.load_checkpoint(target=abstract_state,
+                                       shardings=result.state_sharding)
+    if state is None:
+        state = result.init_fn(rng)
+    ...
+    ckpt.save_checkpoint(step, state, StorageType.MEMORY)   # every step
+    ckpt.save_checkpoint(step, state, StorageType.DISK)     # every N steps
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.common.storage import CheckpointStorage
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    CheckpointEngine,
+    SaverMode,
+)
+
+
+class StorageType(Enum):
+    MEMORY = 0
+    DISK = 1
+
+
+class Checkpointer:
+    """Save/load a JAX train-state pytree with second-level pauses."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+        saver_mode: SaverMode = SaverMode.AUTO,
+        **engine_kwargs: Any,
+    ):
+        self._engine = CheckpointEngine(
+            checkpoint_dir,
+            storage=storage,
+            saver_mode=saver_mode,
+            **engine_kwargs,
+        )
+
+    @property
+    def engine(self) -> CheckpointEngine:
+        return self._engine
+
+    def save_checkpoint(
+        self,
+        step: int,
+        state: Any,
+        storage_type: StorageType = StorageType.DISK,
+    ) -> bool:
+        """Blocks only for the device->host shm copy; disk persistence is
+        asynchronous in the agent/saver (reference: checkpointer.py:24-43)."""
+        if storage_type == StorageType.MEMORY:
+            return self._engine.save_to_memory(step, state)
+        return self._engine.save_to_storage(step, state)
+
+    def load_checkpoint(
+        self,
+        target: Any = None,
+        shardings: Any = None,
+    ) -> Tuple[int, Optional[Any]]:
+        """Latest state, shm-first then disk; ``(-1, None)`` if none."""
+        return self._engine.load(target, shardings)
+
+    def wait_latest_checkpoint(self, timeout: float = 600.0) -> int:
+        return self._engine.wait_latest_checkpoint(timeout)
+
+    def close(self) -> None:
+        self._engine.close()

@@ -1,0 +1,354 @@
+"""Flash Checkpoint — trainer-side engine.
+
+Counterpart of the reference's ``CheckpointEngine``
+(reference: dlrover/trainer/torch/flash_checkpoint/engine.py:135-405):
+
+- ``save_to_memory(step, state)``: one host copy of the train-state pytree
+  into POSIX shared memory (non-blocking if the agent saver is mid-persist)
+  — the training pause is the D2H copy only;
+- ``save_to_storage(step, state)``: memory save + an async persist event to
+  the agent-side :class:`~dlrover_tpu.agent.ckpt_saver.AsyncCheckpointSaver`
+  (factory-created on first use, reference: engine.py:253-275);
+- ``load(...)``: restore preferring shm over storage (reference:
+  engine.py:325-336), rebuilding sharded ``jax.Array``s from the per-shard
+  index metadata — resharding to a *different* mesh works because shards
+  carry global index slices (the analogue of the reference's DCP metadata
+  design, fsdp_engine.py:70-157).
+
+JAX specifics: state is any pytree of arrays (e.g. a flax ``TrainState``);
+per-host we save only the addressable shards of each GSPMD array, so a
+multi-host save never gathers.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.agent.ckpt_saver import (
+    CKPT_DIR_PREFIX,
+    SAVE_EVENT,
+    AsyncCheckpointSaver,
+    CheckpointEvent,
+    notify_agent_to_create_saver,
+    read_latest_step,
+)
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.serialize import dumps, loads
+from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+from dlrover_tpu.trainer.flash_checkpoint.shm_handler import (
+    SharedMemoryHandler,
+    leaf_paths,
+)
+
+
+class SaverMode(str, Enum):
+    AUTO = "auto"
+    AGENT = "agent"  # saver lives in the elastic-agent process
+    LOCAL = "local"  # standalone: saver thread in this process
+
+
+def _assemble_leaf(
+    global_shape: Tuple[int, ...],
+    dtype: str,
+    pieces: List[Tuple[List[List[int]], np.ndarray]],
+) -> np.ndarray:
+    """Rebuild a full array from (index, data) shards.
+
+    ``index`` is a per-dim [start, stop] list over the global shape (empty
+    for scalars / unsharded fallbacks); overlapping pieces (replicas saved
+    by different hosts) simply overwrite each other with identical data.
+    """
+    if not global_shape:
+        return np.array(pieces[0][1], dtype=np.dtype(dtype)).reshape(())
+    full = np.empty(global_shape, dtype=np.dtype(dtype))
+    covered = 0
+    for index, data in pieces:
+        if not index:
+            # copy: data may be a view into the (mutable, reused) shm buffer
+            return np.array(data, dtype=np.dtype(dtype)).reshape(global_shape)
+        slices = tuple(slice(a, b) for a, b in index)
+        full[slices] = data.reshape([b - a for a, b in index])
+        covered += data.size
+    if covered < int(np.prod(global_shape)):
+        raise ValueError(
+            f"incomplete checkpoint leaf: {covered} of "
+            f"{int(np.prod(global_shape))} elements covered"
+        )
+    return full
+
+
+def _restore_into(target: Any, saved: Dict[str, np.ndarray], shardings: Any):
+    """Rebuild ``target``'s pytree from saved full arrays (by leaf path),
+    placing each leaf onto its sharding when provided."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(target)
+    paths = [p for p, _ in leaf_paths(target)]
+    shard_leaves: List[Any] = [None] * len(leaves)
+    if shardings is not None:
+        shard_leaves = jax.tree_util.tree_flatten(shardings)[0]
+        if len(shard_leaves) != len(leaves):
+            raise ValueError(
+                "shardings tree does not match target state tree: "
+                f"{len(shard_leaves)} vs {len(leaves)} leaves"
+            )
+    out = []
+    for path, leaf, sharding in zip(paths, leaves, shard_leaves):
+        if path not in saved:
+            raise KeyError(f"checkpoint is missing leaf {path!r}")
+        arr = saved[path]
+        want_dtype = getattr(leaf, "dtype", arr.dtype)
+        if arr.dtype != want_dtype:
+            arr = arr.astype(want_dtype)
+        if sharding is not None:
+            out.append(jax.device_put(arr, sharding))
+        else:
+            out.append(jax.device_put(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class CheckpointEngine:
+    """Per-training-process flash-checkpoint engine.
+
+    One engine per worker process; ``local_rank`` selects the shm segment
+    shared with the agent saver.  In ``LOCAL`` mode (no agent — plain
+    ``python train.py``) the engine starts the async saver in-process, so
+    the user API is identical either way.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+        local_rank: Optional[int] = None,
+        local_world_size: Optional[int] = None,
+        node_rank: Optional[int] = None,
+        node_num: Optional[int] = None,
+        saver_mode: SaverMode = SaverMode.AUTO,
+        save_timeout: float = 600.0,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.storage = storage or PosixDiskStorage()
+        env = os.environ
+        self._local_rank = (
+            int(env.get("DLROVER_LOCAL_RANK", "0"))
+            if local_rank is None else local_rank
+        )
+        self._local_world_size = (
+            int(env.get("DLROVER_LOCAL_WORLD_SIZE", "1"))
+            if local_world_size is None else local_world_size
+        )
+        self._node_rank = (
+            int(env.get(NodeEnv.NODE_RANK, "0"))
+            if node_rank is None else node_rank
+        )
+        self._node_num = (
+            int(env.get(NodeEnv.NODE_NUM, "1"))
+            if node_num is None else node_num
+        )
+        if saver_mode == SaverMode.AUTO:
+            # Launched by the elastic agent => the agent hosts the saver.
+            saver_mode = (
+                SaverMode.AGENT if env.get(NodeEnv.NODE_RANK) is not None
+                else SaverMode.LOCAL
+            )
+        self._saver_mode = saver_mode
+        self._save_timeout = save_timeout
+        self._saver_started = False
+        self._shm_handler = SharedMemoryHandler(self._local_rank)
+        self._shm_lock = SharedLock(f"ckpt_{self._local_rank}")
+        self._event_queue = SharedQueue("ckpt_event")
+        self._latest_memory_step = -1
+        self._latest_storage_request = -1
+
+    # -- saver bootstrap --------------------------------------------------
+    def _ensure_saver(self) -> None:
+        if self._saver_started:
+            return
+        if self._saver_mode == SaverMode.LOCAL:
+            AsyncCheckpointSaver.start_async_saving_ckpt(
+                checkpoint_dir=self.checkpoint_dir,
+                storage=self.storage,
+                local_shard_num=self._local_world_size,
+                global_shard_num=self._node_num,
+                node_rank=self._node_rank,
+            )
+        elif self._local_rank == 0:
+            storage_config = self.storage.to_config()
+            if storage_config is None:
+                logger.warning(
+                    "custom CheckpointStorage is not transferable to the "
+                    "agent saver; it will persist with PosixDiskStorage"
+                )
+            notify_agent_to_create_saver(
+                checkpoint_dir=self.checkpoint_dir,
+                local_shard_num=self._local_world_size,
+                global_shard_num=self._node_num,
+                node_rank=self._node_rank,
+                storage_config=storage_config,
+            )
+        self._saver_started = True
+
+    # -- save -------------------------------------------------------------
+    def save_to_memory(self, step: int, state: Any) -> bool:
+        """Copy ``state`` into shared memory.  Returns False (skipping the
+        save) when the agent saver holds the shm lock mid-persist —
+        training never blocks on storage (reference: engine.py:291-323)."""
+        self._ensure_saver()
+        owner = f"writer{self._local_rank}"
+        if not self._shm_lock.acquire(blocking=False, owner=owner):
+            logger.warning(
+                "step %s memory save skipped: saver busy persisting", step
+            )
+            return False
+        try:
+            self._shm_handler.save_state_dict(state, step)
+            self._latest_memory_step = step
+        finally:
+            self._shm_lock.release(owner=owner)
+        return True
+
+    def save_to_storage(self, step: int, state: Any) -> bool:
+        """Memory save + async persist request to the saver (reference:
+        engine.py:354-394).  Local rank 0 enqueues one event per host —
+        the saver persists every local shard from it (duplicate per-rank
+        events would only thrash the stage dir)."""
+        ok = self.save_to_memory(step, state)
+        if ok and self._local_rank == 0:
+            self._event_queue.put(
+                dumps(CheckpointEvent(SAVE_EVENT, step).to_dict())
+            )
+        if ok:
+            self._latest_storage_request = step
+        return ok
+
+    # -- load -------------------------------------------------------------
+    def load(
+        self,
+        target: Any = None,
+        shardings: Any = None,
+    ) -> Tuple[int, Optional[Any]]:
+        """Restore the latest checkpoint, preferring shared memory.
+
+        Returns ``(step, state)``; ``(-1, None)`` when nothing exists.
+        ``target`` is an (abstract or concrete) pytree giving the structure
+        and dtypes to restore into; ``shardings`` an optional matching
+        pytree of ``jax.sharding.Sharding``s.
+        """
+        self._ensure_saver()  # shm meta server must exist before we query it
+        loaded = self._load_from_memory()
+        if loaded is not None:
+            step, saved = loaded
+            if target is None:
+                return step, saved
+            return step, _restore_into(target, saved, shardings)
+        return self.load_from_storage(target, shardings)
+
+    def _load_from_memory(self) -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
+        try:
+            result = self._shm_handler.load_arrays()
+        except Exception:
+            return None
+        if result is None:
+            return None
+        step, leaves, arrays = result
+        saved: Dict[str, np.ndarray] = {}
+        for path, meta in leaves.items():
+            pieces = [
+                (meta["shards"][i]["index"], arrays[(path, i)])
+                for i in range(len(meta["shards"]))
+            ]
+            saved[path] = _assemble_leaf(
+                tuple(meta["global_shape"]), meta["dtype"], pieces
+            )
+        logger.info("Restoring step %s from shared memory", step)
+        return step, saved
+
+    def load_from_storage(
+        self,
+        target: Any = None,
+        shardings: Any = None,
+        step: Optional[int] = None,
+    ) -> Tuple[int, Optional[Any]]:
+        if step is None:
+            step = read_latest_step(self.storage, self.checkpoint_dir)
+        if step < 0:
+            return -1, None
+        ckpt_dir = os.path.join(
+            self.checkpoint_dir, f"{CKPT_DIR_PREFIX}{step}"
+        )
+        saved = self._read_shards(ckpt_dir)
+        if saved is None:
+            return -1, None
+        logger.info("Restoring step %s from %s", step, ckpt_dir)
+        if target is None:
+            return step, saved
+        return step, _restore_into(target, saved, shardings)
+
+    def _read_shards(self, ckpt_dir: str) -> Optional[Dict[str, np.ndarray]]:
+        """Merge all shard files of one committed checkpoint dir into full
+        per-leaf arrays (reshard-agnostic: indices are global)."""
+        metas = [
+            f for f in self.storage.listdir(ckpt_dir) if f.endswith(".meta")
+        ]
+        if not metas:
+            return None
+        pieces: Dict[str, List[Tuple[List[List[int]], np.ndarray]]] = {}
+        leaf_info: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+        for meta_name in sorted(metas):
+            meta = loads(self.storage.read(
+                os.path.join(ckpt_dir, meta_name), "rb"
+            ))
+            bin_name = meta_name[: -len(".meta")] + ".bin"
+            blob = self.storage.read(os.path.join(ckpt_dir, bin_name), "rb")
+            if blob is None:
+                logger.warning("missing shard data file %s", bin_name)
+                return None
+            for path, leaf_meta in meta["leaves"].items():
+                leaf_info[path] = (
+                    tuple(leaf_meta["global_shape"]), leaf_meta["dtype"]
+                )
+                file_offsets = {
+                    o["shard"]: o for o in meta["offsets"].get(path, [])
+                }
+                for i, shard in enumerate(leaf_meta["shards"]):
+                    off = file_offsets.get(i)
+                    if off is None:
+                        continue
+                    raw = blob[off["offset"]: off["offset"] + off["nbytes"]]
+                    arr = np.frombuffer(
+                        raw, dtype=np.dtype(leaf_meta["dtype"])
+                    ).reshape(shard["shape"])
+                    pieces.setdefault(path, []).append((shard["index"], arr))
+        saved = {}
+        for path, (gshape, dtype) in leaf_info.items():
+            saved[path] = _assemble_leaf(gshape, dtype, pieces[path])
+        return saved
+
+    # -- misc -------------------------------------------------------------
+    def latest_storage_step(self) -> int:
+        return read_latest_step(self.storage, self.checkpoint_dir)
+
+    def wait_latest_checkpoint(self, timeout: float = 600.0) -> int:
+        """Block until the latest *storage-requested* save is committed
+        (memory-only saves don't gate this; reference: checkpointer
+        ``wait_latest_checkpoint``)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            step = self.latest_storage_step()
+            if step >= self._latest_storage_request:
+                return step
+            time.sleep(0.2)
+        return self.latest_storage_step()
+
+    def close(self) -> None:
+        self._shm_handler.close()
+        self._shm_lock.close()
+        self._event_queue.close()
