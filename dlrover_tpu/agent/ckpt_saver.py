@@ -147,18 +147,33 @@ class AsyncCheckpointSaver:
     def _final_dir(self, step: int) -> str:
         return os.path.join(self.checkpoint_dir, f"{CKPT_DIR_PREFIX}{step}")
 
-    def _save_step_checkpoint(self, step: int) -> None:
+    def _save_step_checkpoint(self, step: int, reclaim_locks: bool = False) -> None:
+        """Persist all local shards and commit.
+
+        ``reclaim_locks``: force-release a held shm lock before acquiring —
+        ONLY valid when the caller knows no worker process is alive (the
+        agent's failure path after stopping the worker group), where a
+        crash mid-save would otherwise leave the lock held forever.
+        """
         with self._persist_mutex:
             persisted_steps = set()
+            skipped = False
             for local_rank, handler in enumerate(self._shm_handlers):
                 lock = self._shm_locks[local_rank]
                 owner = f"saver{local_rank}-{threading.get_ident()}"
+                if reclaim_locks and lock.locked():
+                    logger.warning(
+                        "reclaiming shm lock of rank %s (holder dead)",
+                        local_rank,
+                    )
+                    lock.force_release()
                 if not lock.acquire(owner=owner, timeout=60):
                     # a writer holds the shm mid-copy; skipping is safer
                     # than persisting a torn shard
                     logger.warning(
                         "shm lock for rank %s busy; skipping shard", local_rank
                     )
+                    skipped = True
                     continue
                 try:
                     actual = self._persist_shard(step, local_rank, handler)
@@ -166,6 +181,11 @@ class AsyncCheckpointSaver:
                         persisted_steps.add(actual)
                 finally:
                     lock.release(owner=owner)
+            if skipped:
+                # an incomplete host save can never commit (the done-file
+                # count would spin to timeout); leave the stage for a retry
+                logger.warning("step %s not committed: shard(s) skipped", step)
+                return
             # Commit what was actually persisted: when shm held a newer step
             # than requested, the shard landed in that step's stage dir and
             # the commit must target it (not the stale requested step).
@@ -273,7 +293,9 @@ class AsyncCheckpointSaver:
                 steps.add(meta.step)
         if not steps or max(steps) <= self._last_persisted_step:
             return
-        self._save_step_checkpoint(max(steps))
+        # Workers are dead when the agent takes this path, so a lock left
+        # held by a crashed writer is reclaimable.
+        self._save_step_checkpoint(max(steps), reclaim_locks=True)
 
     # -- singleton --------------------------------------------------------
     @classmethod

@@ -300,8 +300,10 @@ class ElasticAgent:
                         node_rank=self._node_rank,
                         restart_count=self._group.restart_count,
                     )
-                    # persist the in-memory checkpoint before the restart
-                    # (reference: training.py:662-672)
+                    # stop remaining workers FIRST so a crashed writer's shm
+                    # lock is safely reclaimable, then persist the in-memory
+                    # checkpoint (reference: training.py:662-672)
+                    self._group.stop()
                     self._save_shm_checkpoint()
                     if self._group.restart_count >= spec.max_restarts:
                         self._client.report_node_status(

@@ -182,6 +182,14 @@ class SharedLock(LocalSocketComm):
             return False
         if method == "locked":
             return self._lock.locked()
+        if method == "force_release":
+            # Reclaim a lock whose holder died without releasing (the agent
+            # calls this only after it has stopped all worker processes).
+            if self._lock.locked():
+                self._owner = None
+                self._lock.release()
+                return True
+            return False
         raise ValueError(method)
 
     def acquire(
@@ -201,6 +209,11 @@ class SharedLock(LocalSocketComm):
 
     def release(self, owner: str = "") -> bool:
         return self._call("release", owner=owner)
+
+    def force_release(self) -> bool:
+        """Release regardless of owner — only safe when the holder is
+        known dead (e.g. after the agent stopped all workers)."""
+        return self._call("force_release")
 
     def locked(self) -> bool:
         return self._call("locked")

@@ -57,18 +57,6 @@ def _decode(obj: Any) -> Any:
     return obj
 
 
-def dumps(obj: Any) -> bytes:
-    """Plain-value msgpack encode (dicts/lists/scalars/bytes)."""
-    return msgpack.packb(_encode(obj), use_bin_type=True)
-
-
-def loads(data: bytes) -> Any:
-    """Inverse of :func:`dumps`."""
-    if not data:
-        return None
-    return _decode(msgpack.unpackb(data, raw=False, strict_map_key=False))
-
-
 def serialize_message(obj: Any) -> bytes:
     return msgpack.packb(_encode(obj), use_bin_type=True)
 
@@ -77,3 +65,8 @@ def deserialize_message(data: bytes) -> Any:
     if not data:
         return None
     return _decode(msgpack.unpackb(data, raw=False, strict_map_key=False))
+
+
+# Short aliases used by the checkpoint/IPC layer — same wire format.
+dumps = serialize_message
+loads = deserialize_message

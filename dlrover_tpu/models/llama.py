@@ -28,6 +28,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
+
 from dlrover_tpu.accel.parallel.mesh import with_logical_constraint
 from dlrover_tpu.ops.attention import dot_product_attention
 
@@ -89,6 +91,16 @@ class LlamaConfig:
         )
         base.update(kw)
         return cls(**base)
+
+
+def resolve_remat_policy(name: str):
+    """Checkpoint policy by name; ``"names:a,b"`` maps to
+    ``save_only_these_names(a, b)`` over the model's checkpoint_name tags
+    (qkv_proj / attn_out / mlp_out)."""
+    if name.startswith("names:"):
+        tags = [t for t in name[len("names:"):].split(",") if t]
+        return jax.checkpoint_policies.save_only_these_names(*tags)
+    return getattr(jax.checkpoint_policies, name)
 
 
 class RMSNorm(nn.Module):
@@ -191,10 +203,12 @@ class Attention(nn.Module):
         v = with_logical_constraint(v, ("batch", "seq", "kv_heads", "head_dim"))
 
         angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[positions]
-        q = apply_rope(q, angles)
-        k = apply_rope(k, angles)
+        q = checkpoint_name(apply_rope(q, angles), "qkv_proj")
+        k = checkpoint_name(apply_rope(k, angles), "qkv_proj")
+        v = checkpoint_name(v, "qkv_proj")
 
         out = dot_product_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        out = checkpoint_name(out, "attn_out")
         out = with_logical_constraint(out, ("batch", "seq", "heads", "head_dim"))
         return o_proj(out)
 
@@ -216,7 +230,12 @@ class MLP(nn.Module):
         up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
         h = nn.silu(gate) * up
         h = with_logical_constraint(h, ("batch", "seq", "mlp"))
-        return dense(cfg.hidden_size, ("mlp", "embed"), "down_proj")(h)
+        # Deliberately NOT checkpoint-named: the wide [.., intermediate]
+        # tensors dominate saved-activation memory; the "names" remat
+        # policy recomputes them in backward instead of storing them.
+        return checkpoint_name(
+            dense(cfg.hidden_size, ("mlp", "embed"), "down_proj")(h), "mlp_out"
+        )
 
 
 class DecoderLayer(nn.Module):
@@ -259,7 +278,12 @@ class LlamaModel(nn.Module):
         input_ids: jax.Array,
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
+        return_hidden: bool = False,
     ) -> jax.Array:
+        """``return_hidden=True`` skips the lm-head projection and returns
+        the final normed hidden states — used with
+        :func:`dlrover_tpu.ops.losses.fused_lm_head_loss` so the full
+        logits are never materialized."""
         cfg = self.config
         if positions is None:
             positions = jnp.arange(input_ids.shape[1])
@@ -279,7 +303,7 @@ class LlamaModel(nn.Module):
         if cfg.scan_layers:
             block = _ScanLayer
             if cfg.remat:
-                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                policy = resolve_remat_policy(cfg.remat_policy)
                 block = nn.remat(
                     block, policy=policy, prevent_cse=False, static_argnums=()
                 )
@@ -294,12 +318,15 @@ class LlamaModel(nn.Module):
         else:
             layer_cls = DecoderLayer
             if cfg.remat:
-                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                policy = resolve_remat_policy(cfg.remat_policy)
                 layer_cls = nn.remat(layer_cls, policy=policy, prevent_cse=False)
             for i in range(cfg.num_layers):
                 x = layer_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
 
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+
+        if return_hidden:
+            return x
 
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(cfg.param_dtype))

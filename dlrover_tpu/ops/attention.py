@@ -85,6 +85,11 @@ def dot_product_attention(
     k, v: [batch, kv_seq, kv_heads, head_dim]
     """
     if use_pallas is None:
+        import os
+
+        if os.getenv("DLROVER_DISABLE_PALLAS", "").lower() in ("1", "true", "yes"):
+            use_pallas = False
+    if use_pallas is None:
         # XLA's fused attention is competitive up to ~2k tokens; the pallas
         # kernel wins (and avoids O(s^2) memory) beyond that.  The gate must
         # match the kernel's block-divisibility requirement — there is no

@@ -48,6 +48,73 @@ def cross_entropy_with_integer_labels(
     return loss, z_loss
 
 
+def fused_lm_head_loss(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    labels: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    chunk_size: int = 512,
+    z_loss_weight: float = 0.0,
+):
+    """LM-head projection + cross entropy without materializing the full
+    ``[batch, seq, vocab]`` logits.
+
+    The fused-loss counterpart of the reference's fused cross-entropy
+    (reference: atorch/atorch/modules/transformer/losses.py): sequence
+    chunks are scanned with rematerialization, so peak memory holds one
+    ``[batch, chunk, vocab]`` block instead of the full logits (fwd AND
+    bwd) — on a 32k vocab this saves gigabytes and lets a larger model fit
+    the chip.
+
+    hidden: [batch, seq, hidden] final transformer states
+    kernel: [hidden, vocab] lm-head weight
+    labels: [batch, seq] int targets; mask: [batch, seq] validity.
+    Returns (mean loss over valid tokens, valid-token count).
+    """
+    b, s, h = hidden.shape
+    if s % chunk_size:
+        # keep the memory bound: largest divisor of s not above chunk_size
+        chunk_size = next(
+            c for c in range(min(chunk_size, s), 0, -1) if s % c == 0
+        )
+    nchunk = s // chunk_size
+    xs = hidden.reshape(b, nchunk, chunk_size, h).transpose(1, 0, 2, 3)
+    labels_r = labels.reshape(b, nchunk, chunk_size).transpose(1, 0, 2)
+    if mask is None:
+        mask_r = jnp.ones((nchunk, b, chunk_size), jnp.float32)
+    else:
+        mask_r = (
+            mask.astype(jnp.float32)
+            .reshape(b, nchunk, chunk_size)
+            .transpose(1, 0, 2)
+        )
+
+    def body(carry, x):
+        loss_acc, w_acc = carry
+        hid, lab, msk = x
+        logits = jax.lax.dot_general(
+            hid, kernel.astype(hid.dtype),
+            (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        loss, z_loss = cross_entropy_with_integer_labels(
+            logits, lab, z_loss_weight=z_loss_weight
+        )
+        return (
+            loss_acc + jnp.sum((loss + z_loss) * msk),
+            w_acc + jnp.sum(msk),
+        ), None
+
+    (loss_sum, w_sum), _ = jax.lax.scan(
+        jax.checkpoint(body, prevent_cse=False),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xs, labels_r, mask_r),
+    )
+    weight = jnp.maximum(w_sum, 1.0)
+    return loss_sum / weight, weight
+
+
 def masked_language_model_loss(
     logits: jax.Array,
     labels: jax.Array,

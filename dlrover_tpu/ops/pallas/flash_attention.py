@@ -8,8 +8,10 @@ kernels so the MXU sees [block_q, d] x [d, block_k] matmuls and HBM never
 holds the [sq, skv] score matrix.
 
 Layout: kernels run on [batch, heads, seq, dim] so the trailing (seq, dim)
-block dims are MXU/VPU tile friendly.  GQA is handled by repeating K/V to
-the query head count outside the kernel (same resolution MaxText applies).
+block dims are MXU/VPU tile friendly.  GQA never materializes repeated
+K/V: the K/V BlockSpec index maps divide the query-head grid index by the
+group size (``ih // reps``), so each query-head block reads its kv head's
+block directly from HBM.
 
 Forward (per batch x head x q-block, kv-blocks innermost grid dim):
     m, l, acc scratch carried across kv blocks; causal blocks fully above
@@ -29,8 +31,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# Measured on v5e (470M Llama, seq 2048, bf16, head_dim 128): 1024x1024
+# blocks reach 0.60 MFU vs 0.42 at 256x256; 2048 blocks exceed the 16MB
+# scoped-VMEM limit.  _flash_attention_impl clamps to the sequence length.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 

@@ -143,7 +143,9 @@ class SharedMemoryHandler:
         self._ensure_shm(total)
         mv = self._shm.buf
         for off, arr in buffers:
-            mv[off:off + arr.nbytes] = arr.tobytes()  # host copy into shm
+            # single host copy straight into shm (no tobytes() staging)
+            dst = np.ndarray(arr.shape, arr.dtype, buffer=mv, offset=off)
+            np.copyto(dst, arr)
         self._meta.set(
             {
                 "step": int(step),

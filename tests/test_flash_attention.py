@@ -138,3 +138,38 @@ def test_bf16_forward_close():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
     )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_query_shorter_than_kv(causal):
+    """sq < skv exercises the seq_offset path: query position i attends to
+    kv positions up to i + (skv - sq) (decode-style suffix queries)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 2, 128, 256, 2, 2, 128)
+    ref = _xla_attention(q, k, v, causal=causal, segment_ids=None, scale=None)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gradients_query_shorter_than_kv():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), 1, 128, 256, 2, 2, 128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+            )
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+            ** 2
+        )
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
