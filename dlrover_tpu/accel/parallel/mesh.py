@@ -138,6 +138,14 @@ DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("kv_seq", None),
     ("embed", "fsdp"),          # param embed dim: ZeRO-3 shard
     ("act_embed", None),        # activation embed dim: replicated
+    # Embedding TABLE axes: rows (vocab) sharded, embed dim replicated.
+    # Sharding the table's embed dim over fsdp makes the token gather's
+    # output embed-sharded, and XLA cannot reshard gather output to the
+    # (batch, seq) activation sharding without an involuntary full
+    # rematerialization of the embedding; row sharding keeps ZeRO-3
+    # memory scaling and lowers to a masked-lookup + psum instead.
+    ("vocab_tbl", ("tp", "fsdp")),
+    ("embed_tbl", None),
     ("heads", "tp"),
     ("kv_heads", "tp"),
     ("head_dim", None),

@@ -293,7 +293,7 @@ class LlamaModel(nn.Module):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+                nn.initializers.normal(stddev=0.02), ("vocab_tbl", "embed_tbl")
             ),
             name="embed_tokens",
         )
