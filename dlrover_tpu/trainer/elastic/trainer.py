@@ -1,0 +1,248 @@
+"""ElasticTrainer — fixed-global-batch training that survives world-size
+changes.
+
+Counterpart of the reference's ``ElasticTrainer``
+(reference: dlrover/trainer/torch/elastic/trainer.py:181-336): there the
+trainer wraps the optimizer and adjusts gradient-accumulation so
+``micro_batch * world_size * accum == global_batch`` stays constant as
+nodes come and go (trainer.py:307-327).  TPU-native differences:
+
+- the "world" is a device mesh, not a process group: on membership change
+  the agent restarts the training process, which rebuilds the mesh for the
+  new device count and re-jits (a compile cache keyed by the accelerate
+  strategy avoids recompiling configurations seen before);
+- training state survives the restart through Flash Checkpoint: the shm
+  restore path rebuilds GSPMD-sharded arrays under the NEW mesh from the
+  saved global-index metadata (resharding is free at restore time);
+- gradient accumulation runs inside the jitted step (lax.scan over
+  microbatches), so "adjusting accumulation" is part of the strategy, not
+  a Python loop change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.accel.accelerate import (
+    AccelerateConfig,
+    AccelerateResult,
+    accelerate,
+)
+from dlrover_tpu.accel.parallel.mesh import MeshSpec, num_data_shards
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer.flash_checkpoint import (
+    Checkpointer,
+    SaverMode,
+    StorageType,
+)
+
+# accelerate() results keyed by (mesh dims, accum, batch shape, seq, model
+# id) — a restarted process starts cold, but within one process an
+# elasticity experiment revisiting a world size reuses the compiled step.
+_COMPILE_CACHE: Dict[Tuple, AccelerateResult] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticBatchPlan:
+    """How a fixed global batch maps onto the current world."""
+
+    global_batch_size: int
+    micro_batch_per_shard: int
+    data_shards: int
+    grad_accum_steps: int
+
+    @property
+    def micro_batch_global(self) -> int:
+        return self.micro_batch_per_shard * self.data_shards
+
+
+def plan_global_batch(
+    global_batch_size: int,
+    mesh_spec: MeshSpec,
+    micro_batch_per_shard: int,
+) -> ElasticBatchPlan:
+    """Keep the global batch fixed by solving for grad accumulation
+    (reference: trainer.py:307-327 ``_adjust_grad_accum``)."""
+    shards = num_data_shards(mesh_spec)
+    micro_global = micro_batch_per_shard * shards
+    if global_batch_size % micro_global:
+        raise ValueError(
+            f"global batch {global_batch_size} is not divisible by "
+            f"micro_batch {micro_batch_per_shard} x {shards} data shards"
+        )
+    return ElasticBatchPlan(
+        global_batch_size=global_batch_size,
+        micro_batch_per_shard=micro_batch_per_shard,
+        data_shards=shards,
+        grad_accum_steps=global_batch_size // micro_global,
+    )
+
+
+class ElasticTrainer:
+    """Drives fixed-global-batch training across elastic restarts.
+
+    Usage (inside the training script the agent [re]spawns)::
+
+        trainer = ElasticTrainer(
+            model, global_batch_size=64, micro_batch_per_shard=2,
+            seq_len=2048, checkpoint_dir="/ckpt")
+        trainer.prepare(devices=jax.devices())   # mesh for CURRENT world
+        trainer.restore_or_init(jax.random.PRNGKey(0))
+        while trainer.step < total_steps:
+            batch = next(data)      # [accum, global_micro, seq] int32
+            metrics = trainer.train_step(batch)
+            trainer.maybe_save()
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        global_batch_size: int,
+        micro_batch_per_shard: int,
+        seq_len: int,
+        checkpoint_dir: Optional[str] = None,
+        optimizer: Any = None,
+        loss_fn: Optional[Callable] = None,
+        mesh_spec: Optional[MeshSpec] = None,
+        accel_config: Optional[AccelerateConfig] = None,
+        save_memory_interval: int = 1,
+        save_storage_interval: int = 50,
+        saver_mode: SaverMode = SaverMode.AUTO,
+    ):
+        self._model = model
+        self._global_batch_size = global_batch_size
+        self._micro_batch_per_shard = micro_batch_per_shard
+        self._seq_len = seq_len
+        self._optimizer = optimizer
+        self._loss_fn = loss_fn
+        self._mesh_spec = mesh_spec
+        self._accel_config = accel_config
+        self._save_memory_interval = save_memory_interval
+        self._save_storage_interval = save_storage_interval
+        self._ckpt = (
+            Checkpointer(checkpoint_dir, saver_mode=saver_mode)
+            if checkpoint_dir else None
+        )
+        self.result: Optional[AccelerateResult] = None
+        self.plan: Optional[ElasticBatchPlan] = None
+        self.state: Any = None
+
+    # -- world / strategy -------------------------------------------------
+    def prepare(self, devices: Optional[Sequence[Any]] = None) -> None:
+        """Build mesh + jitted steps for the current world size."""
+        if devices is None:
+            devices = jax.devices()
+        spec = self._mesh_spec or MeshSpec.for_device_count(len(devices))
+        if spec.size != len(devices):
+            spec = MeshSpec.for_device_count(len(devices))
+        self.plan = plan_global_batch(
+            self._global_batch_size, spec, self._micro_batch_per_shard
+        )
+        base = self._accel_config or AccelerateConfig()
+        config = dataclasses.replace(
+            base,
+            mesh_spec=spec,
+            grad_accum_steps=self.plan.grad_accum_steps,
+        )
+        key = (
+            id(self._model),
+            spec.dims,
+            config.grad_accum_steps,
+            self.plan.micro_batch_global,
+            self._seq_len,
+            tuple(d.id for d in devices),
+        )
+        cached = _COMPILE_CACHE.get(key)
+        if cached is not None:
+            self.result = cached
+        else:
+            self.result = accelerate(
+                self._model,
+                optimizer=self._optimizer,
+                config=config,
+                loss_fn=self._loss_fn,
+                batch_shape=(self.plan.micro_batch_global, self._seq_len),
+                devices=devices,
+            )
+            _COMPILE_CACHE[key] = self.result
+        logger.info(
+            "ElasticTrainer prepared: mesh=%s accum=%s micro_global=%s",
+            spec.dims, self.plan.grad_accum_steps, self.plan.micro_batch_global,
+        )
+
+    # -- state ------------------------------------------------------------
+    def restore_or_init(self, rng: jax.Array) -> int:
+        """Restore the train state from flash checkpoint (resharding to the
+        current mesh), else initialize fresh.  Returns the restored step
+        (0 for a fresh start)."""
+        assert self.result is not None, "call prepare() first"
+        target = self.result.abstract_state
+        import flax.linen as nn
+
+        target = nn.unbox(target)
+        if self._ckpt is not None:
+            step, state = self._ckpt.load_checkpoint(
+                target=target, shardings=self.result.state_sharding
+            )
+            if state is not None:
+                self.state = state
+                logger.info("Restored train state at step %s", step)
+                return int(step)
+        self.state = self.result.init_fn(rng)
+        return 0
+
+    @property
+    def step(self) -> int:
+        if self.state is None:
+            return 0
+        return int(jax.device_get(self.state.step))
+
+    # -- training ---------------------------------------------------------
+    def _shape_batch(self, batch: Any) -> Any:
+        """Accepts [global_batch, seq] (splits into microbatches) or an
+        already micro-shaped [accum, micro_global, seq] array/dict."""
+        accum = self.plan.grad_accum_steps
+
+        def reshape(x):
+            x = np.asarray(x) if not isinstance(x, jax.Array) else x
+            if x.ndim >= 2 and x.shape[0] == self._global_batch_size:
+                return x.reshape(
+                    (accum, self.plan.micro_batch_global) + x.shape[1:]
+                ) if accum > 1 else x
+            return x
+
+        if isinstance(batch, dict):
+            return {k: reshape(v) for k, v in batch.items()}
+        return {"input_ids": reshape(batch)}
+
+    def train_step(self, batch: Any) -> Dict[str, jax.Array]:
+        assert self.state is not None, "call restore_or_init() first"
+        self.state, metrics = self.result.train_step(
+            self.state, self._shape_batch(batch)
+        )
+        return metrics
+
+    def maybe_save(self) -> None:
+        """Flash-checkpoint cadence: shm every ``save_memory_interval``
+        steps, async disk persist every ``save_storage_interval``."""
+        if self._ckpt is None:
+            return
+        step = self.step
+        if self._save_storage_interval and step % self._save_storage_interval == 0:
+            self._ckpt.save_checkpoint(step, self.state, StorageType.DISK)
+        elif self._save_memory_interval and step % self._save_memory_interval == 0:
+            self._ckpt.save_checkpoint(step, self.state, StorageType.MEMORY)
+
+    def save(self, storage_type: StorageType = StorageType.DISK) -> bool:
+        if self._ckpt is None:
+            return False
+        return self._ckpt.save_checkpoint(self.step, self.state, storage_type)
+
+    def close(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.close()
