@@ -1,0 +1,139 @@
+"""Distributed job master: composes every master component for a
+multi-node job.
+
+Counterpart of the reference's ``DistributedJobMaster``
+(reference: dlrover/python/master/dist_master.py:86-304): one process per
+job owning node lifecycle (JobManager + Scaler/Watcher), rendezvous, data
+sharding, sync/kv services and the RPC servicer; the run loop exits when
+the job completes, fails fatally, or hangs.
+"""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import (
+    JobExitReason,
+    RendezvousName,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.common.rpc import build_server
+from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+from dlrover_tpu.master.elastic_training.kv_store_service import (
+    KVStoreService,
+)
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.event_callback import (
+    JobFailureAccountingCallback,
+    RendezvousMembershipCallback,
+    TaskRescheduleCallback,
+)
+from dlrover_tpu.master.node.job_manager import JobManager
+from dlrover_tpu.master.scaler.base import Scaler
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.watcher.base import NodeWatcher
+
+
+class DistributedJobMaster:
+    def __init__(
+        self,
+        port: int,
+        scaler: Scaler,
+        watcher: NodeWatcher,
+        node_num: int = 1,
+        worker_resource: Optional[NodeResource] = None,
+        heartbeat_timeout: float = 300.0,
+    ):
+        self._port = port
+        self._node_num = node_num
+        self.speed_monitor = SpeedMonitor()
+        self.task_manager = TaskManager(0, self.speed_monitor)
+        self.rdzv_managers = {
+            RendezvousName.ELASTIC_TRAINING: (
+                ElasticTrainingRendezvousManager()
+            ),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self.job_manager = JobManager(
+            scaler=scaler,
+            watcher=watcher,
+            worker_num=node_num,
+            worker_resource=worker_resource,
+            heartbeat_timeout=heartbeat_timeout,
+        )
+        self.failure_accounting = JobFailureAccountingCallback()
+        self.job_manager.add_node_event_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
+        self.job_manager.add_node_event_callback(
+            RendezvousMembershipCallback(self.rdzv_managers)
+        )
+        self.job_manager.add_node_event_callback(self.failure_accounting)
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.elastic_ps_service = ElasticPsService()
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            elastic_ps_service=self.elastic_ps_service,
+        )
+        self._server = build_server(self.servicer.get, self.servicer.report)
+        self._stopped = threading.Event()
+        self.exit_reason: str = ""
+
+    def prepare(self) -> None:
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                min_nodes=self._node_num,
+                max_nodes=self._node_num,
+                waiting_timeout=30,
+                node_unit=1,
+            )
+        self.task_manager.start()
+        self.job_manager.start()
+        self._server.add_insecure_port(f"[::]:{self._port}")
+        self._server.start()
+        logger.info("Distributed master serving on port %s", self._port)
+
+    def run(self, poll_interval: float = 5.0) -> int:
+        """Main loop (reference: dist_master.py:211-269): exit on job
+        completion, fatal failure, or all-workers-exited."""
+        try:
+            while not self._stopped.is_set():
+                if self.job_manager.any_worker_failed_fatally():
+                    self.exit_reason = JobExitReason.WORKER_ERROR
+                    logger.error("Worker relaunch budget exhausted; failing")
+                    return 1
+                if self.job_manager.all_workers_exited():
+                    # failures that were covered by a relaunch don't fail
+                    # the job — only unrecovered ones do
+                    if self.job_manager.job_failed():
+                        self.exit_reason = JobExitReason.WORKER_ERROR
+                        return 1
+                    self.exit_reason = JobExitReason.SUCCEEDED
+                    logger.info("All workers exited successfully")
+                    return 0
+                if self.task_manager.finished():
+                    self.exit_reason = JobExitReason.SUCCEEDED
+                    logger.info("All dataset tasks completed; master exits")
+                    return 0
+                time.sleep(poll_interval)
+        except KeyboardInterrupt:  # pragma: no cover
+            pass
+        return 0
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.job_manager.stop()
+        self.task_manager.stop()
+        self._server.stop(grace=None)

@@ -13,10 +13,29 @@ def run(args) -> int:
         from dlrover_tpu.master.local_master import LocalJobMaster
 
         master = LocalJobMaster(port, node_num=args.node_num)
+    elif args.platform == "in_memory":
+        # Distributed master over the in-process scheduler: full node
+        # lifecycle / heartbeat / relaunch machinery without a cluster
+        # (the k8s Scaler/Watcher pair plugs into the same seams).
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.scheduler.in_memory import (
+            InMemoryCluster,
+            InMemoryNodeWatcher,
+            InMemoryScaler,
+        )
+
+        cluster = InMemoryCluster()
+        master = DistributedJobMaster(
+            port,
+            scaler=InMemoryScaler(cluster),
+            watcher=InMemoryNodeWatcher(cluster),
+            node_num=args.node_num,
+        )
     else:
         raise NotImplementedError(
-            f"platform {args.platform!r} is not wired up yet; only 'local' "
-            "is supported (the distributed master is under construction)"
+            f"platform {args.platform!r} is not wired up yet; 'local' and "
+            "'in_memory' are supported (the k8s operator lands with the "
+            "cluster scheduler backend)"
         )
     master.prepare()
     logger.info(

@@ -1,0 +1,84 @@
+"""Node event callbacks: react to node lifecycle changes.
+
+Counterpart of the reference's event callbacks (reference:
+dlrover/python/master/node/event_callback.py): when the JobManager applies
+a node state transition, registered callbacks fire — rescheduling the dead
+node's data shards, updating rendezvous membership, and recording
+job-level failure accounting.
+"""
+
+from abc import ABCMeta
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+
+
+class NodeEventCallback(metaclass=ABCMeta):
+    """Hooks for node lifecycle transitions; override what you need."""
+
+    def on_node_started(self, node: Node) -> None: ...
+
+    def on_node_succeeded(self, node: Node) -> None: ...
+
+    def on_node_failed(self, node: Node) -> None: ...
+
+    def on_node_deleted(self, node: Node) -> None: ...
+
+
+class TaskRescheduleCallback(NodeEventCallback):
+    """Recover the data shards a dead worker was processing (reference:
+    event_callback.py TaskRescheduleCallback)."""
+
+    def __init__(self, task_manager):
+        self._task_manager = task_manager
+
+    def on_node_failed(self, node: Node) -> None:
+        # tasks are dispatched to agents keyed by their RANK (the env
+        # contract id), not the scheduler-assigned node id
+        self._task_manager.recover_tasks(node.rank_index)
+        logger.info("Recovered data shards of failed node %s", node.name)
+
+    def on_node_deleted(self, node: Node) -> None:
+        self._task_manager.recover_tasks(node.rank_index)
+
+
+class RendezvousMembershipCallback(NodeEventCallback):
+    """Keep the elastic rendezvous' alive-node set in sync with the node
+    lifecycle so a dead node shrinks the next comm world (the SPMD analogue
+    of the reference's AllReduceNodeHandlingCallback)."""
+
+    def __init__(self, rdzv_managers: dict):
+        self._rdzv_managers = rdzv_managers
+
+    def on_node_started(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.add_alive_node(node.rank_index)
+
+    def on_node_failed(self, node: Node) -> None:
+        self._remove(node)
+
+    def on_node_deleted(self, node: Node) -> None:
+        self._remove(node)
+
+    def on_node_succeeded(self, node: Node) -> None:
+        self._remove(node)
+
+    def _remove(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.rank_index)
+
+
+class JobFailureAccountingCallback(NodeEventCallback):
+    """Track job-level exit accounting (which nodes failed, why) for the
+    master's early-stop and final-status decisions."""
+
+    def __init__(self):
+        self.failed_nodes: dict = {}
+        self.succeeded_nodes: set = set()
+
+    def on_node_failed(self, node: Node) -> None:
+        self.failed_nodes[node.name] = node.exit_reason or "unknown"
+
+    def on_node_succeeded(self, node: Node) -> None:
+        self.succeeded_nodes.add(node.name)

@@ -1,0 +1,382 @@
+"""JobManager — node lifecycle management of the distributed master.
+
+Counterpart of the reference's ``DistributedJobManager``
+(reference: dlrover/python/master/node/dist_job_manager.py:88-400):
+
+- consumes lifecycle events from a :class:`NodeWatcher`, applies the
+  :mod:`status_flow` transition table, fires event callbacks;
+- monitors agent heartbeats and synthesizes a node-failure event when a
+  node goes silent past the timeout (dist_job_manager.py:355-400) — the
+  TPU preemption/hang case where no clean event ever arrives;
+- relaunches failed nodes through the :class:`Scaler` within per-node
+  relaunch budgets;
+- serves the servicer-side queries (resource usage, reported status,
+  job detail).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    JobConstant,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.event_callback import NodeEventCallback
+from dlrover_tpu.master.node.status_flow import get_node_state_flow
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.base import NodeEvent, NodeWatcher
+
+
+class JobManager:
+    def __init__(
+        self,
+        scaler: Scaler,
+        watcher: NodeWatcher,
+        worker_num: int = 1,
+        worker_resource: Optional[NodeResource] = None,
+        heartbeat_timeout: float = JobConstant.NODE_HEARTBEAT_TIMEOUT,
+        max_relaunch_count: int = JobConstant.MAX_NODE_RELAUNCH_COUNT,
+    ):
+        self._scaler = scaler
+        self._watcher = watcher
+        self._worker_num = worker_num
+        self._worker_resource = worker_resource or NodeResource()
+        self._heartbeat_timeout = heartbeat_timeout
+        self._max_relaunch_count = max_relaunch_count
+        self._lock = threading.Lock()
+        # Serializes status transitions end-to-end (flow lookup + apply +
+        # relaunch): the watcher thread and the heartbeat thread both feed
+        # _process_event, and racing them could relaunch a node twice.
+        self._transition_lock = threading.RLock()
+        # node_type -> {node_id: Node}
+        self.job_nodes: Dict[str, Dict[int, Node]] = {NodeType.WORKER: {}}
+        self._event_callbacks: List[NodeEventCallback] = []
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._relaunch_budget_exhausted: List[str] = []
+
+    # -- setup ------------------------------------------------------------
+    def add_node_event_callback(self, cb: NodeEventCallback) -> None:
+        self._event_callbacks.append(cb)
+
+    def start(self) -> None:
+        self._scaler.start()
+        # adopt nodes that already exist (master restart case)
+        for node in self._watcher.list():
+            self.job_nodes.setdefault(node.type, {})[node.id] = node
+        if not self.job_nodes.get(NodeType.WORKER):
+            plan = ScalePlan(
+                node_group_resources={
+                    NodeType.WORKER: NodeGroupResource(
+                        self._worker_num, self._worker_resource
+                    )
+                }
+            )
+            self._scaler.scale(plan)
+        for target, name in (
+            (self._monitor_nodes, "job-manager-nodes"),
+            (self._monitor_heart_beats, "job-manager-heartbeat"),
+        ):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._scaler.stop()
+
+    # -- event processing -------------------------------------------------
+    def _monitor_nodes(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                for event in self._watcher.watch(timeout=1.0):
+                    self._process_event(event)
+            except Exception:
+                logger.exception("node monitor iteration failed")
+                time.sleep(1)
+
+    def _process_event(self, event: NodeEvent) -> None:
+        new = event.node
+        with self._transition_lock:
+            with self._lock:
+                nodes = self.job_nodes.setdefault(new.type, {})
+                node = nodes.get(new.id)
+                if node is None:
+                    # adopt at INITIAL so the transition table replays the
+                    # observed lifecycle from the start
+                    node = Node(
+                        new.type,
+                        new.id,
+                        name=new.name,
+                        status=NodeStatus.INITIAL,
+                        rank_index=new.rank_index,
+                        relaunch_count=new.relaunch_count,
+                        max_relaunch_count=self._max_relaunch_count,
+                        config_resource=new.config_resource,
+                        slice_id=new.slice_id,
+                    )
+                    nodes[new.id] = node
+                    self._absorb_phantom(nodes, node)
+            flow = get_node_state_flow(
+                node.status, event.event_type, new.status
+            )
+            if flow is None:
+                return
+            node.exit_reason = new.exit_reason or node.exit_reason
+            node.update_status(flow.to_status)
+            logger.info(
+                "Node %s: %s -> %s (%s)",
+                node.name, flow.from_status, flow.to_status, event.event_type,
+            )
+            self._fire_callbacks(node, flow.to_status)
+            if flow.should_relaunch:
+                self._relaunch_node(node)
+
+    @staticmethod
+    def _absorb_phantom(nodes: Dict[int, Node], node: Node) -> None:
+        """A heartbeat that raced ahead of the watcher created a synthetic
+        node keyed by agent rank; fold its liveness into the real node and
+        drop it so it cannot shadow rank lookups."""
+        phantom = nodes.get(node.rank_index)
+        if (
+            phantom is not None
+            and phantom is not node
+            and getattr(phantom, "is_phantom", False)
+            and phantom.rank_index == node.rank_index
+        ):
+            node.heartbeat_time = max(
+                node.heartbeat_time, phantom.heartbeat_time
+            )
+            node.reported_status = phantom.reported_status
+            del nodes[node.rank_index]
+
+    def _fire_callbacks(self, node: Node, status: str) -> None:
+        for cb in self._event_callbacks:
+            try:
+                if status == NodeStatus.RUNNING:
+                    cb.on_node_started(node)
+                elif status == NodeStatus.SUCCEEDED:
+                    cb.on_node_succeeded(node)
+                elif status == NodeStatus.FAILED:
+                    cb.on_node_failed(node)
+                elif status == NodeStatus.DELETED:
+                    cb.on_node_deleted(node)
+            except Exception:
+                logger.exception(
+                    "event callback %s failed", type(cb).__name__
+                )
+
+    # -- relaunch ---------------------------------------------------------
+    def _relaunch_node(self, node: Node) -> None:
+        if not node.should_relaunch():
+            logger.warning(
+                "Not relaunching %s (relaunch_count=%s, reason=%s)",
+                node.name, node.relaunch_count, node.exit_reason,
+            )
+            self._relaunch_budget_exhausted.append(node.name)
+            return
+        node.is_released = True
+        with self._lock:
+            new_id = max(self.job_nodes[node.type], default=0) + 1
+            replacement = node.get_relaunch_node_info(new_id)
+            self.job_nodes[node.type][new_id] = replacement
+        logger.info(
+            "Relaunching %s as %s (attempt %s/%s)",
+            node.name, replacement.name,
+            replacement.relaunch_count, replacement.max_relaunch_count,
+        )
+        plan = ScalePlan(launch_nodes=[replacement], remove_nodes=[node])
+        self._scaler.scale(plan)
+
+    # -- heartbeats -------------------------------------------------------
+    def collect_node_heart_beat(
+        self, node_type: str, node_id: int, timestamp: float
+    ) -> str:
+        """Record an agent heartbeat; returns an action for the agent
+        (empty = keep going).
+
+        Agents identify by their *rank* (env contract), while scheduler
+        node ids are platform-assigned — match rank first, id second.
+        """
+        with self._lock:
+            node = self._find_node(node_type, node_id)
+            if node is None:
+                # heartbeat from a node the watcher hasn't reported yet;
+                # marked so the real node absorbs it on arrival
+                node = Node(node_type, node_id, status=NodeStatus.RUNNING)
+                node.is_phantom = True
+                self.job_nodes.setdefault(node_type, {})[node_id] = node
+        node.update_heartbeat(timestamp)
+        return ""
+
+    def _find_node(self, node_type: str, agent_id: int) -> Optional[Node]:
+        """Agents identify by rank (env contract); scheduler ids are
+        platform-assigned.  Prefer the live node with that rank."""
+        nodes = self.job_nodes.setdefault(node_type, {})
+        return next(
+            (
+                n for n in nodes.values()
+                if n.rank_index == agent_id and not n.is_exited()
+            ),
+            None,
+        ) or nodes.get(agent_id)
+
+    def _monitor_heart_beats(self) -> None:
+        interval = min(15.0, max(1.0, self._heartbeat_timeout / 4))
+        while not self._stopped.wait(interval):
+            try:
+                self.check_heart_beats()
+            except Exception:
+                logger.exception("heartbeat check failed")
+
+    def check_heart_beats(self, now: Optional[float] = None) -> List[Node]:
+        """Synthesize failure events for silent nodes (reference:
+        dist_job_manager.py:369-400).  Returns the newly-dead nodes."""
+        now = now or time.time()
+        dead: List[Node] = []
+        with self._lock:
+            candidates = [
+                n
+                for nodes in self.job_nodes.values()
+                for n in nodes.values()
+                if not n.is_exited() and n.heartbeat_time > 0
+            ]
+        for node in candidates:
+            if now - node.heartbeat_time > self._heartbeat_timeout:
+                logger.warning(
+                    "Node %s heartbeat silent for %.0fs; marking dead",
+                    node.name, now - node.heartbeat_time,
+                )
+                node.exit_reason = NodeExitReason.HARDWARE_ERROR
+                dead.append(node)
+                self._process_event(
+                    NodeEvent(
+                        NodeEventType.DELETED,
+                        self._as_deleted(node),
+                    )
+                )
+        return dead
+
+    @staticmethod
+    def _as_deleted(node: Node) -> Node:
+        ghost = Node(
+            node.type, node.id, status=NodeStatus.DELETED,
+            rank_index=node.rank_index,
+        )
+        ghost.exit_reason = node.exit_reason
+        return ghost
+
+    # -- failure reports from agents --------------------------------------
+    def handle_training_failure(
+        self,
+        node_type: str,
+        node_id: int,
+        restart_count: int = 0,
+        error_data: str = "",
+        level: str = "",
+    ) -> None:
+        """A worker-process failure reported by the agent (in-place restart
+        is the agent's job; the master only records it unless the node
+        itself is unrecoverable)."""
+        with self._lock:
+            node = self._find_node(node_type, node_id)
+        if node is None:
+            return
+        node.update_info(relaunch_count=restart_count)
+        logger.info(
+            "Training failure on %s (restart %s, level %s): %s",
+            node.name, restart_count, level, error_data[:200],
+        )
+
+    # -- servicer queries -------------------------------------------------
+    def update_node_resource_usage(self, node_type, node_id, stats) -> None:
+        with self._lock:
+            node = self._find_node(node_type, node_id)
+        if node is not None:
+            node.used_resource.cpu = getattr(stats, "cpu_percent", 0.0)
+            node.used_resource.memory = int(getattr(stats, "memory_mb", 0))
+
+    def update_node_reported_status(self, node_type, node_id, status) -> None:
+        """Agent-reported terminal status flows through the same transition
+        machinery as watcher events so relaunch policy applies (an agent
+        reporting FAILED has exhausted its in-place restarts — node-level
+        relaunch is the next escalation)."""
+        with self._lock:
+            node = self._find_node(node_type, node_id)
+        if node is None:
+            return
+        node.reported_status = status
+        if status in (NodeStatus.SUCCEEDED, NodeStatus.FAILED):
+            ghost = Node(
+                node.type, node.id, status=status,
+                rank_index=node.rank_index,
+            )
+            if status == NodeStatus.FAILED:
+                ghost.exit_reason = NodeExitReason.UNKNOWN_ERROR
+            self._process_event(NodeEvent(NodeEventType.MODIFIED, ghost))
+
+    def update_node_service_addr(self, node_type, node_id, addr) -> None:
+        with self._lock:
+            node = self._find_node(node_type, node_id)
+        if node is not None:
+            node.service_addr = addr
+
+    def process_reported_node_event(self, message) -> None:
+        pass  # diagnosis events; consumed by the diagnosis manager later
+
+    def get_paral_config(self, node_id: int):
+        return None
+
+    def query_ps_nodes(self):
+        return [], True, False
+
+    def get_elastic_run_configs(self) -> Dict[str, str]:
+        return {}
+
+    def get_job_detail(self) -> Dict:
+        with self._lock:
+            return {
+                node_type: {
+                    node.name: {
+                        "status": node.status,
+                        "rank": node.rank_index,
+                        "relaunch_count": node.relaunch_count,
+                        "heartbeat_age": (
+                            round(time.time() - node.heartbeat_time, 1)
+                            if node.heartbeat_time else None
+                        ),
+                    }
+                    for node in nodes.values()
+                }
+                for node_type, nodes in self.job_nodes.items()
+            }
+
+    # -- job-level state --------------------------------------------------
+    def all_workers_exited(self) -> bool:
+        with self._lock:
+            workers = list(self.job_nodes.get(NodeType.WORKER, {}).values())
+        return bool(workers) and all(n.is_exited() for n in workers)
+
+    def any_worker_failed_fatally(self) -> bool:
+        return bool(self._relaunch_budget_exhausted)
+
+    def job_failed(self) -> bool:
+        """The job is failed only by *unrecovered* worker failures: a node
+        whose failure was covered by a relaunch (is_released) doesn't count
+        against the job's final status."""
+        if self._relaunch_budget_exhausted:
+            return True
+        with self._lock:
+            workers = list(self.job_nodes.get(NodeType.WORKER, {}).values())
+        return any(
+            n.status == NodeStatus.FAILED and not n.is_released
+            for n in workers
+        )

@@ -1,0 +1,65 @@
+"""Node status transition table.
+
+Counterpart of the reference's ``NodeStateFlow``
+(reference: dlrover/python/master/node/status_flow.py): the master never
+mutates a node's status freely — every (from, to, event) transition is
+looked up here, and the flow decides whether the node should be
+relaunched or the event refused.
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+
+
+@dataclass(frozen=True)
+class NodeStateFlow:
+    from_status: str
+    to_status: str
+    event_type: str
+    should_relaunch: bool = False
+
+
+NODE_STATE_FLOWS = [
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.PENDING, NodeEventType.ADDED),
+    NodeStateFlow(NodeStatus.INITIAL, NodeStatus.RUNNING, NodeEventType.MODIFIED),
+    NodeStateFlow(NodeStatus.PENDING, NodeStatus.RUNNING, NodeEventType.MODIFIED),
+    NodeStateFlow(NodeStatus.PENDING, NodeStatus.SUCCEEDED, NodeEventType.MODIFIED),
+    NodeStateFlow(
+        NodeStatus.PENDING, NodeStatus.FAILED, NodeEventType.MODIFIED,
+        should_relaunch=True,
+    ),
+    NodeStateFlow(
+        NodeStatus.PENDING, NodeStatus.DELETED, NodeEventType.DELETED,
+        should_relaunch=True,
+    ),
+    NodeStateFlow(NodeStatus.RUNNING, NodeStatus.SUCCEEDED, NodeEventType.MODIFIED),
+    NodeStateFlow(
+        NodeStatus.RUNNING, NodeStatus.FAILED, NodeEventType.MODIFIED,
+        should_relaunch=True,
+    ),
+    NodeStateFlow(
+        NodeStatus.RUNNING, NodeStatus.DELETED, NodeEventType.DELETED,
+        should_relaunch=True,
+    ),
+    # terminal states never transition
+    NodeStateFlow(NodeStatus.SUCCEEDED, NodeStatus.DELETED, NodeEventType.DELETED),
+    NodeStateFlow(NodeStatus.FAILED, NodeStatus.DELETED, NodeEventType.DELETED),
+]
+
+
+def get_node_state_flow(
+    from_status: str, event_type: str, to_status: str
+) -> Optional[NodeStateFlow]:
+    """The transition for (from, event, to), or None if not allowed."""
+    if from_status == to_status:
+        return None
+    for flow in NODE_STATE_FLOWS:
+        if (
+            flow.from_status == from_status
+            and flow.to_status == to_status
+            and flow.event_type == event_type
+        ):
+            return flow
+    return None

@@ -1,0 +1,75 @@
+"""Scaler abstraction: execute ScalePlans against a cluster backend.
+
+Counterpart of the reference's scaler layer (reference:
+dlrover/python/master/scaler/base_scaler.py and pod_scaler.py:78-707): the
+master computes a :class:`ScalePlan` (how many nodes of each type, which
+nodes to remove/relaunch) and a platform-specific ``Scaler`` makes the
+cluster match it.  On TPU clusters the unit is a *host of a pod slice*
+(the operator schedules whole slices; in-place process restarts stay with
+the agent).
+"""
+
+from abc import ABCMeta, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.node import Node, NodeGroupResource
+
+
+@dataclass
+class ScalePlan:
+    """What the cluster should look like after scaling."""
+
+    # target group sizes by node type (e.g. {"worker": NodeGroupResource})
+    node_group_resources: Dict[str, NodeGroupResource] = field(
+        default_factory=dict
+    )
+    # nodes to launch individually (relaunches with inherited rank)
+    launch_nodes: List[Node] = field(default_factory=list)
+    # nodes to remove from the cluster
+    remove_nodes: List[Node] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return (
+            not self.node_group_resources
+            and not self.launch_nodes
+            and not self.remove_nodes
+        )
+
+    def merge(self, other: "ScalePlan") -> None:
+        self.node_group_resources.update(other.node_group_resources)
+        self.launch_nodes.extend(other.launch_nodes)
+        self.remove_nodes.extend(other.remove_nodes)
+
+
+class Scaler(metaclass=ABCMeta):
+    """Executes scale plans (reference: base_scaler.py Scaler)."""
+
+    def __init__(self, job_name: str = ""):
+        self._job_name = job_name
+
+    @abstractmethod
+    def start(self) -> None: ...
+
+    @abstractmethod
+    def scale(self, plan: ScalePlan) -> None: ...
+
+    def stop(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+
+class ElasticJobScaler(Scaler):
+    """Scaler that records plans for an external controller (the CRD path
+    of the reference, elasticjob_scaler.py): the operator watches the
+    plans and realizes them.  Kept as a queue the controller can drain."""
+
+    def __init__(self, job_name: str = ""):
+        super().__init__(job_name)
+        self.pending_plans: List[ScalePlan] = []
+
+    def start(self) -> None:
+        pass
+
+    def scale(self, plan: ScalePlan) -> None:
+        if not plan.empty():
+            self.pending_plans.append(plan)

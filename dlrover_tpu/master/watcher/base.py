@@ -1,0 +1,29 @@
+"""NodeWatcher abstraction: list/watch node lifecycle events.
+
+Counterpart of the reference's watcher layer (reference:
+dlrover/python/master/watcher/base_watcher.py and k8s_watcher.py:194-265).
+The JobManager consumes ``NodeEvent``s from a platform watcher; tests use
+the in-memory scheduler's watcher.
+"""
+
+from abc import ABCMeta, abstractmethod
+from dataclasses import dataclass
+from typing import List
+
+from dlrover_tpu.common.node import Node
+
+
+@dataclass
+class NodeEvent:
+    event_type: str  # NodeEventType.ADDED / MODIFIED / DELETED
+    node: Node
+
+
+class NodeWatcher(metaclass=ABCMeta):
+    @abstractmethod
+    def watch(self, timeout: float = 1.0) -> List[NodeEvent]:
+        """Block up to ``timeout`` for new events; may return []."""
+
+    @abstractmethod
+    def list(self) -> List[Node]:
+        """Snapshot of all live nodes."""

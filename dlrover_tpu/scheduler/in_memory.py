@@ -1,0 +1,145 @@
+"""In-memory cluster scheduler — the test double for k8s/TPU platforms.
+
+The reference tests every master feature against a mocked k8s client
+(reference: dlrover/python/tests/test_utils.py:268-290 ``mock_k8s_client``);
+here the same role is played by a real little scheduler object: the Scaler
+writes desired state into it, it "starts" nodes, and the NodeWatcher reads
+lifecycle events back out.  Chaos hooks (fail/delete a node) drive
+fault-tolerance tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.base import NodeEvent, NodeWatcher
+
+
+class InMemoryCluster:
+    """Holds "running" virtual nodes and a queue of lifecycle events."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.nodes: Dict[str, Node] = {}  # name -> Node
+        self.events: "queue.Queue[NodeEvent]" = queue.Queue()
+        self._next_id = 10000
+
+    def _emit(self, event_type: str, node: Node) -> None:
+        # snapshot: consumers must see the status at event time, not a
+        # live object the cluster keeps mutating
+        self.events.put(NodeEvent(event_type, copy.copy(node)))
+
+    # -- scheduler actions ------------------------------------------------
+    def create_node(self, node: Node) -> None:
+        with self._lock:
+            # keep the id counter ahead of explicitly-assigned ids so a
+            # later group-fill scale can never collide with a relaunch id
+            self._next_id = max(self._next_id, node.id + 1)
+            node.update_status(NodeStatus.PENDING)
+            self.nodes[node.name] = node
+        self._emit(NodeEventType.ADDED, node)
+        # virtual nodes start instantly
+        self.start_node(node.name)
+
+    def start_node(self, name: str) -> None:
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is None:
+                return
+            node.update_status(NodeStatus.RUNNING)
+        self._emit(NodeEventType.MODIFIED, node)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            node = self.nodes.pop(name, None)
+        if node is not None:
+            node.update_status(NodeStatus.DELETED)
+            self._emit(NodeEventType.DELETED, node)
+
+    def next_node_id(self) -> int:
+        with self._lock:
+            nid = self._next_id
+            self._next_id += 1
+            return nid
+
+    # -- chaos hooks (tests) ----------------------------------------------
+    def fail_node(
+        self, name: str, exit_reason: str = "UnknownError"
+    ) -> None:
+        """Chaos hook.  Default reason is relaunchable; pass
+        NodeExitReason.FATAL_ERROR to simulate an unrecoverable crash."""
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is None:
+                return
+            node.exit_reason = exit_reason
+            node.update_status(NodeStatus.FAILED)
+        self._emit(NodeEventType.MODIFIED, node)
+
+    def preempt_node(self, name: str) -> None:
+        self.remove_node(name)
+
+
+class InMemoryScaler(Scaler):
+    """Realizes ScalePlans against the in-memory cluster."""
+
+    def __init__(self, cluster: Optional[InMemoryCluster] = None, job_name: str = ""):
+        super().__init__(job_name)
+        self.cluster = cluster or InMemoryCluster()
+        self.plans: List[ScalePlan] = []
+
+    def start(self) -> None:
+        pass
+
+    def scale(self, plan: ScalePlan) -> None:
+        if plan.empty():
+            return
+        self.plans.append(plan)
+        for node in plan.remove_nodes:
+            self.cluster.remove_node(node.name)
+        for node in plan.launch_nodes:
+            # the cluster owns its copy — mutating the caller's object
+            # directly would bypass the master's state machine
+            self.cluster.create_node(copy.copy(node))
+        for node_type, group in plan.node_group_resources.items():
+            alive = [
+                n for n in self.cluster.nodes.values()
+                if n.type == node_type and not n.is_exited()
+            ]
+            used_ranks = {n.rank_index for n in alive}
+            free_ranks = (r for r in itertools.count() if r not in used_ranks)
+            for _ in range(group.count - len(alive)):
+                node_id = self.cluster.next_node_id()
+                self.cluster.create_node(
+                    Node(
+                        node_type,
+                        node_id,
+                        rank_index=next(free_ranks),
+                        config_resource=group.node_resource,
+                    )
+                )
+
+
+class InMemoryNodeWatcher(NodeWatcher):
+    def __init__(self, cluster: InMemoryCluster):
+        self._cluster = cluster
+
+    def watch(self, timeout: float = 1.0) -> List[NodeEvent]:
+        events: List[NodeEvent] = []
+        try:
+            events.append(self._cluster.events.get(timeout=timeout))
+            while True:
+                events.append(self._cluster.events.get_nowait())
+        except queue.Empty:
+            pass
+        return events
+
+    def list(self) -> List[Node]:
+        return list(self._cluster.nodes.values())
