@@ -288,9 +288,14 @@ class ElasticAgent:
                 time.sleep(spec.monitor_interval)
                 state, rc = self._group.state()
                 if state == WorkerState.SUCCEEDED:
-                    self._client.report_node_status(
-                        self._node_rank, NodeStatus.SUCCEEDED
-                    )
+                    try:
+                        self._client.report_node_status(
+                            self._node_rank, NodeStatus.SUCCEEDED
+                        )
+                    except Exception:
+                        # a local master that exits on dataset completion
+                        # may already be gone — success stands regardless
+                        logger.info("master gone before final status report")
                     logger.info("Workers finished successfully")
                     return 0
                 if state == WorkerState.FAILED:
@@ -315,10 +320,16 @@ class ElasticAgent:
                         return rc or 1
                     self._restart_workers(f"worker failed rc={rc}")
                     continue
-                # healthy: check membership growth
-                waiting = self._client.num_nodes_waiting(
-                    RendezvousName.ELASTIC_TRAINING
-                )
+                # healthy: check membership growth.  An unreachable master
+                # must not kill healthy workers (it may be restarting, or —
+                # local mode — already exited after the dataset finished).
+                try:
+                    waiting = self._client.num_nodes_waiting(
+                        RendezvousName.ELASTIC_TRAINING
+                    )
+                except Exception as e:
+                    logger.warning("membership poll failed: %s", e)
+                    continue
                 if waiting > 0:
                     self._restart_workers(
                         f"{waiting} node(s) waiting to join"

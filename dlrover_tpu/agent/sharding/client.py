@@ -1,0 +1,226 @@
+"""Worker-side data-shard consumption.
+
+Counterpart of the reference's sharding client
+(reference: dlrover/python/elastic_agent/sharding/client.py:29-319):
+the master's TaskManager owns the dataset split; workers pull shard tasks,
+consume them, and report completion so a dead worker's shards get
+re-dispatched.  ``IndexShardingClient`` flattens shards into per-sample
+indices with a background prefetch thread — the form a data iterator
+consumes directly.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ShardingClient:
+    """Pulls shard tasks from the master and reports completion.
+
+    ``fetch_shard`` returns the next shard (or None when the dataset is
+    exhausted); ``report_batch_done`` counts consumed minibatches and
+    acknowledges the active task once its minibatch budget is used
+    (reference: client.py:29-220).
+    """
+
+    def __init__(
+        self,
+        client: MasterClient,
+        dataset_name: str,
+        batch_size: int,
+        num_epochs: int = 1,
+        dataset_size: int = 0,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        task_type: str = TaskType.TRAINING,
+        storage_type: str = "table",
+    ):
+        self._client = client
+        self.dataset_name = dataset_name
+        self._batch_size = batch_size
+        self._num_minibatches_per_shard = num_minibatches_per_shard
+        self._current_task: Optional[comm.Task] = None
+        self._pending_batch_count = 0
+        self._lock = threading.Lock()
+        if dataset_size > 0:
+            client.report_dataset_shard_params(
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                dataset_size=dataset_size,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                dataset_name=dataset_name,
+                task_type=task_type,
+                storage_type=storage_type,
+            )
+
+    def fetch_shard(self, timeout: float = 600.0) -> Optional[comm.Shard]:
+        """Next shard, blocking through WAIT tasks; None = exhausted."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            task = self._client.get_task(self.dataset_name)
+            if task.task_id >= 0 and task.shard is not None:
+                with self._lock:
+                    self._current_task = task
+                    self._pending_batch_count = 0
+                return task.shard
+            if task.task_type == TaskType.WAIT:
+                time.sleep(1.0)
+                continue
+            return None
+        raise TimeoutError(f"no shard for {self.dataset_name} in {timeout}s")
+
+    def report_batch_done(self, batch_count: int = 1) -> None:
+        """Report consumed minibatches; completes the active task when its
+        per-shard minibatch budget is consumed (reference: client.py:190)."""
+        with self._lock:
+            if self._current_task is None:
+                return
+            self._pending_batch_count += batch_count
+            if self._pending_batch_count >= self._num_minibatches_per_shard:
+                self._ack_current_task()
+
+    def report_shard_done(self) -> None:
+        """Explicitly complete the active shard (end of iteration)."""
+        with self._lock:
+            self._ack_current_task()
+
+    def _ack_current_task(self) -> None:
+        if self._current_task is not None:
+            self._client.report_task_result(
+                self.dataset_name, self._current_task.task_id
+            )
+            self._current_task = None
+            self._pending_batch_count = 0
+
+    # -- dataset checkpoint (streaming resume) ----------------------------
+    def get_shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def report_shard_checkpoint(self, content: str) -> None:
+        self._client.report_shard_checkpoint(content)
+
+
+class IndexShardingClient(ShardingClient):
+    """Per-sample index stream over the master's shards with background
+    prefetch (reference: client.py:231-319 ``IndexShardingClient``)."""
+
+    def __init__(self, *args, prefetch_shards: int = 2, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._index_queue: "queue.Queue[Optional[int]]" = queue.Queue(
+            maxsize=max(1, prefetch_shards)
+            * self._num_minibatches_per_shard
+            * self._batch_size
+        )
+        # Prefetch runs ahead of consumption, so tasks are acked in FIFO
+        # order as their samples are actually TRAINED ON — the consumer
+        # calls report_batch_done(n) after the optimizer step (and any
+        # checkpoint), so a crash between dequeue and step re-dispatches
+        # the shard instead of silently skipping it.
+        self._task_fifo: "queue.Queue[tuple]" = queue.Queue()
+        self._consumed_in_head = 0
+        self._prefetch_error: Optional[Exception] = None
+        self._exhausted = threading.Event()
+        self._prefetch_thread = threading.Thread(
+            target=self._prefetch_loop, daemon=True, name="shard-prefetch"
+        )
+        self._prefetch_thread.start()
+
+    def _prefetch_loop(self) -> None:
+        while not self._exhausted.is_set():
+            try:
+                shard = self.fetch_shard()
+            except Exception as e:
+                # a real error, not end-of-data: surface it to the consumer
+                logger.warning("shard prefetch failed: %s", e)
+                self._prefetch_error = e
+                break
+            if shard is None:
+                break
+            with self._lock:
+                task, self._current_task = self._current_task, None
+            indices: List[int] = list(
+                shard.record_indices
+                or range(shard.start, shard.end)
+            )
+            self._task_fifo.put((task.task_id, len(indices)))
+            for idx in indices:
+                while not self._exhausted.is_set():
+                    try:
+                        self._index_queue.put(idx, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+                if self._exhausted.is_set():
+                    break
+        self._exhausted.set()
+        try:
+            self._index_queue.put_nowait(None)  # sentinel
+        except queue.Full:
+            pass
+
+    def fetch_sample_index(self, timeout: float = 600.0) -> Optional[int]:
+        """Next global sample index, or None when the dataset is done.
+        Raises if the prefetch thread died on an error — an unreachable
+        master must not masquerade as normal end-of-data."""
+        idx = self._index_queue.get(timeout=timeout)
+        if idx is None:
+            if self._prefetch_error is not None:
+                raise RuntimeError(
+                    "shard prefetch failed"
+                ) from self._prefetch_error
+            try:
+                self._index_queue.put_nowait(None)  # keep sentinel for peers
+            except queue.Full:
+                pass
+            return None
+        return idx
+
+    def report_batch_done(self, batch_count: int = 1) -> None:
+        """Ack consumption of ``batch_count`` SAMPLES (overrides the base
+        minibatch semantics): call after the train step that used them."""
+        with self._lock:
+            remaining = batch_count
+            while remaining > 0 and not self._task_fifo.empty():
+                head_id, head_n = self._task_fifo.queue[0]
+                take = min(remaining, head_n - self._consumed_in_head)
+                self._consumed_in_head += take
+                remaining -= take
+                if self._consumed_in_head >= head_n:
+                    self._task_fifo.get()
+                    self._consumed_in_head = 0
+                    self._client.report_task_result(
+                        self.dataset_name, head_id
+                    )
+
+    def fetch_batch_indices(
+        self, batch_size: Optional[int] = None, timeout: float = 600.0
+    ) -> List[int]:
+        """Up to one batch of indices; [] = dataset exhausted."""
+        n = batch_size or self._batch_size
+        out: List[int] = []
+        for _ in range(n):
+            idx = self.fetch_sample_index(timeout)
+            if idx is None:
+                break
+            out.append(idx)
+        return out
+
+    def close(self) -> None:
+        self._exhausted.set()
+        # unblock a prefetch thread parked on a full queue, then join it
+        while self._prefetch_thread.is_alive():
+            try:
+                while True:
+                    self._index_queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._prefetch_thread.join(timeout=0.2)

@@ -311,12 +311,19 @@ class SharedDict(LocalSocketComm):
         self._call("clear")
 
 
-def _unregister_from_tracker(shm_name: str) -> None:
-    """Keep the resource tracker from unlinking shm when a proc dies."""
+def _unregister_from_tracker(registered_name: str) -> None:
+    """Keep the resource tracker from unlinking shm when a proc dies.
+
+    ``registered_name`` must be EXACTLY what SharedMemory registered
+    (``shm._name``, which on CPython 3.12 already carries the leading
+    slash) — a mismatched name leaves the registration in place and the
+    tracker unlinks the segment when the creating process dies, silently
+    destroying the in-memory checkpoint a crash was supposed to preserve.
+    """
     try:
         from multiprocessing import resource_tracker
 
-        resource_tracker.unregister("/" + shm_name, "shared_memory")
+        resource_tracker.unregister(registered_name, "shared_memory")
     except Exception:  # pragma: no cover - tracker internals vary
         pass
 

@@ -30,7 +30,6 @@ from dlrover_tpu.master.elastic_training.rdzv_manager import (
 from dlrover_tpu.master.elastic_training.sync_service import SyncService
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node.event_callback import (
-    JobFailureAccountingCallback,
     RendezvousMembershipCallback,
     TaskRescheduleCallback,
 )
@@ -68,14 +67,12 @@ class DistributedJobMaster:
             worker_resource=worker_resource,
             heartbeat_timeout=heartbeat_timeout,
         )
-        self.failure_accounting = JobFailureAccountingCallback()
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)
         )
         self.job_manager.add_node_event_callback(
             RendezvousMembershipCallback(self.rdzv_managers)
         )
-        self.job_manager.add_node_event_callback(self.failure_accounting)
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.elastic_ps_service = ElasticPsService()

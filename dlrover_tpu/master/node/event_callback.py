@@ -69,16 +69,3 @@ class RendezvousMembershipCallback(NodeEventCallback):
             mgr.remove_alive_node(node.rank_index)
 
 
-class JobFailureAccountingCallback(NodeEventCallback):
-    """Track job-level exit accounting (which nodes failed, why) for the
-    master's early-stop and final-status decisions."""
-
-    def __init__(self):
-        self.failed_nodes: dict = {}
-        self.succeeded_nodes: set = set()
-
-    def on_node_failed(self, node: Node) -> None:
-        self.failed_nodes[node.name] = node.exit_reason or "unknown"
-
-    def on_node_succeeded(self, node: Node) -> None:
-        self.succeeded_nodes.add(node.name)

@@ -329,6 +329,11 @@ class MasterServicer:
                     error_data=message.error_data,
                     level=message.level,
                 )
+            if self._task_manager is not None:
+                # An in-place process restart (node still alive) loses the
+                # dead process's in-flight shards either way — recover them
+                # now instead of waiting out the task timeout.
+                self._task_manager.recover_tasks(message.node_id)
             return None
         if isinstance(message, comm.HeartBeat):
             action = ""

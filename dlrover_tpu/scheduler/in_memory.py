@@ -142,4 +142,6 @@ class InMemoryNodeWatcher(NodeWatcher):
         return events
 
     def list(self) -> List[Node]:
-        return list(self._cluster.nodes.values())
+        # snapshots, like _emit: consumers must never share the cluster's
+        # mutable node objects
+        return [copy.copy(n) for n in self._cluster.nodes.values()]

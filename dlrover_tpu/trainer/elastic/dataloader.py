@@ -1,0 +1,99 @@
+"""ElasticDataLoader — batched loader over an index source.
+
+Counterpart of the reference's ``ElasticDataLoader``
+(reference: dlrover/trainer/torch/elastic/dataloader.py:26-147): batches a
+dataset by indices from either an :class:`ElasticDistributedSampler`
+(local sharding) or an
+:class:`~dlrover_tpu.agent.sharding.client.IndexShardingClient` (master
+sharding with failure recovery), and picks up runtime batch-size changes
+from the master's mutable parallel-config file (the auto-tuning loop,
+reference: dataloader.py:70-117).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Iterator, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import ConfigPath
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def _default_collate(samples: List[Any]):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {
+            k: np.stack([np.asarray(s[k]) for s in samples]) for k in first
+        }
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class ElasticDataLoader:
+    """``dataset`` is any indexable (``dataset[i]`` -> sample)."""
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        sampler: Any = None,
+        sharding_client: Any = None,
+        collate_fn: Optional[Callable] = None,
+        drop_last: bool = True,
+        config_file: Optional[str] = None,
+    ):
+        if (sampler is None) == (sharding_client is None):
+            raise ValueError(
+                "provide exactly one of sampler / sharding_client"
+            )
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.sharding_client = sharding_client
+        self.collate_fn = collate_fn or _default_collate
+        self.drop_last = drop_last
+        self._config_file = config_file or os.getenv(
+            ConfigPath.ENV_PARAL_CONFIG, ""
+        )
+
+    # -- dynamic config (master-tunable batch size) -----------------------
+    def load_config(self) -> None:
+        if not self._config_file or not os.path.exists(self._config_file):
+            return
+        try:
+            with open(self._config_file) as f:
+                config = json.load(f)
+            dl_conf = config.get("dataloader", {})
+            new_bs = int(dl_conf.get("batch_size", 0))
+            if new_bs > 0 and new_bs != self.batch_size:
+                logger.info(
+                    "Dataloader batch size %s -> %s (paral config)",
+                    self.batch_size, new_bs,
+                )
+                self.batch_size = new_bs
+        except (ValueError, OSError) as e:
+            logger.warning("paral config read failed: %s", e)
+
+    # -- iteration --------------------------------------------------------
+    def _index_stream(self) -> Iterator[int]:
+        if self.sampler is not None:
+            yield from iter(self.sampler)
+        else:
+            while True:
+                idx = self.sharding_client.fetch_sample_index()
+                if idx is None:
+                    return
+                yield idx
+
+    def __iter__(self):
+        self.load_config()
+        batch: List[Any] = []
+        for idx in self._index_stream():
+            batch.append(self.dataset[idx])
+            if len(batch) >= self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)

@@ -1,0 +1,145 @@
+"""End-to-end elastic Llama pretraining example.
+
+Launch (standalone, spawns a local master):
+
+    dlrover-tpu-run --nnodes=1 python examples/train_llama.py \
+        --steps 50 --ckpt-dir /tmp/ckpt
+
+Everything the framework offers in one script (the counterpart of the
+reference's examples/pytorch/mnist + llama2 examples):
+
+- ``init_distributed()``: env contract -> jax.distributed;
+- master-driven data sharding (``IndexShardingClient``): a dead worker's
+  unconsumed shards are re-dispatched by the master;
+- ``ElasticTrainer``: mesh for the current world, fixed global batch via
+  grad accumulation, flash-checkpoint restore on (re)start;
+- flash checkpoint cadence: shm every step, async disk persist;
+- global-step reports feeding the master's SpeedMonitor.
+
+Chaos knob: ``DLROVER_CRASH_AT_STEP`` makes the worker kill itself once at
+that step — the elastic agent restarts it and training resumes from the
+in-memory checkpoint (what the reference's chaosblade experiments verify,
+reference: docs/tech_report/fault_tolerance_exps.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def synth_tokens(index: int, seq_len: int, vocab: int) -> np.ndarray:
+    """Deterministic synthetic sample: the data a shard index denotes is
+    identical across restarts and world sizes."""
+    rng = np.random.RandomState(7 + index)
+    return rng.randint(0, vocab, size=(seq_len,)).astype(np.int32)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--micro-batch", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--ckpt-dir", default="/tmp/dlrover_tpu_example_ckpt")
+    p.add_argument("--out-file", default="")
+    p.add_argument("--save-storage-interval", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding.client import IndexShardingClient
+    from dlrover_tpu.common.constants import NodeEnv
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+    from dlrover_tpu.trainer.elastic.distributed import init_distributed
+    from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer
+
+    env = init_distributed()
+    cfg = LlamaConfig.tiny(max_seq_len=args.seq_len)
+    model = LlamaModel(cfg)
+
+    trainer = ElasticTrainer(
+        model,
+        global_batch_size=args.global_batch,
+        micro_batch_per_shard=args.micro_batch,
+        seq_len=args.seq_len,
+        checkpoint_dir=args.ckpt_dir,
+        save_memory_interval=1,
+        save_storage_interval=args.save_storage_interval,
+    )
+    trainer.prepare(devices=jax.devices())
+    start_step = trainer.restore_or_init(jax.random.PRNGKey(0))
+    print(f"[train] starting from step {start_step}", flush=True)
+
+    master_addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+    client = sharding = None
+    if master_addr:
+        client = MasterClient(
+            master_addr, node_id=env.node_rank, node_type="worker"
+        )
+        dataset_size = args.steps * args.global_batch
+        sharding = IndexShardingClient(
+            client,
+            dataset_name="synth",
+            batch_size=args.global_batch,
+            num_epochs=1,
+            # only the first boot creates the dataset; restarts re-attach
+            dataset_size=dataset_size if start_step == 0 else 0,
+            num_minibatches_per_shard=1,
+        )
+
+    crash_at = int(os.getenv("DLROVER_CRASH_AT_STEP", "0"))
+    losses = []
+    step = start_step
+    while step < args.steps:
+        if sharding is not None:
+            indices = sharding.fetch_batch_indices(args.global_batch)
+            if not indices:
+                print("[train] dataset exhausted", flush=True)
+                break
+        else:
+            base = step * args.global_batch
+            indices = list(range(base, base + args.global_batch))
+        batch = np.stack(
+            [synth_tokens(i, args.seq_len, cfg.vocab_size) for i in indices]
+        )
+        metrics = trainer.train_step(batch)
+        step = trainer.step
+        loss = float(metrics["loss"])
+        losses.append((step, loss))
+        trainer.maybe_save()
+        if sharding is not None:
+            # ack AFTER the step + checkpoint: a crash in between makes
+            # the master re-dispatch the shard instead of skipping it
+            sharding.report_batch_done(len(indices))
+        if client is not None:
+            try:
+                client.report_global_step(step, time.time())
+            except Exception:
+                pass  # a local master may exit once the dataset completes
+        if crash_at and step == crash_at and start_step == 0:
+            print(f"[train] simulated crash at step {step}", flush=True)
+            os._exit(23)
+
+    if args.out_file:
+        with open(args.out_file, "w") as f:
+            json.dump(
+                {
+                    "start_step": start_step,
+                    "final_step": step,
+                    "losses": losses,
+                },
+                f,
+            )
+    print(f"[train] done at step {step}", flush=True)
+    trainer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

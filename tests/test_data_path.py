@@ -1,0 +1,162 @@
+"""Worker data path: sharding client, elastic sampler/dataloader, and the
+end-to-end example (launcher + master sharding + flash-ckpt resume after a
+mid-run worker kill) — reference test models:
+dlrover/python/tests/test_sharding_client.py and
+dlrover/trainer/tests/torch/elastic_sampler_test.py."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.sharding.client import (
+    IndexShardingClient,
+    ShardingClient,
+)
+from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
+from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- sampler
+def test_sampler_deals_indices_across_replicas():
+    s0 = ElasticDistributedSampler(10, num_replicas=2, rank=0, shuffle=False)
+    s1 = ElasticDistributedSampler(10, num_replicas=2, rank=1, shuffle=False)
+    assert list(s0) == [0, 2, 4, 6, 8]
+    assert list(s1) == [1, 3, 5, 7, 9]
+
+
+def test_sampler_state_resume_across_world_change():
+    """Mid-epoch state resumes on a different replica count without
+    repeating or losing samples (reference: sampler.py:118-140)."""
+    s = ElasticDistributedSampler(12, num_replicas=2, rank=0, shuffle=False)
+    s.record_batch_done(6)  # 3 global batches of 2 consumed
+    state = s.state_dict()
+
+    resumed = [
+        ElasticDistributedSampler(12, num_replicas=3, rank=r, shuffle=False)
+        for r in range(3)
+    ]
+    for r in resumed:
+        r.load_state_dict(state)
+    remaining = sorted(i for r in resumed for i in r)
+    assert remaining == [6, 7, 8, 9, 10, 11]
+
+
+def test_sampler_shuffle_is_deterministic_per_epoch():
+    a = ElasticDistributedSampler(32, num_replicas=1, rank=0, seed=5)
+    b = ElasticDistributedSampler(32, num_replicas=1, rank=0, seed=5)
+    a.set_epoch(2), b.set_epoch(2)
+    assert list(a) == list(b)
+    b.set_epoch(3)
+    assert list(a) != list(b)
+
+
+def test_dataloader_with_sampler_batches():
+    data = [{"x": np.array([i, i + 1])} for i in range(8)]
+    sampler = ElasticDistributedSampler(8, 1, 0, shuffle=False)
+    dl = ElasticDataLoader(data, batch_size=4, sampler=sampler)
+    batches = list(dl)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0]["x"][:, 0], [0, 1, 2, 3])
+
+
+# -------------------------------------------------------- sharding client
+def test_sharding_client_consumes_and_acks(local_master):
+    master, addr = local_master
+    client = MasterClient(addr, node_id=0, node_type="worker")
+    sc = ShardingClient(
+        client, "ds1", batch_size=2, dataset_size=8,
+        num_minibatches_per_shard=1,
+    )
+    seen = []
+    while True:
+        shard = sc.fetch_shard(timeout=10)
+        if shard is None:
+            break
+        seen.append((shard.start, shard.end))
+        sc.report_shard_done()
+    assert seen == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    assert master.task_manager.finished()
+    client.close()
+
+
+def test_index_sharding_client_recovers_after_failure(local_master):
+    """Indices prefetched but unconsumed at death are re-dispatched after
+    the failure report (the local-master recovery path)."""
+    master, addr = local_master
+    c0 = MasterClient(addr, node_id=0, node_type="worker")
+    sc = IndexShardingClient(
+        c0, "ds2", batch_size=2, dataset_size=12,
+        num_minibatches_per_shard=1, prefetch_shards=1,
+    )
+    got = [sc.fetch_sample_index(timeout=10) for _ in range(4)]
+    assert got == [0, 1, 2, 3]
+    sc.report_batch_done(2)  # only the first shard's samples were trained
+    # worker 0 "dies": in-flight (fetched, unacked) shards recovered
+    time.sleep(0.3)  # let prefetch pull ahead
+    c0.report_failure("killed", level="node", node_rank=0)
+    sc.close()
+
+    c1 = MasterClient(addr, node_id=1, node_type="worker")
+    sc1 = IndexShardingClient(
+        c1, "ds2", batch_size=2, dataset_size=0,
+        num_minibatches_per_shard=1,
+    )
+    rest = []
+    while True:
+        idx = sc1.fetch_sample_index(timeout=10)
+        if idx is None:
+            break
+        rest.append(idx)
+        sc1.report_batch_done(1)
+    # everything not ACKED by worker 0 arrives again (2,3 were dequeued
+    # but never trained on => re-dispatched): nothing is lost
+    assert set(rest) == set(range(2, 12))
+    assert master.task_manager.finished()
+    sc1.close()
+    c0.close()
+    c1.close()
+
+
+# ------------------------------------------------------------------- e2e
+def test_example_crash_resume_e2e(tmp_path):
+    """The full story: dlrover-tpu-run launches the example; the worker is
+    killed mid-run; the agent restarts it; it resumes from the in-memory
+    checkpoint and the master re-dispatches lost shards (VERDICT item 5)."""
+    out = tmp_path / "result.json"
+    ckpt = tmp_path / "ckpt"
+    env = dict(os.environ)
+    env.update(
+        {
+            "DLROVER_JOB_UID": uuid.uuid4().hex[:8],
+            "DLROVER_CRASH_AT_STEP": "3",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dlrover_tpu.agent.launcher",
+            "--nnodes=1", "--monitor-interval", "0.3",
+            sys.executable, os.path.join(REPO, "examples", "train_llama.py"),
+            "--steps", "8", "--global-batch", "8", "--seq-len", "64",
+            "--ckpt-dir", str(ckpt), "--out-file", str(out),
+            "--save-storage-interval", "5",
+        ],
+        env=env,
+        capture_output=True,
+        timeout=560,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-3000:]
+    result = json.loads(out.read_text())
+    assert result["start_step"] == 3, result  # resumed from memory
+    assert result["final_step"] == 8, result
+    # async disk persistence produced committed checkpoints
+    assert any(p.name.startswith("step-") for p in ckpt.iterdir())
