@@ -145,9 +145,20 @@ class LocalWorkerGroup:
         port = spec.coordinator_port + (rdzv.round % 16)
         coordinator = f"{coordinator_ip}:{port}"
 
+        # Workers must be able to import the framework even when it is run
+        # from a source checkout (script entrypoints don't inherit the
+        # agent's sys.path the way `-m` module entrypoints do).
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
         for local_rank in range(spec.nproc_per_node):
             env = dict(base_env)
             env.update(spec.env or {})
+            prev = env.get("PYTHONPATH", "")
+            if pkg_root not in prev.split(os.pathsep):
+                env["PYTHONPATH"] = (
+                    pkg_root + (os.pathsep + prev if prev else "")
+                )
             env[NodeEnv.NODE_RANK] = str(node_rank)
             env[NodeEnv.NODE_NUM] = str(len(ranks))
             env[NodeEnv.COORDINATOR_ADDR] = coordinator
