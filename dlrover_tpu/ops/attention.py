@@ -68,6 +68,41 @@ def _xla_attention(
     return out.astype(q.dtype)
 
 
+_warned_probe = False
+
+
+def _warn_probe_once(what: str, exc: Exception) -> None:
+    global _warned_probe
+    if not _warned_probe:
+        _warned_probe = True
+        logger.warning(
+            "%s probe failed (%s: %s) — Ulysses sp dispatch degraded; "
+            "jax internals may have moved", what, type(exc).__name__, exc,
+        )
+
+
+def _under_named_axes() -> bool:
+    """True when tracing inside shard_map/pmap (named mesh axes bound)."""
+    try:
+        from jax._src import core
+
+        return bool(core.get_axis_env().axis_sizes)
+    except Exception as e:  # private API — may move across jax versions
+        _warn_probe_once("axis-env", e)
+        return False
+
+
+def _ambient_mesh():
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception as e:  # private API — may move across jax versions
+        _warn_probe_once("ambient-mesh", e)
+        return None
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -77,13 +112,43 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
+    sp_ulysses: Optional[bool] = None,
 ) -> jax.Array:
     """Multi-head attention with GQA; dispatches to the Pallas TPU kernel
     when running on TPU (and shapes are kernel-friendly), else pure XLA.
 
+    When the ambient mesh has an ``sp`` axis of size > 1 (and we are not
+    already inside a shard_map), the computation routes through
+    :func:`ulysses_attention` — the explicit seq<->heads all-to-all
+    re-partition of the reference's ``_SeqAllToAll`` (reference:
+    atorch/atorch/distributed/distributed.py:474-501) — so each sp peer
+    attends over the full sequence with a head slice.  ``sp_ulysses=False``
+    forces plain GSPMD semantics.
+
     q: [batch, q_seq, q_heads, head_dim]
     k, v: [batch, kv_seq, kv_heads, head_dim]
     """
+    if sp_ulysses is not False and not _under_named_axes():
+        mesh = _ambient_mesh()
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            ok = _ulysses_divisible(q, k, mesh)
+            if ok:
+                return ulysses_attention(
+                    q,
+                    k,
+                    v,
+                    mesh=mesh,
+                    causal=causal,
+                    segment_ids=segment_ids,
+                    scale=scale,
+                    use_pallas=use_pallas,
+                )
+            if sp_ulysses:
+                raise ValueError(
+                    "sp_ulysses requested but head counts are not divisible "
+                    f"by sp*tp: q heads {q.shape[2]}, kv heads {k.shape[2]}, "
+                    f"mesh {dict(mesh.shape)}"
+                )
     if use_pallas is None:
         import os
 
@@ -150,3 +215,109 @@ def heads_to_seq_all_to_all(x: jax.Array, axis_name: str = "sp") -> jax.Array:
     """Inverse of :func:`seq_to_heads_all_to_all`:
     [b, seq, H/P, d] -> [b, seq/P, H, d]."""
     return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _axes_size(mesh, entry) -> int:
+    """Product of mesh-axis sizes named by a PartitionSpec entry."""
+    if entry is None:
+        return 1
+    if isinstance(entry, str):
+        entry = (entry,)
+    size = 1
+    for a in entry:
+        size *= mesh.shape.get(a, 1)
+    return size
+
+
+def _attention_specs(mesh, rules=None):
+    """(q_spec, kv_spec, seg_spec) rank-padded PartitionSpecs for the
+    Ulysses shard_map, derived from the active logical rules so they agree
+    with the model's activation constraints."""
+    from jax.sharding import PartitionSpec
+
+    from dlrover_tpu.accel.parallel.mesh import logical_to_spec
+
+    def pad(spec, rank):
+        entries = list(spec) + [None] * (rank - len(spec))
+        return PartitionSpec(*entries)
+
+    q_spec = pad(logical_to_spec(("batch", "seq", "heads", "head_dim"), rules), 4)
+    kv_spec = pad(
+        logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), rules), 4
+    )
+    seg_spec = pad(logical_to_spec(("batch", "seq"), rules), 2)
+    return q_spec, kv_spec, seg_spec
+
+
+def _ulysses_divisible(q: jax.Array, k: jax.Array, mesh, rules=None) -> bool:
+    """Head counts must split across sp after any tp head sharding."""
+    sp = mesh.shape.get("sp", 1)
+    q_spec, kv_spec, _ = _attention_specs(mesh, rules)
+    q_heads_local = q.shape[2] // max(1, _axes_size(mesh, q_spec[2]))
+    kv_heads_local = k.shape[2] // max(1, _axes_size(mesh, kv_spec[2]))
+    seq_ok = q.shape[1] % sp == 0 and k.shape[1] % sp == 0
+    return seq_ok and q_heads_local % sp == 0 and kv_heads_local % sp == 0
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+    rules=None,
+) -> jax.Array:
+    """Sequence-parallel attention via explicit seq<->heads all-to-all.
+
+    The TPU-native ``_SeqAllToAll`` (reference:
+    atorch/atorch/distributed/distributed.py:474-501 and its opt-lib wiring
+    auto/opt_lib/sequence_parallel_optimization.py:9-51): under shard_map
+    over the mesh, each ``sp`` peer trades its head slice for the full
+    sequence, runs ordinary (flash) attention over full-seq x heads/P, and
+    trades back.  Collectives ride ICI as three all-to-alls instead of the
+    all-gather + reduce-scatter GSPMD would insert for seq-sharded softmax.
+
+    Arguments are *global* arrays; returns the global [b, sq, hq, d] output
+    partitioned like the input.
+    """
+    q_spec, kv_spec, seg_spec = _attention_specs(mesh, rules)
+
+    def inner(q, k, v, seg):
+        q = seq_to_heads_all_to_all(q)
+        k = seq_to_heads_all_to_all(k)
+        v = seq_to_heads_all_to_all(v)
+        if seg is not None:
+            seg = jax.lax.all_gather(seg, "sp", axis=1, tiled=True)
+        out = dot_product_attention(
+            q,
+            k,
+            v,
+            causal=causal,
+            segment_ids=seg,
+            scale=scale,
+            use_pallas=use_pallas,
+            sp_ulysses=False,
+        )
+        return heads_to_seq_all_to_all(out)
+
+    if segment_ids is None:
+        sm = jax.shard_map(
+            lambda q, k, v: inner(q, k, v, None),
+            mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec),
+            out_specs=q_spec,
+            check_vma=False,
+        )
+        return sm(q, k, v)
+    sm = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return sm(q, k, v, segment_ids)

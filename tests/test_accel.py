@@ -250,3 +250,90 @@ def test_chunked_loss_mask_shift_matches_plain():
     l2, a2 = default_loss_fn(model, loss_chunk_size=8)(params, batch)
     assert float(a1["weight"]) == float(a2["weight"])
     assert abs(float(l1) - float(l2)) < 2e-3
+
+
+def test_ulysses_attention_numerics():
+    """Explicit seq<->heads all-to-all path must match plain attention
+    exactly (reference _SeqAllToAll, atorch distributed.py:474-501)."""
+    from dlrover_tpu.ops.attention import (
+        _xla_attention,
+        ulysses_attention,
+    )
+
+    mesh = MeshSpec(dp=2, sp=2, tp=2).build_mesh()
+    b, s, hq, hkv, d = 4, 32, 8, 4, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
+    seg = jnp.concatenate(
+        [jnp.zeros((b, s // 2), jnp.int32), jnp.ones((b, s // 2), jnp.int32)],
+        axis=1,
+    )
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=seg, scale=None)
+
+    @jax.jit
+    def run(q, k, v, seg):
+        return ulysses_attention(
+            q, k, v, mesh=mesh, causal=True, segment_ids=seg
+        )
+
+    with mesh:
+        out = run(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    # no segment ids path
+    ref2 = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+
+    @jax.jit
+    def run2(q, k, v):
+        return ulysses_attention(q, k, v, mesh=mesh, causal=True)
+
+    with mesh:
+        out2 = run2(q, k, v)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2), atol=1e-5)
+
+
+def test_train_step_sp_ulysses_parity():
+    """sp=2 (Ulysses all-to-all engaged via mesh dispatch) must match the
+    sp=1 loss trajectory on identical data."""
+    cfg = LlamaConfig.tiny(num_heads=8, num_kv_heads=4)
+    model = LlamaModel(cfg)
+    res_sp = accelerate(
+        model,
+        config=AccelerateConfig(mesh_spec=MeshSpec(dp=2, sp=2, tp=2)),
+        batch_shape=(8, 32),
+    )
+    res_base = accelerate(
+        model,
+        config=AccelerateConfig(mesh_spec=MeshSpec(dp=8)),
+        batch_shape=(8, 32),
+    )
+    state_sp = res_sp.init_fn(jax.random.PRNGKey(0))
+    state_base = res_base.init_fn(jax.random.PRNGKey(0))
+    batch = _make_batch(jax.random.PRNGKey(1), 8, 32, cfg.vocab_size)
+
+    # The Ulysses path must actually engage — a silent fallback to GSPMD
+    # would also pass the loss-parity assertion below.
+    import dlrover_tpu.ops.attention as attn_mod
+
+    calls = {"n": 0}
+    real = attn_mod.ulysses_attention
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    attn_mod.ulysses_attention = spy
+    try:
+        state_sp, _ = res_sp.train_step(state_sp, batch)
+    finally:
+        attn_mod.ulysses_attention = real
+    assert calls["n"] > 0, "Ulysses dispatch did not engage under sp=2"
+    state_base, _ = res_base.train_step(state_base, batch)
+
+    for _ in range(2):
+        state_sp, m_sp = res_sp.train_step(state_sp, batch)
+        state_base, m_base = res_base.train_step(state_base, batch)
+        assert np.isclose(
+            float(m_sp["loss"]), float(m_base["loss"]), rtol=2e-3
+        ), (float(m_sp["loss"]), float(m_base["loss"]))
