@@ -157,6 +157,57 @@ def _tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
 
 
+def _expand_and_repair_sharding(sharding_tree, abstract_tree, mesh):
+    """Expand the prefix sharding tree to a full per-leaf tree, dropping
+    spec entries that don't apply to a leaf.
+
+    flax derives opt-state shardings by prefix: the param's spec lands on
+    the whole opt-state subtree at that position.  Optimizer states whose
+    leaves do NOT mirror the param geometry (e.g. quantized-state scale
+    tensors with a shrunken last dim, scalar placeholders) would get an
+    invalid annotation.  For every leaf, keep the param's spec entries
+    where the dimension exists and divides evenly; replace the rest with
+    replication.
+    """
+
+    def is_shard(x):
+        import jax.sharding as js
+
+        return x is None or isinstance(x, js.Sharding)
+
+    def axes_size(entry) -> int:
+        if entry is None:
+            return 1
+        if isinstance(entry, str):
+            entry = (entry,)
+        size = 1
+        for a in entry:
+            size *= mesh.shape.get(a, 1)
+        return size
+
+    def fix(sh, subtree):
+        if sh is None:
+            # "unconstrained" applies to the whole subtree by prefix; keep
+            # the single None (expanding would collapse pytree structure)
+            return None
+
+        def per_leaf(leaf):
+            entries = list(sh.spec)[: len(leaf.shape)]
+            out = [
+                e
+                if e is not None and leaf.shape[i] % axes_size(e) == 0
+                else None
+                for i, e in enumerate(entries)
+            ]
+            return NamedSharding(mesh, PartitionSpec(*out))
+
+        return jax.tree_util.tree_map(per_leaf, subtree)
+
+    return jax.tree_util.tree_map(
+        fix, sharding_tree, abstract_tree, is_leaf=is_shard
+    )
+
+
 def accelerate(
     model: nn.Module,
     *,
@@ -200,6 +251,11 @@ def accelerate(
     state_sharding = nn.logical_to_mesh_sharding(
         logical_specs, mesh, list(config.logical_rules)
     )
+    # expand against the UNBOXED abstract tree — the runtime state is
+    # unboxed, so the sharding tree must not contain Partitioned nodes
+    state_sharding = _expand_and_repair_sharding(
+        state_sharding, nn.unbox(abstract_state), mesh
+    )
 
     micro_spec = logical_to_spec(("batch", "seq"), config.logical_rules)
     if config.grad_accum_steps > 1:
@@ -208,15 +264,16 @@ def accelerate(
         data_spec = micro_spec
     batch_sharding = NamedSharding(mesh, data_spec)
 
-    jit_init = jax.jit(init_state, out_shardings=state_sharding)
+    # unbox INSIDE the jitted init so its output structure matches the
+    # expanded per-leaf sharding tree (the training loop works on plain
+    # arrays; the logical-axis metadata lives in abstract_state)
+    jit_init = jax.jit(
+        lambda rng: nn.unbox(init_state(rng)), out_shardings=state_sharding
+    )
 
     def init_fn(rng: jax.Array) -> TrainState:
         with rules_ctx(), mesh:
-            state = jit_init(rng)
-        # init returns flax Partitioned boxes (logical-axis metadata); the
-        # training loop works on plain arrays.  The sharding tree from
-        # nn.get_partition_spec applies to both (prefix-pytree semantics).
-        return nn.unbox(state)
+            return jit_init(rng)
 
     # ---------------- train step ----------------
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):

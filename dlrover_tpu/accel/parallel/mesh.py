@@ -232,6 +232,35 @@ def named_sharding(
     return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
 
 
+_warned_mesh_probe = False
+
+
+def ambient_mesh() -> Optional[Mesh]:
+    """The mesh of the enclosing ``with mesh:`` context, or None.
+
+    Single home for the private-API probe (jax may move
+    ``thread_resources`` across versions; a failure logs once and degrades
+    to None — callers fall back to mesh-less behavior).
+    """
+    global _warned_mesh_probe
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception as e:
+        if not _warned_mesh_probe:
+            _warned_mesh_probe = True
+            import logging
+
+            logging.getLogger("dlrover_tpu").warning(
+                "ambient-mesh probe failed (%s: %s) — sharding constraints "
+                "and Ulysses sp dispatch degraded; jax internals may have "
+                "moved", type(e).__name__, e,
+            )
+        return None
+
+
 def with_logical_constraint(
     x: jax.Array,
     logical_axes: Sequence[Optional[str]],
@@ -243,18 +272,13 @@ def with_logical_constraint(
     """
     if rules is None:
         rules = _ACTIVE_RULES
-    try:
-        from jax._src.mesh import thread_resources
-
-        physical_mesh = thread_resources.env.physical_mesh
-        if physical_mesh.empty:
-            return x
-        spec = logical_to_spec(logical_axes, rules)
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(physical_mesh, spec)
-        )
-    except (ImportError, AttributeError):
+    physical_mesh = ambient_mesh()
+    if physical_mesh is None:
         return x
+    spec = logical_to_spec(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(physical_mesh, spec)
+    )
 
 
 def batch_spec(rules: Optional[Sequence[Tuple[str, Any]]] = None) -> PartitionSpec:

@@ -71,36 +71,22 @@ def _xla_attention(
 _warned_probe = False
 
 
-def _warn_probe_once(what: str, exc: Exception) -> None:
-    global _warned_probe
-    if not _warned_probe:
-        _warned_probe = True
-        logger.warning(
-            "%s probe failed (%s: %s) — Ulysses sp dispatch degraded; "
-            "jax internals may have moved", what, type(exc).__name__, exc,
-        )
-
-
 def _under_named_axes() -> bool:
     """True when tracing inside shard_map/pmap (named mesh axes bound)."""
+    global _warned_probe
     try:
         from jax._src import core
 
         return bool(core.get_axis_env().axis_sizes)
     except Exception as e:  # private API — may move across jax versions
-        _warn_probe_once("axis-env", e)
+        if not _warned_probe:
+            _warned_probe = True
+            logger.warning(
+                "axis-env probe failed (%s: %s) — Ulysses sp dispatch "
+                "degraded; jax internals may have moved",
+                type(e).__name__, e,
+            )
         return False
-
-
-def _ambient_mesh():
-    try:
-        from jax._src.mesh import thread_resources
-
-        mesh = thread_resources.env.physical_mesh
-        return None if mesh.empty else mesh
-    except Exception as e:  # private API — may move across jax versions
-        _warn_probe_once("ambient-mesh", e)
-        return None
 
 
 def dot_product_attention(
@@ -129,9 +115,11 @@ def dot_product_attention(
     k, v: [batch, kv_seq, kv_heads, head_dim]
     """
     if sp_ulysses is not False and not _under_named_axes():
-        mesh = _ambient_mesh()
+        from dlrover_tpu.accel.parallel.mesh import ambient_mesh
+
+        mesh = ambient_mesh()
         if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            ok = _ulysses_divisible(q, k, mesh)
+            ok = _ulysses_applicable(q, k, mesh)
             if ok:
                 return ulysses_attention(
                     q,
@@ -145,10 +133,22 @@ def dot_product_attention(
                 )
             if sp_ulysses:
                 raise ValueError(
-                    "sp_ulysses requested but head counts are not divisible "
-                    f"by sp*tp: q heads {q.shape[2]}, kv heads {k.shape[2]}, "
-                    f"mesh {dict(mesh.shape)}"
+                    "sp_ulysses requested but not applicable: either head "
+                    "counts are not divisible by sp after tp head sharding "
+                    f"(q heads {q.shape[2]}, kv heads {k.shape[2]}, mesh "
+                    f"{dict(mesh.shape)}), or the active logical rules do "
+                    "not shard the seq axis over 'sp'"
                 )
+        elif sp_ulysses:
+            raise ValueError(
+                "sp_ulysses requested but no ambient mesh with an sp axis "
+                "of size > 1 is active (wrap the call in `with mesh:`)"
+            )
+    elif sp_ulysses and _under_named_axes():
+        raise ValueError(
+            "sp_ulysses requested inside shard_map/pmap — the Ulysses "
+            "dispatch only applies to global (unmapped) arrays"
+        )
     if use_pallas is None:
         import os
 
@@ -249,10 +249,23 @@ def _attention_specs(mesh, rules=None):
     return q_spec, kv_spec, seg_spec
 
 
-def _ulysses_divisible(q: jax.Array, k: jax.Array, mesh, rules=None) -> bool:
-    """Head counts must split across sp after any tp head sharding."""
+def _spec_uses(entry, axis: str) -> bool:
+    if entry is None:
+        return False
+    if isinstance(entry, str):
+        return entry == axis
+    return axis in entry
+
+
+def _ulysses_applicable(q: jax.Array, k: jax.Array, mesh, rules=None) -> bool:
+    """The active rules must shard seq over sp, and head counts must split
+    across sp after any tp head sharding.  If seq is NOT sp-sharded (custom
+    rules), the all-to-all would concatenate replicated copies into a bogus
+    doubled sequence — GSPMD semantics are the correct path there."""
     sp = mesh.shape.get("sp", 1)
     q_spec, kv_spec, _ = _attention_specs(mesh, rules)
+    if not (_spec_uses(q_spec[1], "sp") and _spec_uses(kv_spec[1], "sp")):
+        return False
     q_heads_local = q.shape[2] // max(1, _axes_size(mesh, q_spec[2]))
     kv_heads_local = k.shape[2] // max(1, _axes_size(mesh, kv_spec[2]))
     seq_ok = q.shape[1] % sp == 0 and k.shape[1] % sp == 0

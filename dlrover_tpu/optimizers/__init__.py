@@ -1,0 +1,41 @@
+"""TPU-native optimizers (parity with reference atorch/atorch/optimizers/):
+
+- :func:`agd` — stepwise-gradient-difference preconditioning (agd.py:18)
+- WSAM two-pass sharpness-aware step (wsam.py:11)
+- :func:`quantized_adamw` — int8 block-quantized moments (low_bit/optim/
+  q_optimizer.py:17)
+
+All are optax ``GradientTransformation``s / traceable step helpers, so they
+shard under GSPMD and compose with optax chains.
+"""
+
+from dlrover_tpu.optimizers.agd import AGDState, agd
+from dlrover_tpu.optimizers.low_bit import (
+    QAdamState,
+    QTensor,
+    dequantize_blockwise,
+    quantize_blockwise,
+    quantized_adamw,
+    state_nbytes,
+)
+from dlrover_tpu.optimizers.wsam import (
+    WSAMConfig,
+    apply_wsam_correction,
+    wsam_gradients,
+    wsam_step,
+)
+
+__all__ = [
+    "AGDState",
+    "agd",
+    "QAdamState",
+    "QTensor",
+    "quantize_blockwise",
+    "dequantize_blockwise",
+    "quantized_adamw",
+    "state_nbytes",
+    "WSAMConfig",
+    "wsam_gradients",
+    "apply_wsam_correction",
+    "wsam_step",
+]

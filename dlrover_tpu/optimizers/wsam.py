@@ -1,0 +1,144 @@
+"""Weighted Sharpness-Aware Minimization (WSAM, KDD'23), JAX-native.
+
+Parity target: reference atorch/atorch/optimizers/wsam.py:11
+(``WeightedSAM``), which wraps a torch optimizer and drives two
+forward/backward passes through a closure, all-reducing gradients by hand
+between them.  The TPU-native design is a *step transform*: given the
+user's grad fn, :func:`wsam_gradients` computes the ascent perturbation and
+the perturbed-point gradient inside one jitted step — DP gradient averaging
+is already handled by GSPMD, so no explicit collectives are needed.
+
+WSAM update (alpha = gamma / (1 - gamma)):
+    e_w  = rho * g(w) / ||g(w)||          (ascent to the local maximum)
+    g_s  = g(w + e_w)                      (sharpness gradient)
+    decoupled:   step with g(w), then p -= lr * alpha * (g_s - g(w))
+    coupled:     step with (1-alpha) * g(w) + alpha * g_s
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class WSAMConfig:
+    rho: float = 0.05
+    gamma: float = 0.9
+    sam_eps: float = 1e-12
+    adaptive: bool = False
+    decouple: bool = True
+    # The decoupled sharpness term is applied OUTSIDE the base optimizer
+    # with the base step size (the reference reads the live group lr each
+    # step, wsam.py:100-106).  Pass the same schedule the base optimizer
+    # uses (a callable of the step count) or a float for constant lr.
+    learning_rate: Union[float, Callable[[Any], Any]] = 1e-3
+
+    @property
+    def alpha(self) -> float:
+        return self.gamma / (1.0 - self.gamma)
+
+
+def perturbation(params, grads, cfg: WSAMConfig):
+    """The ascent step e_w = rho * g / ||g|| (adaptive: elementwise |p|-scaled)."""
+    if cfg.adaptive:
+        scaled = jax.tree_util.tree_map(
+            lambda p, g: jnp.abs(p) * g, params, grads
+        )
+    else:
+        scaled = grads
+    gnorm = optax.global_norm(scaled)
+    scale = cfg.rho / (gnorm + cfg.sam_eps)
+    if cfg.adaptive:
+        return jax.tree_util.tree_map(
+            lambda p, g: jnp.square(p) * g * scale, params, grads
+        )
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def wsam_gradients(
+    grad_fn: Callable[[Any], Tuple[Any, Any]],
+    params,
+    cfg: WSAMConfig,
+):
+    """Two-pass WSAM gradients inside one traceable step.
+
+    ``grad_fn(params) -> (aux, grads)`` — e.g. from
+    ``jax.value_and_grad(loss_fn, has_aux=True)`` partially applied to the
+    batch.  Returns ``(aux, base_grads, final_grads, sharpness)`` where
+    ``final_grads`` is what the base optimizer should consume and
+    ``sharpness`` is the decoupled correction term (zero pytree when
+    ``cfg.decouple`` is False).
+    """
+    aux, g_w = grad_fn(params)
+    e_w = perturbation(params, g_w, cfg)
+    perturbed = jax.tree_util.tree_map(jnp.add, params, e_w)
+    _, g_s = grad_fn(perturbed)
+    alpha = cfg.alpha
+    if cfg.decouple:
+        sharpness = jax.tree_util.tree_map(lambda a, b: a - b, g_s, g_w)
+        return aux, g_w, g_w, sharpness
+    mixed = jax.tree_util.tree_map(
+        lambda a, b: (1.0 - alpha) * a + alpha * b, g_w, g_s
+    )
+    zero = jax.tree_util.tree_map(jnp.zeros_like, g_w)
+    return aux, g_w, mixed, zero
+
+
+def apply_wsam_correction(params, sharpness, cfg: WSAMConfig, step=None):
+    """Decoupled sharpness regularization: p -= lr * alpha * sharpness.
+
+    ``step`` (the base optimizer's step count *before* this update) resolves
+    a schedule learning_rate so the correction tracks the base step size.
+    """
+    lr = cfg.learning_rate
+    if callable(lr):
+        if step is None:
+            raise ValueError(
+                "WSAMConfig.learning_rate is a schedule; pass the step count"
+            )
+        lr = lr(step)
+    scale = lr * cfg.alpha
+    return jax.tree_util.tree_map(
+        lambda p, s: (p.astype(jnp.float32) - scale * s).astype(p.dtype),
+        params,
+        sharpness,
+    )
+
+
+def wsam_step(
+    grad_fn: Callable[[Any], Tuple[Any, Any]],
+    params,
+    opt_state,
+    base_tx: optax.GradientTransformation,
+    cfg: Optional[WSAMConfig] = None,
+    step=None,
+):
+    """One full WSAM parameter update (the analogue of the reference's
+    ``WeightedSAM.step`` with its closure, wsam.py:108-121).
+
+    Returns ``(aux, new_params, new_opt_state)``.  Fully traceable: call it
+    inside a jitted train step.  When cfg.learning_rate is a schedule,
+    ``step`` defaults to the count found in ``opt_state`` (optax
+    ``ScaleByAdamState``-style trees expose one).
+    """
+    cfg = cfg or WSAMConfig()
+    aux, _, final_grads, sharpness = wsam_gradients(grad_fn, params, cfg)
+    if step is None and callable(cfg.learning_rate):
+        counts = [
+            getattr(s, "count")
+            for s in jax.tree_util.tree_leaves(
+                opt_state, is_leaf=lambda s: hasattr(s, "count")
+            )
+            if hasattr(s, "count")
+        ]
+        step = counts[0] if counts else None
+    updates, new_opt_state = base_tx.update(final_grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    if cfg.decouple:
+        new_params = apply_wsam_correction(new_params, sharpness, cfg, step)
+    return aux, new_params, new_opt_state
