@@ -95,13 +95,22 @@ def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
     never materialized.
     """
 
+    def _aux_losses(var_updates) -> jax.Array:
+        """Sum of sown per-layer MoE losses (load-balance + z-loss), zero
+        when the model has none."""
+        leaves = jax.tree_util.tree_leaves(var_updates.get("moe_losses", {}))
+        if not leaves:
+            return jnp.zeros((), jnp.float32)
+        return sum(jnp.sum(leaf) for leaf in leaves)
+
     def chunked_loss_fn(params, batch):
-        hidden = model.apply(
+        hidden, var_updates = model.apply(
             {"params": params},
             batch["input_ids"],
             positions=batch.get("positions"),
             segment_ids=batch.get("segment_ids"),
             return_hidden=True,
+            mutable=["moe_losses"],
         )
         if "lm_head" in params:
             kernel = params["lm_head"]["kernel"]
@@ -128,14 +137,15 @@ def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
         loss, weight = fused_lm_head_loss(
             hidden, kernel, labels, mask, chunk_size=loss_chunk_size
         )
-        return loss, {"weight": weight}
+        return loss + _aux_losses(var_updates), {"weight": weight}
 
     def loss_fn(params, batch):
-        logits = model.apply(
+        logits, var_updates = model.apply(
             {"params": params},
             batch["input_ids"],
             positions=batch.get("positions"),
             segment_ids=batch.get("segment_ids"),
+            mutable=["moe_losses"],
         )
         labels = batch.get("labels")
         if labels is None:
@@ -148,7 +158,7 @@ def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
         loss, weight = masked_language_model_loss(
             logits, labels, mask, return_weight=True
         )
-        return loss, {"weight": weight}
+        return loss + _aux_losses(var_updates), {"weight": weight}
 
     return chunked_loss_fn if loss_chunk_size else loss_fn
 
@@ -175,15 +185,10 @@ def _expand_and_repair_sharding(sharding_tree, abstract_tree, mesh):
 
         return x is None or isinstance(x, js.Sharding)
 
+    from dlrover_tpu.accel.parallel.mesh import axes_size as _mesh_axes_size
+
     def axes_size(entry) -> int:
-        if entry is None:
-            return 1
-        if isinstance(entry, str):
-            entry = (entry,)
-        size = 1
-        for a in entry:
-            size *= mesh.shape.get(a, 1)
-        return size
+        return _mesh_axes_size(mesh, entry)
 
     def fix(sh, subtree):
         if sh is None:
@@ -252,10 +257,15 @@ def accelerate(
         logical_specs, mesh, list(config.logical_rules)
     )
     # expand against the UNBOXED abstract tree — the runtime state is
-    # unboxed, so the sharding tree must not contain Partitioned nodes
+    # unboxed, so the sharding tree must not contain Partitioned nodes.
+    # Model params keep their exact (prefix) shardings: a non-divisible
+    # param dim should still fail loudly at jit time, not silently
+    # replicate; the repair is for opt-state leaves that don't mirror the
+    # param geometry (quantization scales, scalar placeholders).
+    param_sharding = state_sharding.params
     state_sharding = _expand_and_repair_sharding(
         state_sharding, nn.unbox(abstract_state), mesh
-    )
+    ).replace(params=param_sharding)
 
     micro_spec = logical_to_spec(("batch", "seq"), config.logical_rules)
     if config.grad_accum_steps > 1:

@@ -281,6 +281,19 @@ def with_logical_constraint(
     )
 
 
+def axes_size(mesh: Mesh, entry: Any) -> int:
+    """Product of mesh-axis sizes named by one PartitionSpec entry
+    (None -> 1, str -> that axis, tuple -> product)."""
+    if entry is None:
+        return 1
+    if isinstance(entry, str):
+        entry = (entry,)
+    size = 1
+    for a in entry:
+        size *= mesh.shape.get(a, 1)
+    return size
+
+
 def batch_spec(rules: Optional[Sequence[Tuple[str, Any]]] = None) -> PartitionSpec:
     """PartitionSpec for a ``[batch, seq, ...]`` input array."""
     return logical_to_spec(("batch", "seq"), rules)

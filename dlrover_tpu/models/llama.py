@@ -56,6 +56,13 @@ class LlamaConfig:
     # keeps matmul outputs (selective checkpointing).
     remat_policy: str = "nothing_saveable"
     tie_embeddings: bool = False
+    # MoE (0 = dense): experts shard over the ep mesh axis (reference:
+    # atorch/atorch/modules/moe/moe_layer.py)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
+    moe_z_loss_coef: float = 1e-3
 
     @property
     def head_dim_(self) -> int:
@@ -68,6 +75,8 @@ class LlamaConfig:
         d = self.head_dim_
         attn = h * d * (self.num_heads * 2 + self.num_kv_heads * 2)
         mlp = 3 * h * self.intermediate_size
+        if self.num_experts:
+            mlp = mlp * self.num_experts + h * self.num_experts  # + router
         per_layer = attn + mlp + 2 * h
         emb = v * h * (1 if self.tie_embeddings else 2)
         return self.num_layers * per_layer + emb + h
@@ -253,7 +262,24 @@ class DecoderLayer(nn.Module):
         x = x + Attention(cfg, name="attn")(h, positions, segment_ids)
         x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_norm")(x)
-        x = x + MLP(cfg, name="mlp")(h)
+        if cfg.num_experts:
+            from dlrover_tpu.models.moe import MoEMLP
+
+            mlp = MoEMLP(
+                hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                num_experts=cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                aux_loss_coef=cfg.moe_aux_loss_coef,
+                z_loss_coef=cfg.moe_z_loss_coef,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="mlp",
+            )
+        else:
+            mlp = MLP(cfg, name="mlp")
+        x = x + mlp(h)
         return with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
 
@@ -309,7 +335,7 @@ class LlamaModel(nn.Module):
                 )
             scan = nn.scan(
                 block,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "moe_losses": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

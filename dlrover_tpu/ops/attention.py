@@ -217,18 +217,6 @@ def heads_to_seq_all_to_all(x: jax.Array, axis_name: str = "sp") -> jax.Array:
     return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
-def _axes_size(mesh, entry) -> int:
-    """Product of mesh-axis sizes named by a PartitionSpec entry."""
-    if entry is None:
-        return 1
-    if isinstance(entry, str):
-        entry = (entry,)
-    size = 1
-    for a in entry:
-        size *= mesh.shape.get(a, 1)
-    return size
-
-
 def _attention_specs(mesh, rules=None):
     """(q_spec, kv_spec, seg_spec) rank-padded PartitionSpecs for the
     Ulysses shard_map, derived from the active logical rules so they agree
@@ -266,8 +254,10 @@ def _ulysses_applicable(q: jax.Array, k: jax.Array, mesh, rules=None) -> bool:
     q_spec, kv_spec, _ = _attention_specs(mesh, rules)
     if not (_spec_uses(q_spec[1], "sp") and _spec_uses(kv_spec[1], "sp")):
         return False
-    q_heads_local = q.shape[2] // max(1, _axes_size(mesh, q_spec[2]))
-    kv_heads_local = k.shape[2] // max(1, _axes_size(mesh, kv_spec[2]))
+    from dlrover_tpu.accel.parallel.mesh import axes_size
+
+    q_heads_local = q.shape[2] // max(1, axes_size(mesh, q_spec[2]))
+    kv_heads_local = k.shape[2] // max(1, axes_size(mesh, kv_spec[2]))
     seq_ok = q.shape[1] % sp == 0 and k.shape[1] % sp == 0
     return seq_ok and q_heads_local % sp == 0 and kv_heads_local % sp == 0
 
