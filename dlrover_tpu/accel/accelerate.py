@@ -57,6 +57,10 @@ class AccelerateConfig:
     mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     logical_rules: Tuple[Tuple[str, Any], ...] = DEFAULT_LOGICAL_RULES
     grad_accum_steps: int = 1
+    # Pipeline parallelism (mesh_spec.pp > 1): microbatches per step
+    # (default: 2 * pp — bubble fraction (pp-1)/(mb+pp-1)).
+    pp_microbatches: Optional[int] = None
+    pp_remat: bool = True
     donate_state: bool = True
     # Gradient clipping by global norm; None disables.
     max_grad_norm: Optional[float] = 1.0
@@ -82,7 +86,11 @@ class AccelerateResult:
     jit_train_step: Any = None
 
 
-def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
+def default_loss_fn(
+    model: nn.Module,
+    loss_chunk_size: Optional[int] = None,
+    forward_fn: Optional[Callable] = None,
+):
     """Next-token LM loss over a batch dict with ``input_ids`` and optional
     ``loss_mask`` / ``segment_ids`` / ``positions``.
 
@@ -93,6 +101,10 @@ def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
     With ``loss_chunk_size`` the lm-head projection is fused into a
     chunked cross entropy (:func:`fused_lm_head_loss`) — full logits are
     never materialized.
+
+    ``forward_fn(params, batch, return_hidden) -> (out, var_updates)``
+    replaces the plain ``model.apply`` (used by pipeline parallelism to
+    route the decoder stack through the GPipe schedule).
     """
 
     def _aux_losses(var_updates) -> jax.Array:
@@ -103,15 +115,20 @@ def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
             return jnp.zeros((), jnp.float32)
         return sum(jnp.sum(leaf) for leaf in leaves)
 
+    if forward_fn is None:
+
+        def forward_fn(params, batch, return_hidden=False):
+            return model.apply(
+                {"params": params},
+                batch["input_ids"],
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"),
+                return_hidden=return_hidden,
+                mutable=["moe_losses"],
+            )
+
     def chunked_loss_fn(params, batch):
-        hidden, var_updates = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"),
-            return_hidden=True,
-            mutable=["moe_losses"],
-        )
+        hidden, var_updates = forward_fn(params, batch, return_hidden=True)
         if "lm_head" in params:
             kernel = params["lm_head"]["kernel"]
         else:  # tied embeddings
@@ -140,13 +157,7 @@ def default_loss_fn(model: nn.Module, loss_chunk_size: Optional[int] = None):
         return loss + _aux_losses(var_updates), {"weight": weight}
 
     def loss_fn(params, batch):
-        logits, var_updates = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"),
-            mutable=["moe_losses"],
-        )
+        logits, var_updates = forward_fn(params, batch, return_hidden=False)
         labels = batch.get("labels")
         if labels is None:
             labels = batch["input_ids"][:, 1:]
@@ -235,9 +246,29 @@ def accelerate(
         optimizer = optax.chain(
             optax.clip_by_global_norm(config.max_grad_norm), optimizer
         )
+    if config.mesh_spec.pp > 1:
+        # the stacked layer axis shards over pp so each stage stores (and
+        # optimizes) only its own layers' params
+        rules = tuple(
+            ("layers", "pp") if r[0] == "layers" and r[1] is None else r
+            for r in config.logical_rules
+        )
+        config = dataclasses.replace(config, logical_rules=rules)
     rules_ctx = lambda: logical_rules_context(config.logical_rules)  # noqa: E731
     mesh = config.mesh_spec.build_mesh(devices)
-    loss_fn = loss_fn or default_loss_fn(model, config.loss_chunk_size)
+    forward_fn = None
+    if config.mesh_spec.pp > 1 and loss_fn is None:
+        from dlrover_tpu.accel.parallel.pipeline import make_pipelined_forward
+
+        forward_fn = make_pipelined_forward(
+            model,
+            mesh,
+            num_microbatches=config.pp_microbatches or 2 * config.mesh_spec.pp,
+            remat=config.pp_remat,
+        )
+    loss_fn = loss_fn or default_loss_fn(
+        model, config.loss_chunk_size, forward_fn
+    )
 
     if batch_shape is None:
         if example_batch is None:
