@@ -247,6 +247,15 @@ def accelerate(
             optax.clip_by_global_norm(config.max_grad_norm), optimizer
         )
     if config.mesh_spec.pp > 1:
+        if loss_fn is not None:
+            # a custom loss_fn would run plain model.apply over a
+            # pp-sharded layer stack: no GPipe schedule, per-layer cross-pp
+            # gathers — a severe silent slowdown.  Fail loudly instead.
+            raise NotImplementedError(
+                "pp > 1 requires the default loss path (the pipelined "
+                "forward is wired through default_loss_fn); drop loss_fn "
+                "or set mesh_spec.pp = 1"
+            )
         # the stacked layer axis shards over pp so each stage stores (and
         # optimizes) only its own layers' params
         rules = tuple(

@@ -1,0 +1,26 @@
+"""Strategy-search engine — the "auto" of auto_accelerate (reference:
+atorch/atorch/auto/engine/)."""
+
+from dlrover_tpu.accel.engine.dry_runner import dry_run_candidate
+from dlrover_tpu.accel.engine.engine import (
+    SearchReport,
+    auto_accelerate,
+    search_strategy,
+)
+from dlrover_tpu.accel.engine.planner import (
+    Candidate,
+    ModelInfo,
+    enumerate_candidates,
+    estimate_memory_bytes,
+)
+
+__all__ = [
+    "Candidate",
+    "ModelInfo",
+    "SearchReport",
+    "auto_accelerate",
+    "dry_run_candidate",
+    "enumerate_candidates",
+    "estimate_memory_bytes",
+    "search_strategy",
+]

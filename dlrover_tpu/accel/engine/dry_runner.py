@@ -1,0 +1,77 @@
+"""Dry runner: profile candidate strategies with short timed runs.
+
+Parity target: reference atorch/atorch/auto/dry_runner/dry_runner.py —
+``profile(model_context, warmup_step=10, profile_step=15)`` returning
+throughput used by the strategy engine to rank candidates.  Here a
+candidate is an AccelerateConfig; profiling = build the jitted sharded
+step, run warmup (compile) + timed steps, report tokens/sec.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.engine.planner import Candidate
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def dry_run_candidate(
+    model,
+    candidate: Candidate,
+    batch_shape: Tuple[int, int],
+    *,
+    optimizer=None,
+    loss_fn: Optional[Callable] = None,
+    devices: Optional[Sequence[Any]] = None,
+    warmup_steps: int = 1,
+    profile_steps: int = 3,
+) -> Candidate:
+    """Fill ``candidate.tokens_per_sec`` (or ``candidate.failed``).
+
+    Failures (OOM, invalid sharding, compile errors) mark the candidate
+    failed instead of raising — the search continues with the survivors,
+    like the reference engine dropping failed dryrun tasks.
+    """
+    from dlrover_tpu.accel.accelerate import accelerate
+
+    b, s = batch_shape
+    # a re-run must not leave stale results from a prior round
+    candidate.tokens_per_sec = None
+    candidate.failed = None
+    vocab = getattr(getattr(model, "config", None), "vocab_size", 1024)
+    try:
+        res = accelerate(
+            model,
+            optimizer=optimizer,
+            config=candidate.config,
+            batch_shape=batch_shape,
+            loss_fn=loss_fn,
+            devices=devices,
+        )
+        candidate.result = res
+        state = res.init_fn(jax.random.PRNGKey(0))
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (b, s), 0, vocab
+        ).astype(jnp.int32)
+        batch = {"input_ids": ids}
+        for _ in range(max(1, warmup_steps)):
+            state, metrics = res.train_step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(max(1, profile_steps)):
+            state, metrics = res.train_step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        candidate.tokens_per_sec = b * s * max(1, profile_steps) / dt
+        logger.info(
+            "dryrun %s: %.0f tokens/sec", candidate.name,
+            candidate.tokens_per_sec,
+        )
+    except Exception as e:  # noqa: BLE001 — any failure disqualifies
+        candidate.failed = f"{type(e).__name__}: {e}"
+        logger.warning("dryrun %s failed: %s", candidate.name, candidate.failed)
+    return candidate

@@ -1,0 +1,200 @@
+"""Acceleration engine: the task loop that turns candidates into a chosen
+strategy — the "auto" of auto_accelerate.
+
+Parity target: the reference's engine service + task protocol
+(atorch/atorch/auto/engine/acceleration_engine.py, task types
+WAIT/ANALYSE/TUNE/DRYRUN/SETUP_PARALLEL_GROUP/FINISH in
+atorch/atorch/auto/accelerate.py:194-225, strategy selection by dryrun
+throughput in engine/planner.py + sg_algo/).
+
+TPU-native: JAX is single-controller, so no gRPC service or rank-0
+election is needed — the engine is an in-process loop: ANALYSE the model,
+enumerate candidates (planner), DRYRUN them in promise order with
+successive halving, FINISH with the best config materialized as a full
+:class:`AccelerateResult`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.accel.accelerate import AccelerateConfig, AccelerateResult
+from dlrover_tpu.accel.engine.dry_runner import dry_run_candidate
+from dlrover_tpu.accel.engine.planner import (
+    Candidate,
+    ModelInfo,
+    enumerate_candidates,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class SearchReport:
+    """What the search saw — kept for tests/observability (the analogue of
+    the reference's StrategyInfoCollection, engine/strategy.py:49)."""
+
+    candidates: List[Candidate]
+    best: Optional[Candidate] = None
+
+    @property
+    def succeeded(self) -> List[Candidate]:
+        return [
+            c
+            for c in self.candidates
+            if c.tokens_per_sec is not None and c.failed is None
+        ]
+
+
+def search_strategy(
+    model,
+    batch_shape: Tuple[int, int],
+    *,
+    optimizer=None,
+    loss_fn: Optional[Callable] = None,
+    devices: Optional[Sequence[Any]] = None,
+    base_config: Optional[AccelerateConfig] = None,
+    model_info: Optional[ModelInfo] = None,
+    memory_budget_bytes: Optional[int] = None,
+    max_candidates: int = 8,
+    warmup_steps: int = 1,
+    profile_steps: int = 3,
+    halving_survivors: int = 3,
+) -> SearchReport:
+    """Enumerate -> dry-run -> successive-halving refine -> pick best.
+
+    Round 1 times every candidate briefly; round 2 re-times the top
+    ``halving_survivors`` with 3x profile steps to de-noise the ranking
+    (a deterministic stand-in for the reference's HEBO loop that fits
+    dry-run budgets; the BO hook lives in dlrover_tpu.brain.hpsearch).
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if model_info is None:
+        if hasattr(model, "config"):
+            model_info = ModelInfo.from_llama_config(model.config)
+        else:
+            raise ValueError("pass model_info for non-Llama models")
+
+    candidates = enumerate_candidates(
+        n,
+        model_info,
+        batch_shape,
+        base_config=base_config,
+        memory_budget_bytes=memory_budget_bytes,
+        max_candidates=max_candidates,
+    )
+    if not candidates:
+        raise ValueError(
+            f"no valid parallelism candidates for {n} devices and this model"
+        )
+    logger.info(
+        "strategy search: %d candidates: %s",
+        len(candidates),
+        [c.name for c in candidates],
+    )
+
+    for cand in candidates:
+        dry_run_candidate(
+            model,
+            cand,
+            batch_shape,
+            optimizer=optimizer,
+            loss_fn=loss_fn,
+            devices=devices,
+            warmup_steps=warmup_steps,
+            profile_steps=profile_steps,
+        )
+
+    report = SearchReport(candidates=candidates)
+    ranked = sorted(
+        report.succeeded, key=lambda c: -(c.tokens_per_sec or 0.0)
+    )
+    if not ranked:
+        raise RuntimeError(
+            "every candidate failed to dry-run: "
+            + "; ".join(f"{c.name}: {c.failed}" for c in candidates)
+        )
+
+    finalists = ranked[: max(1, halving_survivors)]
+    if len(finalists) > 1:
+        for cand in finalists:
+            dry_run_candidate(
+                model,
+                cand,
+                batch_shape,
+                optimizer=optimizer,
+                loss_fn=loss_fn,
+                devices=devices,
+                warmup_steps=1,
+                profile_steps=3 * profile_steps,
+            )
+        finalists = sorted(
+            (
+                c
+                for c in finalists
+                if c.tokens_per_sec is not None and c.failed is None
+            ),
+            key=lambda c: -(c.tokens_per_sec or 0.0),
+        )
+        if not finalists:
+            raise RuntimeError(
+                "every finalist failed re-profiling: "
+                + "; ".join(f"{c.name}: {c.failed}" for c in ranked)
+            )
+    report.best = finalists[0]
+    # free the losers' compiled executables; keep the winner's for reuse
+    for cand in report.candidates:
+        if cand is not report.best:
+            cand.result = None
+    logger.info(
+        "strategy search winner: %s (%.0f tokens/sec)",
+        report.best.name,
+        report.best.tokens_per_sec or 0.0,
+    )
+    return report
+
+
+def auto_accelerate(
+    model,
+    *,
+    batch_shape: Tuple[int, int],
+    optimizer=None,
+    loss_fn: Optional[Callable] = None,
+    devices: Optional[Sequence[Any]] = None,
+    base_config: Optional[AccelerateConfig] = None,
+    **search_kwargs,
+) -> Tuple[AccelerateResult, SearchReport]:
+    """Strategy search + materialization: the reference's
+    ``auto_accelerate(model, ...)`` without a load_strategy
+    (atorch/atorch/auto/accelerate.py:406-665).
+
+    Returns ``(AccelerateResult, SearchReport)`` — the result is built
+    from the winning config and ready to train with.
+    """
+    from dlrover_tpu.accel.accelerate import accelerate
+
+    report = search_strategy(
+        model,
+        batch_shape,
+        optimizer=optimizer,
+        loss_fn=loss_fn,
+        devices=devices,
+        base_config=base_config,
+        **search_kwargs,
+    )
+    # reuse the winner's dry-run build — same config, already compiled
+    result = report.best.result
+    if result is None:
+        result = accelerate(
+            model,
+            optimizer=optimizer,
+            config=report.best.config,
+            batch_shape=batch_shape,
+            loss_fn=loss_fn,
+            devices=devices,
+        )
+    return result, report

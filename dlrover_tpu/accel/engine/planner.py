@@ -1,0 +1,209 @@
+"""Strategy planner: enumerate + prune candidate parallelism strategies.
+
+Parity target: the reference's strategy-generation engine
+(atorch/atorch/auto/engine/planner.py:13-97 — prune -> baseline ->
+analyse -> algorithms; candidates come from the optimization library,
+validated against device/model constraints).
+
+TPU-native: a "strategy" is not a wrapper list but an
+:class:`~dlrover_tpu.accel.accelerate.AccelerateConfig` — a MeshSpec
+factorization plus remat policy / loss chunking.  The planner enumerates
+mesh factorizations of the device count over (dp, fsdp, tp, sp, pp),
+prunes those that violate model divisibility constraints (heads % tp,
+layers % pp, ...) or the per-device HBM budget (rough f32 params + Adam
+moments + activation estimate), and ranks the survivors for the dry
+runner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.accel.accelerate import AccelerateConfig
+from dlrover_tpu.accel.parallel.mesh import MeshSpec
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class ModelInfo:
+    """What the planner needs to know about the model (the analogue of the
+    reference's ANALYSE task result, atorch/atorch/auto/analyser/)."""
+
+    num_params: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    hidden_size: int
+    vocab_size: int
+    scan_layers: bool = True
+    num_experts: int = 0
+
+    @classmethod
+    def from_llama_config(cls, cfg) -> "ModelInfo":
+        return cls(
+            num_params=cfg.num_params,
+            num_layers=cfg.num_layers,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            hidden_size=cfg.hidden_size,
+            vocab_size=cfg.vocab_size,
+            scan_layers=cfg.scan_layers,
+            num_experts=cfg.num_experts,
+        )
+
+
+@dataclasses.dataclass
+class Candidate:
+    config: AccelerateConfig
+    name: str
+    est_memory_bytes: int = 0
+    # filled by the dry runner
+    tokens_per_sec: Optional[float] = None
+    failed: Optional[str] = None
+    # the built AccelerateResult of the last dry run (reused by
+    # auto_accelerate so the winner is not compiled again)
+    result: Any = None
+
+
+def _factor_pairs(n: int) -> Iterable[Tuple[int, int]]:
+    for a in range(1, n + 1):
+        if n % a == 0:
+            yield a, n // a
+
+
+def estimate_memory_bytes(
+    info: ModelInfo,
+    spec: MeshSpec,
+    batch_shape: Tuple[int, int],
+    remat: bool = True,
+) -> int:
+    """Rough per-device HBM estimate: f32 params + Adam moments sharded
+    over (fsdp * tp * pp), plus activation working set.
+
+    Deliberately coarse — the point is pruning sure-OOM candidates before
+    compiling them (the reference prunes with analyser results the same
+    way); the dry runner is the ground truth for the survivors.
+    """
+    param_shards = spec.fsdp * spec.tp * spec.pp
+    # params + grads + 2 Adam moments, f32
+    state_bytes = info.num_params * 4 * 4 // max(1, param_shards)
+    b, s = batch_shape
+    b_local = max(1, b // (spec.dp * spec.fsdp))
+    s_local = max(1, s // spec.sp)
+    # activation working set per layer ~ hidden + mlp blowup; remat keeps
+    # roughly one layer live plus the residual stream per layer
+    act_per_layer = b_local * s_local * info.hidden_size * 2 * 6
+    live_layers = 2 if remat else max(1, info.num_layers // spec.pp)
+    act_bytes = act_per_layer * live_layers + (
+        b_local * s_local * info.hidden_size * 2 * info.num_layers // spec.pp
+    )
+    return state_bytes + act_bytes
+
+
+def enumerate_candidates(
+    n_devices: int,
+    info: ModelInfo,
+    batch_shape: Tuple[int, int],
+    *,
+    base_config: Optional[AccelerateConfig] = None,
+    memory_budget_bytes: Optional[int] = None,
+    include_pp: bool = True,
+    include_sp: bool = True,
+    max_candidates: int = 16,
+) -> List[Candidate]:
+    """All valid (mesh, remat) combinations for ``n_devices``, pruned by
+    divisibility and the memory budget, cheapest-communication first.
+
+    Ordering heuristic (stands in for the reference's baseline ranking):
+    prefer pure fsdp (the reference's own headline strategy), then
+    fsdp x tp, then sp/pp variants — candidates earlier in the list get
+    dry-run first so a truncated search still covers the usual winners.
+    """
+    base = base_config or AccelerateConfig()
+    b, s = batch_shape
+    seen = set()
+    out: List[Candidate] = []
+
+    def add(spec: MeshSpec, name: str):
+        if spec.dims in seen:
+            return
+        seen.add(spec.dims)
+        if spec.size != n_devices:
+            return
+        # divisibility constraints (the reference's opt-lib validity
+        # checks, e.g. sequence_parallel_optimization.py requires
+        # num_heads % sp == 0)
+        if info.num_heads % max(1, spec.tp):
+            return
+        if info.num_kv_heads % max(1, spec.tp):
+            return
+        heads_local = info.num_heads // max(1, spec.tp)
+        kv_local = info.num_kv_heads // max(1, spec.tp)
+        if spec.sp > 1 and (heads_local % spec.sp or kv_local % spec.sp):
+            return
+        if spec.sp > 1 and s % spec.sp:
+            return
+        if spec.pp > 1 and (
+            not info.scan_layers or info.num_layers % spec.pp
+        ):
+            return
+        if spec.pp > 1 and info.num_experts:
+            return  # pp x MoE unsupported
+        if spec.pp > 1 and b % (base.pp_microbatches or 2 * spec.pp):
+            return  # pipeline_blocks requires batch % microbatches == 0
+        if spec.ep > 1 and (
+            not info.num_experts or info.num_experts % spec.ep
+        ):
+            return
+        if b % (spec.dp * spec.fsdp):
+            return
+        cand = Candidate(
+            config=dataclasses.replace(base, mesh_spec=spec),
+            name=name,
+            est_memory_bytes=estimate_memory_bytes(info, spec, batch_shape),
+        )
+        if (
+            memory_budget_bytes
+            and cand.est_memory_bytes > memory_budget_bytes
+        ):
+            logger.info(
+                "pruning %s: est %.1f GB > budget",
+                cand.name,
+                cand.est_memory_bytes / 1e9,
+            )
+            return
+        out.append(cand)
+
+    # pure data-parallel family first (reference baseline)
+    add(MeshSpec(fsdp=n_devices), f"fsdp{n_devices}")
+    add(MeshSpec(dp=n_devices), f"dp{n_devices}")
+    # fsdp x tp
+    for tp, rest in _factor_pairs(n_devices):
+        if tp > 1 and tp <= info.num_heads:
+            add(MeshSpec(fsdp=rest, tp=tp), f"fsdp{rest}tp{tp}")
+    # sp variants
+    if include_sp:
+        for sp, rest in _factor_pairs(n_devices):
+            if sp > 1:
+                add(MeshSpec(fsdp=rest, sp=sp), f"fsdp{rest}sp{sp}")
+                for tp, rest2 in _factor_pairs(rest):
+                    if tp > 1:
+                        add(
+                            MeshSpec(fsdp=rest2, sp=sp, tp=tp),
+                            f"fsdp{rest2}sp{sp}tp{tp}",
+                        )
+    # pp variants
+    if include_pp:
+        for pp, rest in _factor_pairs(n_devices):
+            if pp > 1:
+                add(MeshSpec(dp=rest, pp=pp), f"dp{rest}pp{pp}")
+                add(MeshSpec(fsdp=rest, pp=pp), f"fsdp{rest}pp{pp}")
+    # ep variants
+    if info.num_experts:
+        for ep, rest in _factor_pairs(n_devices):
+            if ep > 1:
+                add(MeshSpec(dp=rest, ep=ep), f"dp{rest}ep{ep}")
+                add(MeshSpec(fsdp=rest, ep=ep), f"fsdp{rest}ep{ep}")
+
+    return out[:max_candidates]

@@ -1,0 +1,106 @@
+"""Strategy-search ("auto") tests — reference parity:
+atorch/atorch/auto/engine/planner.py (prune/rank), dry_runner.py
+(throughput profiling), accelerate.py task protocol. The reference tests
+its search against faked dryrun results (bo_sg_test.py); here the dry
+runs are real (tiny model, CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.accel.accelerate import AccelerateConfig
+from dlrover_tpu.accel.engine import (
+    ModelInfo,
+    auto_accelerate,
+    enumerate_candidates,
+    search_strategy,
+)
+from dlrover_tpu.accel.parallel.mesh import MeshSpec
+from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def _info(**kw):
+    base = dict(
+        num_params=1_000_000,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        hidden_size=64,
+        vocab_size=256,
+        scan_layers=True,
+    )
+    base.update(kw)
+    return ModelInfo(**base)
+
+
+def test_enumerate_prunes_invalid_tp():
+    # tp=8 > num_heads=4 must not appear
+    cands = enumerate_candidates(8, _info(), (8, 32), max_candidates=50)
+    assert cands, "no candidates"
+    for c in cands:
+        assert c.config.mesh_spec.tp <= 4
+        assert 4 % c.config.mesh_spec.tp == 0
+        # kv heads = 2: tp must divide them too
+        assert 2 % c.config.mesh_spec.tp == 0
+
+
+def test_enumerate_prunes_pp_on_indivisible_layers():
+    cands = enumerate_candidates(
+        8, _info(num_layers=3), (8, 32), max_candidates=50
+    )
+    for c in cands:
+        assert c.config.mesh_spec.pp in (1, 3)
+
+
+def test_enumerate_memory_budget_prunes():
+    # an absurdly small budget kills everything
+    cands = enumerate_candidates(
+        8, _info(), (8, 32), memory_budget_bytes=16, max_candidates=50
+    )
+    assert cands == []
+
+
+def test_search_picks_best_and_beats_worst():
+    cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=4, scan_layers=True)
+    model = LlamaModel(cfg)
+    report = search_strategy(
+        model,
+        (8, 32),
+        max_candidates=4,
+        warmup_steps=1,
+        profile_steps=2,
+        halving_survivors=2,
+    )
+    assert report.best is not None
+    assert len(report.succeeded) >= 2, [c.failed for c in report.candidates]
+    worst = min(c.tokens_per_sec for c in report.succeeded)
+    assert report.best.tokens_per_sec >= worst
+    # the winner is a real measured strategy, not the enumeration order
+    assert report.best.tokens_per_sec == max(
+        c.tokens_per_sec for c in report.succeeded
+    )
+
+
+def test_auto_accelerate_end_to_end():
+    cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=4, scan_layers=True)
+    model = LlamaModel(cfg)
+    result, report = auto_accelerate(
+        model,
+        batch_shape=(8, 32),
+        max_candidates=3,
+        warmup_steps=1,
+        profile_steps=1,
+        halving_survivors=1,
+    )
+    assert result.config.mesh_spec == report.best.config.mesh_spec
+    state = result.init_fn(jax.random.PRNGKey(0))
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    losses = []
+    for _ in range(2):
+        state, m = result.train_step(state, {"input_ids": ids})
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
