@@ -53,6 +53,14 @@ class WorkerSpec:
     # Host the flash-checkpoint saver factory so trainers can checkpoint
     # into agent-owned shared memory (reference: training.py:580).
     flash_ckpt: bool = True
+    # Observability: sample host/TPU usage + tail the trainer's runtime-
+    # metrics file and report upstream (reference: elastic_agent/monitor/).
+    monitors: bool = True
+    # Hang detection: restart workers when the global step stalls this
+    # long (reference: atorch fault_tolerance/hanging_detector.py:86).
+    # 0 disables.  Grace period covers compile + first-step latency.
+    hang_timeout: float = 0.0
+    hang_grace_period: float = 600.0
 
 
 class WorkerState(str, Enum):
@@ -223,6 +231,9 @@ class ElasticAgent:
         self._stop_heartbeat = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._saver_factory = None
+        self._training_monitor = None
+        self._resource_monitor = None
+        self._hang_detector = None
 
     # -- flash checkpoint -------------------------------------------------
     def _start_ckpt_factory(self) -> None:
@@ -276,11 +287,75 @@ class ElasticAgent:
         logger.info("Restarting workers: %s", reason)
         self._group.stop()
         self._group.restart_count += 1
-        return self._initialize_workers()
+        rdzv = self._initialize_workers()
+        # EVERY restart (failure, hang, rescale) re-enters restore +
+        # compile; re-arm the progress clock and the hang grace period so
+        # that latency is not mistaken for a fresh hang.
+        if self._training_monitor is not None:
+            self._training_monitor.reset_progress_clock()
+        if self._hang_detector is not None:
+            self._hang_detector.reset()
+        return rdzv
+
+    def _recover_failed_workers(
+        self, reason: str, level: str, rc: int
+    ) -> Optional[int]:
+        """Shared failure/hang recovery: report upstream, persist the
+        in-memory checkpoint, then restart (or give up past max_restarts).
+        Returns an exit code to propagate, or None after a restart."""
+        self._client.report_failure(
+            reason,
+            level=level,
+            node_rank=self._node_rank,
+            restart_count=self._group.restart_count,
+        )
+        # stop remaining workers FIRST so a crashed writer's shm lock is
+        # safely reclaimable, then persist the in-memory checkpoint
+        # (reference: training.py:662-672)
+        self._group.stop()
+        self._save_shm_checkpoint()
+        if self._group.restart_count >= self._spec.max_restarts:
+            self._client.report_node_status(self._node_rank, NodeStatus.FAILED)
+            logger.error(
+                "Exhausted %s restarts (%s); failing",
+                self._spec.max_restarts,
+                reason,
+            )
+            return rc
+        self._restart_workers(reason)
+        return None
 
     def run(self) -> int:
         """Monitor loop (reference training.py:577-728). Returns exit code."""
         self.start_heartbeat()
+        self._training_monitor = None
+        self._resource_monitor = None
+        hang_detector = None
+        if self._spec.monitors:
+            from dlrover_tpu.agent.monitor.resource import ResourceMonitor
+            from dlrover_tpu.agent.monitor.training import TrainingMonitor
+
+            self._training_monitor = TrainingMonitor(self._client)
+            self._training_monitor.start()
+            self._resource_monitor = ResourceMonitor(self._client)
+            self._resource_monitor.start()
+        if self._spec.hang_timeout > 0:
+            if self._training_monitor is None:
+                logger.warning(
+                    "hang_timeout=%s has no effect: hang detection needs "
+                    "the training monitor (set monitors=True)",
+                    self._spec.hang_timeout,
+                )
+            else:
+                from dlrover_tpu.agent.monitor.hang import HangingDetector
+
+                hang_detector = HangingDetector(
+                    self._training_monitor.seconds_without_progress,
+                    timeout=self._spec.hang_timeout,
+                    grace_period=self._spec.hang_grace_period,
+                )
+                hang_detector.arm()
+        self._hang_detector = hang_detector
         if self._spec.flash_ckpt:
             self._start_ckpt_factory()
         if self._spec.network_check:
@@ -310,26 +385,22 @@ class ElasticAgent:
                     logger.info("Workers finished successfully")
                     return 0
                 if state == WorkerState.FAILED:
-                    self._client.report_failure(
-                        f"worker exit code {rc}",
-                        level="error",
-                        node_rank=self._node_rank,
-                        restart_count=self._group.restart_count,
+                    recovered = self._recover_failed_workers(
+                        f"worker exit code {rc}", level="error", rc=rc or 1
                     )
-                    # stop remaining workers FIRST so a crashed writer's shm
-                    # lock is safely reclaimable, then persist the in-memory
-                    # checkpoint (reference: training.py:662-672)
-                    self._group.stop()
-                    self._save_shm_checkpoint()
-                    if self._group.restart_count >= spec.max_restarts:
-                        self._client.report_node_status(
-                            self._node_rank, NodeStatus.FAILED
-                        )
-                        logger.error(
-                            "Exhausted %s restarts; failing", spec.max_restarts
-                        )
-                        return rc or 1
-                    self._restart_workers(f"worker failed rc={rc}")
+                    if recovered is not None:
+                        return recovered
+                    continue
+                if hang_detector is not None and hang_detector.check_once():
+                    stalled = self._training_monitor.seconds_without_progress()
+                    recovered = self._recover_failed_workers(
+                        f"training hang: no global-step progress for "
+                        f"{stalled:.0f}s",
+                        level="hang",
+                        rc=1,
+                    )
+                    if recovered is not None:
+                        return recovered
                     continue
                 # healthy: check membership growth.  An unreachable master
                 # must not kill healthy workers (it may be restarting, or —
@@ -347,6 +418,10 @@ class ElasticAgent:
                     )
         finally:
             self._stop_heartbeat.set()
+            if self._training_monitor is not None:
+                self._training_monitor.stop()
+            if self._resource_monitor is not None:
+                self._resource_monitor.stop()
             self._group.stop()
             self._save_shm_checkpoint()
             if self._saver_factory is not None:

@@ -1,0 +1,78 @@
+"""Hang detection: no-training-progress watchdog.
+
+Parity targets in the reference:
+- ATorch ``HangingDetector``
+  (atorch/atorch/fault_tolerance/hanging_detector.py:86) — monitors
+  collective progress via a TCPStore relaunch protocol and triggers a
+  relaunch when workers stop advancing;
+- master-side hang checks (dlrover/python/master/dist_master.py:242-248
+  ``all_running_node_hanged`` / ``task_hanged``).
+
+TPU-native: the signal is the global-step progress already tracked by
+:class:`~dlrover_tpu.agent.monitor.training.TrainingMonitor` (a stuck XLA
+collective, a wedged host, or a dead data pipeline all stop the step
+counter).  The elastic agent polls :meth:`HangingDetector.check_once`
+from its monitor loop so the recovery (report-failure + worker restart)
+runs on the agent thread — the same recovery the reference's relaunch
+protocol performs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class HangingDetector:
+    """Reports a hang when ``progress_fn`` stalls past ``timeout``.
+
+    ``progress_fn() -> float`` returns seconds since last observed
+    progress.  ``grace_period`` suppresses detection after :meth:`arm`
+    (and after each :meth:`reset`) so compilation / restore / first-step
+    latency is not mistaken for a hang (compare the reference's
+    monitor_interval warmup).  Poll :meth:`check_once` from the owner's
+    monitor loop; there is no internal thread.
+    """
+
+    def __init__(
+        self,
+        progress_fn: Callable[[], float],
+        timeout: float = 1800.0,
+        grace_period: float = 600.0,
+        max_triggers: int = 1,
+    ):
+        self._progress_fn = progress_fn
+        self.timeout = timeout
+        self._grace = grace_period
+        self._max_triggers = max_triggers
+        self._triggers = 0
+        self._armed_at = 0.0
+
+    def arm(self) -> None:
+        """Start (or restart) the grace-period clock."""
+        self._armed_at = time.time()
+
+    def reset(self) -> None:
+        """Call after a worker restart: re-arm grace period and triggers."""
+        self._armed_at = time.time()
+        self._triggers = 0
+
+    def check_once(self, now: Optional[float] = None) -> bool:
+        """Returns True when a hang was detected."""
+        now = now or time.time()
+        if now - self._armed_at < self._grace:
+            return False
+        if self._triggers >= self._max_triggers:
+            return False
+        stalled = self._progress_fn()
+        if stalled < self.timeout:
+            return False
+        self._triggers += 1
+        logger.error(
+            "training hang detected: no progress for %.0fs (timeout %.0fs)",
+            stalled,
+            self.timeout,
+        )
+        return True

@@ -76,6 +76,9 @@ class DistributedJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.elastic_ps_service = ElasticPsService()
+        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
+        self.job_metric_collector = JobMetricCollector()
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -83,6 +86,7 @@ class DistributedJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
+            job_metric_collector=self.job_metric_collector,
         )
         self._server = build_server(self.servicer.get, self.servicer.report)
         self._stopped = threading.Event()

@@ -25,6 +25,7 @@ from dlrover_tpu.master.elastic_training.sync_service import SyncService
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.servicer import MasterServicer
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.stats.job_collector import JobMetricCollector
 
 
 class LocalJobMaster:
@@ -32,6 +33,7 @@ class LocalJobMaster:
         self._port = port
         self._node_num = node_num
         self.speed_monitor = SpeedMonitor()
+        self.job_metric_collector = JobMetricCollector()
         self.task_manager = TaskManager(0, self.speed_monitor)
         self.rdzv_managers = {
             RendezvousName.ELASTIC_TRAINING: (
@@ -49,6 +51,7 @@ class LocalJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
+            job_metric_collector=self.job_metric_collector,
         )
         self._server = build_server(self.servicer.get, self.servicer.report)
         self._stopped = threading.Event()

@@ -351,6 +351,10 @@ class MasterServicer:
                     req.node_id,
                     message,
                 )
+            if self._job_metric_collector is not None:
+                self._job_metric_collector.report_resource_usage(
+                    req.node_type or NodeType.WORKER, req.node_id, message
+                )
             return None
         if isinstance(message, comm.NodeStatusReport):
             if self._job_manager is not None:

@@ -1,0 +1,116 @@
+"""JobMetricCollector: master-side accumulation of job runtime metrics.
+
+Parity target: reference dlrover/python/master/stats/job_collector.py
+(``JobMetricCollector``) + stats/reporter.py — the master collects global
+steps, training speed, and per-node resource usage, and ships them to a
+reporter (local log in standalone mode, Brain datastore in cluster mode).
+
+The collected history is what the resource optimizer / auto-scaler reads
+(dlrover_tpu.master.resource) and what ``get_job_metrics`` RPC consumers
+see.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class LocalMetricReporter:
+    """Appends metric records to a JSONL file (the standalone analogue of
+    the reference's Brain/MySQL reporter, stats/reporter.py)."""
+
+    def __init__(self, path: Optional[str] = None):
+        # DLROVER_METRICS_DUMP lets a standalone master dump its collected
+        # metrics without code changes (cluster mode would ship to Brain)
+        self._path = path or os.getenv("DLROVER_METRICS_DUMP")
+        self._lock = threading.Lock()
+
+    def report(self, record: Dict[str, Any]) -> None:
+        if not self._path:
+            return
+        try:
+            with self._lock, open(self._path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError as e:
+            logger.warning("metric report failed: %s", e)
+
+
+class JobMetricCollector:
+    def __init__(
+        self,
+        reporter: Optional[LocalMetricReporter] = None,
+        max_samples: int = 512,
+    ):
+        self._reporter = reporter or LocalMetricReporter()
+        # reentrant: get_job_metrics holds it while calling training_speed
+        self._lock = threading.RLock()
+        self.steps: Deque[Dict[str, float]] = deque(maxlen=max_samples)
+        self.node_usage: Dict[str, Dict[str, Any]] = {}
+        self.events: Deque[Dict[str, Any]] = deque(maxlen=max_samples)
+        self.job_meta: Dict[str, Any] = {}
+
+    # ---------------------------------------------------------- reporting
+    def report_global_step(self, step: int, timestamp: float) -> None:
+        with self._lock:
+            self.steps.append({"step": step, "timestamp": timestamp})
+        self._reporter.report(
+            {"kind": "global_step", "step": step, "timestamp": timestamp}
+        )
+
+    def report_resource_usage(self, node_type: str, node_id, stats) -> None:
+        key = f"{node_type}-{node_id}"
+        record = {
+            "cpu_percent": getattr(stats, "cpu_percent", 0.0),
+            "memory_mb": getattr(stats, "memory_mb", 0),
+            "tpu_duty_cycle": getattr(stats, "tpu_duty_cycle", 0.0),
+            "tpu_hbm_used_mb": getattr(stats, "tpu_hbm_used_mb", 0),
+            "timestamp": time.time(),
+        }
+        with self._lock:
+            self.node_usage[key] = record
+        self._reporter.report({"kind": "resource", "node": key, **record})
+
+    def report_event(self, event_type: str, instance: str = "", msg: str = "") -> None:
+        record = {
+            "event_type": event_type,
+            "instance": instance,
+            "msg": msg,
+            "timestamp": time.time(),
+        }
+        with self._lock:
+            self.events.append(record)
+        self._reporter.report({"kind": "event", **record})
+
+    def collect_job_meta(self, **meta) -> None:
+        with self._lock:
+            self.job_meta.update(meta)
+
+    # ------------------------------------------------------------ queries
+    def training_speed(self, window: int = 16) -> float:
+        """Steps/sec over the last ``window`` samples (0 when unknown)."""
+        with self._lock:
+            samples = list(self.steps)[-window:]
+        if len(samples) < 2:
+            return 0.0
+        dt = samples[-1]["timestamp"] - samples[0]["timestamp"]
+        dstep = samples[-1]["step"] - samples[0]["step"]
+        if dt <= 0 or dstep <= 0:
+            return 0.0
+        return dstep / dt
+
+    def get_job_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "job": dict(self.job_meta),
+                "global_step": self.steps[-1]["step"] if self.steps else 0,
+                "speed_steps_per_sec": self.training_speed(),
+                "node_usage": dict(self.node_usage),
+                "recent_events": list(self.events)[-16:],
+            }

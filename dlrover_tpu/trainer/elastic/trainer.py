@@ -22,6 +22,8 @@ nodes come and go (trainer.py:307-327).  TPU-native differences:
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -113,6 +115,7 @@ class ElasticTrainer:
         save_memory_interval: int = 1,
         save_storage_interval: int = 50,
         saver_mode: SaverMode = SaverMode.AUTO,
+        metrics_every: int = 1,
     ):
         self._model = model
         self._global_batch_size = global_batch_size
@@ -131,6 +134,12 @@ class ElasticTrainer:
         self.result: Optional[AccelerateResult] = None
         self.plan: Optional[ElasticBatchPlan] = None
         self.state: Any = None
+        from dlrover_tpu.utils.profiler import StepTimer
+
+        self._step_timer = StepTimer()
+        self._metrics_every = metrics_every
+        self._steps_since_report = 0
+        self._host_step = 0
 
     # -- world / strategy -------------------------------------------------
     def prepare(self, devices: Optional[Sequence[Any]] = None) -> None:
@@ -191,16 +200,18 @@ class ElasticTrainer:
             )
             if state is not None:
                 self.state = state
+                self._host_step = int(step)
                 logger.info("Restored train state at step %s", step)
                 return int(step)
         self.state = self.result.init_fn(rng)
+        self._host_step = 0
         return 0
 
     @property
     def step(self) -> int:
-        if self.state is None:
-            return 0
-        return int(jax.device_get(self.state.step))
+        """Host-side step mirror: incremented per train_step so reading it
+        never forces a device sync on the async-dispatched train state."""
+        return self._host_step
 
     # -- training ---------------------------------------------------------
     def _shape_batch(self, batch: Any) -> Any:
@@ -222,10 +233,36 @@ class ElasticTrainer:
 
     def train_step(self, batch: Any) -> Dict[str, jax.Array]:
         assert self.state is not None, "call restore_or_init() first"
+        t0 = time.time()
         self.state, metrics = self.result.train_step(
             self.state, self._shape_batch(batch)
         )
+        self._host_step += 1
+        self._report_runtime_metrics(time.time() - t0)
         return metrics
+
+    def _report_runtime_metrics(self, elapsed: float) -> None:
+        """Write the runtime-metrics file every step so the agent's
+        TrainingMonitor can report speed to the master and the hang
+        detector sees progress (reference: monitor/training.py:77 — the
+        trainer-side half of the metrics-file contract).  Written by the
+        host-local rank-0 process: each host's agent tails its own
+        host-local file, so gating on the *global* process index would
+        starve every other host's monitor."""
+        self._step_timer.observe(elapsed)
+        if self._metrics_every <= 0:
+            return
+        if int(os.getenv("DLROVER_LOCAL_RANK", "0")) != 0:
+            return
+        self._steps_since_report += 1
+        if self._steps_since_report < self._metrics_every:
+            return
+        self._steps_since_report = 0
+        from dlrover_tpu.agent.monitor.training import write_runtime_metrics
+
+        write_runtime_metrics(
+            self.step, elapsed_per_step=self._step_timer.ema_seconds
+        )
 
     def maybe_save(self) -> None:
         """Flash-checkpoint cadence: shm every ``save_memory_interval``

@@ -1,0 +1,184 @@
+"""Profiling + metrics export: the TPU counterpart of xpu_timer.
+
+Parity target: reference atorch/dev/xpu_timer/ — an LD_PRELOAD C++
+library hooking cudaLaunchKernel/NCCL/cuBLAS, timing kernels with CUDA
+events and serving Prometheus metrics per rank
+(atorch/dev/xpu_timer/README.md:1-40, xpu_timer/nvidia/hook.cc).
+
+On TPU the XLA runtime already owns kernel timing — the idiomatic
+equivalents are:
+
+- :class:`StepTimer` — wall-clock step timing with EMA + reservoir
+  percentiles (device time is visible through it because JAX dispatch
+  blocks on donated-buffer reuse each step);
+- :func:`trace` — ``jax.profiler`` trace capture (the XProf/``xplane``
+  trace is the TPU analogue of the CUDA-event kernel timeline; view with
+  TensorBoard);
+- :class:`MetricsExporter` — a Prometheus text endpoint per process
+  (``/metrics``), like xpu_timer's per-rank ``:38888+rank`` exporter.
+
+No LD_PRELOAD is needed: libtpu/XLA expose their timeline through the
+profiler plugin, so the framework only adds the serving layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.server
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class StepTimer:
+    """Per-step wall-time stats: count, EMA, and reservoir percentiles."""
+
+    def __init__(self, ema_alpha: float = 0.05, reservoir: int = 256):
+        self._alpha = ema_alpha
+        self._reservoir_size = reservoir
+        self._lock = threading.Lock()
+        self.count = 0
+        self.ema_seconds = 0.0
+        self.last_seconds = 0.0
+        self.total_seconds = 0.0
+        self._samples: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.observe(dt)
+        return dt
+
+    @contextlib.contextmanager
+    def step(self):
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.last_seconds = seconds
+            self.total_seconds += seconds
+            if self.count == 1:
+                self.ema_seconds = seconds
+            else:
+                self.ema_seconds += self._alpha * (seconds - self.ema_seconds)
+            if len(self._samples) < self._reservoir_size:
+                self._samples.append(seconds)
+            else:  # reservoir sampling keeps percentiles unbiased
+                j = random.randint(0, self.count - 1)
+                if j < self._reservoir_size:
+                    self._samples[j] = seconds
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ordered = sorted(self._samples)
+            idx = min(len(ordered) - 1, int(q / 100.0 * len(ordered)))
+            return ordered[idx]
+
+    def metrics(self, prefix: str = "dlrover_step") -> Dict[str, float]:
+        return {
+            f"{prefix}_count": float(self.count),
+            f"{prefix}_seconds_ema": self.ema_seconds,
+            f"{prefix}_seconds_last": self.last_seconds,
+            f"{prefix}_seconds_p50": self.percentile(50),
+            f"{prefix}_seconds_p99": self.percentile(99),
+            f"{prefix}_seconds_total": self.total_seconds,
+        }
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2):
+    """Capture an XLA/XProf trace for the enclosed region (TensorBoard-
+    viewable) — the TPU analogue of xpu_timer's kernel timeline."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def render_prometheus(metrics: Dict[str, float], labels: Optional[Dict[str, str]] = None) -> str:
+    """Prometheus text exposition format."""
+    label_str = ""
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        label_str = "{" + inner + "}"
+    lines = []
+    for name in sorted(metrics):
+        lines.append(f"{name}{label_str} {metrics[name]}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """Serves ``/metrics`` (Prometheus text) + ``/healthz`` on a local port
+    (per-process, like xpu_timer's per-rank exporter ports)."""
+
+    def __init__(self, port: int = 0, labels: Optional[Dict[str, str]] = None):
+        self._labels = labels or {}
+        self._sources = []  # callables returning Dict[str, float]
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.startswith("/healthz"):
+                    body = b"ok"
+                    ctype = "text/plain"
+                elif self.path.startswith("/metrics"):
+                    merged: Dict[str, float] = {}
+                    for src in exporter._sources:
+                        try:
+                            merged.update(src())
+                        except Exception:
+                            pass
+                    body = render_prometheus(merged, exporter._labels).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def add_source(self, fn) -> None:
+        """``fn() -> Dict[str, float]`` merged into /metrics at scrape time."""
+        self._sources.append(fn)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="metrics-exporter"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

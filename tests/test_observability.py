@@ -1,0 +1,196 @@
+"""Observability + hang-detection tests (reference parity:
+elastic_agent/monitor/resource.py:86-180, monitor/training.py:77-134,
+master/stats/job_collector.py, atorch fault_tolerance/
+hanging_detector.py:86, xpu_timer Prometheus export)."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.monitor.hang import HangingDetector
+from dlrover_tpu.agent.monitor.resource import (
+    ResourceMonitor,
+    sample_resource_stats,
+)
+from dlrover_tpu.agent.monitor.training import (
+    TrainingMonitor,
+    read_runtime_metrics,
+    write_runtime_metrics,
+)
+from dlrover_tpu.master.stats.job_collector import (
+    JobMetricCollector,
+    LocalMetricReporter,
+)
+from dlrover_tpu.utils.profiler import (
+    MetricsExporter,
+    StepTimer,
+    render_prometheus,
+)
+
+
+def test_sample_resource_stats():
+    stats = sample_resource_stats(num_chips=4)
+    assert stats.memory_mb > 0
+    assert stats.tpu_chips == 4
+
+
+def test_resource_monitor_reports_to_master(local_master, master_client):
+    master, _ = local_master
+    monitor = ResourceMonitor(master_client, interval=60)
+    stats = monitor.report_once()
+    assert stats.memory_mb > 0
+    usage = master.job_metric_collector.node_usage
+    assert "worker-0" in usage
+    assert usage["worker-0"]["memory_mb"] == stats.memory_mb
+
+
+def test_training_monitor_reports_global_step(
+    local_master, master_client, tmp_path
+):
+    master, _ = local_master
+    path = str(tmp_path / "metrics.json")
+    write_runtime_metrics(7, elapsed_per_step=0.5, path=path)
+    assert read_runtime_metrics(path)["step"] == 7
+
+    monitor = TrainingMonitor(master_client, interval=60, path=path)
+    before = monitor.last_progress_time
+    time.sleep(0.01)
+    assert monitor.check_once() == 7
+    assert monitor.last_step == 7
+    assert monitor.last_progress_time > before
+    # the master saw the step (collector + speed monitor)
+    assert master.job_metric_collector.steps[-1]["step"] == 7
+
+    # no new step => no progress-time update
+    stamp = monitor.last_progress_time
+    monitor.check_once()
+    assert monitor.last_progress_time == stamp
+
+
+def test_hang_detector_fires_once():
+    det = HangingDetector(
+        progress_fn=lambda: 9999.0,
+        timeout=10.0,
+        grace_period=0.0,
+        max_triggers=1,
+    )
+    assert det.check_once(now=100.0)
+    assert not det.check_once(now=200.0)  # max_triggers reached
+    det.reset()  # re-arms grace (0.0) and trigger budget
+    assert det.check_once(now=time.time() + 300.0)
+
+
+def test_hang_detector_respects_grace_and_progress():
+    det = HangingDetector(
+        progress_fn=lambda: 5.0,
+        timeout=10.0,
+        grace_period=1000.0,
+    )
+    det.arm()
+    assert not det.check_once()  # inside grace
+    det._armed_at = 0.0
+    assert not det.check_once()  # progress below timeout
+
+
+def test_training_monitor_reset_counts_resumed_step_as_progress(
+    local_master, master_client, tmp_path
+):
+    """After a restart the trainer resumes BELOW the pre-crash step; the
+    reset must drop the high-water mark so that still counts as progress."""
+    path = str(tmp_path / "metrics.json")
+    monitor = TrainingMonitor(master_client, interval=60, path=path)
+    write_runtime_metrics(1000, path=path)
+    assert monitor.check_once() == 1000
+    monitor.reset_progress_clock()
+    assert monitor.last_step == -1
+    assert read_runtime_metrics(path) is None  # stale file dropped
+    write_runtime_metrics(950, path=path)  # resumed from checkpoint
+    before = monitor.last_progress_time
+    time.sleep(0.01)
+    assert monitor.check_once() == 950
+    assert monitor.last_progress_time > before
+
+
+def test_agent_restarts_on_hang(local_master, tmp_path):
+    """E2e: a worker that never reports progress gets restarted, then the
+    agent fails after max_restarts (reference relaunch-on-hang protocol)."""
+    _, addr = local_master
+    client = MasterClient(addr, node_id=0, node_type="worker")
+    metrics_path = str(tmp_path / "rt_metrics.json")
+    os.environ["DLROVER_RUNTIME_METRICS_PATH"] = metrics_path
+    try:
+        from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+
+        spec = WorkerSpec(
+            entrypoint=[sys.executable, "-c", "import time; time.sleep(60)"],
+            monitor_interval=0.2,
+            max_restarts=1,
+            hang_timeout=0.5,
+            hang_grace_period=0.0,
+            monitors=True,
+            flash_ckpt=False,
+        )
+        agent = ElasticAgent(client, 0, spec)
+        rc = agent.run()
+        assert rc == 1
+        assert agent._group.restart_count == 1
+    finally:
+        os.environ.pop("DLROVER_RUNTIME_METRICS_PATH", None)
+        client.close()
+
+
+def test_job_metric_collector_speed_and_dump(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    col = JobMetricCollector(LocalMetricReporter(path))
+    t0 = 1000.0
+    for i in range(5):
+        col.report_global_step(i * 10, t0 + i)
+    assert col.training_speed() == pytest.approx(10.0)
+    col.report_event("node_failed", "worker-1", "exit 9")
+    col.collect_job_meta(job="test", nodes=2)
+    m = col.get_job_metrics()
+    assert m["global_step"] == 40
+    assert m["speed_steps_per_sec"] == pytest.approx(10.0)
+    assert m["recent_events"][0]["event_type"] == "node_failed"
+    lines = [json.loads(x) for x in open(path)]
+    kinds = {r["kind"] for r in lines}
+    assert kinds == {"global_step", "event"}
+
+
+def test_step_timer_stats():
+    t = StepTimer()
+    for v in (0.1, 0.2, 0.3):
+        t.observe(v)
+    assert t.count == 3
+    assert 0.09 < t.percentile(50) < 0.31
+    m = t.metrics()
+    assert m["dlrover_step_count"] == 3.0
+    assert m["dlrover_step_seconds_total"] == pytest.approx(0.6)
+
+
+def test_metrics_exporter_serves_prometheus():
+    timer = StepTimer()
+    timer.observe(0.25)
+    exporter = MetricsExporter(labels={"rank": "0"})
+    exporter.add_source(timer.metrics)
+    exporter.start()
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'dlrover_step_count{rank="0"} 1.0' in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/healthz", timeout=5
+        ).read()
+        assert health == b"ok"
+    finally:
+        exporter.stop()
+
+
+def test_render_prometheus_format():
+    text = render_prometheus({"a_metric": 1.5}, {"node": "w0"})
+    assert text == 'a_metric{node="w0"} 1.5\n'
