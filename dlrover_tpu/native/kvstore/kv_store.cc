@@ -1,0 +1,874 @@
+// KvStore: host-side dynamic-vocab embedding store for TPU training.
+//
+// TPU-native counterpart of the reference's TFPlus KvVariable subsystem
+// (tfplus/tfplus/kv_variable/kernels/kv_variable.h:88-1021, hashmap.h,
+// kernels/training_ops.cc).  Same capabilities — dynamic vocabulary,
+// gather-or-insert / gather-or-zeros, frequency-based feature admission,
+// age/frequency/LRU eviction, full+delta export/import for checkpoint and
+// elastic resharding, per-row sparse optimizers, and a two-tier
+// (RAM + disk) hybrid storage mode — but a different architecture:
+// instead of TF custom ops inside the graph, this is a standalone C
+// library driven from Python via ctypes (calls release the GIL).  The
+// device never sees the hash table: lookups produce a dense [n, dim]
+// slab that JAX ships to the TPU, and gradients come back per unique id.
+// That split (host table / device dense math) is the idiomatic TPU
+// design — dynamic shapes and pointer chasing don't belong in XLA.
+//
+// Layout: a table is 16 independent stripes (hash-sharded by id), each
+// with its own mutex, open-addressing-free std::unordered_map index,
+// chunked row arena (stable row storage, free-list reuse), and metadata.
+// A row holds the embedding vector plus `num_slots` optimizer slot
+// vectors inline: stride = dim * (1 + num_slots).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (see
+// dlrover_tpu/sparse/native.py — no TF/Bazel dependency).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kStripes = 16;
+constexpr uint32_t kNoRow = 0xffffffffu;     // metadata exists, row not admitted
+constexpr uint32_t kRowsPerChunk = 1024;
+
+// ---------------------------------------------------------------------------
+// deterministic per-id init: splitmix64(seed ^ id) seeds a tiny PRNG, so a
+// row's initial value depends only on (table seed, id) — reproducible
+// across insert orders, restarts, and shards.
+// ---------------------------------------------------------------------------
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 1) {}
+  uint64_t next() {
+    s = splitmix64(s);
+    return s;
+  }
+  // uniform in [0, 1)
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+  // standard normal via Box-Muller
+  float normal() {
+    double u1 = uniform(), u2 = uniform();
+    if (u1 < 1e-300) u1 = 1e-300;
+    return static_cast<float>(std::sqrt(-2.0 * std::log(u1)) *
+                              std::cos(2.0 * M_PI * u2));
+  }
+};
+
+struct Meta {
+  int64_t id = 0;
+  uint32_t row = kNoRow;      // arena row; kNoRow until admitted
+  uint32_t freq = 0;          // saturating access counter
+  uint32_t last_day = 0;      // coarse timestamp (days) for age eviction
+  uint64_t last_access = 0;   // table access clock, for LRU
+  uint64_t version = 0;       // table version at last value update
+  bool live = false;          // admitted (has values)
+};
+
+// Metadata and value storage are decoupled: ids below the admission
+// threshold hold only a Meta (a few dozen bytes), not a stride-sized
+// arena row — the point of the frequency filter is to keep hapax ids
+// from pinning embedding memory.
+struct Stripe {
+  std::mutex mu;
+  std::unordered_map<int64_t, uint32_t> index;  // id -> meta slot
+  std::vector<Meta> meta;
+  std::vector<uint32_t> free_meta;
+  std::vector<std::unique_ptr<float[]>> chunks;
+  uint32_t arena_rows = 0;
+  std::vector<uint32_t> free_rows;
+
+  float* row_ptr(uint32_t row, uint32_t stride) {
+    return chunks[row / kRowsPerChunk].get() +
+           static_cast<size_t>(row % kRowsPerChunk) * stride;
+  }
+
+  uint32_t alloc_meta() {
+    if (!free_meta.empty()) {
+      uint32_t m = free_meta.back();
+      free_meta.pop_back();
+      meta[m] = Meta();
+      return m;
+    }
+    meta.emplace_back();
+    return static_cast<uint32_t>(meta.size() - 1);
+  }
+
+  uint32_t alloc_values(uint32_t stride) {
+    if (!free_rows.empty()) {
+      uint32_t r = free_rows.back();
+      free_rows.pop_back();
+      return r;
+    }
+    uint32_t r = arena_rows++;
+    if (r % kRowsPerChunk == 0) {
+      chunks.emplace_back(new float[static_cast<size_t>(kRowsPerChunk) * stride]);
+    }
+    return r;
+  }
+
+  void release(uint32_t meta_slot) {
+    Meta& m = meta[meta_slot];
+    if (m.row != kNoRow) free_rows.push_back(m.row);
+    m.row = kNoRow;
+    m.live = false;
+    free_meta.push_back(meta_slot);
+  }
+};
+
+// secondary (disk) tier for hybrid storage: append-only record file with an
+// in-memory id -> offset index.  Reference counterpart:
+// tfplus hybrid_embedding/{table_manager.h,storage_table.h}.
+struct SecondaryTier {
+  std::mutex mu;
+  std::unordered_map<int64_t, uint64_t> offsets;
+  std::string path;
+  FILE* f = nullptr;
+  uint64_t live_bytes = 0;
+
+  ~SecondaryTier() {
+    if (f) fclose(f);
+  }
+};
+
+struct Table {
+  uint32_t dim = 0;
+  uint32_t num_slots = 0;
+  uint32_t stride = 0;
+  uint64_t seed = 0;
+  float init_scale = 0.0f;      // stddev of N(0, scale); 0 => zeros init
+  uint32_t min_frequency = 0;   // admission threshold (<=1 admits everything)
+  std::atomic<uint64_t> version{0};
+  std::atomic<uint64_t> access_clock{0};
+  Stripe stripes[kStripes];
+  SecondaryTier secondary;
+
+  int stripe_of(int64_t id) const {
+    return static_cast<int>(splitmix64(static_cast<uint64_t>(id)) % kStripes);
+  }
+
+  void init_row(float* row, int64_t id) {
+    if (init_scale == 0.0f) {
+      std::memset(row, 0, sizeof(float) * stride);
+      return;
+    }
+    Rng rng(splitmix64(seed ^ static_cast<uint64_t>(id)));
+    for (uint32_t d = 0; d < dim; ++d) row[d] = rng.normal() * init_scale;
+    std::memset(row + dim, 0, sizeof(float) * (stride - dim));
+  }
+};
+
+inline uint32_t saturate_add(uint32_t a, uint32_t b) {
+  uint64_t s = static_cast<uint64_t>(a) + b;
+  return s > 0xffffffffull ? 0xffffffffu : static_cast<uint32_t>(s);
+}
+
+// Partition batch positions by stripe so each stripe is visited once under
+// one lock; output slots are disjoint so stripe jobs could run in parallel.
+void partition(const Table* t, const int64_t* ids, int64_t n,
+               std::vector<int64_t> (&by_stripe)[kStripes]) {
+  for (int64_t i = 0; i < n; ++i) {
+    by_stripe[t->stripe_of(ids[i])].push_back(i);
+  }
+}
+
+template <typename Fn>
+void for_stripes(const Table* t, const int64_t* ids, int64_t n, Fn fn) {
+  std::vector<int64_t> by_stripe[kStripes];
+  partition(t, ids, n, by_stripe);
+  if (n >= 8192) {
+    std::vector<std::thread> threads;
+    threads.reserve(kStripes);
+    for (int s = 0; s < kStripes; ++s) {
+      if (by_stripe[s].empty()) continue;
+      threads.emplace_back([&, s] { fn(s, by_stripe[s]); });
+    }
+    for (auto& th : threads) th.join();
+  } else {
+    for (int s = 0; s < kStripes; ++s) {
+      if (!by_stripe[s].empty()) fn(s, by_stripe[s]);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// secondary-tier helpers (caller holds tier.mu)
+// ---------------------------------------------------------------------------
+
+struct SecRecord {
+  int64_t id;
+  uint32_t freq;
+  uint32_t last_day;
+  uint64_t version;
+};
+
+bool sec_write(Table* t, const Meta& m, const float* row) {
+  SecondaryTier& tier = t->secondary;
+  if (!tier.f) return false;
+  if (fseek(tier.f, 0, SEEK_END) != 0) return false;
+  uint64_t off = static_cast<uint64_t>(ftell(tier.f));
+  SecRecord rec{m.id, m.freq, m.last_day, m.version};
+  if (fwrite(&rec, sizeof(rec), 1, tier.f) != 1) return false;
+  if (fwrite(row, sizeof(float), t->stride, tier.f) != t->stride) return false;
+  tier.offsets[m.id] = off;
+  tier.live_bytes += sizeof(rec) + sizeof(float) * t->stride;
+  return true;
+}
+
+bool sec_read(Table* t, uint64_t off, SecRecord* rec, float* row) {
+  SecondaryTier& tier = t->secondary;
+  if (!tier.f) return false;
+  if (fseek(tier.f, static_cast<long>(off), SEEK_SET) != 0) return false;
+  if (fread(rec, sizeof(*rec), 1, tier.f) != 1) return false;
+  if (fread(row, sizeof(float), t->stride, tier.f) != t->stride) return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// lifecycle
+// ---------------------------------------------------------------------------
+
+void* kv_create(uint32_t dim, uint32_t num_slots, uint64_t seed,
+                float init_scale, uint32_t min_frequency) {
+  if (dim == 0) return nullptr;
+  Table* t = new Table();
+  t->dim = dim;
+  t->num_slots = num_slots;
+  t->stride = dim * (1 + num_slots);
+  t->seed = seed;
+  t->init_scale = init_scale;
+  t->min_frequency = min_frequency;
+  return t;
+}
+
+void kv_free(void* h) { delete static_cast<Table*>(h); }
+
+int64_t kv_size(void* h) {
+  Table* t = static_cast<Table*>(h);
+  int64_t n = 0;
+  for (auto& s : t->stripes) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.index) {
+      if (s.meta[kv.second].live) ++n;
+    }
+  }
+  std::lock_guard<std::mutex> g(t->secondary.mu);
+  return n + static_cast<int64_t>(t->secondary.offsets.size());
+}
+
+uint64_t kv_version(void* h) {
+  return static_cast<Table*>(h)->version.load();
+}
+
+uint64_t kv_storage_bytes(void* h) {
+  Table* t = static_cast<Table*>(h);
+  uint64_t bytes = 0;
+  for (auto& s : t->stripes) {
+    std::lock_guard<std::mutex> g(s.mu);
+    bytes += s.chunks.size() * static_cast<uint64_t>(kRowsPerChunk) *
+             t->stride * sizeof(float);
+    bytes += s.meta.capacity() * sizeof(Meta);
+  }
+  return bytes;
+}
+
+// ---------------------------------------------------------------------------
+// gather
+// ---------------------------------------------------------------------------
+
+}  // extern "C"
+
+namespace {
+
+// core lookup used by both gather flavors.  `train` controls insertion and
+// frequency counting; unadmitted/unknown rows output zeros and flag 0.
+void gather_impl(Table* t, const int64_t* ids, int64_t n, float* out,
+                 uint8_t* admitted, uint32_t now_day, bool train) {
+  uint32_t dim = t->dim;
+  for_stripes(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    for (int64_t p : pos) {
+      int64_t id = ids[p];
+      float* dst = out + static_cast<size_t>(p) * dim;
+      auto it = st.index.find(id);
+      if (it == st.index.end()) {
+        // primary miss: maybe fault in from the secondary tier
+        bool faulted = false;
+        {
+          std::lock_guard<std::mutex> sg(t->secondary.mu);
+          auto sit = t->secondary.offsets.find(id);
+          if (sit != t->secondary.offsets.end()) {
+            std::vector<float> buf(t->stride);
+            SecRecord rec;
+            if (sec_read(t, sit->second, &rec, buf.data())) {
+              uint32_t mi = st.alloc_meta();
+              Meta& m = st.meta[mi];
+              m.row = st.alloc_values(t->stride);
+              std::memcpy(st.row_ptr(m.row, t->stride), buf.data(),
+                          sizeof(float) * t->stride);
+              m.id = id;
+              m.freq = rec.freq;
+              m.last_day = rec.last_day;
+              m.last_access = ++t->access_clock;
+              m.version = rec.version;
+              m.live = true;
+              st.index.emplace(id, mi);
+              t->secondary.offsets.erase(sit);
+              it = st.index.find(id);
+              faulted = true;
+            }
+          }
+        }
+        if (!faulted) {
+          if (!train) {
+            std::memset(dst, 0, sizeof(float) * dim);
+            if (admitted) admitted[p] = 0;
+            continue;
+          }
+          // first sighting: metadata only; values allocate once the id
+          // clears the admission threshold (reference
+          // kv_variable.h:326-352 low-frequency filter).
+          bool admit = t->min_frequency <= 1;
+          uint32_t mi = st.alloc_meta();
+          Meta& m = st.meta[mi];
+          if (admit) {
+            m.row = st.alloc_values(t->stride);
+            t->init_row(st.row_ptr(m.row, t->stride), id);
+          }
+          m.id = id;
+          m.freq = 1;
+          m.last_day = now_day;
+          m.last_access = ++t->access_clock;
+          m.version = admit ? t->version.load() : 0;
+          m.live = admit;
+          st.index.emplace(id, mi);
+          if (admit) {
+            std::memcpy(dst, st.row_ptr(m.row, t->stride),
+                        sizeof(float) * dim);
+            if (admitted) admitted[p] = 1;
+          } else {
+            std::memset(dst, 0, sizeof(float) * dim);
+            if (admitted) admitted[p] = 0;
+          }
+          continue;
+        }
+      }
+      Meta& m = st.meta[it->second];
+      if (train) {
+        m.freq = saturate_add(m.freq, 1);
+        m.last_day = now_day;
+        m.last_access = ++t->access_clock;
+        if (!m.live && m.freq >= t->min_frequency) {
+          // admission: materialize the deferred row
+          m.row = st.alloc_values(t->stride);
+          t->init_row(st.row_ptr(m.row, t->stride), id);
+          m.version = t->version.load();
+          m.live = true;
+        }
+      }
+      if (m.live) {
+        std::memcpy(dst, st.row_ptr(m.row, t->stride), sizeof(float) * dim);
+        if (admitted) admitted[p] = 1;
+      } else {
+        std::memset(dst, 0, sizeof(float) * dim);
+        if (admitted) admitted[p] = 0;
+      }
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+// training-path gather (reference KvVariableGatherOrInsert)
+void kv_gather_or_insert(void* h, const int64_t* ids, int64_t n, float* out,
+                         uint8_t* admitted, uint32_t now_day) {
+  gather_impl(static_cast<Table*>(h), ids, n, out, admitted, now_day, true);
+}
+
+// inference-path gather (reference KvVariableGatherOrZeros)
+void kv_gather_or_zeros(void* h, const int64_t* ids, int64_t n, float* out) {
+  gather_impl(static_cast<Table*>(h), ids, n, out, nullptr, 0, false);
+}
+
+void kv_frequencies(void* h, const int64_t* ids, int64_t n, uint32_t* out) {
+  Table* t = static_cast<Table*>(h);
+  for_stripes(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    for (int64_t p : pos) {
+      auto it = st.index.find(ids[p]);
+      out[p] = it == st.index.end() ? 0 : st.meta[it->second].freq;
+    }
+  });
+}
+
+// ---------------------------------------------------------------------------
+// scatter ops (reference KvVariableScatterAdd/Sub/Mul/Div/Update)
+// ---------------------------------------------------------------------------
+
+}  // extern "C"
+
+namespace {
+enum ScatterOp { kAdd = 0, kSub = 1, kMul = 2, kDiv = 3, kAssign = 4 };
+
+int64_t scatter_impl(Table* t, const int64_t* ids, const float* updates,
+                     int64_t n, int op) {
+  uint32_t dim = t->dim;
+  uint64_t ver = t->version.fetch_add(1) + 1;
+  std::atomic<int64_t> applied{0};
+  for_stripes(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    int64_t local = 0;
+    for (int64_t p : pos) {
+      auto it = st.index.find(ids[p]);
+      if (it == st.index.end() || !st.meta[it->second].live) continue;
+      float* row = st.row_ptr(st.meta[it->second].row, t->stride);
+      const float* u = updates + static_cast<size_t>(p) * dim;
+      switch (op) {
+        case kAdd: for (uint32_t d = 0; d < dim; ++d) row[d] += u[d]; break;
+        case kSub: for (uint32_t d = 0; d < dim; ++d) row[d] -= u[d]; break;
+        case kMul: for (uint32_t d = 0; d < dim; ++d) row[d] *= u[d]; break;
+        case kDiv: for (uint32_t d = 0; d < dim; ++d) row[d] /= u[d]; break;
+        case kAssign: std::memcpy(row, u, sizeof(float) * dim); break;
+      }
+      st.meta[it->second].version = ver;
+      ++local;
+    }
+    applied += local;
+  });
+  return applied.load();
+}
+}  // namespace
+
+// returns #rows actually updated (absent/unadmitted ids are skipped)
+extern "C" int64_t kv_scatter(void* h, const int64_t* ids,
+                              const float* updates, int64_t n, int op) {
+  return scatter_impl(static_cast<Table*>(h), ids, updates, n, op);
+}
+
+// ---------------------------------------------------------------------------
+// sparse optimizers (reference tfplus kernels/training_ops.cc).
+// Each applies one update per unique id; ids absent or unadmitted are
+// skipped (their gradient came from a zero row).  Slot layout per row:
+// optimizer-specific, documented per function.  Returns #rows updated.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+template <typename Fn>
+int64_t apply_impl(Table* t, const int64_t* ids, const float* grads,
+                   int64_t n, Fn update) {
+  uint32_t dim = t->dim;
+  uint64_t ver = t->version.fetch_add(1) + 1;
+  std::atomic<int64_t> applied{0};
+  for_stripes(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    int64_t local = 0;
+    for (int64_t p : pos) {
+      auto it = st.index.find(ids[p]);
+      if (it == st.index.end() || !st.meta[it->second].live) continue;
+      float* row = st.row_ptr(st.meta[it->second].row, t->stride);
+      update(row, row + dim, grads + static_cast<size_t>(p) * dim);
+      st.meta[it->second].version = ver;
+      ++local;
+    }
+    applied += local;
+  });
+  return applied.load();
+}
+
+}  // namespace
+
+extern "C" {
+
+// slots: [accum]
+int64_t kv_apply_adagrad(void* h, const int64_t* ids, const float* grads,
+                         int64_t n, float lr, float eps) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* acc = slots;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        acc[d] += g[d] * g[d];
+                        w[d] -= lr * g[d] / (std::sqrt(acc[d]) + eps);
+                      }
+                    });
+}
+
+// slots: [m, v]; t_step is the global step for bias correction
+int64_t kv_apply_adam(void* h, const int64_t* ids, const float* grads,
+                      int64_t n, float lr, float beta1, float beta2,
+                      float eps, int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  float corr = static_cast<float>(std::sqrt(bc2) / bc1);
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* m = slots;
+                      float* v = slots + dim;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        float gd = g[d] + weight_decay * w[d];
+                        m[d] = beta1 * m[d] + (1 - beta1) * gd;
+                        v[d] = beta2 * v[d] + (1 - beta2) * gd * gd;
+                        w[d] -= lr * corr * m[d] / (std::sqrt(v[d]) + eps);
+                      }
+                    });
+}
+
+// slots: [momentum]
+int64_t kv_apply_momentum(void* h, const int64_t* ids, const float* grads,
+                          int64_t n, float lr, float momentum) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* mom = slots;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        mom[d] = momentum * mom[d] + g[d];
+                        w[d] -= lr * mom[d];
+                      }
+                    });
+}
+
+// slots: [z, n] (FTRL-proximal per McMahan et al.; reference
+// training_ops.cc FTRL).  lr_power is positive: 0.5 => sqrt schedule.
+int64_t kv_apply_ftrl(void* h, const int64_t* ids, const float* grads,
+                      int64_t n, float lr, float l1, float l2,
+                      float lr_power) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* z = slots;
+                      float* acc = slots + dim;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        float new_acc = acc[d] + g[d] * g[d];
+                        float sigma = (std::pow(new_acc, lr_power) -
+                                       std::pow(acc[d], lr_power)) / lr;
+                        z[d] += g[d] - sigma * w[d];
+                        acc[d] = new_acc;
+                        if (std::fabs(z[d]) <= l1) {
+                          w[d] = 0.0f;
+                        } else {
+                          float sign = z[d] > 0 ? 1.0f : -1.0f;
+                          w[d] = -(z[d] - sign * l1) /
+                                 (std::pow(new_acc, lr_power) / lr + 2 * l2);
+                        }
+                      }
+                    });
+}
+
+// slots: [m, s] — AdaBelief (Zhuang et al. 2020): v tracks (g - m)^2
+int64_t kv_apply_adabelief(void* h, const int64_t* ids, const float* grads,
+                           int64_t n, float lr, float beta1, float beta2,
+                           float eps, int64_t t_step) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  float corr = static_cast<float>(std::sqrt(bc2) / bc1);
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* m = slots;
+                      float* s = slots + dim;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        m[d] = beta1 * m[d] + (1 - beta1) * g[d];
+                        float diff = g[d] - m[d];
+                        s[d] = beta2 * s[d] + (1 - beta2) * diff * diff + eps;
+                        w[d] -= lr * corr * m[d] / (std::sqrt(s[d]) + eps);
+                      }
+                    });
+}
+
+// slots: [m, v] — Group AdamW ("rectified" group-lasso variant, the
+// sparse-group regularizer of reference training_ops.cc GroupAdam /
+// arXiv:2107.14432): adam step then row-level soft threshold, which
+// drives whole embedding rows to zero so they can be evicted.
+int64_t kv_apply_group_adam(void* h, const int64_t* ids, const float* grads,
+                            int64_t n, float lr, float beta1, float beta2,
+                            float eps, int64_t t_step, float l21) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  float corr = static_cast<float>(std::sqrt(bc2) / bc1);
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* m = slots;
+                      float* v = slots + dim;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        m[d] = beta1 * m[d] + (1 - beta1) * g[d];
+                        v[d] = beta2 * v[d] + (1 - beta2) * g[d] * g[d];
+                        w[d] -= lr * corr * m[d] / (std::sqrt(v[d]) + eps);
+                      }
+                      if (l21 > 0) {
+                        float norm = 0;
+                        for (uint32_t d = 0; d < dim; ++d) norm += w[d] * w[d];
+                        norm = std::sqrt(norm);
+                        float shrink =
+                            norm > lr * l21 ? (norm - lr * l21) / norm : 0.0f;
+                        for (uint32_t d = 0; d < dim; ++d) w[d] *= shrink;
+                      }
+                    });
+}
+
+// ---------------------------------------------------------------------------
+// eviction (reference kv_variable.h eviction by frequency/time) and
+// hybrid-tier spill
+// ---------------------------------------------------------------------------
+
+// remove ids with freq < min_freq or last_day < oldest_day.  Returns count.
+int64_t kv_evict(void* h, uint32_t min_freq, uint32_t oldest_day) {
+  Table* t = static_cast<Table*>(h);
+  int64_t evicted = 0;
+  for (auto& st : t->stripes) {
+    std::lock_guard<std::mutex> g(st.mu);
+    for (auto it = st.index.begin(); it != st.index.end();) {
+      Meta& m = st.meta[it->second];
+      if (m.freq < min_freq || m.last_day < oldest_day) {
+        st.release(it->second);
+        it = st.index.erase(it);
+        ++evicted;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return evicted;
+}
+
+int kv_secondary_open(void* h, const char* path) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->secondary.mu);
+  if (t->secondary.f) fclose(t->secondary.f);
+  t->secondary.offsets.clear();
+  t->secondary.path = path;
+  t->secondary.f = fopen(path, "w+b");
+  return t->secondary.f ? 0 : -1;
+}
+
+// move the coldest (LRU) rows to the secondary tier until at most
+// `target_resident` rows remain in RAM.  Returns rows spilled (<0 on io
+// error / tier not open).
+int64_t kv_spill(void* h, int64_t target_resident) {
+  Table* t = static_cast<Table*>(h);
+  {
+    std::lock_guard<std::mutex> g(t->secondary.mu);
+    if (!t->secondary.f) return -1;
+  }
+  // collect (last_access, stripe, meta slot) for all live rows
+  struct Cold { uint64_t access; int stripe; uint32_t slot; };
+  std::vector<Cold> rows;
+  for (int s = 0; s < kStripes; ++s) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    for (auto& kv : st.index) {
+      if (st.meta[kv.second].live) {
+        rows.push_back({st.meta[kv.second].last_access, s, kv.second});
+      }
+    }
+  }
+  if (static_cast<int64_t>(rows.size()) <= target_resident) return 0;
+  int64_t to_spill = static_cast<int64_t>(rows.size()) - target_resident;
+  std::nth_element(rows.begin(), rows.begin() + to_spill, rows.end(),
+                   [](const Cold& a, const Cold& b) {
+                     return a.access < b.access;
+                   });
+  int64_t spilled = 0;
+  for (int64_t i = 0; i < to_spill; ++i) {
+    Stripe& st = t->stripes[rows[i].stripe];
+    std::lock_guard<std::mutex> g(st.mu);
+    Meta& m = st.meta[rows[i].slot];
+    if (!m.live) continue;  // raced with eviction
+    std::lock_guard<std::mutex> sg(t->secondary.mu);
+    if (!sec_write(t, m, st.row_ptr(m.row, t->stride))) return spilled;
+    st.index.erase(m.id);
+    st.release(rows[i].slot);
+    ++spilled;
+  }
+  return spilled;
+}
+
+int64_t kv_secondary_size(void* h) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->secondary.mu);
+  return static_cast<int64_t>(t->secondary.offsets.size());
+}
+
+// ---------------------------------------------------------------------------
+// export / import: full and delta (rows updated after `since_version`),
+// for checkpoint and elastic resharding (reference kv_variable.h:580-640
+// FullOrDeltaExport/Import).  Buffers are caller-allocated: call
+// kv_export_count first, then kv_export with capacity.  Values include
+// optimizer slots (stride floats per row).
+// ---------------------------------------------------------------------------
+
+int64_t kv_export_count(void* h, uint64_t since_version) {
+  Table* t = static_cast<Table*>(h);
+  int64_t n = 0;
+  for (auto& st : t->stripes) {
+    std::lock_guard<std::mutex> g(st.mu);
+    for (auto& kv : st.index) {
+      const Meta& m = st.meta[kv.second];
+      if (m.live && m.version >= since_version) ++n;
+    }
+  }
+  if (since_version == 0) {
+    std::lock_guard<std::mutex> g(t->secondary.mu);
+    n += static_cast<int64_t>(t->secondary.offsets.size());
+  }
+  return n;
+}
+
+int64_t kv_export(void* h, uint64_t since_version, int64_t* ids, float* values,
+                  uint32_t* freqs, uint32_t* days, uint64_t* versions,
+                  int64_t cap) {
+  Table* t = static_cast<Table*>(h);
+  int64_t n = 0;
+  for (auto& st : t->stripes) {
+    std::lock_guard<std::mutex> g(st.mu);
+    for (auto& kv : st.index) {
+      const Meta& m = st.meta[kv.second];
+      if (!m.live || m.version < since_version) continue;
+      if (n >= cap) return n;
+      ids[n] = m.id;
+      std::memcpy(values + static_cast<size_t>(n) * t->stride,
+                  st.row_ptr(m.row, t->stride), sizeof(float) * t->stride);
+      freqs[n] = m.freq;
+      days[n] = m.last_day;
+      versions[n] = m.version;
+      ++n;
+    }
+  }
+  if (since_version == 0) {
+    // full export also drains the secondary tier
+    std::lock_guard<std::mutex> g(t->secondary.mu);
+    for (auto& kv : t->secondary.offsets) {
+      if (n >= cap) return n;
+      SecRecord rec;
+      if (!sec_read(t, kv.second, &rec,
+                    values + static_cast<size_t>(n) * t->stride)) {
+        continue;
+      }
+      ids[n] = rec.id;
+      freqs[n] = rec.freq;
+      days[n] = rec.last_day;
+      versions[n] = rec.version;
+      ++n;
+    }
+  }
+  return n;
+}
+
+// upsert rows (values include slots).  Used for checkpoint restore and for
+// delta sync when resharding an elastic PS/embedding worker.
+void kv_import(void* h, const int64_t* ids, const float* values,
+               const uint32_t* freqs, const uint32_t* days,
+               const uint64_t* versions, int64_t n) {
+  Table* t = static_cast<Table*>(h);
+  // imported rows are stamped with a fresh table version so the next
+  // delta export includes them (their snapshot version is from the
+  // *source* table's clock, which is meaningless here)
+  uint64_t ver = t->version.fetch_add(1) + 1;
+  for_stripes(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    for (int64_t p : pos) {
+      int64_t id = ids[p];
+      auto it = st.index.find(id);
+      uint32_t mi;
+      if (it == st.index.end()) {
+        mi = st.alloc_meta();
+        st.index.emplace(id, mi);
+      } else {
+        mi = it->second;
+      }
+      Meta& m = st.meta[mi];
+      if (m.row == kNoRow) m.row = st.alloc_values(t->stride);
+      std::memcpy(st.row_ptr(m.row, t->stride),
+                  values + static_cast<size_t>(p) * t->stride,
+                  sizeof(float) * t->stride);
+      m.id = id;
+      m.freq = freqs ? freqs[p] : 1;
+      m.last_day = days ? days[p] : 0;
+      m.version = ver;
+      m.last_access = ++t->access_clock;
+      m.live = true;
+    }
+  });
+  // an upserted id must not survive as a stale secondary-tier record
+  // (double count in kv_size, duplicate + stale row in full export)
+  {
+    std::lock_guard<std::mutex> g(t->secondary.mu);
+    if (!t->secondary.offsets.empty()) {
+      for (int64_t p = 0; p < n; ++p) t->secondary.offsets.erase(ids[p]);
+    }
+  }
+}
+
+// drop every id whose hash-shard (splitmix64(id) % num_shards) != shard.
+// Used after an elastic resharding import so each worker retains only its
+// partition.  Returns rows dropped.
+int64_t kv_retain_shard(void* h, uint32_t shard, uint32_t num_shards) {
+  Table* t = static_cast<Table*>(h);
+  if (num_shards <= 1) return 0;
+  int64_t dropped = 0;
+  for (auto& st : t->stripes) {
+    std::lock_guard<std::mutex> g(st.mu);
+    for (auto it = st.index.begin(); it != st.index.end();) {
+      uint64_t hs = splitmix64(static_cast<uint64_t>(it->first)) % num_shards;
+      if (hs != shard) {
+        st.release(it->second);
+        it = st.index.erase(it);
+        ++dropped;
+      } else {
+        ++it;
+      }
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(t->secondary.mu);
+    for (auto it = t->secondary.offsets.begin();
+         it != t->secondary.offsets.end();) {
+      uint64_t hs = splitmix64(static_cast<uint64_t>(it->first)) % num_shards;
+      if (hs != shard) {
+        it = t->secondary.offsets.erase(it);
+        ++dropped;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return dropped;
+}
+
+}  // extern "C"

@@ -1,0 +1,12 @@
+"""Sparse embedding subsystem (TPU-native TFPlus KvVariable counterpart).
+
+Host-RAM dynamic-vocab hash-table embeddings with native C++ kernels,
+frequency admission / eviction, hybrid RAM+disk storage, full/delta
+export-import, and a hybrid host/device JAX train step.
+"""
+
+from dlrover_tpu.sparse.kv_variable import (  # noqa: F401
+    KvOptimizerConfig,
+    KvVariable,
+    get_kv_variable,
+)

@@ -1,0 +1,143 @@
+"""JAX wiring for KvVariable embeddings: hybrid host/device train step.
+
+Reference counterpart: the TFPlus python layer that plugs KvVariable
+gathers into the TF graph (tfplus/kv_variable/python/ops) and the sparse
+PS training path of dlrover's L5.  The TPU design splits the step:
+
+  host   : unique(ids) -> KvVariable.lookup -> dense slab [u, dim]
+  device : jit( slab[inverse] -> model -> loss; grad w.r.t. slab + dense )
+  host   : KvVariable.apply_gradients(unique_ids, slab_grad)
+
+Everything inside jit has static shapes (the slab is padded to a bucket
+size so XLA compiles once per bucket, not per batch), keeping the MXU
+busy while the hash table stays in host RAM where dynamic vocab belongs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.sparse.kv_variable import KvVariable
+
+
+def pad_bucket(n: int, bucket: int = 512) -> int:
+    """Round up to a bucket size so jit sees few distinct shapes."""
+    if n <= bucket:
+        return bucket
+    out = bucket
+    while out < n:
+        out *= 2
+    return out
+
+
+def unique_pad(
+    ids: np.ndarray, bucket: int = 512
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """np.unique only — returns (unique_ids, inverse, padded_len).
+
+    The *slab* (not the id list) is padded to the bucket size with zero
+    rows: padded positions never touch the hash table, so they can't
+    inflate frequency/LRU stats of a real id, and they receive zero
+    gradient because no batch position maps to them.
+    """
+    flat = np.ascontiguousarray(ids).reshape(-1)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    return (uniq, inverse.reshape(ids.shape).astype(np.int32),
+            pad_bucket(len(uniq), bucket))
+
+
+class KvEmbedding:
+    """One embedding feature backed by a KvVariable.
+
+    ``lookup_for_step`` produces the device-ready (slab, inverse) pair;
+    after the jitted step returns d(loss)/d(slab), ``apply_slab_grad``
+    routes per-row gradients into the native sparse optimizer.
+    """
+
+    def __init__(self, var: KvVariable, bucket: int = 512):
+        self.var = var
+        self.bucket = bucket
+        self._pending: Optional[Tuple[np.ndarray, int]] = None
+
+    def lookup_for_step(
+        self, ids: np.ndarray, train: bool = True
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        uniq, inverse, padded_len = unique_pad(ids, self.bucket)
+        slab = np.zeros((padded_len, self.var.dim), dtype=np.float32)
+        if len(uniq):
+            slab[: len(uniq)], _ = self.var.lookup(uniq, train=train)
+        if train:
+            self._pending = (uniq, len(uniq))
+        return jnp.asarray(slab), jnp.asarray(inverse)
+
+    def apply_slab_grad(self, slab_grad: Any) -> int:
+        assert self._pending is not None, "no pending lookup"
+        uniq, n = self._pending
+        self._pending = None
+        if n == 0:
+            return 0
+        g = np.asarray(slab_grad)[:n]
+        return self.var.apply_gradients(uniq, g)
+
+
+class SparseTrainStep:
+    """Hybrid train step over dense params + named KvEmbedding features.
+
+    ``loss_fn(dense_params, embeddings: {name: [batch..., dim]}, batch)``
+    runs under jit; embeddings are device-gathered from the slabs.
+    Dense params are updated by the caller-provided optax update fn;
+    sparse rows by the native kernels.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable[..., jnp.ndarray],
+        embeddings: Dict[str, KvEmbedding],
+        dense_update: Optional[Callable] = None,
+    ):
+        self.embeddings = embeddings
+        self._dense_update = dense_update
+        self._loss_fn = loss_fn
+        self._jitted = jax.jit(self._device_step)
+
+    def _device_step(self, dense_params, slabs, inverses, batch):
+        def compute(dense, slabs_):
+            embs = {
+                name: jnp.take(slabs_[name], inverses[name], axis=0)
+                for name in slabs_
+            }
+            return self._loss_fn(dense, embs, batch)
+
+        (loss, dense_grads), slab_grads = _value_and_both_grads(
+            compute, dense_params, slabs)
+        return loss, dense_grads, slab_grads
+
+    def __call__(self, dense_params, id_batches: Dict[str, np.ndarray],
+                 batch: Any):
+        """Returns (loss, new_dense_params)."""
+        slabs, inverses = {}, {}
+        for name, emb in self.embeddings.items():
+            slabs[name], inverses[name] = emb.lookup_for_step(
+                id_batches[name], train=True)
+        loss, dense_grads, slab_grads = self._jitted(
+            dense_params, slabs, inverses, batch)
+        for name, emb in self.embeddings.items():
+            emb.apply_slab_grad(slab_grads[name])
+        if self._dense_update is not None:
+            dense_params = self._dense_update(dense_params, dense_grads)
+        return loss, dense_params
+
+
+def _value_and_both_grads(fn, dense, slabs):
+    """((loss, d/d_dense), d/d_slabs) in one backward pass."""
+
+    def wrapped(d, s):
+        return fn(d, s)
+
+    (loss, (dg, sg)) = jax.value_and_grad(wrapped, argnums=(0, 1))(
+        dense, slabs)
+    return (loss, dg), sg

@@ -1,0 +1,328 @@
+"""KvVariable: dynamic-vocabulary embedding variable on the native store.
+
+Parity targets in the reference:
+- `KvVariable` core (tfplus/tfplus/kv_variable/kernels/kv_variable.h:88-1021)
+  — gather-or-insert/zeros, frequency admission, eviction, full/delta
+  export-import, sharded storage;
+- op registry (kv_variable/ops/kv_variable_ops.cc:37-560);
+- python layer `get_kv_variable` (tfplus/kv_variable/python/ops).
+
+TPU-native shape: the variable lives in host RAM (a C++ striped hash
+table); training gathers a dense [n_unique, dim] slab that JAX moves to
+the device, and the sparse optimizer applies per-row updates back on the
+host.  See :mod:`dlrover_tpu.sparse.embedding` for the JAX wiring.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.sparse import native
+
+# slot requirements per optimizer kernel (rows store dim*(1+slots) floats)
+OPTIMIZER_SLOTS = {
+    "sgd": 0,
+    "adagrad": 1,
+    "momentum": 1,
+    "adam": 2,
+    "ftrl": 2,
+    "adabelief": 2,
+    "group_adam": 2,
+}
+
+
+def _days_now() -> int:
+    return int(time.time() // 86400)
+
+
+@dataclasses.dataclass
+class KvOptimizerConfig:
+    """Hyperparameters for the native sparse optimizers (reference
+    training_ops.cc kernels)."""
+
+    name: str = "adagrad"
+    learning_rate: float = 0.05
+    eps: float = 1e-8
+    beta1: float = 0.9
+    beta2: float = 0.999
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    ftrl_l1: float = 0.0
+    ftrl_l2: float = 0.0
+    ftrl_lr_power: float = 0.5
+    group_l21: float = 0.0
+
+
+class KvVariable:
+    """A hash-table embedding variable with optimizer slots.
+
+    Args:
+        dim: embedding dimension.
+        optimizer: one of OPTIMIZER_SLOTS (decides slot storage).
+        init_scale: stddev of the N(0, scale) row init; 0 = zeros.
+        min_frequency: admission threshold — ids seen fewer times get a
+            zero embedding and no training until admitted (reference
+            kv_variable.h:326-352 low-frequency filter).
+        seed: init seed; row init is a pure function of (seed, id).
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        optimizer: str = "adagrad",
+        init_scale: float = 0.01,
+        min_frequency: int = 0,
+        seed: int = 0,
+        opt_config: Optional[KvOptimizerConfig] = None,
+    ):
+        if optimizer not in OPTIMIZER_SLOTS:
+            raise ValueError(f"unknown sparse optimizer: {optimizer}")
+        self.dim = dim
+        self.optimizer = optimizer
+        self.num_slots = OPTIMIZER_SLOTS[optimizer]
+        self.stride = dim * (1 + self.num_slots)
+        self.opt = opt_config or KvOptimizerConfig(name=optimizer)
+        self.opt.name = optimizer
+        self._lib = native.load_library()
+        self._handle = self._lib.kv_create(
+            dim, self.num_slots, seed, float(init_scale), int(min_frequency)
+        )
+        if not self._handle:
+            raise RuntimeError("kv_create failed")
+        self._step = 0  # for adam-family bias correction
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.kv_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    # -- introspection ----------------------------------------------------
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._handle))
+
+    @property
+    def version(self) -> int:
+        return int(self._lib.kv_version(self._handle))
+
+    def storage_bytes(self) -> int:
+        return int(self._lib.kv_storage_bytes(self._handle))
+
+    def frequencies(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        out = np.zeros(len(ids), dtype=np.uint32)
+        self._lib.kv_frequencies(
+            self._handle, native.as_ptr(ids, ctypes.c_int64), len(ids),
+            native.as_ptr(out, ctypes.c_uint32))
+        return out
+
+    # -- gather -----------------------------------------------------------
+    def lookup(
+        self, ids: np.ndarray, train: bool = True
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather rows for (possibly repeated) ids.
+
+        Returns (values [n, dim] float32, admitted [n] bool).  With
+        ``train=True`` unknown ids are inserted and frequencies counted
+        (gather-or-insert); otherwise unknown ids read zeros
+        (gather-or-zeros) and admitted is all-True heuristically.
+        """
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        n = len(ids)
+        out = np.empty((n, self.dim), dtype=np.float32)
+        if train:
+            admitted = np.empty(n, dtype=np.uint8)
+            self._lib.kv_gather_or_insert(
+                self._handle, native.as_ptr(ids, ctypes.c_int64), n,
+                native.as_ptr(out, ctypes.c_float),
+                native.as_ptr(admitted, ctypes.c_uint8), _days_now())
+            return out, admitted.astype(bool)
+        self._lib.kv_gather_or_zeros(
+            self._handle, native.as_ptr(ids, ctypes.c_int64), n,
+            native.as_ptr(out, ctypes.c_float))
+        return out, np.ones(n, dtype=bool)
+
+    # -- scatter ----------------------------------------------------------
+    def scatter(
+        self, ids: np.ndarray, updates: np.ndarray, op: str = "add"
+    ) -> int:
+        """Elementwise row update; returns rows actually touched (absent
+        or unadmitted ids are skipped)."""
+        ops = {"add": 0, "sub": 1, "mul": 2, "div": 3, "assign": 4}
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        updates = np.ascontiguousarray(updates, dtype=np.float32)
+        assert updates.shape == (len(ids), self.dim)
+        return int(self._lib.kv_scatter(
+            self._handle, native.as_ptr(ids, ctypes.c_int64),
+            native.as_ptr(updates, ctypes.c_float), len(ids), ops[op]))
+
+    # -- training ---------------------------------------------------------
+    def apply_gradients(self, ids: np.ndarray, grads: np.ndarray) -> int:
+        """One sparse optimizer step for unique ``ids`` with per-row
+        ``grads`` [n, dim].  Rows absent or unadmitted are skipped (their
+        forward value was zeros).  Returns rows updated."""
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        assert grads.shape == (len(ids), self.dim), grads.shape
+        n = len(ids)
+        o = self.opt
+        self._step += 1
+        lib, h = self._lib, self._handle
+        idp = native.as_ptr(ids, ctypes.c_int64)
+        gp = native.as_ptr(grads, ctypes.c_float)
+        if o.name == "sgd":
+            # plain scatter-sub of lr*g — no slots
+            return self.scatter(ids, o.learning_rate * grads, op="sub")
+        if o.name == "adagrad":
+            return int(lib.kv_apply_adagrad(h, idp, gp, n, o.learning_rate,
+                                            o.eps))
+        if o.name == "momentum":
+            return int(lib.kv_apply_momentum(h, idp, gp, n, o.learning_rate,
+                                             o.momentum))
+        if o.name == "adam":
+            return int(lib.kv_apply_adam(h, idp, gp, n, o.learning_rate,
+                                         o.beta1, o.beta2, o.eps, self._step,
+                                         o.weight_decay))
+        if o.name == "ftrl":
+            return int(lib.kv_apply_ftrl(h, idp, gp, n, o.learning_rate,
+                                         o.ftrl_l1, o.ftrl_l2,
+                                         o.ftrl_lr_power))
+        if o.name == "adabelief":
+            return int(lib.kv_apply_adabelief(h, idp, gp, n, o.learning_rate,
+                                              o.beta1, o.beta2, o.eps,
+                                              self._step))
+        if o.name == "group_adam":
+            return int(lib.kv_apply_group_adam(h, idp, gp, n, o.learning_rate,
+                                               o.beta1, o.beta2, o.eps,
+                                               self._step, o.group_l21))
+        raise AssertionError(o.name)
+
+    # -- eviction / hybrid storage ---------------------------------------
+    def evict(self, min_frequency: int = 0, max_age_days: int = 0) -> int:
+        """Drop rows below ``min_frequency`` or idle for more than
+        ``max_age_days`` (reference feature eviction)."""
+        oldest_day = _days_now() - max_age_days if max_age_days > 0 else 0
+        return int(self._lib.kv_evict(self._handle, int(min_frequency),
+                                      int(oldest_day)))
+
+    def enable_secondary(self, path: str) -> None:
+        """Open the disk tier (hybrid embedding: reference
+        hybrid_embedding/table_manager.h).  Cold rows move there via
+        :meth:`spill` and fault back in transparently on lookup."""
+        rc = self._lib.kv_secondary_open(self._handle, path.encode())
+        if rc != 0:
+            raise OSError(f"cannot open secondary tier at {path}")
+
+    def spill(self, max_resident_rows: int) -> int:
+        """LRU-spill rows to the secondary tier until at most
+        ``max_resident_rows`` stay in RAM.  Returns rows spilled."""
+        spilled = int(self._lib.kv_spill(self._handle, int(max_resident_rows)))
+        if spilled < 0:
+            raise OSError("secondary tier not open")
+        return spilled
+
+    def secondary_size(self) -> int:
+        return int(self._lib.kv_secondary_size(self._handle))
+
+    # -- export / import --------------------------------------------------
+    def export(self, since_version: int = 0) -> Dict[str, np.ndarray]:
+        """Full (since_version=0) or delta export of rows incl. optimizer
+        slots + admission metadata (reference FullOrDeltaExport)."""
+        cap = int(self._lib.kv_export_count(self._handle, since_version))
+        ids = np.empty(cap, dtype=np.int64)
+        values = np.empty((cap, self.stride), dtype=np.float32)
+        freqs = np.empty(cap, dtype=np.uint32)
+        days = np.empty(cap, dtype=np.uint32)
+        versions = np.empty(cap, dtype=np.uint64)
+        n = int(self._lib.kv_export(
+            self._handle, since_version,
+            native.as_ptr(ids, ctypes.c_int64),
+            native.as_ptr(values, ctypes.c_float),
+            native.as_ptr(freqs, ctypes.c_uint32),
+            native.as_ptr(days, ctypes.c_uint32),
+            native.as_ptr(versions, ctypes.c_uint64), cap))
+        return {
+            "ids": ids[:n].copy(),
+            "values": values[:n].copy(),
+            "freqs": freqs[:n].copy(),
+            "days": days[:n].copy(),
+            "versions": versions[:n].copy(),
+            "step": np.int64(self._step),
+        }
+
+    def import_(self, snapshot: Dict[str, np.ndarray]) -> None:
+        ids = np.ascontiguousarray(snapshot["ids"], dtype=np.int64)
+        values = np.ascontiguousarray(snapshot["values"], dtype=np.float32)
+        n = len(ids)
+        assert values.shape == (n, self.stride), values.shape
+        freqs = np.ascontiguousarray(
+            snapshot.get("freqs", np.ones(n)), dtype=np.uint32)
+        days = np.ascontiguousarray(
+            snapshot.get("days", np.zeros(n)), dtype=np.uint32)
+        versions = np.ascontiguousarray(
+            snapshot.get("versions", np.zeros(n)), dtype=np.uint64)
+        self._lib.kv_import(
+            self._handle, native.as_ptr(ids, ctypes.c_int64),
+            native.as_ptr(values, ctypes.c_float),
+            native.as_ptr(freqs, ctypes.c_uint32),
+            native.as_ptr(days, ctypes.c_uint32),
+            native.as_ptr(versions, ctypes.c_uint64), n)
+        if "step" in snapshot:
+            self._step = max(self._step, int(snapshot["step"]))
+
+    def retain_shard(self, shard: int, num_shards: int) -> int:
+        """Keep only ids hashing to ``shard`` — elastic resharding after a
+        full import (reference sharded export/import)."""
+        return int(self._lib.kv_retain_shard(self._handle, shard, num_shards))
+
+    # -- checkpoint through CheckpointStorage -----------------------------
+    def save(self, storage, path: str) -> None:
+        """Write a full snapshot through a
+        :class:`dlrover_tpu.common.storage.CheckpointStorage`."""
+        import io
+
+        snap = self.export()
+        buf = io.BytesIO()
+        np.savez(buf, **snap)
+        storage.write(buf.getvalue(), path)
+
+    def restore(self, storage, path: str) -> bool:
+        import io
+
+        data = storage.read(path, mode="rb")
+        if not data:
+            return False
+        snap = dict(np.load(io.BytesIO(data)))
+        self.import_(snap)
+        return True
+
+
+def get_kv_variable(
+    name: str,
+    embedding_dim: int,
+    registry: Optional[Dict[str, KvVariable]] = None,
+    **kwargs,
+) -> KvVariable:
+    """variable_scope-style accessor (reference python `get_kv_variable`):
+    returns the existing variable for ``name`` or creates it."""
+    registry = _GLOBAL_REGISTRY if registry is None else registry
+    if name in registry:
+        var = registry[name]
+        if var.dim != embedding_dim:
+            raise ValueError(
+                f"kv_variable {name} exists with dim={var.dim}, "
+                f"requested {embedding_dim}")
+        return var
+    var = KvVariable(embedding_dim, **kwargs)
+    registry[name] = var
+    return var
+
+
+_GLOBAL_REGISTRY: Dict[str, KvVariable] = {}

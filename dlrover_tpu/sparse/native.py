@@ -1,0 +1,142 @@
+"""ctypes bindings + on-demand build of the native KvStore library.
+
+The reference builds its KvVariable ops with Bazel against TensorFlow
+headers (tfplus/WORKSPACE); here the store is a freestanding C++17
+library with a C ABI, compiled once with g++ and loaded via ctypes —
+no framework headers, and every call releases the GIL (ctypes does this
+for CDLL), so lookups overlap with JAX dispatch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "native", "kvstore",
+                    "kv_store.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "..", "native", "_build")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library(src: str, out: str) -> None:
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", out, src,
+    ]
+    logger.info("building kvstore native library: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if stale) the kvstore shared library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = os.environ.get("DLROVER_KVSTORE_SO")
+        if not so:
+            src = os.path.abspath(_SRC)
+            so = os.path.join(os.path.abspath(_BUILD_DIR), "libkvstore.so")
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                try:
+                    _build_library(src, so)
+                except subprocess.CalledProcessError as e:
+                    raise RuntimeError(
+                        f"kvstore build failed:\n{e.stderr}"
+                    ) from e
+        lib = ctypes.CDLL(so)
+        _declare(lib)
+        _lib = lib
+        return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    p = c.POINTER
+    lib.kv_create.restype = c.c_void_p
+    lib.kv_create.argtypes = [c.c_uint32, c.c_uint32, c.c_uint64, c.c_float,
+                              c.c_uint32]
+    lib.kv_free.argtypes = [c.c_void_p]
+    lib.kv_size.restype = c.c_int64
+    lib.kv_size.argtypes = [c.c_void_p]
+    lib.kv_version.restype = c.c_uint64
+    lib.kv_version.argtypes = [c.c_void_p]
+    lib.kv_storage_bytes.restype = c.c_uint64
+    lib.kv_storage_bytes.argtypes = [c.c_void_p]
+    lib.kv_gather_or_insert.argtypes = [
+        c.c_void_p, p(c.c_int64), c.c_int64, p(c.c_float), p(c.c_uint8),
+        c.c_uint32]
+    lib.kv_gather_or_zeros.argtypes = [
+        c.c_void_p, p(c.c_int64), c.c_int64, p(c.c_float)]
+    lib.kv_frequencies.argtypes = [
+        c.c_void_p, p(c.c_int64), c.c_int64, p(c.c_uint32)]
+    lib.kv_scatter.restype = c.c_int64
+    lib.kv_scatter.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_int]
+    lib.kv_apply_adagrad.restype = c.c_int64
+    lib.kv_apply_adagrad.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float]
+    lib.kv_apply_adam.restype = c.c_int64
+    lib.kv_apply_adam.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_momentum.restype = c.c_int64
+    lib.kv_apply_momentum.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float]
+    lib.kv_apply_ftrl.restype = c.c_int64
+    lib.kv_apply_ftrl.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float]
+    lib.kv_apply_adabelief.restype = c.c_int64
+    lib.kv_apply_adabelief.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64]
+    lib.kv_apply_group_adam.restype = c.c_int64
+    lib.kv_apply_group_adam.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_evict.restype = c.c_int64
+    lib.kv_evict.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
+    lib.kv_secondary_open.restype = c.c_int
+    lib.kv_secondary_open.argtypes = [c.c_void_p, c.c_char_p]
+    lib.kv_spill.restype = c.c_int64
+    lib.kv_spill.argtypes = [c.c_void_p, c.c_int64]
+    lib.kv_secondary_size.restype = c.c_int64
+    lib.kv_secondary_size.argtypes = [c.c_void_p]
+    lib.kv_export_count.restype = c.c_int64
+    lib.kv_export_count.argtypes = [c.c_void_p, c.c_uint64]
+    lib.kv_export.restype = c.c_int64
+    lib.kv_export.argtypes = [
+        c.c_void_p, c.c_uint64, p(c.c_int64), p(c.c_float), p(c.c_uint32),
+        p(c.c_uint32), p(c.c_uint64), c.c_int64]
+    lib.kv_import.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), p(c.c_uint32), p(c.c_uint32),
+        p(c.c_uint64), c.c_int64]
+    lib.kv_retain_shard.restype = c.c_int64
+    lib.kv_retain_shard.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
+
+
+def as_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def check_toolchain() -> Optional[str]:
+    """Returns None when the native path is usable, else a skip reason."""
+    try:
+        load_library()
+        return None
+    except (RuntimeError, OSError, FileNotFoundError) as e:
+        return str(e)
