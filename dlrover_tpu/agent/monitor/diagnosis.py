@@ -1,0 +1,127 @@
+"""Agent-side diagnosis collectors: ship evidence to the master.
+
+Parity target: reference dlrover/python/elastic_agent/monitor/diagnosis.py
+(``DiagnosisMonitor``) + datacollector/{log_collector,metrics_collector}.py
+— periodic collectors gather worker log tails and runtime metrics and
+report them as ``DiagnosisReportData``; the master's InferenceChain turns
+them into hang/OOM/failure conclusions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from abc import ABCMeta, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class DataCollector(metaclass=ABCMeta):
+    """One evidence source (reference datacollector/data_collector.py)."""
+
+    @abstractmethod
+    def collect(self) -> Optional[comm.DiagnosisReportData]: ...
+
+
+class MetricsCollector(DataCollector):
+    """Latest runtime-metrics snapshot (data_cls="metrics")."""
+
+    def __init__(self, node_id: int, path: Optional[str] = None):
+        from dlrover_tpu.agent.monitor.training import metrics_path
+
+        self._node_id = node_id
+        self._path = path or metrics_path()
+
+    def collect(self) -> Optional[comm.DiagnosisReportData]:
+        try:
+            with open(self._path) as f:
+                content = f.read()
+            payload = json.loads(content)  # only ship well-formed snapshots
+        except (OSError, ValueError):
+            return None
+        # the timestamp is the TRAINER's write time, not collection time:
+        # a hung trainer with a live agent must look stale to the master's
+        # hang operator
+        ts = float(payload.get("timestamp", 0.0)) or os.path.getmtime(
+            self._path)
+        return comm.DiagnosisReportData(
+            data_cls="metrics",
+            data_content=content,
+            node_id=self._node_id,
+            timestamp=ts,
+        )
+
+
+class LogCollector(DataCollector):
+    """Worker log tail (data_cls="log"; reference log_collector.py)."""
+
+    def __init__(self, node_id: int, log_path: str, max_bytes: int = 16384):
+        self._node_id = node_id
+        self._log_path = log_path
+        self._max_bytes = max_bytes
+
+    def collect(self) -> Optional[comm.DiagnosisReportData]:
+        try:
+            size = os.path.getsize(self._log_path)
+            with open(self._log_path, "rb") as f:
+                f.seek(max(0, size - self._max_bytes))
+                tail = f.read().decode("utf-8", errors="replace")
+        except OSError:
+            return None
+        return comm.DiagnosisReportData(
+            data_cls="log",
+            data_content=tail,
+            node_id=self._node_id,
+            timestamp=time.time(),
+        )
+
+
+class DiagnosisReporter:
+    """Runs collectors periodically and reports upstream."""
+
+    def __init__(self, client, collectors: List[DataCollector],
+                 interval: float = 60.0):
+        self._client = client
+        self._collectors = collectors
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def report_once(self) -> int:
+        sent = 0
+        for collector in self._collectors:
+            try:
+                data = collector.collect()
+            except Exception:
+                logger.exception("collector %s failed", collector)
+                continue
+            if data is None:
+                continue
+            try:
+                self._client.report_diagnosis_data(data)
+                sent += 1
+            except Exception as e:
+                logger.warning("diagnosis report failed: %s", e)
+        return sent
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="diagnosis-reporter"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.report_once()

@@ -1,0 +1,143 @@
+"""Brain hyperparameter search: Bayesian optimization over job configs.
+
+Parity target: reference dlrover/python/brain/hpsearch/{base,bo}.py —
+the Brain service's GP-based search that proposes training configs
+(worker counts, micro-batch, learning rates) from observed trials.
+
+Self-contained numpy implementation (no scikit dependency): an RBF-kernel
+Gaussian process posterior with expected-improvement acquisition,
+maximized over random candidates.  Deterministic given the seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Param:
+    """One search dimension: continuous range or discrete choices."""
+
+    name: str
+    low: float = 0.0
+    high: float = 1.0
+    choices: Optional[Sequence[float]] = None
+    integer: bool = False
+
+    def clip(self, x: float) -> float:
+        if self.choices is not None:
+            arr = np.asarray(self.choices, dtype=np.float64)
+            return float(arr[np.argmin(np.abs(arr - x))])
+        x = min(max(x, self.low), self.high)
+        return float(round(x)) if self.integer else float(x)
+
+    def sample(self, rng: np.random.RandomState) -> float:
+        if self.choices is not None:
+            return float(rng.choice(np.asarray(self.choices)))
+        x = rng.uniform(self.low, self.high)
+        return float(round(x)) if self.integer else float(x)
+
+    def unit(self, x: float) -> float:
+        """Normalize to [0,1] for the kernel."""
+        lo, hi = (min(self.choices), max(self.choices)) \
+            if self.choices is not None else (self.low, self.high)
+        return 0.0 if hi == lo else (x - lo) / (hi - lo)
+
+
+@dataclasses.dataclass
+class Trial:
+    params: Dict[str, float]
+    value: Optional[float] = None  # objective; higher is better
+
+
+class BayesianOptimizer:
+    """Propose-observe loop (reference bo.py BayesianSearch)."""
+
+    def __init__(
+        self,
+        space: Sequence[Param],
+        seed: int = 0,
+        n_init: int = 4,
+        n_candidates: int = 256,
+        length_scale: float = 0.3,
+        noise: float = 1e-6,
+    ):
+        self.space = list(space)
+        self._rng = np.random.RandomState(seed)
+        self._n_init = n_init
+        self._n_candidates = n_candidates
+        self._ls = length_scale
+        self._noise = noise
+        self.trials: List[Trial] = []
+
+    # -- API ---------------------------------------------------------------
+    def suggest(self) -> Dict[str, float]:
+        done = [t for t in self.trials if t.value is not None]
+        if len(done) < self._n_init:
+            return {p.name: p.sample(self._rng) for p in self.space}
+        X = np.array([[p.unit(t.params[p.name]) for p in self.space]
+                      for t in done])
+        y = np.array([t.value for t in done], dtype=np.float64)
+        y_mean, y_std = y.mean(), y.std() or 1.0
+        yn = (y - y_mean) / y_std
+        K = self._kernel(X, X) + self._noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        cands = np.array([
+            [p.unit(p.sample(self._rng)) for p in self.space]
+            for _ in range(self._n_candidates)
+        ])
+        Ks = self._kernel(cands, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.maximum(1e-12, 1.0 - np.sum(v * v, axis=0))
+        sigma = np.sqrt(var)
+        best = yn.max()
+        ei = self._expected_improvement(mu, sigma, best)
+        x = cands[int(np.argmax(ei))]
+        return {
+            p.name: p.clip(self._denorm(p, x[i]))
+            for i, p in enumerate(self.space)
+        }
+
+    def observe(self, params: Dict[str, float], value: float) -> None:
+        self.trials.append(Trial(params=dict(params), value=float(value)))
+
+    def best(self) -> Optional[Trial]:
+        done = [t for t in self.trials if t.value is not None]
+        return max(done, key=lambda t: t.value) if done else None
+
+    # -- internals ----------------------------------------------------------
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self._ls ** 2))
+
+    @staticmethod
+    def _denorm(p: Param, u: float) -> float:
+        lo, hi = (min(p.choices), max(p.choices)) \
+            if p.choices is not None else (p.low, p.high)
+        return lo + u * (hi - lo)
+
+    @staticmethod
+    def _expected_improvement(
+        mu: np.ndarray, sigma: np.ndarray, best: float, xi: float = 0.01
+    ) -> np.ndarray:
+        z = (mu - best - xi) / sigma
+        phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        Phi = 0.5 * (1.0 + _erf(z / math.sqrt(2)))
+        return (mu - best - xi) * Phi + sigma * phi
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    """Vectorized erf (Abramowitz-Stegun 7.1.26, |err| < 1.5e-7)."""
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    y = 1.0 - (((((1.061405429 * t - 1.453152027) * t) + 1.421413741) * t
+                - 0.284496736) * t + 0.254829592) * t * np.exp(-x * x)
+    return sign * y

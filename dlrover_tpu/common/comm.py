@@ -435,11 +435,12 @@ class ElasticRunConfig:
 
 @comm_message
 class DiagnosisReportData:
-    data_cls: str = ""
+    data_cls: str = ""  # "metrics" | "log" | custom collector name
     data_content: str = ""
     node_id: int = 0
     node_type: str = ""
     node_rank: int = 0
+    timestamp: float = 0.0
 
 
 @comm_message

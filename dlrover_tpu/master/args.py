@@ -17,7 +17,11 @@ def build_master_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--platform",
         default="local",
-        choices=["local", "k8s", "pyk8s", "ray"],
+        choices=["local", "in_memory", "k8s", "pyk8s", "ray"],
+    )
+    parser.add_argument(
+        "--autoscale", type=str2bool, default=False, nargs="?", const=True,
+        help="enable the throughput-driven JobAutoScaler",
     )
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--node_num", type=int, default=1)

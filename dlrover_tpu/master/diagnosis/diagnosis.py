@@ -1,0 +1,236 @@
+"""Diagnosis: master-side failure inference from agent-reported data.
+
+Parity targets in the reference:
+- ``DiagnosisManager`` + ``DiagnosisDataManager``
+  (dlrover/python/master/diagnosis/diagnosis.py:31,
+  diagnosis_data_manager.py);
+- ``InferenceChain`` with pluggable ``InferenceOperator``s
+  (master/diagnosis/inferencechain/inference_chain.py:28, e.g.
+  CheckTrainingHangOperator);
+- agent-side collectors shipping ``DiagnosisReportData`` (log chunks,
+  chip metrics) via the master client.
+
+Data flows: agents report DiagnosisReportData -> DataManager ring buffers
+-> the manager's periodic tick runs the chain -> inferences become
+events on the JobMetricCollector and, for actionable conclusions
+(hang / fault node), callbacks into the JobManager.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from abc import ABCMeta, abstractmethod
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class InferenceName:
+    TRAINING_HANG = "training_hang"
+    NODE_FAILURE = "node_failure"
+    OOM = "oom"
+
+
+@dataclasses.dataclass
+class Inference:
+    """One conclusion of the chain (reference Inference attributes)."""
+
+    name: str
+    node_id: int = -1  # -1 = job-wide
+    reason: str = ""
+    severity: str = "warning"  # warning | critical
+
+
+class DiagnosisDataManager:
+    """Ring-buffered per-node diagnosis data (reference
+    DiagnosisDataManager with data expiry)."""
+
+    def __init__(self, expire_seconds: float = 600.0, max_items: int = 64):
+        self._expire = expire_seconds
+        self._lock = threading.Lock()
+        self._data: Dict[int, Deque[comm.DiagnosisReportData]] = {}
+        self._max_items = max_items
+
+    def store(self, data: comm.DiagnosisReportData) -> None:
+        if not data.timestamp:
+            data.timestamp = time.time()
+        with self._lock:
+            buf = self._data.setdefault(
+                data.node_id, deque(maxlen=self._max_items)
+            )
+            buf.append(data)
+
+    def get(self, node_id: int,
+            data_cls: Optional[str] = None,
+            include_expired: bool = False
+            ) -> List[comm.DiagnosisReportData]:
+        now = time.time()
+        with self._lock:
+            buf = list(self._data.get(node_id, ()))
+        return [
+            d for d in buf
+            if (include_expired or now - d.timestamp <= self._expire)
+            and (data_cls is None or d.data_cls == data_cls)
+        ]
+
+    def node_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._data.keys())
+
+
+class InferenceOperator(metaclass=ABCMeta):
+    """One diagnostic rule (reference InferenceOperator)."""
+
+    @abstractmethod
+    def infer(self, data: DiagnosisDataManager) -> List[Inference]: ...
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Job-wide hang: every node's latest step metric is stale
+    (reference check_training_hang_operator.py — all_running_node_hanged)."""
+
+    def __init__(self, hang_seconds: float = 900.0):
+        self._hang_seconds = hang_seconds
+
+    def infer(self, data: DiagnosisDataManager) -> List[Inference]:
+        node_ids = data.node_ids()
+        if not node_ids:
+            return []
+        now = time.time()
+        stale_nodes = []
+        for nid in node_ids:
+            # include expired records: a node whose only evidence has
+            # aged out is exactly the stale case this operator exists
+            # for (expiry < hang threshold must not mask a hang)
+            metrics = data.get(nid, data_cls="metrics",
+                               include_expired=True)
+            if not metrics:
+                continue
+            latest = max(m.timestamp for m in metrics)
+            if now - latest > self._hang_seconds:
+                stale_nodes.append(nid)
+            else:
+                return []  # any live node => not a job-wide hang
+        if stale_nodes and len(stale_nodes) == len(node_ids):
+            return [Inference(
+                name=InferenceName.TRAINING_HANG,
+                reason=f"no metrics from any node for {self._hang_seconds}s",
+                severity="critical",
+            )]
+        return []
+
+
+class CheckFailureNodeOperator(InferenceOperator):
+    """Classify per-node failures from reported log chunks (reference
+    check_failure_node_operator.py keyword rules)."""
+
+    OOM_MARKERS = ("out of memory", "oom-kill", "RESOURCE_EXHAUSTED")
+    FATAL_MARKERS = ("segmentation fault", "core dumped", "FATAL")
+
+    def infer(self, data: DiagnosisDataManager) -> List[Inference]:
+        out: List[Inference] = []
+        for nid in data.node_ids():
+            for item in data.get(nid, data_cls="log"):
+                text = (item.data_content or "").lower()
+                if any(m.lower() in text for m in self.OOM_MARKERS):
+                    out.append(Inference(
+                        name=InferenceName.OOM, node_id=nid,
+                        reason="OOM marker in worker log",
+                        severity="critical"))
+                    break
+                if any(m.lower() in text for m in self.FATAL_MARKERS):
+                    out.append(Inference(
+                        name=InferenceName.NODE_FAILURE, node_id=nid,
+                        reason="fatal marker in worker log",
+                        severity="critical"))
+                    break
+        return out
+
+
+class InferenceChain:
+    """Run operators in order, concatenating conclusions (reference
+    inference_chain.py — the reference resolves operators per problem;
+    here every registered operator observes the same data pool)."""
+
+    def __init__(self, operators: List[InferenceOperator]):
+        self._operators = operators
+
+    def infer(self, data: DiagnosisDataManager) -> List[Inference]:
+        results: List[Inference] = []
+        for op in self._operators:
+            try:
+                results.extend(op.infer(data))
+            except Exception:
+                logger.exception("inference operator %s failed", op)
+        return results
+
+
+class DiagnosisManager:
+    """Periodic observe -> infer -> act loop on the master (reference
+    DiagnosisManager.start_observing)."""
+
+    def __init__(
+        self,
+        data_manager: Optional[DiagnosisDataManager] = None,
+        chain: Optional[InferenceChain] = None,
+        on_inference: Optional[Callable[[Inference], None]] = None,
+        interval: float = 60.0,
+    ):
+        self.data_manager = data_manager or DiagnosisDataManager()
+        self.chain = chain or InferenceChain([
+            CheckTrainingHangOperator(),
+            CheckFailureNodeOperator(),
+        ])
+        self._on_inference = on_inference
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_inferences: List[Inference] = []
+        # dedup window: the same (name, node) conclusion from the same
+        # still-buffered evidence must not re-fire the action every tick
+        self._acted_at: Dict[tuple, float] = {}
+        self._dedup_window = max(interval, 300.0)
+
+    # servicer entry: store agent-reported diagnosis data
+    def collect_diagnosis_data(self, data: comm.DiagnosisReportData) -> None:
+        self.data_manager.store(data)
+
+    def start_observing(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="diagnosis-manager"
+        )
+        self._thread.start()
+
+    def stop_observing(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def diagnose_once(self) -> List[Inference]:
+        inferences = self.chain.infer(self.data_manager)
+        self.last_inferences = inferences
+        now = time.time()
+        for inf in inferences:
+            key = (inf.name, inf.node_id)
+            if now - self._acted_at.get(key, 0.0) < self._dedup_window:
+                continue
+            self._acted_at[key] = now
+            logger.warning("diagnosis: %s node=%s (%s)", inf.name,
+                           inf.node_id, inf.reason)
+            if self._on_inference is not None:
+                try:
+                    self._on_inference(inf)
+                except Exception:
+                    logger.exception("inference action failed")
+        return inferences
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.diagnose_once()

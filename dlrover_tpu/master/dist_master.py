@@ -49,6 +49,7 @@ class DistributedJobMaster:
         node_num: int = 1,
         worker_resource: Optional[NodeResource] = None,
         heartbeat_timeout: float = 300.0,
+        autoscale: bool = False,
     ):
         self._port = port
         self._node_num = node_num
@@ -79,6 +80,23 @@ class DistributedJobMaster:
         from dlrover_tpu.master.stats.job_collector import JobMetricCollector
 
         self.job_metric_collector = JobMetricCollector()
+        from dlrover_tpu.master.diagnosis.diagnosis import DiagnosisManager
+
+        self.diagnosis_manager = DiagnosisManager(
+            on_inference=self._act_on_inference
+        )
+        from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+        from dlrover_tpu.master.resource.local_optimizer import LocalOptimizer
+
+        self.job_auto_scaler = JobAutoScaler(
+            optimizer=LocalOptimizer(max_workers=2 * node_num),
+            speed_monitor=self.speed_monitor,
+            scaler=scaler,
+            get_worker_num=lambda: len(
+                self.speed_monitor.running_workers
+            ) or node_num,
+            rdzv_managers=self.rdzv_managers,
+        ) if autoscale else None
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -87,6 +105,7 @@ class DistributedJobMaster:
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
             job_metric_collector=self.job_metric_collector,
+            diagnosis_manager=self.diagnosis_manager,
         )
         self._server = build_server(self.servicer.get, self.servicer.report)
         self._stopped = threading.Event()
@@ -102,6 +121,9 @@ class DistributedJobMaster:
             )
         self.task_manager.start()
         self.job_manager.start()
+        self.diagnosis_manager.start_observing()
+        if self.job_auto_scaler is not None:
+            self.job_auto_scaler.start_auto_scaling()
         self._server.add_insecure_port(f"[::]:{self._port}")
         self._server.start()
         logger.info("Distributed master serving on port %s", self._port)
@@ -133,8 +155,34 @@ class DistributedJobMaster:
             pass
         return 0
 
+    def _act_on_inference(self, inference) -> None:
+        """Route diagnosis conclusions: record as events; OOM goes to the
+        autoscaler's memory-bump relaunch path, other node-level failures
+        to the JobManager (reference dist_master's diagnosis actions)."""
+        from dlrover_tpu.master.diagnosis.diagnosis import InferenceName
+
+        self.job_metric_collector.report_event(
+            inference.name,
+            instance=f"node-{inference.node_id}",
+            msg=inference.reason,
+        )
+        if inference.node_id < 0 or inference.severity != "critical":
+            return
+        if (inference.name == InferenceName.OOM
+                and self.job_auto_scaler is not None):
+            node = self.job_manager.get_node("worker", inference.node_id)
+            if node is not None:
+                self.job_auto_scaler.handle_oom_nodes([node])
+                return
+        self.job_manager.handle_training_failure(
+            "worker", inference.node_id, error_data=inference.reason
+        )
+
     def stop(self) -> None:
         self._stopped.set()
+        self.diagnosis_manager.stop_observing()
+        if self.job_auto_scaler is not None:
+            self.job_auto_scaler.stop_auto_scaling()
         self.job_manager.stop()
         self.task_manager.stop()
         self._server.stop(grace=None)

@@ -1,0 +1,77 @@
+"""SimpleStrategyGenerator: propose runtime configs from job history.
+
+Parity target: reference dlrover/python/master/hyperparams/
+simple_strategy_generator.py — generates worker-count / dataloader /
+micro-batch strategies from the metrics the JobMetricCollector gathered,
+optionally refined by the Brain hpsearch optimizer.
+
+The generated ``ParallelConfig`` flows: master -> agent ParalConfigTuner
+-> JSON config file -> ElasticDataLoader hot-reload (the same loop the
+reference drives through paral_config_tuner.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlrover_tpu.brain.hpsearch import BayesianOptimizer, Param
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class SimpleStrategyGenerator:
+    """Tunes dataloader width / batch size against observed speed."""
+
+    def __init__(
+        self,
+        batch_size_choices=(8, 16, 32, 64, 128),
+        workers_range=(0, 8),
+        seed: int = 0,
+    ):
+        self._bo = BayesianOptimizer(
+            space=[
+                Param(name="batch_size", choices=batch_size_choices),
+                Param(name="dataloader_workers", low=workers_range[0],
+                      high=workers_range[1], integer=True),
+            ],
+            seed=seed,
+        )
+        self._pending: Optional[dict] = None
+        self._version = 0
+
+    def next_config(self) -> comm.ParallelConfig:
+        """Propose the next config to try.  Each proposal bumps the
+        dataloader version so the agent-side ParalConfigTuner rewrites
+        its hot-reload file (the tuner gates on version changes)."""
+        params = self._bo.suggest()
+        self._pending = params
+        self._version += 1
+        return comm.ParallelConfig(
+            dataloader=comm.DataLoaderConfig(
+                batch_size=int(params["batch_size"]),
+                num_workers=int(params["dataloader_workers"]),
+                version=self._version,
+            ),
+        )
+
+    def observe_speed(self, speed: float) -> None:
+        """Report the steps/sec achieved under the last proposal."""
+        if self._pending is None:
+            return
+        self._bo.observe(self._pending, speed)
+        self._pending = None
+
+    def best_config(self) -> Optional[comm.ParallelConfig]:
+        best = self._bo.best()
+        if best is None:
+            return None
+        logger.info("best strategy so far: %s (speed %.3f)",
+                    best.params, best.value)
+        self._version += 1
+        return comm.ParallelConfig(
+            dataloader=comm.DataLoaderConfig(
+                batch_size=int(best.params["batch_size"]),
+                num_workers=int(best.params["dataloader_workers"]),
+                version=self._version,
+            ),
+        )

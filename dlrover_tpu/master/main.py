@@ -30,6 +30,7 @@ def run(args) -> int:
             scaler=InMemoryScaler(cluster),
             watcher=InMemoryNodeWatcher(cluster),
             node_num=args.node_num,
+            autoscale=args.autoscale,
         )
     else:
         raise NotImplementedError(

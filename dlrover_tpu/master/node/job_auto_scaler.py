@@ -1,0 +1,153 @@
+"""JobAutoScaler: periodic throughput-driven node scaling.
+
+Parity target: reference dlrover/python/master/node/job_auto_scaler.py
+(``JobAutoScaler`` ABC :73, ``AllreduceTrainingAutoScaler`` — the
+allreduce/SPMD variant is the one that maps to TPU jobs; the PS variant's
+role is covered by the elastic sparse-embedding workers).
+
+Loop: wait for a stable speed window at the current worker count →
+record a SpeedSample → ask the ResourceOptimizer for a plan → execute it
+through the Scaler and update the rendezvous target so the next
+membership round admits the new size.  OOM-killed nodes short-circuit
+into an immediate memory-bumped relaunch plan.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.resource.optimizer import (
+    ResourceOptimizer,
+    ResourcePlan,
+    SpeedSample,
+)
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+
+
+class JobAutoScaler:
+    """Drives worker-count changes from observed training speed."""
+
+    def __init__(
+        self,
+        optimizer: ResourceOptimizer,
+        speed_monitor: SpeedMonitor,
+        scaler: Scaler,
+        get_worker_num: Callable[[], int],
+        rdzv_managers: Optional[dict] = None,
+        interval: float = 30.0,
+        min_samples_per_size: int = 1,
+        node_unit: int = 1,
+        max_samples: int = 64,
+    ):
+        self._optimizer = optimizer
+        self._speed_monitor = speed_monitor
+        self._scaler = scaler
+        self._get_worker_num = get_worker_num
+        self._rdzv_managers = rdzv_managers or {}
+        self._interval = interval
+        self._min_samples = min_samples_per_size
+        self._node_unit = node_unit
+        # bounded window: early-training burst speeds must not dominate
+        # scaling decisions for the whole job lifetime
+        self._samples: deque = deque(maxlen=max_samples)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.started = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start_auto_scaling(self) -> None:
+        if self.started:
+            return
+        self.started = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="job-auto-scaler"
+        )
+        self._thread.start()
+
+    def stop_auto_scaling(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self.started = False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.autoscale_once()
+            except Exception:
+                logger.exception("autoscale tick failed")
+
+    # -- one decision tick (also called directly by tests) ----------------
+    def autoscale_once(self) -> ResourcePlan:
+        speed = self._speed_monitor.running_speed()
+        workers = self._get_worker_num()
+        if speed <= 0 or workers <= 0:
+            return ResourcePlan()
+        self._samples.append(SpeedSample(worker_num=workers, speed=speed))
+        at_size = [s for s in self._samples if s.worker_num == workers]
+        if len(at_size) < self._min_samples:
+            return ResourcePlan()
+        plan = self._optimizer.generate_opt_plan(list(self._samples),
+                                                 workers)
+        if not plan.empty():
+            self.execute_job_optimization_plan(plan)
+        return plan
+
+    def handle_oom_nodes(self, oom_nodes: List[Node]) -> ResourcePlan:
+        """Immediate path for OOM events (reference PSTrainingAutoScaler
+        _execute_memory_ascending_plan)."""
+        if not oom_nodes:
+            return ResourcePlan()
+        plan = self._optimizer.generate_oom_recovery_plan(oom_nodes)
+        if not plan.empty():
+            self.execute_job_optimization_plan(plan, relaunch=oom_nodes)
+        return plan
+
+    def execute_job_optimization_plan(
+        self, plan: ResourcePlan, relaunch: Optional[List[Node]] = None
+    ) -> ScalePlan:
+        """ResourcePlan -> ScalePlan -> Scaler (reference
+        execute_job_optimization_plan)."""
+        scale_plan = ScalePlan()
+        for node_type, group in plan.node_group_resources.items():
+            # ScalePlan's node_group_resources means TARGET GROUP SIZE;
+            # memory-only bumps (count=0, e.g. OOM recovery) ride on the
+            # individual launch_nodes instead of the group target
+            if group.count > 0:
+                scale_plan.node_group_resources[node_type] = group
+        for node in relaunch or []:
+            group = plan.node_group_resources.get(node.type)
+            if group is not None and group.node_resource.memory > 0:
+                node.config_resource = group.node_resource
+            scale_plan.launch_nodes.append(node)
+        if not scale_plan.empty():
+            worker_group = scale_plan.node_group_resources.get(
+                NodeType.WORKER
+            )
+            if worker_group is not None and worker_group.count > 0:
+                target = worker_group.count
+                self._speed_monitor.set_target_worker_num(target)
+                # new size invalidates cross-size speed comparisons at
+                # the *same* size recorded before the change
+                self._speed_monitor.reset_running_speed_monitor()
+                # widen rendezvous so the new membership is admissible
+                # (prepare() pinned min=max=initial node_num)
+                for mgr in self._rdzv_managers.values():
+                    try:
+                        mgr.update_rdzv_params(
+                            min_nodes=min(target, self._get_worker_num()),
+                            max_nodes=target,
+                            node_unit=self._node_unit,
+                        )
+                    except Exception:
+                        logger.exception("rendezvous resize failed")
+            self._scaler.scale(scale_plan)
+            logger.info("autoscaler executed plan: %s", scale_plan)
+        return scale_plan

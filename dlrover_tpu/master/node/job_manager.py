@@ -217,6 +217,11 @@ class JobManager:
         node.update_heartbeat(timestamp)
         return ""
 
+    def get_node(self, node_type: str, agent_id: int) -> Optional[Node]:
+        """Public accessor for the live node with an agent rank."""
+        with self._lock:
+            return self._find_node(node_type, agent_id)
+
     def _find_node(self, node_type: str, agent_id: int) -> Optional[Node]:
         """Agents identify by rank (env contract); scheduler ids are
         platform-assigned.  Prefer the live node with that rank."""
@@ -332,8 +337,13 @@ class JobManager:
     def process_reported_node_event(self, message) -> None:
         pass  # diagnosis events; consumed by the diagnosis manager later
 
+    def set_paral_config(self, config) -> None:
+        """Publish a new mutable parallel config (fed by the strategy
+        generator / hpsearch loop); agents poll it via ParalConfigTuner."""
+        self._paral_config = config
+
     def get_paral_config(self, node_id: int):
-        return None
+        return getattr(self, "_paral_config", None)
 
     def query_ps_nodes(self):
         return [], True, False

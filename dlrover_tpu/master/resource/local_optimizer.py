@@ -1,0 +1,122 @@
+"""LocalOptimizer: heuristic resource plans without a Brain service.
+
+Parity target: reference dlrover/python/master/resource/local_optimizer.py
+(PSLocalOptimizer: OOM-factor memory bumps, speed-curve worker tuning) —
+reshaped for SPMD TPU jobs where throughput scales with hosts of a pod
+slice and the only per-node knob is host memory / data-pipeline width.
+
+Scaling policy (speed curve):
+  - Record (worker_num, steps/sec) samples as the autoscaler observes
+    stable windows.
+  - Growing: if the last scale-up kept per-worker efficiency above
+    ``efficiency_threshold`` (speed scaled ≥ thr × linearly), propose
+    another ``node_unit`` workers, up to ``max_workers``.
+  - Shrinking: if efficiency fell below the threshold, back off to the
+    previous best-throughput worker count (pointless hosts waste money
+    and add failure surface).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeGroupResource
+from dlrover_tpu.master.resource.optimizer import (
+    ResourceOptimizer,
+    ResourcePlan,
+    SpeedSample,
+    scale_memory,
+)
+
+
+class LocalOptimizer(ResourceOptimizer):
+    def __init__(
+        self,
+        node_unit: int = 1,
+        min_workers: int = 1,
+        max_workers: int = 0,
+        efficiency_threshold: float = 0.75,
+        oom_memory_factor: float = 1.5,
+    ):
+        self._node_unit = max(1, node_unit)
+        self._min_workers = min_workers
+        self._max_workers = max_workers
+        self._threshold = efficiency_threshold
+        self._oom_factor = oom_memory_factor
+        # sizes that already failed the efficiency check; never re-grown
+        # into (prevents the N <-> N+unit scaling oscillation)
+        self._rejected_sizes: set = set()
+
+    # -- throughput-driven worker tuning ---------------------------------
+    def generate_opt_plan(
+        self, samples: List[SpeedSample], current_workers: int
+    ) -> ResourcePlan:
+        plan = ResourcePlan()
+        best = self._best_speed_by_workers(samples)
+        if current_workers not in best:
+            return plan  # no stable sample at the current size yet
+        target = current_workers
+        cur_speed = best[current_workers]
+        smaller = [n for n in best if n < current_workers]
+        if smaller:
+            prev = max(smaller)
+            # efficiency of the last growth step
+            linear = best[prev] * current_workers / prev
+            if linear > 0 and cur_speed / linear < self._threshold:
+                # poor scaling: remember this size as rejected and fall
+                # back to the best-throughput size seen
+                self._rejected_sizes.add(current_workers)
+                target = max(best, key=lambda n: best[n])
+                if target == current_workers:
+                    return plan
+                logger.info(
+                    "scaling back: efficiency %.2f < %.2f (best size %s)",
+                    cur_speed / linear, self._threshold, target,
+                )
+        if target == current_workers:
+            grown = self._grow_target(current_workers)
+            if grown == current_workers:
+                return plan
+            target = grown
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=target
+        )
+        return plan
+
+    def _grow_target(self, current: int) -> int:
+        target = current + self._node_unit
+        if self._max_workers and target > self._max_workers:
+            return current
+        if target in self._rejected_sizes:
+            return current
+        return target
+
+    @staticmethod
+    def _best_speed_by_workers(
+        samples: List[SpeedSample],
+    ) -> Dict[int, float]:
+        best: Dict[int, float] = {}
+        for s in samples:
+            if s.speed > 0 and s.worker_num > 0:
+                best[s.worker_num] = max(best.get(s.worker_num, 0.0), s.speed)
+        return best
+
+    # -- OOM recovery -----------------------------------------------------
+    def generate_oom_recovery_plan(
+        self, oom_nodes: List[Node]
+    ) -> ResourcePlan:
+        plan = ResourcePlan()
+        for node in oom_nodes:
+            group = plan.node_group_resources.setdefault(
+                node.type, NodeGroupResource(count=0)
+            )
+            bumped = scale_memory(node.config_resource, self._oom_factor)
+            group.node_resource = bumped
+            logger.info(
+                "OOM recovery: %s-%s memory %s -> %s MiB",
+                node.type, node.id, node.config_resource.memory,
+                bumped.memory,
+            )
+        return plan

@@ -1,0 +1,71 @@
+"""Resource optimization: turn observed job metrics into ResourcePlans.
+
+Parity targets: reference dlrover/python/master/resource/optimizer.py
+(``ResourceOptimizer`` ABC + ``ResourcePlan``), resource/job.py
+(``JobResourceOptimizer`` driving init/oom/speed-based adjustments) and
+brain_optimizer.py (the Brain-service client variant).
+
+TPU-native framing: the scalable unit is a *worker host* of a pod slice
+(scaling granularity = node_unit hosts so the device mesh stays
+rectangular); memory bumps apply to host RAM (the data pipeline), not
+device HBM, which is fixed per chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from abc import ABCMeta, abstractmethod
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+
+
+@dataclasses.dataclass
+class ResourcePlan:
+    """Desired per-type group resources (reference ResourcePlan)."""
+
+    node_group_resources: Dict[str, NodeGroupResource] = dataclasses.field(
+        default_factory=dict
+    )
+    # optional tuning hints shipped to workers via ParallelConfig
+    dataloader_workers: Optional[int] = None
+    batch_size: Optional[int] = None
+
+    def empty(self) -> bool:
+        return (
+            not self.node_group_resources
+            and self.dataloader_workers is None
+            and self.batch_size is None
+        )
+
+
+@dataclasses.dataclass
+class SpeedSample:
+    """One (worker_num -> steps/sec) observation for scaling decisions."""
+
+    worker_num: int
+    speed: float
+
+
+class ResourceOptimizer(metaclass=ABCMeta):
+    """Generates ResourcePlans from collected runtime stats."""
+
+    @abstractmethod
+    def generate_opt_plan(
+        self, samples: List[SpeedSample], current_workers: int
+    ) -> ResourcePlan:
+        """Periodic throughput-driven plan (may be empty)."""
+
+    @abstractmethod
+    def generate_oom_recovery_plan(
+        self, oom_nodes: List[Node]
+    ) -> ResourcePlan:
+        """Plan that relaunches OOM-killed nodes with more memory."""
+
+
+def scale_memory(resource: NodeResource, factor: float,
+                 ceiling_mb: int = 1 << 20) -> NodeResource:
+    """Memory bump used for OOM recovery (reference local_optimizer's
+    oom factor)."""
+    new_mem = min(int(max(resource.memory, 1024) * factor), ceiling_mb)
+    return dataclasses.replace(resource, memory=new_mem)

@@ -1,0 +1,181 @@
+"""Autoscaler + resource optimizer + brain hpsearch tests (reference
+parity: master/node/job_auto_scaler.py, master/resource/local_optimizer.py,
+brain/hpsearch/bo.py, hyperparams/simple_strategy_generator.py)."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.brain.hpsearch import BayesianOptimizer, Param
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.hyperparams.strategy_generator import (
+    SimpleStrategyGenerator,
+)
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+from dlrover_tpu.master.resource.local_optimizer import LocalOptimizer
+from dlrover_tpu.master.resource.optimizer import ResourcePlan, SpeedSample
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("test")
+        self.plans = []
+
+    def start(self):
+        pass
+
+    def scale(self, plan: ScalePlan):
+        self.plans.append(plan)
+
+
+# -- LocalOptimizer ---------------------------------------------------------
+
+def test_optimizer_grows_when_scaling_is_linear():
+    opt = LocalOptimizer(node_unit=2, max_workers=8)
+    samples = [SpeedSample(2, 10.0), SpeedSample(4, 19.0)]  # 95% efficiency
+    plan = opt.generate_opt_plan(samples, current_workers=4)
+    assert plan.node_group_resources[NodeType.WORKER].count == 6
+
+
+def test_optimizer_respects_max_workers():
+    opt = LocalOptimizer(node_unit=4, max_workers=4)
+    plan = opt.generate_opt_plan([SpeedSample(4, 10.0)], 4)
+    assert plan.empty()
+
+
+def test_optimizer_backs_off_on_poor_scaling():
+    opt = LocalOptimizer(node_unit=2, efficiency_threshold=0.75)
+    # 2->4 workers only brought 10 -> 11 steps/s (55% efficiency)
+    samples = [SpeedSample(2, 10.0), SpeedSample(4, 11.0)]
+    plan = opt.generate_opt_plan(samples, current_workers=4)
+    # best throughput size is still 4 (11 > 10), so no change...
+    assert plan.empty()
+    # ...but if the bigger size is actually SLOWER, fall back
+    samples = [SpeedSample(2, 10.0), SpeedSample(4, 8.0)]
+    plan = opt.generate_opt_plan(samples, current_workers=4)
+    assert plan.node_group_resources[NodeType.WORKER].count == 2
+
+
+def test_optimizer_never_regrows_into_rejected_size():
+    """After backing off from an inefficient size the optimizer must not
+    propose it again (no N <-> N+unit oscillation)."""
+    opt = LocalOptimizer(node_unit=2, efficiency_threshold=0.75)
+    samples = [SpeedSample(2, 10.0), SpeedSample(4, 8.0)]
+    plan = opt.generate_opt_plan(samples, current_workers=4)
+    assert plan.node_group_resources[NodeType.WORKER].count == 2
+    # back at 2 workers: growth to the rejected size 4 is suppressed
+    plan = opt.generate_opt_plan(samples, current_workers=2)
+    assert plan.empty()
+
+
+def test_oom_recovery_bumps_memory():
+    opt = LocalOptimizer(oom_memory_factor=2.0)
+    node = Node("worker", 3,
+                config_resource=NodeResource(cpu=4, memory=8192))
+    plan = opt.generate_oom_recovery_plan([node])
+    assert plan.node_group_resources["worker"].node_resource.memory == 16384
+
+
+# -- JobAutoScaler ----------------------------------------------------------
+
+def test_autoscaler_executes_growth_plan():
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    monitor = SpeedMonitor()
+    scaler = RecordingScaler()
+    rdzv = ElasticTrainingRendezvousManager()
+    rdzv.update_rdzv_params(min_nodes=2, max_nodes=2)
+    auto = JobAutoScaler(
+        optimizer=LocalOptimizer(node_unit=2, max_workers=8),
+        speed_monitor=monitor,
+        scaler=scaler,
+        get_worker_num=lambda: 2,
+        rdzv_managers={"elastic-training": rdzv},
+        min_samples_per_size=1,
+    )
+    monitor.add_running_worker("worker", 0)
+    monitor.add_running_worker("worker", 1)
+    monitor.sample_global_step(0, 1000.0)
+    monitor.sample_global_step(100, 1010.0)  # 10 steps/s
+    plan = auto.autoscale_once()
+    assert plan.node_group_resources[NodeType.WORKER].count == 4
+    assert len(scaler.plans) == 1
+    assert scaler.plans[0].node_group_resources[NodeType.WORKER].count == 4
+    # target propagated so rendezvous admits the larger world
+    assert monitor.target_worker_num == 4
+    assert rdzv._rdzv_params.max_nodes == 4
+
+
+def test_autoscaler_oom_path_relaunches_with_more_memory():
+    monitor = SpeedMonitor()
+    scaler = RecordingScaler()
+    auto = JobAutoScaler(
+        optimizer=LocalOptimizer(oom_memory_factor=1.5),
+        speed_monitor=monitor,
+        scaler=scaler,
+        get_worker_num=lambda: 2,
+    )
+    node = Node("worker", 1,
+                config_resource=NodeResource(cpu=4, memory=1000 * 4))
+    auto.handle_oom_nodes([node])
+    assert len(scaler.plans) == 1
+    launched = scaler.plans[0].launch_nodes
+    assert len(launched) == 1
+    assert launched[0].config_resource.memory == 6000
+    # a memory-only recovery must NOT publish a count=0 group target
+    # (ScalePlan group counts mean target size; 0 would kill the group)
+    assert NodeType.WORKER not in scaler.plans[0].node_group_resources
+
+
+def test_autoscaler_no_plan_without_speed():
+    auto = JobAutoScaler(
+        optimizer=LocalOptimizer(),
+        speed_monitor=SpeedMonitor(),
+        scaler=RecordingScaler(),
+        get_worker_num=lambda: 2,
+    )
+    assert auto.autoscale_once().empty()
+
+
+# -- Brain hpsearch ---------------------------------------------------------
+
+def test_bo_finds_quadratic_maximum():
+    space = [Param(name="x", low=-2.0, high=2.0)]
+    bo = BayesianOptimizer(space, seed=1, n_init=5)
+    for _ in range(30):
+        params = bo.suggest()
+        value = -(params["x"] - 0.7) ** 2  # max at x=0.7
+        bo.observe(params, value)
+    best = bo.best()
+    assert abs(best.params["x"] - 0.7) < 0.25, best
+
+
+def test_bo_integer_and_choice_params():
+    space = [
+        Param(name="workers", low=1, high=8, integer=True),
+        Param(name="batch", choices=(8, 16, 32)),
+    ]
+    bo = BayesianOptimizer(space, seed=0)
+    for _ in range(10):
+        p = bo.suggest()
+        assert p["workers"] == int(p["workers"])
+        assert 1 <= p["workers"] <= 8
+        assert p["batch"] in (8, 16, 32)
+        bo.observe(p, float(p["workers"]))
+    assert bo.best().params["workers"] >= 4
+
+
+def test_strategy_generator_converges_to_best_batch():
+    gen = SimpleStrategyGenerator(batch_size_choices=(8, 16, 32),
+                                  workers_range=(0, 4), seed=3)
+    # pretend batch 32 is always fastest
+    for _ in range(12):
+        cfg = gen.next_config()
+        speed = {8: 1.0, 16: 2.0, 32: 3.0}[cfg.dataloader.batch_size]
+        gen.observe_speed(speed)
+    best = gen.best_config()
+    assert best.dataloader.batch_size == 32
