@@ -1,0 +1,6 @@
+"""RL training (TPU-native counterpart of atorch/atorch/rl): PPO with
+jitted rollout/score/update programs, KL-shaped rewards, GAE, replay
+buffer, and adaptive KL control."""
+
+from dlrover_tpu.rl.config import PPOConfig  # noqa: F401
+from dlrover_tpu.rl.ppo_trainer import PPOTrainer, ValueModel  # noqa: F401

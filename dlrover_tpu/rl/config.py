@@ -1,0 +1,63 @@
+"""RL configuration (reference parity: atorch/atorch/rl/config.py — the
+PPO hyperparameters + KL controller settings of the reference's
+AtorchRLConfig, minus the torch/deepspeed engine knobs that accelerate()
+replaces on TPU)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    # rollout
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = full softmax
+
+    # reward shaping (reference ppo_util.get_rewards / get_kl_penalty)
+    kl_coef: float = 0.1
+    adaptive_kl: bool = False
+    kl_target: float = 6.0
+    kl_horizon: int = 10000
+
+    # advantages (reference get_advantages_and_returns)
+    gamma: float = 1.0
+    lam: float = 0.95
+    whiten_advantages: bool = True
+
+    # ppo objective (reference ppo_util.loss)
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.0
+
+    # optimization
+    ppo_epochs: int = 4
+    minibatches: int = 4
+    learning_rate: float = 1e-5
+    max_grad_norm: float = 1.0
+
+
+class FixedKLController:
+    """Constant KL coefficient (reference FixedKLController)."""
+
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        pass
+
+
+class AdaptiveKLController:
+    """PPO-style adaptive KL coefficient (Ziegler et al. 2019; reference
+    AdaptiveKLController): nudges kl_coef so observed KL tracks target."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: int):
+        self.value = init_kl_coef
+        self._target = target
+        self._horizon = horizon
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        error = min(max(current_kl / self._target - 1.0, -0.2), 0.2)
+        self.value *= 1.0 + error * n_steps / self._horizon

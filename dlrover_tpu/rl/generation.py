@@ -1,0 +1,72 @@
+"""Autoregressive sampling for RL rollouts (static shapes, jittable).
+
+Reference counterpart: the rollout half of atorch's PPO experience maker
+(atorch/atorch/rl/trainer/ppo_trainer.py make_experience + its vllm
+inference backend).  TPU-native shape: one fixed [B, prompt+gen] token
+buffer filled by a ``lax.scan`` over decode steps — no dynamic shapes,
+one compile.  Each step re-runs the full causal forward; a KV-cache
+decode path is the standard optimization and slots in behind the same
+interface (causality makes the suffix garbage invisible to position t).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_sequences(
+    apply_fn: Callable[..., jax.Array],
+    params: Any,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    pad_token: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sample ``max_new_tokens`` continuations.
+
+    ``apply_fn(params, tokens) -> logits [B, T, V]`` is the causal LM.
+    Returns (tokens [B, prompt+new], response_mask [B, prompt+new]).
+    ``temperature == 0`` is greedy decode.
+    """
+    batch, prompt_len = prompt_ids.shape
+    total = prompt_len + max_new_tokens
+    tokens = jnp.concatenate(
+        [prompt_ids,
+         jnp.full((batch, max_new_tokens), pad_token, prompt_ids.dtype)],
+        axis=1,
+    )
+
+    def decode_step(carry, t):
+        toks, key = carry
+        logits = apply_fn(params, toks)  # [B, total, V]
+        step_logits = jax.lax.dynamic_slice_in_dim(
+            logits, t - 1, 1, axis=1
+        )[:, 0, :].astype(jnp.float32)
+        key, sub = jax.random.split(key)
+        if top_k > 0:
+            kth = jnp.sort(step_logits, axis=-1)[:, -top_k][:, None]
+            step_logits = jnp.where(
+                step_logits < kth, -jnp.inf, step_logits
+            )
+        if temperature == 0.0:
+            nxt = jnp.argmax(step_logits, axis=-1)
+        else:
+            nxt = jax.random.categorical(sub, step_logits / temperature)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, nxt[:, None].astype(toks.dtype), t, axis=1
+        )
+        return (toks, key), None
+
+    (tokens, _), _ = jax.lax.scan(
+        decode_step, (tokens, rng),
+        jnp.arange(prompt_len, total),
+    )
+    positions = jnp.arange(total)[None, :]
+    response_mask = (positions >= prompt_len).astype(jnp.int32)
+    response_mask = jnp.broadcast_to(response_mask, (batch, total))
+    return tokens, response_mask

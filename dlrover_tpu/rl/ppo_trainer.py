@@ -1,0 +1,232 @@
+"""PPOTrainer: rollout -> reward shaping -> GAE -> clipped PPO updates.
+
+Parity targets in the reference:
+- atorch/atorch/rl/trainer/ppo_trainer.py (``AtorchPPOTrainer`` —
+  make_experience with KL-shaped rewards, minibatched PPO epochs);
+- atorch/atorch/rl/trainer/rl_trainer.py (the trainer surface);
+- atorch/atorch/rl/model_engine/model_engine.py (actor/ref/critic/reward
+  model bookkeeping — here plain param pytrees instead of engine-managed
+  torch modules; the frozen ref policy is a stop-gradient param copy).
+
+TPU-native: rollout, logprob/value scoring, and the PPO update are three
+jitted programs with static shapes; minibatches are equal-sized so the
+update compiles once.  The reward model is a host callable (scores come
+from a classifier or rule), matching the reference's pluggable reward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.rl.config import (
+    AdaptiveKLController,
+    FixedKLController,
+    PPOConfig,
+)
+from dlrover_tpu.rl.generation import sample_sequences
+from dlrover_tpu.rl.ppo_utils import (
+    gae_advantages,
+    logprobs_from_logits,
+    ppo_loss,
+    shape_rewards,
+)
+from dlrover_tpu.rl.replay_buffer import Experience, ReplayBuffer
+
+
+class ValueModel(nn.Module):
+    """Critic: causal-LM trunk + scalar head (reference's critic built in
+    model_utils/load_init_model.py from the actor architecture)."""
+
+    trunk: nn.Module
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        hidden = self.trunk(input_ids, return_hidden=True)
+        v = nn.Dense(
+            1, dtype=jnp.float32, name="value_head",
+            kernel_init=nn.initializers.normal(stddev=0.01),
+        )(hidden.astype(jnp.float32))
+        return v[..., 0]  # [B, T]
+
+
+def _shift_right_pad(x: jax.Array) -> jax.Array:
+    """[B, T-1] scored positions -> [B, T] aligned so index t describes
+    token t (position 0 has no prefix; it gets 0 and is always masked)."""
+    return jnp.concatenate([jnp.zeros_like(x[:, :1]), x], axis=1)
+
+
+class PPOTrainer:
+    def __init__(
+        self,
+        actor: nn.Module,
+        critic: nn.Module,
+        config: Optional[PPOConfig] = None,
+        seed: int = 0,
+    ):
+        self.actor = actor
+        self.critic = critic
+        self.config = config or PPOConfig()
+        self._rng = jax.random.PRNGKey(seed)
+        self._np_rng = np.random.RandomState(seed)
+        self.buffer = ReplayBuffer()
+        c = self.config
+        self.kl_ctl = (
+            AdaptiveKLController(c.kl_coef, c.kl_target, c.kl_horizon)
+            if c.adaptive_kl else FixedKLController(c.kl_coef)
+        )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(c.max_grad_norm),
+            optax.adam(c.learning_rate),
+        )
+        self.params: Optional[Dict[str, Any]] = None   # {actor, critic}
+        self.ref_params: Optional[Any] = None          # frozen policy copy
+        self.opt_state = None
+        self._jit_rollout = None
+        self._jit_score = None
+        self._jit_update = None
+
+    # -- setup -----------------------------------------------------------
+    def init_models(self, sample_prompt: np.ndarray,
+                    actor_params: Optional[Any] = None) -> None:
+        """Initialize (or adopt pretrained) actor params; the frozen
+        reference policy is a copy at init time."""
+        total = sample_prompt.shape[1] + self.config.max_new_tokens
+        probe = jnp.zeros((1, total), jnp.int32)
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        if actor_params is None:
+            actor_params = self.actor.init(k1, probe)
+        critic_params = self.critic.init(k2, probe)
+        self.params = {"actor": actor_params, "critic": critic_params}
+        self.ref_params = jax.tree.map(lambda x: x, actor_params)
+        self.opt_state = self.optimizer.init(self.params)
+        self._build_jits()
+
+    def _build_jits(self) -> None:
+        c = self.config
+        actor_apply = self.actor.apply
+        critic_apply = self.critic.apply
+
+        def rollout(actor_params, prompts, rng):
+            return sample_sequences(
+                actor_apply, actor_params, prompts, c.max_new_tokens, rng,
+                temperature=c.temperature, top_k=c.top_k,
+            )
+
+        def score(params, ref_params, tokens):
+            logits = actor_apply(params["actor"], tokens)
+            lp = _shift_right_pad(
+                logprobs_from_logits(logits[:, :-1], tokens[:, 1:])
+            )
+            ref_logits = actor_apply(ref_params, tokens)
+            ref_lp = _shift_right_pad(
+                logprobs_from_logits(ref_logits[:, :-1], tokens[:, 1:])
+            )
+            values = _shift_right_pad(
+                critic_apply(params["critic"], tokens)[:, :-1]
+            )
+            return lp, ref_lp, values
+
+        def update(params, opt_state, batch):
+            def loss_fn(p):
+                logits = actor_apply(p["actor"], batch["tokens"])
+                lp = _shift_right_pad(logprobs_from_logits(
+                    logits[:, :-1], batch["tokens"][:, 1:]))
+                values = _shift_right_pad(
+                    critic_apply(p["critic"], batch["tokens"])[:, :-1])
+                entropy = None
+                if c.entropy_coef > 0:
+                    full_lp = jax.nn.log_softmax(
+                        logits[:, :-1].astype(jnp.float32), axis=-1)
+                    entropy = _shift_right_pad(
+                        -(jnp.exp(full_lp) * full_lp).sum(-1))
+                return ppo_loss(
+                    lp, values,
+                    batch["logprobs"], batch["values"],
+                    batch["advantages"], batch["returns"],
+                    batch["response_mask"],
+                    clip_ratio=c.clip_ratio, value_clip=c.value_clip,
+                    vf_coef=c.vf_coef,
+                    entropy=entropy, entropy_coef=c.entropy_coef,
+                )
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats["loss"] = loss
+            return params, opt_state, stats
+
+        self._jit_rollout = jax.jit(rollout)
+        self._jit_score = jax.jit(score)
+        self._jit_update = jax.jit(update)
+
+    # -- experience ------------------------------------------------------
+    def make_experience(
+        self,
+        prompt_ids: np.ndarray,
+        reward_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+    ) -> Dict[str, float]:
+        """One rollout batch into the buffer.  ``reward_fn(tokens, mask)
+        -> scores [B]`` runs on host (reference's reward model call)."""
+        assert self.params is not None, "call init_models first"
+        self._rng, sub = jax.random.split(self._rng)
+        tokens, mask = self._jit_rollout(
+            self.params["actor"], jnp.asarray(prompt_ids), sub)
+        lp, ref_lp, values = self._jit_score(
+            self.params, self.ref_params, tokens)
+        scores = jnp.asarray(
+            reward_fn(np.asarray(tokens), np.asarray(mask)),
+            dtype=jnp.float32)
+        rewards, mean_kl = shape_rewards(
+            scores, lp, ref_lp, mask, self.kl_ctl.value)
+        adv, ret = gae_advantages(
+            values, rewards, mask, gamma=self.config.gamma,
+            lam=self.config.lam, whiten=self.config.whiten_advantages)
+        self.buffer.add(Experience(
+            tokens=np.asarray(tokens),
+            response_mask=np.asarray(mask),
+            logprobs=np.asarray(lp),
+            values=np.asarray(values),
+            advantages=np.asarray(adv),
+            returns=np.asarray(ret),
+        ))
+        self.kl_ctl.update(float(mean_kl), n_steps=len(prompt_ids))
+        return {
+            "mean_score": float(scores.mean()),
+            "mean_kl": float(mean_kl),
+            "kl_coef": float(self.kl_ctl.value),
+        }
+
+    # -- optimization ----------------------------------------------------
+    def train_on_buffer(self) -> Dict[str, float]:
+        """PPO epochs over the buffered experience; clears the buffer."""
+        assert len(self.buffer) > 0, "empty buffer"
+        c = self.config
+        last_stats: Dict[str, float] = {}
+        for _ in range(c.ppo_epochs):
+            for mb in self.buffer.minibatches(c.minibatches, self._np_rng):
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self.params, self.opt_state, stats = self._jit_update(
+                    self.params, self.opt_state, mb)
+                last_stats = {k: float(v) for k, v in stats.items()}
+        self.buffer.clear()
+        logger.info("ppo update: %s", last_stats)
+        return last_stats
+
+    def step(
+        self,
+        prompt_ids: np.ndarray,
+        reward_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+    ) -> Dict[str, float]:
+        """make_experience + train_on_buffer (one PPO iteration)."""
+        roll = self.make_experience(prompt_ids, reward_fn)
+        train = self.train_on_buffer()
+        return {**roll, **train}

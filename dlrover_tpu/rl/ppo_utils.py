@@ -1,0 +1,153 @@
+"""PPO math: logprobs, KL-shaped rewards, GAE, the clipped objective.
+
+Parity target: reference atorch/atorch/rl/ppo_utils/ppo_util.py —
+``get_kl_penalty`` (:19), ``get_rewards`` (:55), ``loss`` (:79),
+``get_advantages_and_returns`` (:147).  All functions here are pure and
+jit-friendly (static shapes, mask-weighted reductions, ``lax.scan`` for
+the reverse GAE recursion) so the whole PPO update compiles into one
+XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def logprobs_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-token log p(label) — [B, T] from logits [B, T, V]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+
+
+def kl_penalty(logprobs: jax.Array, ref_logprobs: jax.Array) -> jax.Array:
+    """Per-token KL estimate logp - ref_logp on the sampled tokens
+    (reference get_kl_penalty uses the same sampled-token estimator)."""
+    return logprobs - ref_logprobs
+
+
+def shape_rewards(
+    scores: jax.Array,
+    logprobs: jax.Array,
+    ref_logprobs: jax.Array,
+    response_mask: jax.Array,
+    kl_coef: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Dense rewards: -kl_coef * KL per response token, plus the scalar
+    score on each sequence's LAST response token (reference get_rewards).
+
+    Returns (rewards [B, T], mean_kl scalar for the controller).
+    """
+    kl = kl_penalty(logprobs, ref_logprobs) * response_mask
+    rewards = -kl_coef * kl
+    # index of last response token per row
+    t = jnp.arange(response_mask.shape[1])[None, :]
+    last = jnp.argmax(
+        jnp.where(response_mask > 0, t, -1), axis=1
+    )
+    rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
+    denom = jnp.maximum(response_mask.sum(), 1.0)
+    return rewards, kl.sum() / denom
+
+
+def gae_advantages(
+    values: jax.Array,
+    rewards: jax.Array,
+    response_mask: jax.Array,
+    gamma: float = 1.0,
+    lam: float = 0.95,
+    whiten: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked GAE over the response region (reference
+    get_advantages_and_returns with use_whitening).
+
+    ``values``/``rewards``/``response_mask`` are [B, T] aligned on token
+    positions; positions outside the response contribute nothing.
+    Returns (advantages, returns), both [B, T].
+    """
+    mask = response_mask.astype(jnp.float32)
+
+    def step(carry, xs):
+        next_adv = carry
+        v, r, m, next_v = xs
+        delta = r + gamma * next_v - v
+        adv = delta + gamma * lam * next_adv
+        adv = adv * m  # outside the response the recursion restarts at 0
+        return adv, adv
+
+    # bootstrap from V(t+1) only when position t+1 is itself inside the
+    # response — at the last response token (EOS-truncated masks included)
+    # the next value is 0, not the critic's opinion of a post-response
+    # position
+    next_mask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1
+    )
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1
+    ) * next_mask
+    xs = (values.T, rewards.T, mask.T, next_values.T)
+    _, adv_rev = jax.lax.scan(
+        step, jnp.zeros(values.shape[0]), xs, reverse=True
+    )
+    advantages = adv_rev.T
+    returns = advantages + values * mask
+    if whiten:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        mean = (advantages * mask).sum() / denom
+        var = (((advantages - mean) ** 2) * mask).sum() / denom
+        advantages = (advantages - mean) * jax.lax.rsqrt(var + 1e-8) * mask
+    return jax.lax.stop_gradient(advantages), jax.lax.stop_gradient(returns)
+
+
+def ppo_loss(
+    logprobs: jax.Array,
+    values: jax.Array,
+    old_logprobs: jax.Array,
+    old_values: jax.Array,
+    advantages: jax.Array,
+    returns: jax.Array,
+    response_mask: jax.Array,
+    clip_ratio: float = 0.2,
+    value_clip: float = 0.2,
+    vf_coef: float = 0.5,
+    entropy: Optional[jax.Array] = None,
+    entropy_coef: float = 0.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Clipped-surrogate policy loss + clipped value loss (reference
+    ppo_util.loss), minus an optional entropy bonus (``entropy`` is the
+    per-token policy entropy [B, T]).  Masked means over response tokens
+    only."""
+    mask = response_mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+
+    ratio = jnp.exp(logprobs - old_logprobs)
+    pg1 = -advantages * ratio
+    pg2 = -advantages * jnp.clip(ratio, 1 - clip_ratio, 1 + clip_ratio)
+    pg_loss = (jnp.maximum(pg1, pg2) * mask).sum() / denom
+
+    v_clipped = old_values + jnp.clip(
+        values - old_values, -value_clip, value_clip
+    )
+    vf1 = (values - returns) ** 2
+    vf2 = (v_clipped - returns) ** 2
+    vf_loss = 0.5 * (jnp.maximum(vf1, vf2) * mask).sum() / denom
+
+    loss = pg_loss + vf_coef * vf_loss
+    mean_entropy = jnp.zeros(())
+    if entropy is not None and entropy_coef > 0:
+        mean_entropy = (entropy * mask).sum() / denom
+        loss = loss - entropy_coef * mean_entropy
+    stats = {
+        "policy_loss": pg_loss,
+        "value_loss": vf_loss,
+        "entropy": mean_entropy,
+        "approx_kl": ((old_logprobs - logprobs) * mask).sum() / denom,
+        "clipfrac": (
+            (jnp.abs(ratio - 1.0) > clip_ratio).astype(jnp.float32) * mask
+        ).sum() / denom,
+    }
+    return loss, stats

@@ -1,0 +1,57 @@
+"""Experience replay buffer for PPO (reference parity:
+atorch/atorch/rl/replay_buffer/replay_buffer.py — host-side experience
+storage with shuffled minibatch iteration)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Experience:
+    """One rollout batch; all arrays [B, T] (tokens include the prompt)."""
+
+    tokens: np.ndarray
+    response_mask: np.ndarray
+    logprobs: np.ndarray
+    values: np.ndarray
+    advantages: np.ndarray
+    returns: np.ndarray
+
+
+class ReplayBuffer:
+    def __init__(self):
+        self._items: List[Experience] = []
+
+    def add(self, exp: Experience) -> None:
+        self._items.append(exp)
+
+    def clear(self) -> None:
+        self._items.clear()
+
+    def __len__(self) -> int:
+        return sum(len(e.tokens) for e in self._items)
+
+    def _stacked(self) -> Dict[str, np.ndarray]:
+        fields = [f.name for f in dataclasses.fields(Experience)]
+        return {
+            f: np.concatenate([getattr(e, f) for e in self._items])
+            for f in fields
+        }
+
+    def minibatches(
+        self, num_minibatches: int, rng: np.random.RandomState
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Shuffled EQUAL-SIZED minibatches (remainder rows dropped) so a
+        jitted update step compiles once, not once per split shape."""
+        data = self._stacked()
+        n = len(next(iter(data.values())))
+        mb_size = max(1, n // num_minibatches)
+        order = rng.permutation(n)
+        for i in range(0, mb_size * (n // mb_size), mb_size):
+            idx = order[i:i + mb_size]
+            if len(idx) == mb_size:
+                yield {k: v[idx] for k, v in data.items()}

@@ -264,16 +264,20 @@ class ElasticTrainer:
             self.step, elapsed_per_step=self._step_timer.ema_seconds
         )
 
-    def maybe_save(self) -> None:
+    def maybe_save(self) -> bool:
         """Flash-checkpoint cadence: shm every ``save_memory_interval``
-        steps, async disk persist every ``save_storage_interval``."""
+        steps, async disk persist every ``save_storage_interval``.
+        Returns True when a checkpoint was actually written."""
         if self._ckpt is None:
-            return
+            return False
         step = self.step
         if self._save_storage_interval and step % self._save_storage_interval == 0:
             self._ckpt.save_checkpoint(step, self.state, StorageType.DISK)
-        elif self._save_memory_interval and step % self._save_memory_interval == 0:
+            return True
+        if self._save_memory_interval and step % self._save_memory_interval == 0:
             self._ckpt.save_checkpoint(step, self.state, StorageType.MEMORY)
+            return True
+        return False
 
     def save(self, storage_type: StorageType = StorageType.DISK) -> bool:
         if self._ckpt is None:
