@@ -152,7 +152,8 @@ def default_loss_fn(
             else:
                 mask = valid
         loss, weight = fused_lm_head_loss(
-            hidden, kernel, labels, mask, chunk_size=loss_chunk_size
+            hidden, kernel, labels, mask, chunk_size=loss_chunk_size,
+            logit_scale=getattr(model.config, "logit_scale", 1.0),
         )
         return loss + _aux_losses(var_updates), {"weight": weight}
 

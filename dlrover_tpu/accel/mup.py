@@ -1,0 +1,156 @@
+"""muP (maximal update parametrization): width-transferable hyperparams.
+
+Parity target: reference atorch/atorch/mup/ — ``MupModule``/``MupLinear``
+(module.py:11,29) track infshapes and rescale inits, ``MuAdam``/``MuSGD``
+(optim.py:76,126) adjust per-group learning rates so tuned LRs transfer
+from a small proxy model to the full width (Tensor Programs V,
+arXiv:2203.03466).
+
+TPU-native shape: no module subclassing — JAX params are a pytree, so
+muP is (a) a pure *labeling* of that tree (embed / hidden / output /
+vector) from path names + shapes, (b) an ``optax.multi_transform`` whose
+adam LR is divided by the width multiplier for hidden and output
+matrices, (c) an init rescale of the output head, and (d) the model's
+``logit_scale = 1/width_mult``.  All of it composes with accelerate()'s
+sharded train step unchanged.
+
+The practical Adam recipe (Table 8 of the paper, with the output
+multiplier ABSORBED into init + LR — use either the absorbed form or an
+explicit ``logit_scale = 1/m``, never both):
+  - embedding & vector params (norms, biases): lr η, init unchanged;
+  - hidden matrices: lr η/m (init already ∝ 1/sqrt(fan_in), which the
+    standard lecun/normal initializers give);
+  - output head: lr η/m and init scaled by an extra 1/sqrt(m) (making
+    its std ∝ 1/fan_in overall).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass
+class MupConfig:
+    """``width_mult`` = target_width / base_width of the tuned proxy."""
+
+    base_width: int
+    width: int
+
+    @property
+    def width_mult(self) -> float:
+        return self.width / self.base_width
+
+    @property
+    def logit_scale(self) -> float:
+        """The EXPLICIT-multiplier convention (alternative to the
+        absorbed init+LR form this module applies by default): set the
+        model's logit_scale to this and skip apply_mup_init's output
+        rescale.  Do not combine both."""
+        return 1.0 / self.width_mult
+
+
+EMBED = "embed"
+HIDDEN = "hidden"
+OUTPUT = "output"
+VECTOR = "vector"
+
+
+def classify_param(path: tuple, value: Any) -> str:
+    """muP role from the flax param path + shape (the reference encodes
+    the same roles in MupLinear subclasses: QKVLayer/OutputLayer)."""
+    names = [str(getattr(p, "key", p)) for p in path]
+    joined = "/".join(names)
+    if value.ndim <= 1:
+        return VECTOR
+    if "embed_tokens" in joined:
+        # NOTE: with tie_embeddings the shared table serves both input
+        # and output; it keeps the EMBED role (lr η).  Tied models get
+        # their output correction from the EXPLICIT convention instead:
+        # set model logit_scale = MupConfig.logit_scale and skip
+        # apply_mup_init (there is no separate output param to rescale).
+        return EMBED
+    if "lm_head" in joined:
+        return OUTPUT
+    return HIDDEN
+
+
+def label_tree(params: Any,
+               classify: Callable[[tuple, Any], str] = classify_param
+               ) -> Any:
+    return jax.tree_util.tree_map_with_path(classify, params)
+
+
+def apply_mup_init(params: Any, config: MupConfig,
+                   classify: Callable = classify_param) -> Any:
+    """Post-init rescale: output-head weights get an extra 1/sqrt(m)
+    (standard init is var 1/fan_in; muP output wants var 1/fan_in/m)."""
+    m = config.width_mult
+
+    def rescale(path, value):
+        if classify(path, value) == OUTPUT:
+            return value / jnp.sqrt(jnp.asarray(m, value.dtype))
+        return value
+
+    return jax.tree_util.tree_map_with_path(rescale, params)
+
+
+def mu_adam(
+    learning_rate: float,
+    config: MupConfig,
+    classify: Callable = classify_param,
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """Adam with muP per-role LRs (reference MuAdam: hidden/output groups
+    get lr/m).  ``weight_decay`` follows the scaled-wd convention
+    (decoupled wd multiplied by the same factor, reference scaled_wd)."""
+    m = config.width_mult
+
+    def make(lr_scale: float) -> optax.GradientTransformation:
+        lr = learning_rate * lr_scale
+        if weight_decay:
+            return optax.adamw(lr, b1=b1, b2=b2, eps=eps,
+                               weight_decay=weight_decay)
+        return optax.adam(lr, b1=b1, b2=b2, eps=eps)
+
+    transforms: Dict[str, optax.GradientTransformation] = {
+        EMBED: make(1.0),
+        VECTOR: make(1.0),
+        HIDDEN: make(1.0 / m),
+        OUTPUT: make(1.0 / m),
+    }
+    return optax.multi_transform(
+        transforms, lambda params: label_tree(params, classify)
+    )
+
+
+def make_mup_model_config(base_config, width: int, base_width: int,
+                          **overrides):
+    """Scale the PROXY config (``base_config``, whose hidden size must be
+    ``base_width``) to ``width`` under muP: hidden sizes and head count
+    scale (head_dim fixed).  The output correction comes from
+    ``apply_mup_init`` + ``mu_adam`` (absorbed convention), so
+    logit_scale stays 1.  Returns a new config of the same dataclass."""
+    cfg = base_config
+    if cfg.hidden_size != base_width:
+        raise ValueError(
+            f"base_config.hidden_size={cfg.hidden_size} must equal "
+            f"base_width={base_width}: the proxy config IS the base; a "
+            "mismatch would desync model geometry from mu_adam's LRs"
+        )
+    ratio = width / base_width
+    return dataclasses.replace(
+        cfg,
+        hidden_size=width,
+        intermediate_size=int(cfg.intermediate_size * ratio),
+        num_heads=max(1, int(cfg.num_heads * ratio)),
+        num_kv_heads=max(1, int(cfg.num_kv_heads * ratio)),
+        **overrides,
+    )

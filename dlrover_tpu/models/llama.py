@@ -63,6 +63,9 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     moe_z_loss_coef: float = 1e-3
+    # output-logit multiplier; muP sets this to base_width/width so the
+    # logit scale is width-invariant (dlrover_tpu.accel.mup)
+    logit_scale: float = 1.0
 
     @property
     def head_dim_(self) -> int:
@@ -368,4 +371,6 @@ class LlamaModel(nn.Module):
                 name="lm_head",
             )
             logits = lm_head(x)
+        if cfg.logit_scale != 1.0:
+            logits = logits * cfg.logit_scale
         return with_logical_constraint(logits, ("batch", "seq", "vocab"))

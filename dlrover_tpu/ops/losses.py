@@ -56,6 +56,7 @@ def fused_lm_head_loss(
     *,
     chunk_size: int = 512,
     z_loss_weight: float = 0.0,
+    logit_scale: float = 1.0,
 ):
     """LM-head projection + cross entropy without materializing the full
     ``[batch, seq, vocab]`` logits.
@@ -98,6 +99,10 @@ def fused_lm_head_loss(
             (((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if logit_scale != 1.0:
+            # the model's output multiplier (e.g. muP's explicit 1/m
+            # convention) must match the non-fused logits path
+            logits = logits * logit_scale
         loss, z_loss = cross_entropy_with_integer_labels(
             logits, lab, z_loss_weight=z_loss_weight
         )
