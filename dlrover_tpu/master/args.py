@@ -30,6 +30,10 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="seconds to wait pending nodes before failing the job",
     )
     parser.add_argument(
+        "--worker_image", default="",
+        help="container image for worker pods (k8s platform)",
+    )
+    parser.add_argument(
         "--distribution_strategy",
         default="AllreduceStrategy",
     )

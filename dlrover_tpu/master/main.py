@@ -32,11 +32,38 @@ def run(args) -> int:
             node_num=args.node_num,
             autoscale=args.autoscale,
         )
+    elif args.platform in ("k8s", "pyk8s"):
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.scheduler.k8s import (
+            PodScaler,
+            PodWatcher,
+            default_k8s_api,
+        )
+
+        api = default_k8s_api()
+        # workers reach the master through the "{job}-master" Service the
+        # operator creates; the port must be the one actually bound
+        scaler = PodScaler(
+            args.job_name,
+            api=api,
+            namespace=args.namespace,
+            image=args.worker_image,
+            node_num=args.node_num,
+            master_addr=f"{args.job_name}-master:{port}",
+        )
+        master = DistributedJobMaster(
+            port,
+            scaler=scaler,
+            watcher=PodWatcher(args.job_name, api=api,
+                               namespace=args.namespace),
+            node_num=args.node_num,
+            autoscale=args.autoscale,
+        )
     else:
         raise NotImplementedError(
-            f"platform {args.platform!r} is not wired up yet; 'local' and "
-            "'in_memory' are supported (the k8s operator lands with the "
-            "cluster scheduler backend)"
+            f"platform {args.platform!r} is not wired up yet; 'local', "
+            "'in_memory', and 'k8s' are supported ('ray' uses the "
+            "dlrover_tpu.client.ray_job submitter from outside a cluster)"
         )
     master.prepare()
     logger.info(

@@ -1,0 +1,242 @@
+"""ElasticJob controller: the reference Go operator's reconciler in
+framework-native form.
+
+Parity targets (reference, Go):
+- CRD types ``ElasticJob``/``ReplicaSpec``/``ScalePlan``
+  (dlrover/go/operator/api/v1alpha1/elasticjob_types.go:29-127,
+  scaleplan_types.go:129);
+- the reconciler state machine Created -> Pending -> Running ->
+  (Scaling) -> Succeeded/Failed
+  (pkg/controllers/elasticjob_controller.go:108-156), which launches
+  exactly one job master (pkg/controllers/master/master.go) and realizes
+  ScalePlans (scaleplan_controller.go:199);
+- fault-pod handling in pkg/controllers/training/task.go:545.
+
+TPU-native shape: the controller is platform-agnostic — it drives any
+``Scaler``/``NodeWatcher`` pair (k8s PodScaler/PodWatcher in a cluster,
+the in-memory scheduler in tests), so the reconcile logic itself is unit
+-testable without a kube-apiserver, and on GKE the schedulable unit is a
+TPU pod-slice host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.base import NodeWatcher
+
+
+class JobPhase:
+    """elasticjob_types.go JobPhase values."""
+
+    CREATED = "Created"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SCALING = "Scaling"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """elasticjob_types.go ReplicaSpec: how many nodes of one type and
+    their per-node resources + restart budget."""
+
+    replicas: int
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+    restart_count: int = 3
+    priority: str = ""
+
+
+@dataclasses.dataclass
+class ElasticJobSpec:
+    job_name: str
+    replica_specs: Dict[str, ReplicaSpec]
+    distribution_strategy: str = "AllreduceStrategy"
+    enable_elastic_scheduling: bool = True
+
+
+@dataclasses.dataclass
+class ElasticJobStatus:
+    phase: str = JobPhase.CREATED
+    scale_generation: int = 0
+    start_time: float = 0.0
+    completion_time: float = 0.0
+    replica_statuses: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class ElasticJob:
+    spec: ElasticJobSpec
+    status: ElasticJobStatus = dataclasses.field(
+        default_factory=ElasticJobStatus
+    )
+
+
+@dataclasses.dataclass
+class ScalePlanCR:
+    """scaleplan_types.go ScalePlan: a user/brain-submitted resize."""
+
+    replica_resource_specs: Dict[str, ReplicaSpec]
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+class ElasticJobController:
+    """Reconcile loop over one job (elasticjob_controller.go:108-156).
+
+    Each ``reconcile()`` observes cluster state through the watcher,
+    advances the phase machine, and issues ScalePlans through the
+    scaler.  Call it periodically (or after watcher events).
+    """
+
+    def __init__(self, job: ElasticJob, scaler: Scaler,
+                 watcher: NodeWatcher):
+        self.job = job
+        self._scaler = scaler
+        self._watcher = watcher
+        self._relaunch_counts: Dict[tuple, int] = {}
+        # pod names already relaunched: k8s deletes asynchronously, so a
+        # Failed pod lingers in list() — it must not burn budget twice
+        self._handled_failures: set = set()
+
+    # -- observation ------------------------------------------------------
+    def _observe(self) -> Dict[str, List[Node]]:
+        by_type: Dict[str, List[Node]] = {}
+        for node in self._watcher.list():
+            by_type.setdefault(node.type, []).append(node)
+        return by_type
+
+    def _update_replica_statuses(
+        self, observed: Dict[str, List[Node]]
+    ) -> None:
+        statuses: Dict[str, Dict[str, int]] = {}
+        for node_type, nodes in observed.items():
+            counts: Dict[str, int] = {}
+            for n in nodes:
+                counts[n.status] = counts.get(n.status, 0) + 1
+            statuses[node_type] = counts
+        self.job.status.replica_statuses = statuses
+
+    # -- reconcile --------------------------------------------------------
+    def reconcile(self) -> str:
+        """One reconcile pass; returns the (possibly new) phase."""
+        job = self.job
+        observed = self._observe()
+        self._update_replica_statuses(observed)
+        phase = job.status.phase
+
+        if phase == JobPhase.CREATED:
+            # launch the full initial replica set (the Go operator first
+            # creates the master pod — here the master IS the process
+            # hosting this controller, so only workers are scheduled)
+            plan = ScalePlan()
+            for node_type, spec in job.spec.replica_specs.items():
+                plan.node_group_resources[node_type] = NodeGroupResource(
+                    count=spec.replicas, node_resource=spec.resource
+                )
+            self._scaler.scale(plan)
+            job.status.phase = JobPhase.PENDING
+            job.status.start_time = time.time()
+
+        elif phase in (JobPhase.PENDING, JobPhase.RUNNING,
+                       JobPhase.SCALING):
+            # terminal checks apply in EVERY live phase: a fast job can
+            # finish (or exhaust its budget) before all replicas were
+            # ever simultaneously Running
+            if self._job_succeeded(observed):
+                job.status.phase = JobPhase.SUCCEEDED
+                job.status.completion_time = time.time()
+            elif self._job_failed(observed):
+                job.status.phase = JobPhase.FAILED
+                job.status.completion_time = time.time()
+            else:
+                self._handle_faults(observed)
+                if (phase in (JobPhase.PENDING, JobPhase.SCALING)
+                        and self._all_running(observed)):
+                    job.status.phase = JobPhase.RUNNING
+
+        if job.status.phase != phase:
+            logger.info("job %s: %s -> %s", job.spec.job_name, phase,
+                        job.status.phase)
+        return job.status.phase
+
+    def apply_scale_plan(self, plan: ScalePlanCR) -> None:
+        """User/Brain-submitted resize (scaleplan_controller.go:199)."""
+        if not self.job.spec.enable_elastic_scheduling:
+            logger.warning("elastic scheduling disabled; plan ignored")
+            return
+        scale = ScalePlan()
+        for node_type, spec in plan.replica_resource_specs.items():
+            self.job.spec.replica_specs[node_type] = spec
+            scale.node_group_resources[node_type] = NodeGroupResource(
+                count=spec.replicas, node_resource=spec.resource
+            )
+        self._scaler.scale(scale)
+        self.job.status.phase = JobPhase.SCALING
+        self.job.status.scale_generation += 1
+
+    # -- helpers ---------------------------------------------------------
+    def _all_running(self, observed: Dict[str, List[Node]]) -> bool:
+        for node_type, spec in self.job.spec.replica_specs.items():
+            nodes = observed.get(node_type, [])
+            running = [n for n in nodes
+                       if n.status == NodeStatus.RUNNING]
+            if len(running) < spec.replicas:
+                return False
+        return True
+
+    def _job_succeeded(self, observed: Dict[str, List[Node]]) -> bool:
+        workers = observed.get(NodeType.WORKER, [])
+        return bool(workers) and all(
+            n.status == NodeStatus.SUCCEEDED for n in workers
+        )
+
+    def _job_failed(self, observed: Dict[str, List[Node]]) -> bool:
+        spec = self.job.spec.replica_specs.get(NodeType.WORKER)
+        if spec is None:
+            return False
+        for n in observed.get(NodeType.WORKER, []):
+            # budget is PER RANK (a relaunched pod has a fresh name but
+            # inherits the rank's failure history, training/task.go:545)
+            key = (NodeType.WORKER, n.rank_index)
+            if (n.status == NodeStatus.FAILED
+                    and n.name not in self._handled_failures
+                    and self._relaunch_counts.get(key, 0)
+                    >= spec.restart_count):
+                return True
+        return False
+
+    def _handle_faults(self, observed: Dict[str, List[Node]]) -> None:
+        """Relaunch failed pods within the per-rank budget
+        (training/task.go:545)."""
+        plan = ScalePlan()
+        for node_type, spec in self.job.spec.replica_specs.items():
+            for n in observed.get(node_type, []):
+                if (n.status != NodeStatus.FAILED
+                        or n.name in self._handled_failures):
+                    continue
+                key = (node_type, n.rank_index)
+                used = self._relaunch_counts.get(key, 0)
+                if used >= spec.restart_count:
+                    continue
+                self._handled_failures.add(n.name)
+                self._relaunch_counts[key] = used + 1
+                replacement = Node(
+                    node_type,
+                    n.id + 100000 * (used + 1),
+                    rank_index=n.rank_index,
+                    config_resource=spec.resource,
+                    relaunch_count=used + 1,
+                )
+                plan.remove_nodes.append(n)
+                plan.launch_nodes.append(replacement)
+        if not plan.empty():
+            self._scaler.scale(plan)

@@ -1,0 +1,351 @@
+"""Kubernetes scheduler backend: PodScaler + PodWatcher for TPU jobs.
+
+Parity targets in the reference:
+- ``k8sClient`` singleton (dlrover/python/scheduler/kubernetes.py:121);
+- ``PodScaler`` (dlrover/python/master/scaler/pod_scaler.py:78-707) —
+  realize ScalePlans by creating/deleting pods, build worker pod specs
+  (:608), periodic creator thread (:420);
+- ``PodWatcher`` (dlrover/python/master/watcher/k8s_watcher.py:194-265)
+  — list/watch pods into NodeEvents.
+
+TPU-native differences: the schedulable unit is a HOST of a TPU pod
+slice — pods request ``google.com/tpu`` chips, carry the TPU topology
+node selectors, and the master injects the DLROVER_* env contract the
+elastic agent expects.  The kubernetes client import is gated so every
+code path is testable with an injected fake API object (the reference
+mocks k8sClient the same way, tests/test_utils.py:268).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    DEFAULT_MASTER_PORT,
+    NodeEnv,
+    NodeEventType,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.base import NodeEvent, NodeWatcher
+
+_POD_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.INITIAL,
+}
+
+_LABEL_JOB = "dlrover-tpu/job-name"
+_LABEL_TYPE = "dlrover-tpu/node-type"
+_LABEL_RANK = "dlrover-tpu/rank-index"
+_LABEL_ID = "dlrover-tpu/node-id"
+
+
+def default_k8s_api():  # pragma: no cover - needs a cluster
+    """Build the real CoreV1Api (reference k8sClient singleton)."""
+    try:
+        from kubernetes import client, config
+    except ImportError as e:
+        raise RuntimeError(
+            "--platform k8s needs the `kubernetes` python client "
+            "installed in the master image (pip install kubernetes); "
+            "tests inject a fake API object instead"
+        ) from e
+
+    try:
+        config.load_incluster_config()
+    except Exception:
+        config.load_kube_config()
+    return client.CoreV1Api()
+
+
+def build_pod_spec(
+    job_name: str,
+    node: Node,
+    *,
+    image: str,
+    command: List[str],
+    namespace: str = "default",
+    master_addr: str = "",
+    node_num: int = 1,
+    tpu_chips_per_host: int = 4,
+    tpu_topology: str = "",
+    extra_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Worker pod manifest (reference pod_scaler.py:608 _create_pod_obj),
+    as a plain dict so tests need no kubernetes models.  The env block is
+    the agent's startup contract (trainer/elastic/distributed.py)."""
+    res = node.config_resource or NodeResource()
+    limits: Dict[str, Any] = {}
+    if res.cpu:
+        limits["cpu"] = str(res.cpu)
+    if res.memory:
+        limits["memory"] = f"{res.memory}Mi"
+    chips = res.tpu_chips or tpu_chips_per_host
+    if chips:
+        limits["google.com/tpu"] = str(chips)
+    env = {
+        NodeEnv.MASTER_ADDR: master_addr
+        or f"{job_name}-master:{DEFAULT_MASTER_PORT}",
+        NodeEnv.NODE_RANK: str(node.rank_index),
+        NodeEnv.NODE_NUM: str(node_num),
+        NodeEnv.NODE_ID: str(node.id),
+    }
+    env.update(extra_env or {})
+    node_selector: Dict[str, str] = {}
+    if res.tpu_type:
+        node_selector["cloud.google.com/gke-tpu-accelerator"] = res.tpu_type
+    if tpu_topology:
+        node_selector["cloud.google.com/gke-tpu-topology"] = tpu_topology
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            # job-prefixed so two jobs in one namespace can't collide
+            "name": f"{job_name}-{node.name}",
+            "namespace": namespace,
+            "labels": {
+                _LABEL_JOB: job_name,
+                _LABEL_TYPE: node.type,
+                _LABEL_RANK: str(node.rank_index),
+                _LABEL_ID: str(node.id),
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeSelector": node_selector,
+            "containers": [{
+                "name": "worker",
+                "image": image,
+                "command": command,
+                "env": [{"name": k, "value": v} for k, v in env.items()],
+                "resources": {"limits": limits, "requests": dict(limits)},
+            }],
+        },
+    }
+
+
+class PodScaler(Scaler):
+    """Create/delete worker pods to match ScalePlans.
+
+    ``api`` needs three methods (duck-typed, so tests inject a fake):
+    ``create_namespaced_pod(namespace, body)``,
+    ``delete_namespaced_pod(name, namespace)``,
+    ``list_namespaced_pod(namespace, label_selector)``.
+    Pod creation runs on a background thread draining a queue, like the
+    reference's periodic creator (pod_scaler.py:420) — a wedged API
+    server must not block the master loop.
+    """
+
+    def __init__(
+        self,
+        job_name: str,
+        api: Optional[Any] = None,
+        namespace: str = "default",
+        image: str = "",
+        command: Optional[List[str]] = None,
+        master_addr: str = "",
+        node_num: int = 1,
+        spec_overrides: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(job_name)
+        self._api = api if api is not None else default_k8s_api()
+        self._namespace = namespace
+        self._image = image
+        self._command = command or ["dlrover-tpu-run"]
+        self._master_addr = master_addr
+        self._node_num = node_num
+        self._spec_overrides = spec_overrides or {}
+        self._pending: List[Node] = []
+        self._removals: List[Node] = []
+        self._group_targets: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._creator_loop, daemon=True, name="pod-creator"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # -- plan execution ---------------------------------------------------
+    def scale(self, plan: ScalePlan) -> None:
+        """Record desired state only — NO API calls on the caller thread
+        (the master's event loop must survive a wedged apiserver; all
+        blocking work happens on the creator thread)."""
+        if plan.empty():
+            return
+        with self._lock:
+            self._pending.extend(plan.launch_nodes)
+            self._removals.extend(plan.remove_nodes)
+            for node_type, group in plan.node_group_resources.items():
+                self._group_targets[node_type] = group
+
+    def _pod_name(self, node: Node) -> str:
+        prefix = f"{self._job_name}-"
+        return node.name if node.name.startswith(prefix) \
+            else prefix + node.name
+
+    def _fill_group(self, node_type: str, group) -> None:
+        """Compute missing ranks from live pods (creator thread only)."""
+        alive = [
+            n for n in self._list_nodes()
+            if n.type == node_type and not n.is_exited()
+        ]
+        with self._lock:
+            alive += [p for p in self._pending if p.type == node_type]
+            used_ranks = {n.rank_index for n in alive}
+            next_id = max([n.id for n in alive], default=-1) + 1
+            rank = 0
+            for _ in range(group.count - len(alive)):
+                while rank in used_ranks:
+                    rank += 1
+                used_ranks.add(rank)
+                self._pending.append(Node(
+                    node_type, next_id, rank_index=rank,
+                    config_resource=group.node_resource,
+                ))
+                next_id += 1
+
+    def _creator_loop(self) -> None:
+        while not self._stop.wait(0.5):
+            self.create_pending_pods()
+
+    def create_pending_pods(self) -> int:
+        """Creator-thread body: deletions, group fills, pod creates."""
+        with self._lock:
+            removals, self._removals = self._removals, []
+            targets = dict(self._group_targets)
+            self._group_targets.clear()
+        for node in removals:
+            try:
+                self._api.delete_namespaced_pod(
+                    name=self._pod_name(node), namespace=self._namespace
+                )
+            except Exception as e:
+                logger.warning("pod delete %s failed: %s", node.name, e)
+        for node_type, group in targets.items():
+            self._fill_group(node_type, group)
+        with self._lock:
+            todo, self._pending = self._pending, []
+        created = 0
+        for node in todo:
+            body = build_pod_spec(
+                self._job_name, node,
+                image=self._image, command=self._command,
+                namespace=self._namespace,
+                master_addr=self._master_addr,
+                node_num=self._node_num,
+                **self._spec_overrides,
+            )
+            try:
+                self._api.create_namespaced_pod(
+                    namespace=self._namespace, body=body
+                )
+                created += 1
+            except Exception as e:
+                logger.warning("pod create %s failed (requeued): %s",
+                               node.name, e)
+                with self._lock:
+                    self._pending.append(node)
+        return created
+
+    def _list_nodes(self) -> List[Node]:
+        try:
+            pods = self._api.list_namespaced_pod(
+                namespace=self._namespace,
+                label_selector=f"{_LABEL_JOB}={self._job_name}",
+            )
+        except Exception as e:
+            logger.warning("pod list failed: %s", e)
+            return []
+        return [pod_to_node(p) for p in _items(pods)]
+
+
+def _items(pod_list: Any) -> List[Any]:
+    return getattr(pod_list, "items", pod_list)
+
+
+def _meta(pod: Any, field: str, default=None):
+    if isinstance(pod, dict):
+        return pod.get(field, default)
+    return getattr(pod, field, default)
+
+
+def pod_to_node(pod: Any) -> Node:
+    """Pod (dict or k8s model) -> Node (reference k8s_watcher
+    _convert_pod_event_to_node_event)."""
+    metadata = _meta(pod, "metadata", {})
+    labels = _meta(metadata, "labels", {}) or {}
+    status = _meta(pod, "status", {})
+    phase = _meta(status, "phase", "Unknown")
+    node = Node(
+        labels.get(_LABEL_TYPE, NodeType.WORKER),
+        int(labels.get(_LABEL_ID, 0)),
+        name=_meta(metadata, "name", ""),
+        rank_index=int(labels.get(_LABEL_RANK, 0)),
+        status=_POD_PHASE_TO_STATUS.get(str(phase), NodeStatus.INITIAL),
+    )
+    return node
+
+
+class PodWatcher(NodeWatcher):
+    """List/watch pods of one job (reference k8s_watcher.py:194-265).
+
+    Without a real watch stream (fake API in tests), ``watch`` degrades
+    to list-and-diff, which is also the reconnect fallback the reference
+    uses when the watch connection drops.
+    """
+
+    def __init__(self, job_name: str, api: Optional[Any] = None,
+                 namespace: str = "default"):
+        self._job_name = job_name
+        self._api = api if api is not None else default_k8s_api()
+        self._namespace = namespace
+        self._known: Dict[str, Node] = {}  # pod name -> last snapshot
+
+    def list(self) -> List[Node]:
+        pods = self._api.list_namespaced_pod(
+            namespace=self._namespace,
+            label_selector=f"{_LABEL_JOB}={self._job_name}",
+        )
+        return [pod_to_node(p) for p in _items(pods)]
+
+    def watch(self, timeout: float = 1.0) -> List[NodeEvent]:
+        deadline = time.time() + timeout
+        events: List[NodeEvent] = []
+        while not events and time.time() < deadline:
+            current = {n.name: n for n in self.list()}
+            for name, node in current.items():
+                prev = self._known.get(name)
+                if prev is None:
+                    events.append(NodeEvent(NodeEventType.ADDED, node))
+                elif prev.status != node.status:
+                    events.append(NodeEvent(NodeEventType.MODIFIED, node))
+            for name in set(self._known) - set(current):
+                # a deletion must carry the REAL node identity (id/rank
+                # from the last snapshot) — the master keys its node
+                # table by id, so a placeholder would delete rank 0
+                gone = self._known[name]
+                gone.update_status(NodeStatus.DELETED)
+                events.append(NodeEvent(NodeEventType.DELETED, gone))
+            self._known = current
+            if not events:
+                time.sleep(min(0.1, timeout))
+        return events

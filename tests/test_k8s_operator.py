@@ -1,0 +1,258 @@
+"""K8s scheduler backend + ElasticJob controller tests (reference parity:
+master/scaler/pod_scaler.py, watcher/k8s_watcher.py, and the Go
+operator's reconciler pkg/controllers/elasticjob_controller.go:108-156
+— run against a fake pod API / the in-memory cluster)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.client.ray_job import RayJobSubmitter
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler.base import ScalePlan
+from dlrover_tpu.operator.controller import (
+    ElasticJob,
+    ElasticJobController,
+    ElasticJobSpec,
+    JobPhase,
+    ReplicaSpec,
+    ScalePlanCR,
+)
+from dlrover_tpu.scheduler.in_memory import (
+    InMemoryCluster,
+    InMemoryNodeWatcher,
+    InMemoryScaler,
+)
+from dlrover_tpu.scheduler.k8s import (
+    PodScaler,
+    PodWatcher,
+    build_pod_spec,
+    pod_to_node,
+)
+
+
+class FakePodApi:
+    """Duck-typed CoreV1Api holding pod dicts (reference mock_k8s_client)."""
+
+    def __init__(self):
+        self.pods = {}
+        self.create_calls = 0
+        self.fail_creates = 0
+
+    def create_namespaced_pod(self, namespace, body):
+        self.create_calls += 1
+        if self.fail_creates > 0:
+            self.fail_creates -= 1
+            raise RuntimeError("apiserver unavailable")
+        body.setdefault("status", {"phase": "Running"})
+        self.pods[body["metadata"]["name"]] = body
+
+    def delete_namespaced_pod(self, name, namespace):
+        self.pods.pop(name, None)
+
+    def list_namespaced_pod(self, namespace, label_selector=""):
+        want = dict(kv.split("=") for kv in label_selector.split(",")) \
+            if label_selector else {}
+        out = []
+        for p in self.pods.values():
+            labels = p["metadata"].get("labels", {})
+            if all(labels.get(k) == v for k, v in want.items()):
+                out.append(p)
+        return out
+
+
+def test_build_pod_spec_contract():
+    node = Node("worker", 3, rank_index=1,
+                config_resource=NodeResource(cpu=8, memory=16384,
+                                             tpu_chips=4,
+                                             tpu_type="tpu-v5-lite-podslice"))
+    spec = build_pod_spec(
+        "jobx", node, image="img:1", command=["dlrover-tpu-run"],
+        master_addr="1.2.3.4:22225", node_num=4, tpu_topology="2x4",
+    )
+    c = spec["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env[NodeEnv.MASTER_ADDR] == "1.2.3.4:22225"
+    assert env[NodeEnv.NODE_RANK] == "1"
+    assert env[NodeEnv.NODE_NUM] == "4"
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert c["resources"]["limits"]["memory"] == "16384Mi"
+    sel = spec["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    # roundtrip: the watcher reconstructs the node from the labels
+    spec["status"] = {"phase": "Running"}
+    back = pod_to_node(spec)
+    assert back.type == "worker" and back.rank_index == 1
+    assert back.status == NodeStatus.RUNNING
+
+
+def test_pod_scaler_fills_group_and_retries():
+    api = FakePodApi()
+    scaler = PodScaler("jobx", api=api, image="img", node_num=3)
+    plan = ScalePlan()
+    plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+        count=3, node_resource=NodeResource(cpu=1))
+    scaler.scale(plan)
+    api.fail_creates = 1  # first create bounces -> requeued
+    created = scaler.create_pending_pods()
+    assert created == 2
+    assert scaler.create_pending_pods() == 1  # retry drains the queue
+    assert len(api.pods) == 3
+    ranks = sorted(
+        int(p["metadata"]["labels"]["dlrover-tpu/rank-index"])
+        for p in api.pods.values())
+    assert ranks == [0, 1, 2]
+    # re-scaling to the same size is a no-op (group already full)
+    scaler.scale(plan)
+    assert scaler.create_pending_pods() == 0
+
+
+def test_pod_watcher_list_and_diff_events():
+    api = FakePodApi()
+    scaler = PodScaler("jobx", api=api, image="img")
+    plan = ScalePlan()
+    plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(count=2)
+    scaler.scale(plan)
+    scaler.create_pending_pods()
+    watcher = PodWatcher("jobx", api=api)
+    events = watcher.watch(timeout=0.5)
+    assert {e.event_type for e in events} == {NodeEventType.ADDED}
+    assert len(watcher.list()) == 2
+    # a pod failing surfaces as MODIFIED
+    name = next(iter(api.pods))
+    api.pods[name]["status"]["phase"] = "Failed"
+    events = watcher.watch(timeout=0.5)
+    assert events and events[0].event_type == NodeEventType.MODIFIED
+    assert events[0].node.status == NodeStatus.FAILED
+    # deletion surfaces as DELETED
+    api.delete_namespaced_pod(name, "default")
+    events = watcher.watch(timeout=0.5)
+    assert events and events[0].event_type == NodeEventType.DELETED
+
+
+# -- controller -------------------------------------------------------------
+
+
+def _controller(replicas=2, restart_count=1):
+    cluster = InMemoryCluster()
+    job = ElasticJob(spec=ElasticJobSpec(
+        job_name="ej",
+        replica_specs={NodeType.WORKER: ReplicaSpec(
+            replicas=replicas, restart_count=restart_count)},
+    ))
+    ctl = ElasticJobController(
+        job, InMemoryScaler(cluster), InMemoryNodeWatcher(cluster))
+    return ctl, cluster, job
+
+
+def test_controller_phase_machine_to_running():
+    ctl, cluster, job = _controller()
+    assert ctl.reconcile() == JobPhase.PENDING  # created -> scheduled
+    assert len(cluster.nodes) == 2
+    assert ctl.reconcile() == JobPhase.RUNNING  # virtual pods run at once
+    assert job.status.replica_statuses[NodeType.WORKER][
+        NodeStatus.RUNNING] == 2
+
+
+def test_controller_relaunches_failed_pod_then_fails_job():
+    ctl, cluster, job = _controller(replicas=2, restart_count=1)
+    ctl.reconcile()
+    ctl.reconcile()
+    victim = next(iter(cluster.nodes))
+    cluster.fail_node(victim)
+    ctl.reconcile()  # relaunch within budget
+    assert job.status.phase == JobPhase.RUNNING
+    alive = [n for n in cluster.nodes.values()
+             if n.status == NodeStatus.RUNNING]
+    assert len(alive) == 2
+    # the replacement fails too -> budget exhausted -> job FAILED
+    replacement = next(
+        n.name for n in cluster.nodes.values()
+        if n.status == NodeStatus.RUNNING and n.relaunch_count == 1)
+    cluster.fail_node(replacement, NodeExitReason.FATAL_ERROR)
+    ctl.reconcile()
+    assert job.status.phase in (JobPhase.RUNNING, JobPhase.FAILED)
+    # second pass observes the exhausted budget
+    cluster.fail_node(replacement, NodeExitReason.FATAL_ERROR)
+    ctl.reconcile()
+    assert job.status.phase == JobPhase.FAILED
+
+
+def test_controller_ignores_lingering_failed_pod():
+    """k8s deletes pods asynchronously: the same Failed pod observed on
+    two reconcile passes must burn the budget exactly once."""
+    ctl, cluster, job = _controller(replicas=2, restart_count=3)
+    ctl.reconcile()
+    ctl.reconcile()
+    failed = Node(NodeType.WORKER, 0, rank_index=0,
+                  status=NodeStatus.FAILED)
+    observed = {NodeType.WORKER: [failed]}
+    ctl._handle_faults(observed)
+    ctl._handle_faults(observed)  # lingering pod, second pass
+    assert ctl._relaunch_counts[(NodeType.WORKER, 0)] == 1
+
+
+def test_controller_succeeds_when_all_workers_finish():
+    ctl, cluster, job = _controller(replicas=2)
+    ctl.reconcile()
+    ctl.reconcile()
+    for n in list(cluster.nodes.values()):
+        n.update_status(NodeStatus.SUCCEEDED)
+    ctl.reconcile()
+    assert job.status.phase == JobPhase.SUCCEEDED
+    assert job.status.completion_time > 0
+
+
+def test_controller_applies_scale_plan_cr():
+    ctl, cluster, job = _controller(replicas=2)
+    ctl.reconcile()
+    ctl.reconcile()
+    ctl.apply_scale_plan(ScalePlanCR(replica_resource_specs={
+        NodeType.WORKER: ReplicaSpec(replicas=4)}))
+    assert job.status.phase == JobPhase.SCALING
+    assert job.status.scale_generation == 1
+    assert len(cluster.nodes) == 4
+    assert ctl.reconcile() == JobPhase.RUNNING  # scaled set is running
+
+
+# -- ray client -------------------------------------------------------------
+
+
+class FakeRayClient:
+    def __init__(self):
+        self.jobs = {}
+
+    def submit_job(self, entrypoint, runtime_env, submission_id=None):
+        jid = submission_id or f"raysubmit_{len(self.jobs)}"
+        self.jobs[jid] = "RUNNING"
+        return jid
+
+    def get_job_status(self, jid):
+        status = self.jobs[jid]
+        if status == "RUNNING":  # jobs finish on second poll
+            self.jobs[jid] = "SUCCEEDED"
+        return status
+
+    def get_job_logs(self, jid):
+        return "log"
+
+    def stop_job(self, jid):
+        self.jobs[jid] = "STOPPED"
+        return True
+
+
+def test_ray_job_submitter_lifecycle():
+    sub = RayJobSubmitter(client=FakeRayClient())
+    jid = sub.submit("python train.py", {"pip": []})
+    assert sub.status(jid) == "RUNNING"
+    assert sub.wait(jid, timeout=5, poll=0.01) == "SUCCEEDED"
+    assert sub.logs(jid) == "log"
