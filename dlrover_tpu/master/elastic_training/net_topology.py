@@ -29,14 +29,27 @@ class DefaultTopologySorter:
 
 
 class SliceTopologySorter:
-    """Group hosts by (slice_id, asw, rank) — the TPU analog of
-    ``DpTopologySorter`` (reference: net_topology.py:62)."""
+    """Group hosts by (slice_id, asw), contiguous per group — the TPU
+    analog of ``DpTopologySorter`` (reference: net_topology.py:62).
+
+    Like the reference, the group containing the ORIGINAL rank 0 comes
+    first: rank 0 hosts the rendezvous coordinator and often rank-0-only
+    services, so re-sorting must not displace it from position 0.
+    Within and across the remaining groups, order is deterministic
+    (slice, asw, rank) so every master replica computes the same world.
+    """
 
     def sort(
         self, nodes: Dict[int, NodeTopologyMeta]
     ) -> Dict[int, NodeTopologyMeta]:
-        ordered = sorted(
-            nodes.values(),
-            key=lambda n: (n.slice_id, n.asw, n.node_rank),
-        )
+        if not nodes:
+            return {}
+        rank0 = min(nodes.values(), key=lambda n: n.node_rank)
+        head_key = (rank0.slice_id, rank0.asw)
+
+        def key(n: NodeTopologyMeta):
+            group = (n.slice_id, n.asw)
+            return (group != head_key, n.slice_id, n.asw, n.node_rank)
+
+        ordered = sorted(nodes.values(), key=key)
         return {n.node_rank: n for n in ordered}

@@ -1,0 +1,241 @@
+// Native trace/timing library: the TPU counterpart of the reference's
+// xpu_timer (atorch/dev/xpu_timer/xpu_timer/common/{manager,util,
+// xpu_timer}.h/cc + nvidia/hook.cc).
+//
+// The reference LD_PRELOAD-hooks cudaLaunchKernel/NCCL to time GEMMs and
+// collectives with CUDA events and exports bvar/Prometheus metrics.  On
+// TPU the analogous interception point is the HOST-side step/section
+// boundary (XLA owns the device timeline and already exposes it through
+// the profiler); what the runtime needs natively is a zero-allocation,
+// GIL-free span recorder the hot loop can hit thousands of times per
+// second: fixed-capacity ring of spans, per-name aggregates with O(1)
+// insert, Chrome-trace and Prometheus text export.  Python drives it via
+// ctypes (calls release the GIL), C++/C callers link it directly.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (no deps; see
+// dlrover_tpu/utils/native_timer.py).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+inline uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Span {
+  uint32_t name_id;
+  uint64_t start_ns;
+  uint64_t dur_ns;
+};
+
+struct Aggregate {
+  uint64_t count = 0;
+  uint64_t total_ns = 0;
+  uint64_t min_ns = UINT64_MAX;
+  uint64_t max_ns = 0;
+  // fixed reservoir for approximate percentiles (uniform replacement)
+  static constexpr int kReservoir = 256;
+  uint64_t samples[kReservoir];
+  uint64_t seen = 0;
+
+  void add(uint64_t dur) {
+    ++count;
+    total_ns += dur;
+    min_ns = std::min(min_ns, dur);
+    max_ns = std::max(max_ns, dur);
+    if (seen < kReservoir) {
+      samples[seen] = dur;
+    } else {
+      // Vitter's algorithm R with a cheap LCG
+      uint64_t r = (seen * 6364136223846793005ull + 1442695040888963407ull)
+                   % (seen + 1);
+      if (r < kReservoir) samples[r] = dur;
+    }
+    ++seen;
+  }
+
+  uint64_t percentile(double p) const {
+    uint64_t n = std::min<uint64_t>(seen, kReservoir);
+    if (n == 0) return 0;
+    std::vector<uint64_t> sorted(samples, samples + n);
+    std::sort(sorted.begin(), sorted.end());
+    size_t idx = static_cast<size_t>(p * (n - 1));
+    return sorted[idx];
+  }
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<std::string> names;
+  std::unordered_map<std::string, uint32_t> name_ids;
+  std::vector<Span> ring;
+  size_t capacity = 0;
+  size_t head = 0;
+  bool wrapped = false;
+  std::unordered_map<uint32_t, Aggregate> aggregates;
+};
+
+// sanitize a span name for safe JSON / Prometheus interpolation:
+// quotes, backslashes and control chars become '_'; length capped so
+// fixed-size line buffers can never truncate a record mid-structure.
+std::string sanitize(const char* name) {
+  std::string out;
+  for (const char* p = name; *p && out.size() < 120; ++p) {
+    char c = *p;
+    out += (c == '"' || c == '\\' || static_cast<unsigned char>(c) < 0x20)
+               ? '_' : c;
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// handle-based: each tracer is independent (no process-global state to
+// clobber across components)
+void* xt_create(uint64_t ring_capacity) {
+  Tracer* t = new Tracer();
+  t->capacity = ring_capacity ? ring_capacity : 65536;
+  t->ring.resize(t->capacity);
+  return t;
+}
+
+void xt_free(void* h) { delete static_cast<Tracer*>(h); }
+
+// returns a stable id for a span name (register once, use in hot loop)
+int32_t xt_register(void* h, const char* name) {
+  Tracer* t = static_cast<Tracer*>(h);
+  if (!t || !name) return -1;
+  std::string clean = sanitize(name);
+  std::lock_guard<std::mutex> g(t->mu);
+  auto it = t->name_ids.find(clean);
+  if (it != t->name_ids.end()) return static_cast<int32_t>(it->second);
+  uint32_t id = static_cast<uint32_t>(t->names.size());
+  t->names.emplace_back(clean);
+  t->name_ids.emplace(clean, id);
+  return static_cast<int32_t>(id);
+}
+
+uint64_t xt_now_ns() { return now_ns(); }
+
+// record a completed span (begin timestamp from xt_now_ns)
+void xt_record(void* h, int32_t name_id, uint64_t start_ns,
+               uint64_t end_ns) {
+  Tracer* t = static_cast<Tracer*>(h);
+  if (!t || name_id < 0 || end_ns < start_ns) return;
+  uint64_t dur = end_ns - start_ns;
+  std::lock_guard<std::mutex> g(t->mu);
+  if (static_cast<size_t>(name_id) >= t->names.size()) return;
+  Span& s = t->ring[t->head];
+  s.name_id = static_cast<uint32_t>(name_id);
+  s.start_ns = start_ns;
+  s.dur_ns = dur;
+  t->head = (t->head + 1) % t->capacity;
+  if (t->head == 0) t->wrapped = true;
+  t->aggregates[static_cast<uint32_t>(name_id)].add(dur);
+}
+
+int64_t xt_span_count(void* h, int32_t name_id) {
+  Tracer* t = static_cast<Tracer*>(h);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> g(t->mu);
+  auto it = t->aggregates.find(static_cast<uint32_t>(name_id));
+  return it == t->aggregates.end()
+             ? 0
+             : static_cast<int64_t>(it->second.count);
+}
+
+// stats[6] = count, total_ns, min_ns, max_ns, p50_ns, p99_ns
+int xt_stats(void* h, int32_t name_id, uint64_t* stats) {
+  Tracer* t = static_cast<Tracer*>(h);
+  if (!t || !stats) return -1;
+  std::lock_guard<std::mutex> g(t->mu);
+  auto it = t->aggregates.find(static_cast<uint32_t>(name_id));
+  if (it == t->aggregates.end()) {
+    std::memset(stats, 0, sizeof(uint64_t) * 6);
+    return 0;
+  }
+  const Aggregate& a = it->second;
+  stats[0] = a.count;
+  stats[1] = a.total_ns;
+  stats[2] = a.min_ns == UINT64_MAX ? 0 : a.min_ns;
+  stats[3] = a.max_ns;
+  stats[4] = a.percentile(0.50);
+  stats[5] = a.percentile(0.99);
+  return 0;
+}
+
+namespace {
+// write into caller buffer; returns bytes needed (call twice to size)
+int64_t emit(std::string& out, char* buf, int64_t cap) {
+  int64_t need = static_cast<int64_t>(out.size());
+  if (buf && cap >= need) std::memcpy(buf, out.data(), need);
+  return need;
+}
+}  // namespace
+
+// Chrome trace-event JSON (load in chrome://tracing / perfetto), like
+// the reference's timeline dump
+int64_t xt_export_chrome(void* h, char* buf, int64_t cap) {
+  Tracer* t = static_cast<Tracer*>(h);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> g(t->mu);
+  std::string out = "{\"traceEvents\":[";
+  size_t n = t->wrapped ? t->capacity : t->head;
+  size_t start = t->wrapped ? t->head : 0;
+  bool first = true;
+  char line[256];
+  for (size_t i = 0; i < n; ++i) {
+    const Span& s = t->ring[(start + i) % t->capacity];
+    std::snprintf(
+        line, sizeof(line),
+        "%s{\"name\":\"%s\",\"ph\":\"X\",\"ts\":%.3f,\"dur\":%.3f,"
+        "\"pid\":0,\"tid\":0}",
+        first ? "" : ",", t->names[s.name_id].c_str(),
+        s.start_ns / 1e3, s.dur_ns / 1e3);
+    out += line;
+    first = false;
+  }
+  out += "]}";
+  return emit(out, buf, cap);
+}
+
+// Prometheus text format, matching the reference's bvar/brpc exporter
+int64_t xt_export_prometheus(void* h, char* buf, int64_t cap) {
+  Tracer* t = static_cast<Tracer*>(h);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> g(t->mu);
+  std::string out;
+  char line[512];
+  for (auto& kv : t->aggregates) {
+    const char* name = t->names[kv.first].c_str();
+    const Aggregate& a = kv.second;
+    std::snprintf(
+        line, sizeof(line),
+        "xputimer_span_count{name=\"%s\"} %llu\n"
+        "xputimer_span_seconds_total{name=\"%s\"} %.9f\n"
+        "xputimer_span_seconds{name=\"%s\",quantile=\"0.5\"} %.9f\n"
+        "xputimer_span_seconds{name=\"%s\",quantile=\"0.99\"} %.9f\n",
+        name, static_cast<unsigned long long>(a.count),
+        name, a.total_ns / 1e9,
+        name, a.percentile(0.5) / 1e9,
+        name, a.percentile(0.99) / 1e9);
+    out += line;
+  }
+  return emit(out, buf, cap);
+}
+
+}  // extern "C"

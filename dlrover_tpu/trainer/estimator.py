@@ -1,0 +1,183 @@
+"""Estimator-style executor: spec-driven train/eval over elastic shards.
+
+Parity target: reference dlrover/trainer/tensorflow/ — the TF estimator
+path: ``BaseExecutor``/``EstimatorExecutor``
+(executor/estimator_executor.py:52) builds an estimator whose input_fn
+reads master-dispatched data shards through an elastic reader
+(reader/file_reader.py:18), with session hooks reporting shard/batch
+progress (hooks/elastic_data_shard_report_hook.py:19,
+global_step_hook.py:25) and failover handled by the master.
+
+TPU-native shape: the "estimator" contract (model_fn + input_fn +
+Train/EvalSpec + hooks) is preserved as the user API, but the engine
+underneath is a jitted JAX step — model_fn returns loss from (params,
+features, labels), input_fn yields numpy batches, and the hooks are
+plain callables fired from the host loop.  Elastic data comes from the
+same ShardingClient the torch path uses; a worker crash replays
+unacknowledged shards to the survivors (master TaskManager recovery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class TrainSpec:
+    input_fn: Callable[[], Iterator[Any]]
+    max_steps: int = 0  # 0 = until the input stream ends
+
+
+@dataclasses.dataclass
+class EvalSpec:
+    input_fn: Callable[[], Iterator[Any]]
+    steps: int = 0          # 0 = drain the iterator
+    every_n_steps: int = 100
+
+
+class SessionHook:
+    """Host-loop hook points (reference session hooks)."""
+
+    def after_step(self, step: int, metrics: Dict[str, float]) -> None: ...
+    def after_eval(self, step: int, metrics: Dict[str, float]) -> None: ...
+    def end(self, step: int) -> None: ...
+
+
+class ElasticDataShardReportHook(SessionHook):
+    """Report batch completion to the master so shard recovery works
+    (reference elastic_data_shard_report_hook.py:19)."""
+
+    def __init__(self, sharding_client):
+        self._client = sharding_client
+
+    def after_step(self, step: int, metrics: Dict[str, float]) -> None:
+        try:
+            self._client.report_batch_done()
+        except Exception as e:  # keep training when the master blips
+            logger.warning("batch-done report failed: %s", e)
+
+
+class GlobalStepHook(SessionHook):
+    """Mirror the global step into the runtime-metrics file (reference
+    global_step_hook.py:25) so agent monitors see progress."""
+
+    def after_step(self, step: int, metrics: Dict[str, float]) -> None:
+        from dlrover_tpu.agent.monitor.training import write_runtime_metrics
+
+        write_runtime_metrics(step)
+
+
+class ElasticShardReader:
+    """Iterate (start, end) record ranges from master shards (reference
+    reader/file_reader.py): the read_fn maps an index range to samples."""
+
+    def __init__(self, sharding_client, read_fn: Callable[[int, int], Any]):
+        self._client = sharding_client
+        self._read_fn = read_fn
+
+    def __iter__(self):
+        while True:
+            shard = self._client.fetch_shard()
+            if shard is None:
+                return
+            yield self._read_fn(shard.start, shard.end)
+            self._client.report_shard_done()
+
+
+class EstimatorExecutor:
+    """``model_fn(params, features, labels) -> (loss, metrics)`` trained
+    under jit with an optax optimizer; specs drive the loop."""
+
+    def __init__(
+        self,
+        model_fn: Callable[..., Any],
+        init_params_fn: Callable[[jax.Array], Any],
+        train_spec: TrainSpec,
+        eval_spec: Optional[EvalSpec] = None,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        hooks: Optional[List[SessionHook]] = None,
+        seed: int = 0,
+    ):
+        self._model_fn = model_fn
+        self._train_spec = train_spec
+        self._eval_spec = eval_spec
+        self._optimizer = optimizer or optax.adam(1e-3)
+        self._hooks = hooks or []
+        self.params = init_params_fn(jax.random.PRNGKey(seed))
+        self.opt_state = self._optimizer.init(self.params)
+        self.global_step = 0
+
+        def train_step(params, opt_state, features, labels):
+            def loss_fn(p):
+                loss, metrics = self._model_fn(p, features, labels)
+                return loss, metrics
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            return params, opt_state, metrics
+
+        self._jit_train = jax.jit(train_step)
+        self._jit_eval = jax.jit(
+            lambda params, f, l: self._model_fn(params, f, l))
+
+    # -- loops -----------------------------------------------------------
+    def _fire(self, hook_name: str, *args) -> None:
+        for h in self._hooks:
+            try:
+                getattr(h, hook_name)(*args)
+            except Exception:
+                logger.exception("hook %s failed", hook_name)
+
+    def train_and_evaluate(self) -> Dict[str, float]:
+        """The reference's tf.estimator.train_and_evaluate shape."""
+        metrics: Dict[str, Any] = {}
+        for batch in self._train_spec.input_fn():
+            features, labels = batch
+            self.params, self.opt_state, metrics = self._jit_train(
+                self.params, self.opt_state,
+                jnp.asarray(features), jnp.asarray(labels))
+            self.global_step += 1
+            if self._hooks:
+                # only hooks need host floats; without them, skip the
+                # device sync so async dispatch pipelines the steps
+                host = {k: float(jax.device_get(v))
+                        for k, v in metrics.items()}
+                self._fire("after_step", self.global_step, host)
+            if (self._eval_spec is not None
+                    and self._eval_spec.every_n_steps > 0
+                    and self.global_step
+                    % self._eval_spec.every_n_steps == 0):
+                self.evaluate()
+            if (self._train_spec.max_steps
+                    and self.global_step >= self._train_spec.max_steps):
+                break
+        self._fire("end", self.global_step)
+        return {k: float(jax.device_get(v)) for k, v in metrics.items()}
+
+    def evaluate(self) -> Dict[str, float]:
+        assert self._eval_spec is not None
+        losses = []
+        for i, batch in enumerate(self._eval_spec.input_fn()):
+            features, labels = batch
+            loss, _ = self._jit_eval(
+                self.params, jnp.asarray(features), jnp.asarray(labels))
+            losses.append(float(jax.device_get(loss)))
+            if self._eval_spec.steps and i + 1 >= self._eval_spec.steps:
+                break
+        metrics = {"eval_loss": float(np.mean(losses))} if losses else {}
+        self._fire("after_eval", self.global_step, metrics)
+        logger.info("estimator eval: %s", metrics)
+        return metrics

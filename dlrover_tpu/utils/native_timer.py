@@ -1,0 +1,155 @@
+"""ctypes wrapper over the native trace library (xpu_timer counterpart).
+
+Reference: atorch/dev/xpu_timer — native span timing with Prometheus +
+timeline export.  Spans cost two clock reads and one GIL-free C call;
+use :class:`NativeTracer` for the runtime's hot sections (step loop,
+checkpoint shm writes, RPC handling) and hand the Prometheus text to
+:class:`dlrover_tpu.utils.profiler.MetricsExporter` via
+``add_text_source``.  Tracers are independent handles — constructing a
+second one never clobbers the first.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "native", "xputimer",
+                    "trace_lib.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "..", "native",
+                          "_build")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.abspath(_SRC)
+        so = os.path.join(os.path.abspath(_BUILD_DIR), "libxputimer.so")
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", "-o", so, src]
+            logger.info("building xputimer: %s", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        lib = ctypes.CDLL(so)
+        c = ctypes
+        lib.xt_create.restype = c.c_void_p
+        lib.xt_create.argtypes = [c.c_uint64]
+        lib.xt_free.argtypes = [c.c_void_p]
+        lib.xt_register.restype = c.c_int32
+        lib.xt_register.argtypes = [c.c_void_p, c.c_char_p]
+        lib.xt_now_ns.restype = c.c_uint64
+        lib.xt_record.argtypes = [c.c_void_p, c.c_int32, c.c_uint64,
+                                  c.c_uint64]
+        lib.xt_span_count.restype = c.c_int64
+        lib.xt_span_count.argtypes = [c.c_void_p, c.c_int32]
+        lib.xt_stats.restype = c.c_int
+        lib.xt_stats.argtypes = [c.c_void_p, c.c_int32,
+                                 c.POINTER(c.c_uint64)]
+        lib.xt_export_chrome.restype = c.c_int64
+        lib.xt_export_chrome.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.xt_export_prometheus.restype = c.c_int64
+        lib.xt_export_prometheus.argtypes = [c.c_void_p, c.c_char_p,
+                                             c.c_int64]
+        _lib = lib
+        return lib
+
+
+class NativeTracer:
+    """Span recorder over a native ring buffer (one handle per tracer)."""
+
+    def __init__(self, ring_capacity: int = 65536):
+        self._lib = load_library()
+        self._handle = self._lib.xt_create(ring_capacity)
+        self._ids: Dict[str, int] = {}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.xt_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    def _id(self, name: str) -> int:
+        nid = self._ids.get(name)
+        if nid is None:
+            nid = int(self._lib.xt_register(self._handle, name.encode()))
+            self._ids[name] = nid
+        return nid
+
+    @contextmanager
+    def span(self, name: str):
+        nid = self._id(name)
+        start = self._lib.xt_now_ns()
+        try:
+            yield
+        finally:
+            self._lib.xt_record(self._handle, nid, start,
+                                self._lib.xt_now_ns())
+
+    def record(self, name: str, start_ns: int, end_ns: int) -> None:
+        self._lib.xt_record(self._handle, self._id(name), start_ns, end_ns)
+
+    def now_ns(self) -> int:
+        return int(self._lib.xt_now_ns())
+
+    def stats(self, name: str) -> Dict[str, float]:
+        buf = (ctypes.c_uint64 * 6)()
+        self._lib.xt_stats(self._handle, self._id(name), buf)
+        count, total, mn, mx, p50, p99 = (int(x) for x in buf)
+        return {
+            "count": count,
+            "total_s": total / 1e9,
+            "min_s": mn / 1e9,
+            "max_s": mx / 1e9,
+            "p50_s": p50 / 1e9,
+            "p99_s": p99 / 1e9,
+        }
+
+    def _export(self, fn) -> str:
+        # concurrent recording can grow the output between the sizing
+        # call and the fill call, so allocate slack and retry until the
+        # fill's own byte count fits the buffer we passed
+        cap = int(fn(self._handle, None, 0))
+        for _ in range(4):
+            if cap <= 0:
+                return ""
+            cap += 65536
+            buf = ctypes.create_string_buffer(cap)
+            got = int(fn(self._handle, buf, cap))
+            if 0 <= got <= cap:
+                return buf.raw[:got].decode()
+            cap = got
+        return ""
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        """Chrome trace-event JSON (chrome://tracing / perfetto)."""
+        text = self._export(self._lib.xt_export_chrome)
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def export_prometheus(self) -> str:
+        return self._export(self._lib.xt_export_prometheus)
+
+
+def check_toolchain() -> Optional[str]:
+    try:
+        load_library()
+        return None
+    except (RuntimeError, OSError, subprocess.CalledProcessError) as e:
+        return str(e)

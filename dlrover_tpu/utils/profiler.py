@@ -131,6 +131,7 @@ class MetricsExporter:
     def __init__(self, port: int = 0, labels: Optional[Dict[str, str]] = None):
         self._labels = labels or {}
         self._sources = []  # callables returning Dict[str, float]
+        self._text_sources = []  # callables returning Prometheus text
         exporter = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -145,7 +146,13 @@ class MetricsExporter:
                             merged.update(src())
                         except Exception:
                             pass
-                    body = render_prometheus(merged, exporter._labels).encode()
+                    body = render_prometheus(merged, exporter._labels)
+                    for src in exporter._text_sources:
+                        try:
+                            body += src()
+                        except Exception:
+                            pass
+                    body = body.encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
                     self.send_response(404)
@@ -167,6 +174,11 @@ class MetricsExporter:
     def add_source(self, fn) -> None:
         """``fn() -> Dict[str, float]`` merged into /metrics at scrape time."""
         self._sources.append(fn)
+
+    def add_text_source(self, fn) -> None:
+        """``fn() -> str`` of ready-made Prometheus text appended at
+        scrape time (e.g. NativeTracer.export_prometheus)."""
+        self._text_sources.append(fn)
 
     def start(self) -> None:
         if self._thread is not None:
