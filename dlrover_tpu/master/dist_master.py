@@ -61,12 +61,19 @@ class DistributedJobMaster:
             ),
             RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
         }
+        from dlrover_tpu.master.monitor.error_monitor import JobErrorMonitor
+        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
+        self.job_metric_collector = JobMetricCollector()
         self.job_manager = JobManager(
             scaler=scaler,
             watcher=watcher,
             worker_num=node_num,
             worker_resource=worker_resource,
             heartbeat_timeout=heartbeat_timeout,
+            error_monitor=JobErrorMonitor(
+                on_event=self.job_metric_collector.report_event
+            ),
         )
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)
@@ -77,9 +84,6 @@ class DistributedJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.elastic_ps_service = ElasticPsService()
-        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
-
-        self.job_metric_collector = JobMetricCollector()
         from dlrover_tpu.master.diagnosis.diagnosis import DiagnosisManager
 
         self.diagnosis_manager = DiagnosisManager(

@@ -1,0 +1,71 @@
+"""ErrorMonitor: classify reported node errors and drive the response.
+
+Parity target: reference dlrover/python/master/monitor/error_monitor.py
+(``SimpleErrorMonitor``/``K8sJobErrorMonitor`` — pattern-match error
+data from failed nodes, decide relaunchability, emit cluster events).
+
+TPU-native additions: chip/ICI failure markers count as HARDWARE_ERROR
+(relaunchable — the scheduler moves the host), and classifications feed
+the JobMetricCollector event stream instead of k8s Events (the k8s path
+emits through the operator).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeExitReason
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+
+# marker -> exit reason, first match wins (reference error patterns)
+_PATTERNS = [
+    (("out of memory", "oom-kill", "oomkilled", "resource_exhausted"),
+     NodeExitReason.OOM),
+    (("tpu chip", "ici link", "data_loss: ", "hbm parity",
+      "device unavailable"),
+     NodeExitReason.HARDWARE_ERROR),
+    (("preempted", "spot reclaim"), NodeExitReason.PREEMPTED),
+    (("segmentation fault", "core dumped", "fatal python error"),
+     NodeExitReason.FATAL_ERROR),
+]
+
+
+def classify_error(error_data: str) -> str:
+    text = (error_data or "").lower()
+    for markers, reason in _PATTERNS:
+        if any(m in text for m in markers):
+            return reason
+    return NodeExitReason.UNKNOWN_ERROR
+
+
+class JobErrorMonitor:
+    """Stateless classifier + event emitter used by the JobManager."""
+
+    def __init__(self, on_event: Optional[Callable[[str, str, str], None]]
+                 = None):
+        # on_event(event_type, instance, message) — typically
+        # JobMetricCollector.report_event
+        self._on_event = on_event
+
+    def process_error(
+        self, node: Optional[Node], restart_count: int, error_data: str,
+        level: str = "error",
+    ) -> Tuple[str, bool]:
+        """Returns (exit_reason, relaunchable)."""
+        reason = classify_error(error_data)
+        relaunchable = NodeExitReason.relaunchable(reason)
+        name = node.name if node is not None else "?"
+        logger.info(
+            "node %s error classified %s (relaunchable=%s, restarts=%s)",
+            name, reason, relaunchable, restart_count,
+        )
+        if node is not None:
+            node.exit_reason = reason
+        if self._on_event is not None:
+            try:
+                self._on_event(f"node_{reason.lower()}", name,
+                               (error_data or "")[:500])
+            except Exception:
+                logger.exception("error event emit failed")
+        return reason, relaunchable

@@ -44,9 +44,11 @@ class JobManager:
         worker_resource: Optional[NodeResource] = None,
         heartbeat_timeout: float = JobConstant.NODE_HEARTBEAT_TIMEOUT,
         max_relaunch_count: int = JobConstant.MAX_NODE_RELAUNCH_COUNT,
+        error_monitor=None,
     ):
         self._scaler = scaler
         self._watcher = watcher
+        self._error_monitor = error_monitor
         self._worker_num = worker_num
         self._worker_resource = worker_resource or NodeResource()
         self._heartbeat_timeout = heartbeat_timeout
@@ -296,6 +298,12 @@ class JobManager:
         if node is None:
             return
         node.update_info(relaunch_count=restart_count)
+        if self._error_monitor is not None:
+            reason, relaunchable = self._error_monitor.process_error(
+                node, restart_count, error_data, level
+            )
+            if not relaunchable:
+                node.relaunchable = False
         logger.info(
             "Training failure on %s (restart %s, level %s): %s",
             node.name, restart_count, level, error_data[:200],

@@ -609,6 +609,94 @@ int64_t kv_apply_adabelief(void* h, const int64_t* ids, const float* grads,
                     });
 }
 
+// slots: [m, v, vhat] — AMSGrad (Reddi et al. 2018): max-v denominator
+int64_t kv_apply_amsgrad(void* h, const int64_t* ids, const float* grads,
+                         int64_t n, float lr, float beta1, float beta2,
+                         float eps, int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  float corr = static_cast<float>(std::sqrt(bc2) / bc1);
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* m = slots;
+                      float* v = slots + dim;
+                      float* vhat = slots + 2 * dim;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        float gd = g[d] + weight_decay * w[d];
+                        m[d] = beta1 * m[d] + (1 - beta1) * gd;
+                        v[d] = beta2 * v[d] + (1 - beta2) * gd * gd;
+                        vhat[d] = std::max(vhat[d], v[d]);
+                        w[d] -= lr * corr * m[d] /
+                                (std::sqrt(vhat[d]) + eps);
+                      }
+                    });
+}
+
+// slots: [acc, acc_update] — Adadelta (Zeiler 2012)
+int64_t kv_apply_adadelta(void* h, const int64_t* ids, const float* grads,
+                          int64_t n, float lr, float rho, float eps) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* acc = slots;
+                      float* acc_up = slots + dim;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        acc[d] = rho * acc[d] + (1 - rho) * g[d] * g[d];
+                        float update = g[d] *
+                            std::sqrt(acc_up[d] + eps) /
+                            std::sqrt(acc[d] + eps);
+                        acc_up[d] = rho * acc_up[d] +
+                                    (1 - rho) * update * update;
+                        w[d] -= lr * update;
+                      }
+                    });
+}
+
+// slots: [m, v] — LAMB (You et al. 2020): adam direction, per-ROW trust
+// ratio (the embedding row is the natural "layer" for sparse tables)
+int64_t kv_apply_lamb(void* h, const int64_t* ids, const float* grads,
+                      int64_t n, float lr, float beta1, float beta2,
+                      float eps, int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* m = slots;
+                      float* v = slots + dim;
+                      float w_norm = 0, u_norm = 0;
+                      // pass 1: update moments, accumulate norms.  u is
+                      // recomputed in pass 2 from the (now-final) m/v/w
+                      // instead of buffered — no per-row allocation
+                      // while the stripe mutex is held
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        m[d] = beta1 * m[d] + (1 - beta1) * g[d];
+                        v[d] = beta2 * v[d] + (1 - beta2) * g[d] * g[d];
+                        float mhat = m[d] / static_cast<float>(bc1);
+                        float vhat = v[d] / static_cast<float>(bc2);
+                        float u = mhat / (std::sqrt(vhat) + eps) +
+                                  weight_decay * w[d];
+                        w_norm += w[d] * w[d];
+                        u_norm += u * u;
+                      }
+                      w_norm = std::sqrt(w_norm);
+                      u_norm = std::sqrt(u_norm);
+                      float trust = (w_norm > 0 && u_norm > 0)
+                                        ? w_norm / u_norm : 1.0f;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        float mhat = m[d] / static_cast<float>(bc1);
+                        float vhat = v[d] / static_cast<float>(bc2);
+                        float u = mhat / (std::sqrt(vhat) + eps) +
+                                  weight_decay * w[d];
+                        w[d] -= lr * trust * u;
+                      }
+                    });
+}
+
 // slots: [m, v] — Group AdamW ("rectified" group-lasso variant, the
 // sparse-group regularizer of reference training_ops.cc GroupAdam /
 // arXiv:2107.14432): adam step then row-level soft threshold, which

@@ -33,6 +33,9 @@ OPTIMIZER_SLOTS = {
     "ftrl": 2,
     "adabelief": 2,
     "group_adam": 2,
+    "adadelta": 2,
+    "lamb": 2,
+    "amsgrad": 3,
 }
 
 
@@ -56,6 +59,7 @@ class KvOptimizerConfig:
     ftrl_l2: float = 0.0
     ftrl_lr_power: float = 0.5
     group_l21: float = 0.0
+    adadelta_rho: float = 0.95
 
 
 class KvVariable:
@@ -202,6 +206,17 @@ class KvVariable:
             return int(lib.kv_apply_group_adam(h, idp, gp, n, o.learning_rate,
                                                o.beta1, o.beta2, o.eps,
                                                self._step, o.group_l21))
+        if o.name == "amsgrad":
+            return int(lib.kv_apply_amsgrad(h, idp, gp, n, o.learning_rate,
+                                            o.beta1, o.beta2, o.eps,
+                                            self._step, o.weight_decay))
+        if o.name == "adadelta":
+            return int(lib.kv_apply_adadelta(h, idp, gp, n, o.learning_rate,
+                                             o.adadelta_rho, o.eps))
+        if o.name == "lamb":
+            return int(lib.kv_apply_lamb(h, idp, gp, n, o.learning_rate,
+                                         o.beta1, o.beta2, o.eps, self._step,
+                                         o.weight_decay))
         raise AssertionError(o.name)
 
     # -- eviction / hybrid storage ---------------------------------------

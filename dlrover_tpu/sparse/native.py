@@ -108,6 +108,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kv_apply_group_adam.argtypes = [
         c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
         c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_amsgrad.restype = c.c_int64
+    lib.kv_apply_amsgrad.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_adadelta.restype = c.c_int64
+    lib.kv_apply_adadelta.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float]
+    lib.kv_apply_lamb.restype = c.c_int64
+    lib.kv_apply_lamb.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
     lib.kv_evict.restype = c.c_int64
     lib.kv_evict.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
     lib.kv_secondary_open.restype = c.c_int

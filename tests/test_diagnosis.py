@@ -135,6 +135,35 @@ def test_diagnosis_reporter_e2e(local_master, master_client, tmp_path):
     assert out and out[0].name == InferenceName.NODE_FAILURE
 
 
+# -- error monitor ----------------------------------------------------------
+
+def test_error_monitor_classification_and_events():
+    from dlrover_tpu.common.constants import NodeExitReason
+    from dlrover_tpu.common.node import Node
+    from dlrover_tpu.master.monitor.error_monitor import (
+        JobErrorMonitor,
+        classify_error,
+    )
+
+    assert classify_error("RESOURCE_EXHAUSTED: OOM") == NodeExitReason.OOM
+    assert classify_error("ICI link down on host") == \
+        NodeExitReason.HARDWARE_ERROR
+    assert classify_error("spot reclaim notice") == NodeExitReason.PREEMPTED
+    assert classify_error("Segmentation fault") == NodeExitReason.FATAL_ERROR
+    assert classify_error("???") == NodeExitReason.UNKNOWN_ERROR
+
+    events = []
+    mon = JobErrorMonitor(on_event=lambda *a: events.append(a))
+    node = Node("worker", 2)
+    reason, relaunchable = mon.process_error(node, 1, "worker OOMKilled")
+    assert reason == NodeExitReason.OOM and relaunchable
+    assert node.exit_reason == NodeExitReason.OOM
+    assert events[0][0] == "node_oomkilled"
+    # fatal errors are not relaunchable
+    _, relaunchable = mon.process_error(node, 1, "core dumped")
+    assert not relaunchable
+
+
 # -- paral config tuner -----------------------------------------------------
 
 def test_write_read_paral_config(tmp_path):

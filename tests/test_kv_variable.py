@@ -133,7 +133,8 @@ def test_adam_matches_numpy():
 def test_momentum_ftrl_adabelief_group_adam_update():
     """Each optimizer changes rows, keeps slots, and trains a simple
     quadratic toward its minimum."""
-    for name in ("momentum", "ftrl", "adabelief", "group_adam"):
+    for name in ("momentum", "ftrl", "adabelief", "group_adam",
+                 "amsgrad", "lamb"):
         v = KvVariable(dim=4, optimizer=name, init_scale=0.5, seed=11)
         ids = np.array([1], dtype=np.int64)
         v.lookup(ids)
@@ -143,6 +144,55 @@ def test_momentum_ftrl_adabelief_group_adam_update():
             v.apply_gradients(ids, 2.0 * w)
         w, _ = v.lookup(ids, train=False)
         assert np.abs(w).max() < 0.1, f"{name} failed to shrink: {w}"
+
+
+def test_adadelta_matches_numpy():
+    dim = 4
+    v = KvVariable(dim=dim, optimizer="adadelta", init_scale=0.2, seed=8,
+                   opt_config=KvOptimizerConfig(learning_rate=1.0))
+    ids = np.array([2], dtype=np.int64)
+    w_ref, _ = v.lookup(ids)
+    w_ref = w_ref.astype(np.float64)
+    acc = np.zeros_like(w_ref)
+    acc_up = np.zeros_like(w_ref)
+    o = v.opt
+    rng = np.random.RandomState(4)
+    for _ in range(5):
+        g = rng.randn(1, dim).astype(np.float32)
+        v.apply_gradients(ids, g)
+        acc = o.adadelta_rho * acc + (1 - o.adadelta_rho) * g * g
+        update = g * np.sqrt(acc_up + o.eps) / np.sqrt(acc + o.eps)
+        acc_up = o.adadelta_rho * acc_up + (1 - o.adadelta_rho) * update**2
+        w_ref -= o.learning_rate * update
+    out, _ = v.lookup(ids, train=False)
+    np.testing.assert_allclose(out, w_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_amsgrad_vhat_monotone():
+    """AMSGrad's max-accumulator must never decrease the denominator: a
+    large-gradient step followed by tiny gradients keeps updates damped
+    (unlike plain adam, whose v decays)."""
+    ids = np.array([1], dtype=np.int64)
+    # fast beta2 so adam's v visibly decays within the test horizon
+    cfg = KvOptimizerConfig(learning_rate=0.1, beta2=0.5)
+    ams = KvVariable(dim=2, optimizer="amsgrad", init_scale=0.0,
+                     opt_config=cfg)
+    adam = KvVariable(dim=2, optimizer="adam", init_scale=0.0,
+                      opt_config=KvOptimizerConfig(learning_rate=0.1,
+                                                   beta2=0.5))
+    ams.lookup(ids)
+    adam.lookup(ids)
+    big = np.full((1, 2), 100.0, np.float32)
+    tiny = np.full((1, 2), 1e-3, np.float32)
+    ams.apply_gradients(ids, big)
+    adam.apply_gradients(ids, big)
+    for _ in range(50):
+        ams.apply_gradients(ids, tiny)
+        adam.apply_gradients(ids, tiny)
+    a, _ = ams.lookup(ids, train=False)
+    b, _ = adam.lookup(ids, train=False)
+    # adam's decayed v lets tiny grads move weights much further
+    assert np.abs(b).max() > np.abs(a).max() * 2
 
 
 def test_group_adam_l21_zeroes_rows():
