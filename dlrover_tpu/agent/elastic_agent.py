@@ -48,6 +48,12 @@ class WorkerSpec:
     max_restarts: int = 3
     monitor_interval: float = 5.0
     network_check: bool = False
+    # measure ICI/DCN collective bandwidth during the check rounds
+    # (reference: dlrover-run --comm-perf-test)
+    comm_perf_test: bool = False
+    # poll the master's mutable ParallelConfig into the trainer's
+    # hot-reload file (reference: --auto_tunning + ParalConfigTuner)
+    auto_tunning: bool = False
     coordinator_port: int = 52300
     env: Optional[Dict[str, str]] = None
     # Host the flash-checkpoint saver factory so trainers can checkpoint
@@ -339,6 +345,14 @@ class ElasticAgent:
             self._training_monitor.start()
             self._resource_monitor = ResourceMonitor(self._client)
             self._resource_monitor.start()
+        self._paral_tuner = None
+        if self._spec.auto_tunning:
+            from dlrover_tpu.agent.config.paral_config_tuner import (
+                ParalConfigTuner,
+            )
+
+            self._paral_tuner = ParalConfigTuner(self._client)
+            self._paral_tuner.start()
         if self._spec.hang_timeout > 0:
             if self._training_monitor is None:
                 logger.warning(
@@ -422,6 +436,8 @@ class ElasticAgent:
                 self._training_monitor.stop()
             if self._resource_monitor is not None:
                 self._resource_monitor.stop()
+            if self._paral_tuner is not None:
+                self._paral_tuner.stop()
             self._group.stop()
             self._save_shm_checkpoint()
             if self._saver_factory is not None:
@@ -475,6 +491,8 @@ def run_network_check(
                 f"{coordinator_ip}:{check_port + rdzv.round % 8}"
             ),
         }
+        if spec.comm_perf_test:
+            env["DLROVER_COMM_PERF"] = "1"
         start = time.time()
         try:
             proc = subprocess.run(  # noqa: S603
@@ -485,6 +503,10 @@ def run_network_check(
             )
             ok = proc.returncode == 0
             stderr = proc.stderr
+            if ok and spec.comm_perf_test:
+                for line in proc.stdout.decode(errors="replace").splitlines():
+                    if line.startswith("comm perf:"):
+                        logger.info("node %s %s", node_rank, line)
         except subprocess.TimeoutExpired:
             # A hung runtime is exactly what the check exists to catch.
             ok, stderr = False, b"node check timed out"

@@ -53,6 +53,26 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "(reference: dlrover-run --network-check)",
     )
     p.add_argument(
+        "--comm-perf-test", action="store_true",
+        help="also measure ICI allreduce / DCN allgather bandwidth in "
+             "the check rounds (reference: dlrover-run --comm-perf-test)",
+    )
+    p.add_argument(
+        "--auto-tunning", action="store_true",
+        help="poll the master's mutable ParallelConfig into the trainer "
+             "hot-reload file (reference: dlrover-run --auto_tunning)",
+    )
+    p.add_argument(
+        "--hang-timeout", type=float, default=0.0,
+        help="restart workers when the global step stalls this many "
+             "seconds (0 disables)",
+    )
+    p.add_argument(
+        "--hang-grace-period", type=float, default=600.0,
+        help="suppress hang detection after (re)start for compile/"
+             "restore latency",
+    )
+    p.add_argument(
         "--node_unit", type=int, default=1,
         help="rendezvous admits node counts that are multiples of this "
              "(TPU: hosts per pod slice)",
@@ -131,12 +151,21 @@ def run(args: argparse.Namespace) -> int:
     else:
         entrypoint = [script, *script_args]
 
+    if args.comm_perf_test and not args.network_check:
+        logger.warning(
+            "--comm-perf-test only runs inside the check rounds; "
+            "pass --network-check too (no perf will be measured)"
+        )
     spec = WorkerSpec(
         entrypoint=entrypoint,
         nproc_per_node=args.nproc_per_node,
         max_restarts=args.max_restarts,
         monitor_interval=args.monitor_interval,
         network_check=args.network_check,
+        comm_perf_test=args.comm_perf_test,
+        auto_tunning=args.auto_tunning,
+        hang_timeout=args.hang_timeout,
+        hang_grace_period=args.hang_grace_period,
     )
     agent = ElasticAgent(client, args.node_rank, spec)
     try:
