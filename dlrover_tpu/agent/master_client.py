@@ -248,6 +248,22 @@ class MasterClient:
         reply = self._get(comm.KVStoreGetRequest(key=key))
         return reply.value
 
+    @retry_rpc()
+    def kv_store_get_ex(self, key: str):
+        """(value, found): a stored empty value vs an absent key."""
+        reply = self._get(comm.KVStoreGetRequest(key=key))
+        return reply.value, reply.found
+
+    @retry_rpc()
+    def kv_store_cas(self, key: str, expected: bytes, desired: bytes,
+                     expect_absent: bool = False):
+        """Server-side atomic compare-and-set; (value_after, swapped)."""
+        reply = self._get(comm.KVStoreCasRequest(
+            key=key, expected=expected, desired=desired,
+            expect_absent=expect_absent,
+        ))
+        return reply.value, reply.swapped
+
     def kv_store_add(self, key: str, amount: int) -> int:
         # A unique op_id makes retransmitted adds idempotent server-side,
         # so the retry decorator cannot double-count the atomic increment.

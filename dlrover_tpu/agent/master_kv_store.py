@@ -1,0 +1,77 @@
+"""MasterKVStore: a rendezvous-store abstraction over the master KV
+service.
+
+Parity target: reference dlrover/python/elastic_agent/torch/
+master_kv_store.py (``MasterKVStore(torch.distributed.Store)``) — the
+Store workers use for rendezvous barriers and small config exchange,
+backed by the job master so no extra etcd/TCPStore service exists.
+
+TPU-native: no torch Store interface to subclass; the same contract is a
+small dict-like object (get/set/add/wait/compare_set) that the JAX-side
+coordination helpers and user code share.  All blocking semantics
+(``wait`` with timeout, ``get`` with default) live master-side via the
+idempotent KV service RPCs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+
+
+class MasterKVStore:
+    def __init__(self, client: MasterClient, prefix: str = "store"):
+        self._client = client
+        self._prefix = prefix
+
+    def _key(self, key: str) -> str:
+        return f"{self._prefix}/{key}"
+
+    # -- Store contract ---------------------------------------------------
+    def set(self, key: str, value: bytes) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._client.kv_store_set(self._key(key), value)
+
+    def get(self, key: str, default: Optional[bytes] = None) -> bytes:
+        value, found = self._client.kv_store_get_ex(self._key(key))
+        if not found and default is not None:
+            return default
+        return value
+
+    def add(self, key: str, amount: int = 1) -> int:
+        """Atomic counter add; returns the new value (the rendezvous
+        arrival-count primitive)."""
+        return self._client.kv_store_add(self._key(key), amount)
+
+    def multi_get(self, keys: List[str]) -> List[bytes]:
+        return self._client.kv_store_multi_get(
+            [self._key(k) for k in keys])
+
+    def multi_set(self, keys: List[str], values: List[bytes]) -> None:
+        self._client.kv_store_multi_set(
+            [self._key(k) for k in keys],
+            [v.encode() if isinstance(v, str) else v for v in values])
+
+    def wait(self, keys: List[str], timeout: float = 300.0) -> bool:
+        """Block until every key exists (reference Store.wait)."""
+        return self._client.kv_store_wait(
+            [self._key(k) for k in keys], timeout=timeout)
+
+    def delete_key(self, key: str) -> None:
+        self._client.kv_store_delete(self._key(key))
+
+    def compare_set(self, key: str, expected: bytes,
+                    desired: bytes) -> bytes:
+        """Atomic CAS (server-side, under the store lock — concurrent
+        callers cannot both win): set when the current value matches
+        ``expected``; empty ``expected`` means set-if-ABSENT.  Returns
+        the value after the operation."""
+        if isinstance(desired, str):
+            desired = desired.encode()
+        value, _ = self._client.kv_store_cas(
+            self._key(key), expected, desired,
+            expect_absent=(expected == b""),
+        )
+        return value

@@ -1,0 +1,76 @@
+"""PS/embedding-worker failover client: cluster-version handshakes.
+
+Parity target: reference dlrover/trainer/tensorflow/failover/
+(``TensorflowFailover`` + ``FailoverClient``) and the elastic-PS
+cluster-version protocol: workers track a GLOBAL cluster version on the
+master (bumped whenever the PS set changes) against their LOCAL version,
+and on divergence re-resolve the PS endpoints and restore/rebalance.
+
+TPU-native use: the "PS set" is the group of sparse-embedding workers
+hosting KvVariable shards (dlrover_tpu.sparse) — on membership change
+each trainer detects the version bump, re-fetches the live worker set
+from the master, and the KvVariable layer reshards via
+export/``retain_shard``/import.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.elastic_training.elastic_ps import (
+    PSClusterVersionType,
+)
+
+
+class PsFailoverClient:
+    def __init__(self, client, node_type: str = "worker", node_id: int = 0):
+        self._client = client
+        self._node_type = node_type
+        self._node_id = node_id
+
+    # -- version bookkeeping ---------------------------------------------
+    def local_version(self) -> int:
+        return self._client.query_cluster_version(
+            PSClusterVersionType.LOCAL, self._node_type, self._node_id)
+
+    def global_version(self) -> int:
+        return self._client.query_cluster_version(
+            PSClusterVersionType.GLOBAL, self._node_type, self._node_id)
+
+    def set_local_version(self, version: int) -> None:
+        self._client.update_cluster_version(
+            PSClusterVersionType.LOCAL, version, self._node_type,
+            self._node_id)
+
+    # -- failover protocol -----------------------------------------------
+    def ps_cluster_changed(self) -> bool:
+        """True when the master's global version ran ahead of ours
+        (reference FailoverClient ver comparison)."""
+        return self.global_version() > self.local_version()
+
+    def resolve_ps_nodes(self) -> Tuple[List, bool]:
+        """(live ps/embedding nodes, ready) from the master."""
+        nodes, ready, failure = self._client.query_ps_nodes()
+        if failure:
+            logger.warning("master reports PS failure in progress")
+        return nodes, bool(ready) and not failure
+
+    def sync_to_cluster(
+        self, on_reshard: Optional[Callable[[List], None]] = None
+    ) -> bool:
+        """One failover round: if the cluster changed, wait for the new
+        set to be ready, invoke ``on_reshard(nodes)`` (e.g. KvVariable
+        retain_shard/import), then adopt the global version."""
+        if not self.ps_cluster_changed():
+            return False
+        target = self.global_version()
+        nodes, ready = self.resolve_ps_nodes()
+        if not ready:
+            return False
+        if on_reshard is not None:
+            on_reshard(nodes)
+        self.set_local_version(target)
+        logger.info("adopted PS cluster version %s (%s nodes)",
+                    target, len(nodes))
+        return True

@@ -254,6 +254,29 @@ class KVStoreDeleteRequest:
     key: str = ""
 
 
+@comm_message
+class KVStoreGetReply:
+    value: bytes = b""
+    found: bool = False  # distinguishes a stored empty value from absence
+
+
+@comm_message
+class KVStoreCasRequest:
+    """Server-side compare-and-set (atomic under the store lock)."""
+
+    key: str = ""
+    expected: bytes = b""
+    desired: bytes = b""
+    # empty `expected` means set-if-absent, NOT compare-to-empty-value
+    expect_absent: bool = False
+
+
+@comm_message
+class KVStoreCasReply:
+    value: bytes = b""  # value after the operation
+    swapped: bool = False
+
+
 # ------------------------------------------------------------ reporting
 
 

@@ -30,6 +30,28 @@ class KVStoreService:
         with self._lock:
             return self._store.get(key, b"")
 
+    def get_ex(self, key: str):
+        """(value, found) — a stored empty value is distinguishable from
+        an absent key."""
+        with self._lock:
+            return self._store.get(key, b""), key in self._store
+
+    def compare_set(self, key: str, expected: bytes, desired: bytes,
+                    expect_absent: bool = False):
+        """Atomic CAS under the store lock: set ``desired`` when the
+        current value equals ``expected`` (or, with ``expect_absent``,
+        when the key does not exist).  Returns (value_after, swapped)."""
+        with self._lock:
+            exists = key in self._store
+            current = self._store.get(key, b"")
+            matches = (not exists) if expect_absent \
+                else (exists and current == bytes(expected))
+            if matches:
+                self._store[key] = bytes(desired)
+                self._lock.notify_all()
+                return bytes(desired), True
+            return current, False
+
     def add(self, key: str, amount: int, op_id: str = "") -> int:
         """Atomic increment; exactly-once when the caller passes a unique
         ``op_id`` (retransmissions of an applied op return the first

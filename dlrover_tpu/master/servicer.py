@@ -106,9 +106,14 @@ class MasterServicer:
             nodes, reason = mgr.check_straggler() if mgr else ([], "")
             return comm.StragglerExistReply(straggler=nodes, reason=reason)
         if isinstance(message, comm.KVStoreGetRequest):
-            return comm.KeyValuePair(
-                key=message.key, value=self._kv_store.get(message.key)
+            value, found = self._kv_store.get_ex(message.key)
+            return comm.KVStoreGetReply(value=value, found=found)
+        if isinstance(message, comm.KVStoreCasRequest):
+            value, swapped = self._kv_store.compare_set(
+                message.key, message.expected, message.desired,
+                expect_absent=message.expect_absent,
             )
+            return comm.KVStoreCasReply(value=value, swapped=swapped)
         if isinstance(message, comm.KVStoreAddRequest):
             return comm.KVStoreAddReply(
                 value=self._kv_store.add(
@@ -224,6 +229,9 @@ class MasterServicer:
     def _query_ps_nodes(self):
         reply = comm.PsNodesReply()
         if self._job_manager is None:
+            # standalone/local master: no PS lifecycle to wait on — an
+            # empty-but-ready set lets the failover client proceed
+            reply.new_ps_ready = True
             return reply
         nodes, ready, failure = self._job_manager.query_ps_nodes()
         reply.nodes = nodes

@@ -1,0 +1,213 @@
+"""ShmDataLoader: coworker processes feed batches through shared memory.
+
+Parity target: reference atorch/atorch/data/{shm_dataloader.py,
+coworker_dataset.py, preloader.py} — data preprocessing runs in separate
+"coworker" processes and ships ready batches to the trainer through
+shared memory, so Python-side input work never blocks the training loop.
+
+TPU-native framing: one host process drives all local chips, so input
+pipeline stalls directly gap the device.  The producer process runs the
+user's (possibly slow) batch iterator and writes each array batch into a
+slot of a shared-memory ring; the consumer maps slots zero-copy, hands
+numpy views to the caller, and recycles the slot on the next iteration.
+Bulk data rides the framework's resource-tracker-proof SharedMemory
+(common/multi_process.py — the flash-checkpoint plumbing); per-batch
+flow control rides multiprocessing Queues (persistent pipes, true
+blocking waits — no polling latency and no artificial deadline on long
+consumer pauses).
+
+Batch contract: a dict of fixed-shape numpy arrays (the shapes of the
+first batch fix the slot layout — matching the static-shape jit step).
+"""
+
+from __future__ import annotations
+
+import pickle
+import uuid
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import multiprocessing as mp
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedMemory
+
+
+def _slot_layout(batch: Dict[str, np.ndarray]):
+    """(total_bytes, {key: (offset, dtype, shape)}) for one slot."""
+    offset = 0
+    layout = {}
+    for key in sorted(batch):
+        arr = np.ascontiguousarray(batch[key])
+        layout[key] = (offset, str(arr.dtype), arr.shape)
+        offset += arr.nbytes
+    return offset, layout
+
+
+def _producer_main(name: str, make_iter: bytes, num_slots: int,
+                   free_q, ready_q) -> None:
+    """Coworker body: iterate the user loader, fill free slots."""
+    shm: Optional[SharedMemory] = None
+    try:
+        iter_fn = pickle.loads(make_iter)
+        layout = None
+        slot_bytes = 0
+        for batch in iter_fn():
+            batch = {k: np.ascontiguousarray(v) for k, v in batch.items()}
+            if shm is None:
+                slot_bytes, layout = _slot_layout(batch)
+                shm = SharedMemory(
+                    name, create=True, size=max(1, slot_bytes) * num_slots
+                )
+                for i in range(num_slots):
+                    free_q.put(i)
+                ready_q.put(("layout", slot_bytes, layout))
+            slot = free_q.get()
+            if slot is None:  # consumer closed
+                break
+            base = slot * slot_bytes
+            for key, (off, dtype, shape) in layout.items():
+                arr = batch[key]
+                if str(arr.dtype) != dtype or arr.shape != tuple(shape):
+                    raise ValueError(
+                        f"batch field {key!r} changed shape/dtype: "
+                        f"{arr.dtype}{arr.shape} vs {dtype}{tuple(shape)}"
+                    )
+                dst = np.ndarray(
+                    shape, dtype=dtype, buffer=shm.buf,
+                    offset=base + off,
+                )
+                np.copyto(dst, arr)
+            ready_q.put(("batch", slot))
+        ready_q.put(("end",))
+    except Exception as e:  # surface the error to the consumer
+        logger.exception("shm dataloader producer failed")
+        try:
+            ready_q.put(("error", repr(e)))
+        except Exception:
+            pass
+    finally:
+        if shm is not None:
+            try:
+                shm.close()
+            except Exception:
+                pass
+
+
+class ShmDataLoader:
+    """``for batch in ShmDataLoader(make_iter): ...``
+
+    ``make_iter`` is a picklable zero-arg callable returning an iterator
+    of dict-of-ndarray batches; it executes in the coworker process.
+    ``num_slots`` ready batches are buffered ahead of the consumer.
+
+    The coworker uses the ``spawn`` start method (fork is unsafe under
+    JAX's threads), so script entry points that construct a loader MUST
+    be guarded with ``if __name__ == "__main__":`` — an unguarded script
+    would re-execute itself in the child and deadlock.
+    """
+
+    def __init__(self, make_iter: Callable[[], Iterator[Dict[str, Any]]],
+                 num_slots: int = 4, name: Optional[str] = None):
+        self._name = name or f"shmdl_{uuid.uuid4().hex[:8]}"
+        self._num_slots = num_slots
+        ctx = mp.get_context("spawn")
+        self._free_q = ctx.Queue()
+        self._ready_q = ctx.Queue()
+        self._proc = ctx.Process(
+            target=_producer_main,
+            args=(self._name, pickle.dumps(make_iter), num_slots,
+                  self._free_q, self._ready_q),
+            daemon=True,
+            name="shm-dataloader",
+        )
+        self._proc.start()
+        self._shm: Optional[SharedMemory] = None
+        self._shm_created = False
+        self._layout = None
+        self._slot_bytes = 0
+        self._pending_slot: Optional[int] = None
+        self._closed = False
+
+    # -- iteration -------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if self._closed:
+            raise StopIteration
+        self._recycle()
+        msg = self._ready_q.get()
+        if msg[0] == "layout":
+            _, self._slot_bytes, self._layout = msg
+            self._shm = SharedMemory(self._name, create=False)
+            self._shm_created = True
+            msg = self._ready_q.get()
+        if msg[0] == "end":
+            self.close()
+            raise StopIteration
+        if msg[0] == "error":
+            self.close()
+            raise RuntimeError(f"shm dataloader producer died: {msg[1]}")
+        slot = msg[1]
+        self._pending_slot = slot
+        base = slot * self._slot_bytes
+        out = {}
+        for key, (off, dtype, shape) in self._layout.items():
+            # zero-copy view into the slot; valid until the next
+            # __next__ recycles it (jnp.asarray/device_put copies anyway)
+            out[key] = np.ndarray(
+                shape, dtype=dtype, buffer=self._shm.buf,
+                offset=base + off,
+            )
+        return out
+
+    def _recycle(self) -> None:
+        if self._pending_slot is not None:
+            self._free_q.put(self._pending_slot)
+            self._pending_slot = None
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._free_q.put(None)  # producer stop signal
+        except Exception:
+            pass
+        if self._proc.is_alive():
+            self._proc.join(timeout=5)
+            if self._proc.is_alive():
+                self._proc.terminate()
+        # close and unlink INDEPENDENTLY: close() raises BufferError
+        # while the caller still holds zero-copy views, but the segment
+        # must be unlinked regardless or every epoch leaks /dev/shm
+        if self._shm is not None:
+            try:
+                self._shm.close()
+            except BufferError:
+                pass  # caller still holds views; unlink still proceeds
+            except Exception:
+                pass
+        try:
+            # unlink by name even if this process never attached (the
+            # producer may have created the segment before dying)
+            seg = self._shm or SharedMemory(self._name, create=False)
+            seg.unlink()
+            if seg is not self._shm:
+                seg.close()
+        except Exception:
+            pass
+        for q in (self._free_q, self._ready_q):
+            try:
+                q.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,118 @@
+"""Shared-memory coworker dataloader tests (reference parity:
+atorch/atorch/data/shm_dataloader.py + coworker preprocessing)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.trainer.data.shm_dataloader import ShmDataLoader
+
+
+def _ten_batches():
+    for i in range(10):
+        yield {
+            "x": np.full((4, 8), i, np.float32),
+            "y": np.arange(4, dtype=np.int64) + i,
+        }
+
+
+def _failing_batches():
+    yield {"x": np.zeros((2, 2), np.float32)}
+    raise RuntimeError("boom in coworker")
+
+
+def test_shm_dataloader_streams_batches():
+    loader = ShmDataLoader(_ten_batches, num_slots=3)
+    seen = []
+    try:
+        for batch in loader:
+            assert batch["x"].shape == (4, 8)
+            assert batch["x"].dtype == np.float32
+            seen.append(int(batch["x"][0, 0]))
+            np.testing.assert_array_equal(
+                batch["y"], np.arange(4) + seen[-1])
+        assert seen == list(range(10))
+    finally:
+        loader.close()
+
+
+def test_shm_dataloader_producer_error_surfaces():
+    loader = ShmDataLoader(_failing_batches, num_slots=2)
+    with pytest.raises(RuntimeError, match="producer died"):
+        for _ in range(5):
+            next(loader)
+    loader.close()
+
+
+def test_shm_dataloader_backpressure():
+    """Producer fills at most num_slots batches ahead; consumer draining
+    slowly still sees every batch exactly once."""
+    loader = ShmDataLoader(_ten_batches, num_slots=2)
+    try:
+        time.sleep(0.5)  # let the producer run ahead (bounded by slots)
+        seen = [int(b["x"][0, 0]) for b in loader]
+        assert seen == list(range(10))
+    finally:
+        loader.close()
+
+
+# -- master kv store + ps failover (same small-parity batch) ---------------
+
+def test_master_kv_store_contract(local_master, master_client):
+    from dlrover_tpu.agent.master_kv_store import MasterKVStore
+
+    store = MasterKVStore(master_client, prefix="rdzv")
+    store.set("a", b"1")
+    assert store.get("a") == b"1"
+    assert store.get("missing", default=b"d") == b"d"
+    assert store.add("counter", 2) == 2
+    assert store.add("counter", 3) == 5
+    store.multi_set(["x", "y"], [b"xv", "yv"])
+    assert store.multi_get(["x", "y"]) == [b"xv", b"yv"]
+    assert store.wait(["a", "x"], timeout=5)
+    assert store.compare_set("cas", b"", b"first") == b"first"
+    assert store.compare_set("cas", b"wrong", b"second") == b"first"
+    store.delete_key("a")
+    assert store.get("a", default=b"gone") == b"gone"
+
+
+def test_ps_failover_client_version_protocol(local_master, master_client):
+    from dlrover_tpu.agent.ps_failover import PsFailoverClient
+    from dlrover_tpu.master.elastic_training.elastic_ps import PSClusterVersionType
+
+    master, _ = local_master
+    fo = PsFailoverClient(master_client, node_type="worker", node_id=0)
+    assert not fo.ps_cluster_changed()
+    # master bumps the global cluster version (PS membership changed)
+    master.elastic_ps_service.inc_global_cluster_version()
+    assert fo.ps_cluster_changed()
+    resharded = []
+    assert fo.sync_to_cluster(on_reshard=resharded.append)
+    assert len(resharded) == 1
+    assert not fo.ps_cluster_changed()  # local caught up
+
+
+def test_master_kv_store_empty_value_vs_absent(local_master, master_client):
+    from dlrover_tpu.agent.master_kv_store import MasterKVStore
+
+    store = MasterKVStore(master_client, prefix="p")
+    store.set("empty", b"")
+    # a stored empty value is NOT the default-for-missing case
+    assert store.get("empty", default=b"d") == b""
+    assert store.get("truly_missing", default=b"d") == b"d"
+
+
+def test_master_kv_store_cas_is_atomic_server_side(
+    local_master, master_client
+):
+    """Set-if-absent through the server lock: the second writer must
+    observe the first's value, never overwrite it."""
+    from dlrover_tpu.agent.master_kv_store import MasterKVStore
+
+    store = MasterKVStore(master_client, prefix="c")
+    assert store.compare_set("leader", b"", b"w0") == b"w0"
+    assert store.compare_set("leader", b"", b"w1") == b"w0"  # lost race
+    # value-match CAS
+    assert store.compare_set("leader", b"w0", b"w2") == b"w2"
+    assert store.compare_set("leader", b"w0", b"w3") == b"w2"
