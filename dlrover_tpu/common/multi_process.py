@@ -311,6 +311,17 @@ class SharedDict(LocalSocketComm):
         self._call("clear")
 
 
+def _tracker_call(op: str, registered_name: str) -> None:
+    """register/unregister with the resource tracker, tolerating tracker
+    internals varying across CPython versions."""
+    try:
+        from multiprocessing import resource_tracker
+
+        getattr(resource_tracker, op)(registered_name, "shared_memory")
+    except Exception:  # pragma: no cover - tracker internals vary
+        pass
+
+
 def _unregister_from_tracker(registered_name: str) -> None:
     """Keep the resource tracker from unlinking shm when a proc dies.
 
@@ -320,12 +331,7 @@ def _unregister_from_tracker(registered_name: str) -> None:
     tracker unlinks the segment when the creating process dies, silently
     destroying the in-memory checkpoint a crash was supposed to preserve.
     """
-    try:
-        from multiprocessing import resource_tracker
-
-        resource_tracker.unregister(registered_name, "shared_memory")
-    except Exception:  # pragma: no cover - tracker internals vary
-        pass
+    _tracker_call("unregister", registered_name)
 
 
 class SharedMemory(shared_memory.SharedMemory):
@@ -345,10 +351,17 @@ class SharedMemory(shared_memory.SharedMemory):
         super().close()
 
     def unlink(self) -> None:
+        # 3.12's unlink() sends its own tracker unregister; since __init__
+        # already unregistered, re-register first so the pair balances —
+        # otherwise the tracker process logs a KeyError at exit
+        _tracker_call("register", self._name)
         try:
             super().unlink()
         except FileNotFoundError:
-            pass
+            # stdlib unlink raises BEFORE its unregister ran: roll back
+            # our registration or the tracker would shm_unlink a future
+            # same-named segment at process exit (checkpoint data loss)
+            _tracker_call("unregister", self._name)
 
 
 def clear_sockets() -> None:

@@ -1,0 +1,117 @@
+"""Sparse recommender training example: KvVariable embeddings + coworker
+data loading + eviction + checkpoint.
+
+Launch:
+
+    python examples/train_recsys.py --steps 200
+
+The sparse-path counterpart of examples/train_llama.py (reference
+counterpart: the TFPlus KvVariable + estimator recommender path):
+
+- dynamic-vocabulary user/item embeddings in the native C++ store
+  (``dlrover_tpu.sparse``) with frequency admission — ids must be seen
+  ``--min-frequency`` times before they earn an embedding row;
+- the hybrid host/device step: unique ids -> host gather -> bucket-
+  padded dense slab -> jitted forward/backward -> native sparse adagrad;
+- a coworker process producing batches through shared memory
+  (``ShmDataLoader``) so feature generation never blocks the step;
+- periodic eviction of stale ids and a full checkpoint (values +
+  optimizer slots + frequencies) through CheckpointStorage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def make_batches():
+    """Runs in the coworker: synthesize (user, item, label) batches with
+    a long-tail id distribution (hapax ids exercise admission).
+    Module-level (picklable) so the spawned coworker can import it."""
+    rng = np.random.RandomState(0)
+    for _ in range(10_000):
+        users = (rng.zipf(1.5, 256) % 50_000).astype(np.int64)
+        items = (rng.zipf(1.3, 256) % 500_000).astype(np.int64)
+        labels = (users % 13 == items % 13).astype(np.float32)
+        yield {"user": users, "item": items, "label": labels}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--dim", type=int, default=32)
+    parser.add_argument("--min-frequency", type=int, default=2)
+    parser.add_argument("--ckpt-dir", default="")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.common.storage import PosixDiskStorage
+    from dlrover_tpu.sparse import KvOptimizerConfig, KvVariable
+    from dlrover_tpu.sparse.embedding import KvEmbedding, SparseTrainStep
+    from dlrover_tpu.trainer.data.shm_dataloader import ShmDataLoader
+
+    users = KvEmbedding(KvVariable(
+        args.dim, optimizer="adagrad", init_scale=0.05, seed=1,
+        min_frequency=args.min_frequency,
+        opt_config=KvOptimizerConfig(learning_rate=0.1)), bucket=512)
+    items = KvEmbedding(KvVariable(
+        args.dim, optimizer="adagrad", init_scale=0.05, seed=2,
+        min_frequency=args.min_frequency,
+        opt_config=KvOptimizerConfig(learning_rate=0.1)), bucket=1024)
+
+    def loss_fn(dense, embs, batch):
+        logit = jnp.sum(embs["user"] * embs["item"], -1) + dense["bias"]
+        label = batch["label"]
+        return jnp.mean(
+            jnp.maximum(logit, 0) - logit * label
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        )
+
+    step = SparseTrainStep(
+        loss_fn, {"user": users, "item": items},
+        lambda p, g: jax.tree.map(lambda a, b: a - 0.05 * b, p, g))
+    dense = {"bias": jnp.zeros(())}
+
+    loader = ShmDataLoader(make_batches, num_slots=4)
+    losses = []
+    try:
+        for i, batch in enumerate(loader):
+            if i >= args.steps:
+                break
+            loss, dense = step(
+                dense,
+                {"user": batch["user"], "item": batch["item"]},
+                {"label": jnp.asarray(batch["label"])},
+            )
+            losses.append(float(loss))
+            if (i + 1) % 50 == 0:
+                print(
+                    f"step {i + 1}: loss={np.mean(losses[-50:]):.4f} "
+                    f"users={len(users.var)} items={len(items.var)} "
+                    f"item_bytes={items.var.storage_bytes() >> 20}MiB"
+                )
+                # stale-id eviction keeps the long tail bounded
+                evicted = items.var.evict(min_frequency=2)
+                if evicted:
+                    print(f"  evicted {evicted} cold item rows")
+    finally:
+        loader.close()
+
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="recsys_ckpt_")
+    storage = PosixDiskStorage()
+    users.var.save(storage, os.path.join(ckpt_dir, "users.npz"))
+    items.var.save(storage, os.path.join(ckpt_dir, "items.npz"))
+    print(f"checkpoint saved to {ckpt_dir}")
+    first, last = np.mean(losses[:20]), np.mean(losses[-20:])
+    print(f"loss {first:.4f} -> {last:.4f}")
+    return 0 if last < first else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
