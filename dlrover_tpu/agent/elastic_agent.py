@@ -51,6 +51,10 @@ class WorkerSpec:
     # measure ICI/DCN collective bandwidth during the check rounds
     # (reference: dlrover-run --comm-perf-test)
     comm_perf_test: bool = False
+    # leave the job when the check rounds mark this host a straggler
+    # (reference: dlrover-run --exclude-straggler): the scheduler then
+    # replaces the slow host instead of letting it drag every step
+    exclude_straggler: bool = False
     # poll the master's mutable ParallelConfig into the trainer's
     # hot-reload file (reference: --auto_tunning + ParalConfigTuner)
     auto_tunning: bool = False
@@ -381,6 +385,26 @@ class ElasticAgent:
                     self._node_rank, NodeStatus.FAILED
                 )
                 return 1
+            if self._spec.exclude_straggler:
+                try:
+                    stragglers, _ = self._client.check_straggler()
+                except Exception as e:
+                    stragglers = []
+                    logger.warning("straggler query failed: %s", e)
+                if self._node_rank in stragglers:
+                    logger.error(
+                        "This host is a straggler (slower than the group "
+                        "median threshold); leaving the job so the "
+                        "scheduler replaces it"
+                    )
+                    self._client.report_failure(
+                        "straggler excluded", level="straggler",
+                        node_rank=self._node_rank, restart_count=0,
+                    )
+                    self._client.report_node_status(
+                        self._node_rank, NodeStatus.FAILED
+                    )
+                    return 1
         self._initialize_workers()
         spec = self._spec
         try:

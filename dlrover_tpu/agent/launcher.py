@@ -58,6 +58,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "the check rounds (reference: dlrover-run --comm-perf-test)",
     )
     p.add_argument(
+        "--exclude-straggler", action="store_true",
+        help="exit (for replacement) when the check rounds mark this "
+             "host a straggler (reference: dlrover-run --exclude-straggler)",
+    )
+    p.add_argument(
         "--auto-tunning", action="store_true",
         help="poll the master's mutable ParallelConfig into the trainer "
              "hot-reload file (reference: dlrover-run --auto_tunning)",
@@ -156,6 +161,11 @@ def run(args: argparse.Namespace) -> int:
             "--comm-perf-test only runs inside the check rounds; "
             "pass --network-check too (no perf will be measured)"
         )
+    if args.exclude_straggler and not args.network_check:
+        logger.warning(
+            "--exclude-straggler needs the check rounds to rank hosts; "
+            "pass --network-check too (no straggler will be excluded)"
+        )
     spec = WorkerSpec(
         entrypoint=entrypoint,
         nproc_per_node=args.nproc_per_node,
@@ -163,6 +173,7 @@ def run(args: argparse.Namespace) -> int:
         monitor_interval=args.monitor_interval,
         network_check=args.network_check,
         comm_perf_test=args.comm_perf_test,
+        exclude_straggler=args.exclude_straggler,
         auto_tunning=args.auto_tunning,
         hang_timeout=args.hang_timeout,
         hang_grace_period=args.hang_grace_period,

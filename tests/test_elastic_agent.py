@@ -187,3 +187,48 @@ def test_membership_change_triggers_restart(master2, tmp_path):
     rounds = {p.name: p.read_text() for p in tmp_path.glob("round_*")}
     assert "2" in rounds.values(), f"no 2-node round observed: {rounds}"
     setup.close()
+
+
+def test_exclude_straggler_leaves_job(local_master):
+    """A host flagged straggler by the check rounds exits for replacement
+    when exclusion is enabled (reference: dlrover-run --exclude-straggler)."""
+    import sys
+
+    from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.constants import RendezvousName
+
+    master, addr = local_master
+    client = MasterClient(addr, node_id=0, node_type="worker")
+    # seed the check rendezvous so the median rule flags rank 0: its
+    # round took >2x the median of its peers
+    mgr = master.rdzv_managers[RendezvousName.NETWORK_CHECK]
+    mgr._rdzv_nodes = {0: 1, 1: 1, 2: 1}
+    mgr._node_times = {0: 30.0, 1: 2.0, 2: 2.0}
+    try:
+        stragglers, _ = client.check_straggler()
+        assert stragglers == [0]
+        # full agent path: the real check round would overwrite the
+        # seeded timings, so pin the straggler verdict at the client and
+        # assert the agent leaves without ever spawning workers
+        client.check_straggler = lambda: ([0], "")
+        reported = []
+        orig_report = client.report_failure
+        client.report_failure = lambda *a, **k: (
+            reported.append(k.get("level")), orig_report(*a, **k))[1]
+        spec = WorkerSpec(
+            entrypoint=[sys.executable, "-c", "print('nope')"],
+            monitor_interval=0.2,
+            network_check=True,
+            exclude_straggler=True,
+            flash_ckpt=False,
+            monitors=False,
+        )
+        agent = ElasticAgent(client, 0, spec)
+        rc = agent.run()
+        assert rc == 1  # left the job for replacement
+        # specifically via the straggler path, not a failed check:
+        assert "straggler" in reported, reported
+        assert agent._group.procs == []  # never spawned workers
+    finally:
+        client.close()
