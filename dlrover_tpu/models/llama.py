@@ -163,6 +163,8 @@ class Attention(nn.Module):
         x: jax.Array,
         positions: jax.Array,
         segment_ids: Optional[jax.Array] = None,
+        decode: bool = False,
+        cache_len: Optional[int] = None,
     ) -> jax.Array:
         cfg = self.config
         d = cfg.head_dim_
@@ -219,6 +221,48 @@ class Attention(nn.Module):
         k = checkpoint_name(apply_rope(k, angles), "qkv_proj")
         v = checkpoint_name(v, "qkv_proj")
 
+        if decode:
+            # KV-cache decode: append this call's K/V at the caller-given
+            # positions (prefill writes [0, P); steps write one column)
+            # and attend over the whole cache with a position mask.  The
+            # write offset is positions[0] — the caller's position stream
+            # IS the cache clock, so no separate index variable can skew.
+            # ``cache_len`` sizes the cache to the actual generation
+            # horizon (prompt+new), not max_seq_len — at 16 new tokens on
+            # a 4k-context config that is ~200x less cache memory and
+            # attention work per step.
+            assert segment_ids is None, (
+                "packed sequences are not supported in decode: the cache "
+                "mask is position-only and would attend across segments"
+            )
+            length = cache_len or cfg.max_seq_len
+            batch = x.shape[0]
+            cache_shape = (batch, length, cfg.num_kv_heads, d)
+            ck = self.variable("cache", "cached_key",
+                               jnp.zeros, cache_shape, k.dtype)
+            cv = self.variable("cache", "cached_value",
+                               jnp.zeros, cache_shape, v.dtype)
+            offset = positions[0].astype(jnp.int32)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, offset, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, offset, 0, 0))
+            key_pos = jnp.arange(length)
+            # [q, kv] True where the key is visible to the query
+            mask = key_pos[None, :] <= positions[:, None]
+            reps = cfg.num_heads // cfg.num_kv_heads
+            kk = jnp.repeat(ck.value, reps, axis=2) if reps > 1 else ck.value
+            vv = jnp.repeat(cv.value, reps, axis=2) if reps > 1 else cv.value
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                kk.astype(jnp.float32)) / jnp.sqrt(float(d))
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, vv.astype(jnp.float32)
+            ).astype(x.dtype)
+            return o_proj(out)
+
         out = dot_product_attention(q, k, v, causal=True, segment_ids=segment_ids)
         out = checkpoint_name(out, "attn_out")
         out = with_logical_constraint(out, ("batch", "seq", "heads", "head_dim"))
@@ -259,10 +303,14 @@ class DecoderLayer(nn.Module):
         x: jax.Array,
         positions: jax.Array,
         segment_ids: Optional[jax.Array] = None,
+        decode: bool = False,
+        cache_len: Optional[int] = None,
     ) -> jax.Array:
         cfg = self.config
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="input_norm")(x)
-        x = x + Attention(cfg, name="attn")(h, positions, segment_ids)
+        x = x + Attention(cfg, name="attn")(h, positions, segment_ids,
+                                            decode=decode,
+                                            cache_len=cache_len)
         x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="post_norm")(x)
         if cfg.num_experts:
@@ -308,6 +356,8 @@ class LlamaModel(nn.Module):
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
         return_hidden: bool = False,
+        decode: bool = False,
+        cache_len: Optional[int] = None,
     ) -> jax.Array:
         """``return_hidden=True`` skips the lm-head projection and returns
         the final normed hidden states — used with
@@ -329,6 +379,12 @@ class LlamaModel(nn.Module):
         x = embed(input_ids)
         x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
+        if decode and cfg.scan_layers:
+            raise NotImplementedError(
+                "KV-cache decode needs per-layer cache variables; use "
+                "scan_layers=False for generation configs (training keeps "
+                "scan_layers=True — the cache never exists under training)"
+            )
         if cfg.scan_layers:
             block = _ScanLayer
             if cfg.remat:
@@ -344,13 +400,23 @@ class LlamaModel(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
             (x, _, _), _ = scan(cfg, name="layers")((x, positions, segment_ids), None)
+        elif decode:
+            # no remat in decode (nothing to rematerialize — inference);
+            # keeping the bool OUT of nn.remat also matters: remat would
+            # trace it and `if decode:` would fail at trace time
+            for i in range(cfg.num_layers):
+                x = DecoderLayer(cfg, name=f"layer_{i}")(
+                    x, positions, segment_ids, decode=True,
+                    cache_len=cache_len,
+                )
         else:
             layer_cls = DecoderLayer
             if cfg.remat:
                 policy = resolve_remat_policy(cfg.remat_policy)
                 layer_cls = nn.remat(layer_cls, policy=policy, prevent_cse=False)
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+                x = layer_cls(cfg, name=f"layer_{i}")(x, positions,
+                                                      segment_ids)
 
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
 

@@ -14,6 +14,9 @@ class PPOConfig:
     max_new_tokens: int = 16
     temperature: float = 1.0
     top_k: int = 0  # 0 = full softmax
+    # KV-cache decode (O(1)-context steps; needs scan_layers=False on
+    # the actor) vs full-recompute rollout
+    use_kv_cache: bool = False
 
     # reward shaping (reference ppo_util.get_rewards / get_kl_penalty)
     kl_coef: float = 0.1

@@ -11,10 +11,25 @@ interface (causality makes the suffix garbage invisible to position t).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def select_token(
+    logits: jax.Array, key: jax.Array, temperature: float, top_k: int
+) -> jax.Array:
+    """Shared token selection: top-k mask, then greedy (temperature 0)
+    or categorical sampling — one implementation for both samplers."""
+    logits = logits.astype(jnp.float32)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(key, logits / temperature)
 
 
 def sample_sequences(
@@ -46,17 +61,9 @@ def sample_sequences(
         logits = apply_fn(params, toks)  # [B, total, V]
         step_logits = jax.lax.dynamic_slice_in_dim(
             logits, t - 1, 1, axis=1
-        )[:, 0, :].astype(jnp.float32)
+        )[:, 0, :]
         key, sub = jax.random.split(key)
-        if top_k > 0:
-            kth = jnp.sort(step_logits, axis=-1)[:, -top_k][:, None]
-            step_logits = jnp.where(
-                step_logits < kth, -jnp.inf, step_logits
-            )
-        if temperature == 0.0:
-            nxt = jnp.argmax(step_logits, axis=-1)
-        else:
-            nxt = jax.random.categorical(sub, step_logits / temperature)
+        nxt = select_token(step_logits, sub, temperature, top_k)
         toks = jax.lax.dynamic_update_slice_in_dim(
             toks, nxt[:, None].astype(toks.dtype), t, axis=1
         )
@@ -70,3 +77,88 @@ def sample_sequences(
     response_mask = (positions >= prompt_len).astype(jnp.int32)
     response_mask = jnp.broadcast_to(response_mask, (batch, total))
     return tokens, response_mask
+
+
+def sample_sequences_cached(
+    model: Any,
+    variables: Any,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    pad_token: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """KV-cache decode: one prefill pass then O(1)-context steps.
+
+    ``model`` is a ``LlamaModel`` with ``scan_layers=False`` (per-layer
+    cache variables); ``variables`` its init dict ({"params": ...}).
+    ``prompt_len + max_new_tokens`` must fit ``config.max_seq_len`` (the
+    cache capacity).  Same sampling semantics as
+    :func:`sample_sequences`, ~seq_len-times fewer FLOPs per generated
+    token.
+    """
+    batch, prompt_len = prompt_ids.shape
+    total = prompt_len + max_new_tokens
+    cfg = model.config
+    assert total <= cfg.max_seq_len, (total, cfg.max_seq_len)
+    generate = _cached_generate(
+        model, prompt_len, max_new_tokens, float(temperature), int(top_k),
+        int(pad_token),
+    )
+    tokens = generate(variables, prompt_ids, rng)
+    positions = jnp.arange(total)[None, :]
+    response_mask = jnp.broadcast_to(
+        (positions >= prompt_len).astype(jnp.int32), (batch, total))
+    return tokens, response_mask
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_generate(model, prompt_len: int, max_new_tokens: int,
+                     temperature: float, top_k: int, pad_token: int):
+    """One jitted prefill+scan program per (model, static config) — a
+    fresh closure per call would retrace and recompile every rollout,
+    erasing the cache's speedup.  flax modules are frozen dataclasses,
+    hence hashable cache keys."""
+    total = prompt_len + max_new_tokens
+
+    @jax.jit
+    def generate(variables, prompts, key):
+        batch = prompts.shape[0]
+        # prefill: writes cache positions [0, P) and predicts token P
+        logits, cache = model.apply(
+            variables, prompts, positions=jnp.arange(prompt_len),
+            decode=True, cache_len=total, mutable=["cache"],
+        )
+        key, sub = jax.random.split(key)
+        first = select_token(logits[:, -1], sub, temperature, top_k)
+        tokens = jnp.concatenate(
+            [prompts,
+             jnp.full((batch, max_new_tokens), pad_token, prompts.dtype)],
+            axis=1,
+        )
+        tokens = tokens.at[:, prompt_len].set(first.astype(tokens.dtype))
+
+        def step(carry, t):
+            toks, cache, key = carry
+            last = jax.lax.dynamic_slice_in_dim(toks, t - 1, 1, axis=1)
+            logits, cache = model.apply(
+                {**variables, **cache}, last,
+                positions=jnp.reshape(t - 1, (1,)),
+                decode=True, cache_len=total, mutable=["cache"],
+            )
+            key, sub = jax.random.split(key)
+            nxt = select_token(logits[:, 0], sub, temperature, top_k)
+            toks = jax.lax.dynamic_update_slice_in_dim(
+                toks, nxt[:, None].astype(toks.dtype), t, axis=1
+            )
+            return (toks, cache, key), None
+
+        if max_new_tokens > 1:
+            (tokens, _, _), _ = jax.lax.scan(
+                step, (tokens, cache, key),
+                jnp.arange(prompt_len + 1, total),
+            )
+        return tokens
+
+    return generate

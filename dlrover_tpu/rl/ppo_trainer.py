@@ -114,6 +114,15 @@ class PPOTrainer:
         critic_apply = self.critic.apply
 
         def rollout(actor_params, prompts, rng):
+            if c.use_kv_cache:
+                from dlrover_tpu.rl.generation import (
+                    sample_sequences_cached,
+                )
+
+                return sample_sequences_cached(
+                    self.actor, actor_params, prompts, c.max_new_tokens,
+                    rng, temperature=c.temperature, top_k=c.top_k,
+                )
             return sample_sequences(
                 actor_apply, actor_params, prompts, c.max_new_tokens, rng,
                 temperature=c.temperature, top_k=c.top_k,

@@ -171,3 +171,54 @@ def test_ppo_increases_reward():
     early = np.mean(scores[:2])
     late = np.mean(scores[-2:])
     assert late > early + 0.1, scores
+
+
+def test_cached_decode_matches_full_recompute():
+    """KV-cache decode must produce EXACTLY the same greedy tokens as the
+    full-recompute sampler (same model, same prompts)."""
+    from dlrover_tpu.rl.generation import sample_sequences_cached
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=False,
+                           remat=False, vocab_size=64)
+    model = LlamaModel(cfg)
+    prompts = jnp.asarray(np.random.RandomState(0).randint(
+        1, 60, (2, 5)).astype(np.int32))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 16), jnp.int32))
+    full, mask_full = sample_sequences(
+        model.apply, variables, prompts, max_new_tokens=9,
+        rng=jax.random.PRNGKey(7), temperature=0.0)
+    cached, mask_cached = sample_sequences_cached(
+        model, variables, prompts, max_new_tokens=9,
+        rng=jax.random.PRNGKey(7), temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+    np.testing.assert_array_equal(np.asarray(mask_full),
+                                  np.asarray(mask_cached))
+
+
+def test_cached_decode_rejects_scan_models():
+    from dlrover_tpu.rl.generation import sample_sequences_cached
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    model = LlamaModel(cfg)
+    prompts = jnp.zeros((1, 4), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(NotImplementedError, match="scan_layers"):
+        sample_sequences_cached(model, variables, prompts, 4,
+                                jax.random.PRNGKey(0))
+
+
+def test_ppo_rollout_with_kv_cache():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, vocab_size=64,
+                           scan_layers=False, remat=False)
+    ppo = PPOTrainer(
+        LlamaModel(cfg), ValueModel(trunk=LlamaModel(cfg)),
+        PPOConfig(max_new_tokens=6, ppo_epochs=1, minibatches=2,
+                  use_kv_cache=True),
+        seed=3,
+    )
+    prompts = np.full((4, 4), 2, np.int32)
+    ppo.init_models(prompts)
+    stats = ppo.step(prompts, lambda t, m: np.ones(len(t), np.float32))
+    assert np.isfinite(stats["loss"])
