@@ -23,6 +23,12 @@ def build_master_parser() -> argparse.ArgumentParser:
         "--autoscale", type=str2bool, default=False, nargs="?", const=True,
         help="enable the throughput-driven JobAutoScaler",
     )
+    parser.add_argument(
+        "--auto_tuning", type=str2bool, default=False, nargs="?",
+        const=True,
+        help="enable the BO-driven ParallelConfig tuning loop (agents "
+             "need --auto-tunning to ship configs to trainers)",
+    )
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--node_num", type=int, default=1)
     parser.add_argument(

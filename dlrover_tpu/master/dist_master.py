@@ -50,6 +50,8 @@ class DistributedJobMaster:
         worker_resource: Optional[NodeResource] = None,
         heartbeat_timeout: float = 300.0,
         autoscale: bool = False,
+        auto_tuning: bool = False,
+        tuning_interval: float = 120.0,
     ):
         self._port = port
         self._node_num = node_num
@@ -114,6 +116,32 @@ class DistributedJobMaster:
         self._server = build_server(self.servicer.get, self.servicer.report)
         self._stopped = threading.Event()
         self.exit_reason: str = ""
+        # BO-driven runtime tuning loop: propose a ParallelConfig, let the
+        # agents' ParalConfigTuner ship it to trainers, observe the speed
+        # it achieves, repeat (reference: the Brain-driven auto_tunning
+        # loop behind dlrover-run --auto_tunning)
+        self.strategy_generator = None
+        self._tuning_interval = tuning_interval
+        # agents poll configs every ~30s; speed measured before a proposal
+        # has propagated would score the OLD config, so the scoring window
+        # opens only after this grace
+        self._tuning_propagation_grace = 45.0
+        self._tuning_thread: Optional[threading.Thread] = None
+        if auto_tuning:
+            if autoscale:
+                # both features consume AND reset the same SpeedMonitor
+                # window; combined they would corrupt each other's
+                # measurements (tuner resets wipe autoscaler samples and
+                # vice versa)
+                raise ValueError(
+                    "enable either autoscale or auto_tuning, not both: "
+                    "they share the speed-measurement window"
+                )
+            from dlrover_tpu.master.hyperparams.strategy_generator import (
+                SimpleStrategyGenerator,
+            )
+
+            self.strategy_generator = SimpleStrategyGenerator()
 
     def prepare(self) -> None:
         for mgr in self.rdzv_managers.values():
@@ -128,6 +156,11 @@ class DistributedJobMaster:
         self.diagnosis_manager.start_observing()
         if self.job_auto_scaler is not None:
             self.job_auto_scaler.start_auto_scaling()
+        if self.strategy_generator is not None:
+            self._tuning_thread = threading.Thread(
+                target=self._tuning_loop, daemon=True, name="auto-tuning"
+            )
+            self._tuning_thread.start()
         self._server.add_insecure_port(f"[::]:{self._port}")
         self._server.start()
         logger.info("Distributed master serving on port %s", self._port)
@@ -158,6 +191,35 @@ class DistributedJobMaster:
         except KeyboardInterrupt:  # pragma: no cover
             pass
         return 0
+
+    def tuning_tick(self) -> None:
+        """One tuning round: score the last proposal by observed speed,
+        publish the next one (also called directly by tests).  The
+        caller opens the next scoring window via
+        :meth:`open_tuning_window` once the proposal has propagated."""
+        speed = self.speed_monitor.running_speed()
+        if speed > 0:
+            self.strategy_generator.observe_speed(speed)
+        config = self.strategy_generator.next_config()
+        self.job_manager.set_paral_config(config)
+
+    def open_tuning_window(self) -> None:
+        """Start a fresh speed window attributable to the LAST published
+        proposal (call after agents had time to apply it)."""
+        self.speed_monitor.reset_running_speed_monitor()
+
+    def _tuning_loop(self) -> None:
+        while not self._stopped.wait(self._tuning_interval):
+            try:
+                # only tune while training is actually progressing
+                if self.speed_monitor.running_speed() > 0:
+                    self.tuning_tick()
+                    # don't score the new proposal until agents applied it
+                    if self._stopped.wait(self._tuning_propagation_grace):
+                        return
+                    self.open_tuning_window()
+            except Exception:
+                logger.exception("auto-tuning tick failed")
 
     def _act_on_inference(self, inference) -> None:
         """Route diagnosis conclusions: record as events; OOM goes to the

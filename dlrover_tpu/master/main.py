@@ -12,6 +12,12 @@ def run(args) -> int:
     if args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster
 
+        if args.autoscale or args.auto_tuning:
+            logger.warning(
+                "--autoscale/--auto_tuning need node lifecycle management; "
+                "the local platform ignores them (use --platform in_memory "
+                "or k8s)"
+            )
         master = LocalJobMaster(port, node_num=args.node_num)
     elif args.platform == "in_memory":
         # Distributed master over the in-process scheduler: full node
@@ -31,6 +37,7 @@ def run(args) -> int:
             watcher=InMemoryNodeWatcher(cluster),
             node_num=args.node_num,
             autoscale=args.autoscale,
+            auto_tuning=args.auto_tuning,
         )
     elif args.platform in ("k8s", "pyk8s"):
         from dlrover_tpu.master.dist_master import DistributedJobMaster
@@ -58,6 +65,7 @@ def run(args) -> int:
                                namespace=args.namespace),
             node_num=args.node_num,
             autoscale=args.autoscale,
+            auto_tuning=args.auto_tuning,
         )
     else:
         raise NotImplementedError(

@@ -232,3 +232,31 @@ def test_exclude_straggler_leaves_job(local_master):
         assert agent._group.procs == []  # never spawned workers
     finally:
         client.close()
+
+
+def test_two_node_check_with_mismatched_comm_perf_flags(master2):
+    """One agent requests comm perf, its peer does not: the group-wide
+    agreement vote must let BOTH pass the check instead of stranding the
+    flag-enabled host in a blocking collective until timeout."""
+    _, addr = master2
+    results = {}
+
+    def run_agent(rank, comm_perf):
+        client = _client(addr, rank)
+        spec = WorkerSpec(
+            entrypoint=[sys.executable, "-c", "print('ok')"],
+            monitor_interval=0.3,
+            network_check=True,
+            comm_perf_test=comm_perf,
+            flash_ckpt=False,
+            monitors=False,
+        )
+        agent = ElasticAgent(client, rank, spec)
+        results[rank] = agent.run()
+        client.close()
+
+    t0 = threading.Thread(target=run_agent, args=(0, True))
+    t1 = threading.Thread(target=run_agent, args=(1, False))
+    t0.start(); t1.start()
+    t0.join(240); t1.join(240)
+    assert results == {0: 0, 1: 0}, results
