@@ -149,6 +149,8 @@ def build_local_sgd_step(
     inner_step: Callable[[Any, Any], Any],
     config: Optional[LocalSGDConfig] = None,
     axis: str = "dp",
+    param_spec=None,
+    batch_spec=None,
 ):
     """Returns jitted (inner_fn, sync_fn) over ``mesh``'s dp axis.
 
@@ -157,17 +159,24 @@ def build_local_sgd_step(
     dp axis with params held per-replica (leading axis R sharded over
     dp).  ``sync_fn(state, replica_params)`` merges on-device: the only
     dp communication in the whole scheme.
+
+    HSDP: pass ``param_spec=PartitionSpec("dp", "fsdp")`` (and a matching
+    ``batch_spec``) to keep each replica's params SHARDED over the fsdp
+    axis inside the shard_map — inner steps then run on fsdp-local
+    shards and the sync reduction moves shard-sized payloads only
+    (reference local_sgd/HSDP composition).
     """
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     cfg = config or LocalSGDConfig()
     local = LocalSGD(cfg)
-    rep = P(axis)
+    rep = param_spec if param_spec is not None else P(axis)
+    bspec = batch_spec if batch_spec is not None else rep
 
     @partial(
         shard_map, mesh=mesh,
-        in_specs=(rep, rep), out_specs=rep, check_rep=False,
+        in_specs=(rep, bspec), out_specs=rep, check_rep=False,
     )
     def inner_fn(replica_params, batch):
         params = jax.tree.map(lambda x: x[0], replica_params)

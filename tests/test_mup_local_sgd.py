@@ -219,3 +219,54 @@ def test_build_local_sgd_step_on_mesh():
     err = float(jnp.linalg.norm(state["global"]["w"] - target))
     # Nesterov (0.7/0.9) rings around the optimum; 12 rounds reach ~0.05
     assert err < 0.1, err
+
+
+def test_hsdp_local_sgd_over_fsdp_sharded_params():
+    """HSDP composition (reference local_sgd/HSDP) through the LIBRARY
+    path: build_local_sgd_step with param_spec over ("dp", "fsdp") keeps
+    each replica's params sharded over fsdp while Local SGD merges over
+    dp.  Replicas train toward DIFFERENT shifted targets whose mean is
+    the true target, so convergence is impossible unless the cross-dp
+    merge actually averages (an identity sync would fail)."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devices = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("dp", "fsdp"))
+    R, dim = 4, 8
+    target = jnp.arange(dim, dtype=jnp.float32)
+    # zero-mean per-replica offsets: each replica's own fixed point is
+    # target + offset_r; only the dp average recovers `target`
+    offsets = jnp.asarray(
+        [[4.0], [-4.0], [2.0], [-2.0]]) * jnp.ones((R, dim))
+
+    def inner_step(params, batch):
+        # batch carries this replica's shifted target ([1, local_dim]
+        # inside shard_map: fsdp-local shard)
+        tgt = batch["target"]
+        return {"w": params["w"] - 0.1 * 2 * (params["w"] - tgt)}
+
+    inner_fn, sync_fn, local = build_local_sgd_step(
+        mesh, inner_step,
+        LocalSGDConfig(merge_method="linear", outer_lr=1.0,
+                       outer_momentum=0.0),
+        param_spec=P("dp", "fsdp"),
+        batch_spec=P("dp", "fsdp"),
+    )
+    spec = NamedSharding(mesh, P("dp", "fsdp"))
+    batches = {"target": jax.device_put(
+        jnp.broadcast_to(target, (R, dim)) + offsets, spec)}
+    w = {"w": jax.device_put(jnp.zeros((R, dim)), spec)}
+    state = local.init({"w": jnp.zeros(dim)})
+    for _ in range(10):
+        for _ in range(20):  # run each replica close to ITS fixed point
+            w = inner_fn(w, batches)
+        merged, state = sync_fn(state, w)
+        w = {"w": jax.device_put(
+            jnp.broadcast_to(merged["w"], (R, dim)), spec)}
+    # replicas sit at target+offset; only a real average lands on target
+    err = float(jnp.linalg.norm(merged["w"] - target))
+    assert err < 1e-2, err
+    # the per-replica params the library produced stayed sharded over
+    # BOTH axes throughout (fsdp shards were never gathered)
+    assert not w["w"].sharding.is_fully_replicated
