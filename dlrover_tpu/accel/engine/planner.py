@@ -90,7 +90,7 @@ def estimate_memory_bytes(
     state_bytes = info.num_params * 4 * 4 // max(1, param_shards)
     b, s = batch_shape
     b_local = max(1, b // (spec.dp * spec.fsdp))
-    s_local = max(1, s // spec.sp)
+    s_local = max(1, s // (spec.sp * spec.cp))
     # activation working set per layer ~ hidden + mlp blowup; remat keeps
     # roughly one layer live plus the residual stream per layer
     act_per_layer = b_local * s_local * info.hidden_size * 2 * 6
@@ -144,6 +144,8 @@ def enumerate_candidates(
             return
         if spec.sp > 1 and s % spec.sp:
             return
+        if spec.cp > 1 and s % (spec.cp * spec.sp):
+            return  # ring attention needs seq divisible by cp*sp
         if spec.pp > 1 and (
             not info.scan_layers or info.num_layers % spec.pp
         ):
@@ -182,8 +184,12 @@ def enumerate_candidates(
     for tp, rest in _factor_pairs(n_devices):
         if tp > 1 and tp <= info.num_heads:
             add(MeshSpec(fsdp=rest, tp=tp), f"fsdp{rest}tp{tp}")
-    # sp variants
+    # sp variants (Ulysses all-to-all) and cp variants (ring attention —
+    # scales context past one chip's HBM; beyond-reference strategy)
     if include_sp:
+        for cp, rest in _factor_pairs(n_devices):
+            if cp > 1:
+                add(MeshSpec(fsdp=rest, cp=cp), f"fsdp{rest}cp{cp}")
         for sp, rest in _factor_pairs(n_devices):
             if sp > 1:
                 add(MeshSpec(fsdp=rest, sp=sp), f"fsdp{rest}sp{sp}")

@@ -16,7 +16,10 @@ ride ICI neighbours):
 ``fsdp``   data parallel with fully-sharded params (ZeRO-3 equivalent —
            reference: atorch auto/opt_lib/zero_optimization.py)
 ``pp``     pipeline stages (reference: pipeline_parallel_optimization.py)
-``sp``     sequence/context parallel, Ulysses all-to-all equivalent
+``cp``     context parallel: ring flash attention over seq chunks
+           (beyond-reference — the reference's SP is all-to-all only,
+           SURVEY.md §2.3; ring attention scales seq past one chip's HBM)
+``sp``     sequence parallel, Ulysses all-to-all equivalent
            (reference: atorch/atorch/distributed/distributed.py:435-501)
 ``ep``     expert parallel for MoE (reference: atorch/atorch/modules/moe/)
 ``tp``     tensor parallel (reference: modules/distributed_modules/layers.py)
@@ -40,7 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Fixed axis order: collectives on later (inner) axes map to closer ICI
 # neighbours, and tensor-parallel all-reduces are the most latency-sensitive.
-MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "cp", "sp", "ep", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +60,7 @@ class MeshSpec:
     dp: int = 1
     fsdp: int = 1
     pp: int = 1
+    cp: int = 1
     sp: int = 1
     ep: int = 1
     tp: int = 1
@@ -69,7 +73,7 @@ class MeshSpec:
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.pp * self.sp * self.ep * self.tp
+        return math.prod(getattr(self, name) for name in MESH_AXES)
 
     @property
     def dims(self) -> Tuple[Tuple[str, int], ...]:
@@ -102,26 +106,29 @@ class MeshSpec:
         n: int,
         tp: int = 1,
         pp: int = 1,
+        cp: int = 1,
         sp: int = 1,
         ep: int = 1,
         fsdp: Optional[int] = None,
     ) -> "MeshSpec":
         """Fill the data dimensions to cover ``n`` devices.
 
-        By default everything not claimed by tp/pp/sp/ep goes to ``fsdp``
+        By default everything not claimed by tp/pp/cp/sp/ep goes to ``fsdp``
         (the reference's default strategy is FSDP too — its headline bench is
         Llama2 FSDP, atorch/examples/llama2/README.md).  Pass ``fsdp`` to
         split the remainder between ``fsdp`` and pure ``dp``.
         """
-        denom = tp * pp * sp * ep
+        denom = tp * pp * cp * sp * ep
         if n % denom:
-            raise ValueError(f"device count {n} not divisible by tp*pp*sp*ep={denom}")
+            raise ValueError(
+                f"device count {n} not divisible by tp*pp*cp*sp*ep={denom}"
+            )
         rest = n // denom
         if fsdp is None:
             fsdp = rest
         if rest % fsdp:
             raise ValueError(f"remainder {rest} not divisible by fsdp={fsdp}")
-        return cls(dp=rest // fsdp, fsdp=fsdp, pp=pp, sp=sp, ep=ep, tp=tp)
+        return cls(dp=rest // fsdp, fsdp=fsdp, pp=pp, cp=cp, sp=sp, ep=ep, tp=tp)
 
 
 # ---------------------------------------------------------------------------
@@ -134,7 +141,10 @@ class MeshSpec:
 # experts over ep.
 DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("batch", ("dp", "fsdp")),
-    ("seq", "sp"),
+    # cp-major, sp-minor: after the Ulysses all-to-all gathers the sp
+    # sub-chunks, each cp peer holds one CONTIGUOUS global seq range —
+    # exactly what the ring's block-causal masking assumes.
+    ("seq", ("cp", "sp")),
     ("kv_seq", None),
     ("embed", "fsdp"),          # param embed dim: ZeRO-3 shard
     ("act_embed", None),        # activation embed dim: replicated

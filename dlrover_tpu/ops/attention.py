@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from dlrover_tpu.common.log import default_logger as logger
 
 _warned_fallback = False
+_warned_cp = False
 
 
 def _xla_attention(
@@ -118,6 +119,34 @@ def dot_product_attention(
         from dlrover_tpu.accel.parallel.mesh import ambient_mesh
 
         mesh = ambient_mesh()
+        if mesh is not None and mesh.shape.get("cp", 1) > 1:
+            # Context parallelism: ring flash attention over cp (composing
+            # Ulysses over sp when sp > 1 — 2D sequence parallel).
+            from dlrover_tpu.ops.ring_attention import (
+                _cp_applicable,
+                ring_attention,
+            )
+
+            if _cp_applicable(q, k, mesh):
+                return ring_attention(
+                    q,
+                    k,
+                    v,
+                    mesh=mesh,
+                    causal=causal,
+                    segment_ids=segment_ids,
+                    scale=scale,
+                    use_pallas=use_pallas,
+                )
+            global _warned_cp
+            if not _warned_cp:
+                _warned_cp = True
+                logger.warning(
+                    "mesh has cp > 1 but ring attention is not applicable "
+                    "(q %s, k %s, mesh %s) — falling back to GSPMD "
+                    "semantics (correct but the seq-sharded softmax will "
+                    "all-gather K/V)", q.shape, k.shape, dict(mesh.shape),
+                )
         if mesh is not None and mesh.shape.get("sp", 1) > 1:
             ok = _ulysses_applicable(q, k, mesh)
             if ok:
@@ -245,6 +274,18 @@ def _spec_uses(entry, axis: str) -> bool:
     return axis in entry
 
 
+def _heads_split_over_sp(q, k, mesh, q_spec, kv_spec) -> bool:
+    """Head counts (after any tp head sharding) must divide by sp for the
+    Ulysses seq<->heads all-to-all.  Shared by the Ulysses and ring
+    applicability checks so the two dispatchers can never disagree."""
+    sp = mesh.shape.get("sp", 1)
+    from dlrover_tpu.accel.parallel.mesh import axes_size
+
+    q_heads_local = q.shape[2] // max(1, axes_size(mesh, q_spec[2]))
+    kv_heads_local = k.shape[2] // max(1, axes_size(mesh, kv_spec[2]))
+    return q_heads_local % sp == 0 and kv_heads_local % sp == 0
+
+
 def _ulysses_applicable(q: jax.Array, k: jax.Array, mesh, rules=None) -> bool:
     """The active rules must shard seq over sp, and head counts must split
     across sp after any tp head sharding.  If seq is NOT sp-sharded (custom
@@ -254,12 +295,12 @@ def _ulysses_applicable(q: jax.Array, k: jax.Array, mesh, rules=None) -> bool:
     q_spec, kv_spec, _ = _attention_specs(mesh, rules)
     if not (_spec_uses(q_spec[1], "sp") and _spec_uses(kv_spec[1], "sp")):
         return False
-    from dlrover_tpu.accel.parallel.mesh import axes_size
-
-    q_heads_local = q.shape[2] // max(1, axes_size(mesh, q_spec[2]))
-    kv_heads_local = k.shape[2] // max(1, axes_size(mesh, kv_spec[2]))
+    if mesh.shape.get("cp", 1) > 1 and _spec_uses(q_spec[1], "cp"):
+        # cp-sharded seq belongs to the ring path; the sp-only all-to-all
+        # would reassemble just one cp chunk and attend block-diagonally.
+        return False
     seq_ok = q.shape[1] % sp == 0 and k.shape[1] % sp == 0
-    return seq_ok and q_heads_local % sp == 0 and kv_heads_local % sp == 0
+    return seq_ok and _heads_split_over_sp(q, k, mesh, q_spec, kv_spec)
 
 
 def ulysses_attention(

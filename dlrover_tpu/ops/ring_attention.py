@@ -1,0 +1,453 @@
+"""Ring (context-parallel) flash attention over the ``cp`` mesh axis.
+
+Long-context training beyond one chip's HBM: the sequence is sharded into
+contiguous chunks over ``cp``; each ring step every peer runs blockwise
+flash attention of its local queries against the K/V chunk it currently
+holds, merges the result into an online-softmax accumulator ``(o, lse)``,
+and rotates K/V to its ring neighbour with ``jax.lax.ppermute`` (one ICI
+hop).  HBM never holds more than two K/V chunks and attention compute per
+chip is O(s^2 / cp) FLOPs.  Note the causal critical path is ~2x that:
+with contiguous chunks the per-step ppermute synchronizes all peers to the
+busiest one, so skipped future blocks don't shorten wall-clock (the
+classic plain-ring imbalance; a zigzag chunk placement would halve it at
+the cost of non-contiguous positions).
+
+The reference framework has **no** ring/context parallelism — its sequence
+parallelism is Ulysses all-to-all only (reference:
+atorch/atorch/auto/opt_lib/sequence_parallel_optimization.py:9-51 and
+distributed/distributed.py:474-501, confirmed by SURVEY.md §2.3) — so this
+is a beyond-parity capability.  Design follows the ring-attention recipe
+(blockwise parallel transformers) re-expressed TPU-natively:
+
+- per-step block attention reuses the Pallas flash kernels
+  (:mod:`dlrover_tpu.ops.pallas.flash_attention`): the diagonal chunk runs
+  the causal kernel, strictly-past chunks run the non-causal kernel, and
+  strictly-future chunks are skipped entirely via ``jax.lax.switch`` — so
+  causal masking never wastes MXU time on masked blocks;
+- chunk merging uses the normalized-output + LSE identity
+  ``o = sum_i o_i * exp(lse_i - logsumexp_i lse_i)``;
+- the backward pass runs the ring again: ``dq`` accumulates locally while
+  ``(dk, dv)`` ride around the ring *with* their K/V chunk and are home
+  after ``cp`` rotations.
+
+Composes with Ulysses ``sp`` inside one shard_map (2D sequence parallel):
+the seq axis is sharded cp-major / sp-minor (mesh rule ``("cp", "sp")``),
+so the sp all-to-all reassembles a contiguous cp chunk before the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# per-chunk block attention returning (normalized output, LSE)
+# ---------------------------------------------------------------------------
+
+
+def _xla_chunk_fwd(q, k, v, q_seg, k_seg, *, causal: bool, scale: float):
+    """Chunk attention in plain XLA; [b, h, s, d] layout, f32 compute.
+
+    Matches the Pallas kernel contract: normalized output in ``q.dtype``
+    plus ``lse = m + log(l)`` of shape [b, h, 1, sq]; fully-masked rows get
+    ``o = 0`` and ``lse = -1e30``.
+    """
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    reps = h // hkv
+    qf = (q.astype(jnp.float32) * scale).reshape(b, hkv, reps, sq, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qf, kf)
+    mask = None
+    if causal:
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(skv)[None, :]
+        mask = mask[None, None, None]
+    if q_seg is not None:
+        seg = (q_seg[:, 0, :, None] == k_seg[:, 0, None, :])[:, None, None]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p, vf) / l_safe[..., None]
+    lse = m + jnp.log(l_safe)
+    return (
+        o.reshape(b, h, sq, d).astype(q.dtype),
+        lse.reshape(b, h, 1, sq),
+    )
+
+
+def _xla_chunk_bwd(
+    q, k, v, q_seg, k_seg, lse, do, delta, *, causal: bool, scale: float
+):
+    """Chunk backward in plain XLA given the *global* lse/delta.
+
+    Same math as the Pallas ``_dq_kernel``/``_dkv_kernel``
+    (flash_attention.py): ``p = exp(s - lse)``, ``ds = p (do.v - delta)``,
+    ``dq = scale * ds.k``, ``dk = scale * ds^T.q``, ``dv = p^T.do``.
+    """
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    reps = h // hkv
+    qf = (q.astype(jnp.float32) * scale).reshape(b, hkv, reps, sq, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32).reshape(b, hkv, reps, sq, d)
+    lse_g = lse.reshape(b, hkv, reps, sq)
+    delta_g = delta.reshape(b, hkv, reps, sq)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qf, kf)
+    mask = None
+    if causal:
+        mask = (jnp.arange(sq)[:, None] >= jnp.arange(skv)[None, :])[
+            None, None, None
+        ]
+    if q_seg is not None:
+        seg = (q_seg[:, 0, :, None] == k_seg[:, 0, None, :])[:, None, None]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse_g[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dv = jnp.einsum("bhgqk,bhgqd->bhkd", p, dof)
+    dov = jnp.einsum("bhgqd,bhkd->bhgqk", dof, vf)
+    ds = p * (dov - delta_g[..., None])
+    dq = jnp.einsum("bhgqk,bhkd->bhgqd", ds, kf) * scale
+    # qf already carries `scale` (matches the Pallas kernels).
+    dk = jnp.einsum("bhgqk,bhgqd->bhkd", ds, qf)
+    return dq.reshape(b, h, sq, d), dk, dv
+
+
+def _pallas_ok(sq: int, skv: int, d: int) -> bool:
+    """Kernel tiling constraints for the per-chunk Pallas path."""
+    if jax.default_backend() in ("cpu", "gpu"):
+        return False
+    return sq % 128 == 0 and skv % 128 == 0 and d % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# local ring (runs inside shard_map over the cp axis)
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(cp: int):
+    # send to the previous peer => after t steps peer i holds chunk (i+t)%cp
+    return [(j, (j - 1) % cp) for j in range(cp)]
+
+
+def _rotate(xs, axis_name: str, cp: int):
+    return jax.lax.ppermute(xs, axis_name, _ring_perm(cp))
+
+
+def _block_size(seq: int) -> int:
+    """Largest kernel block (<=1024, >=128) that divides the chunk."""
+    for b in (1024, 512, 256, 128):
+        if seq % b == 0:
+            return b
+    return seq
+
+
+def _chunk_fwd(q, k, v, q_seg, k_seg, causal, scale, use_pallas, interpret):
+    if use_pallas:
+        from dlrover_tpu.ops.pallas.flash_attention import _fwd
+
+        return _fwd(
+            q, k, v, q_seg, k_seg,
+            causal=causal, scale=scale,
+            block_q=_block_size(q.shape[2]), block_k=_block_size(k.shape[2]),
+            interpret=interpret,
+        )
+    return _xla_chunk_fwd(q, k, v, q_seg, k_seg, causal=causal, scale=scale)
+
+
+def _chunk_bwd(
+    q, k, v, q_seg, k_seg, o, lse, do, delta,
+    causal, scale, use_pallas, interpret,
+):
+    if use_pallas:
+        from dlrover_tpu.ops.pallas.flash_attention import _bwd
+
+        return _bwd(
+            (q, k, v, q_seg, k_seg, o, lse), do,
+            causal=causal, scale=scale,
+            block_q=_block_size(q.shape[2]), block_k=_block_size(k.shape[2]),
+            interpret=interpret,
+        )
+    return _xla_chunk_bwd(
+        q, k, v, q_seg, k_seg, lse, do, delta, causal=causal, scale=scale
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _ring_local(
+    q, k, v, q_seg, k_seg, axis_name, cp, causal, scale, use_pallas, interpret
+):
+    o, _ = _ring_fwd(
+        q, k, v, q_seg, k_seg, axis_name, cp, causal, scale, use_pallas,
+        interpret,
+    )
+    return o
+
+
+def _ring_fwd(
+    q, k, v, q_seg, k_seg, axis_name, cp, causal, scale, use_pallas, interpret
+):
+    """Forward ring: returns (o [b,h,sq,d] in q.dtype, lse [b,h,1,sq] f32)."""
+    b, h, sq, d = q.shape
+    me = jax.lax.axis_index(axis_name)
+    have_segs = q_seg is not None
+
+    def block(kc, vc, ksegc, blk_causal):
+        return _chunk_fwd(
+            q, kc, vc, q_seg, ksegc, blk_causal, scale, use_pallas, interpret
+        )
+
+    def skip(kc, vc, ksegc):
+        return (
+            jnp.zeros((b, h, sq, d), q.dtype),
+            jnp.full((b, h, 1, sq), _NEG_INF, jnp.float32),
+        )
+
+    def body(t, carry):
+        o_acc, lse_acc, kc, vc, ksegc = carry
+        ki = (me + t) % cp
+        if causal:
+            branch = jnp.where(ki == me, 1, jnp.where(ki < me, 2, 0))
+            o_b, lse_b = jax.lax.switch(
+                branch,
+                [
+                    skip,
+                    lambda kc, vc, sc: block(kc, vc, sc, True),
+                    lambda kc, vc, sc: block(kc, vc, sc, False),
+                ],
+                kc, vc, ksegc,
+            )
+        else:
+            o_b, lse_b = block(kc, vc, ksegc, False)
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        # [b,h,1,sq] -> [b,h,sq,1] to broadcast over head_dim
+        w_acc = jnp.exp(jnp.swapaxes(lse_acc - lse_new, 2, 3))
+        w_b = jnp.exp(jnp.swapaxes(lse_b - lse_new, 2, 3))
+        o_acc = o_acc * w_acc + o_b.astype(jnp.float32) * w_b
+        rot = (kc, vc, ksegc) if have_segs else (kc, vc)
+        rot = _rotate(rot, axis_name, cp)
+        kc, vc = rot[0], rot[1]
+        ksegc = rot[2] if have_segs else ksegc
+        return o_acc, lse_new, kc, vc, ksegc
+
+    init = (
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        jnp.full((b, h, 1, sq), _NEG_INF, jnp.float32),
+        k,
+        v,
+        k_seg if have_segs else jnp.zeros((b, 1, k.shape[2]), jnp.int32),
+    )
+    o_acc, lse, *_ = jax.lax.fori_loop(0, cp, body, init)
+    return o_acc.astype(q.dtype), lse
+
+
+def _ring_fwd_rule(
+    q, k, v, q_seg, k_seg, axis_name, cp, causal, scale, use_pallas, interpret
+):
+    o, lse = _ring_fwd(
+        q, k, v, q_seg, k_seg, axis_name, cp, causal, scale, use_pallas,
+        interpret,
+    )
+    return o, (q, k, v, q_seg, k_seg, o, lse)
+
+
+def _ring_bwd_rule(
+    axis_name, cp, causal, scale, use_pallas, interpret, res, g
+):
+    q, k, v, q_seg, k_seg, o, lse = res
+    do = g
+    b, h, sq, d = q.shape
+    me = jax.lax.axis_index(axis_name)
+    have_segs = q_seg is not None
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, :, None, :]
+
+    def block(kc, vc, ksegc, blk_causal):
+        dq_b, dk_b, dv_b = _chunk_bwd(
+            q, kc, vc, q_seg, ksegc, o, lse, do, delta,
+            blk_causal, scale, use_pallas, interpret,
+        )
+        return (
+            dq_b.astype(jnp.float32),
+            dk_b.astype(jnp.float32),
+            dv_b.astype(jnp.float32),
+        )
+
+    def skip(kc, vc, ksegc):
+        return (
+            jnp.zeros((b, h, sq, d), jnp.float32),
+            jnp.zeros(kc.shape, jnp.float32),
+            jnp.zeros(vc.shape, jnp.float32),
+        )
+
+    def body(t, carry):
+        dq_acc, kc, vc, ksegc, dk_acc, dv_acc = carry
+        ki = (me + t) % cp
+        if causal:
+            branch = jnp.where(ki == me, 1, jnp.where(ki < me, 2, 0))
+            dq_b, dk_b, dv_b = jax.lax.switch(
+                branch,
+                [
+                    skip,
+                    lambda kc, vc, sc: block(kc, vc, sc, True),
+                    lambda kc, vc, sc: block(kc, vc, sc, False),
+                ],
+                kc, vc, ksegc,
+            )
+        else:
+            dq_b, dk_b, dv_b = block(kc, vc, ksegc, False)
+        dq_acc = dq_acc + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        # (dk, dv) travel WITH their chunk; after cp rotations they're home.
+        rot = (kc, vc, dk_acc, dv_acc, ksegc) if have_segs else (
+            kc, vc, dk_acc, dv_acc
+        )
+        rot = _rotate(rot, axis_name, cp)
+        kc, vc, dk_acc, dv_acc = rot[0], rot[1], rot[2], rot[3]
+        ksegc = rot[4] if have_segs else ksegc
+        return dq_acc, kc, vc, ksegc, dk_acc, dv_acc
+
+    init = (
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        k,
+        v,
+        k_seg if have_segs else jnp.zeros((b, 1, k.shape[2]), jnp.int32),
+        jnp.zeros(k.shape, jnp.float32),
+        jnp.zeros(v.shape, jnp.float32),
+    )
+    dq, _, _, _, dk, dv = jax.lax.fori_loop(0, cp, body, init)
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        None,
+        None,
+    )
+
+
+_ring_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# public API: global arrays, shard_map over (cp [, sp]) from the mesh rules
+# ---------------------------------------------------------------------------
+
+
+def _cp_applicable(q, k, mesh, rules=None) -> bool:
+    """Seq must be cp-sharded by the active rules; when sp > 1 the Ulysses
+    head split must also hold (heads divide by sp after tp sharding)."""
+    from dlrover_tpu.ops.attention import (
+        _attention_specs,
+        _heads_split_over_sp,
+        _spec_uses,
+    )
+
+    cp = mesh.shape.get("cp", 1)
+    sp = mesh.shape.get("sp", 1)
+    q_spec, kv_spec, _ = _attention_specs(mesh, rules)
+    if not (_spec_uses(q_spec[1], "cp") and _spec_uses(kv_spec[1], "cp")):
+        return False
+    if q.shape[1] % (cp * sp) or k.shape[1] % (cp * sp):
+        return False
+    if sp > 1:
+        if not (_spec_uses(q_spec[1], "sp") and _spec_uses(kv_spec[1], "sp")):
+            return False
+        if not _heads_split_over_sp(q, k, mesh, q_spec, kv_spec):
+            return False
+    return True
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+    rules=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Context-parallel attention on *global* [b, s, h, d] arrays.
+
+    shard_maps over the mesh: when ``sp > 1`` the Ulysses all-to-all first
+    trades the sp-sub-chunks for a head slice (2D sequence parallelism),
+    then the ring runs over ``cp``.  Output is partitioned like ``q``.
+    """
+    from dlrover_tpu.ops.attention import (
+        _attention_specs,
+        heads_to_seq_all_to_all,
+        seq_to_heads_all_to_all,
+    )
+
+    cp = mesh.shape.get("cp", 1)
+    sp = mesh.shape.get("sp", 1)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q_spec, kv_spec, seg_spec = _attention_specs(mesh, rules)
+    chunk = q.shape[1] // cp  # local seq after the sp gather
+    if use_pallas is None:
+        resolved_pallas = _pallas_ok(chunk, chunk, q.shape[-1])
+    else:
+        resolved_pallas = bool(use_pallas)
+
+    have_segs = segment_ids is not None
+
+    def inner(q, k, v, seg):
+        if sp > 1:
+            q = seq_to_heads_all_to_all(q)
+            k = seq_to_heads_all_to_all(k)
+            v = seq_to_heads_all_to_all(v)
+            if seg is not None:
+                seg = jax.lax.all_gather(seg, "sp", axis=1, tiled=True)
+        # kernel layout [b, heads, seq, d]
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        sg = seg[:, None, :].astype(jnp.int32) if seg is not None else None
+        o = _ring_local(
+            qt, kt, vt, sg, sg,
+            "cp", cp, causal, float(scale), resolved_pallas, interpret,
+        )
+        o = o.transpose(0, 2, 1, 3)
+        if sp > 1:
+            o = heads_to_seq_all_to_all(o)
+        return o
+
+    if not have_segs:
+        sm = jax.shard_map(
+            lambda q, k, v: inner(q, k, v, None),
+            mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec),
+            out_specs=q_spec,
+            check_vma=False,
+        )
+        return sm(q, k, v)
+    sm = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return sm(q, k, v, segment_ids)

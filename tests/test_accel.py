@@ -33,7 +33,7 @@ def test_mesh_spec_validation():
 
 def test_logical_to_spec_rules():
     spec = logical_to_spec(("batch", "seq", "act_embed"))
-    assert spec == jax.sharding.PartitionSpec(("dp", "fsdp"), "sp")
+    assert spec == jax.sharding.PartitionSpec(("dp", "fsdp"), ("cp", "sp"))
     # conflicting mesh axis: second user falls back to replication
     spec = logical_to_spec(("heads", "vocab"))
     assert spec == jax.sharding.PartitionSpec("tp")

@@ -1,0 +1,128 @@
+"""Ring (context-parallel) attention: numerics vs the single-device XLA
+reference, fwd + grads, on the virtual 8-device CPU mesh.
+
+Beyond-reference capability (the reference's SP is Ulysses all-to-all
+only — SURVEY.md §2.3); the correctness bar is exact agreement with
+:func:`dlrover_tpu.ops.attention._xla_attention` on identical inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.accel.parallel.mesh import MeshSpec
+from dlrover_tpu.ops.attention import _xla_attention, dot_product_attention
+from dlrover_tpu.ops.ring_attention import ring_attention
+
+
+def _mk_qkv(b=4, s=64, hq=4, hkv=4, d=8, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, hq, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), dtype)
+    return q, k, v
+
+
+def _mesh(**kw):
+    spec = MeshSpec.for_device_count(8, **kw)
+    return spec.build_mesh()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("cp,sp", [(2, 1), (4, 1), (2, 2)])
+def test_ring_matches_reference(causal, cp, sp):
+    q, k, v = _mk_qkv()
+    mesh = _mesh(cp=cp, sp=sp)
+    ref = _xla_attention(q, k, v, causal=causal, segment_ids=None, scale=None)
+    out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gqa():
+    q, k, v = _mk_qkv(hq=8, hkv=2)
+    mesh = _mesh(cp=2, sp=2)
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_segment_ids():
+    q, k, v = _mk_qkv(b=4, s=64)
+    segs = jnp.concatenate(
+        [jnp.zeros((4, 24), jnp.int32), jnp.ones((4, 40), jnp.int32)], axis=1
+    )
+    mesh = _mesh(cp=2)
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=segs, scale=None)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("cp,sp", [(2, 1), (2, 2)])
+def test_ring_gradients(cp, sp):
+    q, k, v = _mk_qkv(s=32)
+    mesh = _mesh(cp=cp, sp=sp)
+
+    def loss_ref(q, k, v):
+        o = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ring(q, k, v):
+        o = ring_attention(q, k, v, mesh=mesh, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ring_pallas_interpret_matches():
+    """The Pallas per-chunk path (interpret mode on CPU) agrees with the
+    XLA per-chunk path through the full ring."""
+    q, k, v = _mk_qkv(s=512, d=128, hq=2, hkv=2)
+    mesh = _mesh(cp=2)
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=None, scale=None)
+    out = ring_attention(
+        q, k, v, mesh=mesh, causal=True, use_pallas=True, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_dispatch_routes_cp_mesh():
+    """dot_product_attention under a cp>1 mesh context routes to the ring
+    and matches the no-mesh reference."""
+    q, k, v = _mk_qkv()
+    ref = dot_product_attention(q, k, v, causal=True)
+    mesh = _mesh(cp=2, sp=2)
+    with mesh:
+        out = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_accelerate_cp_mesh_end_to_end():
+    """Full train step on a cp=2 mesh: loss matches the cp=1 strategy."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=4, max_seq_len=64)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    losses = {}
+    for name, spec in {
+        "cp": MeshSpec.for_device_count(8, cp=2),
+        "plain": MeshSpec.for_device_count(8),
+    }.items():
+        res = accelerate(
+            LlamaModel(cfg),
+            config=AccelerateConfig(mesh_spec=spec),
+            batch_shape=(8, 64),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        _, metrics = res.train_step(state, {"input_ids": ids})
+        losses[name] = float(metrics["loss"])
+    assert np.isfinite(losses["cp"])
+    np.testing.assert_allclose(losses["cp"], losses["plain"], rtol=1e-4)
