@@ -1,0 +1,91 @@
+"""Perf sweep: train-step MFU across Llama shapes on one TPU chip.
+
+Produced the bench.py flagship config (see bench.py module note for the
+conclusions).  Usage: python benchmarks/shape_sweep.py [name ...]
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+from dlrover_tpu.accel.parallel.mesh import MeshSpec, mfu_denominator_flops
+from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def flops_per_token(cfg):
+    return 6.0 * cfg.num_params + 12 * cfg.num_layers * cfg.max_seq_len * cfg.hidden_size
+
+
+def run(name, cfg, batch, steps=10, warmup=3):
+    try:
+        model = LlamaModel(cfg)
+        res = accelerate(
+            model,
+            config=AccelerateConfig(mesh_spec=MeshSpec.for_device_count(len(jax.devices()))),
+            batch_shape=(batch, cfg.max_seq_len),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, cfg.max_seq_len), 0, cfg.vocab_size
+        ).astype(jnp.int32)
+        b = {"input_ids": ids}
+        for _ in range(warmup):
+            state, m = res.train_step(state, b)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = res.train_step(state, b)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+        toks = steps * batch * cfg.max_seq_len / dt
+        mfu = toks * flops_per_token(cfg) / mfu_denominator_flops(jax.devices()[0].device_kind)
+        print(json.dumps({
+            "name": name, "mfu": round(mfu, 4), "tok_s": round(toks, 0),
+            "params": cfg.num_params, "step_s": round(dt / steps, 4),
+        }), flush=True)
+    except Exception as e:
+        print(json.dumps({"name": name, "error": str(e)[:200]}), flush=True)
+
+
+BASE = dict(vocab_size=32000, num_kv_heads=8, scan_layers=True, remat=True,
+            remat_policy="dots_with_no_batch_dims_saveable")
+
+CONFIGS = {
+    "A_cur": (LlamaConfig(hidden_size=1024, intermediate_size=4096, num_layers=24,
+                          num_heads=8, max_seq_len=2048, **BASE), 4),
+    "B_h2048L6": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                              num_heads=16, max_seq_len=2048, **{**BASE, "num_kv_heads": 16}), 4),
+    "C_h2048L8b2": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=8,
+                                num_heads=16, max_seq_len=2048, **{**BASE, "num_kv_heads": 16}), 2),
+    "D_seq4096": (LlamaConfig(hidden_size=1024, intermediate_size=4096, num_layers=24,
+                              num_heads=8, max_seq_len=4096, **BASE), 2),
+    "E_h1536L12": (LlamaConfig(hidden_size=1536, intermediate_size=6144, num_layers=12,
+                               num_heads=12, max_seq_len=2048, **{**BASE, "num_kv_heads": 12}), 4),
+    "F_Bb8": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                          num_heads=16, max_seq_len=2048, **{**BASE, "num_kv_heads": 16}), 8),
+    "G_h2560L4": (LlamaConfig(hidden_size=2560, intermediate_size=10240, num_layers=4,
+                              num_heads=20, max_seq_len=2048, **{**BASE, "num_kv_heads": 20}), 4),
+    "H_Bseq4096": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                               num_heads=16, max_seq_len=4096, **{**BASE, "num_kv_heads": 16}), 2),
+    "I_h2048L6gqa": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                                 num_heads=16, max_seq_len=2048, **{**BASE, "num_kv_heads": 4}), 8),
+    "J_Fb16": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                           num_heads=16, max_seq_len=2048, **{**BASE, "num_kv_heads": 16}), 16),
+    "K_h4096L2": (LlamaConfig(hidden_size=4096, intermediate_size=16384, num_layers=2,
+                              num_heads=32, max_seq_len=2048, **{**BASE, "num_kv_heads": 32}), 4),
+    "L_h2560L5gqa": (LlamaConfig(hidden_size=2560, intermediate_size=10240, num_layers=5,
+                                 num_heads=20, max_seq_len=2048, **{**BASE, "num_kv_heads": 5}), 8),
+    "O_Iseq4096": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                                num_heads=16, max_seq_len=4096, **{**BASE, "num_kv_heads": 4}), 4),
+    "N_h4096L2gqa": (LlamaConfig(hidden_size=4096, intermediate_size=16384, num_layers=2,
+                                 num_heads=32, max_seq_len=2048, **{**BASE, "num_kv_heads": 8}), 8),
+}
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(CONFIGS)
+    for n in names:
+        cfg, batch = CONFIGS[n]
+        run(n, cfg, batch)

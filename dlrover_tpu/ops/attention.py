@@ -115,6 +115,11 @@ def dot_product_attention(
     q: [batch, q_seq, q_heads, head_dim]
     k, v: [batch, kv_seq, kv_heads, head_dim]
     """
+    if use_pallas is None:
+        import os
+
+        if os.getenv("DLROVER_DISABLE_PALLAS", "").lower() in ("1", "true", "yes"):
+            use_pallas = False
     if sp_ulysses is not False and not _under_named_axes():
         from dlrover_tpu.accel.parallel.mesh import ambient_mesh
 
@@ -178,11 +183,6 @@ def dot_product_attention(
             "sp_ulysses requested inside shard_map/pmap — the Ulysses "
             "dispatch only applies to global (unmapped) arrays"
         )
-    if use_pallas is None:
-        import os
-
-        if os.getenv("DLROVER_DISABLE_PALLAS", "").lower() in ("1", "true", "yes"):
-            use_pallas = False
     if use_pallas is None:
         # XLA's fused attention is competitive up to ~2k tokens; the pallas
         # kernel wins (and avoids O(s^2) memory) beyond that.  The gate must

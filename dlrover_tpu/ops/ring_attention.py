@@ -219,8 +219,7 @@ def _ring_fwd(
             jnp.full((b, h, 1, sq), _NEG_INF, jnp.float32),
         )
 
-    def body(t, carry):
-        o_acc, lse_acc, kc, vc, ksegc = carry
+    def merge(t, o_acc, lse_acc, kc, vc, ksegc):
         ki = (me + t) % cp
         if causal:
             branch = jnp.where(ki == me, 1, jnp.where(ki < me, 2, 0))
@@ -239,12 +238,16 @@ def _ring_fwd(
         # [b,h,1,sq] -> [b,h,sq,1] to broadcast over head_dim
         w_acc = jnp.exp(jnp.swapaxes(lse_acc - lse_new, 2, 3))
         w_b = jnp.exp(jnp.swapaxes(lse_b - lse_new, 2, 3))
-        o_acc = o_acc * w_acc + o_b.astype(jnp.float32) * w_b
+        return o_acc * w_acc + o_b.astype(jnp.float32) * w_b, lse_new
+
+    def body(t, carry):
+        o_acc, lse_acc, kc, vc, ksegc = carry
+        o_acc, lse_acc = merge(t, o_acc, lse_acc, kc, vc, ksegc)
         rot = (kc, vc, ksegc) if have_segs else (kc, vc)
         rot = _rotate(rot, axis_name, cp)
         kc, vc = rot[0], rot[1]
         ksegc = rot[2] if have_segs else ksegc
-        return o_acc, lse_new, kc, vc, ksegc
+        return o_acc, lse_acc, kc, vc, ksegc
 
     init = (
         jnp.zeros((b, h, sq, d), jnp.float32),
@@ -253,7 +256,10 @@ def _ring_fwd(
         v,
         k_seg if have_segs else jnp.zeros((b, 1, k.shape[2]), jnp.int32),
     )
-    o_acc, lse, *_ = jax.lax.fori_loop(0, cp, body, init)
+    # cp-1 compute+rotate steps, then the final chunk without the rotation
+    # (its K/V would be discarded — one ICI hop saved per call).
+    o_acc, lse, kc, vc, ksegc = jax.lax.fori_loop(0, cp - 1, body, init)
+    o_acc, lse = merge(cp - 1, o_acc, lse, kc, vc, ksegc)
     return o_acc.astype(q.dtype), lse
 
 
@@ -297,8 +303,7 @@ def _ring_bwd_rule(
             jnp.zeros(vc.shape, jnp.float32),
         )
 
-    def body(t, carry):
-        dq_acc, kc, vc, ksegc, dk_acc, dv_acc = carry
+    def accum(t, dq_acc, kc, vc, ksegc, dk_acc, dv_acc):
         ki = (me + t) % cp
         if causal:
             branch = jnp.where(ki == me, 1, jnp.where(ki < me, 2, 0))
@@ -313,9 +318,11 @@ def _ring_bwd_rule(
             )
         else:
             dq_b, dk_b, dv_b = block(kc, vc, ksegc, False)
-        dq_acc = dq_acc + dq_b
-        dk_acc = dk_acc + dk_b
-        dv_acc = dv_acc + dv_b
+        return dq_acc + dq_b, dk_acc + dk_b, dv_acc + dv_b
+
+    def body(t, carry):
+        dq_acc, kc, vc, ksegc, dk_acc, dv_acc = carry
+        dq_acc, dk_acc, dv_acc = accum(t, dq_acc, kc, vc, ksegc, dk_acc, dv_acc)
         # (dk, dv) travel WITH their chunk; after cp rotations they're home.
         rot = (kc, vc, dk_acc, dv_acc, ksegc) if have_segs else (
             kc, vc, dk_acc, dv_acc
@@ -333,7 +340,11 @@ def _ring_bwd_rule(
         jnp.zeros(k.shape, jnp.float32),
         jnp.zeros(v.shape, jnp.float32),
     )
-    dq, _, _, _, dk, dv = jax.lax.fori_loop(0, cp, body, init)
+    # cp-1 full steps; the final step computes, then rotates ONLY dk/dv
+    # (one more hop homes them; the K/V copies would be discarded).
+    dq, kc, vc, ksegc, dk, dv = jax.lax.fori_loop(0, cp - 1, body, init)
+    dq, dk, dv = accum(cp - 1, dq, kc, vc, ksegc, dk, dv)
+    dk, dv = _rotate((dk, dv), axis_name, cp)
     return (
         dq.astype(q.dtype),
         dk.astype(k.dtype),

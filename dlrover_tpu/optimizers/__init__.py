@@ -4,12 +4,21 @@
 - WSAM two-pass sharpness-aware step (wsam.py:11)
 - :func:`quantized_adamw` — int8 block-quantized moments (low_bit/optim/
   q_optimizer.py:17)
+- :func:`adafactor` / :func:`came` — factored second moments with optional
+  int8 first moment (low_bit/optim/q_adafactor.py:23, q_came.py:22)
 
 All are optax ``GradientTransformation``s / traceable step helpers, so they
 shard under GSPMD and compose with optax chains.
 """
 
 from dlrover_tpu.optimizers.agd import AGDState, agd
+from dlrover_tpu.optimizers.factored import (
+    AdafactorLeaf,
+    CameLeaf,
+    FactoredState,
+    adafactor,
+    came,
+)
 from dlrover_tpu.optimizers.low_bit import (
     QAdamState,
     QTensor,
@@ -28,6 +37,11 @@ from dlrover_tpu.optimizers.wsam import (
 __all__ = [
     "AGDState",
     "agd",
+    "AdafactorLeaf",
+    "CameLeaf",
+    "FactoredState",
+    "adafactor",
+    "came",
     "QAdamState",
     "QTensor",
     "quantize_blockwise",

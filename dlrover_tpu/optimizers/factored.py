@@ -1,0 +1,280 @@
+"""Memory-efficient factored optimizers: Adafactor and CAME, with optional
+int8 block-quantized first moments.
+
+Parity targets:
+- ``Q_Adafactor`` (reference: atorch/atorch/optimizers/low_bit/optim/
+  q_adafactor.py:23) — factored second moment (row/col means, O(n+m)
+  instead of O(nm)), update clipping, relative step sizes, optional
+  quantized first moment.
+- ``Q_CAME`` (reference: atorch/atorch/optimizers/low_bit/optim/
+  q_came.py:22) — CAME (confidence-guided adaptive memory-efficient
+  optimization): Adafactor-style factored second moment plus a factored
+  *instability* EMA ``res = (u - m)^2`` whose rsqrt re-scales the first
+  moment, and RMS update clipping.
+
+TPU-native design: one optax ``GradientTransformation`` per algorithm; the
+per-leaf state is a small NamedTuple pytree, the whole update is traceable
+and fuses under jit, and the only O(params) state (the first moment) can be
+stored as block-wise int8 (:class:`dlrover_tpu.optimizers.low_bit.QTensor`)
+— the reference needs CUDA quantization kernels for that, here XLA fuses
+the dequant -> update -> requant chain (low_bit.py module note).
+
+Factoring applies to leaves with ndim >= 2 (the last two dims are
+factored); 1-D leaves keep a full second moment, sqrt-companded int8 when
+large, matching the reference's ``factored = len(shape) >= 2`` gate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.optimizers.agd import ScalarOrSchedule, _lr_at
+from dlrover_tpu.optimizers.low_bit import QMoment, _load, _store
+
+
+class FactoredSecond(NamedTuple):
+    """Second-moment state for one leaf: (row, col) EMAs when factored,
+    else a full-size moment (int8-companded when large)."""
+
+    row: Optional[jax.Array]
+    col: Optional[jax.Array]
+    full: Optional[QMoment]
+
+
+class AdafactorLeaf(NamedTuple):
+    v: FactoredSecond
+    m: Optional[QMoment]  # None when beta1 is unused
+
+
+class CameLeaf(NamedTuple):
+    v: FactoredSecond
+    res: Optional[FactoredSecond]  # factored leaves only
+    m: QMoment
+
+
+class FactoredState(NamedTuple):
+    step: jax.Array
+    leaves: Any  # pytree of AdafactorLeaf / CameLeaf
+
+
+def _rms(x: jax.Array) -> jax.Array:
+    return jnp.sqrt(jnp.mean(jnp.square(x)))
+
+
+def _approx_rsqrt(row: jax.Array, col: jax.Array) -> jax.Array:
+    """rank-1 rsqrt approximation of the factored second moment
+    (reference: q_came.py ``_approx_sq_grad``): ``R^-1/2 ~ r x c`` with
+    the row factor normalized by its mean."""
+    r = jax.lax.rsqrt(row / jnp.mean(row, axis=-1, keepdims=True))
+    c = jax.lax.rsqrt(col)
+    return r[..., None] * c[..., None, :]
+
+
+def _factored(shape) -> bool:
+    return len(shape) >= 2
+
+
+def _init_second(p, block_size: int, min_size: int) -> FactoredSecond:
+    if _factored(p.shape):
+        return FactoredSecond(
+            row=jnp.zeros(p.shape[:-1], jnp.float32),
+            col=jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32),
+            full=None,
+        )
+    z = jnp.zeros(p.shape, jnp.float32)
+    return FactoredSecond(row=None, col=None, full=_store(z, block_size, min_size, True))
+
+
+def _update_second(
+    v: FactoredSecond, u2: jax.Array, beta2, block_size: int, min_size: int
+):
+    """EMA the second moment; returns (new_state, preconditioner rsqrt(V))."""
+    if v.row is not None:
+        row = beta2 * v.row + (1.0 - beta2) * jnp.mean(u2, axis=-1)
+        col = beta2 * v.col + (1.0 - beta2) * jnp.mean(u2, axis=-2)
+        return FactoredSecond(row=row, col=col, full=None), _approx_rsqrt(row, col)
+    full = beta2 * _load(v.full, True) + (1.0 - beta2) * u2
+    return (
+        FactoredSecond(row=None, col=None, full=_store(full, block_size, min_size, True)),
+        jax.lax.rsqrt(full),
+    )
+
+
+def adafactor(
+    learning_rate: Optional[ScalarOrSchedule] = None,
+    eps1: float = 1e-30,
+    eps2: float = 1e-3,
+    clip_threshold: float = 1.0,
+    decay_rate: float = -0.8,
+    beta1: Optional[float] = None,
+    weight_decay: float = 0.0,
+    scale_parameter: bool = True,
+    relative_step: bool = True,
+    quantize_moment: bool = False,
+    block_size: int = 256,
+    min_quant_size: int = 4096,
+) -> optax.GradientTransformation:
+    """Adafactor, optionally with an int8 first moment (``Q_Adafactor``
+    parity — reference q_adafactor.py:23, defaults matched).
+
+    With ``relative_step`` the step size is ``min(1e-2, 1/sqrt(t))``
+    (times ``max(eps2, rms(param))`` when ``scale_parameter``), so
+    ``learning_rate`` may be None exactly as in the reference.
+    """
+    if relative_step and learning_rate is not None:
+        raise ValueError(
+            "adafactor: learning_rate was given but relative_step=True "
+            "would ignore it — pass relative_step=False to use an external "
+            "learning rate"
+        )
+    if not relative_step and learning_rate is None:
+        raise ValueError(
+            "adafactor: relative_step=False requires a learning_rate"
+        )
+
+    def init_fn(params):
+        def leaf(p):
+            m = None
+            if beta1 is not None:
+                m = _store(
+                    jnp.zeros(p.shape, jnp.float32),
+                    block_size,
+                    min_quant_size if quantize_moment else 1 << 62,
+                    False,
+                )
+            return AdafactorLeaf(v=_init_second(p, block_size, min_quant_size), m=m)
+
+        leaves = jax.tree_util.tree_map(leaf, params)
+        return FactoredState(step=jnp.zeros((), jnp.int32), leaves=leaves)
+
+    def update_fn(grads, state: FactoredState, params=None):
+        if params is None:
+            raise ValueError("adafactor requires params")
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        beta2t = 1.0 - jnp.power(stepf, decay_rate)
+
+        def leaf(g, s: AdafactorLeaf, p):
+            g = g.astype(jnp.float32)
+            u2 = g * g + eps1
+            v_new, precond = _update_second(
+                s.v, u2, beta2t, block_size, min_quant_size
+            )
+            u = precond * g
+            u = u / jnp.maximum(1.0, _rms(u) / clip_threshold)
+            if relative_step:
+                lr_t = jnp.minimum(1e-2, jax.lax.rsqrt(stepf))
+            else:
+                lr_t = _lr_at(learning_rate, state.step)
+            if scale_parameter:
+                lr_t = lr_t * jnp.maximum(eps2, _rms(p.astype(jnp.float32)))
+            m_new = s.m
+            if s.m is not None:
+                m = beta1 * _load(s.m, False) + (1.0 - beta1) * u
+                u = m
+                m_new = _store(
+                    m,
+                    block_size,
+                    min_quant_size if quantize_moment else 1 << 62,
+                    False,
+                )
+            delta = -lr_t * (u + weight_decay * p.astype(jnp.float32))
+            return delta.astype(p.dtype), AdafactorLeaf(v=v_new, m=m_new)
+
+        # tree_map zips by grads' structure; flatten_up_to hands each leaf
+        # fn the whole AdafactorLeaf/CameLeaf subtree from state.leaves.
+        pairs = jax.tree_util.tree_map(leaf, grads, state.leaves, params)
+        is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(  # noqa: E731
+            x[1], AdafactorLeaf
+        )
+        updates = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+        leaves = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+        return updates, FactoredState(step=step, leaves=leaves)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def came(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    eps1: float = 1e-30,
+    eps2: float = 1e-16,
+    clip_threshold: float = 1.0,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    beta3: float = 0.9999,
+    weight_decay: float = 0.0,
+    quantize_moment: bool = False,
+    block_size: int = 256,
+    min_quant_size: int = 4096,
+) -> optax.GradientTransformation:
+    """CAME, optionally with an int8 first moment (``Q_CAME`` parity —
+    reference q_came.py:22, defaults matched).
+
+    Confidence-guided step: the factored EMA of the instability
+    ``(u - m)^2`` rescales the first moment, damping update directions the
+    moment disagrees with.
+    """
+    min_m = min_quant_size if quantize_moment else 1 << 62
+
+    def init_fn(params):
+        def leaf(p):
+            res = (
+                _init_second(p, block_size, min_quant_size)
+                if _factored(p.shape)
+                else None
+            )
+            return CameLeaf(
+                v=_init_second(p, block_size, min_quant_size),
+                res=res,
+                m=_store(jnp.zeros(p.shape, jnp.float32), block_size, min_m, False),
+            )
+
+        return FactoredState(
+            step=jnp.zeros((), jnp.int32),
+            leaves=jax.tree_util.tree_map(leaf, params),
+        )
+
+    def update_fn(grads, state: FactoredState, params=None):
+        if params is None:
+            raise ValueError("came requires params")
+        step = state.step + 1
+        lr_t = _lr_at(learning_rate, state.step)
+
+        def leaf(g, s: CameLeaf, p):
+            g = g.astype(jnp.float32)
+            u2 = g * g + eps1
+            v_new, precond = _update_second(
+                s.v, u2, beta2, block_size, min_quant_size
+            )
+            u = precond * g
+            u = u / jnp.maximum(1.0, _rms(u) / clip_threshold)
+            m = beta1 * _load(s.m, False) + (1.0 - beta1) * u
+            res_new = s.res
+            if s.res is not None:
+                res = jnp.square(u - m) + eps2
+                res_new, res_precond = _update_second(
+                    s.res, res, beta3, block_size, min_quant_size
+                )
+                upd = res_precond * m
+            else:
+                upd = m
+            delta = -lr_t * (upd + weight_decay * p.astype(jnp.float32))
+            return delta.astype(p.dtype), CameLeaf(
+                v=v_new, res=res_new, m=_store(m, block_size, min_m, False)
+            )
+
+        # tree_map zips by grads' structure; flatten_up_to hands each leaf
+        # fn the whole AdafactorLeaf/CameLeaf subtree from state.leaves.
+        pairs = jax.tree_util.tree_map(leaf, grads, state.leaves, params)
+        is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(  # noqa: E731
+            x[1], CameLeaf
+        )
+        updates = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+        leaves = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+        return updates, FactoredState(step=step, leaves=leaves)
+
+    return optax.GradientTransformation(init_fn, update_fn)
