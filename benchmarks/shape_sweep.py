@@ -15,8 +15,12 @@ from dlrover_tpu.accel.parallel.mesh import MeshSpec, mfu_denominator_flops
 from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
 
 
+import bench
+
+
 def flops_per_token(cfg):
-    return 6.0 * cfg.num_params + 12 * cfg.num_layers * cfg.max_seq_len * cfg.hidden_size
+    # single source of truth with the headline benchmark
+    return bench._model_flops_per_token(cfg)
 
 
 def run(name, cfg, batch, steps=10, warmup=3):

@@ -503,6 +503,32 @@ int64_t apply_impl(Table* t, const int64_t* ids, const float* grads,
   return applied.load();
 }
 
+// apply_impl with a second per-row input (e.g. Hutchinson hessian-diagonal
+// estimates for the AdaHessian family).
+template <typename Fn>
+int64_t apply_impl2(Table* t, const int64_t* ids, const float* grads,
+                    const float* aux, int64_t n, Fn update) {
+  uint32_t dim = t->dim;
+  uint64_t ver = t->version.fetch_add(1) + 1;
+  std::atomic<int64_t> applied{0};
+  for_stripes(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Stripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    int64_t local = 0;
+    for (int64_t p : pos) {
+      auto it = st.index.find(ids[p]);
+      if (it == st.index.end() || !st.meta[it->second].live) continue;
+      float* row = st.row_ptr(st.meta[it->second].row, t->stride);
+      update(row, row + dim, grads + static_cast<size_t>(p) * dim,
+             aux + static_cast<size_t>(p) * dim);
+      st.meta[it->second].version = ver;
+      ++local;
+    }
+    applied += local;
+  });
+  return applied.load();
+}
+
 }  // namespace
 
 extern "C" {
@@ -727,6 +753,148 @@ int64_t kv_apply_group_adam(void* h, const int64_t* ids, const float* grads,
                         for (uint32_t d = 0; d < dim; ++d) w[d] *= shrink;
                       }
                     });
+}
+
+// slots: [m, v] — AdaHessian (Yao et al. 2021): second moment from the
+// Hutchinson hessian-diagonal estimate instead of g^2 (reference:
+// tfplus kernels/training_ops.cc ApplyAdaHessian functor /
+// KvVariableGroupSparseApplyAdaHessian op).
+int64_t kv_apply_adahessian(void* h, const int64_t* ids, const float* grads,
+                            const float* hessians, int64_t n, float lr,
+                            float beta1, float beta2, float eps,
+                            int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  float alpha = static_cast<float>(lr * std::sqrt(bc2) / bc1);
+  return apply_impl2(
+      t, ids, grads, hessians, n,
+      [&](float* w, float* slots, const float* g, const float* hs) {
+        float* m = slots;
+        float* v = slots + dim;
+        for (uint32_t d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1 - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1 - beta2) * hs[d] * hs[d];
+          w[d] -= alpha * m[d] / (std::sqrt(v[d]) + eps) +
+                  lr * weight_decay * w[d];
+        }
+      });
+}
+
+// slots: [m, v] — LAMB with AdaHessian second moment and per-row trust
+// ratio (reference: training_ops.cc ApplyLambHessian functor: ratio =
+// |w| / (|r| + 1e-8) with r = m*adjust/(sqrt(v)+eps)).
+int64_t kv_apply_lamb_hessian(void* h, const int64_t* ids, const float* grads,
+                              const float* hessians, int64_t n, float lr,
+                              float beta1, float beta2, float eps,
+                              int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double bc1 = 1.0 - std::pow(beta1, static_cast<double>(t_step));
+  double bc2 = 1.0 - std::pow(beta2, static_cast<double>(t_step));
+  float adjust = static_cast<float>(std::sqrt(bc2) / bc1);
+  return apply_impl2(
+      t, ids, grads, hessians, n,
+      [&](float* w, float* slots, const float* g, const float* hs) {
+        float* m = slots;
+        float* v = slots + dim;
+        float r_norm = 0, w_norm = 0;
+        for (uint32_t d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1 - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1 - beta2) * hs[d] * hs[d];
+          float r = m[d] * adjust / (std::sqrt(v[d]) + eps) +
+                    weight_decay * w[d];
+          r_norm += r * r;
+          w_norm += w[d] * w[d];
+        }
+        r_norm = std::sqrt(r_norm);
+        w_norm = std::sqrt(w_norm);
+        float ratio = (r_norm > 0 && w_norm > 0)
+                          ? w_norm / (r_norm + 1e-8f) : 1.0f;
+        for (uint32_t d = 0; d < dim; ++d) {
+          float r = m[d] * adjust / (std::sqrt(v[d]) + eps) +
+                    weight_decay * w[d];
+          w[d] -= lr * ratio * r;
+        }
+      });
+}
+
+// slots: [m, v] — RAdam (Liu et al. 2020): variance-rectified Adam.  The
+// rectification r_t depends only on t, computed once per call (reference:
+// training_ops.cc KvVariableGroupSparseApplyRectifiedAdam; here without
+// the group-lasso linear/prox machinery — kv_apply_group_adam covers the
+// l21 path).
+int64_t kv_apply_radam(void* h, const int64_t* ids, const float* grads,
+                       int64_t n, float lr, float beta1, float beta2,
+                       float eps, int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double tstep = static_cast<double>(t_step);
+  double b2t = std::pow(beta2, tstep);
+  double bc1 = 1.0 - std::pow(beta1, tstep);
+  double bc2 = 1.0 - b2t;
+  double rho_inf = 2.0 / (1.0 - beta2) - 1.0;
+  double rho_t = rho_inf - 2.0 * tstep * b2t / bc2;
+  bool tractable = rho_t > 4.0;
+  float r_t = 1.0f;
+  if (tractable) {
+    r_t = static_cast<float>(
+        std::sqrt(((rho_t - 4.0) * (rho_t - 2.0) * rho_inf) /
+                  ((rho_inf - 4.0) * (rho_inf - 2.0) * rho_t)));
+  }
+  return apply_impl(
+      t, ids, grads, n, [&](float* w, float* slots, const float* g) {
+        float* m = slots;
+        float* v = slots + dim;
+        for (uint32_t d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1 - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1 - beta2) * g[d] * g[d];
+          float mhat = m[d] / static_cast<float>(bc1);
+          if (tractable) {
+            float vhat = std::sqrt(v[d] / static_cast<float>(bc2));
+            w[d] -= lr * r_t * mhat / (vhat + eps) +
+                    lr * weight_decay * w[d];
+          } else {
+            // variance intractable: SGD-with-momentum
+            w[d] -= lr * mhat + lr * weight_decay * w[d];
+          }
+        }
+      });
+}
+
+// slots: [m, v] — AdaDQH: quasi-hessian from the difference of successive
+// bias-corrected first moments (reference: training_ops.cc ApplyAdaDQH
+// functor: h = m_new/(1-b1^t) - m_old/(1-b1^(t-1)); v EMA of h^2;
+// denominator max(sqrt(v), eps*sqrt(1-b2^t))).
+int64_t kv_apply_adadqh(void* h, const int64_t* ids, const float* grads,
+                        int64_t n, float lr, float beta1, float beta2,
+                        float eps, int64_t t_step, float weight_decay) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  double tstep = static_cast<double>(t_step);
+  double b1t = std::pow(beta1, tstep);
+  double bc1 = 1.0 - b1t;
+  double bc2 = 1.0 - std::pow(beta2, tstep);
+  float alpha = static_cast<float>(lr * std::sqrt(bc2) / bc1);
+  // previous-step bias correction 1 - b1^(t-1); 1 at t=1 (m was zero)
+  float beta_prev =
+      (beta1 > b1t) ? static_cast<float>(1.0 - b1t / beta1) : 1.0f;
+  float vmin = static_cast<float>(eps * std::sqrt(bc2));
+  return apply_impl(
+      t, ids, grads, n, [&](float* w, float* slots, const float* g) {
+        float* m = slots;
+        float* v = slots + dim;
+        for (uint32_t d = 0; d < dim; ++d) {
+          float m_old = m[d] / beta_prev;
+          float m_new = beta1 * m[d] + (1 - beta1) * g[d];
+          float hq = m_new / static_cast<float>(bc1) - m_old;
+          v[d] = beta2 * v[d] + (1 - beta2) * hq * hq;
+          w[d] -= alpha * m_new / std::max(std::sqrt(v[d]), vmin) +
+                  lr * weight_decay * w[d];
+          m[d] = m_new;
+        }
+      });
 }
 
 // ---------------------------------------------------------------------------

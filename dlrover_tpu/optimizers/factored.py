@@ -104,6 +104,16 @@ def _update_second(
     )
 
 
+def _split_pairs(pairs, leaf_cls):
+    """Split a tree of (update, new_leaf_state) pairs into two trees."""
+    is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(  # noqa: E731
+        x[1], leaf_cls
+    )
+    updates = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+    leaves = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+    return updates, leaves
+
+
 def adafactor(
     learning_rate: Optional[ScalarOrSchedule] = None,
     eps1: float = 1e-30,
@@ -188,11 +198,7 @@ def adafactor(
         # tree_map zips by grads' structure; flatten_up_to hands each leaf
         # fn the whole AdafactorLeaf/CameLeaf subtree from state.leaves.
         pairs = jax.tree_util.tree_map(leaf, grads, state.leaves, params)
-        is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(  # noqa: E731
-            x[1], AdafactorLeaf
-        )
-        updates = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
-        leaves = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+        updates, leaves = _split_pairs(pairs, AdafactorLeaf)
         return updates, FactoredState(step=step, leaves=leaves)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -270,11 +276,7 @@ def came(
         # tree_map zips by grads' structure; flatten_up_to hands each leaf
         # fn the whole AdafactorLeaf/CameLeaf subtree from state.leaves.
         pairs = jax.tree_util.tree_map(leaf, grads, state.leaves, params)
-        is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(  # noqa: E731
-            x[1], CameLeaf
-        )
-        updates = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
-        leaves = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+        updates, leaves = _split_pairs(pairs, CameLeaf)
         return updates, FactoredState(step=step, leaves=leaves)
 
     return optax.GradientTransformation(init_fn, update_fn)

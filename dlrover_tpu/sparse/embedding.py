@@ -74,14 +74,17 @@ class KvEmbedding:
             self._pending = (uniq, len(uniq))
         return jnp.asarray(slab), jnp.asarray(inverse)
 
-    def apply_slab_grad(self, slab_grad: Any) -> int:
+    def apply_slab_grad(
+        self, slab_grad: Any, slab_hessian: Any = None
+    ) -> int:
         assert self._pending is not None, "no pending lookup"
         uniq, n = self._pending
         self._pending = None
         if n == 0:
             return 0
         g = np.asarray(slab_grad)[:n]
-        return self.var.apply_gradients(uniq, g)
+        hs = None if slab_hessian is None else np.asarray(slab_hessian)[:n]
+        return self.var.apply_gradients(uniq, g, hessians=hs)
 
 
 class SparseTrainStep:
@@ -99,6 +102,17 @@ class SparseTrainStep:
         embeddings: Dict[str, KvEmbedding],
         dense_update: Optional[Callable] = None,
     ):
+        from dlrover_tpu.sparse.kv_variable import HESSIAN_OPTIMIZERS
+
+        for name, emb in embeddings.items():
+            if emb.var.optimizer in HESSIAN_OPTIMIZERS:
+                raise ValueError(
+                    f"embedding {name!r} uses {emb.var.optimizer}, which "
+                    "needs Hutchinson hessian estimates SparseTrainStep "
+                    "does not compute — drive KvEmbedding.apply_slab_grad "
+                    "(slab_hessian=...) directly, or pick a first-order "
+                    "sparse optimizer"
+                )
         self.embeddings = embeddings
         self._dense_update = dense_update
         self._loss_fn = loss_fn

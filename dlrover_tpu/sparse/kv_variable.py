@@ -36,7 +36,17 @@ OPTIMIZER_SLOTS = {
     "adadelta": 2,
     "lamb": 2,
     "amsgrad": 3,
+    # hessian / rectified families (reference training_ops.cc: AdaHessian,
+    # LambHessian, RectifiedAdam, AdaDQH kernels)
+    "adahessian": 2,
+    "lamb_hessian": 2,
+    "radam": 2,
+    "adadqh": 2,
 }
+
+# optimizers whose apply_gradients step consumes a Hutchinson
+# hessian-diagonal estimate alongside the gradient
+HESSIAN_OPTIMIZERS = frozenset({"adahessian", "lamb_hessian"})
 
 
 def _days_now() -> int:
@@ -168,19 +178,45 @@ class KvVariable:
             native.as_ptr(updates, ctypes.c_float), len(ids), ops[op]))
 
     # -- training ---------------------------------------------------------
-    def apply_gradients(self, ids: np.ndarray, grads: np.ndarray) -> int:
+    def apply_gradients(
+        self,
+        ids: np.ndarray,
+        grads: np.ndarray,
+        hessians: Optional[np.ndarray] = None,
+    ) -> int:
         """One sparse optimizer step for unique ``ids`` with per-row
         ``grads`` [n, dim].  Rows absent or unadmitted are skipped (their
-        forward value was zeros).  Returns rows updated."""
+        forward value was zeros).  Returns rows updated.
+
+        The hessian-family optimizers (:data:`HESSIAN_OPTIMIZERS`) consume
+        ``hessians`` — per-row Hutchinson hessian-diagonal estimates of the
+        same shape as ``grads`` (reference: tfplus AdaHessian ops take a
+        ``hessian`` input tensor).
+        """
         ids = np.ascontiguousarray(ids, dtype=np.int64)
         grads = np.ascontiguousarray(grads, dtype=np.float32)
         assert grads.shape == (len(ids), self.dim), grads.shape
         n = len(ids)
         o = self.opt
+        if o.name in HESSIAN_OPTIMIZERS and hessians is None:
+            raise ValueError(
+                f"{o.name} requires hessians (Hutchinson diagonal "
+                "estimates) alongside grads"
+            )
+        if o.name not in HESSIAN_OPTIMIZERS and hessians is not None:
+            raise ValueError(f"{o.name} does not take hessians")
         self._step += 1
         lib, h = self._lib, self._handle
         idp = native.as_ptr(ids, ctypes.c_int64)
         gp = native.as_ptr(grads, ctypes.c_float)
+        if o.name in HESSIAN_OPTIMIZERS:
+            hessians = np.ascontiguousarray(hessians, dtype=np.float32)
+            assert hessians.shape == grads.shape, hessians.shape
+            hp = native.as_ptr(hessians, ctypes.c_float)
+            fn = (lib.kv_apply_adahessian if o.name == "adahessian"
+                  else lib.kv_apply_lamb_hessian)
+            return int(fn(h, idp, gp, hp, n, o.learning_rate, o.beta1,
+                          o.beta2, o.eps, self._step, o.weight_decay))
         if o.name == "sgd":
             # plain scatter-sub of lr*g — no slots
             return self.scatter(ids, o.learning_rate * grads, op="sub")
@@ -217,6 +253,14 @@ class KvVariable:
             return int(lib.kv_apply_lamb(h, idp, gp, n, o.learning_rate,
                                          o.beta1, o.beta2, o.eps, self._step,
                                          o.weight_decay))
+        if o.name == "radam":
+            return int(lib.kv_apply_radam(h, idp, gp, n, o.learning_rate,
+                                          o.beta1, o.beta2, o.eps,
+                                          self._step, o.weight_decay))
+        if o.name == "adadqh":
+            return int(lib.kv_apply_adadqh(h, idp, gp, n, o.learning_rate,
+                                           o.beta1, o.beta2, o.eps,
+                                           self._step, o.weight_decay))
         raise AssertionError(o.name)
 
     # -- eviction / hybrid storage ---------------------------------------

@@ -120,6 +120,22 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kv_apply_lamb.argtypes = [
         c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
         c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_adahessian.restype = c.c_int64
+    lib.kv_apply_adahessian.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), p(c.c_float), c.c_int64,
+        c.c_float, c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_lamb_hessian.restype = c.c_int64
+    lib.kv_apply_lamb_hessian.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), p(c.c_float), c.c_int64,
+        c.c_float, c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_radam.restype = c.c_int64
+    lib.kv_apply_radam.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_adadqh.restype = c.c_int64
+    lib.kv_apply_adadqh.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
     lib.kv_evict.restype = c.c_int64
     lib.kv_evict.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
     lib.kv_secondary_open.restype = c.c_int
