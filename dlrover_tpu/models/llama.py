@@ -66,6 +66,18 @@ class LlamaConfig:
     # output-logit multiplier; muP sets this to base_width/width so the
     # logit scale is width-invariant (dlrover_tpu.accel.mup)
     logit_scale: float = 1.0
+    # fp8 matmuls (e4m3 operands / e5m2 grads, current scaling) in every
+    # projection — the reference's TransformerEngine fp8 AMP equivalent
+    # (dlrover_tpu.ops.fp8; reference amp_optimization.py:377)
+    fp8: bool = False
+
+    @property
+    def dot_general(self):
+        if self.fp8:
+            from dlrover_tpu.ops.fp8 import fp8_dot_general
+
+            return fp8_dot_general
+        return jax.lax.dot_general
 
     @property
     def head_dim_(self) -> int:
@@ -175,6 +187,7 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
+            dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("embed", "heads", "head_dim")
             ),
@@ -183,7 +196,7 @@ class Attention(nn.Module):
         kv_features = (cfg.num_kv_heads, d)
         k_proj = nn.DenseGeneral(
             kv_features, axis=-1, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
+            param_dtype=cfg.param_dtype, dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("embed", "kv_heads", "head_dim")
             ),
@@ -191,7 +204,7 @@ class Attention(nn.Module):
         )
         v_proj = nn.DenseGeneral(
             kv_features, axis=-1, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
+            param_dtype=cfg.param_dtype, dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("embed", "kv_heads", "head_dim")
             ),
@@ -203,6 +216,7 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
+            dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("heads", "head_dim", "embed")
             ),
@@ -281,7 +295,10 @@ class MLP(nn.Module):
         dense = lambda feat, axes, name: nn.DenseGeneral(  # noqa: E731
             feat, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(init, axes), name=name,
+            dot_general=cfg.dot_general,
         )
+        # (the lm_head stays bf16 — the last projection is the standard
+        # fp8-recipe exclusion: logit quantization hurts loss directly)
         gate = dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj")(x)
         up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
         h = nn.silu(gate) * up
@@ -326,6 +343,7 @@ class DecoderLayer(nn.Module):
                 z_loss_coef=cfg.moe_z_loss_coef,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
+                fp8=cfg.fp8,
                 name="mlp",
             )
         else:

@@ -107,6 +107,9 @@ class MoEMLP(nn.Module):
     z_loss_coef: float = 1e-3
     dtype: type = jnp.bfloat16
     param_dtype: type = jnp.float32
+    # fp8 expert GEMMs (the model's FLOPs majority); the router stays
+    # f32 — routing decisions are the standard fp8-recipe exclusion
+    fp8: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -163,12 +166,20 @@ class MoEMLP(nn.Module):
         wg = w_gate.astype(self.dtype)
         wu = w_up.astype(self.dtype)
         wd = w_down.astype(self.dtype)
+        if self.fp8:
+            from dlrover_tpu.ops.fp8 import fake_quant_fp8, grad_quant_fp8
+        else:
+            fake_quant_fp8 = grad_quant_fp8 = lambda x: x  # noqa: E731
         # grouped GEMM over the expert dim (reference grouped_gemm_moe.py)
-        gate = jnp.einsum("becm,emh->bech", expert_in, wg)
-        up = jnp.einsum("becm,emh->bech", expert_in, wu)
+        xq = fake_quant_fp8(expert_in)
+        gate = grad_quant_fp8(jnp.einsum("becm,emh->bech", xq,
+                                         fake_quant_fp8(wg)))
+        up = grad_quant_fp8(jnp.einsum("becm,emh->bech", xq,
+                                       fake_quant_fp8(wu)))
         act = nn.silu(gate) * up
         act = with_logical_constraint(act, ("batch", "expert", None, "mlp"))
-        out = jnp.einsum("bech,ehm->becm", act, wd)
+        out = grad_quant_fp8(jnp.einsum("bech,ehm->becm", fake_quant_fp8(act),
+                                        fake_quant_fp8(wd)))
         # combine: expert->token all-to-all back
         y = jnp.einsum("bsec,becm->bsm", combine, out)
         return with_logical_constraint(y, ("batch", "seq", "act_embed"))

@@ -1,0 +1,120 @@
+"""FP8 training path (reference amp fp8 via TransformerEngine,
+amp_optimization.py:377): fake-quant dot_general with e4m3 forward /
+e5m2 gradient quantization and current scaling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.fp8 import (
+    E4M3_MAX,
+    fake_quant_fp8,
+    fp8_dot_general,
+    quantize_dequantize,
+)
+
+
+def test_quantize_roundtrip_error_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (128, 64), jnp.float32)
+    y = quantize_dequantize(x, jnp.float8_e4m3fn, E4M3_MAX)
+    # e4m3 has 3 mantissa bits: relative error ~2^-4 of amax-scaled values
+    err = jnp.max(jnp.abs(x - y))
+    assert float(err) < float(jnp.max(jnp.abs(x))) * 0.07
+    # actually quantized: far fewer distinct values than input
+    assert len(np.unique(np.asarray(y))) < len(np.unique(np.asarray(x)))
+
+
+def test_zero_tensor_safe():
+    z = jnp.zeros((8, 8))
+    out = quantize_dequantize(z, jnp.float8_e4m3fn, E4M3_MAX)
+    assert not np.any(np.isnan(np.asarray(out)))
+    g = jax.grad(lambda x: jnp.sum(fake_quant_fp8(x)))(z)
+    assert not np.any(np.isnan(np.asarray(g)))
+
+
+def test_fp8_dot_close_to_exact():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (32, 64), jnp.float32)
+    w = jax.random.normal(k2, (64, 16), jnp.float32)
+    exact = x @ w
+    dn = (((1,), (0,)), ((), ()))
+    q = fp8_dot_general(x, w, dn)
+    rel = jnp.linalg.norm(q - exact) / jnp.linalg.norm(exact)
+    assert float(rel) < 0.05, float(rel)
+
+
+def test_fp8_gradients_flow_and_are_close():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(k1, (16, 32), jnp.float32)
+    w = jax.random.normal(k2, (32, 8), jnp.float32)
+    dn = (((1,), (0,)), ((), ()))
+
+    def loss_q(w):
+        return jnp.sum(jnp.tanh(fp8_dot_general(x, w, dn)))
+
+    def loss_e(w):
+        return jnp.sum(jnp.tanh(jax.lax.dot_general(x, w, dn)))
+
+    gq = jax.grad(loss_q)(w)
+    ge = jax.grad(loss_e)(w)
+    rel = jnp.linalg.norm(gq - ge) / jnp.linalg.norm(ge)
+    assert float(rel) < 0.25, float(rel)
+
+
+@pytest.mark.parametrize("scan", [False, True], ids=["layers", "scan"])
+def test_llama_fp8_trains(scan):
+    """LlamaConfig(fp8=True) trains end-to-end; loss stays in the same
+    regime as bf16 for the first steps."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.accel.parallel.mesh import MeshSpec
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, 256
+    ).astype(jnp.int32)
+    losses = {}
+    for mode in ("fp8", "bf16"):
+        cfg = LlamaConfig.tiny(max_seq_len=64, fp8=(mode == "fp8"),
+                               scan_layers=scan)
+        res = accelerate(
+            LlamaModel(cfg),
+            config=AccelerateConfig(mesh_spec=MeshSpec.for_device_count(8)),
+            batch_shape=(8, 64),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        for _ in range(3):
+            state, metrics = res.train_step(state, {"input_ids": ids})
+        losses[mode] = float(metrics["loss"])
+    assert np.isfinite(losses["fp8"])
+    assert abs(losses["fp8"] - losses["bf16"]) < 0.2, losses
+
+
+def test_moe_fp8_trains():
+    """fp8=True quantizes MoE expert GEMMs too (not a silent no-op)."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.accel.parallel.mesh import MeshSpec
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, 256
+    ).astype(jnp.int32)
+    losses = {}
+    for mode in ("fp8", "bf16"):
+        cfg = LlamaConfig.tiny(max_seq_len=64, num_experts=4,
+                               fp8=(mode == "fp8"))
+        res = accelerate(
+            LlamaModel(cfg),
+            config=AccelerateConfig(
+                mesh_spec=MeshSpec.for_device_count(8, ep=2, fsdp=4)
+            ),
+            batch_shape=(8, 64),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        for _ in range(2):
+            state, metrics = res.train_step(state, {"input_ids": ids})
+        losses[mode] = float(metrics["loss"])
+    assert np.isfinite(losses["fp8"])
+    assert abs(losses["fp8"] - losses["bf16"]) < 0.3, losses
+    # fp8 must actually change the numerics (quantization is engaged)
+    assert losses["fp8"] != losses["bf16"], losses
