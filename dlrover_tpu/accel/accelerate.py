@@ -321,10 +321,38 @@ def accelerate(
     jit_init = jax.jit(
         lambda rng: nn.unbox(init_state(rng)), out_shardings=state_sharding
     )
+    # init from existing (e.g. HF-converted or checkpoint) params: same
+    # TrainState/sharding, params substituted instead of random-initialized
+    jit_init_from = jax.jit(
+        lambda p: nn.unbox(
+            TrainState.create(apply_fn=model.apply, params=p, tx=optimizer)
+        ),
+        out_shardings=state_sharding,
+    )
 
-    def init_fn(rng: jax.Array) -> TrainState:
+    def init_fn(rng: jax.Array, params=None) -> TrainState:
         with rules_ctx(), mesh:
-            return jit_init(rng)
+            if params is None:
+                return jit_init(rng)
+            # Cast on host and device_put each leaf with its param
+            # sharding so only the local shard lands on each device —
+            # a full-model jnp.asarray would OOM one chip for models
+            # whose sharded state fits.
+            import numpy as np
+
+            target = nn.unbox(abstract_state).params
+
+            def put(x, t, s):
+                if not isinstance(x, jax.Array):
+                    x = np.asarray(x, t.dtype)
+                elif x.dtype != t.dtype:
+                    x = x.astype(t.dtype)
+                return jax.device_put(x, s)
+
+            placed = jax.tree_util.tree_map(
+                put, params, target, param_sharding
+            )
+            return jit_init_from(placed)
 
     # ---------------- train step ----------------
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):

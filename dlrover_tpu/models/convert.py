@@ -1,0 +1,197 @@
+"""HF Llama checkpoint interop: import transformers weights into the
+TPU-native model.
+
+The migration path for users of the reference framework: the reference
+trains HF ``LlamaForCausalLM`` modules (reference:
+atorch/examples/llama2/README.md, modules/transformer/layers.py HF
+fast-path replacements); here the same checkpoints load into
+:class:`dlrover_tpu.models.llama.LlamaModel` — torch ``state_dict`` or
+``transformers`` model in, flax param pytree out (scan-stacked when
+``cfg.scan_layers``), with logits parity against the HF forward verified
+in tests/test_convert.py.
+
+Rotary convention note: HF's ``rotate_half`` ([x1, x2] -> [x1 cos - x2
+sin, x2 cos + x1 sin] with half-split, not interleaved, frequencies) is
+exactly this model's :func:`apply_rope`, so weights map without any
+permutation of head dims.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+from dlrover_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` to :class:`LlamaConfig`."""
+    get = lambda k, d=None: getattr(hf_config, k, d)  # noqa: E731
+    # Refuse configs the flax model cannot represent — silent conversion
+    # would break the logits-parity promise.
+    scaling = get("rope_scaling")
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported by LlamaModel's "
+            "plain-theta RoPE; conversion would silently change numerics"
+        )
+    if get("attention_bias", False) or get("mlp_bias", False):
+        raise ValueError(
+            "attention_bias/mlp_bias checkpoints are unsupported (the "
+            "flax projections are bias-free); bias tensors would be "
+            "silently dropped"
+        )
+    explicit_head_dim = get("head_dim")
+    if explicit_head_dim and explicit_head_dim * get(
+        "num_attention_heads"
+    ) != get("hidden_size"):
+        raise ValueError(
+            f"head_dim={explicit_head_dim} with num_heads*head_dim != "
+            "hidden_size is unsupported"
+        )
+    kw: Dict[str, Any] = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads", get("num_attention_heads")),
+        max_seq_len=get("max_position_embeddings", 4096),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        rms_norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / numpy array -> float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _layer_params(sd: Mapping[str, Any], i: int, cfg: LlamaConfig) -> Dict:
+    h, d = cfg.hidden_size, cfg.head_dim_
+    nh, nkv = cfg.num_heads, cfg.num_kv_heads
+    pre = f"model.layers.{i}."
+
+    def w(name):
+        return _np(sd[pre + name + ".weight"])
+
+    # torch Linear stores [out, in]; flax kernels are [in, ...out].
+    return {
+        "attn": {
+            "q_proj": {"kernel": w("self_attn.q_proj").T.reshape(h, nh, d)},
+            "k_proj": {"kernel": w("self_attn.k_proj").T.reshape(h, nkv, d)},
+            "v_proj": {"kernel": w("self_attn.v_proj").T.reshape(h, nkv, d)},
+            "o_proj": {"kernel": w("self_attn.o_proj").T.reshape(nh, d, h)},
+        },
+        "mlp": {
+            "gate_proj": {"kernel": w("mlp.gate_proj").T},
+            "up_proj": {"kernel": w("mlp.up_proj").T},
+            "down_proj": {"kernel": w("mlp.down_proj").T},
+        },
+        "input_norm": {"scale": _np(sd[pre + "input_layernorm.weight"])},
+        "post_norm": {
+            "scale": _np(sd[pre + "post_attention_layernorm.weight"])
+        },
+    }
+
+
+def params_from_hf(sd: Mapping[str, Any], cfg: LlamaConfig) -> Dict:
+    """Convert an HF Llama ``state_dict`` to this model's param pytree.
+
+    Handles the ``scan_layers`` layout (per-layer trees stacked on a
+    leading axis) and tied embeddings.  All arrays come out float32 —
+    cast afterwards if you want bf16 params.
+    """
+    layers = [_layer_params(sd, i, cfg) for i in range(cfg.num_layers)]
+    params: Dict[str, Any] = {
+        "embed_tokens": {"embedding": _np(sd["model.embed_tokens.weight"])},
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+    }
+    if cfg.scan_layers:
+        import jax
+
+        params["layers"] = {
+            "layer": jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs, axis=0), *layers
+            )
+        }
+    else:
+        for i, lp in enumerate(layers):
+            params[f"layer_{i}"] = lp
+    if not cfg.tie_embeddings:
+        key = "lm_head.weight"
+        # tied-weight checkpoints may omit lm_head; fall back to embed
+        lm = _np(sd[key]) if key in sd else params["embed_tokens"]["embedding"]
+        params["lm_head"] = {"kernel": lm.T}
+    return params
+
+
+def load_hf_llama(
+    model_or_path: Any, **config_overrides
+) -> Tuple[LlamaConfig, Dict]:
+    """One-call import: a ``transformers`` Llama model instance or a
+    pretrained path/name -> (LlamaConfig, flax params)."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    cfg = config_from_hf(model.config, **config_overrides)
+    return cfg, params_from_hf(model.state_dict(), cfg)
+
+
+def params_to_hf(params: Mapping[str, Any], cfg: LlamaConfig) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`params_from_hf`: export this model's params as an
+    HF Llama ``state_dict`` (numpy float32) for serving/interop."""
+    h, d = cfg.hidden_size, cfg.head_dim_
+    nh, nkv = cfg.num_heads, cfg.num_kv_heads
+
+    if cfg.scan_layers:
+        import jax
+
+        # one device->host transfer of the stacked tree, indexed per layer
+        host_stack = jax.tree_util.tree_map(
+            np.asarray, params["layers"]["layer"]
+        )
+
+    def layer_tree(i):
+        if cfg.scan_layers:
+            import jax
+
+            return jax.tree_util.tree_map(lambda x: x[i], host_stack)
+        return params[f"layer_{i}"]
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["embed_tokens"]["embedding"]),
+        "model.norm.weight": _np(params["final_norm"]["scale"]),
+    }
+    for i in range(cfg.num_layers):
+        lp = layer_tree(i)
+        pre = f"model.layers.{i}."
+        a, m = lp["attn"], lp["mlp"]
+        sd[pre + "self_attn.q_proj.weight"] = (
+            _np(a["q_proj"]["kernel"]).reshape(h, nh * d).T)
+        sd[pre + "self_attn.k_proj.weight"] = (
+            _np(a["k_proj"]["kernel"]).reshape(h, nkv * d).T)
+        sd[pre + "self_attn.v_proj.weight"] = (
+            _np(a["v_proj"]["kernel"]).reshape(h, nkv * d).T)
+        sd[pre + "self_attn.o_proj.weight"] = (
+            _np(a["o_proj"]["kernel"]).reshape(nh * d, h).T)
+        sd[pre + "mlp.gate_proj.weight"] = _np(m["gate_proj"]["kernel"]).T
+        sd[pre + "mlp.up_proj.weight"] = _np(m["up_proj"]["kernel"]).T
+        sd[pre + "mlp.down_proj.weight"] = _np(m["down_proj"]["kernel"]).T
+        sd[pre + "input_layernorm.weight"] = _np(lp["input_norm"]["scale"])
+        sd[pre + "post_attention_layernorm.weight"] = _np(
+            lp["post_norm"]["scale"])
+    if cfg.tie_embeddings:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    return sd

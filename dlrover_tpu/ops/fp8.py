@@ -47,7 +47,11 @@ def quantize_dequantize(x: jax.Array, fp8_dtype: Any, max_val: float) -> jax.Arr
     """Round-trip x through fp8 with per-tensor current scaling."""
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf))
-    scale = jnp.where(amax > 0, max_val / amax, 1.0)
+    # inf/nan amax (overflow spikes — the canonical fp8 hazard) must not
+    # zero the scale and NaN-poison the whole tensor: fall back to
+    # scale=1, letting clip saturate only the overflowed entries.
+    ok = jnp.isfinite(amax) & (amax > 0)
+    scale = jnp.where(ok, max_val / jnp.where(ok, amax, 1.0), 1.0)
     q = jnp.clip(xf * scale, -max_val, max_val).astype(fp8_dtype)
     return (q.astype(jnp.float32) / scale).astype(x.dtype)
 
