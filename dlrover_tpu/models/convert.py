@@ -42,6 +42,12 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
             "flax projections are bias-free); bias tensors would be "
             "silently dropped"
         )
+    act = get("hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(
+            f"hidden_act={act!r} is unsupported (the flax MLP is SwiGLU/"
+            "silu); conversion would silently change numerics"
+        )
     explicit_head_dim = get("head_dim")
     if explicit_head_dim and explicit_head_dim * get(
         "num_attention_heads"

@@ -1,0 +1,284 @@
+"""Remote coworker data service: CPU nodes preprocess, workers pull.
+
+Parity target: the reference's coworker gRPC data path (reference:
+atorch/atorch/service/coworker_data_service.py:12-53 CoworkerRpcServicer
++ rpc_clients.py, atorch/atorch/data/coworker_dataset.py CoworkerDataset)
+— dedicated CPU pods run the expensive input pipeline and accelerator
+workers fetch ready batches over RPC, so input preprocessing scales
+independently of the accelerator fleet.
+
+TPU-native shape:
+- :class:`CoworkerDataService` wraps any batch iterator on a CPU node and
+  serves batches over the framework's generic gRPC get/report envelope
+  (common/rpc.py — no new proto); it can register its address in the
+  master KV store so workers discover coworkers dynamically (the
+  reference's data_info_service role).
+- :class:`RemoteBatchIterator` is the worker side: background prefetch,
+  round-robin across coworkers, dead-coworker exclusion with retry, and
+  optional periodic re-discovery from the master — an elastic coworker
+  pool (coworkers may join/leave like any other node).
+
+Batches are dict[str, np.ndarray] pickled over the channel (the same
+trusted-cluster serialization stance as the reference's pickle fields in
+its grpc messages; see common/comm.py notes).
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.rpc import RpcStub, build_server, find_free_port
+
+_KV_PREFIX = "coworker/addr/"
+_END = b"__END_OF_DATA__"
+_EMPTY = b"__NOT_READY__"
+_ERROR = b"__PRODUCER_ERROR__"
+
+
+class CoworkerDataService:
+    """Serve batches from ``batch_iter`` to remote workers.
+
+    One ``get`` RPC pops one ready batch (blocking up to
+    ``get_timeout_s`` server-side, then returning a NOT_READY marker the
+    client retries on).  After the iterator is exhausted every ``get``
+    returns END_OF_DATA.
+    """
+
+    def __init__(
+        self,
+        batch_iter: Iterator[Dict[str, np.ndarray]],
+        port: int = 0,
+        queue_size: int = 8,
+        get_timeout_s: float = 5.0,
+    ):
+        self._iter = batch_iter
+        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue(queue_size)
+        self._done = threading.Event()
+        self._failed = threading.Event()
+        self._stop = threading.Event()
+        self._get_timeout_s = get_timeout_s
+        self.port = find_free_port(port)
+        self._server = build_server(self._handle_get, self._handle_report)
+        self._server.add_insecure_port(f"[::]:{self.port}")
+        self._producer = threading.Thread(
+            target=self._produce, name="coworker-producer", daemon=True
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._server.start()
+        self._producer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop(grace=1.0)
+
+    def register(self, master_client, name: str) -> None:
+        """Publish this coworker's address for dynamic discovery."""
+        import socket
+
+        host = socket.getfqdn()
+        master_client.kv_store_set(
+            _KV_PREFIX + name, f"{host}:{self.port}".encode()
+        )
+
+    # -- server internals -------------------------------------------------
+    def _produce(self) -> None:
+        try:
+            for batch in self._iter:
+                if self._stop.is_set():
+                    return
+                payload = pickle.dumps(batch, protocol=4)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(payload, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception:
+            logger.exception("coworker producer failed")
+            self._failed.set()
+        finally:
+            self._done.set()
+
+    def _handle_get(self, request: bytes, context) -> bytes:
+        deadline = time.monotonic() + self._get_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                return self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._done.is_set() and self._queue.empty():
+                    # a broken pipeline must NOT look like a clean epoch end
+                    return _ERROR if self._failed.is_set() else _END
+        return _EMPTY
+
+    def _handle_report(self, request: bytes, context) -> bytes:
+        return b"ok"
+
+
+def discover_coworkers(master_client, names: Sequence[str]) -> List[str]:
+    """Resolve registered coworker addresses from the master KV store."""
+    addrs = []
+    for name in names:
+        val = master_client.kv_store_get(_KV_PREFIX + name)
+        if val:
+            addrs.append(val.decode())
+    return addrs
+
+
+class RemoteBatchIterator:
+    """Worker-side iterator over a pool of coworker data services.
+
+    Prefetches in a background thread, round-robins across coworkers,
+    excludes a coworker after ``max_failures`` consecutive errors (it may
+    re-join via ``refresh_fn``), and stops cleanly when every live
+    coworker reports END_OF_DATA.
+    """
+
+    def __init__(
+        self,
+        addrs: Sequence[str],
+        prefetch: int = 4,
+        rpc_timeout_s: float = 30.0,
+        max_failures: int = 3,
+        refresh_fn: Optional[Callable[[], Sequence[str]]] = None,
+        refresh_interval_s: float = 30.0,
+    ):
+        if not addrs and refresh_fn is None:
+            raise ValueError("need coworker addresses or a refresh_fn")
+        self._timeout = rpc_timeout_s
+        self._max_failures = max_failures
+        self._refresh_fn = refresh_fn
+        self._refresh_interval_s = refresh_interval_s
+        self._stubs: Dict[str, RpcStub] = {}
+        self._failures: Dict[str, int] = {}
+        self._ended: Dict[str, bool] = {}
+        for a in addrs:
+            self._add_addr(a)
+        self._queue: "queue.Queue[object]" = queue.Queue(prefetch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pull_loop, name="coworker-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _add_addr(self, addr: str, announced: bool = False) -> None:
+        if addr not in self._stubs:
+            self._stubs[addr] = RpcStub(addr, timeout=self._timeout)
+            self._failures[addr] = 0
+            self._ended[addr] = False
+        elif announced and self._failures[addr] >= self._max_failures:
+            # a re-announced excluded address is a restarted coworker:
+            # fresh channel, clean slate (docstring's re-join semantics)
+            try:
+                self._stubs[addr].close()
+            except Exception:
+                pass
+            self._stubs[addr] = RpcStub(addr, timeout=self._timeout)
+            self._failures[addr] = 0
+            self._ended[addr] = False
+
+    def _live(self) -> List[str]:
+        return [
+            a for a in self._stubs
+            if self._failures[a] < self._max_failures and not self._ended[a]
+        ]
+
+    def _pull_loop(self) -> None:
+        last_refresh = time.monotonic()
+        idx = 0
+        while not self._stop.is_set():
+            if self._refresh_fn and (
+                time.monotonic() - last_refresh > self._refresh_interval_s
+                or not self._live()
+            ):
+                last_refresh = time.monotonic()
+                try:
+                    for a in self._refresh_fn():
+                        self._add_addr(a, announced=True)
+                except Exception as e:
+                    logger.warning("coworker refresh failed: %s", e)
+            live = self._live()
+            if not live:
+                terminal = self._stubs and all(
+                    self._ended[a] or self._failures[a] >= self._max_failures
+                    for a in self._stubs
+                )
+                ended_all = self._stubs and all(
+                    self._ended[a] for a in self._stubs
+                )
+                # without a refresh_fn an excluded coworker can never come
+                # back, so "all terminal" must end the stream, not hang
+                if ended_all or (terminal and self._refresh_fn is None):
+                    if not ended_all:
+                        logger.warning(
+                            "coworker stream ending with excluded "
+                            "coworkers: %s",
+                            [a for a in self._stubs
+                             if self._failures[a] >= self._max_failures],
+                        )
+                    self._queue.put(StopIteration)
+                    return
+                time.sleep(0.5)
+                continue
+            addr = live[idx % len(live)]
+            idx += 1
+            try:
+                payload = self._stubs[addr].get(b"get_batch")
+            except Exception as e:
+                self._failures[addr] += 1
+                if self._failures[addr] >= self._max_failures:
+                    logger.warning(
+                        "excluding coworker %s after %d failures (%s)",
+                        addr, self._failures[addr], e,
+                    )
+                continue
+            self._failures[addr] = 0
+            if payload == _END:
+                self._ended[addr] = True
+                continue
+            if payload == _ERROR:
+                self._queue.put(RuntimeError(
+                    f"coworker {addr} input pipeline failed (see its logs)"
+                ))
+                return
+            if payload == _EMPTY:
+                continue
+            try:
+                batch = pickle.loads(payload)
+            except Exception as e:
+                logger.warning("bad batch payload from %s: %s", addr, e)
+                self._failures[addr] += 1
+                continue
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(batch, timeout=1.0)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self) -> "RemoteBatchIterator":
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        item = self._queue.get()
+        if item is StopIteration:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        for stub in self._stubs.values():
+            try:
+                stub.close()
+            except Exception:
+                pass
