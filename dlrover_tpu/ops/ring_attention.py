@@ -6,11 +6,10 @@ flash attention of its local queries against the K/V chunk it currently
 holds, merges the result into an online-softmax accumulator ``(o, lse)``,
 and rotates K/V to its ring neighbour with ``jax.lax.ppermute`` (one ICI
 hop).  HBM never holds more than two K/V chunks and attention compute per
-chip is O(s^2 / cp) FLOPs.  Note the causal critical path is ~2x that:
-with contiguous chunks the per-step ppermute synchronizes all peers to the
-busiest one, so skipped future blocks don't shorten wall-clock (the
-classic plain-ring imbalance; a zigzag chunk placement would halve it at
-the cost of non-contiguous positions).
+chip is O(s^2 / cp) FLOPs.  For causal attention the default is the
+*zigzag* chunk placement (section at the bottom of this file), which
+keeps the critical path at O(s^2 / cp) too — the plain contiguous ring
+would synchronize every ppermute step to its busiest peer, costing ~2x.
 
 The reference framework has **no** ring/context parallelism — its sequence
 parallelism is Ulysses all-to-all only (reference:
@@ -150,6 +149,19 @@ def _rotate(xs, axis_name: str, cp: int):
     return jax.lax.ppermute(xs, axis_name, _ring_perm(cp))
 
 
+def _merge_acc(acc, ob_lse):
+    """Online-softmax accumulator merge shared by all ring variants:
+    acc = (o f32, lse); new chunk result folds in via the normalized-
+    output + LSE identity."""
+    o_acc, lse_acc = acc
+    o_b, lse_b = ob_lse
+    lse_new = jnp.logaddexp(lse_acc, lse_b)
+    # [b,h,1,sq] -> [b,h,sq,1] to broadcast over head_dim
+    w_acc = jnp.exp(jnp.swapaxes(lse_acc - lse_new, 2, 3))
+    w_b = jnp.exp(jnp.swapaxes(lse_b - lse_new, 2, 3))
+    return o_acc * w_acc + o_b.astype(jnp.float32) * w_b, lse_new
+
+
 def _block_size(seq: int) -> int:
     """Largest kernel block (<=1024, >=128) that divides the chunk."""
     for b in (1024, 512, 256, 128):
@@ -234,11 +246,7 @@ def _ring_fwd(
             )
         else:
             o_b, lse_b = block(kc, vc, ksegc, False)
-        lse_new = jnp.logaddexp(lse_acc, lse_b)
-        # [b,h,1,sq] -> [b,h,sq,1] to broadcast over head_dim
-        w_acc = jnp.exp(jnp.swapaxes(lse_acc - lse_new, 2, 3))
-        w_b = jnp.exp(jnp.swapaxes(lse_b - lse_new, 2, 3))
-        return o_acc * w_acc + o_b.astype(jnp.float32) * w_b, lse_new
+        return _merge_acc((o_acc, lse_acc), (o_b, lse_b))
 
     def body(t, carry):
         o_acc, lse_acc, kc, vc, ksegc = carry
@@ -398,12 +406,16 @@ def ring_attention(
     use_pallas: Optional[bool] = None,
     rules=None,
     interpret: bool = False,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """Context-parallel attention on *global* [b, s, h, d] arrays.
 
     shard_maps over the mesh: when ``sp > 1`` the Ulysses all-to-all first
     trades the sp-sub-chunks for a head slice (2D sequence parallelism),
     then the ring runs over ``cp``.  Output is partitioned like ``q``.
+
+    ``zigzag`` (default: auto for causal) uses the balanced zigzag chunk
+    placement — see the module section below.
     """
     from dlrover_tpu.ops.attention import (
         _attention_specs,
@@ -417,8 +429,21 @@ def ring_attention(
         scale = q.shape[-1] ** -0.5
     q_spec, kv_spec, seg_spec = _attention_specs(mesh, rules)
     chunk = q.shape[1] // cp  # local seq after the sp gather
+    # zigzag balances the causal ring (every peer computes two half-chunk
+    # pairs per step instead of 0..cp); needs even half-chunks
+    if zigzag is None:
+        zigzag = causal and cp > 1 and chunk % 2 == 0
+    zigzag = zigzag and causal and cp > 1 and chunk % 2 == 0
+    if zigzag and use_pallas and (
+        (chunk // 2) % 128 != 0 and not interpret
+    ):
+        # explicit Pallas request but the zigzag halves break the
+        # kernel's 128-divisibility contract: keep the contiguous ring
+        # (full chunks) that the caller's request was validated against
+        zigzag = False
     if use_pallas is None:
-        resolved_pallas = _pallas_ok(chunk, chunk, q.shape[-1])
+        half = chunk // 2 if zigzag else chunk
+        resolved_pallas = _pallas_ok(half, half, q.shape[-1])
     else:
         resolved_pallas = bool(use_pallas)
 
@@ -436,10 +461,25 @@ def ring_attention(
         kt = k.transpose(0, 2, 1, 3)
         vt = v.transpose(0, 2, 1, 3)
         sg = seg[:, None, :].astype(jnp.int32) if seg is not None else None
-        o = _ring_local(
-            qt, kt, vt, sg, sg,
-            "cp", cp, causal, float(scale), resolved_pallas, interpret,
-        )
+        if zigzag:
+            q_lo, q_hi = _zigzag_shuffle(qt, "cp", cp, axis=2)
+            k_lo, k_hi = _zigzag_shuffle(kt, "cp", cp, axis=2)
+            v_lo, v_hi = _zigzag_shuffle(vt, "cp", cp, axis=2)
+            if sg is not None:
+                sg_lo, sg_hi = _zigzag_shuffle(sg, "cp", cp, axis=2)
+            else:
+                sg_lo = sg_hi = None
+            o_lo, o_hi = _ring_local_zigzag(
+                q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+                sg_lo, sg_hi, sg_lo, sg_hi,
+                "cp", cp, float(scale), resolved_pallas, interpret,
+            )
+            o = _zigzag_unshuffle(o_lo, o_hi, "cp", cp, axis=2)
+        else:
+            o = _ring_local(
+                qt, kt, vt, sg, sg,
+                "cp", cp, causal, float(scale), resolved_pallas, interpret,
+            )
         o = o.transpose(0, 2, 1, 3)
         if sp > 1:
             o = heads_to_seq_all_to_all(o)
@@ -462,3 +502,301 @@ def ring_attention(
         check_vma=False,
     )
     return sm(q, k, v, segment_ids)
+
+
+# ---------------------------------------------------------------------------
+# zigzag chunk placement: balanced causal ring
+# ---------------------------------------------------------------------------
+#
+# Plain contiguous chunks make the causal ring unbalanced: peer 0 attends
+# 1 chunk, peer cp-1 attends cp, and the per-step ppermute synchronizes
+# everyone to the busiest peer (~2x the balanced critical path).  Zigzag
+# placement pairs head and tail half-chunks — peer p holds global half-
+# chunks {p, 2cp-1-p} — so EVERY peer computes exactly two half-chunk
+# block pairs per ring step: (q_lo x k_lo or q_hi x k_hi, whichever is
+# past/diagonal) plus the always-past (q_hi x k_lo).  Entry/exit is two
+# ppermutes each way (half-chunk exchange), amortized over the whole
+# attention computation.
+
+
+def _zz(h: int, cp: int) -> int:
+    """Zigzag owner of global half-chunk ``h``."""
+    return h if h < cp else 2 * cp - 1 - h
+
+
+def _zigzag_tables(cp: int):
+    """Static permutations and selection tables for the boundary shuffles."""
+    perm_a = [(c, _zz(2 * c, cp)) for c in range(cp)]       # lo half out
+    perm_b = [(c, _zz(2 * c + 1, cp)) for c in range(cp)]   # hi half out
+    dest_a = {c: _zz(2 * c, cp) for c in range(cp)}
+    dest_b = {c: _zz(2 * c + 1, cp) for c in range(cp)}
+    inv_a = {v: k for k, v in dest_a.items()}
+    inv_b = {v: k for k, v in dest_b.items()}
+    # after the forward shuffle: is peer p's A-received half its LOW id?
+    a_is_lo = [2 * inv_a[p] == p for p in range(cp)]
+    # inverse shuffle: does peer q send its z-LOW half on the invA hop?
+    send_lo_inv_a = [2 * inv_a[q] == q for q in range(cp)]
+    inv_perm_a = [(dest_a[c], c) for c in range(cp)]
+    inv_perm_b = [(dest_b[c], c) for c in range(cp)]
+    return perm_a, perm_b, inv_perm_a, inv_perm_b, a_is_lo, send_lo_inv_a
+
+
+def _take_flag(table, axis_name):
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.take(jnp.asarray(table, jnp.bool_), idx)
+
+
+def _zigzag_shuffle(x, axis_name: str, cp: int, axis: int):
+    """Contiguous local chunk -> (lo, hi) zigzag half-chunks."""
+    perm_a, perm_b, _, _, a_is_lo, _ = _zigzag_tables(cp)
+    lo, hi = jnp.split(x, 2, axis=axis)
+    ra = jax.lax.ppermute(lo, axis_name, perm_a)
+    rb = jax.lax.ppermute(hi, axis_name, perm_b)
+    flag = _take_flag(a_is_lo, axis_name)
+    return jnp.where(flag, ra, rb), jnp.where(flag, rb, ra)
+
+
+def _zigzag_unshuffle(lo_z, hi_z, axis_name: str, cp: int, axis: int):
+    """(lo, hi) zigzag half-chunks -> contiguous local chunk."""
+    _, _, inv_perm_a, inv_perm_b, _, send_lo_inv_a = _zigzag_tables(cp)
+    flag = _take_flag(send_lo_inv_a, axis_name)
+    send_a = jnp.where(flag, lo_z, hi_z)
+    send_b = jnp.where(flag, hi_z, lo_z)
+    ra = jax.lax.ppermute(send_a, axis_name, inv_perm_a)  # the 2c half
+    rb = jax.lax.ppermute(send_b, axis_name, inv_perm_b)  # the 2c+1 half
+    return jnp.concatenate([ra, rb], axis=axis)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14)
+)
+def _ring_local_zigzag(
+    q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+    qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+    axis_name, cp, scale, use_pallas, interpret,
+):
+    (o_lo, o_hi), _ = _ring_zigzag_fwd(
+        q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+        qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+        axis_name, cp, scale, use_pallas, interpret,
+    )
+    return o_lo, o_hi
+
+
+def _zz_cases(me, src, which):
+    """Branch index for a (q, k) half pair: 0 skip / 1 diag / 2 full."""
+    if which == "ll":   # q id me vs k id src
+        return jnp.where(src == me, 1, jnp.where(src < me, 2, 0))
+    if which == "hh":   # q id 2cp-1-me vs k id 2cp-1-src
+        return jnp.where(src == me, 1, jnp.where(src > me, 2, 0))
+    raise AssertionError(which)
+
+
+def _ring_zigzag_fwd(
+    q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+    qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+    axis_name, cp, scale, use_pallas, interpret,
+):
+    b, h, s2, d = q_lo.shape
+    me = jax.lax.axis_index(axis_name)
+    have_segs = qseg_lo is not None
+
+    def block(q, qseg, kc, vc, ksegc, blk_causal):
+        return _chunk_fwd(
+            q, kc, vc, qseg, ksegc, blk_causal, scale, use_pallas, interpret
+        )
+
+    def pair(q, qseg, case, kc, vc, ksegc):
+        def skip(kc, vc, sc):
+            return (
+                jnp.zeros((b, h, s2, d), q.dtype),
+                jnp.full((b, h, 1, s2), _NEG_INF, jnp.float32),
+            )
+
+        return jax.lax.switch(
+            case,
+            [
+                skip,
+                lambda kc, vc, sc: block(q, qseg, kc, vc, sc, True),
+                lambda kc, vc, sc: block(q, qseg, kc, vc, sc, False),
+            ],
+            kc, vc, ksegc,
+        )
+
+    merge = _merge_acc
+
+    def step(t, lo_acc, hi_acc, kl, kh, vl, vh, sl, sh):
+        src = (me + t) % cp
+        lo_acc = merge(
+            lo_acc, pair(q_lo, qseg_lo, _zz_cases(me, src, "ll"), kl, vl, sl)
+        )
+        # q_hi x k_lo: the high half is always past every low half
+        hi_acc = merge(
+            hi_acc, block(q_hi, qseg_hi, kl, vl, sl, False)
+        )
+        hi_acc = merge(
+            hi_acc, pair(q_hi, qseg_hi, _zz_cases(me, src, "hh"), kh, vh, sh)
+        )
+        return lo_acc, hi_acc
+
+    def body(t, carry):
+        lo_acc, hi_acc, kl, kh, vl, vh, sl, sh = carry
+        lo_acc, hi_acc = step(t, lo_acc, hi_acc, kl, kh, vl, vh, sl, sh)
+        rot = (kl, kh, vl, vh) + ((sl, sh) if have_segs else ())
+        rot = _rotate(rot, axis_name, cp)
+        kl, kh, vl, vh = rot[0], rot[1], rot[2], rot[3]
+        if have_segs:
+            sl, sh = rot[4], rot[5]
+        return lo_acc, hi_acc, kl, kh, vl, vh, sl, sh
+
+    def zero_acc():
+        return (
+            jnp.zeros((b, h, s2, d), jnp.float32),
+            jnp.full((b, h, 1, s2), _NEG_INF, jnp.float32),
+        )
+
+    dummy = jnp.zeros((b, 1, s2), jnp.int32)
+    init = (
+        zero_acc(), zero_acc(), k_lo, k_hi, v_lo, v_hi,
+        kseg_lo if have_segs else dummy,
+        kseg_hi if have_segs else dummy,
+    )
+    lo_acc, hi_acc, kl, kh, vl, vh, sl, sh = jax.lax.fori_loop(
+        0, cp - 1, body, init
+    )
+    lo_acc, hi_acc = step(cp - 1, lo_acc, hi_acc, kl, kh, vl, vh, sl, sh)
+    (o_lo, lse_lo), (o_hi, lse_hi) = lo_acc, hi_acc
+    outs = (o_lo.astype(q_lo.dtype), o_hi.astype(q_hi.dtype))
+    return outs, (lse_lo, lse_hi)
+
+
+def _ring_zigzag_fwd_rule(
+    q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+    qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+    axis_name, cp, scale, use_pallas, interpret,
+):
+    (o_lo, o_hi), (lse_lo, lse_hi) = _ring_zigzag_fwd(
+        q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+        qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+        axis_name, cp, scale, use_pallas, interpret,
+    )
+    res = (
+        q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+        qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+        o_lo, o_hi, lse_lo, lse_hi,
+    )
+    return (o_lo, o_hi), res
+
+
+def _ring_zigzag_bwd_rule(axis_name, cp, scale, use_pallas, interpret, res, g):
+    (
+        q_lo, q_hi, k_lo, k_hi, v_lo, v_hi,
+        qseg_lo, qseg_hi, kseg_lo, kseg_hi,
+        o_lo, o_hi, lse_lo, lse_hi,
+    ) = res
+    do_lo, do_hi = g
+    b, h, s2, d = q_lo.shape
+    me = jax.lax.axis_index(axis_name)
+    have_segs = qseg_lo is not None
+    delta_lo = jnp.sum(
+        do_lo.astype(jnp.float32) * o_lo.astype(jnp.float32), axis=-1
+    )[:, :, None, :]
+    delta_hi = jnp.sum(
+        do_hi.astype(jnp.float32) * o_hi.astype(jnp.float32), axis=-1
+    )[:, :, None, :]
+
+    def block(q, qseg, o, lse, do, delta, kc, vc, ksegc, blk_causal):
+        dq_b, dk_b, dv_b = _chunk_bwd(
+            q, kc, vc, qseg, ksegc, o, lse, do, delta,
+            blk_causal, scale, use_pallas, interpret,
+        )
+        return (
+            dq_b.astype(jnp.float32),
+            dk_b.astype(jnp.float32),
+            dv_b.astype(jnp.float32),
+        )
+
+    def pair(q, qseg, o, lse, do, delta, case, kc, vc, ksegc):
+        def skip(kc, vc, sc):
+            return (
+                jnp.zeros((b, h, s2, d), jnp.float32),
+                jnp.zeros(kc.shape, jnp.float32),
+                jnp.zeros(vc.shape, jnp.float32),
+            )
+
+        return jax.lax.switch(
+            case,
+            [
+                skip,
+                lambda kc, vc, sc: block(q, qseg, o, lse, do, delta,
+                                         kc, vc, sc, True),
+                lambda kc, vc, sc: block(q, qseg, o, lse, do, delta,
+                                         kc, vc, sc, False),
+            ],
+            kc, vc, ksegc,
+        )
+
+    def accum(t, dq_lo, dq_hi, kl, kh, vl, vh, sl, sh, dkl, dkh, dvl, dvh):
+        src = (me + t) % cp
+        a, bk, bv = pair(q_lo, qseg_lo, o_lo, lse_lo, do_lo, delta_lo,
+                         _zz_cases(me, src, "ll"), kl, vl, sl)
+        dq_lo = dq_lo + a
+        dkl = dkl + bk
+        dvl = dvl + bv
+        a, bk, bv = block(q_hi, qseg_hi, o_hi, lse_hi, do_hi, delta_hi,
+                          kl, vl, sl, False)
+        dq_hi = dq_hi + a
+        dkl = dkl + bk
+        dvl = dvl + bv
+        a, bk, bv = pair(q_hi, qseg_hi, o_hi, lse_hi, do_hi, delta_hi,
+                         _zz_cases(me, src, "hh"), kh, vh, sh)
+        dq_hi = dq_hi + a
+        dkh = dkh + bk
+        dvh = dvh + bv
+        return dq_lo, dq_hi, dkl, dkh, dvl, dvh
+
+    def body(t, carry):
+        (dq_lo, dq_hi, kl, kh, vl, vh, sl, sh,
+         dkl, dkh, dvl, dvh) = carry
+        dq_lo, dq_hi, dkl, dkh, dvl, dvh = accum(
+            t, dq_lo, dq_hi, kl, kh, vl, vh, sl, sh, dkl, dkh, dvl, dvh
+        )
+        rot = (kl, kh, vl, vh, dkl, dkh, dvl, dvh) + (
+            (sl, sh) if have_segs else ()
+        )
+        rot = _rotate(rot, axis_name, cp)
+        kl, kh, vl, vh, dkl, dkh, dvl, dvh = rot[:8]
+        if have_segs:
+            sl, sh = rot[8], rot[9]
+        return (dq_lo, dq_hi, kl, kh, vl, vh, sl, sh, dkl, dkh, dvl, dvh)
+
+    dummy = jnp.zeros((b, 1, s2), jnp.int32)
+    zq = jnp.zeros((b, h, s2, d), jnp.float32)
+    init = (
+        zq, zq, k_lo, k_hi, v_lo, v_hi,
+        kseg_lo if have_segs else dummy,
+        kseg_hi if have_segs else dummy,
+        jnp.zeros(k_lo.shape, jnp.float32),
+        jnp.zeros(k_hi.shape, jnp.float32),
+        jnp.zeros(v_lo.shape, jnp.float32),
+        jnp.zeros(v_hi.shape, jnp.float32),
+    )
+    carry = jax.lax.fori_loop(0, cp - 1, body, init)
+    (dq_lo, dq_hi, kl, kh, vl, vh, sl, sh, dkl, dkh, dvl, dvh) = carry
+    dq_lo, dq_hi, dkl, dkh, dvl, dvh = accum(
+        cp - 1, dq_lo, dq_hi, kl, kh, vl, vh, sl, sh, dkl, dkh, dvl, dvh
+    )
+    # final hop homes the travelling dk/dv halves
+    dkl, dkh, dvl, dvh = _rotate((dkl, dkh, dvl, dvh), axis_name, cp)
+    return (
+        dq_lo.astype(q_lo.dtype),
+        dq_hi.astype(q_hi.dtype),
+        dkl.astype(k_lo.dtype),
+        dkh.astype(k_hi.dtype),
+        dvl.astype(v_lo.dtype),
+        dvh.astype(v_hi.dtype),
+        None, None, None, None,
+    )
+
+
+_ring_local_zigzag.defvjp(_ring_zigzag_fwd_rule, _ring_zigzag_bwd_rule)
