@@ -131,8 +131,15 @@ def default_loss_fn(
         hidden, var_updates = forward_fn(params, batch, return_hidden=True)
         if "lm_head" in params:
             kernel = params["lm_head"]["kernel"]
-        else:  # tied embeddings
+        elif "embed_tokens" in params:  # tied embeddings (Llama naming)
             kernel = params["embed_tokens"]["embedding"].T
+        elif "wte" in params:  # tied embeddings (GPT-2 naming)
+            kernel = params["wte"]["embedding"].T
+        else:
+            raise ValueError(
+                "cannot locate the LM head: expected 'lm_head', "
+                "'embed_tokens', or 'wte' in params"
+            )
         labels = batch.get("labels")
         mask = batch.get("loss_mask")
         if labels is None:

@@ -67,12 +67,13 @@ def classify_param(path: tuple, value: Any) -> str:
     joined = "/".join(names)
     if value.ndim <= 1:
         return VECTOR
-    if "embed_tokens" in joined:
+    if "embed_tokens" in joined or "wte" in joined or "wpe" in joined:
         # NOTE: with tie_embeddings the shared table serves both input
         # and output; it keeps the EMBED role (lr η).  Tied models get
         # their output correction from the EXPLICIT convention instead:
         # set model logit_scale = MupConfig.logit_scale and skip
         # apply_mup_init (there is no separate output param to rescale).
+        # ("wte"/"wpe" are the GPT-2 family's embedding tables.)
         return EMBED
     if "lm_head" in joined:
         return OUTPUT

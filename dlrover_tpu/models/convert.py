@@ -201,3 +201,117 @@ def params_to_hf(params: Mapping[str, Any], cfg: LlamaConfig) -> Dict[str, np.nd
     else:
         sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
     return sd
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 family (reference: GPT2AttentionFA fast path, layers.py:1569)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_gpt2(hf_config: Any, **overrides):
+    """Map a ``transformers.GPT2Config`` to :class:`GPT2Config`."""
+    from dlrover_tpu.models.gpt2 import GPT2Config
+
+    get = lambda k, d=None: getattr(hf_config, k, d)  # noqa: E731
+    act = get("activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"activation_function={act!r} unsupported (model uses tanh-gelu)"
+        )
+    inner = get("n_inner") or 4 * get("n_embd")
+    if inner != 4 * get("n_embd"):
+        raise ValueError("n_inner != 4*n_embd is unsupported")
+    if not get("scale_attn_weights", True):
+        raise ValueError(
+            "scale_attn_weights=False is unsupported (the flax attention "
+            "always scales by head_dim**-0.5)"
+        )
+    if get("scale_attn_by_inverse_layer_idx", False) or get(
+        "reorder_and_upcast_attn", False
+    ):
+        raise ValueError(
+            "scale_attn_by_inverse_layer_idx / reorder_and_upcast_attn "
+            "checkpoints are unsupported; conversion would silently "
+            "change attention numerics"
+        )
+    kw: Dict[str, Any] = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("n_embd"),
+        num_layers=get("n_layer"),
+        num_heads=get("n_head"),
+        max_seq_len=get("n_positions", 1024),
+        layer_norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+    )
+    kw.update(overrides)
+    return GPT2Config(**kw)
+
+
+def _gpt2_block(sd: Mapping[str, Any], i: int, cfg) -> Dict:
+    h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    pre = f"transformer.h.{i}."
+
+    def w(name):
+        # HF GPT-2 uses Conv1D modules: weights already stored [in, out]
+        return _np(sd[pre + name + ".weight"])
+
+    def b(name):
+        return _np(sd[pre + name + ".bias"])
+
+    def ln(name):
+        return {"scale": w(name), "bias": b(name)}
+
+    return {
+        "ln_1": ln("ln_1"),
+        "attn": {
+            "c_attn": {
+                "kernel": w("attn.c_attn").reshape(h, 3, nh, d),
+                "bias": b("attn.c_attn").reshape(3, nh, d),
+            },
+            "c_proj": {
+                "kernel": w("attn.c_proj").reshape(nh, d, h),
+                "bias": b("attn.c_proj"),
+            },
+        },
+        "ln_2": ln("ln_2"),
+        "c_fc": {"kernel": w("mlp.c_fc"), "bias": b("mlp.c_fc")},
+        "c_proj": {"kernel": w("mlp.c_proj"), "bias": b("mlp.c_proj")},
+    }
+
+
+def params_from_hf_gpt2(sd: Mapping[str, Any], cfg) -> Dict:
+    """Convert an HF GPT-2 ``state_dict`` to the flax param pytree."""
+    blocks = [_gpt2_block(sd, i, cfg) for i in range(cfg.num_layers)]
+    params: Dict[str, Any] = {
+        "wte": {"embedding": _np(sd["transformer.wte.weight"])},
+        "wpe": {
+            "embedding": _np(sd["transformer.wpe.weight"])[: cfg.max_seq_len]
+        },
+        "ln_f": {
+            "scale": _np(sd["transformer.ln_f.weight"]),
+            "bias": _np(sd["transformer.ln_f.bias"]),
+        },
+    }
+    if cfg.scan_layers:
+        import jax
+
+        params["blocks"] = {
+            "layer": jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs, axis=0), *blocks
+            )
+        }
+    else:
+        for i, bp in enumerate(blocks):
+            params[f"block_{i}"] = bp
+    return params
+
+
+def load_hf_gpt2(model_or_path: Any, **config_overrides):
+    """One-call GPT-2 import: transformers model/path -> (cfg, params)."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    cfg = config_from_hf_gpt2(model.config, **config_overrides)
+    return cfg, params_from_hf_gpt2(model.state_dict(), cfg)

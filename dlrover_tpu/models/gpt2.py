@@ -1,0 +1,241 @@
+"""GPT-2 family decoder, TPU-native (flax.linen + logical partitioning).
+
+Second model family beside Llama — the reference accelerates HF GPT-2
+modules via its FlashAttention fast paths (reference:
+atorch/atorch/modules/transformer/layers.py:1569 ``GPT2AttentionFA`` and
+the module_replace optimization); here GPT-2 is a first-class flax model
+sharing the framework's attention dispatch, logical sharding rules, scan/
+remat machinery, and the HF checkpoint interop
+(:func:`dlrover_tpu.models.convert.load_hf_gpt2`, logits-parity tested).
+
+Architectural differences from Llama handled here: learned absolute
+position embeddings, pre-LayerNorm (with bias), fused QKV projection,
+biased projections, gelu(tanh) MLP, and tied output embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.parallel.mesh import with_logical_constraint
+from dlrover_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    mlp_ratio: int = 4
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = False
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return self.mlp_ratio * self.hidden_size
+
+    @property
+    def num_params(self) -> int:
+        h = self.hidden_size
+        per_layer = 4 * h * h + 2 * h * self.intermediate_size
+        return (
+            self.num_layers * per_layer
+            + self.vocab_size * h
+            + self.max_seq_len * h
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        base = dict(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+class LayerNorm(nn.Module):
+    eps: float
+    dtype: Dtype
+    param_dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = x.shape[-1]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (h,), self.param_dtype,
+        )
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+            (h,), self.param_dtype,
+        )
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        return y.astype(self.dtype)
+
+
+class GPT2Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array, segment_ids=None) -> jax.Array:
+        cfg = self.config
+        h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+        init = nn.initializers.normal(0.02)
+        qkv = nn.DenseGeneral(
+            (3, nh, d), axis=-1, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                init, ("embed", None, "heads", "head_dim")
+            ),
+            name="c_attn",
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+        v = with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+        out = dot_product_attention(q, k, v, causal=True,
+                                    segment_ids=segment_ids)
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", "head_dim")
+        )
+        return nn.DenseGeneral(
+            h, axis=(-2, -1), use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                init, ("heads", "head_dim", "embed")
+            ),
+            name="c_proj",
+        )(out)
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array, segment_ids=None) -> jax.Array:
+        cfg = self.config
+        ln = lambda name: LayerNorm(  # noqa: E731
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype, name=name
+        )
+        x = x + GPT2Attention(cfg, name="attn")(ln("ln_1")(x), segment_ids)
+        h = ln("ln_2")(x)
+        init = nn.initializers.normal(0.02)
+        up = nn.DenseGeneral(
+            cfg.intermediate_size, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(init, ("embed", "mlp")),
+            name="c_fc",
+        )(h)
+        up = with_logical_constraint(up, ("batch", "seq", "mlp"))
+        up = nn.gelu(up, approximate=True)
+        down = nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(init, ("mlp", "embed")),
+            name="c_proj",
+        )(up)
+        x = x + down
+        return with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class _ScanBlock(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, segment_ids = carry
+        x = GPT2Block(self.config, name="layer")(x, segment_ids)
+        return (x, segment_ids), None
+
+
+class GPT2Model(nn.Module):
+    """GPT-2 LM: returns [batch, seq, vocab] logits (tied embeddings).
+
+    Shares the framework model-call contract (positions / segment_ids /
+    return_hidden) so ``accelerate()``'s default forward works unchanged.
+    """
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        return_hidden: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        b, s = input_ids.shape
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab_tbl", "embed_tbl")
+            ),
+            name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), (None, "embed_tbl")
+            ),
+            name="wpe",
+        )
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        x = wte(input_ids) + wpe(positions)
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+        if cfg.scan_layers:
+            block = _ScanBlock
+            if cfg.remat:
+                block = nn.remat(
+                    block,
+                    prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            (x, _), _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="blocks")((x, segment_ids), None)
+        else:
+            for i in range(cfg.num_layers):
+                blk = GPT2Block
+                if cfg.remat:
+                    blk = nn.remat(blk, prevent_cse=False)
+                x = blk(cfg, name=f"block_{i}")(x, segment_ids)
+
+        x = LayerNorm(
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype, name="ln_f"
+        )(x)
+        if return_hidden:
+            return x
+        return wte.attend(x.astype(cfg.param_dtype))
