@@ -67,6 +67,13 @@ class AccelerateConfig:
     # Fused lm-head + cross-entropy over sequence chunks of this size
     # (never materializes full logits); None = plain logits loss.
     loss_chunk_size: Optional[int] = None
+    # Keep optimizer states in host (pinned) memory and stream them
+    # through the update — the TPU-native counterpart of the reference's
+    # CPU-offloaded Adam (reference: atorch/atorch/optimizers adam_offload;
+    # here XLA's memory-kind shardings insert the transfers, no custom
+    # offload optimizer class).  Frees ~8 bytes/param of HBM for Adam at
+    # the cost of PCIe/host bandwidth per step.
+    offload_optimizer_states: bool = False
 
 
 @dataclasses.dataclass
@@ -186,6 +193,31 @@ def _tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
 
 
+def _offload_streaming(tx, shardings_cell):
+    """Wrap ``tx`` so pinned-host optimizer states stream through the
+    update: host -> device before the math, device -> host after (the
+    reference's CPU-offloaded Adam, expressed as memory-kind transfers —
+    peak HBM during fwd/bwd never holds the optimizer moments).
+
+    ``shardings_cell['tree']`` is filled in later (the wrapper must exist
+    before the state structure is traced, because the tx object is static
+    TrainState metadata); it is only read when the train step traces."""
+
+    def to_kind(state, kind):
+        return jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(x, sh.with_memory_kind(kind))
+            if isinstance(sh, NamedSharding) and getattr(x, "ndim", 0) >= 1
+            else x,
+            state, shardings_cell["tree"],
+        )
+
+    def update_fn(grads, state, params=None):
+        upd, new_state = tx.update(grads, to_kind(state, "device"), params)
+        return upd, to_kind(new_state, "pinned_host")
+
+    return optax.GradientTransformation(tx.init, update_fn)
+
+
 def _expand_and_repair_sharding(sharding_tree, abstract_tree, mesh):
     """Expand the prefix sharding tree to a full per-leaf tree, dropping
     spec entries that don't apply to a leaf.
@@ -250,10 +282,13 @@ def accelerate(
     config = config or AccelerateConfig()
     if optimizer is None:
         optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    _offload_cell: Dict[str, Any] = {}
     if config.max_grad_norm is not None:
         optimizer = optax.chain(
             optax.clip_by_global_norm(config.max_grad_norm), optimizer
         )
+    if config.offload_optimizer_states:
+        optimizer = _offload_streaming(optimizer, _offload_cell)
     if config.mesh_spec.pp > 1:
         if loss_fn is not None:
             # a custom loss_fn would run plain model.apply over a
@@ -314,6 +349,24 @@ def accelerate(
     state_sharding = _expand_and_repair_sharding(
         state_sharding, nn.unbox(abstract_state), mesh
     ).replace(params=param_sharding)
+    if config.offload_optimizer_states:
+        # Only offload real state tensors: scalars (Adam step counts) in
+        # host memory trip XLA's device-placement annotation inside SPMD
+        # partitioning, and moving them buys nothing anyway.
+        state_sharding = state_sharding.replace(
+            opt_state=jax.tree_util.tree_map(
+                lambda sh, leaf: sh.with_memory_kind("pinned_host")
+                if isinstance(sh, NamedSharding) and leaf.ndim >= 1
+                else sh,
+                state_sharding.opt_state,
+                nn.unbox(abstract_state).opt_state,
+            )
+        )
+        # Stream the host states through the update: the wrapper installed
+        # above reads these shardings when the train step traces (explicit
+        # device_put transfers — mixing memory spaces in one op is not
+        # allowed).
+        _offload_cell["tree"] = state_sharding.opt_state
 
     micro_spec = logical_to_spec(("batch", "seq"), config.logical_rules)
     if config.grad_accum_steps > 1:

@@ -147,11 +147,16 @@ def make_mup_model_config(base_config, width: int, base_width: int,
             "mismatch would desync model geometry from mu_adam's LRs"
         )
     ratio = width / base_width
-    return dataclasses.replace(
-        cfg,
+    updates = dict(
         hidden_size=width,
-        intermediate_size=int(cfg.intermediate_size * ratio),
         num_heads=max(1, int(cfg.num_heads * ratio)),
-        num_kv_heads=max(1, int(cfg.num_kv_heads * ratio)),
-        **overrides,
     )
+    # only scale fields the config actually has (GPT-2's intermediate
+    # size is the derived mlp_ratio*hidden; it has no kv heads)
+    fields = {f.name for f in dataclasses.fields(cfg)}
+    if "intermediate_size" in fields:
+        updates["intermediate_size"] = int(cfg.intermediate_size * ratio)
+    if "num_kv_heads" in fields:
+        updates["num_kv_heads"] = max(1, int(cfg.num_kv_heads * ratio))
+    updates.update(overrides)
+    return dataclasses.replace(cfg, **updates)

@@ -41,6 +41,9 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     scan_layers: bool = False
     remat: bool = False
+    # output-logit multiplier; muP's explicit convention sets this to
+    # base_width/width on tied-embedding models (accel/mup.py)
+    logit_scale: float = 1.0
 
     @property
     def head_dim(self) -> int:
@@ -238,4 +241,7 @@ class GPT2Model(nn.Module):
         )(x)
         if return_hidden:
             return x
-        return wte.attend(x.astype(cfg.param_dtype))
+        logits = wte.attend(x.astype(cfg.param_dtype))
+        if cfg.logit_scale != 1.0:
+            logits = logits * cfg.logit_scale
+        return logits

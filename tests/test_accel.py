@@ -337,3 +337,55 @@ def test_train_step_sp_ulysses_parity():
         assert np.isclose(
             float(m_sp["loss"]), float(m_base["loss"]), rtol=2e-3
         ), (float(m_sp["loss"]), float(m_base["loss"]))
+
+
+def test_offload_optimizer_states_to_host():
+    """opt states live in pinned host memory; params stay on device; the
+    train step streams them through the update (adam_offload parity).
+
+    The CPU SPMD partitioner in this XLA build rejects memory-kind
+    placement annotations ("Side-effect ops cannot be replicated"), so
+    on the CPU mesh this skips — the path is validated on real TPU
+    (single-chip run: pinned_host states, loss descends, states stay
+    host-resident after steps).
+    """
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+
+    cfg = LlamaConfig.tiny(max_seq_len=64)
+    res = accelerate(
+        LlamaModel(cfg),
+        config=AccelerateConfig(
+            mesh_spec=MeshSpec.for_device_count(8),
+            offload_optimizer_states=True,
+        ),
+        batch_shape=(8, 64),
+    )
+    try:
+        state = res.init_fn(jax.random.PRNGKey(0))
+    except Exception as e:  # jax.errors.JaxRuntimeError on CPU SPMD
+        if "annotate_device_placement" in str(e) or "Side-effect" in str(e):
+            pytest.skip("backend does not support memory-kind SPMD")
+        raise
+    kinds = {
+        leaf.sharding.memory_kind
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if leaf.ndim >= 1
+    }
+    assert kinds == {"pinned_host"}, kinds
+    param_kinds = {
+        leaf.sharding.memory_kind
+        for leaf in jax.tree_util.tree_leaves(state.params)
+    }
+    assert "pinned_host" not in param_kinds
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    state, metrics = res.train_step(state, {"input_ids": ids})
+    assert float(metrics["loss"]) > 0
+    # states remain host-resident after the step (no silent migration)
+    kinds = {
+        leaf.sharding.memory_kind
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if leaf.ndim >= 1
+    }
+    assert kinds == {"pinned_host"}, kinds

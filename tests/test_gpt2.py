@@ -67,3 +67,38 @@ def test_gpt2_rejects_unsupported_activation():
     cfg = transformers.GPT2Config(activation_function="relu")
     with pytest.raises(ValueError, match="activation_function"):
         config_from_hf_gpt2(cfg)
+
+
+def test_gpt2_chunked_loss_matches_plain():
+    """The fused chunked LM loss resolves GPT-2's tied wte head and
+    matches the plain-logits loss exactly."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.accel.parallel.mesh import MeshSpec
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    losses = {}
+    for chunk in (None, 16):
+        res = accelerate(
+            GPT2Model(cfg),
+            config=AccelerateConfig(
+                mesh_spec=MeshSpec.for_device_count(8),
+                loss_chunk_size=chunk,
+            ),
+            batch_shape=(8, 64),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        _, metrics = res.train_step(state, {"input_ids": ids})
+        losses[chunk] = float(metrics["loss"])
+    np.testing.assert_allclose(losses[16], losses[None], rtol=1e-5)
+
+
+def test_gpt2_mup_config_scaling():
+    from dlrover_tpu.accel.mup import make_mup_model_config
+
+    base = GPT2Config.tiny(hidden_size=32, num_heads=4)
+    wide = make_mup_model_config(base, width=64, base_width=32)
+    assert wide.hidden_size == 64 and wide.num_heads == 8
+    assert wide.intermediate_size == 4 * 64  # derived from mlp_ratio
