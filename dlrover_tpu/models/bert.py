@@ -1,0 +1,182 @@
+"""BERT-family bidirectional encoder, TPU-native.
+
+Third model family (reference accelerates HF BERT via its FlashAttention
+fast paths — reference: atorch/atorch/modules/transformer/layers.py
+``BertAttentionFA`` around :801-1447 — and swaps modules via the
+module_replace optimization).  Shares the framework's attention dispatch,
+logical sharding rules, and HF checkpoint interop
+(:func:`dlrover_tpu.models.convert.load_hf_bert`, logits-parity tested).
+
+Architecture notes vs the decoder families: bidirectional attention
+(``causal=False``; padding expressed as segment ids so pads and valid
+tokens never mix), post-LayerNorm residuals, word+position+token-type
+embedding sum with an embedding LayerNorm, exact (non-tanh) gelu, and an
+MLM head (dense + gelu + LN + tied decoder with output bias).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.parallel.mesh import with_logical_constraint
+from dlrover_tpu.models.gpt2 import LayerNorm
+from dlrover_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        base = dict(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_seq_len=64,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, segment_ids=None) -> jax.Array:
+        cfg = self.config
+        h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+        init = nn.initializers.normal(0.02)
+        ln = lambda name: LayerNorm(  # noqa: E731
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype, name=name
+        )
+        dense = lambda feats, axis, axes, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=axis, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(init, axes), name=name,
+        )
+
+        q = dense((nh, d), -1, ("embed", "heads", "head_dim"), "query")(x)
+        k = dense((nh, d), -1, ("embed", "heads", "head_dim"), "key")(x)
+        v = dense((nh, d), -1, ("embed", "heads", "head_dim"), "value")(x)
+        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+        v = with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+        attn = dot_product_attention(
+            q, k, v, causal=False, segment_ids=segment_ids
+        )
+        attn = dense(
+            h, (-2, -1), ("heads", "head_dim", "embed"), "attn_out"
+        )(attn)
+        x = ln("attn_norm")(x + attn)  # post-LN
+
+        up = dense(cfg.intermediate_size, -1, ("embed", "mlp"), "intermediate")(x)
+        up = with_logical_constraint(up, ("batch", "seq", "mlp"))
+        up = nn.gelu(up, approximate=False)
+        down = dense(h, -1, ("mlp", "embed"), "output")(up)
+        x = ln("mlp_norm")(x + down)
+        return with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class BertModel(nn.Module):
+    """BERT encoder with MLM head: [b, s] ids -> [b, s, vocab] logits.
+
+    ``attention_mask`` (1 = valid) folds into segment ids so padding
+    never attends to (or is attended by) real tokens; ``segment_ids``
+    (sequence packing) composes with the mask; ``positions`` overrides
+    the default arange (the framework model-call contract, so
+    ``accelerate()``'s default forward works unchanged); ``return_hidden``
+    skips the MLM head (feature-extraction / fine-tuning use).
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        token_type_ids: Optional[jax.Array] = None,
+        attention_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        return_hidden: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        b, s = input_ids.shape
+        embed = lambda n, rows, name: nn.Embed(  # noqa: E731
+            rows, cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02),
+                ("vocab_tbl" if n == "word" else None, "embed_tbl"),
+            ),
+            name=name,
+        )
+        word = embed("word", cfg.vocab_size, "word_embeddings")
+        pos = embed("pos", cfg.max_seq_len, "position_embeddings")
+        typ = embed("typ", cfg.type_vocab_size, "token_type_embeddings")
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        x = word(input_ids) + pos(positions) + typ(token_type_ids)
+        x = LayerNorm(
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype,
+            name="embeddings_norm",
+        )(x)
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+        # fold padding and packing into one segment field: attending
+        # requires the same packing segment AND both tokens valid (pads
+        # land in segment 0 together — harmless, masked in the loss)
+        segs = segment_ids.astype(jnp.int32) if segment_ids is not None else None
+        if attention_mask is not None:
+            mask = attention_mask.astype(jnp.int32)
+            base = segs + 1 if segs is not None else jnp.ones_like(mask)
+            segs = jnp.where(mask == 1, base, 0)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, segs)
+
+        if return_hidden:
+            return x
+
+        # MLM head: transform + tied decoder + output bias
+        x = nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "embed")
+            ),
+            name="mlm_transform",
+        )(x)
+        x = nn.gelu(x, approximate=False)
+        x = LayerNorm(
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype, name="mlm_norm"
+        )(x)
+        logits = word.attend(x.astype(cfg.param_dtype))
+        bias = self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)
+            ),
+            (cfg.vocab_size,), cfg.param_dtype,
+        )
+        return logits + bias

@@ -315,3 +315,115 @@ def load_hf_gpt2(model_or_path: Any, **config_overrides):
         model = model_or_path
     cfg = config_from_hf_gpt2(model.config, **config_overrides)
     return cfg, params_from_hf_gpt2(model.state_dict(), cfg)
+
+
+# ---------------------------------------------------------------------------
+# BERT family (reference: BertAttentionFA fast path, layers.py:801-1447)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_bert(hf_config: Any, **overrides):
+    """Map a ``transformers.BertConfig`` to :class:`BertConfig`."""
+    from dlrover_tpu.models.bert import BertConfig
+
+    get = lambda k, d=None: getattr(hf_config, k, d)  # noqa: E731
+    act = get("hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(
+            f"hidden_act={act!r} unsupported (model uses exact gelu)"
+        )
+    pet = get("position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"position_embedding_type={pet!r} unsupported (model uses "
+            "absolute learned positions); conversion would drop the "
+            "relative-position tables"
+        )
+    if get("tie_word_embeddings", True) is False:
+        raise ValueError(
+            "tie_word_embeddings=False unsupported (the MLM decoder is "
+            "tied to the word embeddings); the separate decoder weight "
+            "would be silently dropped"
+        )
+    kw: Dict[str, Any] = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        intermediate_size=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 512),
+        type_vocab_size=get("type_vocab_size", 2),
+        layer_norm_eps=float(get("layer_norm_eps", 1e-12)),
+    )
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+def params_from_hf_bert(sd: Mapping[str, Any], cfg) -> Dict:
+    """Convert an HF ``BertForMaskedLM`` state_dict to the flax tree."""
+    h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def ln(prefix):
+        return {
+            "scale": _np(sd[prefix + ".weight"]),
+            "bias": _np(sd[prefix + ".bias"]),
+        }
+
+    params: Dict[str, Any] = {
+        "word_embeddings": {
+            "embedding": _np(sd["bert.embeddings.word_embeddings.weight"])
+        },
+        "position_embeddings": {
+            "embedding": _np(
+                sd["bert.embeddings.position_embeddings.weight"]
+            )[: cfg.max_seq_len]
+        },
+        "token_type_embeddings": {
+            "embedding": _np(sd["bert.embeddings.token_type_embeddings.weight"])
+        },
+        "embeddings_norm": ln("bert.embeddings.LayerNorm"),
+        "mlm_transform": {
+            "kernel": _np(sd["cls.predictions.transform.dense.weight"]).T,
+            "bias": _np(sd["cls.predictions.transform.dense.bias"]),
+        },
+        "mlm_norm": ln("cls.predictions.transform.LayerNorm"),
+        "mlm_bias": _np(sd["cls.predictions.bias"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"bert.encoder.layer.{i}."
+
+        def wb(name, shape=None):
+            w = _np(sd[pre + name + ".weight"]).T
+            if shape is not None:
+                w = w.reshape(shape)
+            return w, _np(sd[pre + name + ".bias"])
+
+        qw, qb = wb("attention.self.query", (h, nh, d))
+        kw_, kb = wb("attention.self.key", (h, nh, d))
+        vw, vb = wb("attention.self.value", (h, nh, d))
+        ow, ob = wb("attention.output.dense")
+        iw, ib = wb("intermediate.dense")
+        dw, db = wb("output.dense")
+        params[f"layer_{i}"] = {
+            "query": {"kernel": qw, "bias": qb.reshape(nh, d)},
+            "key": {"kernel": kw_, "bias": kb.reshape(nh, d)},
+            "value": {"kernel": vw, "bias": vb.reshape(nh, d)},
+            "attn_out": {"kernel": ow.reshape(nh, d, h), "bias": ob},
+            "attn_norm": ln(pre + "attention.output.LayerNorm"),
+            "intermediate": {"kernel": iw, "bias": ib},
+            "output": {"kernel": dw, "bias": db},
+            "mlp_norm": ln(pre + "output.LayerNorm"),
+        }
+    return params
+
+
+def load_hf_bert(model_or_path: Any, **config_overrides):
+    """One-call BERT import: transformers model/path -> (cfg, params)."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForMaskedLM
+
+        model = AutoModelForMaskedLM.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    cfg = config_from_hf_bert(model.config, **config_overrides)
+    return cfg, params_from_hf_bert(model.state_dict(), cfg)
