@@ -84,6 +84,10 @@ CONFIGS = {
                                  num_heads=20, max_seq_len=2048, **{**BASE, "num_kv_heads": 5}), 8),
     "O_Iseq4096": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
                                 num_heads=16, max_seq_len=4096, **{**BASE, "num_kv_heads": 4}), 4),
+    "P_Ob6": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                          num_heads=16, max_seq_len=4096, **{**BASE, "num_kv_heads": 4}), 6),
+    "Q_Ob8": (LlamaConfig(hidden_size=2048, intermediate_size=8192, num_layers=6,
+                          num_heads=16, max_seq_len=4096, **{**BASE, "num_kv_heads": 4}), 8),
     "N_h4096L2gqa": (LlamaConfig(hidden_size=4096, intermediate_size=16384, num_layers=2,
                                  num_heads=32, max_seq_len=2048, **{**BASE, "num_kv_heads": 8}), 8),
 }

@@ -1,0 +1,21 @@
+"""Model families (TPU-native flax; reference counterparts are the HF
+modules the reference fast-paths in atorch/atorch/modules/transformer/).
+
+- :mod:`~dlrover_tpu.models.llama` — flagship decoder (dense + MoE)
+- :mod:`~dlrover_tpu.models.gpt2` — GPT-2 decoder family
+- :mod:`~dlrover_tpu.models.bert` — bidirectional encoder + MLM head
+- :mod:`~dlrover_tpu.models.convert` — HF checkpoint import/export
+"""
+
+from dlrover_tpu.models.bert import BertConfig, BertModel
+from dlrover_tpu.models.gpt2 import GPT2Config, GPT2Model
+from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+__all__ = [
+    "BertConfig",
+    "BertModel",
+    "GPT2Config",
+    "GPT2Model",
+    "LlamaConfig",
+    "LlamaModel",
+]

@@ -163,7 +163,9 @@ class BertModel(nn.Module):
             cfg.hidden_size, use_bias=True,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("embed", "embed")
+                # square kernel: second dim unsharded (duplicate logical
+                # names are rejected by logical_to_mesh_sharding)
+                nn.initializers.normal(0.02), ("embed", None)
             ),
             name="mlm_transform",
         )(x)

@@ -116,6 +116,7 @@ class ElasticTrainer:
         save_storage_interval: int = 50,
         saver_mode: SaverMode = SaverMode.AUTO,
         metrics_every: int = 1,
+        compile_cache_dir: Optional[str] = None,
     ):
         self._model = model
         self._global_batch_size = global_batch_size
@@ -138,12 +139,33 @@ class ElasticTrainer:
 
         self._step_timer = StepTimer()
         self._metrics_every = metrics_every
+        self._compile_cache_dir = (
+            compile_cache_dir
+            if compile_cache_dir is not None
+            else os.environ.get("DLROVER_COMPILE_CACHE_DIR")
+        )
         self._steps_since_report = 0
         self._host_step = 0
 
     # -- world / strategy -------------------------------------------------
     def prepare(self, devices: Optional[Sequence[Any]] = None) -> None:
         """Build mesh + jitted steps for the current world size."""
+        if self._compile_cache_dir:
+            # Persistent (disk) compilation cache: the in-process
+            # _COMPILE_CACHE dies with the worker, but elastic restarts
+            # respawn the process — the disk cache is what turns the
+            # post-restart recompile into a cache hit (VERDICT's
+            # compile-cache-keyed-by-mesh at the granularity that
+            # actually matters for goodput).
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir", self._compile_cache_dir
+                )
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0
+                )
+            except Exception as e:  # old jax without the knobs
+                logger.warning("compile cache unavailable: %s", e)
         if devices is None:
             devices = jax.devices()
         spec = self._mesh_spec or MeshSpec.for_device_count(len(devices))

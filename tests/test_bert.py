@@ -137,3 +137,32 @@ def test_bert_rejects_unsupported_variants():
         config_from_hf_bert(
             transformers.BertConfig(position_embedding_type="relative_key")
         )
+
+
+def test_bert_shards_under_accelerate():
+    """BertModel passes through accelerate() sharding (regression: the
+    mlm_transform square kernel must not use duplicate logical axes)."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.accel.parallel.mesh import MeshSpec
+
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+
+    def loss_fn(params, batch):
+        model = BertModel(cfg)
+        logits = model.apply({"params": params}, batch["input_ids"])
+        lab = jax.nn.one_hot(batch["input_ids"], cfg.vocab_size)
+        loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * lab, axis=-1))
+        return loss, {"weight": jnp.float32(batch["input_ids"].size)}
+
+    res = accelerate(
+        BertModel(cfg),
+        config=AccelerateConfig(mesh_spec=MeshSpec.for_device_count(8, tp=2)),
+        batch_shape=(8, 32),
+        loss_fn=loss_fn,
+    )
+    state = res.init_fn(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128).astype(
+        jnp.int32
+    )
+    state, metrics = res.train_step(state, {"input_ids": ids})
+    assert np.isfinite(float(metrics["loss"]))

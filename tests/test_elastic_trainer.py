@@ -119,3 +119,44 @@ def test_scale_up_resumes_with_identical_trajectory(tmp_path):
     np.testing.assert_allclose(a_losses, ref_losses[:3], rtol=2e-4, atol=2e-4)
     # ... and the restarted world continues the exact trajectory
     np.testing.assert_allclose(b_losses, ref_losses[3:], rtol=2e-4, atol=2e-4)
+
+
+def test_persistent_compile_cache_dir(tmp_path):
+    """prepare() wires the JAX persistent compilation cache so elastic
+    restarts (fresh processes) reuse compiled executables from disk."""
+    import jax
+
+    from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cache = tmp_path / "xla_cache"
+    trainer = ElasticTrainer(
+        LlamaModel(LlamaConfig.tiny(max_seq_len=32)),
+        global_batch_size=8,
+        micro_batch_per_shard=1,
+        seq_len=32,
+        compile_cache_dir=str(cache),
+    )
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        trainer.prepare()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        trainer.restore_or_init(jax.random.PRNGKey(0))
+        import numpy as np
+        import jax.numpy as jnp
+
+        shape = (trainer.plan.micro_batch_global, 32)
+        if trainer.plan.grad_accum_steps > 1:
+            shape = (trainer.plan.grad_accum_steps,) + shape
+        ids = jnp.zeros(shape, jnp.int32)
+        metrics = trainer.train_step(ids)
+        assert np.isfinite(float(metrics["loss"]))
+        # the executable landed in the on-disk cache
+        assert cache.exists() and any(cache.iterdir())
+    finally:
+        # restore global jax config for the rest of the suite
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min
+        )
