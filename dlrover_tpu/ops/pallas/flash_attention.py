@@ -31,9 +31,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Measured on v5e (470M Llama, seq 2048, bf16, head_dim 128): 1024x1024
-# blocks reach 0.60 MFU vs 0.42 at 256x256; 2048 blocks exceed the 16MB
-# scoped-VMEM limit.  _flash_attention_impl clamps to the sequence length.
+# Measured on v5e (470M-class Llama, bf16, head_dim 128): 1024x1024
+# blocks are best in the FULL training step (0.70 MFU at seq 4096).
+# Note: an isolated fwd+bwd kernel microbenchmark prefers 512-wide q
+# tiles by ~16%, but the full model with remat regresses to 0.69 MFU
+# with them — tune against the end-to-end step, not the kernel alone.
+# 2048-wide blocks exceed the 16MB scoped-VMEM limit; _fwd/_bwd clamp
+# blocks to the sequence length.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30

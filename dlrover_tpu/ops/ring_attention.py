@@ -177,7 +177,8 @@ def _chunk_fwd(q, k, v, q_seg, k_seg, causal, scale, use_pallas, interpret):
         return _fwd(
             q, k, v, q_seg, k_seg,
             causal=causal, scale=scale,
-            block_q=_block_size(q.shape[2]), block_k=_block_size(k.shape[2]),
+            block_q=_block_size(q.shape[2]),
+            block_k=_block_size(k.shape[2]),
             interpret=interpret,
         )
     return _xla_chunk_fwd(q, k, v, q_seg, k_seg, causal=causal, scale=scale)
@@ -193,7 +194,8 @@ def _chunk_bwd(
         return _bwd(
             (q, k, v, q_seg, k_seg, o, lse), do,
             causal=causal, scale=scale,
-            block_q=_block_size(q.shape[2]), block_k=_block_size(k.shape[2]),
+            block_q=_block_size(q.shape[2]),
+            block_k=_block_size(k.shape[2]),
             interpret=interpret,
         )
     return _xla_chunk_bwd(
@@ -431,9 +433,8 @@ def ring_attention(
     chunk = q.shape[1] // cp  # local seq after the sp gather
     # zigzag balances the causal ring (every peer computes two half-chunk
     # pairs per step instead of 0..cp); needs even half-chunks
-    if zigzag is None:
-        zigzag = causal and cp > 1 and chunk % 2 == 0
-    zigzag = zigzag and causal and cp > 1 and chunk % 2 == 0
+    # auto (None) and explicit True both require causal + even halves
+    zigzag = (zigzag is not False) and causal and cp > 1 and chunk % 2 == 0
     if zigzag and use_pallas and (
         (chunk // 2) % 128 != 0 and not interpret
     ):

@@ -117,6 +117,7 @@ class ElasticTrainer:
         saver_mode: SaverMode = SaverMode.AUTO,
         metrics_every: int = 1,
         compile_cache_dir: Optional[str] = None,
+        compile_cache_min_secs: Optional[float] = None,
     ):
         self._model = model
         self._global_batch_size = global_batch_size
@@ -144,6 +145,7 @@ class ElasticTrainer:
             if compile_cache_dir is not None
             else os.environ.get("DLROVER_COMPILE_CACHE_DIR")
         )
+        self._compile_cache_min_secs = compile_cache_min_secs
         self._steps_since_report = 0
         self._host_step = 0
 
@@ -161,9 +163,14 @@ class ElasticTrainer:
                 jax.config.update(
                     "jax_compilation_cache_dir", self._compile_cache_dir
                 )
-                jax.config.update(
-                    "jax_persistent_cache_min_compile_time_secs", 1.0
-                )
+                if self._compile_cache_min_secs is not None:
+                    # only override the persistence threshold when the
+                    # user asked — jax's default (and any value they set
+                    # themselves) stands otherwise
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs",
+                        self._compile_cache_min_secs,
+                    )
             except Exception as e:  # old jax without the knobs
                 logger.warning("compile cache unavailable: %s", e)
         if devices is None:

@@ -29,7 +29,8 @@ STEPS = 5
 
 def main() -> None:
     n = len(jax.devices())
-    spec = MeshSpec.for_device_count(n, cp=min(CP, n))
+    cp = next(c for c in range(min(CP, n), 0, -1) if n % c == 0)
+    spec = MeshSpec.for_device_count(n, cp=cp)
     cfg = LlamaConfig.tiny(
         num_heads=4,
         num_kv_heads=4,

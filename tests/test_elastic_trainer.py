@@ -136,6 +136,7 @@ def test_persistent_compile_cache_dir(tmp_path):
         micro_batch_per_shard=1,
         seq_len=32,
         compile_cache_dir=str(cache),
+        compile_cache_min_secs=0.0,  # persist even sub-second compiles
     )
     prev_dir = jax.config.jax_compilation_cache_dir
     prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
