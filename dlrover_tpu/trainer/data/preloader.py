@@ -1,0 +1,43 @@
+"""Device prefetch: stage upcoming batches on the accelerator.
+
+Parity target: the reference's GPU preloader (reference:
+atorch/atorch/data/preloader.py — a CUDA-stream copy of the next batch
+overlapping the current step).  The TPU-native mechanism is simpler:
+``jax.device_put`` is asynchronous, so enqueueing the next ``size``
+batches' transfers keeps host->device DMA overlapped with the running
+step; yielding committed (sharded) arrays also lets ``jit`` skip its
+own blocking transfer at call time.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+
+def device_prefetch(
+    iterator: Iterable[Any],
+    sharding: Optional[Any] = None,
+    size: int = 2,
+) -> Iterator[Any]:
+    """Yield batches with ``size`` device transfers in flight.
+
+    ``sharding`` may be a single sharding applied to every leaf or a
+    pytree prefix of the batch (anything ``jax.device_put`` accepts);
+    None transfers to the default device.
+    """
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    queue: "collections.deque[Any]" = collections.deque()
+    for batch in iterator:
+        queue.append(
+            jax.device_put(batch, sharding)
+            if sharding is not None
+            else jax.device_put(batch)
+        )
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

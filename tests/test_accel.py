@@ -389,3 +389,29 @@ def test_offload_optimizer_states_to_host():
         if leaf.ndim >= 1
     }
     assert kinds == {"pinned_host"}, kinds
+
+
+def test_chunked_loss_under_tensor_parallel_vocab():
+    """Vocab-parallel cross entropy (reference distributed_modules/
+    cross_entropy.py): the chunked fused loss must agree with the plain
+    loss when the lm_head vocab dim is tp-sharded."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    losses = {}
+    for chunk in (None, 8):
+        res = accelerate(
+            LlamaModel(cfg),
+            config=AccelerateConfig(
+                mesh_spec=MeshSpec.for_device_count(8, tp=2),
+                loss_chunk_size=chunk,
+            ),
+            batch_shape=(8, 32),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        _, metrics = res.train_step(state, {"input_ids": ids})
+        losses[chunk] = float(metrics["loss"])
+    np.testing.assert_allclose(losses[8], losses[None], rtol=1e-5)

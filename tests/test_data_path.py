@@ -160,3 +160,30 @@ def test_example_crash_resume_e2e(tmp_path):
     assert result["final_step"] == 8, result
     # async disk persistence produced committed checkpoints
     assert any(p.name.startswith("step-") for p in ckpt.iterdir())
+
+
+def test_device_prefetch_orders_and_places():
+    """device_prefetch (reference preloader parity) preserves order and
+    commits batches to the requested sharding."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dlrover_tpu.accel.parallel.mesh import MeshSpec
+    from dlrover_tpu.trainer.data.preloader import device_prefetch
+
+    mesh = MeshSpec.for_device_count(8).build_mesh()
+    sharding = NamedSharding(mesh, PartitionSpec(("dp", "fsdp")))
+
+    def batches():
+        for i in range(6):
+            yield {"x": np.full((8, 4), i, np.float32)}
+
+    got = list(device_prefetch(batches(), sharding={"x": sharding}, size=2))
+    assert [int(b["x"][0, 0]) for b in got] == list(range(6))
+    assert got[0]["x"].sharding == sharding
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        next(device_prefetch(batches(), size=0))
