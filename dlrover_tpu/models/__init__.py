@@ -5,13 +5,17 @@ modules the reference fast-paths in atorch/atorch/modules/transformer/).
 - :mod:`~dlrover_tpu.models.gpt2` — GPT-2 decoder family
 - :mod:`~dlrover_tpu.models.bert` — bidirectional encoder + MLM head
 - :mod:`~dlrover_tpu.models.convert` — HF checkpoint import/export
+- :mod:`~dlrover_tpu.models.generation` — (cached) decode / sampling
 """
 
 from dlrover_tpu.models.bert import BertConfig, BertModel
+from dlrover_tpu.models.generation import generate, sample_sequences
 from dlrover_tpu.models.gpt2 import GPT2Config, GPT2Model
 from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
 
 __all__ = [
+    "generate",
+    "sample_sequences",
     "BertConfig",
     "BertModel",
     "GPT2Config",

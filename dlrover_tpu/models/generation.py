@@ -1,0 +1,19 @@
+"""Public generation API (re-export of the RL engine's samplers).
+
+Text generation lives with the RL engine (reference shape: rollouts are
+the RL engine's job, atorch/atorch/rl/inference_backend); this module
+gives trainer/serving users a direct import path:
+
+- :func:`sample_sequences` — full-context decode (any causal LM
+  ``apply_fn``); ``temperature=0`` is greedy.
+- :func:`generate` — KV-cache decode on a ``LlamaModel``
+  (``scan_layers=False``): one prefill then O(1)-context steps.
+"""
+
+from dlrover_tpu.rl.generation import (  # noqa: F401
+    sample_sequences,
+    sample_sequences_cached as generate,
+    select_token,
+)
+
+__all__ = ["generate", "sample_sequences", "select_token"]

@@ -123,3 +123,31 @@ def test_roundtrip_hf_export():
         np.testing.assert_allclose(
             hf2(ids).logits.numpy(), hf(ids).logits.numpy(), atol=1e-5
         )
+
+
+def test_greedy_generation_parity_with_hf():
+    """End-to-end: an imported HF checkpoint greedy-decodes the same
+    tokens as transformers' generate() (KV-cache path)."""
+    from dlrover_tpu.models.convert import load_hf_llama
+    from dlrover_tpu.models.generation import generate
+    from dlrover_tpu.models.llama import LlamaModel
+
+    hf = _tiny_hf_model().eval()
+    cfg, params = load_hf_llama(
+        hf, scan_layers=False, remat=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    prompts = np.array([[5, 17, 42, 7]], dtype=np.int64)
+    new = 6
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.from_numpy(prompts), max_new_tokens=new, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+    model = LlamaModel(cfg)
+    tokens, mask = generate(
+        model, {"params": params}, jnp.asarray(prompts, jnp.int32),
+        max_new_tokens=new, rng=jax.random.PRNGKey(0), temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(tokens), ref)
+    assert int(mask.sum()) == new
