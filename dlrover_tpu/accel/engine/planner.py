@@ -41,16 +41,20 @@ class ModelInfo:
 
     @classmethod
     def from_llama_config(cls, cfg) -> "ModelInfo":
+        """Works for any model config exposing the Llama-style fields;
+        GPT-2/BERT lack kv heads / experts / scan flags — default them."""
         return cls(
             num_params=cfg.num_params,
             num_layers=cfg.num_layers,
             num_heads=cfg.num_heads,
-            num_kv_heads=cfg.num_kv_heads,
+            num_kv_heads=getattr(cfg, "num_kv_heads", cfg.num_heads),
             hidden_size=cfg.hidden_size,
             vocab_size=cfg.vocab_size,
-            scan_layers=cfg.scan_layers,
-            num_experts=cfg.num_experts,
+            scan_layers=getattr(cfg, "scan_layers", False),
+            num_experts=getattr(cfg, "num_experts", 0),
         )
+
+    from_config = from_llama_config
 
 
 @dataclasses.dataclass

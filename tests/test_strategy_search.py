@@ -104,3 +104,30 @@ def test_auto_accelerate_end_to_end():
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_auto_accelerate_gpt2_family():
+    """Strategy search handles config families without Llama-only fields
+    (GPT-2 lacks num_kv_heads / num_experts / scan_layers)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.accel.engine import auto_accelerate
+    from dlrover_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    res, report = auto_accelerate(
+        GPT2Model(cfg),
+        batch_shape=(8, 64),
+        max_candidates=3,
+        profile_steps=1,
+        warmup_steps=1,
+    )
+    state = res.init_fn(jax.random.PRNGKey(0))
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    _, metrics = res.train_step(state, {"input_ids": ids})
+    assert np.isfinite(float(metrics["loss"]))
+    assert report.best is not None
