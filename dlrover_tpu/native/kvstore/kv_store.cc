@@ -755,6 +755,32 @@ int64_t kv_apply_group_adam(void* h, const int64_t* ids, const float* grads,
                     });
 }
 
+// slots: [accum] — group-lasso Adagrad: adagrad step then per-row l2,1
+// proximal shrink (reference: tfplus group "Rectified" family,
+// arXiv:2107.14432 — the adagrad counterpart of kv_apply_group_adam).
+int64_t kv_apply_group_adagrad(void* h, const int64_t* ids,
+                               const float* grads, int64_t n, float lr,
+                               float eps, float l21) {
+  Table* t = static_cast<Table*>(h);
+  uint32_t dim = t->dim;
+  return apply_impl(t, ids, grads, n,
+                    [&](float* w, float* slots, const float* g) {
+                      float* acc = slots;
+                      for (uint32_t d = 0; d < dim; ++d) {
+                        acc[d] += g[d] * g[d];
+                        w[d] -= lr * g[d] / (std::sqrt(acc[d]) + eps);
+                      }
+                      if (l21 > 0) {
+                        float norm = 0;
+                        for (uint32_t d = 0; d < dim; ++d) norm += w[d] * w[d];
+                        norm = std::sqrt(norm);
+                        float shrink =
+                            norm > lr * l21 ? (norm - lr * l21) / norm : 0.0f;
+                        for (uint32_t d = 0; d < dim; ++d) w[d] *= shrink;
+                      }
+                    });
+}
+
 // slots: [m, v] — AdaHessian (Yao et al. 2021): second moment from the
 // Hutchinson hessian-diagonal estimate instead of g^2 (reference:
 // tfplus kernels/training_ops.cc ApplyAdaHessian functor /

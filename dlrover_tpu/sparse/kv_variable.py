@@ -33,6 +33,7 @@ OPTIMIZER_SLOTS = {
     "ftrl": 2,
     "adabelief": 2,
     "group_adam": 2,
+    "group_adagrad": 1,
     "adadelta": 2,
     "lamb": 2,
     "amsgrad": 3,
@@ -238,6 +239,10 @@ class KvVariable:
             return int(lib.kv_apply_adabelief(h, idp, gp, n, o.learning_rate,
                                               o.beta1, o.beta2, o.eps,
                                               self._step))
+        if o.name == "group_adagrad":
+            return int(lib.kv_apply_group_adagrad(h, idp, gp, n,
+                                                  o.learning_rate, o.eps,
+                                                  o.group_l21))
         if o.name == "group_adam":
             return int(lib.kv_apply_group_adam(h, idp, gp, n, o.learning_rate,
                                                o.beta1, o.beta2, o.eps,

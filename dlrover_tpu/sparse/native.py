@@ -120,6 +120,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kv_apply_lamb.argtypes = [
         c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
         c.c_float, c.c_float, c.c_float, c.c_int64, c.c_float]
+    lib.kv_apply_group_adagrad.restype = c.c_int64
+    lib.kv_apply_group_adagrad.argtypes = [
+        c.c_void_p, p(c.c_int64), p(c.c_float), c.c_int64, c.c_float,
+        c.c_float, c.c_float]
     lib.kv_apply_adahessian.restype = c.c_int64
     lib.kv_apply_adahessian.argtypes = [
         c.c_void_p, p(c.c_int64), p(c.c_float), p(c.c_float), c.c_int64,

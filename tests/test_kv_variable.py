@@ -452,3 +452,37 @@ def test_adadqh_and_lamb_hessian_descend():
         w, _ = v.lookup(ids, train=False)
         last = float(np.sum((w - target) ** 2))
         assert last < first * factor, (name, first, last)
+
+
+def test_group_adagrad_l21_shrinks_rows():
+    """Group-lasso adagrad: small-gradient rows shrink to zero under the
+    l2,1 prox while trained rows survive (rectified group family)."""
+    cfg = KvOptimizerConfig(learning_rate=0.1, group_l21=0.5)
+    v = KvVariable(dim=4, optimizer="group_adagrad", init_scale=0.1,
+                   seed=3, opt_config=cfg)
+    ids = np.array([1, 2], dtype=np.int64)
+    v.lookup(ids)
+    big = np.zeros((2, 4), np.float32)
+    big[0] = 5.0   # row 1 gets real gradient signal
+    for _ in range(20):
+        v.apply_gradients(ids, big)
+    out, _ = v.lookup(ids, train=False)
+    assert np.linalg.norm(out[1]) == 0.0        # untrained row: zeroed
+    assert np.linalg.norm(out[0]) > 0.1          # trained row: survives
+
+    # numpy parity without regularization
+    cfg2 = KvOptimizerConfig(learning_rate=0.05)
+    v2 = KvVariable(dim=3, optimizer="group_adagrad", init_scale=0.1,
+                    seed=7, opt_config=cfg2)
+    ids2 = np.array([9], dtype=np.int64)
+    w, _ = v2.lookup(ids2)
+    w = w.astype(np.float64)
+    acc = np.zeros_like(w)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        g = rng.randn(1, 3).astype(np.float32)
+        v2.apply_gradients(ids2, g)
+        acc += g.astype(np.float64) ** 2
+        w -= cfg2.learning_rate * g / (np.sqrt(acc) + cfg2.eps)
+    out, _ = v2.lookup(ids2, train=False)
+    np.testing.assert_allclose(out, w, rtol=1e-4, atol=1e-5)
