@@ -44,6 +44,17 @@ class GPT2Config:
     # output-logit multiplier; muP's explicit convention sets this to
     # base_width/width on tied-embedding models (accel/mup.py)
     logit_scale: float = 1.0
+    # fp8 matmuls in every projection (dlrover_tpu.ops.fp8; same recipe
+    # as LlamaConfig.fp8 — lm_head excluded, it's the tied embedding)
+    fp8: bool = False
+
+    @property
+    def dot_general(self):
+        if self.fp8:
+            from dlrover_tpu.ops.fp8 import fp8_dot_general
+
+            return fp8_dot_general
+        return jax.lax.dot_general
 
     @property
     def head_dim(self) -> int:
@@ -110,6 +121,7 @@ class GPT2Attention(nn.Module):
         qkv = nn.DenseGeneral(
             (3, nh, d), axis=-1, use_bias=True,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("embed", None, "heads", "head_dim")
             ),
@@ -127,6 +139,7 @@ class GPT2Attention(nn.Module):
         return nn.DenseGeneral(
             h, axis=(-2, -1), use_bias=True,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("heads", "head_dim", "embed")
             ),
@@ -149,6 +162,7 @@ class GPT2Block(nn.Module):
         up = nn.DenseGeneral(
             cfg.intermediate_size, use_bias=True,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(init, ("embed", "mlp")),
             name="c_fc",
         )(h)
@@ -157,6 +171,7 @@ class GPT2Block(nn.Module):
         down = nn.DenseGeneral(
             cfg.hidden_size, use_bias=True,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(init, ("mlp", "embed")),
             name="c_proj",
         )(up)

@@ -118,3 +118,29 @@ def test_moe_fp8_trains():
     assert abs(losses["fp8"] - losses["bf16"]) < 0.3, losses
     # fp8 must actually change the numerics (quantization is engaged)
     assert losses["fp8"] != losses["bf16"], losses
+
+
+def test_gpt2_fp8_trains():
+    """GPT2Config(fp8=True) quantizes its projections (family parity)."""
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.accel.parallel.mesh import MeshSpec
+    from dlrover_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 64), 0, 128
+    ).astype(jnp.int32)
+    losses = {}
+    for mode in ("fp8", "bf16"):
+        cfg = GPT2Config.tiny(fp8=(mode == "fp8"))
+        res = accelerate(
+            GPT2Model(cfg),
+            config=AccelerateConfig(mesh_spec=MeshSpec.for_device_count(8)),
+            batch_shape=(8, 64),
+        )
+        state = res.init_fn(jax.random.PRNGKey(0))
+        for _ in range(2):
+            state, metrics = res.train_step(state, {"input_ids": ids})
+        losses[mode] = float(metrics["loss"])
+    assert np.isfinite(losses["fp8"])
+    assert abs(losses["fp8"] - losses["bf16"]) < 0.3, losses
+    assert losses["fp8"] != losses["bf16"]  # quantization engaged
