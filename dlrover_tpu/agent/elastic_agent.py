@@ -273,13 +273,23 @@ class ElasticAgent:
             try:
                 self._client.report_heart_beat(time.time())
             except Exception as e:
-                logger.warning("heartbeat failed: %s", e)
+                # a shutdown that closed the channel mid-RPC is expected
+                if not self._stop_heartbeat.is_set():
+                    logger.warning("heartbeat failed: %s", e)
 
     def start_heartbeat(self) -> None:
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="agent-heartbeat"
         )
         self._heartbeat_thread.start()
+
+    def stop_heartbeat(self, timeout: float = 5.0) -> None:
+        """Stop and join the heartbeat thread BEFORE the master channel
+        closes, so no RPC races the close (advisor r2 weak #7)."""
+        self._stop_heartbeat.set()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout)
+            self._heartbeat_thread = None
 
     # -- lifecycle -------------------------------------------------------
     def _initialize_workers(self) -> RendezvousResult:
@@ -455,7 +465,7 @@ class ElasticAgent:
                         f"{waiting} node(s) waiting to join"
                     )
         finally:
-            self._stop_heartbeat.set()
+            self.stop_heartbeat()
             if self._training_monitor is not None:
                 self._training_monitor.stop()
             if self._resource_monitor is not None:

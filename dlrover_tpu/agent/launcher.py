@@ -182,6 +182,7 @@ def run(args: argparse.Namespace) -> int:
     try:
         return agent.run()
     finally:
+        agent.stop_heartbeat()
         client.close()
         if master_proc is not None:
             # Give the master a moment to publish final job state.

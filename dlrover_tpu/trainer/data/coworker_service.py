@@ -109,6 +109,16 @@ class CoworkerDataService:
             self._done.set()
 
     def _handle_get(self, request: bytes, context) -> bytes:
+        """Pop and return one batch.
+
+        Delivery is at-most-once: the batch is dequeued before the
+        response is known to be delivered, so a client-side deadline or
+        transport failure after the server-side pop drops that batch and
+        slightly shrinks the epoch.  That is the intended trade for
+        pretraining streams (same stance as the reference's coworker
+        path); exactly-once would need client acks and server-side
+        redelivery state for no training-quality gain.
+        """
         deadline = time.monotonic() + self._get_timeout_s
         while time.monotonic() < deadline:
             try:
@@ -158,7 +168,8 @@ class RemoteBatchIterator:
         self._refresh_fn = refresh_fn
         self._refresh_interval_s = refresh_interval_s
         self._stubs: Dict[str, RpcStub] = {}
-        self._failures: Dict[str, int] = {}
+        # float: deadline-exceeded errors count at half weight
+        self._failures: Dict[str, float] = {}
         self._ended: Dict[str, bool] = {}
         for a in addrs:
             self._add_addr(a)
@@ -224,7 +235,7 @@ class RemoteBatchIterator:
                             [a for a in self._stubs
                              if self._failures[a] >= self._max_failures],
                         )
-                    self._queue.put(StopIteration)
+                    self._put_terminal(StopIteration)
                     return
                 time.sleep(0.5)
                 continue
@@ -233,10 +244,19 @@ class RemoteBatchIterator:
             try:
                 payload = self._stubs[addr].get(b"get_batch")
             except Exception as e:
-                self._failures[addr] += 1
+                # A deadline on a slow-but-healthy coworker is not the
+                # same signal as a refused connection: count it at half
+                # weight so congestion alone doesn't exclude the node.
+                import grpc as _grpc
+
+                is_deadline = (
+                    isinstance(e, _grpc.RpcError)
+                    and e.code() == _grpc.StatusCode.DEADLINE_EXCEEDED
+                )
+                self._failures[addr] += 0.5 if is_deadline else 1
                 if self._failures[addr] >= self._max_failures:
                     logger.warning(
-                        "excluding coworker %s after %d failures (%s)",
+                        "excluding coworker %s after %s failures (%s)",
                         addr, self._failures[addr], e,
                     )
                 continue
@@ -245,7 +265,7 @@ class RemoteBatchIterator:
                 self._ended[addr] = True
                 continue
             if payload == _ERROR:
-                self._queue.put(RuntimeError(
+                self._put_terminal(RuntimeError(
                     f"coworker {addr} input pipeline failed (see its logs)"
                 ))
                 return
@@ -264,11 +284,37 @@ class RemoteBatchIterator:
                 except queue.Full:
                     continue
 
+    def _put_terminal(self, item) -> None:
+        """Enqueue the end-of-stream sentinel/exception with the same
+        stop-aware timeout loop as normal batches; a blocking put on a
+        full queue after the consumer left would wedge the thread."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=1.0)
+                return
+            except queue.Full:
+                continue
+        # stop raced the terminal put: a consumer may still be blocked in
+        # __next__ on an empty queue — one non-blocking attempt delivers
+        # the sentinel in that (empty-queue) case
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            pass
+
     def __iter__(self) -> "RemoteBatchIterator":
         return self
 
     def __next__(self) -> Dict[str, np.ndarray]:
-        item = self._queue.get()
+        # stop-aware: close() during a blocked get must end the stream,
+        # not hang forever (the pull thread is gone after stop)
+        while True:
+            try:
+                item = self._queue.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration from None
         if item is StopIteration:
             raise StopIteration
         if isinstance(item, Exception):

@@ -242,6 +242,10 @@ class ElasticTrainer:
         never forces a device sync on the async-dispatched train state."""
         return self._host_step
 
+    @property
+    def seq_len(self) -> int:
+        return self._seq_len
+
     # -- training ---------------------------------------------------------
     def _shape_batch(self, batch: Any) -> Any:
         """Accepts [global_batch, seq] (splits into microbatches) or an

@@ -34,8 +34,13 @@ class IntervalStrategy:
 
 @dataclasses.dataclass
 class TrainingArguments:
-    """Subset of the reference AtorchTrainingArgs that is meaningful on
-    TPU (device-placement/fp16 flags are superseded by accelerate())."""
+    """The reference AtorchTrainingArgs surface that is meaningful on
+    TPU (device-placement/fp16 flags are superseded by accelerate()).
+
+    Optimizer knobs (learning_rate/warmup/scheduler/weight_decay) build
+    an optax chain when the caller does not hand ``Trainer`` an explicit
+    ``optimizer=`` (reference atorch_trainer.py create_optimizer /
+    create_scheduler)."""
 
     max_steps: int = -1              # -1: derive from epochs * loader len
     num_train_epochs: int = 1
@@ -44,6 +49,55 @@ class TrainingArguments:
     eval_steps: int = 100
     save_strategy: str = IntervalStrategy.STEPS
     seed: int = 0
+    # optimizer / schedule
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    lr_scheduler_type: str = "cosine"   # cosine | linear | constant
+    warmup_steps: int = 0
+    warmup_ratio: float = 0.0            # used when warmup_steps == 0
+    min_lr_ratio: float = 0.0            # decay floor as lr fraction
+
+    def make_schedule(self, total_steps: int):
+        """Warmup + decay schedule (HF/atorch get_scheduler shape)."""
+        import optax
+
+        total = max(1, total_steps)
+        warmup = self.warmup_steps or int(self.warmup_ratio * total)
+        peak, floor = self.learning_rate, self.learning_rate * self.min_lr_ratio
+        if self.lr_scheduler_type == "constant":
+            decay = optax.constant_schedule(peak)
+        elif self.lr_scheduler_type == "linear":
+            decay = optax.linear_schedule(
+                peak, floor, max(1, total - warmup)
+            )
+        elif self.lr_scheduler_type == "cosine":
+            decay = optax.cosine_decay_schedule(
+                peak, max(1, total - warmup), alpha=self.min_lr_ratio
+            )
+        else:
+            raise ValueError(
+                f"unknown lr_scheduler_type {self.lr_scheduler_type!r}"
+            )
+        if warmup <= 0:
+            return decay
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, peak, warmup), decay], [warmup]
+        )
+
+    def make_optimizer(self, total_steps: int):
+        import optax
+
+        schedule = self.make_schedule(total_steps)
+        return optax.adamw(
+            schedule,
+            b1=self.adam_beta1,
+            b2=self.adam_beta2,
+            eps=self.adam_epsilon,
+            weight_decay=self.weight_decay,
+        ), schedule
 
 
 class TrainerCallback:
@@ -86,6 +140,30 @@ class Trainer:
         self.train_dataloader = train_dataloader
         self.eval_dataloader = eval_dataloader
         self.callbacks = callbacks or []
+        self._schedule = None
+        if elastic_kwargs.get("optimizer") is None:
+            total = args.max_steps
+            if total <= 0:
+                try:
+                    total = args.num_train_epochs * len(train_dataloader)
+                except TypeError:
+                    # Horizon unknown (streaming loader, no max_steps): a
+                    # decaying schedule would silently hit its floor at an
+                    # arbitrary step, so force constant LR instead.
+                    if args.lr_scheduler_type != "constant":
+                        logger.warning(
+                            "max_steps not set and dataloader has no len(); "
+                            "using constant LR %s instead of %s schedule",
+                            args.learning_rate, args.lr_scheduler_type,
+                        )
+                        args = dataclasses.replace(
+                            args, lr_scheduler_type="constant"
+                        )
+                        self.args = args
+                    total = 1
+            elastic_kwargs["optimizer"], self._schedule = (
+                args.make_optimizer(total)
+            )
         self.elastic = ElasticTrainer(model, **elastic_kwargs)
         self.log_history: List[Dict[str, float]] = []
         self._loss_sum = 0.0
@@ -132,15 +210,27 @@ class Trainer:
                 if (self.args.logging_steps > 0
                         and step % self.args.logging_steps == 0):
                     now = time.time()
+                    sps = steps_since_log / max(1e-9, now - t_last_log)
                     logs = {
                         "step": step,
                         "epoch": epoch,
                         "loss": loss,
                         # actual steps in this window (a resume can land
                         # mid-window, so logging_steps would over-count)
-                        "steps_per_sec": steps_since_log / max(
-                            1e-9, now - t_last_log),
+                        "steps_per_sec": sps,
                     }
+                    if "grad_norm" in metrics:
+                        logs["grad_norm"] = float(
+                            jax.device_get(metrics["grad_norm"])
+                        )
+                    if self._schedule is not None:
+                        logs["learning_rate"] = float(self._schedule(step))
+                    plan = self.elastic.plan
+                    if plan is not None:
+                        logs["tokens_per_sec"] = round(
+                            sps * plan.global_batch_size
+                            * self.elastic.seq_len
+                        )
                     t_last_log = now
                     steps_since_log = 0
                     self.log_history.append(logs)

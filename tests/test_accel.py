@@ -391,6 +391,62 @@ def test_offload_optimizer_states_to_host():
     assert kinds == {"pinned_host"}, kinds
 
 
+def test_offload_streaming_roundtrip_logic():
+    """Backend-independent check of _offload_streaming: the wrapped
+    update must hand the inner tx a device-kind state and return a
+    pinned_host-kind state, leaving scalar / unsharded leaves untouched
+    (covers the wrapper even where memory kinds are unsupported)."""
+    import optax
+
+    from dlrover_tpu.accel import accelerate as accel_mod
+    from dlrover_tpu.accel.accelerate import _offload_streaming
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("dp",))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    moved = []
+    real_device_put = jax.device_put
+
+    def fake_device_put(x, dst):
+        moved.append((getattr(x, "_tag", "?"), dst.memory_kind))
+        y = np.asarray(x).view(np.ndarray).copy()
+        out = _Tagged(y, dst.memory_kind)
+        return out
+
+    class _Tagged(np.ndarray):
+        def __new__(cls, arr, tag):
+            obj = np.asarray(arr).view(cls)
+            obj._tag = tag
+            return obj
+
+    seen = {}
+
+    def inner_update(grads, state, params=None):
+        seen["state"] = state
+        return grads, state
+
+    tx = optax.GradientTransformation(lambda p: None, inner_update)
+    cell = {"tree": {"mu": sh, "count": sh}}
+    wrapped = _offload_streaming(tx, cell)
+
+    state = {"mu": _Tagged(np.ones((4,)), "host"), "count": np.int32(3)}
+    grads = {"mu": np.ones((4,)), "count": np.int32(0)}
+    jax.device_put = fake_device_put
+    try:
+        _, new_state = wrapped.update(grads, state, None)
+    finally:
+        jax.device_put = real_device_put
+    # inner tx saw the device-kind copy of the vector state
+    assert seen["state"]["mu"]._tag == "device"
+    # scalar (ndim 0) leaf passed through both directions untouched
+    assert seen["state"]["count"] == 3
+    assert int(new_state["count"]) == 3
+    # returned vector state went back to pinned_host
+    assert new_state["mu"]._tag == "pinned_host"
+    kinds = [k for _, k in moved]
+    assert kinds == ["device", "pinned_host"], kinds
+
+
 def test_chunked_loss_under_tensor_parallel_vocab():
     """Vocab-parallel cross entropy (reference distributed_modules/
     cross_entropy.py): the chunked fused loss must agree with the plain

@@ -145,3 +145,34 @@ def test_resume_from_checkpoint(tmp_path):
     trainer2, _ = _make_trainer(tmp_path, max_steps=8)
     out = trainer2.train()
     assert out.global_step == 8
+
+
+def test_lr_schedule_shapes():
+    """warmup + cosine/linear/constant schedules (reference
+    atorch_trainer.py create_scheduler surface)."""
+    from dlrover_tpu.trainer.trainer import TrainingArguments
+
+    args = TrainingArguments(
+        learning_rate=1e-3, warmup_steps=10, lr_scheduler_type="cosine",
+        min_lr_ratio=0.1,
+    )
+    sched = args.make_schedule(100)
+    assert float(sched(0)) == 0.0
+    assert np.isclose(float(sched(10)), 1e-3)
+    # cosine decays monotonically to the floor
+    assert float(sched(55)) < 1e-3
+    assert np.isclose(float(sched(100)), 1e-4, rtol=1e-2)
+
+    lin = TrainingArguments(
+        learning_rate=2e-4, warmup_ratio=0.1, lr_scheduler_type="linear"
+    ).make_schedule(100)
+    assert np.isclose(float(lin(10)), 2e-4)
+    assert np.isclose(float(lin(100)), 0.0, atol=1e-9)
+
+    const = TrainingArguments(
+        learning_rate=5e-4, lr_scheduler_type="constant"
+    ).make_schedule(100)
+    assert np.isclose(float(const(77)), 5e-4)
+
+    opt, sched2 = TrainingArguments(learning_rate=1e-3).make_optimizer(50)
+    assert hasattr(opt, "update") and sched2 is not None
