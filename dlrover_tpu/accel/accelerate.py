@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -457,9 +458,28 @@ def accelerate(
         donate_argnums=donate,
     )
 
+    def _globalize(batch, sharding):
+        """Multi-process: numpy inputs cannot be auto-sharded by jit (each
+        process owns only its addressable shards).  The data contract is
+        SPMD: every process supplies the identical full global batch; the
+        callback hands each device its slice, so no cross-process data
+        movement happens (reference: the per-rank sampler slicing in
+        elastic/sampler.py does the same split host-side)."""
+        if jax.process_count() == 1:
+            return batch
+
+        def conv(x):
+            if not isinstance(x, np.ndarray):
+                return x
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx]
+            )
+
+        return jax.tree_util.tree_map(conv, batch)
+
     def train_step(state, batch):
         with rules_ctx(), mesh:
-            return jit_train(state, batch)
+            return jit_train(state, _globalize(batch, batch_sharding))
 
     # ---------------- eval step ----------------
     def _eval_step(state: TrainState, batch: Dict[str, jax.Array]):
@@ -473,7 +493,7 @@ def accelerate(
 
     def eval_step(state, batch):
         with rules_ctx(), mesh:
-            return jit_eval(state, batch)
+            return jit_eval(state, _globalize(batch, eval_sharding))
 
     return AccelerateResult(
         mesh=mesh,

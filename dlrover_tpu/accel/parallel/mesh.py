@@ -64,12 +64,25 @@ class MeshSpec:
     sp: int = 1
     ep: int = 1
     tp: int = 1
+    # Hybrid ICI x DCN layout: the outer `dcn_dp` factor of the dp axis
+    # strides across slices/hosts (DCN links), everything else stays
+    # inside one slice (ICI).  Pure layout metadata — the mesh axes and
+    # their sizes are unchanged; only the device assignment differs.
+    # (reference: atorch distributed.py:323-396 node-spanning data groups
+    # + net_topology.py:62 locality-aware dp placement; scaling-book
+    # recipe: dp outer over DCN.)
+    dcn_dp: int = 1
 
     def __post_init__(self) -> None:
-        for name in MESH_AXES:
+        for name in MESH_AXES + ("dcn_dp",):
             v = getattr(self, name)
             if not isinstance(v, int) or v < 1:
                 raise ValueError(f"mesh dim {name!r} must be a positive int, got {v!r}")
+        if self.dp % self.dcn_dp:
+            raise ValueError(
+                f"dcn_dp={self.dcn_dp} must divide dp={self.dp} (the DCN "
+                "replicas are the outer factor of the dp axis)"
+            )
 
     @property
     def size(self) -> int:
@@ -89,6 +102,10 @@ class MeshSpec:
                 f"MeshSpec size {self.size} ({self.dims}) != device count {n}"
             )
         shape = tuple(getattr(self, name) for name in MESH_AXES)
+        if self.dcn_dp > 1:
+            return Mesh(
+                _hybrid_device_array(self, devices), MESH_AXES
+            )
         try:
             # Let JAX pick an ICI-friendly physical layout when possible.
             from jax.experimental import mesh_utils
@@ -129,6 +146,137 @@ class MeshSpec:
         if rest % fsdp:
             raise ValueError(f"remainder {rest} not divisible by fsdp={fsdp}")
         return cls(dp=rest // fsdp, fsdp=fsdp, pp=pp, cp=cp, sp=sp, ep=ep, tp=tp)
+
+    @classmethod
+    def hybrid(
+        cls,
+        n_slices: int,
+        devices_per_slice: int,
+        **inner: int,
+    ) -> "MeshSpec":
+        """Multi-slice spec: pure-dp replicas over DCN (one per slice),
+        ``inner`` axes (fsdp/tp/pp/...) inside each slice over ICI.
+
+        ``MeshSpec.hybrid(2, 4, fsdp=4)`` = 2 slices x 4 chips, FSDP
+        within the slice, gradient all-reduce across slices over DCN —
+        the scaling-book layout for multi-pod training.
+        """
+        inner_size = math.prod(inner.values()) if inner else 1
+        if devices_per_slice % inner_size:
+            raise ValueError(
+                f"inner axes {inner} (size {inner_size}) do not divide "
+                f"devices_per_slice={devices_per_slice}"
+            )
+        inner_dp = inner.pop("dp", 1) * (devices_per_slice // inner_size)
+        if "fsdp" not in inner and inner_size == 1:
+            # no inner strategy given: default the slice-local remainder
+            # to fsdp (mirrors for_device_count), dp carries only DCN
+            inner["fsdp"] = devices_per_slice
+            inner_dp = 1
+        return cls(dp=n_slices * inner_dp, dcn_dp=n_slices, **inner)
+
+
+def _device_slice_groups(
+    devices: Sequence[Any], n_groups: int
+) -> list:
+    """Partition ``devices`` into DCN granules (slices/hosts).
+
+    Priority: the TPU ``slice_index`` attribute (real multi-slice), then
+    ``process_index`` (multi-host CPU/GPU), then contiguous chunks (a
+    single-process emulation, e.g. the virtual-device dryrun).
+    """
+    for attr in ("slice_index", "process_index"):
+        keys = []
+        for d in devices:
+            k = getattr(d, attr, None)
+            if k is None:
+                keys = None
+                break
+            keys.append(k)
+        if keys and len(set(keys)) > 1:
+            groups: dict = {}
+            for d, k in zip(devices, keys):
+                groups.setdefault(k, []).append(d)
+            return [groups[k] for k in sorted(groups)]
+    chunk = len(devices) // n_groups
+    return [
+        list(devices[i * chunk: (i + 1) * chunk]) for i in range(n_groups)
+    ]
+
+
+def _hybrid_device_array(spec: MeshSpec, devices: Sequence[Any]) -> np.ndarray:
+    """Device array whose outer dp factor strides across DCN granules.
+
+    Shape ``(dp, fsdp, pp, cp, sp, ep, tp)`` where dp index
+    ``g * inner_dp + i`` lives entirely in granule ``g`` for the non-dp
+    axes — so fsdp/tp/cp/sp/ep collectives ride ICI and only the dp
+    gradient all-reduce crosses DCN.
+    """
+    groups = _device_slice_groups(devices, spec.dcn_dp)
+    if len(groups) % spec.dcn_dp:
+        raise ValueError(
+            f"found {len(groups)} device granules, not divisible by "
+            f"dcn_dp={spec.dcn_dp}"
+        )
+    # several granules per DCN replica (e.g. 2 hosts per slice): merge
+    # consecutive granules
+    per = len(groups) // spec.dcn_dp
+    merged = [
+        [d for g in groups[i * per: (i + 1) * per] for d in g]
+        for i in range(spec.dcn_dp)
+    ]
+    inner_dp = spec.dp // spec.dcn_dp
+    inner_shape = (inner_dp,) + tuple(
+        getattr(spec, name) for name in MESH_AXES[1:]
+    )
+    blocks = []
+    for g, devs in enumerate(merged):
+        if len(devs) != math.prod(inner_shape):
+            raise ValueError(
+                f"granule {g} has {len(devs)} devices, expected "
+                f"{math.prod(inner_shape)} for inner shape {inner_shape}"
+            )
+        try:
+            from jax.experimental import mesh_utils
+
+            block = mesh_utils.create_device_mesh(
+                inner_shape, devices=np.asarray(devs)
+            )
+        except Exception:
+            block = np.asarray(devs).reshape(inner_shape)
+        blocks.append(block)
+    return np.concatenate(blocks, axis=0)
+
+
+def check_dcn_adjacency(mesh: Mesh, dcn_dp: int) -> None:
+    """Assert the hybrid layout invariant: each dp-outer block (one DCN
+    replica) lives entirely inside one DCN granule, i.e. the high-traffic
+    fsdp/tp/cp/sp/ep collectives never cross DCN; only dp-outer
+    neighbours do."""
+    arr = mesh.devices
+    devices = sorted(arr.flatten().tolist(), key=lambda d: d.id)
+    groups = _device_slice_groups(devices, dcn_dp)
+    per = max(1, len(groups) // dcn_dp)
+    label: dict = {}
+    for gi, g in enumerate(groups):
+        for d in g:
+            label[d.id] = gi // per
+    inner_dp = arr.shape[0] // dcn_dp
+    block_labels = []
+    for b in range(dcn_dp):
+        block = arr[b * inner_dp: (b + 1) * inner_dp]
+        labels = {label[d.id] for d in block.flat}
+        if len(labels) != 1:
+            raise AssertionError(
+                f"dp-outer block {b} spans DCN granules {labels}; "
+                "fsdp/tp collectives would cross DCN"
+            )
+        block_labels.append(labels.pop())
+    if len(set(block_labels)) != dcn_dp:
+        raise AssertionError(
+            f"dp-outer blocks map to granules {block_labels}; each DCN "
+            "replica must own a distinct granule"
+        )
 
 
 # ---------------------------------------------------------------------------

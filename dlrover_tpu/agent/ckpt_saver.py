@@ -147,7 +147,13 @@ class AsyncCheckpointSaver:
     def _final_dir(self, step: int) -> str:
         return os.path.join(self.checkpoint_dir, f"{CKPT_DIR_PREFIX}{step}")
 
-    def _save_step_checkpoint(self, step: int, reclaim_locks: bool = False) -> None:
+    def _save_step_checkpoint(
+        self,
+        step: int,
+        reclaim_locks: bool = False,
+        commit_timeout: float = 600.0,
+        commit_async: bool = False,
+    ) -> None:
         """Persist all local shards and commit.
 
         ``reclaim_locks``: force-release a held shm lock before acquiring —
@@ -190,7 +196,20 @@ class AsyncCheckpointSaver:
             # than requested, the shard landed in that step's stage dir and
             # the commit must target it (not the stale requested step).
             for actual in sorted(persisted_steps):
-                self.commit_checkpoint(actual)
+                if commit_async:
+                    # shard files + done-file are on storage already; only
+                    # the cross-node done-file WAIT runs off-thread (it can
+                    # never finish when a peer node died, and the caller —
+                    # the agent's restart path — must not block on it)
+                    threading.Thread(
+                        target=self.commit_checkpoint,
+                        args=(actual,),
+                        kwargs={"timeout": commit_timeout},
+                        daemon=True,
+                        name=f"ckpt-commit-{actual}",
+                    ).start()
+                else:
+                    self.commit_checkpoint(actual, timeout=commit_timeout)
 
     def _persist_shard(
         self,
@@ -278,7 +297,9 @@ class AsyncCheckpointSaver:
         self.storage.commit(step, True)
 
     # -- failure path -----------------------------------------------------
-    def save_shm_to_storage(self) -> None:
+    def save_shm_to_storage(
+        self, commit_timeout: float = 30.0, commit_async: bool = False
+    ) -> None:
         """Persist whatever valid state is in shm (called by the agent when
         workers fail, so the in-memory checkpoint survives the restart).
 
@@ -294,8 +315,15 @@ class AsyncCheckpointSaver:
         if not steps or max(steps) <= self._last_persisted_step:
             return
         # Workers are dead when the agent takes this path, so a lock left
-        # held by a crashed writer is reclaimable.
-        self._save_step_checkpoint(max(steps), reclaim_locks=True)
+        # held by a crashed writer is reclaimable.  The commit wait is
+        # SHORT: when a PEER node died, its done-file never appears and a
+        # 600s wait here would stall this node's recovery (the restarted
+        # workers restore from shm anyway; the persisted shards still
+        # land and a later full-world save commits normally).
+        self._save_step_checkpoint(
+            max(steps), reclaim_locks=True, commit_timeout=commit_timeout,
+            commit_async=commit_async,
+        )
 
     # -- singleton --------------------------------------------------------
     @classmethod

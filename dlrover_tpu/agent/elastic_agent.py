@@ -254,16 +254,26 @@ class ElasticAgent:
         self._saver_factory = SaverFactory()
         self._saver_factory.start()
 
-    def _save_shm_checkpoint(self) -> None:
+    def _save_shm_checkpoint(self, commit_async: bool = False) -> None:
         """Persist any in-memory checkpoint before a restart/exit wipes the
-        workers (reference: training.py:662-672)."""
+        workers (reference: training.py:662-672).
+
+        The shard writes always run synchronously HERE, before any worker
+        respawn — the lock reclaim inside is only sound while no worker
+        is alive.  ``commit_async=True`` (the restart path) moves just the
+        cross-node done-file wait off-thread: when a PEER node died that
+        wait cannot finish and must not delay this node's re-rendezvous.
+        The terminal (max-restarts) path keeps the commit synchronous so
+        a single-host job's last checkpoint is fully published before the
+        process exits.
+        """
         from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
 
         saver = AsyncCheckpointSaver.get_ckpt_saver()
         if saver is None:
             return
         try:
-            saver.save_shm_to_storage()
+            saver.save_shm_to_storage(commit_async=commit_async)
         except Exception:
             logger.exception("persisting shm checkpoint failed")
 
@@ -333,8 +343,9 @@ class ElasticAgent:
         # safely reclaimable, then persist the in-memory checkpoint
         # (reference: training.py:662-672)
         self._group.stop()
-        self._save_shm_checkpoint()
-        if self._group.restart_count >= self._spec.max_restarts:
+        terminal = self._group.restart_count >= self._spec.max_restarts
+        self._save_shm_checkpoint(commit_async=not terminal)
+        if terminal:
             self._client.report_node_status(self._node_rank, NodeStatus.FAILED)
             logger.error(
                 "Exhausted %s restarts (%s); failing",

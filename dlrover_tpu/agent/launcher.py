@@ -48,6 +48,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--max-restarts", type=int, default=3)
     p.add_argument("--monitor-interval", type=float, default=5.0)
     p.add_argument(
+        "--rdzv-waiting-timeout", type=float, default=30.0,
+        help="seconds a rendezvous waits for more hosts once min_nodes "
+             "have joined (smaller = faster recovery after node loss, "
+             "more churn on staggered startup)",
+    )
+    p.add_argument(
         "--network-check", action="store_true",
         help="run chip/ICI health-check rounds before training "
              "(reference: dlrover-run --network-check)",
@@ -146,7 +152,9 @@ def run(args: argparse.Namespace) -> int:
         master_addr, node_id=args.node_rank, node_type="worker"
     )
     client.report_rdzv_params(
-        min_nodes, max_nodes, waiting_timeout=30.0, node_unit=args.node_unit
+        min_nodes, max_nodes,
+        waiting_timeout=args.rdzv_waiting_timeout,
+        node_unit=args.node_unit,
     )
 
     script = args.training_script

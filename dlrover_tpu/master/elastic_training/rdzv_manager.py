@@ -129,22 +129,33 @@ class RendezvousManager(metaclass=ABCMeta):
             return len(self._waiting_nodes)
 
     def _check_rdzv_completed(self) -> bool:
-        """Caller holds the lock."""
-        waiting = len(self._waiting_nodes)
+        """Caller holds the lock.
+
+        Completion rules (ordered):
+        1. full world joined -> immediately;
+        2. every *previously admitted, still-alive* member has (re)joined
+           and min_nodes is met -> immediately (fast recovery after the
+           master removed a dead node; a lone late joiner does NOT
+           qualify — it must wait for the members' round invalidation,
+           otherwise two staggered nodes complete two divergent
+           singleton worlds);
+        3. otherwise, the last-call window: min_nodes joined and no new
+           joiner for waiting_timeout.
+        """
+        waiting = set(self._waiting_nodes)
         params = self._rdzv_params
-        if waiting == 0:
+        if not waiting:
             return False
-        alive = max(len(self._alive_nodes), params.min_nodes)
-        target = min(alive, params.max_nodes)
-        if waiting >= target:
+        if len(waiting) >= params.max_nodes:
+            return True
+        known = set(self._latest_rdzv_nodes) & self._alive_nodes
+        if known and known <= waiting and len(waiting) >= params.min_nodes:
             return True
         since_lastcall = time.time() - self._lastcall_time
-        if (
-            waiting >= params.min_nodes
+        return (
+            len(waiting) >= params.min_nodes
             and since_lastcall >= params.waiting_timeout
-        ):
-            return True
-        return False
+        )
 
     def _complete_rdzv(self) -> bool:
         """Caller holds the lock: admit a node_unit-rounded set of nodes.

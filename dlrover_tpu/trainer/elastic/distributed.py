@@ -47,16 +47,32 @@ class WorkerEnv:
 
 
 def init_distributed(timeout_s: int = 300) -> WorkerEnv:
-    """Initialize jax.distributed from the agent env (no-op for 1 process)."""
+    """Initialize jax.distributed from the agent env (no-op for 1 process).
+
+    ``DLROVER_JAX_HEARTBEAT_TIMEOUT`` (seconds) bounds how long surviving
+    processes wait before the coordination service declares a dead peer —
+    the trigger for the elastic restart path on real node loss.
+    ``DLROVER_SLICE_ID`` tags this host's DCN granule for hybrid meshes
+    (on real multi-slice TPU the runtime knows; this is the override for
+    CPU/GPU multi-host emulation).
+    """
     env = WorkerEnv.from_env()
     if env.worker_num > 1 and env.coordinator:
         import jax
 
+        kwargs = {}
+        hb = os.environ.get("DLROVER_JAX_HEARTBEAT_TIMEOUT")
+        if hb:
+            kwargs["heartbeat_timeout_seconds"] = int(hb)
+        slice_id = os.environ.get("DLROVER_SLICE_ID")
+        if slice_id is not None and slice_id != "":
+            kwargs["slice_index"] = int(slice_id)
         jax.distributed.initialize(
             coordinator_address=env.coordinator,
             num_processes=env.worker_num,
             process_id=env.worker_rank,
             initialization_timeout=timeout_s,
+            **kwargs,
         )
     return env
 

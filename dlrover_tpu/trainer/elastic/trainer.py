@@ -111,6 +111,7 @@ class ElasticTrainer:
         optimizer: Any = None,
         loss_fn: Optional[Callable] = None,
         mesh_spec: Optional[MeshSpec] = None,
+        mesh_spec_fn: Optional[Callable[[Sequence[Any]], MeshSpec]] = None,
         accel_config: Optional[AccelerateConfig] = None,
         save_memory_interval: int = 1,
         save_storage_interval: int = 50,
@@ -126,6 +127,10 @@ class ElasticTrainer:
         self._optimizer = optimizer
         self._loss_fn = loss_fn
         self._mesh_spec = mesh_spec
+        # elasticity-aware strategy: called with the CURRENT world's
+        # device list on every prepare(), so a multi-host job can keep
+        # "dp over hosts x fsdp within host" as the world resizes
+        self._mesh_spec_fn = mesh_spec_fn
         self._accel_config = accel_config
         self._save_memory_interval = save_memory_interval
         self._save_storage_interval = save_storage_interval
@@ -175,9 +180,12 @@ class ElasticTrainer:
                 logger.warning("compile cache unavailable: %s", e)
         if devices is None:
             devices = jax.devices()
-        spec = self._mesh_spec or MeshSpec.for_device_count(len(devices))
-        if spec.size != len(devices):
-            spec = MeshSpec.for_device_count(len(devices))
+        if self._mesh_spec_fn is not None:
+            spec = self._mesh_spec_fn(devices)
+        else:
+            spec = self._mesh_spec or MeshSpec.for_device_count(len(devices))
+            if spec.size != len(devices):
+                spec = MeshSpec.for_device_count(len(devices))
         self.plan = plan_global_batch(
             self._global_batch_size, spec, self._micro_batch_per_shard
         )
@@ -189,7 +197,7 @@ class ElasticTrainer:
         )
         key = (
             id(self._model),
-            spec.dims,
+            spec,
             config.grad_accum_steps,
             self.plan.micro_batch_global,
             self._seq_len,

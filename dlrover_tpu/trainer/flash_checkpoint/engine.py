@@ -243,7 +243,20 @@ class CheckpointEngine:
         pytree of ``jax.sharding.Sharding``s.
         """
         self._ensure_saver()  # shm meta server must exist before we query it
-        loaded = self._load_from_memory()
+        try:
+            loaded = self._load_from_memory()
+        except ValueError as e:
+            # This host's shm holds only its own addressable shards; when
+            # params span hosts (fsdp across processes) and a PEER host
+            # died, local shm cannot cover the global arrays — fall back
+            # to the last committed storage checkpoint (the reference's
+            # node-loss semantics: memory restore is per-node, storage is
+            # the cross-node recovery tier).
+            logger.warning(
+                "memory checkpoint incomplete (%s); falling back to "
+                "storage restore", e,
+            )
+            loaded = None
         if loaded is not None:
             step, saved = loaded
             if target is None:

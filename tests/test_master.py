@@ -129,7 +129,12 @@ class TestElasticRendezvous:
         mgr = ElasticTrainingRendezvousManager()
         mgr.update_rdzv_params(1, 4, waiting_timeout=0.2)
         mgr.join_rendezvous(0, 0, 8)
-        # alive=1 target=min(1,4)=1 -> completes immediately
+        # a lone first joiner must NOT instantly form a singleton world
+        # (staggered startup would diverge into per-node worlds); it
+        # completes after the last-call window
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {}
+        time.sleep(0.25)
         _, _, world = mgr.get_comm_world(0)
         assert set(world.keys()) == {0}
 
@@ -164,9 +169,10 @@ class TestElasticRendezvous:
         assert world == {}
         assert mgr.rdzv_round == 0
         assert mgr.num_nodes_waiting() == 2
-        # two more nodes arrive -> full unit admitted
+        # two more nodes arrive -> full unit admitted after last-call
         mgr.join_rendezvous(2, 2, 4)
         mgr.join_rendezvous(3, 3, 4)
+        time.sleep(0.1)
         _, _, world = mgr.get_comm_world(0)
         assert len(world) == 4
 
@@ -175,6 +181,7 @@ class TestElasticRendezvous:
         mgr.update_rdzv_params(2, 4, waiting_timeout=0.1)
         mgr.join_rendezvous(0, 0, 8)
         mgr.join_rendezvous(1, 1, 8)
+        time.sleep(0.15)  # below max_nodes: last-call window applies
         mgr.get_comm_world(0)
         assert mgr.num_nodes_waiting() == 0
         # a new node joins -> agents see waiting>0 and restart workers

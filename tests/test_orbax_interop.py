@@ -1,0 +1,124 @@
+"""Orbax checkpoint interop (SURVEY §7 step 5): flash checkpoints open
+with ``orbax.checkpoint`` and Orbax checkpoints resume flash training."""
+
+import os
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+from dlrover_tpu.trainer.flash_checkpoint import (
+    Checkpointer,
+    SaverMode,
+    StorageType,
+)
+from dlrover_tpu.trainer.flash_checkpoint.orbax_interop import (
+    export_flash_to_orbax,
+    export_to_orbax,
+    import_from_orbax,
+    restore_from_orbax,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    job = uuid.uuid4().hex[:8]
+    monkeypatch.setenv("DLROVER_JOB_UID", job)
+    yield
+    AsyncCheckpointSaver.reset()
+    for f in os.listdir("/dev/shm"):
+        if job in f:
+            try:
+                os.unlink(os.path.join("/dev/shm", f))
+            except OSError:
+                pass
+
+
+def _state():
+    return {
+        "params": {
+            "dense": {"kernel": np.arange(12, dtype=np.float32).reshape(3, 4),
+                      "bias": np.ones(4, np.float32)},
+        },
+        "opt_state": {"mu": np.full((3, 4), 0.5, np.float32)},
+        "step": np.int32(7),
+    }
+
+
+def test_flash_to_orbax_roundtrip(tmp_path):
+    """A flash checkpoint exported to Orbax loads via orbax.checkpoint
+    with identical values."""
+    import orbax.checkpoint as ocp
+
+    ckpt = Checkpointer(str(tmp_path / "flash"), saver_mode=SaverMode.LOCAL)
+    state = _state()
+    assert ckpt.save_checkpoint(5, state, StorageType.DISK)
+    ckpt.wait_latest_checkpoint(30)
+
+    orbax_dir = str(tmp_path / "orbax" / "step_5")
+    step = export_flash_to_orbax(ckpt.engine, orbax_dir)
+    assert step == 5
+
+    with ocp.PyTreeCheckpointer() as c:
+        tree = c.restore(orbax_dir)
+    np.testing.assert_array_equal(
+        tree["params"]["dense"]["kernel"],
+        state["params"]["dense"]["kernel"],
+    )
+    np.testing.assert_array_equal(
+        tree["opt_state"]["mu"], state["opt_state"]["mu"]
+    )
+    assert int(np.asarray(tree["step"])) == 7
+    ckpt.close()
+
+
+def test_orbax_to_flash_restore(tmp_path):
+    """A checkpoint written by plain orbax (any JAX framework) restores
+    into a sharded target via restore_from_orbax."""
+    import orbax.checkpoint as ocp
+
+    state = _state()
+    orbax_dir = str(tmp_path / "external" / "step_12")
+    with ocp.PyTreeCheckpointer() as c:
+        c.save(orbax_dir, state)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]).reshape(4), ("fsdp",))
+    sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, "fsdp")
+    )
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    target = jax.tree_util.tree_map(np.zeros_like, state)
+    shardings = jax.tree_util.tree_map(lambda _: repl, state)
+    shardings["params"]["dense"]["kernel"] = sh
+
+    step, restored = restore_from_orbax(orbax_dir, target, shardings)
+    assert step == 12
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["dense"]["kernel"]),
+        state["params"]["dense"]["kernel"],
+    )
+    k = restored["params"]["dense"]["kernel"]
+    assert isinstance(k, jax.Array) and k.sharding.spec == sh.spec
+    np.testing.assert_array_equal(
+        np.asarray(restored["opt_state"]["mu"]), state["opt_state"]["mu"]
+    )
+
+
+def test_export_live_pytree_and_flat(tmp_path):
+    """export_to_orbax accepts both live pytrees and the flash engine's
+    flat path->array dicts."""
+    flat = {"a/b": np.ones(3, np.float32), "a/c": np.zeros(2, np.int32),
+            "d": np.float32(2.5)}
+    p1 = str(tmp_path / "o1")
+    export_to_orbax(p1, flat)
+    back = import_from_orbax(p1)
+    assert set(back) == {"a/b", "a/c", "d"}
+    np.testing.assert_array_equal(back["a/b"], flat["a/b"])
+
+    p2 = str(tmp_path / "o2")
+    export_to_orbax(p2, _state())
+    nested = import_from_orbax(p2, flat=False)
+    assert int(np.asarray(nested["step"])) == 7
