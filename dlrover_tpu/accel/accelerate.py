@@ -291,15 +291,6 @@ def accelerate(
     if config.offload_optimizer_states:
         optimizer = _offload_streaming(optimizer, _offload_cell)
     if config.mesh_spec.pp > 1:
-        if loss_fn is not None:
-            # a custom loss_fn would run plain model.apply over a
-            # pp-sharded layer stack: no GPipe schedule, per-layer cross-pp
-            # gathers — a severe silent slowdown.  Fail loudly instead.
-            raise NotImplementedError(
-                "pp > 1 requires the default loss path (the pipelined "
-                "forward is wired through default_loss_fn); drop loss_fn "
-                "or set mesh_spec.pp = 1"
-            )
         # the stacked layer axis shards over pp so each stage stores (and
         # optimizes) only its own layers' params
         rules = tuple(
@@ -310,7 +301,7 @@ def accelerate(
     rules_ctx = lambda: logical_rules_context(config.logical_rules)  # noqa: E731
     mesh = config.mesh_spec.build_mesh(devices)
     forward_fn = None
-    if config.mesh_spec.pp > 1 and loss_fn is None:
+    if config.mesh_spec.pp > 1:
         from dlrover_tpu.accel.parallel.pipeline import make_pipelined_forward
 
         forward_fn = make_pipelined_forward(
@@ -319,6 +310,25 @@ def accelerate(
             num_microbatches=config.pp_microbatches or 2 * config.mesh_spec.pp,
             remat=config.pp_remat,
         )
+        if loss_fn is not None:
+            # A custom loss must route the decoder stack through the
+            # GPipe schedule — plain model.apply over a pp-sharded layer
+            # stack would silently gather every layer cross-pp.  Contract:
+            # ``loss_fn(params, batch, forward_fn)`` where
+            # ``forward_fn(params, batch, return_hidden=False) ->
+            # (logits | hidden, var_updates)`` is the pipelined forward.
+            import inspect
+
+            n_params = len(inspect.signature(loss_fn).parameters)
+            if n_params < 3:
+                raise TypeError(
+                    "pp > 1 with a custom loss: loss_fn must accept "
+                    "(params, batch, forward_fn) and compute from the "
+                    "pipelined forward's outputs — a 2-arg loss_fn "
+                    "calling model.apply would bypass the GPipe schedule"
+                )
+            user_loss, pp_forward = loss_fn, forward_fn
+            loss_fn = lambda p, b: user_loss(p, b, pp_forward)  # noqa: E731
     loss_fn = loss_fn or default_loss_fn(
         model, config.loss_chunk_size, forward_fn
     )

@@ -53,10 +53,14 @@ def pipeline_blocks(
 ) -> jax.Array:
     """Run the layer stack over ``x`` through the GPipe schedule.
 
-    stage_fn(stage_params, x_mb, extras_mb) -> y_mb applies one stage's
-    layers to one microbatch.  ``x``: [batch, seq, hidden] global;
-    ``extras``: pytree of per-example arrays with leading batch dim (or
-    None leaves for broadcast data).  Returns [batch, seq, hidden].
+    stage_fn(stage_params, x_mb, extras_mb) -> (y_mb, aux) applies one
+    stage's layers to one microbatch; ``aux`` is a scalar side loss (MoE
+    load-balance/z-loss sum over the stage's layers — 0.0 for dense).
+    ``x``: [batch, seq, hidden] global; ``extras``: pytree of per-example
+    arrays with leading batch dim (or None leaves for broadcast data).
+    Returns ``(y, aux_mean)`` with y [batch, seq, hidden] and aux_mean
+    the per-microbatch mean of aux summed over stages (bubble ticks
+    masked out).
     """
     num_stages = mesh.shape["pp"]
     if num_stages <= 1:
@@ -105,7 +109,7 @@ def pipeline_blocks(
         ticks = m_count + num_stages - 1
 
         def tick_fn(carry, t):
-            act, out_buf = carry
+            act, out_buf, aux_sum = carry
             # stage s processes microbatch m = t - s this tick
             m = t - stage
             m_clamped = jnp.clip(m, 0, m_count - 1)
@@ -119,7 +123,11 @@ def pipeline_blocks(
                 ),
                 extras_mb,
             )
-            y = body(local_params, inp, mb_extras)
+            y, aux = body(local_params, inp, mb_extras)
+            # bubble ticks run clamped garbage microbatches whose aux
+            # must not count (their activations are already ignored)
+            valid = ((m >= 0) & (m < m_count)).astype(jnp.float32)
+            aux_sum = aux_sum + valid * aux.astype(jnp.float32)
             # shift to the next stage (last stage's send wraps to 0 and is
             # ignored — stage 0 always reads fresh microbatches)
             shifted = jax.lax.ppermute(
@@ -138,16 +146,25 @@ def pipeline_blocks(
             out_buf = jax.lax.dynamic_update_index_in_dim(
                 out_buf, new_slice, out_clamped, axis=0
             )
-            return (shifted, out_buf), None
+            return (shifted, out_buf, aux_sum), None
 
-        init = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
-        (_, out_buf), _ = jax.lax.scan(
+        init = (
+            jnp.zeros_like(x_mb[0]),
+            jnp.zeros_like(x_mb),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, out_buf, aux_sum), _ = jax.lax.scan(
             tick_fn, init, jnp.arange(ticks, dtype=jnp.int32)
         )
         # broadcast the last stage's buffer to every pp peer (f32 for the
         # same boundary reason as above)
         mask = (stage == num_stages - 1).astype(jnp.float32)
-        return jax.lax.psum(out_buf.astype(jnp.float32) * mask, "pp")
+        out = jax.lax.psum(out_buf.astype(jnp.float32) * mask, "pp")
+        # aux: sum over stages' layers, mean over microbatches (matching
+        # the non-pp path where each layer's aux is computed once over
+        # the full batch)
+        aux_mean = jax.lax.psum(aux_sum, "pp") / m_count
+        return out, aux_mean
 
     sm = jax.shard_map(
         pipelined,
@@ -157,12 +174,13 @@ def pipeline_blocks(
             data_spec,
             jax.tree_util.tree_map(lambda _: data_spec, extras_mb),
         ),
-        out_specs=data_spec,
+        out_specs=(data_spec, data_spec),
         check_vma=False,
         axis_names={"pp"},
     )
-    out_mb = sm(staged, x_mb, extras_mb).astype(orig_dtype)
-    return out_mb.reshape(batch, *out_mb.shape[2:])
+    out_mb, aux = sm(staged, x_mb, extras_mb)
+    out_mb = out_mb.astype(orig_dtype)
+    return out_mb.reshape(batch, *out_mb.shape[2:]), aux
 
 
 def make_pipelined_forward(
@@ -188,8 +206,6 @@ def make_pipelined_forward(
     cfg = model.config
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True")
-    if cfg.num_experts:
-        raise NotImplementedError("pp x MoE composition not yet supported")
     num_stages = mesh.shape["pp"]
     if cfg.num_layers % num_stages:
         raise ValueError(
@@ -205,14 +221,31 @@ def make_pipelined_forward(
     def stage_fn(stage_params, x, extras):
         positions, segment_ids = extras
 
-        def one_layer(h, layer_params):
-            h = layer_mod.apply(
-                {"params": layer_params}, h, positions, segment_ids
-            )
-            return h, None
+        def one_layer(carry, layer_params):
+            h, aux = carry
+            if cfg.num_experts:
+                # MoE layers sow load-balance/z losses; collect them into
+                # the pipeline's scalar side channel (pp x ep composition:
+                # experts stay ep-sharded inside the stage — GSPMD manages
+                # ep while shard_map only manualizes pp)
+                h, vu = layer_mod.apply(
+                    {"params": layer_params}, h, positions, segment_ids,
+                    mutable=["moe_losses"],
+                )
+                aux = aux + sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for leaf in jax.tree_util.tree_leaves(vu["moe_losses"])
+                )
+            else:
+                h = layer_mod.apply(
+                    {"params": layer_params}, h, positions, segment_ids
+                )
+            return (h, aux), None
 
-        x, _ = jax.lax.scan(one_layer, x, stage_params)
-        return x
+        (x, aux), _ = jax.lax.scan(
+            one_layer, (x, jnp.zeros((), jnp.float32)), stage_params
+        )
+        return x, aux
 
     def forward(params: Dict[str, Any], batch: Dict[str, jax.Array],
                 return_hidden: bool = False):
@@ -233,7 +266,7 @@ def make_pipelined_forward(
         extras = (positions, segment_ids)
         stacked = params["layers"]["layer"]
 
-        x = pipeline_blocks(
+        x, aux = pipeline_blocks(
             stage_fn,
             stacked,
             x,
@@ -242,16 +275,18 @@ def make_pipelined_forward(
             num_microbatches=m_count,
             remat=remat,
         )
+        var_updates = {"moe_losses": {"pipeline": aux}} if cfg.num_experts \
+            else {}
 
         x = norm_mod.apply({"params": params["final_norm"]}, x)
         if return_hidden:
-            return x, {}
+            return x, var_updates
         if cfg.tie_embeddings:
             logits = x.astype(cfg.param_dtype) @ table.T
         else:
             kernel = params["lm_head"]["kernel"]
             logits = x @ jnp.asarray(kernel, cfg.dtype)
         logits = with_logical_constraint(logits, ("batch", "seq", "vocab"))
-        return logits, {}
+        return logits, var_updates
 
     return forward

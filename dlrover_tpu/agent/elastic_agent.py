@@ -282,6 +282,10 @@ class ElasticAgent:
         while not self._stop_heartbeat.wait(interval):
             try:
                 self._client.report_heart_beat(time.time())
+            except ValueError:
+                # closed channel: the client is gone for good (owner shut
+                # down without stop_heartbeat) — beating on is pure noise
+                return
             except Exception as e:
                 # a shutdown that closed the channel mid-RPC is expected
                 if not self._stop_heartbeat.is_set():

@@ -1,0 +1,165 @@
+"""Persistent job-history datastore for the Brain role.
+
+Parity target: the reference Brain's MySQL-backed job history
+(dlrover/go/brain/pkg/datastore/implementation/utils/mysql.go:339 —
+job / job_metrics / job_node tables that the resource optimizers and
+hpsearch read so a NEW job starts from what similar PAST jobs learned).
+
+TPU-native shape: SQLite (stdlib, zero deps) behind the same three
+queries the optimizers need — speed-by-worker-count history, prior
+hyperparameter trials, and job outcomes.  A cluster deployment points
+``DLROVER_HISTORY_DB`` at a shared volume; tests use a temp file or
+``:memory:``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    job_uuid TEXT PRIMARY KEY,
+    job_name TEXT,
+    config   TEXT,
+    status   TEXT DEFAULT 'Running',
+    created_at REAL,
+    finished_at REAL
+);
+CREATE TABLE IF NOT EXISTS speed_samples (
+    job_uuid   TEXT,
+    worker_num INTEGER,
+    speed      REAL,
+    ts         REAL
+);
+CREATE INDEX IF NOT EXISTS idx_speed_job ON speed_samples (job_uuid);
+CREATE TABLE IF NOT EXISTS trials (
+    job_uuid TEXT,
+    params   TEXT,
+    value    REAL,
+    ts       REAL
+);
+"""
+
+
+def default_history_store() -> Optional["JobHistoryStore"]:
+    """Build the store from ``DLROVER_HISTORY_DB`` (None when unset —
+    history is an opt-in persistent role, like the reference's Brain)."""
+    path = os.getenv("DLROVER_HISTORY_DB", "")
+    if not path:
+        return None
+    try:
+        return JobHistoryStore(path)
+    except Exception as e:  # a bad path must not kill the master
+        logger.warning("job-history store unavailable (%s): %s", path, e)
+        return None
+
+
+class JobHistoryStore:
+    """Record and query cross-job training history."""
+
+    def __init__(self, path: str = ":memory:"):
+        if path not in ("", ":memory:") and os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # -- writes ----------------------------------------------------------
+    def record_job(self, job_uuid: str, job_name: str,
+                   config: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO jobs "
+                "(job_uuid, job_name, config, created_at) VALUES (?,?,?,?)",
+                (job_uuid, job_name, json.dumps(config or {}), time.time()),
+            )
+            self._conn.commit()
+
+    def finish_job(self, job_uuid: str, status: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE jobs SET status=?, finished_at=? WHERE job_uuid=?",
+                (status, time.time(), job_uuid),
+            )
+            self._conn.commit()
+
+    def record_speed(self, job_uuid: str, worker_num: int,
+                     speed: float) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO speed_samples VALUES (?,?,?,?)",
+                (job_uuid, worker_num, speed, time.time()),
+            )
+            self._conn.commit()
+
+    def record_trial(self, job_uuid: str, params: Dict[str, float],
+                     value: float) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO trials VALUES (?,?,?,?)",
+                (job_uuid, json.dumps(params), value, time.time()),
+            )
+            self._conn.commit()
+
+    # -- queries ---------------------------------------------------------
+    def speed_history(
+        self, job_name: Optional[str] = None
+    ) -> Dict[int, float]:
+        """Best observed speed per worker count over past jobs (the
+        reference's optimize_job_ps_resource_util-style history input)."""
+        q = (
+            "SELECT s.worker_num, MAX(s.speed) FROM speed_samples s "
+            "JOIN jobs j ON s.job_uuid = j.job_uuid "
+        )
+        args: Tuple = ()
+        if job_name:
+            q += "WHERE j.job_name = ? "
+            args = (job_name,)
+        q += "GROUP BY s.worker_num"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return {int(n): float(v) for n, v in rows}
+
+    def prior_trials(
+        self, job_name: Optional[str] = None, limit: int = 256
+    ) -> List[Tuple[Dict[str, float], float]]:
+        """Past (params, value) observations to warm-start hpsearch."""
+        q = (
+            "SELECT t.params, t.value FROM trials t "
+            "JOIN jobs j ON t.job_uuid = j.job_uuid "
+        )
+        args: List[Any] = []
+        if job_name:
+            q += "WHERE j.job_name = ? "
+            args.append(job_name)
+        q += "ORDER BY t.ts DESC LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._conn.execute(q, tuple(args)).fetchall()
+        return [(json.loads(p), float(v)) for p, v in rows]
+
+    def best_worker_count(self, job_name: Optional[str] = None
+                          ) -> Optional[int]:
+        hist = self.speed_history(job_name)
+        if not hist:
+            return None
+        return max(hist, key=lambda n: hist[n])
+
+    def jobs(self) -> List[Tuple[str, str, str]]:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT job_uuid, job_name, status FROM jobs"
+            ).fetchall()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

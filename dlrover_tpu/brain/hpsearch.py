@@ -108,6 +108,21 @@ class BayesianOptimizer:
     def observe(self, params: Dict[str, float], value: float) -> None:
         self.trials.append(Trial(params=dict(params), value=float(value)))
 
+    def warm_start(self, prior: Sequence[Tuple[Dict[str, float], float]]
+                   ) -> int:
+        """Seed the GP with past jobs' (params, value) observations (the
+        Brain datastore role, ``brain.datastore.JobHistoryStore.
+        prior_trials``); skips entries missing a dimension.  Returns how
+        many were adopted."""
+        adopted = 0
+        names = {p.name for p in self.space}
+        for params, value in prior:
+            if not names <= set(params):
+                continue
+            self.observe({n: params[n] for n in names}, value)
+            adopted += 1
+        return adopted
+
     def best(self) -> Optional[Trial]:
         done = [t for t in self.trials if t.value is not None]
         return max(done, key=lambda t: t.value) if done else None

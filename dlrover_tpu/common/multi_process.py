@@ -263,13 +263,18 @@ class SharedQueue(LocalSocketComm):
                 raise queue.Empty()
             return resp["item"]
         deadline = time.time() + (600.0 if timeout is None else timeout)
+        delay = 0.02
         while True:
             resp = self._call("get", block=False)
             if not resp.get("empty"):
                 return resp["item"]
             if time.time() > deadline:
                 raise queue.Empty()
-            time.sleep(0.05)
+            time.sleep(delay)
+            # back off to 0.25s: an idle consumer (e.g. the saver event
+            # loop) must not spin the GIL at 20Hz on small hosts — it
+            # measurably steals bandwidth from same-process memcpys
+            delay = min(delay * 2, 0.25)
 
     def qsize(self) -> int:
         return self._call("qsize")

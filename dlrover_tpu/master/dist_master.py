@@ -8,6 +8,7 @@ sharding, sync/kv services and the RPC servicer; the run loop exits when
 the job completes, fails fatally, or hangs.
 """
 
+import os
 import threading
 import time
 from typing import Optional
@@ -91,11 +92,28 @@ class DistributedJobMaster:
         self.diagnosis_manager = DiagnosisManager(
             on_inference=self._act_on_inference
         )
+        from dlrover_tpu.brain.datastore import default_history_store
         from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
         from dlrover_tpu.master.resource.local_optimizer import LocalOptimizer
 
+        # cross-job history (the Brain datastore role): opt-in via
+        # DLROVER_HISTORY_DB; feeds the optimizer's cold start and
+        # records this job's speed curve for future jobs
+        self.history_store = default_history_store()
+        self._job_name = os.getenv("DLROVER_JOB_NAME", "")
+        self._job_uuid = os.getenv("DLROVER_JOB_UID", "") or f"job-{id(self)}"
+        self._last_history_ts = 0.0
+        if self.history_store is not None:
+            self.history_store.record_job(
+                self._job_uuid, self._job_name,
+                {"node_num": node_num},
+            )
         self.job_auto_scaler = JobAutoScaler(
-            optimizer=LocalOptimizer(max_workers=2 * node_num),
+            optimizer=LocalOptimizer(
+                max_workers=2 * node_num,
+                history_store=self.history_store,
+                job_name=self._job_name,
+            ),
             speed_monitor=self.speed_monitor,
             scaler=scaler,
             get_worker_num=lambda: len(
@@ -142,6 +160,15 @@ class DistributedJobMaster:
             )
 
             self.strategy_generator = SimpleStrategyGenerator()
+            if self.history_store is not None:
+                adopted = self.strategy_generator.attach_history(
+                    self.history_store, self._job_uuid, self._job_name
+                )
+                if adopted:
+                    logger.info(
+                        "auto-tuning warm-started from %d prior trials",
+                        adopted,
+                    )
 
     def prepare(self) -> None:
         for mgr in self.rdzv_managers.values():
@@ -187,10 +214,39 @@ class DistributedJobMaster:
                     self.exit_reason = JobExitReason.SUCCEEDED
                     logger.info("All dataset tasks completed; master exits")
                     return 0
+                self._record_history_sample()
                 time.sleep(poll_interval)
         except KeyboardInterrupt:  # pragma: no cover
             pass
+        finally:
+            if self.history_store is not None:
+                try:
+                    self.history_store.finish_job(
+                        self._job_uuid, self.exit_reason or "Stopped"
+                    )
+                except Exception:
+                    pass
         return 0
+
+    def _record_history_sample(self, min_interval: float = 60.0) -> None:
+        """At most one speed row per ``min_interval`` — the run loop polls
+        every few seconds and a multi-week job must not grow the history
+        DB (and fsync) unboundedly."""
+        if self.history_store is None:
+            return
+        now = time.time()
+        if now - self._last_history_ts < min_interval:
+            return
+        try:
+            speed = self.speed_monitor.running_speed()
+            workers = len(self.speed_monitor.running_workers)
+            if speed > 0 and workers > 0:
+                self._last_history_ts = now
+                self.history_store.record_speed(
+                    self._job_uuid, workers, speed
+                )
+        except Exception:
+            logger.exception("recording job history failed")
 
     def tuning_tick(self) -> None:
         """One tuning round: score the last proposal by observed speed,

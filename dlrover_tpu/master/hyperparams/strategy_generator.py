@@ -38,6 +38,8 @@ class SimpleStrategyGenerator:
         )
         self._pending: Optional[dict] = None
         self._version = 0
+        self._history_store = None
+        self._job_uuid = ""
 
     def next_config(self) -> comm.ParallelConfig:
         """Propose the next config to try.  Each proposal bumps the
@@ -59,7 +61,30 @@ class SimpleStrategyGenerator:
         if self._pending is None:
             return
         self._bo.observe(self._pending, speed)
+        if self._history_store is not None:
+            try:
+                # persist the trial for future jobs' warm starts (the
+                # Brain datastore role)
+                self._history_store.record_trial(
+                    self._job_uuid, self._pending, float(speed)
+                )
+            except Exception:  # history must never break tuning
+                pass
         self._pending = None
+
+    def attach_history(self, store, job_uuid: str,
+                       job_name: str = "") -> int:
+        """Warm-start the GP from past jobs' trials and persist this
+        job's trials (brain.datastore.JobHistoryStore).  Returns how
+        many prior trials were adopted."""
+        self._history_store = store
+        self._job_uuid = job_uuid
+        try:
+            return self._bo.warm_start(
+                store.prior_trials(job_name or None)
+            )
+        except Exception:
+            return 0
 
     def best_config(self) -> Optional[comm.ParallelConfig]:
         best = self._bo.best()

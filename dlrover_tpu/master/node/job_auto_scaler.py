@@ -127,6 +127,10 @@ class JobAutoScaler:
             if group is not None and group.node_resource.memory > 0:
                 node.config_resource = group.node_resource
             scale_plan.launch_nodes.append(node)
+        # per-node resizes (the PS optimizers' remove+relaunch shape)
+        # carry straight through to the scaler
+        scale_plan.launch_nodes.extend(plan.launch_nodes)
+        scale_plan.remove_nodes.extend(plan.remove_nodes)
         if not scale_plan.empty():
             worker_group = scale_plan.node_group_resources.get(
                 NodeType.WORKER

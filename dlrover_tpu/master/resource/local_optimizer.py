@@ -39,12 +39,20 @@ class LocalOptimizer(ResourceOptimizer):
         max_workers: int = 0,
         efficiency_threshold: float = 0.75,
         oom_memory_factor: float = 1.5,
+        history_store=None,
+        job_name: str = "",
     ):
         self._node_unit = max(1, node_unit)
         self._min_workers = min_workers
         self._max_workers = max_workers
         self._threshold = efficiency_threshold
         self._oom_factor = oom_memory_factor
+        # cross-job history (Brain datastore role): past jobs' speed
+        # curves seed this job's plan so it starts near the known-best
+        # size instead of re-learning the curve (reference brain
+        # optimize_job_ps_resource_util.go history input)
+        self._history_store = history_store
+        self._job_name = job_name
         # sizes that already failed the efficiency check; never re-grown
         # into (prevents the N <-> N+unit scaling oscillation)
         self._rejected_sizes: set = set()
@@ -56,7 +64,25 @@ class LocalOptimizer(ResourceOptimizer):
         plan = ResourcePlan()
         best = self._best_speed_by_workers(samples)
         if current_workers not in best:
-            return plan  # no stable sample at the current size yet
+            # no stable sample at the current size yet: a cold job can
+            # still jump to the historical best size for this job name
+            hist_best = self._historical_best()
+            if hist_best:
+                # the configured floor and this run's rejected sizes
+                # still bind — history is a hint, not an override
+                hist_best = max(hist_best, self._min_workers)
+                if hist_best in self._rejected_sizes:
+                    hist_best = None
+            if (hist_best and hist_best != current_workers
+                    and (not self._max_workers
+                         or hist_best <= self._max_workers)):
+                logger.info(
+                    "cold start: job history suggests %s workers", hist_best
+                )
+                plan.node_group_resources[NodeType.WORKER] = (
+                    NodeGroupResource(count=hist_best)
+                )
+            return plan
         target = current_workers
         cur_speed = best[current_workers]
         smaller = [n for n in best if n < current_workers]
@@ -84,6 +110,17 @@ class LocalOptimizer(ResourceOptimizer):
             count=target
         )
         return plan
+
+    def _historical_best(self):
+        if self._history_store is None:
+            return None
+        try:
+            return self._history_store.best_worker_count(
+                self._job_name or None
+            )
+        except Exception as e:
+            logger.warning("job-history query failed: %s", e)
+            return None
 
     def _grow_target(self, current: int) -> int:
         target = current + self._node_unit

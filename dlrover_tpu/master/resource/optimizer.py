@@ -27,6 +27,10 @@ class ResourcePlan:
     node_group_resources: Dict[str, NodeGroupResource] = dataclasses.field(
         default_factory=dict
     )
+    # per-node resizes expressed as relaunches (remove + launch with the
+    # new resources — the PS optimizers' output shape)
+    launch_nodes: List["Node"] = dataclasses.field(default_factory=list)
+    remove_nodes: List["Node"] = dataclasses.field(default_factory=list)
     # optional tuning hints shipped to workers via ParallelConfig
     dataloader_workers: Optional[int] = None
     batch_size: Optional[int] = None
@@ -34,6 +38,8 @@ class ResourcePlan:
     def empty(self) -> bool:
         return (
             not self.node_group_resources
+            and not self.launch_nodes
+            and not self.remove_nodes
             and self.dataloader_workers is None
             and self.batch_size is None
         )

@@ -1,0 +1,160 @@
+"""PS-role resource optimizers: utilization resize + hot-node handling.
+
+Parity targets (reference, Go brain):
+- utilization-band resizing
+  (go/brain/.../optalgorithm/optimize_job_ps_resource_util.go) — keep
+  each PS's requested CPU/memory near its observed use, within a band,
+  so over-provisioned jobs shrink and saturated ones grow;
+- hot-PS detection
+  (optalgorithm/optimize_job_hot_ps_resource.go:30-160) — a PS whose
+  CPU runs beyond a hot threshold (and far above the group median) gets
+  its CPU scaled toward the per-worker target and a memory bump.
+
+TPU-native mapping: the "PS" role here is a sparse-embedding service
+host (the KvVariable tier of recsys jobs, dlrover_tpu.sparse) or any
+CPU-side coworker pool member — the dense SPMD path has no parameter
+servers.  Resizes are expressed as relaunch plans (remove + launch with
+new resources), which is how resizing works on k8s anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.resource.optimizer import ResourcePlan
+
+
+@dataclasses.dataclass
+class PSUtilSample:
+    """One PS node's observed usage (agent resource monitor units)."""
+
+    node_id: int
+    cpu_used: float        # cores actually used
+    cpu_requested: float   # cores requested
+    memory_used_mb: float
+    memory_requested_mb: float
+
+    @property
+    def cpu_util(self) -> float:
+        return self.cpu_used / self.cpu_requested if self.cpu_requested else 0.0
+
+    @property
+    def memory_util(self) -> float:
+        return (
+            self.memory_used_mb / self.memory_requested_mb
+            if self.memory_requested_mb else 0.0
+        )
+
+
+class PSResourceOptimizer:
+    """Generate resize plans for the PS/sparse-service node group."""
+
+    def __init__(
+        self,
+        node_type: str = NodeType.PS,
+        util_low: float = 0.3,
+        util_high: float = 0.85,
+        headroom: float = 1.4,
+        hot_cpu_threshold: float = 0.9,
+        hot_median_factor: float = 1.5,
+        hot_memory_adjust_mb: float = 4096,
+        max_cpu: float = 32.0,
+        min_cpu: float = 1.0,
+    ):
+        self._node_type = node_type
+        self._low = util_low
+        self._high = util_high
+        self._headroom = headroom
+        self._hot_cpu = hot_cpu_threshold
+        self._hot_factor = hot_median_factor
+        self._hot_mem_adjust = hot_memory_adjust_mb
+        self._max_cpu = max_cpu
+        self._min_cpu = min_cpu
+
+    # -- utilization band resize -----------------------------------------
+    def generate_util_plan(
+        self, samples: List[PSUtilSample]
+    ) -> ResourcePlan:
+        """Resize any PS whose cpu utilization left the [low, high] band
+        (reference optimize_job_ps_resource_util.go): new request =
+        used * headroom, clamped."""
+        plan = ResourcePlan()
+        for s in samples:
+            util = s.cpu_util
+            if self._low <= util <= self._high:
+                continue
+            new_cpu = min(
+                self._max_cpu,
+                max(self._min_cpu, s.cpu_used * self._headroom),
+            )
+            new_mem = max(
+                s.memory_requested_mb, s.memory_used_mb * self._headroom
+            )
+            if (abs(new_cpu - s.cpu_requested) / max(s.cpu_requested, 1e-9)
+                    < 0.1 and new_mem <= s.memory_requested_mb):
+                continue  # not worth a relaunch
+            self._add_resize(plan, s, new_cpu, new_mem)
+            logger.info(
+                "ps %s util %.2f outside [%.2f, %.2f]: cpu %s -> %s",
+                s.node_id, util, self._low, self._high,
+                s.cpu_requested, new_cpu,
+            )
+        return plan
+
+    # -- hot PS -----------------------------------------------------------
+    def generate_hot_ps_plan(
+        self,
+        samples: List[PSUtilSample],
+        worker_count: int,
+        target_worker_count: Optional[int] = None,
+    ) -> ResourcePlan:
+        """Scale a HOT PS's cpu toward what ``target_worker_count``
+        workers will demand (reference optimize_job_hot_ps_resource.go:
+        hot = util beyond threshold AND well above the group median)."""
+        plan = ResourcePlan()
+        if not samples:
+            return plan
+        utils = [s.cpu_util for s in samples]
+        median = statistics.median(utils)
+        target_workers = target_worker_count or worker_count
+        for s in samples:
+            hot = s.cpu_util >= self._hot_cpu and (
+                median <= 0 or s.cpu_util >= self._hot_factor * median
+                or len(samples) == 1
+            )
+            if not hot:
+                continue
+            # demand scales with the worker fan-in
+            scale = target_workers / max(worker_count, 1)
+            new_cpu = min(
+                self._max_cpu, max(self._min_cpu, s.cpu_used * scale
+                                   * self._headroom)
+            )
+            new_mem = s.memory_requested_mb + self._hot_mem_adjust
+            self._add_resize(plan, s, new_cpu, new_mem)
+            logger.info(
+                "hot ps %s (util %.2f, median %.2f): cpu %s -> %s, "
+                "mem +%sMB",
+                s.node_id, s.cpu_util, median, s.cpu_requested, new_cpu,
+                self._hot_mem_adjust,
+            )
+        return plan
+
+    def _add_resize(self, plan: ResourcePlan, s: PSUtilSample,
+                    new_cpu: float, new_mem: float) -> None:
+        old = Node(self._node_type, s.node_id)
+        replacement = Node(
+            self._node_type,
+            s.node_id,
+            rank_index=s.node_id,
+            config_resource=NodeResource(
+                cpu=round(new_cpu, 1), memory=int(new_mem)
+            ),
+        )
+        plan.remove_nodes.append(old)
+        plan.launch_nodes.append(replacement)

@@ -70,9 +70,21 @@ class LlamaConfig:
     # projection — the reference's TransformerEngine fp8 AMP equivalent
     # (dlrover_tpu.ops.fp8; reference amp_optimization.py:377)
     fp8: bool = False
+    # int8 W8A8 projections on the MXU (2x bf16 rate on v5e) for
+    # eval/generation — routes every Dense contraction through the
+    # Pallas int8 GEMM (ops/pallas/quant_matmul.int8_dot_general; the
+    # reference's csrc int8 GEMM serving path).  Inference-only: the
+    # kernel defines no VJP.
+    w8a8: bool = False
 
     @property
     def dot_general(self):
+        if self.w8a8:
+            from dlrover_tpu.ops.pallas.quant_matmul import (
+                int8_dot_general,
+            )
+
+            return int8_dot_general
         if self.fp8:
             from dlrover_tpu.ops.fp8 import fp8_dot_general
 

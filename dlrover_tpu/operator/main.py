@@ -1,0 +1,277 @@
+"""Operator entrypoint: watch ElasticJob CRs, run one master per job.
+
+The deployable half of the operator (deploy/operator.yaml runs this;
+deploy/crds/*.yaml define the resources).  Reference counterparts:
+- manager main + reconciler registration
+  (dlrover/go/operator/main.go, pkg/controllers/elasticjob_controller.go);
+- master-pod creation (pkg/controllers/master/master.go:117 — the
+  operator schedules ONE job-master pod per ElasticJob; the master then
+  owns worker lifecycle through its own Scaler/Watcher).
+
+Architecture note (matches the reference, differs from a classic
+all-in-operator controller): this process does NOT manage worker pods.
+It reconciles ElasticJob CRs into (master pod + master service), mirrors
+the master pod's phase into the CR status, and relaunches a crashed
+master.  Worker scheduling, elasticity, and fault handling live in the
+master (dlrover_tpu.master.dist_master + scheduler.k8s.PodScaler).
+
+Testable without a cluster: every k8s interaction goes through the small
+``OperatorApi`` surface; tests inject a fake (tests/test_k8s_operator.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+GROUP = "dlrover-tpu.org"
+VERSION = "v1alpha1"
+PLURAL = "elasticjobs"
+DEFAULT_MASTER_PORT = 22222
+
+
+def build_master_pod_spec(
+    job: Dict[str, Any], namespace: str
+) -> Dict[str, Any]:
+    """The job-master pod (reference master.go:117 NewMasterTemplateToJob):
+    runs ``dlrover-tpu-master --platform k8s`` with the job's identity."""
+    name = job["metadata"]["name"]
+    spec = job.get("spec", {})
+    image = spec.get("image", "dlrover-tpu:latest")
+    workers = spec.get("replicaSpecs", {}).get("worker", {})
+    res = spec.get("masterResource", {}) or {}
+    limits = {
+        "cpu": str(res.get("cpu", "2")),
+        "memory": str(res.get("memory", "4Gi")),
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{name}-master",
+            "namespace": namespace,
+            "labels": {
+                "dlrover-tpu/job-name": name,
+                "dlrover-tpu/node-type": "master",
+            },
+            "ownerReferences": [_owner_ref(job)],
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "master",
+                "image": image,
+                "command": [
+                    "dlrover-tpu-master",
+                    "--platform", "k8s",
+                    "--job_name", name,
+                    "--namespace", namespace,
+                    "--port", str(DEFAULT_MASTER_PORT),
+                    "--node_num", str(workers.get("replicas", 1)),
+                    "--worker_image", image,
+                ],
+                "ports": [{"containerPort": DEFAULT_MASTER_PORT}],
+                "resources": {"limits": limits, "requests": dict(limits)},
+            }],
+        },
+    }
+
+
+def build_master_service_spec(
+    job: Dict[str, Any], namespace: str
+) -> Dict[str, Any]:
+    """Stable DNS name workers dial (reference master.go service)."""
+    name = job["metadata"]["name"]
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{name}-master",
+            "namespace": namespace,
+            "ownerReferences": [_owner_ref(job)],
+        },
+        "spec": {
+            "selector": {
+                "dlrover-tpu/job-name": name,
+                "dlrover-tpu/node-type": "master",
+            },
+            "ports": [{
+                "port": DEFAULT_MASTER_PORT,
+                "targetPort": DEFAULT_MASTER_PORT,
+            }],
+        },
+    }
+
+
+def _owner_ref(job: Dict[str, Any]) -> Dict[str, Any]:
+    """Garbage collection: deleting the ElasticJob deletes its pods."""
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "ElasticJob",
+        "name": job["metadata"]["name"],
+        "uid": job["metadata"].get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+class OperatorApi:
+    """The k8s surface the operator needs (real impl wraps the kubernetes
+    client; tests inject a fake)."""
+
+    def __init__(self, core_api: Any, custom_api: Any):
+        self._core = core_api
+        self._custom = custom_api
+
+    def list_elasticjobs(self, namespace: str) -> List[Dict[str, Any]]:
+        if namespace:
+            out = self._custom.list_namespaced_custom_object(
+                GROUP, VERSION, namespace, PLURAL
+            )
+        else:
+            out = self._custom.list_cluster_custom_object(
+                GROUP, VERSION, PLURAL
+            )
+        return out.get("items", [])
+
+    def patch_status(self, namespace: str, name: str,
+                     status: Dict[str, Any]) -> None:
+        self._custom.patch_namespaced_custom_object_status(
+            GROUP, VERSION, namespace, PLURAL, name, {"status": status}
+        )
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self._core.read_namespaced_pod(name, namespace)
+        except Exception:
+            return None
+
+    def create_pod(self, namespace: str, manifest: Dict[str, Any]) -> None:
+        self._core.create_namespaced_pod(namespace, manifest)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._core.delete_namespaced_pod(name, namespace)
+
+    def create_service(self, namespace: str,
+                       manifest: Dict[str, Any]) -> None:
+        try:
+            self._core.create_namespaced_service(namespace, manifest)
+        except Exception as e:  # already exists across reconciles
+            logger.debug("service create: %s", e)
+
+
+def _pod_phase(pod: Any) -> str:
+    status = getattr(pod, "status", None) or (
+        pod.get("status", {}) if isinstance(pod, dict) else {}
+    )
+    phase = getattr(status, "phase", None)
+    if phase is None and isinstance(status, dict):
+        phase = status.get("phase")
+    return phase or "Unknown"
+
+
+class JobReconciler:
+    """ElasticJob CR -> (master pod + service) -> CR status mirror."""
+
+    def __init__(self, api: OperatorApi, max_master_relaunch: int = 2):
+        self._api = api
+        self._max_relaunch = max_master_relaunch
+        self._relaunches: Dict[tuple, int] = {}
+
+    def reconcile(self, job: Dict[str, Any]) -> str:
+        meta = job["metadata"]
+        name, ns = meta["name"], meta.get("namespace", "default")
+        # budget key includes namespace AND uid: same-named jobs in other
+        # namespaces, or a deleted-and-recreated job (fresh uid), must
+        # not inherit an exhausted relaunch budget
+        budget_key = (ns, name, meta.get("uid", ""))
+        status = job.get("status") or {}
+        phase = status.get("phase", "Created")
+        if phase in ("Succeeded", "Failed"):
+            return phase
+        master = self._api.get_pod(ns, f"{name}-master")
+        if master is None:
+            self._api.create_service(ns, build_master_service_spec(job, ns))
+            self._api.create_pod(ns, build_master_pod_spec(job, ns))
+            new_phase = "Pending"
+        else:
+            pod_phase = _pod_phase(master)
+            if pod_phase == "Failed":
+                used = self._relaunches.get(budget_key, 0)
+                if used < self._max_relaunch:
+                    # master crash: relaunch (workers keep running; the
+                    # new master resyncs from heartbeats/watch)
+                    self._relaunches[budget_key] = used + 1
+                    self._api.delete_pod(ns, f"{name}-master")
+                    logger.warning(
+                        "job %s: master failed; relaunch %d/%d",
+                        name, used + 1, self._max_relaunch,
+                    )
+                    new_phase = "Pending"
+                else:
+                    new_phase = "Failed"
+            elif pod_phase == "Succeeded":
+                new_phase = "Succeeded"
+            elif pod_phase == "Running":
+                new_phase = "Running"
+            else:
+                new_phase = "Pending"
+        if new_phase != phase:
+            patch: Dict[str, Any] = {"phase": new_phase}
+            if new_phase in ("Succeeded", "Failed"):
+                patch["completionTime"] = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                )
+            self._api.patch_status(ns, name, patch)
+            logger.info("job %s: %s -> %s", name, phase, new_phase)
+        return new_phase
+
+
+def run(namespace: str = "", interval: float = 5.0,
+        api: Optional[OperatorApi] = None,
+        max_iterations: Optional[int] = None) -> None:
+    """The controller loop (reference manager main)."""
+    if api is None:  # pragma: no cover - needs a cluster
+        from kubernetes import client, config
+
+        try:
+            config.load_incluster_config()
+        except Exception:
+            config.load_kube_config()
+        api = OperatorApi(client.CoreV1Api(), client.CustomObjectsApi())
+    reconciler = JobReconciler(api)
+    i = 0
+    while max_iterations is None or i < max_iterations:
+        i += 1
+        try:
+            jobs = api.list_elasticjobs(namespace)
+        except Exception as e:
+            logger.warning("listing ElasticJobs failed: %s", e)
+            jobs = []
+        for job in jobs:
+            try:
+                reconciler.reconcile(job)
+            except Exception:
+                logger.exception(
+                    "reconcile of %s failed",
+                    job.get("metadata", {}).get("name"),
+                )
+        if max_iterations is None or i < max_iterations:
+            time.sleep(interval)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--namespace", default="",
+                   help="watch one namespace ('' = cluster-wide)")
+    p.add_argument("--interval", type=float, default=5.0)
+    args = p.parse_args(argv)
+    run(namespace=args.namespace, interval=args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

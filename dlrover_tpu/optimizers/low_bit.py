@@ -31,26 +31,33 @@ from dlrover_tpu.optimizers.agd import ScalarOrSchedule, _lr_at
 
 @jax.tree_util.register_pytree_node_class
 class QTensor:
-    """Block-wise int8 tensor, blocked along the LAST dimension.
+    """Block-wise int8/int4 tensor, blocked along the LAST dimension.
 
-    ``codes`` keeps the original tensor's shape (int8), so any GSPMD
-    sharding valid for the f32 tensor is valid for the codes — the
+    8-bit: ``codes`` keeps the original tensor's shape (int8), so any
+    GSPMD sharding valid for the f32 tensor is valid for the codes — the
     optimizer state inherits the param sharding unchanged (ZeRO-style
-    sharded low-bit states).  ``scale`` is f32 ``[..., ceil(last/block)]``.
-    ``block`` is static pytree aux data so jit never traces it.
+    sharded low-bit states).  4-bit (reference q_optimizer.py:17 /
+    quantize.cu 4-bit states): two codes pack per byte, so ``codes``
+    has a halved last dim (uint8) — the sharding repair in accelerate's
+    ``_expand_and_repair_sharding`` handles the non-mirroring leaf.
+    ``scale`` is f32 ``[..., ceil(last/block)]``.  ``block``/``bits``/
+    ``orig_last`` are static pytree aux data so jit never traces them.
     """
 
-    def __init__(self, codes, scale, block):
+    def __init__(self, codes, scale, block, bits=8, orig_last=None):
         self.codes = codes
         self.scale = scale
         self.block = int(block)
+        self.bits = int(bits)
+        self.orig_last = orig_last
 
     def tree_flatten(self):
-        return (self.codes, self.scale), (self.block,)
+        return (self.codes, self.scale), (self.block, self.bits,
+                                          self.orig_last)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0])
+        return cls(children[0], children[1], *aux)
 
     @property
     def nbytes(self) -> int:
@@ -58,8 +65,11 @@ class QTensor:
 
 
 def quantize_blockwise(
-    x: jax.Array, block_size: int = 256, companding: bool = False
+    x: jax.Array, block_size: int = 256, companding: bool = False,
+    bits: int = 8,
 ) -> QTensor:
+    assert bits in (8, 4), bits
+    qmax = 127 if bits == 8 else 7
     xf = x.astype(jnp.float32)
     if companding:
         xf = jnp.sqrt(xf)
@@ -70,21 +80,47 @@ def quantize_blockwise(
     padded = jnp.pad(xf, [(0, 0)] * (xf.ndim - 1) + [(0, pad)])
     blocks = padded.reshape(*padded.shape[:-1], nblocks, block_size)
     absmax = jnp.max(jnp.abs(blocks), axis=-1)
-    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
-    codes = jnp.clip(jnp.round(blocks / scale[..., None]), -127, 127)
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    codes = jnp.clip(jnp.round(blocks / scale[..., None]), -qmax, qmax)
     codes = codes.reshape(*padded.shape[:-1], nblocks * block_size)
-    codes = codes[..., :last].astype(jnp.int8).reshape(x.shape)
-    return QTensor(codes=codes, scale=scale, block=block_size)
+    codes = codes[..., :last]
+    if bits == 8:
+        codes = codes.astype(jnp.int8).reshape(x.shape)
+        return QTensor(codes=codes, scale=scale, block=block_size)
+    # 4-bit: bias to [1, 15] (0 marks nothing; absmax codes are
+    # symmetric) and pack two per byte along the last dim
+    upad = (-last) % 2
+    if upad:
+        codes = jnp.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, upad)])
+    biased = (codes + qmax + 1).astype(jnp.uint8)  # [1, 15]
+    hi = biased[..., 0::2]
+    lo = biased[..., 1::2]
+    packed = (hi << 4) | lo
+    return QTensor(
+        codes=packed, scale=scale, block=block_size, bits=4,
+        orig_last=last,
+    )
 
 
 def dequantize_blockwise(q: QTensor, companding: bool = False) -> jax.Array:
-    codes = q.codes if q.codes.ndim else q.codes.reshape(1)
+    qmax = 127 if q.bits == 8 else 7
+    if q.bits == 8:
+        codes = q.codes if q.codes.ndim else q.codes.reshape(1)
+        shape = q.codes.shape
+    else:
+        packed = q.codes if q.codes.ndim else q.codes.reshape(1)
+        hi = (packed >> 4).astype(jnp.int32) - (qmax + 1)
+        lo = (packed & 0xF).astype(jnp.int32) - (qmax + 1)
+        codes = jnp.stack([hi, lo], axis=-1).reshape(
+            *packed.shape[:-1], packed.shape[-1] * 2
+        )[..., :q.orig_last]
+        shape = codes.shape
     last = codes.shape[-1]
     scales = jnp.repeat(q.scale, q.block, axis=-1)[..., :last]
     out = codes.astype(jnp.float32) * scales
     if companding:
         out = jnp.square(out)
-    return out.reshape(q.codes.shape)
+    return out.reshape(shape)
 
 
 class QMoment(NamedTuple):
@@ -94,10 +130,13 @@ class QMoment(NamedTuple):
     full: Optional[jax.Array]
 
 
-def _store(x: jax.Array, block_size: int, min_size: int, companding: bool) -> QMoment:
+def _store(x: jax.Array, block_size: int, min_size: int, companding: bool,
+           bits: int = 8) -> QMoment:
     if x.size < min_size:
         return QMoment(q=None, full=x.astype(jnp.float32))
-    return QMoment(q=quantize_blockwise(x, block_size, companding), full=None)
+    return QMoment(
+        q=quantize_blockwise(x, block_size, companding, bits), full=None
+    )
 
 
 def _load(m: QMoment, companding: bool) -> jax.Array:
@@ -120,8 +159,10 @@ def quantized_adamw(
     weight_decay: float = 0.0,
     block_size: int = 256,
     min_quant_size: int = 4096,
+    bits: int = 8,
 ) -> optax.GradientTransformation:
-    """AdamW with int8 block-quantized moments (8-bit ``Q_AdamW`` parity).
+    """AdamW with int8/int4 block-quantized moments (reference 8- AND
+    4-bit ``Q_AdamW``, q_optimizer.py:17 + quantize.cu).
 
     The moments are dequantized, updated, and requantized inside the jitted
     step; XLA fuses the whole chain so peak memory holds int8 states plus
@@ -132,8 +173,8 @@ def quantized_adamw(
         def zero(p):
             z = jnp.zeros(p.shape, jnp.float32)
             return (
-                _store(z, block_size, min_quant_size, False),
-                _store(z, block_size, min_quant_size, True),
+                _store(z, block_size, min_quant_size, False, bits),
+                _store(z, block_size, min_quant_size, True, bits),
             )
 
         pairs = jax.tree_util.tree_map(zero, params)
@@ -169,8 +210,8 @@ def quantized_adamw(
             )
             return (
                 delta.astype(p.dtype),
-                _store(mu, block_size, min_quant_size, False),
-                _store(nu, block_size, min_quant_size, True),
+                _store(mu, block_size, min_quant_size, False, bits),
+                _store(nu, block_size, min_quant_size, True, bits),
             )
 
         triples = jax.tree_util.tree_map(
@@ -193,3 +234,14 @@ def state_nbytes(state) -> int:
     for leaf in jax.tree_util.tree_leaves(state):
         total += leaf.size * leaf.dtype.itemsize
     return total
+
+
+def quantized_adamw_4bit(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    **kwargs,
+) -> optax.GradientTransformation:
+    """4-bit AdamW (reference 4-bit Q_AdamW): 16x smaller second-order
+    state than f32 Adam.  Smaller blocks bound the absmax-sharing error
+    at 4-bit resolution."""
+    kwargs.setdefault("block_size", 128)
+    return quantized_adamw(learning_rate, bits=4, **kwargs)

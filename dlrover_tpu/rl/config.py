@@ -14,6 +14,7 @@ class PPOConfig:
     max_new_tokens: int = 16
     temperature: float = 1.0
     top_k: int = 0  # 0 = full softmax
+    top_p: float = 1.0  # 1.0 = no nucleus cutoff
     # KV-cache decode (O(1)-context steps; needs scan_layers=False on
     # the actor) vs full-recompute rollout
     use_kv_cache: bool = False

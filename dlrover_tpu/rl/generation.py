@@ -19,14 +19,37 @@ import jax.numpy as jnp
 
 
 def select_token(
-    logits: jax.Array, key: jax.Array, temperature: float, top_k: int
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float,
+    top_k: int,
+    top_p: float = 1.0,
 ) -> jax.Array:
-    """Shared token selection: top-k mask, then greedy (temperature 0)
-    or categorical sampling — one implementation for both samplers."""
+    """Shared token selection: top-k mask, nucleus (top-p) mask, then
+    greedy (temperature 0) or categorical sampling — one implementation
+    for both samplers (reference: the vllm backend's sampling params,
+    rl/inference_backend/vllm_backend.py)."""
     logits = logits.astype(jnp.float32)
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose cumulative probability exceeds top_p.  Static-shape
+        # formulation: sort descending, mask tokens whose *preceding*
+        # cumulative mass already reached top_p (the first token always
+        # survives).
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(
+            sorted_logits / (temperature if temperature > 0 else 1.0),
+            axis=-1,
+        )
+        cum = jnp.cumsum(probs, axis=-1) - probs  # mass BEFORE each token
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1) - 1
+        cutoff_val = jnp.take_along_axis(
+            sorted_logits, cutoff_idx[:, None], axis=-1
+        )
+        logits = jnp.where(logits < cutoff_val, -jnp.inf, logits)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     return jax.random.categorical(key, logits / temperature)
@@ -40,6 +63,7 @@ def sample_sequences(
     rng: jax.Array,
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     pad_token: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Sample ``max_new_tokens`` continuations.
@@ -63,7 +87,7 @@ def sample_sequences(
             logits, t - 1, 1, axis=1
         )[:, 0, :]
         key, sub = jax.random.split(key)
-        nxt = select_token(step_logits, sub, temperature, top_k)
+        nxt = select_token(step_logits, sub, temperature, top_k, top_p)
         toks = jax.lax.dynamic_update_slice_in_dim(
             toks, nxt[:, None].astype(toks.dtype), t, axis=1
         )
@@ -87,6 +111,7 @@ def sample_sequences_cached(
     rng: jax.Array,
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     pad_token: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """KV-cache decode: one prefill pass then O(1)-context steps.
@@ -104,7 +129,7 @@ def sample_sequences_cached(
     assert total <= cfg.max_seq_len, (total, cfg.max_seq_len)
     generate = _cached_generate(
         model, prompt_len, max_new_tokens, float(temperature), int(top_k),
-        int(pad_token),
+        float(top_p), int(pad_token),
     )
     tokens = generate(variables, prompt_ids, rng)
     positions = jnp.arange(total)[None, :]
@@ -115,7 +140,8 @@ def sample_sequences_cached(
 
 @functools.lru_cache(maxsize=64)
 def _cached_generate(model, prompt_len: int, max_new_tokens: int,
-                     temperature: float, top_k: int, pad_token: int):
+                     temperature: float, top_k: int, top_p: float,
+                     pad_token: int):
     """One jitted prefill+scan program per (model, static config) — a
     fresh closure per call would retrace and recompile every rollout,
     erasing the cache's speedup.  flax modules are frozen dataclasses,
@@ -131,7 +157,7 @@ def _cached_generate(model, prompt_len: int, max_new_tokens: int,
             decode=True, cache_len=total, mutable=["cache"],
         )
         key, sub = jax.random.split(key)
-        first = select_token(logits[:, -1], sub, temperature, top_k)
+        first = select_token(logits[:, -1], sub, temperature, top_k, top_p)
         tokens = jnp.concatenate(
             [prompts,
              jnp.full((batch, max_new_tokens), pad_token, prompts.dtype)],
@@ -148,7 +174,7 @@ def _cached_generate(model, prompt_len: int, max_new_tokens: int,
                 decode=True, cache_len=total, mutable=["cache"],
             )
             key, sub = jax.random.split(key)
-            nxt = select_token(logits[:, 0], sub, temperature, top_k)
+            nxt = select_token(logits[:, 0], sub, temperature, top_k, top_p)
             toks = jax.lax.dynamic_update_slice_in_dim(
                 toks, nxt[:, None].astype(toks.dtype), t, axis=1
             )

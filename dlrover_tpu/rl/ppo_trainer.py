@@ -69,9 +69,16 @@ class PPOTrainer:
         critic: nn.Module,
         config: Optional[PPOConfig] = None,
         seed: int = 0,
+        engine: Optional["RLModelEngine"] = None,
     ):
+        """``engine``: a :class:`dlrover_tpu.rl.model_engine.RLModelEngine`
+        with strategies for roles "actor", "critic", "ref" — each role's
+        params live under its OWN mesh/sharding (reference
+        model_engine.py:35 per-model strategies).  Without it everything
+        runs single-strategy on the default device."""
         self.actor = actor
         self.critic = critic
+        self.engine = engine
         self.config = config or PPOConfig()
         self._rng = jax.random.PRNGKey(seed)
         self._np_rng = np.random.RandomState(seed)
@@ -100,18 +107,36 @@ class PPOTrainer:
         total = sample_prompt.shape[1] + self.config.max_new_tokens
         probe = jnp.zeros((1, total), jnp.int32)
         self._rng, k1, k2 = jax.random.split(self._rng, 3)
-        if actor_params is None:
-            actor_params = self.actor.init(k1, probe)
-        critic_params = self.critic.init(k2, probe)
+        if self.engine is not None:
+            actor_params = self.engine.prepare(
+                "actor", self.actor, probe, params=actor_params, rng=k1
+            )
+            critic_params = self.engine.prepare(
+                "critic", self.critic, probe, rng=k2
+            )
+            self.ref_params = self.engine.adopt(
+                "ref", jax.tree.map(lambda x: x, actor_params),
+                "actor", self.actor, probe,
+            )
+        else:
+            if actor_params is None:
+                actor_params = self.actor.init(k1, probe)
+            critic_params = self.critic.init(k2, probe)
+            self.ref_params = jax.tree.map(lambda x: x, actor_params)
         self.params = {"actor": actor_params, "critic": critic_params}
-        self.ref_params = jax.tree.map(lambda x: x, actor_params)
         self.opt_state = self.optimizer.init(self.params)
         self._build_jits()
 
     def _build_jits(self) -> None:
         c = self.config
-        actor_apply = self.actor.apply
-        critic_apply = self.critic.apply
+        if self.engine is not None:
+            actor_apply = self.engine.apply("actor")
+            critic_apply = self.engine.apply("critic")
+            ref_apply = self.engine.apply("ref")
+        else:
+            actor_apply = self.actor.apply
+            critic_apply = self.critic.apply
+            ref_apply = self.actor.apply
 
         def rollout(actor_params, prompts, rng):
             if c.use_kv_cache:
@@ -122,10 +147,11 @@ class PPOTrainer:
                 return sample_sequences_cached(
                     self.actor, actor_params, prompts, c.max_new_tokens,
                     rng, temperature=c.temperature, top_k=c.top_k,
+                    top_p=c.top_p,
                 )
             return sample_sequences(
                 actor_apply, actor_params, prompts, c.max_new_tokens, rng,
-                temperature=c.temperature, top_k=c.top_k,
+                temperature=c.temperature, top_k=c.top_k, top_p=c.top_p,
             )
 
         def score(params, ref_params, tokens):
@@ -133,7 +159,7 @@ class PPOTrainer:
             lp = _shift_right_pad(
                 logprobs_from_logits(logits[:, :-1], tokens[:, 1:])
             )
-            ref_logits = actor_apply(ref_params, tokens)
+            ref_logits = ref_apply(ref_params, tokens)
             ref_lp = _shift_right_pad(
                 logprobs_from_logits(ref_logits[:, :-1], tokens[:, 1:])
             )

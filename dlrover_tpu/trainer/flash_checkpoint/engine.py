@@ -54,25 +54,40 @@ class SaverMode(str, Enum):
     LOCAL = "local"  # standalone: saver thread in this process
 
 
+def _covers_full(index: List[List[int]], global_shape: Tuple[int, ...]) -> bool:
+    return all(
+        a == 0 and b == n for (a, b), n in zip(index, global_shape)
+    )
+
+
 def _assemble_leaf(
     global_shape: Tuple[int, ...],
     dtype: str,
     pieces: List[Tuple[List[List[int]], np.ndarray]],
+    copy: bool = True,
 ) -> np.ndarray:
     """Rebuild a full array from (index, data) shards.
 
     ``index`` is a per-dim [start, stop] list over the global shape (empty
     for scalars / unsharded fallbacks); overlapping pieces (replicas saved
     by different hosts) simply overwrite each other with identical data.
+
+    ``copy=False``: when ONE piece already covers the whole array (the
+    unsharded / single-host case — most leaves of a 1-host restore),
+    return a zero-copy VIEW into the shm buffer instead of materializing
+    a second host copy.  Only safe when the caller consumes the data
+    before the next shm save reuses the segment (``_restore_into`` does:
+    ``jax.device_put`` copies into the device buffer immediately).
     """
     if not global_shape:
         return np.array(pieces[0][1], dtype=np.dtype(dtype)).reshape(())
+    for index, data in pieces:
+        if not index or _covers_full(index, global_shape):
+            view = data.reshape(global_shape)
+            return view if not copy else np.array(view, dtype=np.dtype(dtype))
     full = np.empty(global_shape, dtype=np.dtype(dtype))
     covered = 0
     for index, data in pieces:
-        if not index:
-            # copy: data may be a view into the (mutable, reused) shm buffer
-            return np.array(data, dtype=np.dtype(dtype)).reshape(global_shape)
         slices = tuple(slice(a, b) for a, b in index)
         full[slices] = data.reshape([b - a for a, b in index])
         covered += data.size
@@ -244,7 +259,18 @@ class CheckpointEngine:
         """
         self._ensure_saver()  # shm meta server must exist before we query it
         try:
-            loaded = self._load_from_memory()
+            # With a target the leaves are device_put immediately, so
+            # zero-copy shm views skip the 2nd host copy — safe on
+            # TPU/GPU where device_put is a real transfer.  The CPU
+            # backend ALIASES host numpy memory in device_put, which
+            # would hand the caller arrays living inside the reusable
+            # shm segment — copy there.
+            import jax
+
+            zero_copy_ok = (
+                target is not None and jax.default_backend() != "cpu"
+            )
+            loaded = self._load_from_memory(copy=not zero_copy_ok)
         except ValueError as e:
             # This host's shm holds only its own addressable shards; when
             # params span hosts (fsdp across processes) and a PEER host
@@ -264,7 +290,9 @@ class CheckpointEngine:
             return step, _restore_into(target, saved, shardings)
         return self.load_from_storage(target, shardings)
 
-    def _load_from_memory(self) -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
+    def _load_from_memory(
+        self, copy: bool = True
+    ) -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
         try:
             result = self._shm_handler.load_arrays()
         except Exception:
@@ -279,7 +307,8 @@ class CheckpointEngine:
                 for i in range(len(meta["shards"]))
             ]
             saved[path] = _assemble_leaf(
-                tuple(meta["global_shape"]), meta["dtype"], pieces
+                tuple(meta["global_shape"]), meta["dtype"], pieces,
+                copy=copy,
             )
         logger.info("Restoring step %s from shared memory", step)
         return step, saved

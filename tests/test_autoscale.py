@@ -225,3 +225,64 @@ def test_dist_master_tuning_loop_publishes_configs():
     assert observed >= 7  # every measured round actually scored the BO
     best = master.strategy_generator.best_config()
     assert best is not None
+
+
+def test_ps_util_band_resize():
+    """PS resize outside the utilization band (reference
+    optimize_job_ps_resource_util.go)."""
+    from dlrover_tpu.master.resource.ps_optimizer import (
+        PSResourceOptimizer,
+        PSUtilSample,
+    )
+
+    opt = PSResourceOptimizer(util_low=0.3, util_high=0.85, headroom=1.4)
+    samples = [
+        # over-provisioned: 1 of 8 cores used -> shrink to ~1.4
+        PSUtilSample(0, cpu_used=1.0, cpu_requested=8.0,
+                     memory_used_mb=1000, memory_requested_mb=8000),
+        # in band: untouched
+        PSUtilSample(1, cpu_used=4.0, cpu_requested=8.0,
+                     memory_used_mb=1000, memory_requested_mb=8000),
+        # saturated: grow
+        PSUtilSample(2, cpu_used=7.8, cpu_requested=8.0,
+                     memory_used_mb=7000, memory_requested_mb=8000),
+    ]
+    plan = opt.generate_util_plan(samples)
+    resized = {n.id: n.config_resource for n in plan.launch_nodes}
+    assert set(resized) == {0, 2}
+    assert resized[0].cpu == 1.4
+    assert resized[2].cpu == round(7.8 * 1.4, 1)
+    assert resized[2].memory >= 7000 * 1.4 - 1
+    assert len(plan.remove_nodes) == 2  # resize = remove + relaunch
+
+
+def test_hot_ps_detection_and_scaling():
+    """A hot PS (beyond threshold AND above the median) gets cpu scaled
+    to the target worker fan-in and a memory bump (reference
+    optimize_job_hot_ps_resource.go)."""
+    from dlrover_tpu.master.resource.ps_optimizer import (
+        PSResourceOptimizer,
+        PSUtilSample,
+    )
+
+    opt = PSResourceOptimizer(
+        hot_cpu_threshold=0.9, hot_median_factor=1.5,
+        hot_memory_adjust_mb=2048, headroom=1.4,
+    )
+    samples = [
+        PSUtilSample(0, 7.6, 8.0, 4000, 8000),   # hot: util 0.95
+        PSUtilSample(1, 2.0, 8.0, 4000, 8000),   # cool
+        PSUtilSample(2, 2.4, 8.0, 4000, 8000),   # cool
+    ]
+    # worker fan-in doubling from 4 to 8
+    plan = opt.generate_hot_ps_plan(samples, worker_count=4,
+                                    target_worker_count=8)
+    assert len(plan.launch_nodes) == 1
+    node = plan.launch_nodes[0]
+    assert node.id == 0
+    assert node.config_resource.cpu == round(7.6 * 2 * 1.4, 1)
+    assert node.config_resource.memory == 8000 + 2048
+
+    # nobody hot -> empty plan
+    cool = [PSUtilSample(i, 2.0, 8.0, 100, 8000) for i in range(3)]
+    assert opt.generate_hot_ps_plan(cool, worker_count=4).empty()

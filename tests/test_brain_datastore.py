@@ -1,0 +1,142 @@
+"""Brain job-history datastore (reference: the Brain's MySQL job-history
+tables, go/brain/pkg/datastore/implementation/utils/mysql.go:339, feeding
+resource optimizers and hpsearch)."""
+
+import os
+import time
+
+import numpy as np
+
+from dlrover_tpu.brain.datastore import JobHistoryStore, default_history_store
+from dlrover_tpu.brain.hpsearch import BayesianOptimizer, Param
+from dlrover_tpu.master.resource.local_optimizer import LocalOptimizer
+from dlrover_tpu.master.resource.optimizer import SpeedSample
+
+
+def test_store_roundtrip(tmp_path):
+    db = str(tmp_path / "hist.db")
+    s = JobHistoryStore(db)
+    s.record_job("j1", "llama-pretrain", {"node_num": 4})
+    s.record_speed("j1", 2, 10.0)
+    s.record_speed("j1", 4, 18.0)
+    s.record_speed("j1", 8, 19.0)
+    s.record_trial("j1", {"lr": 1e-3, "accum": 2}, 12.5)
+    s.finish_job("j1", "Succeeded")
+    s.close()
+
+    # persistence: a NEW process/store sees the history
+    s2 = JobHistoryStore(db)
+    assert s2.speed_history("llama-pretrain") == {2: 10.0, 4: 18.0, 8: 19.0}
+    assert s2.best_worker_count("llama-pretrain") == 8
+    assert s2.best_worker_count("other-job") is None
+    (params, value), = s2.prior_trials("llama-pretrain")
+    assert params == {"lr": 1e-3, "accum": 2} and value == 12.5
+    assert s2.jobs() == [("j1", "llama-pretrain", "Succeeded")]
+    s2.close()
+
+
+def test_optimizer_cold_start_uses_history(tmp_path):
+    s = JobHistoryStore(str(tmp_path / "hist.db"))
+    s.record_job("past", "train-x", {})
+    for n, v in ((2, 8.0), (4, 15.0), (6, 14.0)):
+        s.record_speed("past", n, v)
+
+    opt = LocalOptimizer(max_workers=8, history_store=s, job_name="train-x")
+    # no current-job samples yet -> plan jumps to the historical best (4)
+    plan = opt.generate_opt_plan([], current_workers=2)
+    assert plan.node_group_resources["worker"].count == 4
+
+    # once current samples exist, the live curve drives as before
+    samples = [SpeedSample(worker_num=4, speed=15.5)]
+    plan2 = opt.generate_opt_plan(samples, current_workers=4)
+    assert plan2.node_group_resources["worker"].count == 5  # grow by unit
+    s.close()
+
+
+def test_hpsearch_warm_start(tmp_path):
+    s = JobHistoryStore(str(tmp_path / "h.db"))
+    s.record_job("past", "tune-y", {})
+    rng = np.random.RandomState(0)
+    for _ in range(6):
+        lr = float(rng.uniform(0, 1))
+        s.record_trial("past", {"lr": lr}, -(lr - 0.7) ** 2)
+    bo = BayesianOptimizer([Param("lr", 0.0, 1.0)], seed=1, n_init=4)
+    adopted = bo.warm_start(s.prior_trials("tune-y"))
+    assert adopted == 6
+    # with 6 prior observations the GP path is active immediately and
+    # proposes near the prior optimum
+    prop = bo.suggest()
+    assert 0.3 < prop["lr"] < 1.0
+    # trials missing a dimension are skipped, not crashed
+    assert bo.warm_start([({"other": 1.0}, 0.0)]) == 0
+    s.close()
+
+
+def test_default_store_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("DLROVER_HISTORY_DB", raising=False)
+    assert default_history_store() is None
+    db = str(tmp_path / "env.db")
+    monkeypatch.setenv("DLROVER_HISTORY_DB", db)
+    s = default_history_store()
+    assert s is not None and os.path.exists(db)
+    s.close()
+
+
+def test_dist_master_records_history(tmp_path, monkeypatch):
+    """The master records its speed curve into the store for future jobs
+    (the reference's job_metrics persistence path)."""
+    from dlrover_tpu.common.rpc import find_free_port
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+    from dlrover_tpu.scheduler.in_memory import (
+        InMemoryCluster,
+        InMemoryNodeWatcher,
+        InMemoryScaler,
+    )
+
+    db = str(tmp_path / "hist.db")
+    monkeypatch.setenv("DLROVER_HISTORY_DB", db)
+    monkeypatch.setenv("DLROVER_JOB_NAME", "histjob")
+    monkeypatch.setenv("DLROVER_JOB_UID", "uid-42")
+    cluster = InMemoryCluster()
+    master = DistributedJobMaster(
+        find_free_port(),
+        scaler=InMemoryScaler(cluster),
+        watcher=InMemoryNodeWatcher(cluster),
+        node_num=1,
+    )
+    assert master.history_store is not None
+    # synthesize observed speed
+    master.speed_monitor.add_running_worker("worker", 0)
+    master.speed_monitor.sample_global_step(100, time.time() - 10)
+    master.speed_monitor.sample_global_step(200, time.time())
+    master._record_history_sample()
+    hist = JobHistoryStore(db)
+    assert hist.speed_history("histjob"), "no speed recorded"
+    assert hist.jobs()[0][:2] == ("uid-42", "histjob")
+    hist.close()
+    master.stop()
+
+
+def test_tuning_trials_persist_and_warm_start(tmp_path):
+    """The auto-tuning loop persists trials and warm-starts from them
+    (closes the loop the Brain's trial tables exist for)."""
+    from dlrover_tpu.brain.datastore import JobHistoryStore
+    from dlrover_tpu.master.hyperparams.strategy_generator import (
+        SimpleStrategyGenerator,
+    )
+
+    db = str(tmp_path / "t.db")
+    store = JobHistoryStore(db)
+    store.record_job("run1", "tunejob")
+    gen = SimpleStrategyGenerator(seed=3)
+    assert gen.attach_history(store, "run1", "tunejob") == 0
+    for _ in range(3):
+        gen.next_config()
+        gen.observe_speed(5.0)
+    assert len(store.prior_trials("tunejob")) == 3
+
+    # a later job warm-starts from them
+    store.record_job("run2", "tunejob")
+    gen2 = SimpleStrategyGenerator(seed=4)
+    assert gen2.attach_history(store, "run2", "tunejob") == 3
+    store.close()

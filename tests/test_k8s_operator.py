@@ -256,3 +256,160 @@ def test_ray_job_submitter_lifecycle():
     assert sub.status(jid) == "RUNNING"
     assert sub.wait(jid, timeout=5, poll=0.01) == "SUCCEEDED"
     assert sub.logs(jid) == "log"
+
+
+# ---------------------------------------------------------------------------
+# deployable artifacts (deploy/*.yaml + operator.main)
+# ---------------------------------------------------------------------------
+
+
+def _load_yaml_docs(path):
+    import yaml
+
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def test_crd_manifests_parse_and_match_types():
+    """deploy/crds/*.yaml are valid CRDs whose schema covers the
+    controller's spec fields (VERDICT r2 #8)."""
+    import os
+
+    base = os.path.join(os.path.dirname(__file__), "..", "deploy")
+    (ej,) = _load_yaml_docs(os.path.join(base, "crds/elasticjob-crd.yaml"))
+    assert ej["kind"] == "CustomResourceDefinition"
+    assert ej["spec"]["names"]["kind"] == "ElasticJob"
+    schema = ej["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    spec_props = schema["properties"]["spec"]["properties"]
+    for field in ("replicaSpecs", "distributionStrategy",
+                  "enableElasticScheduling", "image", "command"):
+        assert field in spec_props, field
+    replica = spec_props["replicaSpecs"]["additionalProperties"]["properties"]
+    assert {"replicas", "restartCount", "resource"} <= set(replica)
+
+    (sp,) = _load_yaml_docs(os.path.join(base, "crds/scaleplan-crd.yaml"))
+    assert sp["spec"]["names"]["kind"] == "ScalePlan"
+
+    docs = _load_yaml_docs(os.path.join(base, "operator.yaml"))
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["Namespace", "ServiceAccount", "ClusterRole",
+                     "ClusterRoleBinding", "Deployment"]
+    deploy = docs[-1]
+    cmd = deploy["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[:3] == ["python", "-m", "dlrover_tpu.operator.main"]
+    rules = docs[2]["rules"]
+    api_groups = {g for r in rules for g in r["apiGroups"]}
+    assert "dlrover-tpu.org" in api_groups and "" in api_groups
+
+    (job,) = _load_yaml_docs(os.path.join(base, "example-job.yaml"))
+    assert job["apiVersion"] == "dlrover-tpu.org/v1alpha1"
+    assert job["spec"]["replicaSpecs"]["worker"]["replicas"] == 4
+
+
+class _FakeCustomApi:
+    def __init__(self, jobs):
+        self.jobs = jobs
+        self.status_patches = []
+
+    def list_cluster_custom_object(self, group, version, plural):
+        return {"items": self.jobs}
+
+    def list_namespaced_custom_object(self, group, version, ns, plural):
+        return {"items": [j for j in self.jobs
+                          if j["metadata"].get("namespace") == ns]}
+
+    def patch_namespaced_custom_object_status(
+        self, group, version, ns, plural, name, body
+    ):
+        self.status_patches.append((name, body["status"]))
+        for j in self.jobs:
+            if j["metadata"]["name"] == name:
+                j.setdefault("status", {}).update(body["status"])
+
+
+class _FakeCoreApi:
+    def __init__(self):
+        self.pods = {}
+        self.services = {}
+        self.deleted = []
+
+    def read_namespaced_pod(self, name, ns):
+        if name not in self.pods:
+            raise KeyError(name)
+        return self.pods[name]
+
+    def create_namespaced_pod(self, ns, manifest):
+        manifest = dict(manifest)
+        manifest["status"] = {"phase": "Pending"}
+        self.pods[manifest["metadata"]["name"]] = manifest
+
+    def delete_namespaced_pod(self, name, ns):
+        self.deleted.append(name)
+        self.pods.pop(name, None)
+
+    def create_namespaced_service(self, ns, manifest):
+        self.services[manifest["metadata"]["name"]] = manifest
+
+
+def test_operator_main_reconciles_cr_to_master_pod():
+    """operator.main drives an ElasticJob CR end to end against the
+    mocked API: master pod+service created, status mirrored, crashed
+    master relaunched, success terminal."""
+    from dlrover_tpu.operator.main import JobReconciler, OperatorApi
+
+    job = {
+        "metadata": {"name": "demo", "namespace": "default", "uid": "u1"},
+        "spec": {
+            "image": "img:1",
+            "replicaSpecs": {"worker": {"replicas": 3}},
+        },
+    }
+    core, custom = _FakeCoreApi(), _FakeCustomApi([job])
+    api = OperatorApi(core, custom)
+    rec = JobReconciler(api, max_master_relaunch=1)
+
+    assert rec.reconcile(job) == "Pending"
+    assert "demo-master" in core.pods and "demo-master" in core.services
+    pod = core.pods["demo-master"]
+    cmd = pod["spec"]["containers"][0]["command"]
+    assert "--platform" in cmd and "k8s" in cmd
+    assert cmd[cmd.index("--node_num") + 1] == "3"
+    assert pod["metadata"]["ownerReferences"][0]["name"] == "demo"
+
+    # master runs -> CR Running
+    pod["status"]["phase"] = "Running"
+    assert rec.reconcile(job) == "Running"
+    # master crashes -> relaunched once
+    pod["status"]["phase"] = "Failed"
+    assert rec.reconcile(job) == "Pending"
+    assert core.deleted == ["demo-master"]
+    assert rec.reconcile(job) == "Pending"  # recreated
+    # crashes again -> budget exhausted -> Failed terminal
+    core.pods["demo-master"]["status"]["phase"] = "Failed"
+    assert rec.reconcile(job) == "Failed"
+    assert job["status"]["phase"] == "Failed"
+
+    # a fresh job that completes
+    job2 = {
+        "metadata": {"name": "ok", "namespace": "default", "uid": "u2"},
+        "spec": {"replicaSpecs": {"worker": {"replicas": 1}}},
+    }
+    custom.jobs.append(job2)
+    rec.reconcile(job2)
+    core.pods["ok-master"]["status"]["phase"] = "Succeeded"
+    assert rec.reconcile(job2) == "Succeeded"
+    assert ("ok", {"phase": "Running"}) not in custom.status_patches
+
+
+def test_operator_run_loop_with_fake_api():
+    from dlrover_tpu.operator.main import OperatorApi, run
+
+    job = {
+        "metadata": {"name": "loop", "namespace": "default", "uid": "u3"},
+        "spec": {"replicaSpecs": {"worker": {"replicas": 1}}},
+    }
+    core, custom = _FakeCoreApi(), _FakeCustomApi([job])
+    run(namespace="", api=OperatorApi(core, custom), max_iterations=2,
+        interval=0.01)
+    assert "loop-master" in core.pods
+    assert job["status"]["phase"] == "Pending"

@@ -1,0 +1,62 @@
+"""int8 weight-storage quantization (serving footprint / interchange;
+reference csrc int8 GEMM serving role — honest scope in models/quantize.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+from dlrover_tpu.models.quantize import (
+    dequantize_weights,
+    generate_int8,
+    quantize_weights_int8,
+    quantized_nbytes,
+)
+
+
+def _setup():
+    cfg = LlamaConfig.tiny(
+        max_seq_len=64, hidden_size=256, intermediate_size=512,
+        vocab_size=512, num_heads=2, num_kv_heads=2, dtype=jnp.float32,
+    )
+    model = LlamaModel(cfg)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, (2, 64)), jnp.int32
+    )
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), ids))
+    return cfg, model, ids, params
+
+
+def test_quantize_roundtrip_footprint_and_logits():
+    cfg, model, ids, params = _setup()
+    qvars = quantize_weights_int8(params)
+    # kernels+embeddings dominate: ~4x smaller
+    assert quantized_nbytes(qvars) < 0.35 * quantized_nbytes(params)
+    deq = dequantize_weights(qvars, dtype=jnp.float32)
+    ref = model.apply(params, ids)
+    got = model.apply(deq, ids)
+    err = float(jnp.mean(jnp.abs(got - ref)) / jnp.mean(jnp.abs(ref)))
+    assert err < 0.1, err
+    agree = float((jnp.argmax(got, -1) == jnp.argmax(ref, -1)).mean())
+    assert agree > 0.85, agree
+    # norm scales / biases pass through untouched
+    assert (
+        qvars["params"]["final_norm"]["scale"].dtype
+        == params["params"]["final_norm"]["scale"].dtype
+    )
+
+
+def test_generate_over_int8_weights():
+    import dataclasses
+
+    cfg, model, ids, params = _setup()
+    cfg_gen = dataclasses.replace(cfg, scan_layers=False, remat=False)
+    model_gen = LlamaModel(cfg_gen)
+    qvars = quantize_weights_int8(params)
+    toks, mask = generate_int8(
+        model_gen, qvars, ids[:, :8], max_new_tokens=4,
+        rng=jax.random.PRNGKey(0), temperature=0.0,
+    )
+    assert toks.shape == (2, 12)
+    assert int(mask.sum()) == 2 * 4
