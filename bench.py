@@ -297,7 +297,7 @@ def main() -> None:
     # head_dim 128 (TPU lane width), seq 4096: 1.10B params — the
     # largest Llama-proportioned model that trains on one 16G v5e
     # (bf16 params + int8-momentum Adafactor + dots-saveable remat).
-    # Micro-batch 1 x grad-accum 4 amortizes the optimizer update the
+    # Micro-batch 1 x grad-accum 8 amortizes the optimizer update the
     # way any real small-chip run would.
     realistic = {}
     if on_tpu:

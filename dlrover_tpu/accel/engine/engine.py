@@ -79,6 +79,14 @@ def search_strategy(
         else:
             raise ValueError("pass model_info for non-Llama models")
 
+    # hybrid DCN candidates only make sense when the devices actually
+    # span slices/hosts (emulated granules would just reorder one host)
+    def _granule(d):
+        si = getattr(d, "slice_index", None)
+        # slice_index 0 is a real slice id — `or` would miskey it
+        return ("slice", si) if si is not None else ("proc", d.process_index)
+
+    granules = len({_granule(d) for d in devices})
     candidates = enumerate_candidates(
         n,
         model_info,
@@ -86,6 +94,7 @@ def search_strategy(
         base_config=base_config,
         memory_budget_bytes=memory_budget_bytes,
         max_candidates=max_candidates,
+        n_granules=granules,
     )
     if not candidates:
         raise ValueError(

@@ -115,6 +115,7 @@ def enumerate_candidates(
     include_pp: bool = True,
     include_sp: bool = True,
     max_candidates: int = 16,
+    n_granules: int = 1,
 ) -> List[Candidate]:
     """All valid (mesh, remat) combinations for ``n_devices``, pruned by
     divisibility and the memory budget, cheapest-communication first.
@@ -154,8 +155,6 @@ def enumerate_candidates(
             not info.scan_layers or info.num_layers % spec.pp
         ):
             return
-        if spec.pp > 1 and info.num_experts:
-            return  # pp x MoE unsupported
         if spec.pp > 1 and b % (base.pp_microbatches or 2 * spec.pp):
             return  # pipeline_blocks requires batch % microbatches == 0
         if spec.ep > 1 and (
@@ -215,5 +214,19 @@ def enumerate_candidates(
             if ep > 1:
                 add(MeshSpec(dp=rest, ep=ep), f"dp{rest}ep{ep}")
                 add(MeshSpec(fsdp=rest, ep=ep), f"fsdp{rest}ep{ep}")
+    # multi-slice/host: dp-outer-over-DCN hybrid layouts (scaling-book
+    # recipe; n_granules = slices or processes in the device set)
+    if n_granules > 1 and n_devices % n_granules == 0:
+        per = n_devices // n_granules
+        add(
+            MeshSpec.hybrid(n_granules, per),
+            f"dcn{n_granules}xfsdp{per}",
+        )
+        for tp, rest in _factor_pairs(per):
+            if 1 < tp <= info.num_heads:
+                add(
+                    MeshSpec.hybrid(n_granules, per, fsdp=rest, tp=tp),
+                    f"dcn{n_granules}xfsdp{rest}tp{tp}",
+                )
 
     return out[:max_candidates]

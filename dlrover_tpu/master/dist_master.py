@@ -104,10 +104,14 @@ class DistributedJobMaster:
         self._job_uuid = os.getenv("DLROVER_JOB_UID", "") or f"job-{id(self)}"
         self._last_history_ts = 0.0
         if self.history_store is not None:
-            self.history_store.record_job(
-                self._job_uuid, self._job_name,
-                {"node_num": node_num},
-            )
+            try:
+                self.history_store.record_job(
+                    self._job_uuid, self._job_name,
+                    {"node_num": node_num},
+                )
+            except Exception as e:  # a locked/corrupt shared DB must not
+                logger.warning("job-history record failed: %s", e)
+                self.history_store = None
         self.job_auto_scaler = JobAutoScaler(
             optimizer=LocalOptimizer(
                 max_workers=2 * node_num,

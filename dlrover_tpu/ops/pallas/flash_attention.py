@@ -38,6 +38,10 @@ from jax.experimental.pallas import tpu as pltpu
 # with them — tune against the end-to-end step, not the kernel alone.
 # 2048-wide blocks exceed the 16MB scoped-VMEM limit; _fwd/_bwd clamp
 # blocks to the sequence length.
+# 1024x1024: the r3 end-to-end sweep measured 2048x2048 ~0.8% faster on
+# the fwd-dominant probe, but its BACKWARD kernel exceeds the 16M scoped
+# VMEM limit in full bench compiles (22.5M stack) — 1024 is the largest
+# robust block.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30

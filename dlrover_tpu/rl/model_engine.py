@@ -111,7 +111,6 @@ class RLModelEngine:
         """Init (or adopt) ``params`` for ``role``, placed on its mesh
         with its strategy's shardings.  Returns the sharded variables."""
         abstract, sharding = self.param_sharding(role, model, probe_ids)
-        self.shardings[role] = sharding
         strat = self.strategies[role]
         mesh = self.meshes[role]
         if params is None:
@@ -124,19 +123,27 @@ class RLModelEngine:
                 params = init(rng if rng is not None else jax.random.PRNGKey(0))
         else:
             params = jax.device_put(params, nn.unbox(sharding))
-        self.params[role] = params
-
-        def apply_fn(p, tokens, **kwargs):
-            with logical_rules_context(strat.logical_rules), mesh:
-                return model.apply(p, tokens, **kwargs)
-
-        self._apply_fns[role] = apply_fn
+        self._register(role, model, params, sharding)
         n_leaves = len(jax.tree_util.tree_leaves(params))
         logger.info(
             "RL role %r prepared: mesh=%s (%s param leaves)",
             role, strat.mesh_spec.dims, n_leaves,
         )
         return params
+
+    def _register(self, role: str, model: nn.Module, params: Any,
+                  sharding: Any) -> None:
+        """Record a role's placed params and mesh/rules-scoped apply."""
+        self.shardings[role] = sharding
+        self.params[role] = params
+        strat = self.strategies[role]
+        mesh = self.meshes[role]
+
+        def apply_fn(p, tokens, **kwargs):
+            with logical_rules_context(strat.logical_rules), mesh:
+                return model.apply(p, tokens, **kwargs)
+
+        self._apply_fns[role] = apply_fn
 
     # -- use -------------------------------------------------------------
     def apply(self, role: str) -> Callable:
@@ -150,20 +157,11 @@ class RLModelEngine:
             logical_to_spec(("batch", None), strat.logical_rules),
         )
 
-    def adopt(self, role: str, params: Any, like_role: str,
+    def adopt(self, role: str, params: Any,
               model: nn.Module, probe_ids: jax.Array) -> Any:
         """Place a copy of ``params`` (e.g. the frozen ref = actor copy)
         under ``role``'s own strategy."""
         _, sharding = self.param_sharding(role, model, probe_ids)
-        self.shardings[role] = sharding
         placed = jax.device_put(params, nn.unbox(sharding))
-        self.params[role] = placed
-        strat = self.strategies[role]
-        mesh = self.meshes[role]
-
-        def apply_fn(p, tokens, **kwargs):
-            with logical_rules_context(strat.logical_rules), mesh:
-                return model.apply(p, tokens, **kwargs)
-
-        self._apply_fns[role] = apply_fn
+        self._register(role, model, placed, sharding)
         return placed

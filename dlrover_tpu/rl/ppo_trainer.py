@@ -116,7 +116,7 @@ class PPOTrainer:
             )
             self.ref_params = self.engine.adopt(
                 "ref", jax.tree.map(lambda x: x, actor_params),
-                "actor", self.actor, probe,
+                self.actor, probe,
             )
         else:
             if actor_params is None:

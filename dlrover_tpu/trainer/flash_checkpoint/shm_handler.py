@@ -122,6 +122,18 @@ class SharedMemoryHandler:
 
     # -- write side (training process) ----------------------------------
     def save_state_dict(self, state: Any, step: int) -> None:
+        # Stage ALL leaves' D2H DMA first, then consume: the copies
+        # overlap across shards and the save pause approaches
+        # max(total D2H, shm memcpy) instead of their serial sum
+        # (reference engine.py: the async-copy half of its save pause).
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(state):
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    break  # backend without async staging: plain path
         pairs = leaf_paths(state)
         metas: Dict[str, Dict] = {}
         buffers: List[Tuple[int, np.ndarray]] = []

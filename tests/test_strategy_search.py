@@ -131,3 +131,36 @@ def test_auto_accelerate_gpt2_family():
     _, metrics = res.train_step(state, {"input_ids": ids})
     assert np.isfinite(float(metrics["loss"]))
     assert report.best is not None
+
+
+def test_planner_emits_hybrid_and_pp_moe_candidates():
+    """Round-3 planner coverage: multi-granule device sets produce
+    dp-over-DCN hybrid layouts, and MoE models may pipeline (pp x ep is
+    supported now)."""
+    from dlrover_tpu.accel.engine.planner import (
+        ModelInfo,
+        enumerate_candidates,
+    )
+
+    info = ModelInfo(
+        num_params=1_000_000, num_layers=4, num_heads=4, num_kv_heads=4,
+        hidden_size=64, vocab_size=256, scan_layers=True, num_experts=0,
+    )
+    cands = enumerate_candidates(
+        8, info, (8, 64), n_granules=2, max_candidates=32
+    )
+    names = [c.name for c in cands]
+    assert any(n.startswith("dcn2x") for n in names), names
+    hybrid = next(c for c in cands if c.name.startswith("dcn2x"))
+    assert hybrid.config.mesh_spec.dcn_dp == 2
+
+    moe_info = ModelInfo(
+        num_params=1_000_000, num_layers=4, num_heads=4, num_kv_heads=4,
+        hidden_size=64, vocab_size=256, scan_layers=True, num_experts=2,
+    )
+    moe_cands = enumerate_candidates(
+        8, moe_info, (8, 64), max_candidates=32
+    )
+    assert any(
+        c.config.mesh_spec.pp > 1 for c in moe_cands
+    ), [c.name for c in moe_cands]
