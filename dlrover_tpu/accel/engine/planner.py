@@ -131,9 +131,10 @@ def enumerate_candidates(
     out: List[Candidate] = []
 
     def add(spec: MeshSpec, name: str):
-        if spec.dims in seen:
+        key = (spec.dims, spec.dcn_dp)  # hybrid layouts differ by dcn_dp only
+        if key in seen:
             return
-        seen.add(spec.dims)
+        seen.add(key)
         if spec.size != n_devices:
             return
         # divisibility constraints (the reference's opt-lib validity
@@ -180,7 +181,22 @@ def enumerate_candidates(
             return
         out.append(cand)
 
-    # pure data-parallel family first (reference baseline)
+    # multi-slice/host FIRST: on a real multi-granule device set the
+    # DCN-aware layouts are the expected winners and must survive the
+    # max_candidates truncation (candidates are dry-run in order)
+    if n_granules > 1 and n_devices % n_granules == 0:
+        per = n_devices // n_granules
+        add(
+            MeshSpec.hybrid(n_granules, per),
+            f"dcn{n_granules}xfsdp{per}",
+        )
+        for tp, rest in _factor_pairs(per):
+            if 1 < tp <= info.num_heads:
+                add(
+                    MeshSpec.hybrid(n_granules, per, fsdp=rest, tp=tp),
+                    f"dcn{n_granules}xfsdp{rest}tp{tp}",
+                )
+    # pure data-parallel family (reference baseline)
     add(MeshSpec(fsdp=n_devices), f"fsdp{n_devices}")
     add(MeshSpec(dp=n_devices), f"dp{n_devices}")
     # fsdp x tp
@@ -214,19 +230,10 @@ def enumerate_candidates(
             if ep > 1:
                 add(MeshSpec(dp=rest, ep=ep), f"dp{rest}ep{ep}")
                 add(MeshSpec(fsdp=rest, ep=ep), f"fsdp{rest}ep{ep}")
-    # multi-slice/host: dp-outer-over-DCN hybrid layouts (scaling-book
-    # recipe; n_granules = slices or processes in the device set)
-    if n_granules > 1 and n_devices % n_granules == 0:
-        per = n_devices // n_granules
-        add(
-            MeshSpec.hybrid(n_granules, per),
-            f"dcn{n_granules}xfsdp{per}",
+    if len(out) > max_candidates:
+        logger.info(
+            "truncating %d candidates to %d: dropping %s",
+            len(out), max_candidates,
+            [c.name for c in out[max_candidates:]],
         )
-        for tp, rest in _factor_pairs(per):
-            if 1 < tp <= info.num_heads:
-                add(
-                    MeshSpec.hybrid(n_granules, per, fsdp=rest, tp=tp),
-                    f"dcn{n_granules}xfsdp{rest}tp{tp}",
-                )
-
     return out[:max_candidates]

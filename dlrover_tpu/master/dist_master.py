@@ -165,9 +165,13 @@ class DistributedJobMaster:
 
             self.strategy_generator = SimpleStrategyGenerator()
             if self.history_store is not None:
-                adopted = self.strategy_generator.attach_history(
-                    self.history_store, self._job_uuid, self._job_name
-                )
+                try:
+                    adopted = self.strategy_generator.attach_history(
+                        self.history_store, self._job_uuid, self._job_name
+                    )
+                except Exception as e:  # shared-DB faults never kill the master
+                    logger.warning("history warm start failed: %s", e)
+                    adopted = 0
                 if adopted:
                     logger.info(
                         "auto-tuning warm-started from %d prior trials",
