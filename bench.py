@@ -45,7 +45,7 @@ def _timed_windows(train_step, state, batch, steps, warmup,
     ``steps`` chained train steps each.  ``float(loss)`` forces a device
     sync (block_until_ready alone does not synchronize the axon tunnel).
     Returns (state, mean_step_s, min_step_s)."""
-    for _ in range(warmup):
+    for _ in range(max(1, warmup)):  # >=1: the sync below needs a step
         state, m = train_step(state, batch)
     float(m["loss"])
     windows = []

@@ -121,9 +121,12 @@ def enumerate_candidates(
     divisibility and the memory budget, cheapest-communication first.
 
     Ordering heuristic (stands in for the reference's baseline ranking):
-    prefer pure fsdp (the reference's own headline strategy), then
-    fsdp x tp, then sp/pp variants — candidates earlier in the list get
-    dry-run first so a truncated search still covers the usual winners.
+    on multi-granule device sets the DCN-aware hybrid layouts come
+    FIRST (they are the expected winners there and must survive
+    truncation), then pure fsdp (the reference's own headline strategy),
+    then fsdp x tp, then sp/pp variants — candidates earlier in the list
+    get dry-run first so a truncated search still covers the usual
+    winners.
     """
     base = base_config or AccelerateConfig()
     b, s = batch_shape

@@ -84,6 +84,17 @@ class JobHistoryStore:
             )
             self._conn.commit()
 
+    def ensure_job(self, job_uuid: str, job_name: str = "") -> None:
+        """Create the jobs row if absent (non-clobbering: trial/speed
+        writers must not overwrite a registered job's config)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO jobs "
+                "(job_uuid, job_name, config, created_at) VALUES (?,?,?,?)",
+                (job_uuid, job_name, "{}", time.time()),
+            )
+            self._conn.commit()
+
     def finish_job(self, job_uuid: str, status: str) -> None:
         with self._lock:
             self._conn.execute(
@@ -133,14 +144,18 @@ class JobHistoryStore:
         self, job_name: Optional[str] = None, limit: int = 256
     ) -> List[Tuple[Dict[str, float], float]]:
         """Past (params, value) observations to warm-start hpsearch."""
-        q = (
-            "SELECT t.params, t.value FROM trials t "
-            "JOIN jobs j ON t.job_uuid = j.job_uuid "
-        )
         args: List[Any] = []
         if job_name:
-            q += "WHERE j.job_name = ? "
+            q = (
+                "SELECT t.params, t.value FROM trials t "
+                "JOIN jobs j ON t.job_uuid = j.job_uuid "
+                "WHERE j.job_name = ? "
+            )
             args.append(job_name)
+        else:
+            # no name filter: include trials whose job row was never
+            # registered (a join would silently drop them)
+            q = "SELECT t.params, t.value FROM trials t "
         q += "ORDER BY t.ts DESC LIMIT ?"
         args.append(limit)
         with self._lock:

@@ -37,6 +37,10 @@ def main() -> int:
     p.add_argument("--seq-len", type=int, default=32)
     p.add_argument("--ckpt-dir", default="/tmp/dlrover_tpu_spmd_ckpt")
     p.add_argument("--metrics-file", default="")
+    p.add_argument("--step-sleep", type=float, default=0.0,
+                   help="host-side pause per step (elasticity tests: "
+                        "keeps tiny runs alive long enough to observe "
+                        "membership changes)")
     args = p.parse_args()
 
     # The test harness emulates hosts with virtual CPU devices; the env
@@ -104,6 +108,10 @@ def main() -> int:
             out.write(f"{step} {loss:.6f} {env.worker_num}\n")
             out.flush()
         trainer.maybe_save()
+        if args.step_sleep:
+            import time
+
+            time.sleep(args.step_sleep)
     print(f"[spmd] done at step {step}", flush=True)
     trainer.close()
     return 0

@@ -33,7 +33,7 @@ KILL_AFTER_STEP = 3
 SEQ, GB = 32, 8
 
 
-def _agent_cmd(node_rank, master_addr, work):
+def _agent_cmd(node_rank, master_addr, work, step_sleep=0.0):
     return [
         sys.executable, "-m", "dlrover_tpu.agent.launcher",
         "--nnodes=1:2", f"--node_rank={node_rank}",
@@ -45,6 +45,7 @@ def _agent_cmd(node_rank, master_addr, work):
         "--seq-len", str(SEQ),
         "--ckpt-dir", os.path.join(work, "ckpt"),
         "--metrics-file", os.path.join(work, "metrics"),
+        "--step-sleep", str(step_sleep),
     ]
 
 
@@ -184,3 +185,89 @@ def _reference_losses():
         ).astype(np.int32)
         losses.append(float(tr.train_step(batch)["loss"]))
     return losses
+
+
+def test_scale_up_mid_run_grows_world(tmp_path):
+    """Growth half of the elasticity story with REAL processes: node 0
+    trains solo, node 1 joins mid-run, node 0's agent notices the
+    waiting member, restarts into the 2-process jax.distributed world,
+    and the run continues from shm with the same trajectory."""
+    work = str(tmp_path)
+    from dlrover_tpu.common.rpc import find_free_port
+
+    port = find_free_port()
+    master = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.master.main",
+         "--platform", "local", "--port", str(port), "--node_num", "2"],
+        stdout=open(os.path.join(work, "master.log"), "w"),
+        stderr=subprocess.STDOUT,
+    )
+    agents = {}
+
+    def start_agent(rank):
+        env = dict(os.environ)
+        env.update(
+            DLROVER_FORCE_CPU="1",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            DLROVER_JAX_HEARTBEAT_TIMEOUT="10",
+            DLROVER_JOB_UID=f"spmdGrow{rank}",
+            JAX_PLATFORMS="cpu",
+        )
+        agents[rank] = subprocess.Popen(
+            # slow steps: the solo phase must outlive the joiner's boot
+            _agent_cmd(rank, f"127.0.0.1:{port}", work, step_sleep=2.0),
+            env=env, cwd=REPO,
+            stdout=open(os.path.join(work, f"agent{rank}.log"), "w"),
+            stderr=subprocess.STDOUT,
+            preexec_fn=os.setsid,
+        )
+
+    try:
+        time.sleep(2)
+        start_agent(0)
+        # solo world forms after the last-call window; wait for steps
+        m0 = os.path.join(work, "metrics.r0")
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            rows = _read_metrics(m0)
+            if any(s >= 2 and w == 1 for s, _, w in rows):
+                break
+            if agents[0].poll() is not None:
+                pytest.fail("agent0 exited before training solo")
+            time.sleep(1)
+        else:
+            pytest.fail("solo world never trained")
+
+        start_agent(1)  # join mid-run
+
+        rc0 = agents[0].wait(400)
+        assert rc0 == 0, "agent0 failed after scale-up"
+        rc1 = agents[1].wait(60)
+        assert rc1 == 0, "agent1 failed"
+
+        rows = _read_metrics(m0)
+        worlds = {s: w for s, _, w in rows}
+        assert worlds[TOTAL_STEPS] == 2, (
+            f"final steps did not run on the grown world: {rows}"
+        )
+        grow_step = min(s for s, w in worlds.items() if w == 2)
+        assert grow_step > 1
+        steps = [s for s, _, _ in rows]
+        assert steps == sorted(set(steps)), steps  # no redone work
+        ref = _reference_losses()
+        for s, loss, _ in rows:
+            assert np.isclose(loss, ref[s - 1], rtol=1e-3, atol=1e-3), (
+                s, loss, ref[s - 1]
+            )
+    finally:
+        for p in agents.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        master.terminate()
+        try:
+            master.wait(10)
+        except subprocess.TimeoutExpired:
+            master.kill()
