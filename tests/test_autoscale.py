@@ -286,3 +286,41 @@ def test_hot_ps_detection_and_scaling():
     # nobody hot -> empty plan
     cool = [PSUtilSample(i, 2.0, 8.0, 100, 8000) for i in range(3)]
     assert opt.generate_hot_ps_plan(cool, worker_count=4).empty()
+
+
+def test_autoscaler_forwards_per_node_resizes():
+    """A ResourcePlan carrying only per-node relaunches (the PS
+    optimizers' shape) must reach the scaler, not be dropped."""
+    from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.resource.optimizer import ResourcePlan
+    from dlrover_tpu.common.node import Node, NodeResource
+
+    class SpyScaler:
+        def __init__(self):
+            self.plans = []
+
+        def start(self):
+            pass
+
+        def scale(self, plan):
+            self.plans.append(plan)
+
+    scaler = SpyScaler()
+    aus = JobAutoScaler(
+        optimizer=None,
+        speed_monitor=SpeedMonitor(),
+        scaler=scaler,
+        get_worker_num=lambda: 2,
+        rdzv_managers={},
+    )
+    plan = ResourcePlan()
+    plan.remove_nodes.append(Node("ps", 3, rank_index=3))
+    plan.launch_nodes.append(
+        Node("ps", 3, rank_index=3,
+             config_resource=NodeResource(cpu=8, memory=16000))
+    )
+    scale_plan = aus.execute_job_optimization_plan(plan)
+    assert len(scaler.plans) == 1
+    assert [n.id for n in scale_plan.remove_nodes] == [3]
+    assert scale_plan.launch_nodes[-1].config_resource.cpu == 8
