@@ -291,22 +291,7 @@ def test_hot_ps_detection_and_scaling():
 def test_autoscaler_forwards_per_node_resizes():
     """A ResourcePlan carrying only per-node relaunches (the PS
     optimizers' shape) must reach the scaler, not be dropped."""
-    from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
-    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
-    from dlrover_tpu.master.resource.optimizer import ResourcePlan
-    from dlrover_tpu.common.node import Node, NodeResource
-
-    class SpyScaler:
-        def __init__(self):
-            self.plans = []
-
-        def start(self):
-            pass
-
-        def scale(self, plan):
-            self.plans.append(plan)
-
-    scaler = SpyScaler()
+    scaler = RecordingScaler()
     aus = JobAutoScaler(
         optimizer=None,
         speed_monitor=SpeedMonitor(),
