@@ -4,3 +4,7 @@ buffer, and adaptive KL control."""
 
 from dlrover_tpu.rl.config import PPOConfig  # noqa: F401
 from dlrover_tpu.rl.ppo_trainer import PPOTrainer, ValueModel  # noqa: F401
+from dlrover_tpu.rl.reward import (  # noqa: F401
+    RewardModelTrainer,
+    make_reward_fn,
+)

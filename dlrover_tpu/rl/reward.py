@@ -1,0 +1,134 @@
+"""Reward-model training: pairwise preference ranking.
+
+Parity target: the reference RL stack's reward-model role
+(atorch/atorch/rl/model_engine/model_engine.py model_types includes
+"reward"; its model_utils build the RM from a causal trunk + scalar
+head) and the standard RLHF RM recipe the reference's examples follow —
+Bradley-Terry pairwise loss over (chosen, rejected) completions, scored
+at the last response token.
+
+TPU-native: the RM is :class:`dlrover_tpu.rl.ppo_trainer.ValueModel`
+(causal trunk + scalar head, the same module PPO uses as critic); one
+jitted step scores both completions in a single batched forward
+([2B, T] — keeps the MXU batch large) and applies
+``-log(sigmoid(r_chosen - r_rejected))``.  The trained params drop
+straight into ``PPOTrainer``'s ``reward_fn`` via :func:`make_reward_fn`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def last_token_reward(scores: jax.Array, mask: jax.Array) -> jax.Array:
+    """[B, T] per-token scores -> [B] reward at each sequence's LAST
+    valid (mask != 0) position (the RM scoring convention).  A row with
+    no valid positions scores 0 (not some padding token's value)."""
+    mask = mask.astype(jnp.int32)
+    last = mask.shape[1] - 1 - jnp.argmax(jnp.flip(mask, axis=1), axis=1)
+    picked = jnp.take_along_axis(scores, last[:, None], axis=1)[:, 0]
+    return jnp.where(mask.sum(axis=1) > 0, picked, 0.0)
+
+
+def pairwise_loss(
+    chosen_r: jax.Array, rejected_r: jax.Array
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Bradley-Terry ranking loss with accuracy/margin stats."""
+    margin = chosen_r - rejected_r
+    loss = -jnp.mean(jax.nn.log_sigmoid(margin))
+    stats = {
+        "accuracy": jnp.mean((margin > 0).astype(jnp.float32)),
+        "margin": jnp.mean(margin),
+    }
+    return loss, stats
+
+
+class RewardModelTrainer:
+    """Train a ValueModel-style RM on (chosen, rejected) token pairs."""
+
+    def __init__(
+        self,
+        model: Any,
+        learning_rate: float = 1e-4,
+        max_grad_norm: float = 1.0,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm),
+            optax.adamw(learning_rate, weight_decay=0.01),
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        self.params: Optional[Any] = None
+        self.opt_state = None
+        self._jit_step = None
+        self._jit_eval = None
+
+    def init(self, seq_len: int, params: Optional[Any] = None) -> None:
+        probe = jnp.zeros((1, seq_len), jnp.int32)
+        if params is None:
+            self._rng, k = jax.random.split(self._rng)
+            params = self.model.init(k, probe)
+        self.params = params
+        self.opt_state = self.optimizer.init(params)
+        model_apply = self.model.apply
+        optimizer = self.optimizer
+
+        def scores_fn(params, tokens, mask):
+            # [2B, T] single forward: chosen stacked over rejected
+            per_token = model_apply(params, tokens)
+            return last_token_reward(per_token, mask)
+
+        def step(params, opt_state, batch):
+            def loss_fn(p):
+                n = batch["chosen"].shape[0]
+                tokens = jnp.concatenate(
+                    [batch["chosen"], batch["rejected"]], axis=0
+                )
+                mask = jnp.concatenate(
+                    [batch["chosen_mask"], batch["rejected_mask"]], axis=0
+                )
+                r = scores_fn(p, tokens, mask)
+                return pairwise_loss(r[:n], r[n:])
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats["loss"] = loss
+            return params, opt_state, stats
+
+        self._jit_step = jax.jit(step)
+        self._jit_eval = jax.jit(scores_fn)
+
+    def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """``batch``: chosen/rejected [B, T] int32 + *_mask [B, T]."""
+        assert self.params is not None, "call init() first"
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._jit_step(
+            self.params, self.opt_state, batch
+        )
+        return {k: float(v) for k, v in stats.items()}
+
+    def score(self, tokens: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        assert self._jit_eval is not None, "call init() first"
+        return np.asarray(
+            self._jit_eval(self.params, jnp.asarray(tokens),
+                           jnp.asarray(mask))
+        )
+
+
+def make_reward_fn(
+    trainer: RewardModelTrainer,
+) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
+    """Adapter: a trained RM as ``PPOTrainer``'s ``reward_fn(tokens,
+    response_mask) -> scores`` (the reference's reward-model call in
+    make_experience).  ``trainer.score`` already has the contract's
+    exact signature; this name exists for discoverability."""
+    return trainer.score
