@@ -24,6 +24,15 @@ def logprobs_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
     )[..., 0]
 
 
+def last_valid_index(mask: jax.Array) -> jax.Array:
+    """[B, T] mask -> [B] index of each row's LAST nonzero position
+    (0 for an all-zero row — pair with a validity check when that
+    matters).  The single home of the 'score at the last response
+    token' convention shared by reward shaping and RM scoring."""
+    t = jnp.arange(mask.shape[1])[None, :]
+    return jnp.maximum(jnp.argmax(jnp.where(mask > 0, t, -1), axis=1), 0)
+
+
 def kl_penalty(logprobs: jax.Array, ref_logprobs: jax.Array) -> jax.Array:
     """Per-token KL estimate logp - ref_logp on the sampled tokens
     (reference get_kl_penalty uses the same sampled-token estimator)."""
@@ -44,11 +53,7 @@ def shape_rewards(
     """
     kl = kl_penalty(logprobs, ref_logprobs) * response_mask
     rewards = -kl_coef * kl
-    # index of last response token per row
-    t = jnp.arange(response_mask.shape[1])[None, :]
-    last = jnp.argmax(
-        jnp.where(response_mask > 0, t, -1), axis=1
-    )
+    last = last_valid_index(response_mask)
     rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
     denom = jnp.maximum(response_mask.sum(), 1.0)
     return rewards, kl.sum() / denom

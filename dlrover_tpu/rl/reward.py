@@ -27,10 +27,13 @@ import optax
 
 def last_token_reward(scores: jax.Array, mask: jax.Array) -> jax.Array:
     """[B, T] per-token scores -> [B] reward at each sequence's LAST
-    valid (mask != 0) position (the RM scoring convention).  A row with
-    no valid positions scores 0 (not some padding token's value)."""
+    valid (mask != 0) position (the RM scoring convention, shared with
+    PPO's reward shaping via ppo_utils.last_valid_index).  A row with no
+    valid positions scores 0 (not some padding token's value)."""
+    from dlrover_tpu.rl.ppo_utils import last_valid_index
+
     mask = mask.astype(jnp.int32)
-    last = mask.shape[1] - 1 - jnp.argmax(jnp.flip(mask, axis=1), axis=1)
+    last = last_valid_index(mask)
     picked = jnp.take_along_axis(scores, last[:, None], axis=1)[:, 0]
     return jnp.where(mask.sum(axis=1) > 0, picked, 0.0)
 

@@ -1,0 +1,113 @@
+"""Supervised fine-tuning (SFT) with prompt-masked loss — stage 1 of the
+RLHF pipeline (SFT -> reward model -> PPO).
+
+Parity target: the reference's instruction-tuning entry point (atorch's
+HF-Trainer-shaped fine-tuning path, atorch_trainer.py; its RL examples
+assume an SFT'd actor).  The stages after this one live in
+``dlrover_tpu.rl``: :class:`~dlrover_tpu.rl.reward.RewardModelTrainer`
+(preference pairs) and :class:`~dlrover_tpu.rl.ppo_trainer.PPOTrainer`.
+
+What this demonstrates:
+- ``loss_mask``: the loss is computed on RESPONSE tokens only — prompt
+  positions contribute nothing (the standard SFT recipe; the fused
+  chunked loss honors the mask identically, accelerate.py loss path);
+- the high-level :class:`~dlrover_tpu.trainer.trainer.Trainer` with a
+  warmup+cosine schedule built from ``TrainingArguments``;
+- starting from an HF checkpoint: swap ``LlamaConfig.tiny`` +
+  random-init for ``models.convert.load_hf_llama`` and pass ``params``.
+
+Run::
+
+    python examples/train_sft.py --steps 30
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_example(rng: np.random.RandomState, seq_len: int, vocab: int):
+    """One synthetic instruction pair: [prompt || response || pad].
+
+    The 'task' is learnable: the response repeats the prompt's first
+    token (so a trained model measurably beats an untrained one).
+    Returns (input_ids [T], loss_mask [T]) with mask=1 on response
+    positions only.
+    """
+    if seq_len < 10:
+        raise SystemExit("--seq-len must be >= 10 (prompt + response)")
+    prompt_len = rng.randint(4, seq_len // 2)
+    resp_len = rng.randint(2, seq_len - prompt_len)
+    prompt = rng.randint(2, vocab, size=(prompt_len,))
+    response = np.full((resp_len,), prompt[0])
+    ids = np.zeros((seq_len,), np.int32)
+    ids[:prompt_len] = prompt
+    ids[prompt_len:prompt_len + resp_len] = response
+    mask = np.zeros((seq_len,), np.float32)
+    # next-token loss at position t scores token t+1: response tokens
+    # t+1 in [prompt_len, prompt_len+resp_len) are scored by positions
+    # [prompt_len-1, ...); the Trainer's loss shifts labels internally,
+    # so the mask marks the RESPONSE TOKEN positions themselves.
+    mask[prompt_len:prompt_len + resp_len] = 1.0
+    return ids, mask
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=256)
+    args = p.parse_args()
+
+    import jax
+
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+    from dlrover_tpu.trainer.trainer import Trainer, TrainingArguments
+
+    cfg = LlamaConfig.tiny(max_seq_len=args.seq_len,
+                           vocab_size=args.vocab)
+    rng = np.random.RandomState(0)
+
+    def batches():
+        for _ in range(args.steps):
+            ids, masks = zip(*[
+                build_example(rng, args.seq_len, cfg.vocab_size)
+                for _ in range(args.global_batch)
+            ])
+            yield {
+                "input_ids": np.stack(ids),
+                "loss_mask": np.stack(masks),
+            }
+
+    trainer = Trainer(
+        LlamaModel(cfg),
+        TrainingArguments(
+            max_steps=args.steps,
+            logging_steps=max(1, args.steps // 5),
+            learning_rate=3e-3,
+            warmup_ratio=0.1,
+            lr_scheduler_type="cosine",
+            weight_decay=0.01,
+        ),
+        list(batches()),
+        global_batch_size=args.global_batch,
+        micro_batch_per_shard=args.global_batch // max(
+            1, len(jax.devices())
+        ) or 1,
+        seq_len=args.seq_len,
+    )
+    out = trainer.train()
+    train_logs = [l for l in trainer.log_history if "loss" in l]
+    first, last = train_logs[0]["loss"], train_logs[-1]["loss"]
+    print(
+        f"[sft] loss {first:.3f} -> {last:.3f} over "
+        f"{out.global_step} steps (masked to response tokens)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
