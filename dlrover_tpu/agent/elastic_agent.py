@@ -282,10 +282,17 @@ class ElasticAgent:
         while not self._stop_heartbeat.wait(interval):
             try:
                 self._client.report_heart_beat(time.time())
-            except ValueError:
-                # closed channel: the client is gone for good (owner shut
-                # down without stop_heartbeat) — beating on is pure noise
-                return
+            except ValueError as e:
+                # grpc raises ValueError when invoked on a closed channel
+                # (owner shut the client without stop_heartbeat) — beating
+                # on is pure noise then.  Any OTHER ValueError (e.g. a
+                # serialization bug) must NOT silently kill the thread:
+                # the master would synthesize this node as dead.
+                if self._stop_heartbeat.is_set() or getattr(
+                    self._client, "closed", False
+                ):
+                    return
+                logger.warning("heartbeat failed: %s", e)
             except Exception as e:
                 # a shutdown that closed the channel mid-RPC is expected
                 if not self._stop_heartbeat.is_set():

@@ -432,6 +432,10 @@ class MasterClient:
         reply = self._get(comm.BarrierRequest(barrier_name=barrier_name))
         return reply.success
 
+    @property
+    def closed(self) -> bool:
+        return self._stub.closed
+
     def close(self):
         self._stub.close()
 

@@ -126,35 +126,39 @@ class BrainService:
         return {"worker_count": workers}
 
     # -- hyperparameter search sessions ----------------------------------
-    def _session(self, msg: Dict[str, Any]) -> BayesianOptimizer:
+    def _session_locked(self, msg: Dict[str, Any]) -> BayesianOptimizer:
+        """Get/create the per-job optimizer. Caller holds ``self._lock``."""
         job_uuid = msg["job_uuid"]
-        with self._lock:
-            bo = self._searches.get(job_uuid)
-            if bo is None:
-                space = [
-                    Param(
-                        name=p["name"],
-                        low=float(p.get("low", 0.0)),
-                        high=float(p.get("high", 1.0)),
-                        choices=p.get("choices"),
-                        integer=bool(p.get("integer", False)),
-                    )
-                    for p in msg.get("space", [])
-                ]
-                bo = BayesianOptimizer(space, seed=int(msg.get("seed", 0)))
-                bo.warm_start(
-                    self._store.prior_trials(msg.get("job_name") or None)
+        bo = self._searches.get(job_uuid)
+        if bo is None:
+            space = [
+                Param(
+                    name=p["name"],
+                    low=float(p.get("low", 0.0)),
+                    high=float(p.get("high", 1.0)),
+                    choices=p.get("choices"),
+                    integer=bool(p.get("integer", False)),
                 )
-                self._searches[job_uuid] = bo
-            return bo
+                for p in msg.get("space", [])
+            ]
+            bo = BayesianOptimizer(space, seed=int(msg.get("seed", 0)))
+            bo.warm_start(
+                self._store.prior_trials(msg.get("job_name") or None)
+            )
+            self._searches[job_uuid] = bo
+        return bo
 
     def _suggest(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        return {"params": self._session(msg).suggest()}
+        # the lock must span the optimizer call itself: concurrent
+        # observe() mutates the trial history suggest() fits over
+        with self._lock:
+            return {"params": self._session_locked(msg).suggest()}
 
     def _observe(self, msg: Dict[str, Any]) -> None:
-        bo = self._searches.get(msg["job_uuid"])
-        if bo is not None:
-            bo.observe(msg["params"], float(msg["value"]))
+        with self._lock:
+            bo = self._searches.get(msg["job_uuid"])
+            if bo is not None:
+                bo.observe(msg["params"], float(msg["value"]))
         # an unregistered session's trials must still be reachable by
         # NAMED warm starts later (prior_trials joins the jobs table)
         self._store.ensure_job(msg["job_uuid"], msg.get("job_name", ""))

@@ -75,6 +75,7 @@ class RpcStub:
     def __init__(self, addr: str, timeout: float = 30.0):
         self._addr = addr
         self._timeout = timeout
+        self._closed = False
         self._channel = grpc.insecure_channel(
             addr,
             options=[
@@ -103,5 +104,10 @@ class RpcStub:
     def report(self, payload: bytes, timeout: float = 0) -> bytes:
         return self._report(payload, timeout=timeout or self._timeout)
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def close(self) -> None:
+        self._closed = True
         self._channel.close()

@@ -180,6 +180,20 @@ class JobReconciler:
         self._api = api
         self._max_relaunch = max_master_relaunch
         self._relaunches: Dict[tuple, int] = {}
+        self._seen_keys: set = set()
+
+    def prune_budgets(self) -> None:
+        """Drop relaunch budgets for jobs no longer being reconciled.
+
+        Called once per watch pass (after every job in the listing went
+        through ``reconcile``): any budget key not seen this pass belongs
+        to a deleted job — keeping it would grow ``_relaunches``
+        unboundedly on churny namespaces.
+        """
+        stale = [k for k in self._relaunches if k not in self._seen_keys]
+        for k in stale:
+            del self._relaunches[k]
+        self._seen_keys.clear()
 
     def reconcile(self, job: Dict[str, Any]) -> str:
         meta = job["metadata"]
@@ -188,6 +202,7 @@ class JobReconciler:
         # namespaces, or a deleted-and-recreated job (fresh uid), must
         # not inherit an exhausted relaunch budget
         budget_key = (ns, name, meta.get("uid", ""))
+        self._seen_keys.add(budget_key)
         status = job.get("status") or {}
         phase = status.get("phase", "Created")
         if phase in ("Succeeded", "Failed"):
@@ -259,6 +274,10 @@ def run(namespace: str = "", interval: float = 5.0,
                     "reconcile of %s failed",
                     job.get("metadata", {}).get("name"),
                 )
+        if jobs:
+            # only prune on a non-empty listing: an empty result may be
+            # a transient API failure, not mass deletion
+            reconciler.prune_budgets()
         if max_iterations is None or i < max_iterations:
             time.sleep(interval)
 

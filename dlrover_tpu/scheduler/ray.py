@@ -92,12 +92,27 @@ class RayClient:
         actor = AgentActor.options(**opts).remote()
         actor.run.remote(command, env)
 
-    def remove_actor(self, name: str) -> None:
+    def remove_actor(self, name: str, wait: float = 10.0) -> None:
+        """Kill a detached actor and wait for its NAME to be released.
+
+        ``ray.kill`` returns before the actor is fully dead; re-creating
+        the same detached name immediately (the per-node-resize path:
+        same identity in remove_nodes and launch_nodes) would race the
+        asynchronous name release and fail with name-already-taken.
+        """
         try:
             handle = self._ray.get_actor(name, namespace=self._ns)
             self._ray.kill(handle)
         except ValueError:
-            pass
+            return
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline:
+            try:
+                self._ray.get_actor(name, namespace=self._ns)
+            except ValueError:
+                return  # name released
+            time.sleep(0.2)
+        logger.warning("actor %s still registered after kill", name)
 
     def list_actors(self) -> List[Tuple[str, str]]:
         from ray.util import state
@@ -188,14 +203,27 @@ class ActorScaler(Scaler):
                     for name, _, _ in doomed:
                         self._client.remove_actor(name)
                         logger.info("removed ray actor %s", name)
-            for node in plan.launch_nodes:
-                self._next_id += 1
-                self._launch(node.type, self._next_id, node.rank_index,
-                             node.config_resource)
+            # removals first: a per-node resize plan carries the SAME
+            # identity in remove_nodes and launch_nodes, and a detached
+            # actor name must be freed before its replacement is created
             for node in plan.remove_nodes:
                 name = actor_name(self._job_name, node.type, node.id,
                                   node.rank_index)
                 self._client.remove_actor(name)
+            for node in plan.launch_nodes:
+                # honor the plan's node id (a relaunch must keep its
+                # identity for consumers keying on it); mint a fresh one
+                # only when the plan left it unset
+                if node.id is not None:
+                    nid = node.id
+                    # future minted ids must never collide with an
+                    # honored one (two live actors sharing a NODE_ID)
+                    self._next_id = max(self._next_id, nid)
+                else:
+                    self._next_id += 1
+                    nid = self._next_id
+                self._launch(node.type, nid, node.rank_index,
+                             node.config_resource)
 
 
 class ActorWatcher(NodeWatcher):

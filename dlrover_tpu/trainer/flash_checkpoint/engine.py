@@ -84,7 +84,11 @@ def _assemble_leaf(
     for index, data in pieces:
         if not index or _covers_full(index, global_shape):
             view = data.reshape(global_shape)
-            return view if not copy else np.array(view, dtype=np.dtype(dtype))
+            # the zero-copy path must not silently reinterpret a shard
+            # whose stored dtype diverged from the recorded meta dtype
+            if copy or view.dtype != np.dtype(dtype):
+                return np.array(view, dtype=np.dtype(dtype))
+            return view
     full = np.empty(global_shape, dtype=np.dtype(dtype))
     covered = 0
     for index, data in pieces:
