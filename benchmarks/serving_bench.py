@@ -1,0 +1,125 @@
+"""Serving-engine benchmark: continuous-batching decode throughput.
+
+Measures the VERDICT r3 item-1 "done" criteria on the real chip:
+
+- ``serving_tok_s_bf16`` / ``serving_tok_s_int8``: aggregate decode
+  tokens/sec at 8 concurrent slots (prompt 128, generate 128 each);
+- ``serving_int8_speedup``: int8 / bf16 (target >= 1.2 — weights
+  pre-quantized into the Pallas kernel layout, streaming from HBM at
+  half the bf16 bytes on the bandwidth-bound decode path);
+- ``serving_batch_scaling``: slots-8 aggregate throughput / slots-1
+  throughput (continuous batching must scale, target >> 1).
+
+Each config runs in its OWN subprocess (one JSON line on stdout) so an
+HBM-arena failure or compile flake in one config cannot poison the
+others — invoked with no argument, this script fans out over configs
+and merges the lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROMPT_LEN = 128
+GEN_LEN = 128
+N_REQUESTS = 8
+
+
+def _engine_cfg():
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.llama import LlamaConfig
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    if on_tpu:
+        # bench-model geometry (496M, bench.py): MXU-saturating shapes
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+            num_layers=6, num_heads=16, num_kv_heads=4,
+            max_seq_len=4096, scan_layers=True, remat=False,
+        )
+        prompt, gen, n_req = PROMPT_LEN, GEN_LEN, N_REQUESTS
+    else:
+        cfg = LlamaConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+        prompt, gen, n_req = 8, 8, 4
+    return cfg, prompt, gen, n_req
+
+
+def run_config(mode: str) -> dict:
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+
+    cfg, prompt_len, gen_len, n_req = _engine_cfg()
+    int8 = mode.startswith("int8")
+    slots = 1 if mode.endswith("slots1") else 8
+    model = LlamaModel(cfg)
+    probe = jax.numpy.zeros((1, 8), jax.numpy.int32)
+    variables = model.init(jax.random.PRNGKey(0), probe)
+    eng = InferenceEngine(
+        cfg, variables, max_slots=slots, int8=int8, chunk=32,
+        temperature=1.0, top_k=50,
+        max_len=prompt_len + gen_len, seed=0,
+    )
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, cfg.vocab_size,
+                          (n_req, prompt_len)).astype(np.int32)
+    # warmup: compile prefill + chunk
+    for i in range(min(2, n_req)):
+        eng.add_request(prompts[i], gen_len)
+    eng.run()
+    eng.stats.generated_tokens = 0
+    eng.stats.decode_seconds = 0.0
+    eng.stats.prefill_seconds = 0.0
+    t0 = time.perf_counter()
+    for i in range(n_req):
+        eng.add_request(prompts[i], gen_len)
+    eng.run()
+    wall = time.perf_counter() - t0
+    total_tokens = n_req * gen_len
+    return {
+        f"serving_tok_s_{mode}": round(total_tokens / wall, 1),
+        f"serving_decode_tok_s_{mode}": round(
+            eng.stats.decode_tokens_per_sec, 1),
+        f"serving_prefill_s_{mode}": round(eng.stats.prefill_seconds, 3),
+    }
+
+
+def main() -> dict:
+    out = {}
+    for mode in ("bf16", "int8", "bf16_slots1"):
+        proc = subprocess.run(
+            [sys.executable, __file__, mode],
+            capture_output=True, text=True, timeout=1800,
+            env=dict(os.environ),
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() \
+            else ""
+        try:
+            out.update(json.loads(line))
+        except (json.JSONDecodeError, ValueError):
+            out[f"serving_error_{mode}"] = (
+                (proc.stderr or "no output").strip()[-300:])
+    if "serving_tok_s_bf16" in out and "serving_tok_s_int8" in out:
+        out["serving_int8_speedup"] = round(
+            out["serving_tok_s_int8"] / out["serving_tok_s_bf16"], 3)
+    if "serving_tok_s_bf16" in out and "serving_tok_s_bf16_slots1" in out:
+        out["serving_batch_scaling"] = round(
+            out["serving_tok_s_bf16"] / out["serving_tok_s_bf16_slots1"],
+            2)
+    return out
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        print(json.dumps(run_config(sys.argv[1])))
+    else:
+        print(json.dumps(main()))

@@ -1,0 +1,93 @@
+"""RL inference backend: the serving engine behind PPO rollouts.
+
+Reference counterpart: atorch's vLLM inference backend
+(atorch/atorch/rl/inference_backend/vllm_backend.py:11-24) — the RL
+trainer hands rollout generation to a dedicated high-throughput engine
+and re-syncs the actor's weights into it every iteration (the
+reference's generation-model weight broadcast,
+rl/model_engine.py update_generation_model).  TPU-native equivalent:
+:class:`dlrover_tpu.serving.engine.InferenceEngine` (continuous
+batching + chunked KV-cache decode + optional pre-quantized int8
+weights) fed from the live actor params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.models.llama import LlamaConfig
+from dlrover_tpu.serving.engine import InferenceEngine
+from dlrover_tpu.serving.params import serving_params_from_llama
+
+
+class ServingBackend:
+    """Rollout generation through the continuous-batching engine.
+
+    ``sync_weights`` must be called whenever the actor params change
+    (PPOTrainer does this per ``make_experience``); with ``int8=True``
+    the sync re-quantizes the fresh weights into the Pallas kernel
+    layout — once per rollout batch, amortized over every generated
+    token of that batch.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        *,
+        max_slots: int = 8,
+        int8: bool = False,
+        chunk: int = 8,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token: Optional[int] = None,
+        max_len: Optional[int] = None,
+        seed: int = 0,
+    ):
+        """Sampling params left as ``None`` are adopted from the
+        PPOConfig when the backend is attached to a PPOTrainer (so one
+        config governs both rollout paths); explicit values win."""
+        self.cfg = cfg
+        self.int8 = int8
+        self._engine_kw = dict(
+            max_slots=max_slots, int8=int8, chunk=chunk,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token=eos_token, max_len=max_len, seed=seed,
+        )
+        self.engine: Optional[InferenceEngine] = None
+
+    def adopt_sampling(self, temperature: float, top_k: int,
+                       top_p: float) -> None:
+        """Fill unset sampling params (PPOTrainer calls this with its
+        PPOConfig before the first sync)."""
+        if self.engine is not None:
+            return  # sampling fixed at engine build
+        for key, val in (("temperature", temperature), ("top_k", top_k),
+                         ("top_p", top_p)):
+            if self._engine_kw[key] is None:
+                self._engine_kw[key] = val
+
+    def sync_weights(self, variables: Any) -> None:
+        """Adopt the current actor weights (re-quantizing when int8)."""
+        if self.engine is None:
+            kw = dict(self._engine_kw)
+            for key, default in (("temperature", 1.0), ("top_k", 0),
+                                 ("top_p", 1.0)):
+                if kw[key] is None:
+                    kw[key] = default
+            self.engine = InferenceEngine(self.cfg, variables, **kw)
+        else:
+            self.engine.params = serving_params_from_llama(
+                variables, self.cfg, int8=self.int8)
+
+    def generate(
+        self, prompt_ids: np.ndarray, max_new_tokens: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        assert self.engine is not None, "sync_weights first"
+        return self.engine.generate(prompt_ids, max_new_tokens)
+
+    @property
+    def stats(self):
+        return self.engine.stats if self.engine is not None else None

@@ -70,16 +70,30 @@ class PPOTrainer:
         config: Optional[PPOConfig] = None,
         seed: int = 0,
         engine: Optional["RLModelEngine"] = None,
+        inference_backend: Optional[Any] = None,
     ):
         """``engine``: a :class:`dlrover_tpu.rl.model_engine.RLModelEngine`
         with strategies for roles "actor", "critic", "ref" — each role's
         params live under its OWN mesh/sharding (reference
         model_engine.py:35 per-model strategies).  Without it everything
-        runs single-strategy on the default device."""
+        runs single-strategy on the default device.
+
+        ``inference_backend``: a
+        :class:`dlrover_tpu.rl.inference_backend.ServingBackend` — rollouts
+        then run through the continuous-batching serving engine with the
+        actor's weights synced each iteration (the reference's vLLM
+        backend split, rl/inference_backend/vllm_backend.py:11-24)
+        instead of the in-process sampler."""
         self.actor = actor
         self.critic = critic
         self.engine = engine
         self.config = config or PPOConfig()
+        self.inference_backend = inference_backend
+        if inference_backend is not None and hasattr(
+                inference_backend, "adopt_sampling"):
+            inference_backend.adopt_sampling(
+                self.config.temperature, self.config.top_k,
+                self.config.top_p)
         self._rng = jax.random.PRNGKey(seed)
         self._np_rng = np.random.RandomState(seed)
         self.buffer = ReplayBuffer()
@@ -213,8 +227,15 @@ class PPOTrainer:
         -> scores [B]`` runs on host (reference's reward model call)."""
         assert self.params is not None, "call init_models first"
         self._rng, sub = jax.random.split(self._rng)
-        tokens, mask = self._jit_rollout(
-            self.params["actor"], jnp.asarray(prompt_ids), sub)
+        if self.inference_backend is not None:
+            self.inference_backend.sync_weights(self.params["actor"])
+            tokens, mask = self.inference_backend.generate(
+                np.asarray(prompt_ids), self.config.max_new_tokens)
+            tokens = jnp.asarray(tokens)
+            mask = jnp.asarray(mask)
+        else:
+            tokens, mask = self._jit_rollout(
+                self.params["actor"], jnp.asarray(prompt_ids), sub)
         lp, ref_lp, values = self._jit_score(
             self.params, self.ref_params, tokens)
         scores = jnp.asarray(

@@ -1,0 +1,211 @@
+"""Functional Llama forward for serving: prefill + per-slot decode.
+
+The inference-engine half of the reference's RL serving story
+(atorch/atorch/rl/inference_backend/vllm_backend.py:11-24): a
+purpose-built decode path instead of the training module, because
+serving wants different things than training —
+
+- **per-slot positions**: every batch row is an independent sequence at
+  its own decode position (continuous batching), so the KV cache is
+  written with a per-row scatter and masked with per-row lengths; the
+  training module's cache clock is a single shared offset
+  (models/llama.py:271).
+- **prefill/decode split**: prefill is one causal pass over a
+  right-padded prompt bucket ([1, Lp]); decode is a one-token step for
+  all slots at once.  Right-padding needs NO validity bookkeeping: a
+  pad entry at cache index i > pos is invisible to the ``key <= pos``
+  mask until the sequence itself overwrites index i with a real token.
+- **chunked decode**: ``decode_chunk`` runs N steps inside one
+  ``lax.scan`` so the host syncs once per chunk, not per token (the
+  multi-step scheduling trick of serving engines — and on this rig the
+  host<->device hop is a slow debug tunnel, so it is the difference
+  between measuring the model and measuring the RPC).
+- **pre-quantized int8 weights**: every projection may be
+  ``{"q", "scale"}`` in the Pallas kernel layout; only activations
+  quantize per call (``prequant_matmul``), weights stream from HBM at
+  int8 width — decode's actual bottleneck.
+
+All functions are pure; the engine (serving/engine.py) owns jit and
+cache state.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.llama import LlamaConfig, apply_rope, rope_frequencies
+from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.ops.pallas.quant_matmul import prequant_matmul
+from dlrover_tpu.rl.generation import select_token
+
+
+def _mm(x: jax.Array, w: Any, dtype) -> jax.Array:
+    """x @ w for fp or pre-quantized ({"q","scale"}) weights."""
+    if isinstance(w, dict):
+        interpret = jax.default_backend() == "cpu"
+        return prequant_matmul(
+            x, w["q"], w["scale"], interpret=interpret
+        ).astype(dtype)
+    return (x.astype(dtype) @ w.astype(dtype)).astype(dtype)
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32))
+
+
+def _split_heads(x: jax.Array, n_heads: int, d: int) -> jax.Array:
+    b, t = x.shape[:2]
+    return x.reshape(b, t, n_heads, d)
+
+
+def _attn_decode(
+    q: jax.Array,            # [B, 1, H, D]
+    cache_k: jax.Array,      # [B, L, KV, D]
+    cache_v: jax.Array,
+    positions: jax.Array,    # [B] current position of each slot
+    n_rep: int,
+) -> jax.Array:
+    """GQA decode attention WITHOUT materializing the n_rep-expanded
+    cache (a ``jnp.repeat`` would stream 4x the cache bytes per step on
+    a 16:4 model — decode is bandwidth-bound, so that costs as much as
+    the weight reads).  q folds to [B, 1, KV, G, D] and both einsums
+    contract against the unexpanded cache; accumulation in f32 on the
+    MXU via preferred_element_type."""
+    b, qlen, h, d = q.shape
+    kv = cache_k.shape[2]
+    g = h // kv
+    qg = q.reshape(b, qlen, kv, g, d)
+    scores = jnp.einsum(
+        "bqkgd,blkd->bkgql", qg, cache_k,
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(float(d))
+    key_pos = jnp.arange(cache_k.shape[1])
+    mask = key_pos[None, :] <= positions[:, None]      # [B, L]
+    scores = jnp.where(
+        mask[:, None, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgql,blkd->bqkgd", probs.astype(cache_v.dtype), cache_v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, qlen, h, d)
+
+
+def _write_cache(cache: jax.Array, kv: jax.Array,
+                 positions: jax.Array) -> jax.Array:
+    """Per-row scatter: cache[b, positions[b]] = kv[b, 0]."""
+    def one(c, x, p):
+        return jax.lax.dynamic_update_slice(c, x, (p, 0, 0))
+    return jax.vmap(one)(cache, kv, positions.astype(jnp.int32))
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],   # {"k","v"}: [n_layers, B, L, KV, D]
+    tokens: jax.Array,             # [B] last sampled token per slot
+    positions: jax.Array,          # [B] write position per slot
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step for all slots; returns (logits [B, V], cache)."""
+    dtype = cfg.dtype
+    d = cfg.head_dim_
+    n_rep = cfg.num_heads // cfg.num_kv_heads
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,E]
+    angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
+        positions][:, None, :]                                 # [B,1,d/2]
+
+    def body(x, layer_and_cache):
+        lp, ck, cv = layer_and_cache
+        h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
+        q = _split_heads(_mm(h, lp["wq"], dtype), cfg.num_heads, d)
+        k = _split_heads(_mm(h, lp["wk"], dtype), cfg.num_kv_heads, d)
+        v = _split_heads(_mm(h, lp["wv"], dtype), cfg.num_kv_heads, d)
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        ck = _write_cache(ck, k, positions)
+        cv = _write_cache(cv, v, positions)
+        o = _attn_decode(q, ck, cv, positions, n_rep).astype(dtype)
+        o = o.reshape(o.shape[0], 1, cfg.num_heads * d)
+        x = x + _mm(o, lp["wo"], dtype)
+        h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
+        gate = jax.nn.silu(_mm(h, lp["gate"], dtype))
+        up = _mm(h, lp["up"], dtype)
+        x = x + _mm(gate * up, lp["down"], dtype)
+        return x, (ck, cv)
+
+    def scan_body(x, xs):
+        lp, ck, cv = xs
+        x, (ck, cv) = body(x, (lp, ck, cv))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _lm_head(params, x.astype(dtype), cfg)[:, 0, :]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _lm_head(params, x, cfg: LlamaConfig) -> jax.Array:
+    # compute dtype mirrors the training module (models/llama.py lm_head:
+    # bf16 matmul; tied path attends in param_dtype) so greedy decode
+    # agrees with the trainer's forward down to tie-breaks
+    if params.get("lm_head") is None:  # tied embeddings
+        logits = x.astype(cfg.param_dtype) @ params["embed"].astype(
+            cfg.param_dtype).T
+    else:
+        logits = _mm(x, params["lm_head"], cfg.dtype)
+    logits = logits.astype(jnp.float32)
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits
+
+
+def prefill(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jax.Array,        # [1, Lp] right-padded prompt bucket
+    real_len: jax.Array,      # scalar: actual prompt length (<= Lp)
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal pass over one prompt; returns (last_logits [1, V],
+    k [n_layers, 1, Lp, KV, D], v [...]) — the engine inserts the K/V
+    into a decode-cache slot.  Pad garbage beyond ``real_len`` is
+    harmless: decode overwrites/masks it (module docstring)."""
+    dtype = cfg.dtype
+    d = cfg.head_dim_
+    lp_len = tokens.shape[1]
+    x = jnp.take(params["embed"], tokens, axis=0)          # [1, Lp, E]
+    angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
+        jnp.arange(lp_len)]
+
+    def scan_body(x, lp):
+        h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
+        q = _split_heads(_mm(h, lp["wq"], dtype), cfg.num_heads, d)
+        k = _split_heads(_mm(h, lp["wk"], dtype), cfg.num_kv_heads, d)
+        v = _split_heads(_mm(h, lp["wv"], dtype), cfg.num_kv_heads, d)
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        o = dot_product_attention(q, k, v, causal=True,
+                                  sp_ulysses=False).astype(dtype)
+        o = o.reshape(o.shape[0], lp_len, cfg.num_heads * d)
+        x = x + _mm(o, lp["wo"], dtype)
+        h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
+        gate = jax.nn.silu(_mm(h, lp["gate"], dtype))
+        up = _mm(h, lp["up"], dtype)
+        x = x + _mm(gate * up, lp["down"], dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jax.lax.dynamic_slice_in_dim(
+        x, real_len.astype(jnp.int32) - 1, 1, axis=1)
+    logits = _lm_head(params, last.astype(dtype), cfg)[:, 0, :]
+    return logits, ks, vs
+
+

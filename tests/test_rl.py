@@ -353,3 +353,29 @@ def test_reward_model_learns_preferences_and_feeds_ppo():
     fn = make_reward_fn(rm)
     out = fn(probe["chosen"], probe["chosen_mask"])
     assert out.shape == (8,) and np.isfinite(out).all()
+
+
+def test_ppo_with_serving_backend():
+    """Rollouts through the continuous-batching serving engine
+    (reference vllm_backend split): one full PPO iteration trains, and
+    the engine re-syncs actor weights between iterations."""
+    from dlrover_tpu.rl.inference_backend import ServingBackend
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, vocab_size=64,
+                           scan_layers=False, remat=False)
+    backend = ServingBackend(cfg, max_slots=2, chunk=4, temperature=1.0,
+                             top_k=8, seed=7)
+    ppo = PPOTrainer(
+        LlamaModel(cfg), ValueModel(trunk=LlamaModel(cfg)),
+        PPOConfig(max_new_tokens=6, ppo_epochs=1, minibatches=2),
+        seed=3,
+        inference_backend=backend,
+    )
+    prompts = np.full((4, 4), 2, np.int32)
+    ppo.init_models(prompts)
+    stats = ppo.step(prompts, lambda t, m: np.ones(len(t), np.float32))
+    assert np.isfinite(stats["loss"])
+    assert backend.stats.generated_tokens > 0
+    # second iteration exercises the weight re-sync path
+    stats2 = ppo.step(prompts, lambda t, m: np.ones(len(t), np.float32))
+    assert np.isfinite(stats2["loss"])
