@@ -1,0 +1,242 @@
+"""LoRA parameter-efficient fine-tuning, TPU-first.
+
+Reference parity: atorch trains and checkpoints FSDP+LoRA through peft
+(atorch/atorch/utils/fsdp_save_util.py lora save/load paths,
+atorch/atorch/tests/common_tests/fsdp_lora_load_test.py; BASELINE.md
+"Llama2-7B FSDP + LoRA 177.9 TFLOPs").  The torch recipe is module
+surgery — wrap each nn.Linear in a peft LoraLayer.  The JAX-native
+shape is *parameter-space*: adapters live in their own pytree and the
+effective weight ``W + (alpha/r) * A @ B`` is formed functionally
+inside jit, so
+
+- NO model changes: any flax module whose kernels match the target
+  names gains LoRA (Llama, GPT-2, ...), scan-stacked or per-layer;
+- the frozen base keeps its logical-axis shardings — fsdp/tp still
+  shard ``W`` exactly as in full fine-tuning, while the (tiny)
+  adapters replicate; XLA inserts the reshard for the ``+`` once per
+  step, negligible next to the matmuls that consume ``W``;
+- ``stop_gradient`` on the base makes its gradients structural zeros
+  (XLA folds them away), and :func:`lora_optimizer` masks the
+  optimizer so moments exist ONLY for adapters — the ~10x optimizer
+  memory saving that is the point of LoRA (measure with
+  :func:`adapter_nbytes` vs the full-param optimizer);
+- the merged weight is what the matmuls consume, so step time is full
+  fine-tuning's plus an O(r/K) rank-update — MFU stays within a few
+  percent of full FT.
+
+Usage::
+
+    lcfg = LoRAConfig(rank=8, alpha=16.0)
+    lora_model = LoRAModel(model, lcfg)            # init/apply wrapper
+    res = accelerate(lora_model, optimizer=lora_optimizer(opt),
+                     batch_shape=...)
+    state = res.init_fn(rng)          # params = {"base": ..., "lora": ...}
+    ...                               # train: only adapters move
+    merged = lora_export(state.params, lcfg)       # plain params for HF
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+# kernels whose INPUT spans every dim but the last ([H, D, E] /
+# [F, E]): K = prod(all but last), N = last.  Every other target is
+# input-FIRST ([E, ...out]): K = first, N = prod(rest).  Covers both
+# c_proj shapes in GPT-2 (attention [H, D, E] and MLP [F, E]) with the
+# same rule.
+_OUT_LAST_TARGETS = frozenset({"o_proj", "c_proj", "down_proj"})
+# top-level collections holding nn.scan-stacked layers (leading layer
+# axis on every kernel): models/llama.py "layers", models/gpt2.py
+# "blocks"
+_SCAN_COLLECTIONS = ("layers", "blocks")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # kernel owners to adapt, matched against the parent module name of
+    # each "kernel" leaf (peft's target_modules)
+    targets: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _factor_shape(name: str, w_shape: Tuple[int, ...], stacked: bool):
+    """(lead_shape, K, N) viewing the kernel as [lead..., matmul K x N].
+
+    ``stacked`` marks an nn.scan kernel (one leading layer axis).  The
+    in/out boundary is per-name: output-last kernels split before the
+    last dim, input-first kernels after the first.
+    """
+    lead = w_shape[:1] if stacked else ()
+    core = w_shape[len(lead):]
+    if name in _OUT_LAST_TARGETS:
+        k = 1
+        for d in core[:-1]:
+            k *= d
+        n = core[-1]
+    else:
+        k = core[0]
+        n = 1
+        for d in core[1:]:
+            n *= d
+    return lead, k, n
+
+
+def _walk_kernels(tree: Any, path=()):
+    """Yield (path, parent_name, leaf) for every ``kernel`` leaf."""
+    if isinstance(tree, dict):
+        for key, val in tree.items():
+            if key == "kernel" and not isinstance(val, dict):
+                yield path + (key,), path[-1] if path else "", val
+            else:
+                yield from _walk_kernels(val, path + (key,))
+
+
+def _get(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set(tree, path, value):
+    """Functional set: returns a new nested dict."""
+    if not path:
+        return value
+    out = dict(tree)
+    out[path[0]] = _set(tree[path[0]], path[1:], value)
+    return out
+
+
+def lora_init(rng: jax.Array, base_params: Any,
+              cfg: LoRAConfig) -> Dict[str, Any]:
+    """Create the adapter tree for every targeted kernel.
+
+    ``{"<dot-joined kernel path>": {"a": [lead..., K, r],
+    "b": [lead..., r, N]}}`` — A gaussian (1/sqrt K), B zeros, so the
+    merged model starts EXACTLY at the base (peft's init)."""
+    adapters: Dict[str, Any] = {}
+    base_params = nn.meta.unbox(base_params)
+    for path, parent, leaf in _walk_kernels(base_params):
+        if parent not in cfg.targets:
+            continue
+        stacked = path[0] in _SCAN_COLLECTIONS
+        lead, k, n = _factor_shape(parent, leaf.shape, stacked)
+        rng, sub = jax.random.split(rng)
+        a = jax.random.normal(
+            sub, (*lead, k, cfg.rank), jnp.float32) / jnp.sqrt(float(k))
+        b = jnp.zeros((*lead, cfg.rank, n), jnp.float32)
+        adapters["/".join(path)] = {"a": a, "b": b}
+    if not adapters:
+        raise ValueError(
+            f"no kernels matched LoRA targets {cfg.targets}")
+    return adapters
+
+
+def lora_merge(base_params: Any, adapters: Dict[str, Any],
+               cfg: LoRAConfig, freeze_base: bool = True) -> Any:
+    """Effective params: ``W + scaling * (A @ B)`` on targeted kernels.
+
+    Call INSIDE jit.  ``freeze_base`` stop-gradients the base so its
+    grads are structural zeros (LoRA training); pass False to
+    fine-tune base and adapters jointly."""
+    merged = base_params
+    if freeze_base:
+        merged = jax.tree_util.tree_map(jax.lax.stop_gradient, merged)
+    for key, ab in adapters.items():
+        path = tuple(key.split("/"))
+        w = _get(merged, path)
+        delta = jnp.matmul(
+            ab["a"].astype(jnp.float32), ab["b"].astype(jnp.float32)
+        ) * cfg.scaling
+        w_eff = w + delta.reshape(w.shape).astype(w.dtype)
+        merged = _set(merged, path, w_eff)
+    return merged
+
+
+class LoRAModel:
+    """init/apply wrapper: ``params = {"base": frozen, "lora": adapters}``.
+
+    Drop-in for ``accelerate()`` / ``Trainer`` — those only use
+    ``.init``/``.apply`` (+ ``.config`` passthrough).  The base subtree
+    keeps its flax logical-partitioning boxes, so mesh rules shard it
+    exactly as in full fine-tuning; adapters are plain (replicated)
+    leaves."""
+
+    def __init__(self, model: Any, cfg: LoRAConfig, seed: int = 0):
+        self.model = model
+        self.lora_config = cfg
+        self._seed = seed
+
+    @property
+    def config(self):
+        return self.model.config
+
+    def init(self, rng: jax.Array, *args, **kwargs) -> Dict[str, Any]:
+        variables = self.model.init(rng, *args, **kwargs)
+        adapters = lora_init(
+            jax.random.fold_in(rng, self._seed),
+            variables["params"], self.lora_config,
+        )
+        out = dict(variables)
+        out["params"] = {"base": variables["params"], "lora": adapters}
+        return out
+
+    def apply(self, variables: Any, *args, **kwargs):
+        params = variables["params"]
+        merged = lora_merge(
+            params["base"], params["lora"], self.lora_config)
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        return self.model.apply(
+            {"params": merged, **rest}, *args, **kwargs)
+
+
+def lora_label_fn(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Label tree for ``optax.multi_transform``: adapters "train",
+    frozen base "freeze"."""
+    return {
+        "base": jax.tree_util.tree_map(lambda _: "freeze", params["base"]),
+        "lora": jax.tree_util.tree_map(lambda _: "train", params["lora"]),
+    }
+
+
+def lora_optimizer(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Optimizer that updates ONLY the adapters: no moments, no weight
+    decay, no updates on the frozen base (a plain optimizer would still
+    weight-decay it even at zero gradient)."""
+    return optax.multi_transform(
+        {"train": inner, "freeze": optax.set_to_zero()},
+        lora_label_fn,
+    )
+
+
+def lora_export(params: Dict[str, Any], cfg: LoRAConfig) -> Any:
+    """Merge adapters into a PLAIN base-shaped param tree (host or
+    device) — feed to ``models.convert.params_to_hf`` for HF export."""
+    return lora_merge(
+        nn.meta.unbox(params["base"]), params["lora"], cfg,
+        freeze_base=False,
+    )
+
+
+def adapter_nbytes(params: Dict[str, Any]) -> int:
+    from dlrover_tpu.optimizers.low_bit import state_nbytes
+
+    return state_nbytes(params["lora"])
+
+
+def base_nbytes(params: Dict[str, Any]) -> int:
+    from dlrover_tpu.optimizers.low_bit import state_nbytes
+
+    return state_nbytes(nn.meta.unbox(params["base"]))
