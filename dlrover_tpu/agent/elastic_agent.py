@@ -31,6 +31,7 @@ from enum import Enum
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import (
     NodeEnv,
     NodeStatus,
@@ -142,6 +143,10 @@ class LocalWorkerGroup:
     def __init__(self):
         self.procs: List[subprocess.Popen] = []
         self.restart_count = 0
+        # the stack-dump dir the workers were actually SPAWNED with —
+        # the collector must read the same one (spec.env overrides can
+        # diverge from the agent's own environment)
+        self.stack_dump_dir: Optional[str] = None
 
     def spawn(
         self,
@@ -185,6 +190,15 @@ class LocalWorkerGroup:
             env["DLROVER_WORKER_RANK"] = str(starts[node_rank] + local_rank)
             env["DLROVER_WORKER_NUM"] = str(total_procs)
             env["DLROVER_RDZV_ROUND"] = str(rdzv.round)
+            # stack forensics: workers register a SIGUSR1 traceback
+            # dumper here; the agent signals + collects on hang
+            from dlrover_tpu.agent.monitor.stack_dump import (
+                ENV_DUMP_DIR,
+                default_dump_dir,
+            )
+
+            env.setdefault(ENV_DUMP_DIR, default_dump_dir())
+            self.stack_dump_dir = env[ENV_DUMP_DIR]
             proc = subprocess.Popen(  # noqa: S603
                 list(spec.entrypoint), env=env
             )
@@ -276,6 +290,43 @@ class ElasticAgent:
             saver.save_shm_to_storage(commit_async=commit_async)
         except Exception:
             logger.exception("persisting shm checkpoint failed")
+
+    def _collect_hang_stacks(self) -> str:
+        """On hang: SIGUSR1 the workers, ship their all-thread tracebacks
+        through the diagnosis channel (data_cls="stack"), and return a
+        one-line summary of the deepest frames for the failure reason.
+
+        Reference counterpart: the py-spy-style stack collector feeding
+        diagnosis (dlrover/python/elastic_agent/datacollector/
+        cuda_log_collector.py:20)."""
+        from dlrover_tpu.agent.monitor.stack_dump import (
+            format_stack_report,
+            summarize_stacks,
+            trigger_stack_dumps,
+        )
+
+        pids = [p.pid for p in self._group.procs
+                if p.poll() is None]
+        if not pids:
+            return ""
+        try:
+            dumps = trigger_stack_dumps(
+                pids, dump_dir=self._group.stack_dump_dir)
+        except Exception:
+            logger.exception("stack-dump collection failed")
+            return ""
+        report = format_stack_report(dumps)
+        try:
+            self._client.report_diagnosis_data(comm.DiagnosisReportData(
+                data_cls="stack",
+                data_content=report,
+                node_id=self._node_rank,
+                timestamp=time.time(),
+            ))
+        except Exception as e:
+            logger.warning("stack diagnosis report failed: %s", e)
+        logger.error("hang stack dumps:\n%s", report)
+        return summarize_stacks(dumps)
 
     # -- heartbeats ------------------------------------------------------
     def _heartbeat_loop(self, interval: float = 15.0) -> None:
@@ -463,9 +514,11 @@ class ElasticAgent:
                     continue
                 if hang_detector is not None and hang_detector.check_once():
                     stalled = self._training_monitor.seconds_without_progress()
+                    where = self._collect_hang_stacks()
                     recovered = self._recover_failed_workers(
                         f"training hang: no global-step progress for "
-                        f"{stalled:.0f}s",
+                        f"{stalled:.0f}s"
+                        + (f"; stacks: {where}" if where else ""),
                         level="hang",
                         rc=1,
                     )

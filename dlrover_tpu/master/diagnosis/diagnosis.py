@@ -102,6 +102,7 @@ class CheckTrainingHangOperator(InferenceOperator):
             return []
         now = time.time()
         stale_nodes = []
+        reporting = 0  # nodes with ANY metrics evidence
         for nid in node_ids:
             # include expired records: a node whose only evidence has
             # aged out is exactly the stale case this operator exists
@@ -109,16 +110,41 @@ class CheckTrainingHangOperator(InferenceOperator):
             metrics = data.get(nid, data_cls="metrics",
                                include_expired=True)
             if not metrics:
+                # nodes known only through OTHER data classes (e.g. a
+                # "stack" report) must not veto the job-wide conclusion:
+                # the hang verdict is over metric-reporting nodes
                 continue
+            reporting += 1
             latest = max(m.timestamp for m in metrics)
             if now - latest > self._hang_seconds:
                 stale_nodes.append(nid)
             else:
                 return []  # any live node => not a job-wide hang
-        if stale_nodes and len(stale_nodes) == len(node_ids):
+        if stale_nodes and len(stale_nodes) == reporting:
+            reason = f"no metrics from any node for {self._hang_seconds}s"
+            # attach worker stack forensics (agents ship SIGUSR1
+            # faulthandler dumps as data_cls="stack" on hang detection,
+            # reference cuda_log_collector.py:20) so the conclusion
+            # names WHERE each worker is stuck, not just THAT it is
+            frames = []
+            for nid in node_ids:
+                # ONLY fresh dumps: unlike metrics (where aged-out
+                # evidence IS the signal), a stack from a previous
+                # incident would misdirect operators to the wrong frame
+                stacks = [
+                    s for s in data.get(nid, data_cls="stack",
+                                        include_expired=True)
+                    if now - s.timestamp <= self._hang_seconds
+                ]
+                if stacks:
+                    latest = max(stacks, key=lambda s: s.timestamp)
+                    frames.append(
+                        f"node {nid}:\n{latest.data_content}")
+            if frames:
+                reason += "\nworker stacks:\n" + "\n".join(frames)
             return [Inference(
                 name=InferenceName.TRAINING_HANG,
-                reason=f"no metrics from any node for {self._hang_seconds}s",
+                reason=reason,
                 severity="critical",
             )]
         return []

@@ -16,6 +16,7 @@ import os
 from dataclasses import dataclass
 
 from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
 
 
 @dataclass(frozen=True)
@@ -57,6 +58,18 @@ def init_distributed(timeout_s: int = 300) -> WorkerEnv:
     CPU/GPU multi-host emulation).
     """
     env = WorkerEnv.from_env()
+    from dlrover_tpu.agent.monitor.stack_dump import (
+        ENV_DUMP_DIR,
+        enable_stack_dump,
+    )
+
+    if os.environ.get(ENV_DUMP_DIR):
+        # hang forensics: the agent SIGUSR1s us on stall and reads the
+        # traceback back (agent/monitor/stack_dump.py)
+        try:
+            enable_stack_dump()
+        except OSError as e:  # unwritable dir must not block training
+            logger.warning("stack-dump setup failed: %s", e)
     if env.worker_num > 1 and env.coordinator:
         import jax
 

@@ -216,3 +216,85 @@ def test_paral_config_tuner_e2e(local_master, master_client, tmp_path):
     loader.load_config()
     assert loader.batch_size == 16
     assert first_write is None or first_write["dataloader"]["version"] == 0
+
+
+# ---------------------------------------------------------------------------
+# stack forensics (reference: cuda_log_collector.py py-spy-style dumps)
+# ---------------------------------------------------------------------------
+
+
+def test_stack_dump_names_stuck_function(tmp_path):
+    """A real stalled subprocess: trigger_stack_dumps must return a
+    traceback naming the function it is stuck in, and the summary line
+    must carry it."""
+    import subprocess
+    import sys
+
+    from dlrover_tpu.agent.monitor.stack_dump import (
+        summarize_stacks,
+        trigger_stack_dumps,
+    )
+
+    dump_dir = str(tmp_path / "stacks")
+    code = (
+        "import time\n"
+        "from dlrover_tpu.agent.monitor.stack_dump import enable_stack_dump\n"
+        f"enable_stack_dump({dump_dir!r})\n"
+        "def definitely_stuck_here():\n"
+        "    time.sleep(300)\n"
+        "print('ready', flush=True)\n"
+        "definitely_stuck_here()\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        time.sleep(0.3)  # let it enter the sleep
+        dumps = trigger_stack_dumps([proc.pid], dump_dir=dump_dir,
+                                    wait=5.0)
+        assert "definitely_stuck_here" in dumps[proc.pid]
+        summary = summarize_stacks(dumps)
+        assert "definitely_stuck_here" in summary
+        assert str(proc.pid) in summary
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_stack_dump_reports_unresponsive_worker(tmp_path):
+    """A pid that never handles the signal yields an explanatory
+    placeholder, not a silent drop."""
+    from dlrover_tpu.agent.monitor.stack_dump import trigger_stack_dumps
+
+    # pid that exists but has no handler registered in our dump dir:
+    # use a short-lived subprocess WITHOUT enable_stack_dump
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        dumps = trigger_stack_dumps(
+            [proc.pid], dump_dir=str(tmp_path), wait=0.5)
+        assert "no stack dump" in dumps[proc.pid]
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_hang_inference_includes_worker_stacks():
+    """Stale metrics + shipped stack data: the hang conclusion's reason
+    names the stuck frame (master half of the forensics chain)."""
+    data = DiagnosisDataManager(expire_seconds=10_000)
+    data.store(_metrics(0, age=120))
+    data.store(comm.DiagnosisReportData(
+        data_cls="stack",
+        data_content=(
+            'Current thread 0x1 (most recent call first):\n'
+            '  File "/app/train.py", line 99 in blocked_allreduce\n'
+        ),
+        node_id=0, timestamp=time.time()))
+    ops = CheckTrainingHangOperator(hang_seconds=60)
+    out = ops.infer(data)
+    assert out and out[0].name == InferenceName.TRAINING_HANG
+    assert "blocked_allreduce" in out[0].reason
