@@ -119,6 +119,8 @@ class ElasticTrainer:
         metrics_every: int = 1,
         compile_cache_dir: Optional[str] = None,
         compile_cache_min_secs: Optional[float] = None,
+        xprof_every_n_steps: int = 0,
+        metrics_port: Optional[int] = None,
     ):
         self._model = model
         self._global_batch_size = global_batch_size
@@ -145,6 +147,25 @@ class ElasticTrainer:
 
         self._step_timer = StepTimer()
         self._metrics_every = metrics_every
+        # transparent per-kernel/collective timing (reference xpu_timer,
+        # atorch/dev/xpu_timer/nvidia/hook.cc): every N steps ONE train
+        # step runs under an XLA trace; the op breakdown lands on the
+        # Prometheus endpoint with zero user instrumentation
+        self.auto_profiler = None
+        self.metrics_exporter = None
+        if xprof_every_n_steps > 0:
+            from dlrover_tpu.utils.xprof_metrics import AutoProfiler
+
+            self.auto_profiler = AutoProfiler(every_n=xprof_every_n_steps)
+        if metrics_port is not None:
+            from dlrover_tpu.utils.profiler import MetricsExporter
+
+            self.metrics_exporter = MetricsExporter(port=metrics_port)
+            self.metrics_exporter.add_source(self._step_timer.metrics)
+            if self.auto_profiler is not None:
+                self.metrics_exporter.add_text_source(
+                    self.auto_profiler.prometheus_text)
+            self.metrics_exporter.start()
         self._compile_cache_dir = (
             compile_cache_dir
             if compile_cache_dir is not None
@@ -275,9 +296,15 @@ class ElasticTrainer:
     def train_step(self, batch: Any) -> Dict[str, jax.Array]:
         assert self.state is not None, "call restore_or_init() first"
         t0 = time.time()
-        self.state, metrics = self.result.train_step(
-            self.state, self._shape_batch(batch)
-        )
+        shaped = self._shape_batch(batch)
+        if self.auto_profiler is not None:
+            self.state, metrics = self.auto_profiler.around_step(
+                lambda: self.result.train_step(self.state, shaped)
+            )
+        else:
+            self.state, metrics = self.result.train_step(
+                self.state, shaped
+            )
         self._host_step += 1
         self._report_runtime_metrics(time.time() - t0)
         return metrics
@@ -328,3 +355,6 @@ class ElasticTrainer:
     def close(self) -> None:
         if self._ckpt is not None:
             self._ckpt.close()
+        if self.metrics_exporter is not None:
+            self.metrics_exporter.stop()
+            self.metrics_exporter = None
