@@ -79,6 +79,8 @@ def _assemble_leaf(
     before the next shm save reuses the segment (``_restore_into`` does:
     ``jax.device_put`` copies into the device buffer immediately).
     """
+    from dlrover_tpu.common.multi_process import populate_write_ndarray
+
     if not global_shape:
         return np.array(pieces[0][1], dtype=np.dtype(dtype)).reshape(())
     for index, data in pieces:
@@ -87,9 +89,16 @@ def _assemble_leaf(
             # the zero-copy path must not silently reinterpret a shard
             # whose stored dtype diverged from the recorded meta dtype
             if copy or view.dtype != np.dtype(dtype):
-                return np.array(view, dtype=np.dtype(dtype))
+                # pre-populate the destination: first-write page faults
+                # on a fresh allocation are the cold-restore wall
+                # (multi_process.populate_write_ndarray)
+                out = np.empty(global_shape, dtype=np.dtype(dtype))
+                populate_write_ndarray(out)
+                np.copyto(out, view, casting="unsafe")
+                return out
             return view
     full = np.empty(global_shape, dtype=np.dtype(dtype))
+    populate_write_ndarray(full)
     covered = 0
     for index, data in pieces:
         slices = tuple(slice(a, b) for a, b in index)
@@ -101,6 +110,63 @@ def _assemble_leaf(
             f"{int(np.prod(global_shape))} elements covered"
         )
     return full
+
+
+def _assemble_region(
+    global_shape: Tuple[int, ...],
+    dtype: str,
+    pieces: List[Tuple[List[List[int]], np.ndarray]],
+    region: Tuple[slice, ...],
+) -> Optional[np.ndarray]:
+    """Rebuild ONE region (a device shard) of a leaf from whatever
+    pieces the local shm holds; None when the pieces do not cover it.
+
+    Coverage is tracked with a mask: dp replicas saved by the same host
+    produce overlapping identical pieces, so byte counting would
+    over-report.
+    """
+    shape = tuple(s.stop - s.start for s in region)
+    if not shape:
+        for index, data in pieces:
+            return np.asarray(data, np.dtype(dtype)).reshape(())
+        return None
+    out = np.empty(shape, np.dtype(dtype))
+    mask = np.zeros(shape, bool)
+    for index, data in pieces:
+        if not index:
+            index = [[0, n] for n in global_shape]
+        inter = []
+        ok = True
+        for (a, b), s in zip(index, region):
+            lo, hi = max(a, s.start), min(b, s.stop)
+            if lo >= hi:
+                ok = False
+                break
+            inter.append((lo, hi))
+        if not ok:
+            continue
+        src = data.reshape([b - a for a, b in index])
+        src_sl = tuple(
+            slice(lo - a, hi - a)
+            for (a, b), (lo, hi) in zip(index, inter)
+        )
+        dst_sl = tuple(
+            slice(lo - s.start, hi - s.start)
+            for (lo, hi), s in zip(inter, region)
+        )
+        out[dst_sl] = src[src_sl]
+        mask[dst_sl] = True
+    if not mask.all():
+        return None
+    return out
+
+
+def _normalize_region(index, global_shape) -> Tuple[slice, ...]:
+    """jax device index -> concrete slices over the global shape."""
+    return tuple(
+        slice(s.start or 0, s.stop if s.stop is not None else n)
+        for s, n in zip(index, global_shape)
+    )
 
 
 def _restore_into(target: Any, saved: Dict[str, np.ndarray], shardings: Any):
@@ -253,15 +319,48 @@ class CheckpointEngine:
         self,
         target: Any = None,
         shardings: Any = None,
+        host_views: bool = False,
     ) -> Tuple[int, Optional[Any]]:
         """Restore the latest checkpoint, preferring shared memory.
 
         Returns ``(step, state)``; ``(-1, None)`` when nothing exists.
+        ``host_views=True`` returns zero-copy VIEWS into the shm segment
+        even without a target — the true recovery-path cost on a TPU
+        host, where the next step is a device DMA straight from these
+        views.  Caller contract: consume (device_put) before the next
+        shm save reuses the segment, and never on the CPU backend's
+        aliasing device_put.
         ``target`` is an (abstract or concrete) pytree giving the structure
         and dtypes to restore into; ``shardings`` an optional matching
         pytree of ``jax.sharding.Sharding``s.
         """
         self._ensure_saver()  # shm meta server must exist before we query it
+        # Freshness across tiers: a host can hold a STALE shm checkpoint
+        # (e.g. a node that sat out rounds while its peers trained on and
+        # committed newer storage saves — the multi-slice orphan).  Memory
+        # wins only when at least as new as the committed storage step.
+        try:
+            meta = self._shm_handler.get_meta()
+            mem_step = meta.step if meta is not None and meta.valid else -1
+        except Exception:
+            mem_step = -1
+        if mem_step >= 0:
+            try:
+                storage_step = read_latest_step(
+                    self.storage, self.checkpoint_dir)
+            except Exception as e:
+                # a storage blip must not break a pure-memory recovery
+                logger.warning(
+                    "storage freshness check failed (%s); trusting shm",
+                    e)
+                storage_step = -1
+            if storage_step > mem_step:
+                logger.info(
+                    "shm checkpoint (step %s) is older than committed "
+                    "storage (step %s); restoring from storage",
+                    mem_step, storage_step,
+                )
+                return self.load_from_storage(target, shardings)
         try:
             # With a target the leaves are device_put immediately, so
             # zero-copy shm views skip the 2nd host copy — safe on
@@ -271,28 +370,95 @@ class CheckpointEngine:
             # shm segment — copy there.
             import jax
 
-            zero_copy_ok = (
+            zero_copy_ok = host_views or (
                 target is not None and jax.default_backend() != "cpu"
             )
             loaded = self._load_from_memory(copy=not zero_copy_ok)
         except ValueError as e:
-            # This host's shm holds only its own addressable shards; when
-            # params span hosts (fsdp across processes) and a PEER host
-            # died, local shm cannot cover the global arrays — fall back
-            # to the last committed storage checkpoint (the reference's
-            # node-loss semantics: memory restore is per-node, storage is
-            # the cross-node recovery tier).
+            # This host's shm holds only its own addressable shards.
+            # When params span hosts (fsdp across processes) the SHARDED
+            # restore path places each host's own pieces directly onto
+            # its devices (make_array_from_single_device_arrays) — full
+            # local coverage is not needed as long as every host restores
+            # its own part (the multi-host / multi-slice recovery path).
+            loaded = None
+            if target is not None and shardings is not None:
+                try:
+                    loaded = self._load_partial_from_memory(
+                        target, shardings)
+                except Exception as e2:
+                    logger.warning(
+                        "per-shard memory restore failed too: %s", e2)
+            if loaded is not None:
+                step, restored = loaded
+                logger.info(
+                    "Restored step %s from shared memory (per-host "
+                    "shards)", step)
+                return step, restored
+            # last resort: the committed storage checkpoint (the
+            # reference's node-loss semantics — memory restore is
+            # per-node, storage is the cross-node recovery tier)
             logger.warning(
                 "memory checkpoint incomplete (%s); falling back to "
                 "storage restore", e,
             )
-            loaded = None
         if loaded is not None:
             step, saved = loaded
             if target is None:
                 return step, saved
             return step, _restore_into(target, saved, shardings)
         return self.load_from_storage(target, shardings)
+
+    def _load_partial_from_memory(
+        self, target: Any, shardings: Any
+    ) -> Optional[Tuple[int, Any]]:
+        """Sharded restore from partial local shm: place each of THIS
+        host's device shards from the pieces its shm holds; the global
+        arrays form via ``make_array_from_single_device_arrays`` (every
+        host contributes its own part).  Raises/returns None when a
+        locally-addressable shard is not covered — then storage is the
+        only recovery tier."""
+        import jax
+
+        result = self._shm_handler.load_arrays()
+        if result is None:
+            return None
+        step, leaves_meta, arrays = result
+        leaves, treedef = jax.tree_util.tree_flatten(target)
+        paths = [p for p, _ in leaf_paths(target)]
+        shard_leaves = jax.tree_util.tree_flatten(shardings)[0]
+        if len(shard_leaves) != len(leaves):
+            raise ValueError("shardings tree does not match target")
+        out = []
+        for path, leaf, sharding in zip(paths, leaves, shard_leaves):
+            meta = leaves_meta.get(path)
+            if meta is None:
+                raise ValueError(f"shm checkpoint is missing {path!r}")
+            pieces = [
+                (meta["shards"][i]["index"], arrays[(path, i)])
+                for i in range(len(meta["shards"]))
+            ]
+            gshape = tuple(meta["global_shape"])
+            want_dtype = getattr(leaf, "dtype", np.dtype(meta["dtype"]))
+            if sharding is None:
+                full = _assemble_leaf(gshape, meta["dtype"], pieces)
+                out.append(jax.device_put(full.astype(want_dtype)))
+                continue
+            index_map = sharding.addressable_devices_indices_map(gshape)
+            device_arrays = []
+            for device, index in index_map.items():
+                region = _normalize_region(index, gshape)
+                block = _assemble_region(
+                    gshape, meta["dtype"], pieces, region)
+                if block is None:
+                    raise ValueError(
+                        f"local shm does not cover shard {region} of "
+                        f"{path!r}")
+                device_arrays.append(jax.device_put(
+                    block.astype(want_dtype), device))
+            out.append(jax.make_array_from_single_device_arrays(
+                gshape, sharding, device_arrays))
+        return step, jax.tree_util.tree_unflatten(treedef, out)
 
     def _load_from_memory(
         self, copy: bool = True
