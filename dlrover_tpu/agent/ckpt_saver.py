@@ -332,6 +332,22 @@ class AsyncCheckpointSaver:
             if cls._instance is None:
                 cls._instance = cls(**kwargs)
                 cls._instance.start()
+            else:
+                # the saver outlives worker restarts; an ELASTIC restart
+                # can change the world size — the commit barrier must
+                # expect done-files from the CURRENT world, not the one
+                # the saver was born into
+                inst = cls._instance
+                new_global = kwargs.get("global_shard_num")
+                if new_global and new_global != inst.global_shard_num:
+                    logger.info(
+                        "saver world resize: global shards %s -> %s",
+                        inst.global_shard_num, new_global,
+                    )
+                    inst.global_shard_num = new_global
+                new_rank = kwargs.get("node_rank")
+                if new_rank is not None:
+                    inst.node_rank = new_rank
             return cls._instance
 
     @classmethod

@@ -105,12 +105,16 @@ class MasterRendezvousHandler:
         self._rdzv_name = rdzv_name
         self._local_world_size = local_world_size
         self._timeout = timeout
+        # this host's TPU slice (DCN granule); the master groups
+        # admission by it so only COMPLETE slices train
+        self._slice_id = int(os.environ.get("DLROVER_SLICE_ID") or 0)
 
     def next_rendezvous(self) -> RendezvousResult:
         self._client.join_rendezvous(
             node_rank=self._node_rank,
             local_world_size=self._local_world_size,
             rdzv_name=self._rdzv_name,
+            slice_id=self._slice_id,
         )
         start = time.time()
         while True:
@@ -375,9 +379,17 @@ class ElasticAgent:
         self._client.report_node_status(self._node_rank, NodeStatus.RUNNING)
         return rdzv
 
-    def _restart_workers(self, reason: str) -> RendezvousResult:
+    def _restart_workers(self, reason: str,
+                         persist_first: bool = False) -> RendezvousResult:
         logger.info("Restarting workers: %s", reason)
         self._group.stop()
+        if persist_first:
+            # growth restart: peers are alive, commit synchronously so
+            # the regrown world's restore-step consensus finds the
+            # committed storage step (a replacement host has no shm).
+            # Must run AFTER group.stop(): the shm lock reclaim inside
+            # the save is only sound with no worker alive.
+            self._save_shm_checkpoint(commit_async=False)
         self._group.restart_count += 1
         rdzv = self._initialize_workers()
         # EVERY restart (failure, hang, rescale) re-enters restore +
@@ -537,7 +549,8 @@ class ElasticAgent:
                     continue
                 if waiting > 0:
                     self._restart_workers(
-                        f"{waiting} node(s) waiting to join"
+                        f"{waiting} node(s) waiting to join",
+                        persist_first=True,
                     )
         finally:
             self.stop_heartbeat()

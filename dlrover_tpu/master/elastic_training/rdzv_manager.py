@@ -159,14 +159,40 @@ class RendezvousManager(metaclass=ABCMeta):
 
     def _complete_rdzv(self) -> bool:
         """Caller holds the lock: admit a node_unit-rounded set of nodes.
-        Returns False (and leaves state untouched) if rounding admits 0."""
+        Returns False (and leaves state untouched) if rounding admits 0.
+
+        When nodes carry distinct ``slice_id``s the unit applies PER
+        SLICE: only complete slices (>= unit members) are admitted —
+        losing one member of a slice drops that whole slice from the
+        world (its ICI domain is broken; a partial slice cannot train),
+        while other slices train on (reference rdzv_manager.py:291-343
+        node-loss-at-scale semantics + net_topology slice grouping).
+        """
         params = self._rdzv_params
         unit = max(params.node_unit, 1)
-        admitted_num = (len(self._waiting_nodes) // unit) * unit
-        admitted_num = min(admitted_num, params.max_nodes)
-        if admitted_num == 0:
-            return False
-        ranks = sorted(self._waiting_nodes.keys())[:admitted_num]
+        slice_ids = {m.slice_id for m in self._waiting_nodes.values()}
+        if unit > 1 and len(slice_ids) > 1:
+            by_slice: Dict[int, list] = {}
+            for r in sorted(self._waiting_nodes.keys()):
+                m = self._waiting_nodes[r]
+                by_slice.setdefault(m.slice_id, []).append(r)
+            ranks = []
+            for sid in sorted(by_slice):
+                members = by_slice[sid]
+                take = (len(members) // unit) * unit
+                if take and len(ranks) + take <= params.max_nodes:
+                    ranks.extend(members[:take])
+            # the slice-filtered set must still honor the job's
+            # min_nodes contract (the raw waiting count satisfied the
+            # completion rules, but broken slices don't count)
+            if not ranks or len(ranks) < params.min_nodes:
+                return False
+        else:
+            admitted_num = (len(self._waiting_nodes) // unit) * unit
+            admitted_num = min(admitted_num, params.max_nodes)
+            if admitted_num == 0:
+                return False
+            ranks = sorted(self._waiting_nodes.keys())[:admitted_num]
         nodes = {r: self._waiting_nodes[r] for r in ranks}
         sorter = SliceTopologySorter()
         self._rdzv_nodes = sorter.sort(nodes)
@@ -209,9 +235,22 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
         — otherwise agents restart in a loop for a node that can never be
         admitted (node_unit rounding or max_nodes cap)."""
         with self._lock:
-            waiting = len(self._waiting_nodes)
             params = self._rdzv_params
             unit = max(params.node_unit, 1)
+            slice_ids = {m.slice_id for m in self._waiting_nodes.values()}
+            if unit > 1 and len(slice_ids) > 1:
+                # slice-aware: only members of COMPLETE waiting slices
+                # can ever be admitted — a broken slice's orphan must
+                # not keep healthy agents in a restart loop while it
+                # waits (possibly forever) for a replacement host
+                by_slice: Dict[int, int] = {}
+                for m in self._waiting_nodes.values():
+                    by_slice[m.slice_id] = by_slice.get(m.slice_id, 0) + 1
+                waiting = sum(
+                    (count // unit) * unit for count in by_slice.values()
+                )
+            else:
+                waiting = len(self._waiting_nodes)
             if waiting < unit and self._rdzv_nodes:
                 return 0
             cur = len(self._rdzv_nodes)

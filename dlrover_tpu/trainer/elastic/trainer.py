@@ -253,9 +253,20 @@ class ElasticTrainer:
 
         target = nn.unbox(target)
         if self._ckpt is not None:
-            step, state = self._ckpt.load_checkpoint(
-                target=target, shardings=self.result.state_sharding
-            )
+            decision = self._consensus_restore_decision()
+            if decision == "fresh":
+                # asymmetric world with no common checkpoint: every host
+                # must take the SAME branch — init fresh everywhere
+                self.state = self.result.init_fn(rng)
+                self._host_step = 0
+                return 0
+            if isinstance(decision, int):
+                step, state = self._ckpt.engine.load_from_storage(
+                    target, self.result.state_sharding, step=decision)
+            else:
+                step, state = self._ckpt.load_checkpoint(
+                    target=target, shardings=self.result.state_sharding
+                )
             if state is not None:
                 self.state = state
                 self._host_step = int(step)
@@ -264,6 +275,116 @@ class ElasticTrainer:
         self.state = self.result.init_fn(rng)
         self._host_step = 0
         return 0
+
+    def _consensus_restore_decision(self):
+        """Multi-host restore-step agreement.
+
+        After an ASYMMETRIC restart (a replacement host with empty shm,
+        or an orphan whose shm is stale) hosts' shm checkpoints can
+        disagree — a per-host restore would put the world at different
+        steps and the first collective diverges.  All hosts gather
+        (shm_step, storage_step) ONCE and derive the same decision:
+        ``None`` = symmetric, the normal memory-first restore is safe;
+        an ``int`` = every host restores that committed storage step;
+        ``"fresh"`` = no common checkpoint, every host initializes.
+        The decision must be a pure function of the gathered values —
+        re-reading storage later would race concurrent commits and
+        diverge.  (Reference: rank-consistent resume of the
+        flash-checkpoint torch engines.)
+        """
+        import jax
+
+        if jax.process_count() <= 1:
+            return None
+        from dlrover_tpu.agent.ckpt_saver import read_latest_step
+
+        eng = self._ckpt.engine
+        try:
+            meta = eng._shm_handler.get_meta()
+            shm_step = meta.step if meta is not None and meta.valid else -1
+        except Exception:
+            shm_step = -1
+        try:
+            storage_step = read_latest_step(
+                eng.storage, eng.checkpoint_dir)
+        except Exception:
+            storage_step = -1
+        gathered = self._gather_restore_steps(shm_step, storage_step)
+        if gathered is None:
+            return None  # could not coordinate; plain local restore
+        shm_steps = gathered[:, 0]
+        if (shm_steps == shm_steps[0]).all():
+            return None  # symmetric world: memory-first restore is safe
+        # max, not min: the tracker is written AFTER the commit rename,
+        # so a step ANY host observed is already fully committed and
+        # readable by every host — a host whose own read raced the
+        # commit just loads that step directly
+        import numpy as np
+
+        common_storage = int(np.max(gathered[:, 1]))
+        logger.warning(
+            "host checkpoints disagree (shm steps %s); forcing common "
+            "restore: %s", shm_steps.tolist(),
+            common_storage if common_storage >= 0 else "fresh init",
+        )
+        if common_storage < 0:
+            return "fresh"
+        return common_storage
+
+    def _gather_restore_steps(self, shm_step: int, storage_step: int):
+        """All-hosts gather of (shm_step, storage_step) -> [P, 2] array.
+
+        Goes through the master KV store when reachable — a CONTROL
+        plane exchange; the data-plane (Gloo/ICI) may still be forming
+        its first connections at restore time and a collective here can
+        hit connect timeouts on loaded hosts.  Falls back to a jax
+        allgather without a master (plain multi-process runs), and to
+        None (no coordination) if both fail.
+        """
+        import os as _os
+
+        import numpy as np
+
+        addr = _os.environ.get("DLROVER_MASTER_ADDR", "")
+        n = int(_os.environ.get("DLROVER_WORKER_NUM", "0") or 0)
+        rank = int(_os.environ.get("DLROVER_WORKER_RANK", "0") or 0)
+        rnd = _os.environ.get("DLROVER_RDZV_ROUND", "0")
+        if addr and n > 1:
+            try:
+                from dlrover_tpu.agent.master_client import MasterClient
+                from dlrover_tpu.agent.master_kv_store import MasterKVStore
+
+                client = MasterClient(addr, node_id=rank,
+                                      node_type="worker")
+                store = MasterKVStore(client,
+                                      prefix=f"restore_steps/{rnd}")
+                store.set(str(rank), f"{shm_step},{storage_step}")
+                deadline = time.time() + 120
+                keys = [str(r) for r in range(n)]
+                while time.time() < deadline:
+                    vals = store.multi_get(keys)
+                    if all(v for v in vals):
+                        client.close()
+                        return np.array(
+                            [[int(x) for x in v.decode().split(",")]
+                             for v in vals], np.int64)
+                    time.sleep(0.2)
+                client.close()
+                logger.warning("restore-step KV gather timed out")
+            except Exception as e:
+                logger.warning("restore-step KV gather failed: %s", e)
+            # with a master configured the KV path is the ONLY gather:
+            # falling into a jax collective here while peers returned
+            # via KV would strand this host in a barrier nobody joins
+            return None
+        try:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(
+                np.array([shm_step, storage_step], np.int64))
+        except Exception as e:
+            logger.warning("restore-step allgather failed: %s", e)
+            return None
 
     @property
     def step(self) -> int:

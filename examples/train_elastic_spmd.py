@@ -41,6 +41,11 @@ def main() -> int:
                    help="host-side pause per step (elasticity tests: "
                         "keeps tiny runs alive long enough to observe "
                         "membership changes)")
+    p.add_argument("--slice-unit", type=int, default=0,
+                   help="hosts per (emulated) TPU slice: when the world "
+                        "holds more than one complete slice, train on a "
+                        "hybrid DCN mesh — dp replicas over slices, fsdp "
+                        "inside each slice (MeshSpec.hybrid)")
     args = p.parse_args()
 
     # The test harness emulates hosts with virtual CPU devices; the env
@@ -63,8 +68,16 @@ def main() -> int:
     env = init_distributed()
 
     def spec_for(devices):
-        """dp over hosts (DCN) x fsdp over local chips (ICI)."""
+        """dp over hosts/slices (DCN) x fsdp inside (ICI)."""
         procs = len({d.process_index for d in devices})
+        unit = args.slice_unit
+        if unit and procs % unit == 0:
+            # whole slices present: one dp replica per slice, fsdp spans
+            # the slice's hosts (the slice-loss e2e re-enters here with
+            # fewer slices after the master drops an incomplete one;
+            # n_slices == 1 is the single-surviving-slice world)
+            n_slices = procs // unit
+            return MeshSpec.hybrid(n_slices, len(devices) // n_slices)
         if procs > 1:
             per = len(devices) // procs
             return MeshSpec(dp=procs, fsdp=per, dcn_dp=procs)
@@ -113,6 +126,18 @@ def main() -> int:
 
             time.sleep(args.step_sleep)
     print(f"[spmd] done at step {step}", flush=True)
+    # Explicit distributed shutdown WHILE ranks are still in collective
+    # lockstep (just finished the same step): the shutdown barrier
+    # passes immediately.  Leaving it to interpreter atexit lets
+    # per-host teardown skew exceed the barrier window on a loaded box
+    # — the fast rank then exits, the coordination service declares the
+    # job dead, every worker aborts with rc 1, and the agents "recover"
+    # a job that already finished.
+    from dlrover_tpu.trainer.elastic.distributed import (
+        shutdown_distributed,
+    )
+
+    shutdown_distributed()
     trainer.close()
     return 0
 

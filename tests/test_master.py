@@ -156,6 +156,36 @@ class TestElasticRendezvous:
         mgr.join_rendezvous(3, 3, 4)
         assert mgr.num_nodes_waiting() == 2
 
+    def test_slice_aware_admission_drops_incomplete_slice(self):
+        """Losing one member of a slice drops the WHOLE slice from the
+        world (broken ICI domain); the other slice trains on — and the
+        slice is re-admitted when a replacement member joins (reference
+        rdzv_manager.py:291-343 node-loss-at-scale)."""
+        mgr = ElasticTrainingRendezvousManager()
+        mgr.update_rdzv_params(2, 4, waiting_timeout=0.1, node_unit=2)
+        # slice 0 complete (ranks 0,1); slice 1 broken (only rank 2 —
+        # rank 3's host died before joining)
+        mgr.join_rendezvous(0, 0, 2, slice_id=0)
+        mgr.join_rendezvous(1, 1, 2, slice_id=0)
+        mgr.join_rendezvous(2, 2, 2, slice_id=1)
+        time.sleep(0.15)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world.keys()) == {0, 1}, world  # only the whole slice
+        assert world[0].slice_id == 0 and world[1].slice_id == 0
+        # rank 2 was NOT admitted and must re-join the next round
+        _, _, w2 = mgr.get_comm_world(2)
+        assert 2 not in w2
+        # replacement for the dead host arrives: slice 1 is complete
+        # again and the world can grow back to both slices
+        mgr.join_rendezvous(3, 3, 2, slice_id=1)
+        assert mgr.num_nodes_waiting() == 2
+        # members re-join (agent restart on growth) -> 4-node world
+        mgr.join_rendezvous(0, 0, 2, slice_id=0)
+        mgr.join_rendezvous(1, 1, 2, slice_id=0)
+        mgr.join_rendezvous(2, 2, 2, slice_id=1)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world.keys()) == {0, 1, 2, 3}
+
     def test_zero_admit_keeps_waiting(self):
         # fewer waiting nodes than node_unit: must NOT complete with an
         # empty world or inflate the round counter
