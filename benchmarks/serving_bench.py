@@ -76,20 +76,65 @@ def run_config(mode: str) -> dict:
     for i in range(min(2, n_req)):
         eng.add_request(prompts[i], gen_len)
     eng.run()
-    eng.stats.generated_tokens = 0
-    eng.stats.decode_seconds = 0.0
-    eng.stats.prefill_seconds = 0.0
-    t0 = time.perf_counter()
-    for i in range(n_req):
-        eng.add_request(prompts[i], gen_len)
-    eng.run()
-    wall = time.perf_counter() - t0
+    # Best of 3 trials, like every number on this rig: the shared
+    # host's dispatch latency and memory bandwidth swing >10x
+    # second-to-second, and a single sample measures the neighbor.
+    best_wall, best_decode, best_prefill = None, 0.0, None
+    for _ in range(3):
+        eng.stats.generated_tokens = 0
+        eng.stats.decode_seconds = 0.0
+        eng.stats.prefill_seconds = 0.0
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            eng.add_request(prompts[i], gen_len)
+        eng.run()
+        wall = time.perf_counter() - t0
+        if best_wall is None or wall < best_wall:
+            best_wall = wall
+            best_prefill = eng.stats.prefill_seconds
+        best_decode = max(best_decode, eng.stats.decode_tokens_per_sec)
     total_tokens = n_req * gen_len
+    out = {
+        f"serving_tok_s_{mode}": round(total_tokens / best_wall, 1),
+        f"serving_decode_tok_s_{mode}": round(best_decode, 1),
+        f"serving_prefill_s_{mode}": round(best_prefill, 3),
+    }
+    out.update(_decode_step_probe(eng, mode))
+    return out
+
+
+def _decode_step_probe(eng, mode: str) -> dict:
+    """Device-side decode step time: chained chunk dispatches with ONE
+    sync — isolates the model from per-call dispatch latency (on this
+    rig the host<->device hop is a slow debug tunnel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n_chunks, trials = 3, 3
+    eng._admit()
+    tokens = jnp.asarray(eng._tokens)
+    positions = jnp.zeros(eng.max_slots, jnp.int32) + 1
+    active = jnp.asarray(np.ones(eng.max_slots, bool))
+    cache, rng = eng._cache, eng._rng
+    out, tokens, positions, cache, rng = eng._chunk_fn(
+        eng.params, cache, tokens, positions, active, rng)
+    jax.block_until_ready(out)
+    best = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        outs = []
+        for _ in range(n_chunks):
+            out, tokens, positions, cache, rng = eng._chunk_fn(
+                eng.params, cache, tokens, positions, active, rng)
+            outs.append(out)
+        jax.block_until_ready(outs)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    steps = n_chunks * eng.chunk
+    eng._cache, eng._rng = cache, rng
     return {
-        f"serving_tok_s_{mode}": round(total_tokens / wall, 1),
-        f"serving_decode_tok_s_{mode}": round(
-            eng.stats.decode_tokens_per_sec, 1),
-        f"serving_prefill_s_{mode}": round(eng.stats.prefill_seconds, 3),
+        f"serving_decode_step_ms_{mode}": round(best / steps * 1e3, 3),
     }
 
 
@@ -111,6 +156,11 @@ def main() -> dict:
     if "serving_tok_s_bf16" in out and "serving_tok_s_int8" in out:
         out["serving_int8_speedup"] = round(
             out["serving_tok_s_int8"] / out["serving_tok_s_bf16"], 3)
+    if ("serving_decode_step_ms_bf16" in out
+            and "serving_decode_step_ms_int8" in out):
+        out["serving_int8_decode_speedup"] = round(
+            out["serving_decode_step_ms_bf16"]
+            / out["serving_decode_step_ms_int8"], 3)
     if "serving_tok_s_bf16" in out and "serving_tok_s_bf16_slots1" in out:
         out["serving_batch_scaling"] = round(
             out["serving_tok_s_bf16"] / out["serving_tok_s_bf16_slots1"],

@@ -130,12 +130,35 @@ class LlamaConfig:
 
 
 def resolve_remat_policy(name: str):
-    """Checkpoint policy by name; ``"names:a,b"`` maps to
-    ``save_only_these_names(a, b)`` over the model's checkpoint_name tags
-    (qkv_proj / attn_out / mlp_out)."""
+    """Checkpoint policy by name.
+
+    - ``"names:a,b"`` -> ``save_only_these_names(a, b)`` over the
+      model's checkpoint_name tags (qkv_proj / attn_out / mlp_out);
+    - ``"offload_names:a,b"`` -> selective activation OFFLOADING: the
+      named activations are saved to pinned HOST memory during forward
+      and fetched back for backward (XLA overlaps the D2H/H2D with
+      compute) instead of occupying HBM — the reference's
+      selective_offloading_checkpoint.py:252, TPU-native via XLA memory
+      spaces rather than a CUDA stream pool;
+    - ``"offload_dots"`` -> offload every matmul output a plain
+      ``dots_with_no_batch_dims_saveable`` policy would have kept in
+      HBM (the measured seq-16k memory wall, PERF.md);
+    - anything else -> the eponymous ``jax.checkpoint_policies`` entry.
+    """
     if name.startswith("names:"):
         tags = [t for t in name[len("names:"):].split(",") if t]
         return jax.checkpoint_policies.save_only_these_names(*tags)
+    if name.startswith("offload_names:"):
+        tags = [t for t in name[len("offload_names:"):].split(",") if t]
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=tags,
+            offload_src="device", offload_dst="pinned_host",
+        )
+    if name == "offload_dots":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host",
+        )
     return getattr(jax.checkpoint_policies, name)
 
 

@@ -181,11 +181,20 @@ def prequant_matmul(
         a_q = jnp.pad(a_q, ((0, pad), (0, 0)))
         a_scale = jnp.pad(a_scale, ((0, pad), (0, 0)))
     bm = a_q.shape[0]
+
+    def block(dim: int, top: int) -> int:
+        # skinny-M decode wants FEW grid steps streaming LARGE weight
+        # tiles: prefer 512 over 256/128 when it divides
+        for b in (top, 256, 128):
+            if dim % b == 0:
+                return b
+        return 128
+
     out = quantized_matmul(
         a_q, a_scale, w_q, w_scale,
-        block_m=256 if bm % 256 == 0 else 128,
-        block_n=256 if n % 256 == 0 else 128,
-        block_k=256 if k % 256 == 0 else 128,
+        block_m=block(bm, 256),
+        block_n=block(n, 512),
+        block_k=block(k, 512),
         interpret=interpret,
     )
     if pad:

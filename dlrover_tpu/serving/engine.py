@@ -107,11 +107,15 @@ class InferenceEngine:
         self.buckets = tuple(sorted(prefill_buckets))
         self.max_slots = int(max_slots)
         self.params = serving_params_from_llama(variables, cfg, int8=int8)
-        kvd = (cfg.num_layers, self.max_slots, self.max_len,
+        kvd = (self.max_slots, self.max_len,
                cfg.num_kv_heads, cfg.head_dim_)
+        # per-layer buffers (a pytree of lists): donated in place by the
+        # decode chunk, no stacked-cache copies
         self._cache = {
-            "k": jnp.zeros(kvd, cfg.dtype),
-            "v": jnp.zeros(kvd, cfg.dtype),
+            "k": [jnp.zeros(kvd, cfg.dtype)
+                  for _ in range(cfg.num_layers)],
+            "v": [jnp.zeros(kvd, cfg.dtype)
+                  for _ in range(cfg.num_layers)],
         }
         self._rng = jax.random.PRNGKey(seed)
         # host-side slot state
@@ -151,15 +155,19 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1,))
         def insert_fn(params, cache, tokens, real_len, slot, rng):
             logits, ks, vs = prefill(params, cfg, tokens, real_len)
-            k = jax.lax.dynamic_update_slice(
-                cache["k"], ks.astype(cache["k"].dtype),
-                (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                cache["v"], vs.astype(cache["v"].dtype),
-                (0, slot, 0, 0, 0))
+            new_k = [
+                jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (slot, 0, 0, 0))
+                for ck, k in zip(cache["k"], ks)
+            ]
+            new_v = [
+                jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (slot, 0, 0, 0))
+                for cv, v in zip(cache["v"], vs)
+            ]
             rng, sub = jax.random.split(rng)
             first = select_token(logits, sub, temperature, top_k, top_p)
-            return {"k": k, "v": v}, first[0], rng
+            return {"k": new_k, "v": new_v}, first[0], rng
 
         self._chunk_fn = chunk_fn
         self._insert_fn = insert_fn

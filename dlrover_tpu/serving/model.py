@@ -105,27 +105,52 @@ def _write_cache(cache: jax.Array, kv: jax.Array,
     return jax.vmap(one)(cache, kv, positions.astype(jnp.int32))
 
 
+def _layer_weights(layers, i: int) -> Dict[str, Any]:
+    """Layer ``i``'s weights: params store an unstacked per-layer LIST
+    so each weight is its own buffer — read directly by the Pallas int8
+    kernel / XLA with no per-step slice copies (serving/params.py)."""
+    return layers[i]
+
+
+def _qkv_split(cfg: LlamaConfig, qkv: jax.Array):
+    d = cfg.head_dim_
+    qd = cfg.num_heads * d
+    kvd = cfg.num_kv_heads * d
+    return (
+        _split_heads(qkv[..., :qd], cfg.num_heads, d),
+        _split_heads(qkv[..., qd:qd + kvd], cfg.num_kv_heads, d),
+        _split_heads(qkv[..., qd + kvd:], cfg.num_kv_heads, d),
+    )
+
+
 def decode_step(
     params: Dict[str, Any],
     cfg: LlamaConfig,
-    cache: Dict[str, jax.Array],   # {"k","v"}: [n_layers, B, L, KV, D]
-    tokens: jax.Array,             # [B] last sampled token per slot
+    cache: Dict[str, Any],         # {"k","v"}: per-layer LISTS of
+    tokens: jax.Array,             #   [B, L, KV, D] buffers
     positions: jax.Array,          # [B] write position per slot
-) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """One decode step for all slots; returns (logits [B, V], cache)."""
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """One decode step for all slots; returns (logits [B, V], cache).
+
+    The layer loop is python-unrolled (static weight slices, per-layer
+    cache buffers donated in place) and qkv / gate+up run as single
+    fused matmuls — decode is launch/bandwidth-bound, so fewer, larger
+    kernels over unsliced weights is the win (module docstring).
+    """
     dtype = cfg.dtype
     d = cfg.head_dim_
     n_rep = cfg.num_heads // cfg.num_kv_heads
+    f = cfg.intermediate_size
     x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,E]
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
         positions][:, None, :]                                 # [B,1,d/2]
 
-    def body(x, layer_and_cache):
-        lp, ck, cv = layer_and_cache
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        lp = _layer_weights(params["layers"], i)
+        ck, cv = cache["k"][i], cache["v"][i]
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
-        q = _split_heads(_mm(h, lp["wq"], dtype), cfg.num_heads, d)
-        k = _split_heads(_mm(h, lp["wk"], dtype), cfg.num_kv_heads, d)
-        v = _split_heads(_mm(h, lp["wv"], dtype), cfg.num_kv_heads, d)
+        q, k, v = _qkv_split(cfg, _mm(h, lp["wqkv"], dtype))
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         ck = _write_cache(ck, k, positions)
@@ -134,19 +159,12 @@ def decode_step(
         o = o.reshape(o.shape[0], 1, cfg.num_heads * d)
         x = x + _mm(o, lp["wo"], dtype)
         h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
-        gate = jax.nn.silu(_mm(h, lp["gate"], dtype))
-        up = _mm(h, lp["up"], dtype)
-        x = x + _mm(gate * up, lp["down"], dtype)
-        return x, (ck, cv)
+        gu = _mm(h, lp["wgu"], dtype)
+        x = x + _mm(jax.nn.silu(gu[..., :f]) * gu[..., f:],
+                    lp["down"], dtype)
+        new_k.append(ck)
+        new_v.append(cv)
 
-    def scan_body(x, xs):
-        lp, ck, cv = xs
-        x, (ck, cv) = body(x, (lp, ck, cv))
-        return x, (ck, cv)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        scan_body, x, (params["layers"], cache["k"], cache["v"])
-    )
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _lm_head(params, x.astype(dtype), cfg)[:, 0, :]
     return logits, {"k": new_k, "v": new_v}
@@ -172,23 +190,24 @@ def prefill(
     cfg: LlamaConfig,
     tokens: jax.Array,        # [1, Lp] right-padded prompt bucket
     real_len: jax.Array,      # scalar: actual prompt length (<= Lp)
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, list, list]:
     """Causal pass over one prompt; returns (last_logits [1, V],
-    k [n_layers, 1, Lp, KV, D], v [...]) — the engine inserts the K/V
-    into a decode-cache slot.  Pad garbage beyond ``real_len`` is
-    harmless: decode overwrites/masks it (module docstring)."""
+    per-layer k list of [1, Lp, KV, D], v list) — the engine inserts
+    the K/V into a decode-cache slot.  Pad garbage beyond ``real_len``
+    is harmless: decode overwrites/masks it (module docstring)."""
     dtype = cfg.dtype
     d = cfg.head_dim_
+    f = cfg.intermediate_size
     lp_len = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)          # [1, Lp, E]
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
         jnp.arange(lp_len)]
 
-    def scan_body(x, lp):
+    ks, vs = [], []
+    for i in range(cfg.num_layers):
+        lp = _layer_weights(params["layers"], i)
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
-        q = _split_heads(_mm(h, lp["wq"], dtype), cfg.num_heads, d)
-        k = _split_heads(_mm(h, lp["wk"], dtype), cfg.num_kv_heads, d)
-        v = _split_heads(_mm(h, lp["wv"], dtype), cfg.num_kv_heads, d)
+        q, k, v = _qkv_split(cfg, _mm(h, lp["wqkv"], dtype))
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         o = dot_product_attention(q, k, v, causal=True,
@@ -196,12 +215,11 @@ def prefill(
         o = o.reshape(o.shape[0], lp_len, cfg.num_heads * d)
         x = x + _mm(o, lp["wo"], dtype)
         h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
-        gate = jax.nn.silu(_mm(h, lp["gate"], dtype))
-        up = _mm(h, lp["up"], dtype)
-        x = x + _mm(gate * up, lp["down"], dtype)
-        return x, (k, v)
-
-    x, (ks, vs) = jax.lax.scan(scan_body, x, params["layers"])
+        gu = _mm(h, lp["wgu"], dtype)
+        x = x + _mm(jax.nn.silu(gu[..., :f]) * gu[..., f:],
+                    lp["down"], dtype)
+        ks.append(k)
+        vs.append(v)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jax.lax.dynamic_slice_in_dim(
         x, real_len.astype(jnp.int32) - 1, 1, axis=1)

@@ -34,8 +34,11 @@ import jax.numpy as jnp
 from dlrover_tpu.models.llama import LlamaConfig
 from dlrover_tpu.ops.pallas.quant_matmul import prequantize_weight
 
-# weights quantized when int8=True; norms/embedding always stay fp
-_LAYER_MATS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+# weights quantized when int8=True; norms/embedding always stay fp.
+# wqkv / wgu are load-time fusions: one [E, H*D+2*KV*D] matmul instead
+# of three and one [E, 2F] instead of two — fewer, larger kernels (the
+# standard serving fusion; decode is launch/bandwidth-bound)
+_LAYER_MATS = ("wqkv", "wo", "wgu", "down")
 
 
 def _maybe_quant(w: jax.Array, int8: bool):
@@ -61,15 +64,18 @@ def _layer_tree(p: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
         return w.reshape(*w.shape[:-3], w.shape[-3] * w.shape[-2],
                          w.shape[-1])
 
+    wq = merge_last2(attn["q_proj"]["kernel"])
+    wk = merge_last2(attn["k_proj"]["kernel"])
+    wv = merge_last2(attn["v_proj"]["kernel"])
     return {
         "input_norm": p["input_norm"]["scale"],
         "post_norm": p["post_norm"]["scale"],
-        "wq": merge_last2(attn["q_proj"]["kernel"]),
-        "wk": merge_last2(attn["k_proj"]["kernel"]),
-        "wv": merge_last2(attn["v_proj"]["kernel"]),
+        "wqkv": jnp.concatenate([jnp.asarray(wq), jnp.asarray(wk),
+                                 jnp.asarray(wv)], axis=-1),
         "wo": merge_head_in(attn["o_proj"]["kernel"]),
-        "gate": p["mlp"]["gate_proj"]["kernel"],
-        "up": p["mlp"]["up_proj"]["kernel"],
+        "wgu": jnp.concatenate(
+            [jnp.asarray(p["mlp"]["gate_proj"]["kernel"]),
+             jnp.asarray(p["mlp"]["up_proj"]["kernel"])], axis=-1),
         "down": p["mlp"]["down_proj"]["kernel"],
     }
 
@@ -90,27 +96,33 @@ def serving_params_from_llama(
         dtype = cfg.dtype
     variables = nn.meta.unbox(variables)
     params = variables["params"] if "params" in variables else variables
-    if "layers" in params:  # scan form: leading layer axis already there
+    if "layers" in params:  # scan form: unstack the leading layer axis
         stacked = _layer_tree(params["layers"]["layer"], cfg)
+        per_layer = [
+            {k: v[i] for k, v in stacked.items()}
+            for i in range(cfg.num_layers)
+        ]
     else:
         per_layer = [
             _layer_tree(params[f"layer_{i}"], cfg)
             for i in range(cfg.num_layers)
         ]
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *per_layer
-        )
 
-    def quant_stacked(name: str, w: jax.Array):
-        if name not in _LAYER_MATS or not int8:
-            return jnp.asarray(w, dtype if name in _LAYER_MATS else w.dtype)
-        qs = [_maybe_quant(w[i], True) for i in range(w.shape[0])]
-        return {
-            "q": jnp.stack([x["q"] for x in qs]),
-            "scale": jnp.stack([x["scale"] for x in qs]),
-        }
+    # layers stay a LIST of per-layer trees — the decode loop is
+    # unrolled, and an unstacked weight is a buffer the Pallas int8
+    # kernel (and XLA) reads directly; a stacked array would force a
+    # materialized slice copy per layer per step (measured: the copies
+    # cost as much as the int8 matmuls they feed)
+    def finish(name: str, w):
+        if name not in _LAYER_MATS:
+            return jnp.asarray(w)
+        if int8:
+            return _maybe_quant(w, True)
+        return jnp.asarray(w, dtype)
 
-    layers = {k: quant_stacked(k, v) for k, v in stacked.items()}
+    layers = [
+        {k: finish(k, v) for k, v in lt.items()} for lt in per_layer
+    ]
     embed = jnp.asarray(params["embed_tokens"]["embedding"], dtype)
     out: Dict[str, Any] = {
         "embed": embed,

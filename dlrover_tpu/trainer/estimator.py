@@ -44,10 +44,16 @@ class EvalSpec:
 
 
 class SessionHook:
-    """Host-loop hook points (reference session hooks)."""
+    """Host-loop hook points (the reference's session-hook ecosystem:
+    hooks/elastic_data_shard_report_hook.py, global_step_hook.py, and
+    tf.train's Checkpoint/Logging/StopAtStep hooks)."""
 
+    def begin(self, executor: "EstimatorExecutor") -> None: ...
+    def after_restore(self, step: int) -> None: ...
+    def before_step(self, step: int) -> None: ...
     def after_step(self, step: int, metrics: Dict[str, float]) -> None: ...
     def after_eval(self, step: int, metrics: Dict[str, float]) -> None: ...
+    def after_save(self, step: int) -> None: ...
     def end(self, step: int) -> None: ...
 
 
@@ -73,6 +79,92 @@ class GlobalStepHook(SessionHook):
         from dlrover_tpu.agent.monitor.training import write_runtime_metrics
 
         write_runtime_metrics(step)
+
+
+class LoggingHook(SessionHook):
+    """Log training metrics every N steps (reference logging session
+    hooks / tf.train.LoggingTensorHook)."""
+
+    def __init__(self, every_n_steps: int = 100):
+        self._every = max(1, every_n_steps)
+
+    def after_step(self, step: int, metrics: Dict[str, float]) -> None:
+        if step % self._every == 0:
+            rendered = " ".join(
+                f"{k}={v:.6g}" for k, v in sorted(metrics.items()))
+            logger.info("step %s: %s", step, rendered)
+
+    def after_eval(self, step: int, metrics: Dict[str, float]) -> None:
+        rendered = " ".join(
+            f"{k}={v:.6g}" for k, v in sorted(metrics.items()))
+        logger.info("eval @ step %s: %s", step, rendered)
+
+
+class CheckpointHook(SessionHook):
+    """Periodic flash-checkpoint of (params, opt_state, step) plus
+    restore-on-begin (the reference's checkpoint session hook /
+    CheckpointSaverHook over our flash-checkpoint engine)."""
+
+    def __init__(self, checkpoint_dir: str, every_n_steps: int = 100,
+                 to_disk_every: int = 0):
+        from dlrover_tpu.trainer.flash_checkpoint import Checkpointer
+
+        self._ckpt = Checkpointer(checkpoint_dir)
+        self._every = max(1, every_n_steps)
+        self._disk_every = to_disk_every
+        self._executor: Optional["EstimatorExecutor"] = None
+
+    def begin(self, executor: "EstimatorExecutor") -> None:
+        self._executor = executor
+        target = {
+            "params": executor.params,
+            "opt_state": executor.opt_state,
+        }
+        step, restored = self._ckpt.load_checkpoint(target)
+        if restored is not None:
+            executor.params = restored["params"]
+            executor.opt_state = restored["opt_state"]
+            executor.global_step = int(step)
+            logger.info("estimator restored at step %s", step)
+            executor._fire("after_restore", int(step))
+
+    def after_step(self, step: int, metrics: Dict[str, float]) -> None:
+        if step % self._every:
+            return
+        from dlrover_tpu.trainer.flash_checkpoint import StorageType
+
+        storage = (
+            StorageType.DISK
+            if self._disk_every and step % self._disk_every == 0
+            else StorageType.MEMORY
+        )
+        assert self._executor is not None
+        self._ckpt.save_checkpoint(
+            step,
+            {"params": self._executor.params,
+             "opt_state": self._executor.opt_state},
+            storage_type=storage,
+        )
+        self._executor._fire("after_save", step)
+
+    def end(self, step: int) -> None:
+        self._ckpt.close()
+
+
+class StopAtStepHook(SessionHook):
+    """Stop training at an absolute step (tf.train.StopAtStepHook) —
+    raises the executor's stop flag rather than an exception."""
+
+    def __init__(self, last_step: int):
+        self._last = last_step
+        self._executor: Optional["EstimatorExecutor"] = None
+
+    def begin(self, executor: "EstimatorExecutor") -> None:
+        self._executor = executor
+
+    def after_step(self, step: int, metrics: Dict[str, float]) -> None:
+        if step >= self._last and self._executor is not None:
+            self._executor.request_stop()
 
 
 class ElasticShardReader:
@@ -132,6 +224,11 @@ class EstimatorExecutor:
         self._jit_train = jax.jit(train_step)
         self._jit_eval = jax.jit(
             lambda params, f, l: self._model_fn(params, f, l))
+        self._stop_requested = False
+
+    def request_stop(self) -> None:
+        """Hooks call this to end training after the current step."""
+        self._stop_requested = True
 
     # -- loops -----------------------------------------------------------
     def _fire(self, hook_name: str, *args) -> None:
@@ -143,8 +240,10 @@ class EstimatorExecutor:
 
     def train_and_evaluate(self) -> Dict[str, float]:
         """The reference's tf.estimator.train_and_evaluate shape."""
+        self._fire("begin", self)  # may restore params/step (ckpt hook)
         metrics: Dict[str, Any] = {}
         for batch in self._train_spec.input_fn():
+            self._fire("before_step", self.global_step + 1)
             features, labels = batch
             self.params, self.opt_state, metrics = self._jit_train(
                 self.params, self.opt_state,
@@ -161,23 +260,33 @@ class EstimatorExecutor:
                     and self.global_step
                     % self._eval_spec.every_n_steps == 0):
                 self.evaluate()
-            if (self._train_spec.max_steps
+            if self._stop_requested or (
+                    self._train_spec.max_steps
                     and self.global_step >= self._train_spec.max_steps):
                 break
         self._fire("end", self.global_step)
         return {k: float(jax.device_get(v)) for k, v in metrics.items()}
 
     def evaluate(self) -> Dict[str, float]:
+        """Aggregate EVERY metric the model_fn returns (mean over eval
+        batches), not just the loss — the reference's eval metric_ops."""
         assert self._eval_spec is not None
-        losses = []
+        sums: Dict[str, float] = {}
+        count = 0
         for i, batch in enumerate(self._eval_spec.input_fn()):
             features, labels = batch
-            loss, _ = self._jit_eval(
+            loss, batch_metrics = self._jit_eval(
                 self.params, jnp.asarray(features), jnp.asarray(labels))
-            losses.append(float(jax.device_get(loss)))
+            sums["loss"] = sums.get("loss", 0.0) + float(
+                jax.device_get(loss))
+            for k, v in (batch_metrics or {}).items():
+                sums[k] = sums.get(k, 0.0) + float(jax.device_get(v))
+            count += 1
             if self._eval_spec.steps and i + 1 >= self._eval_spec.steps:
                 break
-        metrics = {"eval_loss": float(np.mean(losses))} if losses else {}
+        metrics = {
+            f"eval_{k}": v / count for k, v in sums.items()
+        } if count else {}
         self._fire("after_eval", self.global_step, metrics)
         logger.info("estimator eval: %s", metrics)
         return metrics

@@ -471,3 +471,17 @@ def test_chunked_loss_under_tensor_parallel_vocab():
         _, metrics = res.train_step(state, {"input_ids": ids})
         losses[chunk] = float(metrics["loss"])
     np.testing.assert_allclose(losses[8], losses[None], rtol=1e-5)
+
+
+def test_offload_remat_policies_resolve():
+    """Selective activation offloading policies (reference
+    selective_offloading_checkpoint.py:252) resolve to callables; the
+    execution path needs a real TPU (XLA host memory spaces), covered
+    by benchmarks/offload_probe.py."""
+    from dlrover_tpu.models.llama import resolve_remat_policy
+
+    assert callable(resolve_remat_policy("offload_dots"))
+    assert callable(resolve_remat_policy("offload_names:mlp_out,attn_out"))
+    assert callable(resolve_remat_policy("names:qkv_proj"))
+    assert callable(
+        resolve_remat_policy("dots_with_no_batch_dims_saveable"))

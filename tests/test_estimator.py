@@ -93,3 +93,85 @@ def test_global_step_hook_writes_metrics_file(tmp_path, monkeypatch):
     from dlrover_tpu.agent.monitor.training import read_runtime_metrics
 
     assert read_runtime_metrics(path)["step"] == 41
+
+
+def _quadratic_executor(hooks, max_steps=10, eval_every=0):
+    """Tiny learnable problem with an extra metric (mae)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.trainer.estimator import (
+        EstimatorExecutor,
+        EvalSpec,
+        TrainSpec,
+    )
+
+    def model_fn(params, features, labels):
+        pred = features @ params["w"]
+        loss = jnp.mean((pred - labels) ** 2)
+        return loss, {"mae": jnp.mean(jnp.abs(pred - labels))}
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    w_true = np.arange(1, 5, dtype=np.float32)
+    y = x @ w_true
+
+    def input_fn():
+        for i in range(1000):
+            sl = slice((i * 8) % 56, (i * 8) % 56 + 8)
+            yield x[sl], y[sl]
+
+    return EstimatorExecutor(
+        model_fn,
+        lambda key: {"w": jnp.zeros(4, jnp.float32)},
+        TrainSpec(input_fn, max_steps=max_steps),
+        eval_spec=EvalSpec(input_fn, steps=3, every_n_steps=eval_every),
+        optimizer=optax.adam(0.1),
+        hooks=hooks,
+    )
+
+
+def test_checkpoint_hook_saves_and_restores(tmp_path):
+    """The reference's CheckpointSaverHook shape over flash checkpoint:
+    run 1 saves; run 2 begins from the restored step."""
+    import os
+    import uuid
+
+    os.environ["DLROVER_JOB_UID"] = uuid.uuid4().hex[:8]
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+    from dlrover_tpu.trainer.estimator import CheckpointHook
+
+    ckpt_dir = str(tmp_path / "est_ckpt")
+    hook = CheckpointHook(ckpt_dir, every_n_steps=5)
+    ex = _quadratic_executor([hook], max_steps=10)
+    ex.train_and_evaluate()
+    assert ex.global_step == 10
+    AsyncCheckpointSaver.reset()
+
+    hook2 = CheckpointHook(ckpt_dir, every_n_steps=5)
+    ex2 = _quadratic_executor([hook2], max_steps=12)
+    ex2.train_and_evaluate()
+    # restored at 10 (last save), trained to 12 — not from scratch
+    assert ex2.global_step == 12
+    AsyncCheckpointSaver.reset()
+
+
+def test_stop_at_step_and_logging_hooks():
+    from dlrover_tpu.trainer.estimator import LoggingHook, StopAtStepHook
+
+    ex = _quadratic_executor(
+        [StopAtStepHook(4), LoggingHook(every_n_steps=2)],
+        max_steps=100,
+    )
+    ex.train_and_evaluate()
+    assert ex.global_step == 4
+
+
+def test_eval_aggregates_all_metrics():
+    ex = _quadratic_executor([], max_steps=6)
+    ex.train_and_evaluate()
+    metrics = ex.evaluate()
+    assert "eval_loss" in metrics and "eval_mae" in metrics
+    assert metrics["eval_mae"] >= 0.0
