@@ -32,11 +32,11 @@ import time
 
 
 def _model_flops_per_token(cfg) -> float:
-    """Training FLOPs/token: 6*N for matmuls + attention quadratic term."""
-    n = cfg.num_params
-    # attention scores+values: 12 * L * s * h per token (fwd+bwd)
-    attn = 12 * cfg.num_layers * cfg.max_seq_len * cfg.hidden_size
-    return 6.0 * n + attn
+    """Training FLOPs/token (canonical formula lives next to the peak
+    table: accel/parallel/mesh.py model_flops_per_token)."""
+    from dlrover_tpu.accel.parallel.mesh import model_flops_per_token
+
+    return model_flops_per_token(cfg)
 
 
 def _timed_windows(train_step, state, batch, steps, warmup,

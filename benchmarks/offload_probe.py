@@ -80,10 +80,11 @@ def run_policy(policy: str, seq: int = 16384, steps: int = 4,
     }
     peak_flops = mfu_denominator_flops(jax.devices()[0].device_kind)
     if peak_flops:
-        n = cfg.num_params
-        attn = 12 * cfg.num_layers * seq * cfg.hidden_size
+        from dlrover_tpu.accel.parallel.mesh import model_flops_per_token
+
         out["mfu"] = round(
-            (seq / step_s) * (6.0 * n + attn) / peak_flops, 4)
+            (seq / step_s) * model_flops_per_token(cfg, seq_len=seq)
+            / peak_flops, 4)
     return out
 
 

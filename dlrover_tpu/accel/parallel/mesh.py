@@ -462,6 +462,17 @@ def num_data_shards(spec: MeshSpec) -> int:
     return spec.dp * spec.fsdp
 
 
+def model_flops_per_token(cfg, seq_len: Optional[int] = None) -> float:
+    """Training model-FLOPs per token: ``6*N`` for the matmuls plus the
+    attention quadratic term (``12 * L * s * h`` fwd+bwd).  The single
+    source of the MFU numerator used by bench.py and the probes —
+    recompute from rematerialization is deliberately NOT counted (it
+    shows up as lost MFU, keeping the accounting honest)."""
+    n = cfg.num_params
+    seq = seq_len if seq_len is not None else cfg.max_seq_len
+    return 6.0 * n + 12 * cfg.num_layers * seq * cfg.hidden_size
+
+
 def mfu_denominator_flops(device_kind: str) -> Optional[float]:
     """Peak bf16 FLOP/s for known TPU generations (for MFU accounting).
     Returns None for unknown hardware — an MFU against a guessed peak

@@ -87,6 +87,7 @@ class Node:
         relaunchable: bool = True,
         service_addr: str = "",
         slice_id: int = 0,
+        critical: bool = False,
     ):
         self.type = node_type
         self.id = node_id
@@ -100,6 +101,11 @@ class Node:
         self.relaunchable = relaunchable
         self.service_addr = service_addr
         self.slice_id = slice_id
+        # Critical nodes fail the whole job when their failure cannot be
+        # recovered by a relaunch (reference: training_node.py:40-71
+        # set_critical_node — chief/evaluator always, PS per flag,
+        # workers per critical_worker_index).
+        self.critical = critical
 
         self.create_time: Optional[float] = None
         self.start_time: Optional[float] = None
@@ -186,6 +192,7 @@ class Node:
             relaunch_count=self.relaunch_count + 1,
             max_relaunch_count=self.max_relaunch_count,
             slice_id=self.slice_id,
+            critical=self.critical,
         )
         return new_node
 

@@ -43,7 +43,39 @@ def build_master_parser() -> argparse.ArgumentParser:
         "--distribution_strategy",
         default="AllreduceStrategy",
     )
+    parser.add_argument(
+        "--node_groups", default="",
+        help="multi-role replica spec 'role:count[,role:count...]', e.g. "
+             "'chief:1,worker:2,evaluator:1,ps:2' (reference: ElasticJob "
+             "replicaSpecs); empty = workers only from --node_num",
+    )
     return parser
+
+
+def parse_node_groups(spec: str):
+    """'chief:1,worker:2,ps:2' -> {role: NodeGroupResource}; '' -> None."""
+    if not spec:
+        return None
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.common.node import NodeGroupResource
+
+    known_roles = {
+        NodeType.CHIEF, NodeType.WORKER, NodeType.EVALUATOR, NodeType.PS
+    }
+    groups = {}
+    for part in spec.split(","):
+        role, _, count = part.strip().partition(":")
+        if not role or not count.strip().isdigit():
+            raise ValueError(
+                f"bad --node_groups entry {part!r}; want 'role:count'"
+            )
+        if role not in known_roles:
+            raise ValueError(
+                f"unknown node role {role!r} in --node_groups; "
+                f"known: {sorted(known_roles)}"
+            )
+        groups[role] = NodeGroupResource(int(count))
+    return groups
 
 
 def parse_master_args(argv=None):

@@ -53,8 +53,18 @@ class DistributedJobMaster:
         autoscale: bool = False,
         auto_tuning: bool = False,
         tuning_interval: float = 120.0,
+        node_groups=None,
+        critical_worker_index=None,
+        ps_is_critical: bool = True,
     ):
+        """``node_groups`` (role -> NodeGroupResource) schedules multi-role
+        jobs — chief/evaluator/ps alongside workers (reference:
+        dist_job_manager.py:259-316); omitted = plain SPMD worker job."""
         self._port = port
+        # a multi-role spec defines the training world size through its
+        # worker group; --node_num then only covers the workers-only case
+        if node_groups and "worker" in node_groups:
+            node_num = node_groups["worker"].count
         self._node_num = node_num
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager(0, self.speed_monitor)
@@ -77,6 +87,9 @@ class DistributedJobMaster:
             error_monitor=JobErrorMonitor(
                 on_event=self.job_metric_collector.report_event
             ),
+            node_groups=node_groups,
+            critical_worker_index=critical_worker_index,
+            ps_is_critical=ps_is_critical,
         )
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)

@@ -4,19 +4,20 @@ import sys
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.rpc import find_free_port
-from dlrover_tpu.master.args import parse_master_args
+from dlrover_tpu.master.args import parse_master_args, parse_node_groups
 
 
 def run(args) -> int:
     port = args.port or find_free_port()
+    node_groups = parse_node_groups(args.node_groups)
     if args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster
 
-        if args.autoscale or args.auto_tuning:
+        if args.autoscale or args.auto_tuning or node_groups:
             logger.warning(
-                "--autoscale/--auto_tuning need node lifecycle management; "
-                "the local platform ignores them (use --platform in_memory "
-                "or k8s)"
+                "--autoscale/--auto_tuning/--node_groups need node "
+                "lifecycle management; the local platform ignores them "
+                "(use --platform in_memory or k8s)"
             )
         master = LocalJobMaster(port, node_num=args.node_num)
     elif args.platform == "in_memory":
@@ -38,6 +39,7 @@ def run(args) -> int:
             node_num=args.node_num,
             autoscale=args.autoscale,
             auto_tuning=args.auto_tuning,
+            node_groups=node_groups,
         )
     elif args.platform in ("k8s", "pyk8s"):
         from dlrover_tpu.master.dist_master import DistributedJobMaster
@@ -66,6 +68,7 @@ def run(args) -> int:
             node_num=args.node_num,
             autoscale=args.autoscale,
             auto_tuning=args.auto_tuning,
+            node_groups=node_groups,
         )
     else:
         raise NotImplementedError(

@@ -48,10 +48,19 @@ class RendezvousMembershipCallback(NodeEventCallback):
     lifecycle so a dead node shrinks the next comm world (the SPMD analogue
     of the reference's AllReduceNodeHandlingCallback)."""
 
+    #: only workers join the SPMD comm world — chief/evaluator/PS roles
+    #: belong to the estimator path, which coordinates through the sync/
+    #: elastic-PS services instead (reference: event_callback.py
+    #: AllReduceNodeHandlingCallback acts on workers only; ranks are
+    #: per-role, so admitting other roles would alias worker ranks)
+    COMM_WORLD_TYPES = ("worker",)
+
     def __init__(self, rdzv_managers: dict):
         self._rdzv_managers = rdzv_managers
 
     def on_node_started(self, node: Node) -> None:
+        if node.type not in self.COMM_WORLD_TYPES:
+            return
         for mgr in self._rdzv_managers.values():
             mgr.add_alive_node(node.rank_index)
 
@@ -65,6 +74,8 @@ class RendezvousMembershipCallback(NodeEventCallback):
         self._remove(node)
 
     def _remove(self, node: Node) -> None:
+        if node.type not in self.COMM_WORLD_TYPES:
+            return
         for mgr in self._rdzv_managers.values():
             mgr.remove_alive_node(node.rank_index)
 

@@ -36,6 +36,10 @@ from dlrover_tpu.master.watcher.base import NodeEvent, NodeWatcher
 
 
 class JobManager:
+    #: node roles whose exit/failure decides the job outcome (the PS role
+    #: stays alive for the whole job and is judged by criticality instead)
+    TRAINING_TYPES = (NodeType.CHIEF, NodeType.WORKER, NodeType.EVALUATOR)
+
     def __init__(
         self,
         scaler: Scaler,
@@ -45,7 +49,14 @@ class JobManager:
         heartbeat_timeout: float = JobConstant.NODE_HEARTBEAT_TIMEOUT,
         max_relaunch_count: int = JobConstant.MAX_NODE_RELAUNCH_COUNT,
         error_monitor=None,
+        node_groups: Optional[Dict[str, NodeGroupResource]] = None,
+        critical_worker_index: Optional[Dict[int, int]] = None,
+        ps_is_critical: bool = True,
     ):
+        """``node_groups`` maps role -> group size/resource for multi-role
+        jobs (chief/evaluator/ps alongside workers — reference:
+        dist_job_manager.py:259-316 Chief/Evaluator/PS managers).  When
+        omitted, the job is the plain SPMD worker group."""
         self._scaler = scaler
         self._watcher = watcher
         self._error_monitor = error_monitor
@@ -53,13 +64,25 @@ class JobManager:
         self._worker_resource = worker_resource or NodeResource()
         self._heartbeat_timeout = heartbeat_timeout
         self._max_relaunch_count = max_relaunch_count
+        if node_groups is None:
+            node_groups = {
+                NodeType.WORKER: NodeGroupResource(
+                    worker_num, self._worker_resource
+                )
+            }
+        self._node_groups = node_groups
+        self._critical_worker_index = critical_worker_index or {}
+        self._ps_is_critical = ps_is_critical
         self._lock = threading.Lock()
         # Serializes status transitions end-to-end (flow lookup + apply +
         # relaunch): the watcher thread and the heartbeat thread both feed
         # _process_event, and racing them could relaunch a node twice.
         self._transition_lock = threading.RLock()
         # node_type -> {node_id: Node}
-        self.job_nodes: Dict[str, Dict[int, Node]] = {NodeType.WORKER: {}}
+        self.job_nodes: Dict[str, Dict[int, Node]] = {
+            node_type: {} for node_type in node_groups
+        }
+        self.job_nodes.setdefault(NodeType.WORKER, {})
         self._event_callbacks: List[NodeEventCallback] = []
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -71,18 +94,18 @@ class JobManager:
 
     def start(self) -> None:
         self._scaler.start()
-        # adopt nodes that already exist (master restart case)
+        # adopt nodes that already exist (master restart case); re-stamp
+        # role policy — watcher-built nodes default to critical=False
         for node in self._watcher.list():
+            self._apply_role_policy(node)
             self.job_nodes.setdefault(node.type, {})[node.id] = node
-        if not self.job_nodes.get(NodeType.WORKER):
-            plan = ScalePlan(
-                node_group_resources={
-                    NodeType.WORKER: NodeGroupResource(
-                        self._worker_num, self._worker_resource
-                    )
-                }
-            )
-            self._scaler.scale(plan)
+        missing = {
+            node_type: group
+            for node_type, group in self._node_groups.items()
+            if group.count > 0 and not self.job_nodes.get(node_type)
+        }
+        if missing:
+            self._scaler.scale(ScalePlan(node_group_resources=missing))
         for target, name in (
             (self._monitor_nodes, "job-manager-nodes"),
             (self._monitor_heart_beats, "job-manager-heartbeat"),
@@ -125,6 +148,7 @@ class JobManager:
                         config_resource=new.config_resource,
                         slice_id=new.slice_id,
                     )
+                    self._apply_role_policy(node)
                     nodes[new.id] = node
                     self._absorb_phantom(nodes, node)
             flow = get_node_state_flow(
@@ -141,6 +165,19 @@ class JobManager:
             self._fire_callbacks(node, flow.to_status)
             if flow.should_relaunch:
                 self._relaunch_node(node)
+
+    def _apply_role_policy(self, node: Node) -> None:
+        """Stamp role-dependent criticality/budgets onto a newly-adopted
+        node (reference: training_node.py:40-71 set_critical_node)."""
+        if node.type in (NodeType.CHIEF, NodeType.EVALUATOR):
+            node.critical = True
+        elif node.type == NodeType.PS:
+            node.critical = self._ps_is_critical
+        elif node.type == NodeType.WORKER:
+            budget = self._critical_worker_index.get(node.rank_index)
+            if budget is not None:
+                node.critical = True
+                node.max_relaunch_count = budget
 
     @staticmethod
     def _absorb_phantom(nodes: Dict[int, Node], node: Node) -> None:
@@ -354,7 +391,42 @@ class JobManager:
         return getattr(self, "_paral_config", None)
 
     def query_ps_nodes(self):
-        return [], True, False
+        """PS cluster view for the TF/estimator failover client: live PS
+        node metas (rank-ordered), whether the target PS set is fully
+        running, and whether any PS failed unrecoverably (reference:
+        servicer.py query_ps_nodes + node/ps.py ParameterServerManager).
+        """
+        from dlrover_tpu.common import comm
+
+        target = self._node_groups.get(NodeType.PS)
+        target_num = target.count if target else 0
+        with self._lock:
+            ps_nodes = sorted(
+                (
+                    n
+                    for n in self.job_nodes.get(NodeType.PS, {}).values()
+                    if not n.is_exited()
+                ),
+                key=lambda n: n.rank_index,
+            )
+            failure = any(
+                n.status == NodeStatus.FAILED and not n.is_released
+                for n in self.job_nodes.get(NodeType.PS, {}).values()
+            )
+        metas = [
+            comm.NodeMeta(
+                node_type=NodeType.PS,
+                node_id=n.id,
+                node_rank=n.rank_index,
+                addr=n.service_addr,
+            )
+            for n in ps_nodes
+        ]
+        ready = target_num == 0 or (
+            len(ps_nodes) >= target_num
+            and all(n.status == NodeStatus.RUNNING for n in ps_nodes)
+        )
+        return metas, ready, failure
 
     def get_elastic_run_configs(self) -> Dict[str, str]:
         return {}
@@ -378,23 +450,38 @@ class JobManager:
             }
 
     # -- job-level state --------------------------------------------------
+    def _training_nodes(self) -> List[Node]:
+        """Chief + workers + evaluators — the roles whose completion ends
+        the job (reference: dist_job_manager.py:655-662 all_workers_exited
+        spans chief/worker/evaluator managers; PS stays up by design)."""
+        return [
+            n
+            for node_type in self.TRAINING_TYPES
+            for n in self.job_nodes.get(node_type, {}).values()
+        ]
+
     def all_workers_exited(self) -> bool:
         with self._lock:
-            workers = list(self.job_nodes.get(NodeType.WORKER, {}).values())
+            workers = self._training_nodes()
         return bool(workers) and all(n.is_exited() for n in workers)
 
     def any_worker_failed_fatally(self) -> bool:
         return bool(self._relaunch_budget_exhausted)
 
     def job_failed(self) -> bool:
-        """The job is failed only by *unrecovered* worker failures: a node
-        whose failure was covered by a relaunch (is_released) doesn't count
-        against the job's final status."""
+        """The job is failed only by *unrecovered* failures: a node whose
+        failure was covered by a relaunch (is_released) doesn't count.
+        Training-role failures always count; other roles (PS) only when
+        the node is critical."""
         if self._relaunch_budget_exhausted:
             return True
         with self._lock:
-            workers = list(self.job_nodes.get(NodeType.WORKER, {}).values())
+            nodes = [
+                n for nodes in self.job_nodes.values() for n in nodes.values()
+            ]
         return any(
-            n.status == NodeStatus.FAILED and not n.is_released
-            for n in workers
+            n.status == NodeStatus.FAILED
+            and not n.is_released
+            and (n.type in self.TRAINING_TYPES or n.critical)
+            for n in nodes
         )

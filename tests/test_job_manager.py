@@ -244,3 +244,140 @@ def test_distributed_master_end_to_end_rpc():
             c.close()
     finally:
         master.stop()
+
+
+# -- multi-role jobs (chief / evaluator / PS) ----------------------------
+
+
+def _role_manager(max_relaunch_count=2, critical_worker_index=None):
+    from dlrover_tpu.common.node import NodeGroupResource
+
+    cluster = InMemoryCluster()
+    jm = JobManager(
+        scaler=InMemoryScaler(cluster),
+        watcher=InMemoryNodeWatcher(cluster),
+        heartbeat_timeout=30.0,
+        max_relaunch_count=max_relaunch_count,
+        node_groups={
+            NodeType.CHIEF: NodeGroupResource(1),
+            NodeType.WORKER: NodeGroupResource(2),
+            NodeType.EVALUATOR: NodeGroupResource(1),
+            NodeType.PS: NodeGroupResource(2),
+        },
+        critical_worker_index=critical_worker_index,
+    )
+    return jm, cluster
+
+
+def test_multi_role_groups_scheduled_with_criticality():
+    """chief/evaluator/ps groups are launched alongside workers and carry
+    the reference's criticality policy (training_node.py set_critical_node:
+    chief+evaluator always critical, PS per flag, workers per index)."""
+    jm, cluster = _role_manager(critical_worker_index={0: 1})
+    jm.start()
+    try:
+        assert _wait(
+            lambda: all(
+                sum(
+                    n.status == NodeStatus.RUNNING
+                    for n in jm.job_nodes.get(t, {}).values()
+                )
+                == c
+                for t, c in {
+                    NodeType.CHIEF: 1,
+                    NodeType.WORKER: 2,
+                    NodeType.EVALUATOR: 1,
+                    NodeType.PS: 2,
+                }.items()
+            )
+        ), jm.get_job_detail()
+        chief = next(iter(jm.job_nodes[NodeType.CHIEF].values()))
+        evaluator = next(iter(jm.job_nodes[NodeType.EVALUATOR].values()))
+        assert chief.critical and evaluator.critical
+        assert all(n.critical for n in jm.job_nodes[NodeType.PS].values())
+        workers = {
+            n.rank_index: n for n in jm.job_nodes[NodeType.WORKER].values()
+        }
+        assert workers[0].critical and workers[0].max_relaunch_count == 1
+        assert not workers[1].critical
+    finally:
+        jm.stop()
+
+
+def test_ps_query_and_training_completion_ignores_live_ps():
+    """query_ps_nodes reports the rank-ordered live PS set; the job
+    completes when chief+workers+evaluator exit even though PS stays up
+    (reference: dist_job_manager.py:655-662)."""
+    jm, cluster = _role_manager()
+    jm.start()
+    try:
+        assert _wait(
+            lambda: sum(
+                n.status == NodeStatus.RUNNING
+                for nodes in jm.job_nodes.values()
+                for n in nodes.values()
+            )
+            == 6
+        ), jm.get_job_detail()
+        metas, ready, failure = jm.query_ps_nodes()
+        assert ready and not failure
+        assert [m.node_rank for m in metas] == [0, 1]
+        assert all(m.node_type == NodeType.PS for m in metas)
+
+        assert not jm.all_workers_exited()
+        # every training-role node succeeds; PS nodes keep running
+        for t in (NodeType.CHIEF, NodeType.WORKER, NodeType.EVALUATOR):
+            for n in list(jm.job_nodes[t].values()):
+                jm.update_node_reported_status(t, n.rank_index, NodeStatus.SUCCEEDED)
+        assert _wait(jm.all_workers_exited), jm.get_job_detail()
+        assert not jm.job_failed()
+    finally:
+        jm.stop()
+
+
+def test_critical_ps_failure_beyond_budget_fails_job():
+    jm, cluster = _role_manager(max_relaunch_count=0)
+    jm.start()
+    try:
+        assert _wait(
+            lambda: sum(
+                n.status == NodeStatus.RUNNING
+                for n in jm.job_nodes.get(NodeType.PS, {}).values()
+            )
+            == 2
+        ), jm.get_job_detail()
+        victim = next(
+            name for name, n in cluster.nodes.items() if n.type == NodeType.PS
+        )
+        cluster.fail_node(victim)
+        assert _wait(jm.job_failed, timeout=5), jm.get_job_detail()
+        _, _, failure = jm.query_ps_nodes()
+        assert failure
+    finally:
+        jm.stop()
+
+
+def test_rendezvous_membership_excludes_evaluator_and_ps():
+    """Chief/evaluator/PS nodes never enter the SPMD comm world: the
+    rendezvous membership callback tracks workers only (ranks are
+    per-role, so other roles would alias worker ranks)."""
+    jm, cluster = _role_manager()
+    rdzv = ElasticTrainingRendezvousManager()
+    rdzv.update_rdzv_params(2, 2, 10, 1)
+    jm.add_node_event_callback(
+        RendezvousMembershipCallback({RendezvousName.ELASTIC_TRAINING: rdzv})
+    )
+    jm.start()
+    try:
+        assert _wait(
+            lambda: sum(
+                n.status == NodeStatus.RUNNING
+                for nodes in jm.job_nodes.values()
+                for n in nodes.values()
+            )
+            == 6
+        ), jm.get_job_detail()
+        # the 2 workers joined; chief + evaluator + 2 ps did not
+        assert len(rdzv._alive_nodes) == 2
+    finally:
+        jm.stop()
