@@ -28,11 +28,17 @@ class PsFailoverClient:
         self._client = client
         self._node_type = node_type
         self._node_id = node_id
+        # LOCAL is this worker's own adopted value — after the first read
+        # it is served from this cache, so the steady-state change check
+        # costs ONE master round-trip (the GLOBAL query), not two
+        self._local_cache: Optional[int] = None
 
     # -- version bookkeeping ---------------------------------------------
     def local_version(self) -> int:
-        return self._client.query_cluster_version(
-            PSClusterVersionType.LOCAL, self._node_type, self._node_id)
+        if self._local_cache is None:
+            self._local_cache = self._client.query_cluster_version(
+                PSClusterVersionType.LOCAL, self._node_type, self._node_id)
+        return self._local_cache
 
     def global_version(self) -> int:
         return self._client.query_cluster_version(
@@ -42,6 +48,7 @@ class PsFailoverClient:
         self._client.update_cluster_version(
             PSClusterVersionType.LOCAL, version, self._node_type,
             self._node_id)
+        self._local_cache = version
 
     # -- failover protocol -----------------------------------------------
     def ps_cluster_changed(self) -> bool:
@@ -62,9 +69,9 @@ class PsFailoverClient:
         """One failover round: if the cluster changed, wait for the new
         set to be ready, invoke ``on_reshard(nodes)`` (e.g. KvVariable
         retain_shard/import), then adopt the global version."""
-        if not self.ps_cluster_changed():
-            return False
         target = self.global_version()
+        if target <= self.local_version():
+            return False
         nodes, ready = self.resolve_ps_nodes()
         if not ready:
             return False

@@ -31,6 +31,7 @@ from dlrover_tpu.master.elastic_training.rdzv_manager import (
 from dlrover_tpu.master.elastic_training.sync_service import SyncService
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.node.event_callback import (
+    PSClusterVersionCallback,
     RendezvousMembershipCallback,
     TaskRescheduleCallback,
 )
@@ -100,6 +101,12 @@ class DistributedJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.elastic_ps_service = ElasticPsService()
+        if node_groups and "ps" in node_groups:
+            self.job_manager.add_node_event_callback(
+                PSClusterVersionCallback(
+                    self.elastic_ps_service, self.job_manager
+                )
+            )
         from dlrover_tpu.master.diagnosis.diagnosis import DiagnosisManager
 
         self.diagnosis_manager = DiagnosisManager(

@@ -80,3 +80,66 @@ class RendezvousMembershipCallback(NodeEventCallback):
             mgr.remove_alive_node(node.rank_index)
 
 
+class PSClusterVersionCallback(NodeEventCallback):
+    """Bump the elastic-PS GLOBAL cluster version whenever PS membership
+    changes, so workers' failover clients re-resolve the PS set
+    (reference: event_callback.py:182-192 TFPSNodeHandlingCallback
+    ``on_node_failed`` -> ``inc_global_cluster_version``; scale-ups bump
+    when the new PS reaches RUNNING)."""
+
+    def __init__(self, elastic_ps_service, job_manager):
+        self._svc = elastic_ps_service
+        self._jm = job_manager
+        # versions only move once the initial cluster has fully formed —
+        # workers adopt version 0 at startup and must not see churn from
+        # the initial creation sequence
+        self._ever_ready = False
+        # a single loss produces both a FAILED and a DELETED event for
+        # the same node; bumping twice would trigger a redundant reshard
+        # round on every worker (and snapshot-restore reshard callbacks
+        # would roll back survivor updates)
+        self._bumped_losses: set = set()
+
+    def on_node_started(self, node: Node) -> None:
+        if node.type != "ps":
+            return
+        target = self._jm.node_group_target("ps")
+        if not self._ever_ready:
+            # a master restart adopts running PS nodes without firing
+            # started events: a cluster containing adopted nodes, or one
+            # already complete BEFORE this node joined, pre-dates this
+            # master — this join is a scale-up, not initial formation
+            others = [
+                n for n in self._jm.running_nodes("ps") if n.id != node.id
+            ]
+            pre_existing = any(
+                getattr(n, "adopted_at_start", False) for n in others
+            )
+            if not pre_existing and len(others) < target:
+                _, ready, _ = self._jm.query_ps_nodes()
+                if ready:
+                    self._ever_ready = True
+                return
+            self._ever_ready = True
+        version = self._svc.inc_global_cluster_version()
+        logger.info(
+            "PS %s joined; cluster version -> %s", node.name, version
+        )
+
+    def on_node_failed(self, node: Node) -> None:
+        self._bump_on_loss(node)
+
+    def on_node_deleted(self, node: Node) -> None:
+        self._bump_on_loss(node)
+
+    def _bump_on_loss(self, node: Node) -> None:
+        if node.type != "ps":
+            return
+        if node.id in self._bumped_losses:
+            return
+        self._bumped_losses.add(node.id)
+        self._ever_ready = True  # a PS died => the cluster had formed
+        version = self._svc.inc_global_cluster_version()
+        logger.info(
+            "PS %s lost; cluster version -> %s", node.name, version
+        )

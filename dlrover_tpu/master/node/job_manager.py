@@ -70,6 +70,18 @@ class JobManager:
                     worker_num, self._worker_resource
                 )
             }
+        else:
+            worker_group = node_groups.get(NodeType.WORKER)
+            if (
+                worker_group is not None
+                and worker_resource is not None
+                and not worker_group.node_resource.cpu
+                and not worker_group.node_resource.memory
+                and not worker_group.node_resource.tpu_chips
+            ):
+                # an explicit worker_resource fills a resource-less group
+                # spec instead of being silently dropped
+                worker_group.node_resource = self._worker_resource
         self._node_groups = node_groups
         self._critical_worker_index = critical_worker_index or {}
         self._ps_is_critical = ps_is_critical
@@ -95,9 +107,12 @@ class JobManager:
     def start(self) -> None:
         self._scaler.start()
         # adopt nodes that already exist (master restart case); re-stamp
-        # role policy — watcher-built nodes default to critical=False
+        # role policy — watcher-built nodes default to critical=False.
+        # adopted_at_start lets consumers (PSClusterVersionCallback) tell
+        # a pre-existing cluster from initial formation.
         for node in self._watcher.list():
             self._apply_role_policy(node)
+            node.adopted_at_start = True
             self.job_nodes.setdefault(node.type, {})[node.id] = node
         missing = {
             node_type: group
@@ -220,7 +235,11 @@ class JobManager:
                 "Not relaunching %s (relaunch_count=%s, reason=%s)",
                 node.name, node.relaunch_count, node.exit_reason,
             )
-            self._relaunch_budget_exhausted.append(node.name)
+            # only nodes whose loss dooms the job count against it — a
+            # non-critical PS that ran out of budget is downgraded to a
+            # shrunken PS set, not a job failure
+            if node.type in self.TRAINING_TYPES or node.critical:
+                self._relaunch_budget_exhausted.append(node.name)
             return
         node.is_released = True
         with self._lock:
@@ -389,6 +408,19 @@ class JobManager:
 
     def get_paral_config(self, node_id: int):
         return getattr(self, "_paral_config", None)
+
+    def node_group_target(self, node_type: str) -> int:
+        """Configured replica count of a role group (0 if absent)."""
+        group = self._node_groups.get(node_type)
+        return group.count if group else 0
+
+    def running_nodes(self, node_type: str) -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self.job_nodes.get(node_type, {}).values()
+                if n.status == NodeStatus.RUNNING
+            ]
 
     def query_ps_nodes(self):
         """PS cluster view for the TF/estimator failover client: live PS

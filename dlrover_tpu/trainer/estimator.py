@@ -151,6 +151,40 @@ class CheckpointHook(SessionHook):
         self._ckpt.close()
 
 
+class PsFailoverHook(SessionHook):
+    """The ``TensorflowFailover`` counterpart (reference:
+    dlrover/trainer/tensorflow/failover/tensorflow_failover.py:33): watch
+    the master's PS cluster version between steps; on a bump, rebuild the
+    sparse state against the new PS set before the next step runs.
+
+    Where TF rebuilds a session from a new ClusterSpec, the TPU-native
+    estimator has no session — the jitted step is stateless and the only
+    cluster-shaped state is the KvVariable shard layout, so "rebuild"
+    means invoking ``on_reshard(new_ps_nodes)`` (export/``retain_shard``/
+    import or snapshot restore) and adopting the new version.
+    """
+
+    def __init__(self, failover_client, on_reshard=None,
+                 every_n_steps: int = 1):
+        """``every_n_steps`` throttles the master GLOBAL-version poll (one
+        gRPC round-trip per check — the LOCAL side is cached client-side);
+        the reference polls from a daemon thread, so per-N-steps keeps the
+        same latency/QPS trade explicit and jit-loop friendly."""
+        self._client = failover_client
+        self._on_reshard = on_reshard
+        self._every = max(1, every_n_steps)
+        self.reshard_count = 0
+
+    def before_step(self, step: int) -> None:
+        if step % self._every:
+            return
+        try:
+            if self._client.sync_to_cluster(on_reshard=self._on_reshard):
+                self.reshard_count += 1
+        except Exception as e:  # master blip must not kill training
+            logger.warning("PS failover check failed: %s", e)
+
+
 class StopAtStepHook(SessionHook):
     """Stop training at an absolute step (tf.train.StopAtStepHook) —
     raises the executor's stop flag rather than an exception."""

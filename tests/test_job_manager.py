@@ -381,3 +381,80 @@ def test_rendezvous_membership_excludes_evaluator_and_ps():
         assert len(rdzv._alive_nodes) == 2
     finally:
         jm.stop()
+
+
+def test_noncritical_ps_budget_exhaustion_does_not_fail_job():
+    from dlrover_tpu.common.node import NodeGroupResource
+
+    cluster = InMemoryCluster()
+    jm = JobManager(
+        scaler=InMemoryScaler(cluster),
+        watcher=InMemoryNodeWatcher(cluster),
+        heartbeat_timeout=30.0,
+        max_relaunch_count=0,
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(1),
+            NodeType.PS: NodeGroupResource(1),
+        },
+        ps_is_critical=False,
+    )
+    jm.start()
+    try:
+        assert _wait(
+            lambda: sum(
+                n.status == NodeStatus.RUNNING
+                for n in jm.job_nodes.get(NodeType.PS, {}).values()
+            )
+            == 1
+        )
+        victim = next(
+            name for name, n in cluster.nodes.items() if n.type == NodeType.PS
+        )
+        cluster.fail_node(victim)
+        assert _wait(
+            lambda: any(
+                n.status == NodeStatus.FAILED
+                for n in jm.job_nodes[NodeType.PS].values()
+            )
+        )
+        # the operator said PS loss is survivable: the job must not die
+        assert not jm.job_failed()
+        assert not jm.any_worker_failed_fatally()
+        _, _, failure = jm.query_ps_nodes()
+        assert failure  # but the failover clients DO see the degradation
+    finally:
+        jm.stop()
+
+
+def test_ps_version_bumps_once_per_loss_and_on_scaleup_join():
+    """One PS loss emits FAILED then DELETED for the same node — the
+    version must bump once; a scale-up join after a master restart (nodes
+    adopted, no started events) must still bump."""
+    from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+    from dlrover_tpu.master.node.event_callback import PSClusterVersionCallback
+
+    jm, cluster = _role_manager()
+    svc = ElasticPsService()
+    cb = PSClusterVersionCallback(svc, jm)
+    jm.add_node_event_callback(cb)
+    jm.start()
+    try:
+        assert _wait(
+            lambda: len(jm.running_nodes(NodeType.PS)) == 2
+        )
+        node = jm.running_nodes(NodeType.PS)[0]
+        cb.on_node_failed(node)
+        cb.on_node_deleted(node)  # watcher reports the removal too
+        assert svc.get_global_cluster_version() == 1
+
+        # master-restart scale-up: adopted cluster, fresh callback
+        svc2 = ElasticPsService()
+        cb2 = PSClusterVersionCallback(svc2, jm)
+        for n in jm.running_nodes(NodeType.PS):
+            n.adopted_at_start = True
+        joiner = Node(NodeType.PS, 999, rank_index=2, status=NodeStatus.RUNNING)
+        jm.job_nodes[NodeType.PS][999] = joiner
+        cb2.on_node_started(joiner)
+        assert svc2.get_global_cluster_version() == 1
+    finally:
+        jm.stop()
