@@ -70,6 +70,12 @@ class PsFailoverClient:
         set to be ready, invoke ``on_reshard(nodes)`` (e.g. KvVariable
         retain_shard/import), then adopt the global version."""
         target = self.global_version()
+        if target < self.local_version():
+            # GLOBAL ran BACKWARDS: the master restarted and its
+            # in-memory version state reset — the cached LOCAL is stale;
+            # drop it and re-read the (also reset) server-side record so
+            # the next genuine bump is not suppressed
+            self._local_cache = None
         if target <= self.local_version():
             return False
         nodes, ready = self.resolve_ps_nodes()

@@ -84,8 +84,11 @@ class PSClusterVersionCallback(NodeEventCallback):
     """Bump the elastic-PS GLOBAL cluster version whenever PS membership
     changes, so workers' failover clients re-resolve the PS set
     (reference: event_callback.py:182-192 TFPSNodeHandlingCallback
-    ``on_node_failed`` -> ``inc_global_cluster_version``; scale-ups bump
-    when the new PS reaches RUNNING)."""
+    ``on_node_failed`` -> ``inc_global_cluster_version``).  Exactly one
+    bump per membership change: a loss bumps once (FAILED/DELETED
+    dedup, relaunch replacements don't re-bump), a genuine scale-up
+    bumps when the new PS reaches RUNNING, and losses during initial
+    formation don't bump at all (workers still hold version 0)."""
 
     def __init__(self, elastic_ps_service, job_manager):
         self._svc = elastic_ps_service
@@ -102,6 +105,13 @@ class PSClusterVersionCallback(NodeEventCallback):
 
     def on_node_started(self, node: Node) -> None:
         if node.type != "ps":
+            return
+        if node.relaunch_count > 0:
+            # a relaunch REPLACEMENT joining: its loss already bumped the
+            # version, and workers gate their reshard on query_ps_nodes
+            # readiness — a second bump here would double-reshard every
+            # worker (snapshot-restore callbacks would roll survivors
+            # back), the exact hazard _bumped_losses exists to prevent
             return
         target = self._jm.node_group_target("ps")
         if not self._ever_ready:
@@ -137,8 +147,17 @@ class PSClusterVersionCallback(NodeEventCallback):
             return
         if node.id in self._bumped_losses:
             return
+        if not self._ever_ready:
+            if getattr(node, "adopted_at_start", False):
+                # adopted from a pre-restart cluster: it had formed
+                self._ever_ready = True
+            else:
+                # loss DURING initial formation: workers still hold
+                # version 0 and must not reshard against a cluster that
+                # never existed — the formation probe will mark
+                # readiness once the (relaunched) set completes
+                return
         self._bumped_losses.add(node.id)
-        self._ever_ready = True  # a PS died => the cluster had formed
         version = self._svc.inc_global_cluster_version()
         logger.info(
             "PS %s lost; cluster version -> %s", node.name, version

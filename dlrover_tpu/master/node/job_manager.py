@@ -72,16 +72,25 @@ class JobManager:
             }
         else:
             worker_group = node_groups.get(NodeType.WORKER)
+            group_res = (
+                worker_group.node_resource if worker_group else None
+            )
             if (
-                worker_group is not None
+                group_res is not None
                 and worker_resource is not None
-                and not worker_group.node_resource.cpu
-                and not worker_group.node_resource.memory
-                and not worker_group.node_resource.tpu_chips
+                and not group_res.cpu
+                and not group_res.memory
+                and not group_res.tpu_chips
+                and not group_res.tpu_type
             ):
                 # an explicit worker_resource fills a resource-less group
-                # spec instead of being silently dropped
-                worker_group.node_resource = self._worker_resource
+                # spec instead of being silently dropped; copied so later
+                # group.update() calls can't mutate the caller's object
+                import dataclasses as _dc
+
+                worker_group.node_resource = _dc.replace(
+                    self._worker_resource
+                )
         self._node_groups = node_groups
         self._critical_worker_index = critical_worker_index or {}
         self._ps_is_critical = ps_is_critical
@@ -240,6 +249,20 @@ class JobManager:
             # shrunken PS set, not a job failure
             if node.type in self.TRAINING_TYPES or node.critical:
                 self._relaunch_budget_exhausted.append(node.name)
+            else:
+                # make the shrunken set adoptable: lower the group target
+                # so query_ps_nodes can report ready again, and release
+                # the abandoned node so the failure flag doesn't latch
+                with self._lock:
+                    group = self._node_groups.get(node.type)
+                    if group is not None and group.count > 0:
+                        group.count -= 1
+                node.is_released = True
+                logger.warning(
+                    "Abandoning non-critical %s; %s group target now %s",
+                    node.name, node.type,
+                    self.node_group_target(node.type),
+                )
             return
         node.is_released = True
         with self._lock:

@@ -420,8 +420,11 @@ def test_noncritical_ps_budget_exhaustion_does_not_fail_job():
         # the operator said PS loss is survivable: the job must not die
         assert not jm.job_failed()
         assert not jm.any_worker_failed_fatally()
-        _, _, failure = jm.query_ps_nodes()
-        assert failure  # but the failover clients DO see the degradation
+        # the shrunken set becomes adoptable: target lowered, abandoned
+        # node released, so failover clients can re-reach ready
+        assert jm.node_group_target(NodeType.PS) == 0
+        _, ready, failure = jm.query_ps_nodes()
+        assert ready and not failure
     finally:
         jm.stop()
 
@@ -456,5 +459,45 @@ def test_ps_version_bumps_once_per_loss_and_on_scaleup_join():
         jm.job_nodes[NodeType.PS][999] = joiner
         cb2.on_node_started(joiner)
         assert svc2.get_global_cluster_version() == 1
+    finally:
+        jm.stop()
+
+
+def test_ps_loss_during_initial_formation_does_not_bump():
+    """A PS dying before the cluster ever fully formed must not move the
+    version: workers still hold version 0 and a reshard round would
+    restore from a checkpoint that never existed."""
+    from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+    from dlrover_tpu.master.node.event_callback import PSClusterVersionCallback
+
+    jm, cluster = _role_manager()
+    svc = ElasticPsService()
+    cb = PSClusterVersionCallback(svc, jm)
+    ghost = Node(NodeType.PS, 1, rank_index=0, status=NodeStatus.FAILED)
+    cb.on_node_failed(ghost)
+    assert svc.get_global_cluster_version() == 0
+    jm.stop()
+
+
+def test_relaunch_replacement_join_does_not_double_bump():
+    from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+    from dlrover_tpu.master.node.event_callback import PSClusterVersionCallback
+
+    jm, cluster = _role_manager()
+    svc = ElasticPsService()
+    cb = PSClusterVersionCallback(svc, jm)
+    jm.add_node_event_callback(cb)
+    jm.start()
+    try:
+        assert _wait(lambda: len(jm.running_nodes(NodeType.PS)) == 2)
+        victim = next(
+            name for name, n in cluster.nodes.items() if n.type == NodeType.PS
+        )
+        cluster.fail_node(victim)
+        # loss bumps once; the replacement (relaunch_count=1) reaching
+        # RUNNING must NOT bump again
+        assert _wait(lambda: len(jm.running_nodes(NodeType.PS)) == 2)
+        time.sleep(0.2)  # let any (wrong) second bump land
+        assert svc.get_global_cluster_version() == 1
     finally:
         jm.stop()

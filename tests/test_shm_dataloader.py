@@ -116,3 +116,24 @@ def test_master_kv_store_cas_is_atomic_server_side(
     # value-match CAS
     assert store.compare_set("leader", b"w0", b"w2") == b"w2"
     assert store.compare_set("leader", b"w0", b"w3") == b"w2"
+
+
+def test_ps_failover_cache_survives_master_restart(local_master, master_client):
+    """The client-side LOCAL cache must not suppress bumps after a master
+    restart resets the in-memory version state (GLOBAL running backwards
+    invalidates the cache)."""
+    from dlrover_tpu.agent.ps_failover import PsFailoverClient
+
+    master, _ = local_master
+    fo = PsFailoverClient(master_client, node_type="worker", node_id=0)
+    master.elastic_ps_service.inc_global_cluster_version()
+    assert fo.sync_to_cluster()
+    assert fo.local_version() == 1
+    # "restart": same service object, state wiped
+    svc = master.elastic_ps_service
+    svc._global_version = 0
+    svc._node_versions.clear()
+    assert not fo.sync_to_cluster()  # nothing to adopt yet
+    svc.inc_global_cluster_version()  # first genuine post-restart bump
+    assert fo.sync_to_cluster()
+    assert fo.local_version() == 1
