@@ -90,6 +90,9 @@ class AsyncCheckpointSaver:
         self._thread: Optional[threading.Thread] = None
         self._persist_count = 0
         self._last_persisted_step = -1
+        # steps whose commit barrier already timed out (a dead peer's
+        # done-file will never appear); retried with a tiny budget
+        self._commit_timed_out_steps: set = set()
         # Serializes persists between the event loop and the agent's
         # failure-path save_shm_to_storage (monitor thread).
         self._persist_mutex = threading.Lock()
@@ -231,6 +234,15 @@ class AsyncCheckpointSaver:
             step = shm_step
         stage = self._stage_dir(step)
         self.storage.safe_makedirs(stage)
+        # record the WRITER world's total shard count: the commit barrier
+        # must expect this many done-files even if the world resizes
+        # between write and commit (an elastic shrink must not let an
+        # old-world stage with fewer done-files than its layout commit)
+        marker = os.path.join(
+            stage, f"world-{self.global_shard_num * self.local_shard_num}"
+        )
+        if not self.storage.exists(marker):
+            self.storage.write(b"", marker)
         shard_id = self.node_rank * self.local_shard_num + local_rank
         bin_path = os.path.join(stage, f"shard-{shard_id}.bin")
         meta_path = os.path.join(stage, f"shard-{shard_id}.meta")
@@ -258,11 +270,33 @@ class AsyncCheckpointSaver:
 
     def commit_checkpoint(self, step: int, timeout: float = 600.0) -> None:
         """Rename stage -> final once every global shard's done-file exists
-        (reference: ckpt_saver.py:860-920)."""
+        (reference: ckpt_saver.py:860-920).
+
+        A step whose commit already timed out once (a dead peer's
+        done-file will never appear) is retried with a ~2s budget: the
+        elastic restart path re-enters this for the same step on every
+        membership change, and re-paying the full wait each time staggers
+        the nodes' rendezvous joins past the admission window (measured:
+        the multislice regrow flapped exactly this way).
+        """
+        if step in self._commit_timed_out_steps:
+            timeout = min(timeout, 2.0)
         stage = self._stage_dir(step)
         final = self._final_dir(step)
         deadline = time.time() + timeout
         expected = self.global_shard_num * self.local_shard_num
+        try:
+            markers = [
+                f for f in self.storage.listdir(stage)
+                if f.startswith("world-")
+            ]
+            if markers:
+                # the stage's writer world overrides the saver's current
+                # world: a post-shrink commit of an old-world stage must
+                # still wait for ALL of that layout's shards
+                expected = max(int(m.split("-", 1)[1]) for m in markers)
+        except Exception:
+            pass
         while True:
             if self.storage.exists(final):
                 # Another host already renamed stage -> final; the commit
@@ -282,6 +316,7 @@ class AsyncCheckpointSaver:
                     "commit of step %s timed out: %s/%s shards done",
                     step, len(done), expected,
                 )
+                self._commit_timed_out_steps.add(step)
                 return
             time.sleep(0.5)
         # host 0 performs the rename + tracker update

@@ -272,7 +272,8 @@ class ElasticAgent:
         self._saver_factory = SaverFactory()
         self._saver_factory.start()
 
-    def _save_shm_checkpoint(self, commit_async: bool = False) -> None:
+    def _save_shm_checkpoint(self, commit_async: bool = False,
+                             commit_timeout: float = 30.0) -> None:
         """Persist any in-memory checkpoint before a restart/exit wipes the
         workers (reference: training.py:662-672).
 
@@ -291,7 +292,8 @@ class ElasticAgent:
         if saver is None:
             return
         try:
-            saver.save_shm_to_storage(commit_async=commit_async)
+            saver.save_shm_to_storage(
+                commit_async=commit_async, commit_timeout=commit_timeout)
         except Exception:
             logger.exception("persisting shm checkpoint failed")
 
@@ -388,8 +390,17 @@ class ElasticAgent:
             # the regrown world's restore-step consensus finds the
             # committed storage step (a replacement host has no shm).
             # Must run AFTER group.stop(): the shm lock reclaim inside
-            # the save is only sound with no worker alive.
-            self._save_shm_checkpoint(commit_async=False)
+            # the save is only sound with no worker alive.  The wait is
+            # BOUNDED SHORT: if the step being committed still carries a
+            # dead peer's shard, its done-file never appears, and a long
+            # stall here staggers this node's rendezvous join past the
+            # admission window (measured: the multislice regrow flapped
+            # between 2- and 4-worlds exactly this way).  The regrown
+            # world's restore does not depend on this commit — survivor
+            # shm covers it via the GSPMD resharding restore; storage is
+            # the fallback tier only.
+            self._save_shm_checkpoint(commit_async=False,
+                                      commit_timeout=8.0)
         self._group.restart_count += 1
         rdzv = self._initialize_workers()
         # EVERY restart (failure, hang, rescale) re-enters restore +

@@ -106,19 +106,15 @@ class PSClusterVersionCallback(NodeEventCallback):
     def on_node_started(self, node: Node) -> None:
         if node.type != "ps":
             return
-        if node.relaunch_count > 0:
-            # a relaunch REPLACEMENT joining: its loss already bumped the
-            # version, and workers gate their reshard on query_ps_nodes
-            # readiness — a second bump here would double-reshard every
-            # worker (snapshot-restore callbacks would roll survivors
-            # back), the exact hazard _bumped_losses exists to prevent
-            return
         target = self._jm.node_group_target("ps")
         if not self._ever_ready:
             # a master restart adopts running PS nodes without firing
             # started events: a cluster containing adopted nodes, or one
             # already complete BEFORE this node joined, pre-dates this
-            # master — this join is a scale-up, not initial formation
+            # master — this join is a scale-up, not initial formation.
+            # The formation probe runs for EVERY started PS (including a
+            # relaunched replacement finishing the formation) so a later
+            # genuine loss can bump.
             others = [
                 n for n in self._jm.running_nodes("ps") if n.id != node.id
             ]
@@ -131,6 +127,14 @@ class PSClusterVersionCallback(NodeEventCallback):
                     self._ever_ready = True
                 return
             self._ever_ready = True
+        if node.relaunch_count > 0:
+            # a relaunch REPLACEMENT joining a FORMED cluster: its loss
+            # already bumped the version, and workers gate their reshard
+            # on query_ps_nodes readiness — a second bump here would
+            # double-reshard every worker (snapshot-restore callbacks
+            # would roll survivors back), the exact hazard
+            # _bumped_losses exists to prevent
+            return
         version = self._svc.inc_global_cluster_version()
         logger.info(
             "PS %s joined; cluster version -> %s", node.name, version

@@ -16,6 +16,7 @@ Counterpart of the reference's ``DistributedJobManager``
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional
@@ -86,9 +87,7 @@ class JobManager:
                 # an explicit worker_resource fills a resource-less group
                 # spec instead of being silently dropped; copied so later
                 # group.update() calls can't mutate the caller's object
-                import dataclasses as _dc
-
-                worker_group.node_resource = _dc.replace(
+                worker_group.node_resource = dataclasses.replace(
                     self._worker_resource
                 )
         self._node_groups = node_groups

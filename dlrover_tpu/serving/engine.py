@@ -53,6 +53,7 @@ class EngineStats:
     generated_tokens: int = 0
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
+    prefill_calls: int = 0        # dispatches; < admissions when batched
     finished_requests: int = 0
 
     @property
@@ -153,21 +154,23 @@ class InferenceEngine:
             return out.T, tokens, positions, cache, rng
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def insert_fn(params, cache, tokens, real_len, slot, rng):
+        def insert_fn(params, cache, tokens, real_len, slots, rng):
+            """Prefill a GROUP of same-bucket prompts ([G, Lp]) and
+            scatter their K/V into cache slots ``slots`` [G] in one
+            dispatch (jit caches one program per (G, bucket) pair)."""
+            lp = tokens.shape[1]
             logits, ks, vs = prefill(params, cfg, tokens, real_len)
             new_k = [
-                jax.lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (slot, 0, 0, 0))
+                ck.at[slots, :lp].set(k.astype(ck.dtype))
                 for ck, k in zip(cache["k"], ks)
             ]
             new_v = [
-                jax.lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (slot, 0, 0, 0))
+                cv.at[slots, :lp].set(v.astype(cv.dtype))
                 for cv, v in zip(cache["v"], vs)
             ]
             rng, sub = jax.random.split(rng)
             first = select_token(logits, sub, temperature, top_k, top_p)
-            return {"k": new_k, "v": new_v}, first[0], rng
+            return {"k": new_k, "v": new_v}, first, rng
 
         self._chunk_fn = chunk_fn
         self._insert_fn = insert_fn
@@ -187,28 +190,51 @@ class InferenceEngine:
         return rid
 
     def _admit(self) -> None:
-        for s in range(self.max_slots):
-            if self._slot_req[s] is not None or not self._queue:
-                continue
-            req = self._queue.popleft()
-            p = req.prompt.size
-            bucket = _bucket(p, self.buckets)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p] = req.prompt
+        """Admit waiting requests into free slots.  Consecutive queue
+        entries whose prompts land in the SAME length bucket prefill as
+        one batched dispatch — at G admissions per dispatch this cuts
+        the prefill launch count up to G-fold (the vLLM-style batched
+        prefill; on this rig dispatch latency dominates prefill, so the
+        cut is a direct wall-clock win)."""
+        while self._queue:
+            free = [
+                s for s in range(self.max_slots)
+                if self._slot_req[s] is None
+            ]
+            if not free:
+                return
+            bucket = _bucket(self._queue[0].prompt.size, self.buckets)
+            group: List[Request] = []
+            while (
+                self._queue
+                and len(group) < len(free)
+                and _bucket(self._queue[0].prompt.size, self.buckets)
+                == bucket
+            ):
+                group.append(self._queue.popleft())
+            slots = free[: len(group)]
+            padded = np.zeros((len(group), bucket), np.int32)
+            lens = np.empty(len(group), np.int32)
+            for g, req in enumerate(group):
+                padded[g, : req.prompt.size] = req.prompt
+                lens[g] = req.prompt.size
             t0 = time.perf_counter()
-            self._cache, first, self._rng = self._insert_fn(
+            self._cache, firsts, self._rng = self._insert_fn(
                 self.params, self._cache, jnp.asarray(padded),
-                jnp.int32(p), jnp.int32(s), self._rng,
+                jnp.asarray(lens), jnp.asarray(slots, jnp.int32),
+                self._rng,
             )
-            first = int(first)
+            firsts = np.asarray(firsts)
             self.stats.prefill_seconds += time.perf_counter() - t0
-            self._slot_req[s] = req
-            req.output.append(first)
-            self._tokens[s] = first
-            self._positions[s] = p
-            self._remaining[s] = req.max_new_tokens - 1
-            if self._finish_if_done(s, first):
-                continue
+            self.stats.prefill_calls += 1
+            for g, (s, req) in enumerate(zip(slots, group)):
+                first = int(firsts[g])
+                self._slot_req[s] = req
+                req.output.append(first)
+                self._tokens[s] = first
+                self._positions[s] = req.prompt.size
+                self._remaining[s] = req.max_new_tokens - 1
+                self._finish_if_done(s, first)
 
     def _finish_if_done(self, s: int, last_token: int) -> bool:
         req = self._slot_req[s]

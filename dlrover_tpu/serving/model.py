@@ -218,13 +218,17 @@ def _lm_head(params, x, cfg: LlamaConfig) -> jax.Array:
 def prefill(
     params: Dict[str, Any],
     cfg: LlamaConfig,
-    tokens: jax.Array,        # [1, Lp] right-padded prompt bucket
-    real_len: jax.Array,      # scalar: actual prompt length (<= Lp)
+    tokens: jax.Array,        # [G, Lp] right-padded prompt bucket(s)
+    real_len: jax.Array,      # [G] (or scalar) actual prompt lengths
 ) -> Tuple[jax.Array, list, list]:
-    """Causal pass over one prompt; returns (last_logits [1, V],
-    per-layer k list of [1, Lp, KV, D], v list) — the engine inserts
-    the K/V into a decode-cache slot.  Pad garbage beyond ``real_len``
-    is harmless: decode overwrites/masks it (module docstring)."""
+    """Causal pass over a GROUP of same-bucket prompts; returns
+    (last_logits [G, V], per-layer k list of [G, Lp, KV, D], v list) —
+    the engine scatters the K/V into decode-cache slots.  Rows are
+    independent (causal attention never crosses the batch dim), so a
+    group of G prompts costs one dispatch instead of G — the admission
+    path batches same-bucket arrivals through here.  Pad garbage beyond
+    ``real_len`` is harmless: decode overwrites/masks it (module
+    docstring)."""
     dtype = cfg.dtype
     d = cfg.head_dim_
     f = cfg.intermediate_size
@@ -251,8 +255,10 @@ def prefill(
         ks.append(k)
         vs.append(v)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    last = jax.lax.dynamic_slice_in_dim(
-        x, real_len.astype(jnp.int32) - 1, 1, axis=1)
+    last_idx = (jnp.atleast_1d(real_len).astype(jnp.int32) - 1)
+    last = jnp.take_along_axis(
+        x, last_idx[:, None, None].astype(jnp.int32), axis=1
+    )                                                     # [G, 1, E]
     logits = _lm_head(params, last.astype(dtype), cfg)[:, 0, :]
     return logits, ks, vs
 

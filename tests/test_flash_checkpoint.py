@@ -5,6 +5,7 @@ dlrover/trainer/tests/torch/checkpoint_egine_test.py)."""
 
 import os
 import sys
+import time
 import uuid
 
 import numpy as np
@@ -291,3 +292,37 @@ def test_assemble_region_partial_pieces():
     out = _assemble_region((), "float32",
                            [([], np.array(7.0, np.float32))], ())
     assert out.shape == () and float(out) == 7.0
+
+
+def test_commit_respects_writer_world_after_shrink(tmp_path):
+    """An old-world stage must NOT commit with fewer done-files than its
+    writer layout even after an elastic shrink resizes the saver: a
+    4-shard GSPMD checkpoint with 3 shards is a hole, not a checkpoint."""
+    saver = AsyncCheckpointSaver(
+        str(tmp_path / "ckpt"), local_shard_num=1, global_shard_num=1,
+        node_rank=0,
+    )
+    try:
+        stage = saver._stage_dir(7)
+        os.makedirs(stage)
+        # stage written by a 2-host world; only shard 0 completed
+        open(os.path.join(stage, "world-2"), "w").close()
+        open(os.path.join(stage, "shard-0.bin"), "w").close()
+        open(os.path.join(stage, "done-0"), "w").close()
+        saver.commit_checkpoint(7, timeout=1.0)
+        assert not os.path.exists(saver._final_dir(7))
+        assert 7 in saver._commit_timed_out_steps
+
+        # a retry after the timeout uses the tiny budget but still
+        # refuses to commit the incomplete layout
+        t0 = time.time()
+        saver.commit_checkpoint(7, timeout=600.0)
+        assert time.time() - t0 < 10
+        assert not os.path.exists(saver._final_dir(7))
+
+        # once the missing shard's done-file lands, the commit completes
+        open(os.path.join(stage, "done-1"), "w").close()
+        saver.commit_checkpoint(7, timeout=5.0)
+        assert os.path.exists(saver._final_dir(7))
+    finally:
+        saver.stop()

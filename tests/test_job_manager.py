@@ -501,3 +501,30 @@ def test_relaunch_replacement_join_does_not_double_bump():
         assert svc.get_global_cluster_version() == 1
     finally:
         jm.stop()
+
+
+def test_loss_after_replacement_completed_formation_still_bumps():
+    """A relaunched replacement that COMPLETES initial formation must
+    still mark the cluster as formed, so a later genuine loss bumps."""
+    from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
+    from dlrover_tpu.master.node.event_callback import PSClusterVersionCallback
+
+    jm, cluster = _role_manager()
+    svc = ElasticPsService()
+    cb = PSClusterVersionCallback(svc, jm)
+    jm.add_node_event_callback(cb)
+    jm.start()
+    try:
+        assert _wait(lambda: len(jm.running_nodes(NodeType.PS)) == 2)
+        # simulate: formation finished by a relaunched node (the live set
+        # is ready; the finishing event carries relaunch_count=1)
+        cb._ever_ready = False
+        finisher = jm.running_nodes(NodeType.PS)[1]
+        finisher.relaunch_count = 1
+        cb.on_node_started(finisher)
+        assert svc.get_global_cluster_version() == 0  # no formation bump
+        # a genuine loss afterwards must bump
+        cb.on_node_failed(jm.running_nodes(NodeType.PS)[0])
+        assert svc.get_global_cluster_version() == 1
+    finally:
+        jm.stop()

@@ -152,3 +152,22 @@ def test_generate_api_shapes(setup):
     assert toks.shape == (3, 13) and mask.shape == (3, 13)
     assert (toks[:, :8] == np.asarray(ids)).all()
     assert (toks >= 0).all() and (toks < cfg.vocab_size).all()
+
+
+def test_same_bucket_burst_prefills_in_one_dispatch(setup):
+    """A burst of same-bucket prompts admitted together must prefill as
+    ONE batched dispatch (vLLM-style batched prefill), and outputs must
+    still match single-request runs."""
+    cfg, _, variables, _ = setup
+    eng = InferenceEngine(cfg, variables, max_slots=4, chunk=4,
+                          temperature=0.0)
+    lengths = (5, 6, 5, 6)  # all land in the same bucket
+    rids = [eng.add_request(np.arange(2, n + 2), 6) for n in lengths]
+    outs = eng.run()
+    assert eng.stats.finished_requests == 4
+    assert eng.stats.prefill_calls == 1, eng.stats
+    for n, rid in zip(lengths, rids):
+        solo = InferenceEngine(cfg, variables, max_slots=1, chunk=4,
+                               temperature=0.0)
+        srid = solo.add_request(np.arange(2, n + 2), 6)
+        assert np.array_equal(solo.run()[srid], outs[rid]), n

@@ -201,8 +201,11 @@ def test_sft_example_masked_loss_learns():
         assert e.code == 0
     finally:
         sys.argv = argv
-    line = [l for l in buf.getvalue().splitlines() if "[sft]" in l][-1]
-    # "[sft] loss A -> B over N steps ..."
+    line = [
+        l for l in buf.getvalue().splitlines()
+        if "[sft:" in l and "loss" in l
+    ][-1]
+    # "[sft:full] loss A -> B over N steps ..."
     parts = line.split()
     first, last = float(parts[2]), float(parts[4])
     assert last < first * 0.6, line
