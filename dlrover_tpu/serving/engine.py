@@ -55,6 +55,9 @@ class EngineStats:
     prefill_seconds: float = 0.0
     prefill_calls: int = 0        # dispatches; < admissions when batched
     finished_requests: int = 0
+    spec_proposed: int = 0        # draft tokens sent to verification
+    spec_accepted: int = 0        # draft tokens accepted (greedy match)
+    spec_calls: int = 0           # verify dispatches (model forwards)
 
     @property
     def decode_tokens_per_sec(self) -> float:
@@ -87,11 +90,29 @@ class InferenceEngine:
         eos_token: Optional[int] = None,
         max_len: Optional[int] = None,
         prefill_buckets: Optional[Tuple[int, ...]] = None,
+        speculative_k: int = 0,
         seed: int = 0,
     ):
+        """``speculative_k > 1`` enables prompt-lookup speculative
+        decoding (greedy only): each dispatch verifies up to
+        ``speculative_k - 1`` draft tokens found by n-gram lookup in the
+        slot's own context, committing up to ``speculative_k`` tokens
+        for ~one decode step's cost (serving/speculative.py)."""
         self.cfg = cfg
         self.int8 = int8
         self.chunk = int(chunk)
+        self.speculative_k = int(speculative_k)
+        if self.speculative_k == 1:
+            raise ValueError(
+                "speculative_k=1 is a no-op (1 token per dispatch with "
+                "no drafts); use 0 to disable or >= 2 to speculate"
+            )
+        if self.speculative_k > 1 and temperature != 0.0:
+            raise ValueError(
+                "speculative decoding requires greedy sampling "
+                "(temperature=0): greedy verification is what keeps the "
+                "output distribution exact"
+            )
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -108,7 +129,12 @@ class InferenceEngine:
         self.buckets = tuple(sorted(prefill_buckets))
         self.max_slots = int(max_slots)
         self.params = serving_params_from_llama(variables, cfg, int8=int8)
-        kvd = (self.max_slots, self.max_len,
+        # speculative slack: a verify near the end of a sequence writes
+        # up to K-1 entries past its last real position; without the
+        # extra rows dynamic_update_slice would CLAMP the start and
+        # silently overwrite earlier (live) cache entries
+        cache_len = self.max_len + max(0, self.speculative_k)
+        kvd = (self.max_slots, cache_len,
                cfg.num_kv_heads, cfg.head_dim_)
         # per-layer buffers (a pytree of lists): donated in place by the
         # decode chunk, no stacked-cache copies
@@ -174,6 +200,19 @@ class InferenceEngine:
 
         self._chunk_fn = chunk_fn
         self._insert_fn = insert_fn
+
+        self._spec_fn = None
+        if self.speculative_k > 1:
+            from dlrover_tpu.serving.model import verify_step
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def spec_fn(params, cache, tokens, positions):
+                logits, cache = verify_step(
+                    params, cfg, cache, tokens, positions)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, cache
+
+            self._spec_fn = spec_fn
 
     # ------------------------------------------------------- requests
     def add_request(self, prompt_ids, max_new_tokens: int) -> int:
@@ -255,11 +294,14 @@ class InferenceEngine:
             r is not None for r in self._slot_req)
 
     def step(self) -> List[Request]:
-        """Admit waiting requests, run one decode chunk, return requests
-        finished during this step."""
+        """Admit waiting requests, run one decode chunk (or speculative
+        verify), return requests finished during this step."""
         before = len(self._finished)
         self._admit()
         active = np.array([r is not None for r in self._slot_req])
+        if active.any() and self._spec_fn is not None:
+            self._spec_step()
+            return self._finished[before:]
         if active.any():
             t0 = time.perf_counter()
             out, tokens, positions, self._cache, self._rng = \
@@ -287,10 +329,64 @@ class InferenceEngine:
                 self._finish_if_done(s, toks[-1] if toks else -1)
         return self._finished[before:]
 
+    def _spec_step(self) -> None:
+        """One speculative round: draft K-1 tokens per slot by prompt
+        lookup, verify all slots in ONE dispatch, commit the longest
+        greedy-matching prefix + 1 bonus token per slot."""
+        from dlrover_tpu.serving.speculative import find_draft
+
+        k = self.speculative_k
+        tokens = np.zeros((self.max_slots, k), np.int32)
+        tokens[:, 0] = self._tokens
+        draft_lens = np.zeros(self.max_slots, np.int32)
+        for s, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            context = np.concatenate(
+                [req.prompt, np.asarray(req.output, np.int32)])
+            draft = find_draft(context, k - 1)
+            if draft is not None:
+                tokens[s, 1:1 + draft.size] = draft
+                draft_lens[s] = draft.size
+        t0 = time.perf_counter()
+        nxt, self._cache = self._spec_fn(
+            self.params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(self._positions),
+        )
+        nxt = np.asarray(nxt)
+        self.stats.decode_seconds += time.perf_counter() - t0
+        self.stats.spec_calls += 1
+        for s in range(self.max_slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            accepted = 0
+            while (accepted < draft_lens[s]
+                   and nxt[s, accepted] == tokens[s, accepted + 1]):
+                accepted += 1
+            self.stats.spec_proposed += int(draft_lens[s])
+            self.stats.spec_accepted += accepted
+            toks = nxt[s, : accepted + 1].tolist()
+            take = min(len(toks), int(self._remaining[s]))
+            toks = toks[:take]
+            if self.eos_token is not None and self.eos_token in toks:
+                toks = toks[: toks.index(self.eos_token) + 1]
+            if not toks:
+                continue
+            req.output.extend(toks)
+            self._remaining[s] -= len(toks)
+            self.stats.generated_tokens += len(toks)
+            self._tokens[s] = toks[-1]
+            self._positions[s] += len(toks)
+            self._finish_if_done(s, toks[-1])
+
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue; returns {request_id: generated tokens}."""
         while self.has_work:
-            if self.eos_token is None:
+            if self.eos_token is None and self._spec_fn is None:
+                # fixed-budget drain needs a KNOWN number of dispatches;
+                # speculative acceptance makes progress data-dependent,
+                # so spec mode always goes through step()
                 self._drain_fixed()
             else:
                 self.step()

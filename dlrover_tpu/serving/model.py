@@ -94,39 +94,6 @@ def _split_heads(x: jax.Array, n_heads: int, d: int) -> jax.Array:
     return x.reshape(b, t, n_heads, d)
 
 
-def _attn_decode(
-    q: jax.Array,            # [B, 1, H, D]
-    cache_k: jax.Array,      # [B, L, KV, D]
-    cache_v: jax.Array,
-    positions: jax.Array,    # [B] current position of each slot
-    n_rep: int,
-) -> jax.Array:
-    """GQA decode attention WITHOUT materializing the n_rep-expanded
-    cache (a ``jnp.repeat`` would stream 4x the cache bytes per step on
-    a 16:4 model — decode is bandwidth-bound, so that costs as much as
-    the weight reads).  q folds to [B, 1, KV, G, D] and both einsums
-    contract against the unexpanded cache; accumulation in f32 on the
-    MXU via preferred_element_type."""
-    b, qlen, h, d = q.shape
-    kv = cache_k.shape[2]
-    g = h // kv
-    qg = q.reshape(b, qlen, kv, g, d)
-    scores = jnp.einsum(
-        "bqkgd,blkd->bkgql", qg, cache_k,
-        preferred_element_type=jnp.float32,
-    ) / jnp.sqrt(float(d))
-    key_pos = jnp.arange(cache_k.shape[1])
-    mask = key_pos[None, :] <= positions[:, None]      # [B, L]
-    scores = jnp.where(
-        mask[:, None, None, None, :], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bkgql,blkd->bqkgd", probs.astype(cache_v.dtype), cache_v,
-        preferred_element_type=jnp.float32,
-    )
-    return out.reshape(b, qlen, h, d)
-
-
 def _write_cache(cache: jax.Array, kv: jax.Array,
                  positions: jax.Array) -> jax.Array:
     """Per-row scatter: cache[b, positions[b]] = kv[b, 0]."""
@@ -162,18 +129,55 @@ def decode_step(
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """One decode step for all slots; returns (logits [B, V], cache).
 
-    The layer loop is python-unrolled (static weight slices, per-layer
-    cache buffers donated in place) and qkv / gate+up run as single
-    fused matmuls — decode is launch/bandwidth-bound, so fewer, larger
-    kernels over unsliced weights is the win (module docstring).
+    Implemented as :func:`verify_step` with K=1 so the decode and
+    speculative-verify programs are identical by construction — a
+    change to one cannot silently break the other's greedy-match
+    invariant.  The layer loop stays python-unrolled and qkv / gate+up
+    run as single fused matmuls — decode is launch/bandwidth-bound, so
+    fewer, larger kernels over unsliced weights is the win (module
+    docstring).
+    """
+    logits, cache = verify_step(params, cfg, cache, tokens[:, None],
+                                positions)
+    return logits[:, 0, :], cache
+
+
+def verify_step(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    cache: Dict[str, Any],
+    tokens: jax.Array,             # [B, K]: last committed token + K-1 drafts
+    positions: jax.Array,          # [B] position of tokens[:, 0]
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Speculative VERIFY: process K tokens per slot in one dispatch and
+    return next-token logits at every position ([B, K, V], cache).
+
+    ``tokens[:, 0]`` is each slot's last committed token (what
+    ``decode_step`` would process) and ``tokens[:, 1:]`` are draft
+    continuations; ``logits[:, i]`` predicts the token AFTER
+    ``tokens[:, i]``, so the caller accepts the longest prefix where
+    ``argmax(logits[:, i]) == tokens[:, i+1]`` and takes one bonus token
+    from the first mismatch.  Decode is bandwidth-bound (weights stream
+    once regardless of K<=8 riding the matmul M-dim), so a verify step
+    costs ~one decode step while committing up to K tokens — the
+    speculative-decoding trade (beyond-reference capability; the
+    reference serves via vLLM, vllm_backend.py:11-24).
+
+    Cache safety on rejection: K entries are written at
+    ``positions..positions+K-1``; after accepting ``a`` drafts the
+    caller advances the position pointer by ``a+1`` only — entries past
+    it are invisible to the ``key <= pos`` mask and get overwritten
+    when the sequence actually reaches them.  No rewind needed.
     """
     dtype = cfg.dtype
     d = cfg.head_dim_
     n_rep = cfg.num_heads // cfg.num_kv_heads
     f = cfg.intermediate_size
-    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,E]
+    b, klen = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)            # [B, K, E]
+    pos_k = positions[:, None] + jnp.arange(klen)[None, :]   # [B, K]
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
-        positions][:, None, :]                                 # [B,1,d/2]
+        pos_k]                                               # [B, K, d/2]
 
     new_k, new_v = [], []
     for i in range(cfg.num_layers):
@@ -185,8 +189,8 @@ def decode_step(
         k = apply_rope(k, angles)
         ck = _write_cache(ck, k, positions)
         cv = _write_cache(cv, v, positions)
-        o = _attn_decode(q, ck, cv, positions, n_rep).astype(dtype)
-        o = o.reshape(o.shape[0], 1, cfg.num_heads * d)
+        o = _attn_verify(q, ck, cv, positions, n_rep).astype(dtype)
+        o = o.reshape(b, klen, cfg.num_heads * d)
         x = x + _mm(o, lp["wo"], dtype)
         h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
         gu = _mm(h, lp["wgu"], dtype)
@@ -196,8 +200,45 @@ def decode_step(
         new_v.append(cv)
 
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = _lm_head(params, x.astype(dtype), cfg)[:, 0, :]
+    logits = _lm_head(params, x.astype(dtype), cfg)           # [B, K, V]
     return logits, {"k": new_k, "v": new_v}
+
+
+def _attn_verify(
+    q: jax.Array,            # [B, K, H, D]
+    cache_k: jax.Array,      # [B, L, KV, D]
+    cache_v: jax.Array,
+    positions: jax.Array,    # [B] position of q[:, 0]
+    n_rep: int,
+) -> jax.Array:
+    """GQA attention for a K-token run against the cache WITHOUT
+    materializing the n_rep-expanded cache (a ``jnp.repeat`` would
+    stream 4x the cache bytes per step on a 16:4 model — decode is
+    bandwidth-bound, so that costs as much as the weight reads).
+    Query i may see keys at ``key_pos <= positions + i`` (causal within
+    the run, everything committed before it); K=1 is plain decode.
+    q folds to [B, K, KV, G, D] and both einsums contract against the
+    unexpanded cache; f32 accumulation on the MXU via
+    preferred_element_type."""
+    b, qlen, h, d = q.shape
+    kv = cache_k.shape[2]
+    g = h // kv
+    qg = q.reshape(b, qlen, kv, g, d)
+    scores = jnp.einsum(
+        "bqkgd,blkd->bkgql", qg, cache_k,
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(float(d))
+    key_pos = jnp.arange(cache_k.shape[1])
+    q_pos = positions[:, None] + jnp.arange(qlen)[None, :]   # [B, K]
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]       # [B, K, L]
+    scores = jnp.where(
+        mask[:, None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgql,blkd->bqkgd", probs.astype(cache_v.dtype), cache_v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, qlen, h, d)
 
 
 def _lm_head(params, x, cfg: LlamaConfig) -> jax.Array:

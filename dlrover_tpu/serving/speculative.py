@@ -1,0 +1,55 @@
+"""Prompt-lookup speculative drafts (model-free n-gram matching).
+
+The draft side of the engine's speculative decode mode: instead of a
+separate draft model, continuations are proposed by matching the tail
+n-gram of the context against its own history and copying what followed
+the previous occurrence — "prompt lookup decoding".  Free to compute,
+surprisingly effective on natural text (summaries, code, chat echo
+long spans of their context), and exactly zero-cost when it misses:
+the verify step degenerates to a normal decode step (1 token/dispatch).
+
+Greedy verification preserves the model's output distribution exactly
+(an accepted draft token IS the greedy token), so the engine restricts
+speculation to ``temperature == 0``.
+
+Beyond-reference capability: the reference delegates serving to vLLM
+(atorch/atorch/rl/inference_backend/vllm_backend.py:11-24).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def find_draft(
+    context: np.ndarray,
+    k: int,
+    ngram: int = 3,
+    min_ngram: int = 1,
+) -> Optional[np.ndarray]:
+    """Propose up to ``k`` draft tokens continuing ``context``.
+
+    Searches for the most recent earlier occurrence of the context's
+    tail ``ngram`` (backing off to shorter n-grams down to
+    ``min_ngram``) and returns a copy of the tokens that followed it.
+    Returns None when no match exists or the match has no continuation.
+    """
+    ctx = np.asarray(context).reshape(-1)
+    n = ctx.size
+    if n < min_ngram + 1 or k <= 0:
+        return None
+    for glen in range(min(ngram, n - 1), min_ngram - 1, -1):
+        tail = ctx[n - glen:]
+        # all window starts except the tail's own position, vectorized
+        windows = np.lib.stride_tricks.sliding_window_view(
+            ctx[: n - 1], glen
+        )
+        hits = np.nonzero((windows == tail).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1])  # most recent occurrence
+            # every window start satisfies start+glen <= n-1, so the
+            # continuation always has at least one token
+            return ctx[start + glen: start + glen + k].astype(np.int32)
+    return None

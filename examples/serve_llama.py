@@ -38,6 +38,8 @@ def main() -> None:
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--int8", action="store_true")
     p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--speculative", type=int, default=0,
+                   help="speculative_k (greedy only; forces temperature 0)")
     p.add_argument("--top-k", type=int, default=40)
     args = p.parse_args()
 
@@ -65,8 +67,9 @@ def main() -> None:
         cfg, variables,
         max_slots=args.slots,
         int8=args.int8,
-        temperature=args.temperature,
-        top_k=args.top_k,
+        temperature=0.0 if args.speculative else args.temperature,
+        top_k=0 if args.speculative else args.top_k,
+        speculative_k=args.speculative,
     )
     rng = np.random.RandomState(0)
     rids = [
@@ -87,6 +90,9 @@ def main() -> None:
     print(f"prefill: {stats.prefill_calls} dispatches "
           f"{stats.prefill_seconds:.2f}s; decode {stats.decode_seconds:.2f}s "
           f"({stats.decode_tokens_per_sec:.0f} tok/s device loop)")
+    if args.speculative:
+        print(f"speculative: accepted {stats.spec_accepted}/"
+              f"{stats.spec_proposed} drafts")
     print("first outputs:", {r: outputs[r][:8].tolist() for r in rids[:2]})
 
 
