@@ -41,7 +41,25 @@ def build_master_pod_spec(
     name = job["metadata"]["name"]
     spec = job.get("spec", {})
     image = spec.get("image", "dlrover-tpu:latest")
-    workers = spec.get("replicaSpecs", {}).get("worker", {})
+    replica_specs = spec.get("replicaSpecs", {})
+    workers = replica_specs.get("worker", {})
+    # multi-role jobs (chief/evaluator/ps alongside workers) ride the
+    # master's --node_groups spec (reference: ElasticJob replicaSpecs →
+    # per-role node groups, dist_job_manager.py:259-316)
+    known_roles = ("chief", "worker", "evaluator", "ps")
+    unknown = sorted(set(replica_specs) - set(known_roles))
+    if unknown:
+        # the CRD schema allows any key; forwarding an unknown role
+        # would crash-loop the master pod on parse_node_groups
+        logger.warning(
+            "ElasticJob %s: ignoring unknown replicaSpecs roles %s "
+            "(known: %s)", name, unknown, list(known_roles),
+        )
+    extra_roles = ",".join(
+        f"{role}:{int(rs.get('replicas', 0))}"
+        for role, rs in sorted(replica_specs.items())
+        if role in known_roles and rs.get("replicas", 0)
+    )
     res = spec.get("masterResource", {}) or {}
     limits = {
         "cpu": str(res.get("cpu", "2")),
@@ -72,7 +90,12 @@ def build_master_pod_spec(
                     "--port", str(DEFAULT_MASTER_PORT),
                     "--node_num", str(workers.get("replicas", 1)),
                     "--worker_image", image,
-                ],
+                ] + (
+                    ["--node_groups", extra_roles]
+                    if extra_roles
+                    and set(replica_specs) & set(known_roles) != {"worker"}
+                    else []
+                ),
                 "ports": [{"containerPort": DEFAULT_MASTER_PORT}],
                 "resources": {"limits": limits, "requests": dict(limits)},
             }],

@@ -102,10 +102,10 @@ class InferenceEngine:
         self.int8 = int8
         self.chunk = int(chunk)
         self.speculative_k = int(speculative_k)
-        if self.speculative_k == 1:
+        if self.speculative_k == 1 or self.speculative_k < 0:
             raise ValueError(
-                "speculative_k=1 is a no-op (1 token per dispatch with "
-                "no drafts); use 0 to disable or >= 2 to speculate"
+                f"speculative_k={self.speculative_k} is invalid: use 0 "
+                "to disable or >= 2 to speculate (1 would be a no-op)"
             )
         if self.speculative_k > 1 and temperature != 0.0:
             raise ValueError(
@@ -147,6 +147,11 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(seed)
         # host-side slot state
         self._slot_req: List[Optional[Request]] = [None] * self.max_slots
+        # per-slot incrementally-filled context (prompt + committed
+        # tokens) for the speculative draft lookup — rebuilding it from
+        # the output list every round would be O(n^2) per request
+        self._ctx_buf = np.zeros((self.max_slots, self.max_len), np.int32)
+        self._ctx_len = np.zeros(self.max_slots, np.int32)
         self._positions = np.zeros(self.max_slots, np.int32)
         self._tokens = np.zeros(self.max_slots, np.int32)
         self._remaining = np.zeros(self.max_slots, np.int32)
@@ -270,8 +275,12 @@ class InferenceEngine:
                 first = int(firsts[g])
                 self._slot_req[s] = req
                 req.output.append(first)
+                p = req.prompt.size
+                self._ctx_buf[s, :p] = req.prompt
+                self._ctx_buf[s, p] = first
+                self._ctx_len[s] = p + 1
                 self._tokens[s] = first
-                self._positions[s] = req.prompt.size
+                self._positions[s] = p
                 self._remaining[s] = req.max_new_tokens - 1
                 self._finish_if_done(s, first)
 
@@ -336,14 +345,15 @@ class InferenceEngine:
         from dlrover_tpu.serving.speculative import find_draft
 
         k = self.speculative_k
+        window = 2048  # bounded lookup tail: keeps the n-gram scan O(1)
         tokens = np.zeros((self.max_slots, k), np.int32)
         tokens[:, 0] = self._tokens
         draft_lens = np.zeros(self.max_slots, np.int32)
         for s, req in enumerate(self._slot_req):
             if req is None:
                 continue
-            context = np.concatenate(
-                [req.prompt, np.asarray(req.output, np.int32)])
+            n = int(self._ctx_len[s])
+            context = self._ctx_buf[s, max(0, n - window):n]
             draft = find_draft(context, k - 1)
             if draft is not None:
                 tokens[s, 1:1 + draft.size] = draft
@@ -374,6 +384,9 @@ class InferenceEngine:
             if not toks:
                 continue
             req.output.extend(toks)
+            n = int(self._ctx_len[s])
+            self._ctx_buf[s, n:n + len(toks)] = toks
+            self._ctx_len[s] = n + len(toks)
             self._remaining[s] -= len(toks)
             self.stats.generated_tokens += len(toks)
             self._tokens[s] = toks[-1]

@@ -96,7 +96,10 @@ def _split_heads(x: jax.Array, n_heads: int, d: int) -> jax.Array:
 
 def _write_cache(cache: jax.Array, kv: jax.Array,
                  positions: jax.Array) -> jax.Array:
-    """Per-row scatter: cache[b, positions[b]] = kv[b, 0]."""
+    """Per-row BLOCK scatter: writes kv[b]'s full K-token run at
+    cache[b, positions[b] : positions[b]+K] (dynamic_update_slice block
+    semantics — K=1 is the plain decode write; the speculative verify
+    and the engine's cache-slack sizing both rely on the K-row case)."""
     def one(c, x, p):
         return jax.lax.dynamic_update_slice(c, x, (p, 0, 0))
     return jax.vmap(one)(cache, kv, positions.astype(jnp.int32))

@@ -413,3 +413,39 @@ def test_operator_run_loop_with_fake_api():
         interval=0.01)
     assert "loop-master" in core.pods
     assert job["status"]["phase"] == "Pending"
+
+
+def test_master_pod_spec_forwards_multi_role_replicas():
+    """A CR with chief/evaluator/ps replicaSpecs produces a master pod
+    command carrying --node_groups (reference: replicaSpecs -> per-role
+    node groups); a workers-only CR stays on plain --node_num."""
+    from dlrover_tpu.master.args import parse_node_groups
+    from dlrover_tpu.operator.main import build_master_pod_spec
+
+    job = {
+        "metadata": {"name": "psjob", "uid": "u1"},
+        "spec": {
+            "image": "img",
+            "replicaSpecs": {
+                "worker": {"replicas": 2},
+                "chief": {"replicas": 1},
+                "evaluator": {"replicas": 1},
+                "ps": {"replicas": 2},
+            },
+        },
+    }
+    cmd = build_master_pod_spec(job, "ns")["spec"]["containers"][0]["command"]
+    assert "--node_groups" in cmd
+    spec = cmd[cmd.index("--node_groups") + 1]
+    groups = parse_node_groups(spec)  # must round-trip through the parser
+    assert {r: g.count for r, g in groups.items()} == {
+        "worker": 2, "chief": 1, "evaluator": 1, "ps": 2,
+    }
+    assert cmd[cmd.index("--node_num") + 1] == "2"
+
+    plain = {
+        "metadata": {"name": "j2", "uid": "u2"},
+        "spec": {"image": "img", "replicaSpecs": {"worker": {"replicas": 4}}},
+    }
+    cmd2 = build_master_pod_spec(plain, "ns")["spec"]["containers"][0]["command"]
+    assert "--node_groups" not in cmd2
