@@ -417,6 +417,106 @@ def params_from_hf_bert(sd: Mapping[str, Any], cfg) -> Dict:
     return params
 
 
+def config_from_hf_vit(hf_config: Any, **overrides):
+    """Map a ``transformers.ViTConfig`` to :class:`ViTConfig`."""
+    from dlrover_tpu.models.vit import ViTConfig
+
+    get = lambda k, d=None: getattr(hf_config, k, d)  # noqa: E731
+    act = get("hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(
+            f"hidden_act={act!r} unsupported (model uses exact gelu)"
+        )
+    if get("qkv_bias", True) is False:
+        raise ValueError(
+            "qkv_bias=False unsupported (the model's q/k/v projections "
+            "always carry biases); conversion would fail on missing "
+            "bias tensors"
+        )
+    kw: Dict[str, Any] = dict(
+        image_size=get("image_size", 224),
+        patch_size=get("patch_size", 16),
+        num_channels=get("num_channels", 3),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        intermediate_size=get("intermediate_size"),
+        layer_norm_eps=float(get("layer_norm_eps", 1e-12)),
+    )
+    kw.update(overrides)
+    return ViTConfig(**kw)
+
+
+def params_from_hf_vit(sd: Mapping[str, Any], cfg) -> Dict:
+    """Convert an HF ``ViTModel`` state_dict to the flax tree.
+
+    The patch conv kernel [H, C, P, P] reshapes straight into the dense
+    patch-projection kernel because :func:`models.vit.patchify` flattens
+    patches channel-major — the conv == linear identity."""
+    h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def ln(prefix):
+        return {
+            "scale": _np(sd[prefix + ".weight"]),
+            "bias": _np(sd[prefix + ".bias"]),
+        }
+
+    conv_w = _np(sd["embeddings.patch_embeddings.projection.weight"])
+    params: Dict[str, Any] = {
+        "patch_projection": {
+            # [H, C, P, P] -> [C*P*P, H]
+            "kernel": conv_w.reshape(h, -1).T,
+            "bias": _np(sd["embeddings.patch_embeddings.projection.bias"]),
+        },
+        "cls_token": _np(sd["embeddings.cls_token"]),
+        "position_embeddings": _np(sd["embeddings.position_embeddings"]),
+        "final_norm": ln("layernorm"),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"encoder.layer.{i}."
+
+        def wb(name, shape=None):
+            w = _np(sd[pre + name + ".weight"]).T
+            if shape is not None:
+                w = w.reshape(shape)
+            return w, _np(sd[pre + name + ".bias"])
+
+        qw, qb = wb("attention.attention.query", (h, nh, d))
+        kw_, kb = wb("attention.attention.key", (h, nh, d))
+        vw, vb = wb("attention.attention.value", (h, nh, d))
+        ow, ob = wb("attention.output.dense")
+        iw, ib = wb("intermediate.dense")
+        dw, db = wb("output.dense")
+        params[f"layer_{i}"] = {
+            "query": {"kernel": qw, "bias": qb.reshape(nh, d)},
+            "key": {"kernel": kw_, "bias": kb.reshape(nh, d)},
+            "value": {"kernel": vw, "bias": vb.reshape(nh, d)},
+            "attn_out": {"kernel": ow.reshape(nh, d, h), "bias": ob},
+            "norm_before": ln(pre + "layernorm_before"),
+            "intermediate": {"kernel": iw, "bias": ib},
+            "output": {"kernel": dw, "bias": db},
+            "norm_after": ln(pre + "layernorm_after"),
+        }
+    return params
+
+
+def load_hf_vit(model_or_path: Any, **config_overrides):
+    """One-call ViT import: transformers model/path -> (cfg, params)."""
+    if isinstance(model_or_path, str):
+        from transformers import ViTModel
+
+        model = ViTModel.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    cfg = config_from_hf_vit(model.config, **config_overrides)
+    sd = model.state_dict()
+    # a ViTForImageClassification state_dict prefixes the encoder "vit."
+    if any(k.startswith("vit.") for k in sd):
+        sd = {k[len("vit."):]: v for k, v in sd.items()
+              if k.startswith("vit.")}
+    return cfg, params_from_hf_vit(sd, cfg)
+
+
 def load_hf_bert(model_or_path: Any, **config_overrides):
     """One-call BERT import: transformers model/path -> (cfg, params)."""
     if isinstance(model_or_path, str):

@@ -1,0 +1,188 @@
+"""ViT-family vision encoder, TPU-native.
+
+Fourth model family — the vision modality of the reference's
+transformer fast-path lineup (reference accelerates HF CLIP/ViT-class
+encoders via its FlashAttention module swaps: atorch/atorch/modules/
+transformer/layers.py CLIP/MHA variants around :801-1447, applied by
+the module_replace optimization).  Shares the framework's attention
+dispatch, logical sharding rules (so ``accelerate()`` meshes apply
+unchanged), and HF checkpoint interop
+(:func:`dlrover_tpu.models.convert.load_hf_vit`, parity tested).
+
+TPU-first notes:
+- the patch "convolution" is a reshape-patchify + ONE dense matmul
+  ([B, N, C*P*P] @ [C*P*P, H]) — the standard ViT identity (stride-P
+  conv == linear over flattened patches) that lands the FLOPs on the
+  MXU as a single large GEMM instead of a conv window walk;
+- pre-LN blocks, bidirectional attention (no mask — every patch sees
+  every patch), exact gelu, CLS token + learned position embeddings,
+  final LayerNorm: HF ``ViTModel`` semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.parallel.mesh import with_logical_constraint
+from dlrover_tpu.models.gpt2 import LayerNorm
+from dlrover_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    num_classes: int = 0          # 0 = encoder only (ViTModel parity)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        base = dict(
+            image_size=32, patch_size=8, hidden_size=32, num_layers=2,
+            num_heads=4, intermediate_size=64,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, C, H, W] -> [B, N, C*P*P] with conv-weight-compatible
+    ordering (channel-major within a patch, row-major over patches) so
+    an HF conv kernel reshapes directly into the dense kernel."""
+    b, c, h, w = images.shape
+    nh, nw = h // patch, w // patch
+    x = images.reshape(b, c, nh, patch, nw, patch)
+    x = x.transpose(0, 2, 4, 1, 3, 5)          # [B, nH, nW, C, P, P]
+    return x.reshape(b, nh * nw, c * patch * patch)
+
+
+class ViTLayer(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+        init = nn.initializers.normal(0.02)
+        ln = lambda name: LayerNorm(  # noqa: E731
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype, name=name
+        )
+        dense = lambda feats, axis, axes, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=axis, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(init, axes), name=name,
+        )
+
+        # pre-LN attention block
+        a = ln("norm_before")(x)
+        q = dense((nh, d), -1, ("embed", "heads", "head_dim"), "query")(a)
+        k = dense((nh, d), -1, ("embed", "heads", "head_dim"), "key")(a)
+        v = dense((nh, d), -1, ("embed", "heads", "head_dim"), "value")(a)
+        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+        v = with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+        attn = dot_product_attention(q, k, v, causal=False)
+        attn = dense(
+            h, (-2, -1), ("heads", "head_dim", "embed"), "attn_out"
+        )(attn)
+        x = x + attn
+
+        # pre-LN MLP block
+        m = ln("norm_after")(x)
+        up = dense(cfg.intermediate_size, -1, ("embed", "mlp"),
+                   "intermediate")(m)
+        up = with_logical_constraint(up, ("batch", "seq", "mlp"))
+        up = nn.gelu(up, approximate=False)
+        down = dense(h, -1, ("mlp", "embed"), "output")(up)
+        x = x + down
+        return with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class ViTModel(nn.Module):
+    """ViT encoder: pixel values [B, C, H, W] -> hidden states
+    [B, 1+N, H] (CLS first), or class logits [B, num_classes] when the
+    config carries a classification head."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        pixel_values: jax.Array,
+        return_hidden: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        b = pixel_values.shape[0]
+        patches = patchify(
+            pixel_values.astype(cfg.dtype), cfg.patch_size
+        )
+        proj = nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed_tbl")
+            ),
+            name="patch_projection",
+        )
+        x = proj(patches)                                  # [B, N, H]
+        cls = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, None, "embed_tbl")
+            ),
+            (1, 1, cfg.hidden_size), cfg.param_dtype,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype),
+                              (b, 1, cfg.hidden_size)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "position_embeddings",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, None, "embed_tbl")
+            ),
+            (1, 1 + cfg.num_patches, cfg.hidden_size), cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+        for i in range(cfg.num_layers):
+            x = ViTLayer(cfg, name=f"layer_{i}")(x)
+        x = LayerNorm(
+            cfg.layer_norm_eps, cfg.dtype, cfg.param_dtype,
+            name="final_norm",
+        )(x)
+        if cfg.num_classes and not return_hidden:
+            head = nn.DenseGeneral(
+                cfg.num_classes, use_bias=True,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("embed", None)
+                ),
+                name="classifier",
+            )
+            return head(x[:, 0]).astype(jnp.float32)       # CLS pooling
+        return x.astype(jnp.float32)

@@ -55,6 +55,15 @@ def build_master_pod_spec(
             "ElasticJob %s: ignoring unknown replicaSpecs roles %s "
             "(known: %s)", name, unknown, list(known_roles),
         )
+    zeroed = sorted(
+        role for role, rs in replica_specs.items()
+        if role in known_roles and not rs.get("replicas", 0)
+    )
+    if zeroed:
+        logger.warning(
+            "ElasticJob %s: replicaSpecs roles %s have no replicas "
+            "and are dropped from the node groups", name, zeroed,
+        )
     extra_roles = ",".join(
         f"{role}:{int(rs.get('replicas', 0))}"
         for role, rs in sorted(replica_specs.items())

@@ -149,8 +149,11 @@ class InferenceEngine:
         self._slot_req: List[Optional[Request]] = [None] * self.max_slots
         # per-slot incrementally-filled context (prompt + committed
         # tokens) for the speculative draft lookup — rebuilding it from
-        # the output list every round would be O(n^2) per request
-        self._ctx_buf = np.zeros((self.max_slots, self.max_len), np.int32)
+        # the output list every round would be O(n^2) per request.
+        # +1 column: a full-length prompt with max_new_tokens=0 still
+        # receives its one prefill token at index max_len
+        self._ctx_buf = np.zeros(
+            (self.max_slots, self.max_len + 1), np.int32)
         self._ctx_len = np.zeros(self.max_slots, np.int32)
         self._positions = np.zeros(self.max_slots, np.int32)
         self._tokens = np.zeros(self.max_slots, np.int32)

@@ -218,3 +218,16 @@ def test_speculative_rejects_sampling():
                            jnp.zeros((1, 8), jnp.int32))
     with pytest.raises(ValueError):
         InferenceEngine(cfg, variables, temperature=0.7, speculative_k=4)
+
+
+def test_full_length_prompt_with_zero_new_tokens(setup):
+    """A prompt that fills max_len with max_new_tokens=0 must admit,
+    emit its single prefill token, and finish (regression: the
+    speculative context buffer write at index max_len)."""
+    cfg, _, variables, _ = setup
+    eng = InferenceEngine(cfg, variables, max_slots=1, chunk=4,
+                          temperature=0.0, speculative_k=4)
+    prompt = np.arange(1, cfg.max_seq_len + 1, dtype=np.int32)
+    rid = eng.add_request(prompt, 0)
+    out = eng.run()[rid]
+    assert out.size == 1
