@@ -501,7 +501,9 @@ def params_from_hf_vit(sd: Mapping[str, Any], cfg) -> Dict:
 
 
 def load_hf_vit(model_or_path: Any, **config_overrides):
-    """One-call ViT import: transformers model/path -> (cfg, params)."""
+    """One-call ViT import: transformers model/path -> (cfg, params).
+    A ``ViTForImageClassification`` source also carries its classifier
+    head across when the config requests ``num_classes``."""
     if isinstance(model_or_path, str):
         from transformers import ViTModel
 
@@ -509,12 +511,31 @@ def load_hf_vit(model_or_path: Any, **config_overrides):
     else:
         model = model_or_path
     cfg = config_from_hf_vit(model.config, **config_overrides)
-    sd = model.state_dict()
+    full_sd = model.state_dict()
+    sd = full_sd
     # a ViTForImageClassification state_dict prefixes the encoder "vit."
     if any(k.startswith("vit.") for k in sd):
         sd = {k[len("vit."):]: v for k, v in sd.items()
               if k.startswith("vit.")}
-    return cfg, params_from_hf_vit(sd, cfg)
+    params = params_from_hf_vit(sd, cfg)
+    if cfg.num_classes:
+        if "classifier.weight" not in full_sd:
+            raise ValueError(
+                f"num_classes={cfg.num_classes} requested but the source "
+                "model has no classifier head; convert from a "
+                "ViTForImageClassification or drop num_classes"
+            )
+        w = _np(full_sd["classifier.weight"])
+        if w.shape[0] != cfg.num_classes:
+            raise ValueError(
+                f"classifier head has {w.shape[0]} classes, config "
+                f"requested {cfg.num_classes}"
+            )
+        params["classifier"] = {
+            "kernel": w.T,
+            "bias": _np(full_sd["classifier.bias"]),
+        }
+    return cfg, params
 
 
 def load_hf_bert(model_or_path: Any, **config_overrides):

@@ -64,10 +64,13 @@ def build_master_pod_spec(
             "ElasticJob %s: replicaSpecs roles %s have no replicas "
             "and are dropped from the node groups", name, zeroed,
         )
-    extra_roles = ",".join(
-        f"{role}:{int(rs.get('replicas', 0))}"
-        for role, rs in sorted(replica_specs.items())
+    active_roles = {
+        role for role, rs in replica_specs.items()
         if role in known_roles and rs.get("replicas", 0)
+    }
+    extra_roles = ",".join(
+        f"{role}:{int(replica_specs[role]['replicas'])}"
+        for role in sorted(active_roles)
     )
     res = spec.get("masterResource", {}) or {}
     limits = {
@@ -101,8 +104,7 @@ def build_master_pod_spec(
                     "--worker_image", image,
                 ] + (
                     ["--node_groups", extra_roles]
-                    if extra_roles
-                    and set(replica_specs) & set(known_roles) != {"worker"}
+                    if extra_roles and active_roles != {"worker"}
                     else []
                 ),
                 "ports": [{"containerPort": DEFAULT_MASTER_PORT}],

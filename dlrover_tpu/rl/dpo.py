@@ -1,0 +1,166 @@
+"""Direct Preference Optimization (DPO) trainer.
+
+The reward-model-free preference stage: instead of training an RM
+(:class:`~dlrover_tpu.rl.reward.RewardModelTrainer`) and running PPO
+against it, DPO optimizes the policy directly on (chosen, rejected)
+pairs with the closed-form objective
+
+    L = -log sigmoid( beta * [ (log pi(yw|x) - log ref(yw|x))
+                             - (log pi(yl|x) - log ref(yl|x)) ] )
+
+(Rafailov et al. 2023).  Beyond-reference capability: the reference's
+alignment stack is PPO-only (atorch/atorch/rl/), but a user of its
+RLHF pipeline today expects the DPO alternative — same data format as
+the RM trainer (chosen/rejected token rows + response masks), so the
+two stages are drop-in interchangeable.
+
+TPU shape: one jitted step; policy forward runs chosen and rejected
+STACKED ([2B, T] — one big MXU batch instead of two half-size ones);
+the frozen reference forward sits under ``stop_gradient``.  Sequence
+log-probs are masked sums over RESPONSE tokens only (prompt positions
+contribute nothing, mirroring the SFT masking convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.rl.ppo_utils import logprobs_from_logits
+
+
+def sequence_logprobs(
+    logits: jax.Array,   # [B, T, V]
+    tokens: jax.Array,   # [B, T]
+    mask: jax.Array,     # [B, T] 1 = response token (loss positions)
+) -> jax.Array:
+    """Sum of log p(token) over masked positions — [B].
+
+    Labels are next-token: position t's logits predict token t+1, so
+    the mask is applied at the LABEL position (the token being scored).
+    """
+    lp = logprobs_from_logits(logits[:, :-1], tokens[:, 1:])   # [B, T-1]
+    m = mask[:, 1:].astype(jnp.float32)
+    return (lp * m).sum(axis=-1)
+
+
+def dpo_loss(
+    policy_chosen: jax.Array,
+    policy_rejected: jax.Array,
+    ref_chosen: jax.Array,
+    ref_rejected: jax.Array,
+    beta: float = 0.1,
+    label_smoothing: float = 0.0,
+):
+    """DPO objective with implicit-reward stats."""
+    chosen_reward = beta * (policy_chosen - ref_chosen)
+    rejected_reward = beta * (policy_rejected - ref_rejected)
+    margin = chosen_reward - rejected_reward
+    loss = (
+        -jax.nn.log_sigmoid(margin) * (1.0 - label_smoothing)
+        - jax.nn.log_sigmoid(-margin) * label_smoothing
+    ).mean()
+    stats = {
+        "accuracy": jnp.mean((margin > 0).astype(jnp.float32)),
+        "margin": jnp.mean(margin),
+        "chosen_reward": jnp.mean(chosen_reward),
+        "rejected_reward": jnp.mean(rejected_reward),
+    }
+    return loss, stats
+
+
+class DPOTrainer:
+    """Preference-tune a causal LM directly on chosen/rejected pairs.
+
+    ``batch`` layout matches :class:`RewardModelTrainer`:
+    ``chosen``/``rejected`` [B, T] int32 token rows (prompt + response,
+    right-padded) and ``chosen_mask``/``rejected_mask`` [B, T] with 1 on
+    response tokens.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        beta: float = 0.1,
+        label_smoothing: float = 0.0,
+        learning_rate: float = 1e-5,
+        max_grad_norm: float = 1.0,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.beta = float(beta)
+        self.label_smoothing = float(label_smoothing)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm),
+            optax.adamw(learning_rate, weight_decay=0.0),
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        self.params: Optional[Any] = None
+        self.ref_params: Optional[Any] = None
+        self.opt_state = None
+        self._jit_step = None
+
+    def init(
+        self,
+        seq_len: int,
+        params: Optional[Any] = None,
+        ref_params: Optional[Any] = None,
+    ) -> None:
+        """``ref_params`` defaults to a frozen copy of the starting
+        policy (the standard DPO reference: the SFT checkpoint)."""
+        probe = jnp.zeros((1, seq_len), jnp.int32)
+        if params is None:
+            self._rng, k = jax.random.split(self._rng)
+            params = self.model.init(k, probe)
+        self.params = params
+        self.ref_params = ref_params if ref_params is not None else params
+        self.opt_state = self.optimizer.init(params)
+        model_apply = self.model.apply
+        optimizer = self.optimizer
+        beta, smoothing = self.beta, self.label_smoothing
+
+        def pair_logprobs(p, batch):
+            n = batch["chosen"].shape[0]
+            tokens = jnp.concatenate(
+                [batch["chosen"], batch["rejected"]], axis=0
+            )
+            mask = jnp.concatenate(
+                [batch["chosen_mask"], batch["rejected_mask"]], axis=0
+            )
+            logits = model_apply(p, tokens)
+            lp = sequence_logprobs(logits, tokens, mask)
+            return lp[:n], lp[n:]
+
+        def step(params, ref_params, opt_state, batch):
+            ref_c, ref_r = jax.lax.stop_gradient(
+                pair_logprobs(ref_params, batch)
+            )
+
+            def loss_fn(p):
+                pol_c, pol_r = pair_logprobs(p, batch)
+                return dpo_loss(
+                    pol_c, pol_r, ref_c, ref_r,
+                    beta=beta, label_smoothing=smoothing,
+                )
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats["loss"] = loss
+            return params, opt_state, stats
+
+        self._jit_step = jax.jit(step)
+
+    def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        assert self.params is not None, "call init() first"
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._jit_step(
+            self.params, self.ref_params, self.opt_state, batch
+        )
+        return {k: float(v) for k, v in stats.items()}

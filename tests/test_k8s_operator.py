@@ -449,3 +449,22 @@ def test_master_pod_spec_forwards_multi_role_replicas():
     }
     cmd2 = build_master_pod_spec(plain, "ns")["spec"]["containers"][0]["command"]
     assert "--node_groups" not in cmd2
+
+
+def test_zero_replica_role_does_not_flip_node_groups_mode():
+    """A zeroed optional role (templated YAML) must leave a semantically
+    workers-only job on plain --node_num."""
+    from dlrover_tpu.operator.main import build_master_pod_spec
+
+    job = {
+        "metadata": {"name": "j3", "uid": "u3"},
+        "spec": {
+            "image": "img",
+            "replicaSpecs": {
+                "worker": {"replicas": 2},
+                "evaluator": {"replicas": 0},
+            },
+        },
+    }
+    cmd = build_master_pod_spec(job, "ns")["spec"]["containers"][0]["command"]
+    assert "--node_groups" not in cmd

@@ -128,3 +128,35 @@ def test_vit_shards_on_mesh():
         )
     assert out.shape == (4, 17, 32)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_load_hf_vit_carries_classifier_head():
+    import torch
+    from transformers import ViTConfig as HFViTConfig
+    from transformers import ViTForImageClassification
+
+    from dlrover_tpu.models.convert import load_hf_vit
+
+    hf_cfg = HFViTConfig(
+        image_size=32, patch_size=8, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, num_labels=5,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    hf = ViTForImageClassification(hf_cfg).eval()
+    cfg, params = load_hf_vit(hf, num_classes=5, dtype=jnp.float32)
+    rng = np.random.RandomState(3)
+    pixels = rng.randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(pixels)).logits.numpy()
+    got = np.asarray(
+        ViTModel(cfg).apply({"params": params}, jnp.asarray(pixels))
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+    # head requested but absent in the source -> loud error
+    from transformers import ViTModel as HFViTModel
+
+    bare = HFViTModel(hf_cfg)
+    with pytest.raises(ValueError, match="classifier"):
+        load_hf_vit(bare, num_classes=5)
