@@ -26,8 +26,11 @@ from dlrover_tpu.models.llama import LlamaConfig
 
 
 def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
-    """Map a ``transformers.LlamaConfig`` to :class:`LlamaConfig`."""
+    """Map a Llama-architecture ``transformers`` config (Llama, Mistral,
+    Qwen2 — all RMSNorm + SwiGLU + RoPE decoders) to
+    :class:`LlamaConfig`."""
     get = lambda k, d=None: getattr(hf_config, k, d)  # noqa: E731
+    model_type = get("model_type", "llama")
     # Refuse configs the flax model cannot represent — silent conversion
     # would break the logits-parity promise.
     scaling = get("rope_scaling")
@@ -36,12 +39,16 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
             f"rope_scaling={scaling!r} is not supported by LlamaModel's "
             "plain-theta RoPE; conversion would silently change numerics"
         )
-    if get("attention_bias", False) or get("mlp_bias", False):
+    if get("mlp_bias", False):
         raise ValueError(
-            "attention_bias/mlp_bias checkpoints are unsupported (the "
-            "flax projections are bias-free); bias tensors would be "
-            "silently dropped"
+            "mlp_bias checkpoints are unsupported (the flax MLP is "
+            "bias-free); bias tensors would be silently dropped"
         )
+    # Qwen2 attention always carries q/k/v biases (its config has no
+    # flag in this transformers version); Llama exposes attention_bias
+    attention_bias = bool(
+        get("attention_bias", False) or model_type == "qwen2"
+    )
     act = get("hidden_act", "silu")
     if act not in ("silu", "swish"):
         raise ValueError(
@@ -56,6 +63,15 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
             f"head_dim={explicit_head_dim} with num_heads*head_dim != "
             "hidden_size is unsupported"
         )
+    max_seq = get("max_position_embeddings", 4096)
+    window = get("sliding_window", None)
+    uses_window = window and (
+        model_type == "mistral" or get("use_sliding_window", False)
+    )
+    if uses_window and window < max_seq:
+        # within the window full causal attention is identical; beyond
+        # it the HF model masks — clamp instead of silently diverging
+        max_seq = int(window)
     kw: Dict[str, Any] = dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -63,10 +79,11 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         num_layers=get("num_hidden_layers"),
         num_heads=get("num_attention_heads"),
         num_kv_heads=get("num_key_value_heads", get("num_attention_heads")),
-        max_seq_len=get("max_position_embeddings", 4096),
+        max_seq_len=max_seq,
         rope_theta=float(get("rope_theta", 10000.0)),
         rms_norm_eps=float(get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(get("tie_word_embeddings", False)),
+        attention_bias=attention_bias,
     )
     kw.update(overrides)
     return LlamaConfig(**kw)
@@ -87,12 +104,18 @@ def _layer_params(sd: Mapping[str, Any], i: int, cfg: LlamaConfig) -> Dict:
     def w(name):
         return _np(sd[pre + name + ".weight"])
 
+    def proj(name, heads):
+        p = {"kernel": w(name).T.reshape(h, heads, d)}
+        if cfg.attention_bias:
+            p["bias"] = _np(sd[pre + name + ".bias"]).reshape(heads, d)
+        return p
+
     # torch Linear stores [out, in]; flax kernels are [in, ...out].
     return {
         "attn": {
-            "q_proj": {"kernel": w("self_attn.q_proj").T.reshape(h, nh, d)},
-            "k_proj": {"kernel": w("self_attn.k_proj").T.reshape(h, nkv, d)},
-            "v_proj": {"kernel": w("self_attn.v_proj").T.reshape(h, nkv, d)},
+            "q_proj": proj("self_attn.q_proj", nh),
+            "k_proj": proj("self_attn.k_proj", nkv),
+            "v_proj": proj("self_attn.v_proj", nkv),
             "o_proj": {"kernel": w("self_attn.o_proj").T.reshape(nh, d, h)},
         },
         "mlp": {
@@ -190,6 +213,13 @@ def params_to_hf(params: Mapping[str, Any], cfg: LlamaConfig) -> Dict[str, np.nd
             _np(a["v_proj"]["kernel"]).reshape(h, nkv * d).T)
         sd[pre + "self_attn.o_proj.weight"] = (
             _np(a["o_proj"]["kernel"]).reshape(nh * d, h).T)
+        if cfg.attention_bias:
+            sd[pre + "self_attn.q_proj.bias"] = (
+                _np(a["q_proj"]["bias"]).reshape(nh * d))
+            sd[pre + "self_attn.k_proj.bias"] = (
+                _np(a["k_proj"]["bias"]).reshape(nkv * d))
+            sd[pre + "self_attn.v_proj.bias"] = (
+                _np(a["v_proj"]["bias"]).reshape(nkv * d))
         sd[pre + "mlp.gate_proj.weight"] = _np(m["gate_proj"]["kernel"]).T
         sd[pre + "mlp.up_proj.weight"] = _np(m["up_proj"]["kernel"]).T
         sd[pre + "mlp.down_proj.weight"] = _np(m["down_proj"]["kernel"]).T
@@ -505,9 +535,17 @@ def load_hf_vit(model_or_path: Any, **config_overrides):
     A ``ViTForImageClassification`` source also carries its classifier
     head across when the config requests ``num_classes``."""
     if isinstance(model_or_path, str):
-        from transformers import ViTModel
+        if config_overrides.get("num_classes"):
+            # ViTModel.from_pretrained strips the classifier head the
+            # caller is asking for — load the classification wrapper
+            from transformers import ViTForImageClassification
 
-        model = ViTModel.from_pretrained(model_or_path)
+            model = ViTForImageClassification.from_pretrained(
+                model_or_path)
+        else:
+            from transformers import ViTModel
+
+            model = ViTModel.from_pretrained(model_or_path)
     else:
         model = model_or_path
     cfg = config_from_hf_vit(model.config, **config_overrides)

@@ -63,6 +63,9 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     moe_z_loss_coef: float = 1e-3
+    # q/k/v projection biases (Qwen2-family checkpoints; o_proj stays
+    # bias-free in every supported architecture)
+    attention_bias: bool = False
     # output-logit multiplier; muP sets this to base_width/width so the
     # logit scale is width-invariant (dlrover_tpu.accel.mup)
     logit_scale: float = 1.0
@@ -219,7 +222,7 @@ class Attention(nn.Module):
         q_proj = nn.DenseGeneral(
             (cfg.num_heads, d),
             axis=-1,
-            use_bias=False,
+            use_bias=cfg.attention_bias,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             dot_general=cfg.dot_general,
@@ -230,7 +233,8 @@ class Attention(nn.Module):
         )
         kv_features = (cfg.num_kv_heads, d)
         k_proj = nn.DenseGeneral(
-            kv_features, axis=-1, use_bias=False, dtype=cfg.dtype,
+            kv_features, axis=-1, use_bias=cfg.attention_bias,
+            dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("embed", "kv_heads", "head_dim")
@@ -238,7 +242,8 @@ class Attention(nn.Module):
             name="k_proj",
         )
         v_proj = nn.DenseGeneral(
-            kv_features, axis=-1, use_bias=False, dtype=cfg.dtype,
+            kv_features, axis=-1, use_bias=cfg.attention_bias,
+            dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, dot_general=cfg.dot_general,
             kernel_init=nn.with_logical_partitioning(
                 init, ("embed", "kv_heads", "head_dim")

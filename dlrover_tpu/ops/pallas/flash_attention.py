@@ -42,8 +42,12 @@ from jax.experimental.pallas import tpu as pltpu
 # the fwd-dominant probe, but its BACKWARD kernel exceeds the 16M scoped
 # VMEM limit in full bench compiles (22.5M stack) — 1024 is the largest
 # robust block.
-DEFAULT_BLOCK_Q = 1024
-DEFAULT_BLOCK_K = 1024
+# Overridable for end-to-end sweeps (and per-deployment tuning) without
+# code edits; the values above remain the measured defaults.
+import os as _os
+
+DEFAULT_BLOCK_Q = int(_os.getenv("DLROVER_FLASH_BLOCK_Q", "1024"))
+DEFAULT_BLOCK_K = int(_os.getenv("DLROVER_FLASH_BLOCK_K", "1024"))
 _NEG_INF = -1e30
 
 

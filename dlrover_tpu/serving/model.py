@@ -187,7 +187,10 @@ def verify_step(
         lp = _layer_weights(params["layers"], i)
         ck, cv = cache["k"][i], cache["v"][i]
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
-        q, k, v = _qkv_split(cfg, _mm(h, lp["wqkv"], dtype))
+        qkv = _mm(h, lp["wqkv"], dtype)
+        if "bqkv" in lp:  # Qwen2-family qkv biases
+            qkv = qkv + lp["bqkv"].astype(dtype)
+        q, k, v = _qkv_split(cfg, qkv)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         ck = _write_cache(ck, k, positions)
@@ -285,7 +288,10 @@ def prefill(
     for i in range(cfg.num_layers):
         lp = _layer_weights(params["layers"], i)
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
-        q, k, v = _qkv_split(cfg, _mm(h, lp["wqkv"], dtype, wide=True))
+        qkv = _mm(h, lp["wqkv"], dtype, wide=True)
+        if "bqkv" in lp:  # Qwen2-family qkv biases
+            qkv = qkv + lp["bqkv"].astype(dtype)
+        q, k, v = _qkv_split(cfg, qkv)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         o = dot_product_attention(q, k, v, causal=True,

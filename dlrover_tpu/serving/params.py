@@ -67,7 +67,7 @@ def _layer_tree(p: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
     wq = merge_last2(attn["q_proj"]["kernel"])
     wk = merge_last2(attn["k_proj"]["kernel"])
     wv = merge_last2(attn["v_proj"]["kernel"])
-    return {
+    out = {
         "input_norm": p["input_norm"]["scale"],
         "post_norm": p["post_norm"]["scale"],
         "wqkv": jnp.concatenate([jnp.asarray(wq), jnp.asarray(wk),
@@ -78,6 +78,18 @@ def _layer_tree(p: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
              jnp.asarray(p["mlp"]["up_proj"]["kernel"])], axis=-1),
         "down": p["mlp"]["down_proj"]["kernel"],
     }
+    if "bias" in attn["q_proj"]:
+        # Qwen2-family qkv biases, fused to match the wqkv layout
+        def flat(b):  # [..., H, D] -> [..., H*D]
+            return jnp.asarray(b).reshape(
+                *b.shape[:-2], b.shape[-2] * b.shape[-1]
+            )
+
+        out["bqkv"] = jnp.concatenate(
+            [flat(attn["q_proj"]["bias"]), flat(attn["k_proj"]["bias"]),
+             flat(attn["v_proj"]["bias"])], axis=-1,
+        )
+    return out
 
 
 def serving_params_from_llama(

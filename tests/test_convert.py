@@ -236,3 +236,83 @@ def test_scan_unrolled_converter_decode_parity():
     np.testing.assert_array_equal(
         np.asarray(toks_direct), np.asarray(toks_hf)
     )
+
+
+def test_mistral_logits_parity():
+    """Mistral = Llama architecture + sliding window; within the window
+    the conversion must be exact (max_seq_len clamps to the window)."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    from dlrover_tpu.models.convert import load_hf_llama
+    from dlrover_tpu.models.llama import LlamaModel
+
+    hf_cfg = MistralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        sliding_window=64,
+    )
+    hf = MistralForCausalLM(hf_cfg).eval()
+    cfg, params = load_hf_llama(
+        hf, scan_layers=False, remat=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    assert cfg.max_seq_len == 64  # clamped to the sliding window
+    assert not cfg.attention_bias
+    ids = np.array([[3, 17, 99, 42, 7, 64, 5, 11]], dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    out = LlamaModel(cfg).apply({"params": params},
+                                jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3, rtol=2e-3)
+
+
+def test_qwen2_logits_parity_with_qkv_bias():
+    """Qwen2 = Llama architecture + q/k/v projection biases."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from dlrover_tpu.models.convert import load_hf_llama
+    from dlrover_tpu.models.llama import LlamaModel
+
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+    )
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    cfg, params = load_hf_llama(
+        hf, scan_layers=False, remat=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    assert cfg.attention_bias
+    ids = np.array([[3, 17, 99, 42, 7, 64, 5, 11]], dtype=np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    out = LlamaModel(cfg).apply({"params": params},
+                                jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3, rtol=2e-3)
+
+
+def test_qwen2_roundtrip_exports_biases():
+    """params_to_hf must carry q/k/v biases back out for
+    attention_bias models (round-trip logits parity)."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from dlrover_tpu.models.convert import load_hf_llama, params_to_hf
+
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+    )
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    cfg, params = load_hf_llama(
+        hf, scan_layers=True, remat=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    sd = params_to_hf(params, cfg)
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    want = hf.state_dict()["model.layers.0.self_attn.q_proj.bias"].numpy()
+    np.testing.assert_allclose(
+        sd["model.layers.0.self_attn.q_proj.bias"], want, atol=1e-6
+    )

@@ -231,3 +231,38 @@ def test_full_length_prompt_with_zero_new_tokens(setup):
     rid = eng.add_request(prompt, 0)
     out = eng.run()[rid]
     assert out.size == 1
+
+
+def test_serving_greedy_parity_with_attention_bias():
+    """Qwen2-style qkv biases flow through the serving layout (fused
+    bqkv) — greedy decode must still match the training model exactly."""
+    from dlrover_tpu.rl.generation import sample_sequences
+
+    cfg = LlamaConfig.tiny(max_seq_len=64, dtype=jnp.float32,
+                           attention_bias=True)
+    model = LlamaModel(cfg)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 8), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    variables = model.init(jax.random.PRNGKey(4), ids)
+    # perturb biases so the test cannot pass with biases dropped
+    import flax
+
+    variables = flax.core.unfreeze(variables)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(variables)["params"]
+    for lname in ("layer_0", "layer_1"):
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            params[lname]["attn"][proj]["bias"] = (
+                params[lname]["attn"][proj]["bias"] + 0.3
+            )
+    variables = {"params": params}
+    toks_ref, _ = sample_sequences(
+        lambda p, t: model.apply(p, t), variables, ids, 8,
+        jax.random.PRNGKey(2), temperature=0.0,
+    )
+    eng = InferenceEngine(cfg, variables, max_slots=2, chunk=4,
+                          temperature=0.0)
+    toks, _ = eng.generate(np.asarray(ids), 8)
+    assert np.array_equal(np.asarray(toks_ref), toks)
