@@ -274,11 +274,19 @@ def accelerate(
     loss_fn: Optional[Callable] = None,
     devices: Optional[Sequence[Any]] = None,
     batch_shape: Optional[Tuple[int, int]] = None,
+    model_input_key: str = "input_ids",
 ) -> AccelerateResult:
     """Build mesh + shardings + jitted train/eval steps for ``model``.
 
     ``batch_shape`` is the *per-microbatch* global ``(batch, seq)`` shape
     used to trace ``init``; provide it or ``example_batch``.
+
+    Non-token models (e.g. the ViT family) set ``model_input_key`` to
+    the batch key the model consumes (``"pixel_values"``) and provide a
+    per-microbatch ``example_batch``; ``init`` traces with zeros of
+    that leaf's shape/dtype, batch leaves shard on their LEADING axis
+    only, and a custom ``loss_fn`` is required (the default loss is a
+    next-token LM loss).
     """
     config = config or AccelerateConfig()
     if optimizer is None:
@@ -329,15 +337,31 @@ def accelerate(
                 )
             user_loss, pp_forward = loss_fn, forward_fn
             loss_fn = lambda p, b: user_loss(p, b, pp_forward)  # noqa: E731
+    user_provided_loss = loss_fn is not None
     loss_fn = loss_fn or default_loss_fn(
         model, config.loss_chunk_size, forward_fn
     )
 
-    if batch_shape is None:
-        if example_batch is None:
-            raise ValueError("provide example_batch or batch_shape")
-        batch_shape = tuple(example_batch["input_ids"].shape[-2:])
-    dummy_ids = jnp.zeros(batch_shape, jnp.int32)
+    nontoken = model_input_key != "input_ids"
+    if nontoken:
+        if example_batch is None or model_input_key not in example_batch:
+            raise ValueError(
+                f"model_input_key={model_input_key!r} needs an "
+                "example_batch containing that key"
+            )
+        if not user_provided_loss:
+            raise ValueError(
+                "non-token models need an explicit loss_fn (the default "
+                "loss is a next-token LM loss over input_ids)"
+            )
+        ex = example_batch[model_input_key]
+        dummy_ids = jnp.zeros(np.shape(ex), np.asarray(ex).dtype)
+    else:
+        if batch_shape is None:
+            if example_batch is None:
+                raise ValueError("provide example_batch or batch_shape")
+            batch_shape = tuple(example_batch["input_ids"].shape[-2:])
+        dummy_ids = jnp.zeros(batch_shape, jnp.int32)
 
     def init_state(rng: jax.Array) -> TrainState:
         variables = model.init(rng, dummy_ids)
@@ -380,11 +404,29 @@ def accelerate(
         _offload_cell["tree"] = state_sharding.opt_state
 
     micro_spec = logical_to_spec(("batch", "seq"), config.logical_rules)
-    if config.grad_accum_steps > 1:
-        data_spec = PartitionSpec(None, *micro_spec)
+    if nontoken:
+        # per-leaf specs from the example: leading (batch) axis sharded,
+        # everything else replicated; grad accum adds a leading None.
+        # 0-d leaves (scalar hyperparams riding the batch) replicate.
+        def _leaf_sharding(x, with_lead: bool):
+            nd = np.ndim(x)
+            if nd == 0:
+                return NamedSharding(mesh, PartitionSpec())
+            lead = (None,) if with_lead else ()
+            return NamedSharding(
+                mesh,
+                PartitionSpec(*lead, micro_spec[0], *([None] * (nd - 1))),
+            )
+
+        accum_lead = config.grad_accum_steps > 1
+        batch_sharding = jax.tree_util.tree_map(
+            lambda x: _leaf_sharding(x, accum_lead), dict(example_batch)
+        )
+    elif config.grad_accum_steps > 1:
+        batch_sharding = NamedSharding(
+            mesh, PartitionSpec(None, *micro_spec))
     else:
-        data_spec = micro_spec
-    batch_sharding = NamedSharding(mesh, data_spec)
+        batch_sharding = NamedSharding(mesh, micro_spec)
 
     # unbox INSIDE the jitted init so its output structure matches the
     # expanded per-leaf sharding tree (the training loop works on plain
@@ -478,14 +520,17 @@ def accelerate(
         if jax.process_count() == 1:
             return batch
 
-        def conv(x):
+        def conv(x, s):
             if not isinstance(x, np.ndarray):
                 return x
             return jax.make_array_from_callback(
-                x.shape, sharding, lambda idx: x[idx]
+                x.shape, s, lambda idx: x[idx]
             )
 
-        return jax.tree_util.tree_map(conv, batch)
+        if isinstance(sharding, dict):  # per-leaf sharding tree
+            return jax.tree_util.tree_map(conv, batch, sharding)
+        return jax.tree_util.tree_map(
+            lambda x: conv(x, sharding), batch)
 
     def train_step(state, batch):
         with rules_ctx(), mesh:
@@ -496,7 +541,12 @@ def accelerate(
         loss, aux = loss_fn(state.params, batch)
         return {"loss": loss, "weight": aux["weight"]}
 
-    eval_sharding = NamedSharding(mesh, micro_spec)
+    if nontoken:
+        eval_sharding = jax.tree_util.tree_map(
+            lambda x: _leaf_sharding(x, False), dict(example_batch)
+        )
+    else:
+        eval_sharding = NamedSharding(mesh, micro_spec)
     jit_eval = jax.jit(
         _eval_step, in_shardings=(state_sharding, eval_sharding), out_shardings=None
     )

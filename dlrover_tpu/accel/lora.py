@@ -149,7 +149,9 @@ def lora_merge(base_params: Any, adapters: Dict[str, Any],
     Call INSIDE jit.  ``freeze_base`` stop-gradients the base so its
     grads are structural zeros (LoRA training); pass False to
     fine-tune base and adapters jointly."""
-    merged = base_params
+    # tolerate logically-boxed params (a model.init tree used directly,
+    # e.g. DPOTrainer over a LoRAModel): the merge consumes plain arrays
+    merged = nn.meta.unbox(base_params)
     if freeze_base:
         merged = jax.tree_util.tree_map(jax.lax.stop_gradient, merged)
     for key, ab in adapters.items():
@@ -202,10 +204,32 @@ class LoRAModel:
 
 def lora_label_fn(params: Dict[str, Any]) -> Dict[str, Any]:
     """Label tree for ``optax.multi_transform``: adapters "train",
-    frozen base "freeze"."""
+    frozen base "freeze".  Accepts either the ``{"base","lora"}``
+    params subtree or a full variables dict wrapping it under
+    ``"params"`` (trainers that optimize the whole variables pytree,
+    e.g. DPOTrainer over a LoRAModel)."""
+    if "base" in params and "lora" in params:
+        return {
+            "base": jax.tree_util.tree_map(
+                lambda _: "freeze", params["base"]),
+            "lora": jax.tree_util.tree_map(
+                lambda _: "train", params["lora"]),
+        }
+    if "params" not in params:
+        # refuse to label a tree with no adapters anywhere: freezing
+        # every leaf would be SILENT no-op training (the failure mode a
+        # forgotten LoRAModel wrapper produces)
+        raise ValueError(
+            "lora_label_fn: no {'base','lora'} split found — wrap the "
+            "model in LoRAModel before using lora_optimizer"
+        )
     return {
-        "base": jax.tree_util.tree_map(lambda _: "freeze", params["base"]),
-        "lora": jax.tree_util.tree_map(lambda _: "train", params["lora"]),
+        k: (
+            lora_label_fn(v)
+            if k == "params"
+            else jax.tree_util.tree_map(lambda _: "freeze", v)
+        )
+        for k, v in params.items()
     }
 
 

@@ -89,14 +89,22 @@ class DPOTrainer:
         label_smoothing: float = 0.0,
         learning_rate: float = 1e-5,
         max_grad_norm: float = 1.0,
+        optimizer: Optional[optax.GradientTransformation] = None,
         seed: int = 0,
     ):
+        """``optimizer`` overrides the default AdamW — e.g.
+        ``accel.lora.lora_optimizer(...)`` to preference-tune only LoRA
+        adapters over a frozen base (pass a
+        :class:`~dlrover_tpu.accel.lora.LoRAModel` as ``model``).
+        ``max_grad_norm`` clipping wraps a custom optimizer too;
+        ``learning_rate`` only applies to the default."""
         self.model = model
         self.beta = float(beta)
         self.label_smoothing = float(label_smoothing)
+        if optimizer is None:
+            optimizer = optax.adamw(learning_rate, weight_decay=0.0)
         self.optimizer = optax.chain(
-            optax.clip_by_global_norm(max_grad_norm),
-            optax.adamw(learning_rate, weight_decay=0.0),
+            optax.clip_by_global_norm(max_grad_norm), optimizer
         )
         self._rng = jax.random.PRNGKey(seed)
         self.params: Optional[Any] = None

@@ -203,3 +203,17 @@ def test_adapter_only_flash_checkpoint(tmp_path, tiny):
     finally:
         ckpt.close()
         AsyncCheckpointSaver.reset()
+
+
+def test_lora_optimizer_rejects_unwrapped_model_tree():
+    """Forgetting the LoRAModel wrapper must fail loudly at optimizer
+    init, not silently freeze every parameter."""
+    import optax
+    import pytest
+
+    from dlrover_tpu.accel.lora import lora_optimizer
+
+    opt = lora_optimizer(optax.adam(1e-3))
+    plain = {"layer_0": {"kernel": jnp.zeros((2, 2))}}
+    with pytest.raises(ValueError, match="LoRAModel"):
+        opt.init(plain)

@@ -440,3 +440,57 @@ def test_dpo_learns_preferences_without_reward_model():
     assert stats["accuracy"] >= 0.9, stats
     assert stats["margin"] > 0
     assert stats["chosen_reward"] > stats["rejected_reward"]
+
+
+def test_dpo_composes_with_lora_adapters():
+    """DPO over a LoRAModel: only the adapters move (base frozen), and
+    preferences are still learned — the parameter-efficient preference
+    stage (LoRA SFT -> LoRA DPO)."""
+    import optax
+
+    from dlrover_tpu.accel.lora import LoRAConfig, LoRAModel, lora_optimizer
+    from dlrover_tpu.rl.dpo import DPOTrainer
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, vocab_size=64,
+                           scan_layers=False)
+    lora = LoRAModel(LlamaModel(cfg), LoRAConfig(rank=4))
+    trainer = DPOTrainer(
+        lora, beta=0.5,
+        optimizer=lora_optimizer(optax.adam(1e-3)),
+    )
+    T = 16
+    trainer.init(T)
+    import flax.linen as nn
+
+    base_before = jax.tree_util.tree_map(
+        np.asarray, nn.meta.unbox(trainer.params["params"]["base"])
+    )
+
+    rng = np.random.RandomState(0)
+
+    def batch():
+        prompt = rng.randint(0, 64, size=(8, 4)).astype(np.int32)
+        chosen = np.concatenate(
+            [prompt, rng.randint(40, 64, size=(8, T - 4))], axis=1
+        ).astype(np.int32)
+        rejected = np.concatenate(
+            [prompt, rng.randint(0, 24, size=(8, T - 4))], axis=1
+        ).astype(np.int32)
+        mask = np.concatenate(
+            [np.zeros((8, 4), np.int32), np.ones((8, T - 4), np.int32)],
+            axis=1,
+        )
+        return {"chosen": chosen, "rejected": rejected,
+                "chosen_mask": mask, "rejected_mask": mask}
+
+    first = trainer.train_step(batch())
+    for _ in range(30):
+        stats = trainer.train_step(batch())
+    assert stats["loss"] < first["loss"]
+    assert stats["margin"] > 0
+
+    base_after = nn.meta.unbox(trainer.params["params"]["base"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        base_after, base_before,
+    )  # frozen base untouched
