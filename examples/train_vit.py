@@ -22,11 +22,13 @@ import numpy as np
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=20)  # must be >= 1
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--image-size", type=int, default=64)
     p.add_argument("--classes", type=int, default=10)
     args = p.parse_args()
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
 
     import jax
     import jax.numpy as jnp
