@@ -93,6 +93,10 @@ class AsyncCheckpointSaver:
         # steps whose commit barrier already timed out (a dead peer's
         # done-file will never appear); retried with a tiny budget
         self._commit_timed_out_steps: set = set()
+        # steps with a commit_checkpoint currently running in this
+        # process: the GC after a newer step's commit must not rmtree a
+        # stage another commit thread is still polling/renaming
+        self._inflight_commits: set = set()
         # Serializes persists between the event loop and the agent's
         # failure-path save_shm_to_storage (monitor thread).
         self._persist_mutex = threading.Lock()
@@ -142,9 +146,21 @@ class AsyncCheckpointSaver:
                     logger.exception("persist of step %s failed", event.step)
 
     # -- persistence ------------------------------------------------------
-    def _stage_dir(self, step: int) -> str:
+    def _stage_dir(self, step: int, world: Optional[int] = None) -> str:
+        """Stage dirs are WORLD-SCOPED (``step-N.wK``): a resized world
+        re-saving a step stages into its own directory, so savers from
+        different worlds can never delete or count each other's files —
+        the first complete layout to finish the commit barrier wins the
+        final rename, and the loser sees the final dir and drops its
+        stage.  (A shared stage dir had an unfixable race: a dying old
+        world's failure-path save and the new world's re-save would
+        mutually clear each other's markers/done-files.)"""
+        if world is None:
+            world = self.global_shard_num * self.local_shard_num
         return os.path.join(
-            self.checkpoint_dir, STAGE_DIR, f"{CKPT_DIR_PREFIX}{step}"
+            self.checkpoint_dir,
+            STAGE_DIR,
+            f"{CKPT_DIR_PREFIX}{step}.w{world}",
         )
 
     def _final_dir(self, step: int) -> str:
@@ -165,6 +181,10 @@ class AsyncCheckpointSaver:
         crash mid-save would otherwise leave the lock held forever.
         """
         with self._persist_mutex:
+            # one world snapshot for the whole persist+commit pass: the
+            # factory thread may resize the saver mid-call, and a persist
+            # into one world's stage must commit against that same stage
+            world = self.global_shard_num * self.local_shard_num
             persisted_steps = set()
             skipped = False
             for local_rank, handler in enumerate(self._shm_handlers):
@@ -185,7 +205,9 @@ class AsyncCheckpointSaver:
                     skipped = True
                     continue
                 try:
-                    actual = self._persist_shard(step, local_rank, handler)
+                    actual = self._persist_shard(
+                        step, local_rank, handler, world
+                    )
                     if actual is not None:
                         persisted_steps.add(actual)
                 finally:
@@ -203,24 +225,32 @@ class AsyncCheckpointSaver:
                     # shard files + done-file are on storage already; only
                     # the cross-node done-file WAIT runs off-thread (it can
                     # never finish when a peer node died, and the caller —
-                    # the agent's restart path — must not block on it)
+                    # the agent's restart path — must not block on it).
+                    # Register the in-flight step BEFORE start(): a faster
+                    # sibling commit's GC must not prune this stage in the
+                    # window before the OS schedules the new thread.
+                    self._inflight_commits.add(actual)
                     threading.Thread(
                         target=self.commit_checkpoint,
                         args=(actual,),
-                        kwargs={"timeout": commit_timeout},
+                        kwargs={"timeout": commit_timeout, "world": world},
                         daemon=True,
                         name=f"ckpt-commit-{actual}",
                     ).start()
                 else:
-                    self.commit_checkpoint(actual, timeout=commit_timeout)
+                    self.commit_checkpoint(
+                        actual, timeout=commit_timeout, world=world
+                    )
 
     def _persist_shard(
         self,
         step: int,
         local_rank: int,
         handler: SharedMemoryHandler,
+        world: int,
     ) -> Optional[int]:
-        """Persist one local shard; returns the step actually persisted."""
+        """Persist one local shard into ``world``'s stage dir; returns the
+        step actually persisted."""
         loaded = handler.load_arrays()
         if loaded is None:
             logger.warning("no shm state for local rank %s", local_rank)
@@ -232,18 +262,21 @@ class AsyncCheckpointSaver:
                 shm_step, step,
             )
             step = shm_step
-        stage = self._stage_dir(step)
+        stage = self._stage_dir(step, world)
         self.storage.safe_makedirs(stage)
-        # record the WRITER world's total shard count: the commit barrier
-        # must expect this many done-files even if the world resizes
-        # between write and commit (an elastic shrink must not let an
-        # old-world stage with fewer done-files than its layout commit)
-        marker = os.path.join(
-            stage, f"world-{self.global_shard_num * self.local_shard_num}"
-        )
+        # record the WRITER world's total shard count (also embedded in
+        # the stage dir name): the final dir keeps it so completeness is
+        # checkable after the rename
+        marker = os.path.join(stage, f"world-{world}")
         if not self.storage.exists(marker):
             self.storage.write(b"", marker)
         shard_id = self.node_rank * self.local_shard_num + local_rank
+        # drop this shard's own done-file from a previous attempt BEFORE
+        # rewriting the bin: a peer's commit scan must never count a
+        # done-file whose bin is mid-write
+        self.storage.safe_remove(
+            os.path.join(stage, f"done-{shard_id}-w{world}")
+        )
         bin_path = os.path.join(stage, f"shard-{shard_id}.bin")
         meta_path = os.path.join(stage, f"shard-{shard_id}.meta")
         # one sequential write of the whole segment
@@ -264,11 +297,91 @@ class AsyncCheckpointSaver:
             dumps({"step": step, "leaves": leaves, "offsets": offsets}),
             meta_path,
         )
-        self.storage.write(b"", os.path.join(stage, f"done-{shard_id}"))
+        # done-files carry the writer world so a commit scan can never
+        # count an old layout's shard toward a new layout's barrier
+        self.storage.write(
+            b"", os.path.join(stage, f"done-{shard_id}-w{world}")
+        )
         self._persist_count += 1
         return step
 
-    def commit_checkpoint(self, step: int, timeout: float = 600.0) -> None:
+    def _gc_stale_stages(self, committed_step: int, world: int) -> None:
+        """Drop stage dirs superseded by a successful commit: any OTHER
+        world's stage of the same step (final exists now; their commit
+        would only see the final and drop the stage anyway) and any
+        stage at or below the committed step (steps grow monotonically,
+        so an older stage can only be an abandoned save of a dead
+        world).  Steps with a commit still in flight IN THIS PROCESS are
+        skipped — mixed-step shm saves spawn one commit thread per step,
+        and only rank 0 (this process, the only renamer) runs GC, so the
+        in-flight set is a complete guard for pending renames."""
+        base = os.path.join(self.checkpoint_dir, STAGE_DIR)
+        try:
+            entries = self.storage.listdir(base)
+        except Exception:
+            return
+        keep = f"{CKPT_DIR_PREFIX}{committed_step}.w{world}"
+        for e in entries:
+            if not e.startswith(CKPT_DIR_PREFIX) or e == keep:
+                continue
+            tail = e[len(CKPT_DIR_PREFIX):]
+            # world-scoped "N.wK" and legacy pre-upgrade "N" names both
+            # parse to their step; anything else is left alone.  Legacy
+            # stages are prune-only by design: no saver format (old or
+            # new) ever re-committed an orphaned stage after restart —
+            # recovery restages from shm/storage instead.
+            try:
+                e_step = int(tail.partition(".w")[0])
+            except ValueError:
+                continue
+            # same-step stages are always prunable (the final exists;
+            # their commits self-clean on seeing it) — the in-flight
+            # guard is for OLDER steps whose rename hasn't happened yet
+            if e_step <= committed_step and (
+                e_step == committed_step
+                or e_step not in self._inflight_commits
+            ):
+                logger.info("pruning superseded stage %s", e)
+                self.storage.safe_rmtree(os.path.join(base, e))
+
+    def _final_is_complete(self, final: str) -> bool:
+        """A committed dir must hold one world marker and that world's
+        full done-file set (its bins/metas precede their done-files)."""
+        try:
+            entries = self.storage.listdir(final)
+        except Exception:
+            return False
+        worlds = [
+            int(e.split("-", 1)[1]) for e in entries
+            if e.startswith("world-")
+        ]
+        if len(worlds) != 1:
+            return False
+        world = worlds[0]
+        done = sum(
+            1 for e in entries
+            if e.startswith("done-") and e.endswith(f"-w{world}")
+        )
+        return done >= world
+
+    def commit_checkpoint(
+        self,
+        step: int,
+        timeout: float = 600.0,
+        world: Optional[int] = None,
+    ) -> None:
+        self._inflight_commits.add(step)
+        try:
+            self._commit_checkpoint(step, timeout=timeout, world=world)
+        finally:
+            self._inflight_commits.discard(step)
+
+    def _commit_checkpoint(
+        self,
+        step: int,
+        timeout: float = 600.0,
+        world: Optional[int] = None,
+    ) -> None:
         """Rename stage -> final once every global shard's done-file exists
         (reference: ckpt_saver.py:860-920).
 
@@ -281,33 +394,30 @@ class AsyncCheckpointSaver:
         """
         if step in self._commit_timed_out_steps:
             timeout = min(timeout, 2.0)
-        stage = self._stage_dir(step)
+        # commit targets the stage of the world that WROTE it; callers
+        # inside a persist pass pin it (the factory thread may resize the
+        # saver concurrently)
+        if world is None:
+            world = self.global_shard_num * self.local_shard_num
+        stage = self._stage_dir(step, world)
         final = self._final_dir(step)
         deadline = time.time() + timeout
-        expected = self.global_shard_num * self.local_shard_num
-        try:
-            markers = [
-                f for f in self.storage.listdir(stage)
-                if f.startswith("world-")
-            ]
-            if markers:
-                # the stage's writer world overrides the saver's current
-                # world: a post-shrink commit of an old-world stage must
-                # still wait for ALL of that layout's shards
-                expected = max(int(m.split("-", 1)[1]) for m in markers)
-        except Exception:
-            pass
+        expected = world
         while True:
             if self.storage.exists(final):
-                # Another host already renamed stage -> final; the commit
-                # happened — stop polling and drop any leftover stage dir
-                # a duplicate persist may have recreated.
+                # Another host (or another world's save of the same step)
+                # already renamed a stage -> final; the commit happened —
+                # stop polling and drop this stage if it lingers.
                 if self.storage.exists(stage):
                     self.storage.safe_rmtree(stage)
                 break
+            try:
+                entries = self.storage.listdir(stage)
+            except Exception:
+                entries = []
             done = [
-                f for f in self.storage.listdir(stage)
-                if f.startswith("done-")
+                f for f in entries
+                if f.startswith("done-") and f.endswith(f"-w{expected}")
             ]
             if len(done) >= expected:
                 break
@@ -319,15 +429,49 @@ class AsyncCheckpointSaver:
                 self._commit_timed_out_steps.add(step)
                 return
             time.sleep(0.5)
-        # host 0 performs the rename + tracker update
-        if self.node_rank == 0 and not self.storage.exists(final):
-            self.storage.safe_move(stage, final)
-            self.storage.write(
-                str(step), os.path.join(self.checkpoint_dir, TRACKER_FILE)
-            )
-            logger.info("Committed checkpoint step %s", step)
-        # every host records the commit so save_shm_to_storage does not
-        # re-persist an already-committed step
+        if self.node_rank == 0:
+            # host 0 performs the rename + tracker update
+            if not self.storage.exists(final):
+                self.storage.safe_move(stage, final)
+                # re-validate AFTER the rename (the dir is frozen then:
+                # writers target the stage path).  World-scoped stages
+                # make a gutted rename near-impossible, but a cheap
+                # completeness check keeps an incomplete final out of
+                # the tracker no matter what put it there.
+                if not self._final_is_complete(final):
+                    quarantine = final + ".invalid"
+                    self.storage.safe_rmtree(quarantine)
+                    self.storage.safe_move(final, quarantine)
+                    logger.error(
+                        "commit of step %s moved an incomplete stage; "
+                        "quarantined to %s (a later save will restage "
+                        "and commit)", step, quarantine,
+                    )
+                    return
+                self.storage.write(
+                    str(step),
+                    os.path.join(self.checkpoint_dir, TRACKER_FILE),
+                )
+                logger.info("Committed checkpoint step %s", step)
+            self._gc_stale_stages(step, world)
+        else:
+            # peers must SEE the final before recording the step as
+            # persisted: rank 0 may still quarantine the rename, and a
+            # peer that records a never-committed step would skip the
+            # failure-path re-save of its shm state forever after
+            while not self.storage.exists(final):
+                if time.time() > deadline:
+                    logger.error(
+                        "commit of step %s: barrier passed but final dir "
+                        "never appeared (rank 0 failed or quarantined)",
+                        step,
+                    )
+                    self._commit_timed_out_steps.add(step)
+                    return
+                time.sleep(0.5)
+        # recorded only once the final dir really exists, so
+        # save_shm_to_storage never skips re-persisting a step that was
+        # in fact never committed
         self._last_persisted_step = step
         self.storage.commit(step, True)
 

@@ -64,6 +64,12 @@ class WorkerSpec:
     # Host the flash-checkpoint saver factory so trainers can checkpoint
     # into agent-owned shared memory (reference: training.py:580).
     flash_ckpt: bool = True
+    # Persist the shm checkpoint to storage at the failure breakpoint,
+    # before restarting workers (reference: --save_at_breakpoint,
+    # elastic_run.py:171 + training.py:662-672).  Default ON here — the
+    # reference defaults off because its torch save can be slow; the
+    # zero-copy shm persist is cheap enough to always take.
+    save_at_breakpoint: bool = True
     # Observability: sample host/TPU usage + tail the trainer's runtime-
     # metrics file and report upstream (reference: elastic_agent/monitor/).
     monitors: bool = True
@@ -429,7 +435,8 @@ class ElasticAgent:
         # (reference: training.py:662-672)
         self._group.stop()
         terminal = self._group.restart_count >= self._spec.max_restarts
-        self._save_shm_checkpoint(commit_async=not terminal)
+        if self._spec.save_at_breakpoint:
+            self._save_shm_checkpoint(commit_async=not terminal)
         if terminal:
             self._client.report_node_status(self._node_rank, NodeStatus.FAILED)
             logger.error(

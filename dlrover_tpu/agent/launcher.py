@@ -74,6 +74,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "hot-reload file (reference: dlrover-run --auto_tunning)",
     )
     p.add_argument(
+        "--save-at-breakpoint", "--save_at_breakpoint",
+        action=argparse.BooleanOptionalAction, default=True,
+        help="persist the in-memory flash checkpoint to storage when the "
+             "training process fails, before restarting (reference: "
+             "dlrover-run --save_at_breakpoint; default on — the "
+             "zero-copy shm persist is cheap on TPU hosts)",
+    )
+    p.add_argument(
         "--hang-timeout", type=float, default=0.0,
         help="restart workers when the global step stalls this many "
              "seconds (0 disables)",
@@ -183,6 +191,7 @@ def run(args: argparse.Namespace) -> int:
         comm_perf_test=args.comm_perf_test,
         exclude_straggler=args.exclude_straggler,
         auto_tunning=args.auto_tunning,
+        save_at_breakpoint=args.save_at_breakpoint,
         hang_timeout=args.hang_timeout,
         hang_grace_period=args.hang_grace_period,
     )

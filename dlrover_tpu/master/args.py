@@ -44,6 +44,12 @@ def build_master_parser() -> argparse.ArgumentParser:
         default="AllreduceStrategy",
     )
     parser.add_argument(
+        "--job_uid", default="",
+        help="k8s uid of the owning ElasticJob CR; when set, worker pods "
+             "and per-rank Services carry an ownerReference so cluster "
+             "GC reclaims them with the job",
+    )
+    parser.add_argument(
         "--node_groups", default="",
         help="multi-role replica spec 'role:count[,role:count...]', e.g. "
              "'chief:1,worker:2,evaluator:1,ps:2' (reference: ElasticJob "

@@ -63,9 +63,23 @@ class DistributedJobMaster:
         dist_job_manager.py:259-316); omitted = plain SPMD worker job."""
         self._port = port
         # a multi-role spec defines the training world size through its
-        # worker group; --node_num then only covers the workers-only case
-        if node_groups and "worker" in node_groups:
-            node_num = node_groups["worker"].count
+        # worker group; --node_num then only covers the workers-only case.
+        # A spec WITHOUT workers (chief+ps estimator jobs) means zero
+        # rendezvous participants — a stale node_num default must not
+        # size rendezvous/task state for a worker that never launches.
+        if node_groups:
+            worker_group = node_groups.get("worker")
+            node_num = worker_group.count if worker_group else 0
+        elif node_num == 0:
+            # scaled-to-zero CR: a valid idle job — the master waits for
+            # the operator/autoscaler to scale workers up (crash-looping
+            # the master pod here would make suspend unrecoverable)
+            logger.warning(
+                "job starts with zero workers and no node groups; "
+                "idling until scaled up"
+            )
+        elif node_num < 0:
+            raise ValueError(f"node_num={node_num} must be >= 0")
         self._node_num = node_num
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager(0, self.speed_monitor)

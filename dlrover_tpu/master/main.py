@@ -52,6 +52,16 @@ def run(args) -> int:
         api = default_k8s_api()
         # workers reach the master through the "{job}-master" Service the
         # operator creates; the port must be the one actually bound
+        owner_ref = None
+        if args.job_uid:
+            owner_ref = {
+                "apiVersion": "dlrover-tpu.org/v1alpha1",
+                "kind": "ElasticJob",
+                "name": args.job_name,
+                "uid": args.job_uid,
+                "controller": False,
+                "blockOwnerDeletion": False,
+            }
         scaler = PodScaler(
             args.job_name,
             api=api,
@@ -59,6 +69,7 @@ def run(args) -> int:
             image=args.worker_image,
             node_num=args.node_num,
             master_addr=f"{args.job_name}-master:{port}",
+            owner_ref=owner_ref,
         )
         master = DistributedJobMaster(
             port,

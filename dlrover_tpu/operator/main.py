@@ -42,7 +42,6 @@ def build_master_pod_spec(
     spec = job.get("spec", {})
     image = spec.get("image", "dlrover-tpu:latest")
     replica_specs = spec.get("replicaSpecs", {})
-    workers = replica_specs.get("worker", {})
     # multi-role jobs (chief/evaluator/ps alongside workers) ride the
     # master's --node_groups spec (reference: ElasticJob replicaSpecs →
     # per-role node groups, dist_job_manager.py:259-316)
@@ -55,22 +54,22 @@ def build_master_pod_spec(
             "ElasticJob %s: ignoring unknown replicaSpecs roles %s "
             "(known: %s)", name, unknown, list(known_roles),
         )
-    zeroed = sorted(
-        role for role, rs in replica_specs.items()
-        if role in known_roles and not rs.get("replicas", 0)
-    )
+    # a PRESENT role without a 'replicas' key takes the conventional
+    # k8s default of 1; an explicit 0 (suspended role) stays 0
+    replicas = {
+        role: int(rs.get("replicas", 1) or 0)
+        for role, rs in replica_specs.items()
+        if role in known_roles
+    }
+    zeroed = sorted(role for role, n in replicas.items() if not n)
     if zeroed:
         logger.warning(
-            "ElasticJob %s: replicaSpecs roles %s have no replicas "
+            "ElasticJob %s: replicaSpecs roles %s have zero replicas "
             "and are dropped from the node groups", name, zeroed,
         )
-    active_roles = {
-        role for role, rs in replica_specs.items()
-        if role in known_roles and rs.get("replicas", 0)
-    }
+    active_roles = {role for role, n in replicas.items() if n}
     extra_roles = ",".join(
-        f"{role}:{int(replica_specs[role]['replicas'])}"
-        for role in sorted(active_roles)
+        f"{role}:{replicas[role]}" for role in sorted(active_roles)
     )
     res = spec.get("masterResource", {}) or {}
     limits = {
@@ -100,8 +99,20 @@ def build_master_pod_spec(
                     "--job_name", name,
                     "--namespace", namespace,
                     "--port", str(DEFAULT_MASTER_PORT),
-                    "--node_num", str(workers.get("replicas", 1)),
+                    # node_num counts WORKERS only; a chief+ps-only job
+                    # must not size rendezvous for a phantom worker (the
+                    # 1-default covers only an empty replicaSpecs = the
+                    # legacy single-worker shorthand)
+                    "--node_num", str(
+                        replicas.get("worker", 0)
+                        if replica_specs else 1
+                    ),
                     "--worker_image", image,
+                    # the CR's k8s uid rides into the master so the
+                    # PodScaler can set ownerReferences on worker pods
+                    # AND their per-rank Services — k8s GC then reclaims
+                    # both when the ElasticJob is deleted
+                    "--job_uid", str(job["metadata"].get("uid", "")),
                 ] + (
                     ["--node_groups", extra_roles]
                     if extra_roles and active_roles != {"worker"}

@@ -46,8 +46,35 @@ from jax.experimental.pallas import tpu as pltpu
 # code edits; the values above remain the measured defaults.
 import os as _os
 
-DEFAULT_BLOCK_Q = int(_os.getenv("DLROVER_FLASH_BLOCK_Q", "1024"))
-DEFAULT_BLOCK_K = int(_os.getenv("DLROVER_FLASH_BLOCK_K", "1024"))
+def _block_from_env(var: str, default: int) -> int:
+    """A bad override must never make the ops package unimportable
+    (this runs at import time, and an elastic restart inherits the same
+    env — raising here would crash-loop every worker): any malformed or
+    out-of-range value warns and falls back to the measured default."""
+    raw = _os.getenv(var)
+    if raw is None or not raw.strip():
+        return default
+    import warnings
+
+    try:
+        val = int(raw)
+    except ValueError:
+        warnings.warn(
+            f"{var}={raw!r} is not an integer; using default {default}"
+        )
+        return default
+    if val <= 0 or val % 128 != 0 or val > 4096:
+        warnings.warn(
+            f"{var}={val} ignored: flash blocks must be positive "
+            "multiples of 128 (TPU lane width) and <= 4096 (16MB "
+            f"scoped-VMEM bound); using default {default}"
+        )
+        return default
+    return val
+
+
+DEFAULT_BLOCK_Q = _block_from_env("DLROVER_FLASH_BLOCK_Q", 1024)
+DEFAULT_BLOCK_K = _block_from_env("DLROVER_FLASH_BLOCK_K", 1024)
 _NEG_INF = -1e30
 
 

@@ -78,6 +78,7 @@ def build_pod_spec(
     tpu_chips_per_host: int = 4,
     tpu_topology: str = "",
     extra_env: Optional[Dict[str, str]] = None,
+    owner_ref: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Worker pod manifest (reference pod_scaler.py:608 _create_pod_obj),
     as a plain dict so tests need no kubernetes models.  The env block is
@@ -104,20 +105,24 @@ def build_pod_spec(
         node_selector["cloud.google.com/gke-tpu-accelerator"] = res.tpu_type
     if tpu_topology:
         node_selector["cloud.google.com/gke-tpu-topology"] = tpu_topology
+    metadata: Dict[str, Any] = {
+        # job-prefixed so two jobs in one namespace can't collide
+        "name": f"{job_name}-{node.name}",
+        "namespace": namespace,
+        "labels": {
+            _LABEL_JOB: job_name,
+            _LABEL_TYPE: node.type,
+            _LABEL_RANK: str(node.rank_index),
+            _LABEL_ID: str(node.id),
+        },
+    }
+    if owner_ref:
+        # cluster GC reclaims worker pods when the ElasticJob CR goes
+        metadata["ownerReferences"] = [dict(owner_ref)]
     return {
         "apiVersion": "v1",
         "kind": "Pod",
-        "metadata": {
-            # job-prefixed so two jobs in one namespace can't collide
-            "name": f"{job_name}-{node.name}",
-            "namespace": namespace,
-            "labels": {
-                _LABEL_JOB: job_name,
-                _LABEL_TYPE: node.type,
-                _LABEL_RANK: str(node.rank_index),
-                _LABEL_ID: str(node.id),
-            },
-        },
+        "metadata": metadata,
         "spec": {
             "restartPolicy": "Never",
             "nodeSelector": node_selector,
@@ -128,6 +133,48 @@ def build_pod_spec(
                 "env": [{"name": k, "value": v} for k, v in env.items()],
                 "resources": {"limits": limits, "requests": dict(limits)},
             }],
+        },
+    }
+
+
+def build_pod_service_spec(
+    job_name: str,
+    node: Node,
+    namespace: str = "default",
+    port: int = DEFAULT_MASTER_PORT,
+    owner_ref: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Per-pod Service for stable addressing across relaunch (reference:
+    pod_scaler.py:608 k8sServiceFactory + scheduler/kubernetes.py:483).
+
+    The Service name keys on (type, rank-index) and the selector matches
+    the pod labels, so a RELAUNCHED pod — new pod name, new IP — keeps
+    the same DNS address: PS hosts stay reachable at
+    ``{job}-ps-{rank}`` across failover instead of clients chasing pod
+    IPs.  Headless (clusterIP None): DNS resolves straight to the pod."""
+    name = f"{job_name}-{node.type}-{node.rank_index}"
+    selector = {
+        _LABEL_JOB: job_name,
+        _LABEL_TYPE: node.type,
+        _LABEL_RANK: str(node.rank_index),
+    }
+    metadata: Dict[str, Any] = {
+        "name": name,
+        "namespace": namespace,
+        "labels": dict(selector),
+    }
+    if owner_ref:
+        # without this the per-rank Services outlive the job forever
+        # (nothing else ever deletes them)
+        metadata["ownerReferences"] = [dict(owner_ref)]
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": metadata,
+        "spec": {
+            "clusterIP": "None",
+            "selector": selector,
+            "ports": [{"port": port, "targetPort": port}],
         },
     }
 
@@ -154,6 +201,7 @@ class PodScaler(Scaler):
         master_addr: str = "",
         node_num: int = 1,
         spec_overrides: Optional[Dict[str, Any]] = None,
+        owner_ref: Optional[Dict[str, Any]] = None,
     ):
         super().__init__(job_name)
         self._api = api if api is not None else default_k8s_api()
@@ -163,7 +211,13 @@ class PodScaler(Scaler):
         self._master_addr = master_addr
         self._node_num = node_num
         self._spec_overrides = spec_overrides or {}
+        self._owner_ref = owner_ref
         self._pending: List[Node] = []
+        # ranks whose stable Service failed to create (transient API
+        # errors): retried by the creator loop — a pod without its
+        # Service is unreachable at its stable address for the job's
+        # whole life
+        self._svc_pending: List[Node] = []
         self._removals: List[Node] = []
         self._group_targets: Dict[str, Any] = {}
         self._lock = threading.Lock()
@@ -245,6 +299,10 @@ class PodScaler(Scaler):
         with self._lock:
             todo, self._pending = self._pending, []
         created = 0
+        with self._lock:
+            svc_retry, self._svc_pending = self._svc_pending, []
+        for node in svc_retry:
+            self._ensure_pod_service(node)
         for node in todo:
             body = build_pod_spec(
                 self._job_name, node,
@@ -252,6 +310,7 @@ class PodScaler(Scaler):
                 namespace=self._namespace,
                 master_addr=self._master_addr,
                 node_num=self._node_num,
+                owner_ref=self._owner_ref,
                 **self._spec_overrides,
             )
             try:
@@ -264,7 +323,41 @@ class PodScaler(Scaler):
                                node.name, e)
                 with self._lock:
                     self._pending.append(node)
+                continue
+            self._ensure_pod_service(node)
         return created
+
+    def _ensure_pod_service(self, node: Node) -> None:
+        """Create the pod's stable (type, rank) Service; AlreadyExists is
+        the common relaunch case and is fine — the selector picks up the
+        new pod.  Services are intentionally NOT deleted with pods (a
+        relaunched rank reuses its address); their ownerReference to the
+        ElasticJob CR hands teardown to cluster GC.  Transient failures
+        are requeued — unlike pods, nothing later recreates a missed
+        Service, so a drop here would strand the rank's address."""
+        create_svc = getattr(self._api, "create_namespaced_service", None)
+        if create_svc is None:  # injected fakes may not model services
+            return
+        svc = build_pod_service_spec(
+            self._job_name, node, namespace=self._namespace,
+            owner_ref=self._owner_ref,
+        )
+        try:
+            create_svc(namespace=self._namespace, body=svc)
+        except Exception as e:
+            # kubernetes ApiException carries .status; the name/message
+            # match covers duck-typed fakes (a bare '409' substring of
+            # the message would misread request ids / ports)
+            if getattr(e, "status", None) == 409 or \
+                    "AlreadyExists" in type(e).__name__ or \
+                    "AlreadyExists" in str(e):
+                return
+            logger.warning(
+                "service create %s failed (requeued): %s",
+                svc["metadata"]["name"], e,
+            )
+            with self._lock:
+                self._svc_pending.append(node)
 
     def _list_nodes(self) -> List[Node]:
         try:

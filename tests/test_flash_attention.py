@@ -173,3 +173,33 @@ def test_gradients_query_shorter_than_kv():
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_block_env_override_validation():
+    """Malformed env overrides must not make the package unimportable;
+    out-of-range values fail with a readable message (ADVICE r4)."""
+    import warnings
+
+    from dlrover_tpu.ops.pallas.flash_attention import _block_from_env
+
+    assert _block_from_env("DLROVER_TEST_NOVAR", 1024) == 1024
+    import os
+
+    os.environ["DLROVER_TEST_BLK"] = "512"
+    try:
+        assert _block_from_env("DLROVER_TEST_BLK", 1024) == 512
+        os.environ["DLROVER_TEST_BLK"] = "not-an-int"
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert _block_from_env("DLROVER_TEST_BLK", 1024) == 1024
+        assert rec and "not an integer" in str(rec[0].message)
+        os.environ["DLROVER_TEST_BLK"] = ""
+        assert _block_from_env("DLROVER_TEST_BLK", 1024) == 1024
+        for bad in ("-128", "100", "8192"):
+            os.environ["DLROVER_TEST_BLK"] = bad
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                assert _block_from_env("DLROVER_TEST_BLK", 1024) == 1024
+            assert rec and "multiples of 128" in str(rec[0].message)
+    finally:
+        os.environ.pop("DLROVER_TEST_BLK", None)

@@ -295,20 +295,20 @@ def test_assemble_region_partial_pieces():
 
 
 def test_commit_respects_writer_world_after_shrink(tmp_path):
-    """An old-world stage must NOT commit with fewer done-files than its
-    writer layout even after an elastic shrink resizes the saver: a
-    4-shard GSPMD checkpoint with 3 shards is a hole, not a checkpoint."""
+    """An incomplete stage must NOT commit (a 2-shard layout with 1 done
+    is a hole, not a checkpoint), and stages are world-scoped: a resized
+    saver never counts — or clears — another world's stage."""
     saver = AsyncCheckpointSaver(
-        str(tmp_path / "ckpt"), local_shard_num=1, global_shard_num=1,
+        str(tmp_path / "ckpt"), local_shard_num=1, global_shard_num=2,
         node_rank=0,
     )
     try:
-        stage = saver._stage_dir(7)
+        stage = saver._stage_dir(7)  # step-7.w2
         os.makedirs(stage)
-        # stage written by a 2-host world; only shard 0 completed
+        # 2-host world; only shard 0 completed
         open(os.path.join(stage, "world-2"), "w").close()
         open(os.path.join(stage, "shard-0.bin"), "w").close()
-        open(os.path.join(stage, "done-0"), "w").close()
+        open(os.path.join(stage, "done-0-w2"), "w").close()
         saver.commit_checkpoint(7, timeout=1.0)
         assert not os.path.exists(saver._final_dir(7))
         assert 7 in saver._commit_timed_out_steps
@@ -320,9 +320,98 @@ def test_commit_respects_writer_world_after_shrink(tmp_path):
         assert time.time() - t0 < 10
         assert not os.path.exists(saver._final_dir(7))
 
+        # a shrink resizes the saver: its commits now target the NEW
+        # world's (empty) stage — the old-world stage is untouched
+        saver.global_shard_num = 1
+        saver.commit_checkpoint(7, timeout=1.0)
+        assert not os.path.exists(saver._final_dir(7))
+        assert os.path.exists(stage), "foreign-world stage must survive"
+        saver.global_shard_num = 2
+
         # once the missing shard's done-file lands, the commit completes
-        open(os.path.join(stage, "done-1"), "w").close()
+        open(os.path.join(stage, "done-1-w2"), "w").close()
         saver.commit_checkpoint(7, timeout=5.0)
         assert os.path.exists(saver._final_dir(7))
+    finally:
+        saver.stop()
+
+
+def test_resized_world_resave_supersedes_old_stage(tmp_path):
+    """A new world re-saving a step an old world already staged commits
+    from its OWN world-scoped stage — none of the old layout's files can
+    leak into the final dir — and the superseded stage is pruned."""
+    saver = AsyncCheckpointSaver(
+        str(tmp_path / "ckpt"), local_shard_num=1, global_shard_num=1,
+        node_rank=0,
+    )
+    try:
+        # residue of an interrupted 2-host save of the same step
+        old_stage = saver._stage_dir(7, world=2)
+        os.makedirs(old_stage)
+        open(os.path.join(old_stage, "world-2"), "w").close()
+        open(os.path.join(old_stage, "shard-0.bin"), "w").close()
+        open(os.path.join(old_stage, "shard-0.meta"), "w").close()
+        open(os.path.join(old_stage, "shard-1.bin"), "w").close()
+        open(os.path.join(old_stage, "shard-1.meta"), "w").close()
+        open(os.path.join(old_stage, "done-0-w2"), "w").close()
+
+        saver._shm_handlers[0].save_state_dict(
+            {"w": np.arange(4.0)}, step=7
+        )
+        saver._save_step_checkpoint(7, commit_timeout=10.0)
+
+        final = saver._final_dir(7)
+        assert os.path.exists(final), "new-world save must commit"
+        names = sorted(os.listdir(final))
+        assert "world-2" not in names
+        assert "done-0-w2" not in names, "old-world done leaked into final"
+        assert "shard-1.bin" not in names, (
+            "old-layout shard outside the new layout leaked into final"
+        )
+        assert {"shard-0.bin", "shard-0.meta", "done-0-w1", "world-1"} <= set(
+            names
+        )
+        # the abandoned old-world stage was pruned by the commit's GC
+        assert not os.path.exists(old_stage)
+    finally:
+        saver.stop()
+
+
+def test_commit_quarantines_stage_gutted_during_rename(tmp_path):
+    """The narrow race: a resize re-save clears stale files between the
+    commit barrier check and the stage->final rename.  The post-rename
+    validation must quarantine the gutted dir instead of recording it in
+    the tracker (a committed-but-incomplete checkpoint is unrestorable)."""
+    saver = AsyncCheckpointSaver(
+        str(tmp_path / "ckpt"), local_shard_num=1, global_shard_num=2,
+        node_rank=0,
+    )
+    try:
+        stage = saver._stage_dir(9)
+        os.makedirs(stage)
+        open(os.path.join(stage, "world-2"), "w").close()
+        for sid in (0, 1):
+            open(os.path.join(stage, f"shard-{sid}.bin"), "w").close()
+            open(os.path.join(stage, f"done-{sid}-w2"), "w").close()
+
+        real_move = saver.storage.safe_move
+
+        def gut_then_move(src, dst):
+            # the re-saving world deletes a stale done-file exactly
+            # between the barrier check and the rename
+            victim = os.path.join(stage, "done-1-w2")
+            if os.path.exists(victim):
+                os.unlink(victim)
+            real_move(src, dst)
+
+        saver.storage.safe_move = gut_then_move
+        saver.commit_checkpoint(9, timeout=5.0)
+        saver.storage.safe_move = real_move
+
+        final = saver._final_dir(9)
+        assert not os.path.exists(final), "gutted stage must not commit"
+        assert os.path.exists(final + ".invalid"), "quarantine dir missing"
+        tracker = os.path.join(str(tmp_path / "ckpt"), "latest_step")
+        assert not os.path.exists(tracker) or "9" not in open(tracker).read()
     finally:
         saver.stop()

@@ -468,3 +468,177 @@ def test_zero_replica_role_does_not_flip_node_groups_mode():
     }
     cmd = build_master_pod_spec(job, "ns")["spec"]["containers"][0]["command"]
     assert "--node_groups" not in cmd
+
+
+def test_workerless_cr_emits_node_num_zero():
+    """A chief+ps-only CR must not size the master for a phantom worker:
+    --node_num 0 with the roles carried by --node_groups (ADVICE r4)."""
+    from dlrover_tpu.operator.main import build_master_pod_spec
+
+    job = {
+        "metadata": {"name": "psonly", "uid": "u9"},
+        "spec": {
+            "image": "img",
+            "replicaSpecs": {
+                "chief": {"replicas": 1},
+                "ps": {"replicas": 2},
+            },
+        },
+    }
+    cmd = build_master_pod_spec(job, "ns")["spec"]["containers"][0]["command"]
+    assert cmd[cmd.index("--node_num") + 1] == "0"
+    assert "--node_groups" in cmd
+
+    # empty replicaSpecs keeps the legacy single-worker shorthand
+    legacy = {
+        "metadata": {"name": "legacy", "uid": "u10"},
+        "spec": {"image": "img"},
+    }
+    cmd2 = build_master_pod_spec(legacy, "ns")["spec"]["containers"][0]["command"]
+    assert cmd2[cmd2.index("--node_num") + 1] == "1"
+
+
+def test_dist_master_zero_workers_idles_and_negative_rejected():
+    """node_num 0 without groups is a valid scaled-to-zero job (the
+    operator emits it for workerless CRs; crash-looping the master pod
+    would make suspend unrecoverable); negative is a hard error."""
+    import pytest as _pytest
+
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+    from dlrover_tpu.scheduler.in_memory import (
+        InMemoryCluster,
+        InMemoryNodeWatcher,
+        InMemoryScaler,
+    )
+
+    cluster = InMemoryCluster()
+    master = DistributedJobMaster(
+        0,
+        scaler=InMemoryScaler(cluster),
+        watcher=InMemoryNodeWatcher(cluster),
+        node_num=0,
+    )
+    assert master._node_num == 0
+
+    with _pytest.raises(ValueError, match="node_num"):
+        DistributedJobMaster(
+            0,
+            scaler=InMemoryScaler(InMemoryCluster()),
+            watcher=InMemoryNodeWatcher(cluster),
+            node_num=-1,
+        )
+
+
+def test_per_pod_services_created_and_stable_across_relaunch():
+    """The scaler creates a headless Service per pod keyed on
+    (type, rank) so a relaunched pod keeps its DNS address (reference:
+    pod_scaler.py:608 k8sServiceFactory)."""
+    from dlrover_tpu.scheduler.k8s import build_pod_service_spec
+
+    class FakeApiWithServices(FakePodApi):
+        def __init__(self):
+            super().__init__()
+            self.services = {}
+            self.service_creates = 0
+
+        def create_namespaced_service(self, namespace, body):
+            self.service_creates += 1
+            name = body["metadata"]["name"]
+            if name in self.services:
+                raise RuntimeError("409 AlreadyExists")
+            self.services[name] = body
+
+    api = FakeApiWithServices()
+    scaler = PodScaler("jobx", api=api, image="img", node_num=2)
+    plan = ScalePlan()
+    plan.launch_nodes = [
+        Node("ps", 0, rank_index=0), Node("worker", 1, rank_index=0),
+    ]
+    scaler.scale(plan)
+    assert scaler.create_pending_pods() == 2
+    assert set(api.services) == {"jobx-ps-0", "jobx-worker-0"}
+    svc = api.services["jobx-ps-0"]
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["selector"]["dlrover-tpu/rank-index"] == "0"
+    assert svc["spec"]["selector"]["dlrover-tpu/node-type"] == "ps"
+
+    # relaunch of the same rank: pod create succeeds, service create
+    # hits AlreadyExists and is tolerated; the address is unchanged
+    plan2 = ScalePlan()
+    plan2.launch_nodes = [Node("ps", 7, rank_index=0)]
+    scaler.scale(plan2)
+    assert scaler.create_pending_pods() == 1
+    assert set(api.services) == {"jobx-ps-0", "jobx-worker-0"}
+
+    # the spec itself round-trips the selector labels build_pod_spec sets
+    pod = build_pod_spec("jobx", Node("ps", 7, rank_index=0),
+                         image="i", command=["c"])
+    svc_spec = build_pod_service_spec("jobx", Node("ps", 7, rank_index=0))
+    for k, v in svc_spec["spec"]["selector"].items():
+        assert pod["metadata"]["labels"][k] == v
+
+
+def test_worker_spec_without_replicas_defaults_to_one():
+    """k8s convention: a present role omitting 'replicas' means 1, not 0
+    (a job must not silently idle because the key was left off)."""
+    from dlrover_tpu.operator.main import build_master_pod_spec
+
+    job = {
+        "metadata": {"name": "defjob", "uid": "u11"},
+        "spec": {
+            "image": "img",
+            "replicaSpecs": {"worker": {"resources": {"cpu": 1}}},
+        },
+    }
+    cmd = build_master_pod_spec(job, "ns")["spec"]["containers"][0]["command"]
+    assert cmd[cmd.index("--node_num") + 1] == "1"
+
+
+def test_pod_and_service_carry_owner_ref_and_service_retries():
+    """Owner refs flow CR -> master (--job_uid) -> scaler -> pod/Service
+    manifests so cluster GC reclaims them with the job; a transiently
+    failed Service create is requeued (nothing else recreates it)."""
+    from dlrover_tpu.operator.main import build_master_pod_spec
+    from dlrover_tpu.scheduler.k8s import build_pod_service_spec
+
+    cr = {
+        "metadata": {"name": "gcjob", "uid": "cr-uid-1"},
+        "spec": {"image": "img",
+                 "replicaSpecs": {"worker": {"replicas": 1}}},
+    }
+    cmd = build_master_pod_spec(cr, "ns")["spec"]["containers"][0]["command"]
+    assert cmd[cmd.index("--job_uid") + 1] == "cr-uid-1"
+
+    owner = {"apiVersion": "dlrover-tpu.org/v1alpha1", "kind": "ElasticJob",
+             "name": "gcjob", "uid": "cr-uid-1", "controller": False,
+             "blockOwnerDeletion": False}
+    pod = build_pod_spec("gcjob", Node("worker", 0, rank_index=0),
+                         image="i", command=["c"], owner_ref=owner)
+    assert pod["metadata"]["ownerReferences"][0]["uid"] == "cr-uid-1"
+    svc = build_pod_service_spec("gcjob", Node("worker", 0, rank_index=0),
+                                 owner_ref=owner)
+    assert svc["metadata"]["ownerReferences"][0]["uid"] == "cr-uid-1"
+
+    class FlakyServiceApi(FakePodApi):
+        def __init__(self):
+            super().__init__()
+            self.services = {}
+            self.fail_service_creates = 1
+
+        def create_namespaced_service(self, namespace, body):
+            if self.fail_service_creates > 0:
+                self.fail_service_creates -= 1
+                raise RuntimeError("apiserver unavailable")
+            self.services[body["metadata"]["name"]] = body
+
+    api = FlakyServiceApi()
+    scaler = PodScaler("gcjob", api=api, owner_ref=owner, image="img")
+    plan = ScalePlan()
+    plan.launch_nodes = [Node("worker", 0, rank_index=0)]
+    scaler.scale(plan)
+    assert scaler.create_pending_pods() == 1
+    assert api.services == {}  # first create bounced
+    scaler.create_pending_pods()  # creator-loop pass retries the Service
+    assert "gcjob-worker-0" in api.services
+    assert api.services["gcjob-worker-0"]["metadata"][
+        "ownerReferences"][0]["uid"] == "cr-uid-1"

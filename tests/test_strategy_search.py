@@ -61,31 +61,81 @@ def test_enumerate_memory_budget_prunes():
     assert cands == []
 
 
-def test_search_picks_best_and_beats_worst():
+def test_search_picks_best_and_beats_worst(monkeypatch):
+    """Ranking logic against an injected deterministic profiler — the
+    reference tests its search the same way (bo_sg_test.py fakes dryrun
+    results).  Real compiles under CPU contention made this flake when
+    it profiled for real; the real-compile path is covered by
+    test_auto_accelerate_end_to_end."""
+    from dlrover_tpu.accel.engine import engine as engine_mod
+
     cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=4, scan_layers=True)
     model = LlamaModel(cfg)
-    # one retry: on a loaded 1-core host a dryrun can stall past its
-    # budget and fail a candidate — a scheduling artifact, not a search
-    # bug (the ranking logic itself is deterministic given measurements)
-    for attempt in range(2):
-        report = search_strategy(
-            model,
-            (8, 32),
-            max_candidates=4,
-            warmup_steps=1,
-            profile_steps=2,
-            halving_survivors=2,
-        )
-        if report.best is not None and len(report.succeeded) >= 2:
-            break
+
+    # throughput keyed on the mesh: dp-heavy best, pp-heavy worst
+    def fake_dry_run(model_, cand, batch_shape, **kw):
+        spec = cand.config.mesh_spec
+        cand.tokens_per_sec = 1000.0 * spec.dp + 10.0 * spec.tp
+        cand.failed = None
+        cand.result = None
+        return cand
+
+    monkeypatch.setattr(engine_mod, "dry_run_candidate", fake_dry_run)
+    report = engine_mod.search_strategy(
+        model,
+        (8, 32),
+        max_candidates=4,
+        warmup_steps=1,
+        profile_steps=2,
+        halving_survivors=2,
+    )
     assert report.best is not None
     assert len(report.succeeded) >= 2, [c.failed for c in report.candidates]
     worst = min(c.tokens_per_sec for c in report.succeeded)
     assert report.best.tokens_per_sec >= worst
-    # the winner is a real measured strategy, not the enumeration order
+    # the winner is the measured argmax, not the enumeration order
     assert report.best.tokens_per_sec == max(
         c.tokens_per_sec for c in report.succeeded
     )
+
+
+def test_search_survives_failing_candidates(monkeypatch):
+    """Candidates that fail to dry-run are dropped, the search still
+    ranks the survivors, and a genuine all-failed search raises."""
+    from dlrover_tpu.accel.engine import engine as engine_mod
+
+    cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=4, scan_layers=True)
+    model = LlamaModel(cfg)
+    calls = []
+
+    def flaky_dry_run(model_, cand, batch_shape, **kw):
+        calls.append(cand.name)
+        if cand.config.mesh_spec.tp > 1:
+            cand.tokens_per_sec = None
+            cand.failed = "XlaRuntimeError: RESOURCE_EXHAUSTED (injected)"
+        else:
+            cand.tokens_per_sec = 500.0 * cand.config.mesh_spec.dp
+            cand.failed = None
+        cand.result = None
+        return cand
+
+    monkeypatch.setattr(engine_mod, "dry_run_candidate", flaky_dry_run)
+    report = engine_mod.search_strategy(
+        model, (8, 32), max_candidates=4, halving_survivors=2
+    )
+    assert report.best is not None
+    assert report.best.config.mesh_spec.tp == 1
+    assert all(c.failed for c in report.candidates
+               if c.config.mesh_spec.tp > 1)
+
+    def all_fail(model_, cand, batch_shape, **kw):
+        cand.tokens_per_sec = None
+        cand.failed = "boom"
+        return cand
+
+    monkeypatch.setattr(engine_mod, "dry_run_candidate", all_fail)
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        engine_mod.search_strategy(model, (8, 32), max_candidates=4)
 
 
 def test_auto_accelerate_end_to_end():
