@@ -9,9 +9,10 @@ throughput in engine/planner.py + sg_algo/).
 
 TPU-native: JAX is single-controller, so no gRPC service or rank-0
 election is needed — the engine is an in-process loop: ANALYSE the model,
-enumerate candidates (planner), DRYRUN them in promise order with
-successive halving, FINISH with the best config materialized as a full
-:class:`AccelerateResult`.
+enumerate candidates (planner), DRYRUN them under a GP/EI Bayesian-
+optimization budget (the reference's bayes_opt_sg algorithm, backed by
+dlrover_tpu.brain.hpsearch), FINISH with the best config materialized
+as a full :class:`AccelerateResult`.
 """
 
 from __future__ import annotations
@@ -36,6 +37,11 @@ class SearchReport:
 
     candidates: List[Candidate]
     best: Optional[Candidate] = None
+    # how many dry-runs the first (search) phase spent, and which
+    # algorithm spent them — the BO-vs-exhaustive comparison tests key
+    # on this (reference: sg_algo/bayes_opt_sg.py's budgeted search)
+    dryruns_used: int = 0
+    algo: str = "bo"
 
     @property
     def succeeded(self) -> List[Candidate]:
@@ -44,6 +50,19 @@ class SearchReport:
             for c in self.candidates
             if c.tokens_per_sec is not None and c.failed is None
         ]
+
+
+_MESH_AXES = ("dp", "fsdp", "tp", "pp", "sp", "cp", "ep", "dcn_dp")
+
+
+def _mesh_features(spec) -> dict:
+    """Numeric GP features of a parallelism layout: log2 of each mesh
+    axis.  Throughput is smooth-ish in these (doubling tp has a similar
+    relative effect at any dp), which is what gives the GP predictive
+    power across the enumerated candidates."""
+    import math
+
+    return {ax: math.log2(getattr(spec, ax)) for ax in _MESH_AXES}
 
 
 def search_strategy(
@@ -60,13 +79,24 @@ def search_strategy(
     warmup_steps: int = 1,
     profile_steps: int = 3,
     halving_survivors: int = 3,
+    search_algo: str = "bo",
+    max_dryruns: Optional[int] = None,
+    n_init: int = 3,
+    seed: int = 0,
 ) -> SearchReport:
-    """Enumerate -> dry-run -> successive-halving refine -> pick best.
+    """Enumerate -> Bayesian-optimized dry-runs -> re-profile finalists.
 
-    Round 1 times every candidate briefly; round 2 re-times the top
-    ``halving_survivors`` with 3x profile steps to de-noise the ranking
-    (a deterministic stand-in for the reference's HEBO loop that fits
-    dry-run budgets; the BO hook lives in dlrover_tpu.brain.hpsearch).
+    The search phase is GP/EI Bayesian optimization over the enumerated
+    strategies (reference:
+    atorch/atorch/auto/engine/sg_algo/bayes_opt_sg.py + its vendored
+    HEBO): seed with the first ``n_init`` candidates in promise order,
+    fit a GP on log-throughput over the mesh-axis features, and spend
+    the remaining ``max_dryruns`` budget on expected-improvement
+    argmaxes — failed candidates are observed at a penalty so the GP
+    steers away from their region.  ``search_algo="grid"`` profiles
+    every candidate (the budget-less fallback).  A final round re-times
+    the top ``halving_survivors`` with 3x profile steps to de-noise the
+    ranking before picking the winner.
     """
     import jax
 
@@ -106,8 +136,8 @@ def search_strategy(
         [c.name for c in candidates],
     )
 
-    for cand in candidates:
-        dry_run_candidate(
+    def profile(cand: Candidate, steps: int) -> Candidate:
+        return dry_run_candidate(
             model,
             cand,
             batch_shape,
@@ -115,10 +145,58 @@ def search_strategy(
             loss_fn=loss_fn,
             devices=devices,
             warmup_steps=warmup_steps,
-            profile_steps=profile_steps,
+            profile_steps=steps,
         )
 
-    report = SearchReport(candidates=candidates)
+    budget = max_dryruns if max_dryruns is not None else len(candidates)
+    budget = max(1, budget)
+    dryruns = 0
+    if search_algo == "bo" and len(candidates) <= max(n_init, 1):
+        # too few candidates for the GP to ever act — honest label
+        search_algo = "grid"
+    if search_algo == "grid":
+        for cand in candidates[:budget]:
+            profile(cand, profile_steps)
+            dryruns += 1
+    elif search_algo == "bo":
+        import math
+
+        from dlrover_tpu.brain.hpsearch import BayesianOptimizer, Param
+
+        log_n = max(1.0, math.log2(max(2, n)))
+        space = [Param(ax, low=0.0, high=log_n) for ax in _MESH_AXES]
+        bo = BayesianOptimizer(space, seed=seed, n_init=n_init)
+        remaining = list(candidates)
+        values: List[float] = []
+        while remaining and dryruns < budget:
+            done_ok = len(values)
+            if done_ok < n_init:
+                # seed in promise order: enumeration already front-loads
+                # the expected winners, giving the GP an informative prior
+                cand = remaining.pop(0)
+            else:
+                idx = bo.suggest_from(
+                    [_mesh_features(c.config.mesh_spec) for c in remaining]
+                )
+                cand = remaining.pop(idx)
+            profile(cand, profile_steps)
+            dryruns += 1
+            feats = _mesh_features(cand.config.mesh_spec)
+            if cand.tokens_per_sec is not None and cand.failed is None:
+                val = math.log(max(1e-9, cand.tokens_per_sec))
+                values.append(val)
+                bo.observe(feats, val)
+            else:
+                # steer the GP away from infeasible regions (OOM,
+                # invalid sharding) without poisoning the scale
+                penalty = (min(values) - 2.0) if values else -10.0
+                bo.observe(feats, penalty)
+    else:
+        raise ValueError(f"unknown search_algo {search_algo!r}")
+
+    report = SearchReport(
+        candidates=candidates, dryruns_used=dryruns, algo=search_algo
+    )
     ranked = sorted(
         report.succeeded, key=lambda c: -(c.tokens_per_sec or 0.0)
     )

@@ -1,0 +1,327 @@
+"""Sharded memory planning without hardware — can this model train on
+that mesh?
+
+The reference publishes hand-made memory tables for its headline
+Llama2-7B runs (reference: atorch/examples/llama2/README.md:395-411);
+here the plan is DERIVED: parameter/gradient/optimizer bytes come from
+``jax.eval_shape`` over the real model init plus the REAL logical
+sharding rules accelerate() trains with (accel/parallel/mesh.py
+DEFAULT_LOGICAL_RULES -> flax ``logical_to_mesh_axes``), so the
+per-device state bytes are exactly what the jitted train step would
+allocate — no devices needed.  Activations are an analytic model (the
+one knob eval_shape cannot see), consistent with the planner's
+estimator and calibrated against XLA's own memory analysis on a small
+mesh (see MEMPLAN.md).
+
+Admission: :func:`plan_memory` takes an HBM budget and answers
+fits/doesn't, and when the base plan overflows but an
+``offload_optimizer_states`` variant fits, the plan carries that
+suggestion — the planner test gates on this exact behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.parallel.mesh import (
+    DEFAULT_LOGICAL_RULES,
+    MeshSpec,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class MemoryPlan:
+    """Per-device byte budget of one (model, mesh, options) combination."""
+
+    mesh_spec: MeshSpec
+    params_bytes: int          # sharded master params (param_dtype)
+    grads_bytes: int           # one gradient tree (param_dtype)
+    opt_device_bytes: int      # optimizer state resident in HBM
+    opt_host_bytes: int        # optimizer state offloaded to host RAM
+    activation_bytes: int      # analytic peak activations (see notes)
+    optimizer: str = "adamw"
+    offload_optimizer: bool = False
+    hbm_budget_bytes: Optional[int] = None
+    suggestion: str = ""
+    notes: str = ""
+
+    @property
+    def total_device_bytes(self) -> int:
+        return (
+            self.params_bytes + self.grads_bytes
+            + self.opt_device_bytes + self.activation_bytes
+        )
+
+    @property
+    def fits(self) -> Optional[bool]:
+        if self.hbm_budget_bytes is None:
+            return None
+        return self.total_device_bytes <= self.hbm_budget_bytes
+
+    def row(self) -> Dict[str, Any]:
+        gib = 1024 ** 3
+        return {
+            "mesh": str(self.mesh_spec.dims),
+            "optimizer": self.optimizer,
+            "offload": self.offload_optimizer,
+            "params_gib": round(self.params_bytes / gib, 2),
+            "grads_gib": round(self.grads_bytes / gib, 2),
+            "opt_device_gib": round(self.opt_device_bytes / gib, 2),
+            "opt_host_gib": round(self.opt_host_bytes / gib, 2),
+            "acts_gib": round(self.activation_bytes / gib, 2),
+            "total_gib": round(self.total_device_bytes / gib, 2),
+            "budget_gib": (
+                round(self.hbm_budget_bytes / gib, 2)
+                if self.hbm_budget_bytes else None
+            ),
+            "fits": self.fits,
+            "suggestion": self.suggestion,
+        }
+
+
+# bytes per parameter element of DEVICE-resident optimizer state
+# (offload moves these to host).  adamw: fp32 m + v.  quantized_adamw:
+# int8 m + v plus one fp32 scale per quantization block.  adafactor:
+# factored row/col stats, O(sqrt) — counted as ~0.1 byte/elem upper
+# bound for planning.
+_OPT_STATE_BYTES_PER_ELEM = {
+    "adamw": 8.0,
+    "quantized_adamw": 2.0 + 2 * 4.0 / 128.0,
+    "adafactor": 0.1,
+    "sgd_momentum": 4.0,
+}
+
+
+def _mesh_axis_sizes(spec: MeshSpec) -> Dict[str, int]:
+    return {
+        "dp": spec.dp, "fsdp": spec.fsdp, "pp": spec.pp, "cp": spec.cp,
+        "sp": spec.sp, "ep": spec.ep, "tp": spec.tp,
+    }
+
+
+def _sharded_bytes(leaf, part_spec, sizes: Dict[str, int]) -> int:
+    """Per-device bytes of one leaf under a mesh PartitionSpec — ceil
+    division per sharded dim, exactly like GSPMD's shard shapes."""
+    shape = list(leaf.shape)
+    if part_spec is not None:
+        for i, entry in enumerate(tuple(part_spec)[: len(shape)]):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            denom = 1
+            for ax in axes:
+                denom *= sizes.get(ax, 1)
+            shape[i] = -(-shape[i] // denom)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * jnp.dtype(leaf.dtype).itemsize
+
+
+def _param_plan(
+    model, batch_shape, spec: MeshSpec, rules
+) -> Tuple[int, int]:
+    """(per-device param bytes, per-device param ELEMENT count) from the
+    real init shapes + real sharding rules."""
+    import flax.linen as nn
+
+    dummy = jnp.zeros(batch_shape, jnp.int32)
+    variables = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), dummy
+    )
+    logical = nn.get_partition_spec(variables)["params"]
+    is_spec = lambda x: x is None or isinstance(  # noqa: E731
+        x, jax.sharding.PartitionSpec
+    )
+    mesh_specs = jax.tree_util.tree_map(
+        lambda ps: nn.logical_to_mesh_axes(ps, list(rules)),
+        logical,
+        is_leaf=is_spec,
+    )
+    sizes = _mesh_axis_sizes(spec)
+    params = nn.unbox(variables)["params"]
+    flat_p = jax.tree_util.tree_leaves(params)
+    flat_s = jax.tree_util.tree_leaves(
+        mesh_specs, is_leaf=lambda x: x is None or hasattr(x, "index")
+    )
+    if len(flat_s) != len(flat_p):
+        # defensive: unpartitioned leaves collapse in the spec tree —
+        # fall back to per-leaf replicated for the mismatch
+        logger.warning(
+            "sharding-spec tree mismatch (%d specs / %d params); "
+            "unmatched leaves counted replicated", len(flat_s), len(flat_p),
+        )
+        flat_s = flat_s + [None] * (len(flat_p) - len(flat_s))
+    total_bytes = 0
+    total_elems = 0
+    for leaf, ps in zip(flat_p, flat_s):
+        b = _sharded_bytes(leaf, ps, sizes)
+        total_bytes += b
+        total_elems += b // jnp.dtype(leaf.dtype).itemsize
+    return total_bytes, total_elems
+
+
+def _activation_bytes(
+    cfg, batch_shape, spec: MeshSpec, remat: bool
+) -> int:
+    """Analytic peak activations per device (bf16 activations).
+
+    With full remat ("nothing_saveable") the scan saves one residual
+    stream per layer (B_l x S_l x H) and the backward recomputes one
+    layer at a time, whose working set is the qkv/attn-out tensors plus
+    the MLP intermediate; without remat every layer's working set is
+    live.  The chunked vocab loss never materializes B x S x V logits
+    (ops/losses.py), so the LM head contributes one hidden-sized chunk.
+    Consistent with planner.estimate_memory_bytes; calibrated against
+    XLA memory analysis in MEMPLAN.md.
+    """
+    b, s = batch_shape
+    b_local = -(-b // (spec.dp * spec.fsdp))
+    s_local = -(-s // (spec.cp * spec.sp))
+    h = cfg.hidden_size
+    inter = cfg.intermediate_size // max(1, spec.tp)
+    heads = cfg.num_heads // max(1, spec.tp)
+    d = cfg.head_dim or (h // cfg.num_heads)
+    layers_local = cfg.num_layers // max(1, spec.pp)
+    act = 2  # bf16
+    # one layer's working set: residual + pre-norm (h each), q/k/v/o
+    # (heads*d each, tp-sharded via heads), gate/up/down (inter each,
+    # tp-sharded); flash attention adds block-sized scratch, not B x S^2
+    layer_ws = b_local * s_local * (
+        2 * h + 4 * heads * d + 3 * inter
+    ) * act
+    residuals = b_local * s_local * h * act * layers_local
+    if remat:
+        # backward holds the saved residuals plus ~2 layers' recompute
+        peak = residuals + 2 * layer_ws
+    else:
+        peak = residuals + layers_local * layer_ws
+    # chunked LM head (ops/losses.py): one fp32 logits chunk, never the
+    # full B x S x V tensor
+    chunk = min(cfg.vocab_size, 8192)
+    peak += b_local * s_local * (chunk // max(1, spec.tp)) * 4
+    return int(peak)
+
+
+def plan_memory(
+    model,
+    mesh_spec: MeshSpec,
+    batch_shape: Tuple[int, int],
+    *,
+    logical_rules: Optional[Sequence[Tuple[str, Any]]] = None,
+    optimizer: str = "adamw",
+    offload_optimizer: bool = False,
+    hbm_budget_bytes: Optional[int] = None,
+    remat: Optional[bool] = None,
+    activation_safety: float = 2.0,
+) -> MemoryPlan:
+    """Derive the per-device memory budget of training ``model`` on
+    ``mesh_spec`` — and, if it overflows ``hbm_budget_bytes``, whether
+    offloading the optimizer states would make it fit (the suggestion
+    the strategy planner surfaces on rejection)."""
+    cfg = getattr(model, "config", None)
+    if cfg is None:
+        raise ValueError("plan_memory needs a model with a .config")
+    rules = tuple(logical_rules or DEFAULT_LOGICAL_RULES)
+    if mesh_spec.pp > 1:
+        rules = tuple(
+            ("layers", "pp") if r[0] == "layers" and r[1] is None else r
+            for r in rules
+        )
+    if remat is None:
+        remat = bool(getattr(cfg, "remat", True))
+
+    params_bytes, param_elems = _param_plan(
+        model, batch_shape, mesh_spec, rules
+    )
+    grads_bytes = params_bytes  # same tree, same shardings
+    per_elem = _OPT_STATE_BYTES_PER_ELEM.get(optimizer)
+    if per_elem is None:
+        raise ValueError(
+            f"unknown optimizer {optimizer!r}; known: "
+            f"{sorted(_OPT_STATE_BYTES_PER_ELEM)}"
+        )
+    opt_bytes = int(param_elems * per_elem)
+    # activation_safety covers what the analytic model cannot see —
+    # XLA scheduling slack, collective staging buffers, fusion
+    # boundaries.  The state bytes need no slack: they match XLA's
+    # buffer assignment exactly (MEMPLAN.md calibration).
+    acts = int(
+        _activation_bytes(cfg, batch_shape, mesh_spec, remat)
+        * activation_safety
+    )
+
+    plan = MemoryPlan(
+        mesh_spec=mesh_spec,
+        params_bytes=params_bytes,
+        grads_bytes=grads_bytes,
+        opt_device_bytes=0 if offload_optimizer else opt_bytes,
+        opt_host_bytes=opt_bytes if offload_optimizer else 0,
+        activation_bytes=acts,
+        optimizer=optimizer,
+        offload_optimizer=offload_optimizer,
+        hbm_budget_bytes=hbm_budget_bytes,
+        notes=(
+            "params/grads/opt from eval_shape + real logical sharding "
+            "rules; activations analytic "
+            f"(remat={'full' if remat else 'off'})"
+        ),
+    )
+    if plan.fits is False and not offload_optimizer:
+        # cheapest fix first: int8 moments keep states on-device (no
+        # PCIe streaming in the update); offload is the bigger hammer
+        if optimizer == "adamw":
+            q = int(param_elems * _OPT_STATE_BYTES_PER_ELEM[
+                "quantized_adamw"])
+            quantized = dataclasses.replace(
+                plan, opt_device_bytes=q, suggestion="",
+            )
+            if quantized.fits:
+                plan.suggestion = (
+                    "switch to quantized_adamw (int8 moments): optimizer "
+                    f"states shrink to {q / 1024**3:.1f} GiB/device and "
+                    "the plan fits "
+                    f"({quantized.total_device_bytes / 1024**3:.1f} GiB "
+                    f"<= {hbm_budget_bytes / 1024**3:.1f} GiB)"
+                )
+        if not plan.suggestion:
+            offloaded = dataclasses.replace(
+                plan, opt_device_bytes=0, opt_host_bytes=opt_bytes,
+                offload_optimizer=True,
+            )
+            if offloaded.fits:
+                plan.suggestion = (
+                    "enable offload_optimizer_states: optimizer states "
+                    f"({opt_bytes / 1024**3:.1f} GiB/device) move to "
+                    f"host RAM and the plan fits "
+                    f"({offloaded.total_device_bytes / 1024**3:.1f} GiB "
+                    f"<= {hbm_budget_bytes / 1024**3:.1f} GiB)"
+                )
+    return plan
+
+
+# -- known HBM budgets (GiB) for planning tables ---------------------------
+HBM_GIB = {
+    "v5e": 16,
+    "v5p": 95,
+    "v4": 32,
+    "v6e": 32,
+}
+
+
+def hbm_budget(device_kind: str, headroom: float = 0.9) -> int:
+    """Usable HBM bytes for planning: chip HBM x headroom (XLA reserves
+    runtime scratch; 10% is the conventional allowance)."""
+    gib = HBM_GIB.get(device_kind)
+    if gib is None:
+        raise ValueError(
+            f"unknown device kind {device_kind!r}; known: "
+            f"{sorted(HBM_GIB)}"
+        )
+    return int(gib * headroom * 1024 ** 3)

@@ -376,6 +376,16 @@ class MasterClient:
         reply = self._get(comm.ElasticRunConfigRequest())
         return reply.configs
 
+    @retry_rpc()
+    def query_job_detail(self) -> dict:
+        """Master-side job state incl. collected metrics — node status,
+        global step, speed and the goodput breakdown (reference: the
+        Brain/metrics query surface)."""
+        import json as _json
+
+        reply = self._get(comm.JobDetailRequest())
+        return _json.loads(reply.content) if reply.content else {}
+
     # ------------------------------------------------------------ PS path
     @retry_rpc()
     def query_ps_nodes(self):

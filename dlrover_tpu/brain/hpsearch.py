@@ -79,6 +79,40 @@ class BayesianOptimizer:
         done = [t for t in self.trials if t.value is not None]
         if len(done) < self._n_init:
             return {p.name: p.sample(self._rng) for p in self.space}
+        X, L, alpha, yn = self._fit(done)
+        cands = np.array([
+            [p.unit(p.sample(self._rng)) for p in self.space]
+            for _ in range(self._n_candidates)
+        ])
+        mu, sigma = self._posterior(cands, X, L, alpha)
+        ei = self._expected_improvement(mu, sigma, yn.max())
+        x = cands[int(np.argmax(ei))]
+        return {
+            p.name: p.clip(self._denorm(p, x[i]))
+            for i, p in enumerate(self.space)
+        }
+
+    def suggest_from(self, pool: Sequence[Dict[str, float]]) -> int:
+        """EI-argmax over an EXPLICIT candidate pool; returns the pool
+        index.  This is the discrete-design-space entry the accelerate
+        strategy engine uses (enumerated parallelism layouts are a
+        finite set — the GP ranks which un-profiled layout to dry-run
+        next; reference counterpart:
+        atorch/atorch/auto/engine/sg_algo/bayes_opt_sg.py)."""
+        if not pool:
+            raise ValueError("empty candidate pool")
+        done = [t for t in self.trials if t.value is not None]
+        if len(done) < self._n_init:
+            return int(self._rng.randint(len(pool)))
+        X, L, alpha, yn = self._fit(done)
+        P = np.array([[p.unit(c[p.name]) for p in self.space]
+                      for c in pool])
+        mu, sigma = self._posterior(P, X, L, alpha)
+        ei = self._expected_improvement(mu, sigma, yn.max())
+        return int(np.argmax(ei))
+
+    def _fit(self, done: Sequence["Trial"]):
+        """GP posterior precomputation over finished trials."""
         X = np.array([[p.unit(t.params[p.name]) for p in self.space]
                       for t in done])
         y = np.array([t.value for t in done], dtype=np.float64)
@@ -87,23 +121,15 @@ class BayesianOptimizer:
         K = self._kernel(X, X) + self._noise * np.eye(len(X))
         L = np.linalg.cholesky(K)
         alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        return X, L, alpha, yn
 
-        cands = np.array([
-            [p.unit(p.sample(self._rng)) for p in self.space]
-            for _ in range(self._n_candidates)
-        ])
-        Ks = self._kernel(cands, X)
+    def _posterior(self, P: np.ndarray, X: np.ndarray,
+                   L: np.ndarray, alpha: np.ndarray):
+        Ks = self._kernel(P, X)
         mu = Ks @ alpha
         v = np.linalg.solve(L, Ks.T)
         var = np.maximum(1e-12, 1.0 - np.sum(v * v, axis=0))
-        sigma = np.sqrt(var)
-        best = yn.max()
-        ei = self._expected_improvement(mu, sigma, best)
-        x = cands[int(np.argmax(ei))]
-        return {
-            p.name: p.clip(self._denorm(p, x[i]))
-            for i, p in enumerate(self.space)
-        }
+        return mu, np.sqrt(var)
 
     def observe(self, params: Dict[str, float], value: float) -> None:
         self.trials.append(Trial(params=dict(params), value=float(value)))

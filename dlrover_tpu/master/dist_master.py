@@ -222,6 +222,7 @@ class DistributedJobMaster:
             )
         self.task_manager.start()
         self.job_manager.start()
+        self.job_metric_collector.mark_job_start()
         self.diagnosis_manager.start_observing()
         if self.job_auto_scaler is not None:
             self.job_auto_scaler.start_auto_scaling()

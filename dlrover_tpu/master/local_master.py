@@ -65,6 +65,7 @@ class LocalJobMaster:
                 node_unit=1,
             )
         self.task_manager.start()
+        self.job_metric_collector.mark_job_start()
         self._server.add_insecure_port(f"[::]:{self._port}")
         self._server.start()
         logger.info("Local master serving on port %s", self._port)

@@ -243,6 +243,8 @@ class MasterServicer:
         detail = {}
         if self._job_manager is not None:
             detail = self._job_manager.get_job_detail()
+        if self._job_metric_collector is not None:
+            detail["metrics"] = self._job_metric_collector.get_job_metrics()
         return comm.JobDetailReply(content=json.dumps(detail))
 
     # ------------------------------------------------------------ report

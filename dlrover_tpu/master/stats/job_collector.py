@@ -55,14 +55,123 @@ class JobMetricCollector:
         self.node_usage: Dict[str, Dict[str, Any]] = {}
         self.events: Deque[Dict[str, Any]] = deque(maxlen=max_samples)
         self.job_meta: Dict[str, Any] = {}
+        # -- goodput accounting (reference README.md:54-57: "the time
+        # spent computing useful new steps over the elapsed time of the
+        # training job").  Time between two step reports is credited as
+        # productive only for steps never completed before — a rollback
+        # re-run earns nothing, so fault downtime, rendezvous, recompile
+        # AND re-done work all count against goodput.
+        self._job_start_ts: Optional[float] = None
+        self._prev_step: Optional[int] = None
+        self._prev_ts: Optional[float] = None
+        self._credited_step: int = -1  # highest step whose time counted
+        self._productive_s: float = 0.0
+        self._first_report_ts: Optional[float] = None
+        self._last_report_ts: Optional[float] = None
+        # recent per-step durations from clean windows: a report window
+        # hiding a stall/restart (worker died and resumed between two
+        # samples with net progress) is detected as per-step time far
+        # above the median and credited at the typical rate instead of
+        # the wall gap
+        self._step_times: Deque[float] = deque(maxlen=64)
 
     # ---------------------------------------------------------- reporting
+    def mark_job_start(self, timestamp: Optional[float] = None) -> None:
+        """Start the goodput wall clock (master ``prepare``): startup,
+        scheduling and first-compile latency all count as downtime."""
+        with self._lock:
+            if self._job_start_ts is None:
+                self._job_start_ts = (
+                    time.time() if timestamp is None else timestamp
+                )
+
     def report_global_step(self, step: int, timestamp: float) -> None:
         with self._lock:
             self.steps.append({"step": step, "timestamp": timestamp})
+            self._account_goodput(step, timestamp)
         self._reporter.report(
             {"kind": "global_step", "step": step, "timestamp": timestamp}
         )
+
+    def _account_goodput(self, step: int, ts: float) -> None:
+        """Credit the interval since the previous report to the NEW steps
+        it completed (none on rollback re-runs or across restarts);
+        called under the lock."""
+        if self._job_start_ts is None:
+            self._job_start_ts = ts
+        prev_step, prev_ts = self._prev_step, self._prev_ts
+        if prev_ts is not None and ts <= prev_ts:
+            # clock skew: drop the report from the ledger entirely —
+            # adopting its timestamp as prev would stretch the next
+            # in-order interval and over-credit productive time
+            return
+        self._prev_step, self._prev_ts = step, ts
+        self._last_report_ts = ts
+        if self._first_report_ts is None:
+            self._first_report_ts = ts
+        if prev_step is None or prev_ts is None:
+            return
+        if step <= prev_step:
+            return  # rollback: post-restart resume, no credit
+        if step <= self._credited_step:
+            return  # entirely re-done work
+        # an interval may straddle the rollback point: credit only the
+        # fraction covering never-before-completed steps
+        base = max(prev_step, self._credited_step)
+        fraction = min(1.0, (step - base) / (step - prev_step))
+        dt = ts - prev_ts
+        credit = dt * fraction
+        per_step = dt / (step - prev_step)
+        median = (
+            sorted(self._step_times)[len(self._step_times) // 2]
+            if self._step_times else None
+        )
+        if median is not None and per_step > 3.0 * median:
+            # the sampling window hides a stall or a restart that still
+            # made net progress: credit the new steps at the typical
+            # per-step rate, count the rest of the gap as downtime
+            credit = min(credit, (step - base) * median)
+        else:
+            self._step_times.append(per_step)
+        self._productive_s += credit
+        self._credited_step = step
+
+    def goodput(self) -> Dict[str, float]:
+        """Productive-step time over elapsed wall time since job start
+        (the reference's headline metric, README.md:54-57).  Returns the
+        ratio with its breakdown; all zeros before any step reports.
+
+        ``steady_goodput`` measures from the FIRST step report instead
+        of job start: on a long job the two converge (launch latency
+        amortizes to nothing), but on a short run the full-wall number
+        is dominated by the one-time submission/compile cost — steady
+        is the number comparable to the reference's 95% claim, and is
+        what fault-recovery overhead actually moves.
+
+        The wall clock ends at the LAST step report: the collector
+        cannot tell a finished job from a stalled one, so an ongoing
+        stall shows up in ``seconds_since_last_step`` (get_job_metrics)
+        and in the hang detector — not as retroactive downtime here."""
+        with self._lock:
+            start, last = self._job_start_ts, self._last_report_ts
+            first = self._first_report_ts
+            productive = self._productive_s
+        if start is None or last is None or last <= start:
+            return {"goodput": 0.0, "wall_s": 0.0, "productive_s": 0.0,
+                    "downtime_s": 0.0, "steady_goodput": 0.0,
+                    "steady_wall_s": 0.0}
+        wall = last - start
+        steady_wall = max(0.0, last - first) if first is not None else 0.0
+        return {
+            "goodput": min(1.0, productive / wall),
+            "wall_s": wall,
+            "productive_s": productive,
+            "downtime_s": max(0.0, wall - productive),
+            "steady_goodput": (
+                min(1.0, productive / steady_wall) if steady_wall else 0.0
+            ),
+            "steady_wall_s": steady_wall,
+        }
 
     def report_resource_usage(self, node_type: str, node_id, stats) -> None:
         key = f"{node_type}-{node_id}"
@@ -111,6 +220,13 @@ class JobMetricCollector:
                 "job": dict(self.job_meta),
                 "global_step": self.steps[-1]["step"] if self.steps else 0,
                 "speed_steps_per_sec": self.training_speed(),
+                "goodput": self.goodput(),
+                # liveness: goodput's wall ends at the last report, so a
+                # stall is visible HERE, not as retroactive downtime
+                "seconds_since_last_step": (
+                    time.time() - self._last_report_ts
+                    if self._last_report_ts else None
+                ),
                 "node_usage": dict(self.node_usage),
                 "recent_events": list(self.events)[-16:],
             }

@@ -41,6 +41,12 @@ def main() -> int:
                    help="host-side pause per step (elasticity tests: "
                         "keeps tiny runs alive long enough to observe "
                         "membership changes)")
+    p.add_argument("--crash-at-step", type=int, default=0,
+                   help="inject one worker crash after this step (fault-"
+                        "tolerance e2e; needs --crash-marker)")
+    p.add_argument("--crash-marker", default="",
+                   help="file recording that the injected crash fired "
+                        "(so the restarted worker does not re-crash)")
     p.add_argument("--slice-unit", type=int, default=0,
                    help="hosts per (emulated) TPU slice: when the world "
                         "holds more than one complete slice, train on a "
@@ -121,6 +127,15 @@ def main() -> int:
             out.write(f"{step} {loss:.6f} {env.worker_num}\n")
             out.flush()
         trainer.maybe_save()
+        if (
+            args.crash_at_step
+            and step == args.crash_at_step
+            and args.crash_marker
+            and not os.path.exists(args.crash_marker)
+        ):
+            open(args.crash_marker, "w").close()
+            print("[spmd] injected crash", flush=True)
+            os._exit(17)
         if args.step_sleep:
             import time
 

@@ -1,0 +1,139 @@
+"""Goodput measured end to end — the reference's headline metric
+(reference README.md:54-57: "the time spent computing useful new steps
+over the elapsed time of the training job", GLM-65B 69% -> 95%).
+
+A real master + agent + worker run with an injected mid-training crash:
+the agent detects the dead worker, restarts it, the worker resumes from
+the in-memory flash checkpoint, and the master's JobMetricCollector —
+fed by the agent's TrainingMonitor step reports — accounts every second
+of detection, respawn, recompile, restore and re-done work as downtime.
+The artifact of record is GOODPUT.json; the gate is steady-state
+goodput >= 0.90 across the injected kill + recovery.
+
+Scale model: steps are paced to ~real-TPU step time (seconds) on the
+CPU host, and the JAX persistent compilation cache plays the role a
+warm compile cache plays on a production cluster (the restarted
+process compiles in ~1s instead of ~10s).  The downtime being divided
+by is fully real: monitor latency, process respawn, jax init, restore.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOTAL_STEPS = 70
+CRASH_AT = 12
+STEP_SLEEP = 2.0
+SEQ, GB = 32, 8
+
+
+def test_goodput_artifact_survives_injected_kill(tmp_path):
+    work = str(tmp_path)
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.rpc import find_free_port
+
+    port = find_free_port()
+    master = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.master.main",
+         "--platform", "local", "--port", str(port), "--node_num", "1"],
+        stdout=open(os.path.join(work, "master.log"), "w"),
+        stderr=subprocess.STDOUT,
+    )
+    env = dict(os.environ)
+    env.update(
+        DLROVER_FORCE_CPU="1",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        DLROVER_JOB_UID="goodputE2e",
+        # tight step sampling: the goodput ledger should see (nearly)
+        # every step boundary, not 15s aggregates
+        DLROVER_MONITOR_INTERVAL="0.5",
+        # warm-compile scale model: the restarted worker hits the
+        # persistent cache the way a production job hits a warm cache
+        JAX_COMPILATION_CACHE_DIR=os.path.join(work, "jaxcache"),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0",
+    )
+    agent = None
+    try:
+        time.sleep(2)
+        agent = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.agent.launcher",
+                "--nnodes=1", "--node_rank=0",
+                f"--master-addr=127.0.0.1:{port}",
+                "--max-restarts=2", "--monitor-interval=0.5",
+                "--rdzv-waiting-timeout=3",
+                sys.executable,
+                os.path.join(REPO, "examples/train_elastic_spmd.py"),
+                "--steps", str(TOTAL_STEPS),
+                "--global-batch", str(GB), "--seq-len", str(SEQ),
+                "--ckpt-dir", os.path.join(work, "ckpt"),
+                "--metrics-file", os.path.join(work, "metrics"),
+                "--step-sleep", str(STEP_SLEEP),
+                "--crash-at-step", str(CRASH_AT),
+                "--crash-marker", os.path.join(work, "crashed"),
+            ],
+            env=env, cwd=REPO,
+            stdout=open(os.path.join(work, "agent.log"), "w"),
+            stderr=subprocess.STDOUT,
+        )
+        rc = agent.wait(800)
+        assert rc == 0, f"agent exited {rc}"
+        assert os.path.exists(os.path.join(work, "crashed")), (
+            "the injected crash never fired"
+        )
+
+        client = MasterClient(
+            f"127.0.0.1:{port}", node_id=0, node_type="worker"
+        )
+        try:
+            detail = client.query_job_detail()
+        finally:
+            client.close()
+        g = detail["metrics"]["goodput"]
+        assert g["productive_s"] > 0, g
+        # the ledger must have SEEN the kill: some of the steady window
+        # (post-first-step) is downtime, so steady goodput < 1...
+        assert g["steady_wall_s"] - g["productive_s"] > 2.0, g
+        assert g["steady_goodput"] < 0.999, g
+        # ...and recovery fast enough that steady goodput clears the
+        # reference's bar on a run that includes a kill + full recovery
+        assert g["steady_goodput"] >= 0.90, g
+
+        artifact = {
+            "scenario": (
+                "single-host elastic agent; worker SIGKILLed by injected "
+                f"crash after step {CRASH_AT}; agent restarts it; resume "
+                "from in-memory flash checkpoint; persistent compile "
+                "cache warm on restart"
+            ),
+            "definition": (
+                "goodput = time computing useful NEW steps / elapsed "
+                "wall; re-run steps after rollback earn nothing; "
+                "steady_goodput measures from the first step report "
+                "(launch compile amortizes to zero on long jobs)"
+            ),
+            "total_steps": TOTAL_STEPS,
+            "crash_at_step": CRASH_AT,
+            "emulated_step_time_s": STEP_SLEEP,
+            "goodput": g,
+            "bar": {"steady_goodput": 0.90},
+            "global_step": detail["metrics"]["global_step"],
+        }
+        with open(os.path.join(REPO, "GOODPUT.json"), "w") as f:
+            json.dump(artifact, f, indent=1)
+    finally:
+        if agent is not None and agent.poll() is None:
+            agent.kill()
+        master.terminate()
+        try:
+            master.wait(10)
+        except subprocess.TimeoutExpired:
+            master.kill()

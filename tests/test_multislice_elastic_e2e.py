@@ -186,6 +186,16 @@ def test_slice_loss_shrinks_then_regrows(tmp_path):
             assert np.isclose(loss, ref[s - 1], rtol=1e-3, atol=1e-3), (
                 s, loss, ref[s - 1])
 
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(f"127.0.0.1:{port}", node_id=9,
+                              node_type="worker")
+        try:
+            goodput = client.query_job_detail().get(
+                "metrics", {}).get("goodput", {})
+        finally:
+            client.close()
+
         with open(os.path.join(REPO, "MULTISLICE_E2E.json"), "w") as f:
             json.dump(
                 {
@@ -197,6 +207,7 @@ def test_slice_loss_shrinks_then_regrows(tmp_path):
                     "regrow_steps": sorted(regrown),
                     "world_phases": [4, 2, 4],
                     "reference_match_rtol": 1e-3,
+                    "goodput": goodput,
                 },
                 f, indent=1,
             )

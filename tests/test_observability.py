@@ -373,3 +373,88 @@ def test_elastic_trainer_xprof_endpoint():
         assert "dlrover_xprof_op_seconds{op=" in body
     finally:
         tr.close()
+
+
+# -- goodput (reference README.md:54-57: useful-new-step time / wall) -------
+
+
+def test_goodput_healthy_run_approaches_one():
+    c = JobMetricCollector()
+    c.mark_job_start(timestamp=100.0)
+    # first step lands after 2s of compile (downtime), then 10 steps
+    # at 1s each — goodput = 10 / 12
+    for i in range(11):
+        c.report_global_step(i + 1, 102.0 + i)
+    g = c.goodput()
+    assert g["wall_s"] == pytest.approx(12.0)
+    assert g["productive_s"] == pytest.approx(10.0)
+    assert g["goodput"] == pytest.approx(10.0 / 12.0)
+
+
+def test_goodput_counts_fault_and_rollback_as_downtime():
+    """A kill at step 8 that rolls back to a step-5 checkpoint: the gap,
+    the recompile, AND the re-run of steps 6-8 all earn nothing — only
+    never-before-completed steps are credited."""
+    c = JobMetricCollector()
+    c.mark_job_start(timestamp=0.0)
+    for i in range(1, 9):  # steps 1..8, 1s each, first at t=1
+        c.report_global_step(i, float(i))
+    # fault: 10s of detection + restart + recompile; resume at step 6
+    c.report_global_step(6, 18.0)   # rollback report: no credit
+    c.report_global_step(7, 19.0)   # re-done: no credit
+    c.report_global_step(8, 20.0)   # re-done: no credit
+    c.report_global_step(9, 21.0)   # NEW step: credited
+    c.report_global_step(10, 22.0)
+    g = c.goodput()
+    # productive: steps 2..8 (7s; step 1's interval is from job start,
+    # prev=None so uncredited) + steps 9,10 (2s)
+    assert g["productive_s"] == pytest.approx(9.0)
+    assert g["wall_s"] == pytest.approx(22.0)
+    assert g["downtime_s"] == pytest.approx(13.0)
+    assert g["goodput"] == pytest.approx(9.0 / 22.0)
+
+
+def test_goodput_credits_partial_interval_across_rollback_point():
+    """A sparse report window straddling the rollback point credits only
+    the fraction covering new steps."""
+    c = JobMetricCollector()
+    c.mark_job_start(timestamp=0.0)
+    c.report_global_step(4, 4.0)
+    c.report_global_step(8, 8.0)    # steps 5-8 credited (4s)
+    c.report_global_step(6, 20.0)   # post-restart resume: no credit
+    # one 4s window covering steps 7..10: 8 already credited, so only
+    # steps 9,10 count -> half the interval
+    c.report_global_step(10, 24.0)
+    g = c.goodput()
+    assert g["productive_s"] == pytest.approx(4.0 + 2.0)
+    assert g["goodput"] == pytest.approx(6.0 / 24.0)
+
+
+def test_goodput_in_job_metrics_and_detail_rpc(local_master, master_client):
+    """The goodput breakdown rides get_job_metrics and the job-detail
+    RPC so any client (and the e2e artifact) can read it."""
+    master, _ = local_master
+    col = master.job_metric_collector
+    now = time.time()
+    col.report_global_step(1, now - 3.0)
+    col.report_global_step(5, now)
+    metrics = master_client.query_job_detail().get("metrics", {})
+    assert "goodput" in metrics
+    assert metrics["goodput"]["productive_s"] == pytest.approx(3.0, abs=0.1)
+    assert 0.0 < metrics["goodput"]["goodput"] <= 1.0
+
+
+def test_goodput_caps_windows_hiding_a_restart():
+    """A sparse sampling window that spans a crash+recovery but still
+    shows net step progress must not credit the recovery gap: new steps
+    are credited at the typical per-step rate instead."""
+    c = JobMetricCollector()
+    c.mark_job_start(timestamp=0.0)
+    for i in range(1, 6):  # steps 1..5, 1s cadence
+        c.report_global_step(i, float(i))
+    # window 5 -> 6 took 14s: a crash + restart hid inside it
+    c.report_global_step(6, 19.0)
+    g = c.goodput()
+    # steps 2..5 credited fully (4s); step 6 at the 1s median, not 14s
+    assert g["productive_s"] == pytest.approx(5.0)
+    assert g["downtime_s"] == pytest.approx(19.0 - 5.0)

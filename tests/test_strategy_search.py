@@ -220,3 +220,89 @@ def test_planner_emits_hybrid_and_pp_moe_candidates():
     assert any(
         c.config.mesh_spec.pp > 1 for c in moe_cands
     ), [c.name for c in moe_cands]
+
+
+def test_bo_search_beats_exhaustive_budget(monkeypatch):
+    """On a synthetic throughput surface with an interior optimum, the
+    GP/EI search must find the best config while dry-running FEWER
+    candidates than exhaustive enumeration needs (reference:
+    bayes_opt_sg.py's whole reason to exist).  Deterministic: fixed
+    seed, noiseless surface."""
+    import math
+
+    from dlrover_tpu.accel.engine import engine as engine_mod
+    from dlrover_tpu.accel.engine.planner import enumerate_candidates
+
+    info = _info(num_heads=8, num_kv_heads=8, num_layers=4,
+                 scan_layers=True)
+    all_cands = enumerate_candidates(8, info, (8, 32), max_candidates=16)
+    assert len(all_cands) >= 8, [c.name for c in all_cands]
+
+    def surface(spec):
+        # peak at fsdp=4, tp=2; smooth log-space falloff elsewhere
+        score = 10.0
+        score -= (math.log2(max(1, spec.fsdp)) - 2.0) ** 2
+        score -= (math.log2(max(1, spec.tp)) - 1.0) ** 2
+        score -= 0.5 * math.log2(max(1, spec.pp))
+        score -= 0.3 * math.log2(max(1, spec.sp * spec.cp))
+        return math.exp(score)
+
+    true_best = max(all_cands, key=lambda c: surface(c.config.mesh_spec))
+
+    calls = []
+
+    def fake_dry_run(model_, cand, batch_shape, **kw):
+        calls.append(cand.name)
+        cand.tokens_per_sec = surface(cand.config.mesh_spec)
+        cand.failed = None
+        cand.result = None
+        return cand
+
+    monkeypatch.setattr(engine_mod, "dry_run_candidate", fake_dry_run)
+    cfg = LlamaConfig.tiny(num_heads=8, num_kv_heads=8, scan_layers=True)
+    model = LlamaModel(cfg)
+    budget = max(5, len(all_cands) // 2)
+    report = engine_mod.search_strategy(
+        model, (8, 32),
+        model_info=info,
+        max_candidates=16,
+        max_dryruns=budget,
+        halving_survivors=2,
+        seed=0,
+    )
+    assert report.algo == "bo"
+    assert report.dryruns_used <= budget < len(all_cands)
+    assert report.best is not None
+    assert report.best.config.mesh_spec == true_best.config.mesh_spec, (
+        f"BO missed the optimum: got {report.best.name}, "
+        f"want {true_best.name}, profiled {calls}"
+    )
+
+
+def test_bo_search_avoids_failed_regions(monkeypatch):
+    """Failed dry-runs (OOM/invalid) are observed at a penalty: the GP
+    keeps searching and still lands on the best FEASIBLE config."""
+    from dlrover_tpu.accel.engine import engine as engine_mod
+
+    def fake_dry_run(model_, cand, batch_shape, **kw):
+        spec = cand.config.mesh_spec
+        if spec.pp > 1:
+            cand.tokens_per_sec = None
+            cand.failed = "XlaRuntimeError: RESOURCE_EXHAUSTED (injected)"
+        else:
+            cand.tokens_per_sec = 100.0 * spec.fsdp + 10.0 * spec.dp
+            cand.failed = None
+        cand.result = None
+        return cand
+
+    monkeypatch.setattr(engine_mod, "dry_run_candidate", fake_dry_run)
+    cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=4, scan_layers=True)
+    model = LlamaModel(cfg)
+    report = engine_mod.search_strategy(
+        model, (8, 32), max_candidates=12, halving_survivors=2, seed=0,
+    )
+    assert report.best is not None
+    assert report.best.config.mesh_spec.pp == 1
+    assert report.best.tokens_per_sec == max(
+        c.tokens_per_sec for c in report.succeeded
+    )
