@@ -1,0 +1,86 @@
+"""Probe 2: does fusing projections (larger N) + native int8 reach the
+bandwidth win the VERDICT demands?  Shapes: qkv-fused [K, 3K],
+mlp gate+up [K, 2*2.75K], down [2.75K, K]."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops.pallas.quant_matmul import quantize_int8
+
+M = 8
+ITERS = 8000
+
+
+def timed(fn, *args, runs=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best / ITERS
+
+
+def chain(op, K):
+    @jax.jit
+    def run(a, *weights):
+        def body(i, a):
+            out = op(a, *weights)
+            n = out.shape[1]
+            if n >= K:
+                # consume EVERY output column (a narrow slice would let
+                # XLA dead-code-eliminate most of the weight read)
+                reps = n // K
+                folded = out[:, : reps * K].reshape(
+                    out.shape[0], reps, K).sum(1)
+                if n % K:
+                    tail = jnp.zeros((out.shape[0], K), out.dtype).at[
+                        :, : n - reps * K].set(out[:, reps * K:])
+                    folded = folded + tail
+            else:
+                reps = -(-K // n)
+                folded = jnp.tile(out, (1, reps))[:, :K]
+            return (folded * 1e-3).astype(a.dtype)
+        return jax.lax.fori_loop(0, ITERS, body, a)
+    return run
+
+
+def xla_int8(a, wq, ws):
+    a_q, a_s = quantize_int8(a.astype(jnp.float32), axis=-1)
+    acc = jax.lax.dot_general(
+        a_q, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * a_s * ws
+
+
+def bench_shape(K, N, label):
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(M, K), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(K, N) / np.sqrt(K), jnp.bfloat16)
+    wq_np, ws_np = quantize_int8(np.asarray(w, np.float32), axis=0)
+    wq, ws = jnp.asarray(wq_np), jnp.asarray(ws_np)
+
+    t_bf = timed(chain(lambda a, w: jnp.dot(a, w), K), a, w)
+    t_i8 = timed(chain(xla_int8, K), a, wq, ws)
+    bw_bf = K * N * 2 / t_bf / 1e9
+    bw_i8 = K * N / t_i8 / 1e9
+    print(f"{label:22s} bf16 {t_bf*1e6:7.2f}us ({bw_bf:5.0f} GB/s)  "
+          f"int8 {t_i8*1e6:7.2f}us ({bw_i8:5.0f} GB/s)  "
+          f"speedup {t_bf/t_i8:5.2f}x")
+
+
+def main():
+    bench_shape(2048, 2048, "square h2048")
+    bench_shape(2048, 3 * 2048, "qkv fused [K,3K]")
+    bench_shape(2048, 2 * 5632, "mlp gate+up [K,2I]")
+    bench_shape(5632, 2048, "mlp down [I,K]")
+    bench_shape(2048, 32000, "lm head [K,V]")
+
+
+if __name__ == "__main__":
+    main()

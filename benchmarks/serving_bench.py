@@ -80,10 +80,12 @@ def run_config(mode: str) -> dict:
     # host's dispatch latency and memory bandwidth swing >10x
     # second-to-second, and a single sample measures the neighbor.
     best_wall, best_decode, best_prefill = None, 0.0, None
+    best_prefill_calls = 1
     for _ in range(3):
         eng.stats.generated_tokens = 0
         eng.stats.decode_seconds = 0.0
         eng.stats.prefill_seconds = 0.0
+        eng.stats.prefill_calls = 0
         t0 = time.perf_counter()
         for i in range(n_req):
             eng.add_request(prompts[i], gen_len)
@@ -92,12 +94,21 @@ def run_config(mode: str) -> dict:
         if best_wall is None or wall < best_wall:
             best_wall = wall
             best_prefill = eng.stats.prefill_seconds
+            best_prefill_calls = max(1, eng.stats.prefill_calls)
         best_decode = max(best_decode, eng.stats.decode_tokens_per_sec)
     total_tokens = n_req * gen_len
     out = {
         f"serving_tok_s_{mode}": round(total_tokens / best_wall, 1),
         f"serving_decode_tok_s_{mode}": round(best_decode, 1),
+        # AGGREGATE prefill seconds for the whole run: a slots=1 config
+        # pays one dispatch per admission while slots=8 batches
+        # same-bucket admissions into 1-2 dispatches, so this number is
+        # ~n_req x larger at slots=1 on a dispatch-dominated rig — an
+        # admission-batching artifact, not a per-request penalty (the
+        # per-dispatch number below is flat across configs)
         f"serving_prefill_s_{mode}": round(best_prefill, 3),
+        f"serving_prefill_s_per_call_{mode}": round(
+            best_prefill / best_prefill_calls, 3),
     }
     out.update(_decode_step_probe(eng, mode))
     return out
@@ -138,9 +149,66 @@ def _decode_step_probe(eng, mode: str) -> dict:
     }
 
 
+def run_spec_config() -> dict:
+    """Speculative decoding on a repetitive workload: tokens committed
+    per model forward (the speculation win; bar: > 1.5).  Prompt-lookup
+    drafts need self-similar text, so the prompt is a repeated phrase —
+    the summarization/code-echo case speculation exists for."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+
+    cfg, prompt_len, gen_len, n_req = _engine_cfg()
+    model = LlamaModel(cfg)
+    probe = jax.numpy.zeros((1, 8), jax.numpy.int32)
+    variables = model.init(jax.random.PRNGKey(0), probe)
+    eng = InferenceEngine(
+        cfg, variables, max_slots=4, int8=False, chunk=16,
+        temperature=0.0, speculative_k=8,
+        max_len=prompt_len + gen_len, seed=0,
+    )
+    rng = np.random.RandomState(0)
+    phrase = rng.randint(0, cfg.vocab_size, 16).astype(np.int32)
+    prompt = np.tile(phrase, prompt_len // 16 + 1)[:prompt_len]
+    # warmup with a FULL admission group so the measured run compiles
+    # nothing (insert_fn is cached per group size)
+    for _ in range(eng.max_slots):
+        eng.add_request(prompt, 8)
+    eng.run()
+    eng.stats.generated_tokens = 0
+    eng.stats.decode_forwards = 0
+    eng.stats.decode_seconds = 0.0
+    eng.stats.spec_proposed = 0
+    eng.stats.spec_accepted = 0
+    eng.stats.spec_calls = 0
+    best_wall = None
+    for _ in range(3):
+        eng.stats.generated_tokens = 0
+        eng.stats.decode_forwards = 0
+        eng.stats.spec_proposed = 0
+        eng.stats.spec_accepted = 0
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            eng.add_request(prompt, gen_len)
+        eng.run()
+        wall = time.perf_counter() - t0
+        best_wall = wall if best_wall is None else min(best_wall, wall)
+    wall = best_wall
+    return {
+        "serving_tokens_per_forward": round(
+            eng.stats.tokens_per_forward, 2),
+        "serving_spec_accept_rate": round(
+            eng.stats.spec_accepted / max(1, eng.stats.spec_proposed), 3),
+        "serving_spec_tok_s": round(
+            eng.stats.generated_tokens / wall, 1),
+    }
+
+
 def main() -> dict:
     out = {}
-    for mode in ("bf16", "int8", "bf16_slots1"):
+    for mode in ("bf16", "int8", "bf16_slots1", "spec"):
         proc = subprocess.run(
             [sys.executable, __file__, mode],
             capture_output=True, text=True, timeout=1800,
@@ -170,6 +238,9 @@ def main() -> dict:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1:
-        print(json.dumps(run_config(sys.argv[1])))
+        if sys.argv[1] == "spec":
+            print(json.dumps(run_spec_config()))
+        else:
+            print(json.dumps(run_config(sys.argv[1])))
     else:
         print(json.dumps(main()))

@@ -18,6 +18,42 @@ import jax
 import jax.numpy as jnp
 
 
+def filter_logits(
+    logits: jax.Array,
+    temperature: float,
+    top_k: int,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Apply the top-k and nucleus (top-p) masks; returns fp32 logits
+    with filtered entries at -inf.  Shared by :func:`select_token` and
+    the speculative rejection-sampling verifier (which needs the FULL
+    filtered distribution, not just a sample)."""
+    logits = logits.astype(jnp.float32)
+    if top_k > 0:
+        # lax.top_k (partial selection) — a full vocab sort per decode
+        # step measurably dominates serving decode at 32k vocab
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose cumulative probability exceeds top_p.  Static-shape
+        # formulation: sort descending, mask tokens whose *preceding*
+        # cumulative mass already reached top_p (the first token always
+        # survives).
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(
+            sorted_logits / (temperature if temperature > 0 else 1.0),
+            axis=-1,
+        )
+        cum = jnp.cumsum(probs, axis=-1) - probs  # mass BEFORE each token
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1) - 1
+        cutoff_val = jnp.take_along_axis(
+            sorted_logits, cutoff_idx[..., None], axis=-1
+        )
+        logits = jnp.where(logits < cutoff_val, -jnp.inf, logits)
+    return logits
+
+
 def select_token(
     logits: jax.Array,
     key: jax.Array,
@@ -29,29 +65,7 @@ def select_token(
     greedy (temperature 0) or categorical sampling — one implementation
     for both samplers (reference: the vllm backend's sampling params,
     rl/inference_backend/vllm_backend.py)."""
-    logits = logits.astype(jnp.float32)
-    if top_k > 0:
-        # lax.top_k (partial selection) — a full vocab sort per decode
-        # step measurably dominates serving decode at 32k vocab
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if 0.0 < top_p < 1.0:
-        # nucleus: keep the smallest prefix of the sorted distribution
-        # whose cumulative probability exceeds top_p.  Static-shape
-        # formulation: sort descending, mask tokens whose *preceding*
-        # cumulative mass already reached top_p (the first token always
-        # survives).
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(
-            sorted_logits / (temperature if temperature > 0 else 1.0),
-            axis=-1,
-        )
-        cum = jnp.cumsum(probs, axis=-1) - probs  # mass BEFORE each token
-        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1) - 1
-        cutoff_val = jnp.take_along_axis(
-            sorted_logits, cutoff_idx[:, None], axis=-1
-        )
-        logits = jnp.where(logits < cutoff_val, -jnp.inf, logits)
+    logits = filter_logits(logits, temperature, top_k, top_p)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     return jax.random.categorical(key, logits / temperature)

@@ -11,10 +11,12 @@ batch that sequences enter and leave independently —
   batching, the Orca/vLLM scheduling model);
 - decode runs in chunks of ``chunk`` tokens per host sync (multi-step
   scheduling) — sampling stays on-device inside a ``lax.scan``;
-- ``int8=True`` serves pre-quantized int8 weights through the Pallas
-  MXU kernel (weights stream from HBM at half the bf16 bytes — decode
-  is bandwidth-bound, so this is the serving speedup, fixing the
-  0.6x end-to-end w8a8 result of the dynamic-quantization path).
+- ``int8=True`` serves pre-quantized int8 weights through XLA's native
+  int8 MXU dot (weights stream from HBM at half the bf16 bytes — decode
+  is bandwidth-bound, so this is the serving speedup; measured against
+  the hand-tiled Pallas alternative in
+  benchmarks/probes/int8_decode_probe*, the native dot wins at every
+  serving shape).
 
 Static shapes everywhere: prompts right-pad to power-of-two buckets,
 the decode batch is fixed at ``max_slots``, EOS only masks. One compile
@@ -56,13 +58,22 @@ class EngineStats:
     prefill_calls: int = 0        # dispatches; < admissions when batched
     finished_requests: int = 0
     spec_proposed: int = 0        # draft tokens sent to verification
-    spec_accepted: int = 0        # draft tokens accepted (greedy match)
+    spec_accepted: int = 0        # draft tokens accepted
     spec_calls: int = 0           # verify dispatches (model forwards)
+    decode_forwards: int = 0      # ALL decode-path model forwards
 
     @property
     def decode_tokens_per_sec(self) -> float:
         return self.generated_tokens / self.decode_seconds \
             if self.decode_seconds else 0.0
+
+    @property
+    def tokens_per_forward(self) -> float:
+        """Committed tokens per decode-path model forward — the
+        speculative-decoding win metric (1.0 = plain decode; >1 means
+        drafts amortized forwards)."""
+        return self.generated_tokens / self.decode_forwards \
+            if self.decode_forwards else 0.0
 
 
 def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
@@ -90,29 +101,46 @@ class InferenceEngine:
         eos_token: Optional[int] = None,
         max_len: Optional[int] = None,
         prefill_buckets: Optional[Tuple[int, ...]] = None,
-        speculative_k: int = 0,
+        speculative_k: Any = 0,
+        spec_accept_floor: float = 0.15,
+        paged: bool = False,
+        cache_blocks: Optional[int] = None,
+        block_size: int = 16,
+        mesh: Optional[Any] = None,
         seed: int = 0,
     ):
         """``speculative_k > 1`` enables prompt-lookup speculative
-        decoding (greedy only): each dispatch verifies up to
-        ``speculative_k - 1`` draft tokens found by n-gram lookup in the
-        slot's own context, committing up to ``speculative_k`` tokens
-        for ~one decode step's cost (serving/speculative.py)."""
+        decoding: each dispatch verifies up to ``speculative_k - 1``
+        draft tokens found by n-gram lookup in the slot's own context,
+        committing up to ``speculative_k`` tokens for ~one decode
+        step's cost.  Works with ANY sampling config: greedy verifies
+        by argmax match, temperature/top-k/top-p by exact rejection
+        sampling (serving/speculative.rejection_commit).
+
+        ``speculative_k="auto"``: start in plain chunk decode, watch
+        the (free) draft hit rate, and switch speculation on when
+        drafts are available often enough to pay — then self-regulate:
+        measured acceptance below ``spec_accept_floor`` backs off to
+        chunk decode and re-probes later."""
         self.cfg = cfg
         self.int8 = int8
         self.chunk = int(chunk)
-        self.speculative_k = int(speculative_k)
+        self.spec_auto = speculative_k == "auto"
+        self.speculative_k = 8 if self.spec_auto else int(speculative_k)
         if self.speculative_k == 1 or self.speculative_k < 0:
             raise ValueError(
                 f"speculative_k={self.speculative_k} is invalid: use 0 "
-                "to disable or >= 2 to speculate (1 would be a no-op)"
+                "to disable, >= 2 to speculate, or 'auto'"
             )
-        if self.speculative_k > 1 and temperature != 0.0:
-            raise ValueError(
-                "speculative decoding requires greedy sampling "
-                "(temperature=0): greedy verification is what keeps the "
-                "output distribution exact"
-            )
+        self.spec_accept_floor = float(spec_accept_floor)
+        # speculation state machine: "on" = verify rounds; "watching" =
+        # chunk decode + free draft-hit-rate probe (auto mode's start);
+        # "backoff" = chunk decode for _spec_cooldown rounds after
+        # measured low acceptance, then back to on/watching
+        self._spec_state = "watching" if self.spec_auto else "on"
+        self._spec_cooldown = 0
+        self._spec_window: deque = deque(maxlen=32)
+        self._draft_hits: deque = deque(maxlen=32)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -128,22 +156,68 @@ class InferenceEngine:
             prefill_buckets = tuple(buckets)
         self.buckets = tuple(sorted(prefill_buckets))
         self.max_slots = int(max_slots)
-        self.params = serving_params_from_llama(variables, cfg, int8=int8)
+        # ``mesh``: tensor-parallel serving — params/cache placed with
+        # Megatron-style col/row shardings (params.shard_serving_state),
+        # jit propagates them and GSPMD inserts the collectives.  Needs
+        # the unfused projection layout (fused [q|k|v] columns would
+        # shard head-incorrectly).
+        self.mesh = mesh
+        self.params = serving_params_from_llama(
+            variables, cfg, int8=int8, fuse=mesh is None)
         # speculative slack: a verify near the end of a sequence writes
         # up to K-1 entries past its last real position; without the
         # extra rows dynamic_update_slice would CLAMP the start and
         # silently overwrite earlier (live) cache entries
         cache_len = self.max_len + max(0, self.speculative_k)
-        kvd = (self.max_slots, cache_len,
-               cfg.num_kv_heads, cfg.head_dim_)
-        # per-layer buffers (a pytree of lists): donated in place by the
-        # decode chunk, no stacked-cache copies
-        self._cache = {
-            "k": [jnp.zeros(kvd, cfg.dtype)
-                  for _ in range(cfg.num_layers)],
-            "v": [jnp.zeros(kvd, cfg.dtype)
-                  for _ in range(cfg.num_layers)],
-        }
+        self.paged = bool(paged)
+        if self.paged:
+            # block-pool cache (serving/paged.py): per-sequence memory
+            # scales with ACTUAL lengths, concurrency is bounded by the
+            # pool (HBM budget) instead of slots x max_len reservations,
+            # and common prompt prefixes share blocks
+            from dlrover_tpu.serving.paged import BlockManager
+
+            self.block_size = int(block_size)
+            self._max_blocks = -(-cache_len // self.block_size)
+            # +1: block 0 is the trash sink (never allocated), so the
+            # default must still let every slot hold a full-length
+            # sequence
+            n_blocks = int(
+                cache_blocks or self.max_slots * self._max_blocks + 1
+            )
+            self._blockmgr = BlockManager(n_blocks, self.block_size)
+            self._slot_blocks: List[Optional[List[int]]] = (
+                [None] * self.max_slots
+            )
+            self._table_dirty = False
+            self._table_np = np.zeros(
+                (self.max_slots, self._max_blocks), np.int32
+            )
+            kvd = (n_blocks, self.block_size,
+                   cfg.num_kv_heads, cfg.head_dim_)
+            self._cache = {
+                "k_pool": [jnp.zeros(kvd, cfg.dtype)
+                           for _ in range(cfg.num_layers)],
+                "v_pool": [jnp.zeros(kvd, cfg.dtype)
+                           for _ in range(cfg.num_layers)],
+                "table": jnp.asarray(self._table_np),
+            }
+        else:
+            kvd = (self.max_slots, cache_len,
+                   cfg.num_kv_heads, cfg.head_dim_)
+            # per-layer buffers (a pytree of lists): donated in place by
+            # the decode chunk, no stacked-cache copies
+            self._cache = {
+                "k": [jnp.zeros(kvd, cfg.dtype)
+                      for _ in range(cfg.num_layers)],
+                "v": [jnp.zeros(kvd, cfg.dtype)
+                      for _ in range(cfg.num_layers)],
+            }
+        if mesh is not None:
+            from dlrover_tpu.serving.params import shard_serving_state
+
+            self.params, self._cache = shard_serving_state(
+                self.params, self._cache, mesh, cfg)
         self._rng = jax.random.PRNGKey(seed)
         # host-side slot state
         self._slot_req: List[Optional[Request]] = [None] * self.max_slots
@@ -187,6 +261,8 @@ class InferenceEngine:
             )
             return out.T, tokens, positions, cache, rng
 
+        paged = self.paged
+
         @functools.partial(jax.jit, donate_argnums=(1,))
         def insert_fn(params, cache, tokens, real_len, slots, rng):
             """Prefill a GROUP of same-bucket prompts ([G, Lp]) and
@@ -194,17 +270,36 @@ class InferenceEngine:
             dispatch (jit caches one program per (G, bucket) pair)."""
             lp = tokens.shape[1]
             logits, ks, vs = prefill(params, cfg, tokens, real_len)
-            new_k = [
-                ck.at[slots, :lp].set(k.astype(ck.dtype))
-                for ck, k in zip(cache["k"], ks)
-            ]
-            new_v = [
-                cv.at[slots, :lp].set(v.astype(cv.dtype))
-                for cv, v in zip(cache["v"], vs)
-            ]
+            if paged:
+                from dlrover_tpu.serving.paged import scatter_tokens
+
+                rows = jnp.take(cache["table"], slots, axis=0)  # [G, MB]
+                zero = jnp.zeros(slots.shape, jnp.int32)
+                new_cache = dict(
+                    cache,
+                    k_pool=[
+                        scatter_tokens(p, rows, k.astype(p.dtype), zero)
+                        for p, k in zip(cache["k_pool"], ks)
+                    ],
+                    v_pool=[
+                        scatter_tokens(p, rows, v.astype(p.dtype), zero)
+                        for p, v in zip(cache["v_pool"], vs)
+                    ],
+                )
+            else:
+                new_cache = {
+                    "k": [
+                        ck.at[slots, :lp].set(k.astype(ck.dtype))
+                        for ck, k in zip(cache["k"], ks)
+                    ],
+                    "v": [
+                        cv.at[slots, :lp].set(v.astype(cv.dtype))
+                        for cv, v in zip(cache["v"], vs)
+                    ],
+                }
             rng, sub = jax.random.split(rng)
             first = select_token(logits, sub, temperature, top_k, top_p)
-            return {"k": new_k, "v": new_v}, first, rng
+            return new_cache, first, rng
 
         self._chunk_fn = chunk_fn
         self._insert_fn = insert_fn
@@ -212,13 +307,19 @@ class InferenceEngine:
         self._spec_fn = None
         if self.speculative_k > 1:
             from dlrover_tpu.serving.model import verify_step
+            from dlrover_tpu.serving.speculative import rejection_commit
 
             @functools.partial(jax.jit, donate_argnums=(1,))
-            def spec_fn(params, cache, tokens, positions):
+            def spec_fn(params, cache, tokens, positions, draft_len,
+                        rng):
                 logits, cache = verify_step(
                     params, cfg, cache, tokens, positions)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return nxt, cache
+                rng, sub = jax.random.split(rng)
+                out, n_commit = rejection_commit(
+                    logits, tokens[:, 1:], draft_len, sub,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                )
+                return out, n_commit, cache, rng
 
             self._spec_fn = spec_fn
 
@@ -231,6 +332,19 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt {prompt.size} + max_new {max_new_tokens} "
                 f"exceeds engine max_len {self.max_len}")
+        if self.paged:
+            # fail fast on a request the pool can NEVER hold (waiting
+            # in the queue would spin run() forever)
+            worst = max(
+                total + max(0, self.speculative_k),
+                _bucket(prompt.size, self.buckets),
+            )
+            need = -(-worst // self.block_size)
+            if need > self._blockmgr.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {need} cache blocks but the pool "
+                    f"holds {self._blockmgr.num_blocks - 1} usable "
+                    "(cache_blocks too small for this request)")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(Request(rid, prompt, int(max_new_tokens)))
@@ -252,14 +366,40 @@ class InferenceEngine:
                 return
             bucket = _bucket(self._queue[0].prompt.size, self.buckets)
             group: List[Request] = []
+            allocs: List[Any] = []
             while (
                 self._queue
                 and len(group) < len(free)
                 and _bucket(self._queue[0].prompt.size, self.buckets)
                 == bucket
             ):
+                if self.paged:
+                    # capacity gate: blocks for the whole lifetime
+                    # (bucket-padded prefill writes + gen + spec slack);
+                    # pool exhaustion keeps the request QUEUED — that is
+                    # the HBM-budget-bound admission paging exists for
+                    req = self._queue[0]
+                    total = max(
+                        req.prompt.size + req.max_new_tokens
+                        + max(0, self.speculative_k),
+                        bucket,
+                    )
+                    alloc = self._blockmgr.alloc_sequence(
+                        req.prompt, total)
+                    if alloc is None:
+                        break
+                    allocs.append(alloc)
                 group.append(self._queue.popleft())
+            if not group:
+                return
             slots = free[: len(group)]
+            if self.paged:
+                for g, s in enumerate(slots):
+                    blocks, _shared = allocs[g]
+                    self._slot_blocks[s] = blocks
+                    self._table_np[s, : len(blocks)] = blocks
+                    self._table_np[s, len(blocks):] = 0
+                self._push_table()
             padded = np.zeros((len(group), bucket), np.int32)
             lens = np.empty(len(group), np.int32)
             for g, req in enumerate(group):
@@ -296,8 +436,31 @@ class InferenceEngine:
             self._finished.append(req)
             self.stats.finished_requests += 1
             self._slot_req[s] = None
+            if self.paged and self._slot_blocks[s] is not None:
+                # blocks return to the pool (shared prefix blocks just
+                # decref; fully-released ones linger in the prefix LRU).
+                # The table row must reset to the trash block NOW: the
+                # dead slot keeps writing junk KV every step, and its
+                # freed blocks may be reallocated to a live sequence.
+                self._blockmgr.free_sequence(self._slot_blocks[s])
+                self._slot_blocks[s] = None
+                self._table_np[s, :] = 0
+                # batched: several slots often finish in one round, and
+                # a table transfer per finish would pay the host->device
+                # hop each time — dispatch sites push once when dirty
+                self._table_dirty = True
             return True
         return False
+
+    def _push_table(self) -> None:
+        table = jnp.asarray(self._table_np)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            table = jax.device_put(
+                table, NamedSharding(self.mesh, PartitionSpec()))
+        self._cache = dict(self._cache, table=table)
+        self._table_dirty = False
 
     # ----------------------------------------------------------- step
     @property
@@ -311,10 +474,13 @@ class InferenceEngine:
         before = len(self._finished)
         self._admit()
         active = np.array([r is not None for r in self._slot_req])
-        if active.any() and self._spec_fn is not None:
+        if active.any() and self._spec_fn is not None \
+                and self._spec_state == "on":
             self._spec_step()
             return self._finished[before:]
         if active.any():
+            if self.paged and self._table_dirty:
+                self._push_table()
             t0 = time.perf_counter()
             out, tokens, positions, self._cache, self._rng = \
                 self._chunk_fn(
@@ -327,6 +493,7 @@ class InferenceEngine:
             self._tokens = np.array(tokens)
             self._positions = np.array(positions)
             self.stats.decode_seconds += time.perf_counter() - t0
+            self.stats.decode_forwards += self.chunk
             for s in range(self.max_slots):
                 req = self._slot_req[s]
                 if req is None:
@@ -336,15 +503,53 @@ class InferenceEngine:
                 if self.eos_token is not None and self.eos_token in toks:
                     toks = toks[: toks.index(self.eos_token) + 1]
                 req.output.extend(toks)
+                if self._spec_fn is not None and toks:
+                    # keep the draft-lookup context fresh so a later
+                    # switch back to speculation sees these tokens
+                    n = int(self._ctx_len[s])
+                    end = min(n + len(toks), self._ctx_buf.shape[1])
+                    self._ctx_buf[s, n:end] = toks[: end - n]
+                    self._ctx_len[s] = end
                 self._remaining[s] -= len(toks)
                 self.stats.generated_tokens += len(toks)
                 self._finish_if_done(s, toks[-1] if toks else -1)
+            if self._spec_fn is not None:
+                self._after_chunk_round()
         return self._finished[before:]
+
+    def _after_chunk_round(self) -> None:
+        """Speculation governor, chunk-decode side: count down a
+        backoff, or (auto mode) probe the FREE draft hit rate and
+        switch speculation on when drafts are available often enough."""
+        from dlrover_tpu.serving.speculative import find_draft
+
+        if self._spec_state == "backoff":
+            self._spec_cooldown -= 1
+            if self._spec_cooldown <= 0:
+                self._spec_state = "watching" if self.spec_auto else "on"
+                self._spec_window.clear()
+            return
+        if self._spec_state != "watching":
+            return
+        for s, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            n = int(self._ctx_len[s])
+            context = self._ctx_buf[s, max(0, n - 2048):n]
+            self._draft_hits.append(
+                find_draft(context, self.speculative_k - 1) is not None
+            )
+        if len(self._draft_hits) >= 8 and (
+            sum(self._draft_hits) / len(self._draft_hits) >= 0.4
+        ):
+            self._spec_state = "on"
+            self._draft_hits.clear()
 
     def _spec_step(self) -> None:
         """One speculative round: draft K-1 tokens per slot by prompt
-        lookup, verify all slots in ONE dispatch, commit the longest
-        greedy-matching prefix + 1 bonus token per slot."""
+        lookup, verify all slots in ONE dispatch, commit the exact-
+        distribution sample (greedy prefix match, or rejection sampling
+        under temperature/top-k/top-p — speculative.rejection_commit)."""
         from dlrover_tpu.serving.speculative import find_draft
 
         k = self.speculative_k
@@ -361,25 +566,31 @@ class InferenceEngine:
             if draft is not None:
                 tokens[s, 1:1 + draft.size] = draft
                 draft_lens[s] = draft.size
+        if self.paged and self._table_dirty:
+            self._push_table()
         t0 = time.perf_counter()
-        nxt, self._cache = self._spec_fn(
+        out, n_commit, self._cache, self._rng = self._spec_fn(
             self.params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(self._positions),
+            jnp.asarray(self._positions), jnp.asarray(draft_lens),
+            self._rng,
         )
-        nxt = np.asarray(nxt)
+        out = np.asarray(out)
+        n_commit = np.asarray(n_commit)
         self.stats.decode_seconds += time.perf_counter() - t0
         self.stats.spec_calls += 1
+        self.stats.decode_forwards += 1
+        round_proposed = 0
+        round_accepted = 0
         for s in range(self.max_slots):
             req = self._slot_req[s]
             if req is None:
                 continue
-            accepted = 0
-            while (accepted < draft_lens[s]
-                   and nxt[s, accepted] == tokens[s, accepted + 1]):
-                accepted += 1
+            accepted = int(n_commit[s]) - 1
+            round_proposed += int(draft_lens[s])
+            round_accepted += accepted
             self.stats.spec_proposed += int(draft_lens[s])
             self.stats.spec_accepted += accepted
-            toks = nxt[s, : accepted + 1].tolist()
+            toks = out[s, : accepted + 1].tolist()
             take = min(len(toks), int(self._remaining[s]))
             toks = toks[:take]
             if self.eos_token is not None and self.eos_token in toks:
@@ -395,6 +606,17 @@ class InferenceEngine:
             self._tokens[s] = toks[-1]
             self._positions[s] += len(toks)
             self._finish_if_done(s, toks[-1])
+        # governor: measured low acceptance -> back off to chunk decode
+        # (a missing draft costs one wasted verify's worth of drafts
+        # every round; backing off makes the miss genuinely free)
+        self._spec_window.append((round_proposed, round_accepted))
+        proposed = sum(p for p, _ in self._spec_window)
+        if proposed >= 64:
+            rate = sum(a for _, a in self._spec_window) / proposed
+            if rate < self.spec_accept_floor:
+                self._spec_state = "backoff"
+                self._spec_cooldown = 8
+                self._spec_window.clear()
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue; returns {request_id: generated tokens}."""

@@ -39,46 +39,42 @@ import jax.numpy as jnp
 
 from dlrover_tpu.models.llama import LlamaConfig, apply_rope, rope_frequencies
 from dlrover_tpu.ops.attention import dot_product_attention
-from dlrover_tpu.ops.pallas.quant_matmul import prequant_matmul
+
 from dlrover_tpu.rl.generation import select_token
 
 
 def _mm(x: jax.Array, w: Any, dtype, wide: bool = False) -> jax.Array:
     """x @ w for fp or pre-quantized ({"q","scale"}) weights.
 
-    ``wide=True`` is the prefill path: at M>=128 the int8 Pallas kernel
-    (tiled for M=1..8 decode) loses to the MXU's bf16 rate.  Wide
-    matmuls instead run XLA's NATIVE int8 dot — per-row activation
-    scales, int8xint8 -> int32 on the MXU, per-column weight scales
-    applied on the OUTPUT (column scales commute with the contraction,
-    so this matches dequantize-first numerics; the w8a8 error class is
-    the same as the decode kernel's).  Measured on v5e at M=128,
-    K=1024, N=4096: bf16 22.6us / dequant-materialize 54us / native
-    int8 20.5us — the fix for "int8 prefill slower than bf16"
-    (PERF.md serving notes).  Decode keeps the Pallas kernel: weight
-    streaming at int8 width is its actual bandwidth win.
+    Every int8 matmul — decode AND prefill — runs XLA's NATIVE int8
+    dot: per-row activation scales, int8xint8 -> int32 on the MXU,
+    per-column weight scales applied on the OUTPUT (column scales
+    commute with the contraction, so this matches dequantize-first
+    numerics).  Measured on v5e (benchmarks/probes/int8_decode_probe*):
+    at decode shapes (M=8, h2048) the native dot streams weights at
+    331 GB/s vs the Pallas kernel's 259 and bf16's wins grow with N
+    (square 1.25x, qkv-fused 1.51x, lm head 1.83x) — XLA's own
+    pipeline beats the hand-tiled kernel at every serving shape, so
+    the Pallas path is gone (it remains in ops/ for the training-side
+    frozen-layer use).  ``wide`` is kept for call-site documentation
+    only.
     """
     if isinstance(w, dict):
-        if wide:
-            amax = jnp.maximum(
-                jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                        keepdims=True),
-                1e-8,
-            )
-            xq = jnp.round(
-                x.astype(jnp.float32) / amax * 127.0
-            ).astype(jnp.int8)
-            out = jax.lax.dot_general(
-                xq, w["q"],
-                (((x.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )
-            return (
-                out.astype(jnp.float32) * (amax / 127.0) * w["scale"]
-            ).astype(dtype)
-        interpret = jax.default_backend() == "cpu"
-        return prequant_matmul(
-            x, w["q"], w["scale"], interpret=interpret
+        amax = jnp.maximum(
+            jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                    keepdims=True),
+            1e-8,
+        )
+        xq = jnp.round(
+            x.astype(jnp.float32) / amax * 127.0
+        ).astype(jnp.int8)
+        out = jax.lax.dot_general(
+            xq, w["q"],
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return (
+            out.astype(jnp.float32) * (amax / 127.0) * w["scale"]
         ).astype(dtype)
     return (x.astype(dtype) @ w.astype(dtype)).astype(dtype)
 
@@ -121,6 +117,42 @@ def _qkv_split(cfg: LlamaConfig, qkv: jax.Array):
         _split_heads(qkv[..., qd:qd + kvd], cfg.num_kv_heads, d),
         _split_heads(qkv[..., qd + kvd:], cfg.num_kv_heads, d),
     )
+
+
+def _attn_proj(lp, h, cfg: LlamaConfig, dtype, wide: bool = False):
+    """q/k/v projections for either param layout: fused ``wqkv``
+    (single-chip decode: fewer, larger launches) or unfused
+    ``wq/wk/wv`` (tensor-parallel serving: per-matrix column sharding
+    keeps head semantics — params.py shard_serving_state)."""
+    d = cfg.head_dim_
+    if "wqkv" in lp:
+        qkv = _mm(h, lp["wqkv"], dtype, wide)
+        if "bqkv" in lp:  # Qwen2-family qkv biases
+            qkv = qkv + lp["bqkv"].astype(dtype)
+        return _qkv_split(cfg, qkv)
+
+    def one(wn: str, bn: str, heads: int):
+        y = _mm(h, lp[wn], dtype, wide)
+        if bn in lp:
+            y = y + lp[bn].astype(dtype)
+        return _split_heads(y, heads, d)
+
+    return (
+        one("wq", "bq", cfg.num_heads),
+        one("wk", "bk", cfg.num_kv_heads),
+        one("wv", "bv", cfg.num_kv_heads),
+    )
+
+
+def _mlp(lp, h, cfg: LlamaConfig, dtype, wide: bool = False):
+    f = cfg.intermediate_size
+    if "wgu" in lp:
+        gu = _mm(h, lp["wgu"], dtype, wide)
+        act = jax.nn.silu(gu[..., :f]) * gu[..., f:]
+    else:
+        act = jax.nn.silu(_mm(h, lp["wgate"], dtype, wide)) * _mm(
+            h, lp["wup"], dtype, wide)
+    return _mm(act, lp["down"], dtype, wide)
 
 
 def decode_step(
@@ -182,32 +214,53 @@ def verify_step(
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
         pos_k]                                               # [B, K, d/2]
 
+    # paged cache ({"k_pool","v_pool","table"}) vs dense ({"k","v"}):
+    # same transformer loop, different cache plumbing (serving/paged.py)
+    paged = "table" in cache
+    if paged:
+        from dlrover_tpu.serving.paged import (
+            gather_blocks,
+            scatter_tokens,
+        )
+
+        table = cache["table"]
+
     new_k, new_v = [], []
     for i in range(cfg.num_layers):
         lp = _layer_weights(params["layers"], i)
-        ck, cv = cache["k"][i], cache["v"][i]
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
-        qkv = _mm(h, lp["wqkv"], dtype)
-        if "bqkv" in lp:  # Qwen2-family qkv biases
-            qkv = qkv + lp["bqkv"].astype(dtype)
-        q, k, v = _qkv_split(cfg, qkv)
+        q, k, v = _attn_proj(lp, h, cfg, dtype)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
-        ck = _write_cache(ck, k, positions)
-        cv = _write_cache(cv, v, positions)
+        if paged:
+            kp = scatter_tokens(cache["k_pool"][i], table,
+                                k.astype(cache["k_pool"][i].dtype),
+                                positions)
+            vp = scatter_tokens(cache["v_pool"][i], table,
+                                v.astype(cache["v_pool"][i].dtype),
+                                positions)
+            ck = gather_blocks(kp, table)
+            cv = gather_blocks(vp, table)
+            new_k.append(kp)
+            new_v.append(vp)
+        else:
+            ck = _write_cache(cache["k"][i], k, positions)
+            cv = _write_cache(cache["v"][i], v, positions)
+            new_k.append(ck)
+            new_v.append(cv)
         o = _attn_verify(q, ck, cv, positions, n_rep).astype(dtype)
         o = o.reshape(b, klen, cfg.num_heads * d)
         x = x + _mm(o, lp["wo"], dtype)
         h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
-        gu = _mm(h, lp["wgu"], dtype)
-        x = x + _mm(jax.nn.silu(gu[..., :f]) * gu[..., f:],
-                    lp["down"], dtype)
-        new_k.append(ck)
-        new_v.append(cv)
+        x = x + _mlp(lp, h, cfg, dtype)
 
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _lm_head(params, x.astype(dtype), cfg)           # [B, K, V]
-    return logits, {"k": new_k, "v": new_v}
+    if paged:
+        out_cache = dict(cache, k_pool=new_k, v_pool=new_v)
+    else:
+        out_cache = {"k": new_k, "v": new_v}
+    return logits, out_cache
 
 
 def _attn_verify(
@@ -288,10 +341,7 @@ def prefill(
     for i in range(cfg.num_layers):
         lp = _layer_weights(params["layers"], i)
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
-        qkv = _mm(h, lp["wqkv"], dtype, wide=True)
-        if "bqkv" in lp:  # Qwen2-family qkv biases
-            qkv = qkv + lp["bqkv"].astype(dtype)
-        q, k, v = _qkv_split(cfg, qkv)
+        q, k, v = _attn_proj(lp, h, cfg, dtype, wide=True)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         o = dot_product_attention(q, k, v, causal=True,
@@ -299,9 +349,7 @@ def prefill(
         o = o.reshape(o.shape[0], lp_len, cfg.num_heads * d)
         x = x + _mm(o, lp["wo"], dtype, wide=True)
         h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
-        gu = _mm(h, lp["wgu"], dtype, wide=True)
-        x = x + _mm(jax.nn.silu(gu[..., :f]) * gu[..., f:],
-                    lp["down"], dtype, wide=True)
+        x = x + _mlp(lp, h, cfg, dtype, wide=True)
         ks.append(k)
         vs.append(v)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)

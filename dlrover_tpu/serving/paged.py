@@ -1,0 +1,159 @@
+"""Paged KV cache: block-pool memory management for the serving engine.
+
+What vLLM gives the reference's RL rollouts (reference:
+atorch/atorch/rl/inference_backend/vllm_backend.py:11-24 — paged
+attention, prefix reuse), rebuilt TPU-style:
+
+- **block pool**: per layer, K/V live in ``[num_blocks, block_size,
+  KV, D]`` pools; a sequence owns a LIST of blocks instead of a dense
+  ``max_len`` stripe, so cache memory scales with actual sequence
+  lengths and concurrency is bounded by the pool (HBM budget), not by
+  ``slots x max_len`` worst-case reservations.
+- **prefix caching**: the leading FULL prompt blocks are content-hashed
+  (chained, so a hit guarantees the whole prefix matches); admissions
+  reuse hit blocks refcounted, and fully-released prefix blocks linger
+  in an LRU until the allocator actually needs them — repeated system
+  prompts cost their KV once.
+- **static shapes**: the device side sees a fixed ``[slots,
+  max_blocks]`` int32 table and fixed pools; only the HOST manager is
+  dynamic.  Reads gather ``pool[table]`` back to the dense ``[B, L,
+  KV, D]`` view the attention kernels already handle — correctness
+  first (the gather is XLA-fused with the attention reads); a fused
+  Pallas paged-attention kernel is the optimization seam.
+
+Writes into SHARED (refcount > 1) prefix blocks are allowed and
+harmless by construction: a shared block is always a full prompt block
+whose content is a deterministic function of the same tokens, so any
+writer rewrites identical values.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockManager:
+    """Host-side pool bookkeeping: allocation, refcounts, prefix LRU."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # block 0 is the TRASH SINK, never allocated: the decode step
+        # computes (and writes) junk KV for INACTIVE slots too — their
+        # all-zero table rows must route those writes somewhere no live
+        # sequence reads (the dense layout absorbs this in the dead
+        # slot's own row; paging needs the sentinel)
+        self._free: List[int] = list(range(1, num_blocks))[::-1]
+        self._ref = np.zeros(num_blocks, np.int32)
+        # chain-hash -> block id for full prompt blocks currently in
+        # the pool (referenced or lingering)
+        self._prefix: Dict[int, int] = {}
+        self._block_hash: Dict[int, int] = {}
+        # fully-released prefix blocks, oldest first (evictable)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+
+    # ------------------------------------------------------------ alloc
+    @property
+    def available_blocks(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    def _take_block(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._lru:  # evict the oldest lingering prefix block
+            bid, _ = self._lru.popitem(last=False)
+            h = self._block_hash.pop(bid, None)
+            # the chain hash may have been RE-registered to a newer
+            # block after this one was orphaned — only drop the mapping
+            # if it still points at the block being evicted
+            if h is not None and self._prefix.get(h) == bid:
+                self._prefix.pop(h, None)
+            return bid
+        return None
+
+    def alloc_sequence(
+        self, prompt: np.ndarray, total_len: int
+    ) -> Optional[Tuple[List[int], int]]:
+        """Blocks for a sequence of ``total_len`` positions whose first
+        ``len(prompt)`` tokens are known: returns ``(blocks,
+        shared_tokens)`` where the first ``shared_tokens`` positions
+        are served by refcount-bumped prefix-cache hits, or None when
+        the pool cannot cover the request (caller keeps it queued)."""
+        bs = self.block_size
+        prompt = np.asarray(prompt).reshape(-1)
+        n_blocks = -(-max(int(total_len), 1) // bs)
+        full_prompt_blocks = prompt.size // bs
+
+        shared: List[int] = []
+        chain = 0
+        for i in range(full_prompt_blocks):
+            chain = hash((chain, prompt[i * bs:(i + 1) * bs].tobytes()))
+            bid = self._prefix.get(chain)
+            if bid is None:
+                break
+            shared.append((chain, bid))
+        need = n_blocks - len(shared)
+        if need > self.available_blocks:
+            return None
+        blocks: List[int] = []
+        for chain_h, bid in shared:
+            if self._ref[bid] == 0:
+                self._lru.pop(bid, None)  # revive a lingering block
+            self._ref[bid] += 1
+            blocks.append(bid)
+        chain = shared[-1][0] if shared else 0
+        for i in range(len(shared), n_blocks):
+            bid = self._take_block()
+            assert bid is not None  # guarded by available_blocks above
+            self._ref[bid] = 1
+            blocks.append(bid)
+            if i < full_prompt_blocks:
+                chain = hash(
+                    (chain, prompt[i * bs:(i + 1) * bs].tobytes())
+                )
+                self._prefix[chain] = bid
+                self._block_hash[bid] = chain
+        return blocks, len(shared) * bs
+
+    def free_sequence(self, blocks: List[int]) -> None:
+        for bid in blocks:
+            self._ref[bid] -= 1
+            assert self._ref[bid] >= 0
+            if self._ref[bid] == 0:
+                if bid in self._block_hash:
+                    # prefix block: linger in the LRU for reuse
+                    self._lru[bid] = None
+                    self._lru.move_to_end(bid)
+                else:
+                    self._free.append(bid)
+
+
+# ---------------------------------------------------------------- device
+def gather_blocks(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """``pool [NB, bs, KV, D] x table [B, MB] -> [B, MB*bs, KV, D]`` —
+    the dense per-slot view the attention kernels consume."""
+    b, mb = table.shape
+    g = jnp.take(pool, table, axis=0)          # [B, MB, bs, KV, D]
+    return g.reshape(b, mb * pool.shape[1], *pool.shape[2:])
+
+
+def scatter_tokens(
+    pool: jax.Array,        # [NB, bs, KV, D]
+    table: jax.Array,       # [B, MB]
+    kv: jax.Array,          # [B, K, KV, D] new entries
+    positions: jax.Array,   # [B] position of kv[:, 0]
+) -> jax.Array:
+    """Write K consecutive tokens per slot into their blocks."""
+    bs = pool.shape[1]
+    b, k = kv.shape[:2]
+    pos = positions[:, None] + jnp.arange(k)[None, :]        # [B, K]
+    bidx = jnp.take_along_axis(table, pos // bs, axis=1)     # [B, K]
+    off = pos % bs
+    return pool.at[bidx.reshape(-1), off.reshape(-1)].set(
+        kv.reshape(b * k, *kv.shape[2:])
+    )

@@ -38,7 +38,8 @@ from dlrover_tpu.ops.pallas.quant_matmul import prequantize_weight
 # wqkv / wgu are load-time fusions: one [E, H*D+2*KV*D] matmul instead
 # of three and one [E, 2F] instead of two — fewer, larger kernels (the
 # standard serving fusion; decode is launch/bandwidth-bound)
-_LAYER_MATS = ("wqkv", "wo", "wgu", "down")
+_LAYER_MATS = ("wqkv", "wo", "wgu", "down",
+               "wq", "wk", "wv", "wgate", "wup")
 
 
 def _maybe_quant(w: jax.Array, int8: bool):
@@ -48,7 +49,9 @@ def _maybe_quant(w: jax.Array, int8: bool):
     return {"q": q, "scale": scale}
 
 
-def _layer_tree(p: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
+def _layer_tree(
+    p: Dict[str, Any], cfg: LlamaConfig, fuse: bool = True
+) -> Dict[str, Any]:
     """One flax DecoderLayer param subtree -> serving 2D matrices.
 
     Handles both the per-layer form ([E, H, D] kernels) and the
@@ -67,28 +70,45 @@ def _layer_tree(p: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
     wq = merge_last2(attn["q_proj"]["kernel"])
     wk = merge_last2(attn["k_proj"]["kernel"])
     wv = merge_last2(attn["v_proj"]["kernel"])
+
+    def flat(b):  # [..., H, D] -> [..., H*D]
+        return jnp.asarray(b).reshape(
+            *b.shape[:-2], b.shape[-2] * b.shape[-1]
+        )
+
     out = {
         "input_norm": p["input_norm"]["scale"],
         "post_norm": p["post_norm"]["scale"],
-        "wqkv": jnp.concatenate([jnp.asarray(wq), jnp.asarray(wk),
-                                 jnp.asarray(wv)], axis=-1),
         "wo": merge_head_in(attn["o_proj"]["kernel"]),
-        "wgu": jnp.concatenate(
-            [jnp.asarray(p["mlp"]["gate_proj"]["kernel"]),
-             jnp.asarray(p["mlp"]["up_proj"]["kernel"])], axis=-1),
         "down": p["mlp"]["down_proj"]["kernel"],
     }
-    if "bias" in attn["q_proj"]:
-        # Qwen2-family qkv biases, fused to match the wqkv layout
-        def flat(b):  # [..., H, D] -> [..., H*D]
-            return jnp.asarray(b).reshape(
-                *b.shape[:-2], b.shape[-2] * b.shape[-1]
+    if fuse:
+        out["wqkv"] = jnp.concatenate(
+            [jnp.asarray(wq), jnp.asarray(wk), jnp.asarray(wv)],
+            axis=-1)
+        out["wgu"] = jnp.concatenate(
+            [jnp.asarray(p["mlp"]["gate_proj"]["kernel"]),
+             jnp.asarray(p["mlp"]["up_proj"]["kernel"])], axis=-1)
+        if "bias" in attn["q_proj"]:
+            # Qwen2-family qkv biases, fused to match the wqkv layout
+            out["bqkv"] = jnp.concatenate(
+                [flat(attn["q_proj"]["bias"]),
+                 flat(attn["k_proj"]["bias"]),
+                 flat(attn["v_proj"]["bias"])], axis=-1,
             )
-
-        out["bqkv"] = jnp.concatenate(
-            [flat(attn["q_proj"]["bias"]), flat(attn["k_proj"]["bias"]),
-             flat(attn["v_proj"]["bias"])], axis=-1,
-        )
+    else:
+        # UNFUSED layout for tensor-parallel serving: a fused
+        # [q|k|v] (or [gate|up]) column block sharded down its last
+        # axis hands device 0 all the q heads — per-matrix weights
+        # shard head-correctly with a plain P(None, "tp")
+        out["wq"], out["wk"], out["wv"] = (
+            jnp.asarray(wq), jnp.asarray(wk), jnp.asarray(wv))
+        out["wgate"] = jnp.asarray(p["mlp"]["gate_proj"]["kernel"])
+        out["wup"] = jnp.asarray(p["mlp"]["up_proj"]["kernel"])
+        if "bias" in attn["q_proj"]:
+            out["bq"] = flat(attn["q_proj"]["bias"])
+            out["bk"] = flat(attn["k_proj"]["bias"])
+            out["bv"] = flat(attn["v_proj"]["bias"])
     return out
 
 
@@ -97,6 +117,7 @@ def serving_params_from_llama(
     cfg: LlamaConfig,
     int8: bool = False,
     dtype=None,
+    fuse: bool = True,
 ) -> Dict[str, Any]:
     """Convert a ``LlamaModel`` variables dict (either per-layer
     ``layer_{i}`` naming or the ``nn.scan`` stacked form) into the
@@ -109,14 +130,14 @@ def serving_params_from_llama(
     variables = nn.meta.unbox(variables)
     params = variables["params"] if "params" in variables else variables
     if "layers" in params:  # scan form: unstack the leading layer axis
-        stacked = _layer_tree(params["layers"]["layer"], cfg)
+        stacked = _layer_tree(params["layers"]["layer"], cfg, fuse)
         per_layer = [
             {k: v[i] for k, v in stacked.items()}
             for i in range(cfg.num_layers)
         ]
     else:
         per_layer = [
-            _layer_tree(params[f"layer_{i}"], cfg)
+            _layer_tree(params[f"layer_{i}"], cfg, fuse)
             for i in range(cfg.num_layers)
         ]
 
@@ -154,3 +175,82 @@ def serving_params_nbytes(sp: Dict[str, Any]) -> int:
     from dlrover_tpu.optimizers.low_bit import state_nbytes
 
     return state_nbytes(sp)
+
+
+# -- tensor-parallel serving ------------------------------------------------
+
+# output-dim-sharded matrices (column parallel) vs input-dim-sharded
+# (row parallel, psum after): the Megatron split, realized here purely
+# through input placement — jit propagates the shardings and GSPMD
+# inserts the collectives (scaling-book recipe; no hand-written
+# collectives anywhere)
+_COL_PARALLEL = ("wq", "wk", "wv", "wgate", "wup")
+_ROW_PARALLEL = ("wo", "down")
+
+
+def _mat_spec(name: str, P):
+    if name in _COL_PARALLEL:
+        return P(None, "tp")
+    if name in _ROW_PARALLEL:
+        return P("tp", None)
+    return P()  # norms, biases of replicated mats
+
+
+def shard_serving_state(
+    params: Dict[str, Any], cache: Dict[str, Any], mesh, cfg: LlamaConfig
+) -> tuple:
+    """Place the serving params + KV cache onto a ``tp`` mesh.
+
+    Column-parallel q/k/v/gate/up, row-parallel o/down, tp-sharded
+    lm_head columns, kv-heads-sharded cache; requires the UNFUSED param
+    layout (``serving_params_from_llama(fuse=False)``) and
+    ``num_kv_heads % tp == 0``.  int8 ``{"q","scale"}`` pairs shard the
+    codes like the fp matrix and the per-column scales with the output
+    dim.  Everything else replicates."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"num_kv_heads={cfg.num_kv_heads}"
+        )
+    if any("wqkv" in lt for lt in params["layers"]):
+        raise ValueError(
+            "sharded serving needs the unfused param layout: build "
+            "with serving_params_from_llama(..., fuse=False)"
+        )
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    def place_mat(name: str, w):
+        spec = _mat_spec(name, P)
+        if isinstance(w, dict):  # int8 {"q","scale"}
+            scale_spec = P(None, "tp") if name in _COL_PARALLEL else P()
+            return {"q": put(w["q"], spec),
+                    "scale": put(w["scale"], scale_spec)}
+        return put(w, spec)
+
+    layers = [
+        {k: place_mat(k, v) for k, v in lt.items()}
+        for lt in params["layers"]
+    ]
+    out = {
+        "embed": put(params["embed"], P()),
+        "final_norm": put(params["final_norm"], P()),
+        "layers": layers,
+    }
+    head = params.get("lm_head")
+    out["lm_head"] = (
+        None if head is None else place_mat("wgate", head)  # col spec
+    )
+
+    kv_spec = P(None, None, "tp", None)  # [.., .., KV, D]
+    sharded_cache = {}
+    for key, val in cache.items():
+        if key in ("k", "v", "k_pool", "v_pool"):
+            sharded_cache[key] = [put(x, kv_spec) for x in val]
+        else:
+            sharded_cache[key] = put(val, P())
+    return out, sharded_cache

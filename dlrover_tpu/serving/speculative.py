@@ -1,4 +1,4 @@
-"""Prompt-lookup speculative drafts (model-free n-gram matching).
+"""Prompt-lookup speculative drafts + rejection-sampling verification.
 
 The draft side of the engine's speculative decode mode: instead of a
 separate draft model, continuations are proposed by matching the tail
@@ -8,9 +8,16 @@ surprisingly effective on natural text (summaries, code, chat echo
 long spans of their context), and exactly zero-cost when it misses:
 the verify step degenerates to a normal decode step (1 token/dispatch).
 
-Greedy verification preserves the model's output distribution exactly
-(an accepted draft token IS the greedy token), so the engine restricts
-speculation to ``temperature == 0``.
+Verification preserves the model's output distribution EXACTLY for any
+sampling config: greedy (temperature 0) accepts a draft iff it is the
+argmax; sampling uses speculative rejection sampling
+(:func:`rejection_commit`) — accept draft ``d_i`` with probability
+``p_i(d_i)`` (the draft proposal is a point mass, so the general
+``min(1, p/q)`` rule reduces to ``p``) and on the first rejection
+resample from the leftover ``p_i`` with ``d_i`` removed, which is the
+``norm(max(0, p - q))`` residual.  The committed tokens are therefore
+an exact sample from the target distribution — the Leviathan/Chen
+speculative-sampling guarantee, with q = delta(draft).
 
 Beyond-reference capability: the reference delegates serving to vLLM
 (atorch/atorch/rl/inference_backend/vllm_backend.py:11-24).
@@ -18,7 +25,7 @@ Beyond-reference capability: the reference delegates serving to vLLM
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -53,3 +60,79 @@ def find_draft(
             # continuation always has at least one token
             return ctx[start + glen: start + glen + k].astype(np.int32)
     return None
+
+
+def rejection_commit(
+    logits,                 # [B, K, V] verify logits (pre-filter)
+    drafts,                 # [B, K-1] int32 draft tokens
+    draft_len,              # [B] int32 valid draft count per slot
+    key,                    # PRNG key
+    *,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+) -> Tuple["object", "object"]:
+    """Device-side speculative commit: returns ``(out_tokens [B, K],
+    n_commit [B])`` where ``out_tokens[b, :n_commit[b]]`` is an EXACT
+    sample of ``n_commit[b]`` tokens from the target sampling
+    distribution.
+
+    ``logits[:, i]`` is the distribution of the token AFTER position i;
+    drafts propose tokens at positions 1..K-1.  Greedy (temperature 0):
+    accept while ``argmax == draft``, emit the argmax at the first
+    mismatch (or the bonus position).  Sampling: accept draft ``d_i``
+    with probability ``p_i(d_i)``; at the first rejection sample from
+    ``p_i`` with ``d_i`` zeroed (the q=delta residual); after a full
+    accept sample the bonus from ``p_K``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.rl.generation import filter_logits
+
+    b, k, v = logits.shape
+    flogits = filter_logits(logits, temperature, top_k, top_p)
+    idx = jnp.arange(k - 1)[None, :]
+    valid = idx < draft_len[:, None]                       # [B, K-1]
+    if temperature == 0.0:
+        greedy = jnp.argmax(flogits, axis=-1).astype(jnp.int32)  # [B, K]
+        accept = (greedy[:, : k - 1] == drafts) & valid
+        lead = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+        acc = lead.sum(axis=1)                             # [B]
+        final = jnp.take_along_axis(
+            greedy, acc[:, None], axis=1
+        )[:, 0]
+    else:
+        probs = jax.nn.softmax(flogits / temperature, axis=-1)
+        k_u, k_s = jax.random.split(key)
+        u = jax.random.uniform(k_u, (b, k - 1))
+        p_draft = jnp.take_along_axis(
+            probs[:, : k - 1], drafts[..., None], axis=-1
+        )[..., 0]                                          # [B, K-1]
+        accept = (u < p_draft) & valid
+        lead = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+        acc = lead.sum(axis=1)                             # [B]
+        p_final = jnp.take_along_axis(
+            probs, acc[:, None, None], axis=1
+        )[:, 0]                                            # [B, V]
+        # at a rejection, remove the rejected draft's mass (the
+        # norm(max(0, p - q)) residual for a point-mass q); a full
+        # accept (acc == draft_len) keeps p intact for the bonus token
+        rejected = acc < draft_len
+        d_rej = jnp.take_along_axis(
+            drafts, jnp.minimum(acc, k - 2)[:, None], axis=1
+        )[:, 0]
+        remove = rejected[:, None] & (
+            jax.nn.one_hot(d_rej, v, dtype=bool)
+        )
+        p_final = jnp.where(remove, 0.0, p_final)
+        final = jax.random.categorical(
+            k_s, jnp.log(jnp.maximum(p_final, 1e-38))
+        ).astype(jnp.int32)
+    out = jnp.where(
+        jnp.arange(k)[None, :] < acc[:, None],
+        jnp.pad(drafts, ((0, 0), (0, 1))),
+        0,
+    )
+    out = out.at[jnp.arange(b), acc].set(final)
+    return out.astype(jnp.int32), (acc + 1).astype(jnp.int32)

@@ -1,0 +1,232 @@
+"""Paged KV cache + tensor-parallel serving (VERDICT r4 #3): what vLLM
+gives the reference's rollouts (paged attention, prefix reuse, sharded
+inference — reference: atorch/atorch/rl/inference_backend/
+vllm_backend.py:11-24), rebuilt TPU-style in serving/paged.py +
+params.shard_serving_state."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+from dlrover_tpu.serving.engine import InferenceEngine
+from dlrover_tpu.serving.paged import BlockManager
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(max_seq_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, variables
+
+
+def _prompts(cfg, n, size, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, cfg.vocab_size, (n, size)).astype(np.int32)
+
+
+# -- BlockManager unit tests -----------------------------------------------
+
+
+def test_block_manager_alloc_free_refcount():
+    m = BlockManager(num_blocks=9, block_size=4)  # block 0 = trash sink
+    a = m.alloc_sequence(np.arange(6, dtype=np.int32), total_len=10)
+    assert a is not None
+    blocks, shared = a
+    assert len(blocks) == 3 and shared == 0
+    assert 0 not in blocks, "the trash sink must never be allocated"
+    assert m.available_blocks == 5
+    # identical prompt: the one FULL prompt block (4 tokens) is shared
+    b = m.alloc_sequence(np.arange(6, dtype=np.int32), total_len=10)
+    blocks2, shared2 = b
+    assert shared2 == 4
+    assert blocks2[0] == blocks[0], "full prefix block must be shared"
+    assert blocks2[1] != blocks[1], "partial block must be private"
+    # freeing one user keeps the shared block for the other
+    m.free_sequence(blocks)
+    c = m.alloc_sequence(np.arange(6, dtype=np.int32), total_len=10)
+    assert c[1] == 4 and c[0][0] == blocks[0]
+    m.free_sequence(blocks2)
+    m.free_sequence(c[0])
+    # fully released: the prefix block lingers in the LRU and still hits
+    d = m.alloc_sequence(np.arange(6, dtype=np.int32), total_len=10)
+    assert d[1] == 4
+
+
+def test_block_manager_capacity_and_lru_eviction():
+    m = BlockManager(num_blocks=5, block_size=4)  # 4 usable
+    a = m.alloc_sequence(np.arange(4, dtype=np.int32), 16)[0]
+    assert m.alloc_sequence(np.arange(99, 103, dtype=np.int32), 8) \
+        is None, "over-capacity allocation must be refused"
+    m.free_sequence(a)
+    # a's prefix block lingers, but demand evicts it
+    b = m.alloc_sequence(np.arange(50, 66, dtype=np.int32), 16)
+    assert b is not None and len(b[0]) == 4
+    # the evicted prefix no longer hits
+    m.free_sequence(b[0])
+    c = m.alloc_sequence(np.arange(4, dtype=np.int32), 8)
+    assert c[1] == 0
+
+
+# -- engine parity ----------------------------------------------------------
+
+
+def test_paged_engine_matches_dense_greedy(setup):
+    """Greedy outputs of the paged engine must be identical to the
+    dense engine's, across multiple admission waves (block free/realloc
+    exercised)."""
+    cfg, variables = setup
+    prompts = _prompts(cfg, 6, 12)
+
+    def run(paged):
+        eng = InferenceEngine(
+            cfg, variables, max_slots=2, chunk=4, temperature=0.0,
+            paged=paged, block_size=8,
+        )
+        outs = {}
+        for p in prompts:
+            outs[eng.add_request(p, 10)] = None
+        res = eng.run()
+        return [res[r] for r in sorted(res)], eng
+
+    dense, _ = run(False)
+    paged, eng = run(True)
+    for d, p in zip(dense, paged):
+        np.testing.assert_array_equal(d, p)
+    assert eng._blockmgr.available_blocks == \
+        eng._blockmgr.num_blocks - 1, (  # minus the trash sink
+        "finished sequences must return their blocks (prefix LRU "
+        "counts as available)"
+    )
+
+
+def test_paged_engine_speculative_parity(setup):
+    cfg, variables = setup
+    prompt = np.tile(np.array([5, 6, 7], np.int32), 6)
+
+    def run(paged):
+        eng = InferenceEngine(
+            cfg, variables, max_slots=2, chunk=4, temperature=0.0,
+            speculative_k=4, paged=paged, block_size=8,
+        )
+        rid = eng.add_request(prompt, 12)
+        return eng.run()[rid]
+
+    np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_paged_capacity_exceeds_dense_at_fixed_hbm(setup):
+    """The paging claim, measured: at the SAME cache byte budget the
+    paged engine sustains >= 2x the concurrent sequences.  Dense must
+    reserve max_len per slot; paged allocates actual lengths."""
+    cfg, variables = setup
+    max_len = 96
+    # dense engine with 2 slots reserves 2 * ~max_len rows
+    dense = InferenceEngine(
+        cfg, variables, max_slots=2, chunk=4, temperature=0.0,
+        max_len=max_len,
+    )
+    dense_rows = dense._cache["k"][0].shape[0] * \
+        dense._cache["k"][0].shape[1]
+    # paged engine with the same row budget but 8 slots
+    block_size = 8
+    budget_blocks = dense_rows // block_size
+    eng = InferenceEngine(
+        cfg, variables, max_slots=8, chunk=4, temperature=0.0,
+        max_len=max_len, paged=True, block_size=block_size,
+        cache_blocks=budget_blocks,
+    )
+    pool_rows = eng._cache["k_pool"][0].shape[0] * block_size
+    assert pool_rows <= dense_rows, "budgets must match"
+    # 8 short requests (16 prompt + 6 gen = 22 rows each; 8 x 24 rows
+    # fit the pool, while the dense layout fits only 2 sequences)
+    prompts = _prompts(cfg, 8, 16)
+    for p in prompts:
+        eng.add_request(p, 6)
+    eng._admit()
+    concurrent = sum(r is not None for r in eng._slot_req)
+    assert concurrent >= 4, (
+        f"only {concurrent} concurrent at a budget where dense fits 2"
+    )
+    res = eng.run()
+    assert len(res) == 8
+    for r in res.values():
+        assert r.size == 6
+
+
+def test_paged_prefix_sharing_across_live_requests(setup):
+    """Two live requests with a common long prompt share its full
+    blocks: pool usage stays well under 2x a single sequence."""
+    cfg, variables = setup
+    prompt = _prompts(cfg, 1, 32)[0]
+    eng = InferenceEngine(
+        cfg, variables, max_slots=2, chunk=4, temperature=0.0,
+        paged=True, block_size=8,
+    )
+    r1 = eng.add_request(prompt, 4)
+    r2 = eng.add_request(prompt, 4)
+    eng._admit()
+    used = eng._blockmgr.num_blocks - eng._blockmgr.available_blocks
+    # each sequence needs ceil(36/8)=5 blocks; 4 full prompt blocks are
+    # shared, so 5 + 1(shared tail copy... private) => <= 7, not 10
+    assert used <= 7, used
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1], res[r2])
+
+
+# -- tensor-parallel serving ------------------------------------------------
+
+
+def test_tp2_sharded_decode_parity(setup):
+    """tp=2 sharded serving on the CPU mesh: greedy outputs must equal
+    the unsharded engine's — the sharded-decode dryrun a >single-chip
+    actor needs (VERDICT r4 #3)."""
+    from jax.sharding import Mesh
+
+    cfg, variables = setup
+    devices = np.array(jax.devices()[:2])
+    mesh = Mesh(devices.reshape(2), ("tp",))
+    prompts = _prompts(cfg, 3, 12, seed=7)
+
+    def run(mesh_):
+        eng = InferenceEngine(
+            cfg, variables, max_slots=2, chunk=4, temperature=0.0,
+            mesh=mesh_,
+        )
+        outs = {}
+        for p in prompts:
+            outs[eng.add_request(p, 8)] = None
+        res = eng.run()
+        return [res[r] for r in sorted(res)]
+
+    plain = run(None)
+    sharded = run(mesh)
+    for a, b in zip(plain, sharded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tp2_sharded_paged_engine(setup):
+    """Sharding composes with paging: tp=2 + block-pool cache."""
+    from jax.sharding import Mesh
+
+    cfg, variables = setup
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("tp",))
+    prompts = _prompts(cfg, 3, 12, seed=9)
+
+    def run(**kw):
+        eng = InferenceEngine(
+            cfg, variables, max_slots=2, chunk=4, temperature=0.0, **kw,
+        )
+        outs = {}
+        for p in prompts:
+            outs[eng.add_request(p, 8)] = None
+        res = eng.run()
+        return [res[r] for r in sorted(res)]
+
+    plain = run()
+    sharded_paged = run(mesh=mesh, paged=True, block_size=8)
+    for a, b in zip(plain, sharded_paged):
+        np.testing.assert_array_equal(a, b)

@@ -211,13 +211,102 @@ def test_speculative_greedy_matches_plain_engine(setup):
     assert spec.stats.spec_calls < spec.stats.generated_tokens
 
 
-def test_speculative_rejects_sampling():
+def test_speculative_sampling_runs_and_commits():
+    """Speculation composes with temperature/top-k sampling via exact
+    rejection sampling: the engine produces the requested token counts
+    and the committed tokens come from a live distribution (spec rounds
+    really happened)."""
     cfg = LlamaConfig.tiny(max_seq_len=64, dtype=jnp.float32)
     model = LlamaModel(cfg)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 8), jnp.int32))
-    with pytest.raises(ValueError):
-        InferenceEngine(cfg, variables, temperature=0.7, speculative_k=4)
+    # repetitive prompt: the n-gram lookup finds drafts
+    prompt = np.array([5, 6, 7, 5, 6, 7, 5, 6, 7], np.int32)
+    eng = InferenceEngine(cfg, variables, max_slots=2,
+                          temperature=0.8, top_k=20, speculative_k=4,
+                          seed=3)
+    rid = eng.add_request(prompt, 16)
+    out = eng.run()[rid]
+    assert out.size == 16
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+    assert eng.stats.spec_calls > 0
+    assert eng.stats.spec_proposed > 0
+    assert eng.stats.tokens_per_forward > 0
+
+
+def test_rejection_commit_preserves_target_distribution():
+    """Monte Carlo check of the Leviathan/Chen guarantee with a
+    point-mass draft: accept-prob p(d), resample from the zeroed
+    residual — the committed token at the drafted position must be an
+    EXACT sample from the target distribution."""
+    from dlrover_tpu.serving.speculative import rejection_commit
+
+    vocab = 8
+    # fixed non-trivial target distribution at every position
+    base = np.array([0.30, 0.05, 0.20, 0.02, 0.18, 0.10, 0.05, 0.10])
+    logits = jnp.log(jnp.asarray(base, jnp.float32))[None, None, :]
+    logits = jnp.tile(logits, (1, 2, 1))  # [B=1, K=2, V]
+    drafts = jnp.array([[2]], jnp.int32)  # always draft token 2
+    draft_len = jnp.array([1], jnp.int32)
+
+    @jax.jit
+    def one(key):
+        out, n = rejection_commit(
+            logits, drafts, draft_len, key,
+            temperature=1.0, top_k=0, top_p=1.0,
+        )
+        return out[0, 0]
+
+    trials = 4000
+    keys = jax.random.split(jax.random.PRNGKey(0), trials)
+    samples = np.asarray(jax.vmap(one)(keys))
+    freq = np.bincount(samples, minlength=vocab) / trials
+    # multinomial std per bin ~ sqrt(p(1-p)/n) <= 0.008; 4 sigma
+    np.testing.assert_allclose(freq, base, atol=0.032)
+
+
+def test_rejection_commit_greedy_matches_argmax_path():
+    from dlrover_tpu.serving.speculative import rejection_commit
+
+    logits = jnp.asarray(
+        np.random.RandomState(0).randn(2, 4, 16), jnp.float32
+    )
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    # drafts: slot 0 matches the greedy chain for 2 tokens then breaks;
+    # slot 1 misses immediately
+    drafts = np.zeros((2, 3), np.int32)
+    drafts[0, 0] = greedy[0, 0]
+    drafts[0, 1] = greedy[0, 1]
+    drafts[0, 2] = (greedy[0, 2] + 1) % 16
+    drafts[1, 0] = (greedy[1, 0] + 1) % 16
+    out, n = rejection_commit(
+        jnp.asarray(logits), jnp.asarray(drafts),
+        jnp.array([3, 3], jnp.int32), jax.random.PRNGKey(0),
+        temperature=0.0, top_k=0, top_p=1.0,
+    )
+    out, n = np.asarray(out), np.asarray(n)
+    assert n[0] == 3 and n[1] == 1
+    assert out[0, :3].tolist() == [
+        greedy[0, 0], greedy[0, 1], greedy[0, 2]]
+    assert out[1, 0] == greedy[1, 0]
+
+
+def test_speculative_auto_enables_on_repetitive_context():
+    """speculative_k='auto' starts in chunk decode, watches the free
+    draft hit rate, and switches speculation on for repetitive text."""
+    cfg = LlamaConfig.tiny(max_seq_len=128, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    eng = InferenceEngine(cfg, variables, max_slots=1, chunk=4,
+                          temperature=0.0, speculative_k="auto")
+    assert eng._spec_state == "watching"
+    prompt = np.tile(np.array([3, 9, 4], np.int32), 10)
+    rid = eng.add_request(prompt, 48)
+    out = eng.run()[rid]
+    assert out.size == 48
+    assert eng.stats.spec_calls > 0, "auto mode never engaged"
+    assert eng.stats.tokens_per_forward > 1.0
 
 
 def test_full_length_prompt_with_zero_new_tokens(setup):
