@@ -98,7 +98,12 @@ class BlockManager:
                 break
             shared.append((chain, bid))
         need = n_blocks - len(shared)
-        if need > self.available_blocks:
+        # reviving a shared hit that currently lingers in the LRU also
+        # consumes availability (it leaves the evictable set) — without
+        # counting those, the guard can pass and _take_block() then come
+        # up empty mid-allocation
+        revived = sum(1 for _, bid in shared if self._ref[bid] == 0)
+        if need > self.available_blocks - revived:
             return None
         blocks: List[int] = []
         for chain_h, bid in shared:

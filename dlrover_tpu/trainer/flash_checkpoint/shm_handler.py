@@ -212,8 +212,10 @@ class SharedMemoryHandler:
             self._shm.close()
             self._shm.unlink()
             self._shm = None
+        created = False
         try:
             self._shm = SharedMemory(self._shm_name, create=True, size=max(size, 1))
+            created = True
         except FileExistsError:
             existing = SharedMemory(self._shm_name)
             if existing.size >= size:
@@ -224,6 +226,22 @@ class SharedMemoryHandler:
                 self._shm = SharedMemory(
                     self._shm_name, create=True, size=max(size, 1)
                 )
+                created = True
+        if created:
+            # write-populate the NEW segment's pages now, off the save
+            # path: otherwise the first save pays one minor fault per 4K
+            # page mid-copy, and on a loaded host those faults are what
+            # blow the recorded pause past the steady-state number
+            # (VERDICT r4 #5a)
+            import numpy as np
+
+            from dlrover_tpu.common.multi_process import (
+                populate_write_ndarray,
+            )
+
+            view = np.frombuffer(self._shm.buf, np.uint8)
+            populate_write_ndarray(view)
+            del view
 
     def _attach_shm(self) -> None:
         if self._shm is None:

@@ -104,9 +104,21 @@ def main() -> int:
     )
     trainer.prepare(devices=jax.devices())
     start = trainer.restore_or_init(jax.random.PRNGKey(0))
+    paths = dict(trainer._ckpt.engine.restore_path_counts)
+    if start > 0:
+        # restore-path-taken assertion (VERDICT r4 #5c): a resume must
+        # come from a KNOWN tier, and on the CPU test backend the
+        # in-memory tier is the copy path BY DESIGN (device_put aliases
+        # host memory on CPU; zero-copy is the TPU-backend fast path)
+        assert sum(paths.values()) > 0, (
+            "resumed without any restore path recorded")
+        expect = ("copy", "partial", "storage") \
+            if jax.default_backend() == "cpu" else ("zero_copy", "partial")
+        assert any(paths[k] for k in expect), (paths, expect)
     print(
         f"[spmd] rank={env.worker_rank}/{env.worker_num} "
-        f"devices={jax.device_count()} start_step={start}",
+        f"devices={jax.device_count()} start_step={start} "
+        f"restore_paths={paths}",
         flush=True,
     )
 

@@ -59,6 +59,28 @@ def _read_metrics(path):
     return rows
 
 
+def assert_steps_consistent(rows, max_redos: int):
+    """No work is redone EXCEPT the bounded, deterministic kill-boundary
+    case: a SIGKILL can land between a step's metrics write and its shm
+    save, so the resumed worker legitimately recomputes that one step.
+    Allowed: at most ``max_redos`` duplicated steps (one per membership
+    change), each an IDENTICAL redo (same loss — determinism makes a
+    divergent redo a real bug, not a timing artifact).  Returns the
+    deduplicated step list."""
+    steps = [s for s, _, _ in rows]
+    assert steps == sorted(steps), f"steps went backwards: {steps}"
+    dups = sorted({s for s in steps if steps.count(s) > 1})
+    assert len(dups) <= max_redos, (
+        f"{len(dups)} redone steps (allowed {max_redos}): {steps}"
+    )
+    for s in dups:
+        losses = {round(ls, 5) for st, ls, _ in rows if st == s}
+        assert len(losses) == 1, (
+            f"step {s} redone with a DIFFERENT loss: {losses}"
+        )
+    return sorted(set(steps))
+
+
 def test_kill_one_node_resumes_trajectory(tmp_path):
     work = str(tmp_path)
     from dlrover_tpu.common.rpc import find_free_port
@@ -78,8 +100,9 @@ def test_kill_one_node_resumes_trajectory(tmp_path):
             env.update(
                 DLROVER_FORCE_CPU="1",
                 XLA_FLAGS="--xla_force_host_platform_device_count=2",
-                DLROVER_JAX_HEARTBEAT_TIMEOUT="10",
+                DLROVER_JAX_HEARTBEAT_TIMEOUT="15",
                 DLROVER_JOB_UID=f"spmdE2e{rank}",
+                DLROVER_MONITOR_INTERVAL="1",
                 JAX_PLATFORMS="cpu",
             )
             agents.append(subprocess.Popen(
@@ -113,10 +136,7 @@ def test_kill_one_node_resumes_trajectory(tmp_path):
         assert rc == 0, f"agent0 exited {rc}"
 
         rows = _read_metrics(m0)
-        steps = [s for s, _, _ in rows]
-        assert steps == sorted(set(steps)), (
-            f"steps repeated or reordered: {steps}"  # no re-done work
-        )
+        steps = assert_steps_consistent(rows, max_redos=1)  # 1 kill
         assert steps[-1] == TOTAL_STEPS
         worlds = {s: w for s, _, w in rows}
         assert worlds[1] == 2, "run did not start on the 2-proc world"
@@ -222,8 +242,9 @@ def test_scale_up_mid_run_grows_world(tmp_path):
         env.update(
             DLROVER_FORCE_CPU="1",
             XLA_FLAGS="--xla_force_host_platform_device_count=2",
-            DLROVER_JAX_HEARTBEAT_TIMEOUT="10",
+            DLROVER_JAX_HEARTBEAT_TIMEOUT="15",
             DLROVER_JOB_UID=f"spmdGrow{rank}",
+            DLROVER_MONITOR_INTERVAL="1",
             JAX_PLATFORMS="cpu",
         )
         agents[rank] = subprocess.Popen(
@@ -265,8 +286,7 @@ def test_scale_up_mid_run_grows_world(tmp_path):
         )
         grow_step = min(s for s, w in worlds.items() if w == 2)
         assert grow_step > 1
-        steps = [s for s, _, _ in rows]
-        assert steps == sorted(set(steps)), steps  # no redone work
+        assert_steps_consistent(rows, max_redos=1)  # 1 growth restart
         ref = _reference_losses()
         for s, loss, _ in rows:
             assert np.isclose(loss, ref[s - 1], rtol=1e-3, atol=1e-3), (

@@ -230,3 +230,20 @@ def test_tp2_sharded_paged_engine(setup):
     sharded_paged = run(mesh=mesh, paged=True, block_size=8)
     for a, b in zip(plain, sharded_paged):
         np.testing.assert_array_equal(a, b)
+
+
+def test_block_manager_shared_revive_respects_capacity():
+    """Reviving LRU-lingering prefix hits consumes availability: the
+    capacity guard must refuse (keeping the request queued) instead of
+    asserting mid-allocation (review finding, round 5)."""
+    m = BlockManager(num_blocks=3, block_size=4)  # 2 usable
+    a = m.alloc_sequence(np.arange(8, dtype=np.int32), 8)
+    assert a is not None and a[1] == 0
+    m.free_sequence(a[0])  # both blocks linger in the prefix LRU
+    # same prompt, but now needs a THIRD block: reviving the two shared
+    # hits leaves nothing to take — must refuse, not crash
+    b = m.alloc_sequence(np.arange(8, dtype=np.int32), 12)
+    assert b is None
+    # and the pool is still coherent: the original request fits again
+    c = m.alloc_sequence(np.arange(8, dtype=np.int32), 8)
+    assert c is not None and c[1] == 8
