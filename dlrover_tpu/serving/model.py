@@ -21,9 +21,9 @@ serving wants different things than training —
   host<->device hop is a slow debug tunnel, so it is the difference
   between measuring the model and measuring the RPC).
 - **pre-quantized int8 weights**: every projection may be
-  ``{"q", "scale"}`` in the Pallas kernel layout; only activations
-  quantize per call (``prequant_matmul``), weights stream from HBM at
-  int8 width — decode's actual bottleneck.
+  ``{"q", "scale"}``; only activations quantize per call and weights
+  stream from HBM at int8 width through XLA's native int8 MXU dot —
+  decode's actual bottleneck (see ``_mm``).
 
 All functions are pure; the engine (serving/engine.py) owns jit and
 cache state.
@@ -207,7 +207,6 @@ def verify_step(
     dtype = cfg.dtype
     d = cfg.head_dim_
     n_rep = cfg.num_heads // cfg.num_kv_heads
-    f = cfg.intermediate_size
     b, klen = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)            # [B, K, E]
     pos_k = positions[:, None] + jnp.arange(klen)[None, :]   # [B, K]
@@ -331,7 +330,6 @@ def prefill(
     docstring)."""
     dtype = cfg.dtype
     d = cfg.head_dim_
-    f = cfg.intermediate_size
     lp_len = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)          # [1, Lp, E]
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
