@@ -1,6 +1,15 @@
 """Device-side cost of the paged KV cache's gather-based decode vs the
 dense layout (bench model, batch 8) — the price of HBM-budget-bound
-concurrency until a fused Pallas paged-attention kernel lands."""
+concurrency until a fused Pallas paged-attention kernel lands.
+
+Methodology: positions are the REAL post-prefill positions (the
+admission path sets them), the cache is sized so every timed step stays
+in range (no clamped-overwrite regime), and each timed dispatch chains
+128 scanned steps so the ~110 ms tunnel dispatch amortizes to <1 ms of
+the ~280 ms device work per dispatch.  Both engines are measured by the
+identical procedure, so the comparison is apples-to-apples; absolute
+per-step numbers still carry the amortized dispatch share.
+"""
 
 import time
 
@@ -11,15 +20,20 @@ import numpy as np
 from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
 from dlrover_tpu.serving.engine import InferenceEngine
 
-PROMPT, GEN = 128, 32
+PROMPT = 128
+CHUNK = 128
+TIMED_CHUNKS = 3
+# warmup chunk + 3 trials x TIMED_CHUNKS chunks, all in-range
+MAX_LEN = PROMPT + (1 + 3 * TIMED_CHUNKS) * CHUNK + 64
 
 
 def probe(eng):
-    eng._admit()
+    eng._admit()  # real prefill -> real per-slot positions (= PROMPT)
     tokens = jnp.asarray(eng._tokens)
-    positions = jnp.zeros(eng.max_slots, jnp.int32) + 1
+    positions = jnp.asarray(eng._positions)
     active = jnp.asarray(np.ones(eng.max_slots, bool))
     cache, rng = eng._cache, eng._rng
+    # warmup compiles the chunk program and advances past position 128
     out, tokens, positions, cache, rng = eng._chunk_fn(
         eng.params, cache, tokens, positions, active, rng)
     jax.block_until_ready(out)
@@ -27,15 +41,18 @@ def probe(eng):
     for _ in range(3):
         t0 = time.perf_counter()
         outs = []
-        for _ in range(3):
+        for _ in range(TIMED_CHUNKS):
             out, tokens, positions, cache, rng = eng._chunk_fn(
                 eng.params, cache, tokens, positions, active, rng)
             outs.append(out)
         jax.block_until_ready(outs)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
+    assert int(np.asarray(positions).max()) < eng.max_len, (
+        "timed steps left the valid cache range — numbers would measure "
+        "the clamped-overwrite regime, not serving")
     eng._cache, eng._rng = cache, rng
-    return best / (3 * eng.chunk) * 1e3
+    return best / (TIMED_CHUNKS * eng.chunk) * 1e3
 
 
 def main():
@@ -51,14 +68,15 @@ def main():
     prompts = rng.randint(0, cfg.vocab_size, (8, PROMPT)).astype(np.int32)
     for paged in (False, True):
         eng = InferenceEngine(
-            cfg, variables, max_slots=8, chunk=32, temperature=1.0,
-            top_k=50, max_len=PROMPT + GEN, seed=0,
+            cfg, variables, max_slots=8, chunk=CHUNK, temperature=1.0,
+            top_k=50, max_len=MAX_LEN, seed=0,
             paged=paged, block_size=16,
         )
         for p in prompts:
-            eng.add_request(p, GEN)
+            eng.add_request(p, MAX_LEN - PROMPT)
         ms = probe(eng)
-        print(f"paged={paged}: decode step {ms:.3f} ms")
+        print(f"paged={paged}: decode step {ms:.3f} ms "
+              f"({TIMED_CHUNKS}x{CHUNK} in-range steps per trial)")
 
 
 if __name__ == "__main__":
