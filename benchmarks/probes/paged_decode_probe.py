@@ -23,8 +23,9 @@ from dlrover_tpu.serving.engine import InferenceEngine
 PROMPT = 128
 CHUNK = 128
 TIMED_CHUNKS = 3
+TRIALS = 3
 # warmup chunk + 3 trials x TIMED_CHUNKS chunks, all in-range
-MAX_LEN = PROMPT + (1 + 3 * TIMED_CHUNKS) * CHUNK + 64
+MAX_LEN = PROMPT + (1 + TRIALS * TIMED_CHUNKS) * CHUNK + 64
 
 
 def probe(eng):
@@ -38,7 +39,7 @@ def probe(eng):
         eng.params, cache, tokens, positions, active, rng)
     jax.block_until_ready(out)
     best = None
-    for _ in range(3):
+    for _ in range(TRIALS):
         t0 = time.perf_counter()
         outs = []
         for _ in range(TIMED_CHUNKS):

@@ -1,0 +1,174 @@
+"""Fused paged-attention decode kernel (TPU Pallas) — EXPERIMENTAL.
+
+The seam named in PERF.md: the XLA path materializes each slot's dense
+cache view (``gather_blocks``) before attention, a second full pass
+over the cache bytes that costs ~19% of the decode step at ~1.4k
+context.  This kernel reads K/V blocks IN PLACE from the pools — the
+per-block pool row is selected by a scalar-prefetched block table in
+the BlockSpec index map, so the only cache traffic is the one
+streaming read attention itself needs.
+
+STATUS (measured on v5e, batch 8, h2048-class heads, ~1.5k rows):
+numerically exact (parity tests) but NOT yet faster than the XLA
+gather path, so serving does not use it.  At the engine's 16-row
+blocks the grid is (B x ~92) tiny steps and per-grid-step latency
+dominates (472 us vs 86 us); at 128-row pages it reaches ~470 GB/s
+(128 us) but XLA's fused gather+attention still wins — the fusion
+already streams near peak, and this kernel's per-kv-head small dots
+under-fill the MXU.  The win would need multi-page compute blocks
+with manual double-buffered DMA (the design the in-tree TPU paged
+kernel uses); kept here with parity tests as the starting point.
+
+Scope: single-query decode (the serving engine's K=1 step — its hot
+path; speculative verify keeps the gather path).  Grid ``(B, MB)``:
+for each slot the kernel streams that slot's blocks once ([bs, KV, D]
+pool rows, every kv head together — exactly the pool's natural
+layout), runs an online-softmax (flash-style m/l/acc carry in VMEM
+scratch) over ``[KV*G, bs]`` score tiles, and masks rows past the
+slot's visible length.  GQA: queries regroup to ``[KV, G, D]`` and
+each kv head's ``[G, bs]`` scores come from one small dot against its
+slice of the block.
+
+Layout contract (matches serving/paged.py):
+  q        [B, H, D]        current-token queries
+  k_pool   [NB, bs, KV, D]
+  v_pool   [NB, bs, KV, D]
+  table    [B, MB] int32    per-slot block lists (0 = trash block)
+  lengths  [B]    int32     visible keys per slot (= position + 1)
+Returns [B, H, D] fp32.
+
+Blocks past the slot's length still stream (static grid) but their
+scores are masked to -inf; with MB sized from the engine's max_len
+this is the same worst-case the dense layout always pays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    table_ref, lengths_ref,          # scalar-prefetched (SMEM)
+    q_ref, k_ref, v_ref,             # [1,KV,G,D], [1,bs,KV,D], [1,bs,KV,D]
+    o_ref,                           # [1,KV,G,D]
+    m_scr, l_scr, acc_scr,           # [KV*G], [KV*G], [KV*G, D]
+    *, block_size: int, num_blocks: int, kv_heads: int, group: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)                # [KV, G, D]
+    k = k_ref[0].astype(jnp.float32)                # [bs, KV, D]
+    v = v_ref[0].astype(jnp.float32)
+    d = q.shape[-1]
+    # per-kv-head scores: [KV, G, bs] via KV small dots (static loop)
+    scores = jnp.concatenate(
+        [
+            jax.lax.dot_general(
+                q[kvi], k[:, kvi], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for kvi in range(kv_heads)
+        ],
+        axis=0,
+    ) / (d ** 0.5)                                  # [KV*G, bs]
+    key_pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1
+    )
+    visible = key_pos < lengths_ref[b]
+    scores = jnp.where(visible, scores, _NEG_INF)
+
+    m_prev = m_scr[...]                             # [KV*G]
+    m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+    # guard the all-masked block: exp(-inf - -inf) must not NaN
+    alpha = jnp.where(m_new == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+    p = jnp.exp(scores - m_new[:, None])
+    p = jnp.where(visible, p, 0.0)
+    l_scr[...] = alpha * l_scr[...] + p.sum(axis=-1)
+    # weighted values: [KV*G, D] from KV dots [G, bs] @ [bs, D]
+    pv = jnp.concatenate(
+        [
+            jax.lax.dot_general(
+                p[kvi * group:(kvi + 1) * group], v[:, kvi],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for kvi in range(kv_heads)
+        ],
+        axis=0,
+    )
+    acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
+    m_scr[...] = m_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / denom[:, None]).reshape(
+            kv_heads, group, d
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(
+    q: jax.Array,        # [B, H, D]
+    k_pool: jax.Array,   # [NB, bs, KV, D]
+    v_pool: jax.Array,
+    table: jax.Array,    # [B, MB] int32
+    lengths: jax.Array,  # [B] int32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    b, h, d = q.shape
+    nb, bs, kv, d2 = k_pool.shape
+    assert d == d2, (q.shape, k_pool.shape)
+    assert h % kv == 0, (h, kv)
+    g = h // kv
+    mb = table.shape[1]
+    qg = q.reshape(b, kv, g, d)
+
+    def q_map(bi, ji, table_ref, lengths_ref):
+        return (bi, 0, 0, 0)
+
+    def kv_map(bi, ji, table_ref, lengths_ref):
+        # the paged read: pool row straight from the prefetched table
+        return (table_ref[bi, ji], 0, 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, block_size=bs, num_blocks=mb,
+        kv_heads=kv, group=g,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, mb),
+            in_specs=[
+                pl.BlockSpec((1, kv, g, d), q_map),
+                pl.BlockSpec((1, bs, kv, d), kv_map),
+                pl.BlockSpec((1, bs, kv, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, kv, g, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((kv * g,), jnp.float32),
+                pltpu.VMEM((kv * g,), jnp.float32),
+                pltpu.VMEM((kv * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, g, d), jnp.float32),
+        interpret=interpret,
+    )(table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(b, h, d)

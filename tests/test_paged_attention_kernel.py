@@ -1,0 +1,68 @@
+"""Parity tests for the experimental Pallas paged-attention decode
+kernel (interpret mode on the CPU mesh; the module docstring records
+the measured TPU status — exact but not yet faster than the XLA
+gather path, so serving does not use it)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+
+def _setup(B=3, H=8, KV=2, D=128, bs=16, MB=5, seed=0):
+    rng = np.random.RandomState(seed)
+    NB = B * MB + 1
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32) * 0.3)
+    k_pool = jnp.asarray(rng.randn(NB, bs, KV, D).astype(np.float32) * 0.3)
+    v_pool = jnp.asarray(rng.randn(NB, bs, KV, D).astype(np.float32) * 0.3)
+    table = np.zeros((B, MB), np.int32)
+    bid = 1
+    for b in range(B):
+        for j in range(MB):
+            table[b, j] = bid
+            bid += 1
+    return q, k_pool, v_pool, jnp.asarray(table)
+
+
+def _reference(q, k_pool, v_pool, table, lengths):
+    B, H, D = q.shape
+    KV = k_pool.shape[2]
+    g = H // KV
+    outs = []
+    for b in range(B):
+        kb = np.concatenate(
+            [np.asarray(k_pool[int(table[b, j])])
+             for j in range(table.shape[1])], 0)
+        vb = np.concatenate(
+            [np.asarray(v_pool[int(table[b, j])])
+             for j in range(table.shape[1])], 0)
+        o = np.zeros((H, D), np.float32)
+        for h in range(H):
+            kvh = h // g
+            s = (np.asarray(q[b, h]) @ kb[:, kvh].T) / np.sqrt(D)
+            s[int(lengths[b]):] = -1e30
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            o[h] = p @ vb[:, kvh]
+        outs.append(o)
+    return np.stack(outs)
+
+
+def test_paged_decode_attention_parity():
+    q, k_pool, v_pool, table = _setup()
+    lengths = jnp.asarray(np.array([33, 80, 1], np.int32))
+    out = paged_decode_attention(
+        q, k_pool, v_pool, table, lengths, interpret=True)
+    ref = _reference(q, k_pool, v_pool, table, lengths)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_paged_decode_attention_mha_and_full_blocks():
+    # MHA (KV == H) and lengths on exact block boundaries
+    q, k_pool, v_pool, table = _setup(B=2, H=4, KV=4, MB=3, seed=1)
+    lengths = jnp.asarray(np.array([48, 16], np.int32))
+    out = paged_decode_attention(
+        q, k_pool, v_pool, table, lengths, interpret=True)
+    ref = _reference(q, k_pool, v_pool, table, lengths)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
