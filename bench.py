@@ -461,6 +461,28 @@ _CONFIG_FNS = {
 }
 
 
+def merge_keep_better(best: dict, partial: dict, mfu_keys) -> dict:
+    """Keep-the-better retry merge over a config's MFU key.
+
+    The first key (in ``mfu_keys`` order) present in EITHER result
+    decides: present in both -> higher value wins; present only in
+    ``best`` -> the retry is a degraded partial rerun and must never
+    clobber the complete first run; present only in ``partial`` -> the
+    retry recovered a key the first run lacked.  No key anywhere ->
+    latest wins (nothing to compare on).
+    """
+    if not best:
+        return partial
+    for key in mfu_keys:
+        if key in partial and key in best:
+            return best if partial[key] < best[key] else partial
+        if key in best:
+            return best
+        if key in partial:
+            return partial
+    return partial
+
+
 def _probe_tpu() -> bool:
     """Detect the accelerator WITHOUT initializing jax in this process
     (the orchestrator must not hold the device while children run)."""
@@ -541,14 +563,10 @@ def main() -> None:
                     continue
             if not partial:
                 continue  # this attempt produced nothing usable
-            if best:
-                # keep whichever run scored higher on its MFU key
-                for key in _mfu_floor:
-                    if key in partial and key in best:
-                        if partial[key] < best[key]:
-                            partial = best
-                        break
-            best = partial
+            # keep whichever run scored higher on its MFU key; a retry
+            # MISSING the key is a degraded partial rerun and must not
+            # clobber a complete first run
+            best = merge_keep_better(best, partial, tuple(_mfu_floor))
             if not _suspiciously_low(best):
                 break
         if best:

@@ -124,6 +124,22 @@ def _sharded_bytes(leaf, part_spec, sizes: Dict[str, int]) -> int:
     return n * jnp.dtype(leaf.dtype).itemsize
 
 
+def _align_specs(flat_s, n_params: int):
+    """Defensive spec/param alignment.  A length mismatch means the spec
+    tree diverged somewhere — zipping misaligned lists would silently
+    attribute sharded byte counts to the WRONG leaves, so treat every
+    leaf as replicated instead: the estimate becomes a conservative
+    upper bound rather than arbitrarily wrong."""
+    if len(flat_s) == n_params:
+        return flat_s
+    logger.warning(
+        "sharding-spec tree mismatch (%d specs / %d params); "
+        "falling back to a fully-replicated (upper-bound) estimate",
+        len(flat_s), n_params,
+    )
+    return [None] * n_params
+
+
 def _param_plan(
     model, batch_shape, spec: MeshSpec, rules
 ) -> Tuple[int, int]:
@@ -150,14 +166,7 @@ def _param_plan(
     flat_s = jax.tree_util.tree_leaves(
         mesh_specs, is_leaf=lambda x: x is None or hasattr(x, "index")
     )
-    if len(flat_s) != len(flat_p):
-        # defensive: unpartitioned leaves collapse in the spec tree —
-        # fall back to per-leaf replicated for the mismatch
-        logger.warning(
-            "sharding-spec tree mismatch (%d specs / %d params); "
-            "unmatched leaves counted replicated", len(flat_s), len(flat_p),
-        )
-        flat_s = flat_s + [None] * (len(flat_p) - len(flat_s))
+    flat_s = _align_specs(flat_s, len(flat_p))
     total_bytes = 0
     total_elems = 0
     for leaf, ps in zip(flat_p, flat_s):

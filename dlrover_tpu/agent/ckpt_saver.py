@@ -458,9 +458,14 @@ class AsyncCheckpointSaver:
             # peers must SEE the final before recording the step as
             # persisted: rank 0 may still quarantine the rename, and a
             # peer that records a never-committed step would skip the
-            # failure-path re-save of its shm state forever after
+            # failure-path re-save of its shm state forever after.
+            # Fresh budget: the done-file barrier may have consumed most
+            # of the shared deadline just before rank 0's rename lands —
+            # reusing it would mis-record an about-to-commit step as
+            # timed out.
+            final_deadline = time.time() + min(30.0, timeout)
             while not self.storage.exists(final):
-                if time.time() > deadline:
+                if time.time() > final_deadline:
                     logger.error(
                         "commit of step %s: barrier passed but final dir "
                         "never appeared (rank 0 failed or quarantined)",

@@ -45,6 +45,15 @@ CREATE TABLE IF NOT EXISTS trials (
     value    REAL,
     ts       REAL
 );
+CREATE TABLE IF NOT EXISTS serving_samples (
+    job_uuid       TEXT,
+    replicas       INTEGER,
+    queue_depth    REAL,
+    ttft_seconds   REAL,
+    tokens_per_sec REAL,
+    ts             REAL
+);
+CREATE INDEX IF NOT EXISTS idx_serving_job ON serving_samples (job_uuid);
 """
 
 
@@ -121,7 +130,50 @@ class JobHistoryStore:
             )
             self._conn.commit()
 
+    def record_serving(
+        self, job_uuid: str, replicas: int, queue_depth: float,
+        ttft_seconds: float, tokens_per_sec: float,
+    ) -> None:
+        """Serving-load sample (router autoscaler reports): the serving
+        twin of ``record_speed`` — replica-count decisions for a new
+        deployment can warm-start from a past one's load curve."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO serving_samples VALUES (?,?,?,?,?,?)",
+                (job_uuid, int(replicas), float(queue_depth),
+                 float(ttft_seconds), float(tokens_per_sec), time.time()),
+            )
+            self._conn.commit()
+
     # -- queries ---------------------------------------------------------
+    def serving_history(
+        self, job_name: Optional[str] = None, limit: int = 256
+    ) -> List[Dict[str, float]]:
+        """Most-recent serving samples (newest first)."""
+        args: List[Any] = []
+        if job_name:
+            q = (
+                "SELECT s.replicas, s.queue_depth, s.ttft_seconds, "
+                "s.tokens_per_sec FROM serving_samples s "
+                "JOIN jobs j ON s.job_uuid = j.job_uuid "
+                "WHERE j.job_name = ? "
+            )
+            args.append(job_name)
+        else:
+            q = (
+                "SELECT s.replicas, s.queue_depth, s.ttft_seconds, "
+                "s.tokens_per_sec FROM serving_samples s "
+            )
+        q += "ORDER BY s.ts DESC LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._conn.execute(q, tuple(args)).fetchall()
+        return [
+            {"replicas": int(r), "queue_depth": float(d),
+             "ttft_seconds": float(t), "tokens_per_sec": float(p)}
+            for r, d, t, p in rows
+        ]
+
     def speed_history(
         self, job_name: Optional[str] = None
     ) -> Dict[int, float]:

@@ -34,6 +34,7 @@ from typing import Any, Dict, Optional
 
 from dlrover_tpu.brain.datastore import JobHistoryStore
 from dlrover_tpu.brain.hpsearch import BayesianOptimizer, Param
+from dlrover_tpu.brain.serving import ServingScalePolicy, ServingSignal
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.rpc import RpcStub, build_server
 from dlrover_tpu.common.serialize import dumps, loads
@@ -77,6 +78,8 @@ class BrainService:
             return dumps(self._suggest(msg))
         if kind == "speed_history":
             return dumps(self._store.speed_history(msg.get("job_name")))
+        if kind == "serving_plan":
+            return dumps(self._serving_plan(msg))
         raise ValueError(f"unknown brain query {kind!r}")
 
     def _handle_report(self, request: bytes, context) -> bytes:
@@ -94,6 +97,14 @@ class BrainService:
             )
         elif kind == "observe":
             self._observe(msg)
+        elif kind == "record_serving":
+            self._store.ensure_job(msg["job_uuid"], msg.get("job_name", ""))
+            self._store.record_serving(
+                msg["job_uuid"], int(msg.get("replicas", 1)),
+                float(msg.get("queue_depth", 0.0)),
+                float(msg.get("ttft_seconds", 0.0)),
+                float(msg.get("tokens_per_sec", 0.0)),
+            )
         elif kind == "finish_job":
             self._store.finish_job(msg["job_uuid"], msg.get("status", ""))
         else:
@@ -124,6 +135,26 @@ class BrainService:
         if group is not None:
             workers = group.count
         return {"worker_count": workers}
+
+    # -- serving scale plans ----------------------------------------------
+    def _serving_plan(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Replica-count plan from router load samples (the serving twin
+        of ``optimize``; policy: brain/serving.ServingScalePolicy)."""
+        policy = ServingScalePolicy(
+            min_replicas=int(msg.get("min_replicas", 1)),
+            max_replicas=int(msg.get("max_replicas", 8)),
+            queue_high=float(msg.get("queue_high", 4.0)),
+            queue_low=float(msg.get("queue_low", 0.5)),
+            ttft_high=msg.get("ttft_high"),
+        )
+        samples = [
+            ServingSignal.from_dict(s) for s in msg.get("samples", [])
+        ]
+        return {
+            "replica_count": policy.decide(
+                samples, int(msg.get("current_replicas", 1))
+            )
+        }
 
     # -- hyperparameter search sessions ----------------------------------
     def _session_locked(self, msg: Dict[str, Any]) -> BayesianOptimizer:
@@ -183,6 +214,15 @@ class BrainClient:
                 {"kind": "speed_history", "job_name": job_name or None}
             ))).items()
         }
+
+    def serving_plan(self, **query) -> Optional[int]:
+        out = loads(
+            self._stub.get(dumps({"kind": "serving_plan", **query}))
+        )
+        return out.get("replica_count")
+
+    def record_serving(self, **report) -> None:
+        self._stub.report(dumps({"kind": "record_serving", **report}))
 
     def suggest(self, **query) -> Dict[str, float]:
         return loads(

@@ -1,0 +1,82 @@
+"""Brain-side serving scale policy: load signals -> replica count.
+
+The serving twin of the training-resource optimizer
+(master/resource/local_optimizer.py): masters/routers push queue-depth,
+TTFT and throughput samples; the policy answers "how many replicas
+should be up".  It runs in two places with the same code — embedded in
+the router's autoscaler when no Brain is deployed, and behind the
+BrainService ``serving_plan`` query (brain/service.py) when one is, so
+pointing a router at a Brain upgrades the decision without changing
+its behavior contract.
+
+Deliberately hysteretic: scale up on sustained per-replica backlog OR
+TTFT pressure, scale down only when the queue is essentially empty and
+latency is comfortable — flapping replica counts costs compile/warmup
+time on every transition, the serving analogue of rendezvous churn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class ServingSignal:
+    """One observation window's aggregate load sample."""
+
+    queue_depth: float = 0.0       # gateway backlog (mean over window)
+    ttft_seconds: float = 0.0      # time-to-first-token (mean)
+    tokens_per_sec: float = 0.0    # generated-token throughput
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServingSignal":
+        return cls(
+            queue_depth=float(d.get("queue_depth", 0.0)),
+            ttft_seconds=float(d.get("ttft_seconds", 0.0)),
+            tokens_per_sec=float(d.get("tokens_per_sec", 0.0)),
+        )
+
+
+class ServingScalePolicy:
+    """Threshold policy with hysteresis over :class:`ServingSignal`s."""
+
+    def __init__(
+        self,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        queue_high: float = 4.0,   # per-replica backlog that adds one
+        queue_low: float = 0.5,    # per-replica backlog that frees one
+        ttft_high: Optional[float] = None,  # seconds; None = ignore
+        step: int = 1,
+    ):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.ttft_high = ttft_high
+        self.step = int(step)
+
+    def decide(
+        self, samples: Sequence[ServingSignal], current_replicas: int
+    ) -> int:
+        """Desired replica count (== ``current_replicas`` for no-op)."""
+        current = max(1, int(current_replicas))
+        if not samples:
+            return current
+        depth = sum(s.queue_depth for s in samples) / len(samples)
+        ttft = sum(s.ttft_seconds for s in samples) / len(samples)
+        per_replica = depth / current
+        ttft_pressure = (
+            self.ttft_high is not None and ttft > self.ttft_high
+        )
+        if per_replica > self.queue_high or ttft_pressure:
+            desired = current + self.step
+        elif per_replica < self.queue_low and not ttft_pressure:
+            desired = current - self.step
+        else:
+            desired = current
+        return max(self.min_replicas, min(self.max_replicas, desired))

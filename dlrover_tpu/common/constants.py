@@ -13,6 +13,7 @@ class NodeType:
     WORKER = "worker"
     EVALUATOR = "evaluator"
     CHIEF = "chief"
+    SERVING_REPLICA = "serving-replica"
     # TPU host agent inside one pod slice.
     TPU_HOST = "worker"
 
@@ -33,6 +34,28 @@ class NodeEventType:
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"
     DELETED = "DELETED"
+
+
+class ReplicaStatus:
+    """Lifecycle of one serving replica in the router's replica manager
+    (serving/router/replica.py) — the serving counterpart of NodeStatus."""
+
+    JOINING = "Joining"    # announced, warming up (compiling/loading)
+    UP = "Up"              # heartbeating, schedulable
+    DRAINING = "Draining"  # no new placements; finishing in-flight work
+    DEAD = "Dead"          # missed heartbeats / crashed; in-flight requeued
+    LEFT = "Left"          # drained and removed
+
+
+class ServingRequestState:
+    """Lifecycle of one request through the serving gateway."""
+
+    QUEUED = "Queued"        # admitted, waiting for a replica slot
+    RUNNING = "Running"      # placed on a replica, generating
+    DONE = "Done"            # output complete
+    TIMED_OUT = "TimedOut"   # deadline expired before completion
+    CANCELLED = "Cancelled"  # caller withdrew it
+    REJECTED = "Rejected"    # refused at admission (queue bound)
 
 
 class NodeExitReason:

@@ -125,6 +125,15 @@ class InMemoryScaler(Scaler):
                         config_resource=group.node_resource,
                     )
                 )
+            # shrink: a count BELOW the alive set removes the highest
+            # ranks first (the serving autoscaler and elastic worker
+            # groups both contract from the top so rank 0 state, e.g. a
+            # warm cache or the chief role, survives longest)
+            if group.count < len(alive):
+                for node in sorted(
+                    alive, key=lambda n: n.rank_index, reverse=True
+                )[: len(alive) - group.count]:
+                    self.cluster.remove_node(node.name)
 
 
 class InMemoryNodeWatcher(NodeWatcher):

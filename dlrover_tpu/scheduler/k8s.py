@@ -66,6 +66,31 @@ def default_k8s_api():  # pragma: no cover - needs a cluster
     return client.CoreV1Api()
 
 
+def build_serving_replica_spec(
+    job_name: str,
+    node: Node,
+    *,
+    image: str,
+    command: List[str],
+    router_addr: str = "",
+    **kwargs,
+) -> Dict[str, Any]:
+    """Serving-replica pod manifest: a worker pod whose process is a
+    model-server speaking the router's replica protocol
+    (serving/router/replica.py) instead of the elastic agent.  The
+    router's autoscaler emits ``NodeType.SERVING_REPLICA`` group counts
+    through :class:`PodScaler` exactly like worker counts; this wrapper
+    only swaps the startup contract — ``DLROVER_ROUTER_ADDR`` tells the
+    replica which router to register with on boot."""
+    extra_env = dict(kwargs.pop("extra_env", None) or {})
+    if router_addr:
+        extra_env["DLROVER_ROUTER_ADDR"] = router_addr
+    return build_pod_spec(
+        job_name, node, image=image, command=command,
+        extra_env=extra_env, **kwargs,
+    )
+
+
 def build_pod_spec(
     job_name: str,
     node: Node,

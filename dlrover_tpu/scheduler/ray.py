@@ -226,6 +226,30 @@ class ActorScaler(Scaler):
                              node.config_resource)
 
 
+def serving_replica_scaler(
+    job_name: str,
+    client: Any,
+    *,
+    router_addr: str = "",
+    command: Optional[List[str]] = None,
+    **kwargs,
+) -> "ActorScaler":
+    """Serving-replica variant of :class:`ActorScaler`: the router's
+    autoscaler emits ``NodeType.SERVING_REPLICA`` group counts and this
+    scaler realizes them as model-server actors that register with the
+    router (serving/router/replica.py protocol) on boot via
+    ``DLROVER_ROUTER_ADDR``.  ActorScaler already contracts highest
+    ranks first, matching the router's drain-first scale-down."""
+    env = dict(kwargs.pop("env", None) or {})
+    if router_addr:
+        env["DLROVER_ROUTER_ADDR"] = router_addr
+    return ActorScaler(
+        job_name, client,
+        command=command or ["dlrover-tpu-serve-replica"],
+        env=env, **kwargs,
+    )
+
+
 class ActorWatcher(NodeWatcher):
     """Node lifecycle from Ray actor states (reference ray_watcher.py)."""
 

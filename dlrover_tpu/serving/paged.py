@@ -29,12 +29,21 @@ writer rewrites identical values.
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _chain_key(prev: bytes, tok_bytes: bytes) -> bytes:
+    """Chained prefix-block key: a stable 128-bit blake2b digest.  Python's
+    ``hash()`` is only 64-bit and salted per process — a collision would
+    silently alias two different prefixes to one block and corrupt a live
+    sequence's attention, and salting breaks cross-restart stability."""
+    return hashlib.blake2b(prev + tok_bytes, digest_size=16).digest()
 
 
 class BlockManager:
@@ -50,10 +59,14 @@ class BlockManager:
         # slot's own row; paging needs the sentinel)
         self._free: List[int] = list(range(1, num_blocks))[::-1]
         self._ref = np.zeros(num_blocks, np.int32)
-        # chain-hash -> block id for full prompt blocks currently in
+        # chain-digest -> block id for full prompt blocks currently in
         # the pool (referenced or lingering)
-        self._prefix: Dict[int, int] = {}
-        self._block_hash: Dict[int, int] = {}
+        self._prefix: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        # block id -> the raw token bytes it holds: a hit is only trusted
+        # after the content check (belt-and-braces on top of the 128-bit
+        # key — a false hit must never alias blocks)
+        self._block_tokens: Dict[int, bytes] = {}
         # fully-released prefix blocks, oldest first (evictable)
         self._lru: "OrderedDict[int, None]" = OrderedDict()
 
@@ -67,6 +80,7 @@ class BlockManager:
             return self._free.pop()
         if self._lru:  # evict the oldest lingering prefix block
             bid, _ = self._lru.popitem(last=False)
+            self._block_tokens.pop(bid, None)
             h = self._block_hash.pop(bid, None)
             # the chain hash may have been RE-registered to a newer
             # block after this one was orphaned — only drop the mapping
@@ -87,14 +101,18 @@ class BlockManager:
         bs = self.block_size
         prompt = np.asarray(prompt).reshape(-1)
         n_blocks = -(-max(int(total_len), 1) // bs)
-        full_prompt_blocks = prompt.size // bs
+        # enforce total_len >= len(prompt) at the API boundary: a shorter
+        # total_len would otherwise let len(shared) exceed n_blocks and
+        # the returned list overflow the engine's fixed table row
+        full_prompt_blocks = min(prompt.size // bs, n_blocks)
 
-        shared: List[int] = []
-        chain = 0
+        shared: List[Tuple[bytes, int]] = []
+        chain = b""
         for i in range(full_prompt_blocks):
-            chain = hash((chain, prompt[i * bs:(i + 1) * bs].tobytes()))
+            tok_bytes = prompt[i * bs:(i + 1) * bs].tobytes()
+            chain = _chain_key(chain, tok_bytes)
             bid = self._prefix.get(chain)
-            if bid is None:
+            if bid is None or self._block_tokens.get(bid) != tok_bytes:
                 break
             shared.append((chain, bid))
         need = n_blocks - len(shared)
@@ -111,18 +129,18 @@ class BlockManager:
                 self._lru.pop(bid, None)  # revive a lingering block
             self._ref[bid] += 1
             blocks.append(bid)
-        chain = shared[-1][0] if shared else 0
+        chain = shared[-1][0] if shared else b""
         for i in range(len(shared), n_blocks):
             bid = self._take_block()
             assert bid is not None  # guarded by available_blocks above
             self._ref[bid] = 1
             blocks.append(bid)
             if i < full_prompt_blocks:
-                chain = hash(
-                    (chain, prompt[i * bs:(i + 1) * bs].tobytes())
-                )
+                tok_bytes = prompt[i * bs:(i + 1) * bs].tobytes()
+                chain = _chain_key(chain, tok_bytes)
                 self._prefix[chain] = bid
                 self._block_hash[bid] = chain
+                self._block_tokens[bid] = tok_bytes
         return blocks, len(shared) * bs
 
     def free_sequence(self, blocks: List[int]) -> None:

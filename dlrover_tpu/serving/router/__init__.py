@@ -1,0 +1,46 @@
+"""Elastic serving gateway: continuous-batching router over replicas.
+
+The serving-side counterpart of the trainer's elasticity stack: the
+single-replica engine (serving/engine.py) scales out behind a router
+that admits, queues, places and — when a replica dies — REQUEUES
+requests, and that feeds load signals into the Brain so replica counts
+scale like worker counts do for training.
+
+Layers (one module each):
+
+- :mod:`gateway`   — admission control, bounded priority queues,
+  per-request deadlines;
+- :mod:`scheduler` — continuous-batching placement: micro-batches per
+  replica under the KV-block budget, prefix-affine + least-loaded;
+- :mod:`replica`   — replica handles + manager: heartbeats, failover
+  (drain + requeue, zero lost requests), graceful join/leave;
+- :mod:`autoscale` — queue/TTFT/throughput signals -> Brain plan ->
+  ScalePlan through a cluster Scaler, plus the provisioner closing the
+  loop from cluster node events back to router membership;
+- :mod:`metrics`   — Prometheus gauges/counters for all of the above;
+- :mod:`router`    — the orchestrating pump.
+"""
+
+from dlrover_tpu.serving.router.gateway import (  # noqa: F401
+    PRIORITY_BATCH,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    QueueFullError,
+    RequestGateway,
+    ServingRequest,
+)
+from dlrover_tpu.serving.router.metrics import RouterMetrics  # noqa: F401
+from dlrover_tpu.serving.router.replica import (  # noqa: F401
+    InferenceEngineAdapter,
+    ReplicaDeadError,
+    ReplicaHandle,
+    ReplicaManager,
+)
+from dlrover_tpu.serving.router.router import ServingRouter  # noqa: F401
+from dlrover_tpu.serving.router.scheduler import (  # noqa: F401
+    ContinuousBatchScheduler,
+)
+from dlrover_tpu.serving.router.autoscale import (  # noqa: F401
+    ReplicaProvisioner,
+    ServingAutoScaler,
+)

@@ -1,0 +1,262 @@
+"""Autoscale hooks: router load -> Brain plan -> ScalePlan -> replicas.
+
+The training auto-scaler's loop (speed samples -> Brain optimize ->
+ScalePlan -> Scaler), rebuilt for serving:
+
+- the router's :class:`~.metrics.RouterMetrics` windows provide the
+  signals (queue depth, TTFT, tokens/sec);
+- the DECISION comes from :class:`~dlrover_tpu.brain.serving.
+  ServingScalePolicy` — run locally by default, or remotely through a
+  ``BrainClient.serving_plan`` query when a Brain deployment is
+  configured (same policy code on both paths);
+- the EXECUTION is a plain :class:`~dlrover_tpu.master.scaler.base.
+  ScalePlan` handed to any cluster ``Scaler`` — the in-memory scheduler
+  in tests, pod/actor scalers (scheduler/k8s.py, scheduler/ray.py) in
+  deployments;
+- the :class:`ReplicaProvisioner` closes the loop: cluster node events
+  coming back from the scaler's watcher become router join/leave calls.
+
+Scale-down is drain-first: the victim replica stops taking placements,
+finishes its in-flight requests inside the router pump, and only the
+DRAINED husk's node is removed from the cluster — no request is ever
+cut off by a scale decision.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.brain.serving import ServingScalePolicy, ServingSignal
+from dlrover_tpu.common.constants import NodeEventType, NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+
+
+class ServingAutoScaler:
+    """Periodic replica-count control loop, driven by router steps."""
+
+    def __init__(
+        self,
+        router,
+        scaler: Scaler,
+        policy: Optional[ServingScalePolicy] = None,
+        brain=None,                    # BrainClient-like (serving_plan)
+        job_name: str = "serving",
+        node_type: str = NodeType.SERVING_REPLICA,
+        node_resource: Optional[NodeResource] = None,
+        decide_interval: float = 5.0,
+        cooldown: float = 15.0,
+        min_samples: int = 3,
+    ):
+        self.router = router
+        self.scaler = scaler
+        self.policy = policy or ServingScalePolicy()
+        self.brain = brain
+        self.job_name = job_name
+        self.node_type = node_type
+        self.node_resource = node_resource or NodeResource()
+        self.decide_interval = float(decide_interval)
+        self.cooldown = float(cooldown)
+        self.min_samples = int(min_samples)
+        self._samples: List[ServingSignal] = []
+        self._last_sample = 0.0
+        self._last_scale = 0.0
+        self._next_node_id = 0
+        # replicas this autoscaler asked to drain, by name -> their Node
+        self._pending_removal: Dict[str, Optional[Node]] = {}
+        self.plans: List[ScalePlan] = []
+        router.autoscaler = self
+
+    # -------------------------------------------------------- sampling
+    def on_step(self, now: Optional[float] = None) -> None:
+        """Router pump hook: sample the windows, maybe act."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_sample >= self.decide_interval / max(
+            1, self.min_samples
+        ):
+            self._last_sample = now
+            m = self.router.metrics
+            self._samples.append(ServingSignal(
+                queue_depth=m.queue_depth_mean(now),
+                ttft_seconds=m.ttft_mean(now),
+                tokens_per_sec=m.tokens_per_second(now),
+            ))
+            del self._samples[: -8 * self.min_samples]
+        self._finish_deaths()
+        self._finish_drains()
+        if now - self._last_scale >= self.cooldown:
+            self.maybe_scale(now)
+
+    # -------------------------------------------------------- deciding
+    def desired_replicas(self, current: int) -> int:
+        if len(self._samples) < self.min_samples:
+            return current
+        samples = self._samples[-self.min_samples:]
+        if self.brain is not None:
+            try:
+                got = self.brain.serving_plan(
+                    job_name=self.job_name,
+                    current_replicas=current,
+                    min_replicas=self.policy.min_replicas,
+                    max_replicas=self.policy.max_replicas,
+                    queue_high=self.policy.queue_high,
+                    queue_low=self.policy.queue_low,
+                    ttft_high=self.policy.ttft_high,
+                    samples=[s.to_dict() for s in samples],
+                )
+                if got:
+                    return int(got)
+            except Exception as e:  # Brain outage must not stop serving
+                logger.warning("brain serving_plan failed: %s", e)
+        return self.policy.decide(samples, current)
+
+    def maybe_scale(self, now: Optional[float] = None
+                    ) -> Optional[ScalePlan]:
+        """One control decision; returns the executed plan, if any."""
+        now = time.monotonic() if now is None else now
+        current = self.router.manager.up_count()
+        if current == 0 and self.router.gateway.depth() == 0:
+            return None
+        desired = self.desired_replicas(max(current, 1))
+        self._report_brain(current)
+        if desired > current:
+            plan = self._scale_up(desired)
+        elif desired < current:
+            plan = self._scale_down(current - desired)
+        else:
+            return None
+        self._last_scale = now
+        self._samples.clear()  # decide from post-change evidence only
+        return plan
+
+    # ------------------------------------------------------- executing
+    def _scale_up(self, desired: int) -> ScalePlan:
+        # ``desired`` counts UP replicas, but the cluster group still
+        # contains draining replicas' nodes until their removal plans
+        # land — the group count must include them or the scaler sees
+        # "already at count" and silently adds nothing (or worse,
+        # shrinks an arbitrary node the policy never chose)
+        count = desired + len(self._pending_removal)
+        plan = ScalePlan(node_group_resources={
+            self.node_type: NodeGroupResource(
+                count=count, node_resource=self.node_resource)
+        })
+        logger.info(
+            "serving scale-up: -> %d replicas (+%d draining)",
+            desired, len(self._pending_removal))
+        self.plans.append(plan)
+        self.scaler.scale(plan)
+        return plan
+
+    def _scale_down(self, n: int) -> Optional[ScalePlan]:
+        """Drain-first: pick the least-loaded UP replicas and stop
+        placements; node removal happens when each one empties."""
+        victims = sorted(
+            (
+                h for h in self.router.manager.schedulable()
+                if h.name not in self._pending_removal
+            ),
+            key=lambda h: len(h.inflight),
+        )[:n]
+        if not victims:
+            return None
+        for handle in victims:
+            logger.info(
+                "serving scale-down: draining replica %s "
+                "(%d in-flight)", handle.name, len(handle.inflight),
+            )
+            self.router.begin_drain(handle.name)
+            self._pending_removal[handle.name] = handle.node
+        return ScalePlan()  # removal plan follows once drained
+
+    def _finish_deaths(self) -> None:
+        """Retire DEAD replicas' cluster nodes.  Without this the
+        crashed replica's node stays 'alive' in the cluster, every
+        future scale-up count matches the stale node count and adds
+        nothing — a crash would permanently cap the fleet.  A drain
+        victim dying mid-drain also lands here: its _pending_removal
+        entry must not inflate scale-up counts forever."""
+        while self.router.dead:
+            rec = self.router.dead.popleft()
+            node = self._pending_removal.pop(rec.name, None) or rec.node
+            if node is not None:
+                plan = ScalePlan(remove_nodes=[node])
+                self.plans.append(plan)
+                self.scaler.scale(plan)
+                logger.info(
+                    "serving replica %s died; removed its node %s",
+                    rec.name, node.name)
+
+    def _finish_drains(self) -> None:
+        """Retire drained replicas: emit the remove_nodes plan."""
+        if not self._pending_removal:
+            return
+        for handle in list(self.router.drained):
+            if handle.name not in self._pending_removal:
+                continue  # drained by someone else; not ours to retire
+            node = self._pending_removal.pop(handle.name)
+            self.router.drained.remove(handle)
+            if node is not None:
+                plan = ScalePlan(remove_nodes=[node])
+                self.plans.append(plan)
+                self.scaler.scale(plan)
+                logger.info(
+                    "serving scale-down: removed node %s", node.name)
+
+    def _report_brain(self, current: int) -> None:
+        if self.brain is None or not self._samples:
+            return
+        s = self._samples[-1]
+        try:
+            self.brain.record_serving(
+                job_uuid=self.job_name, job_name=self.job_name,
+                replicas=current, queue_depth=s.queue_depth,
+                ttft_seconds=s.ttft_seconds,
+                tokens_per_sec=s.tokens_per_sec,
+            )
+        except Exception:  # telemetry only; never blocks the loop
+            pass
+
+
+class ReplicaProvisioner:
+    """Cluster node events -> router replica membership.
+
+    Watches the scaler's node watcher; an ADDED/RUNNING node of the
+    serving type gets an engine from ``engine_factory`` and joins the
+    router, a DELETED one leaves (drain-first).  This is the piece a
+    k8s/ray deployment replaces with real pod/actor startup — the
+    in-memory version makes the whole autoscale loop testable in one
+    process.
+    """
+
+    def __init__(
+        self,
+        router,
+        watcher,                       # NodeWatcher
+        engine_factory: Callable[[Node], object],
+        node_type: str = NodeType.SERVING_REPLICA,
+    ):
+        self.router = router
+        self.watcher = watcher
+        self.engine_factory = engine_factory
+        self.node_type = node_type
+
+    def poll(self, timeout: float = 0.01) -> int:
+        """Apply pending node events; returns how many were applied."""
+        applied = 0
+        for event in self.watcher.watch(timeout=timeout):
+            node = event.node
+            if node.type != self.node_type:
+                continue
+            joined = node.name in self.router.replica_names
+            if event.event_type == NodeEventType.DELETED:
+                if joined:
+                    self.router.begin_drain(node.name)
+                    applied += 1
+            elif not joined and not node.is_exited():
+                self.router.join_replica(
+                    node.name, self.engine_factory(node), node=node)
+                applied += 1
+        return applied

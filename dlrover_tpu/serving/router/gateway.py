@@ -1,0 +1,231 @@
+"""Request gateway: admission control, bounded priority queues, deadlines.
+
+The front door of the serving router.  Every request is admitted (or
+refused) HERE, before any replica sees it — the queue bound is the
+backpressure surface (a full queue answers "overloaded" in microseconds
+instead of letting latency grow without bound), and the per-request
+deadline turns an unserviceable backlog into fast, explicit timeouts
+instead of silently stale answers.
+
+Three strict priority bands (HIGH > NORMAL > BATCH) with FIFO order
+inside each band; failover requeues go to the FRONT of their band so a
+replica crash never sends a half-served request to the back of the
+line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import ServingRequestState
+
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_BATCH = 2
+_PRIORITIES = (PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_BATCH)
+
+
+class AdmissionError(RuntimeError):
+    """The gateway refused the request at the door."""
+
+
+class QueueFullError(AdmissionError):
+    """Bounded queue at capacity — shed load upstream."""
+
+
+class RequestTimedOut(RuntimeError):
+    """Raised by :meth:`ServingRequest.result` for an expired request."""
+
+
+@dataclasses.dataclass
+class ServingRequest:
+    """One request's routing state (the router's view, distinct from the
+    engine-internal ``serving.engine.Request`` it maps to on a replica)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    priority: int = PRIORITY_NORMAL
+    deadline: Optional[float] = None       # absolute monotonic time
+    submitted_at: float = 0.0
+    state: str = ServingRequestState.QUEUED
+    output: List[int] = dataclasses.field(default_factory=list)
+    replica: Optional[str] = None          # placed-on replica name
+    engine_rid: Optional[int] = None       # rid inside that replica's engine
+    requeues: int = 0                      # failover replays (at-least-once)
+    first_token_at: Optional[float] = None
+    ttft_recorded: bool = False            # metrics bookkeeping
+    finished_at: Optional[float] = None
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False
+    )
+
+    @property
+    def total_len(self) -> int:
+        return int(self.prompt.size) + int(self.max_new_tokens)
+
+    def finish(self, output: List[int], now: float) -> None:
+        self.output = list(output)
+        self.state = ServingRequestState.DONE
+        self.finished_at = now
+        self._done.set()
+
+    def abort(self, state: str) -> None:
+        self.state = state
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until completion; the synchronous client surface."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still pending")
+        if self.state != ServingRequestState.DONE:
+            raise RequestTimedOut(
+                f"request {self.rid} ended as {self.state}")
+        return np.asarray(self.output, np.int32)
+
+
+class RequestGateway:
+    """Bounded priority admission queue with deadline expiry."""
+
+    def __init__(
+        self,
+        max_pending: int = 1024,
+        max_prompt_len: Optional[int] = None,
+        max_total_len: Optional[int] = None,
+        default_timeout: Optional[float] = None,
+    ):
+        self.max_pending = int(max_pending)
+        self.max_prompt_len = max_prompt_len
+        self.max_total_len = max_total_len
+        self.default_timeout = default_timeout
+        self._lock = threading.RLock()
+        self._queues: List[Deque[ServingRequest]] = [
+            deque() for _ in _PRIORITIES
+        ]
+        self._next_rid = 0
+        self.submitted = 0
+        self.rejected = 0
+        self.timed_out = 0
+
+    # ----------------------------------------------------------- admit
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int,
+        priority: int = PRIORITY_NORMAL,
+        timeout: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> ServingRequest:
+        """Admit a request or raise :class:`AdmissionError`.  ``timeout``
+        (seconds, default ``default_timeout``) becomes an absolute
+        deadline: expiry while QUEUED aborts the request; a request
+        already generating is allowed to finish (its work is paid for)."""
+        if priority not in _PRIORITIES:
+            raise ValueError(f"unknown priority {priority}")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise AdmissionError("empty prompt")
+        if self.max_prompt_len and prompt.size > self.max_prompt_len:
+            raise AdmissionError(
+                f"prompt length {prompt.size} exceeds gateway bound "
+                f"{self.max_prompt_len}")
+        total = prompt.size + int(max_new_tokens)
+        if self.max_total_len and total > self.max_total_len:
+            raise AdmissionError(
+                f"prompt + max_new_tokens = {total} exceeds gateway "
+                f"bound {self.max_total_len}")
+        now = time.monotonic() if now is None else now
+        timeout = self.default_timeout if timeout is None else timeout
+        with self._lock:
+            if self.depth() >= self.max_pending:
+                self.rejected += 1
+                raise QueueFullError(
+                    f"gateway at capacity ({self.max_pending} pending)")
+            req = ServingRequest(
+                rid=self._next_rid,
+                prompt=prompt,
+                max_new_tokens=int(max_new_tokens),
+                priority=priority,
+                # timeout=0 means "fail unless immediately serviceable",
+                # not "no deadline" — only None disables expiry
+                deadline=(now + timeout) if timeout is not None else None,
+                submitted_at=now,
+            )
+            self._next_rid += 1
+            self._queues[priority].append(req)
+            self.submitted += 1
+            return req
+
+    def requeue_front(self, requests: List[ServingRequest]) -> None:
+        """Failover path: a dead replica's in-flight requests re-enter at
+        the FRONT of their band (they have waited longest).  Partial
+        output is discarded — the replay regenerates from scratch
+        (at-least-once, exactly-once output)."""
+        with self._lock:
+            for req in reversed(requests):
+                req.state = ServingRequestState.QUEUED
+                req.replica = None
+                req.engine_rid = None
+                req.output = []
+                req.first_token_at = None
+                req.ttft_recorded = False
+                req.requeues += 1
+                self._queues[req.priority].appendleft(req)
+
+    # ------------------------------------------------------- schedule
+    def schedule_scan(self, window: int) -> List[ServingRequest]:
+        """The first ``window`` queued requests in strict priority order
+        (a snapshot; the scheduler calls :meth:`remove` on placement).
+        Bounded look-ahead keeps head-of-line blocking at bay without
+        letting a huge backlog starve placement decisions."""
+        with self._lock:
+            out: List[ServingRequest] = []
+            for q in self._queues:
+                for req in q:
+                    if len(out) >= window:
+                        return out
+                    out.append(req)
+            return out
+
+    def remove(self, req: ServingRequest) -> bool:
+        with self._lock:
+            try:
+                self._queues[req.priority].remove(req)
+                return True
+            except ValueError:
+                return False
+
+    # -------------------------------------------------------- expiry
+    def expire(self, now: Optional[float] = None) -> List[ServingRequest]:
+        """Abort queued requests whose deadline has passed."""
+        now = time.monotonic() if now is None else now
+        expired: List[ServingRequest] = []
+        with self._lock:
+            for i, q in enumerate(self._queues):
+                # one-pass partition: per-entry deque.remove() would be
+                # O(n^2) when a stall expires a full queue at once
+                kept: Deque[ServingRequest] = deque()
+                dropped = False
+                for req in q:
+                    if req.deadline is not None and now > req.deadline:
+                        req.abort(ServingRequestState.TIMED_OUT)
+                        expired.append(req)
+                        self.timed_out += 1
+                        dropped = True
+                    else:
+                        kept.append(req)
+                if dropped:
+                    self._queues[i] = kept
+        return expired
+
+    def depth(self, priority: Optional[int] = None) -> int:
+        with self._lock:
+            if priority is not None:
+                return len(self._queues[priority])
+            return sum(len(q) for q in self._queues)

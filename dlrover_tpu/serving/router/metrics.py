@@ -1,0 +1,105 @@
+"""Router observability: the serving Prometheus metric set.
+
+Exported through :class:`~dlrover_tpu.utils.profiler.MetricsExporter`
+(``exporter.add_source(metrics.metrics)``), the same per-process
+``/metrics`` endpoint the trainer uses — one scrape surface for both
+halves of the system.  These are also the autoscaler's input signals:
+what Grafana plots is exactly what the Brain decides replica counts
+from (goodput-style: one source of truth for humans and the control
+loop).
+
+Gauge/counter names (stable API, documented in README + PERF.md):
+
+- ``serving_queue_depth``        — requests waiting in the gateway
+- ``serving_inflight``           — requests currently on replicas
+- ``serving_replica_up``         — schedulable replicas
+- ``serving_replica_draining``   — replicas finishing in-flight work
+- ``serving_ttft_seconds``       — time-to-first-token, window mean
+  (plus ``_p50`` / ``_p99`` from a reservoir)
+- ``serving_tokens_per_second``  — generated-token throughput (window)
+- ``serving_requests_{submitted,completed,rejected,timed_out,
+  requeued}_total`` — lifecycle counters (``requeued`` counts failover
+  replays: nonzero says a replica died; completed+timed_out accounting
+  still balancing says nothing was lost)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.utils.profiler import StepTimer, WindowGauge
+
+
+class RouterMetrics:
+    """Aggregates router signals into one Prometheus-ready dict."""
+
+    def __init__(self, window_seconds: float = 60.0):
+        self.queue_depth = 0.0
+        self.inflight = 0.0
+        self.replica_up = 0.0
+        self.replica_draining = 0.0
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.timed_out = 0
+        self.requeued = 0
+        self.generated_tokens = 0
+        self.ttft = StepTimer()
+        self._ttft_window = WindowGauge(window_seconds)
+        self._tokens_window = WindowGauge(window_seconds)
+        self._depth_window = WindowGauge(window_seconds)
+
+    # ------------------------------------------------------- observe
+    def observe_gauges(
+        self,
+        queue_depth: int,
+        inflight: int,
+        replica_up: int,
+        replica_draining: int,
+        now: Optional[float] = None,
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        self.queue_depth = float(queue_depth)
+        self.inflight = float(inflight)
+        self.replica_up = float(replica_up)
+        self.replica_draining = float(replica_draining)
+        self._depth_window.observe(float(queue_depth), now)
+
+    def observe_ttft(self, seconds: float,
+                     now: Optional[float] = None) -> None:
+        self.ttft.observe(seconds)
+        self._ttft_window.observe(seconds, now)
+
+    def observe_tokens(self, n: int, now: Optional[float] = None) -> None:
+        self.generated_tokens += int(n)
+        self._tokens_window.observe(float(n), now)
+
+    # --------------------------------------------------------- views
+    def queue_depth_mean(self, now: Optional[float] = None) -> float:
+        return self._depth_window.mean(now)
+
+    def ttft_mean(self, now: Optional[float] = None) -> float:
+        return self._ttft_window.mean(now)
+
+    def tokens_per_second(self, now: Optional[float] = None) -> float:
+        return self._tokens_window.rate(now)
+
+    def metrics(self) -> Dict[str, float]:
+        """The Prometheus source (``MetricsExporter.add_source``)."""
+        return {
+            "serving_queue_depth": self.queue_depth,
+            "serving_inflight": self.inflight,
+            "serving_replica_up": self.replica_up,
+            "serving_replica_draining": self.replica_draining,
+            "serving_ttft_seconds": self.ttft_mean(),
+            "serving_ttft_seconds_p50": self.ttft.percentile(50),
+            "serving_ttft_seconds_p99": self.ttft.percentile(99),
+            "serving_tokens_per_second": self.tokens_per_second(),
+            "serving_generated_tokens_total": float(self.generated_tokens),
+            "serving_requests_submitted_total": float(self.submitted),
+            "serving_requests_completed_total": float(self.completed),
+            "serving_requests_rejected_total": float(self.rejected),
+            "serving_requests_timed_out_total": float(self.timed_out),
+            "serving_requests_requeued_total": float(self.requeued),
+        }

@@ -1,0 +1,300 @@
+"""Replica handles + manager: heartbeats, failover, elastic membership.
+
+The training stack's fault-tolerance contract, applied to inference:
+
+- every successful pump of a replica's engine refreshes its
+  **heartbeat**; a replica that stops heartbeating (crashed process,
+  hung device) is declared DEAD exactly like a worker that misses its
+  agent heartbeats;
+- a DEAD replica's in-flight requests are **drained and requeued** at
+  the front of the gateway — the failover guarantee is *zero lost
+  requests* (at-least-once execution: a replay regenerates from
+  scratch, partial output is discarded);
+- **graceful join/leave** makes replica count an elastic knob: a
+  joining replica starts taking placements on its first heartbeat, a
+  leaving one DRAINS (no new placements, in-flight finishes) before it
+  is removed — scale-down loses nothing either.
+
+A replica's engine is anything speaking the small duck-typed protocol
+documented on :class:`ReplicaHandle` — the in-process
+:class:`~dlrover_tpu.serving.engine.InferenceEngine` (via
+:class:`InferenceEngineAdapter`), a test fake, or an RPC proxy to a
+remote model server.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    ReplicaStatus,
+    ServingRequestState,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.router.gateway import ServingRequest
+
+
+class ReplicaDeadError(RuntimeError):
+    """The replica's engine is gone; the caller must fail it over."""
+
+
+class InferenceEngineAdapter:
+    """Protocol adapter over :class:`serving.engine.InferenceEngine`."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        return self.engine.add_request(prompt, max_new_tokens)
+
+    def step(self) -> List:
+        return self.engine.step()
+
+    @property
+    def has_work(self) -> bool:
+        return self.engine.has_work
+
+    def slots_free(self) -> int:
+        eng = self.engine
+        free = sum(1 for r in eng._slot_req if r is None)
+        # requests the router already handed over but the engine has not
+        # yet admitted still consume future slots
+        return max(0, free - len(eng._queue))
+
+    def blocks_free(self) -> float:
+        eng = self.engine
+        if not getattr(eng, "paged", False):
+            return float("inf")
+        # handed-over-but-unadmitted requests will consume blocks too —
+        # without subtracting them the router over-places and a request
+        # can sit in the engine queue past the pool's real capacity
+        pending = sum(
+            self.blocks_needed(r.prompt.size, r.max_new_tokens)
+            for r in eng._queue
+        )
+        return float(eng._blockmgr.available_blocks) - pending
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> float:
+        """The engine's REAL admission requirement (engine.py _admit):
+        bucket-padded prefill writes + generation + speculative slack —
+        the router must gate placement on the same formula or a
+        'placed' request can wait in the engine queue forever."""
+        eng = self.engine
+        if not getattr(eng, "paged", False):
+            return 0.0
+        from dlrover_tpu.serving.engine import _bucket
+
+        total = max(
+            prompt_len + max_new_tokens + max(0, eng.speculative_k),
+            _bucket(prompt_len, eng.buckets),
+        )
+        return float(-(-total // eng.block_size))
+
+
+class ReplicaHandle:
+    """One serving replica as the router sees it.
+
+    ``engine`` protocol (duck-typed):
+
+    - ``add_request(prompt, max_new_tokens) -> int`` (engine-local rid)
+    - ``step() -> list`` of finished engine requests (``.rid``,
+      ``.output``)
+    - ``has_work -> bool``
+    - ``slots_free() -> int`` and ``blocks_free() -> float``
+    - optional ``blocks_needed(prompt_len, max_new_tokens) -> float``
+      (the engine's own admission formula; the scheduler uses its
+      block-size default otherwise)
+    """
+
+    def __init__(self, name: str, engine, node=None):
+        self.name = name
+        self.engine = engine
+        self.node = node  # cluster Node this replica runs on, if any
+        self.status = ReplicaStatus.JOINING
+        self.last_heartbeat = 0.0
+        self.inflight: Dict[int, ServingRequest] = {}
+        self.generated_tokens = 0
+        self._failed = False
+
+    # -------------------------------------------------------- capacity
+    def slots_free(self) -> int:
+        return self.engine.slots_free()
+
+    def blocks_free(self) -> float:
+        return self.engine.blocks_free()
+
+    def blocks_needed(self, prompt_len: int,
+                      max_new_tokens: int) -> Optional[float]:
+        """Engine-specific block estimate for a request, or None when
+        the engine doesn't expose one (scheduler falls back to its
+        block-size default)."""
+        fn = getattr(self.engine, "blocks_needed", None)
+        return None if fn is None else fn(prompt_len, max_new_tokens)
+
+    @property
+    def schedulable(self) -> bool:
+        return self.status == ReplicaStatus.UP and not self._failed
+
+    @property
+    def pumpable(self) -> bool:
+        return self.status in (ReplicaStatus.UP, ReplicaStatus.DRAINING)
+
+    @property
+    def drained(self) -> bool:
+        return (
+            self.status == ReplicaStatus.DRAINING
+            and not self.inflight
+            and not self.engine.has_work
+        )
+
+    # -------------------------------------------------------- requests
+    def submit(self, req: ServingRequest) -> None:
+        if not self.schedulable:
+            raise ReplicaDeadError(f"replica {self.name} not schedulable")
+        erid = self.engine.add_request(req.prompt, req.max_new_tokens)
+        req.replica = self.name
+        req.engine_rid = erid
+        req.state = ServingRequestState.RUNNING
+        self.inflight[erid] = req
+
+    def pump(self, now: Optional[float] = None) -> List[ServingRequest]:
+        """One engine step; returns router requests finished by it.
+        A successful pump IS the heartbeat (the engine demonstrably made
+        progress); an engine exception marks the replica failed."""
+        now = time.monotonic() if now is None else now
+        if self._failed:
+            raise ReplicaDeadError(f"replica {self.name} is dead")
+        try:
+            finished = self.engine.step() if self.engine.has_work else []
+        except Exception as e:
+            self._failed = True
+            raise ReplicaDeadError(
+                f"replica {self.name} engine failed: {e}") from e
+        self.last_heartbeat = now
+        done: List[ServingRequest] = []
+        for ereq in finished:
+            req = self.inflight.pop(ereq.rid, None)
+            if req is None:
+                continue  # e.g. admitted before a drain started
+            self.generated_tokens += len(ereq.output)
+            req.finish(list(ereq.output), now)
+            done.append(req)
+        # TTFT: the first pump after placement completes the prefill and
+        # emits the first token (engine._admit runs inside step())
+        for req in self.inflight.values():
+            if req.first_token_at is None:
+                req.first_token_at = now
+        for req in done:
+            if req.first_token_at is None:
+                req.first_token_at = now
+        return done
+
+    # ------------------------------------------------------- lifecycle
+    def mark_up(self, now: float) -> None:
+        self.status = ReplicaStatus.UP
+        self.last_heartbeat = now
+
+    def begin_drain(self) -> None:
+        if self.status == ReplicaStatus.UP:
+            self.status = ReplicaStatus.DRAINING
+
+    def fail(self) -> None:
+        """Chaos/ops hook: kill this replica (its next pump raises)."""
+        self._failed = True
+
+    def take_inflight(self) -> List[ServingRequest]:
+        reqs = list(self.inflight.values())
+        self.inflight.clear()
+        return reqs
+
+
+class ReplicaManager:
+    """Membership + health: join/leave/drain and heartbeat reaping."""
+
+    def __init__(self, heartbeat_timeout: float = 10.0):
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.replicas: Dict[str, ReplicaHandle] = {}
+        # handles reaped by reap_dead, awaiting router post-mortem
+        # (affinity cleanup + cluster-node retirement); drained by
+        # ServingRouter.step each round
+        self.dead_handles: List[ReplicaHandle] = []
+        self._last_check: Optional[float] = None
+
+    # ------------------------------------------------------ membership
+    def join(self, handle: ReplicaHandle,
+             now: Optional[float] = None) -> ReplicaHandle:
+        now = time.monotonic() if now is None else now
+        if handle.name in self.replicas:
+            raise ValueError(f"replica {handle.name} already joined")
+        handle.mark_up(now)
+        self.replicas[handle.name] = handle
+        logger.info("serving replica %s joined", handle.name)
+        return handle
+
+    def begin_drain(self, name: str) -> Optional[ReplicaHandle]:
+        handle = self.replicas.get(name)
+        if handle is not None:
+            handle.begin_drain()
+        return handle
+
+    def remove(self, name: str) -> Optional[ReplicaHandle]:
+        handle = self.replicas.pop(name, None)
+        if handle is not None:
+            handle.status = ReplicaStatus.LEFT
+            logger.info("serving replica %s left", name)
+        return handle
+
+    # ---------------------------------------------------------- views
+    def get(self, name: str) -> Optional[ReplicaHandle]:
+        return self.replicas.get(name)
+
+    def schedulable(self) -> List[ReplicaHandle]:
+        return [h for h in self.replicas.values() if h.schedulable]
+
+    def pumpable(self) -> List[ReplicaHandle]:
+        return [h for h in self.replicas.values() if h.pumpable]
+
+    def up_count(self) -> int:
+        return sum(1 for h in self.replicas.values() if h.schedulable)
+
+    # --------------------------------------------------------- health
+    def reap_dead(self, now: Optional[float] = None
+                  ) -> List[ServingRequest]:
+        """Declare failed / heartbeat-stale replicas DEAD and return
+        their in-flight requests for requeueing (the failover drain)."""
+        now = time.monotonic() if now is None else now
+        # staleness is only meaningful while the OBSERVER was watching:
+        # if the router itself slept past the timeout (idle lull, no
+        # step() calls), every heartbeat looks ancient — amnesty them
+        # instead of mass-reaping healthy replicas, and judge from the
+        # next real pump cycle
+        observer_slept = (
+            self._last_check is not None
+            and now - self._last_check > self.heartbeat_timeout
+        )
+        self._last_check = now
+        if observer_slept:
+            for handle in self.replicas.values():
+                if handle.pumpable and not handle._failed:
+                    handle.last_heartbeat = now
+        orphans: List[ServingRequest] = []
+        for name in list(self.replicas):
+            handle = self.replicas[name]
+            stale = (
+                handle.pumpable
+                and now - handle.last_heartbeat > self.heartbeat_timeout
+            )
+            if handle._failed or stale:
+                handle.status = ReplicaStatus.DEAD
+                taken = handle.take_inflight()
+                orphans.extend(taken)
+                del self.replicas[name]
+                self.dead_handles.append(handle)
+                logger.warning(
+                    "serving replica %s died (%s); requeueing %d "
+                    "in-flight requests", name,
+                    "engine failure" if handle._failed
+                    else "missed heartbeats", len(taken),
+                )
+        return orphans

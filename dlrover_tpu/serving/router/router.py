@@ -1,0 +1,280 @@
+"""The serving router: one pump tying gateway, scheduler and replicas
+together, with failover and autoscale hooks.
+
+Each :meth:`ServingRouter.step` round:
+
+1. expire queued requests whose deadline passed (gateway);
+2. reap dead replicas (failed engines / stale heartbeats) and requeue
+   their in-flight requests at the front of the line — the zero-lost-
+   requests failover;
+3. place queued requests onto replicas (continuous-batching scheduler:
+   KV-budget gated, prefix-affine, least-loaded) — a placement that
+   fails mid-submit also fails the replica over, losing nothing;
+4. pump every live replica's engine one step, completing requests and
+   recording TTFT / token throughput;
+5. retire drained replicas (graceful leave: the scale-down path);
+6. refresh gauges and, if attached, let the autoscaler act.
+
+The pump is deliberately synchronous and single-threaded: chaos tests
+drive it step-by-step deterministically, and a deployment that wants a
+background loop wraps :meth:`serve_forever` in a thread — concurrency
+is a caller policy, not baked in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import (
+    ReplicaStatus,
+    ServingRequestState,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.router.gateway import (
+    PRIORITY_NORMAL,
+    RequestGateway,
+    ServingRequest,
+)
+from dlrover_tpu.serving.router.metrics import RouterMetrics
+from dlrover_tpu.serving.router.replica import (
+    ReplicaDeadError,
+    ReplicaHandle,
+    ReplicaManager,
+)
+from dlrover_tpu.serving.router.scheduler import ContinuousBatchScheduler
+
+
+@dataclasses.dataclass
+class DrainedReplica:
+    """Lightweight record of a retired replica (the handle — and its
+    engine, i.e. model weights — must NOT be retained here: a
+    long-running deployment cycling replicas would leak one engine per
+    rotation)."""
+
+    name: str
+    node: object = None
+
+
+class ServingRouter:
+    """Admission -> placement -> generation -> completion, elastically."""
+
+    def __init__(
+        self,
+        gateway: Optional[RequestGateway] = None,
+        scheduler: Optional[ContinuousBatchScheduler] = None,
+        manager: Optional[ReplicaManager] = None,
+        metrics: Optional[RouterMetrics] = None,
+    ):
+        self.gateway = gateway or RequestGateway()
+        self.scheduler = scheduler or ContinuousBatchScheduler()
+        self.manager = manager or ReplicaManager()
+        self.metrics = metrics or RouterMetrics()
+        self.autoscaler = None  # attached via ServingAutoScaler(router=...)
+        # drained-replica records awaiting pickup (the autoscaler
+        # finishes node removal); bounded so unclaimed records from
+        # manual drains can never accumulate without limit
+        self.drained: "deque[DrainedReplica]" = deque(maxlen=256)
+        # same, for replicas that DIED (crash / stale heartbeat): their
+        # cluster nodes are still alive and must be retired too, or the
+        # scaler's node accounting drifts one node per crash
+        self.dead: "deque[DrainedReplica]" = deque(maxlen=256)
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------ membership
+    def join_replica(self, name: str, engine, node=None,
+                     now: Optional[float] = None) -> ReplicaHandle:
+        with self._lock:
+            return self.manager.join(
+                ReplicaHandle(name, engine, node=node), now=now)
+
+    def begin_drain(self, name: str) -> Optional[ReplicaHandle]:
+        """Graceful leave, phase 1: stop placing onto the replica; its
+        in-flight requests finish.  Phase 2 (retirement) happens in
+        :meth:`step` once it is empty."""
+        with self._lock:
+            return self.manager.begin_drain(name)
+
+    def fail_replica(self, name: str) -> None:
+        """Chaos/ops hook: the replica dies NOW; next step fails it over."""
+        with self._lock:
+            handle = self.manager.get(name)
+            if handle is not None:
+                handle.fail()
+
+    @property
+    def replica_names(self) -> List[str]:
+        return list(self.manager.replicas)
+
+    # --------------------------------------------------------- client
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int,
+        priority: int = PRIORITY_NORMAL,
+        timeout: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> ServingRequest:
+        try:
+            req = self.gateway.submit(
+                prompt_ids, max_new_tokens, priority=priority,
+                timeout=timeout, now=now,
+            )
+        except Exception:
+            self.metrics.rejected = self.gateway.rejected
+            raise
+        self.metrics.submitted = self.gateway.submitted
+        return req
+
+    # ----------------------------------------------------------- pump
+    def step(self, now: Optional[float] = None) -> List[ServingRequest]:
+        """One router round; returns the requests completed by it."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            # 1. deadline expiry
+            self.gateway.expire(now)
+            self.metrics.timed_out = self.gateway.timed_out
+
+            # 2. failover: reap dead replicas, requeue their in-flight
+            self._reap(now)
+
+            # 3. placement (micro-batch per replica per round)
+            placements = self.scheduler.schedule(
+                self.gateway, self.manager.schedulable())
+            for handle, req in placements:
+                try:
+                    handle.submit(req)
+                except ValueError as e:
+                    # the ENGINE rejected the request as impossible
+                    # (exceeds max_len / pool capacity): a poison
+                    # request must abort, not fail healthy replicas
+                    # over one by one
+                    logger.warning(
+                        "request %s rejected by replica %s: %s",
+                        req.rid, handle.name, e,
+                    )
+                    req.abort(ServingRequestState.REJECTED)
+                    self.gateway.rejected += 1
+                    self.metrics.rejected = self.gateway.rejected
+                except Exception:
+                    # the replica died between capacity probe and submit:
+                    # fail it over; THIS request goes back too
+                    logger.warning(
+                        "placement on replica %s failed; failing it over",
+                        handle.name,
+                    )
+                    handle.fail()
+                    self._reap(now, extra=[req])
+
+            # 4. pump engines
+            completed: List[ServingRequest] = []
+            for handle in self.manager.pumpable():
+                try:
+                    done = handle.pump(now)
+                except ReplicaDeadError:
+                    self._reap(now)
+                    continue
+                for req in done:
+                    self._record_ttft(req, now)
+                    self.metrics.observe_tokens(len(req.output), now)
+                    self.metrics.completed += 1
+                completed.extend(done)
+            # TTFT for still-running requests that just got their first
+            # token (completion above covers the finished ones)
+            for handle in self.manager.pumpable():
+                for req in handle.inflight.values():
+                    self._record_ttft(req, now)
+
+            # 5. retire drained replicas (graceful scale-down, phase 2)
+            for handle in list(self.manager.replicas.values()):
+                if handle.drained:
+                    self.manager.remove(handle.name)
+                    self.scheduler.forget_replica(handle.name)
+                    self.drained.append(
+                        DrainedReplica(handle.name, handle.node))
+
+            # 6. gauges + autoscale
+            inflight = sum(
+                len(h.inflight) for h in self.manager.replicas.values())
+            self.metrics.observe_gauges(
+                queue_depth=self.gateway.depth(),
+                inflight=inflight,
+                replica_up=self.manager.up_count(),
+                replica_draining=sum(
+                    1 for h in self.manager.replicas.values()
+                    if h.status == ReplicaStatus.DRAINING
+                ),
+                now=now,
+            )
+            if self.autoscaler is not None:
+                self.autoscaler.on_step(now)
+            return completed
+
+    def _record_ttft(self, req: ServingRequest, now: float) -> None:
+        if req.first_token_at is not None and not req.ttft_recorded:
+            req.ttft_recorded = True
+            self.metrics.observe_ttft(
+                req.first_token_at - req.submitted_at, now)
+
+    def _reap(self, now: float,
+              extra: Optional[List[ServingRequest]] = None) -> None:
+        """Reap dead replicas, requeue their (+ ``extra``) in-flight
+        requests, and run the post-mortem: drop affinity state (a
+        same-named successor must not inherit routing toward a cache
+        that died with the process) and surface the dead replicas'
+        cluster nodes for retirement."""
+        self._requeue((extra or []) + self.manager.reap_dead(now))
+        for handle in self.manager.dead_handles:
+            self.scheduler.forget_replica(handle.name)
+            self.dead.append(DrainedReplica(handle.name, handle.node))
+        self.manager.dead_handles.clear()
+
+    def _requeue(self, requests: List[ServingRequest]) -> None:
+        if not requests:
+            return
+        self.gateway.requeue_front(requests)
+        self.metrics.requeued += len(requests)
+
+    # ------------------------------------------------------ conveniences
+    @property
+    def has_work(self) -> bool:
+        with self._lock:
+            return self.gateway.depth() > 0 or any(
+                h.inflight for h in self.manager.replicas.values())
+
+    def run_until_idle(
+        self, max_steps: int = 100000, now_fn=None
+    ) -> int:
+        """Pump until queue and replicas are empty; returns steps taken.
+        Raises if work remains but no replica can make progress (so a
+        stuck test fails loudly instead of spinning)."""
+        now_fn = now_fn or time.monotonic
+        steps = 0
+        while self.has_work:
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"router still busy after {max_steps} steps "
+                    f"(depth={self.gateway.depth()})")
+            if not self.manager.replicas and self.gateway.depth():
+                raise RuntimeError("queued work but no replicas")
+            self.step(now_fn())
+            steps += 1
+        return steps
+
+    def serve_forever(
+        self, poll_seconds: float = 0.001, stop_event=None
+    ) -> None:  # pragma: no cover - deployment loop
+        stop_event = stop_event or threading.Event()
+        while not stop_event.is_set():
+            self.step()
+            if not self.has_work:
+                stop_event.wait(poll_seconds)
+
+    def results(self, requests: List[ServingRequest],
+                timeout: Optional[float] = None) -> Dict[int, np.ndarray]:
+        return {r.rid: r.result(timeout) for r in requests}

@@ -1,0 +1,129 @@
+"""Continuous-batching placement: micro-batches per replica under the
+KV-block budget, prefix-cache-aware, least-loaded.
+
+Each router round forms one micro-batch per replica: the requests placed
+on a replica in the same round reach its engine together, and the
+engine's own bucketed group-prefill turns them into one dispatch (the
+Orca/vLLM admission model, one level up).  Placement is gated by REAL
+capacity — a free decode slot AND enough free KV blocks for the
+request's whole lifetime — so the router never over-admits into a
+replica's HBM budget; a request no replica can hold right now simply
+stays queued.
+
+Placement preference order:
+
+1. **prefix affinity** — a replica that recently served the same leading
+   prompt tokens gets the request (its paged prefix cache very likely
+   still holds those blocks, making the prefill nearly free);
+2. **least loaded** — otherwise the replica with the most free slots,
+   ties broken by free KV blocks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.serving.router.gateway import RequestGateway, ServingRequest
+
+
+class ContinuousBatchScheduler:
+    """Stateless placement plus a small per-replica prefix-affinity LRU."""
+
+    def __init__(
+        self,
+        block_size: int = 16,
+        schedule_window: int = 64,
+        prefix_tokens: int = 32,
+        affinity_cap: int = 512,
+    ):
+        self.block_size = int(block_size)
+        self.schedule_window = int(schedule_window)
+        self.prefix_tokens = int(prefix_tokens)
+        self.affinity_cap = int(affinity_cap)
+        # replica name -> LRU of prefix keys it has recently served
+        self._affinity: Dict[str, "OrderedDict[bytes, None]"] = {}
+
+    # ------------------------------------------------------------ keys
+    def prefix_key(self, prompt: np.ndarray) -> Optional[bytes]:
+        """Stable digest of the leading prompt tokens; ``None`` for
+        prompts shorter than one cache block (nothing reusable)."""
+        n = min(self.prefix_tokens, int(prompt.size))
+        if n < self.block_size:
+            return None
+        return hashlib.blake2b(
+            np.asarray(prompt[:n], np.int32).tobytes(), digest_size=16
+        ).digest()
+
+    def blocks_needed(self, req: ServingRequest) -> int:
+        return -(-req.total_len // self.block_size)
+
+    def _need(self, handle, req: ServingRequest) -> float:
+        """Per-replica block requirement: the replica's own admission
+        formula when it exposes one (bucket padding + speculative slack
+        differ per engine), else the block-size default."""
+        fn = getattr(handle, "blocks_needed", None)
+        if fn is not None:
+            n = fn(int(req.prompt.size), int(req.max_new_tokens))
+            if n is not None:
+                return float(n)
+        return float(self.blocks_needed(req))
+
+    # ------------------------------------------------------- schedule
+    def schedule(
+        self, gateway: RequestGateway, replicas: List
+    ) -> List[Tuple[object, ServingRequest]]:
+        """One placement round: assign queued requests to replicas with
+        capacity.  Returns ``(replica_handle, request)`` pairs; the
+        requests are already removed from the gateway.  Skips (leaves
+        queued) any request no replica can currently hold."""
+        if not replicas:
+            return []
+        # local capacity ledger: placements in this round consume it
+        free = {
+            h.name: [h.slots_free(), h.blocks_free()] for h in replicas
+        }
+        placements: List[Tuple[object, ServingRequest]] = []
+        for req in gateway.schedule_scan(self.schedule_window):
+            cands = [
+                h for h in replicas
+                if free[h.name][0] > 0
+                and free[h.name][1] >= self._need(h, req)
+            ]
+            if not cands:
+                continue  # stays queued; later (smaller) requests may fit
+            key = self.prefix_key(req.prompt)
+            if key is not None:
+                affine = [
+                    h for h in cands
+                    if key in self._affinity.get(h.name, ())
+                ]
+                if affine:
+                    cands = affine
+            best = max(
+                cands,
+                key=lambda h: (free[h.name][0], free[h.name][1]),
+            )
+            if not gateway.remove(req):
+                continue  # expired/cancelled between scan and placement
+            free[best.name][0] -= 1
+            free[best.name][1] -= self._need(best, req)
+            if key is not None:
+                self._remember(best.name, key)
+            placements.append((best, req))
+        return placements
+
+    def _remember(self, replica: str, key: bytes) -> None:
+        lru = self._affinity.setdefault(replica, OrderedDict())
+        lru[key] = None
+        lru.move_to_end(key)
+        while len(lru) > self.affinity_cap:
+            lru.popitem(last=False)
+
+    def forget_replica(self, replica: str) -> None:
+        """Drop affinity state for a departed replica (its cache is gone
+        with it — routing for warmth to a fresh process is pure loss)."""
+        self._affinity.pop(replica, None)

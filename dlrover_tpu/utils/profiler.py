@@ -99,6 +99,52 @@ class StepTimer:
         }
 
 
+class WindowGauge:
+    """Sliding-time-window aggregate: mean / max / rate over the last
+    ``window_seconds`` of observations.  The serving router reports
+    queue depth and token throughput through these — a scrape must see
+    recent load, not the lifetime average (autoscaling keys off it)."""
+
+    def __init__(self, window_seconds: float = 60.0):
+        self.window = float(window_seconds)
+        self._lock = threading.Lock()
+        self._samples: List[tuple] = []  # (timestamp, value)
+
+    def observe(self, value: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._samples.append((now, float(value)))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.window
+        i = 0
+        while i < len(self._samples) and self._samples[i][0] < cutoff:
+            i += 1
+        if i:
+            del self._samples[:i]
+
+    def _values(self, now: Optional[float]) -> List[float]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._trim(now)
+            return [v for _, v in self._samples]
+
+    def mean(self, now: Optional[float] = None) -> float:
+        vals = self._values(now)
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def max(self, now: Optional[float] = None) -> float:
+        vals = self._values(now)
+        return max(vals) if vals else 0.0
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Sum of observed values per second over the window (e.g. feed
+        token counts in, read tokens/sec out)."""
+        vals = self._values(now)
+        return sum(vals) / self.window if vals else 0.0
+
+
 @contextlib.contextmanager
 def trace(log_dir: str, host_tracer_level: int = 2):
     """Capture an XLA/XProf trace for the enclosed region (TensorBoard-

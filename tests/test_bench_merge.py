@@ -1,0 +1,41 @@
+"""bench.py keep-the-better retry merge (ADVICE r5): a degraded partial
+rerun must never clobber a complete first run."""
+
+from bench import merge_keep_better
+
+KEYS = ("value", "realistic_mfu", "longctx_mfu")
+
+
+def test_retry_missing_mfu_key_keeps_complete_first_run():
+    first = {"value": 0.72, "ckpt_save_s": 0.2}
+    degraded = {"ckpt_save_s": 0.25}  # parseable JSON, no MFU key
+    assert merge_keep_better(first, degraded, KEYS) is first
+
+
+def test_higher_mfu_wins_either_direction():
+    lo = {"value": 0.60}
+    hi = {"value": 0.75}
+    assert merge_keep_better(lo, hi, KEYS) is hi
+    assert merge_keep_better(hi, lo, KEYS) is hi
+
+
+def test_retry_recovering_missing_key_wins():
+    first = {"ckpt_save_s": 0.2}          # first run lacked the key
+    recovered = {"value": 0.70}
+    assert merge_keep_better(first, recovered, KEYS) is recovered
+
+
+def test_empty_best_and_keyless_fallback():
+    partial = {"anything": 1.0}
+    assert merge_keep_better({}, partial, KEYS) is partial
+    # neither result carries an MFU key: latest wins (nothing to rank)
+    a, b = {"x": 1.0}, {"y": 2.0}
+    assert merge_keep_better(a, b, KEYS) is b
+
+
+def test_per_config_key_isolation():
+    # a longctx retry must be ranked on ITS key even when other keys
+    # never appear
+    lo = {"longctx_mfu": 0.53}
+    hi = {"longctx_mfu": 0.76}
+    assert merge_keep_better(hi, lo, KEYS) is hi

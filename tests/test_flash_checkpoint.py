@@ -336,6 +336,50 @@ def test_commit_respects_writer_world_after_shrink(tmp_path):
         saver.stop()
 
 
+def test_peer_final_wait_gets_fresh_budget_after_slow_barrier(tmp_path):
+    """ADVICE r5: a non-rank-0 host whose done-file barrier consumed
+    most of the commit timeout must NOT mark the step timed out while
+    rank 0's rename is landing — the final-dir wait has its own fresh
+    ``min(30, timeout)`` budget.  Here the barrier eats ~1.2s of a 1.8s
+    timeout and the final dir appears at ~2.4s: inside the fresh budget,
+    beyond the old shared deadline."""
+    import threading
+
+    saver = AsyncCheckpointSaver(
+        str(tmp_path / "ckpt"), local_shard_num=1, global_shard_num=1,
+        node_rank=1,
+    )
+    try:
+        stage = saver._stage_dir(5)  # step-5.w1
+        final = saver._final_dir(5)
+        os.makedirs(stage)
+
+        def slow_done():
+            time.sleep(1.2)
+            open(os.path.join(stage, "done-0-w1"), "w").close()
+
+        def late_rename():
+            time.sleep(2.4)
+            os.makedirs(final)
+
+        threads = [
+            threading.Thread(target=slow_done, daemon=True),
+            threading.Thread(target=late_rename, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        saver.commit_checkpoint(5, timeout=1.8)
+        for t in threads:
+            t.join()
+        assert 5 not in saver._commit_timed_out_steps, (
+            "peer must wait out rank 0's rename on a fresh budget, not "
+            "the exhausted barrier deadline"
+        )
+        assert saver._last_persisted_step == 5
+    finally:
+        saver.stop()
+
+
 def test_resized_world_resave_supersedes_old_stage(tmp_path):
     """A new world re-saving a step an old world already staged commits
     from its OWN world-scoped stage — none of the old layout's files can

@@ -91,3 +91,18 @@ def test_seq32k_offload_variant_matches_perf_table(llama7b):
         hbm_budget_bytes=hbm_budget("v5e"),
     )
     assert base.total_device_bytes > offload.total_device_bytes
+
+
+def test_spec_tree_mismatch_falls_back_to_replicated():
+    """ADVICE r5: a spec/param tree length mismatch must NOT zip
+    misaligned lists (sharded byte counts attributed to the wrong
+    leaves) — every leaf is treated as replicated, so the estimate is a
+    conservative upper bound."""
+    from jax.sharding import PartitionSpec
+
+    from dlrover_tpu.accel.memplan import _align_specs
+
+    specs = [PartitionSpec("fsdp"), None]
+    assert _align_specs(specs, 2) is specs  # aligned: untouched
+    assert _align_specs(specs, 5) == [None] * 5  # short: all replicated
+    assert _align_specs(specs, 1) == [None]      # long: all replicated

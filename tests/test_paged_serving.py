@@ -71,6 +71,61 @@ def test_block_manager_capacity_and_lru_eviction():
     assert c[1] == 0
 
 
+def test_block_manager_key_collision_cannot_alias(monkeypatch):
+    """Prefix keys are stable blake2b digests, and a hit is content-
+    verified — even a FORCED key collision (every block hashing to one
+    key) must never alias two different prefixes to one block, because
+    that silently corrupts a live sequence's attention."""
+    from dlrover_tpu.serving import paged
+
+    monkeypatch.setattr(paged, "_chain_key", lambda prev, tok: b"COLLIDE")
+    m = BlockManager(num_blocks=9, block_size=4)
+    p1 = np.arange(4, dtype=np.int32)
+    p2 = np.arange(100, 104, dtype=np.int32)
+    b1, shared1 = m.alloc_sequence(p1, 8)
+    assert shared1 == 0
+    b2, shared2 = m.alloc_sequence(p2, 8)
+    assert shared2 == 0, "colliding key must fail content verification"
+    assert b2[0] != b1[0], "different prefixes must not share a block"
+    # the genuine prefix still hits (content check passes)
+    b3, shared3 = m.alloc_sequence(p2.copy(), 8)
+    assert shared3 == 4 and b3[0] == b2[0]
+
+
+def test_block_manager_prefix_key_is_stable_digest():
+    """The chain key must be a process-stable wide digest, not the
+    salted 64-bit ``hash()`` (ADVICE r5: silent block aliasing)."""
+    from dlrover_tpu.serving.paged import _chain_key
+
+    k = _chain_key(b"", np.arange(4, dtype=np.int32).tobytes())
+    assert isinstance(k, bytes) and len(k) == 16
+    import hashlib
+
+    expect = hashlib.blake2b(
+        b"" + np.arange(4, dtype=np.int32).tobytes(), digest_size=16
+    ).digest()
+    assert k == expect
+
+
+def test_alloc_sequence_short_total_len_clamps_to_table_row():
+    """total_len < len(prompt) must never return more blocks than the
+    table row holds (the ADVICE r5 invariant at the API boundary) —
+    including when a longer prior alloc seeded prefix-cache hits."""
+    m = BlockManager(num_blocks=9, block_size=4)
+    prompt = np.arange(8, dtype=np.int32)
+    blocks, shared = m.alloc_sequence(prompt, total_len=4)
+    assert len(blocks) == 1 and shared <= 4
+    m.free_sequence(blocks)
+    # seed the full two-block prefix, then re-alloc with the short
+    # total_len: the shared hits must clamp to the one-block row too
+    full = m.alloc_sequence(prompt, total_len=8)
+    assert full is not None and len(full[0]) == 2
+    short = m.alloc_sequence(prompt, total_len=4)
+    assert len(short[0]) == 1 and short[1] <= 4
+    m.free_sequence(full[0])
+    m.free_sequence(short[0])
+
+
 # -- engine parity ----------------------------------------------------------
 
 
