@@ -56,6 +56,26 @@ class ServingRequestState:
     TIMED_OUT = "TimedOut"   # deadline expired before completion
     CANCELLED = "Cancelled"  # caller withdrew it
     REJECTED = "Rejected"    # refused at admission (queue bound)
+    POISONED = "Poisoned"    # crashed every replica it landed on
+    #                          (requeue cap exceeded; see ServingFabric)
+
+
+class ServingFabric:
+    """Serving data-plane knobs (router + remote replica fabric)."""
+
+    # Failover replays before a request is declared POISONED: a request
+    # that takes down every replica it lands on must stop circulating
+    # (each replay costs a replica failover, not just queue time).
+    MAX_REQUEST_REQUEUES = 3
+    # First stdout line of a worker process: its self-announced address
+    # (the worker binds port 0 itself; nothing pre-picks ports).
+    WORKER_ANNOUNCE_PREFIX = "DLROVER_WORKER_ADDR="
+    # Worker -> router STATS cadence; STATS double as liveness.
+    STATS_INTERVAL = 0.05
+    # Proxy declares a connected-but-silent worker dead past this.
+    FRAME_TIMEOUT = 5.0
+    # Router address env var a deployed worker registers back to.
+    ROUTER_ADDR_ENV = "DLROVER_ROUTER_ADDR"
 
 
 class NodeExitReason:

@@ -18,6 +18,19 @@ SERVICE_NAME = "dlrover_tpu.Master"
 
 
 def find_free_port(port: int = 0) -> int:
+    """Pick a currently-free port — bind-then-close, i.e. RACY.
+
+    Between this function returning and the caller re-binding, any
+    other process can grab the port (the classic TOCTOU port race).
+    Use only in tests and the legacy single-host control-plane
+    launchers that still call it (agent/launcher.py, master/main.py,
+    trainer/data/coworker_service.py — migrating them means plumbing
+    the server's self-bound port back out, tracked in ROADMAP).  New
+    servers must bind port 0 THEMSELVES and report the kernel-assigned
+    port — the serving worker does exactly that
+    (serving/remote/worker.py announces its bound address through the
+    handshake), and ``grpc.Server.add_insecure_port(":0")`` returns
+    the bound port for the same reason."""
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("", port))
@@ -109,5 +122,12 @@ class RpcStub:
         return self._closed
 
     def close(self) -> None:
+        """Close the stub and its gRPC channel — idempotent (a double
+        close must not touch the already-closed channel).  The channel
+        owns real resources (sockets, poller threads), so releasing it
+        here is load-bearing; the fd-hygiene regression test in
+        tests/test_common.py pins that behavior."""
+        if self._closed:
+            return
         self._closed = True
         self._channel.close()

@@ -71,20 +71,29 @@ def build_serving_replica_spec(
     node: Node,
     *,
     image: str,
-    command: List[str],
+    command: Optional[List[str]] = None,
     router_addr: str = "",
     **kwargs,
 ) -> Dict[str, Any]:
-    """Serving-replica pod manifest: a worker pod whose process is a
-    model-server speaking the router's replica protocol
-    (serving/router/replica.py) instead of the elastic agent.  The
-    router's autoscaler emits ``NodeType.SERVING_REPLICA`` group counts
-    through :class:`PodScaler` exactly like worker counts; this wrapper
-    only swaps the startup contract — ``DLROVER_ROUTER_ADDR`` tells the
-    replica which router to register with on boot."""
+    """Serving-replica pod manifest: a worker pod whose process is the
+    remote-fabric worker (``python -m dlrover_tpu.serving.remote.worker``,
+    the frame-protocol server of serving/remote/) instead of the elastic
+    agent.  The router's autoscaler emits ``NodeType.SERVING_REPLICA``
+    group counts through :class:`PodScaler` exactly like worker counts.
+    The worker binds port 0 itself and announces the bound address on
+    stdout (never a pre-picked port).  STUB STATUS: the pod env carries
+    ``DLROVER_ROUTER_ADDR``, but the worker does not yet dial out to
+    register — cross-host join needs the router-side registration
+    listener recorded in ROADMAP (today the supervisor/provisioner
+    connects outward on one host)."""
+    from dlrover_tpu.common.constants import ServingFabric
+    from dlrover_tpu.serving.remote.supervisor import serving_worker_command
+
+    if command is None:
+        command = serving_worker_command(python="python")
     extra_env = dict(kwargs.pop("extra_env", None) or {})
     if router_addr:
-        extra_env["DLROVER_ROUTER_ADDR"] = router_addr
+        extra_env[ServingFabric.ROUTER_ADDR_ENV] = router_addr
     return build_pod_spec(
         job_name, node, image=image, command=command,
         extra_env=extra_env, **kwargs,

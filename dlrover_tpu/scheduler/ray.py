@@ -236,16 +236,22 @@ def serving_replica_scaler(
 ) -> "ActorScaler":
     """Serving-replica variant of :class:`ActorScaler`: the router's
     autoscaler emits ``NodeType.SERVING_REPLICA`` group counts and this
-    scaler realizes them as model-server actors that register with the
-    router (serving/router/replica.py protocol) on boot via
-    ``DLROVER_ROUTER_ADDR``.  ActorScaler already contracts highest
-    ranks first, matching the router's drain-first scale-down."""
+    scaler realizes them as remote-fabric worker actors
+    (``python -m dlrover_tpu.serving.remote.worker``, the frame-protocol
+    server of serving/remote/).  ActorScaler already contracts highest
+    ranks first, matching the router's drain-first scale-down.  STUB
+    STATUS: the env carries ``DLROVER_ROUTER_ADDR``, but the worker does
+    not yet dial out to register — cross-host join needs the
+    router-side registration listener recorded in ROADMAP."""
+    from dlrover_tpu.common.constants import ServingFabric
+    from dlrover_tpu.serving.remote.supervisor import serving_worker_command
+
     env = dict(kwargs.pop("env", None) or {})
     if router_addr:
-        env["DLROVER_ROUTER_ADDR"] = router_addr
+        env[ServingFabric.ROUTER_ADDR_ENV] = router_addr
     return ActorScaler(
         job_name, client,
-        command=command or ["dlrover-tpu-serve-replica"],
+        command=command or serving_worker_command(python="python"),
         env=env, **kwargs,
     )
 

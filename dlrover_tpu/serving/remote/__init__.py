@@ -1,0 +1,40 @@
+"""Remote replica fabric: out-of-process serving workers.
+
+The data-plane counterpart of the control plane's get/report RPC
+envelope (common/rpc.py): serving replicas become real OS processes
+that the router reaches over a streaming token protocol instead of
+in-process engine objects.
+
+- :mod:`protocol`   — length-prefixed msgpack frames over TCP
+  (SUBMIT / CANCEL / TOKEN / DONE / STATS / HEARTBEAT / GOODBYE);
+- :mod:`worker`     — ``python -m dlrover_tpu.serving.remote.worker``,
+  a replica process hosting an engine and pushing TOKEN frames as
+  they are emitted;
+- :mod:`proxy`      — :class:`RemoteReplicaHandle`, the router-side
+  engine proxy satisfying the duck-typed ``ReplicaHandle`` engine
+  contract, so failover/heartbeat reaping work unchanged;
+- :mod:`supervisor` — spawn/monitor/respawn local worker processes and
+  plug them into the autoscale Scaler seam.
+"""
+
+from dlrover_tpu.serving.remote.protocol import (  # noqa: F401
+    FrameConnection,
+    FrameKind,
+    FrameProtocolError,
+    connect,
+    parse_addr,
+)
+from dlrover_tpu.serving.remote.proxy import (  # noqa: F401
+    RemoteReplicaHandle,
+)
+from dlrover_tpu.serving.remote.supervisor import (  # noqa: F401
+    WorkerSupervisor,
+    reap_orphans,
+    serving_worker_command,
+)
+
+# NOTE: worker.py (FakeEngine, WorkerServer, main) is deliberately NOT
+# re-exported here — ``python -m dlrover_tpu.serving.remote.worker``
+# imports this package first, and a package-level import of the module
+# being run trips runpy's double-import warning.  Import it directly:
+# ``from dlrover_tpu.serving.remote import worker``.

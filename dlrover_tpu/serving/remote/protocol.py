@@ -1,0 +1,195 @@
+"""Length-prefixed msgpack frame protocol for the replica data plane.
+
+The control plane speaks a 2-method gRPC envelope (common/rpc.py); the
+data plane cannot — token streaming wants many small one-way messages
+per request with no per-message round trip, and a replica worker must
+stay importable on a bare image.  So the fabric uses the dependency-
+lightest thing that works: a TCP socket carrying ``[4-byte big-endian
+length][msgpack map]`` frames (msgpack is already the wire format of
+``common/serialize.py``; frames here are plain maps, no class registry
+needed — both ends of this protocol ship in this repo).
+
+Frame kinds (the ``kind`` key of every frame):
+
+====================  ======  =============================================
+kind                  dir     payload
+====================  ======  =============================================
+``HELLO``             w -> r  ``addr``, ``slots_free``, ``blocks_free``,
+                              ``block_size``, ``engine`` — capability
+                              handshake, first frame on every connection
+``SUBMIT``            r -> w  ``rid``, ``prompt`` (list[int]),
+                              ``max_new_tokens``
+``SUBMITTED``         w -> r  ``rid`` — the engine admitted the request
+``ERROR``             w -> r  ``rid``, ``error`` — the engine REJECTED it
+                              (poison request; never a worker crash)
+``CANCEL``            r -> w  ``rid`` — best-effort withdrawal
+``TOKEN``             w -> r  ``rid``, ``tokens`` (list[int]) — streamed
+                              as emitted; TTFT is measured at the first
+                              one RECEIVED
+``DONE``              w -> r  ``rid``, ``tokens`` — the full,
+                              authoritative output
+``STATS``             w -> r  ``slots_free``, ``blocks_free``,
+                              ``inflight``, ``generated_tokens`` —
+                              capacity refresh AND liveness heartbeat
+``HEARTBEAT``         r -> w  ping; the worker answers with a STATS
+``GOODBYE``           either  graceful shutdown of the peer
+====================  ======  =============================================
+
+Direction: ``r`` = router proxy, ``w`` = worker.
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+import struct
+import threading
+import time
+from typing import Optional, Tuple
+
+import msgpack
+
+# one token frame can carry a whole max-length output plus slack; a
+# larger announced length is a corrupt/hostile peer, not a big message
+MAX_FRAME_BYTES = 16 * 1024 * 1024
+_LEN = struct.Struct(">I")
+
+
+class FrameKind:
+    HELLO = "HELLO"
+    SUBMIT = "SUBMIT"
+    SUBMITTED = "SUBMITTED"
+    ERROR = "ERROR"
+    CANCEL = "CANCEL"
+    TOKEN = "TOKEN"
+    DONE = "DONE"
+    STATS = "STATS"
+    HEARTBEAT = "HEARTBEAT"
+    GOODBYE = "GOODBYE"
+
+
+class FrameProtocolError(ConnectionError):
+    """The peer violated the frame protocol (oversized/truncated frame)."""
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def connect(addr: str, timeout: float = 5.0) -> socket.socket:
+    """TCP-connect to a worker; TCP_NODELAY because the whole point is
+    many small latency-sensitive frames."""
+    sock = socket.create_connection(parse_addr(addr), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    return sock
+
+
+class FrameConnection:
+    """One framed duplex connection; sends are thread-safe, receives
+    belong to a single reader (buffered, so a receive timeout mid-frame
+    never loses stream sync).
+
+    ``send_timeout`` bounds every ``sendall``: a peer that stops
+    reading (SIGSTOPped process, wedged event loop) fills the kernel
+    send buffer, and an unbounded blocking send there would freeze the
+    caller — for the router-side proxy that would be the whole router
+    pump — instead of surfacing the failover-able TimeoutError.
+    Receives are unaffected (they wait in select, never in a blocking
+    socket call)."""
+
+    def __init__(self, sock: socket.socket,
+                 send_timeout: Optional[float] = 10.0):
+        if send_timeout is not None:
+            sock.settimeout(send_timeout)
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._buf = bytearray()
+        self._eof = False
+        self._closed = False
+
+    # ------------------------------------------------------------ send
+    def send(self, kind: str, **payload) -> None:
+        payload["kind"] = kind
+        body = msgpack.packb(payload, use_bin_type=True)
+        if len(body) > MAX_FRAME_BYTES:
+            raise FrameProtocolError(
+                f"frame of {len(body)} bytes exceeds cap {MAX_FRAME_BYTES}")
+        with self._send_lock:
+            if self._closed:
+                raise ConnectionError("frame connection closed")
+            self._sock.sendall(_LEN.pack(len(body)) + body)
+
+    # ------------------------------------------------------------ recv
+    def _parse_one(self) -> Optional[dict]:
+        if len(self._buf) < _LEN.size:
+            return None
+        (n,) = _LEN.unpack(bytes(self._buf[:_LEN.size]))
+        if n > MAX_FRAME_BYTES:
+            raise FrameProtocolError(
+                f"peer announced a {n}-byte frame (cap {MAX_FRAME_BYTES})")
+        if len(self._buf) < _LEN.size + n:
+            return None
+        body = bytes(self._buf[_LEN.size:_LEN.size + n])
+        del self._buf[:_LEN.size + n]
+        frame = msgpack.unpackb(body, raw=False, strict_map_key=False)
+        if not isinstance(frame, dict) or "kind" not in frame:
+            raise FrameProtocolError("frame body is not a kinded map")
+        return frame
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """One frame, or ``None`` on clean EOF (peer closed at a frame
+        boundary).  Raises ``TimeoutError`` when ``timeout`` elapses
+        first — buffered partial bytes are KEPT, so the next call
+        resumes mid-frame — and ``ConnectionError`` on a torn stream
+        (EOF inside a frame: the SIGKILLed-worker signature)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            frame = self._parse_one()
+            if frame is not None:
+                return frame
+            if self._eof or self._closed:
+                if self._buf:
+                    raise FrameProtocolError(
+                        "connection closed mid-frame "
+                        f"({len(self._buf)} trailing bytes)")
+                return None
+            wait = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            ready, _, _ = select.select([self._sock], [], [], wait)
+            if not ready:
+                raise TimeoutError("no frame within timeout")
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except OSError as e:
+                raise ConnectionError(f"recv failed: {e}") from e
+            if not chunk:
+                self._eof = True
+                continue
+            self._buf += chunk
+
+    # ----------------------------------------------------------- close
+    def half_close(self) -> None:
+        """Shut down the WRITE side only, letting already-sent frames
+        (a GOODBYE) drain to the peer.  A full close with unread data
+        in our receive buffer would RST the connection and can destroy
+        the in-flight farewell."""
+        try:
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

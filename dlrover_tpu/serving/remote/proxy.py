@@ -1,0 +1,307 @@
+"""Router-side proxy engine for a remote worker process.
+
+:class:`RemoteReplicaHandle` satisfies the duck-typed engine contract
+documented on :class:`~dlrover_tpu.serving.router.replica.ReplicaHandle`
+(``add_request`` / ``step`` / ``has_work`` / ``slots_free`` /
+``blocks_free`` / ``blocks_needed``) plus the streaming extra
+``drain_token_events``, so the router joins it exactly like an
+in-process engine — and every elasticity behavior (heartbeat reaping,
+drain+requeue failover, graceful leave) applies UNCHANGED:
+
+- a background reader thread consumes TOKEN / DONE / STATS frames;
+  STATS double as the liveness signal and capacity refresh;
+- a SIGKILLed worker tears the TCP stream; the reader marks the proxy
+  dead and the next ``pump`` raises, which is precisely the engine-
+  failure path ``ReplicaManager.reap_dead`` already handles;
+- a HUNG worker (socket alive, no frames) trips the frame-staleness
+  check in :meth:`step`, mapping to the same failover;
+- TOKEN frames carry their RECEIVE timestamp into
+  ``drain_token_events`` — TTFT is measured from true first-token
+  arrival, not from the first post-placement pump.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.constants import ServingFabric
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.remote.protocol import (
+    FrameConnection,
+    FrameKind,
+    FrameProtocolError,
+    connect,
+)
+
+
+class RemoteReplicaHandle:
+    """Engine-protocol proxy over one worker's frame connection."""
+
+    def __init__(
+        self,
+        addr: str,
+        name: str = "",
+        connect_timeout: float = 5.0,
+        submit_timeout: float = 5.0,
+        frame_timeout: float = ServingFabric.FRAME_TIMEOUT,
+    ):
+        self.addr = addr
+        self.name = name or addr
+        self.submit_timeout = float(submit_timeout)
+        self.frame_timeout = float(frame_timeout)
+        self._conn = FrameConnection(connect(addr, connect_timeout))
+        # RLock: _dispatch(GOODBYE) -> _mark_dead re-enters under the
+        # reader's own hold
+        self._lock = threading.RLock()
+        self._dead: Optional[str] = None
+        self._closing = False  # deliberate close() in progress
+        self._inflight: Set[int] = set()  # rids placed, not yet DONE
+        self._finished: List[SimpleNamespace] = []
+        # (rid, tokens, receive-time) — drained by ReplicaHandle.pump
+        self._token_events: List[Tuple[int, List[int], float]] = []
+        self._submit_replies: Dict[int, dict] = {}
+        self._submit_cv = threading.Condition(self._lock)
+        self._next_rid = 0
+        try:
+            hello = self._conn.recv(timeout=connect_timeout)
+        except Exception:
+            # a wedged worker (accepted, never HELLOed) must not leak
+            # the socket — the supervisor's respawn retries would pile
+            # up one fd per attempt
+            self._conn.close()
+            raise
+        if hello is None or hello.get("kind") != FrameKind.HELLO:
+            self._conn.close()
+            raise ConnectionError(
+                f"worker {addr} did not open with HELLO: {hello!r}")
+        self._slots_free = int(hello.get("slots_free", 0))
+        self._blocks_free = float(hello.get("blocks_free", 0.0))
+        self.block_size = int(hello.get("block_size", 0))
+        self.engine_kind = str(hello.get("engine", "?"))
+        self._last_frame = time.monotonic()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"replica-reader-{self.name}")
+        self._reader.start()
+
+    # ----------------------------------------------------- reader side
+    def _read_loop(self) -> None:
+        while self._dead is None and not self._conn.closed:
+            try:
+                frame = self._conn.recv(timeout=0.5)
+            except TimeoutError:
+                # no frame in 0.5s is NOT death by itself — staleness
+                # is judged against frame_timeout in step(); keep going
+                continue
+            except Exception as e:
+                self._mark_dead(f"stream torn: {e}")
+                return
+            if frame is None:
+                self._mark_dead("worker closed the connection")
+                return
+            try:
+                self._dispatch(frame)
+            except Exception as e:
+                # a malformed frame (missing rid, bad field type) must
+                # kill the proxy LOUDLY, not leave a zombie reader that
+                # silently drops every subsequent frame
+                self._mark_dead(
+                    f"malformed {frame.get('kind')!r} frame: {e}")
+                return
+
+    def _dispatch(self, frame: dict) -> None:
+        kind = frame.get("kind")
+        now = time.monotonic()
+        with self._lock:
+            self._last_frame = now
+            if kind == FrameKind.TOKEN:
+                rid = int(frame["rid"])
+                if rid in self._inflight:
+                    self._token_events.append(
+                        (rid, list(frame["tokens"]), now))
+            elif kind == FrameKind.DONE:
+                rid = int(frame["rid"])
+                if rid in self._inflight:
+                    self._inflight.discard(rid)
+                    self._finished.append(SimpleNamespace(
+                        rid=rid, output=list(frame["tokens"])))
+            elif kind == FrameKind.STATS:
+                self._slots_free = int(frame.get("slots_free", 0))
+                self._blocks_free = float(frame.get("blocks_free", 0.0))
+            elif kind in (FrameKind.SUBMITTED, FrameKind.ERROR):
+                self._submit_replies[int(frame["rid"])] = frame
+                self._submit_cv.notify_all()
+            elif kind == FrameKind.GOODBYE:
+                self._mark_dead("worker said goodbye", graceful=True)
+
+    def _mark_dead(self, reason: str, graceful: bool = False) -> None:
+        with self._lock:
+            first = self._dead is None
+            if first:
+                self._dead = reason
+            self._submit_cv.notify_all()
+        # only the call that actually killed the proxy warns — the
+        # reader re-detecting a close()d socket, or the peer's EOF
+        # answering OUR deliberate goodbye, is not news
+        if not graceful and first and not self._closing:
+            logger.warning(
+                "remote replica %s dead: %s", self.name, reason)
+        self._conn.close()
+
+    # -------------------------------------------------- engine protocol
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        """Synchronous SUBMIT round trip.  An engine-side rejection
+        (ERROR frame) raises ``ValueError`` — the router's poison-
+        request path; a torn/silent worker raises ``ConnectionError`` —
+        the router's failover path.
+
+        Tradeoff, documented: the ack wait runs under the router's step
+        lock, so a wedged worker can stall placement for up to
+        ``submit_timeout`` (once — the timeout fails the replica over).
+        The synchronous ack is what gives remote engines rejection
+        parity with local ones (ValueError at submit time); an async
+        submit pipeline is a future rung if placement RTTs ever show up
+        in the step budget (localhost RTT is ~µs today)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        total = int(prompt.size) + int(max_new_tokens)
+        with self._lock:
+            if self._dead is not None:
+                raise ConnectionError(self._dead)
+            rid = self._next_rid
+            self._next_rid += 1
+            # register BEFORE sending: a fast worker's first TOKEN (or
+            # even DONE) frame can beat this thread back to the lock
+            # after the SUBMITTED ack — an unregistered rid would drop
+            # those frames and strand the request in-flight forever
+            self._inflight.add(rid)
+        try:
+            try:
+                self._conn.send(
+                    FrameKind.SUBMIT, rid=rid,
+                    prompt=prompt.tolist(),
+                    max_new_tokens=int(max_new_tokens),
+                )
+            except FrameProtocolError as e:
+                # a request too large to FRAME is the request's defect,
+                # not the replica's: surface it on the rejection path
+                # (ValueError -> router REJECTED) or a healthy replica
+                # would be failed over for every oversized submit
+                raise ValueError(f"request unframeable: {e}") from e
+            deadline = time.monotonic() + self.submit_timeout
+            with self._lock:
+                while rid not in self._submit_replies:
+                    if self._dead is not None:
+                        raise ConnectionError(self._dead)
+                    remaining = deadline - time.monotonic()
+                    timed_out = remaining <= 0 or \
+                        not self._submit_cv.wait(remaining)
+                    # re-check before raising: the ack can land exactly
+                    # on the timeout boundary (wait returns False AFTER
+                    # the reader stored the reply), and a spurious raise
+                    # here would fail over a healthy replica
+                    if timed_out and rid not in self._submit_replies:
+                        raise ConnectionError(
+                            f"worker {self.name}: no SUBMIT ack in "
+                            f"{self.submit_timeout}s")
+                reply = self._submit_replies.pop(rid)
+                if reply["kind"] == FrameKind.ERROR:
+                    raise ValueError(str(reply.get("error", "rejected")))
+                # optimistic ledger: the next STATS frame overwrites
+                self._slots_free = max(0, self._slots_free - 1)
+                if self.block_size:
+                    self._blocks_free -= -(-total // self.block_size)
+        except Exception:
+            with self._lock:
+                self._inflight.discard(rid)
+            raise
+        return rid
+
+    def step(self) -> List[SimpleNamespace]:
+        """Return requests finished since the last pump.  Raises when
+        the worker is dead OR silent past ``frame_timeout`` — a
+        successful return is a genuine liveness proof, which is what
+        makes ``ReplicaHandle.pump``'s heartbeat semantics hold for a
+        process the router cannot observe directly."""
+        now = time.monotonic()
+        with self._lock:
+            if self._dead is not None:
+                raise ConnectionError(self._dead)
+            if now - self._last_frame > self.frame_timeout:
+                raise ConnectionError(
+                    f"worker {self.name} silent for "
+                    f"{now - self._last_frame:.1f}s (> frame_timeout "
+                    f"{self.frame_timeout}s)")
+            finished, self._finished = self._finished, []
+            return finished
+
+    @property
+    def has_work(self) -> bool:
+        # a dead/stale proxy must claim work so ReplicaHandle.pump
+        # actually calls step() and hits the failover path — an idle
+        # corpse would otherwise keep "heartbeating" forever
+        with self._lock:
+            if self._dead is not None or self._finished:
+                return True
+            if time.monotonic() - self._last_frame > self.frame_timeout:
+                return True
+            return bool(self._inflight)
+
+    def slots_free(self) -> int:
+        with self._lock:
+            return 0 if self._dead is not None else self._slots_free
+
+    def blocks_free(self) -> float:
+        with self._lock:
+            return 0.0 if self._dead is not None else self._blocks_free
+
+    def blocks_needed(self, prompt_len: int,
+                      max_new_tokens: int) -> Optional[float]:
+        if not self.block_size:
+            return None  # scheduler falls back to its own default
+        return float(
+            -(-(int(prompt_len) + int(max_new_tokens)) // self.block_size))
+
+    # ------------------------------------------------- streaming extras
+    def drain_token_events(
+        self, now: Optional[float] = None
+    ) -> List[Tuple[int, List[int], float]]:
+        """TOKEN frames received since the last drain, each stamped with
+        its true arrival time (``now`` is ignored: receipt already
+        happened — this is the TTFT-semantics change)."""
+        with self._lock:
+            events, self._token_events = self._token_events, []
+            return events
+
+    def cancel(self, rid: int) -> None:
+        with self._lock:
+            self._inflight.discard(rid)
+        try:
+            self._conn.send(FrameKind.CANCEL, rid=rid)
+        except (ConnectionError, OSError):
+            pass  # best-effort: a dead worker cancelled everything
+
+    # -------------------------------------------------------- lifecycle
+    @property
+    def dead(self) -> Optional[str]:
+        return self._dead
+
+    def close(self, goodbye: bool = True) -> None:
+        self._closing = True
+        if goodbye and self._dead is None:
+            try:
+                self._conn.send(FrameKind.GOODBYE)
+                # half-close and let the reader drain to EOF: a full
+                # close with unread STATS in our buffer would RST the
+                # stream and can destroy the in-flight GOODBYE — the
+                # worker would never learn it should exit
+                self._conn.half_close()
+                self._reader.join(timeout=2.0)
+            except (ConnectionError, OSError, TimeoutError):
+                pass
+        self._mark_dead("closed by router", graceful=True)
+        self._reader.join(timeout=2.0)

@@ -1,0 +1,310 @@
+"""Local-process worker supervisor: spawn / monitor / respawn replicas.
+
+The deployment-shaped end of the fabric.  On a laptop or a single TPU
+host, :class:`WorkerSupervisor` IS the scheduler: it spawns
+``python -m dlrover_tpu.serving.remote.worker`` subprocesses, reads
+each worker's self-announced address (the worker binds port 0 itself —
+see the race note on :func:`~dlrover_tpu.common.rpc.find_free_port`),
+connects a :class:`~dlrover_tpu.serving.remote.proxy.
+RemoteReplicaHandle`, and joins it to the router.  In a cluster the
+same seam is the autoscale loop's ``engine_factory``: the Scaler
+(in-memory in tests, PodScaler/ActorScaler stubs in deployments)
+creates nodes, the :class:`~dlrover_tpu.serving.router.autoscale.
+ReplicaProvisioner` turns each node into a replica by calling
+:meth:`WorkerSupervisor.engine_factory` — so a scale-up launches REAL
+processes.
+
+Every spawned process is registered in a module-level table so a
+crashing test session can always be swept clean (:func:`reap_orphans`,
+wired into ``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import ServingFabric
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+
+# every live worker Popen, across all supervisors in the process —
+# the session-end reaper's ground truth
+_ALL_WORKERS: List[subprocess.Popen] = []
+_ALL_LOCK = threading.Lock()
+
+
+def _register(proc: subprocess.Popen) -> None:
+    with _ALL_LOCK:
+        # prune already-exited entries so a long-lived router process
+        # doesn't accumulate dead Popen objects one per spawn
+        _ALL_WORKERS[:] = [p for p in _ALL_WORKERS if p.poll() is None]
+        _ALL_WORKERS.append(proc)
+
+
+def reap_orphans(grace: float = 1.0) -> int:
+    """Kill every worker subprocess still alive (SIGTERM, then SIGKILL
+    after ``grace``).  Returns how many needed reaping.  Safe to call
+    repeatedly; tests/conftest.py runs it at session end so one failing
+    test can never strand workers that hang the suite."""
+    with _ALL_LOCK:
+        procs, _ALL_WORKERS[:] = list(_ALL_WORKERS), []
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.monotonic() + grace
+    for p in live:
+        try:
+            p.wait(timeout=max(0.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+                p.wait(timeout=grace)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+    return len(live)
+
+
+def serving_worker_command(
+    python: Optional[str] = None,
+    engine: str = "llama",
+    host: str = "0.0.0.0",
+    port: int = 0,
+    extra_args: Optional[List[str]] = None,
+) -> List[str]:
+    """The replica-process command line, shared by this supervisor and
+    the k8s/ray scaler stubs.  ``port=0`` is deliberate and should stay:
+    the worker binds the port itself and announces it — pre-picking one
+    here would reintroduce the bind-then-close race."""
+    return [
+        python or sys.executable,
+        "-m", "dlrover_tpu.serving.remote.worker",
+        "--engine", engine,
+        "--host", host,
+        "--port", str(int(port)),
+        *(extra_args or []),
+    ]
+
+
+class WorkerRecord:
+    """One supervised worker process."""
+
+    def __init__(self, name: str, proc: subprocess.Popen, addr: str,
+                 proxy: RemoteReplicaHandle, managed: bool):
+        self.name = name
+        self.proc = proc
+        self.addr = addr
+        self.proxy = proxy
+        self.managed = managed       # supervisor respawns it on death
+        self.respawns = 0
+
+
+class WorkerSupervisor:
+    """Spawn and babysit local worker processes for a router."""
+
+    def __init__(
+        self,
+        router=None,
+        worker_args: Optional[List[str]] = None,
+        engine: str = "fake",
+        host: str = "127.0.0.1",
+        spawn_timeout: float = 30.0,
+        respawn: bool = True,
+        max_respawns: int = 5,
+        name_prefix: str = "worker",
+    ):
+        self.router = router
+        self.worker_args = list(worker_args or [])
+        self.engine = engine
+        self.host = host
+        self.spawn_timeout = float(spawn_timeout)
+        self.respawn = bool(respawn)
+        self.max_respawns = int(max_respawns)
+        self.name_prefix = name_prefix
+        self.workers: Dict[str, WorkerRecord] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- spawn
+    def _command(self) -> List[str]:
+        return serving_worker_command(
+            engine=self.engine, host=self.host,
+            extra_args=self.worker_args,
+        )
+
+    def spawn(self, name: Optional[str] = None,
+              join: bool = True, managed: bool = True) -> WorkerRecord:
+        """Launch one worker, wait for its address announce, connect the
+        proxy and (``join=True``) join it to the router."""
+        with self._lock:
+            if name is None:
+                name = f"{self.name_prefix}-{self._next}"
+                self._next += 1
+            if name in self.workers:
+                raise ValueError(f"worker {name} already supervised")
+        proc = subprocess.Popen(
+            self._command(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        _register(proc)
+        try:
+            addr = self._read_announce(proc)
+            proxy = RemoteReplicaHandle(addr, name=name)
+        except Exception:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            raise
+        record = WorkerRecord(name, proc, addr, proxy, managed)
+        with self._lock:
+            self.workers[name] = record
+        if join and self.router is not None:
+            self.router.join_replica(name, proxy)
+        logger.info("spawned serving worker %s (pid %d) at %s",
+                    name, proc.pid, addr)
+        return record
+
+    def _read_announce(self, proc: subprocess.Popen) -> str:
+        """First ``DLROVER_WORKER_ADDR=`` stdout line, with a timeout
+        enforced off-thread (a wedged child must not wedge the spawn).
+        The scanner thread then keeps DRAINING stdout for the process's
+        lifetime — stdout is a pipe, and a worker that later prints
+        >64KB (library notices, stray prints) into an unread pipe would
+        block mid-write and read as a dead replica."""
+        result: Dict[str, str] = {}
+        announced = threading.Event()
+
+        def scan_then_drain():
+            for line in proc.stdout:  # type: ignore[union-attr]
+                if not announced.is_set():
+                    stripped = line.strip()
+                    if stripped.startswith(
+                            ServingFabric.WORKER_ANNOUNCE_PREFIX):
+                        result["addr"] = stripped[
+                            len(ServingFabric.WORKER_ANNOUNCE_PREFIX):]
+                        announced.set()
+                # keep consuming (and discarding) until EOF
+
+        threading.Thread(target=scan_then_drain, daemon=True).start()
+        deadline = time.monotonic() + self.spawn_timeout
+        while not announced.wait(0.1):
+            code = proc.poll()
+            # fail FAST on an already-dead child (import error, bad
+            # args) — sleeping out the full spawn_timeout here would
+            # stall every respawn/provisioner retry 30s per attempt.
+            # Brief grace first: the announce line may still sit in the
+            # pipe buffer of a process that printed then exited.
+            if code is not None and not announced.wait(0.5):
+                raise RuntimeError(
+                    f"worker (pid {proc.pid}) exited rc={code} before "
+                    "announcing an address")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"worker (pid {proc.pid}) announced no address "
+                    f"within {self.spawn_timeout}s")
+        return result["addr"]
+
+    # ------------------------------------------------- autoscale seam
+    def engine_factory(self, node) -> RemoteReplicaHandle:
+        """``ReplicaProvisioner`` adapter: one cluster node -> one real
+        worker process -> its proxy engine.  The provisioner does the
+        ``join_replica`` itself, and the autoscaler owns the lifecycle,
+        so these records are unmanaged (no supervisor respawn — a death
+        flows through router failover and the autoscaler's
+        replacement-node plan instead)."""
+        record = self.spawn(name=node.name, join=False, managed=False)
+        return record.proxy
+
+    # ----------------------------------------------------- monitoring
+    def poll(self) -> int:
+        """Reap exited processes; respawn managed ones (bounded).  The
+        router's own failover already requeued the dead worker's
+        requests — this only restores fleet capacity."""
+        respawned = 0
+        with self._lock:
+            dead = [
+                r for r in self.workers.values()
+                if r.proc.poll() is not None
+            ]
+        for record in dead:
+            with self._lock:
+                self.workers.pop(record.name, None)
+            record.proxy.close(goodbye=False)
+            logger.warning(
+                "serving worker %s (pid %d) exited rc=%s",
+                record.name, record.proc.pid, record.proc.returncode)
+            if (
+                self.respawn and record.managed
+                and record.proc.returncode != 0
+                and record.respawns < self.max_respawns
+            ):
+                # rc == 0 is a VOLUNTARY exit (GOODBYE after the router
+                # retired the replica on drain/scale-down) — respawning
+                # it would fight the scale decision; only crashes
+                # (signals / nonzero rc) are restored
+                try:
+                    fresh = self.spawn(
+                        name=f"{record.name}#r{record.respawns + 1}")
+                except Exception as e:
+                    # a transient spawn failure (announce timeout under
+                    # load) must not abort the loop NOR permanently
+                    # shrink the fleet: other dead workers still get
+                    # processed, and the next poll() retries this one
+                    logger.warning(
+                        "respawn of %s failed (retried next poll): %s",
+                        record.name, e)
+                    record.respawns += 1
+                    with self._lock:
+                        self.workers[record.name] = record
+                    continue
+                fresh.respawns = record.respawns + 1
+                respawned += 1
+        return respawned
+
+    # -------------------------------------------------------- chaos
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> int:
+        """Chaos hook: signal a worker process (default SIGKILL — the
+        mid-stream crash the fabric exists to survive).  Returns the
+        pid signalled."""
+        with self._lock:
+            record = self.workers[name]
+        os.kill(record.proc.pid, sig)
+        return record.proc.pid
+
+    # ----------------------------------------------------- lifecycle
+    def shutdown(self, grace: float = 2.0) -> None:
+        """Graceful stop: GOODBYE every proxy (workers exit on their
+        own), then escalate to SIGTERM/SIGKILL for stragglers."""
+        with self._lock:
+            records = list(self.workers.values())
+            self.workers.clear()
+        for r in records:
+            r.proxy.close(goodbye=True)
+        deadline = time.monotonic() + grace
+        for r in records:
+            try:
+                r.proc.wait(
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    r.proc.kill()
+                    r.proc.wait(timeout=grace)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+    def __enter__(self) -> "WorkerSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

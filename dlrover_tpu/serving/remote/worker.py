@@ -1,0 +1,412 @@
+"""Serving worker process: ``python -m dlrover_tpu.serving.remote.worker``.
+
+One worker = one replica process.  It binds port 0 ITSELF (the listener
+reports the kernel-assigned port through the stdout announce line and
+the HELLO frame — a pre-picked ``find_free_port`` would race another
+process between bind-and-close and re-bind), hosts an engine speaking
+the router's duck-typed engine protocol, and pushes TOKEN frames the
+moment tokens exist instead of waiting for request completion.  The
+engine is either the in-repo test :class:`FakeEngine` (deterministic,
+numpy-only — what chaos tests SIGKILL) or a real
+:class:`~dlrover_tpu.serving.engine.InferenceEngine` behind
+:class:`~dlrover_tpu.serving.router.replica.InferenceEngineAdapter`
+(imported lazily: the fake path must work on a jax-less image).
+
+Startup contract (read by ``supervisor.py`` and the k8s/ray stubs):
+the first matching stdout line is ``DLROVER_WORKER_ADDR=<host>:<port>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import sys
+import threading
+import time
+from types import SimpleNamespace
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import ServingFabric
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.remote.protocol import FrameConnection, FrameKind
+
+
+class FakeEngine:
+    """Deterministic engine for fabric tests and jax-less images: each
+    ``step()`` appends ``tokens_per_step`` tokens (value = rid % 997) to
+    every active request.  Speaks the full router engine protocol plus
+    the streaming extras (``inflight_outputs``, ``cancel``)."""
+
+    def __init__(self, slots: int = 4, blocks: int = 10_000,
+                 block_size: int = 4, tokens_per_step: int = 4,
+                 max_len: int = 4096, step_delay: float = 0.0):
+        self.max_slots = int(slots)
+        self.block_size = int(block_size)
+        self.total_blocks = int(blocks)
+        self.used_blocks = 0
+        self.tokens_per_step = int(tokens_per_step)
+        self.max_len = int(max_len)
+        # per-step sleep: lets chaos tests catch a worker MID-stream
+        self.step_delay = float(step_delay)
+        self._next = 0
+        self.active: Dict[int, dict] = {}
+        self.generated_tokens = 0
+
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new_tokens} "
+                f"exceeds engine max_len {self.max_len}")
+        rid = self._next
+        self._next += 1
+        need = -(-total // self.block_size)
+        self.used_blocks += need
+        self.active[rid] = {
+            "remaining": int(max_new_tokens), "output": [], "blocks": need,
+        }
+        return rid
+
+    def step(self) -> List:
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        finished = []
+        for rid in list(self.active):
+            st = self.active[rid]
+            take = min(self.tokens_per_step, st["remaining"])
+            st["output"].extend([rid % 997] * take)
+            st["remaining"] -= take
+            self.generated_tokens += take
+            if st["remaining"] <= 0:
+                self.used_blocks -= st["blocks"]
+                finished.append(
+                    SimpleNamespace(rid=rid, output=st["output"]))
+                del self.active[rid]
+        return finished
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.active)
+
+    def slots_free(self) -> int:
+        return max(0, self.max_slots - len(self.active))
+
+    def blocks_free(self) -> float:
+        return float(self.total_blocks - self.used_blocks)
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> float:
+        return float(-(-(prompt_len + max_new_tokens) // self.block_size))
+
+    # streaming extras -------------------------------------------------
+    def inflight_outputs(self) -> Dict[int, List[int]]:
+        """Live output snapshot per running request — the worker diffs
+        these against what it already streamed as TOKEN frames."""
+        return {rid: st["output"] for rid, st in self.active.items()}
+
+    def cancel(self, rid: int) -> bool:
+        st = self.active.pop(rid, None)
+        if st is None:
+            return False
+        self.used_blocks -= st["blocks"]
+        return True
+
+
+class WorkerServer:
+    """Frame server around one engine.  Accepts one router connection
+    at a time (the router owns its replicas 1:1) and re-listens after a
+    disconnect so a restarted router can re-adopt a warm worker."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 stats_interval: float = ServingFabric.STATS_INTERVAL,
+                 engine_kind: str = "fake"):
+        self.engine = engine
+        self.stats_interval = float(stats_interval)
+        self.engine_kind = engine_kind
+        # bind-port-0-yourself: the ONLY race-free way to pick a port
+        self._listener = socket.create_server(
+            (host, int(port)), reuse_port=False)
+        self._listener.settimeout(0.2)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self.addr = f"{host}:{self.port}"
+        self.stop_event = threading.Event()
+        self._conn: Optional[FrameConnection] = None
+        # SUBMIT rid (router-side) <-> engine rid maps
+        self._erid_by_rid: Dict[int, int] = {}
+        self._rid_by_erid: Dict[int, int] = {}
+        self._streamed: Dict[int, int] = {}  # erid -> tokens streamed
+        # last consistent STATS numbers; the heartbeat thread falls
+        # back to these when a live read races an engine mutation
+        self._last_stats_payload: Dict[str, object] = dict(
+            slots_free=0, blocks_free=0.0, inflight=0,
+            generated_tokens=0,
+        )
+
+    # ------------------------------------------------------- lifecycle
+    def announce(self, stream=None) -> None:
+        stream = stream or sys.stdout
+        print(f"{ServingFabric.WORKER_ANNOUNCE_PREFIX}{self.addr}",
+              file=stream, flush=True)
+
+    def crash(self) -> None:
+        """Test hook: die abruptly mid-stream (socket torn, no GOODBYE) —
+        the in-process stand-in for SIGKILL."""
+        self.stop_event.set()
+        conn = self._conn
+        if conn is not None:
+            conn.close()
+        self._listener.close()
+
+    def serve_forever(self) -> None:
+        try:
+            while not self.stop_event.is_set():
+                try:
+                    sock, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conn = FrameConnection(sock)
+                try:
+                    self._serve_connection(self._conn)
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    logger.warning("router connection dropped: %s", e)
+                finally:
+                    self._conn.close()
+                    self._conn = None
+        finally:
+            self._listener.close()
+
+    # ------------------------------------------------------ connection
+    def _serve_connection(self, conn: FrameConnection) -> None:
+        eng = self.engine
+        self._erid_by_rid.clear()
+        self._rid_by_erid.clear()
+        self._streamed.clear()
+        conn.send(
+            FrameKind.HELLO,
+            addr=self.addr,
+            slots_free=eng.slots_free(),
+            blocks_free=self._finite_blocks(),
+            block_size=getattr(eng, "block_size", 0),
+            engine=self.engine_kind,
+        )
+        # liveness off-thread: a long engine.step() (first-call jit
+        # compile on a real engine runs tens of seconds) must not
+        # starve STATS, or the proxy's frame_timeout would read a
+        # healthy-but-compiling worker as dead and fail it over —
+        # FrameConnection sends are lock-serialized, so this is safe
+        # alongside the pump's TOKEN/DONE sends
+        hb_stop = threading.Event()
+
+        def _heartbeat():
+            while not hb_stop.wait(self.stats_interval):
+                try:
+                    self._send_stats(conn)
+                except (ConnectionError, OSError):
+                    return
+                except Exception:
+                    # capacity accessors race the serve thread's engine
+                    # mutations (e.g. a deque mutating mid-iteration in
+                    # blocks_free) — a torn READ must not kill the
+                    # liveness beat; resend the last consistent numbers
+                    try:
+                        self._send_stats(conn, cached=True)
+                    except (ConnectionError, OSError):
+                        return
+
+        hb = threading.Thread(target=_heartbeat, daemon=True,
+                              name="worker-heartbeat")
+        hb.start()
+        try:
+            while not self.stop_event.is_set():
+                # drain every pending control frame before pumping:
+                # SUBMIT latency must not queue behind a decode step
+                busy = eng.has_work
+                frame = self._recv_one(conn, 0.0 if busy else 0.02)
+                while frame is not None:
+                    if not self._handle(conn, frame):
+                        return
+                    frame = self._recv_one(conn, 0.0)
+                if eng.has_work:
+                    self._pump(conn)
+        finally:
+            hb_stop.set()
+            hb.join(timeout=1.0)
+
+    def _recv_one(self, conn: FrameConnection,
+                  timeout: float) -> Optional[dict]:
+        try:
+            frame = conn.recv(timeout=timeout)
+        except TimeoutError:
+            return None
+        if frame is None:
+            raise ConnectionError("router closed the connection")
+        return frame
+
+    def _handle(self, conn: FrameConnection, frame: dict) -> bool:
+        kind = frame.get("kind")
+        if kind == FrameKind.SUBMIT:
+            rid = int(frame["rid"])
+            try:
+                erid = self.engine.add_request(
+                    np.asarray(frame["prompt"], np.int32),
+                    int(frame["max_new_tokens"]),
+                )
+            except ValueError as e:
+                # an impossible request is the ENGINE's verdict, not a
+                # worker failure: report it, stay alive
+                conn.send(FrameKind.ERROR, rid=rid, error=str(e))
+                return True
+            self._erid_by_rid[rid] = erid
+            self._rid_by_erid[erid] = rid
+            conn.send(FrameKind.SUBMITTED, rid=rid)
+        elif kind == FrameKind.CANCEL:
+            rid = int(frame["rid"])
+            erid = self._erid_by_rid.pop(rid, None)
+            if erid is not None:
+                self._rid_by_erid.pop(erid, None)
+                self._streamed.pop(erid, None)
+                cancel = getattr(self.engine, "cancel", None)
+                if cancel is not None:
+                    cancel(erid)
+        elif kind == FrameKind.HEARTBEAT:
+            self._send_stats(conn)
+        elif kind == FrameKind.GOODBYE:
+            logger.info("router said goodbye; worker %s exits", self.addr)
+            self.stop_event.set()
+            return False
+        return True
+
+    # ------------------------------------------------------------ pump
+    def _pump(self, conn: FrameConnection) -> None:
+        from dlrover_tpu.serving.router.replica import stream_deltas
+
+        finished = self.engine.step()
+        # stream the deltas FIRST — TTFT is measured at the receiver.
+        # prune=False: _streamed keeps the positions of just-finished
+        # requests so the DONE path below flushes only their SUFFIX
+        outputs = getattr(self.engine, "inflight_outputs", None)
+        if outputs is not None:
+            for erid, toks in stream_deltas(
+                    outputs(), self._streamed, prune=False):
+                rid = self._rid_by_erid.get(erid)
+                if rid is not None:
+                    conn.send(FrameKind.TOKEN, rid=rid,
+                              tokens=[int(t) for t in toks])
+        for ereq in finished:
+            rid = self._rid_by_erid.pop(ereq.rid, None)
+            sent = self._streamed.pop(ereq.rid, 0)
+            if rid is None:
+                continue  # cancelled while decoding
+            self._erid_by_rid.pop(rid, None)
+            out = [int(t) for t in ereq.output]
+            if len(out) > sent:
+                conn.send(FrameKind.TOKEN, rid=rid, tokens=out[sent:])
+            # DONE carries the full output: authoritative completion
+            conn.send(FrameKind.DONE, rid=rid, tokens=out)
+        if finished:
+            self._send_stats(conn)
+
+    def _finite_blocks(self) -> float:
+        free = self.engine.blocks_free()
+        # msgpack floats carry inf fine, but cap it so downstream
+        # arithmetic (ledger subtraction) stays well-behaved
+        return min(float(free), 1e18)
+
+    def _send_stats(self, conn: FrameConnection,
+                    cached: bool = False) -> None:
+        if not cached:
+            eng = self.engine
+            self._last_stats_payload = dict(
+                slots_free=eng.slots_free(),
+                blocks_free=self._finite_blocks(),
+                inflight=len(self._rid_by_erid),
+                generated_tokens=int(
+                    getattr(eng, "generated_tokens", 0)),
+            )
+        conn.send(FrameKind.STATS, **self._last_stats_payload)
+
+
+def _build_llama_engine(args) -> object:
+    """Real-engine path (lazy imports: jax must not gate ``--engine
+    fake``).  Weights are randomly initialized — the checkpoint-loading
+    rung is recorded in ROADMAP, not faked here."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+    from dlrover_tpu.serving.router.replica import InferenceEngineAdapter
+
+    cfg = LlamaConfig.tiny(max_seq_len=args.max_len, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32))
+    return InferenceEngineAdapter(InferenceEngine(
+        cfg, variables, max_slots=args.slots, chunk=4, paged=True,
+        block_size=args.block_size, seed=args.seed,
+    ))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dlrover_tpu.serving.remote.worker",
+        description="One serving replica process (frame protocol).",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 (default): bind a kernel-assigned port and "
+                        "announce it — never pre-pick a port")
+    p.add_argument("--engine", choices=("fake", "llama"), default="fake")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--tokens-per-step", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=4)
+    p.add_argument("--blocks", type=int, default=10_000)
+    p.add_argument("--max-len", type=int, default=4096)
+    p.add_argument("--step-delay", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--stats-interval", type=float,
+                   default=ServingFabric.STATS_INTERVAL)
+    args = p.parse_args(argv)
+
+    if args.engine == "llama":
+        engine = _build_llama_engine(args)
+    else:
+        engine = FakeEngine(
+            slots=args.slots, blocks=args.blocks,
+            block_size=args.block_size,
+            tokens_per_step=args.tokens_per_step,
+            max_len=args.max_len, step_delay=args.step_delay,
+        )
+    server = WorkerServer(
+        engine, host=args.host, port=args.port,
+        stats_interval=args.stats_interval, engine_kind=args.engine,
+    )
+
+    terminated = threading.Event()
+
+    def _term(signum, _frame):  # pragma: no cover - signal path
+        terminated.set()
+        server.stop_event.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    server.announce()
+    logger.info("serving worker up at %s (engine=%s)",
+                server.addr, args.engine)
+    server.serve_forever()
+    # rc 0 is reserved for a GOODBYE-initiated exit (the router
+    # DECIDED to retire this worker; the supervisor must not respawn).
+    # An external SIGTERM is not a scale decision — exit 143 so the
+    # supervisor restores the fleet.
+    return 143 if terminated.is_set() else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(main())

@@ -25,6 +25,7 @@ from dlrover_tpu.serving.router.gateway import (  # noqa: F401
     PRIORITY_BATCH,
     PRIORITY_HIGH,
     PRIORITY_NORMAL,
+    STREAM_RESTART,
     QueueFullError,
     RequestGateway,
     ServingRequest,

@@ -237,26 +237,62 @@ class ReplicaProvisioner:
         watcher,                       # NodeWatcher
         engine_factory: Callable[[Node], object],
         node_type: str = NodeType.SERVING_REPLICA,
+        max_join_attempts: int = 5,
     ):
         self.router = router
         self.watcher = watcher
         self.engine_factory = engine_factory
         self.node_type = node_type
+        self.max_join_attempts = int(max_join_attempts)
+        # nodes whose engine_factory failed transiently, awaiting retry
+        # (the watcher's events were already destructively consumed, so
+        # losing these here would be permanent capacity loss)
+        self._join_retry: Dict[str, tuple] = {}  # name -> (node, tries)
+
+    def _try_join(self, node: Node) -> bool:
+        """One join attempt; failures queue the node for later polls.
+        ``engine_factory`` now spawns real processes (supervisor seam)
+        and can legitimately fail transiently (announce timeout under
+        load, connect refusal) — one bad spawn must not strand the node
+        NOR abort the rest of the event batch."""
+        try:
+            engine = self.engine_factory(node)
+        except Exception as e:
+            _, tries = self._join_retry.get(node.name, (None, 0))
+            if tries + 1 >= self.max_join_attempts:
+                self._join_retry.pop(node.name, None)
+                logger.error(
+                    "provisioning replica for node %s failed %d times; "
+                    "giving up: %s", node.name, tries + 1, e)
+            else:
+                self._join_retry[node.name] = (node, tries + 1)
+                logger.warning(
+                    "provisioning replica for node %s failed "
+                    "(attempt %d/%d, retried next poll): %s",
+                    node.name, tries + 1, self.max_join_attempts, e)
+            return False
+        self._join_retry.pop(node.name, None)
+        self.router.join_replica(node.name, engine, node=node)
+        return True
 
     def poll(self, timeout: float = 0.01) -> int:
         """Apply pending node events; returns how many were applied."""
         applied = 0
+        for name, (node, _) in list(self._join_retry.items()):
+            if name not in self.router.replica_names \
+                    and self._try_join(node):
+                applied += 1
         for event in self.watcher.watch(timeout=timeout):
             node = event.node
             if node.type != self.node_type:
                 continue
             joined = node.name in self.router.replica_names
             if event.event_type == NodeEventType.DELETED:
+                self._join_retry.pop(node.name, None)
                 if joined:
                     self.router.begin_drain(node.name)
                     applied += 1
             elif not joined and not node.is_exited():
-                self.router.join_replica(
-                    node.name, self.engine_factory(node), node=node)
-                applied += 1
+                if self._try_join(node):
+                    applied += 1
         return applied

@@ -16,14 +16,15 @@ line.
 from __future__ import annotations
 
 import dataclasses
+import queue as queue_mod
 import threading
 import time
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, Iterator, List, Optional
 
 import numpy as np
 
-from dlrover_tpu.common.constants import ServingRequestState
+from dlrover_tpu.common.constants import ServingFabric, ServingRequestState
 
 PRIORITY_HIGH = 0
 PRIORITY_NORMAL = 1
@@ -41,6 +42,19 @@ class QueueFullError(AdmissionError):
 
 class RequestTimedOut(RuntimeError):
     """Raised by :meth:`ServingRequest.result` for an expired request."""
+
+
+class _StreamRestart:
+    """Yielded by :meth:`ServingRequest.stream` when a replica failure
+    requeued the request: everything yielded so far is void (the replay
+    regenerates from scratch — at-least-once execution) and the stream
+    restarts from token 0 of the new attempt."""
+
+    def __repr__(self) -> str:  # pragma: no cover - repr only
+        return "STREAM_RESTART"
+
+
+STREAM_RESTART = _StreamRestart()
 
 
 @dataclasses.dataclass
@@ -65,20 +79,94 @@ class ServingRequest:
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False, compare=False
     )
+    # token stream: events pushed as TOKEN frames arrive (or as the
+    # local engine emits); consumed by stream().  Events are recorded
+    # even with no consumer attached — a deliberate tradeoff: ONE
+    # subscriber, attaching at any time (even post-completion), sees
+    # the full history including restarts, at the cost of one extra
+    # token copy bounded by the request's own output length and
+    # lifetime.  The queue drains destructively: stream() is
+    # single-consumer, a second iteration sees nothing (use result())
+    _events: "queue_mod.Queue" = dataclasses.field(
+        default_factory=queue_mod.Queue, repr=False, compare=False
+    )
+    _streamed: int = dataclasses.field(
+        default=0, repr=False, compare=False
+    )  # tokens pushed to the stream since the last (re)start
 
     @property
     def total_len(self) -> int:
         return int(self.prompt.size) + int(self.max_new_tokens)
 
+    # ------------------------------------------------------- streaming
+    def push_tokens(self, tokens: List[int], now: float) -> None:
+        """Tokens newly emitted for this request.  The FIRST push of an
+        attempt stamps ``first_token_at`` — for remote replicas ``now``
+        is the TOKEN frame's receive time, which is what makes reported
+        TTFT the true first-token latency rather than a pump artifact."""
+        if not tokens:
+            return
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.output.extend(tokens)
+        self._streamed += len(tokens)
+        self._events.put(("tokens", list(tokens)))
+
     def finish(self, output: List[int], now: float) -> None:
-        self.output = list(output)
+        output = list(output)
+        if len(output) > self._streamed:
+            # engines without incremental emission (or a final flush
+            # race) still complete the stream before it closes
+            self._events.put(("tokens", output[self._streamed:]))
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.output = output
         self.state = ServingRequestState.DONE
-        self.finished_at = now
+        # clamp: the router stamps a whole pump round with its entry
+        # time, but a remote TOKEN frame received DURING the round
+        # carries a later (true) timestamp — completion can never
+        # precede the first token
+        self.finished_at = max(now, self.first_token_at)
+        self._events.put(("done", None))
         self._done.set()
 
     def abort(self, state: str) -> None:
         self.state = state
+        self._events.put(("abort", state))
         self._done.set()
+
+    def restart_stream(self) -> None:
+        """Failover requeue: void partial output, signal consumers."""
+        self.output = []
+        self.first_token_at = None
+        self.ttft_recorded = False
+        self._streamed = 0
+        self._events.put(("restart", None))
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator:
+        """Iterate tokens as they are generated.  Yields ints; a
+        replica failure mid-generation yields :data:`STREAM_RESTART`
+        once, then the replay's tokens from the beginning.  Ends at
+        completion; raises :class:`RequestTimedOut` if the request
+        aborts and ``TimeoutError`` if ``timeout`` elapses between
+        events."""
+        while True:
+            try:
+                kind, payload = self._events.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    f"request {self.rid}: no stream event within "
+                    f"{timeout}s") from None
+            if kind == "tokens":
+                for tok in payload:
+                    yield tok
+            elif kind == "restart":
+                yield STREAM_RESTART
+            elif kind == "done":
+                return
+            else:  # abort
+                raise RequestTimedOut(
+                    f"request {self.rid} ended as {payload}")
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until completion; the synchronous client surface."""
@@ -99,11 +187,13 @@ class RequestGateway:
         max_prompt_len: Optional[int] = None,
         max_total_len: Optional[int] = None,
         default_timeout: Optional[float] = None,
+        max_requeues: int = ServingFabric.MAX_REQUEST_REQUEUES,
     ):
         self.max_pending = int(max_pending)
         self.max_prompt_len = max_prompt_len
         self.max_total_len = max_total_len
         self.default_timeout = default_timeout
+        self.max_requeues = int(max_requeues)
         self._lock = threading.RLock()
         self._queues: List[Deque[ServingRequest]] = [
             deque() for _ in _PRIORITIES
@@ -112,6 +202,7 @@ class RequestGateway:
         self.submitted = 0
         self.rejected = 0
         self.timed_out = 0
+        self.poisoned = 0
 
     # ----------------------------------------------------------- admit
     def submit(
@@ -162,21 +253,35 @@ class RequestGateway:
             self.submitted += 1
             return req
 
-    def requeue_front(self, requests: List[ServingRequest]) -> None:
+    def requeue_front(
+        self, requests: List[ServingRequest]
+    ) -> List[ServingRequest]:
         """Failover path: a dead replica's in-flight requests re-enter at
         the FRONT of their band (they have waited longest).  Partial
         output is discarded — the replay regenerates from scratch
-        (at-least-once, exactly-once output)."""
+        (at-least-once, exactly-once output) — and any open token stream
+        is restarted.
+
+        Poison guard: a request that has already burned ``max_requeues``
+        replays is statistically the thing KILLING replicas, not their
+        victim — it is failed with ``POISONED`` instead of circulating
+        forever.  Returns the poisoned requests (the router counts them
+        into ``serving_requests_poisoned_total``)."""
+        poisoned: List[ServingRequest] = []
         with self._lock:
             for req in reversed(requests):
+                req.requeues += 1
+                if req.requeues > self.max_requeues:
+                    self.poisoned += 1
+                    req.abort(ServingRequestState.POISONED)
+                    poisoned.append(req)
+                    continue
                 req.state = ServingRequestState.QUEUED
                 req.replica = None
                 req.engine_rid = None
-                req.output = []
-                req.first_token_at = None
-                req.ttft_recorded = False
-                req.requeues += 1
+                req.restart_stream()
                 self._queues[req.priority].appendleft(req)
+        return poisoned
 
     # ------------------------------------------------------- schedule
     def schedule_scan(self, window: int) -> List[ServingRequest]:

@@ -18,9 +18,16 @@ Gauge/counter names (stable API, documented in README + PERF.md):
   (plus ``_p50`` / ``_p99`` from a reservoir)
 - ``serving_tokens_per_second``  — generated-token throughput (window)
 - ``serving_requests_{submitted,completed,rejected,timed_out,
-  requeued}_total`` — lifecycle counters (``requeued`` counts failover
-  replays: nonzero says a replica died; completed+timed_out accounting
-  still balancing says nothing was lost)
+  requeued,poisoned}_total`` — lifecycle counters (``requeued`` counts
+  failover replays: nonzero says a replica died; completed+timed_out
+  accounting still balancing says nothing was lost; ``poisoned`` counts
+  requests failed for exceeding the failover-replay cap — a nonzero
+  value says some request was crashing replicas)
+
+TTFT semantics: for streaming engines (the remote replica fabric and
+the in-process adapter) ``serving_ttft_seconds`` measures submission to
+the FIRST TOKEN actually received, not to the first post-placement
+router pump.
 """
 
 from __future__ import annotations
@@ -44,6 +51,7 @@ class RouterMetrics:
         self.rejected = 0
         self.timed_out = 0
         self.requeued = 0
+        self.poisoned = 0
         self.generated_tokens = 0
         self.ttft = StepTimer()
         self._ttft_window = WindowGauge(window_seconds)
@@ -102,4 +110,5 @@ class RouterMetrics:
             "serving_requests_rejected_total": float(self.rejected),
             "serving_requests_timed_out_total": float(self.timed_out),
             "serving_requests_requeued_total": float(self.requeued),
+            "serving_requests_poisoned_total": float(self.poisoned),
         }

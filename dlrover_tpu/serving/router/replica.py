@@ -39,17 +39,74 @@ class ReplicaDeadError(RuntimeError):
     """The replica's engine is gone; the caller must fail it over."""
 
 
+def stream_deltas(
+    outputs: Dict[int, List[int]],
+    sent: Dict[int, int],
+    prune: bool = True,
+) -> List[tuple]:
+    """THE streaming diff: new tokens per request id since the last
+    call, updating ``sent`` positions in place.  One implementation for
+    both sides of the fabric — the in-process adapter below and the
+    remote worker's TOKEN-frame emitter (serving/remote/worker.py) —
+    so flush/reset edge cases cannot drift apart.  ``prune=True`` drops
+    positions for ids absent from ``outputs`` (finished/evicted);
+    callers that flush a final suffix from their own completion path
+    (the worker's DONE handler) pass ``prune=False`` and pop positions
+    themselves."""
+    events = []
+    for rid, out in outputs.items():
+        n = sent.get(rid, 0)
+        if len(out) > n:
+            events.append((rid, list(out[n:])))
+            sent[rid] = len(out)
+    if prune:
+        for rid in list(sent):
+            if rid not in outputs:
+                del sent[rid]
+    return events
+
+
 class InferenceEngineAdapter:
     """Protocol adapter over :class:`serving.engine.InferenceEngine`."""
 
     def __init__(self, engine):
         self.engine = engine
+        self._stream_pos: Dict[int, int] = {}  # rid -> tokens streamed
+
+    @property
+    def block_size(self) -> int:
+        """KV block granularity for capacity reporting (0 = unpaged) —
+        the remote worker publishes this in its HELLO frame so the
+        router-side proxy can gate placements on blocks."""
+        if not getattr(self.engine, "paged", False):
+            return 0
+        return int(getattr(self.engine, "block_size", 0))
 
     def add_request(self, prompt, max_new_tokens: int) -> int:
         return self.engine.add_request(prompt, max_new_tokens)
 
     def step(self) -> List:
         return self.engine.step()
+
+    def inflight_outputs(self) -> Dict[int, List[int]]:
+        """Live output snapshot per RUNNING request (finished ones are
+        covered by ``step()``'s return) — the streaming introspection
+        surface the remote worker and the local pump both diff against."""
+        return {
+            req.rid: req.output
+            for req in self.engine._slot_req if req is not None
+        }
+
+    def drain_token_events(self, now: float) -> List:
+        """Tokens emitted since the last drain as ``(rid, tokens, t)``
+        events.  The in-process engine emits inside ``step()``, so the
+        pump's ``now`` IS the emission time (remote proxies override the
+        timestamp with the TOKEN frame's receive time instead)."""
+        return [
+            (rid, toks, now)
+            for rid, toks in stream_deltas(
+                self.inflight_outputs(), self._stream_pos)
+        ]
 
     @property
     def has_work(self) -> bool:
@@ -161,7 +218,10 @@ class ReplicaHandle:
     def pump(self, now: Optional[float] = None) -> List[ServingRequest]:
         """One engine step; returns router requests finished by it.
         A successful pump IS the heartbeat (the engine demonstrably made
-        progress); an engine exception marks the replica failed."""
+        progress); an engine exception marks the replica failed.  (For
+        a remote engine, ``step()`` itself raises when the worker is
+        dead or frame-silent, so the heartbeat only refreshes on real
+        evidence of a live process.)"""
         now = time.monotonic() if now is None else now
         if self._failed:
             raise ReplicaDeadError(f"replica {self.name} is dead")
@@ -172,6 +232,16 @@ class ReplicaHandle:
             raise ReplicaDeadError(
                 f"replica {self.name} engine failed: {e}") from e
         self.last_heartbeat = now
+        # streaming engines: forward newly-emitted tokens into each
+        # request's stream; the event timestamp (TOKEN-frame receive
+        # time for remote workers) stamps first_token_at — TTFT is
+        # measured from true first-token emission
+        drain = getattr(self.engine, "drain_token_events", None)
+        if drain is not None:
+            for erid, toks, t in drain(now):
+                req = self.inflight.get(erid)
+                if req is not None:
+                    req.push_tokens(toks, t)
         done: List[ServingRequest] = []
         for ereq in finished:
             req = self.inflight.pop(ereq.rid, None)
@@ -180,14 +250,17 @@ class ReplicaHandle:
             self.generated_tokens += len(ereq.output)
             req.finish(list(ereq.output), now)
             done.append(req)
-        # TTFT: the first pump after placement completes the prefill and
-        # emits the first token (engine._admit runs inside step())
-        for req in self.inflight.values():
-            if req.first_token_at is None:
-                req.first_token_at = now
-        for req in done:
-            if req.first_token_at is None:
-                req.first_token_at = now
+        if drain is None:
+            # legacy engines surface no token stream: the first pump
+            # after placement completes the prefill and emits the first
+            # token (engine._admit runs inside step()), so it remains
+            # the best available TTFT estimate
+            for req in self.inflight.values():
+                if req.first_token_at is None:
+                    req.first_token_at = now
+            for req in done:
+                if req.first_token_at is None:
+                    req.first_token_at = now
         return done
 
     # ------------------------------------------------------- lifecycle

@@ -195,6 +195,7 @@ class ServingRouter:
                 if handle.drained:
                     self.manager.remove(handle.name)
                     self.scheduler.forget_replica(handle.name)
+                    self._close_engine(handle, goodbye=True)
                     self.drained.append(
                         DrainedReplica(handle.name, handle.node))
 
@@ -231,14 +232,52 @@ class ServingRouter:
         self._requeue((extra or []) + self.manager.reap_dead(now))
         for handle in self.manager.dead_handles:
             self.scheduler.forget_replica(handle.name)
+            self._close_engine(handle, goodbye=False)
             self.dead.append(DrainedReplica(handle.name, handle.node))
         self.manager.dead_handles.clear()
+
+    @staticmethod
+    def _close_engine(handle: ReplicaHandle, goodbye: bool) -> None:
+        """Release a retired replica's engine resources.  Remote engine
+        proxies expose ``close()`` (connection torn down, reader thread
+        joined); without this every scale-down or crash would leak the
+        proxy's TCP connection and thread.  ``goodbye`` is sent only on
+        DELIBERATE retirement (drain/scale-down) — a replica reaped as
+        dead is only *presumed* dead, and telling a falsely-reaped-but-
+        alive worker to exit would convert a transient liveness glitch
+        into permanent fleet loss (its supervisor would read the clean
+        rc-0 exit as a scale decision and never respawn it; a truly
+        dead process respawns off its nonzero rc instead).  In-process
+        engines expose no ``close`` and need none."""
+        close = getattr(handle.engine, "close", None)
+        if close is None:
+            return
+        try:
+            import inspect
+
+            try:
+                takes_goodbye = "goodbye" in inspect.signature(
+                    close).parameters
+            except (TypeError, ValueError):
+                takes_goodbye = False
+            close(goodbye=goodbye) if takes_goodbye else close()
+        except Exception as e:  # teardown must never fail the pump
+            logger.warning(
+                "closing engine of retired replica %s failed: %s",
+                handle.name, e)
 
     def _requeue(self, requests: List[ServingRequest]) -> None:
         if not requests:
             return
-        self.gateway.requeue_front(requests)
-        self.metrics.requeued += len(requests)
+        poisoned = self.gateway.requeue_front(requests)
+        self.metrics.requeued += len(requests) - len(poisoned)
+        self.metrics.poisoned = self.gateway.poisoned
+        for req in poisoned:
+            logger.error(
+                "request %s poisoned: crashed a replica on each of its "
+                "%d placements; failing it instead of requeueing",
+                req.rid, req.requeues,
+            )
 
     # ------------------------------------------------------ conveniences
     @property

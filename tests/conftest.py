@@ -26,6 +26,23 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _reap_worker_subprocesses():
+    """Session-end sweep of serving-worker subprocesses: a test that
+    fails (or is interrupted) between spawn and shutdown must not leave
+    orphan workers alive to hang the suite or leak ports.  The
+    supervisor registers every Popen it creates in a module-level table;
+    this reaps whatever is still running."""
+    yield
+    try:
+        from dlrover_tpu.serving.remote.supervisor import reap_orphans
+    except Exception:  # the fabric may be un-importable mid-refactor
+        return
+    reaped = reap_orphans()
+    if reaped:
+        print(f"\n[conftest] reaped {reaped} leaked worker subprocesses")
+
+
 @pytest.fixture()
 def local_master():
     """In-process master + gRPC server on a free port; yields (master, addr).

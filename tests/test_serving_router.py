@@ -9,7 +9,6 @@ losing nothing either.
 """
 
 import time
-from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -42,54 +41,10 @@ from dlrover_tpu.serving.router.gateway import AdmissionError
 from dlrover_tpu.utils.profiler import render_prometheus
 
 
-class FakeEngine:
-    """Protocol-conformant in-memory replica engine: each ``step()``
-    appends ``tokens_per_step`` deterministic tokens to every active
-    request (token value = engine rid, so outputs are checkable)."""
-
-    def __init__(self, slots=4, blocks=10_000, block_size=4,
-                 tokens_per_step=4):
-        self.max_slots = slots
-        self.block_size = block_size
-        self.total_blocks = blocks
-        self.used_blocks = 0
-        self.tokens_per_step = tokens_per_step
-        self._next = 0
-        self.active = {}
-
-    def add_request(self, prompt, max_new_tokens):
-        rid = self._next
-        self._next += 1
-        need = -(-(len(prompt) + max_new_tokens) // self.block_size)
-        self.used_blocks += need
-        self.active[rid] = {
-            "remaining": int(max_new_tokens), "output": [], "blocks": need,
-        }
-        return rid
-
-    def step(self):
-        finished = []
-        for rid in list(self.active):
-            st = self.active[rid]
-            take = min(self.tokens_per_step, st["remaining"])
-            st["output"].extend([rid % 997] * take)
-            st["remaining"] -= take
-            if st["remaining"] <= 0:
-                self.used_blocks -= st["blocks"]
-                finished.append(
-                    SimpleNamespace(rid=rid, output=st["output"]))
-                del self.active[rid]
-        return finished
-
-    @property
-    def has_work(self):
-        return bool(self.active)
-
-    def slots_free(self):
-        return self.max_slots - len(self.active)
-
-    def blocks_free(self):
-        return float(self.total_blocks - self.used_blocks)
+# the protocol-conformant in-memory replica engine ships in product
+# code (the remote worker hosts it too) — one implementation, no
+# test-local copy to drift from the contract the fabric tests exercise
+from dlrover_tpu.serving.remote.worker import FakeEngine  # noqa: E402
 
 
 def _prompt(i, n=8):
