@@ -19,6 +19,7 @@ Counterpart of the reference's ``AsyncCheckpointSaver``
 from __future__ import annotations
 
 import os
+import queue
 import threading
 import time
 from typing import Dict, List, Optional
@@ -100,6 +101,9 @@ class AsyncCheckpointSaver:
         # Serializes persists between the event loop and the agent's
         # failure-path save_shm_to_storage (monitor thread).
         self._persist_mutex = threading.Lock()
+        # live async-commit threads, so stop() can give them a bounded
+        # join instead of abandoning them mid-rename (DL002 hygiene)
+        self._commit_threads: List[threading.Thread] = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -115,9 +119,16 @@ class AsyncCheckpointSaver:
                 dumps(CheckpointEvent(EXIT_EVENT).to_dict())
             )
         except Exception:
-            pass
+            # event loop also polls _stop at 1Hz, so a failed wakeup
+            # only delays shutdown by a tick
+            logger.debug("exit-event push failed", exc_info=True)
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # commit threads wait on cross-node done-files; give stragglers
+        # a short window, then leave them to their daemon-ness (a dead
+        # peer's commit can never finish and must not block shutdown)
+        for t in self._drain_commit_threads():
+            t.join(timeout=2.0)
         for h in self._shm_handlers:
             h.close()
         for lk in self._shm_locks:
@@ -132,7 +143,17 @@ class AsyncCheckpointSaver:
         while not self._stop.is_set():
             try:
                 raw = self._event_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue  # poll tick; nothing to persist
             except Exception:
+                # IPC hiccup (agent restarting the event socket) — log
+                # and back off; silently eating it here would turn a
+                # dead queue into an invisible saver stall (DL005)
+                logger.warning(
+                    "ckpt event queue read failed; retrying",
+                    exc_info=True,
+                )
+                time.sleep(1.0)
                 continue
             event = CheckpointEvent.from_dict(loads(raw))
             if event.kind == EXIT_EVENT:
@@ -230,17 +251,28 @@ class AsyncCheckpointSaver:
                     # sibling commit's GC must not prune this stage in the
                     # window before the OS schedules the new thread.
                     self._inflight_commits.add(actual)
-                    threading.Thread(
+                    self._drain_commit_threads()
+                    t = threading.Thread(
                         target=self.commit_checkpoint,
                         args=(actual,),
                         kwargs={"timeout": commit_timeout, "world": world},
                         daemon=True,
                         name=f"ckpt-commit-{actual}",
-                    ).start()
+                    )
+                    self._commit_threads.append(t)
+                    t.start()
                 else:
                     self.commit_checkpoint(
                         actual, timeout=commit_timeout, world=world
                     )
+
+    def _drain_commit_threads(self) -> List[threading.Thread]:
+        """Prune finished commit threads; return the live ones (stop()
+        gives them a bounded join)."""
+        self._commit_threads = [
+            t for t in self._commit_threads if t.is_alive()
+        ]
+        return list(self._commit_threads)
 
     def _persist_shard(
         self,
@@ -570,7 +602,16 @@ class SaverFactory:
         while not self._stop.is_set():
             try:
                 raw = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue  # poll tick; no construction request
             except Exception:
+                # a broken factory queue must be visible, not a silent
+                # "savers never appear" mystery (DL005)
+                logger.warning(
+                    "saver factory queue read failed; retrying",
+                    exc_info=True,
+                )
+                time.sleep(1.0)
                 continue
             try:
                 kwargs = loads(raw)

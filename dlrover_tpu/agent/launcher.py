@@ -24,9 +24,9 @@ from typing import List, Optional, Tuple
 
 from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.announce import read_announced_value
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.rpc import find_free_port
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -111,18 +111,33 @@ def _parse_nnodes(s: str) -> Tuple[int, int]:
 
 def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
     """Spawn an in-host master for standalone / single-host jobs
-    (reference: elastic_run.py:237-266)."""
-    port = find_free_port()
+    (reference: elastic_run.py:237-266).
+
+    ``--port 0``: the master binds a kernel-assigned port itself and
+    announces it on stdout — pre-picking one here (the old
+    ``find_free_port`` call) would hand any other process on the host a
+    window to steal the port before the master re-binds it."""
     proc = subprocess.Popen(  # noqa: S603
         [
             sys.executable, "-m", "dlrover_tpu.master.main",
-            "--platform", "local", "--port", str(port),
+            "--platform", "local", "--port", "0",
             "--node_num", str(node_num),
         ],
         env=dict(os.environ),
+        stdout=subprocess.PIPE,
+        text=True,
     )
-    addr = f"127.0.0.1:{port}"
     atexit.register(proc.terminate)
+    try:
+        addr = read_announced_value(
+            proc,
+            NodeEnv.MASTER_ANNOUNCE_PREFIX,
+            timeout=60.0,
+            what="local master",
+        )
+    except RuntimeError:
+        proc.terminate()
+        raise
     return proc, addr
 
 

@@ -195,7 +195,9 @@ class IndexShardingClient(ShardingClient):
                 self._consumed_in_head += take
                 remaining -= take
                 if self._consumed_in_head >= head_n:
-                    self._task_fifo.get()
+                    # non-empty is guaranteed by the loop condition (we
+                    # hold the only consuming lock), so never block here
+                    self._task_fifo.get_nowait()
                     self._consumed_in_head = 0
                     self._client.report_task_result(
                         self.dataset_name, head_id

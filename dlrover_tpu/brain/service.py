@@ -36,7 +36,7 @@ from dlrover_tpu.brain.datastore import JobHistoryStore
 from dlrover_tpu.brain.hpsearch import BayesianOptimizer, Param
 from dlrover_tpu.brain.serving import ServingScalePolicy, ServingSignal
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.rpc import RpcStub, build_server
+from dlrover_tpu.common.rpc import RpcStub, bind_server_port, build_server
 from dlrover_tpu.common.serialize import dumps, loads
 from dlrover_tpu.master.resource.local_optimizer import LocalOptimizer
 from dlrover_tpu.master.resource.optimizer import SpeedSample
@@ -50,12 +50,9 @@ class BrainService:
         self._searches: Dict[str, BayesianOptimizer] = {}
         self._lock = threading.Lock()
         self._server = build_server(self._handle_get, self._handle_report)
-        # let grpc pick/bind atomically — probing a free port first is a
-        # TOCTOU race and a failed add_insecure_port returns 0 silently
-        bound = self._server.add_insecure_port(f"[::]:{port}")
-        if not bound:
-            raise OSError(f"could not bind brain service port {port}")
-        self.port = bound
+        # one copy of the race-free-bind policy: rpc.bind_server_port
+        # (atomic pick/bind, raises on grpc's silent-failure 0)
+        self.port = bind_server_port(self._server, port)
 
     def start(self) -> None:
         self._server.start()

@@ -1,0 +1,68 @@
+"""The self-bound-port announce handshake, reader side.
+
+A child process that must pick its own port race-free (serving worker,
+local master) binds port 0 ITSELF and prints one
+``<PREFIX><host>:<port>`` line to stdout; the parent reads it here.
+Pre-picking a port in the parent (``find_free_port``) loses the port to
+any other process between bind-and-close and the child's re-bind — the
+TOCTOU race dlint's DL001 checker rejects.
+
+The scanner thread keeps DRAINING stdout for the child's lifetime:
+stdout is a pipe, and a child that later prints >64KB (library notices,
+stray prints) into an unread pipe would block mid-write and read as
+hung.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Dict
+
+
+def read_announced_value(
+    proc: subprocess.Popen,
+    prefix: str,
+    timeout: float = 30.0,
+    what: str = "child",
+) -> str:
+    """First ``<prefix>`` stdout line's value, with the timeout enforced
+    off-thread (a wedged child must not wedge the parent).  The child
+    must have been started with ``stdout=subprocess.PIPE, text=True``.
+
+    Raises ``RuntimeError`` when the child exits or stays silent before
+    announcing — fail FAST on an already-dead child (import error, bad
+    args) instead of sleeping out the full timeout."""
+    result: Dict[str, str] = {}
+    announced = threading.Event()
+
+    def scan_then_drain():
+        for line in proc.stdout:  # type: ignore[union-attr]
+            if not announced.is_set():
+                stripped = line.strip()
+                if stripped.startswith(prefix):
+                    result["value"] = stripped[len(prefix):]
+                    announced.set()
+            # keep consuming (and discarding) until EOF
+
+    threading.Thread(
+        target=scan_then_drain, daemon=True,
+        name=f"announce-drain-{proc.pid}",
+    ).start()
+    deadline = time.monotonic() + timeout
+    while not announced.wait(0.1):
+        code = proc.poll()
+        # brief grace on exit: the announce line may still sit in the
+        # pipe buffer of a process that printed then exited
+        if code is not None and not announced.wait(0.5):
+            raise RuntimeError(
+                f"{what} (pid {proc.pid}) exited rc={code} before "
+                f"announcing {prefix!r}"
+            )
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"{what} (pid {proc.pid}) announced no {prefix!r} "
+                f"within {timeout}s"
+            )
+    return result["value"]

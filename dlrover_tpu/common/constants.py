@@ -150,6 +150,11 @@ class NodeEnv:
     # File the agent writes mutable parallel config into (read by trainer).
     PARAL_CONFIG_PATH = "DLROVER_PARAL_CONFIG_PATH"
     AUTO_PARAL = "DLROVER_AUTO_PARAL"
+    # First stdout line of a master process launched with --port 0: its
+    # self-announced address (the master binds port 0 itself and reports
+    # the kernel-assigned port — same race-free idiom as the serving
+    # worker's WORKER_ANNOUNCE_PREFIX).
+    MASTER_ANNOUNCE_PREFIX = "DLROVER_MASTER_ADDR="
 
 
 class ConfigPath:

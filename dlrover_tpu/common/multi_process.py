@@ -68,6 +68,7 @@ class LocalSocketComm:
         self._path = _socket_path(name)
         self._sock: Optional[socket.socket] = None
         self._stopped = False
+        self._serve_thread: Optional[threading.Thread] = None
         if create:
             self._start_server()
 
@@ -78,10 +79,13 @@ class LocalSocketComm:
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(self._path)
         self._sock.listen(64)
-        t = threading.Thread(
+        # daemon (a wedged client conn must never hang interpreter
+        # exit), but tracked: close() joins it so teardown is ordered,
+        # not fire-and-forget (dlint DL002's contract)
+        self._serve_thread = threading.Thread(
             target=self._serve, name=f"ipc-{self._name}", daemon=True
         )
-        t.start()
+        self._serve_thread.start()
 
     def _serve(self) -> None:
         while not self._stopped:
@@ -149,6 +153,11 @@ class LocalSocketComm:
                 self._sock.close()
             except OSError:
                 pass
+        if self._serve_thread is not None:
+            # the accept loop exits on the closed socket's OSError;
+            # bounded join so a shutdown can never park here
+            self._serve_thread.join(timeout=1.0)
+            self._serve_thread = None
         if self._server and os.path.exists(self._path):
             try:
                 os.unlink(self._path)
@@ -325,7 +334,13 @@ def _tracker_call(op: str, registered_name: str) -> None:
 
         getattr(resource_tracker, op)(registered_name, "shared_memory")
     except Exception:  # pragma: no cover - tracker internals vary
-        pass
+        # never fatal (the tracker is an optimization-adjacent janitor),
+        # but never silent either: a failed unregister means the tracker
+        # may unlink a live checkpoint segment at process exit
+        logger.debug(
+            "resource_tracker.%s(%s) failed", op, registered_name,
+            exc_info=True,
+        )
 
 
 def _unregister_from_tracker(registered_name: str) -> None:

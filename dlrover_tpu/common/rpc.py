@@ -17,24 +17,40 @@ from dlrover_tpu.common.constants import GRPC
 SERVICE_NAME = "dlrover_tpu.Master"
 
 
+# dlint: disable=DL001 sanctioned test-only helper; every in-package caller migrated to bind_server_port / the worker announce idiom, and DL001 blocks new ones
 def find_free_port(port: int = 0) -> int:
     """Pick a currently-free port — bind-then-close, i.e. RACY.
 
     Between this function returning and the caller re-binding, any
     other process can grab the port (the classic TOCTOU port race).
-    Use only in tests and the legacy single-host control-plane
-    launchers that still call it (agent/launcher.py, master/main.py,
-    trainer/data/coworker_service.py — migrating them means plumbing
-    the server's self-bound port back out, tracked in ROADMAP).  New
-    servers must bind port 0 THEMSELVES and report the kernel-assigned
-    port — the serving worker does exactly that
-    (serving/remote/worker.py announces its bound address through the
-    handshake), and ``grpc.Server.add_insecure_port(":0")`` returns
-    the bound port for the same reason."""
+    TEST-ONLY: every in-package caller has been migrated — servers bind
+    port 0 THEMSELVES and report the kernel-assigned port, either via
+    :func:`bind_server_port` (gRPC) or the serving worker's announce
+    handshake (serving/remote/worker.py, master/main.py).  dlint's
+    DL001 checker (``python -m tools.dlint dlrover_tpu``) rejects any
+    new in-package call to this function."""
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("", port))
         return s.getsockname()[1]
+
+
+def bind_server_port(
+    server: "grpc.Server", port: int = 0, host: str = "[::]"
+) -> int:
+    """Race-free gRPC port binding: ``add_insecure_port`` binds inside
+    the server and returns the kernel-assigned port, so ``port=0`` never
+    round-trips through a closed socket (the ``find_free_port`` TOCTOU
+    race).  Raises instead of returning grpc's silent-failure 0 — a
+    master that "started" on an unbound port is the worst failure mode
+    (every worker retries against nothing)."""
+    bound = server.add_insecure_port(f"{host}:{int(port)}")
+    if not bound:
+        raise OSError(
+            f"could not bind gRPC server to {host}:{port} "
+            "(port in use or permission denied)"
+        )
+    return bound
 
 
 def addr_connectable(addr: str, timeout: float = 3.0) -> bool:

@@ -1,0 +1,21 @@
+"""dlint — project-native static analysis for dlrover_tpu.
+
+Canonical home of the implementation (ships in the wheel, owns the
+``dlint`` console script).  The repo-level ``tools/dlint`` package is a
+thin shim over this one so the documented
+``python -m tools.dlint dlrover_tpu`` invocation works from a checkout.
+
+Usage::
+
+    python -m dlrover_tpu.dlint dlrover_tpu   # or: dlint dlrover_tpu
+    python -m tools.dlint dlrover_tpu         # repo-checkout spelling
+    dlint --list-checkers                     # the DL001-DL006 catalog
+
+See ``dlrover_tpu/dlint/checkers.py`` for what each check enforces and
+why.
+"""
+
+from dlrover_tpu.dlint.checkers import CHECKERS, DlintConfig
+from dlrover_tpu.dlint.cli import DlintResult, main, run_dlint
+
+__all__ = ["CHECKERS", "DlintConfig", "DlintResult", "main", "run_dlint"]

@@ -1,0 +1,5 @@
+import sys
+
+from dlrover_tpu.dlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,688 @@
+"""The dlint checker catalog: six project-native invariants.
+
+Each checker encodes a rule no generic linter knows, grounded in a bug
+this codebase already hit (or fought off in review):
+
+====== ==================== =============================================
+code   name                 invariant
+====== ==================== =============================================
+DL001  toctou-port          no bind-then-close free-port allocation and
+                            no ``find_free_port()`` call in the package:
+                            servers bind port 0 THEMSELVES and report
+                            the kernel-assigned port (the
+                            serving-worker / ``add_insecure_port(":0")``
+                            idiom).  The window between close and
+                            re-bind is the classic TOCTOU race.
+DL002  thread-hygiene       every ``threading.Thread(...)`` must say
+                            ``daemon=`` explicitly; a non-daemon thread
+                            must be assigned somewhere so SOMEONE can
+                            join it — an anonymous non-daemon thread
+                            can hang interpreter shutdown forever.
+DL003  lock-blocking        no blocking call (socket recv/send/accept,
+                            ``subprocess`` wait/communicate,
+                            ``time.sleep``, untimed wait/join/get/
+                            acquire, ``select``) lexically inside a
+                            ``with <lock>:`` body — the stall class the
+                            remote-proxy review fought: one blocked
+                            holder freezes every thread that touches
+                            the lock (for the router, the whole pump).
+DL004  frame-exhaustive     every ``FrameKind`` constant in the frame
+                            protocol must be referenced — or declared
+                            in ``_UNHANDLED_FRAME_KINDS`` with a reason
+                            — in each dispatch module.  A frame kind
+                            added to the protocol but forgotten in a
+                            dispatch loop is silently dropped on the
+                            floor at runtime.
+DL005  swallowed-exception  no bare ``except:`` anywhere, and no
+                            ``except Exception: pass/continue`` without
+                            logging inside a ``while`` loop — a
+                            long-lived loop that eats exceptions
+                            silently turns a hard failure into an
+                            invisible stall.
+DL006  metric-registry      every ``serving_*`` metric-name literal
+                            must be declared (with help text) in the
+                            metric registry module; ``serving_``
+                            strings that are protocol/table names must
+                            be listed there as non-metrics.  One
+                            registry means dashboards, autoscaler and
+                            docs can never fork on a misspelled name.
+====== ==================== =============================================
+
+Checkers are pure AST passes — nothing is imported or executed, so
+dlint runs on a bare image in milliseconds and can't be confused by
+import-time side effects.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dlrover_tpu.dlint.core import ParsedModule, Violation
+
+
+@dataclasses.dataclass
+class DlintConfig:
+    """Project wiring: where the cross-file sources of truth live.
+
+    Paths are suffix-matched against scanned module paths, so the scan
+    root can be the package dir, the repo root, or a test fixture tree.
+    """
+
+    protocol_module: str = "serving/remote/protocol.py"
+    frame_kind_class: str = "FrameKind"
+    dispatch_modules: Tuple[str, ...] = (
+        "serving/remote/proxy.py",
+        "serving/remote/worker.py",
+    )
+    ignore_decl: str = "_UNHANDLED_FRAME_KINDS"
+    metric_registry_module: str = "utils/metric_registry.py"
+    metric_help_name: str = "METRIC_HELP"
+    non_metric_name: str = "NON_METRIC_SERVING_NAMES"
+    metric_literal_pattern: str = r"^serving_[a-z0-9_]+$"
+
+
+class Project:
+    """All parsed modules of one dlint run plus the shared config."""
+
+    def __init__(self, modules: List[ParsedModule], config: DlintConfig):
+        self.modules = modules
+        self.config = config
+        self._external: Dict[str, Optional[ParsedModule]] = {}
+
+    def find_module(self, suffix: str) -> Optional[ParsedModule]:
+        """The SCANNED module matching ``suffix``, if any."""
+        suffix = suffix.replace("\\", "/")
+        for mod in self.modules:
+            if mod.rel_path.endswith(suffix):
+                return mod
+        return None
+
+    def context_module(self, suffix: str) -> Optional[ParsedModule]:
+        """A module needed as cross-file CONTEXT (frame-kind vocabulary,
+        metric registry).  Prefers the scanned set; otherwise walks up
+        from each scanned file's directory looking for ``suffix`` on
+        disk, so per-file invocations (``dlint path/to/one_file.py``)
+        still see the project's sources of truth.  An external context
+        module contributes declarations only — it is never itself
+        reported on."""
+        found = self.find_module(suffix)
+        if found is not None:
+            return found
+        if suffix in self._external:
+            return self._external[suffix]
+        result = None
+        norm = suffix.replace("/", os.sep)
+        for mod in self.modules:
+            d = os.path.dirname(os.path.abspath(mod.path))
+            while True:
+                cand = os.path.join(d, norm)
+                if os.path.isfile(cand):
+                    try:
+                        with open(cand, "r", encoding="utf-8") as f:
+                            result = ParsedModule(
+                                cand, suffix, f.read()
+                            )
+                    except (OSError, SyntaxError, ValueError):
+                        result = None
+                    break
+                parent = os.path.dirname(d)
+                if parent == d:
+                    break
+                d = parent
+            if result is not None:
+                break
+        self._external[suffix] = result
+        return result
+
+
+class Checker:
+    CODE = "DL???"
+    NAME = "unnamed"
+    WHY = ""
+
+    def check_project(self, project: Project) -> Iterable[Violation]:
+        for module in project.modules:
+            yield from self.check_module(module, project)
+
+    def check_module(
+        self, module: ParsedModule, project: Project
+    ) -> Iterable[Violation]:
+        return ()
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """``self._send_lock`` -> ``_send_lock``; ``find_free_port`` -> same."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    return _terminal_name(call.func)
+
+
+# =========================================================== DL001
+class ToctouPortChecker(Checker):
+    CODE = "DL001"
+    NAME = "toctou-port"
+    WHY = (
+        "bind-then-close port picking races every other process on the "
+        "host between close and re-bind; servers must bind port 0 "
+        "themselves and report the kernel-assigned port"
+    )
+
+    def check_module(self, module, project):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and (
+                _call_name(node) == "find_free_port"
+            ):
+                yield module.violation(
+                    self.CODE,
+                    node,
+                    "find_free_port() pre-picks a port another process "
+                    "can steal before the re-bind; bind port 0 yourself "
+                    "and report the bound port (worker announce / "
+                    "bind_server_port)",
+                )
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                yield from self._check_bind_then_close(module, node)
+
+    def _check_bind_then_close(self, module, func):
+        binds = gets = listens = escapes = False
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name == "bind":
+                    binds = True
+                elif name == "getsockname":
+                    gets = True
+                elif name in ("listen", "accept"):
+                    listens = True
+            # a socket stored on self/module outlives the function, so
+            # the caller can keep it bound (the sanctioned idiom)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        escapes = True
+        if binds and gets and not listens and not escapes:
+            yield module.violation(
+                self.CODE,
+                func,
+                f"{func.name}() binds, reads the port, and drops the "
+                "socket without listening — the bind-then-close TOCTOU "
+                "pattern",
+            )
+
+
+# =========================================================== DL002
+class ThreadHygieneChecker(Checker):
+    CODE = "DL002"
+    NAME = "thread-hygiene"
+    WHY = (
+        "a thread with unstated daemon-ness (or a non-daemon thread "
+        "nobody holds a reference to) can hang interpreter shutdown"
+    )
+
+    def check_module(self, module, project):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) != "Thread":
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = kw.value
+            if daemon is None:
+                yield module.violation(
+                    self.CODE,
+                    node,
+                    "threading.Thread(...) without an explicit daemon= "
+                    "— state the thread's shutdown contract (daemon=True "
+                    "for fire-and-forget, daemon=False plus a tracked "
+                    "join for work that must finish)",
+                )
+                continue
+            is_false = (
+                isinstance(daemon, ast.Constant) and daemon.value is False
+            )
+            if is_false and not self._is_held(module, node):
+                yield module.violation(
+                    self.CODE,
+                    node,
+                    "non-daemon Thread is never assigned or handed to "
+                    "anything, so nothing can ever join it — "
+                    "interpreter shutdown will block on it forever",
+                )
+
+    @staticmethod
+    def _is_held(module, call):
+        """True when the Thread value escapes somewhere a join can reach
+        it: an assignment, or as an ARGUMENT to another call (e.g.
+        ``self._threads.append(Thread(...))``, an executor submit).
+        ``Thread(...).start()`` is NOT held — the outer call there is a
+        method on the thread itself and its result is discarded."""
+        node = call
+        for anc in module.ancestors(call):
+            if isinstance(
+                anc,
+                (ast.Assign, ast.AnnAssign, ast.NamedExpr, ast.Return),
+            ):
+                return True  # assigned, or a factory's caller holds it
+            if isinstance(anc, ast.Call) and (
+                node in anc.args
+                or node in [kw.value for kw in anc.keywords]
+            ):
+                return True  # passed into a holder
+            if isinstance(anc, (ast.Expr, ast.stmt, ast.Attribute)):
+                return False
+            node = anc
+        return False
+
+
+# =========================================================== DL003
+class LockBlockingChecker(Checker):
+    CODE = "DL003"
+    NAME = "lock-blocking"
+    WHY = (
+        "a blocking call under a held lock stalls every other thread "
+        "that touches the lock (the remote-proxy stall class)"
+    )
+
+    # attribute calls that block outright
+    BLOCKING_ATTRS = frozenset(
+        {
+            "recv",
+            "recvfrom",
+            "recv_into",
+            "accept",
+            "sendall",
+            "communicate",
+            "select",
+        }
+    )
+    # attribute calls that block unless given a timeout / non-blocking
+    # argument: .wait() / .join() / .get() / .acquire() with no args
+    UNTIMED_ATTRS = frozenset({"wait", "join", "get", "acquire"})
+
+    def check_module(self, module, project):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(
+                self._lock_like(item.context_expr) for item in node.items
+            ):
+                continue
+            for stmt in node.body:
+                yield from self._scan(module, stmt)
+
+    @staticmethod
+    def _lock_like(expr: ast.AST) -> bool:
+        # mutexes and semaphores hold waiters exactly like locks do;
+        # condition variables are deliberately excluded (cv.wait under
+        # the paired lock is the correct idiom)
+        name = _terminal_name(expr).lower()
+        if "unlock" in name:
+            return False
+        return any(k in name for k in ("lock", "mutex", "semaphore"))
+
+    def _scan(self, module, node):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            return  # a nested def body does not run under the lock
+        if isinstance(node, ast.With) and any(
+            self._lock_like(item.context_expr) for item in node.items
+        ):
+            # the outer walk over the module visits this With itself;
+            # descending here too would report its body twice
+            return
+        if isinstance(node, ast.Call):
+            v = self._classify(module, node)
+            if v is not None:
+                yield v
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan(module, child)
+
+    def _classify(self, module, call: ast.Call) -> Optional[Violation]:
+        name = _call_name(call)
+        if name == "sleep":
+            return module.violation(
+                self.CODE, call, "time.sleep while holding a lock"
+            )
+        if isinstance(call.func, ast.Attribute):
+            if name in self.BLOCKING_ATTRS:
+                return module.violation(
+                    self.CODE,
+                    call,
+                    f".{name}(...) blocks while holding a lock — move "
+                    "the I/O outside the critical section or bound it "
+                    "with a timeout",
+                )
+            if name in self.UNTIMED_ATTRS and self._untimed(call):
+                return module.violation(
+                    self.CODE,
+                    call,
+                    f"untimed .{name}() while holding a lock — pass a "
+                    "timeout (or make it non-blocking) so a wedged peer "
+                    "can't freeze every lock user",
+                )
+        return None
+
+    @staticmethod
+    def _untimed(call: ast.Call) -> bool:
+        if call.args:
+            return False  # a positional arg is a timeout/iterable/flag
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return False
+            if kw.arg in ("block", "blocking") and (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+            ):
+                return False
+        return True
+
+
+# =========================================================== DL004
+class FrameExhaustiveChecker(Checker):
+    CODE = "DL004"
+    NAME = "frame-exhaustive"
+    WHY = (
+        "a FrameKind added to the protocol but unhandled in a dispatch "
+        "module is silently dropped on the floor at runtime"
+    )
+
+    def check_project(self, project):
+        cfg = project.config
+        # dispatch modules are only judged when scanned; the protocol
+        # is pure context and may be loaded from disk, so linting
+        # proxy.py alone still enforces exhaustiveness
+        if not any(
+            project.find_module(s) for s in cfg.dispatch_modules
+        ):
+            return
+        protocol = project.context_module(cfg.protocol_module)
+        if protocol is None:
+            return  # nothing to enforce in this tree
+        kinds = self._frame_kinds(protocol, cfg.frame_kind_class)
+        if not kinds:
+            if project.find_module(cfg.protocol_module) is protocol:
+                yield protocol.violation(
+                    self.CODE,
+                    1,
+                    f"protocol module defines no {cfg.frame_kind_class} "
+                    "string constants — the frame vocabulary moved "
+                    "without updating dlint's config",
+                )
+            return
+        value_to_name = {v: k for k, v in kinds.items()}
+        for suffix in cfg.dispatch_modules:
+            module = project.find_module(suffix)
+            if module is None:
+                continue
+            yield from self._check_dispatch(
+                module, cfg, set(kinds), value_to_name
+            )
+
+    @staticmethod
+    def _frame_kinds(module, class_name) -> Dict[str, str]:
+        """``{constant_name: string_value}`` from the FrameKind class."""
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef) and node.name == class_name:
+                out = {}
+                for stmt in node.body:
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)
+                    ):
+                        out[stmt.targets[0].id] = stmt.value.value
+                return out
+        return {}
+
+    def _check_dispatch(self, module, cfg, kinds, value_to_name):
+        ignored, decl_line, decl_nodes = self._ignored(
+            module, cfg, kinds, value_to_name
+        )
+        referenced: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if node in decl_nodes:
+                # a FrameKind.X inside the ignore declaration itself is
+                # the declaration, not a handling reference
+                continue
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == cfg.frame_kind_class
+                and node.attr in kinds
+            ):
+                referenced.add(node.attr)
+        report_line = decl_line or 1
+        for kind in sorted(kinds - referenced - ignored):
+            yield module.violation(
+                self.CODE,
+                report_line,
+                f"frame kind {kind} is neither handled nor declared in "
+                f"{cfg.ignore_decl} — a {kind} frame reaching this "
+                "module is dropped silently",
+            )
+        for kind in sorted(ignored & referenced):
+            yield module.violation(
+                self.CODE,
+                report_line,
+                f"frame kind {kind} is declared unhandled in "
+                f"{cfg.ignore_decl} but IS referenced — stale "
+                "declaration, delete it",
+            )
+        for kind in sorted(ignored - kinds):
+            yield module.violation(
+                self.CODE,
+                report_line,
+                f"{cfg.ignore_decl} names {kind}, which is not a "
+                "protocol frame kind",
+            )
+
+    def _ignored(self, module, cfg, kinds, value_to_name):
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == cfg.ignore_decl
+                and isinstance(node.value, (ast.Tuple, ast.List, ast.Set))
+            ):
+                names: Set[str] = set()
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        names.add(value_to_name.get(elt.value, elt.value))
+                    elif isinstance(elt, ast.Attribute):
+                        names.add(elt.attr)
+                return names, node.lineno, set(ast.walk(node))
+        return set(), None, set()
+
+
+# =========================================================== DL005
+class SwallowedExceptionChecker(Checker):
+    CODE = "DL005"
+    NAME = "swallowed-exception"
+    WHY = (
+        "a long-lived loop that eats exceptions silently turns a hard "
+        "failure into an invisible stall"
+    )
+
+    def check_module(self, module, project):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield module.violation(
+                    self.CODE,
+                    node,
+                    "bare except: catches SystemExit/KeyboardInterrupt "
+                    "too — name the exception type",
+                )
+                continue
+            if not self._broad(node.type):
+                continue
+            if not self._silent_body(node.body):
+                continue
+            if any(
+                isinstance(anc, ast.While)
+                for anc in module.ancestors(node)
+            ):
+                yield module.violation(
+                    self.CODE,
+                    node,
+                    "except Exception with a silent pass/continue inside "
+                    "a long-lived loop — log it (even at debug) or catch "
+                    "the specific expected exception",
+                )
+
+    @staticmethod
+    def _broad(type_node: ast.AST) -> bool:
+        return _terminal_name(type_node) in ("Exception", "BaseException")
+
+    @staticmethod
+    def _silent_body(body: List[ast.stmt]) -> bool:
+        real = [
+            s
+            for s in body
+            if not (
+                isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Constant)
+            )
+        ]
+        return bool(real) and all(
+            isinstance(s, (ast.Pass, ast.Continue)) for s in real
+        )
+
+
+# =========================================================== DL006
+class MetricRegistryChecker(Checker):
+    CODE = "DL006"
+    NAME = "metric-registry"
+    WHY = (
+        "a metric-name literal minted outside the registry forks the "
+        "serving_* namespace: dashboards and the autoscaler silently "
+        "read different series"
+    )
+
+    def check_project(self, project):
+        cfg = project.config
+        pattern = re.compile(cfg.metric_literal_pattern)
+        # context_module: a per-file scan still resolves the registry
+        # from disk; help-text completeness is only judged when the
+        # registry itself is part of the scanned set
+        registry = project.context_module(cfg.metric_registry_module)
+        declared: Set[str] = set()
+        non_metric: Set[str] = set()
+        if registry is not None:
+            declared, non_metric = yield from self._check_registry(
+                registry,
+                cfg,
+                report=project.find_module(cfg.metric_registry_module)
+                is registry,
+            )
+        for module in project.modules:
+            if module is registry:
+                continue
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and pattern.match(node.value)
+                ):
+                    continue
+                if module.is_docstring(node):
+                    continue
+                if node.value in declared or node.value in non_metric:
+                    continue
+                where = (
+                    "declare it in the metric registry "
+                    f"({cfg.metric_registry_module}) with help text, or "
+                    f"list it in {cfg.non_metric_name} if it is not a "
+                    "metric"
+                    if registry is not None
+                    else "no metric registry module found in this tree "
+                    f"({cfg.metric_registry_module})"
+                )
+                yield module.violation(
+                    self.CODE,
+                    node,
+                    f"undeclared metric-name literal {node.value!r} — "
+                    + where,
+                )
+
+    def _check_registry(self, registry, cfg, report=True):
+        """Generator-with-return: yields help-text violations (only
+        when ``report`` — i.e. the registry is in the scanned set),
+        returns ``(declared_names, non_metric_names)``."""
+        declared: Set[str] = set()
+        non_metric: Set[str] = set()
+        for node in ast.walk(registry.tree):
+            # both `X = {...}` and the annotated `X: Dict[...] = {...}`
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target = node.target
+            else:
+                continue
+            if not isinstance(target, ast.Name):
+                continue
+            if target.id == cfg.metric_help_name and isinstance(
+                node.value, ast.Dict
+            ):
+                for key, val in zip(node.value.keys, node.value.values):
+                    if not (
+                        isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                    ):
+                        continue
+                    declared.add(key.value)
+                    if report and not (
+                        isinstance(val, ast.Constant)
+                        and isinstance(val.value, str)
+                        and val.value.strip()
+                    ):
+                        yield registry.violation(
+                            self.CODE,
+                            key,
+                            f"metric {key.value!r} has no help text — "
+                            "the registry exists so every exported name "
+                            "is documented",
+                        )
+            elif target.id == cfg.non_metric_name:
+                value = node.value
+                if isinstance(value, ast.Call) and value.args:
+                    value = value.args[0]  # frozenset({...})
+                if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+                    for elt in value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            non_metric.add(elt.value)
+        return declared, non_metric
+
+
+CHECKERS: Tuple[Checker, ...] = (
+    ToctouPortChecker(),
+    ThreadHygieneChecker(),
+    LockBlockingChecker(),
+    FrameExhaustiveChecker(),
+    SwallowedExceptionChecker(),
+    MetricRegistryChecker(),
+)

@@ -1,0 +1,167 @@
+"""dlint command line: ``python -m tools.dlint dlrover_tpu``.
+
+Exit codes: 0 = clean (everything suppressed or baselined), 1 = new
+violations, 2 = usage / parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+from typing import List, Optional
+
+from dlrover_tpu.dlint.checkers import CHECKERS, DlintConfig, Project
+from dlrover_tpu.dlint.core import (
+    ParsedModule,
+    Violation,
+    apply_baseline,
+    iter_python_files,
+    load_baseline,
+    write_baseline,
+)
+
+# the checked-in grandfather file lives in the repo checkout, not the
+# installed package; resolved relative to the cwd at invocation time
+DEFAULT_BASELINE = os.path.join("tools", "dlint", "baseline.json")
+
+
+@dataclasses.dataclass
+class DlintResult:
+    new: List[Violation]
+    suppressed: List[Violation]
+    baselined: List[Violation]
+    stale_baseline: List[dict]
+    parse_errors: List[str]
+
+    @property
+    def exit_code(self) -> int:
+        if self.parse_errors:
+            return 2
+        return 1 if self.new else 0
+
+
+def run_dlint(
+    paths: List[str],
+    config: Optional[DlintConfig] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+) -> DlintResult:
+    """Library entry point (the test suite drives this directly)."""
+    config = config or DlintConfig()
+    modules: List[ParsedModule] = []
+    parse_errors: List[str] = []
+    for abs_path, rel_path in iter_python_files(paths):
+        try:
+            with open(abs_path, "r", encoding="utf-8") as f:
+                source = f.read()
+            modules.append(ParsedModule(abs_path, rel_path, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            parse_errors.append(f"{rel_path}: {e}")
+    project = Project(modules, config)
+
+    raw: List[Violation] = []
+    for module in modules:
+        raw.extend(module.hygiene_violations)
+    for checker in CHECKERS:
+        raw.extend(checker.check_project(project))
+
+    by_path = {m.rel_path: m for m in modules}
+    active: List[Violation] = []
+    suppressed: List[Violation] = []
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.code)):
+        module = by_path.get(v.path)
+        if module is not None and module.suppressed(v.code, v.line):
+            suppressed.append(v)
+        else:
+            active.append(v)
+
+    baseline = (
+        load_baseline(baseline_path)
+        if (use_baseline and baseline_path)
+        else []
+    )
+    new, baselined, stale = apply_baseline(active, baseline)
+    return DlintResult(new, suppressed, baselined, stale, parse_errors)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dlint",
+        description=(
+            "Project-native static analysis for dlrover_tpu: enforces "
+            "the fabric's concurrency and protocol invariants "
+            "(DL001-DL006). See tools/dlint/checkers.py for the catalog."
+        ),
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to scan (default: dlrover_tpu)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file of grandfathered violations "
+                         f"(default: {DEFAULT_BASELINE} when it exists "
+                         "under the cwd)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined violations as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline file with every current "
+                         "violation, then exit 0")
+    ap.add_argument("--list-checkers", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for checker in CHECKERS:
+            print(f"{checker.CODE}  {checker.NAME:20s} {checker.WHY}")
+        return 0
+
+    paths = args.paths or ["dlrover_tpu"]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"dlint: path not found: {path}", file=sys.stderr)
+            return 2
+
+    baseline = args.baseline
+    if baseline is None and not args.write_baseline:
+        baseline = (
+            DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
+        )
+    elif baseline is None:
+        baseline = DEFAULT_BASELINE
+
+    result = run_dlint(
+        paths,
+        baseline_path=baseline,
+        use_baseline=not (args.no_baseline or args.write_baseline),
+    )
+    for err in result.parse_errors:
+        print(f"dlint: parse error: {err}", file=sys.stderr)
+    if result.parse_errors:
+        return 2
+
+    if args.write_baseline:
+        write_baseline(baseline, result.new)
+        print(
+            f"dlint: wrote {len(result.new)} violation(s) to "
+            f"{baseline}"
+        )
+        return 0
+
+    for v in result.new:
+        print(v.render())
+    for entry in result.stale_baseline:
+        print(
+            "dlint: stale baseline entry (fixed? delete it): "
+            f"{entry.get('code')} {entry.get('path')} "
+            f"{entry.get('line_text', '')!r}",
+            file=sys.stderr,
+        )
+    print(
+        f"dlint: {len(result.new)} new violation(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.suppressed)} suppressed"
+    )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

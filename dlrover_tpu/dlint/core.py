@@ -1,0 +1,242 @@
+"""dlint core: parsed modules, suppressions, baseline bookkeeping.
+
+dlint is an AST pass, not a style linter: every checker encodes an
+invariant this codebase has already been bitten by (or is one refactor
+away from being bitten by) — see ``tools/dlint/checkers.py`` for the
+catalog.  This module owns the mechanics shared by all checkers:
+
+- :class:`ParsedModule` — one source file, its AST, a child->parent
+  map (checkers ask "is this call lexically under a ``with lock:``?"),
+  and the per-line suppression table;
+- suppressions — ``# dlint: disable=DL003 <reason>`` on the violating
+  line.  The reason is MANDATORY: a suppression without one is itself
+  reported (``DL000``), so "disabled because it was annoying" can't
+  enter the tree silently;
+- the baseline — grandfathered violations checked into
+  ``tools/dlint/baseline.json``.  Entries match on
+  ``(code, path, stripped source line)`` rather than line numbers, so
+  unrelated edits above a baselined site don't invalidate it; a stale
+  entry (no longer matching anything) is reported as a warning so the
+  file shrinks over time instead of fossilizing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*dlint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)\s*(.*)$"
+)
+
+#: code reserved for problems with dlint's own control comments
+SUPPRESSION_HYGIENE_CODE = "DL000"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str
+    path: str  # as scanned (relative to the invocation cwd)
+    line: int
+    message: str
+    line_text: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code}: {self.message}"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.code, _norm_path(self.path), self.line_text)
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    line: int
+    codes: Tuple[str, ...]
+    reason: str
+
+
+def _norm_path(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+class ParsedModule:
+    """One python file: source, AST, parent links, suppressions."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = _norm_path(rel_path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # a line can be guarded by several suppressions (a standalone
+        # comment above it plus a trailing one), so keep a list per line
+        self.suppressions: Dict[int, List[Suppression]] = {}
+        self.hygiene_violations: List[Violation] = []
+        for lineno, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            codes = tuple(
+                c.strip() for c in m.group(1).split(",") if c.strip()
+            )
+            reason = m.group(2).strip()
+            # a trailing comment guards its own line; a standalone
+            # comment line guards the line below it
+            target = (
+                lineno + 1 if text.strip().startswith("#") else lineno
+            )
+            self.suppressions.setdefault(target, []).append(
+                Suppression(target, codes, reason)
+            )
+            if not reason:
+                self.hygiene_violations.append(
+                    Violation(
+                        SUPPRESSION_HYGIENE_CODE,
+                        self.rel_path,
+                        lineno,
+                        "suppression without a reason — every "
+                        "`# dlint: disable=` must say WHY the invariant "
+                        "does not apply here",
+                        self.line_text(lineno),
+                    )
+                )
+
+    # ----------------------------------------------------------- helpers
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def is_docstring(self, node: ast.Constant) -> bool:
+        """True when ``node`` is the docstring of its enclosing scope
+        (or any bare string expression statement, which is the same
+        thing in practice)."""
+        parent = self.parents.get(node)
+        return isinstance(parent, ast.Expr)
+
+    def suppressed(self, code: str, lineno: int) -> bool:
+        return any(
+            code in sup.codes and sup.reason
+            for sup in self.suppressions.get(lineno, ())
+        )
+
+    def violation(self, code: str, node_or_line, message: str) -> Violation:
+        lineno = (
+            node_or_line
+            if isinstance(node_or_line, int)
+            else getattr(node_or_line, "lineno", 1)
+        )
+        return Violation(
+            code, self.rel_path, lineno, message, self.line_text(lineno)
+        )
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[Tuple[str, str]]:
+    """Yield ``(abs_path, rel_path)`` for every ``.py`` under ``paths``
+    (files are accepted directly), sorted for stable output.
+
+    ``rel_path`` is anchored to the SCAN ROOT (``<root-basename>/...``
+    for directory roots, the path as given for file roots) — never to
+    the process cwd.  Baseline entries and suffix-matched config paths
+    key on it, so ``dlint /abs/path/dlrover_tpu`` from any directory
+    produces the same paths as ``dlint dlrover_tpu`` from the repo
+    root."""
+    seen = set()
+    for root in paths:
+        if os.path.isfile(root):
+            entries = [(root, _norm_path(os.path.normpath(root)))]
+        else:
+            base = os.path.basename(os.path.normpath(root))
+            entries = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        path = os.path.join(dirpath, name)
+                        rel = os.path.join(
+                            base, os.path.relpath(path, root)
+                        )
+                        entries.append((path, _norm_path(rel)))
+        for path, rel in entries:
+            real = os.path.realpath(path)
+            if real in seen:
+                continue
+            seen.add(real)
+            yield path, rel
+
+
+# ------------------------------------------------------------- baseline
+def load_baseline(path: str) -> List[dict]:
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path} must be a JSON list")
+    return data
+
+
+def write_baseline(path: str, violations: Iterable[Violation]) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    entries = [
+        {
+            "code": v.code,
+            "path": _norm_path(v.path),
+            "line_text": v.line_text,
+            "message": v.message,
+        }
+        for v in sorted(
+            violations, key=lambda v: (v.path, v.line, v.code)
+        )
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    violations: List[Violation], baseline: List[dict]
+) -> Tuple[List[Violation], List[Violation], List[dict]]:
+    """Split ``violations`` into (new, baselined); also return baseline
+    entries that matched nothing (stale — the grandfathered site was
+    fixed and the entry should be deleted).  Matching is by
+    ``(code, path, stripped line text)`` and consumes entries, so two
+    identical violations need two identical entries."""
+    budget: Dict[Tuple[str, str, str], List[dict]] = {}
+    for entry in baseline:
+        key = (
+            str(entry.get("code", "")),
+            _norm_path(str(entry.get("path", ""))),
+            str(entry.get("line_text", "")),
+        )
+        budget.setdefault(key, []).append(entry)
+    new: List[Violation] = []
+    matched: List[Violation] = []
+    for v in violations:
+        entries = budget.get(v.baseline_key())
+        if entries:
+            entries.pop()
+            matched.append(v)
+        else:
+            new.append(v)
+    stale = [e for entries in budget.values() for e in entries]
+    return new, matched, stale

@@ -19,7 +19,7 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import NodeResource
-from dlrover_tpu.common.rpc import build_server
+from dlrover_tpu.common.rpc import bind_server_port, build_server
 from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
 from dlrover_tpu.master.elastic_training.kv_store_service import (
     KVStoreService,
@@ -231,9 +231,15 @@ class DistributedJobMaster:
                 target=self._tuning_loop, daemon=True, name="auto-tuning"
             )
             self._tuning_thread.start()
-        self._server.add_insecure_port(f"[::]:{self._port}")
+        self._port = bind_server_port(self._server, self._port)
         self._server.start()
         logger.info("Distributed master serving on port %s", self._port)
+
+    @property
+    def port(self) -> int:
+        """The actually-bound port — authoritative only after
+        :meth:`prepare` (``port=0`` = kernel-assigned, race-free)."""
+        return self._port
 
     def run(self, poll_interval: float = 5.0) -> int:
         """Main loop (reference: dist_master.py:211-269): exit on job

@@ -12,7 +12,7 @@ from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.rpc import build_server
+from dlrover_tpu.common.rpc import bind_server_port, build_server
 from dlrover_tpu.master.elastic_training.elastic_ps import ElasticPsService
 from dlrover_tpu.master.elastic_training.kv_store_service import (
     KVStoreService,
@@ -56,6 +56,13 @@ class LocalJobMaster:
         self._server = build_server(self.servicer.get, self.servicer.report)
         self._stopped = threading.Event()
 
+    @property
+    def port(self) -> int:
+        """The actually-bound port — authoritative only after
+        :meth:`prepare` (``port=0`` in the constructor means "let the
+        kernel pick"; the race-free idiom, see rpc.bind_server_port)."""
+        return self._port
+
     def prepare(self) -> None:
         for mgr in self.rdzv_managers.values():
             mgr.update_rdzv_params(
@@ -66,7 +73,7 @@ class LocalJobMaster:
             )
         self.task_manager.start()
         self.job_metric_collector.mark_job_start()
-        self._server.add_insecure_port(f"[::]:{self._port}")
+        self._port = bind_server_port(self._server, self._port)
         self._server.start()
         logger.info("Local master serving on port %s", self._port)
 

@@ -1,14 +1,22 @@
-"""Master entry point (reference: dlrover/python/master/main.py:43-63)."""
+"""Master entry point (reference: dlrover/python/master/main.py:43-63).
+
+Port contract: ``--port 0`` (the default) makes the master bind a
+kernel-assigned port ITSELF during ``prepare()`` and announce it as the
+first stdout line (``DLROVER_MASTER_ADDR=<host>:<port>``) — the same
+race-free idiom as the serving worker.  The parent (agent launcher)
+reads the announce instead of pre-picking a port with the racy
+bind-then-close ``find_free_port``.
+"""
 
 import sys
 
+from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.rpc import find_free_port
 from dlrover_tpu.master.args import parse_master_args, parse_node_groups
 
 
 def run(args) -> int:
-    port = args.port or find_free_port()
+    port = args.port
     node_groups = parse_node_groups(args.node_groups)
     if args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster
@@ -49,6 +57,15 @@ def run(args) -> int:
             default_k8s_api,
         )
 
+        if not port:
+            # workers dial the "{job}-master" Service, whose targetPort
+            # is declared in the pod spec — a kernel-assigned port can't
+            # be wired into it, so on k8s the port must be explicit
+            # (each pod has its own netns; a fixed port can't race).
+            raise SystemExit(
+                "--port is required on k8s: the master Service targets "
+                "a declared containerPort, not an ephemeral one"
+            )
         api = default_k8s_api()
         # workers reach the master through the "{job}-master" Service the
         # operator creates; the port must be the one actually bound
@@ -88,6 +105,12 @@ def run(args) -> int:
             "dlrover_tpu.client.ray_job submitter from outside a cluster)"
         )
     master.prepare()
+    # prepare() bound the (possibly kernel-assigned) port; announce it
+    # so a parent that launched us with --port 0 learns where we live
+    port = master.port
+    print(
+        f"{NodeEnv.MASTER_ANNOUNCE_PREFIX}127.0.0.1:{port}", flush=True
+    )
     logger.info(
         "Master started: platform=%s port=%s", args.platform, port
     )

@@ -119,6 +119,7 @@ class FrameConnection:
         with self._send_lock:
             if self._closed:
                 raise ConnectionError("frame connection closed")
+            # dlint: disable=DL003 bounded by send_timeout (socket timeout set in __init__); a wedged peer raises TimeoutError into the failover path instead of freezing lock users
             self._sock.sendall(_LEN.pack(len(body)) + body)
 
     # ------------------------------------------------------------ recv

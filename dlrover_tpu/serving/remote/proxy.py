@@ -38,6 +38,13 @@ from dlrover_tpu.serving.remote.protocol import (
     connect,
 )
 
+# Exhaustiveness contract (dlint DL004): every FrameKind must be either
+# referenced in this module or declared here with its reason.  HEARTBEAT
+# is router->worker ping-on-demand; this proxy never pings — the
+# worker's own STATS cadence is the liveness signal, and a silent worker
+# trips frame_timeout in step() instead.
+_UNHANDLED_FRAME_KINDS = (FrameKind.HEARTBEAT,)
+
 
 class RemoteReplicaHandle:
     """Engine-protocol proxy over one worker's frame connection."""

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common.announce import read_announced_value
 from dlrover_tpu.common.constants import ServingFabric
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
@@ -176,44 +177,17 @@ class WorkerSupervisor:
         return record
 
     def _read_announce(self, proc: subprocess.Popen) -> str:
-        """First ``DLROVER_WORKER_ADDR=`` stdout line, with a timeout
-        enforced off-thread (a wedged child must not wedge the spawn).
-        The scanner thread then keeps DRAINING stdout for the process's
-        lifetime — stdout is a pipe, and a worker that later prints
-        >64KB (library notices, stray prints) into an unread pipe would
-        block mid-write and read as a dead replica."""
-        result: Dict[str, str] = {}
-        announced = threading.Event()
-
-        def scan_then_drain():
-            for line in proc.stdout:  # type: ignore[union-attr]
-                if not announced.is_set():
-                    stripped = line.strip()
-                    if stripped.startswith(
-                            ServingFabric.WORKER_ANNOUNCE_PREFIX):
-                        result["addr"] = stripped[
-                            len(ServingFabric.WORKER_ANNOUNCE_PREFIX):]
-                        announced.set()
-                # keep consuming (and discarding) until EOF
-
-        threading.Thread(target=scan_then_drain, daemon=True).start()
-        deadline = time.monotonic() + self.spawn_timeout
-        while not announced.wait(0.1):
-            code = proc.poll()
-            # fail FAST on an already-dead child (import error, bad
-            # args) — sleeping out the full spawn_timeout here would
-            # stall every respawn/provisioner retry 30s per attempt.
-            # Brief grace first: the announce line may still sit in the
-            # pipe buffer of a process that printed then exited.
-            if code is not None and not announced.wait(0.5):
-                raise RuntimeError(
-                    f"worker (pid {proc.pid}) exited rc={code} before "
-                    "announcing an address")
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"worker (pid {proc.pid}) announced no address "
-                    f"within {self.spawn_timeout}s")
-        return result["addr"]
+        """First ``DLROVER_WORKER_ADDR=`` stdout line — the shared
+        announce handshake (common/announce.py): off-thread timeout,
+        fail-fast on an already-dead child, stdout drained for the
+        process's lifetime so a chatty worker can't fill the pipe and
+        read as a dead replica."""
+        return read_announced_value(
+            proc,
+            ServingFabric.WORKER_ANNOUNCE_PREFIX,
+            timeout=self.spawn_timeout,
+            what="worker",
+        )
 
     # ------------------------------------------------- autoscale seam
     def engine_factory(self, node) -> RemoteReplicaHandle:

@@ -8,6 +8,11 @@ what Grafana plots is exactly what the Brain decides replica counts
 from (goodput-style: one source of truth for humans and the control
 loop).
 
+Every name emitted here is declared with help text in
+:mod:`dlrover_tpu.utils.metric_registry` — the single registry dlint's
+DL006 check enforces (``python -m tools.dlint dlrover_tpu``), so the
+``serving_*`` namespace cannot silently fork.
+
 Gauge/counter names (stable API, documented in README + PERF.md):
 
 - ``serving_queue_depth``        — requests waiting in the gateway

@@ -34,7 +34,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.rpc import RpcStub, build_server, find_free_port
+from dlrover_tpu.common.rpc import RpcStub, bind_server_port, build_server
 
 _KV_PREFIX = "coworker/addr/"
 _END = b"__END_OF_DATA__"
@@ -64,9 +64,10 @@ class CoworkerDataService:
         self._failed = threading.Event()
         self._stop = threading.Event()
         self._get_timeout_s = get_timeout_s
-        self.port = find_free_port(port)
+        # bind inside the server (port 0 = kernel-assigned): race-free,
+        # unlike the old find_free_port bind-then-close pre-pick
         self._server = build_server(self._handle_get, self._handle_report)
-        self._server.add_insecure_port(f"[::]:{self.port}")
+        self.port = bind_server_port(self._server, port)
         self._producer = threading.Thread(
             target=self._produce, name="coworker-producer", daemon=True
         )

@@ -1,0 +1,66 @@
+"""Single source of truth for exported Prometheus metric names.
+
+Every ``serving_*`` metric-name literal in the package must be declared
+here with help text — dlint's DL006 (``tools/dlint``) enforces it, so a
+dashboard, the autoscaler, and the docs can never fork on a misspelled
+or half-renamed series.  The exporter renders these as ``# HELP`` lines on
+``/metrics``, which makes the registry visible to every scraper, not
+just to readers of this file.
+
+Adding a metric: add the name + help here, then emit it from your
+``metrics()`` source.  Using a ``serving_``-prefixed string that is NOT
+a metric (an RPC kind, a table name): add it to
+:data:`NON_METRIC_SERVING_NAMES` — the registry arbitrates the whole
+``serving_`` string namespace.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: Prometheus name -> help text (rendered as ``# HELP`` on /metrics).
+METRIC_HELP: Dict[str, str] = {
+    # -- serving router gauges (RouterMetrics.metrics) -----------------
+    "serving_queue_depth": "requests waiting in the gateway",
+    "serving_inflight": "requests currently placed on replicas",
+    "serving_replica_up": "schedulable serving replicas",
+    "serving_replica_draining": "replicas finishing in-flight work",
+    "serving_ttft_seconds": (
+        "time-to-first-token, sliding-window mean (streaming engines: "
+        "submission to first TOKEN frame received)"
+    ),
+    "serving_ttft_seconds_p50": "TTFT reservoir p50 (lifetime)",
+    "serving_ttft_seconds_p99": "TTFT reservoir p99 (lifetime)",
+    "serving_tokens_per_second": (
+        "generated-token throughput over the sliding window"
+    ),
+    "serving_generated_tokens_total": "tokens generated since start",
+    # -- serving request lifecycle counters ----------------------------
+    "serving_requests_submitted_total": "requests admitted by the gateway",
+    "serving_requests_completed_total": "requests finished successfully",
+    "serving_requests_rejected_total": (
+        "requests refused at admission or by an engine (poison request)"
+    ),
+    "serving_requests_timed_out_total": "requests past their deadline",
+    "serving_requests_requeued_total": (
+        "failover replays — nonzero says a replica died; "
+        "completed+timed_out still balancing says nothing was lost"
+    ),
+    "serving_requests_poisoned_total": (
+        "requests failed for exceeding the failover-replay cap — "
+        "nonzero says some request was crashing replicas"
+    ),
+}
+
+#: ``serving_``-prefixed strings that are deliberately NOT metric names
+#: (RPC message kinds, datastore table names).  Kept here so DL006 can
+#: tell "known protocol vocabulary" from "accidentally minted metric".
+NON_METRIC_SERVING_NAMES = frozenset({
+    "serving_plan",      # BrainService RPC kind (brain/service.py)
+    "serving_samples",   # datastore table (brain/datastore.py DDL)
+    "serving_history",   # datastore query name
+})
+
+
+def metric_help(name: str) -> Optional[str]:
+    return METRIC_HELP.get(name)

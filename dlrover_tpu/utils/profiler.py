@@ -158,14 +158,23 @@ def trace(log_dir: str, host_tracer_level: int = 2):
         jax.profiler.stop_trace()
 
 
-def render_prometheus(metrics: Dict[str, float], labels: Optional[Dict[str, str]] = None) -> str:
-    """Prometheus text exposition format."""
+def render_prometheus(
+    metrics: Dict[str, float],
+    labels: Optional[Dict[str, str]] = None,
+    help_map: Optional[Dict[str, str]] = None,
+) -> str:
+    """Prometheus text exposition format.  Names present in ``help_map``
+    (usually :data:`~dlrover_tpu.utils.metric_registry.METRIC_HELP`) get
+    a ``# HELP`` comment so the registry's documentation reaches every
+    scraper."""
     label_str = ""
     if labels:
         inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
         label_str = "{" + inner + "}"
     lines = []
     for name in sorted(metrics):
+        if help_map and name in help_map:
+            lines.append(f"# HELP {name} {help_map[name]}")
         lines.append(f"{name}{label_str} {metrics[name]}")
     return "\n".join(lines) + "\n"
 
@@ -186,13 +195,19 @@ class MetricsExporter:
                     body = b"ok"
                     ctype = "text/plain"
                 elif self.path.startswith("/metrics"):
+                    from dlrover_tpu.utils.metric_registry import (
+                        METRIC_HELP,
+                    )
+
                     merged: Dict[str, float] = {}
                     for src in exporter._sources:
                         try:
                             merged.update(src())
                         except Exception:
                             pass
-                    body = render_prometheus(merged, exporter._labels)
+                    body = render_prometheus(
+                        merged, exporter._labels, help_map=METRIC_HELP
+                    )
                     for src in exporter._text_sources:
                         try:
                             body += src()

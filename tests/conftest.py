@@ -50,13 +50,14 @@ def local_master():
     Mirrors the reference's `start_local_master` test fixture (reference:
     dlrover/python/tests/test_utils.py).
     """
-    from dlrover_tpu.common.rpc import find_free_port
     from dlrover_tpu.master.local_master import LocalJobMaster
 
-    port = find_free_port()
-    master = LocalJobMaster(port, node_num=1)
+    # port 0: prepare() binds a kernel-assigned port race-free and
+    # exposes it as .port (the dlint DL001 idiom; find_free_port's
+    # bind-then-close pre-pick can lose the port to another process)
+    master = LocalJobMaster(0, node_num=1)
     master.prepare()
-    yield master, f"127.0.0.1:{port}"
+    yield master, f"127.0.0.1:{master.port}"
     master.stop()
 
 

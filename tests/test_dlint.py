@@ -1,0 +1,554 @@
+"""dlint (tools/dlint) — the project-native static-analysis suite.
+
+Each checker is exercised on an inline known-bad fixture AND on the
+fixed idiom; plus the suppression comment, the baseline mechanism, the
+CLI exit codes, and the acceptance gate: the real package must be
+clean, and that IS the tier-1 guard against new violations.
+"""
+
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.dlint import DlintConfig, run_dlint
+from tools.dlint.cli import main as dlint_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _scan(tmp_path, files, config=None, baseline_path=None):
+    """Write ``{relpath: source}`` into a tree and run dlint on it."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return run_dlint(
+        [str(tmp_path)],
+        config=config or DlintConfig(),
+        baseline_path=baseline_path,
+        use_baseline=baseline_path is not None,
+    )
+
+
+def _codes(result):
+    return [v.code for v in result.new]
+
+
+# --------------------------------------------------------------- DL001
+def test_dl001_flags_find_free_port_call_and_bind_then_close(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import socket
+        from dlrover_tpu.common.rpc import find_free_port
+
+        def pick():
+            return find_free_port()
+
+        def homegrown_pick():
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+    """})
+    assert _codes(result) == ["DL001", "DL001"]
+
+
+def test_dl001_quiet_on_self_bound_server(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import socket
+
+        class Server:
+            def __init__(self):
+                # listener kept open: the sanctioned self-bind idiom
+                self._listener = socket.create_server(("127.0.0.1", 0))
+                self.port = self._listener.getsockname()[1]
+
+        def bound_listener():
+            s = socket.socket()
+            s.bind(("", 0))
+            s.listen(8)
+            return s, s.getsockname()[1]
+    """})
+    assert _codes(result) == []
+
+
+# --------------------------------------------------------------- DL002
+def test_dl002_flags_unstated_and_discarded_nondaemon_threads(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()            # no daemon=
+            threading.Thread(target=print, daemon=False).start()  # unjoinable
+    """})
+    assert _codes(result) == ["DL002", "DL002"]
+
+
+def test_dl002_quiet_on_explicit_daemon_or_tracked_thread(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class Owner:
+            def start(self):
+                threading.Thread(target=print, daemon=True).start()
+                self._worker = threading.Thread(
+                    target=print, daemon=False)
+                self._worker.start()
+                # handing the thread to a container IS holding it
+                self._pool.append(
+                    threading.Thread(target=print, daemon=False))
+
+            def make(self):
+                # factory pattern: the caller holds and joins it
+                return threading.Thread(target=print, daemon=False)
+
+            def stop(self):
+                self._worker.join()
+    """})
+    assert _codes(result) == []
+
+
+# --------------------------------------------------------------- DL003
+def test_dl003_flags_blocking_calls_under_lock(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def bad(self, sock, q, proc):
+                with self._lock:
+                    time.sleep(1.0)
+                    data = sock.recv(4096)
+                    item = q.get()
+                    proc.wait()
+    """})
+    assert _codes(result) == ["DL003"] * 4
+
+
+def test_dl003_nested_lock_withs_report_once_and_mutex_counts(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        class C:
+            def doubly_locked(self, sock):
+                with self.a_lock:
+                    with self.b_lock:
+                        sock.recv(1)
+
+            def under_mutex(self, q):
+                with self._persist_mutex:
+                    q.get()
+    """})
+    # one violation per blocking call, even under two stacked locks;
+    # mutex-named context managers are lock-like too
+    assert _codes(result) == ["DL003", "DL003"]
+
+
+def test_dl003_quiet_on_timed_calls_and_outside_lock(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def good(self, q, cv):
+                with self._lock:
+                    item = q.get(timeout=1.0)
+                    cv.wait(2.0)
+                    got = q.get(block=False)
+                    parts = "".join(["a", "b"])
+
+                    def later(sock):
+                        # nested def body does NOT run under the lock
+                        return sock.recv(1)
+                time.sleep(0.1)
+    """})
+    assert _codes(result) == []
+
+
+# --------------------------------------------------------------- DL004
+_PROTO = """
+    class FrameKind:
+        HELLO = "HELLO"
+        DATA = "DATA"
+        BYE = "BYE"
+"""
+
+
+def _dl004_config():
+    return DlintConfig(
+        protocol_module="proto.py",
+        dispatch_modules=("dispatch.py",),
+    )
+
+
+def test_dl004_flags_missing_frame_kind(tmp_path):
+    result = _scan(tmp_path, {
+        "proto.py": _PROTO,
+        "dispatch.py": """
+            from proto import FrameKind
+
+            def dispatch(frame):
+                if frame["kind"] == FrameKind.HELLO:
+                    return "hi"
+                if frame["kind"] == FrameKind.DATA:
+                    return "data"
+        """,
+    }, config=_dl004_config())
+    assert _codes(result) == ["DL004"]
+    assert "BYE" in result.new[0].message
+
+
+def test_dl004_declared_unhandled_is_quiet_and_stale_decl_flagged(tmp_path):
+    quiet = _scan(tmp_path / "a", {
+        "proto.py": _PROTO,
+        "dispatch.py": """
+            from proto import FrameKind
+
+            _UNHANDLED_FRAME_KINDS = ("BYE",)  # peer never says bye
+
+            def dispatch(frame):
+                if frame["kind"] == FrameKind.HELLO:
+                    return "hi"
+                if frame["kind"] == FrameKind.DATA:
+                    return "data"
+        """,
+    }, config=_dl004_config())
+    assert _codes(quiet) == []
+
+    stale = _scan(tmp_path / "b", {
+        "proto.py": _PROTO,
+        "dispatch.py": """
+            from proto import FrameKind
+
+            _UNHANDLED_FRAME_KINDS = ("HELLO", "BYE")
+
+            def dispatch(frame):
+                if frame["kind"] == FrameKind.HELLO:
+                    return "hi"
+                if frame["kind"] == FrameKind.DATA:
+                    return "data"
+        """,
+    }, config=_dl004_config())
+    # HELLO is both referenced and declared-unhandled -> stale
+    assert _codes(stale) == ["DL004"]
+    assert "stale" in stale.new[0].message
+
+
+# --------------------------------------------------------------- DL005
+def test_dl005_flags_bare_except_and_silent_loop_swallow(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        def loop(q):
+            while True:
+                try:
+                    q.get_nowait()
+                except Exception:
+                    continue
+
+        def anywhere(x):
+            try:
+                x()
+            except:
+                pass
+    """})
+    assert _codes(result) == ["DL005", "DL005"]
+
+
+def test_dl005_quiet_on_logged_or_typed_or_outside_loop(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import queue
+
+        def loop(q, logger):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    continue
+                except Exception:
+                    logger.warning("read failed", exc_info=True)
+                    continue
+
+        def cleanup(sock):
+            try:
+                sock.close()
+            except Exception:
+                pass  # teardown path, not a long-lived loop
+    """})
+    assert _codes(result) == []
+
+
+# --------------------------------------------------------------- DL006
+_REGISTRY = """
+    METRIC_HELP = {
+        "serving_queue_depth": "requests waiting in the gateway",
+    }
+    NON_METRIC_SERVING_NAMES = frozenset({"serving_plan"})
+"""
+
+
+def _dl006_config():
+    return DlintConfig(metric_registry_module="registry.py")
+
+
+def test_dl006_flags_undeclared_metric_literal(tmp_path):
+    result = _scan(tmp_path, {
+        "registry.py": _REGISTRY,
+        "mod.py": """
+            def metrics(self):
+                return {
+                    "serving_queue_depth": 1.0,   # declared: fine
+                    "serving_queue_depht": 2.0,   # typo fork: flagged
+                }
+
+            def rpc(kind):
+                return kind == "serving_plan"     # listed non-metric
+        """,
+    }, config=_dl006_config())
+    assert _codes(result) == ["DL006"]
+    assert "serving_queue_depht" in result.new[0].message
+
+
+def test_dl006_flags_registry_entry_without_help_text(tmp_path):
+    result = _scan(tmp_path, {
+        "registry.py": """
+            METRIC_HELP = {
+                "serving_queue_depth": "",
+            }
+        """,
+    }, config=_dl006_config())
+    assert _codes(result) == ["DL006"]
+    assert "help text" in result.new[0].message
+
+
+# --------------------------------------------- suppressions + baseline
+def test_suppression_needs_reason_and_silences_the_line(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def a(self):
+                with self._lock:
+                    time.sleep(1)  # dlint: disable=DL003 bounded by test double
+
+            def b(self):
+                # dlint: disable=DL003 standalone comment guards next line
+                with self._lock:
+                    pass
+
+            def c(self):
+                with self._lock:
+                    time.sleep(1)  # dlint: disable=DL003
+    """})
+    # a: suppressed with reason; c: reason missing -> the DL003 still
+    # counts AND the naked suppression is itself a DL000
+    assert sorted(_codes(result)) == ["DL000", "DL003"]
+    assert len(result.suppressed) == 1
+
+
+def test_stacked_suppressions_on_one_line_all_apply(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def a(self):
+                with self._lock:
+                    # dlint: disable=DL003 standalone guard survives the trailing one
+                    time.sleep(1)  # dlint: disable=DL001 trailing guard for another code
+    """})
+    assert _codes(result) == []
+    assert [v.code for v in result.suppressed] == ["DL003"]
+
+
+def test_baseline_grandfathers_then_reports_stale(tmp_path):
+    files = {"mod.py": """
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()
+    """}
+    baseline = tmp_path / "baseline.json"
+    first = _scan(tmp_path, files, baseline_path=str(baseline))
+    assert _codes(first) == ["DL002"]
+
+    from tools.dlint.core import write_baseline
+
+    write_baseline(str(baseline), first.new)
+    second = run_dlint([str(tmp_path)], baseline_path=str(baseline))
+    assert second.new == [] and len(second.baselined) == 1
+
+    # fix the violation: the baseline entry goes stale, run stays clean
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True).start()
+    """))
+    third = run_dlint([str(tmp_path)], baseline_path=str(baseline))
+    assert third.new == [] and len(third.stale_baseline) == 1
+
+
+def test_baseline_matches_on_line_text_not_line_number(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    first = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()
+    """}, baseline_path=str(baseline))
+    from tools.dlint.core import write_baseline
+
+    write_baseline(str(baseline), first.new)
+    # edits ABOVE the baselined site shift its line number; the entry
+    # must keep matching (keyed on source text, not position)
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import threading
+
+        PADDING_A = 1
+        PADDING_B = 2
+
+        def spawn():
+            threading.Thread(target=print).start()
+    """))
+    shifted = run_dlint([str(tmp_path)], baseline_path=str(baseline))
+    assert shifted.new == [] and len(shifted.baselined) == 1
+
+
+# ----------------------------------------------------------------- CLI
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nthreading.Thread(target=print)\n")
+    empty_baseline = tmp_path / "b.json"
+    empty_baseline.write_text("[]\n")
+    assert dlint_main(
+        [str(bad), "--baseline", str(empty_baseline)]
+    ) == 1
+    out = capsys.readouterr().out
+    assert "DL002" in out
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert dlint_main(
+        [str(good), "--baseline", str(empty_baseline)]
+    ) == 0
+    assert dlint_main(["--list-checkers"]) == 0
+    assert dlint_main([str(tmp_path / "missing_dir")]) == 2
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nthreading.Thread(target=print)\n")
+    baseline = tmp_path / "b.json"
+    assert dlint_main(
+        [str(bad), "--baseline", str(baseline), "--write-baseline"]
+    ) == 0
+    entries = json.loads(baseline.read_text())
+    assert [e["code"] for e in entries] == ["DL002"]
+    # grandfathered now; --no-baseline resurfaces it
+    assert dlint_main([str(bad), "--baseline", str(baseline)]) == 0
+    assert dlint_main(
+        [str(bad), "--baseline", str(baseline), "--no-baseline"]
+    ) == 1
+
+
+# ------------------------------------------- per-file + cwd robustness
+def test_single_file_scan_resolves_cross_file_context(tmp_path):
+    """DL004/DL006 context modules (protocol, registry) are resolved
+    from disk when the scan covers only one file — per-file invocation
+    (pre-commit, editors) must neither false-positive nor silently skip
+    the cross-file checks."""
+    for rel, src in {
+        "proto.py": _PROTO,
+        "registry.py": _REGISTRY,
+        "dispatch.py": """
+            from proto import FrameKind
+
+            def dispatch(frame):
+                if frame["kind"] == FrameKind.HELLO:
+                    return "hi"
+        """,
+        "emit.py": """
+            def metrics():
+                return {"serving_queue_depth": 1.0}
+        """,
+    }.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src))
+    config = DlintConfig(
+        protocol_module="proto.py",
+        dispatch_modules=("dispatch.py",),
+        metric_registry_module="registry.py",
+    )
+    # declared metric name, registry found on disk: clean
+    clean = run_dlint([str(tmp_path / "emit.py")], config=config)
+    assert _codes(clean) == []
+    # dispatch alone: protocol pulled from disk, DATA/BYE still missing
+    enforced = run_dlint([str(tmp_path / "dispatch.py")], config=config)
+    assert _codes(enforced) == ["DL004", "DL004"]
+
+
+def test_real_package_single_file_scans_are_clean():
+    clean = run_dlint(
+        [str(REPO_ROOT / "dlrover_tpu" / "serving" / "router" /
+             "metrics.py")]
+    )
+    assert _codes(clean) == []
+    proxy = run_dlint(
+        [str(REPO_ROOT / "dlrover_tpu" / "serving" / "remote" /
+             "proxy.py")]
+    )
+    assert _codes(proxy) == []
+
+
+def test_baseline_is_cwd_independent(tmp_path, monkeypatch):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\nthreading.Thread(target=print)\n"
+    )
+    baseline = tmp_path / "b.json"
+    first = run_dlint([str(pkg)], baseline_path=str(baseline))
+    from tools.dlint.core import write_baseline
+
+    write_baseline(str(baseline), first.new)
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)
+    moved = run_dlint([str(pkg)], baseline_path=str(baseline))
+    assert moved.new == [] and len(moved.baselined) == 1
+    assert moved.stale_baseline == []
+
+
+# ---------------------------------------------------- acceptance gates
+def test_repo_package_is_dlint_clean():
+    """THE tier-1 guard: any new DL001-DL006 violation in dlrover_tpu
+    fails this test.  The baseline is empty — nothing is grandfathered;
+    the two in-tree suppressions carry written reasons."""
+    result = run_dlint(
+        [str(REPO_ROOT / "dlrover_tpu")],
+        baseline_path=str(REPO_ROOT / "tools" / "dlint" / "baseline.json"),
+    )
+    assert result.parse_errors == []
+    assert result.new == [], "\n".join(v.render() for v in result.new)
+    # the checked-in baseline stays empty: violations are fixed or
+    # suppressed-with-reason, not grandfathered
+    assert result.baselined == []
+
+
+def test_registry_covers_router_metric_names():
+    """Runtime twin of DL006: every name RouterMetrics actually emits is
+    declared (with help) in the registry."""
+    from dlrover_tpu.serving.router.metrics import RouterMetrics
+    from dlrover_tpu.utils.metric_registry import METRIC_HELP
+
+    emitted = set(RouterMetrics().metrics())
+    missing = emitted - set(METRIC_HELP)
+    assert not missing, f"undeclared metric names: {sorted(missing)}"
+    assert all(METRIC_HELP[name].strip() for name in emitted)
+
+
+def test_metrics_endpoint_renders_registry_help():
+    from dlrover_tpu.utils.metric_registry import METRIC_HELP
+    from dlrover_tpu.utils.profiler import render_prometheus
+
+    text = render_prometheus(
+        {"serving_queue_depth": 3.0}, help_map=METRIC_HELP
+    )
+    assert "# HELP serving_queue_depth" in text
+    assert "serving_queue_depth 3.0" in text

@@ -1,0 +1,19 @@
+"""Repo-checkout shim: ``python -m tools.dlint dlrover_tpu``.
+
+The implementation lives in :mod:`dlrover_tpu.dlint` (an owned,
+wheel-shipped namespace — a top-level ``tools`` package must never be
+installed, it is one of the most collision-prone names in
+site-packages).  This shim keeps the documented ``tools/dlint`` CLI
+spelling and the checked-in ``tools/dlint/baseline.json`` location
+working from a checkout.
+"""
+
+from dlrover_tpu.dlint import (
+    CHECKERS,
+    DlintConfig,
+    DlintResult,
+    main,
+    run_dlint,
+)
+
+__all__ = ["CHECKERS", "DlintConfig", "DlintResult", "main", "run_dlint"]

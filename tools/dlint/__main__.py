@@ -1,0 +1,5 @@
+import sys
+
+from tools.dlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,14 @@
+"""Shim — canonical module: :mod:`dlrover_tpu.dlint.checkers`."""
+
+from dlrover_tpu.dlint.checkers import (  # noqa: F401
+    CHECKERS,
+    Checker,
+    DlintConfig,
+    FrameExhaustiveChecker,
+    LockBlockingChecker,
+    MetricRegistryChecker,
+    Project,
+    SwallowedExceptionChecker,
+    ThreadHygieneChecker,
+    ToctouPortChecker,
+)

@@ -1,0 +1,3 @@
+"""Shim — canonical module: :mod:`dlrover_tpu.dlint.cli`."""
+
+from dlrover_tpu.dlint.cli import DlintResult, main, run_dlint  # noqa: F401

@@ -1,0 +1,12 @@
+"""Shim — canonical module: :mod:`dlrover_tpu.dlint.core`."""
+
+from dlrover_tpu.dlint.core import (  # noqa: F401
+    SUPPRESSION_HYGIENE_CODE,
+    ParsedModule,
+    Suppression,
+    Violation,
+    apply_baseline,
+    iter_python_files,
+    load_baseline,
+    write_baseline,
+)
