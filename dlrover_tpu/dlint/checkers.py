@@ -39,13 +39,14 @@ DL005  swallowed-exception  no bare ``except:`` anywhere, and no
                             long-lived loop that eats exceptions
                             silently turns a hard failure into an
                             invisible stall.
-DL006  metric-registry      every ``serving_*`` metric-name literal
-                            must be declared (with help text) in the
-                            metric registry module; ``serving_``
-                            strings that are protocol/table names must
-                            be listed there as non-metrics.  One
-                            registry means dashboards, autoscaler and
-                            docs can never fork on a misspelled name.
+DL006  metric-registry      every ``serving_*`` / ``dlrover_*``
+                            metric-name literal must be declared (with
+                            help text) in the metric registry module;
+                            strings in those namespaces that are
+                            protocol/table/prefix vocabulary must be
+                            listed there as non-metrics.  One registry
+                            means dashboards, autoscaler and docs can
+                            never fork on a misspelled name.
 ====== ==================== =============================================
 
 Checkers are pure AST passes — nothing is imported or executed, so
@@ -82,7 +83,11 @@ class DlintConfig:
     metric_registry_module: str = "utils/metric_registry.py"
     metric_help_name: str = "METRIC_HELP"
     non_metric_name: str = "NON_METRIC_SERVING_NAMES"
-    metric_literal_pattern: str = r"^serving_[a-z0-9_]+$"
+    # both exported namespaces: serving_* (router/tracer metrics) and
+    # dlrover_* (trainer/exporter metrics) — a literal in either that
+    # is neither a declared metric nor listed non-metric vocabulary is
+    # a namespace fork waiting to happen
+    metric_literal_pattern: str = r"^(serving|dlrover)_[a-z0-9_]+$"
 
 
 class Project:

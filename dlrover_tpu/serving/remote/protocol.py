@@ -18,16 +18,27 @@ kind                  dir     payload
                               ``block_size``, ``engine`` — capability
                               handshake, first frame on every connection
 ``SUBMIT``            r -> w  ``rid``, ``prompt`` (list[int]),
-                              ``max_new_tokens``
+                              ``max_new_tokens``; optional ``trace`` —
+                              a W3C-style traceparent
+                              (``00-<trace_id>-<span_id>-01``, see
+                              utils/tracing.py) parenting worker-side
+                              spans under the request's current attempt
 ``SUBMITTED``         w -> r  ``rid`` — the engine admitted the request
 ``ERROR``             w -> r  ``rid``, ``error`` — the engine REJECTED it
                               (poison request; never a worker crash)
 ``CANCEL``            r -> w  ``rid`` — best-effort withdrawal
 ``TOKEN``             w -> r  ``rid``, ``tokens`` (list[int]) — streamed
                               as emitted; TTFT is measured at the first
-                              one RECEIVED
+                              one RECEIVED; echoes ``trace`` when the
+                              SUBMIT carried one (wire-sniffer
+                              correlation)
 ``DONE``              w -> r  ``rid``, ``tokens`` — the full,
-                              authoritative output
+                              authoritative output; plus ``trace``,
+                              ``spans`` (worker-side span dicts in the
+                              worker's monotonic clock) and ``sent_at``
+                              (worker clock at send — the proxy's
+                              anchor for translating span times into
+                              router time) when the SUBMIT was traced
 ``STATS``             w -> r  ``slots_free``, ``blocks_free``,
                               ``inflight``, ``generated_tokens`` —
                               capacity refresh AND liveness heartbeat
@@ -36,6 +47,11 @@ kind                  dir     payload
 ====================  ======  =============================================
 
 Direction: ``r`` = router proxy, ``w`` = worker.
+
+Unknown keys in any frame are ignored by both ends (frames are plain
+msgpack maps), so the trace headers are backward- and forward-
+compatible: an untraced router talks to a tracing worker and vice
+versa.
 """
 
 from __future__ import annotations

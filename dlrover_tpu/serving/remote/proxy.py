@@ -136,7 +136,8 @@ class RemoteReplicaHandle:
                 if rid in self._inflight:
                     self._inflight.discard(rid)
                     self._finished.append(SimpleNamespace(
-                        rid=rid, output=list(frame["tokens"])))
+                        rid=rid, output=list(frame["tokens"]),
+                        trace_spans=self._shift_spans(frame, now)))
             elif kind == FrameKind.STATS:
                 self._slots_free = int(frame.get("slots_free", 0))
                 self._blocks_free = float(frame.get("blocks_free", 0.0))
@@ -145,6 +146,33 @@ class RemoteReplicaHandle:
                 self._submit_cv.notify_all()
             elif kind == FrameKind.GOODBYE:
                 self._mark_dead("worker said goodbye", graceful=True)
+
+    @staticmethod
+    def _shift_spans(frame: dict, now: float) -> list:
+        """Worker-side spans ride the DONE frame in the WORKER's
+        monotonic clock, which means nothing in this process.  The
+        frame also carries ``sent_at`` (worker clock at send); the
+        receive time ``now`` is the same instant in OUR clock, so
+        ``now - sent_at`` translates every span (error = one-way
+        network latency, microseconds on the links this fabric runs).
+        Returns spans ready for ``Tracer.graft``; anything malformed
+        degrades to no spans, never to a dead replica."""
+        spans = frame.get("spans")
+        sent_at = frame.get("sent_at")
+        if not spans or not isinstance(sent_at, (int, float)):
+            return []
+        shift = now - float(sent_at)
+        out = []
+        for raw in spans:
+            try:
+                out.append(dict(
+                    raw,
+                    start=float(raw["start"]) + shift,
+                    end=float(raw["end"]) + shift,
+                ))
+            except (KeyError, TypeError, ValueError):
+                continue
+        return out
 
     def _mark_dead(self, reason: str, graceful: bool = False) -> None:
         with self._lock:
@@ -161,11 +189,15 @@ class RemoteReplicaHandle:
         self._conn.close()
 
     # -------------------------------------------------- engine protocol
-    def add_request(self, prompt, max_new_tokens: int) -> int:
+    def add_request(self, prompt, max_new_tokens: int,
+                    trace: Optional[str] = None) -> int:
         """Synchronous SUBMIT round trip.  An engine-side rejection
         (ERROR frame) raises ``ValueError`` — the router's poison-
         request path; a torn/silent worker raises ``ConnectionError`` —
-        the router's failover path.
+        the router's failover path.  ``trace`` (a W3C-style traceparent
+        from the request's span trace) rides the SUBMIT header so the
+        worker's own spans come back on DONE and graft into the
+        request's tree.
 
         Tradeoff, documented: the ack wait runs under the router's step
         lock, so a wedged worker can stall placement for up to
@@ -188,10 +220,12 @@ class RemoteReplicaHandle:
             self._inflight.add(rid)
         try:
             try:
+                extra = {} if trace is None else {"trace": trace}
                 self._conn.send(
                     FrameKind.SUBMIT, rid=rid,
                     prompt=prompt.tolist(),
                     max_new_tokens=int(max_new_tokens),
+                    **extra,
                 )
             except FrameProtocolError as e:
                 # a request too large to FRAME is the request's defect,

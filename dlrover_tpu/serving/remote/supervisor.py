@@ -121,8 +121,16 @@ class WorkerSupervisor:
         respawn: bool = True,
         max_respawns: int = 5,
         name_prefix: str = "worker",
+        recorder=None,
     ):
         self.router = router
+        # fabric flight recorder (utils/tracing.FlightRecorder): worker
+        # spawn/exit/respawn events land next to the router's
+        # replica/request events, so a postmortem dump shows the
+        # process-level story too.  Defaults to the router's own.
+        if recorder is None and router is not None:
+            recorder = getattr(router, "recorder", None)
+        self.recorder = recorder
         self.worker_args = list(worker_args or [])
         self.engine = engine
         self.host = host
@@ -172,6 +180,9 @@ class WorkerSupervisor:
             self.workers[name] = record
         if join and self.router is not None:
             self.router.join_replica(name, proxy)
+        if self.recorder is not None:
+            self.recorder.record(
+                "worker_spawn", worker=name, pid=proc.pid, addr=addr)
         logger.info("spawned serving worker %s (pid %d) at %s",
                     name, proc.pid, addr)
         return record
@@ -215,6 +226,10 @@ class WorkerSupervisor:
             with self._lock:
                 self.workers.pop(record.name, None)
             record.proxy.close(goodbye=False)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "worker_exit", worker=record.name,
+                    pid=record.proc.pid, rc=record.proc.returncode)
             logger.warning(
                 "serving worker %s (pid %d) exited rc=%s",
                 record.name, record.proc.pid, record.proc.returncode)

@@ -139,6 +139,12 @@ class WorkerServer:
         self._erid_by_rid: Dict[int, int] = {}
         self._rid_by_erid: Dict[int, int] = {}
         self._streamed: Dict[int, int] = {}  # erid -> tokens streamed
+        # erid -> trace bookkeeping for SUBMITs that carried a
+        # traceparent header: worker-side spans (request lifetime,
+        # decode steps, engine time) go back on the DONE frame in THIS
+        # process's monotonic clock plus a sent_at anchor the proxy
+        # uses to translate them into router time
+        self._trace_by_erid: Dict[int, dict] = {}
         # last consistent STATS numbers; the heartbeat thread falls
         # back to these when a live read races an engine mutation
         self._last_stats_payload: Dict[str, object] = dict(
@@ -189,6 +195,7 @@ class WorkerServer:
         self._erid_by_rid.clear()
         self._rid_by_erid.clear()
         self._streamed.clear()
+        self._trace_by_erid.clear()
         conn.send(
             FrameKind.HELLO,
             addr=self.addr,
@@ -266,6 +273,12 @@ class WorkerServer:
                 return True
             self._erid_by_rid[rid] = erid
             self._rid_by_erid[erid] = rid
+            tp = frame.get("trace")
+            if isinstance(tp, str) and tp:
+                self._trace_by_erid[erid] = {
+                    "trace": tp, "t0": time.monotonic(),
+                    "t_first": None, "steps": 0, "engine_s": 0.0,
+                }
             conn.send(FrameKind.SUBMITTED, rid=rid)
         elif kind == FrameKind.CANCEL:
             rid = int(frame["rid"])
@@ -273,6 +286,7 @@ class WorkerServer:
             if erid is not None:
                 self._rid_by_erid.pop(erid, None)
                 self._streamed.pop(erid, None)
+                self._trace_by_erid.pop(erid, None)
                 cancel = getattr(self.engine, "cancel", None)
                 if cancel is not None:
                     cancel(erid)
@@ -288,7 +302,18 @@ class WorkerServer:
     def _pump(self, conn: FrameConnection) -> None:
         from dlrover_tpu.serving.router.replica import stream_deltas
 
+        t0 = time.monotonic()
         finished = self.engine.step()
+        step_s = time.monotonic() - t0
+        # attribute the step to every traced request that was aboard
+        # (whole-batch attribution: a batched decode step serves all of
+        # them at once — per-request engine_seconds overlap by design)
+        for erid, rec in self._trace_by_erid.items():
+            if erid in self._rid_by_erid:
+                rec["steps"] += 1
+                rec["engine_s"] += step_s
+                if rec["t_first"] is None:
+                    rec["t_first"] = time.monotonic()
         # stream the deltas FIRST — TTFT is measured at the receiver.
         # prune=False: _streamed keeps the positions of just-finished
         # requests so the DONE path below flushes only their SUFFIX
@@ -299,20 +324,47 @@ class WorkerServer:
                 rid = self._rid_by_erid.get(erid)
                 if rid is not None:
                     conn.send(FrameKind.TOKEN, rid=rid,
-                              tokens=[int(t) for t in toks])
+                              tokens=[int(t) for t in toks],
+                              **self._trace_header(erid))
         for ereq in finished:
             rid = self._rid_by_erid.pop(ereq.rid, None)
             sent = self._streamed.pop(ereq.rid, 0)
+            trace_kw = self._trace_header(ereq.rid)
+            rec = self._trace_by_erid.pop(ereq.rid, None)
             if rid is None:
                 continue  # cancelled while decoding
             self._erid_by_rid.pop(rid, None)
             out = [int(t) for t in ereq.output]
             if len(out) > sent:
-                conn.send(FrameKind.TOKEN, rid=rid, tokens=out[sent:])
-            # DONE carries the full output: authoritative completion
-            conn.send(FrameKind.DONE, rid=rid, tokens=out)
+                conn.send(FrameKind.TOKEN, rid=rid, tokens=out[sent:],
+                          **trace_kw)
+            # DONE carries the full output: authoritative completion —
+            # plus this worker's spans and a sent_at clock anchor so
+            # the router can graft them into the request's trace
+            conn.send(FrameKind.DONE, rid=rid, tokens=out, **trace_kw,
+                      **self._trace_spans(rec))
         if finished:
             self._send_stats(conn)
+
+    def _trace_header(self, erid: int) -> dict:
+        rec = self._trace_by_erid.get(erid)
+        return {} if rec is None else {"trace": rec["trace"]}
+
+    def _trace_spans(self, rec: Optional[dict]) -> dict:
+        if rec is None:
+            return {}
+        now = time.monotonic()
+        return {
+            "sent_at": now,
+            "spans": [
+                {"name": "worker.request", "start": rec["t0"],
+                 "end": now, "attrs": {"engine": self.engine_kind}},
+                {"name": "worker.decode", "parent": "worker.request",
+                 "start": rec["t_first"] or rec["t0"], "end": now,
+                 "attrs": {"steps": rec["steps"],
+                           "engine_seconds": round(rec["engine_s"], 6)}},
+            ],
+        }
 
     def _finite_blocks(self) -> float:
         free = self.engine.blocks_free()

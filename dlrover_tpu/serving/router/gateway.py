@@ -25,6 +25,7 @@ from typing import Deque, Iterator, List, Optional
 import numpy as np
 
 from dlrover_tpu.common.constants import ServingFabric, ServingRequestState
+from dlrover_tpu.utils.tracing import RequestTrace, Tracer
 
 PRIORITY_HIGH = 0
 PRIORITY_NORMAL = 1
@@ -93,6 +94,11 @@ class ServingRequest:
     _streamed: int = dataclasses.field(
         default=0, repr=False, compare=False
     )  # tokens pushed to the stream since the last (re)start
+    # per-request span trace (utils/tracing.RequestTrace), stamped by
+    # the gateway at admission; None when the gateway runs untraced
+    trace: Optional[RequestTrace] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def total_len(self) -> int:
@@ -108,6 +114,8 @@ class ServingRequest:
             return
         if self.first_token_at is None:
             self.first_token_at = now
+            if self.trace is not None:
+                self.trace.first_token(now)
         self.output.extend(tokens)
         self._streamed += len(tokens)
         self._events.put(("tokens", list(tokens)))
@@ -120,6 +128,8 @@ class ServingRequest:
             self._events.put(("tokens", output[self._streamed:]))
         if self.first_token_at is None:
             self.first_token_at = now
+            if self.trace is not None:
+                self.trace.first_token(now)
         self.output = output
         self.state = ServingRequestState.DONE
         # clamp: the router stamps a whole pump round with its entry
@@ -127,11 +137,15 @@ class ServingRequest:
         # carries a later (true) timestamp — completion can never
         # precede the first token
         self.finished_at = max(now, self.first_token_at)
+        if self.trace is not None:
+            self.trace.finished(self.finished_at)
         self._events.put(("done", None))
         self._done.set()
 
     def abort(self, state: str) -> None:
         self.state = state
+        if self.trace is not None:
+            self.trace.aborted(state)
         self._events.put(("abort", state))
         self._done.set()
 
@@ -188,12 +202,17 @@ class RequestGateway:
         max_total_len: Optional[int] = None,
         default_timeout: Optional[float] = None,
         max_requeues: int = ServingFabric.MAX_REQUEST_REQUEUES,
+        tracer: Optional[Tracer] = None,
     ):
         self.max_pending = int(max_pending)
         self.max_prompt_len = max_prompt_len
         self.max_total_len = max_total_len
         self.default_timeout = default_timeout
         self.max_requeues = int(max_requeues)
+        # tracing is on by default: stdlib-only dict/deque bookkeeping
+        # whose memory is capped by the tracer's bounded rings, so
+        # every deployment gets per-request traces without opting in
+        self.tracer = tracer if tracer is not None else Tracer()
         self._lock = threading.RLock()
         self._queues: List[Deque[ServingRequest]] = [
             deque() for _ in _PRIORITIES
@@ -249,12 +268,18 @@ class RequestGateway:
                 submitted_at=now,
             )
             self._next_rid += 1
+            req.trace = RequestTrace(
+                self.tracer, req.rid, now=now,
+                priority=priority, prompt_len=int(prompt.size),
+                max_new_tokens=int(max_new_tokens),
+            )
             self._queues[priority].append(req)
             self.submitted += 1
             return req
 
     def requeue_front(
-        self, requests: List[ServingRequest]
+        self, requests: List[ServingRequest],
+        dump: bool = True,
     ) -> List[ServingRequest]:
         """Failover path: a dead replica's in-flight requests re-enter at
         the FRONT of their band (they have waited longest).  Partial
@@ -266,8 +291,13 @@ class RequestGateway:
         replays is statistically the thing KILLING replicas, not their
         victim — it is failed with ``POISONED`` instead of circulating
         forever.  Returns the poisoned requests (the router counts them
-        into ``serving_requests_poisoned_total``)."""
+        into ``serving_requests_poisoned_total``).
+
+        ``dump=False`` skips the poison flight-recorder dumps: a caller
+        already holding its own lock (the router's step) defers them to
+        after release and dumps from the returned list itself."""
         poisoned: List[ServingRequest] = []
+        requeued: List[ServingRequest] = []
         with self._lock:
             for req in reversed(requests):
                 req.requeues += 1
@@ -276,11 +306,34 @@ class RequestGateway:
                     req.abort(ServingRequestState.POISONED)
                     poisoned.append(req)
                     continue
+                dead_replica = req.replica
+                if dead_replica is None and req.trace is not None \
+                        and req.trace.attempt is not None:
+                    # placement-failure requeues arrive before submit()
+                    # stamped req.replica — the attempt span (stamped
+                    # by the scheduler) still knows who died
+                    dead_replica = req.trace.attempt.attrs.get("replica")
                 req.state = ServingRequestState.QUEUED
                 req.replica = None
                 req.engine_rid = None
                 req.restart_stream()
+                if req.trace is not None:
+                    # close the dead-replica attempt as "failover" (it
+                    # stays in the tree next to the retry) and reopen a
+                    # queue span for the replay
+                    req.trace.failover(f"replica {dead_replica} died")
                 self._queues[req.priority].appendleft(req)
+                requeued.append(req)
+        # flight-recorder dumps happen OUTSIDE the queue lock: logging
+        # and tree serialization must never extend the admission
+        # critical section
+        for req in requeued:
+            self.tracer.recorder.record(
+                "request_requeued", rid=req.rid, requeues=req.requeues)
+        for req in poisoned:
+            self.tracer.recorder.record("request_poisoned", rid=req.rid)
+            if dump and req.trace is not None:
+                self.tracer.flight_dump("poisoned", req.trace.trace_id)
         return poisoned
 
     # ------------------------------------------------------- schedule
@@ -307,8 +360,13 @@ class RequestGateway:
                 return False
 
     # -------------------------------------------------------- expiry
-    def expire(self, now: Optional[float] = None) -> List[ServingRequest]:
-        """Abort queued requests whose deadline has passed."""
+    def expire(self, now: Optional[float] = None,
+               dump: bool = True) -> List[ServingRequest]:
+        """Abort queued requests whose deadline has passed.
+        ``dump=False`` defers the flight-recorder dumps to the caller
+        (the router holds its step lock here and dumps after release —
+        serialization + logging must not extend ITS critical section
+        either)."""
         now = time.monotonic() if now is None else now
         expired: List[ServingRequest] = []
         with self._lock:
@@ -327,6 +385,15 @@ class RequestGateway:
                         kept.append(req)
                 if dropped:
                     self._queues[i] = kept
+        # dump outside the queue lock — the black-box readout
+        # serializes the span tree and logs, neither belongs in the
+        # admission path
+        for req in expired:
+            self.tracer.recorder.record(
+                "deadline_expired", rid=req.rid, now=now)
+            if dump and req.trace is not None:
+                self.tracer.flight_dump(
+                    "deadline_expired", req.trace.trace_id, now=now)
         return expired
 
     def depth(self, priority: Optional[int] = None) -> int:

@@ -33,6 +33,13 @@ TTFT semantics: for streaming engines (the remote replica fabric and
 the in-process adapter) ``serving_ttft_seconds`` measures submission to
 the FIRST TOKEN actually received, not to the first post-placement
 router pump.
+
+These aggregates answer "how is the fleet doing"; the per-request
+companion — WHERE one request's time went — is the span tracer
+(``utils/tracing.py``): the gateway traces every request from
+admission, ``exporter.attach_tracer(router.tracer)`` adds the
+``serving_request_trace_*`` gauges to this same scrape plus the
+``/traces`` + ``/traces/slowest`` JSON views.
 """
 
 from __future__ import annotations

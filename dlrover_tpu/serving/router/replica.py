@@ -173,6 +173,16 @@ class ReplicaHandle:
         self.inflight: Dict[int, ServingRequest] = {}
         self.generated_tokens = 0
         self._failed = False
+        # engines that can carry trace context downstream (the remote
+        # proxy forwards it in the SUBMIT frame header) declare a
+        # ``trace=`` kwarg; probed once so submit stays cheap
+        try:
+            import inspect
+
+            self._engine_takes_trace = "trace" in inspect.signature(
+                engine.add_request).parameters
+        except (TypeError, ValueError):
+            self._engine_takes_trace = False
 
     # -------------------------------------------------------- capacity
     def slots_free(self) -> int:
@@ -209,7 +219,23 @@ class ReplicaHandle:
     def submit(self, req: ServingRequest) -> None:
         if not self.schedulable:
             raise ReplicaDeadError(f"replica {self.name} not schedulable")
-        erid = self.engine.add_request(req.prompt, req.max_new_tokens)
+        tr = req.trace
+        if tr is not None:
+            tr.submit_started()
+        try:
+            if tr is not None and self._engine_takes_trace:
+                erid = self.engine.add_request(
+                    req.prompt, req.max_new_tokens,
+                    trace=tr.traceparent())
+            else:
+                erid = self.engine.add_request(
+                    req.prompt, req.max_new_tokens)
+        except Exception:
+            if tr is not None:
+                tr.submit_finished(status="error")
+            raise
+        if tr is not None:
+            tr.submit_finished()
         req.replica = self.name
         req.engine_rid = erid
         req.state = ServingRequestState.RUNNING
@@ -248,6 +274,14 @@ class ReplicaHandle:
             if req is None:
                 continue  # e.g. admitted before a drain started
             self.generated_tokens += len(ereq.output)
+            if req.trace is not None:
+                # remote workers ship their own spans (decode steps,
+                # engine time) back on the DONE frame, already shifted
+                # to this process's clock by the proxy — graft them
+                # under the attempt that served this request BEFORE
+                # finish() closes the trace into the ring
+                req.trace.graft_worker_spans(
+                    getattr(ereq, "trace_spans", None))
             req.finish(list(ereq.output), now)
             done.append(req)
         if drain is None:
@@ -258,6 +292,8 @@ class ReplicaHandle:
             for req in self.inflight.values():
                 if req.first_token_at is None:
                     req.first_token_at = now
+                    if req.trace is not None:
+                        req.trace.first_token(now)
             for req in done:
                 if req.first_token_at is None:
                     req.first_token_at = now

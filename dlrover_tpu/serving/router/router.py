@@ -64,6 +64,11 @@ class DrainedReplica:
 class ServingRouter:
     """Admission -> placement -> generation -> completion, elastically."""
 
+    # flight-recorder dumps emitted per reason per step; the rest of a
+    # mass failure (a stall expiring a whole queue at once) is one
+    # summary line instead of hundreds of multi-KB records
+    MAX_DUMPS_PER_STEP = 8
+
     def __init__(
         self,
         gateway: Optional[RequestGateway] = None,
@@ -76,6 +81,12 @@ class ServingRouter:
         self.manager = manager or ReplicaManager()
         self.metrics = metrics or RouterMetrics()
         self.autoscaler = None  # attached via ServingAutoScaler(router=...)
+        # the gateway owns the tracer (requests are traced from
+        # admission); the router only needs it for fabric events and
+        # failure dumps — expose it so exporters/supervisors reach one
+        # surface
+        self.tracer = self.gateway.tracer
+        self.recorder = self.tracer.recorder
         # drained-replica records awaiting pickup (the autoscaler
         # finishes node removal); bounded so unclaimed records from
         # manual drains can never accumulate without limit
@@ -90,15 +101,20 @@ class ServingRouter:
     def join_replica(self, name: str, engine, node=None,
                      now: Optional[float] = None) -> ReplicaHandle:
         with self._lock:
-            return self.manager.join(
+            handle = self.manager.join(
                 ReplicaHandle(name, engine, node=node), now=now)
+        self.recorder.record("replica_join", replica=name, now=now)
+        return handle
 
     def begin_drain(self, name: str) -> Optional[ReplicaHandle]:
         """Graceful leave, phase 1: stop placing onto the replica; its
         in-flight requests finish.  Phase 2 (retirement) happens in
         :meth:`step` once it is empty."""
         with self._lock:
-            return self.manager.begin_drain(name)
+            handle = self.manager.begin_drain(name)
+        if handle is not None:
+            self.recorder.record("replica_drain", replica=name)
+        return handle
 
     def fail_replica(self, name: str) -> None:
         """Chaos/ops hook: the replica dies NOW; next step fails it over."""
@@ -135,17 +151,25 @@ class ServingRouter:
     def step(self, now: Optional[float] = None) -> List[ServingRequest]:
         """One router round; returns the requests completed by it."""
         now = time.monotonic() if now is None else now
+        # flight-recorder dumps requested during this round: flushed
+        # AFTER the step lock is released — serializing span trees and
+        # logging must not extend the critical section that placement
+        # and membership calls contend on
+        dumps: List[tuple] = []
         with self._lock:
             # 1. deadline expiry
-            self.gateway.expire(now)
+            for req in self.gateway.expire(now, dump=False):
+                if req.trace is not None:
+                    dumps.append(
+                        ("deadline_expired", req.trace.trace_id))
             self.metrics.timed_out = self.gateway.timed_out
 
             # 2. failover: reap dead replicas, requeue their in-flight
-            self._reap(now)
+            self._reap(now, dumps=dumps)
 
             # 3. placement (micro-batch per replica per round)
             placements = self.scheduler.schedule(
-                self.gateway, self.manager.schedulable())
+                self.gateway, self.manager.schedulable(), now=now)
             for handle, req in placements:
                 try:
                     handle.submit(req)
@@ -169,7 +193,7 @@ class ServingRouter:
                         handle.name,
                     )
                     handle.fail()
-                    self._reap(now, extra=[req])
+                    self._reap(now, extra=[req], dumps=dumps)
 
             # 4. pump engines
             completed: List[ServingRequest] = []
@@ -177,7 +201,7 @@ class ServingRouter:
                 try:
                     done = handle.pump(now)
                 except ReplicaDeadError:
-                    self._reap(now)
+                    self._reap(now, dumps=dumps)
                     continue
                 for req in done:
                     self._record_ttft(req, now)
@@ -196,6 +220,8 @@ class ServingRouter:
                     self.manager.remove(handle.name)
                     self.scheduler.forget_replica(handle.name)
                     self._close_engine(handle, goodbye=True)
+                    self.recorder.record(
+                        "replica_retired", replica=handle.name, now=now)
                     self.drained.append(
                         DrainedReplica(handle.name, handle.node))
 
@@ -214,7 +240,24 @@ class ServingRouter:
             )
             if self.autoscaler is not None:
                 self.autoscaler.on_step(now)
-            return completed
+        # bound the log burst: a stall can expire a whole queue in one
+        # step, and one multi-KB FLIGHT-RECORDER record per request
+        # would flood the log exactly mid-incident — the first few per
+        # reason carry the signal, the rest are summarized
+        flushed: Dict[str, int] = {}
+        dropped: Dict[str, int] = {}
+        for reason, trace_id in dumps:
+            if flushed.get(reason, 0) >= self.MAX_DUMPS_PER_STEP:
+                dropped[reason] = dropped.get(reason, 0) + 1
+                continue
+            flushed[reason] = flushed.get(reason, 0) + 1
+            self.tracer.flight_dump(reason, trace_id, now=now)
+        for reason, n in dropped.items():
+            logger.warning(
+                "flight recorder: %d more %s dumps suppressed this "
+                "step (first %d emitted)", n, reason,
+                self.MAX_DUMPS_PER_STEP)
+        return completed
 
     def _record_ttft(self, req: ServingRequest, now: float) -> None:
         if req.first_token_at is not None and not req.ttft_recorded:
@@ -223,18 +266,37 @@ class ServingRouter:
                 req.first_token_at - req.submitted_at, now)
 
     def _reap(self, now: float,
-              extra: Optional[List[ServingRequest]] = None) -> None:
+              extra: Optional[List[ServingRequest]] = None,
+              dumps: Optional[List[tuple]] = None) -> None:
         """Reap dead replicas, requeue their (+ ``extra``) in-flight
         requests, and run the post-mortem: drop affinity state (a
         same-named successor must not inherit routing toward a cache
         that died with the process) and surface the dead replicas'
-        cluster nodes for retirement."""
-        self._requeue((extra or []) + self.manager.reap_dead(now))
+        cluster nodes for retirement.  Flight-recorder dump requests
+        are appended to ``dumps`` — the step lock is held here, and
+        serializing span trees + logging belongs after its release."""
+        orphans = (extra or []) + self.manager.reap_dead(now)
+        self._requeue(orphans, dumps)
         for handle in self.manager.dead_handles:
             self.scheduler.forget_replica(handle.name)
             self._close_engine(handle, goodbye=False)
+            self.recorder.record(
+                "replica_dead", replica=handle.name, now=now)
             self.dead.append(DrainedReplica(handle.name, handle.node))
         self.manager.dead_handles.clear()
+        # black-box readout for the failover: each orphaned request's
+        # span tree (the dead-replica attempt is closed as "failover"
+        # by the requeue above, so the dump shows exactly where the
+        # request was when its replica died)
+        if dumps is not None:
+            for req in orphans:
+                # poisoned orphans are queued for their own "poisoned"
+                # dump by _requeue; dumping them twice would just burn
+                # ring slots
+                if req.trace is not None and \
+                        req.state == ServingRequestState.QUEUED:
+                    dumps.append(
+                        ("replica_death", req.trace.trace_id))
 
     @staticmethod
     def _close_engine(handle: ReplicaHandle, goodbye: bool) -> None:
@@ -266,13 +328,17 @@ class ServingRouter:
                 "closing engine of retired replica %s failed: %s",
                 handle.name, e)
 
-    def _requeue(self, requests: List[ServingRequest]) -> None:
+    def _requeue(self, requests: List[ServingRequest],
+                 dumps: Optional[List[tuple]] = None) -> None:
         if not requests:
             return
-        poisoned = self.gateway.requeue_front(requests)
+        poisoned = self.gateway.requeue_front(
+            requests, dump=dumps is None)
         self.metrics.requeued += len(requests) - len(poisoned)
         self.metrics.poisoned = self.gateway.poisoned
         for req in poisoned:
+            if dumps is not None and req.trace is not None:
+                dumps.append(("poisoned", req.trace.trace_id))
             logger.error(
                 "request %s poisoned: crashed a replica on each of its "
                 "%d placements; failing it instead of requeueing",

@@ -74,12 +74,15 @@ class ContinuousBatchScheduler:
 
     # ------------------------------------------------------- schedule
     def schedule(
-        self, gateway: RequestGateway, replicas: List
+        self, gateway: RequestGateway, replicas: List,
+        now: Optional[float] = None,
     ) -> List[Tuple[object, ServingRequest]]:
         """One placement round: assign queued requests to replicas with
         capacity.  Returns ``(replica_handle, request)`` pairs; the
         requests are already removed from the gateway.  Skips (leaves
-        queued) any request no replica can currently hold."""
+        queued) any request no replica can currently hold.  Placed
+        requests get a ``placement``-decision stamp on their trace
+        (replica, candidate count, affinity hit) at ``now``."""
         if not replicas:
             return []
         # local capacity ledger: placements in this round consume it
@@ -96,6 +99,7 @@ class ContinuousBatchScheduler:
             if not cands:
                 continue  # stays queued; later (smaller) requests may fit
             key = self.prefix_key(req.prompt)
+            affinity_hit = False
             if key is not None:
                 affine = [
                     h for h in cands
@@ -103,6 +107,7 @@ class ContinuousBatchScheduler:
                 ]
                 if affine:
                     cands = affine
+                    affinity_hit = True
             best = max(
                 cands,
                 key=lambda h: (free[h.name][0], free[h.name][1]),
@@ -113,6 +118,13 @@ class ContinuousBatchScheduler:
             free[best.name][1] -= self._need(best, req)
             if key is not None:
                 self._remember(best.name, key)
+            if req.trace is not None:
+                # the placement DECISION span: queue wait ends here and
+                # the per-replica attempt begins, carrying why this
+                # replica won (affinity vs load)
+                req.trace.placed(
+                    getattr(best, "name", "?"), now=now,
+                    candidates=len(cands), affinity=affinity_hit)
             placements.append((best, req))
         return placements
 

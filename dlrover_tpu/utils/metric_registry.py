@@ -1,17 +1,23 @@
 """Single source of truth for exported Prometheus metric names.
 
-Every ``serving_*`` metric-name literal in the package must be declared
-here with help text — dlint's DL006 (``tools/dlint``) enforces it, so a
-dashboard, the autoscaler, and the docs can never fork on a misspelled
-or half-renamed series.  The exporter renders these as ``# HELP`` lines on
-``/metrics``, which makes the registry visible to every scraper, not
-just to readers of this file.
+Every ``serving_*`` or ``dlrover_*`` metric-name literal in the package
+must be declared here with help text — dlint's DL006 (``tools/dlint``)
+enforces it, so a dashboard, the autoscaler, and the docs can never
+fork on a misspelled or half-renamed series.  The exporter renders
+these as ``# HELP`` lines on ``/metrics``, which makes the registry
+visible to every scraper, not just to readers of this file.
 
 Adding a metric: add the name + help here, then emit it from your
-``metrics()`` source.  Using a ``serving_``-prefixed string that is NOT
-a metric (an RPC kind, a table name): add it to
-:data:`NON_METRIC_SERVING_NAMES` — the registry arbitrates the whole
-``serving_`` string namespace.
+``metrics()`` source.  Using a ``serving_``- or ``dlrover_``-prefixed
+string that is NOT a metric (an RPC kind, a table name, the package
+name itself): add it to :data:`NON_METRIC_SERVING_NAMES` — the
+registry arbitrates both string namespaces.
+
+Families emitted via f-string prefixes (``dlrover_step_*`` from
+``StepTimer.metrics``, ``dlrover_xprof_*`` from ``AutoProfiler``) are
+declared here too even though DL006's literal scan cannot see the
+joined names — the registry is the documentation surface, not just the
+lint allowlist.
 """
 
 from __future__ import annotations
@@ -50,15 +56,80 @@ METRIC_HELP: Dict[str, str] = {
         "requests failed for exceeding the failover-replay cap — "
         "nonzero says some request was crashing replicas"
     ),
+    # -- per-request span tracing (utils/tracing.Tracer.metrics) -------
+    "serving_request_trace_finished_total": (
+        "request traces completed into the tracer's bounded ring"
+    ),
+    "serving_request_trace_active": (
+        "traces still open (admitted requests not yet done/aborted)"
+    ),
+    "serving_request_trace_ring_size": (
+        "finished traces currently held in the in-memory ring "
+        "(bounded; served by the /traces endpoint)"
+    ),
+    "serving_request_trace_slowest_seconds": (
+        "duration of the slowest trace in the ring — the /traces/"
+        "slowest view names the request and the span the time went to"
+    ),
+    "serving_request_trace_orphan_spans_total": (
+        "remote worker spans that arrived for an unknown trace "
+        "(late DONE after failover) and were dropped"
+    ),
+    "serving_request_trace_flight_dumps_total": (
+        "flight-recorder dumps emitted (deadline expiry, poisoning, "
+        "replica death) — each is one structured log record with the "
+        "request's span tree and the last fabric events"
+    ),
+    # -- exporter self-observability (utils/profiler.MetricsExporter) --
+    "dlrover_metrics_source_errors_total": (
+        "metric-source callables that raised during a /metrics scrape "
+        "— nonzero says some series on this endpoint are silently "
+        "missing/stale"
+    ),
+    # -- step timing (StepTimer.metrics, prefix dlrover_step) ----------
+    "dlrover_step_count": "train/serve steps observed by the StepTimer",
+    "dlrover_step_seconds_ema": "EMA of per-step wall seconds",
+    "dlrover_step_seconds_last": "wall seconds of the most recent step",
+    "dlrover_step_seconds_p50": "reservoir p50 of per-step wall seconds",
+    "dlrover_step_seconds_p99": "reservoir p99 of per-step wall seconds",
+    "dlrover_step_seconds_total": "cumulative step wall seconds",
+    # -- xprof auto-profiling (utils/xprof_metrics.AutoProfiler) -------
+    "dlrover_xprof_profiles_total": "xprof captures taken so far",
+    "dlrover_xprof_last_capture_timestamp": (
+        "unix time of the most recent xprof capture"
+    ),
+    "dlrover_xprof_device_seconds": (
+        "total device time of the last captured step"
+    ),
+    "dlrover_xprof_collective_seconds_total": (
+        "device time in collectives during the last captured step"
+    ),
+    "dlrover_xprof_collective_seconds": (
+        "per-collective device time of the last captured step "
+        "(labeled op=...)"
+    ),
+    "dlrover_xprof_op_seconds": (
+        "per-op device time of the last captured step (labeled op=...)"
+    ),
+    "dlrover_xprof_op_count": (
+        "per-op execution count of the last captured step "
+        "(labeled op=...)"
+    ),
 }
 
-#: ``serving_``-prefixed strings that are deliberately NOT metric names
-#: (RPC message kinds, datastore table names).  Kept here so DL006 can
-#: tell "known protocol vocabulary" from "accidentally minted metric".
+#: ``serving_``- or ``dlrover_``-prefixed strings that are deliberately
+#: NOT metric names (RPC message kinds, datastore table names, the
+#: package name, family prefixes).  Kept here so DL006 can tell "known
+#: protocol vocabulary" from "accidentally minted metric".
 NON_METRIC_SERVING_NAMES = frozenset({
     "serving_plan",      # BrainService RPC kind (brain/service.py)
     "serving_samples",   # datastore table (brain/datastore.py DDL)
     "serving_history",   # datastore query name
+    "dlrover_tpu",       # the package/logger/namespace name itself
+    "dlrover_step",      # StepTimer.metrics prefix (family above)
+    "dlrover_xprof_",    # tempdir prefix (utils/xprof_metrics.py)
+    "dlrover_tpu_ckpt",  # shared-memory segment prefix (shm_handler)
+    "dlrover_tpu_factory",  # multi-process queue name (constants.py)
 })
 
 

@@ -25,10 +25,13 @@ from __future__ import annotations
 
 import contextlib
 import http.server
+import json
 import random
 import threading
 import time
 from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
 
 
 class StepTimer:
@@ -158,6 +161,18 @@ def trace(log_dir: str, host_tracer_level: int = 2):
         jax.profiler.stop_trace()
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote and newline must be escaped or a value
+    containing them corrupts every sample after it on the scrape."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def render_prometheus(
     metrics: Dict[str, float],
     labels: Optional[Dict[str, str]] = None,
@@ -169,7 +184,10 @@ def render_prometheus(
     scraper."""
     label_str = ""
     if labels:
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        inner = ",".join(
+            f'{k}="{escape_label_value(v)}"'
+            for k, v in sorted(labels.items())
+        )
         label_str = "{" + inner + "}"
     lines = []
     for name in sorted(metrics):
@@ -180,13 +198,29 @@ def render_prometheus(
 
 
 class MetricsExporter:
-    """Serves ``/metrics`` (Prometheus text) + ``/healthz`` on a local port
-    (per-process, like xpu_timer's per-rank exporter ports)."""
+    """Serves ``/metrics`` (Prometheus text) + ``/healthz`` on a local
+    port (per-process, like xpu_timer's per-rank exporter ports).  With
+    a tracer attached (:meth:`attach_tracer`) it also serves the
+    request-trace debugging views: ``/traces`` (recent finished span
+    trees + flight-recorder dumps, JSON) and ``/traces/slowest``
+    (ranked by duration — where the tail latency lives)."""
 
     def __init__(self, port: int = 0, labels: Optional[Dict[str, str]] = None):
         self._labels = labels or {}
         self._sources = []  # callables returning Dict[str, float]
         self._text_sources = []  # callables returning Prometheus text
+        self._tracer = None  # utils/tracing.Tracer, via attach_tracer
+        # a failing source must be VISIBLE: silently dropping it makes
+        # a dashboard go quietly stale (satellite of ISSUE 4) — each
+        # failure counts into dlrover_metrics_source_errors_total and
+        # logs once per source (not once per scrape: a broken source on
+        # a 15s scrape cadence must not flood the log).  Guarded by a
+        # lock: ThreadingHTTPServer serves concurrent scrapes, and an
+        # unguarded += here would under-count (and double-log) when two
+        # scrapers race
+        self._error_lock = threading.Lock()
+        self._source_errors = 0
+        self._sources_logged = set()
         exporter = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -195,26 +229,16 @@ class MetricsExporter:
                     body = b"ok"
                     ctype = "text/plain"
                 elif self.path.startswith("/metrics"):
-                    from dlrover_tpu.utils.metric_registry import (
-                        METRIC_HELP,
-                    )
-
-                    merged: Dict[str, float] = {}
-                    for src in exporter._sources:
-                        try:
-                            merged.update(src())
-                        except Exception:
-                            pass
-                    body = render_prometheus(
-                        merged, exporter._labels, help_map=METRIC_HELP
-                    )
-                    for src in exporter._text_sources:
-                        try:
-                            body += src()
-                        except Exception:
-                            pass
-                    body = body.encode()
+                    body = exporter._render_metrics().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/traces"):
+                    payload = exporter._render_traces(self.path)
+                    if payload is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = payload.encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -240,6 +264,59 @@ class MetricsExporter:
         """``fn() -> str`` of ready-made Prometheus text appended at
         scrape time (e.g. NativeTracer.export_prometheus)."""
         self._text_sources.append(fn)
+
+    def attach_tracer(self, tracer) -> None:
+        """Wire a :class:`~dlrover_tpu.utils.tracing.Tracer`: enables
+        ``/traces`` + ``/traces/slowest`` and merges the tracer's
+        ``serving_request_trace_*`` gauges into ``/metrics``."""
+        self._tracer = tracer
+        self.add_source(tracer.metrics)
+
+    # ---------------------------------------------------------- render
+    def _note_source_error(self, src) -> None:
+        key = getattr(src, "__qualname__", None) or repr(src)
+        with self._error_lock:
+            self._source_errors += 1
+            first = key not in self._sources_logged
+            self._sources_logged.add(key)
+        if first:
+            logger.warning(
+                "metrics source %s failed; its series are missing from "
+                "/metrics (logged once; see "
+                "dlrover_metrics_source_errors_total)", key,
+                exc_info=True)
+
+    def _render_metrics(self) -> str:
+        from dlrover_tpu.utils.metric_registry import METRIC_HELP
+
+        merged: Dict[str, float] = {}
+        for src in self._sources:
+            try:
+                merged.update(src())
+            except Exception:
+                self._note_source_error(src)
+        merged["dlrover_metrics_source_errors_total"] = float(
+            self._source_errors)
+        body = render_prometheus(
+            merged, self._labels, help_map=METRIC_HELP)
+        for src in self._text_sources:
+            try:
+                body += src()
+            except Exception:
+                self._note_source_error(src)
+        return body
+
+    def _render_traces(self, path: str) -> Optional[str]:
+        if self._tracer is None:
+            return None
+        if path.startswith("/traces/slowest"):
+            return json.dumps({
+                "traces": self._tracer.slowest(10),
+            }, default=str)
+        return json.dumps({
+            "traces": self._tracer.finished(50),
+            "flight_dumps": list(self._tracer.recorder.dumps),
+        }, default=str)
 
     def start(self) -> None:
         if self._thread is not None:

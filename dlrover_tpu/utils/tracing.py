@@ -1,0 +1,496 @@
+"""Per-request span tracing for the serving fabric (stdlib-only).
+
+The metrics surface (profiler.py, router/metrics.py) answers "how is
+the fleet doing *on aggregate*"; this module answers the question those
+gauges cannot: for THIS request, *where did the time go* — queue wait,
+placement, the SUBMIT round trip, worker-side decode, first token,
+retry after a replica death?  The design is a small Dapper/W3C-style
+tracer:
+
+- :class:`Span` — one timed operation with ``trace_id`` / ``span_id`` /
+  ``parent_id`` links, monotonic timestamps and free-form attrs;
+- :class:`Tracer` — creates spans, holds active traces, and keeps a
+  **bounded ring** of finished traces (old traces fall off; a tracer
+  can run forever without growing);
+- traceparent helpers — ``00-<32 hex>-<16 hex>-01`` context strings the
+  remote frame protocol carries in SUBMIT/TOKEN/DONE headers, so
+  worker-side spans come back and are **grafted** into the request's
+  trace (:meth:`Tracer.graft` shifts nothing itself — the proxy
+  translates worker clocks to router clocks before grafting, see
+  serving/remote/proxy.py);
+- :class:`RequestTrace` — the serving request's span vocabulary
+  (``request`` root, ``queued``, per-placement ``attempt`` with
+  ``submit`` / ``first_token`` children) so gateway/scheduler/replica
+  code stays one guarded line per hop;
+- :class:`FlightRecorder` — a bounded ring of fabric events (replica
+  join/death, requeue, poison, expiry) plus structured **dumps**: on a
+  deadline expiry, a poisoning, or a replica death the request's whole
+  span tree and the last N fabric events are emitted as ONE log record,
+  so a chaos postmortem does not require replaying the run.
+
+Everything here is dict/deque bookkeeping under short private locks —
+no I/O, no blocking calls — so stamping spans from under the router or
+gateway lock adds no stall surface (dlint DL003 stays clean).
+
+Timestamps are ``time.monotonic()`` (span math must survive clock
+steps); each trace also records one wall-clock anchor at creation so
+exports can place the trace in absolute time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+TRACEPARENT_VERSION = "00"
+
+
+def new_trace_id() -> str:
+    """128-bit random trace id, W3C-trace-context shaped (32 hex)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """64-bit random span id (16 hex)."""
+    return os.urandom(8).hex()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace>-<span>-01`` (always sampled: the ring is the cap)."""
+    return f"{TRACEPARENT_VERSION}-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value) -> Optional[Tuple[str, str]]:
+    """``(trace_id, span_id)`` from a traceparent string, or ``None``
+    for anything malformed — a bad header degrades to "untraced", never
+    to an error on the data plane."""
+    if not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed operation inside a trace."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start: float                     # monotonic
+    end: Optional[float] = None
+    status: str = "ok"
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def finish(self, now: Optional[float] = None,
+               status: Optional[str] = None) -> "Span":
+        if self.end is None:
+            self.end = time.monotonic() if now is None else now
+            if status is not None:
+                self.status = status
+        return self
+
+    def to_dict(self, t0: float = 0.0) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "offset_s": round(self.start - t0, 6),
+            "duration_s": (
+                None if self.end is None
+                else round(self.end - self.start, 6)
+            ),
+            "status": self.status,
+            "attrs": dict(self.attrs),
+        }
+
+
+class Trace:
+    """All spans of one trace (internal record; export via ``tree``)."""
+
+    def __init__(self, root: Span, wall_anchor: Optional[float] = None):
+        self.root = root
+        self.spans: List[Span] = [root]
+        # wall-clock anchor for exports; spans themselves are monotonic
+        self.wall_anchor = time.time() if wall_anchor is None \
+            else wall_anchor
+        self.status = "active"
+
+    @property
+    def trace_id(self) -> str:
+        return self.root.trace_id
+
+    @property
+    def duration(self) -> float:
+        end = self.root.end
+        if end is None:
+            end = max(
+                (s.end for s in self.spans if s.end is not None),
+                default=self.root.start,
+            )
+        return end - self.root.start
+
+    def tree(self) -> Dict[str, object]:
+        """The nested span tree (JSON-ready)."""
+        t0 = self.root.start
+        by_id: Dict[str, Dict[str, object]] = {}
+        for s in self.spans:
+            d = s.to_dict(t0)
+            d["children"] = []
+            by_id[s.span_id] = d
+        roots: List[Dict[str, object]] = []
+        for s in self.spans:
+            d = by_id[s.span_id]
+            parent = by_id.get(s.parent_id or "")
+            if parent is not None and parent is not d:
+                parent["children"].append(d)
+            else:
+                roots.append(d)
+        return {
+            "trace_id": self.trace_id,
+            "name": self.root.name,
+            "status": self.status,
+            "start_unix": round(self.wall_anchor, 6),
+            "duration_s": round(self.duration, 6),
+            "spans": roots,
+        }
+
+
+class FlightRecorder:
+    """Bounded fabric-event ring + structured failure dumps.
+
+    ``record()`` appends one event (cheap, lock-only).  ``dump()`` is
+    the black-box readout: it snapshots the last events next to the
+    failing request's span tree and emits them as ONE structured log
+    record (single line, JSON payload) — the self-explaining postmortem
+    for a deadline expiry, a poisoning, or a replica death.  Dumps are
+    also kept in a bounded ring so tests and the ``/traces`` surface
+    can read them without scraping logs.
+    """
+
+    def __init__(self, event_capacity: int = 256,
+                 dump_capacity: int = 32):
+        self._lock = threading.Lock()
+        self._events: Deque[Dict[str, object]] = deque(
+            maxlen=int(event_capacity))
+        self.dumps: Deque[Dict[str, object]] = deque(
+            maxlen=int(dump_capacity))
+        self.dumps_total = 0
+
+    def record(self, kind: str, now: Optional[float] = None,
+               **fields) -> None:
+        event = {"kind": kind,
+                 "t": time.monotonic() if now is None else now}
+        event.update(fields)
+        with self._lock:
+            self._events.append(event)
+
+    def events(self, limit: int = 64) -> List[Dict[str, object]]:
+        with self._lock:
+            return list(self._events)[-int(limit):]
+
+    def dump(self, reason: str, trace_tree: Optional[Dict[str, object]],
+             now: Optional[float] = None,
+             last_events: int = 64) -> Dict[str, object]:
+        record = {
+            "reason": reason,
+            "t": time.monotonic() if now is None else now,
+            "trace": trace_tree,
+            "recent_events": self.events(last_events),
+        }
+        with self._lock:
+            self.dumps.append(record)
+            self.dumps_total += 1
+        try:
+            payload = json.dumps(record, default=str)
+        except (TypeError, ValueError):  # unserializable attr snuck in
+            payload = repr(record)
+        logger.error("FLIGHT-RECORDER %s trace=%s %s",
+                     reason,
+                     (trace_tree or {}).get("trace_id", "?"),
+                     payload)
+        return record
+
+
+class Tracer:
+    """Span factory + bounded in-memory store of finished traces."""
+
+    def __init__(self, ring_capacity: int = 512, max_active: int = 4096,
+                 recorder: Optional[FlightRecorder] = None):
+        self._lock = threading.Lock()
+        self._active: "OrderedDict[str, Trace]" = OrderedDict()
+        self._ring: Deque[Trace] = deque(maxlen=int(ring_capacity))
+        self.max_active = int(max_active)
+        self.recorder = recorder or FlightRecorder()
+        self.finished_total = 0
+        self.orphan_spans_total = 0
+
+    # ----------------------------------------------------------- spans
+    def start_trace(self, name: str, now: Optional[float] = None,
+                    **attrs) -> Span:
+        now = time.monotonic() if now is None else now
+        root = Span(
+            trace_id=new_trace_id(), span_id=new_span_id(),
+            parent_id=None, name=name, start=now, attrs=dict(attrs),
+        )
+        trace = Trace(root)
+        with self._lock:
+            self._active[root.trace_id] = trace
+            # bound active traces: a submitted-but-never-pumped request
+            # must not leak memory forever — oldest evicts to the ring
+            while len(self._active) > self.max_active:
+                _, stale = self._active.popitem(last=False)
+                stale.status = "evicted"
+                self._ring.append(stale)
+        return root
+
+    def start_span(self, parent: Span, name: str,
+                   now: Optional[float] = None, **attrs) -> Span:
+        now = time.monotonic() if now is None else now
+        span = Span(
+            trace_id=parent.trace_id, span_id=new_span_id(),
+            parent_id=parent.span_id, name=name, start=now,
+            attrs=dict(attrs),
+        )
+        with self._lock:
+            trace = self._active.get(parent.trace_id)
+            if trace is not None:
+                trace.spans.append(span)
+        return span
+
+    def finish_trace(self, root: Span, now: Optional[float] = None,
+                     status: str = "ok") -> None:
+        root.finish(now, status=status)
+        with self._lock:
+            trace = self._active.pop(root.trace_id, None)
+            if trace is None:
+                return
+            trace.status = status
+            self._ring.append(trace)
+            self.finished_total += 1
+
+    # ----------------------------------------------------------- graft
+    def graft(self, trace_id: str, parent_span_id: str,
+              spans: List[Dict[str, object]]) -> int:
+        """Attach remote-side spans (already translated to THIS
+        process's monotonic clock by the caller) under
+        ``parent_span_id``.  Span dicts: ``name``/``start``/``end``,
+        optional ``attrs`` and ``parent`` (the *name* of an earlier
+        span in the same batch, for nesting).  Spans for an unknown
+        trace — a DONE that raced past completion, a late frame after
+        failover — are counted as orphans and dropped, never an error:
+        observability must not add failure modes."""
+        if not spans:
+            return 0
+        with self._lock:
+            trace = self._find_locked(trace_id)
+            if trace is None:
+                self.orphan_spans_total += len(spans)
+                return 0
+            by_name: Dict[str, str] = {}
+            grafted = 0
+            for raw in spans:
+                try:
+                    name = str(raw["name"])
+                    start = float(raw["start"])
+                    end = float(raw["end"])
+                except (KeyError, TypeError, ValueError):
+                    self.orphan_spans_total += 1
+                    continue
+                parent = by_name.get(str(raw.get("parent", "")),
+                                     parent_span_id)
+                span = Span(
+                    trace_id=trace_id, span_id=new_span_id(),
+                    parent_id=parent, name=name, start=start, end=end,
+                    attrs=dict(raw.get("attrs") or {}),
+                )
+                trace.spans.append(span)
+                by_name[name] = span.span_id
+                grafted += 1
+            return grafted
+
+    def _find_locked(self, trace_id: str) -> Optional[Trace]:
+        trace = self._active.get(trace_id)
+        if trace is not None:
+            return trace
+        for t in self._ring:  # bounded by ring_capacity
+            if t.trace_id == trace_id:
+                return t
+        return None
+
+    # ---------------------------------------------------------- export
+    def get_tree(self, trace_id: str) -> Optional[Dict[str, object]]:
+        with self._lock:
+            trace = self._find_locked(trace_id)
+            return None if trace is None else trace.tree()
+
+    def finished(self, limit: int = 50) -> List[Dict[str, object]]:
+        """Most recent finished traces, newest last."""
+        with self._lock:
+            traces = list(self._ring)[-int(limit):]
+        return [t.tree() for t in traces]
+
+    def slowest(self, limit: int = 10) -> List[Dict[str, object]]:
+        """Finished traces ranked by duration, slowest first — the
+        ``/traces/slowest`` debugging view: which requests blew their
+        budget, and inside which span."""
+        with self._lock:
+            traces = sorted(
+                self._ring, key=lambda t: -t.duration)[:int(limit)]
+        return [t.tree() for t in traces]
+
+    def flight_dump(self, reason: str, trace_id: str,
+                    now: Optional[float] = None) -> Dict[str, object]:
+        return self.recorder.dump(
+            reason, self.get_tree(trace_id), now=now)
+
+    def metrics(self) -> Dict[str, float]:
+        """Prometheus source (``MetricsExporter.add_source``)."""
+        with self._lock:
+            ring = len(self._ring)
+            active = len(self._active)
+            slowest = max(
+                (t.duration for t in self._ring), default=0.0)
+        return {
+            "serving_request_trace_finished_total": float(
+                self.finished_total),
+            "serving_request_trace_active": float(active),
+            "serving_request_trace_ring_size": float(ring),
+            "serving_request_trace_slowest_seconds": float(slowest),
+            "serving_request_trace_orphan_spans_total": float(
+                self.orphan_spans_total),
+            "serving_request_trace_flight_dumps_total": float(
+                self.recorder.dumps_total),
+        }
+
+
+class RequestTrace:
+    """One serving request's span vocabulary, so fabric code stays a
+    guarded one-liner per hop:
+
+    - ``request`` (root) — admission to completion;
+    - ``queued`` — gateway wait (one per attempt: a failover requeue
+      opens a fresh one);
+    - ``attempt`` — one placement on one replica (attrs: replica,
+      attempt number; a dead replica leaves it closed as ``failover``
+      and the retry opens the next one — postmortems see BOTH);
+    - ``submit`` — the engine admission / remote SUBMIT round trip;
+    - ``first_token`` — zero-length marker at true first-token time;
+    - worker-side spans grafted under the attempt they served.
+    """
+
+    def __init__(self, tracer: Tracer, rid: int,
+                 now: Optional[float] = None, **attrs):
+        self.tracer = tracer
+        self.root = tracer.start_trace(
+            "request", now=now, rid=rid, **attrs)
+        self.queued: Optional[Span] = tracer.start_span(
+            self.root, "queued", now=now)
+        self.attempt: Optional[Span] = None
+        self.submit: Optional[Span] = None
+        self.attempts = 0
+
+    @property
+    def trace_id(self) -> str:
+        return self.root.trace_id
+
+    # -------------------------------------------------------- lifecycle
+    def placed(self, replica: str, now: Optional[float] = None,
+               **attrs) -> None:
+        if self.queued is not None:
+            self.queued.finish(now)
+            self.queued = None
+        self.attempts += 1
+        self.attempt = self.tracer.start_span(
+            self.root, "attempt", now=now,
+            replica=replica, attempt=self.attempts, **attrs)
+
+    def submit_started(self, now: Optional[float] = None) -> None:
+        self.submit = self.tracer.start_span(
+            self.attempt or self.root, "submit", now=now)
+
+    def submit_finished(self, now: Optional[float] = None,
+                        status: str = "ok") -> None:
+        if self.submit is not None:
+            self.submit.finish(now, status=status)
+            self.submit = None
+
+    def first_token(self, now: Optional[float] = None) -> None:
+        span = self.tracer.start_span(
+            self.attempt or self.root, "first_token", now=now)
+        span.finish(now)
+
+    def traceparent(self) -> str:
+        """Context string the remote SUBMIT frame carries: worker-side
+        spans parent under the CURRENT attempt, so a retry's worker
+        time lands under the retry, not the dead first attempt."""
+        parent = self.attempt or self.root
+        return format_traceparent(self.root.trace_id, parent.span_id)
+
+    def graft_worker_spans(
+            self, spans: Optional[List[Dict[str, object]]]) -> int:
+        if not spans:
+            return 0
+        parent = self.attempt or self.root
+        return self.tracer.graft(
+            self.root.trace_id, parent.span_id, spans)
+
+    def failover(self, reason: str,
+                 now: Optional[float] = None) -> None:
+        """The replica serving this attempt died: close the attempt as
+        ``failover`` (it stays in the tree — the postmortem shows the
+        dead-replica attempt AND the retry) and reopen a queue span."""
+        if self.submit is not None:
+            self.submit.finish(now, status="failover")
+            self.submit = None
+        if self.attempt is not None:
+            self.attempt.attrs["failover_reason"] = reason
+            self.attempt.finish(now, status="failover")
+            self.attempt = None
+        if self.queued is not None:
+            # requeued while still waiting (never placed): close the
+            # open queue span rather than leaking a dangling one
+            self.queued.finish(now, status="failover")
+        self.queued = self.tracer.start_span(
+            self.root, "queued", now=now, requeue=True)
+
+    def finished(self, now: Optional[float] = None) -> None:
+        self._close_open(now, "ok")
+        self.tracer.finish_trace(self.root, now=now, status="ok")
+
+    def aborted(self, status: str,
+                now: Optional[float] = None) -> None:
+        self._close_open(now, status)
+        self.tracer.finish_trace(self.root, now=now, status=status)
+
+    def _close_open(self, now: Optional[float], status: str) -> None:
+        if self.submit is not None:
+            self.submit.finish(now, status=status)
+            self.submit = None
+        if self.attempt is not None:
+            self.attempt.finish(now, status=status)
+            self.attempt = None
+        if self.queued is not None:
+            self.queued.finish(now, status=status)
+            self.queued = None

@@ -83,15 +83,21 @@ def parse_xplane_dir(log_dir: str) -> Dict[str, Dict[str, float]]:
                     if line.name != "XLA Ops":
                         continue
                     _aggregate(line, metadata, device_ops)
-            elif plane.name.startswith("/host:CPU"):
-                # CPU backend (tests): ops land on the PjRt-CPU-client
-                # listener line, names are plain op names with "end:"
-                # region markers to skip
+            elif plane.name.startswith("/host:"):
+                # CPU backend (tests): executed ops land on the XLA
+                # listener lines, whose names vary across jax versions
+                # ("tf_XLAPjRt..." on older releases, "tf_XLAEigen/..."
+                # and "tf_XLATfrtCpuClient/..." on newer ones) — match
+                # the stable "tf_XLA" stem.  Names are plain op names;
+                # skip the region/bookkeeping markers interleaved with
+                # them ("end:" pairs, ThreadpoolListener regions, the
+                # ThunkExecutor completion wait).
                 for line in plane.lines:
-                    if not line.name.startswith("tf_XLAPjRt"):
+                    if not line.name.startswith("tf_XLA"):
                         continue
                     _aggregate(line, metadata, host_ops,
-                               skip_prefixes=("end:", "Thread"))
+                               skip_prefixes=("end:", "Thread",
+                                              "ThunkExecutor"))
     # device planes are authoritative; the host table only stands in
     # when no accelerator plane exists (CPU test runs)
     return device_ops or host_ops

@@ -73,6 +73,60 @@ def _post_restart(streamed):
     return streamed[i + 1:]
 
 
+def _span_names(tree):
+    out = []
+
+    def walk(spans):
+        for s in spans:
+            out.append(s["name"])
+            walk(s["children"])
+
+    walk(tree["spans"])
+    return out
+
+
+def _spans_named(tree, name):
+    found = []
+
+    def walk(spans):
+        for s in spans:
+            if s["name"] == name:
+                found.append(s)
+            walk(s["children"])
+
+    walk(tree["spans"])
+    return found
+
+
+def _assert_traces_cover_fabric_run(router, reqs):
+    """ISSUE 4 acceptance: every completed request's trace covers
+    admission -> placement -> submit -> first-token -> done; requeued
+    requests show the dead-replica attempt AND the successful retry;
+    the flight recorder dumped at least one failed-over request."""
+    for r in reqs:
+        tree = router.tracer.get_tree(r.trace.trace_id)
+        assert tree is not None and tree["status"] == "ok", r.rid
+        names = _span_names(tree)
+        for expected in ("queued", "attempt", "submit", "first_token",
+                         "worker.request", "worker.decode"):
+            assert expected in names, (r.rid, names)
+        attempts = _spans_named(tree, "attempt")
+        assert len(attempts) == r.requeues + 1, r.rid
+        if r.requeues:
+            statuses = [a["status"] for a in attempts]
+            assert "failover" in statuses and statuses[-1] == "ok", \
+                (r.rid, statuses)
+            replicas = {a["attrs"]["replica"] for a in attempts}
+            assert len(replicas) >= 2, \
+                "retry must show a different replica than the dead one"
+    dumps = [d for d in router.recorder.dumps
+             if d["reason"] == "replica_death"]
+    assert dumps, "flight recorder must dump on replica death"
+    assert dumps[0]["trace"] is not None
+    assert any(e["kind"] == "replica_dead"
+               for e in dumps[-1]["recent_events"])
+
+
 def _can_spawn() -> bool:
     try:
         subprocess.run(
@@ -339,6 +393,31 @@ def test_remote_crash_failover_zero_lost_and_stream_restart(
     streamed = list(requeued[0].stream(timeout=1.0))
     assert STREAM_RESTART in streamed
     assert _post_restart(streamed) == list(requeued[0].result(timeout=0))
+    # every request's span trace covers the full path, failovers show
+    # both attempts, and the flight recorder captured the death
+    _assert_traces_cover_fabric_run(router, reqs)
+    # /traces serves the ring + flight dumps over HTTP
+    import json as json_mod
+    import urllib.request
+
+    from dlrover_tpu.utils.profiler import MetricsExporter
+
+    exporter = MetricsExporter()
+    exporter.attach_tracer(router.tracer)
+    exporter.start()
+    try:
+        body = json_mod.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/traces",
+            timeout=5).read().decode())
+        assert body["traces"], "/traces must serve the finished ring"
+        assert body["flight_dumps"]
+        slow = json_mod.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/traces/slowest",
+            timeout=5).read().decode())
+        durations = [t["duration_s"] for t in slow["traces"]]
+        assert durations == sorted(durations, reverse=True)
+    finally:
+        exporter.stop()
 
 
 # -- poison-request cap ------------------------------------------------------
@@ -593,6 +672,16 @@ def test_chaos_sigkill_worker_zero_lost_requests():
         assert STREAM_RESTART in streamed
         assert _post_restart(streamed) == \
             list(requeued[0].result(timeout=0))
+        # ISSUE 4 acceptance: the SIGKILL postmortem is self-explaining
+        # — every request's trace covers admission -> placement ->
+        # submit -> first-token -> done with worker-side spans grafted,
+        # requeued ones show the dead attempt AND the retry, and the
+        # flight recorder dumped the failover (with the supervisor's
+        # worker_exit/worker_spawn events in the event ring)
+        _assert_traces_cover_fabric_run(router, reqs)
+        event_kinds = {e["kind"] for e in router.recorder.events(256)}
+        assert "worker_spawn" in event_kinds
+        assert "worker_exit" in event_kinds
 
 
 @pytest.mark.slow

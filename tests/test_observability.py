@@ -196,6 +196,78 @@ def test_render_prometheus_format():
     assert text == 'a_metric{node="w0"} 1.5\n'
 
 
+def test_render_prometheus_escapes_label_values():
+    """Exposition-format escaping: an unescaped quote/backslash/newline
+    in a label value corrupts every sample after it on the scrape."""
+    text = render_prometheus(
+        {"a_metric": 1.0},
+        {"path": 'C:\\tmp', "msg": 'say "hi"\nbye'},
+    )
+    assert text == (
+        'a_metric{msg="say \\"hi\\"\\nbye",path="C:\\\\tmp"} 1.0\n'
+    )
+    assert "\n" not in text[:-1].replace("\\n", "")
+
+
+def test_metrics_exporter_counts_and_logs_failing_sources():
+    """A raising source must not vanish silently: it is counted into
+    dlrover_metrics_source_errors_total and logged once per source
+    (the 'dlrover_tpu' logger is non-propagating, so the once-per-
+    source gate is asserted through the exporter's own bookkeeping)."""
+    exporter = MetricsExporter()
+
+    def bad_source():
+        raise RuntimeError("boom")
+
+    exporter.add_source(bad_source)
+    exporter.add_source(lambda: {"dlrover_step_count": 1.0})
+    exporter.start()
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        body1 = urllib.request.urlopen(url, timeout=5).read().decode()
+        body2 = urllib.request.urlopen(url, timeout=5).read().decode()
+        # the healthy source still renders; the failure is visible
+        assert "dlrover_step_count 1.0" in body1
+        assert "dlrover_metrics_source_errors_total 1.0" in body1
+        assert "dlrover_metrics_source_errors_total 2.0" in body2
+        logged = [k for k in exporter._sources_logged if "bad_source" in k]
+        assert len(exporter._sources_logged) == 1 and logged, \
+            "log once per source, not per scrape"
+    finally:
+        exporter.stop()
+
+
+def test_window_gauge_trims_exactly_at_boundary():
+    """A sample exactly window_seconds old sits ON the cutoff and must
+    be kept (strict <): off-by-one trims silently bias the mean the
+    autoscaler keys off."""
+    from dlrover_tpu.utils.profiler import WindowGauge
+
+    g = WindowGauge(window_seconds=10.0)
+    g.observe(1.0, now=100.0)
+    g.observe(3.0, now=105.0)
+    # now=110: the t=100 sample is exactly at the cutoff (110-10) -> kept
+    assert g.mean(now=110.0) == pytest.approx(2.0)
+    # one tick past the window: dropped
+    assert g.mean(now=110.0 + 1e-6) == pytest.approx(3.0)
+    # far past the window every sample ages out
+    assert g.max(now=120.0) == 0.0
+
+
+def test_window_gauge_empty_window_rates_and_stats_are_zero():
+    from dlrover_tpu.utils.profiler import WindowGauge
+
+    g = WindowGauge(window_seconds=5.0)
+    assert g.rate() == 0.0
+    assert g.mean() == 0.0
+    assert g.max() == 0.0
+    g.observe(10.0, now=50.0)
+    assert g.rate(now=50.0) == pytest.approx(2.0)  # 10 over a 5s window
+    # everything aged out: rate decays to exactly zero, not NaN
+    assert g.rate(now=100.0) == 0.0
+    assert g.mean(now=100.0) == 0.0
+
+
 # -- native tracer (xpu_timer counterpart) ----------------------------------
 
 def _native_timer_or_skip():

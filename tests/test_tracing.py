@@ -1,0 +1,389 @@
+"""End-to-end request tracing + flight recorder (utils/tracing.py).
+
+The debugging surface ISSUE 4 adds on top of the aggregate metrics:
+every serving request carries a span trace (admission -> placement ->
+submit -> first token -> done, with worker-side spans grafted over the
+frame protocol), ``/traces`` serves the ring, and the flight recorder
+turns deadline expiries / poisonings / replica deaths into one
+structured, self-explaining log record.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.constants import ServingRequestState
+from dlrover_tpu.serving.router import (
+    ContinuousBatchScheduler,
+    RequestGateway,
+    ServingRouter,
+)
+from dlrover_tpu.utils.profiler import MetricsExporter
+from dlrover_tpu.utils.tracing import (
+    FlightRecorder,
+    Tracer,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+
+
+def _prompt(i, n=8):
+    return np.full(n, i % 251, np.int32)
+
+
+def _names(tree):
+    """All span names in a trace tree, depth-first."""
+    out = []
+
+    def walk(spans):
+        for s in spans:
+            out.append(s["name"])
+            walk(s["children"])
+
+    walk(tree["spans"])
+    return out
+
+
+def _find(tree, name):
+    found = []
+
+    def walk(spans):
+        for s in spans:
+            if s["name"] == name:
+                found.append(s)
+            walk(s["children"])
+
+    walk(tree["spans"])
+    return found
+
+
+# -- ids + traceparent -------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    tid, sid = new_trace_id(), new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    assert parse_traceparent(format_traceparent(tid, sid)) == (tid, sid)
+
+
+@pytest.mark.parametrize("bad", [
+    None, 17, "", "nonsense", "00-short-short-01",
+    "00-" + "g" * 32 + "-" + "0" * 16 + "-01",   # non-hex
+    "00-" + "0" * 32 + "-" + "0" * 8 + "-01",    # short span id
+])
+def test_traceparent_malformed_degrades_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_traceparent_roundtrip_over_frames():
+    """The context string survives the msgpack frame protocol — what
+    the SUBMIT header actually carries between router and worker."""
+    import socket
+
+    from dlrover_tpu.serving.remote.protocol import (
+        FrameConnection,
+        FrameKind,
+    )
+
+    tid, sid = new_trace_id(), new_span_id()
+    a, b = socket.socketpair()
+    left, right = FrameConnection(a), FrameConnection(b)
+    left.send(FrameKind.SUBMIT, rid=1, prompt=[1, 2],
+              max_new_tokens=4, trace=format_traceparent(tid, sid))
+    frame = right.recv(timeout=2.0)
+    assert parse_traceparent(frame["trace"]) == (tid, sid)
+    left.close()
+    right.close()
+
+
+# -- tracer mechanics --------------------------------------------------------
+
+
+def test_ring_evicts_oldest_finished_trace():
+    tracer = Tracer(ring_capacity=3)
+    roots = [tracer.start_trace("request", now=float(i), rid=i)
+             for i in range(5)]
+    for i, root in enumerate(roots):
+        tracer.finish_trace(root, now=float(i) + 0.5)
+    finished = tracer.finished()
+    assert len(finished) == 3, "ring must stay bounded"
+    kept = [t["spans"][0]["attrs"]["rid"] if t["spans"] else None
+            for t in finished]
+    assert [t["trace_id"] for t in finished] == [
+        r.trace_id for r in roots[2:]], kept
+    assert tracer.metrics()["serving_request_trace_finished_total"] == 5.0
+    # the evicted trace is no longer findable
+    assert tracer.get_tree(roots[0].trace_id) is None
+
+
+def test_active_traces_are_bounded():
+    tracer = Tracer(ring_capacity=8, max_active=4)
+    roots = [tracer.start_trace("request", now=0.0) for _ in range(6)]
+    assert tracer.metrics()["serving_request_trace_active"] == 4.0
+    evicted = tracer.get_tree(roots[0].trace_id)
+    assert evicted is not None and evicted["status"] == "evicted"
+
+
+def test_graft_orphan_remote_spans_dropped_and_counted():
+    tracer = Tracer()
+    n = tracer.graft(new_trace_id(), new_span_id(), [
+        {"name": "worker.request", "start": 1.0, "end": 2.0},
+    ])
+    assert n == 0
+    assert tracer.metrics()[
+        "serving_request_trace_orphan_spans_total"] == 1.0
+    # malformed span dicts are also orphans, not errors
+    root = tracer.start_trace("request", now=0.0)
+    n = tracer.graft(root.trace_id, root.span_id,
+                     [{"name": "x"}, {"name": "ok", "start": 0, "end": 1}])
+    assert n == 1
+    assert tracer.metrics()[
+        "serving_request_trace_orphan_spans_total"] == 2.0
+
+
+def test_graft_into_finished_trace_still_lands():
+    """A DONE frame can race request completion: the trace is already
+    in the ring, and the worker spans must still graft (the ring holds
+    the object, not a copy)."""
+    tracer = Tracer()
+    root = tracer.start_trace("request", now=0.0)
+    tracer.finish_trace(root, now=1.0)
+    assert tracer.graft(root.trace_id, root.span_id, [
+        {"name": "worker.request", "start": 0.2, "end": 0.8},
+    ]) == 1
+    assert "worker.request" in _names(tracer.get_tree(root.trace_id))
+
+
+def test_flight_recorder_rings_are_bounded_and_dump_structured():
+    rec = FlightRecorder(event_capacity=4, dump_capacity=2)
+    for i in range(10):
+        rec.record("evt", seq=i)
+    assert [e["seq"] for e in rec.events()] == [6, 7, 8, 9]
+    for i in range(3):
+        rec.dump(f"reason-{i}", {"trace_id": "t", "spans": []})
+    assert rec.dumps_total == 3
+    assert len(rec.dumps) == 2
+    d = rec.dumps[-1]
+    assert d["reason"] == "reason-2"
+    assert d["trace"]["trace_id"] == "t"
+    assert [e["seq"] for e in d["recent_events"]] == [6, 7, 8, 9]
+    json.dumps(d)  # the dump must be one JSON-serializable record
+
+
+# -- request traces through the router ---------------------------------------
+
+
+def _local_router(**gw_kw):
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+
+    router = ServingRouter(
+        gateway=RequestGateway(**gw_kw),
+        scheduler=ContinuousBatchScheduler(block_size=4),
+    )
+    router.join_replica("local-0", FakeEngine(slots=4))
+    return router
+
+
+def test_request_trace_covers_every_hop_local():
+    router = _local_router()
+    req = router.submit(_prompt(1), 8)
+    assert req.trace is not None
+    router.run_until_idle()
+    assert req.state == ServingRequestState.DONE
+    tree = router.tracer.get_tree(req.trace.trace_id)
+    assert tree["status"] == "ok"
+    names = _names(tree)
+    for expected in ("queued", "attempt", "submit", "first_token"):
+        assert expected in names, names
+    (attempt,) = _find(tree, "attempt")
+    assert attempt["attrs"]["replica"] == "local-0"
+    assert attempt["attrs"]["attempt"] == 1
+    (submit,) = _find(tree, "submit")
+    assert submit["status"] == "ok" and submit["duration_s"] is not None
+    # every span closed, durations non-negative, nested under the root
+    def check(spans):
+        for s in spans:
+            assert s["duration_s"] is not None and s["duration_s"] >= 0
+            check(s["children"])
+    check(tree["spans"])
+
+
+def test_remote_request_trace_grafts_worker_spans():
+    from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+    from dlrover_tpu.serving.remote.worker import FakeEngine, WorkerServer
+
+    server = WorkerServer(FakeEngine(slots=4, tokens_per_step=4))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        router = ServingRouter(
+            scheduler=ContinuousBatchScheduler(block_size=4))
+        router.join_replica(
+            "rw", RemoteReplicaHandle(server.addr, name="rw"))
+        req = router.submit(_prompt(2), 8)
+        deadline = time.monotonic() + 15.0
+        while router.has_work and time.monotonic() < deadline:
+            router.step()
+            time.sleep(0.002)
+        assert req.state == ServingRequestState.DONE
+        tree = router.tracer.get_tree(req.trace.trace_id)
+        names = _names(tree)
+        for expected in ("queued", "attempt", "submit", "first_token",
+                         "worker.request", "worker.decode"):
+            assert expected in names, names
+        # worker spans hang under the attempt, in ROUTER clock: the
+        # worker.request span must sit inside the trace, not before it
+        (wreq,) = _find(tree, "worker.request")
+        assert wreq["offset_s"] >= 0
+        (wdec,) = _find(tree, "worker.decode")
+        assert wdec["attrs"]["steps"] >= 1
+        assert wdec["attrs"]["engine_seconds"] >= 0
+        router.begin_drain("rw")
+        router.step()
+    finally:
+        server.crash()
+
+
+def test_failover_trace_shows_both_attempts_and_flight_dump():
+    """A replica death mid-flight leaves the dead attempt in the tree
+    (status failover), the retry lands as attempt 2, and the flight
+    recorder dumps the span tree at the moment of death."""
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("a", FakeEngine(slots=4, tokens_per_step=1))
+    req = router.submit(_prompt(3), 8)
+    router.step()  # placed on "a", partially generated
+    assert req.state == ServingRequestState.RUNNING
+    router.fail_replica("a")
+    router.join_replica("b", FakeEngine(slots=4))
+    router.run_until_idle()
+    assert req.state == ServingRequestState.DONE
+    assert req.requeues == 1
+    tree = router.tracer.get_tree(req.trace.trace_id)
+    attempts = _find(tree, "attempt")
+    assert len(attempts) == 2
+    by_n = {a["attrs"]["attempt"]: a for a in attempts}
+    assert by_n[1]["attrs"]["replica"] == "a"
+    assert by_n[1]["status"] == "failover"
+    assert "failover_reason" in by_n[1]["attrs"]
+    assert by_n[2]["attrs"]["replica"] == "b"
+    assert by_n[2]["status"] == "ok"
+    # two queue spans: the original wait and the requeue wait
+    assert len(_find(tree, "queued")) == 2
+    # the flight recorder dumped this request's tree on replica death
+    dumps = [d for d in router.recorder.dumps
+             if d["reason"] == "replica_death"]
+    assert dumps
+    assert dumps[0]["trace"]["trace_id"] == req.trace.trace_id
+    kinds = [e["kind"] for e in dumps[0]["recent_events"]]
+    assert "replica_join" in kinds
+    assert "request_requeued" in kinds
+
+
+def test_deadline_expiry_dumps_flight_record():
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    # no replicas: the request can only wait, then expire
+    req = router.submit(_prompt(4), 8, timeout=0.0, now=100.0)
+    router.gateway.expire(now=101.0)
+    assert req.state == ServingRequestState.TIMED_OUT
+    tree = router.tracer.get_tree(req.trace.trace_id)
+    assert tree["status"] == ServingRequestState.TIMED_OUT
+    dumps = [d for d in router.recorder.dumps
+             if d["reason"] == "deadline_expired"]
+    assert dumps and dumps[0]["trace"]["trace_id"] == req.trace.trace_id
+    assert router.tracer.metrics()[
+        "serving_request_trace_flight_dumps_total"] >= 1.0
+
+
+def test_poisoned_request_dumps_flight_record():
+    gw = RequestGateway(max_requeues=0)
+    req = gw.submit(_prompt(5), 4)
+    gw.remove(req)
+    poisoned = gw.requeue_front([req])
+    assert poisoned == [req]
+    assert req.state == ServingRequestState.POISONED
+    dumps = [d for d in gw.tracer.recorder.dumps
+             if d["reason"] == "poisoned"]
+    assert dumps and dumps[0]["trace"]["trace_id"] == req.trace.trace_id
+    assert gw.tracer.get_tree(req.trace.trace_id)["status"] == \
+        ServingRequestState.POISONED
+
+
+# -- /traces + metrics surfaces ----------------------------------------------
+
+
+def test_traces_endpoints_serve_ring_and_slowest():
+    router = _local_router()
+    reqs = [router.submit(_prompt(i), 4 + 4 * i) for i in range(3)]
+    router.run_until_idle()
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    exporter = MetricsExporter()
+    exporter.attach_tracer(router.tracer)
+    exporter.start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        body = json.loads(urllib.request.urlopen(
+            f"{base}/traces", timeout=5).read().decode())
+        assert len(body["traces"]) == 3
+        ids = {t["trace_id"] for t in body["traces"]}
+        assert ids == {r.trace.trace_id for r in reqs}
+        for t in body["traces"]:
+            assert t["status"] == "ok"
+            assert "spans" in t and t["spans"]
+        slow = json.loads(urllib.request.urlopen(
+            f"{base}/traces/slowest", timeout=5).read().decode())
+        durations = [t["duration_s"] for t in slow["traces"]]
+        assert durations == sorted(durations, reverse=True)
+        # tracer gauges ride the normal /metrics scrape
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=5).read().decode()
+        assert "serving_request_trace_finished_total 3.0" in metrics
+        assert "# HELP serving_request_trace_finished_total" in metrics
+    finally:
+        exporter.stop()
+
+
+def test_traces_endpoint_404_without_tracer():
+    exporter = MetricsExporter()
+    exporter.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/traces", timeout=5)
+        assert e.value.code == 404
+    finally:
+        exporter.stop()
+
+
+def test_tracing_hot_path_is_lock_clean():
+    """The DL003 acceptance line, executed: dlint over the tracing hot
+    path (tracer + gateway/router/scheduler/replica) must stay clean —
+    no blocking work under router/gateway locks."""
+    from dlrover_tpu.dlint.checkers import CHECKERS, DlintConfig, Project
+    from dlrover_tpu.dlint.core import ParsedModule
+
+    paths = [
+        "dlrover_tpu/utils/tracing.py",
+        "dlrover_tpu/serving/router/gateway.py",
+        "dlrover_tpu/serving/router/router.py",
+        "dlrover_tpu/serving/router/scheduler.py",
+        "dlrover_tpu/serving/router/replica.py",
+    ]
+    modules = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            modules.append(ParsedModule(p, p, f.read()))
+    project = Project(modules, DlintConfig())
+    dl003 = [c for c in CHECKERS if c.CODE == "DL003"][0]
+    violations = list(dl003.check_project(project))
+    assert violations == [], [str(v) for v in violations]
