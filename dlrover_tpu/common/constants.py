@@ -76,6 +76,10 @@ class ServingFabric:
     FRAME_TIMEOUT = 5.0
     # Router address env var a deployed worker registers back to.
     ROUTER_ADDR_ENV = "DLROVER_ROUTER_ADDR"
+    # JSON fault-injection schedule for the frame protocol
+    # (serving/remote/faults.py) — chaos tests set this on spawned
+    # workers to tear/stall/duplicate/drop frames deterministically.
+    FAULTS_ENV = "DLROVER_SERVING_FAULTS"
 
 
 class NodeExitReason:

@@ -13,8 +13,13 @@ in-process engine objects.
 - :mod:`proxy`      — :class:`RemoteReplicaHandle`, the router-side
   engine proxy satisfying the duck-typed ``ReplicaHandle`` engine
   contract, so failover/heartbeat reaping work unchanged;
-- :mod:`supervisor` — spawn/monitor/respawn local worker processes and
-  plug them into the autoscale Scaler seam.
+- :mod:`supervisor` — spawn/monitor/respawn local worker processes
+  (exponential-backoff respawns, crash-loop quarantine) and plug them
+  into the autoscale Scaler seam;
+- :mod:`faults`     — seeded, schedule-driven frame-level fault
+  injection (torn streams, stalled heartbeats, duplicated/dropped
+  frames) pluggable into both proxy and worker — the chaos seam the
+  degradation paths are proven through.
 """
 
 from dlrover_tpu.serving.remote.protocol import (  # noqa: F401
@@ -23,6 +28,10 @@ from dlrover_tpu.serving.remote.protocol import (  # noqa: F401
     FrameProtocolError,
     connect,
     parse_addr,
+)
+from dlrover_tpu.serving.remote.faults import (  # noqa: F401
+    FaultSchedule,
+    FaultyFrameConnection,
 )
 from dlrover_tpu.serving.remote.proxy import (  # noqa: F401
     RemoteReplicaHandle,

@@ -1,0 +1,203 @@
+"""Frame-level fault injection for the serving fabric.
+
+The only way to trust a degradation path is to exercise it on purpose
+(chaos engineering: the failure drill, not the postmortem).  SIGKILL
+covers "the process died"; everything subtler — a frame that arrives
+late, a connection torn mid-length-prefix, a duplicated TOKEN, a
+heartbeat that stalls while the socket stays open, a DONE that never
+comes — lives between the engine and the wire, and nothing could
+inject it.  This module is that seam: a **seeded, schedule-driven**
+wrapper over :class:`~dlrover_tpu.serving.remote.protocol.
+FrameConnection` that perturbs frames at SEND time, pluggable into
+both ends of the protocol:
+
+- the worker (``WorkerServer(fault_schedule=...)`` or the
+  ``DLROVER_SERVING_FAULTS`` env var on a spawned worker process)
+  perturbs worker->router frames: TOKEN / DONE / STATS / HELLO;
+- the proxy (``RemoteReplicaHandle(fault_schedule=...)``) perturbs
+  router->worker frames: SUBMIT / CANCEL / GOODBYE.
+
+A schedule is a list of fault specs (JSON-friendly dicts):
+
+``op``
+    ``delay`` (sleep ``seconds`` before the send), ``dup`` (send the
+    frame twice), ``drop`` (swallow it), ``stall`` (swallow every
+    matching frame for ``seconds`` after the trigger — the
+    heartbeat-stall / silent-worker signature), ``tear`` (write half a
+    length prefix to the raw socket and close it — the torn-stream
+    signature a SIGKILL mid-send leaves).
+``kind``
+    frame kind to match (``"TOKEN"``, ``"STATS"``, ...) or ``"*"``.
+``after``
+    trigger on the Nth matching frame (1-based, default 1).
+``count``
+    for delay/dup/drop: how many consecutive matching frames the
+    fault applies to (default 1).
+``jitter``
+    for delay: extra seconds, scaled by the schedule's seeded RNG —
+    the same seed replays the same perturbation.
+
+Every firing is appended to :attr:`FaultSchedule.injected` so a chaos
+test can assert the schedule actually executed (a fault suite whose
+faults silently never fire proves nothing).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import ServingFabric
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.remote.protocol import FrameConnection
+
+_OPS = ("delay", "dup", "drop", "stall", "tear")
+
+
+class FaultSchedule:
+    """Deterministic, thread-safe decision engine for frame faults.
+
+    One schedule serves all connections of one endpoint; counters are
+    cumulative across reconnects (a worker that is re-adopted after a
+    torn connection keeps marching through the same schedule).
+    """
+
+    def __init__(self, specs: List[Dict], seed: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.specs: List[Dict] = []
+        for raw in specs:
+            spec = dict(raw)
+            op = spec.get("op")
+            if op not in _OPS:
+                raise ValueError(
+                    f"unknown fault op {op!r} (one of {_OPS})")
+            spec.setdefault("kind", "*")
+            spec.setdefault("after", 1)
+            spec.setdefault("count", 1)
+            spec.setdefault("seconds", 0.0)
+            spec.setdefault("jitter", 0.0)
+            spec["_seen"] = 0          # matching frames observed
+            spec["_stall_until"] = None
+            self.specs.append(spec)
+        #: log of fired injections: {op, kind, t} per event
+        self.injected: List[Dict] = []
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["FaultSchedule"]:
+        """Schedule from ``DLROVER_SERVING_FAULTS`` (JSON:
+        ``{"seed": 0, "faults": [...]}``), or None when unset —
+        the env seam spawned worker processes are armed through."""
+        import os
+
+        environ = os.environ if environ is None else environ
+        raw = environ.get(ServingFabric.FAULTS_ENV)
+        if not raw:
+            return None
+        payload = json.loads(raw)
+        return cls(payload.get("faults", []),
+                   seed=int(payload.get("seed", 0)))
+
+    # ------------------------------------------------------- decisions
+    def actions_for(self, kind: str) -> List[Dict]:
+        """The fault actions to apply to one outgoing frame of
+        ``kind`` (in schedule order).  Mutates trigger counters — call
+        exactly once per send attempt."""
+        now = time.monotonic()
+        fired: List[Dict] = []
+        with self._lock:
+            for spec in self.specs:
+                if spec["kind"] not in ("*", kind):
+                    continue
+                if spec["op"] == "stall":
+                    until = spec["_stall_until"]
+                    if until is not None:
+                        if now < until:
+                            fired.append(self._fire(spec, kind, now))
+                        continue
+                    spec["_seen"] += 1
+                    if spec["_seen"] == spec["after"]:
+                        spec["_stall_until"] = now + spec["seconds"]
+                        fired.append(self._fire(spec, kind, now))
+                    continue
+                spec["_seen"] += 1
+                first = spec["after"]
+                if first <= spec["_seen"] < first + spec["count"]:
+                    action = self._fire(spec, kind, now)
+                    if spec["op"] == "delay" and spec["jitter"]:
+                        action["seconds"] += (
+                            spec["jitter"] * self._rng.random())
+                    fired.append(action)
+        return fired
+
+    def _fire(self, spec: Dict, kind: str, now: float) -> Dict:
+        action = {"op": spec["op"], "kind": kind, "t": now,
+                  "seconds": float(spec["seconds"])}
+        self.injected.append(dict(action))
+        return action
+
+    def fired(self, op: Optional[str] = None) -> List[Dict]:
+        with self._lock:
+            events = list(self.injected)
+        return [e for e in events if op is None or e["op"] == op]
+
+
+class FaultyFrameConnection(FrameConnection):
+    """A :class:`FrameConnection` whose sends pass through a
+    :class:`FaultSchedule`.  Receives are untouched — injecting at the
+    sender exercises the RECEIVER's real parsing/staleness/failover
+    paths, which is the point."""
+
+    def __init__(self, sock, schedule: FaultSchedule,
+                 send_timeout: Optional[float] = 10.0):
+        super().__init__(sock, send_timeout=send_timeout)
+        self.schedule = schedule
+
+    def send(self, kind: str, **payload) -> None:
+        dup = False
+        for action in self.schedule.actions_for(kind):
+            op = action["op"]
+            if op == "delay":
+                # outside the send lock: a delayed frame must not
+                # serialize every other sender behind the sleep
+                time.sleep(action["seconds"])
+            elif op in ("drop", "stall"):
+                logger.debug("fault injection: swallowed %s frame", kind)
+                return
+            elif op == "dup":
+                dup = True
+            elif op == "tear":
+                self._tear()
+                raise ConnectionError(
+                    "fault injection: connection torn mid-frame")
+        super().send(kind, **payload)
+        if dup:
+            logger.debug("fault injection: duplicated %s frame", kind)
+            super().send(kind, **payload)
+
+    def _tear(self) -> None:
+        """Write HALF a length prefix, then slam the socket shut: the
+        peer's reader sees trailing bytes at EOF — the exact torn-
+        stream signature a crash mid-``sendall`` leaves on the wire."""
+        try:
+            with self._send_lock:
+                if not self._closed:
+                    # dlint: disable=DL003 two bytes into a kernel buffer cannot block; bounded by the connection's send_timeout regardless
+                    self._sock.sendall(b"\x00\x00")
+        except OSError:
+            pass
+        self.close()
+
+
+def maybe_faulty(sock, schedule: Optional[FaultSchedule],
+                 send_timeout: Optional[float] = 10.0) -> FrameConnection:
+    """The ctor seam proxy and worker share: a plain connection when no
+    schedule is armed, a fault-injecting one when it is."""
+    if schedule is None:
+        return FrameConnection(sock, send_timeout=send_timeout)
+    return FaultyFrameConnection(sock, schedule,
+                                 send_timeout=send_timeout)

@@ -56,12 +56,21 @@ class RemoteReplicaHandle:
         connect_timeout: float = 5.0,
         submit_timeout: float = 5.0,
         frame_timeout: float = ServingFabric.FRAME_TIMEOUT,
+        fault_schedule=None,
     ):
         self.addr = addr
         self.name = name or addr
         self.submit_timeout = float(submit_timeout)
         self.frame_timeout = float(frame_timeout)
-        self._conn = FrameConnection(connect(addr, connect_timeout))
+        if fault_schedule is not None:
+            # chaos seam (serving/remote/faults.py): perturb this
+            # proxy's router->worker frames (SUBMIT/CANCEL/GOODBYE)
+            from dlrover_tpu.serving.remote.faults import maybe_faulty
+
+            self._conn = maybe_faulty(
+                connect(addr, connect_timeout), fault_schedule)
+        else:
+            self._conn = FrameConnection(connect(addr, connect_timeout))
         # RLock: _dispatch(GOODBYE) -> _mark_dead re-enters under the
         # reader's own hold
         self._lock = threading.RLock()
@@ -74,6 +83,11 @@ class RemoteReplicaHandle:
         self._submit_replies: Dict[int, dict] = {}
         self._submit_cv = threading.Condition(self._lock)
         self._next_rid = 0
+        # CANCEL frames that failed to send (router aggregates these
+        # into serving_cancel_send_failures_total); logged once per
+        # replica at debug — see cancel()
+        self.cancel_send_failures = 0
+        self._cancel_fail_logged = False
         try:
             hello = self._conn.recv(timeout=connect_timeout)
         except Exception:
@@ -318,13 +332,29 @@ class RemoteReplicaHandle:
             events, self._token_events = self._token_events, []
             return events
 
-    def cancel(self, rid: int) -> None:
+    def cancel(self, rid: int) -> bool:
+        """Withdraw a placed request: drop its frames from here on and
+        send CANCEL so the worker frees the slot + KV blocks.  Returns
+        False when the frame could not be delivered — a dead worker
+        cancelled everything anyway, but the caller counts it into
+        ``serving_cancel_send_failures_total`` because a LIVE worker
+        that missed a cancel keeps decoding a dropped request."""
         with self._lock:
             self._inflight.discard(rid)
         try:
             self._conn.send(FrameKind.CANCEL, rid=rid)
-        except (ConnectionError, OSError):
-            pass  # best-effort: a dead worker cancelled everything
+        except (ConnectionError, OSError, TimeoutError) as e:
+            self.cancel_send_failures += 1
+            if not self._cancel_fail_logged:
+                # once per replica: every queued cancel fails the same
+                # way once the connection is gone — one line carries
+                # the signal, a line per request is log spam mid-death
+                self._cancel_fail_logged = True
+                logger.debug(
+                    "CANCEL send to replica %s failed "
+                    "(counted, logged once): %s", self.name, e)
+            return False
+        return True
 
     # -------------------------------------------------------- lifecycle
     @property

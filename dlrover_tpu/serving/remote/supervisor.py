@@ -22,6 +22,7 @@ wired into ``tests/conftest.py``).
 from __future__ import annotations
 
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -33,6 +34,7 @@ from dlrover_tpu.common.announce import read_announced_value
 from dlrover_tpu.common.constants import ServingFabric
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+from dlrover_tpu.serving.router.replica import base_replica_name
 
 # every live worker Popen, across all supervisors in the process —
 # the session-end reaper's ground truth
@@ -96,7 +98,11 @@ def serving_worker_command(
 
 
 class WorkerRecord:
-    """One supervised worker process."""
+    """One supervised worker process (plus its crash-loop history —
+    the sliding-window crash timestamps, the planned backoff schedule
+    and any quarantine sentence ride the record chain across respawn
+    generations, so a flapping worker cannot launder its history by
+    getting a fresh record)."""
 
     def __init__(self, name: str, proc: subprocess.Popen, addr: str,
                  proxy: RemoteReplicaHandle, managed: bool):
@@ -106,6 +112,15 @@ class WorkerRecord:
         self.proxy = proxy
         self.managed = managed       # supervisor respawns it on death
         self.respawns = 0
+        # crash timestamps still inside the respawn window (monotonic)
+        self.crash_times: List[float] = []
+        # actual respawn spawn times (the chaos suite asserts strictly
+        # increasing gaps here — the anti-hot-loop proof)
+        self.respawn_times: List[float] = []
+        # planned schedule: {exit_at, respawn_at, backoff_s} per crash
+        self.respawn_schedule: List[dict] = []
+        self.respawn_at = 0.0        # next planned respawn (pending)
+        self.quarantine_until = 0.0
 
 
 class WorkerSupervisor:
@@ -120,9 +135,24 @@ class WorkerSupervisor:
         spawn_timeout: float = 30.0,
         respawn: bool = True,
         max_respawns: int = 5,
+        respawn_window: float = 60.0,
+        backoff_base: float = 0.5,
+        backoff_max: float = 30.0,
+        backoff_jitter: float = 0.25,
+        quarantine_seconds: float = 120.0,
+        seed: Optional[int] = None,
         name_prefix: str = "worker",
         recorder=None,
     ):
+        """``max_respawns`` is a SLIDING-WINDOW budget: that many
+        crash-respawns within ``respawn_window`` seconds sends the
+        worker to quarantine for ``quarantine_seconds`` (it comes back
+        with a clean window afterwards — the fleet is never silently
+        permanently smaller).  Each respawn waits an exponential
+        backoff: ``backoff_base * 2**(crashes_in_window - 1)`` capped
+        at ``backoff_max``, stretched by up to ``backoff_jitter``
+        (seeded — chaos tests pass ``seed`` for reproducible
+        schedules) so a mass crash doesn't respawn in lockstep."""
         self.router = router
         # fabric flight recorder (utils/tracing.FlightRecorder): worker
         # spawn/exit/respawn events land next to the router's
@@ -137,8 +167,19 @@ class WorkerSupervisor:
         self.spawn_timeout = float(spawn_timeout)
         self.respawn = bool(respawn)
         self.max_respawns = int(max_respawns)
+        self.respawn_window = float(respawn_window)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.backoff_jitter = float(backoff_jitter)
+        self.quarantine_seconds = float(quarantine_seconds)
+        self._rng = random.Random(seed)
         self.name_prefix = name_prefix
         self.workers: Dict[str, WorkerRecord] = {}
+        # dead records waiting out their backoff before respawn
+        self.pending: Dict[str, WorkerRecord] = {}
+        # crash-loopers sitting out their quarantine sentence
+        self.quarantined: Dict[str, WorkerRecord] = {}
+        self.quarantined_total = 0
         self._next = 0
         self._lock = threading.Lock()
 
@@ -212,10 +253,16 @@ class WorkerSupervisor:
         return record.proxy
 
     # ----------------------------------------------------- monitoring
-    def poll(self) -> int:
-        """Reap exited processes; respawn managed ones (bounded).  The
-        router's own failover already requeued the dead worker's
-        requests — this only restores fleet capacity."""
+    def poll(self, now: Optional[float] = None) -> int:
+        """Reap exited processes and restore fleet capacity — but never
+        in a hot loop.  A crash schedules a respawn after an
+        exponential (jittered) backoff; crashes beyond the sliding-
+        window budget send the worker to quarantine instead, and a
+        served quarantine earns a fresh window.  The router's own
+        failover already requeued the dead worker's requests — this
+        loop only manages processes.  ``now`` is injectable so chaos
+        tests drive the schedule deterministically."""
+        now = time.monotonic() if now is None else now
         respawned = 0
         with self._lock:
             dead = [
@@ -229,37 +276,107 @@ class WorkerSupervisor:
             if self.recorder is not None:
                 self.recorder.record(
                     "worker_exit", worker=record.name,
-                    pid=record.proc.pid, rc=record.proc.returncode)
+                    pid=record.proc.pid, rc=record.proc.returncode,
+                    now=now)
             logger.warning(
                 "serving worker %s (pid %d) exited rc=%s",
                 record.name, record.proc.pid, record.proc.returncode)
-            if (
+            if not (
                 self.respawn and record.managed
                 and record.proc.returncode != 0
-                and record.respawns < self.max_respawns
             ):
                 # rc == 0 is a VOLUNTARY exit (GOODBYE after the router
                 # retired the replica on drain/scale-down) — respawning
                 # it would fight the scale decision; only crashes
                 # (signals / nonzero rc) are restored
-                try:
-                    fresh = self.spawn(
-                        name=f"{record.name}#r{record.respawns + 1}")
-                except Exception as e:
-                    # a transient spawn failure (announce timeout under
-                    # load) must not abort the loop NOR permanently
-                    # shrink the fleet: other dead workers still get
-                    # processed, and the next poll() retries this one
-                    logger.warning(
-                        "respawn of %s failed (retried next poll): %s",
-                        record.name, e)
-                    record.respawns += 1
-                    with self._lock:
-                        self.workers[record.name] = record
-                    continue
-                fresh.respawns = record.respawns + 1
-                respawned += 1
+                continue
+            record.crash_times = [
+                t for t in record.crash_times
+                if now - t <= self.respawn_window
+            ] + [now]
+            crashes = len(record.crash_times)
+            if crashes > self.max_respawns:
+                record.quarantine_until = now + self.quarantine_seconds
+                self.quarantined[record.name] = record
+                self.quarantined_total += 1
+                self._count_quarantine()
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "worker_quarantined", worker=record.name,
+                        crashes_in_window=crashes,
+                        until=record.quarantine_until, now=now)
+                logger.error(
+                    "serving worker %s quarantined for %.0fs: %d "
+                    "crashes inside %.0fs (respawn budget %d) — a hot "
+                    "respawn loop helps nobody",
+                    record.name, self.quarantine_seconds, crashes,
+                    self.respawn_window, self.max_respawns)
+                continue
+            delay = min(
+                self.backoff_max,
+                self.backoff_base * (2 ** (crashes - 1)),
+            ) * (1.0 + self.backoff_jitter * self._rng.random())
+            record.respawn_at = now + delay
+            record.respawn_schedule.append({
+                "exit_at": now, "respawn_at": record.respawn_at,
+                "backoff_s": delay,
+            })
+            self.pending[record.name] = record
+            if self.recorder is not None:
+                self.recorder.record(
+                    "worker_respawn_scheduled", worker=record.name,
+                    backoff_s=round(delay, 3),
+                    crashes_in_window=crashes, now=now)
+        # quarantine exits: the sentence served buys a clean window
+        for name, record in list(self.quarantined.items()):
+            if now >= record.quarantine_until:
+                del self.quarantined[name]
+                record.crash_times = []
+                record.respawn_at = now
+                self.pending[name] = record
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "worker_quarantine_exit", worker=name, now=now)
+                logger.warning(
+                    "serving worker %s leaves quarantine; respawning "
+                    "with a fresh crash window", name)
+        # due respawns
+        for name, record in list(self.pending.items()):
+            if now < record.respawn_at:
+                continue
+            del self.pending[name]
+            base = base_replica_name(name)
+            try:
+                fresh = self.spawn(
+                    name=f"{base}#r{record.respawns + 1}")
+            except Exception as e:
+                # a transient spawn failure (announce timeout under
+                # load) must not abort the loop NOR permanently shrink
+                # the fleet: other pending workers still get
+                # processed, and this one retries after one base delay
+                # (NOT counted as a crash — the worker never ran)
+                logger.warning(
+                    "respawn of %s failed (retrying in %.1fs): %s",
+                    name, self.backoff_base, e)
+                record.respawn_at = now + self.backoff_base
+                self.pending[name] = record
+                continue
+            fresh.respawns = record.respawns + 1
+            fresh.crash_times = record.crash_times
+            fresh.respawn_times = record.respawn_times + [now]
+            fresh.respawn_schedule = record.respawn_schedule
+            respawned += 1
         return respawned
+
+    def _count_quarantine(self) -> None:
+        """Count one quarantine into the router's metric surface
+        (``serving_worker_quarantined_total``).  Incremented, not
+        assigned: several supervisors can share one router (healthy
+        fleet + chaos fleet in tests, per-host supervisors in a
+        deployment) and each must add to the fleet-wide counter."""
+        metrics = getattr(self.router, "metrics", None)
+        if metrics is not None:
+            metrics.worker_quarantined += 1
 
     # -------------------------------------------------------- chaos
     def kill(self, name: str, sig: int = signal.SIGKILL) -> int:
@@ -267,7 +384,12 @@ class WorkerSupervisor:
         mid-stream crash the fabric exists to survive).  Returns the
         pid signalled."""
         with self._lock:
-            record = self.workers[name]
+            record = self.workers.get(name)
+            supervised = sorted(self.workers)
+        if record is None:
+            raise ValueError(
+                f"no supervised worker named {name!r}; supervised: "
+                f"{supervised or '(none)'}")
         os.kill(record.proc.pid, sig)
         return record.proc.pid
 
@@ -278,6 +400,10 @@ class WorkerSupervisor:
         with self._lock:
             records = list(self.workers.values())
             self.workers.clear()
+        # pending/quarantined records hold no live process — dropping
+        # them just cancels future respawns, which is what shutdown is
+        self.pending.clear()
+        self.quarantined.clear()
         for r in records:
             r.proxy.close(goodbye=True)
         deadline = time.monotonic() + grace

@@ -19,6 +19,7 @@ the first matching stdout line is ``DLROVER_WORKER_ADDR=<host>:<port>``.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import socket
 import sys
@@ -108,10 +109,14 @@ class FakeEngine:
         return {rid: st["output"] for rid, st in self.active.items()}
 
     def cancel(self, rid: int) -> bool:
+        """Free the request's slot + blocks.  Always True: local
+        delivery cannot fail, and an already-finished rid is a
+        successfully-delivered no-op (the router-side contract on
+        ``ReplicaHandle`` — False would be miscounted as a CANCEL
+        send failure when a cancel races completion)."""
         st = self.active.pop(rid, None)
-        if st is None:
-            return False
-        self.used_blocks -= st["blocks"]
+        if st is not None:
+            self.used_blocks -= st["blocks"]
         return True
 
 
@@ -122,10 +127,15 @@ class WorkerServer:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  stats_interval: float = ServingFabric.STATS_INTERVAL,
-                 engine_kind: str = "fake"):
+                 engine_kind: str = "fake", fault_schedule=None):
         self.engine = engine
         self.stats_interval = float(stats_interval)
         self.engine_kind = engine_kind
+        # chaos seam (serving/remote/faults.py): a FaultSchedule here
+        # perturbs every outgoing frame — torn streams, stalled STATS,
+        # duplicated TOKENs — so degradation paths are TESTED, not
+        # hoped for.  None (the default) costs nothing.
+        self.fault_schedule = fault_schedule
         # bind-port-0-yourself: the ONLY race-free way to pick a port
         self._listener = socket.create_server(
             (host, int(port)), reuse_port=False)
@@ -178,7 +188,9 @@ class WorkerServer:
                     break
                 sock.setsockopt(
                     socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conn = FrameConnection(sock)
+                from dlrover_tpu.serving.remote.faults import maybe_faulty
+
+                self._conn = maybe_faulty(sock, self.fault_schedule)
                 try:
                     self._serve_connection(self._conn)
                 except (ConnectionError, TimeoutError, OSError) as e:
@@ -290,6 +302,10 @@ class WorkerServer:
                 cancel = getattr(self.engine, "cancel", None)
                 if cancel is not None:
                     cancel(erid)
+                # freed capacity must be visible to the router's
+                # placement ledger NOW, not a stats-interval later —
+                # a cancel exists to reclaim the slot for live traffic
+                self._send_stats(conn)
         elif kind == FrameKind.HEARTBEAT:
             self._send_stats(conn)
         elif kind == FrameKind.GOODBYE:
@@ -426,6 +442,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stats-interval", type=float,
                    default=ServingFabric.STATS_INTERVAL)
+    p.add_argument("--crash-after", type=float, default=0.0,
+                   help="chaos: hard-exit (rc 9) this many seconds "
+                        "after startup — the crash-loop worker the "
+                        "supervisor's quarantine exists for")
     args = p.parse_args(argv)
 
     if args.engine == "llama":
@@ -437,10 +457,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             tokens_per_step=args.tokens_per_step,
             max_len=args.max_len, step_delay=args.step_delay,
         )
+    from dlrover_tpu.serving.remote.faults import FaultSchedule
+
     server = WorkerServer(
         engine, host=args.host, port=args.port,
         stats_interval=args.stats_interval, engine_kind=args.engine,
+        fault_schedule=FaultSchedule.from_env(),
     )
+    if args.crash_after > 0:
+        # a real abrupt death (no GOODBYE, no atexit, nonzero rc): the
+        # supervisor must read it as a crash and meter its respawns
+        crash = threading.Timer(
+            args.crash_after, lambda: os._exit(9))
+        crash.daemon = True
+        crash.start()
 
     terminated = threading.Event()
 

@@ -74,6 +74,10 @@ class ServingRequest:
     replica: Optional[str] = None          # placed-on replica name
     engine_rid: Optional[int] = None       # rid inside that replica's engine
     requeues: int = 0                      # failover replays (at-least-once)
+    # caller withdrew the request (ServingRequest.cancel); acted on by
+    # the next router step — queued requests are dropped, in-flight
+    # ones are aborted and a CANCEL is sent to the owning replica
+    cancel_requested: bool = False
     first_token_at: Optional[float] = None
     ttft_recorded: bool = False            # metrics bookkeeping
     finished_at: Optional[float] = None
@@ -149,6 +153,20 @@ class ServingRequest:
         self._events.put(("abort", state))
         self._done.set()
 
+    def cancel(self) -> bool:
+        """Withdraw this request (the client no longer wants the
+        answer).  Returns True when the withdrawal was accepted —
+        i.e. the request had not already reached a terminal state.
+        Cancellation is asynchronous: the next router step drops the
+        request from the queue (or aborts it in-flight and sends a
+        CANCEL frame to the owning replica, reclaiming the engine
+        slot), so ``result()`` raises :class:`RequestTimedOut` shortly
+        after, not instantly."""
+        if self._done.is_set():
+            return False
+        self.cancel_requested = True
+        return True
+
     def restart_stream(self) -> None:
         """Failover requeue: void partial output, signal consumers."""
         self.output = []
@@ -222,6 +240,7 @@ class RequestGateway:
         self.rejected = 0
         self.timed_out = 0
         self.poisoned = 0
+        self.cancelled = 0
 
     # ----------------------------------------------------------- admit
     def submit(
@@ -235,7 +254,10 @@ class RequestGateway:
         """Admit a request or raise :class:`AdmissionError`.  ``timeout``
         (seconds, default ``default_timeout``) becomes an absolute
         deadline: expiry while QUEUED aborts the request; a request
-        already generating is allowed to finish (its work is paid for)."""
+        already generating is allowed to finish by default (its work is
+        paid for) unless the router runs with
+        ``cancel_inflight_on_expiry=True``, which aborts it and sends
+        CANCEL so the engine slot returns to live traffic."""
         if priority not in _PRIORITIES:
             raise ValueError(f"unknown priority {priority}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -300,6 +322,13 @@ class RequestGateway:
         requeued: List[ServingRequest] = []
         with self._lock:
             for req in reversed(requests):
+                if req.state not in (ServingRequestState.QUEUED,
+                                     ServingRequestState.RUNNING):
+                    # a failover racing a cancel (or an expiry) must
+                    # not resurrect a request that already reached a
+                    # terminal state — its stream is closed and its
+                    # caller has been answered
+                    continue
                 req.requeues += 1
                 if req.requeues > self.max_requeues:
                     self.poisoned += 1
@@ -395,6 +424,37 @@ class RequestGateway:
                 self.tracer.flight_dump(
                     "deadline_expired", req.trace.trace_id, now=now)
         return expired
+
+    def take_cancelled(self, now: Optional[float] = None,
+                       dump: bool = True) -> List[ServingRequest]:
+        """Drop queued requests whose caller withdrew them
+        (:meth:`ServingRequest.cancel`), aborting each as ``CANCELLED``.
+        Same deferral contract as :meth:`expire`: ``dump=False`` leaves
+        the flight-recorder dumps to a lock-holding caller, and ``now``
+        keeps recorder timestamps on the caller's (possibly synthetic)
+        clock next to the round's other events."""
+        taken: List[ServingRequest] = []
+        with self._lock:
+            for i, q in enumerate(self._queues):
+                kept: Deque[ServingRequest] = deque()
+                dropped = False
+                for req in q:
+                    if req.cancel_requested:
+                        req.abort(ServingRequestState.CANCELLED)
+                        taken.append(req)
+                        self.cancelled += 1
+                        dropped = True
+                    else:
+                        kept.append(req)
+                if dropped:
+                    self._queues[i] = kept
+        for req in taken:
+            self.tracer.recorder.record(
+                "request_cancelled", rid=req.rid, now=now)
+            if dump and req.trace is not None:
+                self.tracer.flight_dump(
+                    "cancelled", req.trace.trace_id, now=now)
+        return taken
 
     def depth(self, priority: Optional[int] = None) -> int:
         with self._lock:

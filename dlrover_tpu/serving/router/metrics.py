@@ -23,11 +23,18 @@ Gauge/counter names (stable API, documented in README + PERF.md):
   (plus ``_p50`` / ``_p99`` from a reservoir)
 - ``serving_tokens_per_second``  — generated-token throughput (window)
 - ``serving_requests_{submitted,completed,rejected,timed_out,
-  requeued,poisoned}_total`` — lifecycle counters (``requeued`` counts
-  failover replays: nonzero says a replica died; completed+timed_out
-  accounting still balancing says nothing was lost; ``poisoned`` counts
-  requests failed for exceeding the failover-replay cap — a nonzero
-  value says some request was crashing replicas)
+  requeued,poisoned,cancelled}_total`` — lifecycle counters
+  (``requeued`` counts failover replays: nonzero says a replica died;
+  completed+timed_out+cancelled accounting still balancing says
+  nothing was lost; ``poisoned`` counts requests failed for exceeding
+  the failover-replay cap — a nonzero value says some request was
+  crashing replicas; ``cancelled`` counts caller withdrawals)
+- ``serving_cancel_send_failures_total`` — CANCEL frames that could
+  not be delivered to a replica
+- ``serving_worker_quarantined_total`` — crash-looping workers the
+  supervisor stopped respawning (respawn budget exhausted)
+- ``serving_replica_probation``  — replicas in crash-loop probation
+  (joined but held out of placement during their cooldown)
 
 TTFT semantics: for streaming engines (the remote replica fabric and
 the in-process adapter) ``serving_ttft_seconds`` measures submission to
@@ -58,12 +65,16 @@ class RouterMetrics:
         self.inflight = 0.0
         self.replica_up = 0.0
         self.replica_draining = 0.0
+        self.replica_probation = 0.0
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
         self.timed_out = 0
         self.requeued = 0
         self.poisoned = 0
+        self.cancelled = 0
+        self.cancel_send_failures = 0
+        self.worker_quarantined = 0
         self.generated_tokens = 0
         self.ttft = StepTimer()
         self._ttft_window = WindowGauge(window_seconds)
@@ -78,12 +89,14 @@ class RouterMetrics:
         replica_up: int,
         replica_draining: int,
         now: Optional[float] = None,
+        replica_probation: int = 0,
     ) -> None:
         now = time.monotonic() if now is None else now
         self.queue_depth = float(queue_depth)
         self.inflight = float(inflight)
         self.replica_up = float(replica_up)
         self.replica_draining = float(replica_draining)
+        self.replica_probation = float(replica_probation)
         self._depth_window.observe(float(queue_depth), now)
 
     def observe_ttft(self, seconds: float,
@@ -123,4 +136,10 @@ class RouterMetrics:
             "serving_requests_timed_out_total": float(self.timed_out),
             "serving_requests_requeued_total": float(self.requeued),
             "serving_requests_poisoned_total": float(self.poisoned),
+            "serving_requests_cancelled_total": float(self.cancelled),
+            "serving_cancel_send_failures_total": float(
+                self.cancel_send_failures),
+            "serving_worker_quarantined_total": float(
+                self.worker_quarantined),
+            "serving_replica_probation": self.replica_probation,
         }

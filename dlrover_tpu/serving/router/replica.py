@@ -24,6 +24,7 @@ remote model server.
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Dict, List, Optional
 
@@ -112,6 +113,36 @@ class InferenceEngineAdapter:
     def has_work(self) -> bool:
         return self.engine.has_work
 
+    def cancel(self, erid: int) -> bool:
+        """Withdraw a request from the engine, freeing its decode slot
+        and (paged engines) its KV blocks immediately — the local twin
+        of the remote worker's CANCEL handler, so in-process and remote
+        replicas reclaim capacity identically.  Covers all three places
+        the request can be: the engine admission queue, a live slot, or
+        already finished (a no-op — the withdrawal still "delivered").
+        Always returns True: local delivery cannot fail."""
+        eng = self.engine
+        self._stream_pos.pop(erid, None)
+        for i, req in enumerate(eng._queue):
+            if req.rid == erid:
+                del eng._queue[i]
+                return True
+        for s, req in enumerate(eng._slot_req):
+            if req is not None and req.rid == erid:
+                eng._slot_req[s] = None
+                if getattr(eng, "paged", False) \
+                        and eng._slot_blocks[s] is not None:
+                    # blocks back to the pool NOW — slot reclamation is
+                    # the whole point of cancelling mid-generation; the
+                    # table row resets to the trash block so the dead
+                    # slot stops writing KV over reallocated blocks
+                    eng._blockmgr.free_sequence(eng._slot_blocks[s])
+                    eng._slot_blocks[s] = None
+                    eng._table_np[s, :] = 0
+                    eng._table_dirty = True
+                return True
+        return True
+
     def slots_free(self) -> int:
         eng = self.engine
         free = sum(1 for r in eng._slot_req if r is None)
@@ -162,6 +193,11 @@ class ReplicaHandle:
     - optional ``blocks_needed(prompt_len, max_new_tokens) -> float``
       (the engine's own admission formula; the scheduler uses its
       block-size default otherwise)
+    - optional ``cancel(erid) -> bool`` — withdraw a request, freeing
+      its slot/KV blocks.  ``False`` means the withdrawal could not be
+      DELIVERED (a remote send failure — counted into
+      ``serving_cancel_send_failures_total``); engines that deliver
+      locally return True even for an already-finished erid.
     """
 
     def __init__(self, name: str, engine, node=None):
@@ -170,6 +206,11 @@ class ReplicaHandle:
         self.node = node  # cluster Node this replica runs on, if any
         self.status = ReplicaStatus.JOINING
         self.last_heartbeat = 0.0
+        self.joined_at = 0.0
+        # probation (crash-loop damping): a replica whose predecessors
+        # kept dying right after joining is held out of placement until
+        # this monotonic time — set by ReplicaManager.join
+        self.probation_until = 0.0
         self.inflight: Dict[int, ServingRequest] = {}
         self.generated_tokens = 0
         self._failed = False
@@ -299,6 +340,25 @@ class ReplicaHandle:
                     req.first_token_at = now
         return done
 
+    def cancel_request(self, erid: int) -> bool:
+        """Deliver a withdrawal to the engine.  Called by the router
+        AFTER its step lock is released — for remote engines this is a
+        CANCEL frame send, i.e. socket I/O that must never run inside
+        the step critical section (dlint DL003's stall class).  Returns
+        False only when delivery failed; engines without a ``cancel``
+        simply keep decoding into a dropped stream (the request left
+        ``inflight`` already, so its tokens go nowhere)."""
+        cancel = getattr(self.engine, "cancel", None)
+        if cancel is None:
+            return True
+        try:
+            return cancel(erid) is not False
+        except Exception as e:
+            logger.debug(
+                "cancel of engine rid %s on replica %s failed: %s",
+                erid, self.name, e)
+            return False
+
     # ------------------------------------------------------- lifecycle
     def mark_up(self, now: float) -> None:
         self.status = ReplicaStatus.UP
@@ -318,16 +378,41 @@ class ReplicaHandle:
         return reqs
 
 
-class ReplicaManager:
-    """Membership + health: join/leave/drain and heartbeat reaping."""
+def base_replica_name(name: str) -> str:
+    """Strip supervisor respawn suffixes (``worker-0#r2`` ->
+    ``worker-0``): probation history must follow the flapping POD, not
+    reset with every respawn's fresh replica name."""
+    return re.sub(r"(#r\d+)+$", "", name)
 
-    def __init__(self, heartbeat_timeout: float = 10.0):
+
+class ReplicaManager:
+    """Membership + health: join/leave/drain, heartbeat reaping, and
+    crash-loop probation.
+
+    Probation: a replica that dies within ``probation_lifetime`` of
+    joining is a *flap*.  When a same-named successor (respawn suffixes
+    stripped) joins, it is admitted but held out of placement for an
+    exponentially growing cooldown — a crash-looping pod must stop
+    eating placements (each one costs the orphaned requests a failover
+    replay) while still getting a probe request once per cooldown to
+    prove recovery.  A replica that survives past the flap threshold
+    clears its name's history."""
+
+    def __init__(self, heartbeat_timeout: float = 10.0,
+                 probation_lifetime: float = 5.0,
+                 probation_cooldown: float = 2.0,
+                 probation_max: float = 60.0):
         self.heartbeat_timeout = float(heartbeat_timeout)
+        self.probation_lifetime = float(probation_lifetime)
+        self.probation_cooldown = float(probation_cooldown)
+        self.probation_max = float(probation_max)
         self.replicas: Dict[str, ReplicaHandle] = {}
         # handles reaped by reap_dead, awaiting router post-mortem
         # (affinity cleanup + cluster-node retirement); drained by
         # ServingRouter.step each round
         self.dead_handles: List[ReplicaHandle] = []
+        # base replica name -> consecutive short-lived deaths
+        self._flaps: Dict[str, int] = {}
         self._last_check: Optional[float] = None
 
     # ------------------------------------------------------ membership
@@ -337,6 +422,18 @@ class ReplicaManager:
         if handle.name in self.replicas:
             raise ValueError(f"replica {handle.name} already joined")
         handle.mark_up(now)
+        handle.joined_at = now
+        flaps = self._flaps.get(base_replica_name(handle.name), 0)
+        if flaps:
+            cooldown = min(
+                self.probation_max,
+                self.probation_cooldown * (2 ** (flaps - 1)),
+            )
+            handle.probation_until = now + cooldown
+            logger.warning(
+                "serving replica %s joined on probation for %.1fs "
+                "(%d consecutive short-lived predecessors)",
+                handle.name, cooldown, flaps)
         self.replicas[handle.name] = handle
         logger.info("serving replica %s joined", handle.name)
         return handle
@@ -351,6 +448,11 @@ class ReplicaManager:
         handle = self.replicas.pop(name, None)
         if handle is not None:
             handle.status = ReplicaStatus.LEFT
+            # a DELIBERATE retirement (drain/scale-down) ends the
+            # name's story: stale flap history must not probation an
+            # unrelated later join of the same name (and the dict must
+            # not grow one entry per retired name forever)
+            self._flaps.pop(base_replica_name(name), None)
             logger.info("serving replica %s left", name)
         return handle
 
@@ -358,14 +460,28 @@ class ReplicaManager:
     def get(self, name: str) -> Optional[ReplicaHandle]:
         return self.replicas.get(name)
 
-    def schedulable(self) -> List[ReplicaHandle]:
-        return [h for h in self.replicas.values() if h.schedulable]
+    def schedulable(self, now: Optional[float] = None
+                    ) -> List[ReplicaHandle]:
+        now = time.monotonic() if now is None else now
+        return [
+            h for h in self.replicas.values()
+            if h.schedulable and h.probation_until <= now
+        ]
 
     def pumpable(self) -> List[ReplicaHandle]:
         return [h for h in self.replicas.values() if h.pumpable]
 
     def up_count(self) -> int:
         return sum(1 for h in self.replicas.values() if h.schedulable)
+
+    def probation_count(self, now: Optional[float] = None) -> int:
+        """Replicas currently held out of placement by probation — the
+        ``serving_replica_probation`` gauge."""
+        now = time.monotonic() if now is None else now
+        return sum(
+            1 for h in self.replicas.values()
+            if h.schedulable and h.probation_until > now
+        )
 
     # --------------------------------------------------------- health
     def reap_dead(self, now: Optional[float] = None
@@ -400,6 +516,14 @@ class ReplicaManager:
                 orphans.extend(taken)
                 del self.replicas[name]
                 self.dead_handles.append(handle)
+                base = base_replica_name(name)
+                if now - handle.joined_at < self.probation_lifetime:
+                    # died right after joining: one more flap — the
+                    # successor's probation cooldown doubles
+                    self._flaps[base] = self._flaps.get(base, 0) + 1
+                else:
+                    # it lived: the crash loop (if any) is over
+                    self._flaps.pop(base, None)
                 logger.warning(
                     "serving replica %s died (%s); requeueing %d "
                     "in-flight requests", name,

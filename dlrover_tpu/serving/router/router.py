@@ -75,7 +75,15 @@ class ServingRouter:
         scheduler: Optional[ContinuousBatchScheduler] = None,
         manager: Optional[ReplicaManager] = None,
         metrics: Optional[RouterMetrics] = None,
+        cancel_inflight_on_expiry: bool = False,
     ):
+        # policy knob: when True, a request whose deadline passes MID-
+        # GENERATION is aborted and a CANCEL is sent to its replica so
+        # the engine slot + KV blocks are reclaimed for live traffic;
+        # when False (default, the historical behavior) work already
+        # placed is allowed to finish — its cost is sunk and the late
+        # answer may still be useful to a caller polling result()
+        self.cancel_inflight_on_expiry = bool(cancel_inflight_on_expiry)
         self.gateway = gateway or RequestGateway()
         self.scheduler = scheduler or ContinuousBatchScheduler()
         self.manager = manager or ReplicaManager()
@@ -104,6 +112,13 @@ class ServingRouter:
             handle = self.manager.join(
                 ReplicaHandle(name, engine, node=node), now=now)
         self.recorder.record("replica_join", replica=name, now=now)
+        if handle.probation_until > handle.joined_at:
+            # crash-loop damping kicked in: the join is visible in the
+            # flight recorder WITH its cooldown, so a postmortem shows
+            # why the fleet count and the placement count disagree
+            self.recorder.record(
+                "replica_probation", replica=name,
+                until=handle.probation_until, now=now)
         return handle
 
     def begin_drain(self, name: str) -> Optional[ReplicaHandle]:
@@ -156,20 +171,63 @@ class ServingRouter:
         # logging must not extend the critical section that placement
         # and membership calls contend on
         dumps: List[tuple] = []
+        # CANCEL deliveries requested during this round: (handle, erid)
+        # pairs COLLECTED under the step lock, TRANSMITTED after its
+        # release — for a remote replica delivery is a frame send, and
+        # blocking socket I/O under the step lock is the stall class
+        # dlint DL003 exists to forbid
+        cancels: List[tuple] = []
         with self._lock:
             # 1. deadline expiry
             for req in self.gateway.expire(now, dump=False):
                 if req.trace is not None:
                     dumps.append(
                         ("deadline_expired", req.trace.trace_id))
+
+            # 1b. cancellation sweep: queued client withdrawals leave
+            # the queue here; in-flight withdrawals — and, under the
+            # cancel_inflight_on_expiry policy, in-flight requests past
+            # their deadline — abort now and queue a CANCEL delivery so
+            # the replica's slot and KV blocks return to live traffic
+            for req in self.gateway.take_cancelled(now, dump=False):
+                if req.trace is not None:
+                    dumps.append(("cancelled", req.trace.trace_id))
+            for handle in self.manager.pumpable():
+                for erid, req in list(handle.inflight.items()):
+                    expired = (
+                        self.cancel_inflight_on_expiry
+                        and req.deadline is not None
+                        and now > req.deadline
+                    )
+                    if not (req.cancel_requested or expired):
+                        continue
+                    del handle.inflight[erid]
+                    if req.cancel_requested:
+                        state = ServingRequestState.CANCELLED
+                        self.gateway.cancelled += 1
+                        reason = "cancelled"
+                    else:
+                        state = ServingRequestState.TIMED_OUT
+                        self.gateway.timed_out += 1
+                        reason = "deadline_expired"
+                    req.abort(state)
+                    self.recorder.record(
+                        "request_cancel_inflight", rid=req.rid,
+                        replica=handle.name, state=state, now=now)
+                    cancels.append((handle, erid))
+                    if req.trace is not None:
+                        dumps.append((reason, req.trace.trace_id))
+            self.metrics.cancelled = self.gateway.cancelled
             self.metrics.timed_out = self.gateway.timed_out
 
             # 2. failover: reap dead replicas, requeue their in-flight
             self._reap(now, dumps=dumps)
 
-            # 3. placement (micro-batch per replica per round)
+            # 3. placement (micro-batch per replica per round);
+            # schedulable(now) keeps probation replicas (crash-loop
+            # cooldown) out of the candidate set
             placements = self.scheduler.schedule(
-                self.gateway, self.manager.schedulable(), now=now)
+                self.gateway, self.manager.schedulable(now), now=now)
             for handle, req in placements:
                 try:
                     handle.submit(req)
@@ -236,10 +294,19 @@ class ServingRouter:
                     1 for h in self.manager.replicas.values()
                     if h.status == ReplicaStatus.DRAINING
                 ),
+                replica_probation=self.manager.probation_count(now),
                 now=now,
             )
             if self.autoscaler is not None:
                 self.autoscaler.on_step(now)
+        # deliver the round's CANCELs now that the lock is gone: remote
+        # deliveries are frame sends (bounded by the connection's
+        # send_timeout, but still I/O); local ones are slot/KV-block
+        # frees, safe here because the pump is single-threaded by
+        # design (concurrency is a caller policy, see module docstring)
+        for handle, erid in cancels:
+            if not handle.cancel_request(erid):
+                self.metrics.cancel_send_failures += 1
         # bound the log burst: a stall can expire a whole queue in one
         # step, and one multi-KB FLIGHT-RECORDER record per request
         # would flood the log exactly mid-incident — the first few per

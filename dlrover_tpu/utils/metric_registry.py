@@ -56,6 +56,25 @@ METRIC_HELP: Dict[str, str] = {
         "requests failed for exceeding the failover-replay cap — "
         "nonzero says some request was crashing replicas"
     ),
+    "serving_requests_cancelled_total": (
+        "requests withdrawn by their caller (queued ones dropped, "
+        "in-flight ones aborted with a CANCEL sent to the replica)"
+    ),
+    "serving_cancel_send_failures_total": (
+        "CANCEL frames that could not be delivered to a replica — "
+        "the slot is reclaimed anyway when the worker dies, but a "
+        "live worker that missed a cancel keeps decoding a dropped "
+        "request to completion"
+    ),
+    "serving_worker_quarantined_total": (
+        "crash-looping workers the supervisor stopped respawning "
+        "(sliding-window respawn budget exhausted) — each sits out a "
+        "quarantine period before respawns resume"
+    ),
+    "serving_replica_probation": (
+        "replicas currently held out of placement by crash-loop "
+        "probation (joined, cooling down before schedulable again)"
+    ),
     # -- per-request span tracing (utils/tracing.Tracer.metrics) -------
     "serving_request_trace_finished_total": (
         "request traces completed into the tracer's bounded ring"

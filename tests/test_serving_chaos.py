@@ -1,0 +1,940 @@
+"""Graceful-degradation chaos suite (ISSUE 5): end-to-end request
+cancellation, crash-loop quarantine, and the frame-level fault-
+injection harness (serving/remote/faults.py).
+
+The acceptance bar: under a seeded fault schedule (a torn connection, a
+heartbeat stall, an abrupt worker death, a crash-looping worker) a
+200-request stream completes with ZERO lost requests; every cancelled
+or expired in-flight request's engine slot is reclaimed (asserted via
+worker STATS and local-engine ``slots_free()``); a crash-looping
+worker's respawn timestamps show strictly increasing gaps and end in
+quarantine rather than a hot loop.  Subprocess scenarios carry
+``@pytest.mark.slow``; the same machinery is covered fast in-thread.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+msgpack = pytest.importorskip(
+    "msgpack", reason="remote fabric frames are msgpack")
+
+from dlrover_tpu.common.constants import (  # noqa: E402
+    ServingFabric,
+    ServingRequestState,
+)
+from dlrover_tpu.serving.remote.faults import (  # noqa: E402
+    FaultSchedule,
+    FaultyFrameConnection,
+)
+from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle  # noqa: E402
+from dlrover_tpu.serving.remote.supervisor import (  # noqa: E402
+    WorkerRecord,
+    WorkerSupervisor,
+)
+from dlrover_tpu.serving.remote.worker import (  # noqa: E402
+    FakeEngine,
+    WorkerServer,
+)
+from dlrover_tpu.serving.router import (  # noqa: E402
+    ContinuousBatchScheduler,
+    RequestGateway,
+    ServingRouter,
+)
+from dlrover_tpu.serving.router.gateway import RequestTimedOut  # noqa: E402
+from dlrover_tpu.serving.router.replica import (  # noqa: E402
+    ReplicaManager,
+    base_replica_name,
+)
+from dlrover_tpu.utils.tracing import FlightRecorder  # noqa: E402
+
+
+def _prompt(i, n=8):
+    return np.full(n, i % 251, np.int32)
+
+
+def _drive(router, timeout=30.0, extra=None):
+    deadline = time.monotonic() + timeout
+    while router.has_work:
+        assert time.monotonic() < deadline, (
+            f"router still busy after {timeout}s "
+            f"(depth={router.gateway.depth()})")
+        router.step()
+        if extra is not None:
+            extra()
+        time.sleep(0.002)
+
+
+# -- fault schedule semantics ------------------------------------------------
+
+
+def test_fault_schedule_after_count_and_stall_semantics():
+    sched = FaultSchedule([
+        {"op": "drop", "kind": "DONE", "after": 2, "count": 2},
+        {"op": "stall", "kind": "STATS", "after": 3, "seconds": 60.0},
+    ], seed=0)
+    # DONE #1 passes, #2 and #3 drop, #4 passes again
+    assert sched.actions_for("DONE") == []
+    assert sched.actions_for("DONE")[0]["op"] == "drop"
+    assert sched.actions_for("DONE")[0]["op"] == "drop"
+    assert sched.actions_for("DONE") == []
+    # STATS stall triggers on the 3rd and swallows everything after
+    assert sched.actions_for("STATS") == []
+    assert sched.actions_for("STATS") == []
+    assert sched.actions_for("STATS")[0]["op"] == "stall"
+    assert sched.actions_for("STATS")[0]["op"] == "stall"
+    # other kinds unaffected by the STATS stall
+    assert sched.actions_for("TOKEN") == []
+    assert [e["op"] for e in sched.fired()].count("drop") == 2
+    assert len(sched.fired("stall")) >= 2
+
+
+def test_fault_schedule_from_env_and_seeded_jitter():
+    payload = {"seed": 7, "faults": [
+        {"op": "delay", "kind": "TOKEN", "seconds": 0.001,
+         "jitter": 0.002},
+    ]}
+    env = {ServingFabric.FAULTS_ENV: json.dumps(payload)}
+    a = FaultSchedule.from_env(env)
+    b = FaultSchedule.from_env(env)
+    assert a is not None and b is not None
+    da = a.actions_for("TOKEN")[0]["seconds"]
+    db = b.actions_for("TOKEN")[0]["seconds"]
+    assert da == db, "same seed must replay the same perturbation"
+    assert 0.001 <= da <= 0.003
+    assert FaultSchedule.from_env({}) is None
+
+
+def test_fault_schedule_rejects_unknown_op():
+    with pytest.raises(ValueError):
+        FaultSchedule([{"op": "explode"}])
+
+
+# -- in-thread workers with injectable faults --------------------------------
+
+
+class _ThreadedWorker:
+    def __init__(self, fault_schedule=None, **engine_kw):
+        self.engine = FakeEngine(**engine_kw)
+        self.server = WorkerServer(
+            self.engine, fault_schedule=fault_schedule)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def proxy(self, name, **kw):
+        return RemoteReplicaHandle(self.server.addr, name=name, **kw)
+
+    def stop(self):
+        self.server.crash()
+
+
+@pytest.fixture()
+def workers():
+    made = []
+
+    def factory(fault_schedule=None, **kw):
+        w = _ThreadedWorker(fault_schedule=fault_schedule, **kw)
+        made.append(w)
+        return w
+
+    yield factory
+    for w in made:
+        w.stop()
+
+
+def test_torn_connection_fails_over_zero_lost(workers):
+    """A connection torn mid-length-prefix (the SIGKILL-mid-send wire
+    signature) must read as a dead replica, fail over, and lose
+    nothing."""
+    sched = FaultSchedule(
+        [{"op": "tear", "kind": "TOKEN", "after": 5}], seed=1)
+    torn = workers(fault_schedule=sched, slots=4, tokens_per_step=2,
+                   step_delay=0.002)
+    ok = workers(slots=4, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("torn", torn.proxy("torn"))
+    router.join_replica("ok", ok.proxy("ok"))
+    reqs = [router.submit(_prompt(i), 8) for i in range(20)]
+    _drive(router)
+    assert sched.fired("tear"), "the tear must actually have fired"
+    lost = [r for r in reqs if r.state != ServingRequestState.DONE]
+    assert not lost
+    assert router.metrics.metrics()[
+        "serving_requests_requeued_total"] >= 1
+    assert router.replica_names == ["ok"]
+
+
+def test_heartbeat_stall_reads_as_silent_and_fails_over(workers):
+    """A worker whose socket stays open but whose frames stop (wedged
+    event loop, SIGSTOP) trips the proxy's frame-staleness check."""
+    sched = FaultSchedule(
+        [{"op": "stall", "kind": "*", "after": 10, "seconds": 60.0}],
+        seed=2)
+    stalled = workers(fault_schedule=sched, slots=4, tokens_per_step=2,
+                      step_delay=0.002)
+    ok = workers(slots=4, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica(
+        "stalled", stalled.proxy("stalled", frame_timeout=0.5))
+    router.join_replica("ok", ok.proxy("ok"))
+    reqs = [router.submit(_prompt(i), 8) for i in range(20)]
+    _drive(router, timeout=30.0)
+    assert sched.fired("stall")
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    assert router.replica_names == ["ok"]
+
+
+def test_duplicated_token_does_not_corrupt_result(workers):
+    """A duplicated TOKEN frame (retransmit-style) may echo in the
+    stream, but DONE's full output stays authoritative and the replica
+    must NOT be failed over."""
+    sched = FaultSchedule(
+        [{"op": "dup", "kind": "TOKEN", "after": 1, "count": 3}],
+        seed=3)
+    w = workers(fault_schedule=sched, slots=2, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("dup", w.proxy("dup"))
+    req = router.submit(_prompt(1), 8)
+    _drive(router)
+    assert sched.fired("dup")
+    assert req.state == ServingRequestState.DONE
+    assert req.result(timeout=0).size == 8, \
+        "DONE's authoritative output must win over duplicated frames"
+    assert router.replica_names == ["dup"], \
+        "a duplicated frame is noise, not a replica death"
+    assert router.metrics.metrics()[
+        "serving_requests_requeued_total"] == 0
+
+
+def test_dropped_done_recovered_by_expiry_cancel(workers):
+    """A DONE frame dropped on the floor would strand its request
+    in-flight forever; with ``cancel_inflight_on_expiry`` the deadline
+    aborts it, a CANCEL reclaims the (already-free) slot, and the
+    router goes idle instead of pumping a ghost."""
+    sched = FaultSchedule(
+        [{"op": "drop", "kind": "DONE", "after": 1}], seed=4)
+    w = workers(fault_schedule=sched, slots=2, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        cancel_inflight_on_expiry=True,
+    )
+    router.join_replica("droppy", w.proxy("droppy"))
+    req = router.submit(_prompt(1), 8, timeout=1.0)
+    _drive(router, timeout=20.0)
+    assert sched.fired("drop")
+    assert req.state == ServingRequestState.TIMED_OUT
+    with pytest.raises(RequestTimedOut):
+        req.result(timeout=0)
+    assert not router.has_work, "the ghost request must be gone"
+    # the worker finished the request long ago: its slots are free and
+    # the trace closed with the timeout status
+    assert w.engine.slots_free() == 2
+    assert w.engine.used_blocks == 0
+    m = router.metrics.metrics()
+    assert m["serving_requests_timed_out_total"] == 1
+
+
+# -- cancellation end-to-end -------------------------------------------------
+
+
+def test_client_cancel_mid_generation_reclaims_remote_slot(workers):
+    """THE cancellation path: a request cancelled mid-decode frees its
+    remote engine slot and KV blocks, visible in the next STATS."""
+    w = workers(slots=2, tokens_per_step=1, step_delay=0.01)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("rw", w.proxy("rw"))
+    req = router.submit(_prompt(1), 500)
+    deadline = time.monotonic() + 10.0
+    handle = router.manager.get("rw")
+    while not handle.inflight and time.monotonic() < deadline:
+        router.step()
+        time.sleep(0.002)
+    assert handle.inflight, "cancel must land mid-generation"
+    assert w.engine.active, "the engine must actually be decoding"
+    assert req.cancel() is True
+    _drive(router, timeout=10.0)
+    assert req.state == ServingRequestState.CANCELLED
+    with pytest.raises(RequestTimedOut):
+        req.result(timeout=0)
+    # the CANCEL frame reached the engine: slot + blocks reclaimed
+    deadline = time.monotonic() + 5.0
+    while w.engine.active and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not w.engine.active
+    assert w.engine.used_blocks == 0
+    # ... and the freed capacity reached the router's ledger via the
+    # post-cancel STATS
+    deadline = time.monotonic() + 5.0
+    while handle.slots_free() < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert handle.slots_free() == 2
+    m = router.metrics.metrics()
+    assert m["serving_requests_cancelled_total"] == 1
+    assert m["serving_cancel_send_failures_total"] == 0
+    # the span tree closed with the cancelled status
+    tree = router.tracer.get_tree(req.trace.trace_id)
+    assert tree["status"] == ServingRequestState.CANCELLED
+
+
+def test_client_cancel_while_queued():
+    """A cancel before placement drops the request from the queue —
+    no replica ever sees it."""
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("e", FakeEngine(slots=1, tokens_per_step=1))
+    blocker = router.submit(_prompt(0), 50)
+    queued = router.submit(_prompt(1), 4)
+    router.step()   # blocker takes the only slot; queued waits
+    assert queued.state == ServingRequestState.QUEUED
+    assert queued.cancel() is True
+    router.step()
+    assert queued.state == ServingRequestState.CANCELLED
+    assert router.gateway.depth() == 0
+    _drive(router, timeout=10.0)
+    assert blocker.state == ServingRequestState.DONE
+    assert router.metrics.metrics()[
+        "serving_requests_cancelled_total"] == 1
+    # cancel of an already-finished request is refused
+    assert blocker.cancel() is False
+
+
+def test_cancel_inflight_on_expiry_local_engine_reclaims_slot():
+    """The policy knob against a LOCAL engine: expiry mid-generation
+    frees the slot for the waiting request (slot reclamation is what
+    continuous batching lives on)."""
+    eng = FakeEngine(slots=1, tokens_per_step=1)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        cancel_inflight_on_expiry=True,
+    )
+    router.join_replica("local", eng)
+    t0 = 100.0
+    hog = router.submit(_prompt(0), 1000, timeout=5.0, now=t0)
+    waiter = router.submit(_prompt(1), 4, timeout=None, now=t0)
+    router.step(now=t0 + 1.0)   # hog placed, decoding
+    assert hog.state == ServingRequestState.RUNNING
+    assert eng.slots_free() == 0
+    router.step(now=t0 + 6.0)   # hog past deadline: abort + cancel
+    assert hog.state == ServingRequestState.TIMED_OUT
+    for _ in range(10):
+        router.step(now=t0 + 7.0)
+        if waiter.state == ServingRequestState.DONE:
+            break
+    assert waiter.state == ServingRequestState.DONE, \
+        "the reclaimed slot must serve the waiting request"
+    assert eng.used_blocks == 0
+    assert router.metrics.metrics()[
+        "serving_requests_timed_out_total"] == 1
+
+
+def test_adapter_cancel_frees_paged_engine_blocks():
+    """InferenceEngineAdapter.cancel against the REAL paged engine:
+    the slot and its KV blocks return to the pool mid-generation."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+    from dlrover_tpu.serving.router import InferenceEngineAdapter
+
+    cfg = LlamaConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    eng = InferenceEngine(cfg, variables, max_slots=2, chunk=4,
+                          paged=True, block_size=16, seed=0)
+    adapter = InferenceEngineAdapter(eng)
+    free0 = adapter.blocks_free()
+    rid = adapter.add_request(_prompt(1), 32)
+    eng.step()          # admit + decode a little
+    assert adapter.blocks_free() < free0
+    assert adapter.cancel(rid) is True
+    assert adapter.slots_free() == 2
+    assert adapter.blocks_free() == free0, \
+        "cancel must free the paged KV blocks"
+    # cancelling a gone rid is a delivered no-op, and a queued (not
+    # yet admitted) request is cancellable too
+    assert adapter.cancel(rid) is True
+    rid2 = adapter.add_request(_prompt(2), 8)
+    assert adapter.cancel(rid2) is True
+    assert not eng.has_work
+    # the engine still serves after cancels
+    rid3 = adapter.add_request(_prompt(3), 4)
+    for _ in range(20):
+        done = eng.step()
+        if done:
+            break
+    assert done and done[0].rid == rid3
+
+
+def test_cancel_vs_failover_race_no_resurrection():
+    """A failover racing a cancel must not resurrect the request:
+    requeue_front of an already-terminal request is a no-op."""
+    gw = RequestGateway()
+    req = gw.submit(_prompt(1), 4)
+    gw.remove(req)
+    req.state = ServingRequestState.RUNNING      # placed on a replica
+    req.cancel()
+    # the router's sweep aborts it (as step() would)...
+    req.abort(ServingRequestState.CANCELLED)
+    gw.cancelled += 1
+    # ...then the replica dies and failover tries to requeue it
+    assert gw.requeue_front([req]) == []
+    assert req.state == ServingRequestState.CANCELLED
+    assert gw.depth() == 0, "a cancelled request must stay dead"
+    assert req.requeues == 0, "no replay was burned on the corpse"
+    with pytest.raises(RequestTimedOut):
+        req.result(timeout=0)
+
+
+def test_cancel_on_dead_replica_counts_send_failure(workers):
+    """A cancel whose CANCEL frame cannot be delivered (worker gone
+    between sweeps) is counted — a live fleet with rising cancel-send
+    failures is a real signal, not noise."""
+    w = workers(slots=2, tokens_per_step=1, step_delay=0.01)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    proxy = w.proxy("rw")
+    router.join_replica("rw", proxy)
+    req = router.submit(_prompt(1), 500)
+    deadline = time.monotonic() + 10.0
+    handle = router.manager.get("rw")
+    while not handle.inflight and time.monotonic() < deadline:
+        router.step()
+        time.sleep(0.002)
+    assert handle.inflight
+    # tear the worker down and cancel before the router notices the
+    # death: the sweep runs before the reap in the same step
+    w.stop()
+    deadline = time.monotonic() + 5.0
+    while proxy.dead is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    req.cancel()
+    router.step()
+    assert req.state == ServingRequestState.CANCELLED
+    assert router.metrics.metrics()[
+        "serving_cancel_send_failures_total"] == 1
+    assert proxy.cancel_send_failures == 1
+    # failover of the dead replica must NOT resurrect the cancelled
+    # request
+    _drive(router, timeout=10.0)
+    assert req.state == ServingRequestState.CANCELLED
+    assert req.requeues == 0
+
+
+# -- crash-loop quarantine (supervisor) --------------------------------------
+
+
+class _StubProc:
+    def __init__(self, pid):
+        self.pid = pid
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+
+class _StubProxy:
+    def close(self, goodbye=True):
+        pass
+
+
+class _StubSupervisor(WorkerSupervisor):
+    """spawn() without fork/exec: tests flip ``record.proc.returncode``
+    to simulate crashes and drive ``poll(now=...)`` deterministically."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._pid = 1000
+        self.spawned = []
+
+    def spawn(self, name=None, join=True, managed=True):
+        with self._lock:
+            if name is None:
+                name = f"{self.name_prefix}-{self._next}"
+                self._next += 1
+        self._pid += 1
+        record = WorkerRecord(
+            name, _StubProc(self._pid), "127.0.0.1:0", _StubProxy(),
+            managed)
+        with self._lock:
+            self.workers[name] = record
+        self.spawned.append(name)
+        return record
+
+
+def _crash_current(sup):
+    for record in sup.workers.values():
+        record.proc.returncode = 9
+
+
+def test_supervisor_backoff_schedule_and_quarantine():
+    """A crash-looping worker is respawned on an exponential, jittered
+    backoff — NEVER a hot loop — and lands in quarantine once it blows
+    the sliding-window budget."""
+    recorder = FlightRecorder()
+    sup = _StubSupervisor(
+        respawn=True, max_respawns=3, respawn_window=300.0,
+        backoff_base=0.5, backoff_max=60.0, backoff_jitter=0.25,
+        quarantine_seconds=50.0, seed=42, recorder=recorder)
+    sup.spawn(name="crashy")
+    t = 100.0
+    while "crashy" not in {
+        base_replica_name(n) for n in sup.quarantined
+    } and t < 100.0 + 200.0:
+        _crash_current(sup)
+        sup.poll(now=t)
+        t += 0.05
+    quarantined = [r for n, r in sup.quarantined.items()
+                   if base_replica_name(n) == "crashy"]
+    assert quarantined, "the crash loop must end in quarantine"
+    record = quarantined[0]
+    # the planned schedule shows exponential growth...
+    backoffs = [e["backoff_s"] for e in record.respawn_schedule]
+    assert len(backoffs) == 3, "budget 3 = three metered respawns"
+    assert all(b2 > b1 for b1, b2 in zip(backoffs, backoffs[1:]))
+    assert backoffs[0] >= 0.5 and backoffs[-1] >= 2.0
+    # ...and the ACTUAL respawn timestamps show strictly increasing
+    # gaps (the anti-hot-loop acceptance)
+    times = record.respawn_times
+    assert len(times) == 3
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert all(g2 > g1 for g1, g2 in zip(gaps, gaps[1:])), gaps
+    # seeded: a second supervisor replays the identical schedule
+    sup2 = _StubSupervisor(
+        respawn=True, max_respawns=3, respawn_window=300.0,
+        backoff_base=0.5, backoff_max=60.0, backoff_jitter=0.25,
+        quarantine_seconds=50.0, seed=42)
+    sup2.spawn(name="crashy")
+    t = 100.0
+    while not sup2.quarantined and t < 300.0:
+        _crash_current(sup2)
+        sup2.poll(now=t)
+        t += 0.05
+    rec2 = list(sup2.quarantined.values())[0]
+    assert [e["backoff_s"] for e in rec2.respawn_schedule] == backoffs
+    # flight recorder saw the whole story
+    kinds = [e["kind"] for e in recorder.events(256)]
+    assert "worker_respawn_scheduled" in kinds
+    assert "worker_quarantined" in kinds
+    assert sup.quarantined_total == 1
+
+
+def test_supervisor_quarantine_exit_earns_fresh_window():
+    """A served quarantine sentence resumes respawns with a clean
+    crash window (the fleet is never silently permanently smaller) —
+    and a worker that LIVES clears its flap history."""
+
+    class _Router:
+        def __init__(self):
+            from dlrover_tpu.serving.router.metrics import RouterMetrics
+
+            self.metrics = RouterMetrics()
+
+    recorder = FlightRecorder()
+    router = _Router()
+    sup = _StubSupervisor(
+        router=router, respawn=True, max_respawns=1,
+        respawn_window=300.0, backoff_base=0.5, backoff_jitter=0.0,
+        quarantine_seconds=10.0, seed=0, recorder=recorder)
+    sup.spawn(name="flappy")
+    t = 100.0
+    while not sup.quarantined and t < 200.0:
+        _crash_current(sup)
+        sup.poll(now=t)
+        t += 0.05
+    assert sup.quarantined
+    assert router.metrics.metrics()[
+        "serving_worker_quarantined_total"] == 1.0
+    until = list(sup.quarantined.values())[0].quarantine_until
+    # sitting out the sentence...
+    sup.poll(now=until - 1.0)
+    assert sup.quarantined and not sup.workers
+    # ...then release: respawned with an EMPTY crash window
+    sup.poll(now=until + 0.1)
+    assert not sup.quarantined
+    assert sup.pending or sup.workers
+    sup.poll(now=until + 0.2)
+    assert len(sup.workers) == 1
+    revived = list(sup.workers.values())[0]
+    assert revived.crash_times == []
+    kinds = [e["kind"] for e in recorder.events(256)]
+    assert "worker_quarantine_exit" in kinds
+    # this time it lives: a crash AFTER the window clears the history
+    # and is metered from scratch (backoff back to base)
+    revived.proc.returncode = 9
+    sup.poll(now=until + 400.0)
+    fresh_backoffs = [
+        e["backoff_s"] for e in revived.respawn_schedule
+        if e["exit_at"] >= until + 400.0
+    ]
+    assert fresh_backoffs == [0.5]
+
+
+def test_supervisor_kill_unknown_name_raises_value_error():
+    sup = _StubSupervisor(respawn=False)
+    sup.spawn(name="alive")
+    with pytest.raises(ValueError) as e:
+        sup.kill("ghost")
+    assert "ghost" in str(e.value) and "alive" in str(e.value)
+
+
+def test_supervisor_voluntary_exit_not_metered():
+    """rc==0 (GOODBYE-initiated) is a scale decision, not a crash: no
+    respawn, no backoff, no quarantine accounting."""
+    sup = _StubSupervisor(respawn=True, max_respawns=1)
+    rec = sup.spawn(name="retired")
+    rec.proc.returncode = 0
+    sup.poll(now=100.0)
+    assert not sup.workers and not sup.pending and not sup.quarantined
+
+
+# -- replica probation (router) ----------------------------------------------
+
+
+def test_replica_probation_cooldown_grows_and_clears():
+    mgr = ReplicaManager(probation_lifetime=5.0,
+                         probation_cooldown=2.0, probation_max=60.0)
+    from dlrover_tpu.serving.router.replica import ReplicaHandle
+
+    t = 1000.0
+    h0 = mgr.join(ReplicaHandle("w", FakeEngine()), now=t)
+    assert h0.probation_until == 0.0, "a first join has no history"
+    h0.fail()
+    mgr.reap_dead(now=t + 1.0)          # died 1s after joining: flap 1
+    mgr.dead_handles.clear()
+    h1 = mgr.join(ReplicaHandle("w#r1", FakeEngine()), now=t + 2.0)
+    assert h1.probation_until == pytest.approx(t + 4.0)   # +2.0s
+    assert mgr.schedulable(now=t + 3.0) == []
+    assert mgr.probation_count(now=t + 3.0) == 1
+    assert mgr.schedulable(now=t + 4.5) == [h1]
+    assert mgr.probation_count(now=t + 4.5) == 0
+    h1.fail()
+    mgr.reap_dead(now=t + 5.0)          # another short life: flap 2
+    mgr.dead_handles.clear()
+    h2 = mgr.join(ReplicaHandle("w#r2", FakeEngine()), now=t + 6.0)
+    assert h2.probation_until == pytest.approx(t + 10.0)  # +4.0s
+    # this generation survives past the flap threshold: history clears
+    h2.fail()
+    mgr.reap_dead(now=t + 30.0)
+    mgr.dead_handles.clear()
+    h3 = mgr.join(ReplicaHandle("w#r3", FakeEngine()), now=t + 31.0)
+    assert h3.probation_until == 0.0, \
+        "a replica that lived must clear its crash-loop history"
+
+
+def test_probation_blocks_placement_until_cooldown():
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        manager=ReplicaManager(probation_lifetime=5.0,
+                               probation_cooldown=4.0),
+    )
+    t = 500.0
+    router.join_replica("w", FakeEngine(), now=t)
+    router.fail_replica("w")
+    router.step(now=t + 1.0)            # reaped: short life, flap 1
+    router.join_replica("w#r1", FakeEngine(), now=t + 2.0)
+    req = router.submit(_prompt(1), 4, now=t + 2.0)
+    router.step(now=t + 3.0)            # inside the 4s cooldown
+    assert req.state == ServingRequestState.QUEUED, \
+        "probation must keep the flapper out of placement"
+    assert router.metrics.metrics()["serving_replica_probation"] == 1.0
+    router.step(now=t + 6.5)            # cooldown over
+    assert req.state == ServingRequestState.DONE
+    assert router.metrics.metrics()["serving_replica_probation"] == 0.0
+    kinds = [e["kind"] for e in router.recorder.events(64)]
+    assert "replica_probation" in kinds
+
+
+# -- the fast acceptance -----------------------------------------------------
+
+
+def test_chaos_acceptance_fast_matrix(workers):
+    """In-thread acceptance: a 200-request stream over 4 workers while
+    a seeded fault schedule tears one connection, stalls another
+    worker's frames, and a third dies abruptly — plus a handful of
+    client cancels — completes with zero lost requests and reclaimed
+    slots everywhere."""
+    tear = FaultSchedule(
+        [{"op": "tear", "kind": "TOKEN", "after": 60}], seed=11)
+    stall = FaultSchedule(
+        [{"op": "stall", "kind": "*", "after": 90, "seconds": 120.0}],
+        seed=12)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        cancel_inflight_on_expiry=True,
+    )
+    fleet = {
+        "torn": workers(fault_schedule=tear, slots=4,
+                        tokens_per_step=2, step_delay=0.002),
+        "stalled": workers(fault_schedule=stall, slots=4,
+                           tokens_per_step=2, step_delay=0.002),
+        "doomed": workers(slots=4, tokens_per_step=2,
+                          step_delay=0.002),
+        "healthy": workers(slots=4, tokens_per_step=2,
+                           step_delay=0.002),
+    }
+    for name, w in fleet.items():
+        router.join_replica(
+            name, w.proxy(name, frame_timeout=1.0))
+    reqs = [router.submit(_prompt(i), 8) for i in range(200)]
+
+    state = {"killed": False, "cancelled": []}
+
+    def chaos():
+        if not state["killed"]:
+            doomed = router.manager.get("doomed")
+            if doomed is not None and doomed.inflight:
+                fleet["doomed"].stop()   # abrupt death, mid-stream
+                state["killed"] = True
+        if not state["cancelled"] and state["killed"]:
+            for r in reqs:
+                if len(state["cancelled"]) >= 5:
+                    break
+                if r.state in (ServingRequestState.QUEUED,
+                               ServingRequestState.RUNNING):
+                    if r.cancel():
+                        state["cancelled"].append(r)
+
+    _drive(router, timeout=60.0, extra=chaos)
+    assert state["killed"], "the abrupt death must have happened"
+    assert tear.fired("tear"), "the torn connection must have fired"
+    assert stall.fired("stall"), "the stall must have fired"
+    assert len(state["cancelled"]) == 5
+
+    # ZERO lost requests: every request reached a terminal, accounted
+    # state — cancelled ones answered their caller, the rest completed
+    terminal = {ServingRequestState.DONE, ServingRequestState.CANCELLED}
+    for r in reqs:
+        assert r.state in terminal, (r.rid, r.state)
+    m = router.metrics.metrics()
+    done = sum(1 for r in reqs if r.state == ServingRequestState.DONE)
+    cancelled = 200 - done
+    assert m["serving_requests_completed_total"] == done
+    assert m["serving_requests_cancelled_total"] == cancelled
+    assert 0 < cancelled <= 5
+    assert m["serving_requests_requeued_total"] >= 1, \
+        "the deaths must have exercised failover"
+    assert m["serving_requests_poisoned_total"] == 0
+    # the fleet degraded to exactly the healthy worker
+    assert router.replica_names == ["healthy"]
+    # slot reclamation: the surviving engine holds NOTHING (cancelled
+    # requests' slots included), asserted at the engine and via the
+    # proxy's STATS-fed ledger
+    deadline = time.monotonic() + 5.0
+    handle = router.manager.get("healthy")
+    while (fleet["healthy"].engine.active
+           or handle.slots_free() < 4) \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not fleet["healthy"].engine.active
+    assert fleet["healthy"].engine.used_blocks == 0
+    assert handle.slots_free() == 4
+    # cancelled in-flight requests closed their trace with the
+    # cancelled status and a flight-recorder cancel event exists
+    for r in state["cancelled"]:
+        tree = router.tracer.get_tree(r.trace.trace_id)
+        assert tree is not None
+        assert tree["status"] == ServingRequestState.CANCELLED
+
+
+def test_cancellation_and_fault_paths_lock_clean():
+    """The DL003 acceptance line, executed: cancel frame sends and
+    fault injection must add no blocking work under fabric locks."""
+    from dlrover_tpu.dlint.checkers import CHECKERS, DlintConfig, Project
+    from dlrover_tpu.dlint.core import ParsedModule
+
+    paths = [
+        "dlrover_tpu/serving/router/gateway.py",
+        "dlrover_tpu/serving/router/router.py",
+        "dlrover_tpu/serving/router/replica.py",
+        "dlrover_tpu/serving/remote/proxy.py",
+        "dlrover_tpu/serving/remote/worker.py",
+        "dlrover_tpu/serving/remote/supervisor.py",
+        "dlrover_tpu/serving/remote/faults.py",
+    ]
+    modules = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            modules.append(ParsedModule(p, p, f.read()))
+    project = Project(modules, DlintConfig())
+    by_path = {m.rel_path: m for m in modules}
+    dl003 = [c for c in CHECKERS if c.CODE == "DL003"][0]
+    violations = [
+        v for v in dl003.check_project(project)
+        if not by_path[v.path].suppressed(v.code, v.line)
+    ]
+    assert violations == [], [str(v) for v in violations]
+
+
+# -- subprocess acceptance (slow) --------------------------------------------
+
+
+def _can_spawn() -> bool:
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "pass"], timeout=30, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return True
+    except Exception:
+        return False
+
+
+needs_spawn = pytest.mark.skipif(
+    not _can_spawn(), reason="cannot spawn subprocesses here")
+
+
+@pytest.mark.slow
+@needs_spawn
+def test_chaos_acceptance_full_matrix_subprocess():
+    """THE acceptance: real worker processes under a seeded fault
+    schedule — one torn connection, one heartbeat stall, one SIGKILL,
+    one crash-looping worker — serve a 200-request stream with zero
+    lost requests; cancelled requests reclaim their slots; the crash
+    looper's respawn gaps strictly increase and end in quarantine."""
+    import signal as signal_mod
+
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        cancel_inflight_on_expiry=True,
+    )
+    base_args = ["--slots", "4", "--tokens-per-step", "2",
+                 "--step-delay", "0.005"]
+
+    def faulted_env(faults, seed):
+        env = dict(os.environ)
+        env[ServingFabric.FAULTS_ENV] = json.dumps(
+            {"seed": seed, "faults": faults})
+        return env
+
+    sups = []
+    try:
+        healthy = WorkerSupervisor(
+            router=router, engine="fake", worker_args=base_args,
+            name_prefix="healthy", seed=1)
+        sups.append(healthy)
+        for _ in range(2):
+            healthy.spawn()
+        victim_sup = WorkerSupervisor(
+            router=router, engine="fake", worker_args=base_args,
+            name_prefix="victim", backoff_base=0.2, seed=2)
+        sups.append(victim_sup)
+        victim_sup.spawn()
+
+        # torn + stalled workers: armed through the env seam
+        os.environ[ServingFabric.FAULTS_ENV] = json.dumps(
+            {"seed": 3, "faults": [
+                {"op": "tear", "kind": "TOKEN", "after": 60}]})
+        try:
+            torn_sup = WorkerSupervisor(
+                router=router, engine="fake", worker_args=base_args,
+                name_prefix="torn", respawn=False)
+            sups.append(torn_sup)
+            torn_sup.spawn()
+            os.environ[ServingFabric.FAULTS_ENV] = json.dumps(
+                {"seed": 4, "faults": [
+                    {"op": "stall", "kind": "*", "after": 90,
+                     "seconds": 120.0}]})
+            stalled_sup = WorkerSupervisor(
+                router=router, engine="fake", worker_args=base_args,
+                name_prefix="stalled", respawn=False)
+            sups.append(stalled_sup)
+            stalled_sup.spawn()
+        finally:
+            os.environ.pop(ServingFabric.FAULTS_ENV, None)
+
+        # the crash looper: dies 0.3s after every start, forever
+        crash_sup = WorkerSupervisor(
+            router=router, engine="fake",
+            worker_args=base_args + ["--crash-after", "0.3"],
+            name_prefix="crashloop", max_respawns=3,
+            respawn_window=300.0, backoff_base=1.0, backoff_max=30.0,
+            backoff_jitter=0.25, quarantine_seconds=600.0, seed=5)
+        sups.append(crash_sup)
+        crash_sup.spawn()
+
+        assert len(router.replica_names) == 6
+        reqs = [router.submit(_prompt(i), 8) for i in range(200)]
+
+        state = {"killed": False, "cancelled": []}
+
+        def chaos():
+            for sup in sups:
+                sup.poll()
+            if not state["killed"]:
+                victims = [n for n in router.replica_names
+                           if n.startswith("victim")]
+                if victims:
+                    v = router.manager.get(victims[0])
+                    if v is not None and v.inflight:
+                        victim_sup.kill(
+                            victims[0], signal_mod.SIGKILL)
+                        state["killed"] = True
+            if state["killed"] and not state["cancelled"]:
+                for r in reqs:
+                    if len(state["cancelled"]) >= 5:
+                        break
+                    if r.state in (ServingRequestState.QUEUED,
+                                   ServingRequestState.RUNNING):
+                        if r.cancel():
+                            state["cancelled"].append(r)
+
+        deadline = time.monotonic() + 120.0
+        while (router.has_work or not crash_sup.quarantined) \
+                and time.monotonic() < deadline:
+            router.step()
+            chaos()
+            time.sleep(0.002)
+        assert state["killed"], "the SIGKILL must have landed"
+
+        # zero lost requests
+        terminal = {ServingRequestState.DONE,
+                    ServingRequestState.CANCELLED}
+        for r in reqs:
+            assert r.state in terminal, (r.rid, r.state)
+        m = router.metrics.metrics()
+        done = sum(
+            1 for r in reqs if r.state == ServingRequestState.DONE)
+        assert m["serving_requests_completed_total"] == done
+        assert m["serving_requests_cancelled_total"] == 200 - done
+        assert m["serving_requests_requeued_total"] >= 1
+        assert m["serving_requests_poisoned_total"] == 0
+
+        # the crash looper: strictly increasing respawn gaps, then
+        # quarantine — never a hot loop, never silent fleet loss
+        assert crash_sup.quarantined, \
+            "the crash loop must end in quarantine"
+        record = list(crash_sup.quarantined.values())[0]
+        times = record.respawn_times
+        assert len(times) == 3
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        assert all(g2 > g1 for g1, g2 in zip(gaps, gaps[1:])), gaps
+        assert m["serving_worker_quarantined_total"] == 1.0
+
+        # slot reclamation on every surviving replica, via STATS
+        for name in router.replica_names:
+            handle = router.manager.get(name)
+            slot_deadline = time.monotonic() + 5.0
+            while handle.slots_free() < 4 \
+                    and time.monotonic() < slot_deadline:
+                time.sleep(0.01)
+            assert handle.slots_free() == 4, name
+        # the flight recorder tells the whole story
+        kinds = {e["kind"] for e in router.recorder.events(512)}
+        assert "worker_quarantined" in kinds
+        assert "worker_respawn_scheduled" in kinds
+        assert "replica_dead" in kinds
+    finally:
+        for sup in sups:
+            sup.shutdown()

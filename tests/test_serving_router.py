@@ -91,6 +91,43 @@ def test_gateway_deadline_expiry():
         req.result(timeout=0)
 
 
+def test_gateway_now_equals_deadline_is_not_expired():
+    """Expiry is strict ``>``: a request AT its deadline still gets
+    this scheduling round — ``timeout=0`` means "fail unless
+    immediately serviceable", and only strictness makes the immediate
+    round possible."""
+    gw = RequestGateway()
+    req = gw.submit(_prompt(1), 4, timeout=5.0, now=100.0)
+    assert gw.expire(now=105.0) == [], \
+        "now == deadline must NOT expire (strict >)"
+    assert req.state == ServingRequestState.QUEUED
+    assert gw.expire(now=105.0000001) == [req]
+
+
+def test_requeue_front_of_cancelled_request_is_noop():
+    """A failover racing a cancel must not resurrect the request."""
+    gw = RequestGateway()
+    req = gw.submit(_prompt(1), 4)
+    gw.remove(req)
+    req.state = ServingRequestState.RUNNING
+    assert req.cancel() is True
+    req.abort(ServingRequestState.CANCELLED)   # the router's sweep
+    assert gw.requeue_front([req]) == []
+    assert gw.depth() == 0
+    assert req.state == ServingRequestState.CANCELLED
+    assert req.requeues == 0
+    # same for every other terminal state — a poisoned/expired corpse
+    # must not re-enter the queue either
+    for state in (ServingRequestState.TIMED_OUT,
+                  ServingRequestState.POISONED,
+                  ServingRequestState.DONE):
+        other = gw.submit(_prompt(2), 4)
+        gw.remove(other)
+        other.state = state
+        assert gw.requeue_front([other]) == []
+        assert gw.depth() == 0 and other.state == state
+
+
 # -- scheduler --------------------------------------------------------------
 
 
