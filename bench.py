@@ -590,6 +590,24 @@ def main() -> None:
         result.update(json.loads(line))
     except Exception as e:
         result["serving_error"] = str(e)[:200]
+    # regression gate (ROADMAP "win back the checkpoint pause"): a
+    # failed ckpt_pause_ok must be LOUD in the summary — a nonzero
+    # bench_regressions flag the driver can key on plus a stderr line —
+    # so the r05 pause regression cannot drift silently run-over-run
+    regressions = []
+    if result.get("ckpt_pause_ok") is False:
+        regressions.append("ckpt_pause")
+        print(
+            "BENCH REGRESSION: ckpt_pause_ok=false — in-loop save "
+            f"pause {result.get('ckpt_save_pause_s')}s vs absolute bar "
+            f"{result.get('ckpt_pause_abs_bar_s')}s (ratio "
+            f"{result.get('ckpt_pause_memcpy_ratio')} vs bar "
+            f"{result.get('ckpt_pause_ratio_bar')}); see PERF.md",
+            file=sys.stderr,
+        )
+    result["bench_regressions"] = len(regressions)
+    if regressions:
+        result["bench_regression_names"] = regressions
     print(json.dumps(result))
 
 

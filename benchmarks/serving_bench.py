@@ -206,9 +206,64 @@ def run_spec_config() -> dict:
     }
 
 
+def run_trace_config() -> dict:
+    """Tracing overhead through the FULL router path (gateway span
+    stamping + placement/submit/first-token spans + histograms) at
+    sample_rate 1.0 vs 0.01, µs per request.  Uses the FakeEngine so
+    the number isolates the observability plane from model math — the
+    cost a millions-of-users fleet pays per request, and the saving
+    the sampling knob buys."""
+    import numpy as np
+
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RequestGateway,
+        ServingRouter,
+    )
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+
+    n_req = 400
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 32000, (n_req, 32)).astype(np.int32)
+
+    def one_run(rate: float) -> float:
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=n_req + 1, trace_sample_rate=rate),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+        )
+        router.join_replica(
+            "bench-0", FakeEngine(slots=16, tokens_per_step=8,
+                                  blocks=1_000_000))
+        t0 = time.perf_counter()
+        reqs = [router.submit(p, 16) for p in prompts]
+        router.run_until_idle()
+        wall = time.perf_counter() - t0
+        assert all(len(r.output) == 16 for r in reqs)
+        return wall / n_req * 1e6  # µs per request
+
+    # INTERLEAVED best-of-5 (rate pairs back to back): this shared
+    # host's load drifts second-to-second, and sequential blocks would
+    # measure the neighbor, not the knob.  Span STAMPING is always on
+    # (incident completeness requires it), so the two numbers are
+    # expected to be close — the knob's real saving at scale is ring
+    # retention + worker-side span shipping, not router-side stamping.
+    fulls, sampleds = [], []
+    for _ in range(5):
+        fulls.append(one_run(1.0))
+        sampleds.append(one_run(0.01))
+    full, sampled = min(fulls), min(sampleds)
+    return {
+        "serving_trace_us_per_req_rate_1": round(full, 2),
+        "serving_trace_us_per_req_rate_001": round(sampled, 2),
+        "serving_trace_sampling_saving": round(
+            (full - sampled) / full, 3),
+    }
+
+
 def main() -> dict:
     out = {}
-    for mode in ("bf16", "int8", "bf16_slots1", "spec"):
+    for mode in ("bf16", "int8", "bf16_slots1", "spec", "trace"):
         proc = subprocess.run(
             [sys.executable, __file__, mode],
             capture_output=True, text=True, timeout=1800,
@@ -240,6 +295,8 @@ if __name__ == "__main__":
     if len(sys.argv) > 1:
         if sys.argv[1] == "spec":
             print(json.dumps(run_spec_config()))
+        elif sys.argv[1] == "trace":
+            print(json.dumps(run_trace_config()))
         else:
             print(json.dumps(run_config(sys.argv[1])))
     else:

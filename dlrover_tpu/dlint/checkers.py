@@ -26,6 +26,10 @@ DL003  lock-blocking        no blocking call (socket recv/send/accept,
                             remote-proxy review fought: one blocked
                             holder freezes every thread that touches
                             the lock (for the router, the whole pump).
+                            Alias-aware: a lock renamed into a local
+                            (``m = self._lock``) or passed as a
+                            parameter (``helper(self._lock)``) guards
+                            its ``with`` body too.
 DL004  frame-exhaustive     every ``FrameKind`` constant in the frame
                             protocol must be referenced — or declared
                             in ``_UNHANDLED_FRAME_KINDS`` with a reason
@@ -317,36 +321,142 @@ class LockBlockingChecker(Checker):
     # attribute calls that block unless given a timeout / non-blocking
     # argument: .wait() / .join() / .get() / .acquire() with no args
     UNTIMED_ATTRS = frozenset({"wait", "join", "get", "acquire"})
+    # constructor calls whose RESULT is evidently a lock — the other
+    # way a local name becomes a lock alias besides `x = self._lock`
+    LOCK_FACTORIES = frozenset(
+        {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+    )
 
     def check_module(self, module, project):
+        # alias-awareness: a lock renamed into a local
+        # (`m = self._lock`) or passed as a parameter
+        # (`helper(self._lock)` into `def helper(m): with m: ...`)
+        # guards its `with` body exactly like a lexically lock-named
+        # one — the step-lock discipline must survive refactors that
+        # thread the lock through helpers
+        aliases = self._alias_table(module)
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.With):
                 continue
+            scope = self._scope_aliases(module, node, aliases)
             if not any(
-                self._lock_like(item.context_expr) for item in node.items
+                self._lock_like(item.context_expr, scope)
+                for item in node.items
             ):
                 continue
             for stmt in node.body:
-                yield from self._scan(module, stmt)
+                yield from self._scan(module, stmt, scope)
 
     @staticmethod
-    def _lock_like(expr: ast.AST) -> bool:
+    def _lock_like(expr: ast.AST, aliases: frozenset = frozenset()
+                   ) -> bool:
         # mutexes and semaphores hold waiters exactly like locks do;
         # condition variables are deliberately excluded (cv.wait under
         # the paired lock is the correct idiom)
-        name = _terminal_name(expr).lower()
+        name = _terminal_name(expr)
+        if isinstance(expr, ast.Name) and name in aliases:
+            return True
+        name = name.lower()
         if "unlock" in name:
             return False
         return any(k in name for k in ("lock", "mutex", "semaphore"))
 
-    def _scan(self, module, node):
+    @classmethod
+    def _lock_expr(cls, expr: ast.AST) -> bool:
+        """An expression that evidently EVALUATES to a lock: a
+        lock-named name/attribute, or a Lock()/RLock()/Semaphore()
+        constructor call."""
+        if isinstance(expr, ast.Call):
+            return _call_name(expr) in cls.LOCK_FACTORIES
+        return cls._lock_like(expr)
+
+    @staticmethod
+    def _own_body_nodes(func):
+        """Nodes of ``func``'s own body, NOT descending into nested
+        defs/lambdas/classes — their locals are their own scope (a
+        nested helper's lock alias must not contaminate the enclosing
+        function's table, mirroring the boundary ``_scan`` enforces)."""
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda, ast.ClassDef)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _alias_table(self, module) -> Dict[ast.AST, Set[str]]:
+        """Per-function sets of local names bound to locks: direct
+        assignments inside the body, plus parameters that receive a
+        lock expression at ANY same-module call site (matched by
+        function name; `self`/`cls` skipped for method calls)."""
+        funcs = [
+            n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        table: Dict[ast.AST, Set[str]] = {f: set() for f in funcs}
+        by_name: Dict[str, List[ast.AST]] = {}
+        for f in funcs:
+            by_name.setdefault(f.name, []).append(f)
+            for node in self._own_body_nodes(f):
+                if isinstance(node, ast.Assign) \
+                        and self._lock_expr(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            table[f].add(tgt.id)
+        for call in ast.walk(module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            targets = by_name.get(_call_name(call))
+            if not targets:
+                continue
+            lock_pos = [
+                i for i, a in enumerate(call.args)
+                if self._lock_expr(a)
+            ]
+            lock_kw = [
+                kw.arg for kw in call.keywords
+                if kw.arg and self._lock_expr(kw.value)
+            ]
+            if not lock_pos and not lock_kw:
+                continue
+            method_call = isinstance(call.func, ast.Attribute)
+            for f in targets:
+                params = [
+                    a.arg for a in f.args.posonlyargs + f.args.args
+                ]
+                offset = (
+                    1 if method_call and params[:1] in (
+                        ["self"], ["cls"])
+                    else 0
+                )
+                for i in lock_pos:
+                    if i + offset < len(params):
+                        table[f].add(params[i + offset])
+                kwonly = {a.arg for a in f.args.kwonlyargs}
+                for name in lock_kw:
+                    if name in params or name in kwonly:
+                        table[f].add(name)
+        return table
+
+    @staticmethod
+    def _scope_aliases(module, node, table) -> frozenset:
+        """The alias set of the function enclosing ``node``."""
+        for anc in module.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return frozenset(table.get(anc, ()))
+        return frozenset()
+
+    def _scan(self, module, node, aliases: frozenset = frozenset()):
         if isinstance(
             node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
                    ast.ClassDef)
         ):
             return  # a nested def body does not run under the lock
         if isinstance(node, ast.With) and any(
-            self._lock_like(item.context_expr) for item in node.items
+            self._lock_like(item.context_expr, aliases)
+            for item in node.items
         ):
             # the outer walk over the module visits this With itself;
             # descending here too would report its body twice
@@ -356,7 +466,7 @@ class LockBlockingChecker(Checker):
             if v is not None:
                 yield v
         for child in ast.iter_child_nodes(node):
-            yield from self._scan(module, child)
+            yield from self._scan(module, child, aliases)
 
     def _classify(self, module, call: ast.Call) -> Optional[Violation]:
         name = _call_name(call)

@@ -378,6 +378,36 @@ class WorkerSupervisor:
         if metrics is not None:
             metrics.worker_quarantined += 1
 
+    # ------------------------------------------------------- metrics
+    def render_worker_state(self) -> str:
+        """Per-worker state as labeled Prometheus text — wire via
+        ``MetricsExporter.add_text_source``.  One
+        ``serving_worker_state{worker="…",state="…"} 1`` sample per
+        supervised worker: ``running`` (process alive), ``backoff``
+        (crashed, waiting out its exponential respawn delay) or
+        ``quarantined`` (respawn budget blown, sitting out the
+        sentence) — the dashboard answer to "the fleet gauge says 3
+        but placement says 2: WHICH worker is sitting out, and why"."""
+        from dlrover_tpu.utils.metric_registry import metric_help
+        from dlrover_tpu.utils.profiler import escape_label_value
+
+        with self._lock:
+            states = [(r.name, "running") for r in self.workers.values()]
+            states += [(name, "backoff") for name in self.pending]
+            states += [(name, "quarantined") for name in self.quarantined]
+
+        lines = []
+        help_text = metric_help("serving_worker_state")
+        if help_text:
+            lines.append(f"# HELP serving_worker_state {help_text}")
+        lines.append("# TYPE serving_worker_state gauge")
+        for name, state in sorted(states):
+            lines.append(
+                "serving_worker_state{"
+                f'worker="{escape_label_value(name)}",state="{state}"'
+                "} 1")
+        return "\n".join(lines) + "\n"
+
     # -------------------------------------------------------- chaos
     def kill(self, name: str, sig: int = signal.SIGKILL) -> int:
         """Chaos hook: signal a worker process (default SIGKILL — the

@@ -33,6 +33,7 @@ import numpy as np
 from dlrover_tpu.common.constants import ServingFabric
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.remote.protocol import FrameConnection, FrameKind
+from dlrover_tpu.utils.tracing import parse_traceparent, trace_sampled
 
 
 class FakeEngine:
@@ -55,6 +56,9 @@ class FakeEngine:
         self._next = 0
         self.active: Dict[int, dict] = {}
         self.generated_tokens = 0
+        # wall seconds of the most recent step() — decode-step
+        # histogram attribution when this engine runs in-process
+        self.last_step_seconds: Optional[float] = None
 
     def add_request(self, prompt, max_new_tokens: int) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -73,6 +77,7 @@ class FakeEngine:
         return rid
 
     def step(self) -> List:
+        t0 = time.perf_counter()
         if self.step_delay:
             time.sleep(self.step_delay)
         finished = []
@@ -87,6 +92,7 @@ class FakeEngine:
                 finished.append(
                     SimpleNamespace(rid=rid, output=st["output"]))
                 del self.active[rid]
+        self.last_step_seconds = time.perf_counter() - t0
         return finished
 
     @property
@@ -127,10 +133,19 @@ class WorkerServer:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  stats_interval: float = ServingFabric.STATS_INTERVAL,
-                 engine_kind: str = "fake", fault_schedule=None):
+                 engine_kind: str = "fake", fault_schedule=None,
+                 trace_sample_rate: float = 1.0):
         self.engine = engine
         self.stats_interval = float(stats_interval)
         self.engine_kind = engine_kind
+        # head-sampling agreement with the router: a received context
+        # that asserts the sampled flag IS the router's keep verdict
+        # (it omits the traceparent for sampled-out requests and keeps
+        # propagating for incidents) and is always honored; this rate
+        # only gates contexts that DON'T assert sampling, via the same
+        # deterministic trace_sampled() predicate the router uses, so
+        # both sides agree with no coordination frame
+        self.trace_sample_rate = float(trace_sample_rate)
         # chaos seam (serving/remote/faults.py): a FaultSchedule here
         # perturbs every outgoing frame — torn streams, stalled STATS,
         # duplicated TOKENs — so degradation paths are TESTED, not
@@ -286,7 +301,8 @@ class WorkerServer:
             self._erid_by_rid[rid] = erid
             self._rid_by_erid[erid] = rid
             tp = frame.get("trace")
-            if isinstance(tp, str) and tp:
+            if isinstance(tp, str) and tp \
+                    and self._trace_wanted(tp):
                 self._trace_by_erid[erid] = {
                     "trace": tp, "t0": time.monotonic(),
                     "t_first": None, "steps": 0, "engine_s": 0.0,
@@ -361,6 +377,27 @@ class WorkerServer:
                       **self._trace_spans(rec))
         if finished:
             self._send_stats(conn)
+
+    def _trace_wanted(self, traceparent: str) -> bool:
+        """Worker-side verdict for a SUBMIT's trace context.  A context
+        asserting the sampled flag (``…-01``) carries the ROUTER's keep
+        decision — it only propagates traces it retains, and the
+        incident override (a failover retry's worker spans must come
+        back even at 1% sampling) rides that decision, so it is honored
+        as-is, never re-derived and vetoed here.  Undecided contexts
+        (flags ``00``, e.g. a foreign sender delegating the decision)
+        fall back to the same deterministic predicate the router uses,
+        keyed on the trace_id, so both sides agree without
+        coordination.  Unparseable context samples in (degrade toward
+        keeping data)."""
+        if self.trace_sample_rate >= 1.0:
+            return True
+        parsed = parse_traceparent(traceparent)
+        if parsed is None:
+            return True
+        if traceparent.rsplit("-", 1)[-1] == "01":
+            return True
+        return trace_sampled(parsed[0], self.trace_sample_rate)
 
     def _trace_header(self, erid: int) -> dict:
         rec = self._trace_by_erid.get(erid)
@@ -442,6 +479,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stats-interval", type=float,
                    default=ServingFabric.STATS_INTERVAL)
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="head-sampling rate for trace contexts that "
+                        "do NOT assert the sampled flag (a flagged "
+                        "context carries the router's keep verdict, "
+                        "incident overrides included, and is always "
+                        "honored); the verdict is deterministic per "
+                        "trace_id, so both sides agree without "
+                        "coordination")
     p.add_argument("--crash-after", type=float, default=0.0,
                    help="chaos: hard-exit (rc 9) this many seconds "
                         "after startup — the crash-loop worker the "
@@ -463,6 +508,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         engine, host=args.host, port=args.port,
         stats_interval=args.stats_interval, engine_kind=args.engine,
         fault_schedule=FaultSchedule.from_env(),
+        trace_sample_rate=args.trace_sample_rate,
     )
     if args.crash_after > 0:
         # a real abrupt death (no GOODBYE, no atexit, nonzero rc): the

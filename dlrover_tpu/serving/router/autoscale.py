@@ -20,6 +20,18 @@ Scale-down is drain-first: the victim replica stops taking placements,
 finishes its in-flight requests inside the router pump, and only the
 DRAINED husk's node is removed from the cluster — no request is ever
 cut off by a scale decision.
+
+Every executed scale decision also opens a control-plane **autoscale
+trace** (served at ``/traces/autoscale``): marker spans for the
+load-window snapshot, the policy verdict and the ScalePlan emission at
+decision time, then milestone spans stitched from the flight
+recorder's fabric-event vocabulary as the decision materializes —
+``node_create`` (provisioner) → ``worker_spawn`` (supervisor) →
+``hello_join`` (router) → ``probation`` (if damped) →
+``first_placement`` (the new replica takes traffic); scale-downs trace
+``drain`` → ``retired`` per victim.  Each milestone span runs from the
+previous milestone, so the trace reads as "where did the 9 seconds
+between 'queue too deep' and 'new replica serving' actually go".
 """
 
 from __future__ import annotations
@@ -67,6 +79,13 @@ class ServingAutoScaler:
         # replicas this autoscaler asked to drain, by name -> their Node
         self._pending_removal: Dict[str, Optional[Node]] = {}
         self.plans: List[ScalePlan] = []
+        # control-plane tracing: one autoscale trace per executed
+        # decision, milestones stitched from flight-recorder events
+        self.tracer = getattr(router, "tracer", None)
+        self.recorder = getattr(router, "recorder", None)
+        self._scale_trace: Optional[dict] = None
+        self._event_cursor = (
+            self.recorder.last_seq if self.recorder is not None else -1)
         router.autoscaler = self
 
     # -------------------------------------------------------- sampling
@@ -84,6 +103,7 @@ class ServingAutoScaler:
                 tokens_per_sec=m.tokens_per_second(now),
             ))
             del self._samples[: -8 * self.min_samples]
+        self._stitch_scale_trace()
         self._finish_deaths()
         self._finish_drains()
         if now - self._last_scale >= self.cooldown:
@@ -127,6 +147,10 @@ class ServingAutoScaler:
             plan = self._scale_down(current - desired)
         else:
             return None
+        if plan is not None:
+            # trace BEFORE clearing samples: the load-window snapshot
+            # span wants the evidence the decision was made from
+            self._trace_decision(now, current, desired, plan)
         self._last_scale = now
         self._samples.clear()  # decide from post-change evidence only
         return plan
@@ -219,6 +243,158 @@ class ServingAutoScaler:
         except Exception:  # telemetry only; never blocks the loop
             pass
 
+    # ------------------------------------------- control-plane tracing
+    # the stage each fabric event advances a NEW replica to; spans run
+    # from the previous milestone so stage-to-stage latency is visible
+    _UP_STAGES = {
+        "node_create": "node_create",
+        "worker_spawn": "worker_spawn",
+        "replica_join": "hello_join",
+        "replica_first_placement": "first_placement",
+    }
+
+    def _trace_decision(self, now: float, current: int, desired: int,
+                        plan: ScalePlan) -> None:
+        """Open the decision's autoscale trace (always sampled:
+        control-plane traces are one-per-decision, never hot-path)."""
+        if self.tracer is None:
+            return
+        direction = "up" if desired > current else "down"
+        st = self._scale_trace
+        if st is not None and st["direction"] == direction \
+                and st["desired"] == desired:
+            # the same episode re-planned while its replicas are still
+            # materializing (short cooldowns re-decide every round):
+            # ONE trace per episode, with the replan count on the root
+            st["plans"] += 1
+            st["root"].attrs["plans"] = st["plans"]
+            return
+        self._close_scale_trace("superseded", now)
+        tracer = self.tracer
+        root = tracer.start_trace(
+            "autoscale", now=now, always_sample=True,
+            current=current, desired=desired, direction=direction)
+        sample = self._samples[-1] if self._samples else None
+        window_attrs = {} if sample is None else {
+            "queue_depth": round(sample.queue_depth, 3),
+            "ttft_seconds": round(sample.ttft_seconds, 6),
+            "tokens_per_sec": round(sample.tokens_per_sec, 3),
+        }
+        tracer.start_span(
+            root, "load_window", now=now,
+            samples=len(self._samples), **window_attrs).finish(now)
+        tracer.start_span(
+            root, "policy", now=now, current=current, desired=desired,
+            source="brain" if self.brain is not None else "local",
+        ).finish(now)
+        tracer.start_span(
+            root, "scale_plan", now=now,
+            count=sum(
+                g.count for g in plan.node_group_resources.values()),
+            remove_nodes=len(plan.remove_nodes),
+        ).finish(now)
+        self._scale_trace = {
+            "root": root, "direction": direction, "desired": desired,
+            "decided_at": now, "plans": 1,
+            # replicas that existed at decision time: anything ELSE
+            # joining afterwards is this decision materializing
+            "known": set(self.router.replica_names),
+            "stage_t": {}, "stages": {}, "placed": set(),
+            "expected_new": max(0, desired - current),
+            "victims": set(self._pending_removal),
+            "retired": set(),
+        }
+
+    def _stitch_scale_trace(self) -> None:
+        """Consume new flight-recorder events into the open autoscale
+        trace — the cross-component stitch: provisioner node creation,
+        supervisor worker spawn, router join/probation/first placement
+        all narrate through the recorder, and this turns their
+        timestamps into milestone spans."""
+        if self.recorder is None:
+            return
+        events = self.recorder.events_since(self._event_cursor)
+        if events:
+            self._event_cursor = max(e["seq"] for e in events)
+        st = self._scale_trace
+        if st is None or self.tracer is None:
+            return
+        for event in events:
+            if st["direction"] == "up":
+                self._stitch_up(st, event)
+            else:
+                self._stitch_down(st, event)
+            if self._scale_trace is None:  # closed mid-batch
+                return
+
+    def _stitch_up(self, st: dict, event: dict) -> None:
+        kind = str(event.get("kind"))
+        name = event.get("replica") or event.get("worker") \
+            or event.get("node")
+        if not name or name in st["known"]:
+            return
+        t = float(event.get("t", st["decided_at"]))
+        if kind == "replica_probation":
+            # crash-loop damping delayed this replica's first traffic:
+            # the probation span runs join -> scheduled release
+            self.tracer.start_span(
+                st["root"], "probation", now=t, replica=name,
+            ).finish(max(t, float(event.get("until", t))))
+            return
+        stage = self._UP_STAGES.get(kind)
+        if stage is None or stage in st["stages"].setdefault(name, set()):
+            return
+        start = st["stage_t"].get(name, st["decided_at"])
+        # clamp: stitched events may mix the caller's synthetic clock
+        # with real monotonic stamps; a milestone never runs backwards
+        end = max(t, start)
+        self.tracer.start_span(
+            st["root"], stage, now=start, replica=name).finish(end)
+        st["stages"][name].add(stage)
+        st["stage_t"][name] = end
+        if stage == "first_placement":
+            st["placed"].add(name)
+            if len(st["placed"]) >= st["expected_new"]:
+                self._close_scale_trace("ok", end)
+
+    def _stitch_down(self, st: dict, event: dict) -> None:
+        kind = str(event.get("kind"))
+        name = event.get("replica")
+        if name not in st["victims"]:
+            return
+        t = float(event.get("t", st["decided_at"]))
+        # a victim dying MID-DRAIN still terminates its leg of the
+        # decision (the node is retired through _finish_deaths) — the
+        # trace must close rather than sit active forever
+        stage = {"replica_drain": "drain",
+                 "replica_retired": "retired",
+                 "replica_dead": "retired"}.get(kind)
+        if stage is None or stage in st["stages"].setdefault(name, set()):
+            return
+        start = st["stage_t"].get(name, st["decided_at"])
+        end = max(t, start)
+        attrs = {"replica": name}
+        if kind == "replica_dead":
+            attrs["died_mid_drain"] = True
+        self.tracer.start_span(
+            st["root"], stage, now=start, **attrs).finish(end)
+        st["stages"][name].add(stage)
+        st["stage_t"][name] = end
+        if stage == "retired":
+            st["retired"].add(name)
+            if st["retired"] >= st["victims"]:
+                self._close_scale_trace("ok", end)
+
+    def _close_scale_trace(self, status: str,
+                           now: Optional[float] = None) -> None:
+        st = self._scale_trace
+        if st is None or self.tracer is None:
+            return
+        self._scale_trace = None
+        end = max(st["decided_at"],
+                  st["decided_at"] if now is None else now)
+        self.tracer.finish_trace(st["root"], now=end, status=status)
+
 
 class ReplicaProvisioner:
     """Cluster node events -> router replica membership.
@@ -244,6 +420,9 @@ class ReplicaProvisioner:
         self.engine_factory = engine_factory
         self.node_type = node_type
         self.max_join_attempts = int(max_join_attempts)
+        # fabric narration: the cluster handing over a node is the
+        # first stitched milestone of an autoscale trace
+        self.recorder = getattr(router, "recorder", None)
         # nodes whose engine_factory failed transiently, awaiting retry
         # (the watcher's events were already destructively consumed, so
         # losing these here would be permanent capacity loss)
@@ -293,6 +472,8 @@ class ReplicaProvisioner:
                     self.router.begin_drain(node.name)
                     applied += 1
             elif not joined and not node.is_exited():
+                if self.recorder is not None:
+                    self.recorder.record("node_create", node=node.name)
                 if self._try_join(node):
                     applied += 1
         return applied

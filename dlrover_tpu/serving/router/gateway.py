@@ -74,6 +74,10 @@ class ServingRequest:
     replica: Optional[str] = None          # placed-on replica name
     engine_rid: Optional[int] = None       # rid inside that replica's engine
     requeues: int = 0                      # failover replays (at-least-once)
+    # when THIS stay in the queue began: admission time, reset by every
+    # failover requeue — queue-wait metrics measure the current
+    # attempt's wait, not the dead predecessor's service time
+    enqueued_at: float = 0.0
     # caller withdrew the request (ServingRequest.cancel); acted on by
     # the next router step — queued requests are dropped, in-flight
     # ones are aborted and a CANCEL is sent to the owning replica
@@ -81,6 +85,11 @@ class ServingRequest:
     first_token_at: Optional[float] = None
     ttft_recorded: bool = False            # metrics bookkeeping
     finished_at: Optional[float] = None
+    # per-decode-step seconds of the attempt that finished this request
+    # (worker-reported over the DONE frame's worker.decode span for
+    # remote replicas, engine-timed for in-process ones); feeds the
+    # serving_decode_step_seconds histogram with this trace's exemplar
+    decode_step_seconds: Optional[float] = None
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False, compare=False
     )
@@ -221,6 +230,7 @@ class RequestGateway:
         default_timeout: Optional[float] = None,
         max_requeues: int = ServingFabric.MAX_REQUEST_REQUEUES,
         tracer: Optional[Tracer] = None,
+        trace_sample_rate: float = 1.0,
     ):
         self.max_pending = int(max_pending)
         self.max_prompt_len = max_prompt_len
@@ -229,8 +239,12 @@ class RequestGateway:
         self.max_requeues = int(max_requeues)
         # tracing is on by default: stdlib-only dict/deque bookkeeping
         # whose memory is capped by the tracer's bounded rings, so
-        # every deployment gets per-request traces without opting in
-        self.tracer = tracer if tracer is not None else Tracer()
+        # every deployment gets per-request traces without opting in.
+        # ``trace_sample_rate`` < 1 keeps only that fraction of HEALTHY
+        # traces (deterministic per trace_id) — the knob a
+        # millions-of-users fleet turns down; incidents always survive
+        self.tracer = tracer if tracer is not None else Tracer(
+            sample_rate=trace_sample_rate)
         self._lock = threading.RLock()
         self._queues: List[Deque[ServingRequest]] = [
             deque() for _ in _PRIORITIES
@@ -288,6 +302,7 @@ class RequestGateway:
                 # not "no deadline" — only None disables expiry
                 deadline=(now + timeout) if timeout is not None else None,
                 submitted_at=now,
+                enqueued_at=now,
             )
             self._next_rid += 1
             req.trace = RequestTrace(
@@ -302,6 +317,7 @@ class RequestGateway:
     def requeue_front(
         self, requests: List[ServingRequest],
         dump: bool = True,
+        now: Optional[float] = None,
     ) -> List[ServingRequest]:
         """Failover path: a dead replica's in-flight requests re-enter at
         the FRONT of their band (they have waited longest).  Partial
@@ -320,6 +336,7 @@ class RequestGateway:
         after release and dumps from the returned list itself."""
         poisoned: List[ServingRequest] = []
         requeued: List[ServingRequest] = []
+        now = time.monotonic() if now is None else now
         with self._lock:
             for req in reversed(requests):
                 if req.state not in (ServingRequestState.QUEUED,
@@ -345,12 +362,17 @@ class RequestGateway:
                 req.state = ServingRequestState.QUEUED
                 req.replica = None
                 req.engine_rid = None
+                # the replay's queue wait starts NOW — the dead
+                # attempt's service time is the failover's cost, not
+                # queueing, and must not pollute the queue-wait metrics
+                req.enqueued_at = now
                 req.restart_stream()
                 if req.trace is not None:
                     # close the dead-replica attempt as "failover" (it
                     # stays in the tree next to the retry) and reopen a
                     # queue span for the replay
-                    req.trace.failover(f"replica {dead_replica} died")
+                    req.trace.failover(
+                        f"replica {dead_replica} died", now=now)
                 self._queues[req.priority].appendleft(req)
                 requeued.append(req)
         # flight-recorder dumps happen OUTSIDE the queue lock: logging

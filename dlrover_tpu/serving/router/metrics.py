@@ -35,6 +35,11 @@ Gauge/counter names (stable API, documented in README + PERF.md):
   supervisor stopped respawning (respawn budget exhausted)
 - ``serving_replica_probation``  — replicas in crash-loop probation
   (joined but held out of placement during their cooldown)
+- ``serving_{ttft_hist,queue_wait,e2e_latency,decode_step}_seconds``
+  — OpenMetrics latency histograms (``_bucket``/``_count``/``_sum``,
+  log-spaced buckets) with ``trace_id`` exemplars on the buckets, so
+  "p99 TTFT spiked" drills down to the exact trace via ``/traces``
+  (rendered by :meth:`RouterMetrics.render_histograms`)
 
 TTFT semantics: for streaming engines (the remote replica fabric and
 the in-process adapter) ``serving_ttft_seconds`` measures submission to
@@ -54,11 +59,20 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
-from dlrover_tpu.utils.profiler import StepTimer, WindowGauge
+from dlrover_tpu.utils.profiler import (
+    Histogram,
+    StepTimer,
+    WindowGauge,
+    log_buckets,
+)
 
 
 class RouterMetrics:
-    """Aggregates router signals into one Prometheus-ready dict."""
+    """Aggregates router signals into one Prometheus-ready dict, plus
+    the OpenMetrics latency histograms (:meth:`render_histograms`) —
+    TTFT, queue wait, end-to-end latency and decode-step time, each
+    bucket carrying a ``trace_id`` exemplar so a spike drills down to
+    the exact trace that caused it."""
 
     def __init__(self, window_seconds: float = 60.0):
         self.queue_depth = 0.0
@@ -80,6 +94,21 @@ class RouterMetrics:
         self._ttft_window = WindowGauge(window_seconds)
         self._tokens_window = WindowGauge(window_seconds)
         self._depth_window = WindowGauge(window_seconds)
+        # latency distributions (histogram names are distinct from the
+        # window gauges above — serving_ttft_seconds stays the mean);
+        # help text comes from the registry so docs can't fork
+        from dlrover_tpu.utils.metric_registry import metric_help
+
+        def _hist(name: str, **kw) -> Histogram:
+            return Histogram(name, help_text=metric_help(name) or "",
+                             **kw)
+
+        self.ttft_hist = _hist("serving_ttft_hist_seconds")
+        self.queue_wait_hist = _hist("serving_queue_wait_seconds")
+        self.e2e_hist = _hist("serving_e2e_latency_seconds")
+        self.decode_step_hist = _hist(
+            "serving_decode_step_seconds",
+            buckets=log_buckets(1e-4, 2.0))
 
     # ------------------------------------------------------- observe
     def observe_gauges(
@@ -100,9 +129,27 @@ class RouterMetrics:
         self._depth_window.observe(float(queue_depth), now)
 
     def observe_ttft(self, seconds: float,
-                     now: Optional[float] = None) -> None:
+                     now: Optional[float] = None,
+                     trace_id: Optional[str] = None) -> None:
         self.ttft.observe(seconds)
         self._ttft_window.observe(seconds, now)
+        self.ttft_hist.observe(seconds, trace_id=trace_id)
+
+    def observe_queue_wait(self, seconds: float,
+                           trace_id: Optional[str] = None) -> None:
+        """Admission-to-placement wait of one placement attempt."""
+        self.queue_wait_hist.observe(seconds, trace_id=trace_id)
+
+    def observe_e2e(self, seconds: float,
+                    trace_id: Optional[str] = None) -> None:
+        """Admission-to-completion latency of a finished request."""
+        self.e2e_hist.observe(seconds, trace_id=trace_id)
+
+    def observe_decode_step(self, seconds: float,
+                            trace_id: Optional[str] = None) -> None:
+        """One engine decode step (whole-batch attribution; remote
+        replicas report theirs via the worker.decode span)."""
+        self.decode_step_hist.observe(seconds, trace_id=trace_id)
 
     def observe_tokens(self, n: int, now: Optional[float] = None) -> None:
         self.generated_tokens += int(n)
@@ -143,3 +190,12 @@ class RouterMetrics:
                 self.worker_quarantined),
             "serving_replica_probation": self.replica_probation,
         }
+
+    def render_histograms(self) -> str:
+        """OpenMetrics histogram text with trace-exemplar drill-down —
+        wire via ``MetricsExporter.add_text_source`` (or the one-call
+        ``exporter.attach_router(router)``)."""
+        return "".join(h.render() for h in (
+            self.ttft_hist, self.queue_wait_hist,
+            self.e2e_hist, self.decode_step_hist,
+        ))

@@ -73,6 +73,11 @@ class InferenceEngineAdapter:
     def __init__(self, engine):
         self.engine = engine
         self._stream_pos: Dict[int, int] = {}  # rid -> tokens streamed
+        # wall seconds of the most recent step() — feeds the
+        # serving_decode_step_seconds histogram (whole-batch
+        # attribution, same convention as the remote worker's
+        # worker.decode span)
+        self.last_step_seconds: Optional[float] = None
 
     @property
     def block_size(self) -> int:
@@ -87,7 +92,10 @@ class InferenceEngineAdapter:
         return self.engine.add_request(prompt, max_new_tokens)
 
     def step(self) -> List:
-        return self.engine.step()
+        t0 = time.perf_counter()
+        finished = self.engine.step()
+        self.last_step_seconds = time.perf_counter() - t0
+        return finished
 
     def inflight_outputs(self) -> Dict[int, List[int]]:
         """Live output snapshot per RUNNING request (finished ones are
@@ -180,6 +188,24 @@ class InferenceEngineAdapter:
         return float(-(-total // eng.block_size))
 
 
+def _worker_decode_step_seconds(spans) -> Optional[float]:
+    """Per-step decode seconds from a DONE frame's ``worker.decode``
+    span attrs (``engine_seconds`` / ``steps``), or ``None`` when the
+    worker shipped no spans (unsampled trace, legacy worker)."""
+    for raw in spans or ():
+        try:
+            if raw.get("name") != "worker.decode":
+                continue
+            attrs = raw.get("attrs") or {}
+            steps = int(attrs["steps"])
+            engine_s = float(attrs["engine_seconds"])
+        except (AttributeError, KeyError, TypeError, ValueError):
+            continue
+        if steps > 0 and engine_s >= 0:
+            return engine_s / steps
+    return None
+
+
 class ReplicaHandle:
     """One serving replica as the router sees it.
 
@@ -214,6 +240,10 @@ class ReplicaHandle:
         self.inflight: Dict[int, ServingRequest] = {}
         self.generated_tokens = 0
         self._failed = False
+        # first-ever placement marker: the autoscale trace's last
+        # milestone (plan -> spawn -> join -> FIRST PLACEMENT) keys
+        # off the router recording the transition exactly once
+        self.ever_placed = False
         # engines that can carry trace context downstream (the remote
         # proxy forwards it in the SUBMIT frame header) declare a
         # ``trace=`` kwarg; probed once so submit stays cheap
@@ -263,11 +293,15 @@ class ReplicaHandle:
         tr = req.trace
         if tr is not None:
             tr.submit_started()
+        # a sampled-out trace propagates no context (traceparent() is
+        # None): the worker then builds/ships no spans for it, so the
+        # sample-rate knob cuts worker-side cost too — incident-marked
+        # traces (failover retries) resume propagating
+        tp = tr.traceparent() if tr is not None else None
         try:
-            if tr is not None and self._engine_takes_trace:
+            if tp is not None and self._engine_takes_trace:
                 erid = self.engine.add_request(
-                    req.prompt, req.max_new_tokens,
-                    trace=tr.traceparent())
+                    req.prompt, req.max_new_tokens, trace=tp)
             else:
                 erid = self.engine.add_request(
                     req.prompt, req.max_new_tokens)
@@ -310,19 +344,26 @@ class ReplicaHandle:
                 if req is not None:
                     req.push_tokens(toks, t)
         done: List[ServingRequest] = []
+        # whole-batch decode-step attribution for engines that time
+        # their own step (the in-process adapter / FakeEngine); remote
+        # proxies report theirs per request via the worker.decode span
+        local_step_s = getattr(self.engine, "last_step_seconds", None)
         for ereq in finished:
             req = self.inflight.pop(ereq.rid, None)
             if req is None:
                 continue  # e.g. admitted before a drain started
             self.generated_tokens += len(ereq.output)
+            spans = getattr(ereq, "trace_spans", None)
+            worker_step = _worker_decode_step_seconds(spans)
+            req.decode_step_seconds = (
+                worker_step if worker_step is not None else local_step_s)
             if req.trace is not None:
                 # remote workers ship their own spans (decode steps,
                 # engine time) back on the DONE frame, already shifted
                 # to this process's clock by the proxy — graft them
                 # under the attempt that served this request BEFORE
                 # finish() closes the trace into the ring
-                req.trace.graft_worker_spans(
-                    getattr(ereq, "trace_spans", None))
+                req.trace.graft_worker_spans(spans)
             req.finish(list(ereq.output), now)
             done.append(req)
         if drain is None:

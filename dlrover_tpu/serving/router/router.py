@@ -50,6 +50,11 @@ from dlrover_tpu.serving.router.replica import (
 from dlrover_tpu.serving.router.scheduler import ContinuousBatchScheduler
 
 
+def _tid(req: ServingRequest) -> Optional[str]:
+    """The request's trace_id for histogram exemplars (None untraced)."""
+    return None if req.trace is None else req.trace.trace_id
+
+
 @dataclasses.dataclass
 class DrainedReplica:
     """Lightweight record of a retired replica (the handle — and its
@@ -231,6 +236,16 @@ class ServingRouter:
             for handle, req in placements:
                 try:
                     handle.submit(req)
+                    self.metrics.observe_queue_wait(
+                        max(0.0, now - req.enqueued_at),
+                        trace_id=_tid(req))
+                    if not handle.ever_placed:
+                        # the autoscale trace's final milestone: the
+                        # new replica is not just joined but SERVING
+                        handle.ever_placed = True
+                        self.recorder.record(
+                            "replica_first_placement",
+                            replica=handle.name, rid=req.rid, now=now)
                 except ValueError as e:
                     # the ENGINE rejected the request as impossible
                     # (exceeds max_len / pool capacity): a poison
@@ -265,6 +280,14 @@ class ServingRouter:
                     self._record_ttft(req, now)
                     self.metrics.observe_tokens(len(req.output), now)
                     self.metrics.completed += 1
+                    if req.finished_at is not None:
+                        self.metrics.observe_e2e(
+                            req.finished_at - req.submitted_at,
+                            trace_id=_tid(req))
+                    if req.decode_step_seconds is not None:
+                        self.metrics.observe_decode_step(
+                            req.decode_step_seconds,
+                            trace_id=_tid(req))
                 completed.extend(done)
             # TTFT for still-running requests that just got their first
             # token (completion above covers the finished ones)
@@ -330,7 +353,8 @@ class ServingRouter:
         if req.first_token_at is not None and not req.ttft_recorded:
             req.ttft_recorded = True
             self.metrics.observe_ttft(
-                req.first_token_at - req.submitted_at, now)
+                req.first_token_at - req.submitted_at, now,
+                trace_id=_tid(req))
 
     def _reap(self, now: float,
               extra: Optional[List[ServingRequest]] = None,
@@ -343,7 +367,7 @@ class ServingRouter:
         are appended to ``dumps`` — the step lock is held here, and
         serializing span trees + logging belongs after its release."""
         orphans = (extra or []) + self.manager.reap_dead(now)
-        self._requeue(orphans, dumps)
+        self._requeue(orphans, dumps, now=now)
         for handle in self.manager.dead_handles:
             self.scheduler.forget_replica(handle.name)
             self._close_engine(handle, goodbye=False)
@@ -396,11 +420,12 @@ class ServingRouter:
                 handle.name, e)
 
     def _requeue(self, requests: List[ServingRequest],
-                 dumps: Optional[List[tuple]] = None) -> None:
+                 dumps: Optional[List[tuple]] = None,
+                 now: Optional[float] = None) -> None:
         if not requests:
             return
         poisoned = self.gateway.requeue_front(
-            requests, dump=dumps is None)
+            requests, dump=dumps is None, now=now)
         self.metrics.requeued += len(requests) - len(poisoned)
         self.metrics.poisoned = self.gateway.poisoned
         for req in poisoned:

@@ -121,10 +121,15 @@ class ContinuousBatchScheduler:
             if req.trace is not None:
                 # the placement DECISION span: queue wait ends here and
                 # the per-replica attempt begins, carrying why this
-                # replica won (affinity vs load)
+                # replica won (affinity vs load) and how long the
+                # request waited (the histogram's per-trace twin)
+                extra = {} if now is None else {
+                    "queued_s": round(
+                        max(0.0, now - req.enqueued_at), 6)}
                 req.trace.placed(
                     getattr(best, "name", "?"), now=now,
-                    candidates=len(cands), affinity=affinity_hit)
+                    candidates=len(cands), affinity=affinity_hit,
+                    **extra)
             placements.append((best, req))
         return placements
 

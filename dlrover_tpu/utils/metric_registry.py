@@ -99,6 +99,42 @@ METRIC_HELP: Dict[str, str] = {
         "replica death) — each is one structured log record with the "
         "request's span tree and the last fabric events"
     ),
+    "serving_trace_sampled_total": (
+        "finished traces retained by head sampling (incident "
+        "overrides — failovers, expiries, cancellations — included)"
+    ),
+    "serving_trace_dropped_total": (
+        "finished healthy traces dropped by the sample-rate knob — "
+        "nonzero proves the knob is biting at high QPS"
+    ),
+    # -- latency histograms (utils/profiler.Histogram; OpenMetrics ----
+    # -- text with trace_id exemplars, rendered as _bucket/_count/_sum)
+    "serving_ttft_hist_seconds": (
+        "time-to-first-token distribution (log-spaced buckets; "
+        "bucket exemplars carry the trace_id of the latest sample — "
+        "drill down via /traces)"
+    ),
+    "serving_queue_wait_seconds": (
+        "gateway admission-to-placement wait distribution "
+        "(per attempt; exemplars carry trace_ids)"
+    ),
+    "serving_e2e_latency_seconds": (
+        "admission-to-completion latency distribution "
+        "(exemplars carry trace_ids)"
+    ),
+    "serving_decode_step_seconds": (
+        "engine decode-step time distribution — whole-batch "
+        "attribution, worker-reported for remote replicas "
+        "(exemplars carry trace_ids)"
+    ),
+    # -- per-worker supervisor state (WorkerSupervisor.render_worker_ --
+    # -- state: one labeled sample per supervised worker)
+    "serving_worker_state": (
+        "supervisor view of each worker process, labeled "
+        'worker="name",state="running|backoff|quarantined" — the '
+        "graceful-degradation dashboard's ground truth for WHICH "
+        "worker is sitting out and why"
+    ),
     # -- exporter self-observability (utils/profiler.MetricsExporter) --
     "dlrover_metrics_source_errors_total": (
         "metric-source callables that raised during a /metrics scrape "

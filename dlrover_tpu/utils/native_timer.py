@@ -147,6 +147,25 @@ class NativeTracer:
         return self._export(self._lib.xt_export_prometheus)
 
 
+def merge_chrome_traces(*texts: str) -> str:
+    """Concatenate trace-event JSON exports into ONE perfetto-loadable
+    document.  The native tracer (this module: hot-section timers on
+    pid 0) and the span tracer
+    (:meth:`dlrover_tpu.utils.tracing.Tracer.export_chrome_trace`:
+    request/autoscale spans on router/replica pids) emit the same
+    schema on the same monotonic µs timebase, so merging is a plain
+    ``traceEvents`` union — one timeline shows a request's spans OVER
+    the native step-loop sections they ran inside."""
+    import json
+
+    events = []
+    for text in texts:
+        if not text:
+            continue
+        events.extend(json.loads(text).get("traceEvents", []))
+    return json.dumps({"traceEvents": events})
+
+
 def check_toolchain() -> Optional[str]:
     try:
         load_library()

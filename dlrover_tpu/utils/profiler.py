@@ -148,6 +148,105 @@ class WindowGauge:
         return sum(vals) / self.window if vals else 0.0
 
 
+def log_buckets(lo: float = 0.001, hi: float = 64.0,
+                factor: float = 2.0) -> tuple:
+    """Log-spaced histogram bucket bounds: ``lo, lo*factor, …`` until
+    ``hi`` is covered.  Fixed at construction — latency distributions
+    span decades, and a fixed log ladder keeps every process's buckets
+    identical (aggregatable across the fleet)."""
+    out = [float(lo)]
+    while out[-1] < hi:
+        out.append(out[-1] * factor)
+    return tuple(round(b, 12) for b in out)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with OpenMetrics exemplars.
+
+    The WindowGauge answers "what is the p99 NOW"; this answers "what
+    does the distribution look like, and WHICH request put a sample in
+    the tail" — each bucket remembers the most recent observation's
+    ``trace_id`` as an OpenMetrics exemplar
+    (``… # {trace_id="…"} value timestamp``), so a Grafana-style
+    drill-down jumps from a bucket straight to ``/traces/<id>``.
+    Lock-guarded; ``observe`` is O(#buckets) with no allocation — safe
+    from the router's hot path."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Optional[tuple] = None):
+        self.name = name
+        self.help_text = help_text
+        self.buckets = tuple(sorted(buckets or log_buckets()))
+        self._lock = threading.Lock()
+        # one slot per bucket + overflow; counts are NON-cumulative
+        # here (cumulated at render time, per the exposition format)
+        self._counts = [0] * (len(self.buckets) + 1)
+        # per-bucket exemplar: (trace_id, value, wall_ts) — newest wins
+        self._exemplars: List[Optional[tuple]] = [None] * (
+            len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                now: Optional[float] = None) -> None:
+        value = float(value)
+        idx = len(self.buckets)
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            if trace_id:
+                self._exemplars[idx] = (
+                    str(trace_id), value,
+                    time.time() if now is None else now)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @staticmethod
+    def _fmt(x: float) -> str:
+        return f"{x:.12g}"
+
+    def render(self) -> str:
+        """OpenMetrics text: ``# TYPE … histogram``, cumulative
+        ``_bucket`` series with exemplars on the buckets that hold
+        one, then ``_count`` / ``_sum``."""
+        with self._lock:
+            counts = list(self._counts)
+            exemplars = list(self._exemplars)
+            total, total_sum = self._count, self._sum
+        lines = [f"# TYPE {self.name} histogram"]
+        if self.help_text:
+            lines.append(f"# HELP {self.name} {self.help_text}")
+        cum = 0
+        bounds = [self._fmt(b) for b in self.buckets] + ["+Inf"]
+        for i, le in enumerate(bounds):
+            cum += counts[i]
+            line = f'{self.name}_bucket{{le="{le}"}} {cum}'
+            ex = exemplars[i]
+            if ex is not None:
+                tid, value, ts = ex
+                line += (
+                    f' # {{trace_id="{escape_label_value(tid)}"}} '
+                    f"{self._fmt(value)} {ts:.3f}"
+                )
+            lines.append(line)
+        lines.append(f"{self.name}_count {total}")
+        lines.append(f"{self.name}_sum {self._fmt(total_sum)}")
+        return "\n".join(lines) + "\n"
+
+
 @contextlib.contextmanager
 def trace(log_dir: str, host_tracer_level: int = 2):
     """Capture an XLA/XProf trace for the enclosed region (TensorBoard-
@@ -267,10 +366,19 @@ class MetricsExporter:
 
     def attach_tracer(self, tracer) -> None:
         """Wire a :class:`~dlrover_tpu.utils.tracing.Tracer`: enables
-        ``/traces`` + ``/traces/slowest`` and merges the tracer's
+        ``/traces`` + ``/traces/slowest`` + ``/traces/autoscale`` +
+        ``/traces/chrome`` and merges the tracer's
         ``serving_request_trace_*`` gauges into ``/metrics``."""
         self._tracer = tracer
         self.add_source(tracer.metrics)
+
+    def attach_router(self, router) -> None:
+        """One-call wiring for a ServingRouter: gauges + OpenMetrics
+        latency histograms (with trace-exemplar drill-down) on
+        ``/metrics``, span traces on ``/traces*``."""
+        self.add_source(router.metrics.metrics)
+        self.add_text_source(router.metrics.render_histograms)
+        self.attach_tracer(router.tracer)
 
     # ---------------------------------------------------------- render
     def _note_source_error(self, src) -> None:
@@ -313,6 +421,24 @@ class MetricsExporter:
             return json.dumps({
                 "traces": self._tracer.slowest(10),
             }, default=str)
+        if path.startswith("/traces/autoscale"):
+            # control-plane traces: one per scale decision, active ones
+            # included (plan -> spawn -> join spans arrive over seconds)
+            return json.dumps({
+                "traces": self._tracer.traces_named("autoscale"),
+            }, default=str)
+        if path.startswith("/traces/chrome"):
+            # perfetto-ready trace-event JSON; ?trace_id= narrows to
+            # one request (404 when it is unknown/evicted)
+            import urllib.parse
+
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(path).query)
+            trace_id = (query.get("trace_id") or [None])[0]
+            if trace_id is not None \
+                    and self._tracer.get_tree(trace_id) is None:
+                return None
+            return self._tracer.export_chrome_trace(trace_id)
         return json.dumps({
             "traces": self._tracer.finished(50),
             "flight_dumps": list(self._tracer.recorder.dumps),

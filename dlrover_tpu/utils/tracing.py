@@ -35,6 +35,26 @@ gateway lock adds no stall surface (dlint DL003 stays clean).
 Timestamps are ``time.monotonic()`` (span math must survive clock
 steps); each trace also records one wall-clock anchor at creation so
 exports can place the trace in absolute time.
+
+Fleet-scale additions (the observability plane):
+
+- **sampling** — ``Tracer(sample_rate=…)`` decides retention with
+  :func:`trace_sampled`, a *deterministic* head-sampling predicate
+  keyed on the trace_id itself, so a worker process configured with
+  the same rate reaches the SAME verdict as the router without any
+  coordination; spans are always stamped (cheap dict ops, bounded by
+  ``max_active``) — the rate only gates what survives into the ring
+  and whether the traceparent propagates to workers;
+- **incident override** — a failover (:meth:`Tracer.mark_incident`)
+  or any non-``ok`` terminal status (expiry, cancellation, poisoning)
+  forces retention, so every incident keeps its full span tree even
+  at 1% sampling;
+- **Chrome export** — :meth:`Tracer.export_chrome_trace` emits the
+  same trace-event JSON schema as the native tracer
+  (``NativeTracer.export_chrome_trace``), pid mapped to
+  router/replica and tid to the trace, so request spans and native
+  hot-section timers concatenate into one perfetto view
+  (:func:`~dlrover_tpu.utils.native_timer.merge_chrome_traces`).
 """
 
 from __future__ import annotations
@@ -60,6 +80,26 @@ def new_trace_id() -> str:
 def new_span_id() -> str:
     """64-bit random span id (16 hex)."""
     return os.urandom(8).hex()
+
+
+def trace_sampled(trace_id: str, sample_rate: float) -> bool:
+    """Deterministic head-sampling verdict for ``trace_id``.
+
+    Keyed on the id's leading 32 bits (uniform for our random ids), so
+    EVERY process that knows the rate computes the same answer — the
+    router's retention decision and a worker's span-shipping decision
+    agree without a coordination frame.  Malformed ids sample in:
+    observability must degrade toward keeping data, not dropping it.
+    """
+    if sample_rate >= 1.0:
+        return True
+    if sample_rate <= 0.0:
+        return False
+    try:
+        bucket = int(trace_id[:8], 16)
+    except (TypeError, ValueError):
+        return True
+    return bucket < sample_rate * float(0x100000000)
 
 
 def format_traceparent(trace_id: str, span_id: str) -> str:
@@ -129,13 +169,21 @@ class Span:
 class Trace:
     """All spans of one trace (internal record; export via ``tree``)."""
 
-    def __init__(self, root: Span, wall_anchor: Optional[float] = None):
+    def __init__(self, root: Span, wall_anchor: Optional[float] = None,
+                 sampled: bool = True):
         self.root = root
         self.spans: List[Span] = [root]
         # wall-clock anchor for exports; spans themselves are monotonic
         self.wall_anchor = time.time() if wall_anchor is None \
             else wall_anchor
         self.status = "active"
+        # head-sampling verdict (trace_sampled at creation); gates ring
+        # retention and traceparent propagation, never span stamping
+        self.sampled = sampled
+        # incident override: a failover/expiry/cancellation marks the
+        # trace so it is retained (and propagated) regardless of the
+        # sampling verdict — incidents must keep their full trace
+        self.incident = False
 
     @property
     def trace_id(self) -> str:
@@ -197,6 +245,7 @@ class FlightRecorder:
         self.dumps: Deque[Dict[str, object]] = deque(
             maxlen=int(dump_capacity))
         self.dumps_total = 0
+        self._seq = 0  # monotone event counter (cursor for consumers)
 
     def record(self, kind: str, now: Optional[float] = None,
                **fields) -> None:
@@ -204,11 +253,29 @@ class FlightRecorder:
                  "t": time.monotonic() if now is None else now}
         event.update(fields)
         with self._lock:
+            event["seq"] = self._seq
+            self._seq += 1
             self._events.append(event)
 
     def events(self, limit: int = 64) -> List[Dict[str, object]]:
         with self._lock:
             return list(self._events)[-int(limit):]
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence number of the newest event (-1 when empty) — the
+        starting cursor for :meth:`events_since` consumers."""
+        with self._lock:
+            return self._seq - 1
+
+    def events_since(self, seq: int) -> List[Dict[str, object]]:
+        """Events with ``seq`` strictly greater than the cursor — how
+        the autoscale-trace stitcher consumes the fabric vocabulary
+        (worker spawn, replica join, first placement) incrementally.
+        A consumer that lags past the ring's capacity simply misses the
+        overwritten events; the ring stays bounded either way."""
+        with self._lock:
+            return [e for e in self._events if e["seq"] > seq]
 
     def dump(self, reason: str, trace_tree: Optional[Dict[str, object]],
              now: Optional[float] = None,
@@ -237,24 +304,36 @@ class Tracer:
     """Span factory + bounded in-memory store of finished traces."""
 
     def __init__(self, ring_capacity: int = 512, max_active: int = 4096,
-                 recorder: Optional[FlightRecorder] = None):
+                 recorder: Optional[FlightRecorder] = None,
+                 sample_rate: float = 1.0):
         self._lock = threading.Lock()
         self._active: "OrderedDict[str, Trace]" = OrderedDict()
         self._ring: Deque[Trace] = deque(maxlen=int(ring_capacity))
         self.max_active = int(max_active)
         self.recorder = recorder or FlightRecorder()
+        # head-sampling knob: the fraction of HEALTHY traces retained
+        # into the ring (and propagated to workers).  1.0 = everything
+        # (the historical behavior); incidents always survive.
+        self.sample_rate = float(sample_rate)
         self.finished_total = 0
         self.orphan_spans_total = 0
+        self.sampled_total = 0   # finished traces retained
+        self.dropped_total = 0   # finished healthy traces sampled out
 
     # ----------------------------------------------------------- spans
     def start_trace(self, name: str, now: Optional[float] = None,
-                    **attrs) -> Span:
+                    always_sample: bool = False, **attrs) -> Span:
+        """Open a trace.  ``always_sample=True`` exempts it from head
+        sampling — control-plane traces (one per autoscale decision)
+        are rare and always worth keeping."""
         now = time.monotonic() if now is None else now
         root = Span(
             trace_id=new_trace_id(), span_id=new_span_id(),
             parent_id=None, name=name, start=now, attrs=dict(attrs),
         )
-        trace = Trace(root)
+        trace = Trace(root, sampled=(
+            always_sample
+            or trace_sampled(root.trace_id, self.sample_rate)))
         with self._lock:
             self._active[root.trace_id] = trace
             # bound active traces: a submitted-but-never-pumped request
@@ -287,8 +366,37 @@ class Tracer:
             if trace is None:
                 return
             trace.status = status
-            self._ring.append(trace)
-            self.finished_total += 1
+            # retention: sampled-in traces, plus EVERY incident — a
+            # non-ok terminal status or an explicit mark_incident (a
+            # failover that later completed ok) — survive the knob
+            if trace.sampled or trace.incident or status != "ok":
+                self._ring.append(trace)
+                self.finished_total += 1
+                self.sampled_total += 1
+            else:
+                self.dropped_total += 1
+
+    def mark_incident(self, trace_id: str, reason: str = "") -> None:
+        """Incident override: this trace must be retained (and its
+        traceparent keep propagating) regardless of the sampling
+        verdict.  Called on failover — expiries/cancellations/poison
+        already retain via their non-``ok`` terminal status."""
+        with self._lock:
+            trace = self._find_locked(trace_id)
+            if trace is not None:
+                trace.incident = True
+                if reason:
+                    trace.root.attrs.setdefault("incident", reason)
+
+    def should_propagate(self, trace_id: str) -> bool:
+        """Whether the traceparent should ride frames to a worker for
+        this trace: sampled-in or incident-marked.  Unknown traces
+        propagate (never drop context on a bookkeeping miss)."""
+        with self._lock:
+            trace = self._find_locked(trace_id)
+            if trace is None:
+                return True
+            return trace.sampled or trace.incident
 
     # ----------------------------------------------------------- graft
     def graft(self, trace_id: str, parent_span_id: str,
@@ -360,6 +468,83 @@ class Tracer:
                 self._ring, key=lambda t: -t.duration)[:int(limit)]
         return [t.tree() for t in traces]
 
+    def traces_named(self, name: str,
+                     limit: int = 20) -> List[Dict[str, object]]:
+        """Traces whose ROOT span is ``name`` — active ones included,
+        newest last.  The ``/traces/autoscale`` view: control-plane
+        traces are long-lived (plan -> spawn -> join -> first
+        placement spans arrive over seconds), so the view must show
+        them mid-flight, not only after they close."""
+        with self._lock:
+            finished = [t for t in self._ring if t.root.name == name]
+            active = [t for t in self._active.values()
+                      if t.root.name == name]
+            picked = (finished + active)[-int(limit):]
+            return [t.tree() for t in picked]
+
+    # ---------------------------------------------- chrome-trace export
+    def export_chrome_trace(self, trace_id: Optional[str] = None,
+                            path: Optional[str] = None) -> str:
+        """Chrome trace-event JSON — the SAME schema the native tracer
+        emits (``NativeTracer.export_chrome_trace``: complete events
+        with ``name``/``ph``/``ts``/``dur``/``pid``/``tid``, µs
+        timestamps on the monotonic clock), so a request's spans, the
+        router's step loop and native hot-section timers concatenate
+        into one perfetto view (merge_chrome_traces).  ``pid`` maps to
+        the process the span ran in (router vs each replica — worker
+        spans are already clock-translated to router time at graft),
+        ``tid`` to the trace, so concurrent requests land on separate
+        rows.  ``trace_id=None`` exports every held trace."""
+        with self._lock:
+            if trace_id is not None:
+                trace = self._find_locked(trace_id)
+                traces = [] if trace is None else [trace]
+            else:
+                traces = list(self._ring) + list(self._active.values())
+            events: List[Dict[str, object]] = []
+            pids: Dict[str, int] = {"router": 1}
+            for tid_n, trace in enumerate(traces):
+                parent_of = {s.span_id: s.parent_id for s in trace.spans}
+                replica_of = {
+                    s.span_id: s.attrs.get("replica")
+                    for s in trace.spans
+                }
+                fallback_end = trace.root.start + trace.duration
+                for s in trace.spans:
+                    proc = "router"
+                    if s.name.startswith("worker."):
+                        # nearest ancestor that names a replica (the
+                        # attempt span) owns the worker-side spans
+                        sid: Optional[str] = s.span_id
+                        while sid is not None:
+                            rep = replica_of.get(sid)
+                            if rep:
+                                proc = f"replica {rep}"
+                                break
+                            sid = parent_of.get(sid)
+                    pid = pids.setdefault(proc, len(pids) + 1)
+                    end = s.end if s.end is not None else fallback_end
+                    events.append({
+                        "name": s.name, "ph": "X",
+                        "ts": round(s.start * 1e6, 3),
+                        "dur": round(max(0.0, end - s.start) * 1e6, 3),
+                        "pid": pid, "tid": tid_n,
+                        "args": dict(
+                            s.attrs, trace_id=trace.trace_id,
+                            status=s.status),
+                    })
+        for proc, pid in sorted(pids.items(), key=lambda kv: kv[1]):
+            events.append({
+                "name": "process_name", "ph": "M", "ts": 0.0,
+                "dur": 0.0, "pid": pid, "tid": 0,
+                "args": {"name": proc},
+            })
+        text = json.dumps({"traceEvents": events}, default=str)
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
     def flight_dump(self, reason: str, trace_id: str,
                     now: Optional[float] = None) -> Dict[str, object]:
         return self.recorder.dump(
@@ -382,6 +567,11 @@ class Tracer:
                 self.orphan_spans_total),
             "serving_request_trace_flight_dumps_total": float(
                 self.recorder.dumps_total),
+            # the sampling knob's proof pair: dropped > 0 says the
+            # rate is biting; sampled counts what survived (incident
+            # overrides included)
+            "serving_trace_sampled_total": float(self.sampled_total),
+            "serving_trace_dropped_total": float(self.dropped_total),
         }
 
 
@@ -441,10 +631,17 @@ class RequestTrace:
             self.attempt or self.root, "first_token", now=now)
         span.finish(now)
 
-    def traceparent(self) -> str:
+    def traceparent(self) -> Optional[str]:
         """Context string the remote SUBMIT frame carries: worker-side
         spans parent under the CURRENT attempt, so a retry's worker
-        time lands under the retry, not the dead first attempt."""
+        time lands under the retry, not the dead first attempt.
+        ``None`` for a sampled-out trace — the worker then builds and
+        ships no spans for it, which is what makes the sample-rate
+        knob a real cost knob end to end (an incident-marked trace
+        resumes propagating: the failover retry's worker spans come
+        back even at 1% sampling)."""
+        if not self.tracer.should_propagate(self.root.trace_id):
+            return None
         parent = self.attempt or self.root
         return format_traceparent(self.root.trace_id, parent.span_id)
 
@@ -460,7 +657,10 @@ class RequestTrace:
                  now: Optional[float] = None) -> None:
         """The replica serving this attempt died: close the attempt as
         ``failover`` (it stays in the tree — the postmortem shows the
-        dead-replica attempt AND the retry) and reopen a queue span."""
+        dead-replica attempt AND the retry) and reopen a queue span.
+        A failover is an INCIDENT: even if the retry completes ok, the
+        trace must survive sampling — mark it before anything else."""
+        self.tracer.mark_incident(self.root.trace_id, reason)
         if self.submit is not None:
             self.submit.finish(now, status="failover")
             self.submit = None

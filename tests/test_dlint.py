@@ -164,6 +164,82 @@ def test_dl003_quiet_on_timed_calls_and_outside_lock(tmp_path):
     assert _codes(result) == []
 
 
+def test_dl003_alias_aware_locals_parameters_and_factories(tmp_path):
+    """The alias escape hatches a lexical checker misses: a lock
+    renamed into a local, a lock threaded through a helper's
+    parameter (positional AND keyword, `self` offset handled), and a
+    lock constructed straight into a local."""
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+        import time
+
+        class C:
+            def renamed(self, sock):
+                m = self._step_lock
+                with m:
+                    sock.recv(4096)          # DL003: m aliases the lock
+
+            def run(self, q):
+                _helper(self._lock)
+                _kw_helper(guard=self._lock)
+                self.meth(self._lock)
+
+            def meth(self, m, q=None):
+                with m:
+                    time.sleep(1.0)          # DL003: self offset
+
+        def _helper(m):
+            with m:
+                time.sleep(1.0)              # DL003: positional param
+
+        def _kw_helper(guard=None):
+            with guard:
+                time.sleep(1.0)              # DL003: keyword param
+
+        def fresh(q):
+            m = threading.Lock()
+            with m:
+                q.get()                      # DL003: lock factory
+    """})
+    assert _codes(result) == ["DL003"] * 5
+
+
+def test_dl003_alias_quiet_on_non_lock_bindings(tmp_path):
+    """No false positives: non-lock aliases, helpers whose call sites
+    never pass a lock, and timed calls under a true alias stay clean."""
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def not_a_lock(self, sock):
+                m = self._session
+                with m:
+                    sock.recv(1)             # m is a session, not a lock
+
+            def timed_under_alias(self, q):
+                m = self._lock
+                with m:
+                    q.get(timeout=1.0)       # timed: fine even locked
+
+            def run(self):
+                _helper(self._queue)
+
+        def _helper(m):
+            with m:
+                time.sleep(1.0)              # no call site passes a lock
+
+        def outer(cm, sock):
+            def inner():
+                import threading
+                cm = threading.Lock()        # inner's OWN local
+                with cm:
+                    pass
+            with cm:
+                sock.recv(1)                 # outer's cm is NOT a lock
+    """})
+    assert _codes(result) == []
+
+
 # --------------------------------------------------------------- DL004
 _PROTO = """
     class FrameKind:

@@ -600,6 +600,60 @@ def test_supervisor_voluntary_exit_not_metered():
     assert not sup.workers and not sup.pending and not sup.quarantined
 
 
+def test_supervisor_worker_state_metric_labels_on_metrics(tmp_path):
+    """The per-worker state family (ISSUE 6 satellite): one
+    ``serving_worker_state{worker=…,state=…} 1`` sample per supervised
+    worker — running / backoff / quarantined — rendered as Prometheus
+    text and served end-to-end through ``MetricsExporter``."""
+    import re
+    import urllib.request
+
+    from dlrover_tpu.utils.profiler import MetricsExporter
+
+    sup = _StubSupervisor(
+        respawn=True, max_respawns=2, respawn_window=300.0,
+        backoff_base=0.5, backoff_max=60.0, backoff_jitter=0.25,
+        quarantine_seconds=50.0, seed=7)
+    sup.spawn(name="steady")
+    sup.spawn(name="crashy")
+    t = 100.0
+    while not sup.quarantined and t < 300.0:
+        for n, r in list(sup.workers.items()):
+            if base_replica_name(n) == "crashy":
+                r.proc.returncode = 9
+        sup.poll(now=t)
+        t += 0.05
+    assert sup.quarantined, "crashy must have blown the respawn budget"
+    flappy = sup.spawn(name="flappy")
+    flappy.proc.returncode = 9
+    sup.poll(now=t)  # first crash: backoff pending, not quarantine
+
+    text = sup.render_worker_state()
+    assert "# TYPE serving_worker_state gauge" in text
+    assert "# HELP serving_worker_state" in text
+    samples = re.findall(
+        r'serving_worker_state\{worker="([^"]+)",state="([^"]+)"\} 1',
+        text)
+    by_base = {base_replica_name(w): s for w, s in samples}
+    assert by_base == {
+        "steady": "running",
+        "crashy": "quarantined",
+        "flappy": "backoff",
+    }, samples
+    # exporter wiring: the labeled family reaches a real /metrics scrape
+    exporter = MetricsExporter()
+    exporter.add_text_source(sup.render_worker_state)
+    exporter.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics",
+            timeout=5).read().decode()
+        assert ('serving_worker_state{worker="steady",'
+                'state="running"} 1') in body
+    finally:
+        exporter.stop()
+
+
 # -- replica probation (router) ----------------------------------------------
 
 
@@ -747,6 +801,83 @@ def test_chaos_acceptance_fast_matrix(workers):
         tree = router.tracer.get_tree(r.trace.trace_id)
         assert tree is not None
         assert tree["status"] == ServingRequestState.CANCELLED
+
+
+def test_chaos_sampled_tracing_keeps_every_incident(workers):
+    """ISSUE 6 acceptance: a chaos matrix at ``sample_rate=0.01``
+    drops (almost) every healthy trace but still yields a COMPLETE
+    span tree for every failed-over, expired and cancelled request —
+    the incident override working under real failover machinery, with
+    the ``sampled/dropped`` counter pair proving the knob bites."""
+
+    def names_in(tree):
+        out = []
+
+        def walk(spans):
+            for s in spans:
+                out.append(s["name"])
+                walk(s["children"])
+
+        walk(tree["spans"])
+        return out
+
+    tear = FaultSchedule(
+        [{"op": "tear", "kind": "TOKEN", "after": 40}], seed=31)
+    torn = workers(fault_schedule=tear, slots=4, tokens_per_step=2,
+                   step_delay=0.002)
+    ok = workers(slots=4, tokens_per_step=2, step_delay=0.002)
+    router = ServingRouter(
+        gateway=RequestGateway(
+            max_pending=256, trace_sample_rate=0.01),
+        scheduler=ContinuousBatchScheduler(block_size=4),
+    )
+    router.join_replica("torn", torn.proxy("torn", frame_timeout=1.0))
+    router.join_replica("ok", ok.proxy("ok", frame_timeout=1.0))
+    reqs = [router.submit(_prompt(i), 8) for i in range(120)]
+    expired = router.submit(_prompt(7), 8, timeout=0.0)
+    cancelled = []
+    for r in reqs:
+        if len(cancelled) >= 3:
+            break
+        if r.state == ServingRequestState.QUEUED and r.cancel():
+            cancelled.append(r)
+    _drive(router, timeout=60.0)
+    assert tear.fired("tear"), "the torn connection must have fired"
+
+    # zero lost, and the fault actually exercised failover
+    terminal = {ServingRequestState.DONE, ServingRequestState.CANCELLED}
+    assert all(r.state in terminal for r in reqs)
+    assert expired.state == ServingRequestState.TIMED_OUT
+    requeued = [r for r in reqs if r.requeues > 0
+                and r.state == ServingRequestState.DONE]
+    assert requeued, "the tear must have failed requests over"
+
+    tracer = router.tracer
+    # every FAILED-OVER request kept its full tree: both attempts, and
+    # the retry's worker-side spans (incident marking resumed
+    # traceparent propagation despite the 1% rate)
+    for r in requeued:
+        tree = tracer.get_tree(r.trace.trace_id)
+        assert tree is not None and tree["status"] == "ok"
+        names = names_in(tree)
+        assert names.count("attempt") >= 2, names
+        assert "worker.request" in names, names
+    # every cancelled/expired request kept its tree via its non-ok
+    # terminal status
+    for r, status in [(c, ServingRequestState.CANCELLED)
+                      for c in cancelled] \
+            + [(expired, ServingRequestState.TIMED_OUT)]:
+        tree = tracer.get_tree(r.trace.trace_id)
+        assert tree is not None and tree["status"] == status
+        assert "queued" in names_in(tree)
+    # the knob's proof pair: almost all healthy traces dropped, the
+    # books balance (121 finished traces total), and both counters
+    # surface as registered metrics
+    m = tracer.metrics()
+    assert m["serving_trace_dropped_total"] >= 80
+    assert m["serving_trace_sampled_total"] \
+        + m["serving_trace_dropped_total"] == len(reqs) + 1
+    assert m["serving_trace_sampled_total"] >= len(requeued) + 4
 
 
 def test_cancellation_and_fault_paths_lock_clean():

@@ -339,7 +339,7 @@ def test_poison_request_rejected_without_killing_replicas():
 
 
 def _autoscale_rig(max_replicas=3, queue_high=2.0, queue_low=0.2,
-                   brain=None):
+                   brain=None, engine_factory=None):
     from dlrover_tpu.serving.router import RouterMetrics
 
     cluster = InMemoryCluster()
@@ -352,8 +352,8 @@ def _autoscale_rig(max_replicas=3, queue_high=2.0, queue_low=0.2,
     )
     provisioner = ReplicaProvisioner(
         router, InMemoryNodeWatcher(cluster),
-        engine_factory=lambda node: FakeEngine(
-            slots=2, tokens_per_step=2),
+        engine_factory=engine_factory or (lambda node: FakeEngine(
+            slots=2, tokens_per_step=2)),
     )
     auto = ServingAutoScaler(
         router, scaler,
@@ -454,6 +454,127 @@ def test_autoscale_recovers_capacity_after_replica_crash():
     ), "the crashed replica's node must be retired from the cluster"
     assert victim_node.name not in cluster.nodes
     assert all(r.state == ServingRequestState.DONE for r in reqs)
+
+
+def _span_names(tree):
+    """All span names in a trace tree, depth-first."""
+    out = []
+
+    def walk(spans):
+        for s in spans:
+            out.append(s["name"])
+            walk(s["children"])
+
+    walk(tree["spans"])
+    return out
+
+
+def _spans_named(tree, name):
+    found = []
+
+    def walk(spans):
+        for s in spans:
+            if s["name"] == name:
+                found.append(s)
+            walk(s["children"])
+
+    walk(tree["spans"])
+    return found
+
+
+def test_autoscale_scale_up_emits_single_stitched_trace():
+    """The control-plane acceptance: ONE scale-up decision produces ONE
+    ``autoscale`` trace whose milestone spans cover plan ->
+    node_create -> worker_spawn -> hello_join -> first_placement, each
+    milestone running from the previous one (stage-to-stage latency is
+    the point of the trace)."""
+
+    rig = {}
+
+    def spawning_factory(node):
+        # mirror the WorkerSupervisor.engine_factory contract: handing
+        # a node an engine is a process spawn, narrated to the flight
+        # recorder under the node's name (the rig's bootstrap replica
+        # spawns before the router is in hand — nothing to narrate to)
+        if "router" in rig:
+            rig["router"].recorder.record(
+                "worker_spawn", worker=node.name, pid=0)
+        return FakeEngine(slots=2, tokens_per_step=2)
+
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        max_replicas=2, engine_factory=spawning_factory)
+    rig["router"] = router
+    reqs = [router.submit(_prompt(i), 8) for i in range(40)]
+    t = time.monotonic()
+    for _ in range(200):
+        t += 0.05
+        router.step(now=t)
+        provisioner.poll()
+        if not router.has_work:
+            break
+    assert not router.has_work
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+
+    traces = router.tracer.traces_named("autoscale", limit=50)
+    ups = [tr for tr in traces
+           if tr["status"] == "ok" and "node_create" in _span_names(tr)]
+    assert len(ups) == 1, [
+        (tr["status"], _span_names(tr)) for tr in traces]
+    tree = ups[0]
+    # decision-time markers carry the evidence the decision was made on
+    (window,) = _spans_named(tree, "load_window")
+    assert "queue_depth" in window["attrs"]
+    (policy,) = _spans_named(tree, "policy")
+    assert policy["attrs"]["desired"] == 2
+    assert _spans_named(tree, "scale_plan")
+    # milestone chain: exactly one span per stage, stitched in causal
+    # order (span append order follows the recorder's event sequence;
+    # offsets collapse under the test's synthetic clock, so the
+    # sequence — not the timestamps — is the order assertion here)
+    names = _span_names(tree)
+    stages = ("node_create", "worker_spawn", "hello_join",
+              "first_placement")
+    for stage in stages:
+        (span,) = _spans_named(tree, stage)
+        assert span["status"] == "ok"
+        assert span["offset_s"] >= 0.0
+    assert [n for n in names if n in stages] == list(stages), names
+    # the new replica is named on every milestone
+    replicas = {s["attrs"]["replica"]
+                for stage in ("worker_spawn", "hello_join",
+                              "first_placement")
+                for s in _spans_named(tree, stage)}
+    assert len(replicas) == 1
+
+
+def test_autoscale_scale_down_traces_drain_to_retired():
+    """The idle tail's scale-down decision traces drain -> retired for
+    its victim replica and closes ``ok`` once the node is gone."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig()
+    reqs = [router.submit(_prompt(i), 8) for i in range(40)]
+    t = time.monotonic()
+    for _ in range(250):
+        t += 0.05
+        router.step(now=t)
+        provisioner.poll()
+        if not router.has_work and router.manager.up_count() <= 1:
+            break
+    assert router.manager.up_count() == 1
+    downs = [
+        tr for tr in router.tracer.traces_named("autoscale", limit=50)
+        if tr["status"] == "ok" and "drain" in _span_names(tr)
+    ]
+    assert downs, "the scale-down must have traced"
+    tree = downs[-1]
+    drains = _spans_named(tree, "drain")
+    retireds = _spans_named(tree, "retired")
+    assert drains and retireds
+    victims = {s["attrs"]["replica"] for s in drains}
+    assert victims == {s["attrs"]["replica"] for s in retireds}
+    for d, r in zip(sorted(drains, key=lambda s: s["attrs"]["replica"]),
+                    sorted(retireds,
+                           key=lambda s: s["attrs"]["replica"])):
+        assert r["offset_s"] >= d["offset_s"]
 
 
 def test_gateway_timeout_zero_means_fail_fast():

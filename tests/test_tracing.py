@@ -23,7 +23,7 @@ from dlrover_tpu.serving.router import (
     RequestGateway,
     ServingRouter,
 )
-from dlrover_tpu.utils.profiler import MetricsExporter
+from dlrover_tpu.utils.profiler import Histogram, MetricsExporter
 from dlrover_tpu.utils.tracing import (
     FlightRecorder,
     Tracer,
@@ -31,6 +31,7 @@ from dlrover_tpu.utils.tracing import (
     new_span_id,
     new_trace_id,
     parse_traceparent,
+    trace_sampled,
 )
 
 
@@ -361,6 +362,286 @@ def test_traces_endpoint_404_without_tracer():
             urllib.request.urlopen(
                 f"http://127.0.0.1:{exporter.port}/traces", timeout=5)
         assert e.value.code == 404
+    finally:
+        exporter.stop()
+
+
+# -- head sampling -----------------------------------------------------------
+
+
+def test_trace_sampling_is_deterministic_and_rate_proportional():
+    """The verdict is a pure function of (trace_id, rate): the router's
+    retention decision and a worker's span-shipping decision agree with
+    no coordination — and over many random ids the keep fraction tracks
+    the rate."""
+    ids = [new_trace_id() for _ in range(4000)]
+    for tid in ids[:50]:
+        assert trace_sampled(tid, 0.25) == trace_sampled(tid, 0.25)
+        assert trace_sampled(tid, 1.0) is True
+        assert trace_sampled(tid, 0.0) is False
+        # monotone in the rate: sampled at 0.25 implies sampled at 0.5
+        if trace_sampled(tid, 0.25):
+            assert trace_sampled(tid, 0.5)
+    kept = sum(trace_sampled(t, 0.25) for t in ids) / len(ids)
+    assert 0.18 < kept < 0.32, kept
+    # malformed ids sample IN: observability degrades toward keeping
+    assert trace_sampled("not-hex", 0.001) is True
+
+
+def test_worker_side_verdict_matches_router_side():
+    """A router-built context asserts the sampled flag: it IS the
+    router's keep verdict (the router omits the traceparent for
+    sampled-out traces and keeps propagating for incidents), so the
+    worker honors it unconditionally — re-deriving from the trace_id
+    would veto exactly the incident traces the override preserves.
+    Undecided (flags 00) contexts gate through the SAME deterministic
+    predicate the router uses, so both sides agree coordination-free."""
+    from dlrover_tpu.serving.remote.worker import FakeEngine, WorkerServer
+
+    server = WorkerServer(FakeEngine(), trace_sample_rate=0.25)
+    try:
+        for _ in range(100):
+            tid = new_trace_id()
+            assert server._trace_wanted(
+                format_traceparent(tid, new_span_id()))
+            undecided = f"00-{tid}-{new_span_id()}-00"
+            assert server._trace_wanted(undecided) \
+                == trace_sampled(tid, 0.25)
+    finally:
+        server.crash()
+
+
+def test_sampled_out_healthy_trace_dropped_and_counted():
+    router = _local_router(trace_sample_rate=0.0)
+    req = router.submit(_prompt(1), 8)
+    assert req.trace is not None          # spans always stamped
+    assert req.trace.traceparent() is None  # but never propagated
+    router.run_until_idle()
+    assert req.state == ServingRequestState.DONE
+    m = router.tracer.metrics()
+    assert m["serving_trace_dropped_total"] == 1.0
+    assert m["serving_trace_sampled_total"] == 0.0
+    assert router.tracer.finished() == []
+    assert router.tracer.get_tree(req.trace.trace_id) is None
+
+
+def test_incident_override_keeps_failover_trace_at_zero_rate():
+    """Even at sample_rate 0, a failed-over request keeps its FULL
+    trace (both attempts) — incidents must always be debuggable."""
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+
+    router = ServingRouter(
+        gateway=RequestGateway(trace_sample_rate=0.0),
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("a", FakeEngine(slots=4, tokens_per_step=1))
+    req = router.submit(_prompt(3), 8)
+    router.step()
+    router.fail_replica("a")
+    router.join_replica("b", FakeEngine(slots=4))
+    router.step()  # reaps "a": the requeue marks the incident
+    # the failover marked the trace as an incident: the retry's submit
+    # resumes propagating context despite the zero rate
+    assert req.trace.traceparent() is not None
+    router.run_until_idle()
+    assert req.state == ServingRequestState.DONE
+    tree = router.tracer.get_tree(req.trace.trace_id)
+    assert tree is not None and tree["status"] == "ok"
+    assert len(_find(tree, "attempt")) == 2
+    assert router.tracer.metrics()["serving_trace_sampled_total"] == 1.0
+
+
+def test_expiry_and_cancel_kept_at_zero_rate():
+    """Non-ok terminal statuses retain without any explicit marking."""
+    router = ServingRouter(
+        gateway=RequestGateway(trace_sample_rate=0.0),
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    expired = router.submit(_prompt(4), 8, timeout=0.0, now=100.0)
+    router.gateway.expire(now=101.0)
+    cancelled = router.submit(_prompt(5), 8)
+    assert cancelled.cancel()
+    router.step()
+    for req, status in ((expired, ServingRequestState.TIMED_OUT),
+                        (cancelled, ServingRequestState.CANCELLED)):
+        tree = router.tracer.get_tree(req.trace.trace_id)
+        assert tree is not None and tree["status"] == status
+    assert router.tracer.dropped_total == 0
+
+
+# -- histograms + exemplars --------------------------------------------------
+
+
+def test_histogram_cumulative_buckets_and_exemplar_escaping():
+    h = Histogram("serving_ttft_hist_seconds",
+                  buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05, trace_id="aa")
+    h.observe(0.5, trace_id='evil"id\\with\nstuff')
+    h.observe(0.7)          # no exemplar: bucket keeps the last one
+    h.observe(99.0, trace_id="ff")  # overflow bucket
+    text = h.render()
+    lines = text.splitlines()
+    assert "# TYPE serving_ttft_hist_seconds histogram" in lines[0]
+    bucket_lines = [
+        ln for ln in lines if "_bucket" in ln]
+    counts = [int(ln.split("} ")[1].split(" #")[0])
+              for ln in bucket_lines]
+    assert counts == [1, 3, 3, 4]  # cumulative, +Inf last
+    assert 'le="+Inf"' in bucket_lines[-1]
+    # the escaped exemplar survives on its bucket's line
+    assert 'trace_id="evil\\"id\\\\with\\nstuff"' in bucket_lines[1]
+    assert "\n".join(lines).count("# {trace_id=") == 3
+    assert "serving_ttft_hist_seconds_count 4" in text
+    # sum parses back
+    [sum_line] = [ln for ln in lines if "_sum" in ln]
+    assert abs(float(sum_line.split()[-1]) - 100.25) < 1e-9
+
+
+def test_histograms_on_metrics_scrape_resolve_to_traces():
+    """The Grafana drill-down contract: /metrics serves the latency
+    histograms with trace_id exemplars, and every exemplar's trace_id
+    resolves through the tracer (and thus /traces)."""
+    import re
+
+    router = _local_router()
+    reqs = [router.submit(_prompt(i), 8) for i in range(3)]
+    router.run_until_idle()
+    exporter = MetricsExporter()
+    exporter.attach_router(router)
+    exporter.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics",
+            timeout=5).read().decode()
+        for family in ("serving_ttft_hist_seconds",
+                       "serving_queue_wait_seconds",
+                       "serving_e2e_latency_seconds",
+                       "serving_decode_step_seconds"):
+            assert f"# TYPE {family} histogram" in body, family
+            assert f"{family}_count 3" in body, family
+        exemplar_ids = set(re.findall(r'# \{trace_id="([0-9a-f]{32})"\}',
+                                      body))
+        assert exemplar_ids
+        assert exemplar_ids <= {r.trace.trace_id for r in reqs}
+        for tid in exemplar_ids:
+            assert router.tracer.get_tree(tid) is not None
+    finally:
+        exporter.stop()
+
+
+# -- chrome-trace export ------------------------------------------------------
+
+
+def _assert_trace_events_schema(events):
+    assert events, "export must hold events"
+    for e in events:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert key in e, e
+        assert e["ph"] in ("X", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+
+
+def test_chrome_export_schema_and_pid_mapping():
+    router = _local_router()
+    reqs = [router.submit(_prompt(i), 8) for i in range(2)]
+    router.run_until_idle()
+    doc = json.loads(router.tracer.export_chrome_trace())
+    events = doc["traceEvents"]
+    _assert_trace_events_schema(events)
+    spans = [e for e in events if e["ph"] == "X"]
+    # concurrent requests land on distinct tid rows; all spans carry
+    # their trace_id in args for cross-referencing with /traces
+    assert len({e["tid"] for e in spans}) == 2
+    assert {e["args"]["trace_id"] for e in spans} == \
+        {r.trace.trace_id for r in reqs}
+    # single-trace export narrows to that request
+    one = json.loads(router.tracer.export_chrome_trace(
+        reqs[0].trace.trace_id))["traceEvents"]
+    assert {e["args"]["trace_id"] for e in one
+            if e["ph"] == "X"} == {reqs[0].trace.trace_id}
+    # process-name metadata names the router process
+    meta = [e for e in events if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "router" for e in meta)
+
+
+def test_chrome_export_concatenates_with_native_tracer():
+    """The unified-view acceptance: a span-tracer export and a
+    NativeTracer export merge into ONE valid trace-event JSON."""
+    from dlrover_tpu.utils.native_timer import (
+        NativeTracer,
+        check_toolchain,
+        merge_chrome_traces,
+    )
+
+    if check_toolchain() is not None:
+        pytest.skip("native toolchain unavailable")
+    router = _local_router()
+    router.submit(_prompt(1), 8)
+    router.run_until_idle()
+    native = NativeTracer(ring_capacity=64)
+    with native.span("router.step"):
+        pass
+    merged = json.loads(merge_chrome_traces(
+        router.tracer.export_chrome_trace(),
+        native.export_chrome_trace(),
+    ))
+    events = merged["traceEvents"]
+    _assert_trace_events_schema(events)
+    names = {e["name"] for e in events}
+    assert "router.step" in names and "request" in names
+    # the two exports keep distinct pids (native pins pid 0, the span
+    # tracer starts at 1) so perfetto shows them as separate processes
+    native_pids = {e["pid"] for e in events
+                   if e["name"] == "router.step"}
+    span_pids = {e["pid"] for e in events if e["name"] == "request"}
+    assert native_pids.isdisjoint(span_pids)
+
+
+def test_traces_chrome_endpoint_serves_and_404s():
+    router = _local_router()
+    req = router.submit(_prompt(1), 8)
+    router.run_until_idle()
+    exporter = MetricsExporter()
+    exporter.attach_tracer(router.tracer)
+    exporter.start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/traces/chrome?trace_id={req.trace.trace_id}",
+            timeout=5).read().decode())
+        _assert_trace_events_schema(doc["traceEvents"])
+        # no trace_id: the whole ring exports
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/traces/chrome", timeout=5).read().decode())
+        _assert_trace_events_schema(doc["traceEvents"])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/traces/chrome?trace_id={'0' * 32}", timeout=5)
+        assert e.value.code == 404
+    finally:
+        exporter.stop()
+
+
+def test_traces_autoscale_endpoint_serves_named_traces():
+    tracer = Tracer(sample_rate=0.0)  # control plane ignores the knob
+    root = tracer.start_trace(
+        "autoscale", now=1.0, always_sample=True,
+        current=1, desired=2, direction="up")
+    tracer.start_span(root, "scale_plan", now=1.0).finish(1.0)
+    exporter = MetricsExporter()
+    exporter.attach_tracer(tracer)
+    exporter.start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/traces/autoscale",
+            timeout=5).read().decode())
+        # active (still-open) control-plane traces are visible
+        assert len(body["traces"]) == 1
+        assert body["traces"][0]["status"] == "active"
+        tracer.finish_trace(root, now=2.0, status="ok")
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/traces/autoscale",
+            timeout=5).read().decode())
+        assert body["traces"][0]["status"] == "ok"
+        assert "scale_plan" in _names(body["traces"][0])
     finally:
         exporter.stop()
 
