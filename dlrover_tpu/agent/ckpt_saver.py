@@ -216,6 +216,7 @@ class AsyncCheckpointSaver:
                         "reclaiming shm lock of rank %s (holder dead)",
                         local_rank,
                     )
+                    # dlint: disable=DL007 the persist mutex exists to serialize whole-checkpoint persistence (disk + shm I/O); its only holder is this slow path, so blocking under it stalls nobody else
                     lock.force_release()
                 if not lock.acquire(owner=owner, timeout=60):
                     # a writer holds the shm mid-copy; skipping is safer
@@ -226,6 +227,7 @@ class AsyncCheckpointSaver:
                     skipped = True
                     continue
                 try:
+                    # dlint: disable=DL007 the persist mutex exists to serialize whole-checkpoint persistence; persisting the shard IS the slow work it guards
                     actual = self._persist_shard(
                         step, local_rank, handler, world
                     )
@@ -262,6 +264,7 @@ class AsyncCheckpointSaver:
                     self._commit_threads.append(t)
                     t.start()
                 else:
+                    # dlint: disable=DL007 the persist mutex exists to serialize whole-checkpoint persistence; the synchronous commit path is that work, and the async path above already moves it off-thread
                     self.commit_checkpoint(
                         actual, timeout=commit_timeout, world=world
                     )

@@ -82,24 +82,48 @@ class ShardingClient:
         """Report consumed minibatches; completes the active task when its
         per-shard minibatch budget is consumed (reference: client.py:190)."""
         with self._lock:
+            done = None
             if self._current_task is None:
                 return
             self._pending_batch_count += batch_count
             if self._pending_batch_count >= self._num_minibatches_per_shard:
-                self._ack_current_task()
+                done = self._take_current_task()
+        self._report_done(done)
 
     def report_shard_done(self) -> None:
         """Explicitly complete the active shard (end of iteration)."""
         with self._lock:
-            self._ack_current_task()
+            done = self._take_current_task()
+        self._report_done(done)
 
-    def _ack_current_task(self) -> None:
-        if self._current_task is not None:
-            self._client.report_task_result(
-                self.dataset_name, self._current_task.task_id
-            )
-            self._current_task = None
-            self._pending_batch_count = 0
+    def _take_current_task(self) -> Optional[comm.Task]:
+        """Pop the active task; caller holds the lock."""
+        task = self._current_task
+        self._current_task = None
+        self._pending_batch_count = 0
+        return task
+
+    def _report_done(self, task: Optional[comm.Task]) -> None:
+        """Ack a completed task to the master AFTER the client lock is
+        released: the report is a gRPC round trip, and holding the lock
+        across it would stall every other reporting thread for the RTT
+        (dlint DL007's blocking-RPC-under-lock class).  A failed RPC
+        re-installs the task at its budget boundary (unless a fetch
+        already replaced it) so the next report_* call retries the ack
+        — the pop-then-report split must not lose the retryability the
+        old report-then-clear-under-lock ordering had."""
+        if task is None:
+            return
+        try:
+            self._client.report_task_result(self.dataset_name,
+                                            task.task_id)
+        except Exception:
+            with self._lock:
+                if self._current_task is None:
+                    self._current_task = task
+                    self._pending_batch_count = (
+                        self._num_minibatches_per_shard)
+            raise
 
     # -- dataset checkpoint (streaming resume) ----------------------------
     def get_shard_checkpoint(self) -> str:
@@ -127,6 +151,10 @@ class IndexShardingClient(ShardingClient):
         # the shard instead of silently skipping it.
         self._task_fifo: "queue.Queue[tuple]" = queue.Queue()
         self._consumed_in_head = 0
+        # fully-consumed task ids whose master ack RPC failed — retried
+        # at the head of the next report_batch_done (consumption already
+        # advanced the FIFO, so the ack is the only retryable piece)
+        self._unacked_done: List[int] = []
         self._prefetch_error: Optional[Exception] = None
         self._exhausted = threading.Event()
         self._prefetch_thread = threading.Thread(
@@ -187,7 +215,10 @@ class IndexShardingClient(ShardingClient):
     def report_batch_done(self, batch_count: int = 1) -> None:
         """Ack consumption of ``batch_count`` SAMPLES (overrides the base
         minibatch semantics): call after the train step that used them."""
+        done_ids: List[int] = []
         with self._lock:
+            done_ids.extend(self._unacked_done)
+            self._unacked_done = []
             remaining = batch_count
             while remaining > 0 and not self._task_fifo.empty():
                 head_id, head_n = self._task_fifo.queue[0]
@@ -199,9 +230,21 @@ class IndexShardingClient(ShardingClient):
                     # hold the only consuming lock), so never block here
                     self._task_fifo.get_nowait()
                     self._consumed_in_head = 0
-                    self._client.report_task_result(
-                        self.dataset_name, head_id
-                    )
+                    done_ids.append(head_id)
+        # master acks AFTER the lock: each report is a gRPC round trip,
+        # and holding the consuming lock across them would stall every
+        # fetch_batch_indices caller for the RTTs (dlint DL007)
+        for i, task_id in enumerate(done_ids):
+            try:
+                self._client.report_task_result(self.dataset_name, task_id)
+            except Exception:
+                # the FIFO already advanced past every popped task, so a
+                # mid-loop RPC failure must stash this and all later ids
+                # for the next call instead of silently dropping acks the
+                # master still waits on (it would re-serve those shards)
+                with self._lock:
+                    self._unacked_done = done_ids[i:] + self._unacked_done
+                raise
 
     def fetch_batch_indices(
         self, batch_size: Optional[int] = None, timeout: float = 600.0

@@ -186,6 +186,7 @@ class BrainService:
         with self._lock:
             bo = self._searches.get(msg["job_uuid"])
             if bo is not None:
+                # dlint: disable=DL007 bo is the in-process search session built by _session_locked, not a BrainClient (the duck-typed fan-out smears the two); no RPC runs here, and the lock MUST span observe() — it mutates the trial history suggest() fits over
                 bo.observe(msg["params"], float(msg["value"]))
         # an unregistered session's trials must still be reachable by
         # NAMED warm starts later (prior_trials joins the jobs table)

@@ -60,6 +60,54 @@ class ServingRequestState:
     #                          (requeue cap exceeded; see ServingFabric)
 
 
+# THE transition spec for ServingRequestState — the single source of
+# truth both the runtime (gateway terminal-state guards) and static
+# analysis (dlint DL009 state-transition checker) read.  It lives next
+# to the enum ON PURPOSE: adding a state without a spec entry, or a
+# spec entry naming a non-state, is itself a DL009 finding, so the two
+# can never drift apart silently.
+#
+# Terminal states answer the caller (result()/stream() unblocked); a
+# write that would LEAVE one re-opens a request whose answer already
+# shipped — the resurrect bug class requeue_front's guard exists for.
+SERVING_REQUEST_TERMINAL_STATES = (
+    ServingRequestState.DONE,
+    ServingRequestState.TIMED_OUT,
+    ServingRequestState.CANCELLED,
+    ServingRequestState.REJECTED,
+    ServingRequestState.POISONED,
+)
+
+SERVING_REQUEST_TRANSITIONS = {
+    # QUEUED -> QUEUED is the pre-placement failover requeue (a replica
+    # died while the request sat scheduled-but-unsubmitted).
+    ServingRequestState.QUEUED: (
+        ServingRequestState.QUEUED,
+        ServingRequestState.RUNNING,
+        ServingRequestState.TIMED_OUT,
+        ServingRequestState.CANCELLED,
+        ServingRequestState.REJECTED,
+        ServingRequestState.POISONED,
+    ),
+    # RUNNING -> QUEUED is the failover replay; REJECTED is absent on
+    # purpose (rejection happens at placement, before RUNNING is set).
+    ServingRequestState.RUNNING: (
+        ServingRequestState.QUEUED,
+        ServingRequestState.DONE,
+        ServingRequestState.TIMED_OUT,
+        ServingRequestState.CANCELLED,
+        ServingRequestState.POISONED,
+    ),
+    # terminal states transition nowhere — DL009 checks the empty
+    # entries against SERVING_REQUEST_TERMINAL_STATES
+    ServingRequestState.DONE: (),
+    ServingRequestState.TIMED_OUT: (),
+    ServingRequestState.CANCELLED: (),
+    ServingRequestState.REJECTED: (),
+    ServingRequestState.POISONED: (),
+}
+
+
 class ServingFabric:
     """Serving data-plane knobs (router + remote replica fabric)."""
 

@@ -51,11 +51,34 @@ DL006  metric-registry      every ``serving_*`` / ``dlrover_*``
                             listed there as non-metrics.  One registry
                             means dashboards, autoscaler and docs can
                             never fork on a misspelled name.
+DL007  lock-blocking-       whole-program DL003: a call made while a
+       transitive           lock is held must not TRANSITIVELY reach a
+                            blocking op through the call graph (the
+                            blocking frame is usually two frames away
+                            from the ``with``).  Findings print the
+                            full witness chain.  DL003 is its depth-0
+                            case — direct ops stay DL003's so one
+                            site is never double-flagged.
+DL008  lock-ordering        the global lock-acquisition-order graph
+                            (nested ``with`` pairs, plus locks reached
+                            through calls made under a lock) must be
+                            acyclic; a cycle is a potential deadlock.
+                            Findings name a witness for every edge of
+                            the cycle.
+DL009  state-transition     every ``ServingRequestState`` write /
+                            ``abort(...)`` is checked against the
+                            transition spec next to the enum in
+                            ``common/constants.py``: a write that can
+                            overwrite a TERMINAL state (no lexical
+                            state guard), or a guard-pinned transition
+                            the spec doesn't declare, is a violation —
+                            and enum/spec drift is itself reported.
 ====== ==================== =============================================
 
-Checkers are pure AST passes — nothing is imported or executed, so
-dlint runs on a bare image in milliseconds and can't be confused by
-import-time side effects.
+DL001-DL006 are per-module lexical passes.  DL007-DL009 run on the
+two-phase whole-program engine in :mod:`dlrover_tpu.dlint.core`
+(per-function summaries, cached by file hash, then call-graph fixpoint
+propagation) — still pure AST, nothing imported or executed.
 """
 
 from __future__ import annotations
@@ -66,7 +89,8 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from dlrover_tpu.dlint.core import ParsedModule, Violation
+from dlrover_tpu.dlint import core as _core
+from dlrover_tpu.dlint.core import ParsedModule, Violation, build_program
 
 
 @dataclasses.dataclass
@@ -92,15 +116,46 @@ class DlintConfig:
     # is neither a declared metric nor listed non-metric vocabulary is
     # a namespace fork waiting to happen
     metric_literal_pattern: str = r"^(serving|dlrover)_[a-z0-9_]+$"
+    # ------------------------------------------- whole-program (DL007-9)
+    # where the ServingRequestState enum + its transition spec live
+    constants_module: str = "common/constants.py"
+    state_class: str = "ServingRequestState"
+    transitions_decl: str = "SERVING_REQUEST_TRANSITIONS"
+    terminal_decl: str = "SERVING_REQUEST_TERMINAL_STATES"
+    # the class owning the guarded ``abort()`` implementation
+    request_class: str = "ServingRequest"
+    request_module: str = "serving/router/gateway.py"
+    # duck-typed fan-out: an attribute call with an unknown receiver
+    # resolves to every project class defining the method, but only
+    # when at most this many do (common names resolve nowhere rather
+    # than smearing unrelated subsystems together)
+    duck_fanout_cap: int = 6
 
 
 class Project:
     """All parsed modules of one dlint run plus the shared config."""
 
-    def __init__(self, modules: List[ParsedModule], config: DlintConfig):
+    def __init__(self, modules: List[ParsedModule], config: DlintConfig,
+                 summary_cache_path: Optional[str] = None):
         self.modules = modules
         self.config = config
         self._external: Dict[str, Optional[ParsedModule]] = {}
+        self._summary_cache_path = summary_cache_path
+        self._program = None
+
+    @property
+    def program(self) -> "_core.WholeProgram":
+        """The phase-2 whole-program view (built lazily, consulting the
+        summary cache when one was configured)."""
+        if self._program is None:
+            self._program = build_program(
+                self.modules,
+                state_class=self.config.state_class,
+                request_class=self.config.request_class,
+                duck_fanout_cap=self.config.duck_fanout_cap,
+                cache_path=self._summary_cache_path,
+            )
+        return self._program
 
     def find_module(self, suffix: str) -> Optional[ParsedModule]:
         """The SCANNED module matching ``suffix``, if any."""
@@ -306,26 +361,16 @@ class LockBlockingChecker(Checker):
         "that touches the lock (the remote-proxy stall class)"
     )
 
-    # attribute calls that block outright
-    BLOCKING_ATTRS = frozenset(
-        {
-            "recv",
-            "recvfrom",
-            "recv_into",
-            "accept",
-            "sendall",
-            "communicate",
-            "select",
-        }
-    )
+    # the shared blocking-op vocabulary lives in core so this lexical
+    # pass and DL007's transitive pass can never disagree on what
+    # "blocking" means
+    BLOCKING_ATTRS = _core.BLOCKING_ATTRS
     # attribute calls that block unless given a timeout / non-blocking
     # argument: .wait() / .join() / .get() / .acquire() with no args
-    UNTIMED_ATTRS = frozenset({"wait", "join", "get", "acquire"})
+    UNTIMED_ATTRS = _core.UNTIMED_ATTRS
     # constructor calls whose RESULT is evidently a lock — the other
     # way a local name becomes a lock alias besides `x = self._lock`
-    LOCK_FACTORIES = frozenset(
-        {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
-    )
+    LOCK_FACTORIES = _core.LOCK_FACTORIES
 
     def check_module(self, module, project):
         # alias-awareness: a lock renamed into a local
@@ -793,6 +838,586 @@ class MetricRegistryChecker(Checker):
         return declared, non_metric
 
 
+# =========================================================== DL007
+def _short(qual: str) -> str:
+    """``serving/router/router.py::ServingRouter.step`` -> the part a
+    human reads in a chain: ``ServingRouter.step``."""
+    return qual.split("::", 1)[1] if "::" in qual else qual
+
+
+class TransitiveLockBlockingChecker(Checker):
+    CODE = "DL007"
+    NAME = "lock-blocking-transitive"
+    WHY = (
+        "a call made under a held lock that transitively reaches a "
+        "blocking op freezes every lock user — and the blocking frame "
+        "is usually two calls away from the `with`"
+    )
+    EXPLAIN = (
+        "Whole-program DL003.  Phase 1 summarizes every function "
+        "(blocking ops, locks, calls with best-effort receiver types); "
+        "phase 2 runs a fixpoint over the call graph so each function "
+        "knows which blocking ops it can transitively reach.  Any call "
+        "made lexically under a `with <lock>:` whose resolved target "
+        "reaches a blocking op (socket recv/send, RPC-stub calls, "
+        "subprocess waits, untimed wait/join/get/acquire, time.sleep) "
+        "is flagged, and the finding prints the full witness chain "
+        "down to the op.  Direct (depth-0) ops in the `with` body stay "
+        "DL003's, so one site is never double-flagged; a "
+        "`# dlint: disable=DL007 <reason>` on the OP's line certifies "
+        "it bounded for every caller, one on the call line suppresses "
+        "that site only.  Fix by moving the call out of the critical "
+        "section (collect under the lock, transmit after release — "
+        "the router step's CANCEL/submit pattern) or by bounding the "
+        "terminal op with a timeout."
+    )
+
+    #: op kinds DL003's lexical pass already reports at depth 0 —
+    #: DL007 skips those there (one site, one code); the kinds DL003
+    #: does not know (rpc-stub, subprocess) are DL007's even at depth 0
+    DL003_KINDS = frozenset({"sleep", "io", "untimed"})
+
+    def check_project(self, project):
+        program = project.program
+        reach = program.blocking_reach()
+        by_path = {m.rel_path: m for m in project.modules}
+        for qual in sorted(program.functions):
+            s = program.functions[qual]
+            module = by_path.get(s["module"])
+            if module is None:
+                continue
+            # depth 0 for the op kinds DL003 does not cover
+            for op in s["blocking"]:
+                if op.get("locks_held") and not op.get(
+                        "dl007_suppressed") \
+                        and op["kind"] not in self.DL003_KINDS:
+                    yield module.violation(
+                        self.CODE,
+                        op["line"],
+                        f"{op['detail']} while holding "
+                        f"{', '.join(op['locks_held'])} — a "
+                        f"{op['kind']} call blocks every lock user",
+                    )
+            for call in s["calls"]:
+                if not call["locks_held"]:
+                    continue
+                best = None
+                for target in program.resolve_call(s, call):
+                    for key, chain in reach.get(target, {}).items():
+                        cand = (len(chain), str(key), target)
+                        if best is None or cand < best[0]:
+                            best = (cand, target, chain)
+                if best is None:
+                    continue
+                _, target, chain = best
+                yield module.violation(
+                    self.CODE,
+                    call["line"],
+                    f"call {call['repr']}(...) under lock "
+                    f"{', '.join(call['locks_held'])} transitively "
+                    f"reaches blocking {chain[-1]['op']}: "
+                    + self._chain_text(program, qual, s, call, target,
+                                       chain),
+                )
+
+    @staticmethod
+    def _chain_text(program, qual, s, call, target, chain) -> str:
+        mod = {q: f["module"] for q, f in program.functions.items()}
+        parts = [f"{_short(qual)} ({s['module']}:{call['line']})"]
+        cur = target
+        for frame in chain[:-1]:
+            parts.append(f"{_short(cur)} ({mod[cur]}:{frame['line']})")
+            cur = frame["fn"]
+        op = chain[-1]
+        parts.append(_short(cur))
+        return (
+            " -> ".join(parts)
+            + f" -> {op['op']} at {op['module']}:{op['line']}"
+        )
+
+
+# =========================================================== DL008
+class LockOrderingChecker(Checker):
+    CODE = "DL008"
+    NAME = "lock-ordering"
+    WHY = (
+        "two code paths acquiring the same locks in opposite orders "
+        "deadlock the moment they interleave"
+    )
+    EXPLAIN = (
+        "Builds the global lock-acquisition-order graph: an edge "
+        "A -> B whenever B is acquired while A is held — from nested "
+        "`with` pairs in one function (alias-aware: a lock renamed "
+        "into a local or passed as a parameter still counts) and from "
+        "calls made under A to functions that transitively acquire B. "
+        "Lock identity is `Class.attr` for `self._lock`-style locks, "
+        "so two classes' same-named locks stay distinct.  A cycle in "
+        "the graph is a potential deadlock; the finding names a "
+        "witness (module:line, call chain) for every edge of the "
+        "cycle.  Fix by making every path acquire the locks in one "
+        "global order, or by collapsing the critical sections."
+    )
+
+    def check_project(self, project):
+        program = project.program
+        by_path = {m.rel_path: m for m in project.modules}
+        adj: Dict[str, Dict[str, dict]] = {}
+
+        def add_edge(outer, inner, module, line, via):
+            if outer == inner:
+                return  # RLock re-entry, not an ordering edge
+            adj.setdefault(outer, {}).setdefault(
+                inner, {"module": module, "line": line, "via": via})
+
+        for qual in sorted(program.functions):
+            s = program.functions[qual]
+            for pair in s["lock_pairs"]:
+                add_edge(pair["outer"], pair["inner"], s["module"],
+                         pair["line"], _short(qual))
+        lock_reach = program.lock_reach()
+        for qual in sorted(program.functions):
+            s = program.functions[qual]
+            for call in s["calls"]:
+                if not call["locks_held"]:
+                    continue
+                for target in program.resolve_call(s, call):
+                    for lock_id in sorted(lock_reach.get(target, ())):
+                        for held in call["locks_held"]:
+                            add_edge(
+                                held, lock_id, s["module"],
+                                call["line"],
+                                f"{_short(qual)} -> {_short(target)}")
+        for cycle in self._cycles(adj):
+            witnesses = []
+            for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+                w = adj[a][b]
+                witnesses.append(
+                    f"{a} -> {b} at {w['module']}:{w['line']} "
+                    f"(in {w['via']})")
+            first = adj[cycle[0]][cycle[1] if len(cycle) > 1
+                                  else cycle[0]]
+            module = by_path.get(first["module"])
+            if module is None:
+                module = project.modules[0] if project.modules else None
+            if module is None:
+                continue
+            yield module.violation(
+                self.CODE,
+                first["line"],
+                "lock-order cycle (potential deadlock): "
+                + " -> ".join(cycle + [cycle[0]])
+                + "; witnesses: " + "; ".join(witnesses),
+            )
+
+    @staticmethod
+    def _cycles(adj: Dict[str, Dict[str, dict]]) -> List[List[str]]:
+        """One canonical cycle per strongly-connected component of
+        size > 1 (self-loops were never edged), deterministic order."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        onstack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+        nodes = sorted(set(adj) | {b for m in adj.values() for b in m})
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            onstack.add(v)
+            for w in sorted(adj.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in onstack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+        for v in nodes:
+            if v not in index:
+                strongconnect(v)
+        cycles = []
+        for comp in sorted(sccs):
+            comp_set = set(comp)
+            start = comp[0]
+            # BFS back to start inside the component = one witness cycle
+            prev = {start: None}
+            queue = [start]
+            found = None
+            while queue and found is None:
+                v = queue.pop(0)
+                for w in sorted(adj.get(v, ())):
+                    if w == start and v in prev:
+                        found = v
+                        break
+                    if w in comp_set and w not in prev:
+                        prev[w] = v
+                        queue.append(w)
+            if found is None:
+                continue
+            path = [found]
+            while prev[path[-1]] is not None:
+                path.append(prev[path[-1]])
+            cycles.append(list(reversed(path)))
+        return cycles
+
+
+# =========================================================== DL009
+class StateTransitionChecker(Checker):
+    CODE = "DL009"
+    NAME = "state-transition"
+    WHY = (
+        "a ServingRequestState write that overwrites a terminal state "
+        "re-opens a request whose answer already shipped — the "
+        "resurrect bug class"
+    )
+    EXPLAIN = (
+        "Checks every `x.state = ServingRequestState.X` and "
+        "`x.abort(ServingRequestState.X)` site against the transition "
+        "spec declared NEXT TO the enum in common/constants.py "
+        "(SERVING_REQUEST_TRANSITIONS / "
+        "SERVING_REQUEST_TERMINAL_STATES).  A direct state write must "
+        "be dominated by a lexical guard on `<subject>.state` whose "
+        "surviving states are all non-terminal (an enclosing "
+        "`if x.state in (QUEUED, RUNNING):` or an early exit "
+        "`if x.state in TERMINAL: return`); when the guard pins the "
+        "source set, the written transition must be declared in the "
+        "spec.  abort() call sites are exempt from the guard rule as "
+        "long as the ServingRequest.abort IMPLEMENTATION is itself "
+        "terminal-guarded (checked whole-program).  Enum/spec drift — "
+        "a state without a spec entry, a spec naming a non-state, a "
+        "terminal list disagreeing with the empty next-sets — is "
+        "itself a finding, so the spec can never rot."
+    )
+
+    def check_project(self, project):
+        cfg = project.config
+        constants = project.context_module(cfg.constants_module)
+        spec = self._load_spec(constants, cfg) if constants else None
+        scanned_constants = (
+            constants is not None
+            and project.find_module(cfg.constants_module) is constants
+        )
+        if spec is not None and scanned_constants:
+            yield from self._drift(constants, spec, cfg)
+        program = project.program
+        by_path = {m.rel_path: m for m in project.modules}
+        abort_guarded = self._abort_impl_guarded(project, program, spec)
+        for qual in sorted(program.functions):
+            s = program.functions[qual]
+            module = by_path.get(s["module"])
+            if module is None:
+                continue
+            for w in s["state_writes"]:
+                if spec is None:
+                    yield module.violation(
+                        self.CODE,
+                        w["line"],
+                        f"{cfg.state_class} write but no transition "
+                        f"spec found — declare "
+                        f"{cfg.transitions_decl} and "
+                        f"{cfg.terminal_decl} next to the enum in "
+                        f"{cfg.constants_module}",
+                    )
+                    continue
+                yield from self._check_write(module, s, w, spec, cfg,
+                                             abort_guarded)
+
+    # -------------------------------------------------------- spec load
+    @staticmethod
+    def _load_spec(constants: ParsedModule, cfg) -> Optional[dict]:
+        states: Dict[str, str] = {}
+        for node in ast.walk(constants.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == cfg.state_class:
+                for stmt in node.body:
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)
+                    ):
+                        states[stmt.targets[0].id] = stmt.value.value
+                state_line = node.lineno
+                break
+        else:
+            return None
+        if not states:
+            return None
+
+        def attr_name(e):
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == cfg.state_class
+            ):
+                return e.attr
+            return None
+
+        terminal: Optional[List[str]] = None
+        terminal_line = None
+        transitions: Optional[Dict[str, List[str]]] = None
+        transitions_line = None
+        bad: List[Tuple[int, str]] = []
+        for node in constants.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if name == cfg.terminal_decl and isinstance(
+                    node.value, (ast.Tuple, ast.List, ast.Set)):
+                terminal = []
+                terminal_line = node.lineno
+                for e in node.value.elts:
+                    a = attr_name(e)
+                    if a is None:
+                        bad.append(
+                            (e.lineno,
+                             f"{cfg.terminal_decl} entry is not a "
+                             f"{cfg.state_class} constant"))
+                    else:
+                        terminal.append(a)
+            elif name == cfg.transitions_decl and isinstance(
+                    node.value, ast.Dict):
+                transitions = {}
+                transitions_line = node.lineno
+                for k, v in zip(node.value.keys, node.value.values):
+                    a = attr_name(k)
+                    if a is None:
+                        bad.append(
+                            (k.lineno if k is not None else node.lineno,
+                             f"{cfg.transitions_decl} key is not a "
+                             f"{cfg.state_class} constant"))
+                        continue
+                    targets: List[str] = []
+                    elts = v.elts if isinstance(
+                        v, (ast.Tuple, ast.List, ast.Set)) else None
+                    if elts is None:
+                        bad.append(
+                            (v.lineno,
+                             f"{cfg.transitions_decl}[{a}] is not a "
+                             "tuple/list of states"))
+                        continue
+                    for e in elts:
+                        t = attr_name(e)
+                        if t is None:
+                            bad.append(
+                                (e.lineno,
+                                 f"{cfg.transitions_decl}[{a}] entry "
+                                 f"is not a {cfg.state_class} constant"))
+                        else:
+                            targets.append(t)
+                    targets_prev = transitions.get(a)
+                    transitions[a] = (
+                        targets if targets_prev is None
+                        else targets_prev + targets)
+        return {
+            "states": states,
+            "state_line": state_line,
+            "terminal": terminal,
+            "terminal_decl": cfg.terminal_decl,
+            "terminal_line": terminal_line,
+            "transitions": transitions,
+            "transitions_line": transitions_line,
+            "bad": bad,
+        }
+
+    def _drift(self, constants: ParsedModule, spec: dict, cfg):
+        states = set(spec["states"])
+        for line, msg in spec["bad"]:
+            yield constants.violation(self.CODE, line, msg)
+        if spec["transitions"] is None:
+            yield constants.violation(
+                self.CODE,
+                spec["state_line"],
+                f"{cfg.state_class} has no {cfg.transitions_decl} "
+                "spec — declare the legal transitions next to the "
+                "enum (DL009's single source of truth)",
+            )
+            return
+        if spec["terminal"] is None:
+            yield constants.violation(
+                self.CODE,
+                spec["state_line"],
+                f"{cfg.state_class} has no {cfg.terminal_decl} "
+                "declaration next to the enum",
+            )
+            return
+        transitions = spec["transitions"]
+        terminal = set(spec["terminal"])
+        line = spec["transitions_line"]
+        for s in sorted(states - set(transitions)):
+            yield constants.violation(
+                self.CODE,
+                line,
+                f"state {s} has no {cfg.transitions_decl} entry — "
+                "a new state without a declared lifecycle is "
+                "unreviewable",
+            )
+        for s in sorted(set(transitions) - states):
+            yield constants.violation(
+                self.CODE, line,
+                f"{cfg.transitions_decl} names {s}, which is not a "
+                f"{cfg.state_class} state")
+        for s, targets in sorted(transitions.items()):
+            for t in sorted(set(targets) - states):
+                yield constants.violation(
+                    self.CODE, line,
+                    f"{cfg.transitions_decl}[{s}] targets {t}, which "
+                    f"is not a {cfg.state_class} state")
+        for s in sorted(set(spec["terminal"]) - states):
+            yield constants.violation(
+                self.CODE, spec["terminal_line"],
+                f"{cfg.terminal_decl} names {s}, which is not a "
+                f"{cfg.state_class} state")
+        empty = {s for s, t in transitions.items()
+                 if not t and s in states}
+        for s in sorted(empty - terminal):
+            yield constants.violation(
+                self.CODE, line,
+                f"state {s} has no outgoing transitions but is not "
+                f"listed in {cfg.terminal_decl}")
+        for s in sorted((terminal & set(transitions)) - empty):
+            yield constants.violation(
+                self.CODE, line,
+                f"terminal state {s} has outgoing transitions in "
+                f"{cfg.transitions_decl} — terminal means terminal")
+
+    # ----------------------------------------------------- write checks
+    @staticmethod
+    def _survivors(guards: List[dict], spec: dict) -> Tuple[set, bool]:
+        all_states = set(spec["states"])
+        terminal = set(spec["terminal"] or ())
+        surv = set(all_states)
+        applied = False
+        for g in guards:
+            names: Set[str] = set()
+            usable = True
+            for n in g["names"]:
+                if n.startswith("@"):
+                    # symbolic reference: ONLY the exact terminal tuple
+                    # constant resolves (a suffix match would let e.g.
+                    # NON_TERMINAL_STATES stand in for the terminal set
+                    # and bless the exact inverted guard DL009 exists
+                    # to catch); any other symbol is opaque
+                    if n[1:] == spec.get("terminal_decl") and terminal:
+                        names |= terminal
+                    else:
+                        usable = False
+                        break
+                elif n in all_states:
+                    names.add(n)
+                else:
+                    usable = False
+                    break
+            if not usable:
+                continue
+            op = g["op"]
+            if g.get("neg"):
+                op = "not-in" if op == "in" else "in"
+            if g["via"] == "enclosing":
+                surv &= names if op == "in" else (all_states - names)
+            else:  # early exit: the test being TRUE leaves the block
+                surv &= (all_states - names) if op == "in" else names
+            applied = True
+        return surv, applied
+
+    def _abort_impl_guarded(self, project, program,
+                            spec) -> Optional[bool]:
+        """True/False when the ``ServingRequest.abort`` implementation
+        was found (scanned set first, request module from disk
+        otherwise); None when there is no such implementation."""
+        if spec is None:
+            return None
+        cfg = project.config
+        records = [
+            w
+            for s in program.functions.values()
+            if s["cls"] == cfg.request_class and s["name"] == "abort"
+            for w in s["state_writes"]
+            if w["kind"] == "assign" and w["subject"] == "self"
+        ]
+        if not records:
+            ctx = project.context_module(cfg.request_module)
+            if ctx is None:
+                return None
+            from dlrover_tpu.dlint.core import extract_module_summaries
+
+            ms = extract_module_summaries(
+                ctx, state_class=cfg.state_class,
+                request_class=cfg.request_class)
+            records = [
+                w
+                for s in ms["functions"].values()
+                if s["cls"] == cfg.request_class and s["name"] == "abort"
+                for w in s["state_writes"]
+                if w["kind"] == "assign" and w["subject"] == "self"
+            ]
+        if not records:
+            return None
+        terminal = set(spec["terminal"] or ())
+        for w in records:
+            surv, _ = self._survivors(w["guards"], spec)
+            if surv & terminal:
+                return False
+        return True
+
+    def _check_write(self, module, summary, w, spec, cfg,
+                     abort_guarded):
+        terminal = set(spec["terminal"] or ())
+        transitions = spec["transitions"] or {}
+        surv, applied = self._survivors(w["guards"], spec)
+        if w["kind"] == "assign":
+            if summary["name"] != "__init__" and surv & terminal:
+                yield module.violation(
+                    self.CODE,
+                    w["line"],
+                    f"state write `{w['subject']}.state = "
+                    f"{w['target'] or '<dynamic>'}` can overwrite a "
+                    f"terminal state ({', '.join(sorted(surv & terminal))}"
+                    " survives the guards) — test "
+                    f"`{w['subject']}.state` against "
+                    f"{cfg.terminal_decl} first",
+                )
+        elif w["kind"] == "abort-call" and abort_guarded is False:
+            yield module.violation(
+                self.CODE,
+                w["line"],
+                f"{w['subject']}.abort({w['target']}) but the "
+                f"{cfg.request_class}.abort implementation does not "
+                "guard against terminal states — fix abort() or guard "
+                "this call site",
+            )
+        if (
+            applied and w["target"] is not None and surv
+            and not (surv & terminal)
+        ):
+            allowed = set()
+            for s in surv:
+                allowed.update(transitions.get(s, ()))
+            if w["target"] not in allowed:
+                yield module.violation(
+                    self.CODE,
+                    w["line"],
+                    "undeclared transition "
+                    f"{{{', '.join(sorted(surv))}}} -> {w['target']} — "
+                    f"not in {cfg.transitions_decl}; declare it next "
+                    "to the enum or fix the write",
+                )
+
+
 CHECKERS: Tuple[Checker, ...] = (
     ToctouPortChecker(),
     ThreadHygieneChecker(),
@@ -800,4 +1425,7 @@ CHECKERS: Tuple[Checker, ...] = (
     FrameExhaustiveChecker(),
     SwallowedExceptionChecker(),
     MetricRegistryChecker(),
+    TransitiveLockBlockingChecker(),
+    LockOrderingChecker(),
+    StateTransitionChecker(),
 )

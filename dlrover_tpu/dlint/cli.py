@@ -42,14 +42,12 @@ class DlintResult:
         return 1 if self.new else 0
 
 
-def run_dlint(
+def _load_modules(
     paths: List[str],
-    config: Optional[DlintConfig] = None,
-    baseline_path: Optional[str] = None,
-    use_baseline: bool = True,
-) -> DlintResult:
-    """Library entry point (the test suite drives this directly)."""
-    config = config or DlintConfig()
+) -> tuple:
+    """Parse every python file under ``paths``; returns
+    ``(modules, parse_errors)`` — the one loading loop both the scan
+    and the ``--call-graph`` dump go through."""
     modules: List[ParsedModule] = []
     parse_errors: List[str] = []
     for abs_path, rel_path in iter_python_files(paths):
@@ -59,7 +57,24 @@ def run_dlint(
             modules.append(ParsedModule(abs_path, rel_path, source))
         except (OSError, SyntaxError, ValueError) as e:
             parse_errors.append(f"{rel_path}: {e}")
-    project = Project(modules, config)
+    return modules, parse_errors
+
+
+def run_dlint(
+    paths: List[str],
+    config: Optional[DlintConfig] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+    summary_cache_path: Optional[str] = None,
+) -> DlintResult:
+    """Library entry point (the test suite drives this directly).
+    ``summary_cache_path`` points at the whole-program summary cache
+    (phase 1 of DL007-DL009, keyed by file hash) — CI passes a
+    persisted path so unchanged files skip extraction."""
+    config = config or DlintConfig()
+    modules, parse_errors = _load_modules(paths)
+    project = Project(modules, config,
+                      summary_cache_path=summary_cache_path)
 
     raw: List[Violation] = []
     for module in modules:
@@ -91,8 +106,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="dlint",
         description=(
             "Project-native static analysis for dlrover_tpu: enforces "
-            "the fabric's concurrency and protocol invariants "
-            "(DL001-DL006). See tools/dlint/checkers.py for the catalog."
+            "the fabric's concurrency and protocol invariants — "
+            "per-module lexical checks (DL001-DL006) plus the "
+            "whole-program pass (DL007-DL009: transitive blocking "
+            "under locks, lock-order cycles, state-machine "
+            "exhaustiveness). See tools/dlint/checkers.py for the "
+            "catalog, `--explain DLxxx` for one checker's contract."
         ),
     )
     ap.add_argument("paths", nargs="*", default=None,
@@ -106,13 +125,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline file with every current "
                          "violation, then exit 0")
+    ap.add_argument("--fail-stale", action="store_true",
+                    help="exit nonzero on stale baseline entries too "
+                         "(CI mode: a fixed-but-still-grandfathered "
+                         "entry must be deleted, not fossilize)")
     ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("--explain", metavar="DLxxx", default=None,
+                    help="print what a checker enforces, why, and how "
+                         "to fix findings; exits 2 on unknown codes")
+    ap.add_argument("--call-graph", action="store_true",
+                    help="dump the resolved whole-program call graph "
+                         "(debug surface for DL007/DL008 findings)")
+    ap.add_argument("--summary-cache", default=None, metavar="PATH",
+                    help="whole-program summary cache file, keyed by "
+                         "file hash (phase 1 of DL007-DL009); pass a "
+                         "persisted path in CI to skip re-extraction "
+                         "of unchanged files")
     args = ap.parse_args(argv)
 
     if args.list_checkers:
         for checker in CHECKERS:
             print(f"{checker.CODE}  {checker.NAME:20s} {checker.WHY}")
         return 0
+
+    if args.explain is not None:
+        code = args.explain.strip().upper()
+        for checker in CHECKERS:
+            if checker.CODE == code:
+                print(f"{checker.CODE} ({checker.NAME})")
+                print(f"why: {checker.WHY}")
+                explain = getattr(checker, "EXPLAIN", "")
+                if explain:
+                    print()
+                    print(explain)
+                return 0
+        print(f"dlint: unknown checker code {args.explain!r} "
+              f"(known: {', '.join(c.CODE for c in CHECKERS)})",
+              file=sys.stderr)
+        return 2
 
     paths = args.paths or ["dlrover_tpu"]
     for path in paths:
@@ -128,10 +178,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif baseline is None:
         baseline = DEFAULT_BASELINE
 
+    if args.call_graph:
+        modules, parse_errors = _load_modules(paths)
+        for err in parse_errors:
+            print(f"dlint: parse error: {err}", file=sys.stderr)
+        if parse_errors:
+            return 2
+        project = Project(modules, DlintConfig(),
+                          summary_cache_path=args.summary_cache)
+        edges = project.program.edges()
+        for caller, line, callee, rep in sorted(edges):
+            print(f"{caller}:{line} -> {callee}  [{rep}]")
+        print(f"dlint: {len(project.program.functions)} functions, "
+              f"{len(edges)} resolved call edges", file=sys.stderr)
+        return 0
+
     result = run_dlint(
         paths,
         baseline_path=baseline,
         use_baseline=not (args.no_baseline or args.write_baseline),
+        summary_cache_path=args.summary_cache,
     )
     for err in result.parse_errors:
         print(f"dlint: parse error: {err}", file=sys.stderr)
@@ -160,7 +226,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"{len(result.baselined)} baselined, "
         f"{len(result.suppressed)} suppressed"
     )
-    return 1 if result.new else 0
+    if result.new:
+        return 1
+    if args.fail_stale and result.stale_baseline:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -213,6 +213,1013 @@ def write_baseline(path: str, violations: Iterable[Violation]) -> None:
         f.write("\n")
 
 
+# ==================================================== whole-program
+# Two-phase interprocedural engine backing DL007-DL009 (and feeding
+# the ``--call-graph`` debug dump):
+#
+# - **phase 1** extracts a per-function :func:`summary <extract_module_
+#   summaries>` from each module's AST — blocking ops performed, locks
+#   acquired (with nesting order), ``ServingRequestState`` writes with
+#   their lexical guards, and every call site with a best-effort type
+#   descriptor (``self.``-method dispatch, attribute types inferred
+#   from ``__init__`` assignments / annotations, local constructor
+#   bindings, return annotations).  A summary is a pure function of
+#   one file's source, which is what makes the file-hash summary
+#   cache sound;
+# - **phase 2** (:class:`WholeProgram`) resolves call descriptors
+#   against the global class/function index and runs fixpoint
+#   propagation: which blocking ops does each function transitively
+#   reach, which locks does it transitively acquire — each with one
+#   witness chain, so a finding can print the full call path.
+#
+# Resolution is deliberately best-effort and under-approximate: an
+# attribute call whose receiver type is unknown falls back to
+# duck-typed fan-out over every project class defining that method,
+# but only when few enough classes do (``duck_fanout_cap``) — common
+# names (`step`, `get`, `close`) resolve nowhere rather than smearing
+# unrelated subsystems together.
+
+SUMMARY_FORMAT_VERSION = 3  # v3: later with-items' context exprs walked under earlier items' locks
+
+#: blocking-op vocabulary shared by DL003 (lexical) and DL007
+#: (transitive) — the two passes must agree on what "blocking" means.
+BLOCKING_ATTRS = frozenset(
+    {"recv", "recvfrom", "recv_into", "accept", "sendall",
+     "communicate", "select"}
+)
+UNTIMED_ATTRS = frozenset({"wait", "join", "get", "acquire"})
+LOCK_FACTORIES = frozenset(
+    {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+)
+#: module-level ``subprocess`` entry points that block until the child
+#: exits (``Popen`` itself returns immediately and is not listed)
+SUBPROCESS_BLOCKING = frozenset(
+    {"run", "call", "check_call", "check_output"}
+)
+
+#: method names that never duck-type-resolve: they collide with stdlib
+#: container/queue/thread/socket/process vocabulary, so an untyped
+#: ``x.clear()`` is overwhelmingly a dict — not the one project class
+#: that happens to define ``clear``.  A receiver whose type the
+#: extractor CAN infer still resolves these precisely; only the
+#: unknown-receiver fan-out is fenced.
+DUCK_FANOUT_SKIP = frozenset({
+    # containers
+    "clear", "pop", "popitem", "update", "append", "extend", "remove",
+    "insert", "get", "setdefault", "keys", "values", "items", "count",
+    "index", "sort", "add", "discard", "copy",
+    # queues
+    "put", "put_nowait", "get_nowait", "qsize", "task_done", "empty",
+    "full",
+    # threading / synchronization
+    "start", "join", "wait", "notify", "notify_all", "acquire",
+    "release", "set", "is_set", "locked",
+    # processes
+    "poll", "kill", "terminate", "communicate", "send_signal", "run",
+    # sockets / files
+    "send", "sendall", "recv", "close", "shutdown", "connect", "bind",
+    "listen", "accept", "read", "readline", "write", "flush", "seek",
+})
+
+_EXIT_STMTS = (ast.Continue, ast.Return, ast.Raise, ast.Break)
+
+
+def terminal_name(node: ast.AST) -> str:
+    """``self._send_lock`` -> ``_send_lock``; ``find_free_port`` -> same."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return terminal_name(call.func)
+
+
+def expr_repr(node: ast.AST) -> str:
+    """Tiny stable renderer for subjects/receivers (``req``,
+    ``self.gateway``); empty string for anything non-trivial."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_repr(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def untimed_call(call: ast.Call) -> bool:
+    """True for ``.wait()`` / ``.join()`` / ``.get()`` / ``.acquire()``
+    invocations with no timeout evidence (positional arg, ``timeout=``,
+    or ``block(ing)=False``)."""
+    if call.args:
+        return False  # a positional arg is a timeout/iterable/flag
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return False
+        if kw.arg in ("block", "blocking") and (
+            isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+        ):
+            return False
+    return True
+
+
+def classify_blocking(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """``(kind, detail)`` when ``call`` is a blocking op, else None.
+    The source set DL007 propagates: DL003's lexical vocabulary plus
+    whole-child ``subprocess`` waits and RPC-stub invocations."""
+    name = call_name(call)
+    if name == "sleep":
+        return ("sleep", "time.sleep(...)")
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    obj = call.func.value
+    if isinstance(obj, ast.Name) and obj.id == "subprocess" \
+            and name in SUBPROCESS_BLOCKING:
+        return ("subprocess", f"subprocess.{name}(...)")
+    if "stub" in terminal_name(obj).lower():
+        # a gRPC/RPC stub call is a network round trip however it is
+        # spelled — the "blocking RPC under the step lock" class
+        return ("rpc-stub", f"{expr_repr(obj) or 'stub'}.{name}(...)")
+    if name in BLOCKING_ATTRS:
+        return ("io", f".{name}(...)")
+    if name in UNTIMED_ATTRS and untimed_call(call):
+        return ("untimed", f"untimed .{name}()")
+    return None
+
+
+def lock_like_name(name: str) -> bool:
+    name = name.lower()
+    if "unlock" in name:
+        return False
+    return any(k in name for k in ("lock", "mutex", "semaphore"))
+
+
+# --------------------------------------------------- summary extraction
+def _own_body_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``func``'s own body, not descending into nested
+    defs/lambdas/classes (their bodies run in their own scope/time)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                   ast.Lambda, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _annotation_names(node: Optional[ast.AST]) -> List[str]:
+    """Identifier tokens mentioned in an annotation (``List["Replica
+    Handle"]`` -> ``["List", "ReplicaHandle"]``); phase 2 filters them
+    against the known-class index, so over-collection is harmless."""
+    if node is None:
+        return []
+    names: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.append(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            names.extend(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", sub.value))
+    return names
+
+
+def _value_type_names(value: ast.AST, ann_params: Dict[str, List[str]],
+                      local_returns: Optional[Dict[str, List[str]]] = None
+                      ) -> List[str]:
+    """Best-effort type names for the value of ``self.x = <value>``.
+    ``local_returns`` maps nested helper defs to their annotated return
+    type names (``self.h = _hist(...)`` with ``def _hist() -> X``)."""
+    if isinstance(value, ast.Call):
+        name = call_name(value)
+        if local_returns and isinstance(value.func, ast.Name) \
+                and name in local_returns:
+            return list(local_returns[name])
+        return [name]
+    if isinstance(value, ast.BoolOp):
+        out: List[str] = []
+        for v in value.values:
+            out.extend(_value_type_names(v, ann_params, local_returns))
+        return out
+    if isinstance(value, ast.IfExp):
+        return (_value_type_names(value.body, ann_params, local_returns)
+                + _value_type_names(value.orelse, ann_params,
+                                    local_returns))
+    if isinstance(value, ast.Name):
+        return list(ann_params.get(value.id, ()))
+    return []
+
+
+def _class_infos(module: "ParsedModule") -> Dict[str, dict]:
+    """Per-class bases, methods and inferred attribute types."""
+    out: Dict[str, dict] = {}
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = {"bases": [terminal_name(b) for b in node.bases
+                          if terminal_name(b)],
+                "attrs": {}, "methods": []}
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                # dataclass-style field annotations
+                info["attrs"].setdefault(stmt.target.id, [])
+                info["attrs"][stmt.target.id].extend(
+                    _annotation_names(stmt.annotation))
+            if not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info["methods"].append(stmt.name)
+            ann_params = {
+                a.arg: _annotation_names(a.annotation)
+                for a in stmt.args.posonlyargs + stmt.args.args
+                + stmt.args.kwonlyargs
+                if a.annotation is not None
+            }
+            local_returns = {
+                sub.name: _annotation_names(sub.returns)
+                for sub in ast.walk(stmt)
+                if sub is not stmt
+                and isinstance(sub,
+                               (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub.returns is not None
+            }
+            for sub in _own_body_nodes(stmt):
+                target = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    target = sub.targets[0]
+                    value = sub.value
+                elif isinstance(sub, ast.AnnAssign):
+                    target = sub.target
+                    value = None
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    names = info["attrs"].setdefault(target.attr, [])
+                    if isinstance(sub, ast.AnnAssign):
+                        names.extend(_annotation_names(sub.annotation))
+                    elif value is not None:
+                        names.extend(_value_type_names(
+                            value, ann_params, local_returns))
+        out[node.name] = info
+    return out
+
+
+def _lock_canon(expr: ast.AST, cls: Optional[str], module: str,
+                aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical identity for a lock expression, or None when it is
+    not lock-like.  ``self._lock`` in class C -> ``C._lock`` (two
+    classes' same-named locks stay DISTINCT — the router's and the
+    gateway's ``_lock`` must not conflate into a false DL008 cycle)."""
+    if isinstance(expr, ast.Name):
+        if expr.id in aliases:
+            return aliases[expr.id]
+        if lock_like_name(expr.id):
+            return f"{module}:{expr.id}"
+        return None
+    if isinstance(expr, ast.Attribute) and lock_like_name(expr.attr):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return f"{cls or '?'}.{expr.attr}"
+        base = expr_repr(expr.value)
+        return f"{base or '?'}.{expr.attr}"
+    return None
+
+
+def _lock_alias_canons(module: "ParsedModule") -> Dict[ast.AST,
+                                                       Dict[str, str]]:
+    """Per-function ``local name -> canonical lock id`` tables: direct
+    renames (``m = self._lock``), in-place constructions
+    (``m = threading.Lock()``), and parameters that receive a lock at a
+    same-module call site."""
+    funcs = [
+        n for n in ast.walk(module.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    cls_of: Dict[ast.AST, Optional[str]] = {}
+    for f in funcs:
+        cls_of[f] = next(
+            (a.name for a in module.ancestors(f)
+             if isinstance(a, ast.ClassDef)), None)
+    table: Dict[ast.AST, Dict[str, str]] = {f: {} for f in funcs}
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in funcs:
+        by_name.setdefault(f.name, []).append(f)
+        for node in _own_body_nodes(f):
+            if not isinstance(node, ast.Assign):
+                continue
+            canon = None
+            if isinstance(node.value, ast.Call):
+                if call_name(node.value) in LOCK_FACTORIES:
+                    canon = "local"
+            else:
+                canon = _lock_canon(
+                    node.value, cls_of[f], module.rel_path, {})
+            if canon is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    table[f][tgt.id] = (
+                        f"{f.name}:{tgt.id}" if canon == "local"
+                        else canon)
+    for call in ast.walk(module.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        targets = by_name.get(call_name(call))
+        if not targets:
+            continue
+        caller_cls = None
+        for anc in module.ancestors(call):
+            if isinstance(anc, ast.ClassDef):
+                caller_cls = anc.name
+                break
+
+        def _arg_canon(a: ast.AST) -> Optional[str]:
+            if isinstance(a, ast.Call):
+                return ("local" if call_name(a) in LOCK_FACTORIES
+                        else None)
+            return _lock_canon(a, caller_cls, module.rel_path, {})
+
+        lock_pos = [(i, _arg_canon(a)) for i, a in enumerate(call.args)]
+        lock_pos = [(i, c) for i, c in lock_pos if c]
+        lock_kw = [(kw.arg, _arg_canon(kw.value)) for kw in call.keywords
+                   if kw.arg]
+        lock_kw = [(n, c) for n, c in lock_kw if c]
+        if not lock_pos and not lock_kw:
+            continue
+        method_call = isinstance(call.func, ast.Attribute)
+        for f in targets:
+            params = [a.arg for a in f.args.posonlyargs + f.args.args]
+            offset = (
+                1 if method_call and params[:1] in (["self"], ["cls"])
+                else 0
+            )
+            for i, canon in lock_pos:
+                if i + offset < len(params):
+                    p = params[i + offset]
+                    table[f].setdefault(
+                        p, f"{f.name}:{p}" if canon == "local" else canon)
+            kwonly = {a.arg for a in f.args.kwonlyargs}
+            for name, canon in lock_kw:
+                if name in params or name in kwonly:
+                    table[f].setdefault(
+                        name,
+                        f"{f.name}:{name}" if canon == "local" else canon)
+    return table
+
+
+class _FunctionExtractor:
+    """Builds one function's summary dict (see module docstring)."""
+
+    def __init__(self, module: "ParsedModule", func: ast.AST,
+                 cls: Optional[str], qualname: str,
+                 aliases: Dict[str, str], state_class: str,
+                 request_class: str):
+        self.module = module
+        self.func = func
+        self.cls = cls
+        self.qualname = qualname
+        self.aliases = aliases
+        self.state_class = state_class
+        self.request_class = request_class
+        self.locals: Dict[str, list] = {}
+        self.local_names: set = set()
+        # nested helper defs with return annotations: name -> type names
+        self.nested_returns: Dict[str, List[str]] = {}
+        self.summary = {
+            "qualname": qualname,
+            "module": module.rel_path,
+            "cls": cls,
+            "name": func.name,
+            "line": func.lineno,
+            "return_types": _annotation_names(
+                getattr(func, "returns", None)),
+            "blocking": [],
+            "locks": [],
+            "lock_pairs": [],
+            "calls": [],
+            "state_writes": [],
+        }
+
+    # ------------------------------------------------------- type refs
+    def _typeref_of(self, expr: ast.AST, depth: int = 0) -> Optional[list]:
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and self.cls:
+                return ["class", self.cls]
+            return self.locals.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._typeref_of(expr.value, depth + 1)
+            return None if base is None else ["attrof", base, expr.attr]
+        if isinstance(expr, ast.Call):
+            return self._typeref_of_call(expr, depth + 1)
+        if isinstance(expr, ast.Await):
+            return self._typeref_of(expr.value, depth + 1)
+        return None
+
+    def _typeref_of_call(self, call: ast.Call,
+                         depth: int = 0) -> Optional[list]:
+        if isinstance(call.func, ast.Name):
+            nested = self.nested_returns.get(call.func.id)
+            if nested:
+                # a helper def'd inside this function with a return
+                # annotation (`def _hist(...) -> Histogram`) types its
+                # call sites even though closures are not summarized
+                return ["names", nested]
+            if call.func.id in self.local_names:
+                return None  # a local variable holding a callable
+            return ["retf", call.func.id]
+        if isinstance(call.func, ast.Attribute):
+            base = self._typeref_of(call.func.value, depth + 1)
+            if base is None:
+                return None
+            return ["ret", base, call.func.attr]
+        return None
+
+    def _collect_locals(self) -> None:
+        args = self.func.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            self.local_names.add(a.arg)
+            names = _annotation_names(a.annotation)
+            if names:
+                self.locals[a.arg] = ["names", names]
+        for node in ast.walk(self.func):
+            if node is not self.func and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names = _annotation_names(node.returns)
+                if names:
+                    self.nested_returns[node.name] = names
+        # two passes so `b = a.meth()` can see `a = C()` regardless of
+        # textual order (the env is flow-insensitive on purpose)
+        for _ in range(2):
+            for node in _own_body_nodes(self.func):
+                if isinstance(node, ast.Assign) and len(
+                        node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name):
+                    self.local_names.add(node.targets[0].id)
+                    tr = None
+                    if isinstance(node.value, ast.Call):
+                        tr = self._typeref_of_call(node.value)
+                    if tr is not None:
+                        self.locals[node.targets[0].id] = tr
+                elif isinstance(node, ast.For) and isinstance(
+                        node.target, ast.Name):
+                    self.local_names.add(node.target.id)
+                    if isinstance(node.iter, ast.Call):
+                        tr = self._typeref_of_call(node.iter)
+                        if tr is not None:
+                            self.locals[node.target.id] = tr
+                elif isinstance(node, (ast.For, ast.Assign, ast.With,
+                                       ast.AnnAssign, ast.NamedExpr)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and isinstance(
+                                sub.ctx, ast.Store):
+                            self.local_names.add(sub.id)
+
+    # ------------------------------------------------------------ walk
+    def run(self) -> dict:
+        self._collect_locals()
+        for stmt in self.func.body:
+            self._walk(stmt, ())
+        return self.summary
+
+    def _walk(self, node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested scope: does not run here
+        if isinstance(node, ast.With):
+            # items of one ``with a, b:`` acquire left-to-right, so a
+            # later item's context expr already RUNS under every earlier
+            # item's lock (``with self._lock, conn.stream():`` calls
+            # stream() while holding _lock — walk it with the folded
+            # held set or DL003/DL007 miss the site), and each later
+            # lock is ordered after every earlier one just as if the
+            # withs were nested — fold each item into the held set
+            # BEFORE the next, or ``with a, b:`` vs ``with b: with a:``
+            # would be an unreported ABBA deadlock
+            inner_held = held
+            for item in node.items:
+                self._walk(item.context_expr, inner_held)
+                canon = _lock_canon(
+                    item.context_expr, self.cls, self.module.rel_path,
+                    self.aliases)
+                if canon is None:
+                    continue
+                self.summary["locks"].append(
+                    {"id": canon, "line": node.lineno})
+                for outer in inner_held:
+                    if outer != canon:
+                        self.summary["lock_pairs"].append(
+                            {"outer": outer, "inner": canon,
+                             "line": node.lineno})
+                if canon not in inner_held:
+                    inner_held = inner_held + (canon,)
+            for stmt in node.body:
+                self._walk(stmt, inner_held)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+    def _record_call(self, call: ast.Call, held: tuple) -> None:
+        op = classify_blocking(call)
+        if op is not None:
+            kind, detail = op
+            self.summary["blocking"].append({
+                "kind": kind,
+                "detail": detail,
+                "line": call.lineno,
+                "locks_held": list(held),
+                "dl003_suppressed": self.module.suppressed(
+                    "DL003", call.lineno),
+                "dl007_suppressed": self.module.suppressed(
+                    "DL007", call.lineno),
+            })
+        desc = None
+        if isinstance(call.func, ast.Name):
+            if call.func.id not in self.local_names:
+                desc = {"form": "name", "name": call.func.id}
+        elif isinstance(call.func, ast.Attribute):
+            obj = self._typeref_of(call.func.value)
+            if obj is not None:
+                desc = {"form": "attr", "obj": obj,
+                        "method": call.func.attr}
+            else:
+                desc = {"form": "method", "method": call.func.attr}
+            self._maybe_state_abort(call)
+        if desc is not None:
+            self.summary["calls"].append({
+                "line": call.lineno,
+                "desc": desc,
+                "locks_held": list(held),
+                "repr": expr_repr(call.func) or terminal_name(call.func),
+            })
+
+    # ----------------------------------------------------- state writes
+    def _state_const(self, expr: ast.AST) -> Optional[str]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == self.state_class
+        ):
+            return expr.attr
+        return None
+
+    def _maybe_state_abort(self, call: ast.Call) -> None:
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "abort"):
+            return
+        target = self._state_const(call.args[0]) if call.args else None
+        subject = expr_repr(func.value)
+        if target is None or not subject:
+            return
+        self.summary["state_writes"].append({
+            "kind": "abort-call",
+            "line": call.lineno,
+            "subject": subject,
+            "target": target,
+            "guards": self._guards_for(call, subject),
+        })
+
+    def record_state_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute) and tgt.attr == "state"):
+            return
+        subject = expr_repr(tgt.value)
+        if not subject:
+            return
+        target = self._state_const(node.value)
+        if target is None:
+            # a dynamic write is only checkable inside the request
+            # class itself (``self.state = state`` in abort()); other
+            # dynamic ``.state`` writes are untyped FSMs elsewhere
+            if not (self.cls == self.request_class
+                    and subject == "self"):
+                return
+        self.summary["state_writes"].append({
+            "kind": "assign",
+            "line": node.lineno,
+            "subject": subject,
+            "target": target,
+            "guards": self._guards_for(node, subject),
+        })
+
+    def _guards_for(self, site: ast.AST, subject: str) -> List[dict]:
+        """Lexical guards dominating ``site`` that test
+        ``<subject>.state``: enclosing ``if`` tests and preceding
+        early-exit ``if ...: continue/return/raise/break`` siblings."""
+        guards: List[dict] = []
+        want = subject + ".state"
+        cur = site
+        for anc in self.module.ancestors(site):
+            if isinstance(anc, ast.If):
+                in_orelse = cur in getattr(anc, "orelse", [])
+                # the else branch sees the NEGATED test: only an Or
+                # splits soundly there (De Morgan — each disjunct is
+                # individually false), an And does not (the else runs
+                # whenever ANY conjunct fails, so no single conjunct
+                # may be assumed false)
+                mode = "enclosing-neg" if in_orelse else "enclosing"
+                for op, names in self._parse_state_test(
+                        anc.test, want, mode):
+                    guards.append({"via": "enclosing", "op": op,
+                                   "names": names, "neg": in_orelse})
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(anc, field, None)
+                if isinstance(body, list) and cur in body:
+                    for stmt in body[:body.index(cur)]:
+                        if (
+                            isinstance(stmt, ast.If)
+                            and not stmt.orelse
+                            and stmt.body
+                            and isinstance(stmt.body[-1], _EXIT_STMTS)
+                        ):
+                            for op, names in self._parse_state_test(
+                                    stmt.test, want, "exit"):
+                                guards.append(
+                                    {"via": "exit", "op": op,
+                                     "names": names, "neg": False})
+            cur = anc
+            if anc is self.func:
+                break
+        return guards
+
+    def _parse_state_test(self, test: ast.AST, want: str,
+                          mode: str) -> List[Tuple[str, List[str]]]:
+        if isinstance(test, ast.BoolOp):
+            # enclosing-if And: every conjunct held -> each narrows;
+            # else-branch (enclosing-neg) Or: every disjunct false ->
+            # each narrows (negated by the caller's ``neg`` flag);
+            # exit-if Or: any disjunct exits -> each narrows.  The
+            # other polarities give no sound narrowing.
+            ok = ((mode == "enclosing" and isinstance(test.op, ast.And))
+                  or (mode == "enclosing-neg"
+                      and isinstance(test.op, ast.Or))
+                  or (mode == "exit" and isinstance(test.op, ast.Or)))
+            if not ok:
+                return []
+            out = []
+            for v in test.values:
+                out.extend(self._parse_state_test(v, want, mode))
+            return out
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and len(test.comparators) == 1):
+            return []
+        if expr_repr(test.left) != want:
+            return []
+        op = {ast.Eq: "in", ast.In: "in",
+              ast.NotEq: "not-in", ast.NotIn: "not-in"}.get(
+            type(test.ops[0]))
+        if op is None:
+            return []
+        comp = test.comparators[0]
+        names: List[str] = []
+        elts = comp.elts if isinstance(
+            comp, (ast.Tuple, ast.List, ast.Set)) else [comp]
+        for e in elts:
+            const = self._state_const(e)
+            if const is not None:
+                names.append(const)
+            elif isinstance(e, ast.Name):
+                names.append("@" + e.id)
+            else:
+                return []  # unparseable member: guard unusable
+        return [(op, names)]
+
+
+def extract_module_summaries(
+    module: "ParsedModule",
+    state_class: str = "ServingRequestState",
+    request_class: str = "ServingRequest",
+) -> dict:
+    """Phase 1 for one module: ``{"functions": {qualname: summary},
+    "classes": {name: info}}`` — a pure function of the module source
+    (plus the two config names folded into the cache salt)."""
+    classes = _class_infos(module)
+    aliases = _lock_alias_canons(module)
+    functions: Dict[str, dict] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cls = None
+        nested = False
+        for anc in module.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested = True
+                break
+            if isinstance(anc, ast.ClassDef) and cls is None:
+                cls = anc.name
+        if nested:
+            continue  # closures run at their own call time
+        qual = (f"{module.rel_path}::{cls}.{node.name}" if cls
+                else f"{module.rel_path}::{node.name}")
+        ex = _FunctionExtractor(
+            module, node, cls, qual, aliases.get(node, {}),
+            state_class, request_class)
+        summary = ex.run()
+        for sub in _own_body_nodes(node):
+            if isinstance(sub, ast.Assign):
+                ex.record_state_assign(sub)
+        summary["state_writes"].sort(key=lambda w: w["line"])
+        functions[qual] = summary
+    return {"functions": functions, "classes": classes}
+
+
+# ------------------------------------------------------- summary cache
+def summary_cache_salt(state_class: str, request_class: str) -> str:
+    return f"v{SUMMARY_FORMAT_VERSION}:{state_class}:{request_class}:"
+
+
+def load_summary_cache(path: Optional[str]) -> Dict[str, dict]:
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        entries = data.get("entries", {})
+        return entries if isinstance(entries, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def save_summary_cache(path: str, entries: Dict[str, dict]) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"version": SUMMARY_FORMAT_VERSION, "entries": entries}, f)
+        f.write("\n")
+
+
+def summary_cache_key(salt: str, rel_path: str, source: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(salt.encode("utf-8"))
+    h.update(rel_path.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(source.encode("utf-8"))
+    return h.hexdigest()
+
+
+# ----------------------------------------------------------- phase two
+class WholeProgram:
+    """Resolved call graph + fixpoint reachability over all summaries."""
+
+    MAX_CHAIN = 12  # recursion/path-length backstop for witness chains
+
+    def __init__(self, module_summaries: Dict[str, dict],
+                 duck_fanout_cap: int = 6):
+        self.duck_fanout_cap = duck_fanout_cap
+        self.functions: Dict[str, dict] = {}
+        self.classes: Dict[str, List[dict]] = {}
+        self.module_funcs: Dict[Tuple[str, str], str] = {}
+        self.global_funcs: Dict[str, List[str]] = {}
+        self.methods_by_name: Dict[str, List[str]] = {}
+        for rel, ms in module_summaries.items():
+            for cname, info in ms.get("classes", {}).items():
+                entry = dict(info)
+                entry["module"] = rel
+                entry["method_quals"] = {}
+                self.classes.setdefault(cname, []).append(entry)
+            for qual, s in ms.get("functions", {}).items():
+                self.functions[qual] = s
+                if s["cls"]:
+                    self.methods_by_name.setdefault(
+                        s["name"], []).append(qual)
+                    for entry in self.classes.get(s["cls"], ()):
+                        if entry["module"] == rel:
+                            entry["method_quals"][s["name"]] = qual
+                else:
+                    self.module_funcs[(rel, s["name"])] = qual
+                    self.global_funcs.setdefault(
+                        s["name"], []).append(qual)
+        self._typeref_memo: Dict[str, frozenset] = {}
+        self._edges: Optional[List[tuple]] = None
+
+    # ------------------------------------------------------- resolution
+    def find_method(self, cls_name: str, method: str,
+                    _seen: Optional[set] = None) -> List[str]:
+        _seen = _seen if _seen is not None else set()
+        if cls_name in _seen or len(_seen) > 16:
+            return []
+        _seen.add(cls_name)
+        out: List[str] = []
+        for entry in self.classes.get(cls_name, ()):
+            q = entry["method_quals"].get(method)
+            if q is not None:
+                out.append(q)
+                continue
+            for base in entry.get("bases", ()):
+                out.extend(self.find_method(base, method, _seen))
+        return out
+
+    def _class_attr_types(self, cls_name: str, attr: str,
+                          _seen: Optional[set] = None) -> List[str]:
+        _seen = _seen if _seen is not None else set()
+        if cls_name in _seen or len(_seen) > 16:
+            return []
+        _seen.add(cls_name)
+        out: List[str] = []
+        for entry in self.classes.get(cls_name, ()):
+            names = entry.get("attrs", {}).get(attr)
+            if names:
+                out.extend(names)
+            else:
+                for base in entry.get("bases", ()):
+                    out.extend(
+                        self._class_attr_types(base, attr, _seen))
+        return out
+
+    def resolve_typeref(self, tr: Optional[list],
+                        depth: int = 0) -> frozenset:
+        """Known-class names a type descriptor can denote."""
+        if tr is None or depth > 5:
+            return frozenset()
+        key = json.dumps(tr)
+        if depth == 0 and key in self._typeref_memo:
+            return self._typeref_memo[key]
+        form = tr[0]
+        out: set = set()
+        if form == "class":
+            if tr[1] in self.classes:
+                out.add(tr[1])
+        elif form == "names":
+            out.update(n for n in tr[1] if n in self.classes)
+        elif form == "attrof":
+            for cls in self.resolve_typeref(tr[1], depth + 1):
+                out.update(
+                    n for n in self._class_attr_types(cls, tr[2])
+                    if n in self.classes)
+        elif form == "ret":
+            for cls in self.resolve_typeref(tr[1], depth + 1):
+                for q in self.find_method(cls, tr[2]):
+                    out.update(
+                        n for n in self.functions[q]["return_types"]
+                        if n in self.classes)
+        elif form == "retf":
+            name = tr[1]
+            if name in self.classes:
+                out.add(name)
+            else:
+                quals = self.global_funcs.get(name, ())
+                if len(quals) == 1:
+                    out.update(
+                        n for n in
+                        self.functions[quals[0]]["return_types"]
+                        if n in self.classes)
+        result = frozenset(out)
+        if depth == 0:
+            self._typeref_memo[key] = result
+        return result
+
+    def _duck_targets(self, method: str) -> List[str]:
+        if method in DUCK_FANOUT_SKIP or method.startswith("__"):
+            return []
+        quals = self.methods_by_name.get(method, ())
+        owners = {self.functions[q]["cls"] for q in quals}
+        if 1 <= len(owners) <= self.duck_fanout_cap:
+            return list(quals)
+        return []
+
+    def resolve_call(self, summary: dict, call: dict) -> List[str]:
+        desc = call["desc"]
+        form = desc["form"]
+        if form == "name":
+            name = desc["name"]
+            q = self.module_funcs.get((summary["module"], name))
+            if q is not None:
+                return [q]
+            if name in self.classes:
+                return self.find_method(name, "__init__")
+            quals = self.global_funcs.get(name, ())
+            return list(quals) if len(quals) == 1 else []
+        if form == "attr":
+            classes = self.resolve_typeref(desc["obj"])
+            if classes:
+                # the receiver type is KNOWN: resolve precisely, and a
+                # miss means the method is stdlib/dynamic — falling
+                # back to fan-out there would smear `handles.clear()`
+                # onto an unrelated project class named like a dict
+                out: List[str] = []
+                for cls in sorted(classes):
+                    out.extend(self.find_method(cls, desc["method"]))
+                return out
+            # receiver type unknown: duck-typed fan-out
+            return self._duck_targets(desc["method"])
+        if form == "method":
+            return self._duck_targets(desc["method"])
+        return []
+
+    # ------------------------------------------------------- call graph
+    def edges(self) -> List[tuple]:
+        """``(caller_qual, line, callee_qual, repr)`` for every resolved
+        call — the ``--call-graph`` dump and the fixpoint skeleton."""
+        if self._edges is None:
+            out: List[tuple] = []
+            for qual, s in self.functions.items():
+                for call in s["calls"]:
+                    for target in self.resolve_call(s, call):
+                        out.append(
+                            (qual, call["line"], target, call["repr"]))
+            self._edges = out
+        return self._edges
+
+    def _propagate(self, init: Dict[str, dict]) -> Dict[str, dict]:
+        """Generic witness-chain fixpoint: ``init[qual]`` maps fact-key
+        to a chain (list of frames); facts flow from callee to caller
+        with the call frame prepended."""
+        from collections import deque
+
+        callers: Dict[str, List[tuple]] = {}
+        for caller, line, callee, rep in self.edges():
+            callers.setdefault(callee, []).append((caller, line, rep))
+        reach = {q: dict(init.get(q, {})) for q in self.functions}
+        work = deque(q for q in self.functions if reach[q])
+        while work:
+            g = work.popleft()
+            for caller, line, rep in callers.get(g, ()):
+                f = reach[caller]
+                changed = False
+                for key, chain in reach[g].items():
+                    if key in f or len(chain) >= self.MAX_CHAIN:
+                        continue
+                    f[key] = [{"fn": g, "line": line,
+                               "call": rep}] + chain
+                    changed = True
+                if changed:
+                    work.append(caller)
+        return reach
+
+    def blocking_reach(self) -> Dict[str, dict]:
+        """qual -> {op key -> witness chain ending at the blocking op}.
+        DL007-suppressed ops are excluded at the source (the written
+        reason claims boundedness for EVERY caller); DL003 suppressions
+        are not — they only justified the op's own lexical context."""
+        init: Dict[str, dict] = {}
+        for qual, s in self.functions.items():
+            for op in s["blocking"]:
+                if op.get("dl007_suppressed"):
+                    continue
+                key = (s["module"], op["line"], op["detail"])
+                init.setdefault(qual, {})[key] = [{
+                    "op": op["detail"], "kind": op["kind"],
+                    "module": s["module"], "line": op["line"],
+                }]
+        return self._propagate(init)
+
+    def lock_reach(self) -> Dict[str, dict]:
+        """qual -> {lock id -> witness chain ending at the acquire}."""
+        init: Dict[str, dict] = {}
+        for qual, s in self.functions.items():
+            for lk in s["locks"]:
+                init.setdefault(qual, {})[lk["id"]] = [{
+                    "acquire": lk["id"], "module": s["module"],
+                    "line": lk["line"],
+                }]
+        return self._propagate(init)
+
+
+def build_program(
+    modules: List["ParsedModule"],
+    state_class: str = "ServingRequestState",
+    request_class: str = "ServingRequest",
+    duck_fanout_cap: int = 6,
+    cache_path: Optional[str] = None,
+) -> WholeProgram:
+    """Run phase 1 over ``modules`` (consulting/refreshing the summary
+    cache when ``cache_path`` is given) and assemble phase 2."""
+    salt = summary_cache_salt(state_class, request_class)
+    cache = load_summary_cache(cache_path)
+    used: Dict[str, dict] = {}
+    by_module: Dict[str, dict] = {}
+    for module in modules:
+        key = summary_cache_key(salt, module.rel_path, module.source)
+        entry = cache.get(key)
+        if entry is None:
+            entry = extract_module_summaries(
+                module, state_class=state_class,
+                request_class=request_class)
+        used[key] = entry
+        by_module[module.rel_path] = entry
+    if cache_path:
+        try:
+            save_summary_cache(cache_path, used)
+        except OSError:
+            pass  # a read-only checkout must not fail the lint run
+    return WholeProgram(by_module, duck_fanout_cap=duck_fanout_cap)
+
+
 def apply_baseline(
     violations: List[Violation], baseline: List[dict]
 ) -> Tuple[List[Violation], List[Violation], List[dict]]:

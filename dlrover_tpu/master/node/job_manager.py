@@ -185,6 +185,7 @@ class JobManager:
                 "Node %s: %s -> %s (%s)",
                 node.name, flow.from_status, flow.to_status, event.event_type,
             )
+            # dlint: disable=DL007 the transition lock deliberately serializes a transition WITH its observer callbacks so observers see transitions in order; the callback's loopback query is served under _lock (never this lock) and bounded by the client timeout
             self._fire_callbacks(node, flow.to_status)
             if flow.should_relaunch:
                 self._relaunch_node(node)

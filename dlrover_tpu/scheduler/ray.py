@@ -201,6 +201,7 @@ class ActorScaler(Scaler):
                         : len(have) - want
                     ]
                     for name, _, _ in doomed:
+                        # dlint: disable=DL007 the scaler lock's only holder is scale(); it serializes whole-plan execution by design — a removed actor's name must be released before its replacement launches
                         self._client.remove_actor(name)
                         logger.info("removed ray actor %s", name)
             # removals first: a per-node resize plan carries the SAME
@@ -209,6 +210,7 @@ class ActorScaler(Scaler):
             for node in plan.remove_nodes:
                 name = actor_name(self._job_name, node.type, node.id,
                                   node.rank_index)
+                # dlint: disable=DL007 same plan-serialization contract as the group-resize removal above: scale() is the lock's only holder
                 self._client.remove_actor(name)
             for node in plan.launch_nodes:
                 # honor the plan's node id (a relaunch must keep its

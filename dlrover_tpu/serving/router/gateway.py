@@ -24,7 +24,11 @@ from typing import Deque, Iterator, List, Optional
 
 import numpy as np
 
-from dlrover_tpu.common.constants import ServingFabric, ServingRequestState
+from dlrover_tpu.common.constants import (
+    SERVING_REQUEST_TERMINAL_STATES,
+    ServingFabric,
+    ServingRequestState,
+)
 from dlrover_tpu.utils.tracing import RequestTrace, Tracer
 
 PRIORITY_HIGH = 0
@@ -134,6 +138,13 @@ class ServingRequest:
         self._events.put(("tokens", list(tokens)))
 
     def finish(self, output: List[int], now: float) -> None:
+        if self.state in SERVING_REQUEST_TERMINAL_STATES:
+            # an engine completing a request the router already
+            # answered (cancelled/expired mid-generation with the
+            # CANCEL frame lost, or failed over and finished elsewhere)
+            # must not flip a terminal state back to DONE: result()
+            # already raised and the stream already closed (DL009)
+            return
         output = list(output)
         if len(output) > self._streamed:
             # engines without incremental emission (or a final flush
@@ -156,6 +167,12 @@ class ServingRequest:
         self._done.set()
 
     def abort(self, state: str) -> None:
+        if self.state in SERVING_REQUEST_TERMINAL_STATES:
+            # terminal means terminal: a second abort racing the first
+            # (expiry vs cancel, failover vs expiry) must not rewrite
+            # the answer the caller was already given (DL009's
+            # transition spec in common/constants.py is the contract)
+            return
         self.state = state
         if self.trace is not None:
             self.trace.aborted(state)

@@ -40,6 +40,14 @@ class ReplicaDeadError(RuntimeError):
     """The replica's engine is gone; the caller must fail it over."""
 
 
+class StaleRequestError(ValueError):
+    """A submit raced the request into a terminal state (cancel/expiry
+    landed between the placement decision and delivery).  ValueError
+    subclass so callers treating any submit refusal as a rejection
+    stay correct, but distinct so the router can tell 'this request is
+    already answered' from 'the engine rejected it'."""
+
+
 def stream_deltas(
     outputs: Dict[int, List[int]],
     sent: Dict[int, int],
@@ -290,6 +298,13 @@ class ReplicaHandle:
     def submit(self, req: ServingRequest) -> None:
         if not self.schedulable:
             raise ReplicaDeadError(f"replica {self.name} not schedulable")
+        if req.state != ServingRequestState.QUEUED:
+            # a cancel/expiry can race placement now that submits run
+            # outside the router's step lock; placing a request that
+            # already reached a terminal state would resurrect it
+            # (DL009: only QUEUED -> RUNNING is a declared transition)
+            raise StaleRequestError(
+                f"request {req.rid} is {req.state}, not queued")
         tr = req.trace
         if tr is not None:
             tr.submit_started()

@@ -46,6 +46,7 @@ from dlrover_tpu.serving.router.replica import (
     ReplicaDeadError,
     ReplicaHandle,
     ReplicaManager,
+    StaleRequestError,
 )
 from dlrover_tpu.serving.router.scheduler import ContinuousBatchScheduler
 
@@ -228,46 +229,82 @@ class ServingRouter:
             # 2. failover: reap dead replicas, requeue their in-flight
             self._reap(now, dumps=dumps)
 
-            # 3. placement (micro-batch per replica per round);
-            # schedulable(now) keeps probation replicas (crash-loop
-            # cooldown) out of the candidate set
+            # 3a. placement DECISIONS (micro-batch per replica per
+            # round); schedulable(now) keeps probation replicas
+            # (crash-loop cooldown) out of the candidate set
             placements = self.scheduler.schedule(
                 self.gateway, self.manager.schedulable(now), now=now)
-            for handle, req in placements:
-                try:
-                    handle.submit(req)
-                    self.metrics.observe_queue_wait(
-                        max(0.0, now - req.enqueued_at),
-                        trace_id=_tid(req))
-                    if not handle.ever_placed:
-                        # the autoscale trace's final milestone: the
-                        # new replica is not just joined but SERVING
-                        handle.ever_placed = True
-                        self.recorder.record(
-                            "replica_first_placement",
-                            replica=handle.name, rid=req.rid, now=now)
-                except ValueError as e:
-                    # the ENGINE rejected the request as impossible
-                    # (exceeds max_len / pool capacity): a poison
-                    # request must abort, not fail healthy replicas
-                    # over one by one
-                    logger.warning(
-                        "request %s rejected by replica %s: %s",
-                        req.rid, handle.name, e,
-                    )
-                    req.abort(ServingRequestState.REJECTED)
-                    self.gateway.rejected += 1
-                    self.metrics.rejected = self.gateway.rejected
-                except Exception:
-                    # the replica died between capacity probe and submit:
-                    # fail it over; THIS request goes back too
-                    logger.warning(
-                        "placement on replica %s failed; failing it over",
-                        handle.name,
-                    )
-                    handle.fail()
+        # 3b. placement DELIVERY outside the step lock: for a remote
+        # replica, submit is a SUBMIT frame send plus a synchronous ack
+        # wait — socket I/O bounded only by submit_timeout, and holding
+        # the step lock across it would freeze every membership call
+        # and has_work reader for up to that long (dlint DL007 found
+        # exactly this chain: step -> ReplicaHandle.submit ->
+        # RemoteReplicaHandle.add_request -> FrameConnection.send).
+        # The pump is single-threaded by design (module docstring), so
+        # handle/request state is safe to touch here; concurrent
+        # join/fail/drain calls only mutate OTHER entries.
+        for handle, req in placements:
+            try:
+                handle.submit(req)
+                self.metrics.observe_queue_wait(
+                    max(0.0, now - req.enqueued_at),
+                    trace_id=_tid(req))
+                if not handle.ever_placed:
+                    # the autoscale trace's final milestone: the
+                    # new replica is not just joined but SERVING
+                    handle.ever_placed = True
+                    self.recorder.record(
+                        "replica_first_placement",
+                        replica=handle.name, rid=req.rid, now=now)
+            except StaleRequestError:
+                # the request reached a terminal state (cancel/expiry)
+                # between the placement decision and this delivery: it
+                # was already answered and accounted by that path —
+                # neither a rejection nor a replica fault, just skip
+                logger.debug(
+                    "request %s went %s before delivery to %s; dropped",
+                    req.rid, req.state, handle.name,
+                )
+            except ReplicaDeadError:
+                # submit's PRE-SEND schedulable check refused: the
+                # replica stopped accepting work between the decision
+                # and this delivery (a begin_drain — or a reap — slid
+                # into the gap the out-of-lock delivery opened).  The
+                # SUBMIT frame was never sent, so the request simply
+                # goes back to the queue; calling handle.fail() here
+                # would escalate a graceful drain into a crash-style
+                # failover (in-flight requeued, no GOODBYE sent).  A
+                # mid-send death raises ConnectionError from the proxy
+                # and still takes the fail-over branch below.
+                logger.info(
+                    "replica %s became unschedulable before delivery "
+                    "of request %s; requeueing", handle.name, req.rid)
+                with self._lock:
+                    self._requeue([req], dumps, now=now)
+            except ValueError as e:
+                # the ENGINE rejected the request as impossible
+                # (exceeds max_len / pool capacity): a poison
+                # request must abort, not fail healthy replicas
+                # over one by one
+                logger.warning(
+                    "request %s rejected by replica %s: %s",
+                    req.rid, handle.name, e,
+                )
+                req.abort(ServingRequestState.REJECTED)
+                self.gateway.rejected += 1
+                self.metrics.rejected = self.gateway.rejected
+            except Exception:
+                # the replica died between capacity probe and submit:
+                # fail it over; THIS request goes back too
+                logger.warning(
+                    "placement on replica %s failed; failing it over",
+                    handle.name,
+                )
+                handle.fail()
+                with self._lock:
                     self._reap(now, extra=[req], dumps=dumps)
-
+        with self._lock:
             # 4. pump engines
             completed: List[ServingRequest] = []
             for handle in self.manager.pumpable():
@@ -320,8 +357,17 @@ class ServingRouter:
                 replica_probation=self.manager.probation_count(now),
                 now=now,
             )
-            if self.autoscaler is not None:
-                self.autoscaler.on_step(now)
+        # autoscale OUTSIDE the step lock: a Brain-backed policy's
+        # serving_plan is a synchronous control-plane RPC (30s default
+        # timeout), and executing a ScalePlan spawns nodes/processes —
+        # neither belongs inside the critical section every membership
+        # call contends on (dlint DL007: step -> on_step -> ... ->
+        # BrainClient.serving_plan -> stub RPC).  on_step is only ever
+        # called from here, so its own state needs no lock; the router
+        # surfaces it reads (metrics, manager counts, gateway depth)
+        # are each internally consistent.
+        if self.autoscaler is not None:
+            self.autoscaler.on_step(now)
         # deliver the round's CANCELs now that the lock is gone: remote
         # deliveries are frame sends (bounded by the connection's
         # send_timeout, but still I/O); local ones are slot/KV-block

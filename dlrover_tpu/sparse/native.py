@@ -50,6 +50,7 @@ def load_library() -> ctypes.CDLL:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
                 try:
+                    # dlint: disable=DL007 the lib lock serializes the one-time native build; every holder is this compile-and-load path and must wait for the .so anyway
                     _build_library(src, so)
                 except subprocess.CalledProcessError as e:
                     raise RuntimeError(

@@ -69,6 +69,88 @@ def test_dataloader_with_sampler_batches():
 
 
 # -------------------------------------------------------- sharding client
+def test_sharding_client_failed_ack_stays_retryable():
+    """The master ack runs OUTSIDE the client lock now (dlint DL007:
+    it's a gRPC round trip) — but the pop-then-report split must not
+    lose the old report-then-clear retry semantics: a transient RPC
+    failure re-installs the task at its budget boundary so the next
+    report_* call retries the ack instead of silently dropping it."""
+    from dlrover_tpu.common import comm
+
+    class FlakyClient:
+        def __init__(self):
+            self.acked = []
+            self.fail_next = 0
+
+        def report_task_result(self, dataset_name, task_id):
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise ConnectionError("master restarting")
+            self.acked.append(task_id)
+
+    client = FlakyClient()
+    sc = ShardingClient(client, "ds0", batch_size=2,
+                        num_minibatches_per_shard=2)
+    sc._current_task = comm.Task(task_id=7, shard=None)
+    client.fail_next = 1
+    with pytest.raises(ConnectionError):
+        sc.report_batch_done(2)
+    # the failed ack left the task current: the very next report
+    # crosses the restored budget boundary and retries
+    assert client.acked == []
+    assert sc._current_task is not None
+    sc.report_batch_done(1)
+    assert client.acked == [7]
+    assert sc._current_task is None
+    # an explicit shard-done retry works the same way
+    sc._current_task = comm.Task(task_id=8, shard=None)
+    client.fail_next = 1
+    with pytest.raises(ConnectionError):
+        sc.report_shard_done()
+    sc.report_shard_done()
+    assert client.acked == [7, 8]
+
+
+def test_index_sharding_client_midloop_ack_failure_is_retried():
+    """IndexShardingClient acks popped FIFO heads OUTSIDE the lock
+    (dlint DL007) — but the FIFO already advanced past them, so a
+    mid-loop RPC failure must stash the failed and not-yet-reported ids
+    and retry them at the head of the next call, not silently drop acks
+    the master still waits on (it would re-serve those shards)."""
+    from dlrover_tpu.common import comm
+
+    class FlakyClient:
+        def __init__(self):
+            self.acked = []
+            self.fail_on = set()
+
+        def get_task(self, dataset_name):
+            return comm.Task(task_id=-1, shard=None)  # exhausted at once
+
+        def report_task_result(self, dataset_name, task_id):
+            if task_id in self.fail_on:
+                self.fail_on.discard(task_id)
+                raise ConnectionError("master restarting")
+            self.acked.append(task_id)
+
+    client = FlakyClient()
+    sc = IndexShardingClient(client, "ds3", batch_size=1,
+                             num_minibatches_per_shard=1)
+    try:
+        # three fully-consumed single-sample tasks waiting for their ack
+        for tid in (1, 2, 3):
+            sc._task_fifo.put((tid, 1))
+        client.fail_on = {2}
+        with pytest.raises(ConnectionError):
+            sc.report_batch_done(3)
+        # 1 was acked before the failure; 2 AND 3 are stashed, not lost
+        assert client.acked == [1]
+        sc.report_batch_done(0)
+        assert client.acked == [1, 2, 3]
+    finally:
+        sc.close()
+
+
 def test_sharding_client_consumes_and_acks(local_master):
     master, addr = local_master
     client = MasterClient(addr, node_id=0, node_type="worker")

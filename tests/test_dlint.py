@@ -591,11 +591,570 @@ def test_baseline_is_cwd_independent(tmp_path, monkeypatch):
     assert moved.stale_baseline == []
 
 
+# --------------------------------------------------------------- DL007
+def test_dl007_cross_module_chain_two_deep_prints_call_chain(tmp_path):
+    """The whole-program pass: the blocking frame is TWO modules away
+    from the ``with`` — exactly what the lexical DL003 cannot see — and
+    the finding prints the full witness chain."""
+    result = _scan(tmp_path, {
+        "a.py": """
+            from b import helper
+
+            class C:
+                def run(self, sock):
+                    with self._lock:
+                        helper(sock)
+        """,
+        "b.py": """
+            def helper(sock):
+                leaf(sock)
+
+            def leaf(sock):
+                sock.recv(1)
+        """,
+    })
+    assert _codes(result) == ["DL007"]
+    msg = result.new[0].message
+    # >= 2 intermediate frames between the lock and the op
+    assert msg.count("->") >= 3
+    for frame in ("C.run", "helper", "leaf", ".recv"):
+        assert frame in msg
+    assert result.new[0].path.endswith("a.py")  # anchored at the call
+
+
+def test_dl007_self_method_dispatch_and_recursion_terminate(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def run(self):
+                with self._lock:
+                    self.slow()
+
+            def slow(self):
+                time.sleep(1.0)
+
+        class R:
+            def run(self):
+                with self._lock:
+                    self.walk(3)
+
+            def walk(self, n):
+                if n:
+                    self.walk(n - 1)   # recursion must not loop dlint
+                time.sleep(0.1)
+    """})
+    assert _codes(result) == ["DL007", "DL007"]
+    assert "C.slow" in result.new[0].message
+    assert "R.walk" in result.new[1].message
+
+
+def test_dl007_rpc_stub_under_lock_is_depth_zero_finding(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        class T:
+            def run(self):
+                with self._lock:
+                    self._stub.get_task()
+    """})
+    assert _codes(result) == ["DL007"]
+    assert "rpc" in result.new[0].message
+
+
+def test_dl007_quiet_on_timed_callees_suppressed_sources_and_no_lock(
+        tmp_path):
+    """Good twins: a callee whose waits are timed, a source op carrying
+    a reasoned DL007 suppression (bounded-by-contract), and the same
+    chain outside any lock all stay silent."""
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        def bounded(sock):
+            # dlint: disable=DL007 bounded by the socket timeout every caller configures in connect()
+            sock.recv(1)
+
+        class G:
+            def run(self, q):
+                with self._lock:
+                    self.fine(q)
+                    bounded(None)
+                self.slow()
+
+            def fine(self, q):
+                return q.get(timeout=1.0)
+
+            def slow(self):
+                time.sleep(1.0)
+    """})
+    assert _codes(result) == []
+
+
+def test_dl007_later_with_item_runs_under_earlier_lock(tmp_path):
+    """``with self._lock, self.slow():`` calls slow() while ALREADY
+    holding _lock (items acquire left-to-right), so the later item's
+    context expr must be walked under the earlier items' locks — and the
+    first item's own expr under none (the good twin reverses the order,
+    so the blocking call runs before any lock exists)."""
+    result = _scan(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def bad(self):
+                with self._lock, self.slow():
+                    pass
+
+            def good(self):
+                with self.slow(), self._lock:
+                    pass
+
+            def slow(self):
+                time.sleep(1.0)
+    """})
+    assert _codes(result) == ["DL007"]
+    assert "C.slow" in result.new[0].message
+
+
+# --------------------------------------------------------------- DL008
+def test_dl008_two_lock_cycle_names_both_witnesses(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        class C:
+            def ab(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def ba(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        pass
+    """})
+    assert _codes(result) == ["DL008"]
+    msg = result.new[0].message
+    assert "C.a_lock -> C.b_lock" in msg
+    assert "C.b_lock -> C.a_lock" in msg
+    assert "C.ab" in msg and "C.ba" in msg
+
+
+def test_dl008_three_lock_cycle_through_a_call(tmp_path):
+    """The interprocedural edge: a is held while a CALL acquires b —
+    the nested ``with`` pair never appears in one function."""
+    result = _scan(tmp_path, {"mod.py": """
+        class D:
+            def one(self):
+                with self.a_lock:
+                    self.grab_b()
+
+            def grab_b(self):
+                with self.b_lock:
+                    pass
+
+            def two(self):
+                with self.b_lock:
+                    with self.c_lock:
+                        pass
+
+            def three(self):
+                with self.c_lock:
+                    with self.a_lock:
+                        pass
+    """})
+    assert _codes(result) == ["DL008"]
+    msg = result.new[0].message
+    assert "D.a_lock" in msg and "D.b_lock" in msg and "D.c_lock" in msg
+    # the a -> b edge only exists THROUGH the call: its witness says so
+    assert "D.one -> D.grab_b" in msg
+
+
+def test_dl008_quiet_on_consistent_global_order(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        class E:
+            def one(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def two(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def three(self):
+                with self.b_lock:
+                    with self.c_lock:
+                        pass
+
+            def reenter(self):
+                # re-acquiring the same RLock is not an ordering edge
+                with self.a_lock:
+                    with self.a_lock:
+                        pass
+    """})
+    assert _codes(result) == []
+
+
+def test_dl008_multi_item_with_orders_left_to_right(tmp_path):
+    """``with a, b:`` acquires left-to-right — the single-statement
+    spelling is ordered exactly like nested withs, so an opposite-order
+    acquisition elsewhere is still the textbook ABBA deadlock."""
+    result = _scan(tmp_path, {"mod.py": """
+        class F:
+            def ab(self):
+                with self.a_lock, self.b_lock:
+                    pass
+
+            def ba(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        pass
+    """})
+    assert _codes(result) == ["DL008"]
+    msg = result.new[0].message
+    assert "F.a_lock -> F.b_lock" in msg
+    assert "F.b_lock -> F.a_lock" in msg
+
+
+def test_dl008_quiet_on_consistent_multi_item_with(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        class G:
+            def one(self):
+                with self.a_lock, self.b_lock:
+                    pass
+
+            def two(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def reenter(self):
+                with self.a_lock, self.a_lock:
+                    pass
+    """})
+    assert _codes(result) == []
+
+
+# --------------------------------------------------------------- DL009
+_STATE_CONSTS = """
+    class ServingRequestState:
+        QUEUED = "Queued"
+        RUNNING = "Running"
+        DONE = "Done"
+
+    SERVING_REQUEST_TERMINAL_STATES = (ServingRequestState.DONE,)
+
+    SERVING_REQUEST_TRANSITIONS = {
+        ServingRequestState.QUEUED: (ServingRequestState.RUNNING,),
+        ServingRequestState.RUNNING: (ServingRequestState.DONE,),
+        ServingRequestState.DONE: (),
+    }
+"""
+
+
+def _dl009_config():
+    return DlintConfig(constants_module="consts.py",
+                       request_module="req.py")
+
+
+def test_dl009_flags_terminal_overwrite_and_undeclared_transition(
+        tmp_path):
+    result = _scan(tmp_path, {
+        "consts.py": _STATE_CONSTS,
+        "mod.py": """
+            from consts import ServingRequestState
+
+            def finish(req):
+                req.state = ServingRequestState.DONE      # unguarded
+
+            def weird(req):
+                if req.state == ServingRequestState.RUNNING:
+                    req.state = ServingRequestState.QUEUED  # not in spec
+        """,
+    }, config=_dl009_config())
+    codes = _codes(result)
+    assert codes == ["DL009", "DL009"]
+    assert "terminal" in result.new[0].message
+    assert "undeclared transition" in result.new[1].message
+    assert "RUNNING" in result.new[1].message
+    assert "QUEUED" in result.new[1].message
+
+
+def test_dl009_quiet_on_guarded_writes(tmp_path):
+    result = _scan(tmp_path, {
+        "consts.py": _STATE_CONSTS,
+        "mod.py": """
+            from consts import (
+                SERVING_REQUEST_TERMINAL_STATES,
+                ServingRequestState,
+            )
+
+            def place(req):
+                if req.state == ServingRequestState.QUEUED:
+                    req.state = ServingRequestState.RUNNING
+
+            def finish(req):
+                if req.state in SERVING_REQUEST_TERMINAL_STATES:
+                    return
+                req.state = ServingRequestState.DONE
+
+            def early_exit(req):
+                if req.state != ServingRequestState.QUEUED:
+                    raise ValueError(req.state)
+                req.state = ServingRequestState.RUNNING
+        """,
+    }, config=_dl009_config())
+    assert _codes(result) == []
+
+
+def test_dl009_inverted_symbolic_guard_is_not_protection(tmp_path):
+    """Only the EXACT terminal tuple constant resolves symbolically: a
+    guard against some other tuple — worst case one literally named
+    NON_TERMINAL_STATES, whose early exit runs exactly when the state
+    is NOT terminal — must leave the write flagged, not bless it."""
+    result = _scan(tmp_path, {
+        "consts.py": _STATE_CONSTS + """
+    NON_TERMINAL_STATES = (
+        ServingRequestState.QUEUED,
+        ServingRequestState.RUNNING,
+    )
+""",
+        "mod.py": """
+            from consts import NON_TERMINAL_STATES, ServingRequestState
+
+            def resurrect(req):
+                if req.state in NON_TERMINAL_STATES:
+                    return
+                req.state = ServingRequestState.RUNNING
+        """,
+    }, config=_dl009_config())
+    assert _codes(result) == ["DL009"]
+    assert "mod.py" in result.new[0].path
+
+
+def test_dl009_else_of_and_conjoined_guard_is_not_protection(tmp_path):
+    """not-(a and b) does not imply not-a: the else branch of an
+    and-conjoined terminal test still runs for terminal states
+    (whenever the OTHER conjunct is false), so a write there is an
+    unguarded terminal overwrite — per-conjunct De Morgan negation
+    would silently bless exactly the resurrect bug DL009 exists for."""
+    result = _scan(tmp_path, {
+        "consts.py": _STATE_CONSTS,
+        "mod.py": """
+            from consts import (
+                SERVING_REQUEST_TERMINAL_STATES,
+                ServingRequestState,
+            )
+
+            def notify_or_restart(req, notify):
+                if (req.state in SERVING_REQUEST_TERMINAL_STATES
+                        and notify):
+                    req.notify()
+                else:
+                    req.state = ServingRequestState.RUNNING
+        """,
+    }, config=_dl009_config())
+    assert _codes(result) == ["DL009"]
+    assert "terminal" in result.new[0].message
+
+
+def test_dl009_else_of_or_disjoined_guard_narrows(tmp_path):
+    """not-(a or b) DOES imply not-a: each disjunct of an or-joined
+    test is individually false in the else branch, so the terminal
+    disjunct soundly protects the write there."""
+    result = _scan(tmp_path, {
+        "consts.py": _STATE_CONSTS,
+        "mod.py": """
+            from consts import (
+                SERVING_REQUEST_TERMINAL_STATES,
+                ServingRequestState,
+            )
+
+            def finish(req, closing):
+                if (req.state in SERVING_REQUEST_TERMINAL_STATES
+                        or closing):
+                    return
+                else:
+                    req.state = ServingRequestState.DONE
+        """,
+    }, config=_dl009_config())
+    assert _codes(result) == []
+
+
+def test_dl009_abort_impl_guard_gates_call_sites(tmp_path):
+    bad = _scan(tmp_path / "bad", {
+        "consts.py": _STATE_CONSTS,
+        "req.py": """
+            from consts import ServingRequestState
+
+            class ServingRequest:
+                def abort(self, state):
+                    self.state = state          # no terminal guard
+
+            def expire(req):
+                req.abort(ServingRequestState.DONE)
+        """,
+    }, config=_dl009_config())
+    # the unguarded impl is flagged itself AND poisons its call sites
+    assert _codes(bad) == ["DL009", "DL009"]
+
+    good = _scan(tmp_path / "good", {
+        "consts.py": _STATE_CONSTS,
+        "req.py": """
+            from consts import (
+                SERVING_REQUEST_TERMINAL_STATES,
+                ServingRequestState,
+            )
+
+            class ServingRequest:
+                def abort(self, state):
+                    if self.state in SERVING_REQUEST_TERMINAL_STATES:
+                        return
+                    self.state = state
+
+            def expire(req):
+                req.abort(ServingRequestState.DONE)
+        """,
+    }, config=_dl009_config())
+    assert _codes(good) == []
+
+
+def test_dl009_spec_drift_is_itself_a_finding(tmp_path):
+    result = _scan(tmp_path, {
+        "consts.py": """
+            class ServingRequestState:
+                QUEUED = "Queued"
+                DONE = "Done"
+                NEW = "New"
+
+            SERVING_REQUEST_TERMINAL_STATES = (ServingRequestState.DONE,)
+
+            SERVING_REQUEST_TRANSITIONS = {
+                ServingRequestState.QUEUED: (ServingRequestState.DONE,),
+                ServingRequestState.DONE: (),
+            }
+        """,
+    }, config=_dl009_config())
+    assert _codes(result) == ["DL009"]
+    assert "NEW" in result.new[0].message
+
+
+def test_dl009_missing_spec_next_to_enum_is_flagged(tmp_path):
+    result = _scan(tmp_path, {
+        "consts.py": """
+            class ServingRequestState:
+                QUEUED = "Queued"
+                DONE = "Done"
+        """,
+    }, config=_dl009_config())
+    assert _codes(result) == ["DL009"]
+    assert "SERVING_REQUEST_TRANSITIONS" in result.new[0].message
+
+
+# ------------------------------------------------------- summary cache
+def test_summary_cache_reused_and_invalidated_on_edit(tmp_path):
+    """The whole-program summary cache is keyed by file hash: a warm
+    run reuses entries, an EDIT must re-extract (a stale summary would
+    keep reporting the fixed chain — or hide a fresh one)."""
+    mod = tmp_path / "pkg" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text(textwrap.dedent("""
+        import time
+
+        class C:
+            def run(self):
+                with self._lock:
+                    self.slow()
+
+            def slow(self):
+                time.sleep(1.0)
+    """))
+    cache = tmp_path / "cache.json"
+    first = run_dlint([str(mod.parent)],
+                      summary_cache_path=str(cache))
+    assert _codes(first) == ["DL007"]
+    keys_before = set(
+        json.loads(cache.read_text())["entries"])
+    warm = run_dlint([str(mod.parent)], summary_cache_path=str(cache))
+    assert _codes(warm) == ["DL007"]
+    assert set(json.loads(cache.read_text())["entries"]) == keys_before
+
+    # fix the violation: the hash changes, the summary is re-extracted
+    mod.write_text(textwrap.dedent("""
+        import time
+
+        class C:
+            def run(self):
+                with self._lock:
+                    pass
+                self.slow()
+
+            def slow(self):
+                time.sleep(1.0)
+    """))
+    fixed = run_dlint([str(mod.parent)], summary_cache_path=str(cache))
+    assert _codes(fixed) == []
+    assert set(json.loads(cache.read_text())["entries"]) != keys_before
+
+
+# ----------------------------------------------- CLI: explain/callgraph
+def test_cli_explain_known_and_unknown_codes(capsys):
+    assert dlint_main(["--explain", "DL007"]) == 0
+    out = capsys.readouterr().out
+    assert "DL007" in out and "chain" in out
+    # unknown codes exit nonzero (CI can trust a typo to fail loudly)
+    assert dlint_main(["--explain", "DL999"]) == 2
+    assert "unknown checker code" in capsys.readouterr().err
+
+
+def test_cli_call_graph_dumps_resolved_edges(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        class C:
+            def run(self):
+                self.helper()
+
+            def helper(self):
+                pass
+    """))
+    assert dlint_main(["--call-graph", str(tmp_path / "mod.py")]) == 0
+    out = capsys.readouterr().out
+    assert "C.run" in out and "C.helper" in out
+
+
+# --------------------------------------------------- tools/dlint shim
+def test_tools_shim_cannot_diverge_from_canonical_impl():
+    """The checkout shim must be a PURE re-export: same objects as the
+    canonical modules, and no ``def``/``class`` of its own anywhere —
+    a copied-then-edited shim cannot pass this."""
+    import ast as ast_mod
+
+    import dlrover_tpu.dlint.checkers as canon_checkers
+    import dlrover_tpu.dlint.cli as canon_cli
+    import dlrover_tpu.dlint.core as canon_core
+    import tools.dlint as shim
+    import tools.dlint.checkers as shim_checkers
+    import tools.dlint.cli as shim_cli
+    import tools.dlint.core as shim_core
+
+    assert shim.run_dlint is canon_cli.run_dlint
+    assert shim.main is canon_cli.main
+    assert shim_checkers.CHECKERS is canon_checkers.CHECKERS
+    assert shim_core.build_program is canon_core.build_program
+    for mod in (shim, shim_checkers, shim_cli, shim_core):
+        tree = ast_mod.parse(
+            Path(mod.__file__).read_text(encoding="utf-8"))
+        defs = [
+            n for n in ast_mod.walk(tree)
+            if isinstance(n, (ast_mod.FunctionDef,
+                              ast_mod.AsyncFunctionDef,
+                              ast_mod.ClassDef))
+        ]
+        assert not defs, f"{mod.__name__} defines its own code: {defs}"
+
+
 # ---------------------------------------------------- acceptance gates
 def test_repo_package_is_dlint_clean():
-    """THE tier-1 guard: any new DL001-DL006 violation in dlrover_tpu
-    fails this test.  The baseline is empty — nothing is grandfathered;
-    the two in-tree suppressions carry written reasons."""
+    """THE tier-1 guard: any new DL001-DL009 violation in dlrover_tpu
+    fails this test — including the whole-program pass (transitive
+    blocking under locks, lock-order cycles, state-machine
+    exhaustiveness).  The baseline is empty — nothing is grandfathered;
+    every in-tree suppression carries a written reason."""
     result = run_dlint(
         [str(REPO_ROOT / "dlrover_tpu")],
         baseline_path=str(REPO_ROOT / "tools" / "dlint" / "baseline.json"),

@@ -718,3 +718,152 @@ def test_router_over_real_paged_engines():
         assert r.result(timeout=0).size == 6
     assert router.metrics.metrics()[
         "serving_requests_completed_total"] == 6
+
+
+# -- ISSUE 7: DL009 terminal-state guards + out-of-lock placement ----------
+
+
+def test_terminal_state_guards_block_resurrection():
+    """The fabric fix dlint DL009 forced: finish()/abort() refuse to
+    leave a terminal state.  An engine completing a request whose
+    CANCEL frame was lost (or an expiry racing a cancel) must not flip
+    the answer the caller was already given."""
+    from dlrover_tpu.serving.router.gateway import (
+        RequestTimedOut,
+        ServingRequest,
+    )
+
+    req = ServingRequest(rid=7, prompt=_prompt(1), max_new_tokens=4)
+    assert req.cancel()
+    req.abort(ServingRequestState.CANCELLED)
+    # the engine finishes anyway: DONE must not overwrite CANCELLED
+    req.finish([1, 2, 3], now=1.0)
+    assert req.state == ServingRequestState.CANCELLED
+    assert req.output == []
+    with pytest.raises(RequestTimedOut):
+        req.result(timeout=0)
+    # an expiry racing the cancel must not rewrite the terminal state
+    req.abort(ServingRequestState.TIMED_OUT)
+    assert req.state == ServingRequestState.CANCELLED
+
+    done = ServingRequest(rid=8, prompt=_prompt(2), max_new_tokens=2)
+    done.finish([5, 6], now=1.0)
+    # ...and the mirror image: a late abort cannot undo completion
+    done.abort(ServingRequestState.TIMED_OUT)
+    assert done.state == ServingRequestState.DONE
+    assert list(done.result(timeout=0)) == [5, 6]
+
+
+def test_submit_refuses_non_queued_request():
+    """Placement runs OUTSIDE the router step lock now (dlint DL007:
+    a remote submit is a frame send + ack wait), so a cancel can race
+    it — ReplicaHandle.submit must reject anything not QUEUED instead
+    of resurrecting a terminal request onto an engine."""
+    from dlrover_tpu.serving.router.gateway import ServingRequest
+    from dlrover_tpu.serving.router.replica import (
+        ReplicaHandle,
+        StaleRequestError,
+    )
+
+    handle = ReplicaHandle("r0", FakeEngine(slots=2, tokens_per_step=2))
+    handle.mark_up(0.0)
+    req = ServingRequest(rid=1, prompt=_prompt(1), max_new_tokens=2)
+    req.abort(ServingRequestState.CANCELLED)
+    with pytest.raises(StaleRequestError):
+        handle.submit(req)
+    assert not handle.inflight
+    assert req.state == ServingRequestState.CANCELLED
+
+
+def test_stale_placement_is_not_a_rejection():
+    """The router must tell 'this request was answered while its
+    submit was in flight' (skip, already accounted by the cancel
+    sweep) from 'the engine rejected it' (REJECTED + counter): the
+    race, forced by handing step() a placement whose request went
+    terminal after the decision, must leave the rejected ledger at 0
+    and blame no replica."""
+    router = ServingRouter(scheduler=ContinuousBatchScheduler(
+        block_size=4))
+    router.join_replica("r0", FakeEngine(slots=2, tokens_per_step=2))
+    handle = router.manager.get("r0")
+    req = router.submit(_prompt(1), 2)
+    req.abort(ServingRequestState.CANCELLED)
+
+    real_schedule = router.scheduler.schedule
+    router.scheduler.schedule = (
+        lambda gateway, replicas, now=None: [(handle, req)])
+    try:
+        router.step()
+    finally:
+        router.scheduler.schedule = real_schedule
+
+    assert router.gateway.rejected == 0
+    assert router.metrics.metrics()[
+        "serving_requests_rejected_total"] == 0
+    assert not handle.inflight
+    assert req.state == ServingRequestState.CANCELLED
+
+
+def test_drain_racing_delivery_is_not_a_failover():
+    """A begin_drain landing between the placement decision and the
+    out-of-lock delivery must keep the drain graceful: the SUBMIT was
+    never sent, so the request just goes back to the queue and the
+    replica stays DRAINING — failing it over would requeue its real
+    in-flight work and retire it crash-style (no GOODBYE)."""
+    from dlrover_tpu.serving.router.replica import ReplicaStatus
+
+    router = ServingRouter(scheduler=ContinuousBatchScheduler(
+        block_size=4))
+    router.join_replica("r0", FakeEngine(slots=2, tokens_per_step=2))
+    handle = router.manager.get("r0")
+    req = router.submit(_prompt(1), 2)
+
+    real_schedule = router.scheduler.schedule
+
+    def schedule_then_drain(gateway, replicas, now=None):
+        # the real decision runs first (with pre-drain membership),
+        # then the drain lands — i.e. before the out-of-lock delivery
+        placements = real_schedule(gateway, replicas, now=now)
+        assert placements == [(handle, req)]
+        handle.begin_drain()
+        return placements
+
+    router.scheduler.schedule = schedule_then_drain
+    try:
+        router.step()
+    finally:
+        router.scheduler.schedule = real_schedule
+
+    # the replica retired GRACEFULLY: it was empty, so the same step's
+    # phase-5 moved it DRAINING -> retired into router.drained (with
+    # GOODBYE) — the bug escalated it into router.dead instead
+    assert handle.status in (ReplicaStatus.DRAINING, ReplicaStatus.LEFT)
+    assert not handle._failed
+    assert any(d.name == "r0" for d in router.drained)
+    assert not any(d.name == "r0" for d in router.dead)
+    assert req.state == ServingRequestState.QUEUED
+    assert router.metrics.metrics()[
+        "serving_requests_requeued_total"] == 1
+    assert router.gateway.depth() == 1
+
+
+def test_transition_spec_is_importable_truth():
+    """The DL009 spec in common/constants.py is runtime-checkable: it
+    covers every enum state exactly, and terminal means terminal."""
+    from dlrover_tpu.common.constants import (
+        SERVING_REQUEST_TERMINAL_STATES,
+        SERVING_REQUEST_TRANSITIONS,
+    )
+
+    states = {
+        v for k, v in vars(ServingRequestState).items()
+        if not k.startswith("_") and isinstance(v, str)
+    }
+    assert set(SERVING_REQUEST_TRANSITIONS) == states
+    assert set(SERVING_REQUEST_TERMINAL_STATES) < states
+    for s in SERVING_REQUEST_TERMINAL_STATES:
+        assert SERVING_REQUEST_TRANSITIONS[s] == ()
+    for s, targets in SERVING_REQUEST_TRANSITIONS.items():
+        assert set(targets) <= states
+        if s not in SERVING_REQUEST_TERMINAL_STATES:
+            assert targets, f"non-terminal {s} must go somewhere"

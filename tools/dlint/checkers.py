@@ -1,4 +1,9 @@
-"""Shim — canonical module: :mod:`dlrover_tpu.dlint.checkers`."""
+"""Shim — canonical module: :mod:`dlrover_tpu.dlint.checkers`.
+
+Pure re-export: this file must define nothing of its own (the test
+suite asserts shim modules carry no ``def``/``class``, so the checkout
+spelling and the wheel-shipped implementation can never diverge).
+"""
 
 from dlrover_tpu.dlint.checkers import (  # noqa: F401
     CHECKERS,
@@ -6,9 +11,12 @@ from dlrover_tpu.dlint.checkers import (  # noqa: F401
     DlintConfig,
     FrameExhaustiveChecker,
     LockBlockingChecker,
+    LockOrderingChecker,
     MetricRegistryChecker,
     Project,
+    StateTransitionChecker,
     SwallowedExceptionChecker,
     ThreadHygieneChecker,
     ToctouPortChecker,
+    TransitiveLockBlockingChecker,
 )
