@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName, TaskType
-from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryPolicy
 from dlrover_tpu.common.rpc import RpcStub
 from dlrover_tpu.common.serialize import (
     deserialize_message,
@@ -23,22 +23,32 @@ from dlrover_tpu.common.serialize import (
 )
 
 
-def retry_rpc(retry: int = 10, interval: float = 3.0):
+def retry_rpc(retry: int = 10, interval: float = 3.0,
+              policy: Optional[RetryPolicy] = None):
+    """Wrap a master RPC in a :class:`~dlrover_tpu.common.retry.
+    RetryPolicy`: typed (only transport-level errors retry — a served
+    failure response raises immediately), exponential + jittered
+    (never a fixed-interval knock on a restarting master), bounded by
+    a total deadline of ``retry * interval`` seconds, and logged once
+    per state change rather than once per attempt.  ``interval`` keeps
+    its historical meaning as the budget unit: the backoff starts at a
+    quarter of it and caps at twice it, so a blip recovers faster than
+    before while a real outage backs off harder."""
+
     def decorator(func):
+        pol = policy if policy is not None else RetryPolicy(
+            max_attempts=retry,
+            backoff_base=max(0.1, interval / 4.0),
+            backoff_max=interval * 2.0,
+            deadline=retry * interval,
+        )
+
         @wraps(func)
         def wrapped(self, *args, **kwargs):
-            for i in range(retry):
-                try:
-                    return func(self, *args, **kwargs)
-                except Exception as e:
-                    if i == retry - 1:
-                        raise
-                    logger.warning(
-                        "%s failed (%s); retry %s/%s",
-                        func.__name__, e, i + 1, retry,
-                    )
-                    time.sleep(interval)
+            return pol.call(func, self, *args,
+                            what=func.__name__, **kwargs)
 
+        wrapped.retry_policy = pol  # introspection/test seam
         return wrapped
 
     return decorator
@@ -53,7 +63,12 @@ class MasterClient:
         self._master_addr = master_addr
         self._node_id = node_id
         self._node_type = node_type
-        self._stub = RpcStub(master_addr, timeout=timeout)
+        # wait_for_ready: riding out a master restart is this client's
+        # CONTRACT (retry_rpc's whole point) — an attempt issued into
+        # the outage waits on the reconnecting channel instead of
+        # burning the retry budget replaying a cached UNAVAILABLE
+        self._stub = RpcStub(master_addr, timeout=timeout,
+                             wait_for_ready=True)
         self._host_name = socket.gethostname()
         try:
             self._host_ip = socket.gethostbyname(self._host_name)

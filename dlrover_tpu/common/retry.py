@@ -1,0 +1,194 @@
+"""Typed, jittered exponential-backoff retry for control-plane RPCs.
+
+The old ``retry_rpc`` loop slept a fixed 3 s between 10 attempts and
+retried bare ``Exception`` — so a master that ANSWERED with a refusal
+(``RuntimeError`` from the envelope) was retried as hard as a master
+that was down, every in-flight call logged one warning per attempt
+(a 30 s master restart emitted 10 warnings per call), and a burst of
+callers all re-knocked in lockstep.  :class:`RetryPolicy` replaces it:
+
+- **typed**: only *transient* failures are retried — transport-level
+  errors (``grpc.RpcError`` with UNAVAILABLE / DEADLINE_EXCEEDED /
+  RESOURCE_EXHAUSTED / ABORTED, ``ConnectionError`` / ``TimeoutError``
+  / ``OSError``).  A served error response, a serialization bug or a
+  ``ValueError`` is an ANSWER; retrying it cannot help and only hides
+  the defect for ``retry * interval`` seconds;
+- **exponential + jittered**: delays grow ``base * multiplier**(n-1)``
+  capped at ``backoff_max``, stretched by up to ``jitter`` (seeded —
+  tests replay the exact schedule), so a fleet of clients does not
+  re-knock on a restarting master in lockstep;
+- **deadline-budgeted**: retrying stops when the NEXT delay would
+  cross ``deadline`` seconds since the first attempt, whatever the
+  attempt count says — a call can never hang longer than its budget;
+- **log-once-per-state-change**: one warning when a call starts
+  failing, debug for subsequent retries, one info on recovery — the
+  log carries the state transition, not the retry cadence.
+
+Every retry is counted into a process-wide counter surfaced as the
+``serving_rpc_retries_total`` metric (rendered by
+``RouterMetrics.metrics`` — a rising value under a steady fleet is the
+control-plane-flakiness signal).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+try:  # transport classification only; the policy works without grpc
+    import grpc
+except Exception:  # pragma: no cover - grpc is baked into the image
+    grpc = None
+
+# process-wide retry accounting (the serving_rpc_retries_total metric)
+_COUNTER_LOCK = threading.Lock()
+_RETRIES_TOTAL = 0
+
+
+def count_retry(n: int = 1) -> None:
+    global _RETRIES_TOTAL
+    with _COUNTER_LOCK:
+        _RETRIES_TOTAL += int(n)
+
+
+def retries_total() -> int:
+    with _COUNTER_LOCK:
+        return _RETRIES_TOTAL
+
+
+def reset_retries_total() -> None:
+    """Test hook: zero the process-wide retry counter."""
+    global _RETRIES_TOTAL
+    with _COUNTER_LOCK:
+        _RETRIES_TOTAL = 0
+
+
+def _transient_grpc_codes():
+    if grpc is None:  # pragma: no cover - grpc is baked into the image
+        return ()
+    c = grpc.StatusCode
+    return (c.UNAVAILABLE, c.DEADLINE_EXCEEDED,
+            c.RESOURCE_EXHAUSTED, c.ABORTED)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transport-level failure that a retry can plausibly outlive.
+
+    A ``grpc.RpcError`` is judged by its status code; socket-layer
+    errors (``ConnectionError`` / ``TimeoutError`` / ``OSError``) are
+    transient by nature.  Everything else — including the envelope's
+    ``RuntimeError`` for a request the server ANSWERED with a failure —
+    is non-transient: the bytes arrived, the answer is no."""
+    if grpc is not None and isinstance(exc, grpc.RpcError):
+        code_fn = getattr(exc, "code", None)
+        try:
+            code = code_fn() if callable(code_fn) else None
+        except Exception:
+            code = None
+        return code in _transient_grpc_codes()
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+
+class RetryPolicy:
+    """Deterministic (seeded) exponential-backoff retry executor.
+
+    One policy instance is shared by many calls (it is stateless per
+    call apart from the jitter RNG); ``seed`` pins the jitter sequence
+    so chaos tests can assert the exact schedule."""
+
+    def __init__(
+        self,
+        max_attempts: int = 10,
+        backoff_base: float = 0.5,
+        backoff_multiplier: float = 2.0,
+        backoff_max: float = 15.0,
+        deadline: float = 60.0,
+        jitter: float = 0.25,
+        seed: Optional[int] = None,
+        classify: Optional[Callable[[BaseException], bool]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        import random
+
+        self.max_attempts = int(max_attempts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.backoff_max = float(backoff_max)
+        self.deadline = float(deadline)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+        self._classify = classify or is_transient
+        self._sleep = sleep or time.sleep
+        self._clock = clock or time.monotonic
+
+    # ------------------------------------------------------------ delays
+    def delay(self, failures: int) -> float:
+        """Backoff before the retry following the ``failures``-th
+        consecutive failure (1-based).  Deterministic under ``seed``."""
+        base = min(
+            self.backoff_max,
+            self.backoff_base
+            * (self.backoff_multiplier ** max(0, failures - 1)),
+        )
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    # -------------------------------------------------------------- call
+    def call(self, fn: Callable, *args, what: Optional[str] = None,
+             **kwargs):
+        """Run ``fn`` under this policy.  Non-transient errors raise
+        immediately; transient ones retry until the attempt budget or
+        the total ``deadline`` runs out (the last error re-raises)."""
+        what = what or getattr(fn, "__name__", "rpc")
+        start = self._clock()
+        failures = 0
+        while True:
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as e:
+                if not self._classify(e):
+                    raise
+                failures += 1
+                wait = self.delay(failures)
+                elapsed = self._clock() - start
+                if failures >= self.max_attempts \
+                        or elapsed + wait > self.deadline:
+                    logger.warning(
+                        "%s: giving up after %d transient failures "
+                        "(%.1fs elapsed, deadline %.1fs): %s",
+                        what, failures, elapsed, self.deadline, e)
+                    raise
+                if failures == 1:
+                    # one warning per OUTAGE, not per attempt: the
+                    # state changed (healthy -> failing); subsequent
+                    # retries of the same call log at debug only
+                    logger.warning(
+                        "%s failed transiently (%s); retrying with "
+                        "backoff (attempt budget %d, deadline %.1fs)",
+                        what, e, self.max_attempts, self.deadline)
+                else:
+                    logger.debug(
+                        "%s still failing (retry %d/%d, next in "
+                        "%.2fs): %s", what, failures,
+                        self.max_attempts, wait, e)
+                # counted HERE, after the give-up check: the metric is
+                # retries performed, not failures observed — an
+                # exhausted call must not read one higher than the
+                # retries it actually burned
+                count_retry()
+                self._sleep(wait)
+                continue
+            if failures:
+                # the matching state change: failing -> recovered
+                logger.info(
+                    "%s recovered after %d transient failures",
+                    what, failures)
+            return result
+
+
+def retry_metrics() -> dict:
+    """Metric source for the process-wide retry counter."""
+    return {"serving_rpc_retries_total": float(retries_total())}

@@ -101,9 +101,11 @@ def build_server(
 class RpcStub:
     """Client stub for the get/report envelope."""
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 wait_for_ready: bool = False):
         self._addr = addr
         self._timeout = timeout
+        self._wait_for_ready = bool(wait_for_ready)
         self._closed = False
         self._channel = grpc.insecure_channel(
             addr,
@@ -114,6 +116,17 @@ class RpcStub:
                     GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
                 ),
                 ("grpc.enable_retries", 1),
+                # bound the channel's own reconnect backoff well below
+                # RetryPolicy's total deadline (default 30s): grpc's
+                # 120s default max means a channel that raced through a
+                # few refused dials early in an outage would not re-dial
+                # again inside the whole retry budget — every app-level
+                # retry just replays the cached UNAVAILABLE and a master
+                # restart is never observed (seen live: master back up
+                # 20s before retry_rpc gave up, all attempts "connection
+                # refused")
+                ("grpc.initial_reconnect_backoff_ms", 1000),
+                ("grpc.max_reconnect_backoff_ms", 5000),
             ],
         )
         self._get = self._channel.unary_unary(
@@ -128,10 +141,22 @@ class RpcStub:
         )
 
     def get(self, payload: bytes, timeout: float = 0) -> bytes:
-        return self._get(payload, timeout=timeout or self._timeout)
+        # wait_for_ready (opt-in): a call issued while the server is
+        # down WAITS (bounded by the per-RPC deadline) for the channel
+        # to reconnect instead of instantly bouncing UNAVAILABLE off
+        # the broken channel — fail-fast calls never re-dial, so an
+        # app-level retry loop can exhaust its whole deadline replaying
+        # one cached refusal while a restarted master sits reachable.
+        # It stays OFF by default: callers with a fallback (the router
+        # pump's Brain-backed autoscale, coworker data-path stubs)
+        # need the millisecond UNAVAILABLE, not a stall to the full
+        # RPC deadline
+        return self._get(payload, timeout=timeout or self._timeout,
+                         wait_for_ready=self._wait_for_ready)
 
     def report(self, payload: bytes, timeout: float = 0) -> bytes:
-        return self._report(payload, timeout=timeout or self._timeout)
+        return self._report(payload, timeout=timeout or self._timeout,
+                            wait_for_ready=self._wait_for_ready)
 
     @property
     def closed(self) -> bool:

@@ -250,8 +250,19 @@ class PodScaler(Scaler):
         # ranks whose stable Service failed to create (transient API
         # errors): retried by the creator loop — a pod without its
         # Service is unreachable at its stable address for the job's
-        # whole life
+        # whole life.  Retries are CAPPED per node (_svc_retries /
+        # MAX_SVC_RETRIES): a persistently failing create (RBAC denial,
+        # quota, webhook rejection) must not grow the retry list one
+        # entry per creator tick forever — it gives up loudly instead
+        # and counts into svc_give_ups.
         self._svc_pending: List[Node] = []
+        self._svc_retries: Dict[str, int] = {}
+        # per-node earliest next attempt: the cap is ATTEMPTS, so
+        # without spacing them out a ~4s apiserver blip would burn all
+        # 8 at the creator loop's 0.5s cadence and strand the rank —
+        # exponential backoff stretches the budget to ~90s of outage
+        self._svc_next_try: Dict[str, float] = {}
+        self.svc_give_ups = 0
         self._removals: List[Node] = []
         self._group_targets: Dict[str, Any] = {}
         self._lock = threading.Lock()
@@ -333,9 +344,13 @@ class PodScaler(Scaler):
         with self._lock:
             todo, self._pending = self._pending, []
         created = 0
+        now = time.monotonic()
         with self._lock:
-            svc_retry, self._svc_pending = self._svc_pending, []
-        for node in svc_retry:
+            due = [n for n in self._svc_pending
+                   if self._svc_next_try.get(n.name, 0.0) <= now]
+            self._svc_pending = [
+                n for n in self._svc_pending if n not in due]
+        for node in due:
             self._ensure_pod_service(node)
         for node in todo:
             body = build_pod_spec(
@@ -361,6 +376,19 @@ class PodScaler(Scaler):
             self._ensure_pod_service(node)
         return created
 
+    #: per-node Service-creation attempts before giving up loudly —
+    #: the retry exists for TRANSIENT apiserver blips; a create that
+    #: fails this many consecutive times is structural (RBAC, quota,
+    #: admission webhook) and re-knocking every creator tick forever
+    #: only grows the retry list and buries the real error in noise.
+    #: Attempts are spaced by exponential backoff (base doubling per
+    #: failure, capped) so the budget spans ~90s of real outage, not
+    #: 8 creator ticks (4 seconds) — a rolling apiserver upgrade must
+    #: not permanently strand a rank's address
+    MAX_SVC_RETRIES = 8
+    SVC_RETRY_BACKOFF_BASE = 1.0
+    SVC_RETRY_BACKOFF_MAX = 30.0
+
     def _ensure_pod_service(self, node: Node) -> None:
         """Create the pod's stable (type, rank) Service; AlreadyExists is
         the common relaunch case and is fine — the selector picks up the
@@ -368,7 +396,10 @@ class PodScaler(Scaler):
         relaunched rank reuses its address); their ownerReference to the
         ElasticJob CR hands teardown to cluster GC.  Transient failures
         are requeued — unlike pods, nothing later recreates a missed
-        Service, so a drop here would strand the rank's address."""
+        Service, so a drop here would strand the rank's address — but
+        only :data:`MAX_SVC_RETRIES` times per node: persistent failure
+        gives up with one ERROR naming the stranded rank and counts
+        into ``svc_give_ups`` instead of retrying unbounded."""
         create_svc = getattr(self._api, "create_namespaced_service", None)
         if create_svc is None:  # injected fakes may not model services
             return
@@ -385,13 +416,45 @@ class PodScaler(Scaler):
             if getattr(e, "status", None) == 409 or \
                     "AlreadyExists" in type(e).__name__ or \
                     "AlreadyExists" in str(e):
+                with self._lock:
+                    self._svc_retries.pop(node.name, None)
+                    self._svc_next_try.pop(node.name, None)
                 return
-            logger.warning(
-                "service create %s failed (requeued): %s",
-                svc["metadata"]["name"], e,
-            )
             with self._lock:
-                self._svc_pending.append(node)
+                tries = self._svc_retries.get(node.name, 0) + 1
+                if tries >= self.MAX_SVC_RETRIES:
+                    self._svc_retries.pop(node.name, None)
+                    self._svc_next_try.pop(node.name, None)
+                    self.svc_give_ups += 1
+                    give_up = True
+                else:
+                    self._svc_retries[node.name] = tries
+                    self._svc_next_try[node.name] = (
+                        time.monotonic() + min(
+                            self.SVC_RETRY_BACKOFF_MAX,
+                            self.SVC_RETRY_BACKOFF_BASE
+                            * (2 ** (tries - 1))))
+                    self._svc_pending.append(node)
+                    give_up = False
+            if give_up:
+                logger.error(
+                    "service create %s failed %d consecutive times; "
+                    "giving up — rank %s of %s has NO stable address "
+                    "until the Service is created by hand or the node "
+                    "is relaunched: %s",
+                    svc["metadata"]["name"], self.MAX_SVC_RETRIES,
+                    node.rank_index, node.type, e,
+                )
+            else:
+                logger.warning(
+                    "service create %s failed (requeued %d/%d): %s",
+                    svc["metadata"]["name"], tries,
+                    self.MAX_SVC_RETRIES, e,
+                )
+            return
+        with self._lock:
+            self._svc_retries.pop(node.name, None)
+            self._svc_next_try.pop(node.name, None)
 
     def _list_nodes(self) -> List[Node]:
         try:

@@ -1,38 +1,58 @@
-"""Frame-level fault injection for the serving fabric.
+"""Fault injection for the serving fabric and the control plane.
 
 The only way to trust a degradation path is to exercise it on purpose
 (chaos engineering: the failure drill, not the postmortem).  SIGKILL
 covers "the process died"; everything subtler — a frame that arrives
 late, a connection torn mid-length-prefix, a duplicated TOKEN, a
 heartbeat that stalls while the socket stays open, a DONE that never
-comes — lives between the engine and the wire, and nothing could
-inject it.  This module is that seam: a **seeded, schedule-driven**
-wrapper over :class:`~dlrover_tpu.serving.remote.protocol.
-FrameConnection` that perturbs frames at SEND time, pluggable into
-both ends of the protocol:
+comes, an RPC that vanishes into a restarting master — lives between
+the engine and the wire, and nothing could inject it.  This module is
+that seam: a **seeded, schedule-driven** decision engine
+(:class:`FaultSchedule`) with three interposers:
 
-- the worker (``WorkerServer(fault_schedule=...)`` or the
-  ``DLROVER_SERVING_FAULTS`` env var on a spawned worker process)
-  perturbs worker->router frames: TOKEN / DONE / STATS / HELLO;
-- the proxy (``RemoteReplicaHandle(fault_schedule=...)``) perturbs
-  router->worker frames: SUBMIT / CANCEL / GOODBYE.
+- :class:`FaultyFrameConnection` perturbs the frame protocol on BOTH
+  sides of the wire.  ``side: "send"`` specs (the default) fire at
+  send time — the worker (``WorkerServer(fault_schedule=...)`` or the
+  ``DLROVER_SERVING_FAULTS`` env var on a spawned worker) perturbs
+  worker->router frames, the proxy
+  (``RemoteReplicaHandle(fault_schedule=...)``) router->worker ones.
+  ``side: "recv"`` specs fire at RECEIVE time, on the real reader
+  thread — the only way to exercise the receiver's reorder and
+  staleness paths (a TOKEN landing after its DONE, an old STATS
+  arriving after a newer one), which TCP ordering otherwise shields
+  from send-side injection;
+- :class:`FaultyRpcStub` perturbs the gRPC control plane (master and
+  Brain RPCs): delay / drop / error / stall on ``get`` / ``report``,
+  so the retry policy (common/retry.py) and every caller's outage
+  tolerance are TESTED, not hoped for.
 
 A schedule is a list of fault specs (JSON-friendly dicts):
 
 ``op``
-    ``delay`` (sleep ``seconds`` before the send), ``dup`` (send the
-    frame twice), ``drop`` (swallow it), ``stall`` (swallow every
-    matching frame for ``seconds`` after the trigger — the
-    heartbeat-stall / silent-worker signature), ``tear`` (write half a
+    ``delay`` (sleep ``seconds`` before delivery), ``dup`` (deliver
+    the frame twice), ``drop`` (swallow it; for an RPC: raise a
+    TRANSIENT ``ConnectionError`` — the call never reached the
+    server), ``stall`` (swallow every matching frame / fail every
+    matching RPC for ``seconds`` after the trigger — the
+    heartbeat-stall / wedged-master signature), ``tear`` (write half a
     length prefix to the raw socket and close it — the torn-stream
-    signature a SIGKILL mid-send leaves).
+    signature a SIGKILL mid-send leaves; for an RPC: a transient
+    ``ConnectionError``), ``error`` (raise a NON-transient
+    ``RuntimeError`` — the served-refusal class a retry policy must
+    NOT retry), ``reorder`` (recv-side: hold the matching frame back
+    and deliver it after the next frame — the out-of-order arrival
+    the receiver's staleness guards exist for).
 ``kind``
-    frame kind to match (``"TOKEN"``, ``"STATS"``, ...) or ``"*"``.
+    frame kind (``"TOKEN"``, ``"STATS"``, ...) or RPC method
+    (``"get"``, ``"report"``) to match, or ``"*"``.
+``side``
+    ``"send"`` (default) or ``"recv"`` — which interposer hook the
+    spec arms.  RPC stubs consult the send side.
 ``after``
     trigger on the Nth matching frame (1-based, default 1).
 ``count``
-    for delay/dup/drop: how many consecutive matching frames the
-    fault applies to (default 1).
+    for delay/dup/drop/error/reorder: how many consecutive matching
+    frames the fault applies to (default 1).
 ``jitter``
     for delay: extra seconds, scaled by the schedule's seeded RNG —
     the same seed replays the same perturbation.
@@ -54,11 +74,12 @@ from dlrover_tpu.common.constants import ServingFabric
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.remote.protocol import FrameConnection
 
-_OPS = ("delay", "dup", "drop", "stall", "tear")
+_OPS = ("delay", "dup", "drop", "stall", "tear", "error", "reorder")
+_SIDES = ("send", "recv")
 
 
 class FaultSchedule:
-    """Deterministic, thread-safe decision engine for frame faults.
+    """Deterministic, thread-safe decision engine for injected faults.
 
     One schedule serves all connections of one endpoint; counters are
     cumulative across reconnects (a worker that is re-adopted after a
@@ -76,6 +97,10 @@ class FaultSchedule:
             if op not in _OPS:
                 raise ValueError(
                     f"unknown fault op {op!r} (one of {_OPS})")
+            side = spec.setdefault("side", "send")
+            if side not in _SIDES:
+                raise ValueError(
+                    f"unknown fault side {side!r} (one of {_SIDES})")
             spec.setdefault("kind", "*")
             spec.setdefault("after", 1)
             spec.setdefault("count", 1)
@@ -84,7 +109,7 @@ class FaultSchedule:
             spec["_seen"] = 0          # matching frames observed
             spec["_stall_until"] = None
             self.specs.append(spec)
-        #: log of fired injections: {op, kind, t} per event
+        #: log of fired injections: {op, kind, side, t} per event
         self.injected: List[Dict] = []
 
     @classmethod
@@ -103,14 +128,16 @@ class FaultSchedule:
                    seed=int(payload.get("seed", 0)))
 
     # ------------------------------------------------------- decisions
-    def actions_for(self, kind: str) -> List[Dict]:
-        """The fault actions to apply to one outgoing frame of
-        ``kind`` (in schedule order).  Mutates trigger counters — call
-        exactly once per send attempt."""
+    def actions_for(self, kind: str, side: str = "send") -> List[Dict]:
+        """The fault actions to apply to one frame of ``kind`` passing
+        the ``side`` hook (in schedule order).  Mutates trigger
+        counters — call exactly once per delivery attempt."""
         now = time.monotonic()
         fired: List[Dict] = []
         with self._lock:
             for spec in self.specs:
+                if spec["side"] != side:
+                    continue
                 if spec["kind"] not in ("*", kind):
                     continue
                 if spec["op"] == "stall":
@@ -136,6 +163,7 @@ class FaultSchedule:
 
     def _fire(self, spec: Dict, kind: str, now: float) -> Dict:
         action = {"op": spec["op"], "kind": kind, "t": now,
+                  "side": spec["side"],
                   "seconds": float(spec["seconds"])}
         self.injected.append(dict(action))
         return action
@@ -147,19 +175,28 @@ class FaultSchedule:
 
 
 class FaultyFrameConnection(FrameConnection):
-    """A :class:`FrameConnection` whose sends pass through a
-    :class:`FaultSchedule`.  Receives are untouched — injecting at the
-    sender exercises the RECEIVER's real parsing/staleness/failover
-    paths, which is the point."""
+    """A :class:`FrameConnection` whose sends AND receives pass
+    through a :class:`FaultSchedule`.  Send-side injection exercises
+    the RECEIVER's real parsing/staleness/failover paths; recv-side
+    injection (``side: "recv"`` specs) perturbs frames between the
+    wire and the reader — the only place a reorder can be produced,
+    since TCP delivers send-side frames in order."""
 
     def __init__(self, sock, schedule: FaultSchedule,
                  send_timeout: Optional[float] = 10.0):
         super().__init__(sock, send_timeout=send_timeout)
         self.schedule = schedule
+        # recv-side perturbation state (single reader by protocol
+        # contract, so no lock): frames queued for delivery ahead of
+        # the wire, and reordered frames held back until the NEXT
+        # frame passes them
+        self._recv_ready: List[dict] = []
+        self._recv_held: List[dict] = []
 
+    # ------------------------------------------------------------ send
     def send(self, kind: str, **payload) -> None:
         dup = False
-        for action in self.schedule.actions_for(kind):
+        for action in self.schedule.actions_for(kind, side="send"):
             op = action["op"]
             if op == "delay":
                 # outside the send lock: a delayed frame must not
@@ -170,14 +207,77 @@ class FaultyFrameConnection(FrameConnection):
                 return
             elif op == "dup":
                 dup = True
+            elif op == "error":
+                raise ConnectionError(
+                    "fault injection: errored %s frame" % kind)
             elif op == "tear":
                 self._tear()
                 raise ConnectionError(
                     "fault injection: connection torn mid-frame")
+            # "reorder" is meaningless at send time (TCP re-serializes
+            # it); declare such specs side="recv"
         super().send(kind, **payload)
         if dup:
             logger.debug("fault injection: duplicated %s frame", kind)
             super().send(kind, **payload)
+
+    # ------------------------------------------------------------ recv
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """One frame through the recv-side schedule.  ``reorder`` holds
+        the matching frame until the next one passes it; ``dup``
+        queues a second delivery; ``drop``/``stall`` swallow and read
+        on; ``error``/``tear`` raise into the reader's torn-stream
+        path.  Held frames flush (in held order) after the frame that
+        overtook them, and at EOF — a reorder must delay a frame, not
+        destroy it."""
+        if self._recv_ready:
+            return self._recv_ready.pop(0)
+        while True:
+            frame = super().recv(timeout=timeout)
+            if frame is None:
+                if self._recv_held:
+                    return self._recv_held.pop(0)
+                return None
+            drop = dup = reorder = False
+            for action in self.schedule.actions_for(
+                    str(frame.get("kind")), side="recv"):
+                op = action["op"]
+                if op == "delay":
+                    time.sleep(action["seconds"])
+                elif op in ("drop", "stall"):
+                    drop = True
+                elif op == "dup":
+                    dup = True
+                elif op == "reorder":
+                    reorder = True
+                elif op == "error":
+                    raise ConnectionError(
+                        "fault injection: errored %s frame at recv"
+                        % frame.get("kind"))
+                elif op == "tear":
+                    self.close()
+                    raise ConnectionError(
+                        "fault injection: connection torn at recv")
+            if drop:
+                logger.debug(
+                    "fault injection: swallowed %s frame at recv",
+                    frame.get("kind"))
+                continue
+            if reorder:
+                logger.debug(
+                    "fault injection: holding %s frame back (reorder)",
+                    frame.get("kind"))
+                self._recv_held.append(frame)
+                continue
+            if dup:
+                logger.debug(
+                    "fault injection: duplicated %s frame at recv",
+                    frame.get("kind"))
+                self._recv_ready.append(dict(frame))
+            # the frame that overtakes releases everything held behind
+            self._recv_ready.extend(self._recv_held)
+            self._recv_held.clear()
+            return frame
 
     def _tear(self) -> None:
         """Write HALF a length prefix, then slam the socket shut: the
@@ -191,6 +291,56 @@ class FaultyFrameConnection(FrameConnection):
         except OSError:
             pass
         self.close()
+
+
+class FaultyRpcStub:
+    """Control-plane interposer: an :class:`~dlrover_tpu.common.rpc.
+    RpcStub` (or the Brain's) whose ``get``/``report`` calls pass
+    through a :class:`FaultSchedule`, keyed on the method name.
+
+    Fault mapping, chosen so the retry policy's TRANSIENT/non-transient
+    split is exercised from both sides: ``delay`` sleeps before the
+    call; ``drop``/``tear`` raise ``ConnectionError`` (transient — the
+    call never reached the server, a retry is correct); ``stall``
+    raises ``TimeoutError`` for ``seconds`` after the trigger (the
+    wedged-master window); ``error`` raises ``RuntimeError``
+    (NON-transient — the served-refusal class a retry policy must
+    surface immediately).  Firings land in the shared
+    ``schedule.injected`` ledger, same contract as the frame side."""
+
+    def __init__(self, stub, schedule: FaultSchedule):
+        self._stub = stub
+        self.schedule = schedule
+
+    def _call(self, method: str, fn, payload: bytes, timeout: float):
+        for action in self.schedule.actions_for(method, side="send"):
+            op = action["op"]
+            if op == "delay":
+                time.sleep(action["seconds"])
+            elif op in ("drop", "tear"):
+                raise ConnectionError(
+                    f"fault injection: dropped {method} rpc")
+            elif op == "stall":
+                raise TimeoutError(
+                    f"fault injection: {method} rpc stalled")
+            elif op == "error":
+                raise RuntimeError(
+                    f"fault injection: {method} rpc served an error")
+            # dup/reorder have no RPC meaning (unary round trips)
+        return fn(payload, timeout=timeout)
+
+    def get(self, payload: bytes, timeout: float = 0) -> bytes:
+        return self._call("get", self._stub.get, payload, timeout)
+
+    def report(self, payload: bytes, timeout: float = 0) -> bytes:
+        return self._call("report", self._stub.report, payload, timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._stub.closed
+
+    def close(self) -> None:
+        self._stub.close()
 
 
 def maybe_faulty(sock, schedule: Optional[FaultSchedule],

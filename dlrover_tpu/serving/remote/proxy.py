@@ -112,6 +112,14 @@ class RemoteReplicaHandle:
         self._blocks_free = float(hello.get("blocks_free", 0.0))
         self.block_size = int(hello.get("block_size", 0))
         self.engine_kind = str(hello.get("engine", "?"))
+        # STATS staleness watermark: the worker's generated_tokens
+        # counter is monotonic within a connection, so a STATS carrying
+        # a LOWER value than one already applied arrived out of order
+        # (recv-side reorder, a retransmit artifact) — applying it
+        # would regress the capacity ledger and over-place
+        self._stats_tokens = -1
+        self._stats_seq_seen = 0
+        self.stale_stats_dropped = 0
         self._last_frame = time.monotonic()
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
@@ -161,8 +169,34 @@ class RemoteReplicaHandle:
                         rid=rid, output=list(frame["tokens"]),
                         trace_spans=self._shift_spans(frame, now)))
             elif kind == FrameKind.STATS:
-                self._slots_free = int(frame.get("slots_free", 0))
-                self._blocks_free = float(frame.get("blocks_free", 0.0))
+                seq = frame.get("seq")
+                seq = int(seq) if isinstance(seq, (int, float)) else None
+                gen = frame.get("generated_tokens")
+                gen = int(gen) if isinstance(gen, (int, float)) else None
+                if seq is not None:
+                    # per-send ordinal (current workers): a strict
+                    # total order, so duplicates AND equal-token
+                    # reorders (two snapshots with no decode step
+                    # between them, e.g. around a SUBMIT) are droppable
+                    stale = seq <= self._stats_seq_seen
+                else:
+                    # token watermark fallback (seq-less sender): a
+                    # snapshot older than one already applied must not
+                    # regress the ledger — freed capacity would be
+                    # forgotten or phantom capacity resurrected; equal
+                    # still refreshes (cancels free slots without
+                    # generating)
+                    stale = gen is not None and gen < self._stats_tokens
+                if stale:
+                    self.stale_stats_dropped += 1
+                else:
+                    if seq is not None:
+                        self._stats_seq_seen = seq
+                    if gen is not None:
+                        self._stats_tokens = gen
+                    self._slots_free = int(frame.get("slots_free", 0))
+                    self._blocks_free = float(
+                        frame.get("blocks_free", 0.0))
             elif kind in (FrameKind.SUBMITTED, FrameKind.ERROR):
                 self._submit_replies[int(frame["rid"])] = frame
                 self._submit_cv.notify_all()

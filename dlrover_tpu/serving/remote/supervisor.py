@@ -368,6 +368,27 @@ class WorkerSupervisor:
             respawned += 1
         return respawned
 
+    def capacity_debt(self, now: Optional[float] = None) -> List[dict]:
+        """Fleet capacity currently lost to quarantine — the feed
+        :class:`~dlrover_tpu.serving.router.autoscale.ServingAutoScaler`
+        polls every ``on_step`` to issue replacement-node plans the
+        SAME poll a worker is quarantined (instead of serving traffic
+        one worker short for the whole sentence).  One record per
+        quarantined worker, keyed on the base name so respawn suffixes
+        cannot mint duplicate debts; the record disappears when the
+        worker leaves quarantine (a clean exit retires the debt by
+        itself — no double-provisioning)."""
+        with self._lock:
+            return [
+                {
+                    "key": f"quarantine:{base_replica_name(name)}",
+                    "kind": "quarantine",
+                    "source": name,
+                    "until": record.quarantine_until,
+                }
+                for name, record in self.quarantined.items()
+            ]
+
     def _count_quarantine(self) -> None:
         """Count one quarantine into the router's metric surface
         (``serving_worker_quarantined_total``).  Incremented, not

@@ -19,6 +19,7 @@ the first matching stdout line is ``DLROVER_WORKER_ADDR=<host>:<port>``.
 from __future__ import annotations
 
 import argparse
+import itertools
 import os
 import signal
 import socket
@@ -176,6 +177,15 @@ class WorkerServer:
             slots_free=0, blocks_free=0.0, inflight=0,
             generated_tokens=0,
         )
+        # per-send STATS ordinal: generated_tokens alone cannot order
+        # two snapshots taken without a decode step between them (e.g.
+        # before/after a SUBMIT), so a recv-side reorder could resurrect
+        # a consumed slot.  The lock pins seq order to WIRE order —
+        # an atomic draw alone would let the heartbeat thread and the
+        # serve loop interleave draw and send, handing the higher seq
+        # to the older snapshot
+        self._stats_seq = itertools.count(1)
+        self._stats_seq_lock = threading.Lock()
 
     # ------------------------------------------------------- lifecycle
     def announce(self, stream=None) -> None:
@@ -436,7 +446,16 @@ class WorkerServer:
                 generated_tokens=int(
                     getattr(eng, "generated_tokens", 0)),
             )
-        conn.send(FrameKind.STATS, **self._last_stats_payload)
+        # seq is assigned at SEND time (never stored in the cached
+        # payload): a cached liveness resend carries stale numbers
+        # under a fresh ordinal, same last-send-wins semantics as
+        # before, but now reorderable by the receiver.  Draw + send
+        # share the lock so seq order == wire order (the send itself
+        # is bounded by the connection's send_timeout)
+        with self._stats_seq_lock:
+            # dlint: disable=DL007 serializing the send IS this lock's contract — seq order must equal wire order, and the send is bounded by the connection's send_timeout
+            conn.send(FrameKind.STATS, seq=next(self._stats_seq),
+                      **self._last_stats_payload)
 
 
 def _build_llama_engine(args) -> object:

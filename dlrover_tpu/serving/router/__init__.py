@@ -17,15 +17,21 @@ Layers (one module each):
 - :mod:`autoscale` — queue/TTFT/throughput signals -> Brain plan ->
   ScalePlan through a cluster Scaler, plus the provisioner closing the
   loop from cluster node events back to router membership;
+- :mod:`brownout`  — per-priority brown-out shedding: watermark +
+  hysteresis ladder that sheds BATCH before NORMAL, never HIGH;
 - :mod:`metrics`   — Prometheus gauges/counters for all of the above;
 - :mod:`router`    — the orchestrating pump.
 """
 
+from dlrover_tpu.serving.router.brownout import (  # noqa: F401
+    BrownoutPolicy,
+)
 from dlrover_tpu.serving.router.gateway import (  # noqa: F401
     PRIORITY_BATCH,
     PRIORITY_HIGH,
     PRIORITY_NORMAL,
     STREAM_RESTART,
+    BrownoutShedError,
     QueueFullError,
     RequestGateway,
     ServingRequest,

@@ -21,6 +21,23 @@ finishes its in-flight requests inside the router pump, and only the
 DRAINED husk's node is removed from the cluster — no request is ever
 cut off by a scale decision.
 
+**Capacity debt (self-healing).**  The load-driven policy reacts to
+QUEUES; a quarantined crash-looper or a probationary replica is lost
+capacity the queue only reveals minutes later.  So the autoscaler also
+polls a *capacity-debt* feed every ``on_step`` — quarantined workers
+from the :class:`~dlrover_tpu.serving.remote.supervisor.
+WorkerSupervisor` (``supervisor=``), probationary replicas from the
+router's :class:`~.replica.ReplicaManager` — and issues a
+replacement-node ``ScalePlan`` (a ``launch_nodes`` entry, outside the
+cooldown gate) the SAME poll a debt appears, instead of serving
+short-handed through the quarantine window.  Each debt retires exactly
+once: when its replacement replica joins the router, or when the
+source clears first (quarantine served, probation cooled, worker
+exited cleanly) — never both, so a healed fleet is not
+double-provisioned.  Open debts surface as the
+``serving_capacity_debt`` gauge and as ``capacity_debt_opened`` /
+``capacity_debt_retired`` flight-recorder events.
+
 Every executed scale decision also opens a control-plane **autoscale
 trace** (served at ``/traces/autoscale``): marker spans for the
 load-window snapshot, the policy verdict and the ScalePlan emission at
@@ -29,7 +46,10 @@ recorder's fabric-event vocabulary as the decision materializes —
 ``node_create`` (provisioner) → ``worker_spawn`` (supervisor) →
 ``hello_join`` (router) → ``probation`` (if damped) →
 ``first_placement`` (the new replica takes traffic); scale-downs trace
-``drain`` → ``retired`` per victim.  Each milestone span runs from the
+``drain`` → ``retired`` per victim.  Replacement decisions get their
+own always-sampled trace whose root carries ``replacement_for`` (the
+quarantined worker / probationary replica being backfilled), stitched
+through the same milestones.  Each milestone span runs from the
 previous milestone, so the trace reads as "where did the 9 seconds
 between 'queue too deep' and 'new replica serving' actually go".
 """
@@ -44,6 +64,12 @@ from dlrover_tpu.common.constants import NodeEventType, NodeType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
 from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.serving.router.replica import base_replica_name
+
+# replacement nodes get ids/ranks from this offset so they can never
+# collide with group-fill ranks, and group-count shrinks (which drop
+# the highest ranks first) retire replacements before steady nodes
+_REPLACEMENT_RANK_BASE = 9000
 
 
 class ServingAutoScaler:
@@ -55,6 +81,7 @@ class ServingAutoScaler:
         scaler: Scaler,
         policy: Optional[ServingScalePolicy] = None,
         brain=None,                    # BrainClient-like (serving_plan)
+        supervisor=None,               # WorkerSupervisor-like (debt feed)
         job_name: str = "serving",
         node_type: str = NodeType.SERVING_REPLICA,
         node_resource: Optional[NodeResource] = None,
@@ -66,6 +93,7 @@ class ServingAutoScaler:
         self.scaler = scaler
         self.policy = policy or ServingScalePolicy()
         self.brain = brain
+        self.supervisor = supervisor
         self.job_name = job_name
         self.node_type = node_type
         self.node_resource = node_resource or NodeResource()
@@ -75,14 +103,28 @@ class ServingAutoScaler:
         self._samples: List[ServingSignal] = []
         self._last_sample = 0.0
         self._last_scale = 0.0
-        self._next_node_id = 0
         # replicas this autoscaler asked to drain, by name -> their Node
         self._pending_removal: Dict[str, Optional[Node]] = {}
         self.plans: List[ScalePlan] = []
+        # capacity debt: key -> {kind, source, replacement, node,
+        # opened_at, retired}; a retired entry lingers until its source
+        # clears so one quarantine episode can never open two debts —
+        # UNLESS the joined replacement itself dies while the source is
+        # still out, which reopens the episode (see _sweep)
+        self.debts: Dict[str, dict] = {}
+        # replacements the policy itself drained (never a reopen cue)
+        self._policy_drained: set = set()
+        self.capacity_debt_retired = 0
+        self._next_replacement = 0
         # control-plane tracing: one autoscale trace per executed
-        # decision, milestones stitched from flight-recorder events
+        # decision (policy episode OR replacement), milestones stitched
+        # from flight-recorder events.  _open_traces holds every trace
+        # still materializing; _scale_trace points at the policy
+        # episode's record (replans merge into it, a new episode
+        # supersedes it) — replacement traces live only in the list.
         self.tracer = getattr(router, "tracer", None)
         self.recorder = getattr(router, "recorder", None)
+        self._open_traces: List[dict] = []
         self._scale_trace: Optional[dict] = None
         self._event_cursor = (
             self.recorder.last_seq if self.recorder is not None else -1)
@@ -103,9 +145,13 @@ class ServingAutoScaler:
                 tokens_per_sec=m.tokens_per_second(now),
             ))
             del self._samples[: -8 * self.min_samples]
-        self._stitch_scale_trace()
+        self._stitch_scale_traces()
         self._finish_deaths()
         self._finish_drains()
+        # capacity debt runs OUTSIDE the cooldown gate: a quarantine is
+        # known capacity loss, and making it wait out the policy
+        # cooldown is exactly the wait-out this sweep exists to remove
+        self._sweep_capacity_debt(now)
         if now - self._last_scale >= self.cooldown:
             self.maybe_scale(now)
 
@@ -159,17 +205,21 @@ class ServingAutoScaler:
     def _scale_up(self, desired: int) -> ScalePlan:
         # ``desired`` counts UP replicas, but the cluster group still
         # contains draining replicas' nodes until their removal plans
-        # land — the group count must include them or the scaler sees
-        # "already at count" and silently adds nothing (or worse,
-        # shrinks an arbitrary node the policy never chose)
-        count = desired + len(self._pending_removal)
+        # land, plus replacement nodes that have not joined yet — the
+        # group count must include both or the scaler sees "already at
+        # count" and silently adds nothing (or worse, shrinks a node
+        # the policy never chose)
+        count = (desired + len(self._pending_removal)
+                 + self._unjoined_replacements())
         plan = ScalePlan(node_group_resources={
             self.node_type: NodeGroupResource(
                 count=count, node_resource=self.node_resource)
         })
         logger.info(
-            "serving scale-up: -> %d replicas (+%d draining)",
-            desired, len(self._pending_removal))
+            "serving scale-up: -> %d replicas (+%d draining, "
+            "+%d replacements in flight)",
+            desired, len(self._pending_removal),
+            self._unjoined_replacements())
         self.plans.append(plan)
         self.scaler.scale(plan)
         return plan
@@ -193,6 +243,15 @@ class ServingAutoScaler:
             )
             self.router.begin_drain(handle.name)
             self._pending_removal[handle.name] = handle.node
+            # a drained replacement must not reopen its capacity debt:
+            # the policy decided the fleet is big enough WITH the
+            # source still out, so its disappearance is not a new loss
+            # (only debt replacements are tracked, base-normalized;
+            # entries are pruned when their debt closes)
+            base = base_replica_name(handle.name)
+            if any(d["replacement"] == base
+                   for d in self.debts.values()):
+                self._policy_drained.add(base)
         return ScalePlan()  # removal plan follows once drained
 
     def _finish_deaths(self) -> None:
@@ -243,6 +302,209 @@ class ServingAutoScaler:
         except Exception:  # telemetry only; never blocks the loop
             pass
 
+    # --------------------------------------------------- capacity debt
+    def _debt_sources(self, now: float) -> Dict[str, dict]:
+        """Current capacity-loss feed: supervisor quarantines + replica
+        probations, keyed for idempotent debt bookkeeping."""
+        sources: Dict[str, dict] = {}
+        if self.supervisor is not None:
+            feed = getattr(self.supervisor, "capacity_debt", None)
+            if feed is not None:
+                for src in feed(now):
+                    sources[src["key"]] = src
+        manager_feed = getattr(self.router.manager, "capacity_debt",
+                               None)
+        if manager_feed is not None:
+            for src in manager_feed(now):
+                sources[src["key"]] = src
+        return sources
+
+    def _replica_bases(self) -> set:
+        """Router replica names normalized to their base — a supervisor
+        respawn rejoins as ``name#rN``, and the debt bookkeeping must
+        recognize it as the same replacement (every other subsystem
+        normalizes through :func:`base_replica_name`)."""
+        return {base_replica_name(n) for n in self.router.replica_names}
+
+    def _unjoined_replacements(self) -> int:
+        bases = self._replica_bases()
+        return sum(
+            1 for d in self.debts.values()
+            if not d["retired"] and d["replacement"] not in bases
+        )
+
+    def _base_has_live_replica(self, key: str, now: float) -> bool:
+        """True when the debt key's base currently has a schedulable,
+        off-probation replica in the manager — the signal that a
+        probation episode genuinely healed (vs the source merely
+        flickering out during a crash-loop's death gap)."""
+        base = key.split(":", 1)[1] if ":" in key else key
+        for h in self.router.manager.replicas.values():
+            if (base_replica_name(h.name) == base and h.schedulable
+                    and h.probation_until <= now):
+                return True
+        return False
+
+    def _drop_debt(self, key: str) -> None:
+        debt = self.debts.pop(key)
+        self._policy_drained.discard(debt["replacement"])
+
+    @staticmethod
+    def _debt_base(key: str) -> str:
+        return key.split(":", 1)[1] if ":" in key else key
+
+    def _sweep_capacity_debt(self, now: float) -> None:
+        """Reconcile open debts against the feed: retire each debt
+        exactly once (replacement joined, or source cleared — whichever
+        comes first), open a debt + replacement plan for every NEW
+        source, and publish the ``serving_capacity_debt`` gauge.
+
+        Debt identity is the BASE, not the feed key: one lost worker is
+        one backfill, even as it moves between feeds across its
+        crash-loop life (``probation:<base>`` while it respawns,
+        ``quarantine:<base>`` when the budget blows — both can even
+        surface in the same poll while a dead respawn awaits reaping).
+        The feed is first collapsed to one source per base (quarantine
+        outranks probation as the authoritative, longer-lived record),
+        and an existing episode follows its base across keys instead of
+        a second node being launched."""
+        sources = self._debt_sources(now)
+        bases = self._replica_bases()
+        per_base: Dict[str, dict] = {}
+        for src in sources.values():
+            b = self._debt_base(src["key"])
+            cur = per_base.get(b)
+            if cur is None or (cur.get("kind") != "quarantine"
+                               and src.get("kind") == "quarantine"):
+                per_base[b] = src
+        for base, debt in [(self._debt_base(k), d)
+                           for k, d in list(self.debts.items())]:
+            src = per_base.get(base)
+            key = debt["key"]
+            if src is not None and src["key"] != key:
+                # the base moved between feeds: ONE episode, rekeyed
+                old_key = key
+                del self.debts[old_key]
+                debt["key"] = key = src["key"]
+                debt["kind"] = src.get("kind", debt["kind"])
+                debt["source"] = src.get("source", debt["source"])
+                self.debts[key] = debt
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "capacity_debt_rekeyed", key=key,
+                        from_key=old_key,
+                        replacement=debt["replacement"], now=now)
+                logger.info(
+                    "capacity debt %s continues as %s (replacement "
+                    "%s) — one lost worker is one backfill, not two",
+                    old_key, key, debt["replacement"])
+            if not debt["retired"]:
+                if debt["replacement"] in bases:
+                    self._retire_debt(debt, "replacement_joined", now)
+                elif src is None:
+                    self._retire_debt(debt, "source_cleared", now)
+            if debt["retired"] and src is None:
+                # the source is gone.  Quarantine feeds are
+                # authoritative (the supervisor holds the record for
+                # the whole sentence), but a PROBATION source flickers
+                # out during every crash-loop death gap — deleting the
+                # entry there would launch a fresh replacement node per
+                # respawn cycle, one flapping pod provisioning
+                # max_respawns surplus nodes.  So a probation episode
+                # only closes when the base demonstrably healed (a
+                # live off-probation replica); until then the entry
+                # lingers and the next flap reuses it.
+                if (debt["kind"] != "probation"
+                        or self._base_has_live_replica(key, now)):
+                    self._drop_debt(key)
+            elif (debt["retired"] and src is not None
+                  and debt.get("retired_reason") == "replacement_joined"
+                  and debt["replacement"] not in bases
+                  and debt["replacement"] not in self._policy_drained):
+                # the replacement JOINED and then DIED while the source
+                # is still out: the loss is back, and the lingering
+                # retired entry would otherwise block a backfill for
+                # the rest of the quarantine window — drop it so this
+                # same sweep opens a fresh debt.  (A policy-drained
+                # replacement is exempt: deliberate shrink, not a new
+                # loss.  A replacement that never joined is NOT
+                # reopened — its launch plan is still in flight and the
+                # provisioner retries the join; reopening would
+                # double-provision the common slow-provision case.)
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "capacity_debt_reopened", key=key,
+                        lost_replacement=debt["replacement"], now=now)
+                logger.warning(
+                    "capacity debt %s: replacement %s died while %s is "
+                    "still out of service — reopening the debt",
+                    key, debt["replacement"], debt["source"])
+                self._drop_debt(key)
+        open_bases = {self._debt_base(k) for k in self.debts}
+        for base, src in per_base.items():
+            if base not in open_bases:
+                self._open_debt(src["key"], src, now)
+        metrics = getattr(self.router, "metrics", None)
+        if metrics is not None:
+            metrics.capacity_debt = float(sum(
+                1 for d in self.debts.values() if not d["retired"]))
+
+    def _open_debt(self, key: str, src: dict, now: float) -> None:
+        """A new capacity loss: issue the replacement-node plan NOW (a
+        ``launch_nodes`` entry — no waiting for load signals or the
+        policy cooldown) and open its always-sampled autoscale trace
+        with ``replacement_for`` naming what it backfills."""
+        n = self._next_replacement
+        self._next_replacement += 1
+        node = Node(
+            self.node_type,
+            _REPLACEMENT_RANK_BASE + n,
+            rank_index=_REPLACEMENT_RANK_BASE + n,
+            name=f"{self.node_type}-replacement-{n}",
+            config_resource=self.node_resource,
+        )
+        self.debts[key] = {
+            "key": key, "kind": src.get("kind", "?"),
+            "source": src.get("source", "?"),
+            "replacement": node.name, "node": node,
+            "opened_at": now, "retired": False,
+        }
+        plan = ScalePlan(launch_nodes=[node])
+        self.plans.append(plan)
+        self.scaler.scale(plan)
+        if self.recorder is not None:
+            self.recorder.record(
+                "capacity_debt_opened", key=key,
+                debt_kind=src.get("kind", "?"),
+                source=src.get("source", "?"),
+                replacement=node.name, now=now)
+        logger.warning(
+            "capacity debt: %s (%s) is out of service — replacement "
+            "node %s launched immediately (debt retires when it joins "
+            "or the source recovers)",
+            src.get("source", "?"), src.get("kind", "?"), node.name)
+        self._trace_replacement(now, src, node)
+
+    def _retire_debt(self, debt: dict, reason: str, now: float) -> None:
+        debt["retired"] = True
+        debt["retired_reason"] = reason
+        self.capacity_debt_retired += 1
+        if self.recorder is not None:
+            self.recorder.record(
+                "capacity_debt_retired", key=debt["key"],
+                source=debt["source"], replacement=debt["replacement"],
+                reason=reason, now=now)
+        logger.info(
+            "capacity debt for %s retired (%s)", debt["source"], reason)
+        if reason == "source_cleared":
+            # the source healed before the replacement joined: close
+            # the replacement trace now — its milestones stopped
+            # mattering, and the surplus node drains via the policy
+            for st in list(self._open_traces):
+                if st.get("only") == {debt["replacement"]} \
+                        and not st["placed"]:
+                    self._close_trace(st, "source_cleared", now)
+
     # ------------------------------------------- control-plane tracing
     # the stage each fabric event advances a NEW replica to; spans run
     # from the previous milestone so stage-to-stage latency is visible
@@ -255,7 +517,7 @@ class ServingAutoScaler:
 
     def _trace_decision(self, now: float, current: int, desired: int,
                         plan: ScalePlan) -> None:
-        """Open the decision's autoscale trace (always sampled:
+        """Open the policy decision's autoscale trace (always sampled:
         control-plane traces are one-per-decision, never hot-path)."""
         if self.tracer is None:
             return
@@ -269,7 +531,8 @@ class ServingAutoScaler:
             st["plans"] += 1
             st["root"].attrs["plans"] = st["plans"]
             return
-        self._close_scale_trace("superseded", now)
+        if st is not None:
+            self._close_trace(st, "superseded", now)
         tracer = self.tracer
         root = tracer.start_trace(
             "autoscale", now=now, always_sample=True,
@@ -293,7 +556,7 @@ class ServingAutoScaler:
                 g.count for g in plan.node_group_resources.values()),
             remove_nodes=len(plan.remove_nodes),
         ).finish(now)
-        self._scale_trace = {
+        record = {
             "root": root, "direction": direction, "desired": desired,
             "decided_at": now, "plans": 1,
             # replicas that existed at decision time: anything ELSE
@@ -303,10 +566,55 @@ class ServingAutoScaler:
             "expected_new": max(0, desired - current),
             "victims": set(self._pending_removal),
             "retired": set(),
+            # None = claim any unknown name not owned by a
+            # replacement trace; replacement traces pin their name
+            "only": None,
         }
+        self._scale_trace = record
+        self._open_traces.append(record)
 
-    def _stitch_scale_trace(self) -> None:
-        """Consume new flight-recorder events into the open autoscale
+    def _trace_replacement(self, now: float, src: dict,
+                           node: Node) -> None:
+        """Open a replacement decision's autoscale trace: root carries
+        ``replacement_for``, the marker span records the debt evidence,
+        and stitching is pinned to the replacement node's name."""
+        if self.tracer is None:
+            return
+        tracer = self.tracer
+        current = self.router.manager.up_count()
+        root = tracer.start_trace(
+            "autoscale", now=now, always_sample=True,
+            current=current, desired=current + 1, direction="up",
+            replacement_for=src.get("source", "?"),
+            debt_kind=src.get("kind", "?"))
+        tracer.start_span(
+            root, "capacity_debt", now=now,
+            key=src.get("key", "?"), kind=src.get("kind", "?"),
+            source=src.get("source", "?"),
+            until=float(src.get("until", now))).finish(now)
+        tracer.start_span(
+            root, "scale_plan", now=now, count=1, remove_nodes=0,
+            replacement=node.name).finish(now)
+        self._open_traces.append({
+            "root": root, "direction": "up", "desired": current + 1,
+            "decided_at": now, "plans": 1,
+            "known": set(), "stage_t": {}, "stages": {},
+            "placed": set(), "expected_new": 1,
+            "victims": set(), "retired": set(),
+            "only": {node.name},
+        })
+
+    def _claimed_names(self) -> set:
+        """Names pinned by replacement traces — the generic policy
+        trace must not stitch THEIR milestones as its own."""
+        claimed: set = set()
+        for st in self._open_traces:
+            if st.get("only"):
+                claimed |= st["only"]
+        return claimed
+
+    def _stitch_scale_traces(self) -> None:
+        """Consume new flight-recorder events into every open autoscale
         trace — the cross-component stitch: provisioner node creation,
         supervisor worker spawn, router join/probation/first placement
         all narrate through the recorder, and this turns their
@@ -316,22 +624,28 @@ class ServingAutoScaler:
         events = self.recorder.events_since(self._event_cursor)
         if events:
             self._event_cursor = max(e["seq"] for e in events)
-        st = self._scale_trace
-        if st is None or self.tracer is None:
+        if not self._open_traces or self.tracer is None:
             return
+        claimed = self._claimed_names()
         for event in events:
-            if st["direction"] == "up":
-                self._stitch_up(st, event)
-            else:
-                self._stitch_down(st, event)
-            if self._scale_trace is None:  # closed mid-batch
-                return
+            for st in list(self._open_traces):
+                if st["direction"] == "up":
+                    self._stitch_up(st, event, claimed)
+                else:
+                    self._stitch_down(st, event)
 
-    def _stitch_up(self, st: dict, event: dict) -> None:
+    def _stitch_up(self, st: dict, event: dict, claimed: set) -> None:
         kind = str(event.get("kind"))
         name = event.get("replica") or event.get("worker") \
             or event.get("node")
         if not name or name in st["known"]:
+            return
+        only = st.get("only")
+        if only is not None:
+            if name not in only:
+                return
+        elif name in claimed:
+            # a replacement trace owns this name's story
             return
         t = float(event.get("t", st["decided_at"]))
         if kind == "replica_probation":
@@ -355,7 +669,7 @@ class ServingAutoScaler:
         if stage == "first_placement":
             st["placed"].add(name)
             if len(st["placed"]) >= st["expected_new"]:
-                self._close_scale_trace("ok", end)
+                self._close_trace(st, "ok", end)
 
     def _stitch_down(self, st: dict, event: dict) -> None:
         kind = str(event.get("kind"))
@@ -383,14 +697,18 @@ class ServingAutoScaler:
         if stage == "retired":
             st["retired"].add(name)
             if st["retired"] >= st["victims"]:
-                self._close_scale_trace("ok", end)
+                self._close_trace(st, "ok", end)
 
-    def _close_scale_trace(self, status: str,
-                           now: Optional[float] = None) -> None:
-        st = self._scale_trace
-        if st is None or self.tracer is None:
+    def _close_trace(self, st: dict, status: str,
+                     now: Optional[float] = None) -> None:
+        if self.tracer is None:
             return
-        self._scale_trace = None
+        try:
+            self._open_traces.remove(st)
+        except ValueError:
+            return  # already closed (stitch + retire racing one step)
+        if st is self._scale_trace:
+            self._scale_trace = None
         end = max(st["decided_at"],
                   st["decided_at"] if now is None else now)
         self.tracer.finish_trace(st["root"], now=end, status=status)

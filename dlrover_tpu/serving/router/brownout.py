@@ -1,0 +1,152 @@
+"""Per-priority brown-out shedding: degrade in ordered stages, never
+all bands at once.
+
+Under sustained overload the gateway's queue bound eventually rejects
+EVERYTHING equally — a HIGH-priority request is as likely to bounce as
+a BATCH backfill job, which inverts the whole point of priority bands.
+The brown-out controller watches a capacity watermark on the router
+(queued demand vs. schedulable slot capacity) and degrades in ordered
+stages, always protecting HIGH:
+
+====== ===================== =======================================
+stage  name                  what sheds
+====== ===================== =======================================
+0      ``normal``            nothing
+1      ``shed_batch``        NEW BATCH admissions rejected at the door
+2      ``cancel_batch``      \\+ queued AND in-flight BATCH
+                             expiry-cancelled through the PR-5 cancel
+                             machinery (slots + paged KV reclaimed for
+                             the surviving bands)
+3      ``shed_normal``       \\+ NEW NORMAL admissions rejected
+====== ===================== =======================================
+
+Transitions are hysteresis-guarded: escalation needs the pressure
+above ``enter_pressure`` continuously for ``dwell_seconds``,
+de-escalation needs it below ``exit_pressure`` (< enter — the
+hysteresis band) for ``dwell_seconds``, and both move ONE stage per
+transition, so a noisy load signal cannot flap the fleet between "all
+good" and "shedding NORMAL".  Recovery walks the stages back down the
+same ladder.
+
+Every transition emits a ``brownout_stage`` flight-recorder event and
+updates the ``serving_brownout_stage`` gauge; the router owns the
+sweep (decide under the step lock, CANCEL frames delivered after its
+release — the DL007 discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+STAGE_NORMAL = 0
+STAGE_SHED_BATCH = 1
+STAGE_CANCEL_BATCH = 2
+STAGE_SHED_NORMAL = 3
+
+STAGE_NAMES = {
+    STAGE_NORMAL: "normal",
+    STAGE_SHED_BATCH: "shed_batch",
+    STAGE_CANCEL_BATCH: "cancel_batch",
+    STAGE_SHED_NORMAL: "shed_normal",
+}
+
+
+class BrownoutPolicy:
+    """Watermark + hysteresis state machine over the router's load.
+
+    ``pressure`` is queued demand per schedulable decode slot
+    (``inf`` when demand exists but no replica is schedulable — a
+    fully-quarantined fleet is maximal pressure, not zero).  The
+    policy object is pure bookkeeping: the ROUTER computes the inputs
+    under its step lock and applies the stage's consequences; this
+    class only decides what stage the fleet is in."""
+
+    def __init__(
+        self,
+        enter_pressure: float = 4.0,
+        exit_pressure: float = 1.0,
+        dwell_seconds: float = 1.0,
+    ):
+        if exit_pressure >= enter_pressure:
+            raise ValueError(
+                "exit_pressure must be below enter_pressure "
+                f"(hysteresis band): {exit_pressure} >= {enter_pressure}")
+        self.enter_pressure = float(enter_pressure)
+        self.exit_pressure = float(exit_pressure)
+        self.dwell_seconds = float(dwell_seconds)
+        self.stage = STAGE_NORMAL
+        self.pressure = 0.0
+        #: (stage_from, stage_to, t, pressure) per transition
+        self.transitions = []
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+
+    # ---------------------------------------------------------- inputs
+    @staticmethod
+    def compute_pressure(queued_demand: int, capacity: float) -> float:
+        """Watermark input: demand per schedulable slot."""
+        if queued_demand <= 0:
+            return 0.0
+        if capacity <= 0:
+            return float("inf")
+        return float(queued_demand) / float(capacity)
+
+    # ---------------------------------------------------------- update
+    def update(self, now: float, queued_demand: int,
+               capacity: float) -> int:
+        """One watermark observation; returns the (possibly changed)
+        stage.  Pure arithmetic — safe under the router's step lock."""
+        p = self.compute_pressure(queued_demand, capacity)
+        self.pressure = p
+        if p >= self.enter_pressure:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if self.stage < STAGE_SHED_NORMAL and \
+                    now - self._above_since >= self.dwell_seconds:
+                self._transition(self.stage + 1, now)
+                self._above_since = now  # next stage needs a new dwell
+        elif p <= self.exit_pressure:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if self.stage > STAGE_NORMAL and \
+                    now - self._below_since >= self.dwell_seconds:
+                self._transition(self.stage - 1, now)
+                self._below_since = now
+        else:
+            # inside the hysteresis band: hold the stage, reset both
+            # dwell clocks — neither escalation nor recovery is earned
+            self._above_since = None
+            self._below_since = None
+        return self.stage
+
+    def _transition(self, to_stage: int, now: float) -> None:
+        self.transitions.append((self.stage, to_stage, now,
+                                 self.pressure))
+        self.stage = to_stage
+
+    # ----------------------------------------------------- consequences
+    def sheds_priority(self, priority: int) -> bool:
+        """Should a NEW admission of ``priority`` be rejected at the
+        current stage?  HIGH (priority 0) is never shed — that is the
+        contract the stages exist to keep."""
+        from dlrover_tpu.serving.router.gateway import (
+            PRIORITY_BATCH,
+            PRIORITY_NORMAL,
+        )
+
+        if priority == PRIORITY_BATCH:
+            return self.stage >= STAGE_SHED_BATCH
+        if priority == PRIORITY_NORMAL:
+            return self.stage >= STAGE_SHED_NORMAL
+        return False
+
+    @property
+    def cancels_batch(self) -> bool:
+        """Stage 2+: queued and in-flight BATCH are expiry-cancelled."""
+        return self.stage >= STAGE_CANCEL_BATCH
+
+    @property
+    def stage_name(self) -> str:
+        return STAGE_NAMES.get(self.stage, str(self.stage))

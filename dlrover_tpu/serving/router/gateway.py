@@ -45,6 +45,13 @@ class QueueFullError(AdmissionError):
     """Bounded queue at capacity — shed load upstream."""
 
 
+class BrownoutShedError(AdmissionError):
+    """The brown-out controller is shedding this priority band — the
+    fleet is degrading in ORDER (BATCH first, then NORMAL, HIGH never)
+    instead of letting the queue bound bounce all bands equally.
+    Retry later, or resubmit at a higher priority if the work is."""
+
+
 class RequestTimedOut(RuntimeError):
     """Raised by :meth:`ServingRequest.result` for an expired request."""
 
@@ -272,6 +279,16 @@ class RequestGateway:
         self.timed_out = 0
         self.poisoned = 0
         self.cancelled = 0
+        # brown-out controller (serving/router/brownout.BrownoutPolicy),
+        # attached by the router when per-priority shedding is armed;
+        # None = every band admits normally.  Consulted read-only here —
+        # the ROUTER updates its stage under the step lock.
+        self.brownout = None
+        # per-priority admissions refused by the brown-out (index =
+        # priority band) — introspection for tests/dashboards; shed
+        # requests also count into ``rejected`` (they were refused at
+        # the door, the accounting identity must keep balancing)
+        self.shed_by_priority = [0 for _ in _PRIORITIES]
 
     # ----------------------------------------------------------- admit
     def submit(
@@ -306,6 +323,16 @@ class RequestGateway:
         now = time.monotonic() if now is None else now
         timeout = self.default_timeout if timeout is None else timeout
         with self._lock:
+            brownout = self.brownout
+            if brownout is not None and brownout.sheds_priority(priority):
+                # ordered degradation: this band is browned out while
+                # higher bands still admit — a refusal here IS the
+                # mechanism protecting HIGH, not a capacity accident
+                self.rejected += 1
+                self.shed_by_priority[priority] += 1
+                raise BrownoutShedError(
+                    f"priority {priority} shed at brown-out stage "
+                    f"{brownout.stage} ({brownout.stage_name})")
             if self.depth() >= self.max_pending:
                 self.rejected += 1
                 raise QueueFullError(
@@ -493,6 +520,33 @@ class RequestGateway:
             if dump and req.trace is not None:
                 self.tracer.flight_dump(
                     "cancelled", req.trace.trace_id, now=now)
+        return taken
+
+    def shed_queued(self, priority: int,
+                    now: Optional[float] = None,
+                    dump: bool = True) -> List[ServingRequest]:
+        """Brown-out stage 2: expiry-cancel every QUEUED request of
+        ``priority`` (the band being browned out), aborting each as
+        ``CANCELLED`` through the same machinery a caller withdrawal
+        uses — the caller's ``result()`` raises promptly instead of
+        aging toward its deadline in a queue that will never drain.
+        Same deferral contract as :meth:`expire`."""
+        taken: List[ServingRequest] = []
+        with self._lock:
+            q = self._queues[priority]
+            if q:
+                for req in q:
+                    req.abort(ServingRequestState.CANCELLED)
+                    taken.append(req)
+                    self.cancelled += 1
+                self._queues[priority] = deque()
+        for req in taken:
+            self.tracer.recorder.record(
+                "brownout_shed_queued", rid=req.rid,
+                priority=priority, now=now)
+            if dump and req.trace is not None:
+                self.tracer.flight_dump(
+                    "brownout_shed", req.trace.trace_id, now=now)
         return taken
 
     def depth(self, priority: Optional[int] = None) -> int:

@@ -59,6 +59,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from dlrover_tpu.common.retry import retry_metrics
 from dlrover_tpu.utils.profiler import (
     Histogram,
     StepTimer,
@@ -80,6 +81,13 @@ class RouterMetrics:
         self.replica_up = 0.0
         self.replica_draining = 0.0
         self.replica_probation = 0.0
+        # brown-out ladder position (0 normal .. 3 shed_normal),
+        # written by the router's watermark sweep each step
+        self.brownout_stage = 0.0
+        # capacity debts currently open (quarantined workers /
+        # probationary replicas awaiting their replacement), written by
+        # the autoscaler's debt sweep
+        self.capacity_debt = 0.0
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -168,6 +176,10 @@ class RouterMetrics:
     def metrics(self) -> Dict[str, float]:
         """The Prometheus source (``MetricsExporter.add_source``)."""
         return {
+            # process-wide control-plane retry counter (common/retry
+            # owns the metric name): master + Brain RPC retries under
+            # the backoff policy
+            **retry_metrics(),
             "serving_queue_depth": self.queue_depth,
             "serving_inflight": self.inflight,
             "serving_replica_up": self.replica_up,
@@ -189,6 +201,8 @@ class RouterMetrics:
             "serving_worker_quarantined_total": float(
                 self.worker_quarantined),
             "serving_replica_probation": self.replica_probation,
+            "serving_brownout_stage": self.brownout_stage,
+            "serving_capacity_debt": self.capacity_debt,
         }
 
     def render_histograms(self) -> str:

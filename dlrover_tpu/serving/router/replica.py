@@ -532,12 +532,30 @@ class ReplicaManager:
 
     def probation_count(self, now: Optional[float] = None) -> int:
         """Replicas currently held out of placement by probation — the
-        ``serving_replica_probation`` gauge."""
+        ``serving_replica_probation`` gauge.  Defined as the size of the
+        capacity-debt feed so the gauge and the autoscaler can never
+        disagree about what counts as probationary."""
+        return len(self.capacity_debt(now))
+
+    def capacity_debt(self, now: Optional[float] = None) -> List[dict]:
+        """Capacity currently lost to crash-loop probation — the feed
+        the autoscaler polls to backfill a cooling-down replica with a
+        replacement node instead of serving short-handed through the
+        cooldown.  One record per probationary replica, keyed on the
+        base name (respawn generations share one debt); the record
+        disappears when the cooldown elapses or the replica dies, so
+        an unreplaced debt retires by itself."""
         now = time.monotonic() if now is None else now
-        return sum(
-            1 for h in self.replicas.values()
+        return [
+            {
+                "key": f"probation:{base_replica_name(h.name)}",
+                "kind": "probation",
+                "source": h.name,
+                "until": h.probation_until,
+            }
+            for h in self.replicas.values()
             if h.schedulable and h.probation_until > now
-        )
+        ]
 
     # --------------------------------------------------------- health
     def reap_dead(self, now: Optional[float] = None

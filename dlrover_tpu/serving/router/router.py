@@ -37,6 +37,7 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.router.gateway import (
+    PRIORITY_BATCH,
     PRIORITY_NORMAL,
     RequestGateway,
     ServingRequest,
@@ -82,6 +83,7 @@ class ServingRouter:
         manager: Optional[ReplicaManager] = None,
         metrics: Optional[RouterMetrics] = None,
         cancel_inflight_on_expiry: bool = False,
+        brownout=None,
     ):
         # policy knob: when True, a request whose deadline passes MID-
         # GENERATION is aborted and a CANCEL is sent to its replica so
@@ -91,6 +93,14 @@ class ServingRouter:
         # answer may still be useful to a caller polling result()
         self.cancel_inflight_on_expiry = bool(cancel_inflight_on_expiry)
         self.gateway = gateway or RequestGateway()
+        # per-priority brown-out controller (brownout.BrownoutPolicy):
+        # when armed, the step loop drives its watermark and applies
+        # the stage's shedding — BATCH admissions refused first, then
+        # in-flight BATCH cancelled, then NORMAL refused; HIGH never.
+        # None (default) keeps the historical all-bands-equal behavior.
+        self.brownout = brownout
+        if brownout is not None:
+            self.gateway.brownout = brownout
         self.scheduler = scheduler or ContinuousBatchScheduler()
         self.manager = manager or ReplicaManager()
         self.metrics = metrics or RouterMetrics()
@@ -223,6 +233,13 @@ class ServingRouter:
                     cancels.append((handle, erid))
                     if req.trace is not None:
                         dumps.append((reason, req.trace.trace_id))
+            # 1c. brown-out watermark + per-priority shedding: DECIDE
+            # the stage under the step lock (pure arithmetic over the
+            # live ledgers), queue the band's CANCEL deliveries for
+            # after release exactly like the expiry sweep above —
+            # BATCH sheds first, then NORMAL; HIGH is never touched
+            if self.brownout is not None:
+                self._brownout_sweep(now, cancels, dumps)
             self.metrics.cancelled = self.gateway.cancelled
             self.metrics.timed_out = self.gateway.timed_out
 
@@ -394,6 +411,59 @@ class ServingRouter:
                 "step (first %d emitted)", n, reason,
                 self.MAX_DUMPS_PER_STEP)
         return completed
+
+    def _brownout_sweep(self, now: float, cancels: List[tuple],
+                        dumps: List[tuple]) -> None:
+        """One brown-out round (step lock held by the caller): update
+        the watermark, record stage transitions, and at stage 2+
+        expiry-cancel queued and in-flight BATCH through the cancel
+        machinery — decisions here, deliveries after lock release via
+        ``cancels`` (a remote CANCEL is a frame send; DL003/DL007)."""
+        capacity = 0.0
+        for handle in self.manager.schedulable(now):
+            try:
+                capacity += handle.slots_free() + len(handle.inflight)
+            except Exception:
+                continue  # a dying replica's ledger is not capacity
+        prev = self.brownout.stage
+        stage = self.brownout.update(now, self.gateway.depth(), capacity)
+        if stage != prev:
+            pressure = self.brownout.pressure
+            self.recorder.record(
+                "brownout_stage", stage=stage, prev=prev,
+                name=self.brownout.stage_name,
+                pressure=(round(pressure, 3)
+                          if pressure != float("inf") else "inf"),
+                now=now)
+            log = logger.warning if stage > prev else logger.info
+            log(
+                "brown-out stage %d -> %d (%s): pressure %.3g, "
+                "queue depth %d, capacity %.0f slots",
+                prev, stage, self.brownout.stage_name,
+                self.brownout.pressure, self.gateway.depth(), capacity)
+        self.metrics.brownout_stage = float(stage)
+        if not self.brownout.cancels_batch:
+            return
+        # stage 2+: the BATCH band drains NOW — queued requests answer
+        # their callers instead of aging out, in-flight ones return
+        # their slots and paged KV blocks to the surviving bands
+        for req in self.gateway.shed_queued(
+                PRIORITY_BATCH, now=now, dump=False):
+            if req.trace is not None:
+                dumps.append(("brownout_shed", req.trace.trace_id))
+        for handle in self.manager.pumpable():
+            for erid, req in list(handle.inflight.items()):
+                if req.priority != PRIORITY_BATCH:
+                    continue
+                del handle.inflight[erid]
+                req.abort(ServingRequestState.CANCELLED)
+                self.gateway.cancelled += 1
+                self.recorder.record(
+                    "brownout_cancel_inflight", rid=req.rid,
+                    replica=handle.name, now=now)
+                cancels.append((handle, erid))
+                if req.trace is not None:
+                    dumps.append(("brownout_shed", req.trace.trace_id))
 
     def _record_ttft(self, req: ServingRequest, now: float) -> None:
         if req.first_token_at is not None and not req.ttft_recorded:

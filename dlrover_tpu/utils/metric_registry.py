@@ -75,6 +75,21 @@ METRIC_HELP: Dict[str, str] = {
         "replicas currently held out of placement by crash-loop "
         "probation (joined, cooling down before schedulable again)"
     ),
+    "serving_brownout_stage": (
+        "per-priority brown-out ladder position: 0 normal, 1 new "
+        "BATCH admissions shed, 2 queued+in-flight BATCH cancelled, "
+        "3 new NORMAL admissions shed too — HIGH is never shed"
+    ),
+    "serving_capacity_debt": (
+        "capacity debts currently open: quarantined workers or "
+        "probationary replicas whose replacement node has been "
+        "launched but has not joined yet — each retires exactly once"
+    ),
+    "serving_rpc_retries_total": (
+        "control-plane RPC retries under the typed backoff policy "
+        "(common/retry) — a rising value under a steady fleet says "
+        "the master/Brain link is flaky, not that calls are failing"
+    ),
     # -- per-request span tracing (utils/tracing.Tracer.metrics) -------
     "serving_request_trace_finished_total": (
         "request traces completed into the tracer's bounded ring"

@@ -638,7 +638,64 @@ def test_pod_and_service_carry_owner_ref_and_service_retries():
     scaler.scale(plan)
     assert scaler.create_pending_pods() == 1
     assert api.services == {}  # first create bounced
+    # the retry is BACKED OFF, not re-knocked next tick: an immediate
+    # pass must defer it (a ~4s blip cannot burn the whole cap)
+    scaler.create_pending_pods()
+    assert api.services == {}
+    assert len(scaler._svc_pending) == 1
+    scaler._svc_next_try.clear()  # backoff elapsed
     scaler.create_pending_pods()  # creator-loop pass retries the Service
     assert "gcjob-worker-0" in api.services
     assert api.services["gcjob-worker-0"]["metadata"][
         "ownerReferences"][0]["uid"] == "cr-uid-1"
+    # a successful create clears the per-node retry ledger
+    assert scaler._svc_retries == {}
+    assert scaler.svc_give_ups == 0
+
+
+def test_service_create_gives_up_after_capped_retries():
+    """A PERSISTENTLY failing Service create (RBAC denial, quota,
+    admission webhook) must not grow the retry list one entry per
+    creator tick forever: after MAX_SVC_RETRIES consecutive failures
+    the scaler gives up loudly and counts it, and the retry list is
+    empty — the unbounded-growth regression (ISSUE 8 satellite)."""
+
+    class DeniedServiceApi(FakePodApi):
+        def __init__(self):
+            super().__init__()
+            self.attempts = 0
+
+        def create_namespaced_service(self, namespace, body):
+            self.attempts += 1
+            raise RuntimeError("forbidden: RBAC says no")
+
+    api = DeniedServiceApi()
+    scaler = PodScaler("jobx", api=api, image="img")
+    scaler.SVC_RETRY_BACKOFF_BASE = 0.0  # tight-loop ticks in the test
+    plan = ScalePlan()
+    plan.launch_nodes = [Node("worker", 0, rank_index=0)]
+    scaler.scale(plan)
+    # drive the creator loop well past the cap
+    for _ in range(PodScaler.MAX_SVC_RETRIES * 2):
+        scaler.create_pending_pods()
+    assert api.attempts == PodScaler.MAX_SVC_RETRIES, \
+        "retries must stop at the cap, not continue forever"
+    assert scaler.svc_give_ups == 1
+    assert scaler._svc_pending == [], "no zombie retry entries"
+    assert scaler._svc_retries == {}
+    # an AlreadyExists outcome also clears any retry bookkeeping
+
+    class ConflictServiceApi(FakePodApi):
+        def create_namespaced_service(self, namespace, body):
+            e = RuntimeError("AlreadyExists")
+            e.status = 409
+            raise e
+
+    api2 = ConflictServiceApi()
+    scaler2 = PodScaler("jobx", api=api2, image="img")
+    plan2 = ScalePlan()
+    plan2.launch_nodes = [Node("worker", 1, rank_index=1)]
+    scaler2.scale(plan2)
+    scaler2.create_pending_pods()
+    assert scaler2._svc_pending == [] and scaler2._svc_retries == {}
+    assert scaler2.svc_give_ups == 0

@@ -711,6 +711,430 @@ def test_probation_blocks_placement_until_cooldown():
     assert "replica_probation" in kinds
 
 
+# -- recv-side frame faults (ISSUE 8) ----------------------------------------
+
+
+def test_fault_schedule_side_field_and_new_ops_validate():
+    # side defaults to send (back-compat) and validates
+    sched = FaultSchedule([{"op": "drop"}])
+    assert sched.specs[0]["side"] == "send"
+    with pytest.raises(ValueError):
+        FaultSchedule([{"op": "drop", "side": "middle"}])
+    # recv-side specs never fire at the send hook and vice versa
+    sched = FaultSchedule([
+        {"op": "drop", "kind": "TOKEN", "side": "recv"},
+        {"op": "dup", "kind": "TOKEN", "side": "send"},
+    ])
+    assert [a["op"] for a in sched.actions_for("TOKEN")] == ["dup"]
+    assert [a["op"] for a in sched.actions_for("TOKEN", side="recv")] \
+        == ["drop"]
+    # the ledger records which hook fired
+    assert {e["side"] for e in sched.injected} == {"send", "recv"}
+
+
+def test_recv_reorder_token_after_done_is_dropped(workers):
+    """A TOKEN frame overtaken by its own DONE (recv-side ``reorder``
+    on the proxy's real reader thread) must be dropped by the
+    staleness guard — the authoritative DONE output wins, and an
+    out-of-order frame is noise, not a replica death."""
+    sched = FaultSchedule([
+        {"op": "reorder", "kind": "TOKEN", "side": "recv",
+         "after": 2, "count": 2},
+    ], seed=21)
+    w = workers(slots=2, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("ro", w.proxy("ro", fault_schedule=sched))
+    reqs = [router.submit(_prompt(i), 8) for i in range(4)]
+    _drive(router)
+    assert sched.fired("reorder"), "the reorder must actually fire"
+    for r in reqs:
+        assert r.state == ServingRequestState.DONE
+        assert r.result(timeout=0).size == 8, \
+            "DONE's authoritative output must survive the reorder"
+    assert router.replica_names == ["ro"]
+    assert router.metrics.metrics()[
+        "serving_requests_requeued_total"] == 0
+
+
+def test_recv_duplicated_done_is_ignored(workers):
+    """A DONE delivered twice to the reader (recv-side ``dup``) must
+    complete the request exactly once: the second copy's rid is gone
+    from the in-flight set and is silently dropped."""
+    sched = FaultSchedule([
+        {"op": "dup", "kind": "DONE", "side": "recv",
+         "after": 1, "count": 2},
+    ], seed=22)
+    w = workers(slots=2, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("dd", w.proxy("dd", fault_schedule=sched))
+    reqs = [router.submit(_prompt(i), 8) for i in range(3)]
+    _drive(router)
+    assert sched.fired("dup")
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    m = router.metrics.metrics()
+    assert m["serving_requests_completed_total"] == 3, \
+        "a duplicated DONE must not double-complete"
+    assert router.replica_names == ["dd"]
+
+
+def test_recv_stale_stats_cannot_regress_ledger(workers):
+    """STATS arriving out of order (recv-side ``reorder``) must not
+    regress the proxy's capacity ledger: the worker's monotonic
+    ``generated_tokens`` counter is the staleness watermark, and an
+    older snapshot is dropped by the REAL parsing path
+    (``RemoteReplicaHandle._dispatch``)."""
+    sched = FaultSchedule([
+        {"op": "reorder", "kind": "STATS", "side": "recv",
+         "after": 3, "count": 3},
+    ], seed=23)
+    w = workers(slots=4, tokens_per_step=2)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    proxy = w.proxy("st", fault_schedule=sched)
+    router.join_replica("st", proxy)
+    reqs = [router.submit(_prompt(i), 8) for i in range(8)]
+    _drive(router)
+    assert sched.fired("reorder")
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    # the ledger converges to the true free capacity despite reorders
+    deadline = time.monotonic() + 5.0
+    while proxy.slots_free() < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert proxy.slots_free() == 4
+    # the guard itself, through the real parser: an older snapshot
+    # (lower generated_tokens) must lose to a newer one
+    proxy._dispatch({"kind": "STATS", "slots_free": 1,
+                     "blocks_free": 8.0, "generated_tokens": 10**9})
+    assert proxy.slots_free() == 1
+    proxy._dispatch({"kind": "STATS", "slots_free": 4,
+                     "blocks_free": 999.0, "generated_tokens": 5})
+    assert proxy.slots_free() == 1, \
+        "a stale STATS must not resurrect phantom capacity"
+    assert proxy.stale_stats_dropped >= 1
+    # an EQUAL watermark is a legitimate refresh (cancel frees slots
+    # without generating tokens)
+    proxy._dispatch({"kind": "STATS", "slots_free": 2,
+                     "blocks_free": 16.0, "generated_tokens": 10**9})
+    assert proxy.slots_free() == 2
+
+
+def test_stats_seq_orders_equal_token_snapshots(workers):
+    """The token watermark cannot order two snapshots taken without a
+    decode step between them (before/after a SUBMIT both carry the
+    same ``generated_tokens``), so workers stamp a per-send ``seq``:
+    a reorder of equal-token STATS must keep the NEWER snapshot and a
+    duplicate must not re-apply — through the real parsing path."""
+    w = workers(slots=4, tokens_per_step=2)
+    proxy = w.proxy("seq")
+    # the LIVE stream already proves workers stamp seq: wait for one
+    deadline = time.monotonic() + 5.0
+    while proxy._stats_seq_seen == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert proxy._stats_seq_seen > 0, "workers must stamp STATS seq"
+    # quiesce the worker so synthetic frames can't race real ones
+    w.stop()
+    base = proxy._stats_seq_seen
+    drops = proxy.stale_stats_dropped
+    # worker sends A (4 slots, base+1), accepts a SUBMIT, sends B
+    # (3 slots, base+2) — same generated_tokens; recv reorders B, A
+    proxy._dispatch({"kind": "STATS", "slots_free": 3,
+                     "blocks_free": 8.0, "generated_tokens": 100,
+                     "seq": base + 2})
+    assert proxy._slots_free == 3
+    proxy._dispatch({"kind": "STATS", "slots_free": 4,
+                     "blocks_free": 9.0, "generated_tokens": 100,
+                     "seq": base + 1})
+    assert proxy._slots_free == 3, \
+        "an equal-token reorder must not resurrect the consumed slot"
+    assert proxy.stale_stats_dropped == drops + 1
+    # a duplicated delivery of the applied snapshot is also stale
+    proxy._dispatch({"kind": "STATS", "slots_free": 3,
+                     "blocks_free": 8.0, "generated_tokens": 100,
+                     "seq": base + 2})
+    assert proxy.stale_stats_dropped == drops + 2
+    # and a genuinely newer snapshot still lands
+    proxy._dispatch({"kind": "STATS", "slots_free": 1,
+                     "blocks_free": 4.0, "generated_tokens": 102,
+                     "seq": base + 3})
+    assert proxy._slots_free == 1
+    # seq-less sender (fallback): token watermark still guards
+    proxy._dispatch({"kind": "STATS", "slots_free": 9,
+                     "blocks_free": 99.0, "generated_tokens": 5})
+    assert proxy._slots_free == 1
+    assert proxy.stale_stats_dropped == drops + 3
+
+
+# -- control-plane fault tolerance (ISSUE 8) ---------------------------------
+
+
+def _manual_clock():
+    state = {"t": 0.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        state["t"] += s
+
+    return state, sleeps, sleep
+
+
+def test_retry_policy_deterministic_backoff_and_deadline():
+    from dlrover_tpu.common.retry import RetryPolicy
+
+    state, sleeps, sleep = _manual_clock()
+    pol = RetryPolicy(
+        max_attempts=10, backoff_base=0.5, backoff_multiplier=2.0,
+        backoff_max=8.0, deadline=10.0, jitter=0.25, seed=42,
+        sleep=sleep, clock=lambda: state["t"])
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("master down")
+
+    with pytest.raises(ConnectionError):
+        pol.call(always_down, what="probe")
+    # the total DEADLINE bites before the attempt budget: every sleep
+    # fit inside the budget, and the refused next delay would not have
+    assert sum(sleeps) <= 10.0
+    assert calls["n"] < 10, \
+        "the deadline must stop retrying before the attempt budget"
+    # exponential: each jittered delay sits in [base*2^n, base*2^n*1.25]
+    for i, s in enumerate(sleeps):
+        lo = min(8.0, 0.5 * (2 ** i))
+        assert lo <= s <= lo * 1.25, (i, s)
+    # deterministic under the seed: an identical policy replays the
+    # exact schedule
+    state2, sleeps2, sleep2 = _manual_clock()
+    pol2 = RetryPolicy(
+        max_attempts=10, backoff_base=0.5, backoff_multiplier=2.0,
+        backoff_max=8.0, deadline=10.0, jitter=0.25, seed=42,
+        sleep=sleep2, clock=lambda: state2["t"])
+    with pytest.raises(ConnectionError):
+        pol2.call(always_down, what="probe")
+    assert sleeps2 == sleeps
+
+
+def test_retry_policy_does_not_retry_non_transient():
+    import grpc
+
+    from dlrover_tpu.common.retry import (
+        RetryPolicy,
+        is_transient,
+        retries_total,
+    )
+
+    # classification: transport errors are transient, served errors not
+    class _Rpc(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    assert is_transient(_Rpc(grpc.StatusCode.UNAVAILABLE))
+    assert is_transient(_Rpc(grpc.StatusCode.DEADLINE_EXCEEDED))
+    assert not is_transient(_Rpc(grpc.StatusCode.INVALID_ARGUMENT))
+    assert is_transient(ConnectionError("x"))
+    assert is_transient(TimeoutError("x"))
+    assert not is_transient(RuntimeError("master get failed"))
+    assert not is_transient(ValueError("bad request"))
+
+    pol = RetryPolicy(max_attempts=5, backoff_base=0.001, jitter=0.0,
+                      deadline=5.0, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def served_refusal():
+        calls["n"] += 1
+        raise RuntimeError("master get failed")
+
+    before = retries_total()
+    with pytest.raises(RuntimeError):
+        pol.call(served_refusal, what="refused")
+    assert calls["n"] == 1, "a served refusal is an ANSWER, not a blip"
+    assert retries_total() == before, \
+        "non-transient failures are not retries"
+
+
+def test_retry_counter_counts_retries_not_failures():
+    """`serving_rpc_retries_total` sells itself as the control-plane
+    flakiness signal: the final failure that GIVES UP is not followed
+    by a retry, so it must not count — an exhausted call of N failures
+    burned N-1 retries, and a success after one blip counts exactly 1."""
+    from dlrover_tpu.common.retry import RetryPolicy, retries_total
+
+    pol = RetryPolicy(max_attempts=4, backoff_base=0.001, jitter=0.0,
+                      deadline=60.0, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    before = retries_total()
+    with pytest.raises(ConnectionError):
+        pol.call(always_down, what="probe")
+    assert calls["n"] == 4
+    assert retries_total() - before == 3, \
+        "4 failures -> 3 retries (the give-up is not a retry)"
+
+    def flaky_once(state={"n": 0}):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise ConnectionError("blip")
+        return "ok"
+
+    before = retries_total()
+    assert pol.call(flaky_once, what="blip") == "ok"
+    assert retries_total() - before == 1
+
+
+def test_retry_policy_logs_once_per_state_change():
+    import logging
+
+    from dlrover_tpu.common.log import default_logger
+    from dlrover_tpu.common.retry import RetryPolicy
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    pol = RetryPolicy(max_attempts=8, backoff_base=0.001, jitter=0.0,
+                      deadline=5.0, sleep=lambda s: None)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 5:
+            raise ConnectionError(f"blip {state['n']}")
+        return "ok"
+
+    handler = _Capture(level=logging.DEBUG)
+    old_level = default_logger.level
+    default_logger.addHandler(handler)
+    default_logger.setLevel(logging.DEBUG)
+    try:
+        assert pol.call(flaky, what="flaky_rpc") == "ok"
+    finally:
+        default_logger.removeHandler(handler)
+        default_logger.setLevel(old_level)
+    warnings = [r for r in records
+                if r.levelno == logging.WARNING
+                and "flaky_rpc" in r.getMessage()]
+    assert len(warnings) == 1, \
+        "one warning per OUTAGE (4 failures used to mean 4 warnings)"
+    recoveries = [r for r in records
+                  if r.levelno == logging.INFO
+                  and "recovered" in r.getMessage()]
+    assert len(recoveries) == 1
+    debugs = [r for r in records if r.levelno == logging.DEBUG
+              and "still failing" in r.getMessage()]
+    assert len(debugs) == 3, "retries 2..4 log at debug only"
+
+
+def test_retry_rpc_decorator_typed_and_budgeted():
+    from dlrover_tpu.agent.master_client import retry_rpc
+    from dlrover_tpu.common.retry import RetryPolicy
+
+    pol = RetryPolicy(max_attempts=5, backoff_base=0.001, jitter=0.0,
+                      deadline=2.0, sleep=lambda s: None)
+
+    class Client:
+        def __init__(self):
+            self.calls = 0
+            self.hard = False
+
+        @retry_rpc(policy=pol)
+        def ping(self):
+            self.calls += 1
+            if self.hard:
+                raise RuntimeError("served refusal")
+            if self.calls <= 2:
+                raise ConnectionError("down")
+            return "pong"
+
+    c = Client()
+    assert c.ping() == "pong"
+    assert c.calls == 3, "transient failures retried to success"
+    hard = Client()
+    hard.hard = True
+    with pytest.raises(RuntimeError):
+        hard.ping()
+    assert hard.calls == 1, "non-transient errors must NOT retry"
+    assert Client.ping.retry_policy is pol  # introspection seam
+    # the default decorator derives its budget from the legacy knobs
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    default_pol = MasterClient.get_task.retry_policy
+    assert default_pol.deadline == pytest.approx(30.0)
+    assert default_pol.max_attempts == 10
+
+
+def test_faulty_rpc_stub_fault_mapping_and_ledger():
+    from dlrover_tpu.common.retry import RetryPolicy, is_transient
+    from dlrover_tpu.serving.remote.faults import FaultyRpcStub
+
+    class _Transport:
+        def __init__(self):
+            self.calls = []
+            self.closed = False
+
+        def get(self, payload, timeout=0):
+            self.calls.append(("get", payload))
+            return b"g"
+
+        def report(self, payload, timeout=0):
+            self.calls.append(("report", payload))
+            return b"r"
+
+        def close(self):
+            self.closed = True
+
+    sched = FaultSchedule([
+        {"op": "delay", "kind": "get", "after": 1, "seconds": 0.0},
+        {"op": "drop", "kind": "get", "after": 2},
+        {"op": "error", "kind": "report", "after": 1},
+        {"op": "stall", "kind": "report", "after": 2, "seconds": 60.0},
+    ], seed=3)
+    inner = _Transport()
+    stub = FaultyRpcStub(inner, sched)
+    assert stub.get(b"1") == b"g"           # delayed but delivered
+    with pytest.raises(ConnectionError) as drop_exc:
+        stub.get(b"2")                      # dropped: never reached
+    assert is_transient(drop_exc.value), \
+        "a dropped RPC must look transient (retry is correct)"
+    assert stub.get(b"3") == b"g"
+    with pytest.raises(RuntimeError) as err_exc:
+        stub.report(b"a")                   # served an error
+    assert not is_transient(err_exc.value), \
+        "an errored RPC must look non-transient (no retry)"
+    with pytest.raises(TimeoutError):
+        stub.report(b"b")                   # stall window opens
+    with pytest.raises(TimeoutError):
+        stub.report(b"c")                   # ...and persists
+    ops = [(e["op"], e["kind"]) for e in sched.injected]
+    for expected in [("delay", "get"), ("drop", "get"),
+                     ("error", "report"), ("stall", "report")]:
+        assert expected in ops, ops
+    # inert schedules cannot masquerade: the firings ARE the ledger
+    assert len(sched.injected) >= 5
+    stub.close()
+    assert inner.closed and stub.closed
+
+    # the retry policy rides out the transient window end-to-end
+    sched2 = FaultSchedule(
+        [{"op": "drop", "kind": "get", "after": 1, "count": 2}], seed=0)
+    stub2 = FaultyRpcStub(_Transport(), sched2)
+    pol = RetryPolicy(max_attempts=5, backoff_base=0.0, jitter=0.0,
+                      deadline=10.0, sleep=lambda s: None)
+    assert pol.call(stub2.get, b"x", what="get") == b"g"
+    assert len(sched2.fired("drop")) == 2
+
+
 # -- the fast acceptance -----------------------------------------------------
 
 
@@ -907,6 +1331,234 @@ def test_cancellation_and_fault_paths_lock_clean():
         if not by_path[v.path].suppressed(v.code, v.line)
     ]
     assert violations == [], [str(v) for v in violations]
+
+
+# -- the self-healing acceptance (ISSUE 8) -----------------------------------
+
+
+def test_self_healing_acceptance_fast():
+    """THE ISSUE-8 acceptance, in-thread on a synthetic clock: 2 of 6
+    workers crash-loop into quarantine while seeded RPC faults hit the
+    Brain link and a demand spike hits the gateway.  Replacement
+    replicas are provisioned within ONE autoscale poll of each
+    quarantine (no waiting out the sentence), capacity debt retires
+    exactly once per quarantine, the brown-out sheds BATCH before
+    NORMAL and never HIGH (zero HIGH requests lost or poisoned), and
+    the books balance."""
+    from dlrover_tpu.brain.serving import ServingScalePolicy
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.common.node import Node
+    from dlrover_tpu.scheduler.in_memory import (
+        InMemoryCluster,
+        InMemoryNodeWatcher,
+        InMemoryScaler,
+    )
+    from dlrover_tpu.serving.remote.faults import FaultyRpcStub
+    from dlrover_tpu.serving.router import (
+        PRIORITY_BATCH,
+        PRIORITY_HIGH,
+        PRIORITY_NORMAL,
+        BrownoutPolicy,
+        BrownoutShedError,
+        ReplicaProvisioner,
+        RouterMetrics,
+        ServingAutoScaler,
+    )
+
+    bo = BrownoutPolicy(enter_pressure=2.0, exit_pressure=0.5,
+                        dwell_seconds=0.5)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        metrics=RouterMetrics(window_seconds=0.5),
+        brownout=bo,
+    )
+    cluster = InMemoryCluster()
+    scaler = InMemoryScaler(cluster)
+    provisioner = ReplicaProvisioner(
+        router, InMemoryNodeWatcher(cluster),
+        engine_factory=lambda node: FakeEngine(
+            slots=2, tokens_per_step=2))
+
+    # seeded control-plane faults on the Brain link: two dropped
+    # serving_plan queries, a stalled one, errored telemetry reports —
+    # the autoscale loop must ride them out on the local policy
+    rpc_sched = FaultSchedule([
+        {"op": "drop", "kind": "get", "after": 1, "count": 2},
+        {"op": "stall", "kind": "get", "after": 5, "seconds": 0.2},
+        {"op": "error", "kind": "report", "after": 1, "count": 3},
+    ], seed=9)
+
+    class _Transport:
+        closed = False
+
+        def get(self, payload, timeout=0):
+            return b"ok"
+
+        def report(self, payload, timeout=0):
+            return b"ok"
+
+        def close(self):
+            pass
+
+    faulty_stub = FaultyRpcStub(_Transport(), rpc_sched)
+
+    class _Brain:
+        def serving_plan(self, **query):
+            faulty_stub.get(b"serving_plan")
+            return None  # defer to the local policy
+
+        def record_serving(self, **report):
+            faulty_stub.report(b"record_serving")
+
+    sup = _StubSupervisor(
+        router=router, respawn=True, max_respawns=2,
+        respawn_window=300.0, backoff_base=0.2, backoff_max=2.0,
+        backoff_jitter=0.25, quarantine_seconds=120.0, seed=13,
+        recorder=router.recorder)
+    auto = ServingAutoScaler(
+        router, scaler,
+        policy=ServingScalePolicy(min_replicas=1, max_replicas=8,
+                                  queue_high=2.0, queue_low=0.0),
+        brain=_Brain(), supervisor=sup,
+        decide_interval=0.0, cooldown=0.5, min_samples=1)
+
+    # a 6-replica fleet through the cluster, 2 of them backed by
+    # supervised worker processes that are about to crash-loop
+    for i in range(6):
+        cluster.create_node(
+            Node(NodeType.SERVING_REPLICA, i, rank_index=i))
+    provisioner.poll()
+    assert router.manager.up_count() == 6
+    loopers = ("serving-replica-4", "serving-replica-5")
+    for name in loopers:
+        sup.spawn(name=name)
+
+    t = time.monotonic()
+    # the demand spike: long requests so the overload outlives the
+    # quarantine episode and the brown-out ladder has time to climb
+    high = [router.submit(_prompt(i), 32, priority=PRIORITY_HIGH,
+                          now=t) for i in range(20)]
+    normal = [router.submit(_prompt(i), 32, priority=PRIORITY_NORMAL,
+                            now=t) for i in range(60)]
+    batch = [router.submit(_prompt(i), 32, priority=PRIORITY_BATCH,
+                           now=t) for i in range(80)]
+    admitted = high + normal + batch
+    # one placement round so the doomed replicas hold REAL in-flight
+    # work, then they die mid-spike: failover requeues it while the
+    # supervisor meters their crash loop
+    router.step(now=t)
+    assert all(router.manager.get(n).inflight for n in loopers)
+    for name in loopers:
+        router.fail_replica(name)
+
+    shed_probe = {"batch": None, "normal": None, "high_after": None}
+    max_stage = 0
+    for _ in range(500):
+        t += 0.05
+        _crash_current(sup)       # every live looper crashes again
+        sup.poll(now=t)
+        router.step(now=t)
+        provisioner.poll(timeout=0.001)
+        max_stage = max(max_stage, bo.stage)
+        if bo.stage >= 1 and shed_probe["batch"] is None:
+            try:
+                router.submit(_prompt(200), 4,
+                              priority=PRIORITY_BATCH, now=t)
+                shed_probe["batch"] = False
+            except BrownoutShedError:
+                shed_probe["batch"] = True
+        if bo.stage >= 3 and shed_probe["normal"] is None:
+            try:
+                router.submit(_prompt(201), 4,
+                              priority=PRIORITY_NORMAL, now=t)
+                shed_probe["normal"] = False
+            except BrownoutShedError:
+                shed_probe["normal"] = True
+            # HIGH admits at the DEEPEST brown-out stage
+            probe_high = router.submit(
+                _prompt(202), 4, priority=PRIORITY_HIGH, now=t)
+            admitted.append(probe_high)
+            high.append(probe_high)
+            shed_probe["high_after"] = True
+        if (len(sup.quarantined) == 2
+                and auto.capacity_debt_retired >= 2
+                and not router.has_work and bo.stage == 0):
+            break
+
+    # the chaos all actually happened
+    assert len(sup.quarantined) == 2, \
+        "both crash-loopers must end in quarantine"
+    assert rpc_sched.fired("drop") and rpc_sched.fired("error"), \
+        "the RPC faults must actually have fired"
+    assert max_stage == 3, "the brown-out ladder must reach stage 3"
+    assert bo.stage == 0, "recovery must walk the ladder back down"
+    assert not router.has_work
+
+    # replacement within ONE autoscale poll: each quarantine's debt
+    # opens at the SAME recorder timestamp the quarantine fired
+    events = router.recorder.events(1024)
+    quarantines = {e["worker"]: e for e in events
+                   if e["kind"] == "worker_quarantined"}
+    debts_opened = {e["key"]: e for e in events
+                    if e["kind"] == "capacity_debt_opened"}
+    assert len(quarantines) == 2 and len(debts_opened) == 2
+    for worker, q in quarantines.items():
+        key = f"quarantine:{base_replica_name(worker)}"
+        assert key in debts_opened, (key, list(debts_opened))
+        assert debts_opened[key]["t"] == q["t"], \
+            "the replacement plan must be issued the same poll"
+
+    # capacity debt retired EXACTLY once per quarantine, by the
+    # replacement joining (the sentence is 120s — never waited out)
+    retired = [e for e in events if e["kind"] == "capacity_debt_retired"]
+    assert len(retired) == 2
+    assert auto.capacity_debt_retired == 2
+    assert all(e["reason"] == "replacement_joined" for e in retired)
+    assert router.metrics.metrics()["serving_capacity_debt"] == 0.0
+    # ...and the replacements took real traffic
+    for e in retired:
+        handle = router.manager.get(e["replacement"])
+        assert handle is not None, e["replacement"]
+        assert handle.ever_placed, \
+            f"replacement {e['replacement']} never served"
+
+    # shed ORDER: BATCH refused first, NORMAL only at stage 3, HIGH
+    # admitted at every stage and NEVER lost or poisoned
+    assert shed_probe["batch"] is True
+    assert shed_probe["normal"] is True
+    assert shed_probe["high_after"] is True
+    gw = router.gateway
+    assert gw.shed_by_priority[PRIORITY_HIGH] == 0
+    assert gw.shed_by_priority[PRIORITY_BATCH] >= 1
+    assert gw.shed_by_priority[PRIORITY_NORMAL] >= 1
+    for r in high:
+        assert r.state == ServingRequestState.DONE, (r.rid, r.state)
+    # the first stage-2 sweep cancelled BATCH before touching NORMAL:
+    # every brown-out cancellation is a BATCH request
+    shed_events = [e for e in events
+                   if e["kind"] == "brownout_shed_queued"]
+    assert shed_events
+    assert {e["priority"] for e in shed_events} == {PRIORITY_BATCH}
+
+    # books balance: every admitted request is DONE or CANCELLED (no
+    # deadlines armed -> no expiry), nothing poisoned, counters agree
+    done = sum(1 for r in admitted
+               if r.state == ServingRequestState.DONE)
+    cancelled = sum(1 for r in admitted
+                    if r.state == ServingRequestState.CANCELLED)
+    assert done + cancelled == len(admitted), [
+        (r.rid, r.state) for r in admitted
+        if r.state not in (ServingRequestState.DONE,
+                           ServingRequestState.CANCELLED)]
+    m = router.metrics.metrics()
+    assert m["serving_requests_completed_total"] == done
+    assert m["serving_requests_cancelled_total"] == cancelled
+    assert m["serving_requests_poisoned_total"] == 0
+    assert m["serving_requests_timed_out_total"] == 0
+    assert gw.submitted == done + cancelled
+    assert m["serving_worker_quarantined_total"] == 2.0
+    assert m["serving_requests_requeued_total"] >= 1, \
+        "the replica deaths must have exercised failover"
 
 
 # -- subprocess acceptance (slow) --------------------------------------------

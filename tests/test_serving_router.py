@@ -847,6 +847,487 @@ def test_drain_racing_delivery_is_not_a_failover():
     assert router.gateway.depth() == 1
 
 
+# -- ISSUE 8: capacity debt -> replacement-node autoscaling -----------------
+
+
+class _DebtFeed:
+    """Stands in for a WorkerSupervisor's quarantine feed: tests put
+    debt records in, the autoscaler polls them out."""
+
+    def __init__(self):
+        self.records = []
+
+    def capacity_debt(self, now=None):
+        return list(self.records)
+
+
+def test_quarantine_debt_issues_replacement_same_poll():
+    """The tentpole contract: a quarantined worker becomes a
+    replacement-node ScalePlan on the SAME autoscale poll — no waiting
+    out the quarantine window, no waiting for load signals — and the
+    debt retires exactly once when the replacement joins."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    auto.on_step(t)  # baseline: no debt, no replacement plans
+    assert not [p for p in auto.plans if p.launch_nodes]
+
+    feed.records.append({
+        "key": "quarantine:w4", "kind": "quarantine",
+        "source": "w4", "until": t + 120.0,
+    })
+    auto.on_step(t + 0.05)  # the poll that learns of the quarantine
+    launch = [p for p in auto.plans if p.launch_nodes]
+    assert len(launch) == 1, \
+        "the replacement plan must be issued the same poll"
+    replacement = launch[0].launch_nodes[0].name
+    assert auto.debts["quarantine:w4"]["replacement"] == replacement
+    assert router.metrics.metrics()["serving_capacity_debt"] == 1.0
+    kinds = [e["kind"] for e in router.recorder.events(64)]
+    assert "capacity_debt_opened" in kinds
+
+    provisioner.poll()  # the cluster delivers the node -> replica joins
+    assert replacement in router.replica_names
+    auto.on_step(t + 0.10)
+    assert auto.capacity_debt_retired == 1
+    assert router.metrics.metrics()["serving_capacity_debt"] == 0.0
+    retired = [e for e in router.recorder.events(64)
+               if e["kind"] == "capacity_debt_retired"]
+    assert len(retired) == 1
+    assert retired[0]["reason"] == "replacement_joined"
+
+    # the quarantine persists: the SAME episode must not reopen a debt
+    # or launch a second replacement (no double-provisioning)
+    auto.on_step(t + 0.15)
+    auto.on_step(t + 0.20)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+    assert auto.capacity_debt_retired == 1
+
+    # quarantine served: the episode's bookkeeping clears, so a LATER
+    # quarantine of the same worker opens a FRESH debt
+    feed.records.clear()
+    auto.on_step(t + 1.0)
+    assert "quarantine:w4" not in auto.debts
+    feed.records.append({
+        "key": "quarantine:w4", "kind": "quarantine",
+        "source": "w4", "until": t + 300.0,
+    })
+    auto.on_step(t + 1.1)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 2
+
+
+def test_debt_source_clearing_first_retires_without_replacement():
+    """A quarantine that ends (or a worker that exits cleanly) BEFORE
+    the replacement joins retires the debt by itself — exactly once,
+    with no second provisioning and no retire-twice when the surplus
+    replacement node eventually joins anyway."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    feed.records.append({
+        "key": "quarantine:w1", "kind": "quarantine",
+        "source": "w1", "until": t + 5.0,
+    })
+    auto.on_step(t + 0.05)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+    # the worker exits cleanly before its replacement materializes
+    feed.records.clear()
+    auto.on_step(t + 0.10)
+    assert auto.capacity_debt_retired == 1
+    retired = [e for e in router.recorder.events(64)
+               if e["kind"] == "capacity_debt_retired"]
+    assert [e["reason"] for e in retired] == ["source_cleared"]
+    assert router.metrics.metrics()["serving_capacity_debt"] == 0.0
+    # the surplus node still joins (launch plans are not recalled) but
+    # retires NOTHING a second time; the idle policy drains it later
+    provisioner.poll()
+    auto.on_step(t + 0.15)
+    assert auto.capacity_debt_retired == 1
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+
+
+def test_replacement_death_reopens_debt_while_source_still_out():
+    """A retired debt must not be the fleet's last word: if the joined
+    replacement itself dies while the source is still quarantined, the
+    episode reopens and a fresh replacement launches — otherwise the
+    fleet serves short-handed for the rest of the quarantine window
+    with the sweep insisting everything is healed.  A replacement the
+    POLICY drained is exempt (that disappearance was a deliberate
+    shrink, not a new loss)."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    feed.records.append({
+        "key": "quarantine:w9", "kind": "quarantine",
+        "source": "w9", "until": t + 600.0,
+    })
+    auto.on_step(t + 0.05)
+    first = auto.debts["quarantine:w9"]["replacement"]
+    provisioner.poll()
+    auto.on_step(t + 0.10)
+    assert auto.capacity_debt_retired == 1
+
+    # the replacement dies mid-quarantine: reopen + second launch
+    router.fail_replica(first)
+    router.step(now=t + 0.15)  # reap -> the handle leaves the manager
+    assert first not in router.replica_names
+    auto.on_step(t + 0.20)
+    launches = [p for p in auto.plans if p.launch_nodes]
+    assert len(launches) == 2, "the lost replacement must be backfilled"
+    second = auto.debts["quarantine:w9"]["replacement"]
+    assert second != first
+    kinds = [e["kind"] for e in router.recorder.events(128)]
+    assert "capacity_debt_reopened" in kinds
+
+    # second replacement joins -> retires the reopened debt
+    provisioner.poll()
+    auto.on_step(t + 0.25)
+    assert auto.capacity_debt_retired == 2
+
+    # but a POLICY-drained replacement is not a loss: drain it and
+    # sweep again — no third launch
+    auto._policy_drained.add(second)
+    router.begin_drain(second)
+    router.step(now=t + 0.30)
+    auto.on_step(t + 10.0)
+    auto.on_step(t + 20.0)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 2, \
+        "a deliberate shrink must not re-trigger the debt"
+
+
+def test_probation_opens_replacement_debt():
+    """The ReplicaManager side of the feed: a replica held out of
+    placement by crash-loop probation is lost capacity too — the
+    autoscaler backfills it and the debt self-retires when the
+    cooldown elapses."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    t = time.monotonic()
+    victim = router.replica_names[0]
+    router.fail_replica(victim)
+    router.step(now=t + 1.0)         # reaped: short life -> flap 1
+    router.join_replica(f"{victim}#r1", FakeEngine(slots=2),
+                        now=t + 2.0)  # probation (cooldown 2s default)
+    auto.on_step(t + 2.1)
+    launch = [p for p in auto.plans if p.launch_nodes]
+    assert len(launch) == 1, "probation must open a replacement debt"
+    key = f"probation:{victim}"
+    assert key in auto.debts
+    assert auto.debts[key]["kind"] == "probation"
+    # cooldown elapses before the replacement joins: source cleared
+    auto.on_step(t + 10.0)
+    assert auto.capacity_debt_retired == 1
+    assert router.metrics.metrics()["serving_capacity_debt"] == 0.0
+
+
+def test_flapping_base_opens_one_probation_debt_not_one_per_respawn():
+    """A crash-looping replica's probation source flickers OUT during
+    every death gap (the handle is reaped between respawns).  The debt
+    entry must linger through the gap and be reused by the next flap —
+    NOT deleted and reopened, which would launch one surplus
+    replacement node per respawn cycle.  The episode only closes when
+    the base demonstrably heals (a live off-probation replica), after
+    which a genuinely new flap opens a fresh debt."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    t = time.monotonic()
+    victim = router.replica_names[0]
+    router.fail_replica(victim)
+    router.step(now=t + 1.0)                       # flap 1 recorded
+    router.join_replica(f"{victim}#r1", FakeEngine(slots=2),
+                        now=t + 2.0)               # probation ~2s
+    auto.on_step(t + 2.1)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+    key = f"probation:{victim}"
+
+    # death gap: #r1 dies mid-cooldown -> source vanishes
+    router.fail_replica(f"{victim}#r1")
+    router.step(now=t + 2.5)
+    auto.on_step(t + 2.6)
+    assert key in auto.debts, \
+        "the entry must LINGER through the death gap"
+    # flap 2 rejoins on (longer) probation: the entry is reused
+    router.join_replica(f"{victim}#r2", FakeEngine(slots=2),
+                        now=t + 3.0)
+    auto.on_step(t + 3.1)
+    auto.on_step(t + 3.2)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1, \
+        "a flap cycle must not provision a second replacement"
+
+    # the base heals: #r2 outlives its 4s cooldown -> episode closes
+    auto.on_step(t + 7.5)
+    assert key not in auto.debts
+
+    # ...and a LATER fresh flap is a new episode with a new debt
+    # (#r2 dies at 4.8s of life: past its cooldown, but still inside
+    # probation_lifetime so the death counts as a flap)
+    router.fail_replica(f"{victim}#r2")
+    router.step(now=t + 7.8)
+    router.join_replica(f"{victim}#r3", FakeEngine(slots=2),
+                        now=t + 8.0)
+    auto.on_step(t + 8.1)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 2
+
+
+def test_quarantine_adopts_probation_replacement_no_double_provision():
+    """One worker, one backfill across feed kinds: a crash-looper first
+    surfaces as probation:<base> (replacement launched + joined), then
+    blows its respawn budget and surfaces as quarantine:<base> — a
+    DIFFERENT key.  The quarantine debt must adopt the live probation
+    replacement instead of launching a second node."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    victim = router.replica_names[0]
+    router.fail_replica(victim)
+    router.step(now=t + 1.0)
+    router.join_replica(f"{victim}#r1", FakeEngine(slots=2),
+                        now=t + 2.0)               # probation source
+    auto.on_step(t + 2.1)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+    provisioner.poll()                             # replacement joins
+    auto.on_step(t + 2.2)
+    assert auto.capacity_debt_retired == 1
+
+    # the budget blows: worker dies for good, supervisor quarantines it
+    router.fail_replica(f"{victim}#r1")
+    router.step(now=t + 2.5)
+    feed.records.append({
+        "key": f"quarantine:{victim}", "kind": "quarantine",
+        "source": f"{victim}#r1", "until": t + 120.0,
+    })
+    auto.on_step(t + 2.6)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1, \
+        "the quarantine must adopt the live replacement, not launch"
+    assert f"quarantine:{victim}" in auto.debts
+    assert f"probation:{victim}" not in auto.debts
+    kinds = [e["kind"] for e in router.recorder.events(256)]
+    assert "capacity_debt_rekeyed" in kinds
+
+    # sentence served: the adopted episode closes like any quarantine
+    feed.records.clear()
+    auto.on_step(t + 3.0)
+    assert f"quarantine:{victim}" not in auto.debts
+
+
+def test_same_poll_quarantine_and_probation_is_one_debt():
+    """Both feeds can surface the SAME base in one poll (the budget
+    blows while the dead respawn still sits in the manager awaiting
+    reaping: supervisor says quarantine:<base>, manager still says
+    probation:<base>).  The sweep must collapse them to one debt —
+    keyed quarantine, the authoritative record — and stay stable
+    across subsequent polls (no rekey ping-pong, no second node)."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    victim = router.replica_names[0]
+    router.fail_replica(victim)
+    router.step(now=t + 1.0)
+    router.join_replica(f"{victim}#r1", FakeEngine(slots=2),
+                        now=t + 2.0)               # probation source on
+    feed.records.append({
+        "key": f"quarantine:{victim}", "kind": "quarantine",
+        "source": f"{victim}#r1", "until": t + 120.0,
+    })
+    auto.on_step(t + 2.1)                          # both feeds, one poll
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+    assert list(auto.debts) == [f"quarantine:{victim}"]
+    auto.on_step(t + 2.2)
+    auto.on_step(t + 2.3)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1, \
+        "the shadowed probation source must never open a second debt"
+    assert list(auto.debts) == [f"quarantine:{victim}"]
+
+
+def test_replacement_trace_carries_replacement_for():
+    """Replacement decisions get their own always-sampled autoscale
+    trace: root attrs name what it backfills (``replacement_for``) and
+    the stitched milestones cover node_create -> hello_join ->
+    first_placement, closing ok when the replacement takes traffic."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    feed.records.append({
+        "key": "quarantine:w9", "kind": "quarantine",
+        "source": "w9", "until": t + 60.0,
+    })
+    auto.on_step(t + 0.05)
+    replacement = auto.debts["quarantine:w9"]["replacement"]
+    provisioner.poll()
+    # enough work that BOTH replicas get placements (ties go to the
+    # incumbent, so fill its slots too)
+    reqs = [router.submit(_prompt(i), 8) for i in range(6)]
+    for _ in range(80):
+        t += 0.05
+        router.step(now=t)
+        provisioner.poll()
+        if not router.has_work:
+            break
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    traces = router.tracer.traces_named("autoscale", limit=50)
+    rep = [tr for tr in traces
+           if tr["spans"][0]["attrs"].get("replacement_for") == "w9"]
+    assert len(rep) == 1, traces
+    tree = rep[0]
+    assert tree["spans"][0]["attrs"]["debt_kind"] == "quarantine"
+    assert tree["status"] == "ok"
+    names = _span_names(tree)
+    assert "capacity_debt" in names
+    for stage in ("node_create", "hello_join", "first_placement"):
+        spans = [s for s in _spans_named(tree, stage)
+                 if s["attrs"].get("replica") == replacement]
+        assert spans, (stage, names)
+
+
+# -- ISSUE 8: per-priority brown-out ----------------------------------------
+
+
+def test_brownout_policy_hysteresis_and_ladder():
+    from dlrover_tpu.serving.router import BrownoutPolicy
+
+    bo = BrownoutPolicy(enter_pressure=2.0, exit_pressure=0.5,
+                        dwell_seconds=1.0)
+    with pytest.raises(ValueError):
+        BrownoutPolicy(enter_pressure=1.0, exit_pressure=1.0)
+    t = 100.0
+    assert bo.update(t, 40, 4.0) == 0, "escalation needs a dwell"
+    assert bo.update(t + 0.5, 40, 4.0) == 0
+    assert bo.update(t + 1.0, 40, 4.0) == 1
+    assert bo.update(t + 1.5, 40, 4.0) == 1, "one stage per dwell"
+    assert bo.update(t + 2.1, 40, 4.0) == 2
+    assert bo.update(t + 3.2, 40, 4.0) == 3
+    assert bo.update(t + 4.5, 40, 4.0) == 3, "stage 3 is the ceiling"
+    # inside the hysteresis band: hold, and reset both dwell clocks
+    assert bo.update(t + 5.0, 4, 4.0) == 3
+    assert bo.update(t + 9.0, 4, 4.0) == 3
+    # recovery walks DOWN one stage per dwell below the exit watermark
+    assert bo.update(t + 9.5, 1, 4.0) == 3
+    assert bo.update(t + 10.5, 1, 4.0) == 2
+    assert bo.update(t + 11.6, 0, 4.0) == 1
+    assert bo.update(t + 12.7, 0, 4.0) == 0
+    # a dead fleet with demand is MAXIMAL pressure, not zero
+    assert BrownoutPolicy.compute_pressure(5, 0.0) == float("inf")
+    assert BrownoutPolicy.compute_pressure(0, 0.0) == 0.0
+    # the transition log tells the whole ordered story
+    assert [(a, b) for a, b, _, _ in bo.transitions] == [
+        (0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]
+
+
+def test_brownout_sheds_batch_then_normal_never_high():
+    """The ordered-degradation acceptance: stage 1 rejects new BATCH,
+    stage 2 expiry-cancels queued + in-flight BATCH through the cancel
+    machinery, stage 3 rejects NORMAL — HIGH admits and completes
+    through the whole brown-out, and recovery walks the ladder back
+    down."""
+    from dlrover_tpu.serving.router import (
+        BrownoutPolicy,
+        BrownoutShedError,
+    )
+
+    bo = BrownoutPolicy(enter_pressure=2.0, exit_pressure=0.5,
+                        dwell_seconds=1.0)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        brownout=bo,
+    )
+    eng = FakeEngine(slots=2, tokens_per_step=2)
+    t = 1000.0
+    router.join_replica("r0", eng, now=t)
+    high = [router.submit(_prompt(i), 8, priority=PRIORITY_HIGH, now=t)
+            for i in range(4)]
+    normal = [router.submit(_prompt(i), 8, priority=PRIORITY_NORMAL,
+                            now=t) for i in range(8)]
+    batch = [router.submit(_prompt(i), 8, priority=PRIORITY_BATCH,
+                           now=t) for i in range(8)]
+
+    router.step(now=t)
+    assert bo.stage == 0, "no escalation before the dwell"
+    # one in-flight BATCH for stage 2 to reclaim: park it directly on
+    # the replica (the strict-priority queue would never place it
+    # while HIGH/NORMAL wait)
+    handle = router.manager.get("r0")
+    inflight_batch = batch[0]
+    router.gateway.remove(inflight_batch)
+    handle.submit(inflight_batch)
+
+    router.step(now=t + 1.1)
+    assert bo.stage == 1
+    with pytest.raises(BrownoutShedError):
+        router.submit(_prompt(90), 8, priority=PRIORITY_BATCH,
+                      now=t + 1.2)
+    late_normal = router.submit(
+        _prompt(91), 8, priority=PRIORITY_NORMAL, now=t + 1.2)
+
+    router.step(now=t + 2.2)
+    assert bo.stage == 2
+    # queued AND in-flight BATCH are gone: slots + queue space freed
+    for b in batch:
+        assert b.state == ServingRequestState.CANCELLED, b.rid
+    assert inflight_batch.engine_rid not in handle.inflight
+    assert not eng.active or all(
+        rid != inflight_batch.engine_rid for rid in eng.active), \
+        "the engine slot must be reclaimed"
+
+    router.step(now=t + 3.3)
+    assert bo.stage == 3
+    with pytest.raises(BrownoutShedError):
+        router.submit(_prompt(92), 8, priority=PRIORITY_NORMAL,
+                      now=t + 3.4)
+    late_high = router.submit(
+        _prompt(93), 8, priority=PRIORITY_HIGH, now=t + 3.4)
+
+    # drain: HIGH and NORMAL complete, pressure falls, stages recover
+    for i in range(200):
+        t += 0.3
+        router.step(now=t)
+        if not router.has_work and bo.stage == 0:
+            break
+    assert bo.stage == 0, bo.transitions
+    for r in high + [late_high]:
+        assert r.state == ServingRequestState.DONE, (r.rid, r.state)
+    for r in normal + [late_normal]:
+        assert r.state == ServingRequestState.DONE, (r.rid, r.state)
+    # the ladder went up and came back down IN ORDER
+    assert [(a, b) for a, b, _, _ in bo.transitions] == [
+        (0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]
+    # per-band shed accounting: BATCH and NORMAL refused, HIGH never
+    gw = router.gateway
+    assert gw.shed_by_priority[PRIORITY_BATCH] == 1
+    assert gw.shed_by_priority[PRIORITY_NORMAL] == 1
+    assert gw.shed_by_priority[PRIORITY_HIGH] == 0
+    # books balance: every admitted request is DONE or CANCELLED, and
+    # the counters agree with the requests
+    done = sum(1 for r in high + normal + batch
+               + [late_normal, late_high]
+               if r.state == ServingRequestState.DONE)
+    cancelled = sum(1 for r in high + normal + batch
+                    + [late_normal, late_high]
+                    if r.state == ServingRequestState.CANCELLED)
+    assert gw.submitted == done + cancelled
+    m = router.metrics.metrics()
+    assert m["serving_requests_completed_total"] == done
+    assert m["serving_requests_cancelled_total"] == cancelled
+    assert m["serving_requests_rejected_total"] == 2
+    assert m["serving_brownout_stage"] == 0.0
+    # every transition is in the flight recorder
+    stage_events = [e for e in router.recorder.events(256)
+                    if e["kind"] == "brownout_stage"]
+    assert [(e["prev"], e["stage"]) for e in stage_events] == [
+        (0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]
+
+
 def test_transition_spec_is_importable_truth():
     """The DL009 spec in common/constants.py is runtime-checkable: it
     covers every enum state exactly, and terminal means terminal."""
