@@ -100,7 +100,11 @@ def _bench_flash_ckpt(nbytes: int = 1 << 30) -> dict:
             # raw copy of the same bytes taken seconds apart, so the
             # ratio sees the same neighbor load the pause saw — the
             # ratio, not the absolute, is the host-load-proof gate
-            # (VERDICT r4 #5b)
+            # (VERDICT r4 #5b).  The copy also stands in for a training
+            # step's host work, giving the async writer a realistic
+            # overlap window (double-buffered saves hide the shm copy
+            # BEHIND compute; back-to-back saves would only measure the
+            # pipeline barrier).
             t0 = time.perf_counter()
             for arr in state.values():
                 arr.copy()
@@ -110,18 +114,32 @@ def _bench_flash_ckpt(nbytes: int = 1 << 30) -> dict:
                 and ok
             pauses.append(time.perf_counter() - t0)
             ratios.append(pauses[-1] / max(1e-9, memcpys[-1]))
+        # the writer thread must COMMIT step 4 before the restore
+        # measurements read shm (the double-buffered contract: staging
+        # returns immediately; load() flushes, raw handler reads do not)
+        assert ckpt.engine.flush(timeout=120), "async ckpt writer wedged"
         out["ckpt_save_pause_s"] = round(min(pauses), 3)
         out["ckpt_save_pause_worst_s"] = round(max(pauses), 3)
         out["host_memcpy_s"] = round(min(memcpys), 3)
         out["ckpt_pause_memcpy_ratio"] = round(min(ratios), 3)
         # the gate of record: pause within 1.1x a raw memcpy of the same
-        # bytes (path is bandwidth-bound) AND the absolute bar when the
-        # host cooperates
+        # bytes AND the absolute bar.  Since the double-buffered engine
+        # (ISSUE 9) the in-loop pause is the staging hand-off + residual
+        # pipeline wait; the overlapped copy cost is reported honestly
+        # below as ckpt_commit_s — it did not vanish, it moved off the
+        # training loop onto the writer thread.
         out["ckpt_pause_ratio_bar"] = 1.1
         out["ckpt_pause_abs_bar_s"] = 0.26
         out["ckpt_pause_ok"] = bool(
             min(ratios) <= 1.1 and min(pauses) <= 0.26
         )
+        out["ckpt_double_buffered"] = True
+        eng_m = ckpt.engine.ckpt_metrics()
+        out["ckpt_commit_s"] = round(ckpt.engine.last_commit_s, 3)
+        out["ckpt_inloop_pause_total_s"] = round(
+            eng_m["dlrover_ckpt_inloop_pause_seconds_total"], 4)
+        out["ckpt_saves_committed"] = int(
+            eng_m["dlrover_ckpt_saves_committed_total"])
         if not ok:
             return {}
         # cold restore = a freshly restarted process's first load.  The

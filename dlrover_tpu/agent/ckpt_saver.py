@@ -14,6 +14,17 @@ Counterpart of the reference's ``AsyncCheckpointSaver``
 - ``save_shm_to_storage`` is invoked by the elastic agent when workers die
   so the last in-memory checkpoint survives the restart (reference:
   training.py:662-672, ckpt_saver.py:472-494).
+
+Double-buffered read contract (ISSUE 9): the trainer-side engine writes
+generations into TWO shm buffers alternately and publishes each with an
+atomic commit marker (see shm_handler.py).  Every read here goes through
+``SharedMemoryHandler.load_arrays``/``get_meta``, which serve ONLY the
+last committed generation — a trainer killed mid-copy (its write landed
+in the inactive buffer, unpublished) is invisible to the persist path,
+so the storage tier can never absorb a torn shm state.  The per-rank
+shm lock still serializes a whole persist pass against the writer
+thread's publish, so one persisted host shard is always a single
+generation.
 """
 
 from __future__ import annotations
@@ -291,6 +302,10 @@ class AsyncCheckpointSaver:
             logger.warning("no shm state for local rank %s", local_rank)
             return None
         shm_step, leaves, arrays = loaded
+        logger.info(
+            "persisting rank %s shm generation %s (step %s)",
+            local_rank, handler.committed_generation(), shm_step,
+        )
         if shm_step != step:
             logger.warning(
                 "shm holds step %s, requested %s; persisting shm step",

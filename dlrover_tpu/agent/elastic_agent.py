@@ -38,6 +38,8 @@ from dlrover_tpu.common.constants import (
     RendezvousName,
 )
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryPolicy, is_transient
+from dlrover_tpu.utils.tracing import FlightRecorder
 
 
 @dataclasses.dataclass
@@ -94,9 +96,50 @@ class RendezvousResult:
     node_ips: Dict[int, str]
 
 
+class OutageEdge:
+    """healthy -> failing -> recovered edge detector.
+
+    Every master-facing loop in this module logs/accounts ONCE per
+    state change, not once per tick; this is the one shared state
+    machine behind that contract (heartbeat, membership poll,
+    rendezvous poll, rendezvous join retry)."""
+
+    def __init__(self):
+        self.since: Optional[float] = None
+
+    @property
+    def failing(self) -> bool:
+        return self.since is not None
+
+    def fail(self) -> bool:
+        """Record a failure; True exactly once per outage (the edge)."""
+        if self.since is None:
+            self.since = time.monotonic()
+            return True
+        return False
+
+    def recover(self) -> Optional[float]:
+        """Record a success; elapsed outage seconds when this ends an
+        outage, else None."""
+        if self.since is None:
+            return None
+        elapsed = time.monotonic() - self.since
+        self.since = None
+        return elapsed
+
+
 class MasterRendezvousHandler:
     """Joins the master's elastic rendezvous and polls for the comm world
-    (reference: training.py:179-311)."""
+    (reference: training.py:179-311).
+
+    Fault tolerance (ISSUE 9): the poll loop rides out transient master
+    outages (each RPC already retries under ``retry_rpc``'s
+    ``RetryPolicy``; an outage outliving one call's budget is absorbed
+    here until the handler timeout), and every ``rejoin_check_interval``
+    it verifies the master still KNOWS this node — a restarted master
+    answers no, and the handler re-joins instead of polling the fresh
+    master's empty world until timeout.
+    """
 
     def __init__(
         self,
@@ -105,40 +148,132 @@ class MasterRendezvousHandler:
         rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
         local_world_size: int = 1,
         timeout: float = 600.0,
+        rejoin_check_interval: float = 5.0,
+        recorder: Optional[FlightRecorder] = None,
     ):
         self._client = client
         self._node_rank = node_rank
         self._rdzv_name = rdzv_name
         self._local_world_size = local_world_size
         self._timeout = timeout
+        self._rejoin_check_interval = rejoin_check_interval
+        self.recorder = recorder or FlightRecorder()
+        self.rejoins = 0  # lost registrations re-established (lifetime)
         # this host's TPU slice (DCN granule); the master groups
         # admission by it so only COMPLETE slices train
         self._slice_id = int(os.environ.get("DLROVER_SLICE_ID") or 0)
 
-    def next_rendezvous(self) -> RendezvousResult:
+    def _join(self) -> None:
         self._client.join_rendezvous(
             node_rank=self._node_rank,
             local_world_size=self._local_world_size,
             rdzv_name=self._rdzv_name,
             slice_id=self._slice_id,
         )
+        self.recorder.record(
+            "rendezvous_join", rdzv=self._rdzv_name,
+            node_rank=self._node_rank,
+        )
+
+    def next_rendezvous(self) -> RendezvousResult:
         start = time.time()
+        deadline = start + self._timeout
+        outage = OutageEdge()
+        last_join_check = time.time()
+        self._retryable(self._join, deadline)
         while True:
-            rnd, group, world, node_ips = self._client.get_comm_world(
-                self._rdzv_name, self._node_rank
-            )
+            try:
+                rnd, group, world, node_ips = self._client.get_comm_world(
+                    self._rdzv_name, self._node_rank
+                )
+                outage_s = outage.recover()
+                if outage_s is not None:
+                    logger.info(
+                        "rendezvous poll recovered after %.1fs master "
+                        "outage", outage_s,
+                    )
+                    self.recorder.record("master_reconnected",
+                                         where="rendezvous")
+            except Exception as e:
+                # one state-change log per outage; each get_comm_world
+                # already burned a full RetryPolicy budget before
+                # raising, so the cadence here is minutes, not ticks
+                if not is_transient(e):
+                    raise
+                if outage.fail():
+                    logger.warning(
+                        "rendezvous poll failed transiently (%s); "
+                        "holding on until the %.0fs handler timeout",
+                        e, self._timeout,
+                    )
+                    self.recorder.record("master_outage",
+                                         where="rendezvous")
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"rendezvous {self._rdzv_name!r} timed out after "
+                        f"{self._timeout}s (master unreachable)"
+                    ) from e
+                time.sleep(1.0)
+                continue
             if world:
                 if self._node_rank not in world:
                     # completed without us (e.g. we were rounded out by
                     # node_unit); re-join next round
                     raise RendezvousOutError(rnd)
+                self.recorder.record(
+                    "rendezvous_complete", rdzv=self._rdzv_name,
+                    round=rnd, world=sorted(world),
+                )
                 return RendezvousResult(rnd, group, world, node_ips)
-            if time.time() - start > self._timeout:
+            now = time.time()
+            if now - last_join_check >= self._rejoin_check_interval:
+                last_join_check = now
+                try:
+                    joined = self._client.rendezvous_joined(
+                        self._node_rank, self._rdzv_name
+                    )
+                except Exception:
+                    joined = True  # can't tell; keep polling
+                if not joined:
+                    # a restarted master lost our registration: re-join
+                    # (idempotent server-side) or this poll never ends
+                    logger.warning(
+                        "master no longer knows this node's rendezvous "
+                        "join (restarted?); re-joining round",
+                    )
+                    self.rejoins += 1
+                    self.recorder.record(
+                        "rendezvous_rejoin", rdzv=self._rdzv_name,
+                        node_rank=self._node_rank,
+                    )
+                    self._retryable(self._join, deadline)
+            if now > deadline:
                 raise TimeoutError(
                     f"rendezvous {self._rdzv_name!r} timed out after "
                     f"{self._timeout}s"
                 )
             time.sleep(0.2)
+
+    def _retryable(self, fn, deadline: float) -> None:
+        """Run ``fn`` (already retry_rpc-wrapped) absorbing transient
+        failures until the handler deadline — a join issued INTO a
+        master restart must not abort the whole rendezvous."""
+        outage = OutageEdge()
+        while True:
+            try:
+                fn()
+                return
+            except Exception as e:
+                if not is_transient(e) or time.time() > deadline:
+                    raise
+                if outage.fail():  # once per outage, not per round
+                    logger.warning(
+                        "rendezvous join failed transiently (%s); "
+                        "retrying until the handler deadline", e,
+                    )
+                else:
+                    logger.debug("rendezvous join still failing: %s", e)
+                time.sleep(1.0)
 
 
 class RendezvousOutError(RuntimeError):
@@ -254,20 +389,63 @@ class ElasticAgent:
         client: MasterClient,
         node_rank: int,
         spec: WorkerSpec,
+        heartbeat_policy: Optional[RetryPolicy] = None,
     ):
         self._client = client
         self._node_rank = node_rank
         self._spec = spec
+        # flight recorder mirroring the serving fleet's vocabulary:
+        # rendezvous_join/complete/rejoin, master_outage/reconnected,
+        # worker_spawn/restart, breakpoint_save
+        self.recorder = FlightRecorder()
         self._handler = MasterRendezvousHandler(
-            client, node_rank, local_world_size=spec.nproc_per_node
+            client, node_rank, local_world_size=spec.nproc_per_node,
+            recorder=self.recorder,
         )
         self._group = LocalWorkerGroup()
         self._stop_heartbeat = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
+        # a heartbeat tick rides out short master blips INSIDE one
+        # policy.call (typed + jittered + deadline-budgeted, logging
+        # once per state change); an outage outliving the policy's
+        # deadline flips the agent into "master outage" state — ONE
+        # escalation log, bare probe per tick, never touching the
+        # worker group — until a probe lands and logs the recovery
+        self._hb_policy = heartbeat_policy or RetryPolicy(
+            max_attempts=6, backoff_base=0.5, backoff_max=4.0,
+            deadline=30.0,
+        )
+        self._hb_outage = OutageEdge()
+        self._poll_outage = OutageEdge()
+        # dlrover_agent_* metric counters (names registered in
+        # utils/metric_registry.py; mirrored vocabulary of the serving
+        # fleet's self-healing counters)
+        self._metrics_lock = threading.Lock()
+        self._metrics: Dict[str, float] = {
+            "dlrover_agent_heartbeat_failures_total": 0.0,
+            "dlrover_agent_master_outages_total": 0.0,
+            "dlrover_agent_master_reconnects_total": 0.0,
+            "dlrover_agent_rendezvous_rounds_total": 0.0,
+            "dlrover_agent_restarts_total": 0.0,
+            "dlrover_agent_breakpoint_saves_total": 0.0,
+        }
         self._saver_factory = None
         self._training_monitor = None
         self._resource_monitor = None
         self._hang_detector = None
+
+    def _count(self, name: str, n: float = 1.0) -> None:
+        with self._metrics_lock:
+            self._metrics[name] = self._metrics.get(name, 0.0) + n
+
+    def metrics(self) -> Dict[str, float]:
+        """Agent-side counters + the rendezvous handler's rejoin count
+        (metric source contract: plain name -> value floats)."""
+        with self._metrics_lock:
+            out = dict(self._metrics)
+        out["dlrover_agent_rendezvous_rejoins_total"] = float(
+            self._handler.rejoins)
+        return out
 
     # -- flash checkpoint -------------------------------------------------
     def _start_ckpt_factory(self) -> None:
@@ -300,6 +478,9 @@ class ElasticAgent:
         try:
             saver.save_shm_to_storage(
                 commit_async=commit_async, commit_timeout=commit_timeout)
+            self._count("dlrover_agent_breakpoint_saves_total")
+            self.recorder.record("breakpoint_save",
+                                 commit_async=commit_async)
         except Exception:
             logger.exception("persisting shm checkpoint failed")
 
@@ -342,9 +523,26 @@ class ElasticAgent:
 
     # -- heartbeats ------------------------------------------------------
     def _heartbeat_loop(self, interval: float = 15.0) -> None:
+        """One beat per tick, hardened (ISSUE 9): short blips are
+        absorbed inside the tick by the ``RetryPolicy`` (which logs once
+        per state change by contract); an outage outliving the policy's
+        deadline logs ONE escalation and degrades to a silent bare probe
+        per tick until the master answers again.  The worker group is
+        NEVER touched from here — a master outage is a control-plane
+        problem; killing healthy training over it would manufacture the
+        exact downtime this agent exists to prevent."""
         while not self._stop_heartbeat.wait(interval):
+            in_outage = self._hb_outage.failing
             try:
-                self._client.report_heart_beat(time.time())
+                if in_outage:
+                    # bare probe: the policy's own retries/logs would
+                    # re-announce the same outage once per tick
+                    self._client.report_heart_beat(time.time())
+                else:
+                    self._hb_policy.call(
+                        self._client.report_heart_beat, time.time(),
+                        what="report_heart_beat",
+                    )
             except ValueError as e:
                 # grpc raises ValueError when invoked on a closed channel
                 # (owner shut the client without stop_heartbeat) — beating
@@ -358,8 +556,32 @@ class ElasticAgent:
                 logger.warning("heartbeat failed: %s", e)
             except Exception as e:
                 # a shutdown that closed the channel mid-RPC is expected
-                if not self._stop_heartbeat.is_set():
-                    logger.warning("heartbeat failed: %s", e)
+                if self._stop_heartbeat.is_set():
+                    continue
+                self._count("dlrover_agent_heartbeat_failures_total")
+                if self._hb_outage.fail():
+                    self._count("dlrover_agent_master_outages_total")
+                    self.recorder.record("master_outage",
+                                         where="heartbeat")
+                    logger.warning(
+                        "heartbeat still failing after the retry "
+                        "deadline (%s); entering master-outage state — "
+                        "workers keep running, probing once per %.0fs "
+                        "tick", e, interval,
+                    )
+                else:
+                    logger.debug("heartbeat probe failed: %s", e)
+            else:
+                outage_s = self._hb_outage.recover()
+                if outage_s is not None:
+                    self._count("dlrover_agent_master_reconnects_total")
+                    self.recorder.record("master_reconnected",
+                                         where="heartbeat",
+                                         outage_s=round(outage_s, 1))
+                    logger.info(
+                        "heartbeat recovered after %.1fs master outage",
+                        outage_s,
+                    )
 
     def start_heartbeat(self) -> None:
         self._heartbeat_thread = threading.Thread(
@@ -383,13 +605,23 @@ class ElasticAgent:
                 break
             except RendezvousOutError:
                 time.sleep(1.0)
+        self._count("dlrover_agent_rendezvous_rounds_total")
         self._group.spawn(self._spec, rdzv, self._node_rank, dict(os.environ))
+        self.recorder.record(
+            "worker_spawn", round=rdzv.round,
+            world=sorted(rdzv.world), procs=self._spec.nproc_per_node,
+        )
         self._client.report_node_status(self._node_rank, NodeStatus.RUNNING)
         return rdzv
 
     def _restart_workers(self, reason: str,
                          persist_first: bool = False) -> RendezvousResult:
         logger.info("Restarting workers: %s", reason)
+        self._count("dlrover_agent_restarts_total")
+        self.recorder.record(
+            "worker_restart", reason=reason,
+            restart_count=self._group.restart_count + 1,
+        )
         self._group.stop()
         if persist_first:
             # growth restart: peers are alive, commit synchronously so
@@ -563,8 +795,22 @@ class ElasticAgent:
                         RendezvousName.ELASTIC_TRAINING
                     )
                 except Exception as e:
-                    logger.warning("membership poll failed: %s", e)
+                    # one warning per outage, not per monitor tick (the
+                    # heartbeat thread owns the outage counters; this
+                    # poll only keeps its own log state)
+                    if self._poll_outage.fail():
+                        logger.warning(
+                            "membership poll failed (%s); workers keep "
+                            "running, polling on", e,
+                        )
+                    else:
+                        logger.debug("membership poll still failing: %s", e)
                     continue
+                outage_s = self._poll_outage.recover()
+                if outage_s is not None:
+                    logger.info(
+                        "membership poll recovered after %.1fs", outage_s,
+                    )
                 if waiting > 0:
                     self._restart_workers(
                         f"{waiting} node(s) waiting to join",

@@ -59,7 +59,7 @@ class MasterClient:
     _instance_lock = threading.Lock()
 
     def __init__(self, master_addr: str, node_id: int, node_type: str,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, fault_schedule=None):
         self._master_addr = master_addr
         self._node_id = node_id
         self._node_type = node_type
@@ -69,6 +69,14 @@ class MasterClient:
         # burning the retry budget replaying a cached UNAVAILABLE
         self._stub = RpcStub(master_addr, timeout=timeout,
                              wait_for_ready=True)
+        if fault_schedule is not None:
+            # chaos seam (ISSUE 9): interpose the training control plane
+            # the same way the serving fabric's Brain client is — every
+            # get/report passes the seeded schedule, so rendezvous,
+            # heartbeat and task RPCs face injected outages in tests
+            from dlrover_tpu.serving.remote.faults import FaultyRpcStub
+
+            self._stub = FaultyRpcStub(self._stub, fault_schedule)
         self._host_name = socket.gethostname()
         try:
             self._host_ip = socket.gethostbyname(self._host_name)
@@ -195,6 +203,22 @@ class MasterClient:
             )
         )
         return reply.round, reply.group, reply.world, reply.node_ips
+
+    @retry_rpc()
+    def rendezvous_joined(
+        self, node_rank: int,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+    ) -> bool:
+        """Whether this node is still registered (waiting or admitted)
+        with the master's rendezvous — False after a master restart
+        wiped its state, which tells the handler to re-join instead of
+        polling an empty world to its timeout."""
+        reply = self._get(
+            comm.RendezvousJoinedRequest(
+                node_rank=node_rank, rdzv_name=rdzv_name
+            )
+        )
+        return reply.joined
 
     @retry_rpc()
     def num_nodes_waiting(

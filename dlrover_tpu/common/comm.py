@@ -144,6 +144,22 @@ class RendezvousRoundReply:
 
 
 @comm_message
+class RendezvousJoinedRequest:
+    """Is this node still registered (waiting or admitted) with the
+    rendezvous?  A restarted master answers False for every node — the
+    agent-side handler re-joins instead of polling an empty world until
+    its timeout (master-restart fault tolerance, ISSUE 9)."""
+
+    node_rank: int = 0
+    rdzv_name: str = ""
+
+
+@comm_message
+class RendezvousJoinedReply:
+    joined: bool = False
+
+
+@comm_message
 class NetworkStatusRequest:
     pass
 

@@ -91,6 +91,13 @@ class MasterServicer:
             return comm.RendezvousStateReply(
                 waiting_num=mgr.num_nodes_waiting() if mgr else 0
             )
+        if isinstance(message, comm.RendezvousJoinedRequest):
+            mgr = self._rdzv_managers.get(
+                message.rdzv_name or RendezvousName.ELASTIC_TRAINING
+            )
+            return comm.RendezvousJoinedReply(
+                joined=bool(mgr and mgr.joined(message.node_rank))
+            )
         if isinstance(message, comm.NetworkStatusRequest):
             mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
             normal, reason = (
@@ -331,6 +338,15 @@ class MasterServicer:
             self._kv_store.delete(message.key)
             return None
         if isinstance(message, comm.NodeFailure):
+            if self._job_metric_collector is not None:
+                # the goodput ledger must see the kill even when the
+                # recovery is fast enough to hide inside one step-report
+                # interval (stats/job_collector.py::mark_restart)
+                self._job_metric_collector.mark_restart()
+                self._job_metric_collector.report_event(
+                    "node_failure", instance=str(message.node_id),
+                    msg=f"{message.level}: {message.error_data}",
+                )
             if self._job_manager is not None:
                 self._job_manager.handle_training_failure(
                     req.node_type or NodeType.WORKER,

@@ -74,6 +74,14 @@ class JobMetricCollector:
         # above the median and credited at the typical rate instead of
         # the wall gap
         self._step_times: Deque[float] = deque(maxlen=64)
+        # a failure report arrived since the last step report: the next
+        # credited interval straddles a restart and must be credited at
+        # the typical per-step rate REGARDLESS of the 3x-median guard —
+        # a fast recovery (warm compile cache, shm restore) can hide an
+        # entire kill+respawn inside one below-threshold interval,
+        # silently crediting real downtime as productive time
+        self._restart_pending = False
+        self.restarts_observed = 0
 
     # ---------------------------------------------------------- reporting
     def mark_job_start(self, timestamp: Optional[float] = None) -> None:
@@ -84,6 +92,15 @@ class JobMetricCollector:
                 self._job_start_ts = (
                     time.time() if timestamp is None else timestamp
                 )
+
+    def mark_restart(self) -> None:
+        """A worker failure/restart was reported: the interval bridging
+        it must not be credited as fully productive (called by the
+        servicer on ``NodeFailure``; idempotent until the next step
+        report consumes it)."""
+        with self._lock:
+            self._restart_pending = True
+            self.restarts_observed += 1
 
     def report_global_step(self, step: int, timestamp: float) -> None:
         with self._lock:
@@ -105,6 +122,7 @@ class JobMetricCollector:
             # adopting its timestamp as prev would stretch the next
             # in-order interval and over-credit productive time
             return
+        restarted, self._restart_pending = self._restart_pending, False
         self._prev_step, self._prev_ts = step, ts
         self._last_report_ts = ts
         if self._first_report_ts is None:
@@ -126,7 +144,15 @@ class JobMetricCollector:
             sorted(self._step_times)[len(self._step_times) // 2]
             if self._step_times else None
         )
-        if median is not None and per_step > 3.0 * median:
+        if restarted:
+            # a reported failure happened inside this interval: whatever
+            # the wall gap says, only the new steps' typical compute time
+            # is productive — detection, respawn, restore and recompile
+            # are downtime even when they fit under the 3x-median radar
+            # (a warm compile cache + shm restore recovers in ~2 steps'
+            # time; the ledger must still SEE the kill)
+            credit = min(credit, (step - base) * median) if median else 0.0
+        elif median is not None and per_step > 3.0 * median:
             # the sampling window hides a stall or a restart that still
             # made net progress: credit the new steps at the typical
             # per-step rate, count the rest of the gap as downtime
@@ -156,10 +182,11 @@ class JobMetricCollector:
             start, last = self._job_start_ts, self._last_report_ts
             first = self._first_report_ts
             productive = self._productive_s
+            restarts = self.restarts_observed
         if start is None or last is None or last <= start:
             return {"goodput": 0.0, "wall_s": 0.0, "productive_s": 0.0,
                     "downtime_s": 0.0, "steady_goodput": 0.0,
-                    "steady_wall_s": 0.0}
+                    "steady_wall_s": 0.0, "restarts_observed": restarts}
         wall = last - start
         steady_wall = max(0.0, last - first) if first is not None else 0.0
         return {
@@ -171,6 +198,7 @@ class JobMetricCollector:
                 min(1.0, productive / steady_wall) if steady_wall else 0.0
             ),
             "steady_wall_s": steady_wall,
+            "restarts_observed": restarts,
         }
 
     def report_resource_usage(self, node_type: str, node_id, stats) -> None:

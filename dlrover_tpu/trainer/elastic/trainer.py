@@ -140,6 +140,8 @@ class ElasticTrainer:
             Checkpointer(checkpoint_dir, saver_mode=saver_mode)
             if checkpoint_dir else None
         )
+        if self._ckpt is not None:
+            self._install_flush_on_term()
         self.result: Optional[AccelerateResult] = None
         self.plan: Optional[ElasticBatchPlan] = None
         self.state: Any = None
@@ -453,18 +455,61 @@ class ElasticTrainer:
             self.step, elapsed_per_step=self._step_timer.ema_seconds
         )
 
-    def maybe_save(self) -> bool:
+    def _install_flush_on_term(self) -> None:
+        """Drain the async checkpoint writer on SIGTERM before dying.
+
+        The agent's worker-group stop is SIGTERM + grace: flushing the
+        staged generation (milliseconds) keeps every host's committed
+        shm step aligned at the collective-lockstep boundary, so a
+        growth restart's restore-step consensus stays on the memory
+        tier instead of falling back to an older storage step because
+        ONE host died mid-commit.  Chained onto any existing handler;
+        no-op off the main thread (signal.signal raises there)."""
+        import signal as _signal
+
+        prev = _signal.getsignal(_signal.SIGTERM)
+
+        def _flush_then_prev(signum, frame):
+            try:
+                # lock-free drain: the handler may have interrupted the
+                # main thread INSIDE a `with _save_cv:` block — flush()
+                # here would self-deadlock on the non-reentrant lock
+                self._ckpt.engine.drain_for_signal(timeout=5.0)
+            except Exception:
+                pass  # dying anyway; the commit either landed or not
+            if callable(prev):
+                prev(signum, frame)
+            elif prev is _signal.SIG_IGN:
+                return  # the process deliberately ignores SIGTERM
+            else:
+                _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _flush_then_prev)
+        except ValueError:
+            pass  # not the main thread: rely on the pipeline barrier
+
+    def maybe_save(self, block: bool = False) -> bool:
         """Flash-checkpoint cadence: shm every ``save_memory_interval``
         steps, async disk persist every ``save_storage_interval``.
-        Returns True when a checkpoint was actually written."""
+        Returns True when a checkpoint was actually written.
+
+        ``block=True`` waits for the shm COMMIT (not just the staging
+        hand-off) — required when the caller acknowledges consumed work
+        upstream right after saving (e.g. index-sharding acks): the ack
+        must follow a durable save or a crash in between resumes one
+        step behind the acked stream."""
         if self._ckpt is None:
             return False
         step = self.step
         if self._save_storage_interval and step % self._save_storage_interval == 0:
-            self._ckpt.save_checkpoint(step, self.state, StorageType.DISK)
+            self._ckpt.save_checkpoint(step, self.state, StorageType.DISK,
+                                       block=block)
             return True
         if self._save_memory_interval and step % self._save_memory_interval == 0:
-            self._ckpt.save_checkpoint(step, self.state, StorageType.MEMORY)
+            self._ckpt.save_checkpoint(step, self.state, StorageType.MEMORY,
+                                       block=block)
             return True
         return False
 

@@ -62,12 +62,18 @@ class Checkpointer:
         step: int,
         state: Any,
         storage_type: StorageType = StorageType.DISK,
+        block: bool = False,
     ) -> bool:
-        """Blocks only for the device->host shm copy; disk persistence is
-        asynchronous in the agent/saver (reference: checkpointer.py:24-43)."""
+        """In-loop cost is the staging hand-off only: the host copy into
+        shm runs on the engine's writer thread double-buffered (crash at
+        any instant restores the previous committed generation), and disk
+        persistence is asynchronous in the agent/saver (reference:
+        checkpointer.py:24-43).  ``block=True`` waits for the shm commit
+        — the durability barrier when THIS step must survive an
+        immediate crash."""
         if storage_type == StorageType.MEMORY:
-            return self._engine.save_to_memory(step, state)
-        return self._engine.save_to_storage(step, state)
+            return self._engine.save_to_memory(step, state, block=block)
+        return self._engine.save_to_storage(step, state, block=block)
 
     def load_checkpoint(
         self,

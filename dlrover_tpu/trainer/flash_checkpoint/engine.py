@@ -3,9 +3,13 @@
 Counterpart of the reference's ``CheckpointEngine``
 (reference: dlrover/trainer/torch/flash_checkpoint/engine.py:135-405):
 
-- ``save_to_memory(step, state)``: one host copy of the train-state pytree
-  into POSIX shared memory (non-blocking if the agent saver is mid-persist)
-  — the training pause is the D2H copy only;
+- ``save_to_memory(step, state)``: stages the state for an ASYNC copy into
+  POSIX shared memory — the in-loop pause is a generation-stamped pointer
+  swap (snapshot references + hand-off to the writer thread), not a
+  blocking memcpy.  The writer thread copies into the shm handler's
+  inactive buffer and publishes the generation atomically (commit-marker
+  protocol, see shm_handler.py), so a crash at any instant leaves the
+  previous generation restorable, never a torn one;
 - ``save_to_storage(step, state)``: memory save + an async persist event to
   the agent-side :class:`~dlrover_tpu.agent.ckpt_saver.AsyncCheckpointSaver`
   (factory-created on first use, reference: engine.py:253-275);
@@ -23,6 +27,7 @@ multi-host save never gathers.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
@@ -208,6 +213,12 @@ class CheckpointEngine:
     the user API is identical either way.
     """
 
+    #: bound on the pipeline barrier in save_to_memory: long enough for
+    #: any normal in-flight copy (a 1 GiB commit is <1 s), short enough
+    #: that a writer parked behind a long saver persist skips instead of
+    #: stalling training
+    STAGE_BARRIER_S = 5.0
+
     def __init__(
         self,
         checkpoint_dir: str,
@@ -218,6 +229,7 @@ class CheckpointEngine:
         node_num: Optional[int] = None,
         saver_mode: SaverMode = SaverMode.AUTO,
         save_timeout: float = 600.0,
+        async_save: Optional[bool] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.storage = storage or PosixDiskStorage()
@@ -259,6 +271,32 @@ class CheckpointEngine:
         self._event_queue = SharedQueue("ckpt_event")
         self._latest_memory_step = -1
         self._latest_storage_request = -1
+        # -- async double-buffered save (ISSUE 9) ------------------------
+        # The in-loop "pause" is the staging hand-off only; the host copy
+        # into the shm handler's inactive buffer runs on this writer
+        # thread and publishes the generation atomically when done.
+        # DLROVER_CKPT_SYNC_SAVE=1 is the kill switch back to the
+        # synchronous copy-in-loop behavior.
+        if async_save is None:
+            async_save = env.get("DLROVER_CKPT_SYNC_SAVE", "") != "1"
+        self._async_save = bool(async_save)
+        self._save_cv = threading.Condition()
+        self._pending: Optional[Tuple[int, Any, bool]] = None
+        self._writer_busy = False
+        self._writer_stop = False
+        self._writer_thread: Optional[threading.Thread] = None
+        # accounting (surfaced by ckpt_metrics(): the remaining in-loop
+        # pause and the overlapped commit cost stay explicitly attributed
+        # instead of silently vanishing from the books)
+        self.saves_staged = 0
+        self.saves_committed = 0
+        self.saves_collapsed = 0
+        self.save_errors = 0
+        self.inloop_pause_s_total = 0.0
+        self.commit_s_total = 0.0
+        self.last_commit_s = 0.0
+        self._save_error_streak = 0
+        self._stage_skip_streak = 0
 
     # -- saver bootstrap --------------------------------------------------
     def _ensure_saver(self) -> None:
@@ -289,11 +327,92 @@ class CheckpointEngine:
         self._saver_started = True
 
     # -- save -------------------------------------------------------------
-    def save_to_memory(self, step: int, state: Any) -> bool:
-        """Copy ``state`` into shared memory.  Returns False (skipping the
-        save) when the agent saver holds the shm lock mid-persist —
-        training never blocks on storage (reference: engine.py:291-323)."""
+    def save_to_memory(
+        self, step: int, state: Any, block: bool = False,
+        _notify_storage: bool = False,
+    ) -> bool:
+        """Stage ``state`` for an async copy into shared memory.
+
+        The in-loop cost is snapshotting device arrays (an async
+        device-side copy, so a caller that DONATES its state into the
+        next jitted step cannot invalidate the bytes mid-copy) plus the
+        writer hand-off — a pointer swap, not the memcpy.  The writer
+        thread performs the host copy into the shm handler's inactive
+        buffer and publishes the generation atomically; a crash before
+        the publish restores the previous generation (never torn).
+
+        The pipeline is depth 1: staging save N first waits out any
+        still-copying save N-1 (steady state: already done — a full
+        training step elapsed), so a crash right after this call can
+        lose at most THIS save, never two.  That residual wait is the
+        whole remaining in-loop pause and is attributed explicitly in
+        ``ckpt_metrics()``.  ``block=True`` additionally waits for save
+        N's own commit (the durability barrier for callers that need
+        save N — not N-1 — to survive an immediate crash, at the old
+        synchronous-pause cost).
+
+        Returns False only when the save could not be STAGED (previous
+        commit still in flight past ``STAGE_BARRIER_S`` — the writer is
+        parked behind a saver persist; sync mode: saver holds the shm
+        lock) or, with ``block=True``, when the commit did not land
+        within the save timeout.
+        """
         self._ensure_saver()
+        t0 = time.perf_counter()
+        if not self._async_save:
+            ok = self._save_to_memory_sync(step, state, _notify_storage)
+            self.inloop_pause_s_total += time.perf_counter() - t0
+            return ok
+        staged = self._snapshot_state(state)
+        # pipeline barrier: the previous save must commit before a new
+        # one stages (at-most-one-behind crash-loss contract).  The wait
+        # is BOUNDED SHORT: a normal in-flight copy finishes in well
+        # under STAGE_BARRIER_S, so exceeding it means the writer is
+        # parked on the shm lock behind a long saver persist — then we
+        # SKIP this save (the old "training never blocks on storage"
+        # contract) instead of stalling the training loop for up to the
+        # 600s save timeout.
+        if not self.flush(timeout=self.STAGE_BARRIER_S):
+            self._stage_skip_streak += 1
+            if self._stage_skip_streak == 1:
+                logger.warning(
+                    "step %s memory save skipped: previous commit still "
+                    "in flight after %.1fs (saver persisting?); further "
+                    "skips log at debug until a save lands",
+                    step, self.STAGE_BARRIER_S,
+                )
+            else:
+                logger.debug("step %s memory save skipped (streak %s)",
+                             step, self._stage_skip_streak)
+            self.inloop_pause_s_total += time.perf_counter() - t0
+            return False
+        if self._stage_skip_streak:
+            logger.info(
+                "memory saves resumed at step %s after %s skipped",
+                step, self._stage_skip_streak,
+            )
+            self._stage_skip_streak = 0
+        with self._save_cv:
+            self._ensure_writer()
+            if self._pending is not None:  # raced another saver thread
+                _, _, prev_notify = self._pending
+                _notify_storage = _notify_storage or prev_notify
+                self.saves_collapsed += 1
+            self._pending = (step, staged, _notify_storage)
+            self.saves_staged += 1
+            self._save_cv.notify_all()
+        self.inloop_pause_s_total += time.perf_counter() - t0
+        if block:
+            return self.flush(timeout=self._save_timeout) \
+                and self._latest_memory_step >= step
+        return True
+
+    def _save_to_memory_sync(
+        self, step: int, state: Any, notify_storage: bool
+    ) -> bool:
+        """The pre-double-buffer path (DLROVER_CKPT_SYNC_SAVE=1): copy in
+        the training loop, skipping when the agent saver holds the shm
+        lock mid-persist (reference: engine.py:291-323)."""
         owner = f"writer{self._local_rank}"
         if not self._shm_lock.acquire(blocking=False, owner=owner):
             logger.warning(
@@ -303,20 +422,174 @@ class CheckpointEngine:
         try:
             self._shm_handler.save_state_dict(state, step)
             self._latest_memory_step = step
+            self.saves_staged += 1
+            self.saves_committed += 1
         finally:
             self._shm_lock.release(owner=owner)
+        if notify_storage:
+            self._notify_storage_event(step)
         return True
 
-    def save_to_storage(self, step: int, state: Any) -> bool:
+    def _snapshot_state(self, state: Any) -> Any:
+        """Decouple the staged state from the caller's buffers.
+
+        ``jax.Array`` leaves get an async DEVICE-side copy (dispatch
+        returns immediately; HBM->HBM bandwidth, not D2H): the training
+        loop may then donate the original into the next step while the
+        writer thread reads the snapshot.  Host (numpy) leaves pass by
+        reference — the caller contract is not to mutate them in place
+        between save and commit (rebinding to new arrays, the jax
+        idiom, is always safe); use ``block=True`` otherwise.
+        """
+        import jax
+
+        def snap(leaf):
+            if isinstance(leaf, jax.Array):
+                try:
+                    return leaf.copy()  # async device copy, same sharding
+                except Exception:
+                    return leaf  # deleted/donated already: writer will log
+            return leaf
+
+        return jax.tree_util.tree_map(snap, state)
+
+    def _ensure_writer(self) -> None:
+        """Caller holds ``_save_cv``."""
+        if self._writer_thread is not None and self._writer_thread.is_alive():
+            return
+        self._writer_stop = False
+        self._writer_thread = threading.Thread(
+            target=self._writer_loop, daemon=True,
+            name=f"ckpt-writer-{self._local_rank}",
+        )
+        self._writer_thread.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._save_cv:
+                while self._pending is None and not self._writer_stop:
+                    self._save_cv.wait(timeout=1.0)
+                if self._writer_stop and self._pending is None:
+                    return
+                step, state, notify = self._pending
+                self._pending = None
+                self._writer_busy = True
+            try:
+                t0 = time.perf_counter()
+                self._commit_staged_save(step, state, notify)
+                self.last_commit_s = time.perf_counter() - t0
+                self.commit_s_total += self.last_commit_s
+            except Exception as e:
+                self.save_errors += 1
+                self._save_error_streak += 1
+                if self._save_error_streak == 1:
+                    # once per state change, not per failed save: a
+                    # donated-buffer misuse at every step must not log
+                    # at every step
+                    logger.warning(
+                        "async memory save of step %s failed (%s); the "
+                        "previous committed generation stays restorable",
+                        step, e,
+                    )
+                else:
+                    logger.debug(
+                        "async memory save of step %s still failing: %s",
+                        step, e,
+                    )
+            finally:
+                with self._save_cv:
+                    self._writer_busy = False
+                    self._save_cv.notify_all()
+
+    def _commit_staged_save(self, step: int, state: Any, notify: bool) -> None:
+        owner = f"writer{self._local_rank}"
+        # blocking here is fine — this is the writer thread, not the
+        # training loop; the agent saver releases the lock when its
+        # persist pass finishes
+        if not self._shm_lock.acquire(owner=owner,
+                                      timeout=self._save_timeout):
+            raise TimeoutError(
+                f"shm lock busy for {self._save_timeout}s (saver persist "
+                "wedged?); save skipped"
+            )
+        try:
+            self._shm_handler.save_state_dict(state, step)
+        finally:
+            self._shm_lock.release(owner=owner)
+        self._latest_memory_step = step
+        self.saves_committed += 1
+        if self._save_error_streak:
+            logger.info(
+                "async memory save recovered at step %s after %s failures",
+                step, self._save_error_streak,
+            )
+            self._save_error_streak = 0
+        if notify:
+            self._notify_storage_event(step)
+
+    def _notify_storage_event(self, step: int) -> None:
+        """Ask the saver to persist shm -> storage.  Sent AFTER the memory
+        commit published, so the saver can never persist a generation
+        newer than the one the event names was committed for."""
+        if self._local_rank != 0:
+            return
+        self._event_queue.put(
+            dumps(CheckpointEvent(SAVE_EVENT, step).to_dict())
+        )
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Wait until every staged save has committed (or failed); True
+        when the writer went idle inside the budget."""
+        deadline = time.monotonic() + timeout
+        with self._save_cv:
+            while self._pending is not None or self._writer_busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._save_cv.wait(timeout=min(remaining, 1.0))
+        return True
+
+    def drain_for_signal(self, timeout: float = 5.0) -> bool:
+        """Best-effort writer drain that NEVER takes ``_save_cv`` — safe
+        from a signal handler, which may interrupt the main thread while
+        it already holds that (non-reentrant) lock; ``flush()`` there
+        would self-deadlock.  Plain-attribute polling is enough: both
+        fields are only ever written under the cv, and a signal-time
+        drain is advisory anyway (the commit either lands or the
+        previous generation stands)."""
+        deadline = time.monotonic() + timeout
+        while self._pending is not None or self._writer_busy:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def ckpt_metrics(self) -> Dict[str, float]:
+        """Explicit attribution of the double-buffered save cost (metric
+        names registered in utils/metric_registry.py)."""
+        return {
+            "dlrover_ckpt_saves_staged_total": float(self.saves_staged),
+            "dlrover_ckpt_saves_committed_total": float(self.saves_committed),
+            "dlrover_ckpt_saves_collapsed_total": float(self.saves_collapsed),
+            "dlrover_ckpt_save_errors_total": float(self.save_errors),
+            "dlrover_ckpt_inloop_pause_seconds_total": float(
+                self.inloop_pause_s_total),
+            "dlrover_ckpt_commit_seconds_total": float(self.commit_s_total),
+            "dlrover_ckpt_committed_step": float(self._latest_memory_step),
+        }
+
+    def save_to_storage(self, step: int, state: Any,
+                        block: bool = False) -> bool:
         """Memory save + async persist request to the saver (reference:
         engine.py:354-394).  Local rank 0 enqueues one event per host —
         the saver persists every local shard from it (duplicate per-rank
-        events would only thrash the stage dir)."""
-        ok = self.save_to_memory(step, state)
-        if ok and self._local_rank == 0:
-            self._event_queue.put(
-                dumps(CheckpointEvent(SAVE_EVENT, step).to_dict())
-            )
+        events would only thrash the stage dir).  The event rides the
+        writer thread: it is enqueued only after the memory generation
+        COMMITS, so the saver never persists ahead of the publish.
+        ``block=True`` waits for the shm COMMIT (disk persistence stays
+        async either way) and returns False if it did not land."""
+        ok = self.save_to_memory(step, state, block=block,
+                                 _notify_storage=True)
         if ok:
             self._latest_storage_request = step
         return ok
@@ -342,6 +615,10 @@ class CheckpointEngine:
         pytree of ``jax.sharding.Sharding``s.
         """
         self._ensure_saver()  # shm meta server must exist before we query it
+        # drain staged-but-uncommitted saves: a restore right after a
+        # save must see that save, not race the writer thread
+        if self._async_save and self._writer_thread is not None:
+            self.flush(timeout=min(self._save_timeout, 60.0))
         # Freshness across tiers: a host can hold a STALE shm checkpoint
         # (e.g. a node that sat out rounds while its peers trained on and
         # committed newer storage saves — the multi-slice orphan).  Memory
@@ -574,6 +851,16 @@ class CheckpointEngine:
         return self.latest_storage_step()
 
     def close(self) -> None:
+        # drain the writer before tearing down shm: an in-flight commit
+        # must not race the segment close (DL002: the thread is tracked
+        # and joined, not abandoned)
+        if self._writer_thread is not None:
+            self.flush(timeout=10.0)
+            with self._save_cv:
+                self._writer_stop = True
+                self._save_cv.notify_all()
+            self._writer_thread.join(timeout=5.0)
+            self._writer_thread = None
         self._shm_handler.close()
         self._shm_lock.close()
         self._event_queue.close()

@@ -16,6 +16,28 @@ JAX specifics vs the torch reference:
   fsdp_engine.py:70-157).
 - a fully-addressable array (single host or replicated) is one shard
   covering the whole index space.
+
+Crash consistency (ISSUE 9): the handler is DOUBLE-BUFFERED.  Each
+(job, local rank) owns TWO shm segments; generation ``g`` writes into
+buffer ``g % 2`` while buffer ``(g-1) % 2`` keeps holding the last
+committed generation untouched.  The commit-marker protocol is
+
+    write payload into the inactive buffer -> flush -> publish
+
+where "publish" is ONE atomic ``SharedDict.set`` carrying the new
+``generation``/``buffer``/``leaves`` map (the meta server applies it
+under a lock in a process that survives the writer).  A SIGKILL at any
+instant during a save therefore leaves the committed meta pointing at
+a fully-written buffer: a restore can read the PREVIOUS generation,
+never a torn one.  The cost is up to 2x shm for the checkpoint tier;
+the win is that the in-loop save pause no longer needs to serialize
+against the persist path or fear mid-copy death.
+
+Readers additionally refuse a STALE generation: the published meta
+stamps each buffer's generation (``buffer_generations``), and a meta
+whose committed ``generation`` disagrees with its own buffer stamp
+(a half-migrated or hand-corrupted meta) reads as invalid instead of
+serving whichever bytes the buffer happens to hold.
 """
 
 from __future__ import annotations
@@ -107,21 +129,41 @@ class ShmMeta:
     valid: bool
     leaves: Dict[str, Dict]  # path -> {global_shape, dtype, shards:[...]}
     total_bytes: int
+    generation: int = 0
+    buffer: int = 0
 
 
 class SharedMemoryHandler:
-    """One shm segment per (job, local rank) holding the flattened state."""
+    """Two shm segments per (job, local rank) holding the flattened state
+    double-buffered (generation ``g`` lives in buffer ``g % 2``)."""
+
+    NUM_BUFFERS = 2
 
     def __init__(self, local_rank: int = 0, job_uid: str = "", create: bool = False):
         import os
 
         job = job_uid or os.getenv("DLROVER_JOB_UID", "local")
-        self._shm_name = f"{_SHM_PREFIX}_{job}_{local_rank}"
+        base = f"{_SHM_PREFIX}_{job}_{local_rank}"
+        # buffer 0 keeps the historical single-buffer name so a restore
+        # can still attach a segment written before the upgrade
+        self._shm_names = {0: base, 1: f"{base}_g1"}
         self._meta = SharedDict(f"ckpt_meta_{local_rank}", create=create)
-        self._shm: Optional[SharedMemory] = None
+        self._shm: Dict[int, Optional[SharedMemory]] = {0: None, 1: None}
 
     # -- write side (training process) ----------------------------------
     def save_state_dict(self, state: Any, step: int) -> None:
+        """Write one generation and commit it: payload into the inactive
+        buffer first, then ONE atomic meta publish.  A writer death at
+        any instant before the publish leaves the previous generation
+        committed and readable."""
+        self._publish(self._write_generation(state, step))
+
+    def _write_generation(self, state: Any, step: int) -> Dict[str, Any]:
+        """Stage the payload of the NEXT generation into the inactive
+        buffer WITHOUT publishing; returns the publish record.  Split
+        from :meth:`_publish` so the commit-marker protocol is directly
+        testable (a staged-but-unpublished generation must be invisible
+        to every reader)."""
         # Stage ALL leaves' D2H DMA first, then consume: the copies
         # overlap across shards and the save pause approaches
         # max(total D2H, shm memcpy) instead of their serial sum
@@ -134,6 +176,14 @@ class SharedMemoryHandler:
                     leaf.copy_to_host_async()
                 except Exception:
                     break  # backend without async staging: plain path
+        committed = self._meta.get() or {}
+        generation = int(committed.get("generation", 0)) + 1
+        buf = generation % self.NUM_BUFFERS
+        buffer_generations = dict(committed.get("buffer_generations") or {})
+        # commit marker, phase 1: record the attempt (a restore ignores
+        # ``inflight``; a postmortem reads inflight > generation as
+        # "a save died mid-copy")
+        self._meta.set({"inflight": generation})
         pairs = leaf_paths(state)
         metas: Dict[str, Dict] = {}
         buffers: List[Tuple[int, np.ndarray]] = []
@@ -152,50 +202,81 @@ class SharedMemoryHandler:
                 "shards": shard_metas,
             }
         total = offset
-        self._ensure_shm(total)
-        mv = self._shm.buf
+        self._ensure_shm(total, buf)
+        mv = self._shm[buf].buf
         for off, arr in buffers:
             # single host copy straight into shm (no tobytes() staging)
             dst = np.ndarray(arr.shape, arr.dtype, buffer=mv, offset=off)
             np.copyto(dst, arr)
-        self._meta.set(
-            {
-                "step": int(step),
-                "valid": True,
-                "total_bytes": total,
-                "leaves": metas,
-            }
-        )
+        buffer_generations[str(buf)] = generation
+        return {
+            "step": int(step),
+            "valid": True,
+            "total_bytes": total,
+            "leaves": metas,
+            "generation": generation,
+            "buffer": buf,
+            "buffer_generations": buffer_generations,
+        }
+
+    def _publish(self, record: Dict[str, Any]) -> None:
+        """Commit marker, phase 2: one atomic meta update flips the
+        committed generation to the freshly written buffer."""
+        self._meta.set(record)
 
     def mark_invalid(self) -> None:
         self._meta.set({"valid": False})
+
+    def committed_generation(self) -> int:
+        d = self._meta.get() or {}
+        return int(d.get("generation", 0))
 
     # -- read side (agent process or restarted trainer) ------------------
     def get_meta(self) -> Optional[ShmMeta]:
         d = self._meta.get()
         if not d or "leaves" not in d:
             return None
+        generation = int(d.get("generation", 0))
+        buf = int(d.get("buffer", 0))
+        valid = bool(d.get("valid", False))
+        stamps = d.get("buffer_generations")
+        if valid and stamps is not None and stamps.get(str(buf)) != generation:
+            # stale-generation refusal: the committed pointer and the
+            # buffer's own stamp disagree — whatever bytes the buffer
+            # holds are not the generation the meta claims
+            logger.warning(
+                "refusing stale shm generation %s (buffer %s stamped %s)",
+                generation, buf, stamps.get(str(buf)),
+            )
+            valid = False
         return ShmMeta(
             step=int(d.get("step", -1)),
-            valid=bool(d.get("valid", False)),
+            valid=valid,
             leaves=d["leaves"],
             total_bytes=int(d.get("total_bytes", 0)),
+            generation=generation,
+            buffer=buf,
         )
 
     def read_shard_bytes(self, offset: int, nbytes: int) -> memoryview:
-        self._attach_shm()
-        return self._shm.buf[offset:offset + nbytes]
+        meta = self.get_meta()
+        buf = meta.buffer if meta is not None else 0
+        self._attach_shm(buf)
+        return self._shm[buf].buf[offset:offset + nbytes]
 
     def load_arrays(self) -> Optional[Tuple[int, Dict[str, Dict], Dict[Tuple[str, int], np.ndarray]]]:
-        """Returns (step, leaf metas, {(path, shard_i): np array}) or None."""
+        """Returns (step, leaf metas, {(path, shard_i): np array}) or None.
+        Always reads the committed buffer — a save mid-copy in the other
+        buffer is invisible."""
         meta = self.get_meta()
         if meta is None or not meta.valid:
             return None
-        self._attach_shm()
+        self._attach_shm(meta.buffer)
+        shm = self._shm[meta.buffer]
         out: Dict[Tuple[str, int], np.ndarray] = {}
         for path, leaf_meta in meta.leaves.items():
             for i, shard in enumerate(leaf_meta["shards"]):
-                raw = self._shm.buf[
+                raw = shm.buf[
                     shard["offset"]:shard["offset"] + shard["nbytes"]
                 ]
                 arr = np.frombuffer(
@@ -205,26 +286,28 @@ class SharedMemoryHandler:
         return meta.step, meta.leaves, out
 
     # -- shm management ---------------------------------------------------
-    def _ensure_shm(self, size: int) -> None:
-        if self._shm is not None and self._shm.size >= size:
+    def _ensure_shm(self, size: int, buf: int = 0) -> None:
+        shm = self._shm[buf]
+        if shm is not None and shm.size >= size:
             return
-        if self._shm is not None:
-            self._shm.close()
-            self._shm.unlink()
-            self._shm = None
+        if shm is not None:
+            shm.close()
+            shm.unlink()
+            self._shm[buf] = None
+        name = self._shm_names[buf]
         created = False
         try:
-            self._shm = SharedMemory(self._shm_name, create=True, size=max(size, 1))
+            self._shm[buf] = SharedMemory(name, create=True, size=max(size, 1))
             created = True
         except FileExistsError:
-            existing = SharedMemory(self._shm_name)
+            existing = SharedMemory(name)
             if existing.size >= size:
-                self._shm = existing
+                self._shm[buf] = existing
             else:
                 existing.close()
                 existing.unlink()
-                self._shm = SharedMemory(
-                    self._shm_name, create=True, size=max(size, 1)
+                self._shm[buf] = SharedMemory(
+                    name, create=True, size=max(size, 1)
                 )
                 created = True
         if created:
@@ -239,13 +322,13 @@ class SharedMemoryHandler:
                 populate_write_ndarray,
             )
 
-            view = np.frombuffer(self._shm.buf, np.uint8)
+            view = np.frombuffer(self._shm[buf].buf, np.uint8)
             populate_write_ndarray(view)
             del view
 
-    def _attach_shm(self) -> None:
-        if self._shm is None:
-            self._shm = SharedMemory(self._shm_name)
+    def _attach_shm(self, buf: int = 0) -> None:
+        if self._shm[buf] is None:
+            self._shm[buf] = SharedMemory(self._shm_names[buf])
             # COLD attach (fresh process restoring after a crash): map
             # every page up front — per-page first-touch faults made the
             # recovery path ~8 s/GiB (VERDICT r3 weak #2)
@@ -254,17 +337,18 @@ class SharedMemoryHandler:
             from dlrover_tpu.common.multi_process import prefault_readonly
 
             t0 = _time.perf_counter()
-            how = prefault_readonly(self._shm._mmap)
+            how = prefault_readonly(self._shm[buf]._mmap)
             logger.info(
                 "prefaulted shm %s (%.2f MiB) via %s in %.3fs",
-                self._shm_name, self._shm.size / 2**20, how,
+                self._shm_names[buf], self._shm[buf].size / 2**20, how,
                 _time.perf_counter() - t0,
             )
 
     def close(self, unlink: bool = False) -> None:
-        if self._shm is not None:
-            self._shm.close()
-            if unlink:
-                self._shm.unlink()
-            self._shm = None
+        for buf, shm in self._shm.items():
+            if shm is not None:
+                shm.close()
+                if unlink:
+                    shm.unlink()
+                self._shm[buf] = None
         self._meta.close()

@@ -163,6 +163,63 @@ METRIC_HELP: Dict[str, str] = {
     "dlrover_step_seconds_p50": "reservoir p50 of per-step wall seconds",
     "dlrover_step_seconds_p99": "reservoir p99 of per-step wall seconds",
     "dlrover_step_seconds_total": "cumulative step wall seconds",
+    # -- elastic agent self-healing (agent/elastic_agent.metrics) ------
+    "dlrover_agent_heartbeat_failures_total": (
+        "heartbeat ticks that failed after their in-tick retry budget "
+        "— rising under a steady master is the control-plane-flakiness "
+        "signal on the training plane"
+    ),
+    "dlrover_agent_master_outages_total": (
+        "master outages entered (heartbeat failing past the retry "
+        "deadline); workers keep running through them by contract"
+    ),
+    "dlrover_agent_master_reconnects_total": (
+        "master outages survived: the heartbeat probe landed again"
+    ),
+    "dlrover_agent_rendezvous_rounds_total": (
+        "rendezvous rounds this agent completed (spawn + every "
+        "elastic restart)"
+    ),
+    "dlrover_agent_rendezvous_rejoins_total": (
+        "rendezvous registrations re-established after a master "
+        "restart wiped its state mid-round"
+    ),
+    "dlrover_agent_restarts_total": (
+        "worker-group restarts (failure, hang, membership growth)"
+    ),
+    "dlrover_agent_breakpoint_saves_total": (
+        "shm checkpoints persisted to storage at a failure breakpoint "
+        "before a restart/exit wiped the workers"
+    ),
+    # -- flash checkpoint double-buffered saves (engine.ckpt_metrics) --
+    "dlrover_ckpt_saves_staged_total": (
+        "memory saves handed to the async writer (the in-loop cost is "
+        "the hand-off, not the copy)"
+    ),
+    "dlrover_ckpt_saves_committed_total": (
+        "generations fully written and atomically published — the "
+        "commit-marker protocol's success count"
+    ),
+    "dlrover_ckpt_saves_collapsed_total": (
+        "staged saves superseded by a newer one before the writer "
+        "started them (newest wins; never silent)"
+    ),
+    "dlrover_ckpt_save_errors_total": (
+        "async saves that failed to commit (e.g. donated-buffer "
+        "misuse); the previous committed generation stays restorable"
+    ),
+    "dlrover_ckpt_inloop_pause_seconds_total": (
+        "cumulative training-loop pause spent in save_to_memory "
+        "(staging + residual pipeline wait) — the explicit attribution "
+        "of whatever pause the double buffer did not remove"
+    ),
+    "dlrover_ckpt_commit_seconds_total": (
+        "cumulative writer-thread time copying + publishing "
+        "generations (overlapped with training, not a pause)"
+    ),
+    "dlrover_ckpt_committed_step": (
+        "training step of the last fully-committed shm generation"
+    ),
     # -- xprof auto-profiling (utils/xprof_metrics.AutoProfiler) -------
     "dlrover_xprof_profiles_total": "xprof captures taken so far",
     "dlrover_xprof_last_capture_timestamp": (

@@ -112,7 +112,12 @@ def main() -> int:
         step = trainer.step
         loss = float(metrics["loss"])
         losses.append((step, loss))
-        trainer.maybe_save()
+        # sharded runs BLOCK on the shm commit: the ack below must
+        # follow a DURABLE save — with the async double-buffered engine
+        # a staged-but-uncommitted save would let a crash resume one
+        # step behind the acked shard stream (redoing a step on the
+        # NEXT shard's data and finishing a step short)
+        trainer.maybe_save(block=sharding is not None)
         if sharding is not None:
             # ack AFTER the step + checkpoint: a crash in between makes
             # the master re-dispatch the shard instead of skipping it

@@ -62,11 +62,14 @@ def _read_metrics(path):
 def assert_steps_consistent(rows, max_redos: int):
     """No work is redone EXCEPT the bounded, deterministic kill-boundary
     case: a SIGKILL can land between a step's metrics write and its shm
-    save, so the resumed worker legitimately recomputes that one step.
-    Allowed: at most ``max_redos`` duplicated steps (one per membership
-    change), each an IDENTICAL redo (same loss — determinism makes a
-    divergent redo a real bug, not a timing artifact).  Returns the
-    deduplicated step list."""
+    save commit, so the resumed worker legitimately recomputes that
+    step — and since the double-buffered engine (ISSUE 9) commits
+    asynchronously at-most-one-behind, the step BEFORE it can need an
+    identical redo too in the worst-case kill phase.  Allowed: at most
+    ``max_redos`` duplicated steps (budget the caller sizes per
+    membership change), each an IDENTICAL redo (same loss —
+    determinism makes a divergent redo a real bug, not a timing
+    artifact).  Returns the deduplicated step list."""
     steps = [s for s, _, _ in rows]
     assert steps == sorted(steps), f"steps went backwards: {steps}"
     dups = sorted({s for s in steps if steps.count(s) > 1})
@@ -136,7 +139,7 @@ def test_kill_one_node_resumes_trajectory(tmp_path):
         assert rc == 0, f"agent0 exited {rc}"
 
         rows = _read_metrics(m0)
-        steps = assert_steps_consistent(rows, max_redos=1)  # 1 kill
+        steps = assert_steps_consistent(rows, max_redos=2)  # 1 kill x at-most-one-behind commit
         assert steps[-1] == TOTAL_STEPS
         worlds = {s: w for s, _, w in rows}
         assert worlds[1] == 2, "run did not start on the 2-proc world"
@@ -286,7 +289,7 @@ def test_scale_up_mid_run_grows_world(tmp_path):
         )
         grow_step = min(s for s, w in worlds.items() if w == 2)
         assert grow_step > 1
-        assert_steps_consistent(rows, max_redos=1)  # 1 growth restart
+        assert_steps_consistent(rows, max_redos=2)  # 1 growth restart x async commit
         ref = _reference_losses()
         for s, loss, _ in rows:
             assert np.isclose(loss, ref[s - 1], rtol=1e-3, atol=1e-3), (

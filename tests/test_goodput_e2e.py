@@ -108,7 +108,23 @@ def test_goodput_artifact_survives_injected_kill(tmp_path):
         g = detail["metrics"]["goodput"]
         assert g["productive_s"] > 0, g
         # the ledger must have SEEN the kill: some of the steady window
-        # (post-first-step) is downtime, so steady goodput < 1...
+        # (post-first-step) is downtime, so steady goodput < 1.
+        #
+        # Diagnosis of the long-standing seed failure here (ISSUE 9
+        # satellite): the GOODPUT ATTRIBUTION was the bug, not this
+        # timing assumption.  The worker resumes from the in-memory
+        # checkpoint at exactly the crash step, so the first
+        # post-restart report is one step AHEAD of the last pre-crash
+        # one — no rollback signal — and on a fast recovery (warm
+        # compile cache + ~ms shm restore) the bridging interval fell
+        # UNDER the ledger's 3x-median stall radar and was credited as
+        # fully productive, zeroing the downtime this assert requires.
+        # Fixed by `JobMetricCollector.mark_restart()`: the servicer
+        # flags the ledger on every NodeFailure report, and the next
+        # credited interval is capped at the typical per-step rate —
+        # detection + respawn + restore time lands in downtime_s even
+        # when recovery is fast.
+        assert g["restarts_observed"] >= 1, g
         assert g["steady_wall_s"] - g["productive_s"] > 2.0, g
         assert g["steady_goodput"] < 0.999, g
         # ...and recovery fast enough that steady goodput clears the

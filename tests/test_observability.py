@@ -162,6 +162,49 @@ def test_job_metric_collector_speed_and_dump(tmp_path):
     assert kinds == {"global_step", "event"}
 
 
+def test_goodput_mark_restart_caps_bridging_interval():
+    """ISSUE 9 satellite: a fast recovery hiding a kill inside one
+    below-3x-median step interval must still be charged as downtime
+    once the master saw the failure report (mark_restart); without the
+    flag the same interval is credited fully."""
+    col = JobMetricCollector(LocalMetricReporter(None))
+    t = 1000.0
+    for i in range(1, 9):  # steady 1s/step baseline
+        col.report_global_step(i, t + i)
+    base = col.goodput()["productive_s"]
+    assert base == pytest.approx(7.0)
+    # a kill + fast recovery: the next report arrives 2.5s later, one
+    # step ahead (resume landed exactly on the crash step) — under the
+    # 3x-median radar.  With the failure reported, only ~1 median step
+    # of it is productive.
+    col.mark_restart()
+    col.report_global_step(9, t + 8 + 2.5)
+    g = col.goodput()
+    assert g["restarts_observed"] == 1
+    assert g["productive_s"] == pytest.approx(base + 1.0)
+    assert g["steady_wall_s"] - g["productive_s"] == pytest.approx(1.5)
+    # the flag is consumed: the following clean interval credits fully
+    col.report_global_step(10, t + 8 + 3.5)
+    assert col.goodput()["productive_s"] == pytest.approx(base + 2.0)
+
+
+def test_node_failure_report_marks_goodput_restart(local_master):
+    """The servicer wires NodeFailure -> mark_restart + a ledger event."""
+    master, addr = local_master
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    client = MasterClient(addr, node_id=0, node_type="worker")
+    try:
+        client.report_failure("worker exit 9", level="error", node_rank=0)
+        col = master.job_metric_collector
+        assert col.restarts_observed == 1
+        events = [e["event_type"] for e in col.get_job_metrics()[
+            "recent_events"]]
+        assert "node_failure" in events
+    finally:
+        client.close()
+
+
 def test_step_timer_stats():
     t = StepTimer()
     for v in (0.1, 0.2, 0.3):
