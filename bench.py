@@ -218,6 +218,182 @@ def _bench_flash_ckpt(nbytes: int = 1 << 30) -> dict:
     return out
 
 
+def _bench_fleet(total_budget_s: float = 120.0) -> dict:
+    """Fleet handoff latency (ISSUE 11): one full borrow+return cycle
+    of the train⇄serve chip-repurposing coordinator with REAL worker
+    processes — ``fleet_borrow_to_first_placement_s`` covers the
+    borrow decision through the durable blocking Flash Checkpoint
+    commit, the rendezvous shrink, a real worker subprocess boot +
+    announce + router join, up to the borrowed replica's FIRST
+    placement; ``fleet_return_to_training_step_s`` covers the return
+    decision through the zero-lost drain, the rendezvous regrow and
+    the first training step of the restored world."""
+    import uuid
+
+    import numpy as np
+
+    from dlrover_tpu.fleet import (
+        FleetCoordinator,
+        ServingPlane,
+        TrainingPlane,
+    )
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.stats.job_collector import (
+        JobMetricCollector,
+    )
+    from dlrover_tpu.serving.remote.supervisor import WorkerSupervisor
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        BrownoutPolicy,
+        ContinuousBatchScheduler,
+        RouterMetrics,
+        ServingRouter,
+    )
+    from dlrover_tpu.serving.router.replica import base_replica_name
+    from dlrover_tpu.trainer.flash_checkpoint import (
+        Checkpointer,
+        SaverMode,
+        StorageType,
+    )
+
+    import os
+    import shutil
+
+    job = uuid.uuid4().hex[:8]
+    os.environ["DLROVER_JOB_UID"] = job
+    ckpt_dir = f"/tmp/dlrover_tpu_bench_fleet_{job}"
+    rdzv = ElasticTrainingRendezvousManager()
+    collector = JobMetricCollector()
+    collector.mark_job_start()
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        metrics=RouterMetrics(window_seconds=0.5),
+        brownout=BrownoutPolicy(enter_pressure=2.0, exit_pressure=0.5,
+                                dwell_seconds=0.2),
+    )
+    for i in range(2):
+        router.join_replica(f"serving-replica-{i}",
+                            FakeEngine(slots=2, tokens_per_step=2))
+    sup = WorkerSupervisor(router=router, engine="fake", respawn=False,
+                           recorder=router.recorder)
+    hosts = {f"host-{r}": r for r in range(3)}
+    state = {"w": np.arange(1 << 16, dtype=np.float32)}
+    ckpt = Checkpointer(ckpt_dir, saver_mode=SaverMode.LOCAL,
+                        local_rank=0, local_world_size=1,
+                        node_rank=0, node_num=1)
+    step_box = {"n": 0}
+
+    def barrier():
+        ok = ckpt.save_checkpoint(step_box["n"], state,
+                                  StorageType.MEMORY, block=True)
+        if not ok:
+            raise RuntimeError("blocking memory save refused")
+        return step_box["n"]
+
+    plane = TrainingPlane(rdzv, hosts, barrier, collector=collector,
+                          min_nodes=1, recorder=router.recorder)
+    coord = FleetCoordinator(
+        plane, ServingPlane(router, sup), min_train_hosts=2,
+        borrow_stage=1, dwell_seconds=0.3, boot_attempts=3)
+    last_round = [None]
+
+    def tick():
+        # fake agents + trainer (real wall clock)
+        expected = set(plane.expected_hosts())
+        for h, r in hosts.items():
+            if h in expected and not rdzv.joined(r):
+                rdzv.join_rendezvous(r, r, 1)
+        if rdzv.num_nodes_waiting() > 0:
+            for r in rdzv.current_world_ranks():
+                rdzv.join_rendezvous(r, r, 1)
+        rdzv.get_comm_world(0)
+        world = rdzv.current_world_ranks()
+        if world and len(world) == plane.target_world:
+            if rdzv.rdzv_round != last_round[0]:
+                last_round[0] = rdzv.rdzv_round
+                restored, st = ckpt.engine.load()
+                if st is not None and restored > 0:
+                    step_box["n"] = int(restored)
+            step_box["n"] += 1
+            collector.report_global_step(step_box["n"], time.time())
+        sup.poll()
+        router.step()
+        coord.poll()
+        # pace the pump: the FakeEngine generates per STEP, and an
+        # unpaced spin would drain the spike faster than the brown-out
+        # dwell can even accumulate — 5ms/step models a real decode
+        time.sleep(0.005)
+
+    out = {}
+    deadline = time.monotonic() + total_budget_s
+    try:
+        while not rdzv.current_world_ranks() and \
+                time.monotonic() < deadline:
+            tick()
+        reqs = [router.submit(
+            np.full(8, i % 251, np.int32), 256) for i in range(150)]
+        while coord.borrows_total < 1 and time.monotonic() < deadline:
+            tick()
+        if coord.borrows_total < 1:
+            return {"fleet_error": "borrow did not complete in budget"}
+        # decision -> first placement of the borrowed replica
+        events = router.recorder.events(4096)
+        decided = next(e["t"] for e in events
+                       if e["kind"] == "fleet_borrow_decided")
+        placed = next(
+            (e["t"] for e in events
+             if e["kind"] == "replica_first_placement"
+             and base_replica_name(str(e.get("replica"))) in hosts),
+            None)
+        while placed is None and time.monotonic() < deadline:
+            tick()
+            placed = next(
+                (e["t"] for e in router.recorder.events(4096)
+                 if e["kind"] == "replica_first_placement"
+                 and base_replica_name(str(e.get("replica"))) in hosts),
+                None)
+        for r in reqs:
+            r.cancel()   # end the spike so the return decision fires
+        while coord.returns_total < 1 and time.monotonic() < deadline:
+            tick()
+        out["fleet_borrow_handoff_s"] = round(
+            coord.last_borrow_handoff_s, 3)
+        if placed is not None:
+            out["fleet_borrow_to_first_placement_s"] = round(
+                placed - decided, 3)
+        if coord.returns_total >= 1:
+            out["fleet_return_to_training_step_s"] = round(
+                coord.last_return_handoff_s, 3)
+        out["fleet_ckpt_barrier_committed_step"] = \
+            plane.last_committed_step
+        out["fleet_debts_retired"] = coord.debts_retired_total
+        out["fleet_single_owner_violations"] = len(coord.verify())
+        g = collector.goodput()
+        out["fleet_planned_elasticity_s"] = round(
+            g["planned_elasticity_s"], 3)
+        out["fleet_note"] = (
+            "borrow = durable blocking ckpt commit + rendezvous "
+            "shrink + REAL worker subprocess boot/announce/join; "
+            "return = zero-lost drain + regrow + first training step"
+        )
+    finally:
+        sup.shutdown()
+        ckpt.close()
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        AsyncCheckpointSaver.reset()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        for f in os.listdir("/dev/shm"):
+            if job in f:
+                try:
+                    os.unlink(os.path.join("/dev/shm", f))
+                except OSError:
+                    pass
+    return out
+
+
 def _bench_long_context(jax, jnp, steps: int = 4, warmup: int = 2) -> dict:
     """MFU at 16k context on one chip (the Pallas flash kernel keeps
     attention memory linear; ring attention extends past one chip).
@@ -476,6 +652,7 @@ _CONFIG_FNS = {
     "realistic": _bench_realistic,
     "longctx": _bench_longctx,
     "ckpt": _bench_ckpt,
+    "fleet": _bench_fleet,
 }
 
 
@@ -537,7 +714,7 @@ def main() -> None:
         return
 
     on_tpu = _probe_tpu()
-    configs = ["primary", "ckpt"]
+    configs = ["primary", "ckpt", "fleet"]
     if on_tpu:
         configs += ["realistic", "longctx"]
     # a result far below the config's long-recorded band is transient

@@ -116,6 +116,26 @@ class AsyncCheckpointSaver:
         # join instead of abandoning them mid-rename (DL002 hygiene)
         self._commit_threads: List[threading.Thread] = []
 
+    # -- metrics ----------------------------------------------------------
+    def metrics(self) -> dict:
+        """Agent-side persistence counters (metric-source contract:
+        plain name -> float), scraped over HTTP via the elastic
+        agent's :class:`~dlrover_tpu.utils.profiler.MetricsExporter`
+        (names registered in utils/metric_registry.py).
+
+        Deliberately lock-free: ``_persist_mutex`` is held across an
+        ENTIRE multi-shard persist+commit pass (tens of seconds for a
+        large state), and a scrape must not stall behind exactly the
+        persistence it exists to observe.  Both fields are plain ints
+        whose reads are atomic under CPython; a scrape racing a
+        persist reads the previous value, which is what a gauge
+        sampled mid-operation means anyway."""
+        return {
+            "dlrover_ckpt_persists_total": float(self._persist_count),
+            "dlrover_ckpt_last_persisted_step": float(
+                self._last_persisted_step),
+        }
+
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
         self._thread = threading.Thread(

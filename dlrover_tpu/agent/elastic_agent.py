@@ -433,6 +433,51 @@ class ElasticAgent:
         self._training_monitor = None
         self._resource_monitor = None
         self._hang_detector = None
+        self.metrics_exporter = None
+
+    def start_metrics_exporter(self, port: int = 0) -> int:
+        """Serve the agent's self-healing counters over HTTP — the
+        ``dlrover_agent_*`` dict (heartbeat outages, rendezvous
+        rounds/rejoins, restarts, breakpoint saves) plus the agent-side
+        checkpoint-persistence counters (``dlrover_ckpt_persists_*``
+        from the :class:`AsyncCheckpointSaver` living in this process),
+        rendered with the metric registry's help text on ``/metrics``.
+        ``port=0`` binds a kernel-assigned port (the project's
+        race-free port idiom) and the chosen port is announced on
+        stdout as ``DLROVER_AGENT_METRICS_PORT=<port>``.  Returns the
+        bound port."""
+        from dlrover_tpu.utils.profiler import MetricsExporter
+
+        exporter = MetricsExporter(port=port)
+        exporter.add_source(self.metrics)
+
+        def _saver_metrics():
+            from dlrover_tpu.agent.ckpt_saver import (
+                AsyncCheckpointSaver,
+            )
+
+            saver = AsyncCheckpointSaver.get_ckpt_saver()
+            if saver is None:
+                return {}
+            return saver.metrics()
+
+        exporter.add_source(_saver_metrics)
+        exporter.start()
+        self.metrics_exporter = exporter
+        # stdout announce, flushed: a supervisor piping us reads the
+        # port the same way it reads the master/worker announces
+        from dlrover_tpu.common.constants import NodeEnv
+
+        print(f"{NodeEnv.AGENT_METRICS_ANNOUNCE_PREFIX}"
+              f"{exporter.port}", flush=True)
+        logger.info("agent metrics exporter on 127.0.0.1:%d",
+                    exporter.port)
+        return exporter.port
+
+    def stop_metrics_exporter(self) -> None:
+        if self.metrics_exporter is not None:
+            self.metrics_exporter.stop()
+            self.metrics_exporter = None
 
     def _count(self, name: str, n: float = 1.0) -> None:
         with self._metrics_lock:

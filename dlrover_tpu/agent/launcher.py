@@ -96,6 +96,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="rendezvous admits node counts that are multiples of this "
              "(TPU: hosts per pod slice)",
     )
+    p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve the agent's dlrover_agent_*/dlrover_ckpt_* "
+             "counters on this HTTP port (0 = kernel-assigned, "
+             "announced on stdout as DLROVER_AGENT_METRICS_PORT=; "
+             "omit to disable the endpoint)",
+    )
     p.add_argument("--master-addr", default=os.getenv(NodeEnv.MASTER_ADDR, ""))
     p.add_argument("training_script", help="program to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -211,9 +218,12 @@ def run(args: argparse.Namespace) -> int:
         hang_grace_period=args.hang_grace_period,
     )
     agent = ElasticAgent(client, args.node_rank, spec)
+    if args.metrics_port is not None:
+        agent.start_metrics_exporter(args.metrics_port)
     try:
         return agent.run()
     finally:
+        agent.stop_metrics_exporter()
         agent.stop_heartbeat()
         client.close()
         if master_proc is not None:

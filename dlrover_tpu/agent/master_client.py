@@ -362,6 +362,19 @@ class MasterClient:
             )
         )
 
+    def report_planned_elasticity(
+        self, action: str, reason: str = "", timestamp: float = 0.0
+    ):
+        """Tell the master's goodput ledger a coordinator-initiated
+        membership change begins/ends (fleet borrow/return) — charged
+        as planned elasticity, not downtime."""
+        return self._report(
+            comm.PlannedElasticityEvent(
+                action=action, reason=reason,
+                timestamp=timestamp or time.time(),
+            )
+        )
+
     def report_heart_beat(self, timestamp: float = 0.0) -> str:
         reply = self._report(
             comm.HeartBeat(

@@ -60,10 +60,14 @@ class ServingScalePolicy:
         self.ttft_high = ttft_high
         self.step = int(step)
 
-    def decide(
+    def raw_desired(
         self, samples: Sequence[ServingSignal], current_replicas: int
     ) -> int:
-        """Desired replica count (== ``current_replicas`` for no-op)."""
+        """The UNCLAMPED replica count the signals call for.  Anything
+        above ``max_replicas`` is demand the serving pool cannot
+        satisfy from its own capacity — the fleet coordinator's borrow
+        trigger reads exactly that overflow
+        (:meth:`ServingAutoScaler.unmet_demand`)."""
         current = max(1, int(current_replicas))
         if not samples:
             return current
@@ -74,9 +78,17 @@ class ServingScalePolicy:
             self.ttft_high is not None and ttft > self.ttft_high
         )
         if per_replica > self.queue_high or ttft_pressure:
-            desired = current + self.step
-        elif per_replica < self.queue_low and not ttft_pressure:
-            desired = current - self.step
-        else:
-            desired = current
-        return max(self.min_replicas, min(self.max_replicas, desired))
+            return current + self.step
+        if per_replica < self.queue_low and not ttft_pressure:
+            return current - self.step
+        return current
+
+    def decide(
+        self, samples: Sequence[ServingSignal], current_replicas: int
+    ) -> int:
+        """Desired replica count (== ``current_replicas`` for no-op)."""
+        return max(
+            self.min_replicas,
+            min(self.max_replicas,
+                self.raw_desired(samples, current_replicas)),
+        )

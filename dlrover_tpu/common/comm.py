@@ -322,6 +322,17 @@ class NodeFailure:
 
 
 @comm_message
+class PlannedElasticityEvent:
+    """Fleet-coordinator notification: a DELIBERATE membership change
+    (borrow/return shrink+regrow) begins or ends — the goodput ledger
+    charges the window as planned elasticity, not downtime."""
+
+    action: str = ""       # "begin" | "end"
+    reason: str = ""
+    timestamp: float = 0.0
+
+
+@comm_message
 class NodeEventReport:
     event_type: str = ""
     instance: str = ""

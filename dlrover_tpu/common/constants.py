@@ -108,6 +108,60 @@ SERVING_REQUEST_TRANSITIONS = {
 }
 
 
+class FleetOwner:
+    """Ownership of one host in the shared train/serve fleet — the
+    lease states of the :mod:`dlrover_tpu.fleet` coordinator's ledger.
+
+    Every host has EXACTLY ONE owner at any instant.  The two
+    ``MIGRATING_*`` states are the in-flight halves of a handoff: a
+    host is never simultaneously a rendezvous member and a serving
+    replica — the coordinator moves it through a migrating state, and
+    a crash mid-migration is recovered by re-deriving the lease from
+    ground truth (master rendezvous membership + worker supervisor),
+    never by trusting a stale claim (epoch fencing)."""
+
+    TRAINING = "Training"            # rendezvous member, training world
+    MIGRATING_OUT = "MigratingOut"   # checkpointed + shrunk, serving
+    #                                  worker not yet joined the router
+    SERVING = "Serving"              # serving replica taking traffic
+    MIGRATING_BACK = "MigratingBack"  # draining / rejoining rendezvous
+
+
+# THE transition spec for FleetOwner — the DL009-style single source of
+# truth next to the enum, same contract as
+# SERVING_REQUEST_TRANSITIONS below: the runtime
+# (fleet/lease.LeaseLedger.transition) and static analysis (dlint
+# DL009's extra-spec drift pass) both read THIS declaration, so a new
+# owner state without a declared lifecycle, or a spec naming a
+# non-state, is a dlint finding before it is a production surprise.
+#
+# The machine is a cycle with two abort edges and no terminal states —
+# a host is repurposed forever, never retired by the coordinator:
+#   TRAINING -> MIGRATING_OUT -> SERVING -> MIGRATING_BACK -> TRAINING
+# MIGRATING_OUT -> TRAINING is the borrow abort (checkpoint barrier
+# failed, or the worker never booted within its attempt budget);
+# MIGRATING_BACK -> SERVING is the return abort (pressure spiked again
+# before the host left the router).
+FLEET_HOST_TERMINAL_STATES = ()
+
+FLEET_HOST_TRANSITIONS = {
+    FleetOwner.TRAINING: (
+        FleetOwner.MIGRATING_OUT,
+    ),
+    FleetOwner.MIGRATING_OUT: (
+        FleetOwner.SERVING,
+        FleetOwner.TRAINING,   # borrow aborted: give the host back
+    ),
+    FleetOwner.SERVING: (
+        FleetOwner.MIGRATING_BACK,
+    ),
+    FleetOwner.MIGRATING_BACK: (
+        FleetOwner.TRAINING,
+        FleetOwner.SERVING,    # return aborted: keep serving
+    ),
+}
+
+
 class ServingFabric:
     """Serving data-plane knobs (router + remote replica fabric)."""
 
@@ -207,6 +261,10 @@ class NodeEnv:
     # the kernel-assigned port — same race-free idiom as the serving
     # worker's WORKER_ANNOUNCE_PREFIX).
     MASTER_ANNOUNCE_PREFIX = "DLROVER_MASTER_ADDR="
+    # Stdout announce of the elastic agent's metrics-exporter port
+    # (--metrics-port 0 binds a kernel-assigned port; the agent
+    # announces what it got — same idiom as the other announces).
+    AGENT_METRICS_ANNOUNCE_PREFIX = "DLROVER_AGENT_METRICS_PORT="
 
 
 class ConfigPath:

@@ -125,6 +125,16 @@ class DlintConfig:
     # the class owning the guarded ``abort()`` implementation
     request_class: str = "ServingRequest"
     request_module: str = "serving/router/gateway.py"
+    # additional state machines whose (enum, transitions, terminal)
+    # triple lives in constants_module and must never drift (DL009 runs
+    # its spec-consistency pass over each; the runtimes enforce the
+    # transitions themselves — e.g. fleet/lease.LeaseLedger).  A triple
+    # whose enum is absent from the scanned constants module is skipped
+    # (fixture trees / older checkouts), so the list is additive-safe.
+    extra_transition_specs: Tuple[Tuple[str, str, str], ...] = (
+        ("FleetOwner", "FLEET_HOST_TRANSITIONS",
+         "FLEET_HOST_TERMINAL_STATES"),
+    )
     # duck-typed fan-out: an attribute call with an unknown receiver
     # resolves to every project class defining the method, but only
     # when at most this many do (common names resolve nowhere rather
@@ -1110,6 +1120,22 @@ class StateTransitionChecker(Checker):
         )
         if spec is not None and scanned_constants:
             yield from self._drift(constants, spec, cfg)
+        if constants is not None and scanned_constants:
+            # extra state machines (fleet host leases, …): the same
+            # enum<->spec drift pass, one per declared triple.  Write
+            # sites are enforced by their runtimes (the ledgers read
+            # the spec); what dlint guarantees is that the declaration
+            # they read can never rot.
+            for state_cls, trans_decl, term_decl in \
+                    cfg.extra_transition_specs:
+                sub = dataclasses.replace(
+                    cfg, state_class=state_cls,
+                    transitions_decl=trans_decl,
+                    terminal_decl=term_decl)
+                extra = self._load_spec(constants, sub)
+                if extra is None:
+                    continue  # enum absent from this tree: opt-in
+                yield from self._drift(constants, extra, sub)
         program = project.program
         by_path = {m.rel_path: m for m in project.modules}
         abort_guarded = self._abort_impl_guarded(project, program, spec)

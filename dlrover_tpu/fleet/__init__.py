@@ -1,0 +1,35 @@
+"""One fleet, two workloads — crash-safe train⇄serve chip repurposing.
+
+The coordinator that moves hosts between the elastic-training runtime
+and the serving fabric under demand, lease-fenced and recoverable at
+any crash point (see :mod:`dlrover_tpu.fleet.coordinator` for the full
+design notes; the state machine's transition spec lives next to the
+``FleetOwner`` enum in :mod:`dlrover_tpu.common.constants` and is
+drift-checked by dlint DL009).
+"""
+
+from dlrover_tpu.fleet.coordinator import (
+    FleetCoordinator,
+    ServingPlane,
+)
+from dlrover_tpu.fleet.lease import (
+    HostLease,
+    LeaseLedger,
+    LeaseTransitionError,
+    StaleLeaseError,
+)
+from dlrover_tpu.fleet.training_plane import (
+    CheckpointBarrierError,
+    TrainingPlane,
+)
+
+__all__ = [
+    "CheckpointBarrierError",
+    "FleetCoordinator",
+    "HostLease",
+    "LeaseLedger",
+    "LeaseTransitionError",
+    "ServingPlane",
+    "StaleLeaseError",
+    "TrainingPlane",
+]

@@ -1,0 +1,756 @@
+"""FleetCoordinator — crash-safe train⇄serve chip repurposing.
+
+One fleet, two workloads: the coordinator moves hosts between the
+elastic-training runtime (master rendezvous + Flash Checkpoint) and
+the serving fabric (router + worker supervisor) so chips follow
+demand, with FAULT TOLERANCE as the design center:
+
+**Borrow path** (serving pressure sustained):
+  1. decide — brown-out stage / unmet ``ServingScalePolicy`` demand
+     above the borrow threshold for a full dwell, and the training
+     world stays at or above ``min_train_hosts`` after the loan;
+  2. lease ``TRAINING -> MIGRATING_OUT`` (epoch-fenced) + open the
+     borrow debt (a deliberate loan, retired exactly once);
+  3. the release barrier: a DURABLE BLOCKING Flash Checkpoint commit,
+     then the world shrinks through the rendezvous
+     (:meth:`TrainingPlane.shrink` — commit-before-evict is what makes
+     every crash point recoverable from membership alone);
+  4. the freed host boots a serving worker
+     (:class:`~dlrover_tpu.serving.remote.supervisor.WorkerSupervisor`)
+     and joins the router; on join the lease moves to ``SERVING`` and
+     the debt retires — exactly once.
+
+**Return path** (pressure gone, or the starvation guard):
+  drain the replica through the router's zero-lost drain, hand the
+  host back to the rendezvous (:meth:`TrainingPlane.regrow`), and the
+  lease returns to ``TRAINING`` when training steps again from the
+  committed generation.
+
+**Crash recovery**: the coordinator keeps no authoritative state.  A
+new incarnation bumps the lease epoch (fencing off any zombie claim)
+and re-derives every lease from ground truth — master membership,
+supervisor process table, router replica set — using the journaled
+owner only as the *intent* hint for hosts momentarily in neither
+world (mid-borrow vs mid-return).  A host in neither world with no
+journal defaults to MIGRATING_BACK: returning capacity to the durable
+workload is the safe direction, and pressure re-decides the borrow.
+
+The goodput ledger charges each shrink/regrow window as *planned*
+elasticity (:meth:`JobMetricCollector.begin_planned_elasticity`), not
+downtime; a real crash inside a borrow window is still downtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import FleetOwner
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.fleet.lease import LeaseLedger, StaleLeaseError
+from dlrover_tpu.fleet.training_plane import (
+    CheckpointBarrierError,
+    TrainingPlane,
+)
+from dlrover_tpu.serving.router.replica import base_replica_name
+
+
+class ServingPlane:
+    """Coordinator-facing adapter over the serving fabric: the router
+    (membership + drain), the worker supervisor (process boot/reap on
+    borrowed hosts) and, optionally, the autoscaler + brown-out policy
+    (the demand signals)."""
+
+    def __init__(self, router, supervisor, autoscaler=None,
+                 brownout=None):
+        self.router = router
+        self.supervisor = supervisor
+        self.autoscaler = autoscaler
+        self.brownout = brownout if brownout is not None \
+            else getattr(router, "brownout", None)
+
+    # ----------------------------------------------------- demand signal
+    def pressure_stage(self) -> int:
+        return 0 if self.brownout is None else int(self.brownout.stage)
+
+    def unmet_demand(self) -> int:
+        """Replicas the scale policy wants but cannot get from the
+        serving pool (beyond ``max_replicas``) — the 'serving cannot
+        satisfy this from free capacity' half of the borrow trigger."""
+        if self.autoscaler is None:
+            return 0
+        return int(getattr(self.autoscaler, "unmet_demand", 0))
+
+    # ------------------------------------------------- host observations
+    def worker_joined(self, host: str) -> bool:
+        """Is a replica for this host serving in the router (respawn
+        suffixes normalized)?"""
+        return any(base_replica_name(n) == host
+                   for n in self.router.replica_names)
+
+    def worker_alive(self, host: str) -> bool:
+        """Does the supervisor hold a live worker process for this
+        host (booted but possibly not joined yet)?"""
+        return host in self.supervisor.live_worker_bases()
+
+    def drained(self, host: str) -> bool:
+        """The host carries no serving responsibility any more: not in
+        the router, no live worker process."""
+        return not self.worker_joined(host) and \
+            not self.worker_alive(host)
+
+    # ------------------------------------------------------ host actions
+    def boot_worker(self, host: str):
+        """Launch the serving worker process on a freed host and join
+        it to the router.  Unmanaged: the COORDINATOR owns this
+        worker's lifecycle (a death reopens the borrow debt), the
+        supervisor's own respawn loop must not fight it.  Raises on
+        boot failure (announce timeout, SIGKILL mid-boot) — the caller
+        retries within its attempt budget."""
+        # reap first: a RE-boot after the previous worker died reuses
+        # the host name, and spawn refuses a name still occupied by
+        # the dead record until a supervisor poll reaps it — without
+        # this, every coordinator poll between deployment supervisor
+        # polls would burn one boot attempt on 'already supervised'
+        self.supervisor.poll()
+        return self.supervisor.spawn(name=host, join=True,
+                                     managed=False)
+
+    def begin_drain(self, host: str) -> None:
+        for name in list(self.router.replica_names):
+            if base_replica_name(name) == host:
+                self.router.begin_drain(name)
+
+
+class FleetCoordinator:
+    """Lease-fenced, exactly-once capacity handoff between training
+    and serving (see module docstring)."""
+
+    def __init__(
+        self,
+        training: TrainingPlane,
+        serving: ServingPlane,
+        ledger: Optional[LeaseLedger] = None,
+        journal_path: Optional[str] = None,
+        min_train_hosts: int = 1,
+        borrow_stage: int = 1,
+        dwell_seconds: float = 1.0,
+        boot_attempts: int = 5,
+        now: Optional[float] = None,
+    ):
+        self.training = training
+        self.serving = serving
+        self.min_train_hosts = max(int(min_train_hosts),
+                                   training.min_hosts)
+        self.borrow_stage = int(borrow_stage)
+        self.dwell_seconds = float(dwell_seconds)
+        self.boot_attempts = int(boot_attempts)
+        self.recorder = getattr(serving.router, "recorder", None)
+        self.tracer = getattr(serving.router, "tracer", None)
+        self.ledger = ledger if ledger is not None else \
+            LeaseLedger(journal_path)
+        # in-flight migrations: host -> {kind, phase, t0, ...}
+        self.migrations: Dict[str, dict] = {}
+        # capacity-handoff debts, PR-8 discipline: a borrow/return is a
+        # deliberate debt opened at decision time and retired EXACTLY
+        # once (serving join / training re-admit) — never silently
+        # dropped, never retired twice, reopened as a NEW episode only
+        # when a retired borrow's worker dies while still on loan
+        self.debts: Dict[str, dict] = {}
+        self.borrows_total = 0
+        self.returns_total = 0
+        self.borrow_aborts_total = 0
+        self.worker_reboots_total = 0
+        self.debts_retired_total = 0
+        self.debts_reopened_total = 0
+        self.recoveries_total = 0
+        self.last_borrow_handoff_s = 0.0
+        self.last_return_handoff_s = 0.0
+        self.fenced = False
+        self._unit_refusal_logged = False
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        now = time.monotonic() if now is None else now
+        # every incarnation is a new epoch: anything the previous one
+        # still thinks it may do is fenced the moment we exist
+        self.epoch = self.ledger.bump_epoch()
+        self._recover(now)
+
+    # ========================================================== recovery
+    def _recover(self, now: float) -> None:
+        """Re-derive every lease from ground truth; the journal only
+        breaks the tie for hosts in neither world (borrow vs return
+        intent).  Idempotent: a fresh start over an all-training fleet
+        just installs TRAINING leases."""
+        self.recoveries_total += 1
+        alive = set(self.training.alive_hosts())
+        journal = dict(self.ledger.owners())  # pre-recovery snapshot
+        # ghost leases (hosts decommissioned from the inventory since
+        # the journal was written) must not survive: a 'return' of a
+        # rankless host would inflate the strict-world target forever
+        self.ledger.prune(self.training.hosts)
+        for host in self.training.hosts:
+            joined = self.serving.worker_joined(host)
+            worker = self.serving.worker_alive(host)
+            in_training = host in alive
+            intent = journal.get(host)
+            if joined and in_training:
+                # the invariant the ledger exists to keep is broken in
+                # the WORLD, not just the books — keep serving traffic,
+                # push the host out of the next training round.
+                # exclude(), not shrink(): no checkpoint barrier (we
+                # are not releasing training state, only correcting
+                # membership), so recovery can never die on a storage
+                # hiccup here with the epoch already bumped
+                logger.error(
+                    "fleet recovery: host %s is BOTH a rendezvous "
+                    "member and a serving replica — forcing the "
+                    "training side out (traffic wins)", host)
+                self.training.exclude([host], now)
+                self.ledger.acquire(host, FleetOwner.SERVING,
+                                    self.epoch, now)
+            elif joined:
+                # reconcile a freshly constructed plane (it starts
+                # expecting everyone): the rendezvous must not wait
+                # for a host that is busy serving traffic
+                self.training.exclude([host], now)
+                if intent == FleetOwner.MIGRATING_BACK:
+                    # a return was in flight: the lease stays in the
+                    # migrating state and the drain re-begins
+                    self.ledger.acquire(host,
+                                        FleetOwner.MIGRATING_BACK,
+                                        self.epoch, now)
+                    self._resume_return(host, now)
+                else:
+                    self.ledger.acquire(host, FleetOwner.SERVING,
+                                        self.epoch, now)
+            elif in_training:
+                self.ledger.acquire(host, FleetOwner.TRAINING,
+                                    self.epoch, now)
+            elif worker and intent == FleetOwner.MIGRATING_BACK:
+                # mid-return crash in the retire-to-exit gap: the
+                # router already dropped the replica (GOODBYE sent)
+                # but the worker process has not exited yet.  The
+                # journal breaks the tie: this is a RETURN — resuming
+                # it as a borrow would boot a brand-new worker for a
+                # host the fleet decided to take home
+                self.training.exclude([host], now)
+                self.ledger.acquire(host, FleetOwner.MIGRATING_BACK,
+                                    self.epoch, now)
+                self._resume_return(host, now, phase="drain")
+            elif worker:
+                # booted but not joined: a borrow one step from done
+                self.training.exclude([host], now)
+                self.ledger.acquire(host, FleetOwner.MIGRATING_OUT,
+                                    self.epoch, now)
+                self._resume_borrow(host, now)
+            elif intent == FleetOwner.TRAINING:
+                # ground truth is momentarily silent (e.g. the master
+                # itself restarted and agents have not re-registered
+                # yet) but the journal says the host was training-owned
+                # with no migration in flight: keep the lease, the
+                # agent re-joins on its own
+                self.ledger.acquire(host, FleetOwner.TRAINING,
+                                    self.epoch, now)
+            elif intent == FleetOwner.MIGRATING_OUT and max(
+                    len(alive),
+                    len(self.training.expected_hosts())
+            ) >= self.min_train_hosts:
+                # the starvation guard reads the EXPECTED world too: a
+                # master that restarted empty mid-borrow says nothing
+                # about training being starved — the survivors are
+                # about to re-register
+                # mid-borrow crash after the shrink (absence from the
+                # training world PROVES the checkpoint committed —
+                # commit-before-evict), before the worker boot: finish
+                # the borrow
+                self.training.exclude([host], now)
+                self.ledger.acquire(host, FleetOwner.MIGRATING_OUT,
+                                    self.epoch, now)
+                self._resume_borrow(host, now)
+            elif intent is not None:
+                # THIS host has a journaled in-flight state (mid-return
+                # crash, or a resumed borrow the starvation guard
+                # refuses): give it back to the durable workload (the
+                # safe direction); pressure re-decides any borrow
+                self.ledger.acquire(host, FleetOwner.MIGRATING_BACK,
+                                    self.epoch, now)
+                self._resume_return(host, now, phase="regrow")
+            else:
+                # no journaled intent for THIS host (fresh fleet still
+                # forming, or a host newly added to the inventory whose
+                # agent has not registered yet): hosts are
+                # training-native — their agents join the rendezvous
+                # on their own; inventing a migration here would mint
+                # phantom returns that pollute the exactly-once audit
+                self.ledger.acquire(host, FleetOwner.TRAINING,
+                                    self.epoch, now)
+        if self.recorder is not None:
+            self.recorder.record(
+                "fleet_recovered", epoch=self.epoch,
+                owners=self.ledger.owners(), now=now)
+        logger.info("fleet coordinator epoch %d recovered leases: %s",
+                    self.epoch, self.ledger.owners())
+
+    def _resume_borrow(self, host: str, now: float) -> None:
+        self._open_debt(f"borrow:{host}", host, "borrow", now)
+        self.migrations[host] = {
+            "kind": "borrow", "phase": "boot", "t0": now,
+            "attempts": 0, "committed_step":
+                self.training.last_committed_step,
+            "root": self._start_trace(host, "borrow", now,
+                                      resumed=True),
+        }
+
+    def _resume_return(self, host: str, now: float,
+                       phase: str = "drain") -> None:
+        self._open_debt(f"return:{host}", host, "return", now)
+        if phase == "drain":
+            self.serving.begin_drain(host)
+        else:
+            self.training.regrow([host], now)
+        self.migrations[host] = {
+            "kind": "return", "phase": phase, "t0": now,
+            "attempts": 0,
+            "root": self._start_trace(host, "return", now,
+                                      resumed=True),
+        }
+
+    # ============================================================= drive
+    def poll(self, now: Optional[float] = None) -> None:
+        """One control round: advance in-flight migrations, then maybe
+        decide a new borrow/return.  Synchronous and lock-free by
+        design (the chaos tests drive it step-by-step); a deployment
+        wraps it in the router's serve loop."""
+        now = time.monotonic() if now is None else now
+        if self.fenced:
+            return  # a successor incarnation owns the fleet now
+        try:
+            self._advance(now)
+            self._repair_borrowed(now)
+            self._decide(now)
+            self.training.poll(now)
+        except StaleLeaseError as e:
+            # a successor bumped the epoch under us: this incarnation
+            # is DEAD to the ledger — go inert instead of fighting
+            self.fenced = True
+            logger.error("fleet coordinator epoch %d fenced: %s",
+                         self.epoch, e)
+
+    # ------------------------------------------------------ advancement
+    def _advance(self, now: float) -> None:
+        for host, mig in sorted(self.migrations.items()):
+            if mig["kind"] == "borrow":
+                self._advance_borrow(host, mig, now)
+            else:
+                self._advance_return(host, mig, now)
+
+    def _advance_borrow(self, host: str, mig: dict, now: float) -> None:
+        if mig["phase"] == "checkpoint":
+            # the durable BLOCKING commit runs OFF the control loop
+            # (same DL007 class as the worker boots below: a large
+            # state committing to real storage takes seconds, and
+            # every other migration would freeze behind it); the
+            # barrier touches no plane state, the membership change
+            # (apply_shrink) happens HERE once the verdict is in
+            thread = mig.get("ckpt_thread")
+            if thread is None:
+                def _barrier(mig=mig):
+                    try:
+                        mig["ckpt_step"] = \
+                            self.training.checkpoint_barrier()
+                    except CheckpointBarrierError as e:
+                        mig["ckpt_error"] = e
+
+                thread = threading.Thread(
+                    target=_barrier, name=f"fleet-ckpt-{host}",
+                    daemon=True)
+                mig["ckpt_thread"] = thread
+                thread.start()
+                return
+            if thread.is_alive():
+                return  # commit still running; poll again next round
+            mig["ckpt_thread"] = None
+            err = mig.pop("ckpt_error", None)
+            if err is not None:
+                # nothing shrank: the borrow aborts cleanly, the host
+                # never left the training world
+                logger.error(
+                    "fleet borrow of %s aborted at the checkpoint "
+                    "barrier: %s", host, err)
+                self.ledger.transition(host, FleetOwner.TRAINING,
+                                       self.epoch, now)
+                self._retire_debt(f"borrow:{host}", "ckpt_failed", now)
+                self._finish_trace(mig, "aborted", now)
+                self.borrow_aborts_total += 1
+                del self.migrations[host]
+                return
+            mig["committed_step"] = self.training.apply_shrink(
+                [host], mig.pop("ckpt_step"), now)
+            self._span(mig, "ckpt_commit", now,
+                       step=mig["committed_step"])
+            mig["phase"] = "boot"
+            if self.recorder is not None:
+                self.recorder.record(
+                    "fleet_borrow_shrunk", host=host,
+                    committed_step=mig["committed_step"], now=now)
+        if mig["phase"] == "boot":
+            if self.serving.worker_joined(host):
+                reboot = self.ledger.owner(host) == FleetOwner.SERVING
+                if not reboot:
+                    # a REBOOT of a still-SERVING-owned borrowed host
+                    # (debt reopened) keeps its lease; only a first
+                    # borrow transitions MIGRATING_OUT -> SERVING
+                    self.ledger.transition(host, FleetOwner.SERVING,
+                                           self.epoch, now,
+                                           migration_id=None)
+                self._retire_debt(f"borrow:{host}", "serving_joined",
+                                  now)
+                self._span(mig, "serving_join", now)
+                self._finish_trace(mig, "ok", now)
+                if reboot:
+                    # a reboot ran no checkpoint and shrank nothing:
+                    # counting it as a borrow (or letting its cheap
+                    # respawn latency overwrite the real handoff
+                    # number) would corrupt both the dashboard and the
+                    # borrows+returns+aborts vs debts_retired audit
+                    self.worker_reboots_total += 1
+                    if self.recorder is not None:
+                        self.recorder.record(
+                            "fleet_reboot_done", host=host, now=now)
+                else:
+                    self.last_borrow_handoff_s = now - mig["t0"]
+                    self.borrows_total += 1
+                    if self.recorder is not None:
+                        self.recorder.record(
+                            "fleet_borrow_done", host=host,
+                            handoff_s=round(
+                                self.last_borrow_handoff_s, 4),
+                            now=now)
+                del self.migrations[host]
+                return
+            if self.serving.worker_alive(host):
+                return  # booted, join lands via the router's next step
+            # boots run OFF the control loop: a spawn blocks up to the
+            # supervisor's announce timeout (30s default), and holding
+            # poll() across it would freeze every other migration at
+            # exactly the brown-out moment the borrow exists to relieve
+            # (the same blocking-work-in-the-pump class DL007 evicted
+            # from the router step)
+            thread = mig.get("boot_thread")
+            if thread is not None:
+                if thread.is_alive():
+                    return  # still spawning; check again next poll
+                mig["boot_thread"] = None
+                err = mig.pop("boot_error", None)
+                if err is None:
+                    # spawn returned: the join is observed (or the
+                    # brand-new worker's death is repaired) next poll
+                    self._span(mig, "worker_boot", now,
+                               attempt=mig["attempts"] + 1)
+                    return
+                mig["attempts"] += 1
+                logger.warning(
+                    "fleet borrow: worker boot on %s failed "
+                    "(attempt %d/%d): %s", host, mig["attempts"],
+                    self.boot_attempts, err)
+                if mig["attempts"] >= self.boot_attempts:
+                    # the host cannot serve: give it back
+                    logger.error(
+                        "fleet borrow of %s aborted after %d boot "
+                        "failures; returning host to training",
+                        host, mig["attempts"])
+                    self.training.regrow([host], now)
+                    mig["phase"] = "abort_regrow"
+                    self.borrow_aborts_total += 1
+                return
+
+            def _boot(mig=mig, host=host):
+                try:
+                    self.serving.boot_worker(host)
+                except Exception as e:  # surfaced to the next poll
+                    mig["boot_error"] = e
+
+            thread = threading.Thread(
+                target=_boot, name=f"fleet-boot-{host}", daemon=True)
+            mig["boot_thread"] = thread
+            thread.start()
+            return
+        if mig["phase"] == "abort_regrow":
+            if host in self.training.alive_hosts():
+                if self.ledger.owner(host) == FleetOwner.SERVING:
+                    # a REBOOT abort starts from a SERVING lease (the
+                    # original borrow completed); walk the declared
+                    # edges home instead of jumping them
+                    self.ledger.transition(host,
+                                           FleetOwner.MIGRATING_BACK,
+                                           self.epoch, now)
+                self.ledger.transition(host, FleetOwner.TRAINING,
+                                       self.epoch, now)
+                self._retire_debt(f"borrow:{host}", "boot_failed", now)
+                self._finish_trace(mig, "aborted", now)
+                del self.migrations[host]
+
+    def _advance_return(self, host: str, mig: dict, now: float) -> None:
+        if mig["phase"] == "drain":
+            if not self.serving.drained(host):
+                return
+            self._span(mig, "drained", now)
+            self.training.regrow([host], now)
+            mig["phase"] = "regrow"
+            if self.recorder is not None:
+                self.recorder.record("fleet_return_drained",
+                                     host=host, now=now)
+        if mig["phase"] == "regrow":
+            if host not in self.training.world_hosts() or \
+                    not self.training.resumed(now):
+                return
+            self.ledger.transition(host, FleetOwner.TRAINING,
+                                   self.epoch, now)
+            self._retire_debt(f"return:{host}", "training_joined", now)
+            self.last_return_handoff_s = now - mig["t0"]
+            self._span(mig, "training_resume", now,
+                       step=self.training.training_step())
+            self._finish_trace(mig, "ok", now)
+            self.returns_total += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "fleet_return_done", host=host,
+                    handoff_s=round(self.last_return_handoff_s, 4),
+                    step=self.training.training_step(), now=now)
+            del self.migrations[host]
+
+    def _repair_borrowed(self, now: float) -> None:
+        """A borrowed (SERVING-owned) host whose worker died is lost
+        serving capacity the coordinator loaned out — reopen the debt
+        as a NEW episode and re-boot, exactly like PR 8's replacement
+        reopen (a deliberate drain, i.e. an open return migration, is
+        NOT a new loss)."""
+        for host in self.ledger.hosts_owned_by(FleetOwner.SERVING):
+            if host in self.migrations:
+                continue
+            if self.serving.worker_joined(host) or \
+                    self.serving.worker_alive(host):
+                continue
+            key = f"borrow:{host}"
+            old = self.debts.pop(key, None)
+            if old is not None:
+                self.debts_reopened_total += 1
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "fleet_debt_reopened", key=key, host=host,
+                        now=now)
+            logger.warning(
+                "fleet: borrowed worker on %s died while on loan — "
+                "reopening the borrow debt and re-booting", host)
+            self._open_debt(key, host, "borrow", now)
+            self.migrations[host] = {
+                "kind": "borrow", "phase": "boot", "t0": now,
+                "attempts": 0,
+                "committed_step": self.training.last_committed_step,
+                "root": self._start_trace(host, "borrow", now,
+                                          reboot=True),
+            }
+
+    # -------------------------------------------------------- decisions
+    def _pressure_high(self) -> bool:
+        return (self.serving.pressure_stage() >= self.borrow_stage
+                or self.serving.unmet_demand() > 0)
+
+    def _decide(self, now: float) -> None:
+        high = self._pressure_high()
+        if high:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if now - self._above_since >= self.dwell_seconds:
+                self._maybe_borrow(now)
+                self._above_since = now  # one loan per earned dwell
+        else:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if now - self._below_since >= self.dwell_seconds:
+                self._maybe_return(now)
+                self._below_since = now
+
+    def _maybe_borrow(self, now: float) -> None:
+        owned = self.ledger.hosts_owned_by(FleetOwner.TRAINING)
+        candidates = [h for h in owned if h not in self.migrations]
+        # the starvation guard: never loan the training world below its
+        # floor, counting loans already in flight
+        lendable = len(candidates) - self.min_train_hosts
+        if lendable <= 0 or not candidates:
+            return
+        unit = self.training.node_unit
+        if unit > 1 and (self.training.target_world - 1) % unit != 0:
+            # slice alignment: shrinking by one host would leave a
+            # world size the unit-rounded rendezvous can never form
+            # (survivors idle outside it forever) — borrowing whole
+            # slices is a ROADMAP item; until then, refuse.  Logged
+            # once per refused episode, not once per dwell (pressure
+            # re-enters here every second for the whole episode)
+            if not self._unit_refusal_logged:
+                self._unit_refusal_logged = True
+                logger.warning(
+                    "fleet borrow refused: world %d - 1 breaks the "
+                    "node_unit=%d slice alignment (borrow whole "
+                    "slices instead)", self.training.target_world,
+                    unit)
+            return
+        self._unit_refusal_logged = False
+        host = candidates[-1]  # highest-ranked host leaves first
+        self.ledger.transition(host, FleetOwner.MIGRATING_OUT,
+                               self.epoch, now,
+                               migration_id=f"borrow:{host}")
+        self._open_debt(f"borrow:{host}", host, "borrow", now)
+        self.migrations[host] = {
+            "kind": "borrow", "phase": "checkpoint", "t0": now,
+            "attempts": 0, "committed_step": -1,
+            "root": self._start_trace(host, "borrow", now),
+        }
+        if self.recorder is not None:
+            self.recorder.record(
+                "fleet_borrow_decided", host=host,
+                stage=self.serving.pressure_stage(),
+                unmet=self.serving.unmet_demand(), now=now)
+        logger.warning(
+            "fleet borrow decided: host %s leaves training for "
+            "serving (brown-out stage %d, unmet demand %d)",
+            host, self.serving.pressure_stage(),
+            self.serving.unmet_demand())
+
+    def _maybe_return(self, now: float) -> None:
+        borrowed = [h for h in
+                    self.ledger.hosts_owned_by(FleetOwner.SERVING)
+                    if h not in self.migrations]
+        if not borrowed:
+            return
+        host = borrowed[0]
+        self.ledger.transition(host, FleetOwner.MIGRATING_BACK,
+                               self.epoch, now,
+                               migration_id=f"return:{host}")
+        self._open_debt(f"return:{host}", host, "return", now)
+        self.serving.begin_drain(host)
+        self.migrations[host] = {
+            "kind": "return", "phase": "drain", "t0": now,
+            "attempts": 0,
+            "root": self._start_trace(host, "return", now),
+        }
+        if self.recorder is not None:
+            self.recorder.record("fleet_return_decided", host=host,
+                                 now=now)
+        logger.info(
+            "fleet return decided: host %s drains out of serving and "
+            "rejoins training", host)
+
+    # ------------------------------------------------- debt bookkeeping
+    def _open_debt(self, key: str, host: str, kind: str,
+                   now: float) -> None:
+        existing = self.debts.get(key)
+        if existing is not None and not existing["retired"]:
+            return  # already open: never two debts for one handoff
+        self.debts[key] = {
+            "key": key, "host": host, "kind": kind,
+            "opened_at": now, "retired": False,
+        }
+        if self.recorder is not None:
+            self.recorder.record("fleet_debt_opened", key=key,
+                                 host=host, debt_kind=kind, now=now)
+
+    def _retire_debt(self, key: str, reason: str, now: float) -> None:
+        debt = self.debts.get(key)
+        if debt is None or debt["retired"]:
+            return  # exactly once: a second retire is a no-op
+        debt["retired"] = True
+        debt["retired_reason"] = reason
+        self.debts_retired_total += 1
+        if self.recorder is not None:
+            self.recorder.record("fleet_debt_retired", key=key,
+                                 reason=reason, now=now)
+
+    def open_debts(self) -> List[dict]:
+        return [d for d in self.debts.values() if not d["retired"]]
+
+    # ----------------------------------------------------------- traces
+    def _start_trace(self, host: str, direction: str, now: float,
+                     **attrs):
+        if self.tracer is None:
+            return None
+        return self.tracer.start_trace(
+            "fleet_migration", now=now, always_sample=True,
+            host=host, direction=direction, epoch=self.epoch, **attrs)
+
+    def _span(self, mig: dict, name: str, now: float, **attrs) -> None:
+        root = mig.get("root")
+        if root is None or self.tracer is None:
+            return
+        start = mig.get("span_t", mig["t0"])
+        self.tracer.start_span(
+            root, name, now=start, **attrs).finish(max(now, start))
+        mig["span_t"] = max(now, start)
+
+    def _finish_trace(self, mig: dict, status: str, now: float) -> None:
+        root = mig.get("root")
+        if root is None or self.tracer is None:
+            return
+        self.tracer.finish_trace(root, now=now, status=status)
+
+    # ------------------------------------------------------ consistency
+    def verify(self) -> List[str]:
+        """The chaos acceptance invariant: every fleet host has exactly
+        one owner, and no host is simultaneously a rendezvous member
+        and a router replica.  Returns violations (empty = healthy)."""
+        violations = []
+        training_hosts = set(self.training.alive_hosts())
+        serving_hosts = {
+            base_replica_name(n)
+            for n in self.serving.router.replica_names
+        }
+        for host in self.ledger.check_single_owner(
+                training_hosts, serving_hosts):
+            if host in self.training.hosts:
+                violations.append(
+                    f"host {host} is in BOTH worlds at once")
+        for host in self.training.hosts:
+            if self.ledger.owner(host) is None:
+                violations.append(f"host {host} has no lease")
+        return violations
+
+    # ----------------------------------------------------------- metrics
+    def metrics(self) -> Dict[str, float]:
+        owners = self.ledger.owners()
+        migrating = sum(
+            1 for o in owners.values()
+            if o in (FleetOwner.MIGRATING_OUT,
+                     FleetOwner.MIGRATING_BACK))
+        return {
+            "dlrover_fleet_hosts_training": float(sum(
+                1 for o in owners.values()
+                if o == FleetOwner.TRAINING)),
+            "dlrover_fleet_hosts_serving": float(sum(
+                1 for o in owners.values()
+                if o == FleetOwner.SERVING)),
+            "dlrover_fleet_hosts_migrating": float(migrating),
+            "dlrover_fleet_borrows_total": float(self.borrows_total),
+            "dlrover_fleet_returns_total": float(self.returns_total),
+            "dlrover_fleet_borrow_aborts_total": float(
+                self.borrow_aborts_total),
+            "dlrover_fleet_worker_reboots_total": float(
+                self.worker_reboots_total),
+            "dlrover_fleet_debts_open": float(len(self.open_debts())),
+            "dlrover_fleet_debts_retired_total": float(
+                self.debts_retired_total),
+            "dlrover_fleet_debts_reopened_total": float(
+                self.debts_reopened_total),
+            "dlrover_fleet_stale_claims_fenced_total": float(
+                self.ledger.stale_claims_fenced),
+            "dlrover_fleet_recoveries_total": float(
+                self.recoveries_total),
+            "dlrover_fleet_lease_epoch": float(self.ledger.epoch),
+            "dlrover_fleet_borrow_handoff_seconds": float(
+                self.last_borrow_handoff_s),
+            "dlrover_fleet_return_handoff_seconds": float(
+                self.last_return_handoff_s),
+        }

@@ -1,0 +1,223 @@
+"""Epoch-fenced host-lease ledger — the robustness core of the fleet
+coordinator.
+
+Every host the coordinator manages has EXACTLY ONE owner at any
+instant: ``FleetOwner.TRAINING`` (rendezvous member),
+``MIGRATING_OUT`` (borrow in flight), ``SERVING`` (router replica) or
+``MIGRATING_BACK`` (return in flight).  The legal moves are declared
+next to the enum in :mod:`dlrover_tpu.common.constants`
+(``FLEET_HOST_TRANSITIONS`` — the DL009-style single source of truth;
+dlint's extra-spec drift pass keeps the declaration honest, THIS
+module enforces it at runtime: an undeclared transition raises, it is
+never silently applied).
+
+Two failure classes are designed against:
+
+- **Coordinator crash mid-migration.**  The ledger optionally journals
+  every mutation to a crash-consistent file (serialize to a temp file,
+  ``os.replace`` — a torn write can never be read as a valid journal).
+  A restarted coordinator does NOT trust the journal for ownership: it
+  re-derives every lease from ground truth (master rendezvous
+  membership + worker supervisor + router), using the journal only for
+  the epoch counter and the in-flight migration *intent* (borrow vs
+  return) that ground truth cannot distinguish for a host that is
+  momentarily in neither world.
+
+- **Stale claims from a dead incarnation.**  Each ledger mutation
+  carries the caller's epoch; every coordinator incarnation bumps the
+  ledger epoch at construction, so a zombie coordinator (or a late
+  callback it scheduled) presenting the previous epoch is fenced off
+  with :class:`StaleLeaseError` instead of corrupting single-ownership
+  — counted in ``stale_claims_fenced``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    FLEET_HOST_TRANSITIONS,
+    FleetOwner,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class StaleLeaseError(RuntimeError):
+    """A lease mutation presented an epoch older than the ledger's —
+    the claim belongs to a dead coordinator incarnation and is fenced
+    off (exactly-once handoff depends on refusing it)."""
+
+
+class LeaseTransitionError(ValueError):
+    """The requested owner change is not declared in
+    ``FLEET_HOST_TRANSITIONS`` — by contract the ledger refuses it."""
+
+
+@dataclasses.dataclass
+class HostLease:
+    """One host's ownership record."""
+
+    host: str
+    owner: str                       # FleetOwner.*
+    epoch: int                       # incarnation that wrote this lease
+    since: float = 0.0               # caller-clock stamp of last change
+    migration_id: Optional[str] = None  # open migration, if any
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class LeaseLedger:
+    """Single-owner host leases with epoch fencing and an optional
+    crash-consistent journal."""
+
+    def __init__(self, journal_path: Optional[str] = None):
+        self.leases: Dict[str, HostLease] = {}
+        self.epoch = 0
+        self.stale_claims_fenced = 0
+        self._journal_path = journal_path
+        if journal_path and os.path.exists(journal_path):
+            self._load_journal(journal_path)
+
+    # ------------------------------------------------------- journaling
+    def _load_journal(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            # a torn/corrupt journal is equivalent to no journal:
+            # ground truth rebuilds the leases either way, only the
+            # epoch floor and migration intent are lost
+            logger.warning("fleet lease journal unreadable (%s); "
+                           "starting from ground truth only", e)
+            return
+        self.epoch = int(data.get("epoch", 0))
+        for host, rec in data.get("leases", {}).items():
+            self.leases[host] = HostLease(
+                host=host,
+                owner=str(rec.get("owner", FleetOwner.TRAINING)),
+                epoch=int(rec.get("epoch", self.epoch)),
+                since=float(rec.get("since", 0.0)),
+                migration_id=rec.get("migration_id"),
+            )
+
+    def _persist(self) -> None:
+        if not self._journal_path:
+            return
+        payload = json.dumps({
+            "epoch": self.epoch,
+            "leases": {h: le.to_dict() for h, le in self.leases.items()},
+        })
+        d = os.path.dirname(os.path.abspath(self._journal_path))
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(prefix=".fleet-leases.",
+                                       dir=d or None)
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(payload)
+            os.replace(tmp, self._journal_path)  # atomic publish
+            tmp = None
+        except OSError as e:
+            # journal loss degrades recovery to ground-truth-only; it
+            # must never take the live coordinator down
+            logger.warning("fleet lease journal write failed: %s", e)
+            if tmp is not None:
+                # _persist runs per mutation: a sustained outage must
+                # not shed one orphan temp file per poll
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    # --------------------------------------------------------- mutation
+    def bump_epoch(self) -> int:
+        """New coordinator incarnation: every lease written from now on
+        carries the new epoch, and any claim still holding the old one
+        is fenced.  Returns the new epoch."""
+        self.epoch += 1
+        self._persist()
+        return self.epoch
+
+    def _fence(self, epoch: int, what: str) -> None:
+        if epoch != self.epoch:
+            self.stale_claims_fenced += 1
+            raise StaleLeaseError(
+                f"{what}: epoch {epoch} is stale (ledger at "
+                f"{self.epoch}) — claim fenced off")
+
+    def acquire(self, host: str, owner: str, epoch: int,
+                now: float = 0.0,
+                migration_id: Optional[str] = None) -> HostLease:
+        """Install a lease for a host the ledger has never seen (or is
+        re-deriving during recovery).  Epoch-fenced like every write."""
+        self._fence(epoch, f"acquire({host})")
+        lease = HostLease(host=host, owner=owner, epoch=epoch,
+                          since=now, migration_id=migration_id)
+        self.leases[host] = lease
+        self._persist()
+        return lease
+
+    def transition(self, host: str, to_owner: str, epoch: int,
+                   now: float = 0.0,
+                   migration_id: Optional[str] = None) -> HostLease:
+        """Move a host to a new owner.  Refuses stale epochs
+        (:class:`StaleLeaseError`) and undeclared transitions
+        (:class:`LeaseTransitionError` — ``FLEET_HOST_TRANSITIONS`` is
+        the contract, not a comment)."""
+        self._fence(epoch, f"transition({host} -> {to_owner})")
+        lease = self.leases.get(host)
+        if lease is None:
+            raise KeyError(f"no lease for host {host!r}")
+        allowed = FLEET_HOST_TRANSITIONS.get(lease.owner, ())
+        if to_owner not in allowed:
+            raise LeaseTransitionError(
+                f"host {host}: {lease.owner} -> {to_owner} is not a "
+                f"declared FLEET_HOST_TRANSITIONS edge "
+                f"(allowed: {allowed})")
+        lease.owner = to_owner
+        lease.epoch = epoch
+        lease.since = now
+        lease.migration_id = migration_id
+        self._persist()
+        return lease
+
+    def prune(self, keep_hosts) -> list:
+        """Drop leases for hosts outside ``keep_hosts`` (recovery
+        trims the journal to the CURRENT inventory: a decommissioned
+        host's ghost lease would otherwise be 'returned' into the
+        expected world and wedge the strict-size rendezvous forever).
+        Returns the dropped host names."""
+        keep = set(keep_hosts)
+        dropped = sorted(h for h in self.leases if h not in keep)
+        for host in dropped:
+            del self.leases[host]
+        if dropped:
+            self._persist()
+            logger.warning(
+                "fleet lease ledger: pruned ghost leases for hosts "
+                "no longer in the inventory: %s", dropped)
+        return dropped
+
+    # ---------------------------------------------------------- queries
+    def owner(self, host: str) -> Optional[str]:
+        lease = self.leases.get(host)
+        return None if lease is None else lease.owner
+
+    def owners(self) -> Dict[str, str]:
+        return {h: le.owner for h, le in self.leases.items()}
+
+    def hosts_owned_by(self, owner: str) -> list:
+        return sorted(h for h, le in self.leases.items()
+                      if le.owner == owner)
+
+    def check_single_owner(self, training_hosts, serving_hosts) -> list:
+        """The invariant the whole design exists for: no host may be a
+        rendezvous member AND a router replica at once.  Returns the
+        violating host names (empty = healthy); chaos tests assert
+        empty at every quiescent point."""
+        both = set(training_hosts) & set(serving_hosts)
+        return sorted(both)

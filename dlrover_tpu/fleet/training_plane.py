@@ -1,0 +1,308 @@
+"""Training-plane adapter for the fleet coordinator.
+
+Wraps the master-side elastic-training surfaces the coordinator needs
+— the rendezvous manager (world membership + coordinated eviction),
+the Flash Checkpoint durability barrier, and the goodput ledger's
+planned-elasticity accounting — behind the small contract
+:class:`FleetCoordinator` drives:
+
+- ``world_hosts()`` / ``alive_hosts()``: training-side ground truth
+  (what lease reconstruction classifies as TRAINING-owned);
+- ``shrink(hosts, now)``: the borrow release barrier.  Ordering is the
+  crash-consistency argument of the whole design: the DURABLE BLOCKING
+  Flash Checkpoint commit happens BEFORE any host leaves the
+  rendezvous, so "host absent from the training world" *implies* "its
+  state is committed" — a coordinator crash between the two steps is
+  recoverable by reading membership alone.  A failed commit raises and
+  nothing shrinks.
+- ``regrow(hosts, now)``: re-admit returned hosts (raise ``max_nodes``
+  back; the host's agent re-joins the rendezvous on its own — in
+  production by respawning into the waiting list, in tests via the
+  driven fake agents).
+- ``resumed(now)`` / ``poll(now)``: did training step again after the
+  last membership change?  ``poll`` also closes the goodput ledger's
+  planned-elasticity window once resumption is visible, so the borrow
+  window is charged as *planned* elasticity, not downtime
+  (:meth:`~dlrover_tpu.master.stats.job_collector.JobMetricCollector.
+  begin_planned_elasticity`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointBarrierError(RuntimeError):
+    """The durable blocking save did not commit — the borrow must not
+    proceed (shrinking an uncheckpointed world risks losing steps)."""
+
+
+class TrainingPlane:
+    """Coordinator-facing view of one elastic-training job."""
+
+    def __init__(
+        self,
+        rdzv_manager,
+        host_ranks: Dict[str, int],
+        checkpoint_fn: Callable[[], int],
+        collector=None,
+        min_nodes: int = 1,
+        recorder=None,
+        wall_clock: Callable[[], float] = time.time,
+    ):
+        """``host_ranks`` maps host name -> rendezvous node rank (the
+        fleet inventory's training identity).  ``checkpoint_fn`` is the
+        durability barrier: it must run a BLOCKING Flash Checkpoint
+        save (``save_checkpoint(block=True)``) and return the committed
+        step, raising on a failed commit — typically a closure over the
+        trainer's ``Checkpointer``.  ``collector`` is the master's
+        :class:`JobMetricCollector` (or None) for planned-elasticity
+        attribution; collector stamps are taken from ``wall_clock``
+        (``time.time`` in production, the synthetic test clock in
+        chaos tests — the collector's ledger lives on wall time, the
+        membership logic on the caller's ``now``)."""
+        self._rdzv = rdzv_manager
+        self._rank_of = dict(host_ranks)
+        self._host_of = {r: h for h, r in self._rank_of.items()}
+        self._checkpoint_fn = checkpoint_fn
+        self._collector = collector
+        self._min_nodes = int(min_nodes)
+        self.recorder = recorder
+        # the hosts the training world SHOULD contain once in-flight
+        # membership changes settle.  A set, not a count: recovery code
+        # re-issues shrink/regrow idempotently (regrow of an already-
+        # expected host is a no-op), which a bare counter cannot offer.
+        self._expected = set(self._rank_of)
+        self._wall = wall_clock
+        self._last_change_t: Optional[float] = None
+        # wall stamp of the last membership change: resumption means a
+        # step report landed strictly AFTER it (not merely "the planned
+        # window closed" — a crash can close the window with zero
+        # steps taken)
+        self._last_change_wall: Optional[float] = None
+        self.last_committed_step = -1
+        self._apply_params()
+
+    # -------------------------------------------------- membership view
+    def rank_of(self, host: str) -> int:
+        return self._rank_of[host]
+
+    def world_hosts(self) -> List[str]:
+        """Hosts in the ADMITTED rendezvous world right now (empty
+        while a round re-forms)."""
+        return sorted(
+            self._host_of[r] for r in self._rdzv.current_world_ranks()
+            if r in self._host_of
+        )
+
+    def alive_hosts(self) -> List[str]:
+        """Hosts the master counts as alive (admitted or waiting) —
+        the reconstruction ground truth: an evicted host leaves this
+        set before its serving worker exists."""
+        return sorted(
+            self._host_of[r] for r in self._rdzv.alive_ranks()
+            if r in self._host_of
+        )
+
+    @property
+    def min_hosts(self) -> int:
+        return self._min_nodes
+
+    @property
+    def hosts(self) -> List[str]:
+        """The full training-native inventory (the fleet the
+        coordinator arbitrates)."""
+        return sorted(self._rank_of)
+
+    @property
+    def target_world(self) -> int:
+        return len(self._expected)
+
+    @property
+    def node_unit(self) -> int:
+        """Hosts per TPU pod slice (rendezvous admission unit) — the
+        coordinator must keep the target world a multiple of this: a
+        partial slice cannot train, so a borrow that breaks alignment
+        would leave survivors idling outside a world that can never
+        form."""
+        get = getattr(self._rdzv, "get_rdzv_params", None)
+        if get is None:
+            return 1
+        return max(1, int(get().node_unit))
+
+    def expected_hosts(self) -> List[str]:
+        return sorted(self._expected)
+
+    def adopt_rdzv(self, rdzv_manager) -> None:
+        """Master restart: point at the fresh master's rendezvous
+        manager (its state starts empty; agents re-register on their
+        own — the coordinator only re-reads ground truth)."""
+        self._rdzv = rdzv_manager
+        self._apply_params()
+
+    # ---------------------------------------------------- world changes
+    def _apply_params(self) -> None:
+        # strict world: the coordinator names the exact membership, so
+        # the rendezvous completes only at the full target — a partial
+        # round completing "elastically" under a deliberate handoff
+        # would hand the job a world the coordinator never chose.
+        # node_unit/join_timeout are PRESERVED (update_rdzv_params
+        # replaces the whole parameter object; clobbering the pod-slice
+        # unit would let partial slices into the world).
+        get = getattr(self._rdzv, "get_rdzv_params", None)
+        prev = get() if get is not None else None
+        self._rdzv.update_rdzv_params(
+            min_nodes=self.target_world,
+            max_nodes=self.target_world,
+            waiting_timeout=0.0,
+            node_unit=prev.node_unit if prev is not None else 1,
+            join_timeout=(prev.join_timeout if prev is not None
+                          else 600.0),
+        )
+
+    def exclude(self, hosts: List[str],
+                now: Optional[float] = None) -> None:
+        """Recovery primitive: remove hosts from the EXPECTED training
+        membership with no checkpoint barrier — for hosts a recovering
+        coordinator found already serving (or mid-borrow): their
+        training state was committed before the original eviction, and
+        a freshly constructed plane (which starts expecting everyone)
+        must not make the rendezvous wait for a host that is busy
+        serving traffic.  Idempotent."""
+        now = time.monotonic() if now is None else now
+        hosts = [h for h in hosts if h in self._expected]
+        if not hosts:
+            return
+        for host in hosts:
+            self._rdzv.evict_node(self._rank_of[host])
+            self._expected.discard(host)
+        self._apply_params()
+        self._last_change_t = now
+        self._last_change_wall = self._wall()
+        if self.recorder is not None:
+            self.recorder.record(
+                "fleet_world_excluded", hosts=list(hosts),
+                target_world=self.target_world, now=now)
+
+    def checkpoint_barrier(self) -> int:
+        """The borrow release barrier alone: run the durable BLOCKING
+        save and return the committed step.  Touches NO plane state,
+        so callers may (should) run it off their control loop — the
+        commit of a large state to real storage takes seconds, and a
+        coordinator polling loop must not freeze for it.  Raises
+        :class:`CheckpointBarrierError` on a failed commit."""
+        try:
+            return int(self._checkpoint_fn())
+        except Exception as e:
+            raise CheckpointBarrierError(
+                f"blocking checkpoint commit failed: {e}") from e
+
+    def apply_shrink(self, hosts: List[str], committed_step: int,
+                     now: Optional[float] = None) -> int:
+        """Commit-before-evict, second half: with ``committed_step``
+        durably committed (the caller ran :meth:`checkpoint_barrier`),
+        evict ``hosts`` and lower the world target.  Cheap and
+        synchronous — belongs ON the control loop so membership state
+        is never mutated from a background thread."""
+        now = time.monotonic() if now is None else now
+        hosts = [h for h in hosts if h in self._expected]
+        if not hosts:
+            return self.last_committed_step  # idempotent re-issue
+        step = int(committed_step)
+        # the window opens AFTER the commit verdict, immediately before
+        # the eviction: the pause being attributed is the rendezvous
+        # re-form, and a trainer still reporting steps during the
+        # barrier (remote-coordinator deployments) must not close the
+        # window before the pause even starts.  A failed barrier never
+        # opens a window at all, so a wedged save cannot be laundered
+        # into planned_elasticity_s.
+        if self._collector is not None:
+            self._collector.begin_planned_elasticity(
+                reason="fleet_shrink", timestamp=self._wall())
+        self.last_committed_step = step
+        for host in hosts:
+            self._rdzv.evict_node(self._rank_of[host])
+            self._expected.discard(host)
+        self._apply_params()
+        self._last_change_t = now
+        self._last_change_wall = self._wall()
+        if self.recorder is not None:
+            self.recorder.record(
+                "fleet_world_shrunk", hosts=list(hosts),
+                committed_step=step, target_world=self.target_world,
+                now=now)
+        logger.info(
+            "fleet shrink: committed step %d, evicted %s, training "
+            "world target now %d", step, hosts, self.target_world)
+        return step
+
+    def shrink(self, hosts: List[str], now: Optional[float] = None
+               ) -> int:
+        """Barrier + apply in one BLOCKING call — for callers without
+        a polling loop.  The coordinator itself runs the barrier
+        off-thread (:meth:`checkpoint_barrier`) and applies the
+        membership change in-poll (:meth:`apply_shrink`)."""
+        step = self.checkpoint_barrier()
+        return self.apply_shrink(hosts, step, now)
+
+    def regrow(self, hosts: List[str], now: Optional[float] = None
+               ) -> None:
+        """Hand hosts back: raise the world target so the rendezvous
+        admits them when their agents re-join.  Idempotent per host —
+        crash recovery re-issues this safely.  Hosts outside the
+        inventory are refused: a rankless ghost in the expected set
+        would inflate the strict-world target into a size that can
+        never form."""
+        now = time.monotonic() if now is None else now
+        hosts = [h for h in hosts
+                 if h in self._rank_of and h not in self._expected]
+        if not hosts:
+            return
+        if self._collector is not None:
+            self._collector.begin_planned_elasticity(
+                reason="fleet_regrow", timestamp=self._wall())
+        self._expected.update(hosts)
+        self._apply_params()
+        self._last_change_t = now
+        self._last_change_wall = self._wall()
+        if self.recorder is not None:
+            self.recorder.record(
+                "fleet_world_regrow", hosts=list(hosts),
+                target_world=self.target_world, now=now)
+        logger.info(
+            "fleet regrow: re-admitting %s, training world target "
+            "now %d", hosts, self.target_world)
+
+    # ---------------------------------------------------------- liveness
+    def training_step(self) -> int:
+        """Latest step the master saw (−1 before any report)."""
+        if self._collector is None or not self._collector.steps:
+            return -1
+        return int(self._collector.steps[-1]["step"])
+
+    def resumed(self, now: Optional[float] = None) -> bool:
+        """True once the world settled at the current target size AND
+        (when a collector is wired) a step report landed strictly
+        after the last membership change — the actual evidence that
+        training is stepping again, not a proxy for it."""
+        world = self._rdzv.current_world_ranks()
+        if len(world) != self.target_world:
+            return False
+        if self._collector is None or self._last_change_wall is None:
+            return True
+        last = self._collector.last_step_timestamp()
+        return last is not None and last > self._last_change_wall
+
+    def poll(self, now: Optional[float] = None) -> None:
+        """Close the planned-elasticity window once resumption is
+        visible (the collector also self-closes on the first step
+        report — this is the belt to that suspender, covering runs
+        where steps are reported to a DIFFERENT collector)."""
+        if self._collector is None:
+            return
+        if self._collector.planned_window_open() and self.resumed(now):
+            self._collector.end_planned_elasticity(
+                timestamp=self._wall())

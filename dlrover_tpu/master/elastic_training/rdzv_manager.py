@@ -82,6 +82,14 @@ class RendezvousManager(metaclass=ABCMeta):
                 min_nodes, max_nodes, waiting_timeout, node_unit, join_timeout
             )
 
+    def get_rdzv_params(self) -> RendezvousParameters:
+        """Current parameters (callers adjusting ONE field — e.g. the
+        fleet coordinator resizing the world — read the rest from here
+        instead of silently resetting node_unit/join_timeout, since
+        ``update_rdzv_params`` replaces the whole object)."""
+        with self._lock:
+            return self._rdzv_params
+
     def add_alive_node(self, node_rank: int) -> None:
         with self._lock:
             self._alive_nodes.add(node_rank)
@@ -127,6 +135,50 @@ class RendezvousManager(metaclass=ABCMeta):
         """Agents poll this to notice membership growth (restart trigger)."""
         with self._lock:
             return len(self._waiting_nodes)
+
+    def current_world_ranks(self) -> List[int]:
+        """Node ranks of the ADMITTED world (empty while a round is
+        forming) — the fleet coordinator's training-side ground truth."""
+        with self._lock:
+            return sorted(self._rdzv_nodes)
+
+    def alive_ranks(self) -> List[int]:
+        """Ranks the master currently counts as alive (admitted or
+        waiting) — what lease reconstruction classifies as
+        training-owned after a coordinator crash: an evicted host is
+        removed from here BEFORE its serving worker exists, so it can
+        never be double-owned."""
+        with self._lock:
+            return sorted(self._alive_nodes)
+
+    def evict_node(self, node_rank: int) -> None:
+        """Deliberately remove one member from the world (fleet
+        coordinator shrink): the rank leaves the alive/waiting sets AND
+        the completed round is invalidated, so the survivors must
+        re-rendezvous into the smaller world — the same round-reset
+        contract a member re-join triggers, but initiated by the
+        control plane instead of a failure.  Callers shrink
+        ``max_nodes`` (update_rdzv_params) in the same breath so the
+        new round completes without waiting for the evicted host."""
+        with self._lock:
+            was_member = node_rank in self._rdzv_nodes
+            self._alive_nodes.discard(node_rank)
+            self._waiting_nodes.pop(node_rank, None)
+            if node_rank in self._latest_rdzv_nodes:
+                self._latest_rdzv_nodes.remove(node_rank)
+            if was_member:
+                # invalidate the round: every survivor re-joins (their
+                # collective over the evicted host's chips is dead
+                # anyway — this makes the restart deliberate, not a
+                # timeout discovery).  Evicting a rank that is NOT a
+                # member (recovery re-excluding a host already on
+                # loan) must not restart a healthy world.
+                self._rdzv_nodes = {}
+        if was_member:
+            logger.info(
+                "Rendezvous %s: node %s evicted by the fleet "
+                "coordinator; survivors will re-rendezvous",
+                self._name, node_rank)
 
     def _check_rdzv_completed(self) -> bool:
         """Caller holds the lock.

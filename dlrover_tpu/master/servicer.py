@@ -361,6 +361,18 @@ class MasterServicer:
                 # now instead of waiting out the task timeout.
                 self._task_manager.recover_tasks(message.node_id)
             return None
+        if isinstance(message, comm.PlannedElasticityEvent):
+            if self._job_metric_collector is not None:
+                ts = message.timestamp or None
+                if message.action == "begin":
+                    self._job_metric_collector.begin_planned_elasticity(
+                        reason=message.reason, timestamp=ts
+                    )
+                else:
+                    self._job_metric_collector.end_planned_elasticity(
+                        timestamp=ts
+                    )
+            return None
         if isinstance(message, comm.HeartBeat):
             action = ""
             if self._job_manager is not None:

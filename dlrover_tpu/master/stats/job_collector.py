@@ -79,9 +79,30 @@ class JobMetricCollector:
         # the typical per-step rate REGARDLESS of the 3x-median guard —
         # a fast recovery (warm compile cache, shm restore) can hide an
         # entire kill+respawn inside one below-threshold interval,
-        # silently crediting real downtime as productive time
+        # silently crediting real downtime as downtime-free time
         self._restart_pending = False
         self.restarts_observed = 0
+        # -- planned elasticity (fleet coordinator shrink/regrow): a
+        # DELIBERATE membership change is not downtime.  The
+        # declaration ARMS the ledger (begin_planned_elasticity);
+        # the next stall interval — the bridging gap the 3x-median
+        # radar would otherwise charge as downtime — books its excess
+        # into _planned_s instead, and disarms.  Interval attribution,
+        # not a wall window, because the pause does not start at the
+        # declaration: survivors keep training (and reporting steps)
+        # through most of a regrow until the returning agent actually
+        # triggers the round reset, and any wall-window close
+        # heuristic either swallows those reports or is closed by
+        # them.  A REAL failure (mark_restart) disarms: recovery after
+        # a crash is ordinary downtime, however planned the borrow
+        # around it was.  The arming self-expires (PLANNED_ARM_TTL_S,
+        # and TrainingPlane.poll disarms on resumption) so a much
+        # later unrelated hang can never be misattributed as planned.
+        self._planned_pending = False
+        self._planned_until = 0.0
+        self._planned_reason = ""
+        self._planned_s: float = 0.0
+        self.planned_windows = 0
 
     # ---------------------------------------------------------- reporting
     def mark_job_start(self, timestamp: Optional[float] = None) -> None:
@@ -97,10 +118,64 @@ class JobMetricCollector:
         """A worker failure/restart was reported: the interval bridging
         it must not be credited as fully productive (called by the
         servicer on ``NodeFailure``; idempotent until the next step
-        report consumes it)."""
+        report consumes it).  A real crash DISARMS any pending
+        planned-elasticity attribution — recovery after a failure is
+        downtime from the moment it happens, no matter how deliberate
+        the borrow window around it was."""
         with self._lock:
+            self._planned_pending = False
             self._restart_pending = True
             self.restarts_observed += 1
+
+    # -------------------------------------------- planned elasticity
+    #: armed planned attribution self-expires after this long so a
+    #: much later, unrelated stall cannot be misread as planned
+    PLANNED_ARM_TTL_S = 600.0
+
+    def begin_planned_elasticity(self, reason: str = "",
+                                 timestamp: Optional[float] = None
+                                 ) -> None:
+        """A coordinator-initiated membership change (fleet borrow /
+        return shrink+regrow) is in flight: ARM the ledger so the
+        bridging stall interval — whenever the pause actually lands —
+        books its excess over the typical per-step rate as planned
+        elasticity instead of downtime.  Idempotent while armed."""
+        ts = time.time() if timestamp is None else timestamp
+        with self._lock:
+            # an EXPIRED arming is not armed: a fresh declaration
+            # after an abandoned one is a new window (counted), not a
+            # TTL extension of the stale one
+            armed = self._planned_pending and ts <= self._planned_until
+            if not armed:
+                self._planned_pending = True
+                self._planned_reason = reason
+                self.planned_windows += 1
+            self._planned_until = ts + self.PLANNED_ARM_TTL_S
+
+    def end_planned_elasticity(self,
+                               timestamp: Optional[float] = None
+                               ) -> bool:
+        """Disarm (the membership change completed, or was aborted);
+        intervals already attributed stay attributed.  Returns whether
+        an arming was actually cleared."""
+        with self._lock:
+            was = self._planned_pending
+            self._planned_pending = False
+            return was
+
+    def planned_window_open(self) -> bool:
+        with self._lock:
+            return self._planned_pending
+
+    def last_step_timestamp(self) -> Optional[float]:
+        """Wall stamp of the newest step report (None before any) —
+        what the fleet coordinator's "training resumed" check compares
+        against its membership-change stamp.  A disarmed planned
+        attribution is NOT evidence of a step (a crash disarms with
+        zero steps taken), so resumption must read the report clock
+        itself."""
+        with self._lock:
+            return self._last_report_ts
 
     def report_global_step(self, step: int, timestamp: float) -> None:
         with self._lock:
@@ -122,6 +197,12 @@ class JobMetricCollector:
             # adopting its timestamp as prev would stretch the next
             # in-order interval and over-credit productive time
             return
+        if self._planned_pending and ts > self._planned_until:
+            # the arming expired unconsumed (a coordinator declared a
+            # change and died): clear it so planned_window_open() does
+            # not report an open window forever
+            self._planned_pending = False
+        planned_armed = self._planned_pending
         restarted, self._restart_pending = self._restart_pending, False
         self._prev_step, self._prev_ts = step, ts
         self._last_report_ts = ts
@@ -153,10 +234,18 @@ class JobMetricCollector:
             # time; the ledger must still SEE the kill)
             credit = min(credit, (step - base) * median) if median else 0.0
         elif median is not None and per_step > 3.0 * median:
-            # the sampling window hides a stall or a restart that still
-            # made net progress: credit the new steps at the typical
-            # per-step rate, count the rest of the gap as downtime
-            credit = min(credit, (step - base) * median)
+            # the sampling window hides a stall that still made net
+            # progress: credit the new steps at the typical per-step
+            # rate.  The remainder of the gap is downtime — UNLESS a
+            # coordinator armed planned-elasticity attribution, in
+            # which case THIS is the bridging pause of the declared
+            # membership change and the excess is planned, not
+            # downtime (one stall per arming; then it disarms)
+            capped = (step - base) * median
+            if planned_armed:
+                self._planned_s += max(0.0, credit - capped)
+                self._planned_pending = False
+            credit = min(credit, capped)
         else:
             self._step_times.append(per_step)
         self._productive_s += credit
@@ -177,28 +266,44 @@ class JobMetricCollector:
         The wall clock ends at the LAST step report: the collector
         cannot tell a finished job from a stalled one, so an ongoing
         stall shows up in ``seconds_since_last_step`` (get_job_metrics)
-        and in the hang detector — not as retroactive downtime here."""
+        and in the hang detector — not as retroactive downtime here.
+
+        ``planned_elasticity_s`` (coordinator-initiated fleet
+        shrink/regrow windows) is excluded from the availability
+        denominator: a deliberate chip repurposing is neither
+        productive nor downtime — it is capacity the job consciously
+        lent out.  A real crash inside such a window IS still downtime
+        (``mark_restart`` closes the planned credit at the failure)."""
         with self._lock:
             start, last = self._job_start_ts, self._last_report_ts
             first = self._first_report_ts
             productive = self._productive_s
             restarts = self.restarts_observed
+            planned = self._planned_s
+            planned_windows = self.planned_windows
         if start is None or last is None or last <= start:
             return {"goodput": 0.0, "wall_s": 0.0, "productive_s": 0.0,
                     "downtime_s": 0.0, "steady_goodput": 0.0,
-                    "steady_wall_s": 0.0, "restarts_observed": restarts}
+                    "steady_wall_s": 0.0, "restarts_observed": restarts,
+                    "planned_elasticity_s": planned,
+                    "planned_windows": planned_windows}
         wall = last - start
         steady_wall = max(0.0, last - first) if first is not None else 0.0
+        avail = max(1e-9, wall - min(planned, wall))
+        steady_avail = max(0.0, steady_wall - min(planned, steady_wall))
         return {
-            "goodput": min(1.0, productive / wall),
+            "goodput": min(1.0, productive / avail),
             "wall_s": wall,
             "productive_s": productive,
-            "downtime_s": max(0.0, wall - productive),
+            "downtime_s": max(0.0, wall - productive - planned),
             "steady_goodput": (
-                min(1.0, productive / steady_wall) if steady_wall else 0.0
+                min(1.0, productive / steady_avail)
+                if steady_avail else 0.0
             ),
             "steady_wall_s": steady_wall,
             "restarts_observed": restarts,
+            "planned_elasticity_s": planned,
+            "planned_windows": planned_windows,
         }
 
     def report_resource_usage(self, node_type: str, node_id, stats) -> None:

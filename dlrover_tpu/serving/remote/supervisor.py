@@ -399,6 +399,18 @@ class WorkerSupervisor:
         if metrics is not None:
             metrics.worker_quarantined += 1
 
+    def live_worker_bases(self) -> List[str]:
+        """Base names of workers whose process is alive right now —
+        the fleet coordinator's serving-side ground truth for lease
+        reconstruction (a borrowed host with a live worker is
+        mid-borrow even if the router has not seen its join yet)."""
+        with self._lock:
+            records = list(self.workers.values())
+        return sorted({
+            base_replica_name(r.name) for r in records
+            if r.proc.poll() is None
+        })
+
     # ------------------------------------------------------- metrics
     def render_worker_state(self) -> str:
         """Per-worker state as labeled Prometheus text — wire via

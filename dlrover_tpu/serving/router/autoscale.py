@@ -88,6 +88,7 @@ class ServingAutoScaler:
         decide_interval: float = 5.0,
         cooldown: float = 15.0,
         min_samples: int = 3,
+        join_latency_floor: float = 0.0,
     ):
         self.router = router
         self.scaler = scaler
@@ -116,6 +117,23 @@ class ServingAutoScaler:
         self._policy_drained: set = set()
         self.capacity_debt_retired = 0
         self._next_replacement = 0
+        # provisioning-latency-aware probation debts (ROADMAP): a debt
+        # whose source self-retires sooner than ANY replacement node
+        # has ever managed to join is deferred instead of launched — a
+        # ~2s first-flap probation must not pay a full launch+drain
+        # cycle.  The floor is the larger of the configured PRIOR and
+        # the fastest join ever OBSERVED (opened_at -> joined samples
+        # collected in _retire_debt).  The default 0.0 prior means
+        # "launch until the cluster has taught us its join latency":
+        # the first observed join activates deferral for probations
+        # shorter than that measured floor — quarantines always launch.
+        self.join_latency_floor = float(join_latency_floor)
+        self._join_samples: List[float] = []
+        self.capacity_debt_deferred_total = 0
+        # replicas beyond the policy's max the LOAD signals still call
+        # for — demand the serving pool cannot satisfy from its own
+        # capacity; the fleet coordinator's borrow trigger reads this
+        self.unmet_demand = 0
         # control-plane tracing: one autoscale trace per executed
         # decision (policy episode OR replacement), milestones stitched
         # from flight-recorder events.  _open_traces holds every trace
@@ -145,6 +163,29 @@ class ServingAutoScaler:
                 tokens_per_sec=m.tokens_per_second(now),
             ))
             del self._samples[: -8 * self.min_samples]
+            # unmet demand refreshes on EVERY sample, not only inside
+            # the cooldown-gated decision path: a stale positive value
+            # frozen across a 15s cooldown would keep the fleet
+            # coordinator borrowing hosts against demand that already
+            # subsided (one spurious blocking-checkpoint + shrink +
+            # boot + return cycle per dwell)
+            if len(self._samples) >= self.min_samples:
+                # current is CLAMPED to max_replicas for this reading:
+                # borrowed fleet hosts push up_count past the policy
+                # cap, and feeding that into raw_desired would latch
+                # unmet_demand positive forever (raw >= current in the
+                # steady band) — the coordinator would then never
+                # return the loan.  Unmet demand means "demand beyond
+                # serving-NATIVE capacity", so it is measured as if
+                # only the native pool existed.
+                eff = min(max(self.router.manager.up_count(), 1),
+                          self.policy.max_replicas)
+                raw = self.policy.raw_desired(
+                    self._samples[-self.min_samples:], eff)
+                self.unmet_demand = max(
+                    0, raw - self.policy.max_replicas)
+            else:
+                self.unmet_demand = 0
         self._stitch_scale_traces()
         self._finish_deaths()
         self._finish_drains()
@@ -330,8 +371,17 @@ class ServingAutoScaler:
         bases = self._replica_bases()
         return sum(
             1 for d in self.debts.values()
-            if not d["retired"] and d["replacement"] not in bases
+            if not d["retired"] and d["replacement"] is not None
+            and d["replacement"] not in bases
         )
+
+    def _join_floor(self) -> float:
+        """Effective node-join latency floor: the configured prior or
+        the fastest opened->joined latency ever observed, whichever is
+        larger (observation can only RAISE the bar — a slow cluster
+        defers more aggressively, never less safely)."""
+        observed = min(self._join_samples) if self._join_samples else 0.0
+        return max(self.join_latency_floor, observed)
 
     def _base_has_live_replica(self, key: str, now: float) -> bool:
         """True when the debt key's base currently has a schedulable,
@@ -377,8 +427,11 @@ class ServingAutoScaler:
             if cur is None or (cur.get("kind") != "quarantine"
                                and src.get("kind") == "quarantine"):
                 per_base[b] = src
+        self._sweep_deferred(per_base, now)
         for base, debt in [(self._debt_base(k), d)
                            for k, d in list(self.debts.items())]:
+            if debt.get("deferred"):
+                continue  # handled by _sweep_deferred (never launched)
             src = per_base.get(base)
             key = debt["key"]
             if src is not None and src["key"] != key:
@@ -446,14 +499,95 @@ class ServingAutoScaler:
                 self._open_debt(src["key"], src, now)
         metrics = getattr(self.router, "metrics", None)
         if metrics is not None:
+            # deferred entries are excluded: the gauge's contract is
+            # "replacement launched but not joined", and a deferral
+            # deliberately launched nothing
             metrics.capacity_debt = float(sum(
-                1 for d in self.debts.values() if not d["retired"]))
+                1 for d in self.debts.values()
+                if not d["retired"] and not d.get("deferred")))
+
+    def _sweep_deferred(self, per_base: Dict[str, dict],
+                        now: float) -> None:
+        """Deferred probation debts: entries that opened no node
+        because their source's ``until`` horizon was shorter than the
+        node-join latency floor.  Each poll they either clear (source
+        healed before any replacement could have arrived — the exact
+        launch+drain cycle the deferral saved), follow their base
+        across feed keys, or PROMOTE to a real launch the moment the
+        horizon stretches past the floor (escalated probation,
+        quarantine)."""
+        for key, debt in list(self.debts.items()):
+            if not debt.get("deferred"):
+                continue
+            base = self._debt_base(key)
+            src = per_base.get(base)
+            if src is None:
+                # nothing was provisioned, so nothing retires: the
+                # episode simply never became a debt
+                del self.debts[key]
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "capacity_debt_deferred_cleared", key=key,
+                        source=debt["source"], now=now)
+                logger.info(
+                    "deferred capacity debt %s cleared: %s healed "
+                    "faster than a replacement could join (saved one "
+                    "launch+drain cycle)", key, debt["source"])
+                continue
+            if src["key"] != key:
+                del self.debts[key]
+                debt["key"] = key = src["key"]
+                debt["kind"] = src.get("kind", debt["kind"])
+                debt["source"] = src.get("source", debt["source"])
+                self.debts[key] = debt
+            horizon = float(src.get("until", now)) - now
+            if src.get("kind") != "probation" or \
+                    horizon >= self._join_floor():
+                del self.debts[key]
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "capacity_debt_promoted", key=key,
+                        horizon_s=round(max(horizon, 0.0), 3), now=now)
+                self._open_debt(key, src, now)  # real launch now
 
     def _open_debt(self, key: str, src: dict, now: float) -> None:
         """A new capacity loss: issue the replacement-node plan NOW (a
         ``launch_nodes`` entry — no waiting for load signals or the
         policy cooldown) and open its always-sampled autoscale trace
-        with ``replacement_for`` naming what it backfills."""
+        with ``replacement_for`` naming what it backfills.
+
+        Exception — the provisioning-latency guard: a PROBATION whose
+        ``until`` horizon is shorter than the observed node-join
+        latency floor would self-retire before any replacement could
+        take traffic; launching for it pays a full launch+drain cycle
+        per flap.  Such a debt opens DEFERRED (bookkept, no node); it
+        promotes to a real launch if the episode outlives the horizon
+        (escalation, quarantine) and clears silently if it heals
+        first (see :meth:`_sweep_deferred`)."""
+        horizon = float(src.get("until", now)) - now
+        floor = self._join_floor()
+        if (src.get("kind") == "probation" and floor > 0.0
+                and horizon < floor):
+            self.debts[key] = {
+                "key": key, "kind": src.get("kind", "?"),
+                "source": src.get("source", "?"),
+                "replacement": None, "node": None,
+                "opened_at": now, "retired": False, "deferred": True,
+            }
+            self.capacity_debt_deferred_total += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "capacity_debt_deferred", key=key,
+                    source=src.get("source", "?"),
+                    horizon_s=round(max(horizon, 0.0), 3),
+                    join_floor_s=round(floor, 3), now=now)
+            logger.info(
+                "capacity debt %s deferred: probation horizon %.2fs "
+                "is shorter than the node-join latency floor %.2fs — "
+                "no replacement could arrive in time, so none is "
+                "launched unless the episode escalates",
+                key, max(horizon, 0.0), floor)
+            return
         n = self._next_replacement
         self._next_replacement += 1
         node = Node(
@@ -489,6 +623,11 @@ class ServingAutoScaler:
         debt["retired"] = True
         debt["retired_reason"] = reason
         self.capacity_debt_retired += 1
+        if reason == "replacement_joined":
+            # opened->joined is the cluster's real provisioning
+            # latency; its floor (fastest ever) gates future deferrals
+            self._join_samples.append(max(0.0, now - debt["opened_at"]))
+            del self._join_samples[:-32]
         if self.recorder is not None:
             self.recorder.record(
                 "capacity_debt_retired", key=debt["key"],

@@ -147,6 +147,28 @@ class BrownoutPolicy:
         """Stage 2+: queued and in-flight BATCH are expiry-cancelled."""
         return self.stage >= STAGE_CANCEL_BATCH
 
+    def expected_recovery_s(self, now: float) -> float:
+        """Best-case seconds until the ladder walks back to ``normal``
+        — the Retry-After hint shed answers carry so clients back off
+        instead of hammering a gateway that cannot admit them anyway.
+
+        De-escalation moves ONE stage per earned dwell below the exit
+        watermark, so full recovery from stage N costs N dwells; if
+        pressure is ALREADY below exit, the current dwell's progress
+        (``now - below_since``) is credited against the first step.
+        Best-case by construction (assumes pressure falls now and
+        stays down) — an honest lower bound is the right hint: clients
+        that return at it and get shed again back off once more, while
+        an upper bound would hold traffic away from a recovered
+        fleet."""
+        if self.stage <= STAGE_NORMAL:
+            return 0.0
+        first = self.dwell_seconds
+        if self._below_since is not None:
+            first = max(0.0,
+                        self.dwell_seconds - (now - self._below_since))
+        return first + (self.stage - 1) * self.dwell_seconds
+
     @property
     def stage_name(self) -> str:
         return STAGE_NAMES.get(self.stage, str(self.stage))

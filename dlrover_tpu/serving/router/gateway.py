@@ -49,7 +49,23 @@ class BrownoutShedError(AdmissionError):
     """The brown-out controller is shedding this priority band — the
     fleet is degrading in ORDER (BATCH first, then NORMAL, HIGH never)
     instead of letting the queue bound bounce all bands equally.
-    Retry later, or resubmit at a higher priority if the work is."""
+    Retry later, or resubmit at a higher priority if the work is.
+
+    The answer carries the Retry-After contract so clients can back
+    off instead of hammering a shedding gateway: ``stage`` /
+    ``stage_name`` (where the ladder stands) and ``retry_after_s``
+    (the policy's best-case exit-watermark + dwell recovery estimate,
+    :meth:`~dlrover_tpu.serving.router.brownout.BrownoutPolicy.
+    expected_recovery_s`) — an HTTP front end maps it 1:1 onto a
+    ``Retry-After`` header on the 503."""
+
+    def __init__(self, message: str, stage: Optional[int] = None,
+                 stage_name: str = "",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.stage = stage
+        self.stage_name = stage_name
+        self.retry_after_s = retry_after_s
 
 
 class RequestTimedOut(RuntimeError):
@@ -330,9 +346,14 @@ class RequestGateway:
                 # mechanism protecting HIGH, not a capacity accident
                 self.rejected += 1
                 self.shed_by_priority[priority] += 1
+                retry_after = brownout.expected_recovery_s(now)
                 raise BrownoutShedError(
                     f"priority {priority} shed at brown-out stage "
-                    f"{brownout.stage} ({brownout.stage_name})")
+                    f"{brownout.stage} ({brownout.stage_name}); "
+                    f"expected recovery in >= {retry_after:.1f}s",
+                    stage=brownout.stage,
+                    stage_name=brownout.stage_name,
+                    retry_after_s=retry_after)
             if self.depth() >= self.max_pending:
                 self.rejected += 1
                 raise QueueFullError(

@@ -220,6 +220,78 @@ METRIC_HELP: Dict[str, str] = {
     "dlrover_ckpt_committed_step": (
         "training step of the last fully-committed shm generation"
     ),
+    # -- agent-side checkpoint persistence (agent/ckpt_saver) ----------
+    "dlrover_ckpt_persists_total": (
+        "shm checkpoint steps the agent-side saver persisted to "
+        "storage (async persist loop + breakpoint saves)"
+    ),
+    "dlrover_ckpt_last_persisted_step": (
+        "training step of the newest checkpoint the agent-side saver "
+        "fully persisted to storage"
+    ),
+    # -- fleet coordinator (fleet/coordinator.FleetCoordinator) --------
+    "dlrover_fleet_hosts_training": (
+        "fleet hosts currently leased to the training world "
+        "(FleetOwner.TRAINING)"
+    ),
+    "dlrover_fleet_hosts_serving": (
+        "fleet hosts currently on loan to the serving fabric "
+        "(FleetOwner.SERVING) — borrowed capacity"
+    ),
+    "dlrover_fleet_hosts_migrating": (
+        "hosts with a handoff in flight (MIGRATING_OUT or "
+        "MIGRATING_BACK) — should return to 0 quickly; a stuck value "
+        "is a wedged migration"
+    ),
+    "dlrover_fleet_borrows_total": (
+        "completed train->serve handoffs (checkpoint committed, world "
+        "shrunk, worker serving)"
+    ),
+    "dlrover_fleet_returns_total": (
+        "completed serve->train handoffs (replica drained zero-lost, "
+        "host rejoined the rendezvous, training stepping again)"
+    ),
+    "dlrover_fleet_borrow_aborts_total": (
+        "borrows rolled back (checkpoint barrier failed, or the "
+        "worker never booted within its attempt budget) — the host "
+        "returned to training, nothing was lost"
+    ),
+    "dlrover_fleet_worker_reboots_total": (
+        "borrowed workers re-booted after dying on loan (a reopened "
+        "debt episode, NOT a new borrow: no checkpoint ran, nothing "
+        "shrank — counted apart so borrow handoff stats stay honest)"
+    ),
+    "dlrover_fleet_debts_open": (
+        "capacity-handoff debts currently open: each borrow/return is "
+        "a deliberate debt retired exactly once on join/return"
+    ),
+    "dlrover_fleet_debts_retired_total": (
+        "handoff debts retired (exactly once each; compare with "
+        "borrows+returns+aborts to audit the exactly-once discipline)"
+    ),
+    "dlrover_fleet_debts_reopened_total": (
+        "borrow debts reopened because the borrowed worker died while "
+        "on loan — a NEW episode, mirrored from the PR-8 replacement "
+        "reopen rule"
+    ),
+    "dlrover_fleet_stale_claims_fenced_total": (
+        "lease mutations refused for carrying a dead incarnation's "
+        "epoch — nonzero proves the fencing earned its keep"
+    ),
+    "dlrover_fleet_recoveries_total": (
+        "coordinator incarnations that rebuilt the lease ledger from "
+        "master + supervisor ground truth (1 = the initial start)"
+    ),
+    "dlrover_fleet_lease_epoch": (
+        "current lease-fencing epoch (bumped once per coordinator "
+        "incarnation)"
+    ),
+    "dlrover_fleet_borrow_handoff_seconds": (
+        "latest borrow decision -> serving-join handoff latency"
+    ),
+    "dlrover_fleet_return_handoff_seconds": (
+        "latest return decision -> training-resumed handoff latency"
+    ),
     # -- xprof auto-profiling (utils/xprof_metrics.AutoProfiler) -------
     "dlrover_xprof_profiles_total": "xprof captures taken so far",
     "dlrover_xprof_last_capture_timestamp": (
@@ -257,6 +329,8 @@ NON_METRIC_SERVING_NAMES = frozenset({
     "dlrover_xprof_",    # tempdir prefix (utils/xprof_metrics.py)
     "dlrover_tpu_ckpt",  # shared-memory segment prefix (shm_handler)
     "dlrover_tpu_factory",  # multi-process queue name (constants.py)
+    "serving_join",      # fleet migration trace span name (coordinator)
+    "serving_joined",    # fleet debt retire reason (coordinator)
 })
 
 

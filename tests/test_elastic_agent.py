@@ -260,3 +260,63 @@ def test_two_node_check_with_mismatched_comm_perf_flags(master2):
     t0.start(); t1.start()
     t0.join(240); t1.join(240)
     assert results == {0: 0, 1: 0}, results
+
+
+def test_agent_metrics_exporter_serves_counters_over_http(local_master):
+    """ISSUE 11 satellite: the agent's dlrover_agent_* self-healing
+    counters (and, when a saver lives in the process, the agent-side
+    dlrover_ckpt_* persistence counters) are scrapable over HTTP with
+    the metric registry's help text — no more dict-only metrics."""
+    import urllib.request
+
+    _, addr = local_master
+    client = _client(addr, 0)
+    spec = WorkerSpec(
+        entrypoint=[sys.executable, "-c", "print('ok')"],
+        monitor_interval=0.3,
+    )
+    agent = ElasticAgent(client, 0, spec)
+    port = agent.start_metrics_exporter(0)
+    try:
+        agent._count("dlrover_agent_restarts_total")
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "dlrover_agent_restarts_total 1.0" in body
+        assert "dlrover_agent_master_outages_total" in body
+        assert "dlrover_agent_rendezvous_rejoins_total" in body
+        # registry help text reaches the scraper
+        assert "# HELP dlrover_agent_restarts_total" in body
+        # health endpoint rides along
+        ok = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read()
+        assert ok == b"ok"
+    finally:
+        agent.stop_metrics_exporter()
+        client.close()
+
+
+def test_agent_side_saver_metrics_contract():
+    """AsyncCheckpointSaver.metrics() speaks the metric-source
+    contract (plain name -> float) with registry-declared names, so
+    the agent exporter can merge it directly."""
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+    from dlrover_tpu.utils.metric_registry import METRIC_HELP
+
+    import uuid as _uuid
+
+    os.environ["DLROVER_JOB_UID"] = _uuid.uuid4().hex[:8]
+    saver = AsyncCheckpointSaver("/tmp/_dlrover_saver_metrics_test")
+    try:
+        m = saver.metrics()
+        assert m["dlrover_ckpt_persists_total"] == 0.0
+        assert m["dlrover_ckpt_last_persisted_step"] == -1.0
+        for name in m:
+            assert name in METRIC_HELP, name
+    finally:
+        for h in saver._shm_handlers:
+            h.close()
+        for lk in saver._shm_locks:
+            lk.close()
+        saver._event_queue.close()

@@ -1,0 +1,138 @@
+"""Goodput attribution of planned elasticity (ISSUE 11 satellite,
+beside test_goodput_e2e): a coordinator-initiated shrink/regrow ARMS
+the ledger, and the bridging stall interval — whenever the pause
+actually lands — books its excess over the typical per-step rate as
+PLANNED elasticity (excluded from the availability denominator), never
+as downtime.  A real crash (mark_restart) disarms: recovery after a
+failure is ordinary downtime, however deliberate the borrow window
+around it was."""
+
+from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
+
+def _steady(collector, t0, n, dt=1.0, start_step=0):
+    """n step reports at a clean dt cadence; returns the last ts."""
+    t = t0
+    step = start_step
+    for _ in range(n):
+        t += dt
+        step += 1
+        collector.report_global_step(step, t)
+    return t, step
+
+
+def test_planned_shrink_stall_is_not_downtime():
+    c = JobMetricCollector()
+    c.mark_job_start(1000.0)
+    t, step = _steady(c, 1000.0, 10)
+    # coordinator shrink declared; the pause lands as an 8s gap
+    c.begin_planned_elasticity("fleet_shrink", timestamp=t)
+    t += 8.0
+    t, step = _steady(c, t, 10, start_step=step)
+    g = c.goodput()
+    assert g["planned_windows"] == 1
+    # the gap's excess over one typical step went to planned...
+    assert 6.0 <= g["planned_elasticity_s"] <= 9.0, g
+    # ...not to downtime
+    assert g["downtime_s"] < 1.5, g
+    assert g["steady_goodput"] >= 0.90, g
+    assert g["restarts_observed"] == 0
+    # one stall per arming: it disarmed after attributing
+    assert not c.planned_window_open()
+
+
+def test_regrow_arming_survives_ongoing_survivor_steps():
+    """The regrow direction: survivors keep training (and reporting
+    steps at normal cadence) AFTER the declaration; the arming must
+    ride through those reports and attribute the REAL pause when the
+    round reset finally lands."""
+    c = JobMetricCollector()
+    c.mark_job_start(1000.0)
+    t, step = _steady(c, 1000.0, 10)
+    c.begin_planned_elasticity("fleet_regrow", timestamp=t)
+    # survivors keep stepping normally for 5 more reports
+    t, step = _steady(c, t, 5, start_step=step)
+    assert c.planned_window_open(), \
+        "normal-cadence reports must not consume the arming"
+    # then the returning agent triggers the round reset: 6s pause
+    t += 6.0
+    t, step = _steady(c, t, 10, start_step=step)
+    g = c.goodput()
+    assert 4.0 <= g["planned_elasticity_s"] <= 7.0, g
+    assert g["downtime_s"] < 1.5, g
+    assert not c.planned_window_open()
+
+
+def test_unplanned_gap_of_same_shape_is_downtime():
+    """Control: the identical stall WITHOUT the coordinator's
+    declaration lands in downtime (the 3x-median radar)."""
+    c = JobMetricCollector()
+    c.mark_job_start(1000.0)
+    t, step = _steady(c, 1000.0, 10)
+    t += 8.0
+    t, step = _steady(c, t, 10, start_step=step)
+    g = c.goodput()
+    assert g["planned_elasticity_s"] == 0.0
+    assert g["downtime_s"] > 5.0, g
+
+
+def test_real_crash_during_borrow_window_is_still_downtime():
+    """mark_restart inside an armed window disarms it: the whole
+    recovery gap is ordinary downtime, however deliberate the borrow
+    around it was."""
+    c = JobMetricCollector()
+    c.mark_job_start(1000.0)
+    t, step = _steady(c, 1000.0, 10)
+    c.begin_planned_elasticity("fleet_shrink", timestamp=t)
+    # a worker actually dies during the planned window
+    c.mark_restart()
+    assert not c.planned_window_open()
+    # recovery takes 20 seconds before steps resume
+    t += 20.0
+    t, step = _steady(c, t, 10, start_step=step)
+    g = c.goodput()
+    assert g["restarts_observed"] == 1
+    # NOTHING of the crash gap was laundered as planned
+    assert g["planned_elasticity_s"] == 0.0, g
+    assert g["downtime_s"] >= 15.0, g
+    assert g["steady_goodput"] < 0.99, g
+
+
+def test_end_planned_elasticity_disarms_without_attribution():
+    """An aborted membership change (e.g. the checkpoint barrier
+    failed) disarms cleanly: nothing was attributed, and a LATER
+    unplanned stall is downtime as usual."""
+    c = JobMetricCollector()
+    c.mark_job_start(1000.0)
+    t, step = _steady(c, 1000.0, 5)
+    c.begin_planned_elasticity("fleet_shrink", timestamp=t)
+    assert c.planned_window_open()
+    assert c.end_planned_elasticity() is True
+    assert not c.planned_window_open()
+    assert c.end_planned_elasticity() is False  # idempotent
+    # a stall AFTER the disarm is not planned
+    t += 8.0
+    t, step = _steady(c, t, 5, start_step=step)
+    g = c.goodput()
+    assert g["planned_elasticity_s"] == 0.0
+    assert g["planned_windows"] == 1
+    assert g["downtime_s"] > 5.0, g
+
+
+def test_arming_self_expires():
+    """A stall landing long after the declaration (past the TTL) is
+    NOT attributed as planned — an abandoned arming cannot launder a
+    much later unrelated hang."""
+    c = JobMetricCollector()
+    c.mark_job_start(1000.0)
+    t, step = _steady(c, 1000.0, 10)
+    c.begin_planned_elasticity("fleet_shrink", timestamp=t)
+    # nothing stalls; steady reports run out the TTL
+    n = int(c.PLANNED_ARM_TTL_S) + 10
+    t, step = _steady(c, t, n, start_step=step)
+    # now an unrelated hang — far past the arming's validity
+    t += 8.0
+    t, step = _steady(c, t, 5, start_step=step)
+    g = c.goodput()
+    assert g["planned_elasticity_s"] == 0.0, g
+    assert g["downtime_s"] > 5.0, g

@@ -1149,6 +1149,122 @@ def test_same_poll_quarantine_and_probation_is_one_debt():
     assert list(auto.debts) == [f"quarantine:{victim}"]
 
 
+def test_short_probation_debt_is_deferred_not_launched():
+    """ISSUE 11 satellite (provisioning-latency-aware debts): a
+    probation whose ``until`` horizon is shorter than the node-join
+    latency floor self-retires before ANY replacement could take
+    traffic — launching for it pays a full launch+drain cycle for
+    nothing.  The debt opens DEFERRED (bookkept, no node) and clears
+    silently when the source heals first."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    auto.join_latency_floor = 10.0  # no node has ever joined in <10s
+    t = time.monotonic()
+    feed.records.append({
+        "key": "probation:w1", "kind": "probation",
+        "source": "w1", "until": t + 2.0,   # 2s horizon << 10s floor
+    })
+    auto.on_step(t + 0.05)
+    assert not [p for p in auto.plans if p.launch_nodes], \
+        "a 2s probation must not launch a node that takes 10s to join"
+    assert auto.debts["probation:w1"]["deferred"]
+    assert auto.capacity_debt_deferred_total == 1
+    # deferred entries stay out of the launched-but-unjoined gauge
+    assert router.metrics.metrics()["serving_capacity_debt"] == 0.0
+    kinds = [e["kind"] for e in router.recorder.events(64)]
+    assert "capacity_debt_deferred" in kinds
+    # the probation self-retires: the entry clears with NOTHING
+    # provisioned and nothing counted as retired
+    feed.records.clear()
+    auto.on_step(t + 2.5)
+    assert "probation:w1" not in auto.debts
+    assert auto.capacity_debt_retired == 0
+    assert not [p for p in auto.plans if p.launch_nodes]
+    kinds = [e["kind"] for e in router.recorder.events(64)]
+    assert "capacity_debt_deferred_cleared" in kinds
+
+
+def test_fast_flapping_base_defers_until_quarantine_promotes():
+    """The ROADMAP regression: a fast-flapping base whose ~2s
+    first-flap probations each self-retire must pay ZERO launch+drain
+    cycles — until the episode escalates (quarantine), at which point
+    the deferred debt PROMOTES to a real launch that retires exactly
+    once on join."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    auto.join_latency_floor = 10.0
+    t = time.monotonic()
+    # five flap cycles: probation appears (2s horizon), flickers out,
+    # reappears — historical behavior provisioned a node per cycle
+    for i in range(5):
+        feed.records[:] = [{
+            "key": "probation:w7", "kind": "probation",
+            "source": "w7", "until": t + i + 2.0,
+        }]
+        auto.on_step(t + i + 0.1)
+        feed.records.clear()
+        auto.on_step(t + i + 0.6)
+    assert not [p for p in auto.plans if p.launch_nodes], \
+        "a fast-flapping base must not provision per flap"
+    # one more flap is still live when the budget blows: the deferred
+    # entry follows its base into the quarantine key (rekey) and
+    # PROMOTES to a real launch
+    feed.records[:] = [{
+        "key": "probation:w7", "kind": "probation",
+        "source": "w7", "until": t + 7.5,
+    }]
+    auto.on_step(t + 5.8)
+    assert auto.debts["probation:w7"]["deferred"]
+    feed.records[:] = [{
+        "key": "quarantine:w7", "kind": "quarantine",
+        "source": "w7", "until": t + 300.0,
+    }]
+    auto.on_step(t + 6.0)
+    launches = [p for p in auto.plans if p.launch_nodes]
+    assert len(launches) == 1, "escalation must launch exactly once"
+    kinds = [e["kind"] for e in router.recorder.events(256)]
+    assert "capacity_debt_promoted" in kinds
+    provisioner.poll()
+    auto.on_step(t + 6.1)
+    assert auto.capacity_debt_retired == 1
+
+
+def test_observed_join_latency_raises_the_deferral_floor():
+    """The floor is LEARNED: once a real replacement join has been
+    observed to take ~8s, later sub-horizon probations defer with no
+    configuration at all."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        queue_low=0.0)
+    feed = _DebtFeed()
+    auto.supervisor = feed
+    t = time.monotonic()
+    # first episode: a quarantine launches; the node takes 8s to join
+    feed.records.append({
+        "key": "quarantine:w2", "kind": "quarantine",
+        "source": "w2", "until": t + 600.0,
+    })
+    auto.on_step(t + 0.0)
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+    provisioner.poll()                   # join observed at t+8
+    auto.on_step(t + 8.0)
+    assert auto.capacity_debt_retired == 1
+    assert auto._join_floor() >= 7.9
+    feed.records.clear()
+    auto.on_step(t + 8.5)
+    # second episode: a 2s probation now defers automatically
+    feed.records[:] = [{
+        "key": "probation:w3", "kind": "probation",
+        "source": "w3", "until": t + 11.0,   # 2.4s horizon < ~8s floor
+    }]
+    auto.on_step(t + 8.6)
+    assert auto.debts["probation:w3"]["deferred"]
+    assert len([p for p in auto.plans if p.launch_nodes]) == 1
+
+
 def test_replacement_trace_carries_replacement_for():
     """Replacement decisions get their own always-sampled autoscale
     trace: root attrs name what it backfills (``replacement_for``) and
@@ -1223,6 +1339,56 @@ def test_brownout_policy_hysteresis_and_ladder():
     # the transition log tells the whole ordered story
     assert [(a, b) for a, b, _, _ in bo.transitions] == [
         (0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]
+
+
+def test_brownout_shed_answers_carry_retry_after_hint():
+    """ISSUE 11 satellite: a shed answer names WHERE the ladder stands
+    (stage + name) and HOW LONG the best-case recovery takes (exit
+    watermark + dwell walk-down), so clients back off instead of
+    hammering a shedding gateway — the Retry-After contract an HTTP
+    front end maps 1:1 onto the 503 header."""
+    from dlrover_tpu.serving.router import (
+        BrownoutPolicy,
+        BrownoutShedError,
+    )
+
+    bo = BrownoutPolicy(enter_pressure=2.0, exit_pressure=0.5,
+                        dwell_seconds=2.0)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4), brownout=bo)
+    router.join_replica("r0", FakeEngine(slots=1, tokens_per_step=1),
+                        now=1000.0)
+    for i in range(20):
+        router.submit(_prompt(i), 16, priority=PRIORITY_NORMAL,
+                      now=1000.0)
+    t = 1000.0
+    router.step(now=t)
+    router.step(now=t + 2.1)          # dwell earned: stage 1
+    assert bo.stage == 1
+    with pytest.raises(BrownoutShedError) as ei:
+        router.submit(_prompt(99), 8, priority=PRIORITY_BATCH,
+                      now=t + 2.2)
+    err = ei.value
+    assert err.stage == 1 and err.stage_name == "shed_batch"
+    # pressure is still above exit: full walk-down = stage * dwell
+    assert err.retry_after_s == pytest.approx(2.0)
+    assert "recovery" in str(err)
+    # deeper stage -> longer hint; and time already spent below the
+    # exit watermark is credited against the first step
+    router.step(now=t + 4.2)
+    assert bo.stage == 2
+    with pytest.raises(BrownoutShedError) as ei:
+        router.submit(_prompt(98), 8, priority=PRIORITY_BATCH,
+                      now=t + 4.3)
+    assert ei.value.retry_after_s == pytest.approx(4.0)
+    assert bo.expected_recovery_s(t + 4.3) == pytest.approx(4.0)
+    # simulate pressure already below exit for 1.5s of the 2s dwell
+    bo.update(t + 5.0, 0, 10.0)
+    assert bo.expected_recovery_s(t + 6.5) == pytest.approx(
+        0.5 + 2.0)  # remainder of this dwell + one more stage
+    # stage 0 needs no hint
+    bo2 = BrownoutPolicy()
+    assert bo2.expected_recovery_s(0.0) == 0.0
 
 
 def test_brownout_sheds_batch_then_normal_never_high():
@@ -1348,3 +1514,34 @@ def test_transition_spec_is_importable_truth():
         assert set(targets) <= states
         if s not in SERVING_REQUEST_TERMINAL_STATES:
             assert targets, f"non-terminal {s} must go somewhere"
+
+
+def test_unmet_demand_does_not_latch_on_borrowed_capacity():
+    """The fleet borrow signal must RELEASE: borrowed hosts push
+    up_count past max_replicas, and measuring raw demand against that
+    inflated count would keep unmet_demand positive forever (the
+    coordinator would never return the loan).  Demand is measured as
+    if only the serving-native pool existed."""
+    cluster, scaler, router, provisioner, auto = _autoscale_rig(
+        max_replicas=2, queue_low=0.5)
+    t = time.monotonic()
+    # two "borrowed" replicas beyond the native cap
+    router.join_replica("host-8", FakeEngine(slots=2), now=t)
+    router.join_replica("host-9", FakeEngine(slots=2), now=t)
+    reqs = [router.submit(_prompt(i), 8) for i in range(40)]
+    # one pump round records the gauges the autoscaler samples (and
+    # runs on_step itself: the rig attaches the autoscaler)
+    router.step(now=t + 0.05)
+    router.step(now=t + 0.10)
+    assert auto.unmet_demand > 0, "spike must register as unmet"
+    # the spike drains (borrowed capacity did its job)
+    while router.has_work:
+        t += 0.05
+        router.step(now=t)
+    for _ in range(6):
+        t += 0.3
+        router.step(now=t)
+    assert auto.unmet_demand == 0, \
+        "zero load with 4 up replicas must not read as unmet demand"
+    for r in reqs:
+        r.result(timeout=5)
