@@ -394,6 +394,120 @@ def _bench_fleet(total_budget_s: float = 120.0) -> dict:
     return out
 
 
+def _bench_gateway() -> dict:
+    """Gateway overhead rig (ISSUE 12): a seeded open-loop schedule
+    (Poisson, heavy-tail prompts, per-priority mix) replayed at 15k
+    offered QPS against the in-process serving stack, with the OTLP
+    push pipeline live against an in-process collector — so the
+    recorded overhead INCLUDES the telemetry the fleet actually runs
+    with.  Gates on sustaining >=10k QPS open-loop admission; records
+    admission p50/p99, shed behavior, SLO verdicts, and the exporter's
+    shipped/dropped proof counters.  A bursty variant records how the
+    on/off shape moves the tail."""
+    import time as _time
+
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        BrownoutPolicy,
+        ContinuousBatchScheduler,
+        RequestGateway,
+        RouterMetrics,
+        ServingRouter,
+        SloEngine,
+    )
+    from dlrover_tpu.serving.router.loadgen import (
+        LoadgenConfig,
+        run_gateway_rig,
+    )
+    from dlrover_tpu.utils.otlp import OtlpExporter
+    from dlrover_tpu.utils.telemetry_collector import TelemetryCollector
+
+    def _build(with_telemetry: bool):
+        slo = SloEngine(fast_window_s=5.0, slow_window_s=60.0)
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=4096, default_timeout=3.0,
+                # the millions-of-users sampling posture: 1% of
+                # healthy traces retained, incidents always
+                trace_sample_rate=0.01),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+            metrics=RouterMetrics(window_seconds=1.0),
+            brownout=BrownoutPolicy(enter_pressure=4.0,
+                                    exit_pressure=1.0,
+                                    dwell_seconds=0.2),
+            slo=slo,
+        )
+        for i in range(4):
+            router.join_replica(
+                f"rig-replica-{i}",
+                FakeEngine(slots=16, tokens_per_step=8,
+                           blocks=100_000))
+        collector = exporter = None
+        if with_telemetry:
+            collector = TelemetryCollector(announce=False)
+            collector.start()
+            exporter = OtlpExporter(
+                collector.endpoint,
+                resource={"service.name": "router"})
+            exporter.add_metrics_source(router.metrics.metrics)
+            exporter.add_labeled_source(
+                lambda: slo.otlp_metrics(_time.monotonic()))
+            exporter.add_histogram_source(
+                lambda: [router.metrics.ttft_hist,
+                         router.metrics.queue_wait_hist])
+            router.tracer.attach_otlp(exporter)
+            exporter.start()
+        return router, collector, exporter
+
+    out = {}
+    router, collector, exporter = _build(with_telemetry=True)
+    try:
+        rig = run_gateway_rig(
+            router,
+            LoadgenConfig(rate_qps=15000, duration_s=2.0, seed=7),
+            otlp_exporter=exporter)
+        out["gateway_qps"] = rig["gateway_qps"]
+        out["gateway_offered"] = rig["gateway_offered"]
+        out["gateway_admitted"] = rig["gateway_admitted"]
+        out["gateway_admission_p50_us"] = rig["gateway_admission_p50_us"]
+        out["gateway_admission_p99_us"] = rig["gateway_admission_p99_us"]
+        out["gateway_queue_wait_p99_s"] = rig["gateway_queue_wait_p99_s"]
+        out["gateway_shed"] = rig["gateway_shed"]
+        out["gateway_slo_met"] = {
+            band: v["met"] for band, v in rig["gateway_slo"].items()}
+        out["gateway_slo_burn_fast"] = {
+            band: v["burn_rate_fast"]
+            for band, v in rig["gateway_slo"].items()}
+        exporter.flush(timeout=5.0)
+        otlp = exporter.metrics()
+        out["gateway_otlp_shipped"] = otlp["dlrover_otlp_shipped_total"]
+        out["gateway_otlp_dropped"] = otlp["dlrover_otlp_dropped_total"]
+        out["gateway_collector_spans"] = float(
+            collector.store.spans_ingested_total)
+        # the gate of record: >=10k QPS open-loop admission on CPU
+        # with the telemetry pipeline LIVE (PERF.md trajectory)
+        out["gateway_qps_bar"] = 10000
+        out["gateway_overhead_ok"] = bool(
+            rig["gateway_qps"] >= 10000)
+    finally:
+        if exporter is not None:
+            exporter.stop()
+        if collector is not None:
+            collector.stop()
+    # bursty shape: same mean rate, 4x on/off square wave — records
+    # what burstiness does to the admission tail and the shed mix
+    router, _, _ = _build(with_telemetry=False)
+    rig = run_gateway_rig(
+        router,
+        LoadgenConfig(rate_qps=12000, duration_s=1.0,
+                      arrival="bursty", seed=11))
+    out["gateway_bursty_qps"] = rig["gateway_qps"]
+    out["gateway_bursty_admission_p99_us"] = \
+        rig["gateway_admission_p99_us"]
+    out["gateway_bursty_shed"] = rig["gateway_shed"]
+    return out
+
+
 def _bench_long_context(jax, jnp, steps: int = 4, warmup: int = 2) -> dict:
     """MFU at 16k context on one chip (the Pallas flash kernel keeps
     attention memory linear; ring attention extends past one chip).
@@ -653,6 +767,7 @@ _CONFIG_FNS = {
     "longctx": _bench_longctx,
     "ckpt": _bench_ckpt,
     "fleet": _bench_fleet,
+    "gateway": _bench_gateway,
 }
 
 
@@ -714,7 +829,7 @@ def main() -> None:
         return
 
     on_tpu = _probe_tpu()
-    configs = ["primary", "ckpt", "fleet"]
+    configs = ["primary", "ckpt", "fleet", "gateway"]
     if on_tpu:
         configs += ["realistic", "longctx"]
     # a result far below the config's long-recorded band is transient
@@ -790,6 +905,15 @@ def main() -> None:
     # bench_regressions flag the driver can key on plus a stderr line —
     # so the r05 pause regression cannot drift silently run-over-run
     regressions = []
+    if result.get("gateway_overhead_ok") is False:
+        regressions.append("gateway_overhead")
+        print(
+            "BENCH REGRESSION: gateway_overhead_ok=false — open-loop "
+            f"admission sustained {result.get('gateway_qps')} QPS vs "
+            f"the {result.get('gateway_qps_bar')} bar (admission p99 "
+            f"{result.get('gateway_admission_p99_us')}us); see PERF.md",
+            file=sys.stderr,
+        )
     if result.get("ckpt_pause_ok") is False:
         regressions.append("ckpt_pause")
         print(

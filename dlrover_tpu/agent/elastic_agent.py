@@ -434,6 +434,7 @@ class ElasticAgent:
         self._resource_monitor = None
         self._hang_detector = None
         self.metrics_exporter = None
+        self.otlp_exporter = None
 
     def start_metrics_exporter(self, port: int = 0) -> int:
         """Serve the agent's self-healing counters over HTTP — the
@@ -464,6 +465,20 @@ class ElasticAgent:
         exporter.add_source(_saver_metrics)
         exporter.start()
         self.metrics_exporter = exporter
+        # OTLP push into the fleet collector when one is announced
+        # (DLROVER_TELEMETRY_ENDPOINT); inert otherwise.  The agent's
+        # counters then appear on /fleet/metrics next to the router's
+        # and the master's — one pane across the planes.
+        from dlrover_tpu.utils.otlp import OtlpExporter
+
+        otlp = OtlpExporter.from_env(
+            resource={"service.name": "agent",
+                      "node.rank": str(self._node_rank)})
+        otlp.add_metrics_source(self.metrics)
+        otlp.add_metrics_source(_saver_metrics)
+        otlp.start()
+        self.otlp_exporter = otlp
+        exporter.add_source(otlp.metrics)
         # stdout announce, flushed: a supervisor piping us reads the
         # port the same way it reads the master/worker announces
         from dlrover_tpu.common.constants import NodeEnv
@@ -478,6 +493,10 @@ class ElasticAgent:
         if self.metrics_exporter is not None:
             self.metrics_exporter.stop()
             self.metrics_exporter = None
+        otlp = getattr(self, "otlp_exporter", None)
+        if otlp is not None:
+            otlp.stop()
+            self.otlp_exporter = None
 
     def _count(self, name: str, n: float = 1.0) -> None:
         with self._metrics_lock:

@@ -28,6 +28,10 @@ class ServingSignal:
     queue_depth: float = 0.0       # gateway backlog (mean over window)
     ttft_seconds: float = 0.0      # time-to-first-token (mean)
     tokens_per_sec: float = 0.0    # generated-token throughput
+    # SLO error-budget burn (serving/router/slo.SloEngine.pressure):
+    # max over priority bands of the multi-window burn rate.  0.0 when
+    # no SLO engine is wired — every pre-SLO caller keeps its behavior
+    slo_pressure: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -38,6 +42,7 @@ class ServingSignal:
             queue_depth=float(d.get("queue_depth", 0.0)),
             ttft_seconds=float(d.get("ttft_seconds", 0.0)),
             tokens_per_sec=float(d.get("tokens_per_sec", 0.0)),
+            slo_pressure=float(d.get("slo_pressure", 0.0)),
         )
 
 
@@ -51,6 +56,7 @@ class ServingScalePolicy:
         queue_high: float = 4.0,   # per-replica backlog that adds one
         queue_low: float = 0.5,    # per-replica backlog that frees one
         ttft_high: Optional[float] = None,  # seconds; None = ignore
+        slo_burn_high: Optional[float] = 2.0,  # burn rate that adds one
         step: int = 1,
     ):
         self.min_replicas = int(min_replicas)
@@ -58,6 +64,12 @@ class ServingScalePolicy:
         self.queue_high = float(queue_high)
         self.queue_low = float(queue_low)
         self.ttft_high = ttft_high
+        # SLO-pressure threshold: sustained multi-window error-budget
+        # burn above this adds a replica even with a shallow queue —
+        # slow replicas can keep the queue drained while every user
+        # waits past the objective.  None disables the signal; the
+        # default 2.0 means "burning budget at twice the allowed rate"
+        self.slo_burn_high = slo_burn_high
         self.step = int(step)
 
     def raw_desired(
@@ -77,9 +89,20 @@ class ServingScalePolicy:
         ttft_pressure = (
             self.ttft_high is not None and ttft > self.ttft_high
         )
-        if per_replica > self.queue_high or ttft_pressure:
+        # the burn signal is already multi-window smoothed (SloEngine
+        # pressure = min(fast, slow)); the worst sample decides —
+        # averaging a cliff against pre-cliff samples only delays the
+        # add by one decide interval for nothing
+        slo_pressure = (
+            self.slo_burn_high is not None
+            and max(s.slo_pressure for s in samples)
+            > self.slo_burn_high
+        )
+        if per_replica > self.queue_high or ttft_pressure \
+                or slo_pressure:
             return current + self.step
-        if per_replica < self.queue_low and not ttft_pressure:
+        if per_replica < self.queue_low and not ttft_pressure \
+                and not slo_pressure:
             return current - self.step
         return current
 

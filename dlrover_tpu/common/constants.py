@@ -265,6 +265,13 @@ class NodeEnv:
     # (--metrics-port 0 binds a kernel-assigned port; the agent
     # announces what it got — same idiom as the other announces).
     AGENT_METRICS_ANNOUNCE_PREFIX = "DLROVER_AGENT_METRICS_PORT="
+    # Stdout announce of the master's metrics-exporter port (the
+    # goodput ledger becomes scrapeable instead of JSON-artifact-only).
+    MASTER_METRICS_ANNOUNCE_PREFIX = "DLROVER_MASTER_METRICS_PORT="
+    # Stdout announce of the fleet telemetry collector's port, and the
+    # env var processes read to find it (OTLP push endpoint base URL).
+    TELEMETRY_ANNOUNCE_PREFIX = "DLROVER_TELEMETRY_PORT="
+    TELEMETRY_ENDPOINT = "DLROVER_TELEMETRY_ENDPOINT"
 
 
 class ConfigPath:

@@ -73,6 +73,15 @@ DL009  state-transition     every ``ServingRequestState`` write /
                             state guard), or a guard-pinned transition
                             the spec doesn't declare, is a violation —
                             and enum/spec drift is itself reported.
+DL010  metric-label-        labeled-sample construction
+       cardinality          (``family{key="…"}`` literals/f-strings)
+                            must use a family whose label keys are
+                            declared in the registry's METRIC_LABELS,
+                            only the declared keys, and never a label
+                            VALUE sourced from an unbounded vocabulary
+                            (request id, trace id, erid, host:port) —
+                            unbounded cardinality mints one series per
+                            request and OOMs every fleet aggregator.
 ====== ==================== =============================================
 
 DL001-DL006 are per-module lexical passes.  DL007-DL009 run on the
@@ -111,6 +120,8 @@ class DlintConfig:
     metric_registry_module: str = "utils/metric_registry.py"
     metric_help_name: str = "METRIC_HELP"
     non_metric_name: str = "NON_METRIC_SERVING_NAMES"
+    # labeled metric families: name -> declared label keys (DL010)
+    metric_labels_name: str = "METRIC_LABELS"
     # both exported namespaces: serving_* (router/tracer metrics) and
     # dlrover_* (trainer/exporter metrics) — a literal in either that
     # is neither a declared metric nor listed non-metric vocabulary is
@@ -1444,6 +1455,181 @@ class StateTransitionChecker(Checker):
                 )
 
 
+# =========================================================== DL010
+class MetricLabelCardinalityChecker(Checker):
+    CODE = "DL010"
+    NAME = "metric-label-cardinality"
+    WHY = (
+        "a label value from an unbounded vocabulary (request id, "
+        "trace id, host:port) mints one Prometheus series per request "
+        "— every aggregator scraping the fleet OOMs exactly "
+        "mid-incident, when cardinality spikes with traffic"
+    )
+
+    #: identifier names whose values are per-request / per-connection
+    #: — using one as a label value is the cardinality bomb this
+    #: checker exists for.  Bounded vocabularies (worker names, state
+    #: enums, priority bands) pass; a genuinely-bounded source that
+    #: happens to collide can carry a `# dlint: disable=DL010 <why>`.
+    UNBOUNDED_NAMES = frozenset({
+        "rid", "erid", "request_id", "trace_id", "span_id",
+        "uuid", "job_uid", "job_uuid", "port", "addr", "address",
+        "host_port", "peername", "sockname",
+    })
+
+    _FAMILY = re.compile(r"((?:serving|dlrover)_[a-z0-9_]+)\{")
+    _KEY = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="')
+
+    def check_project(self, project):
+        cfg = project.config
+        registry = project.context_module(cfg.metric_registry_module)
+        declared: Dict[str, Tuple[str, ...]] = {}
+        if registry is not None:
+            declared, help_names, label_nodes = self._read_registry(
+                registry, cfg)
+            if project.find_module(
+                    cfg.metric_registry_module) is registry:
+                yield from self._check_registry(
+                    registry, cfg, declared, help_names, label_nodes)
+        for module in project.modules:
+            if module is registry:
+                continue
+            yield from self._check_module(module, declared)
+
+    # ------------------------------------------------ registry side
+    def _read_registry(self, registry, cfg):
+        """One walk gathers everything the checker needs: the label
+        declarations, the registered-metric names, and the key NODES
+        of the METRIC_LABELS dict (kept so the self-consistency pass
+        can report on them without re-locating the dict)."""
+        declared: Dict[str, Tuple[str, ...]] = {}
+        help_names: Set[str] = set()
+        label_nodes: List[ast.Constant] = []
+        for node in ast.walk(registry.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                target = node.target
+            else:
+                continue
+            if not isinstance(target, ast.Name):
+                continue
+            if target.id == cfg.metric_labels_name and isinstance(
+                    node.value, ast.Dict):
+                for key, val in zip(node.value.keys, node.value.values):
+                    if not (isinstance(key, ast.Constant)
+                            and isinstance(key.value, str)):
+                        continue
+                    labels = []
+                    if isinstance(val, (ast.Tuple, ast.List, ast.Set)):
+                        labels = [
+                            e.value for e in val.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        ]
+                    declared[key.value] = tuple(labels)
+                    label_nodes.append(key)
+            elif target.id == cfg.metric_help_name and isinstance(
+                    node.value, ast.Dict):
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str):
+                        help_names.add(key.value)
+        return declared, help_names, label_nodes
+
+    def _check_registry(self, registry, cfg, declared, help_names,
+                        label_nodes):
+        """Registry self-consistency: a labeled family must also be a
+        registered metric, and its declared KEYS must themselves be
+        bounded vocabulary."""
+        for key in label_nodes:
+            if key.value not in help_names:
+                yield registry.violation(
+                    self.CODE, key,
+                    f"METRIC_LABELS declares {key.value!r} which "
+                    f"is not in {cfg.metric_help_name} — labels "
+                    "on an unregistered family",
+                )
+            for label in declared.get(key.value, ()):
+                if label in self.UNBOUNDED_NAMES:
+                    yield registry.violation(
+                        self.CODE, key,
+                        f"family {key.value!r} declares label key "
+                        f"{label!r} — an unbounded per-request "
+                        "vocabulary; label on a bounded "
+                        "dimension instead",
+                    )
+
+    # ------------------------------------------------- literal side
+    def _check_module(self, module, declared):
+        # Constants INSIDE a JoinedStr are visited via the JoinedStr
+        # itself; seeing them again standalone would double-report
+        inner: Set[int] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.JoinedStr):
+                for child in node.values:
+                    inner.add(id(child))
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.JoinedStr):
+                literal = "".join(
+                    v.value for v in node.values
+                    if isinstance(v, ast.Constant)
+                    and isinstance(v.value, str))
+                fvs = [v for v in node.values
+                       if isinstance(v, ast.FormattedValue)]
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, str)
+                  and id(node) not in inner
+                  and not module.is_docstring(node)):
+                literal, fvs = node.value, []
+            else:
+                continue
+            m = self._FAMILY.search(literal)
+            if m is None:
+                continue
+            family = m.group(1)
+            keys = self._KEY.findall(literal[m.end():])
+            if family not in declared:
+                yield module.violation(
+                    self.CODE, node,
+                    f"labeled samples for {family!r} but its label "
+                    "keys are not declared in METRIC_LABELS — "
+                    "declare them in the metric registry",
+                )
+                continue
+            for key in keys:
+                if key not in declared[family]:
+                    yield module.violation(
+                        self.CODE, node,
+                        f"label key {key!r} on {family!r} is not in "
+                        "its METRIC_LABELS declaration "
+                        f"({', '.join(declared[family]) or 'none'})",
+                    )
+            for fv in fvs:
+                for bad in self._unbounded_sources(fv.value):
+                    yield module.violation(
+                        self.CODE, node,
+                        f"label value on {family!r} interpolates "
+                        f"{bad!r} — an unbounded per-request source; "
+                        "one series per request OOMs every "
+                        "aggregator (label a bounded dimension, put "
+                        "the id in a trace/exemplar instead)",
+                    )
+
+    def _unbounded_sources(self, expr: ast.AST):
+        seen = set()
+        for node in ast.walk(expr):
+            name = ""
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name in self.UNBOUNDED_NAMES and name not in seen:
+                seen.add(name)
+                yield name
+
+
 CHECKERS: Tuple[Checker, ...] = (
     ToctouPortChecker(),
     ThreadHygieneChecker(),
@@ -1454,4 +1640,5 @@ CHECKERS: Tuple[Checker, ...] = (
     TransitiveLockBlockingChecker(),
     LockOrderingChecker(),
     StateTransitionChecker(),
+    MetricLabelCardinalityChecker(),
 )

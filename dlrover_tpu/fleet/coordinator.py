@@ -122,6 +122,50 @@ class ServingPlane:
             if base_replica_name(name) == host:
                 self.router.begin_drain(name)
 
+    # --------------------------------------------------- cross-plane
+    def evidence_link(self) -> Optional[dict]:
+        """The demand evidence a borrow's ``fleet_migration`` trace
+        links to: the live autoscale episode's trace when one is open
+        (its ``load_window``/``policy`` spans are the recorded 'why');
+        otherwise a minted always-sampled ``serving_pressure``
+        snapshot of the brown-out stage + unmet demand that pulled
+        the trigger.  ``None`` only when there is no pressure story
+        to tell (or no tracer)."""
+        if self.autoscaler is not None:
+            link = getattr(self.autoscaler,
+                           "current_episode_link", None)
+            ev = link() if link is not None else None
+            if ev:
+                return ev
+        tracer = getattr(self.router, "tracer", None)
+        if tracer is None:
+            return None
+        stage = self.pressure_stage()
+        unmet = self.unmet_demand()
+        if stage <= 0 and unmet <= 0:
+            return None
+        root = tracer.start_trace(
+            "serving_pressure", always_sample=True,
+            stage=stage, unmet_demand=unmet,
+            queue_depth=self.router.gateway.depth())
+        tracer.start_span(root, "brownout_stage",
+                          stage=stage).finish()
+        tracer.start_span(root, "unmet_demand",
+                          unmet=unmet).finish()
+        tracer.finish_trace(root)
+        return {"trace_id": root.trace_id, "span_id": root.span_id,
+                "kind": "serving_pressure"}
+
+    def register_replica_origin(self, host: str,
+                                entry: dict) -> None:
+        """Record the fleet_migration trace as the origin of the
+        borrowed host's serving replica, so request attempts landing
+        on it link back to the borrow decision (same registry the
+        autoscale stitcher writes for scale-up/replacement replicas)."""
+        origins = getattr(self.router, "replica_origins", None)
+        if origins is not None:
+            origins[host] = entry
+
 
 class FleetCoordinator:
     """Lease-fenced, exactly-once capacity handoff between training
@@ -408,6 +452,15 @@ class FleetCoordinator:
                 self._retire_debt(f"borrow:{host}", "serving_joined",
                                   now)
                 self._span(mig, "serving_join", now)
+                root = mig.get("root")
+                if root is not None:
+                    # the borrowed replica's origin: request attempts
+                    # landing on this host link to the borrow trace
+                    self.serving.register_replica_origin(host, {
+                        "trace_id": root.trace_id,
+                        "span_id": root.span_id,
+                        "kind": "fleet_borrow",
+                    })
                 self._finish_trace(mig, "ok", now)
                 if reboot:
                     # a reboot ran no checkpoint and shrank nothing:
@@ -678,9 +731,25 @@ class FleetCoordinator:
                      **attrs):
         if self.tracer is None:
             return None
-        return self.tracer.start_trace(
+        root = self.tracer.start_trace(
             "fleet_migration", now=now, always_sample=True,
             host=host, direction=direction, epoch=self.epoch, **attrs)
+        if direction == "borrow":
+            # cross-plane evidence link: the borrow was triggered by
+            # serving pressure — reference the span-level evidence
+            # (the autoscale episode's load_window, or a minted
+            # serving_pressure snapshot) so "why did training shrink"
+            # resolves to the demand that caused it
+            try:
+                evidence = self.serving.evidence_link()
+            except Exception:  # evidence is telemetry, never control
+                evidence = None
+            if evidence:
+                root.add_link(
+                    evidence["trace_id"], evidence["span_id"],
+                    rel="evidence",
+                    kind=evidence.get("kind", "?"))
+        return root
 
     def _span(self, mig: dict, name: str, now: float, **attrs) -> None:
         root = mig.get("root")

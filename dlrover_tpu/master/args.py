@@ -32,6 +32,13 @@ def build_master_parser() -> argparse.ArgumentParser:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--node_num", type=int, default=1)
     parser.add_argument(
+        "--metrics-port", type=int, default=None, dest="metrics_port",
+        help="serve /metrics (goodput ledger + rendezvous counters) "
+             "on this port; 0 binds a kernel-assigned port announced "
+             "as DLROVER_MASTER_METRICS_PORT=<port> on stdout; "
+             "omitted = no metrics endpoint",
+    )
+    parser.add_argument(
         "--pending_timeout", type=int, default=900,
         help="seconds to wait pending nodes before failing the job",
     )

@@ -172,6 +172,8 @@ class DistributedJobMaster:
         self._server = build_server(self.servicer.get, self.servicer.report)
         self._stopped = threading.Event()
         self.exit_reason: str = ""
+        self.metrics_exporter = None  # start_metrics_exporter
+        self.otlp_exporter = None
         # BO-driven runtime tuning loop: propose a ParallelConfig, let the
         # agents' ParalConfigTuner ship it to trainers, observe the speed
         # it achieves, repeat (reference: the Brain-driven auto_tunning
@@ -240,6 +242,68 @@ class DistributedJobMaster:
         """The actually-bound port — authoritative only after
         :meth:`prepare` (``port=0`` = kernel-assigned, race-free)."""
         return self._port
+
+    # -- observability ----------------------------------------------------
+    def master_metrics(self) -> dict:
+        """The goodput ledger + rendezvous state as a Prometheus
+        source: what was JSON-artifact-only (``JobMetricCollector.
+        goodput()``) becomes scrapeable next to the agent/router
+        endpoints — one vocabulary for the whole fleet."""
+        g = self.job_metric_collector.goodput()
+        rdzv = self.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        return {
+            "dlrover_master_goodput": float(g["goodput"]),
+            "dlrover_master_steady_goodput": float(
+                g["steady_goodput"]),
+            "dlrover_master_downtime_seconds_total": float(
+                g["downtime_s"]),
+            "dlrover_master_planned_elasticity_seconds_total": float(
+                g["planned_elasticity_s"]),
+            "dlrover_master_restarts_observed_total": float(
+                g["restarts_observed"]),
+            "dlrover_master_rendezvous_rounds_total": float(
+                rdzv.rdzv_round),
+            "dlrover_master_nodes_waiting": float(
+                rdzv.num_nodes_waiting()),
+            "dlrover_master_world_size": float(
+                len(rdzv.current_world_ranks())),
+        }
+
+    def start_metrics_exporter(self, port: int = 0) -> int:
+        """Serve ``/metrics`` from the master process (port 0 = kernel-
+        assigned, announced on stdout as
+        ``DLROVER_MASTER_METRICS_PORT=<port>`` — the same race-free
+        idiom as the agent exporter).  Returns the bound port."""
+        from dlrover_tpu.common.constants import NodeEnv
+        from dlrover_tpu.utils.profiler import MetricsExporter
+
+        exporter = MetricsExporter(port=port)
+        exporter.add_source(self.master_metrics)
+        exporter.start()
+        self.metrics_exporter = exporter
+        # push the same ledger into the fleet collector when one is
+        # announced (DLROVER_TELEMETRY_ENDPOINT); inert otherwise
+        from dlrover_tpu.utils.otlp import OtlpExporter
+
+        otlp = OtlpExporter.from_env(
+            resource={"service.name": "master"})
+        otlp.add_metrics_source(self.master_metrics)
+        otlp.start()
+        self.otlp_exporter = otlp
+        exporter.add_source(otlp.metrics)
+        print(f"{NodeEnv.MASTER_METRICS_ANNOUNCE_PREFIX}"
+              f"{exporter.port}", flush=True)
+        logger.info("master metrics exporter on 127.0.0.1:%d",
+                    exporter.port)
+        return exporter.port
+
+    def stop_metrics_exporter(self) -> None:
+        if self.metrics_exporter is not None:
+            self.metrics_exporter.stop()
+            self.metrics_exporter = None
+        if self.otlp_exporter is not None:
+            self.otlp_exporter.stop()
+            self.otlp_exporter = None
 
     def run(self, poll_interval: float = 5.0) -> int:
         """Main loop (reference: dist_master.py:211-269): exit on job
@@ -351,6 +415,7 @@ class DistributedJobMaster:
 
     def stop(self) -> None:
         self._stopped.set()
+        self.stop_metrics_exporter()
         self.diagnosis_manager.stop_observing()
         if self.job_auto_scaler is not None:
             self.job_auto_scaler.stop_auto_scaling()

@@ -111,6 +111,15 @@ def run(args) -> int:
     print(
         f"{NodeEnv.MASTER_ANNOUNCE_PREFIX}127.0.0.1:{port}", flush=True
     )
+    if getattr(args, "metrics_port", None) is not None:
+        starter = getattr(master, "start_metrics_exporter", None)
+        if starter is not None:
+            # announces DLROVER_MASTER_METRICS_PORT=<port> itself
+            starter(args.metrics_port)
+        else:
+            logger.warning(
+                "--metrics-port ignored: the %s master has no metrics "
+                "exporter", args.platform)
     logger.info(
         "Master started: platform=%s port=%s", args.platform, port
     )

@@ -19,6 +19,10 @@ Layers (one module each):
   loop from cluster node events back to router membership;
 - :mod:`brownout`  — per-priority brown-out shedding: watermark +
   hysteresis ladder that sheds BATCH before NORMAL, never HIGH;
+- :mod:`slo`       — per-priority objectives + multi-window error-
+  budget burn rates; the SLO-pressure autoscale signal;
+- :mod:`loadgen`   — seeded replayable open-loop traffic generator +
+  the 10k-QPS gateway rig (bench.py --config gateway);
 - :mod:`metrics`   — Prometheus gauges/counters for all of the above;
 - :mod:`router`    — the orchestrating pump.
 """
@@ -50,4 +54,8 @@ from dlrover_tpu.serving.router.scheduler import (  # noqa: F401
 from dlrover_tpu.serving.router.autoscale import (  # noqa: F401
     ReplicaProvisioner,
     ServingAutoScaler,
+)
+from dlrover_tpu.serving.router.slo import (  # noqa: F401
+    SloEngine,
+    SloObjective,
 )

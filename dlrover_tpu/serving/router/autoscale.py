@@ -157,10 +157,16 @@ class ServingAutoScaler:
         ):
             self._last_sample = now
             m = self.router.metrics
+            slo = getattr(self.router, "slo", None)
             self._samples.append(ServingSignal(
                 queue_depth=m.queue_depth_mean(now),
                 ttft_seconds=m.ttft_mean(now),
                 tokens_per_sec=m.tokens_per_second(now),
+                # SLO error-budget burn next to the load windows: the
+                # policy scales up on sustained burn even when slow
+                # replicas keep the queue itself shallow
+                slo_pressure=(
+                    slo.pressure(now) if slo is not None else 0.0),
             ))
             del self._samples[: -8 * self.min_samples]
             # unmet demand refreshes on EVERY sample, not only inside
@@ -743,6 +749,31 @@ class ServingAutoScaler:
             "only": {node.name},
         })
 
+    def sync_traces(self) -> None:
+        """Consume pending fabric events into the open autoscale
+        traces — and the router's replica-origin registry — NOW.  The
+        router calls this right before placement so a replica that
+        joined since the last poll has its origin registered before
+        its FIRST attempt stamps links (on_step alone runs after the
+        step's placements, one round too late for that first hit).
+        Cursor-based and idempotent; pure dict/span bookkeeping, safe
+        under the step lock (DL003)."""
+        self._stitch_scale_traces()
+
+    def current_episode_link(self) -> Optional[dict]:
+        """The live autoscale episode's trace reference, if one is
+        open — the fleet coordinator links a borrow's
+        ``fleet_migration`` trace to it as the demand evidence (its
+        ``load_window``/``policy`` spans are the recorded 'why')."""
+        st = self._scale_trace
+        if st is None and self._open_traces:
+            st = self._open_traces[-1]
+        if st is None:
+            return None
+        root = st["root"]
+        return {"trace_id": root.trace_id, "span_id": root.span_id,
+                "kind": "autoscale_episode"}
+
     def _claimed_names(self) -> set:
         """Names pinned by replacement traces — the generic policy
         trace must not stitch THEIR milestones as its own."""
@@ -786,6 +817,32 @@ class ServingAutoScaler:
         elif name in claimed:
             # a replacement trace owns this name's story
             return
+        # the replica's ORIGIN registry: this trace is the control-
+        # plane decision that created the replica — recorded on the
+        # router so every later placement's attempt span can link back
+        # to it ("why does the replica this request landed on exist").
+        # Keyed by base name (a supervisor respawn rejoins as name#rN
+        # and is still the same decision's offspring); ASSIGNED, not
+        # setdefault — a name re-created by a later decision must link
+        # to the trace that created THIS incarnation, not a long-
+        # evicted predecessor.  Only CREATION milestones register
+        # (probation included): an unrelated replica's death or
+        # quarantine event naming an unknown worker must not be
+        # credited to whatever trace happens to be open.
+        creation_event = (kind in self._UP_STAGES
+                          or kind == "replica_probation")
+        origins = getattr(self.router, "replica_origins", None)
+        if origins is not None and creation_event:
+            root = st["root"]
+            entry = {"trace_id": root.trace_id,
+                     "span_id": root.span_id}
+            replacement_for = root.attrs.get("replacement_for")
+            if replacement_for is not None:
+                entry["kind"] = "replacement"
+                entry["replacement_for"] = replacement_for
+            else:
+                entry["kind"] = "autoscale"
+            origins[base_replica_name(str(name))] = entry
         t = float(event.get("t", st["decided_at"]))
         if kind == "replica_probation":
             # crash-loop damping delayed this replica's first traffic:

@@ -1,0 +1,292 @@
+"""Open-loop traffic generation + the 10k-QPS gateway rig.
+
+A closed-loop load test (N workers, each waiting for its answer before
+sending the next request) measures the SERVER's pace and politely
+backs off exactly when the system degrades — it cannot see the cliff.
+Real traffic is **open-loop**: users arrive when they arrive, whether
+or not the gateway is keeping up.  This module generates that traffic
+and drives it at the in-process serving stack:
+
+- :class:`OpenLoopGenerator` — a **seeded, replayable** arrival
+  schedule: Poisson / bursty (on-off square wave) / diurnal
+  (sinusoidal, a compressed day) arrival processes, heavy-tailed
+  (Pareto) or fixed prompt lengths, and a per-priority mix.  Same
+  config + seed -> byte-identical schedule, so a perf regression
+  re-runs the EXACT offered load that exposed it;
+- :func:`run_gateway_rig` — the bench harness (``bench.py --config
+  gateway``): replays a schedule against a router wall-clock
+  open-loop, measuring what the GATEWAY itself costs — per-request
+  admission latency (the ``submit()`` call: validation, brown-out
+  check, queue insert, trace creation), admission→placement wait,
+  shed behavior per priority band, SLO verdicts from the router's
+  burn-rate engine, and the OTLP exporter's proof counters when one
+  is wired.  The queue bound and the brown-out ladder are expected
+  to bite at rate: shed requests ARE the measurement, not a failure.
+
+Everything here is driver-side; the gateway under test is the real
+one, unmodified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.serving.router.gateway import (
+    PRIORITY_BATCH,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    AdmissionError,
+    BrownoutShedError,
+    QueueFullError,
+)
+from dlrover_tpu.serving.router.slo import BAND_NAMES
+
+
+@dataclasses.dataclass
+class LoadgenConfig:
+    """One replayable offered-load description."""
+
+    seed: int = 0
+    rate_qps: float = 12000.0       # mean offered arrival rate
+    duration_s: float = 2.0         # schedule horizon (virtual time)
+    arrival: str = "poisson"        # poisson | bursty | diurnal
+    burst_factor: float = 4.0       # bursty: on-phase rate multiplier
+    burst_period_s: float = 0.5     # bursty: one on+off cycle
+    diurnal_period_s: float = 4.0   # diurnal: one compressed "day"
+    diurnal_amplitude: float = 0.8  # peak/trough swing (0..1)
+    prompt_mix: str = "heavy_tail"  # heavy_tail | fixed
+    prompt_min: int = 8
+    prompt_max: int = 512
+    pareto_alpha: float = 1.5       # heavy tail: smaller = heavier
+    max_new_tokens: int = 32
+    # (priority, weight) admission mix — the default mirrors a fleet
+    # where interactive traffic dominates and batch rides along
+    priority_mix: Tuple[Tuple[int, float], ...] = (
+        (PRIORITY_HIGH, 0.1),
+        (PRIORITY_NORMAL, 0.6),
+        (PRIORITY_BATCH, 0.3),
+    )
+
+
+@dataclasses.dataclass
+class Arrival:
+    at_s: float          # offset from schedule start (virtual time)
+    prompt_len: int
+    max_new_tokens: int
+    priority: int
+
+
+class OpenLoopGenerator:
+    """Seeded arrival-schedule generator (see module docstring)."""
+
+    def __init__(self, config: Optional[LoadgenConfig] = None):
+        self.config = config or LoadgenConfig()
+        if self.config.arrival not in ("poisson", "bursty", "diurnal"):
+            raise ValueError(
+                f"unknown arrival process {self.config.arrival!r}")
+
+    def _rate_at(self, t: float) -> float:
+        cfg = self.config
+        if cfg.arrival == "bursty":
+            # square-wave on/off, NORMALIZED so the mean stays
+            # rate_qps whatever the burst factor: the on half runs at
+            # burst_factor x the (floored) off half, and both are
+            # scaled by 2/(on+off) — a bursty-vs-poisson comparison
+            # at equal nominal rate really compares shapes, not rates
+            phase = (t % cfg.burst_period_s) / cfg.burst_period_s
+            on = float(cfg.burst_factor)
+            off = max(0.05, 2.0 - on)
+            norm = 2.0 / (on + off)
+            return cfg.rate_qps * norm * (on if phase < 0.5 else off)
+        if cfg.arrival == "diurnal":
+            swing = math.sin(2 * math.pi * t / cfg.diurnal_period_s)
+            return cfg.rate_qps * (
+                1.0 + cfg.diurnal_amplitude * swing)
+        return cfg.rate_qps
+
+    def _prompt_len(self, rng: random.Random) -> int:
+        cfg = self.config
+        if cfg.prompt_mix == "fixed":
+            return cfg.prompt_min
+        # Pareto body at prompt_min, tail clipped at prompt_max — the
+        # heavy-tail mix where one long prompt rides among many short
+        return int(min(cfg.prompt_max,
+                       cfg.prompt_min * rng.paretovariate(
+                           cfg.pareto_alpha)))
+
+    def arrivals(self) -> Iterator[Arrival]:
+        """The schedule, in arrival order.  Deterministic per config."""
+        cfg = self.config
+        rng = random.Random(cfg.seed)
+        bands = [p for p, _ in cfg.priority_mix]
+        weights = [w for _, w in cfg.priority_mix]
+        t = 0.0
+        while True:
+            rate = max(1e-6, self._rate_at(t))
+            t += rng.expovariate(rate)
+            if t >= cfg.duration_s:
+                return
+            yield Arrival(
+                at_s=t,
+                prompt_len=self._prompt_len(rng),
+                max_new_tokens=cfg.max_new_tokens,
+                priority=rng.choices(bands, weights)[0],
+            )
+
+
+def _quantiles(sorted_vals: List[float],
+               qs: Tuple[float, ...]) -> List[float]:
+    if not sorted_vals:
+        return [0.0 for _ in qs]
+    out = []
+    for q in qs:
+        idx = min(len(sorted_vals) - 1,
+                  int(q / 100.0 * len(sorted_vals)))
+        out.append(sorted_vals[idx])
+    return out
+
+
+def hist_quantile(snapshot: Dict[str, object], q: float) -> float:
+    """Approximate quantile from a Histogram.snapshot(): linear
+    interpolation inside the winning bucket (the standard Prometheus
+    histogram_quantile estimate)."""
+    counts = list(snapshot["counts"])
+    bounds = list(snapshot["buckets"])
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q / 100.0 * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if cum + c >= target and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1] * 2
+            frac = (target - cum) / c
+            return lo + (hi - lo) * frac
+        cum += c
+    return bounds[-1]
+
+
+def run_gateway_rig(
+    router,
+    config: Optional[LoadgenConfig] = None,
+    step_every: int = 256,
+    pace: bool = True,
+    admission_reservoir: int = 200_000,
+    drain_max_steps: int = 200_000,
+    otlp_exporter=None,
+) -> Dict[str, object]:
+    """Replay one open-loop schedule against ``router`` on the wall
+    clock and report the gateway's own cost.
+
+    ``pace=True`` holds the driver to the schedule when it runs ahead
+    (so bursty/diurnal shapes survive); it can never slow a driver
+    that is BEHIND — achieved QPS below the offered rate is the
+    honest "this gateway cannot admit that fast" answer, and the
+    bench gates on it.  ``step_every`` bounds how much admission-only
+    work happens between router pump rounds."""
+    cfg = config or LoadgenConfig()
+    gen = OpenLoopGenerator(cfg)
+    # pre-built prompt pool: the rig measures the GATEWAY, and
+    # np.arange per arrival would time numpy allocation instead
+    pool_lens = sorted({a.prompt_len for a in gen.arrivals()})
+    pool = {n: np.arange(n, dtype=np.int32) for n in pool_lens}
+
+    # per-submit wall seconds, RESERVOIR-sampled (not first-N: on a
+    # 60s soak the p99 must see the final seconds' tail, not only the
+    # opening 17s); seeded so the sampling replays with the schedule
+    lat: List[float] = []
+    lat_rng = random.Random(cfg.seed ^ 0x5EED)
+    lat_seen = 0
+    # keyed on the CONFIGURED mix (a custom band outside the stock
+    # three must count, not KeyError mid-run)
+    shed = {band: 0 for band, _ in cfg.priority_mix}
+    shed_kinds = {"queue_full": 0, "brownout": 0, "other": 0}
+    admitted = 0
+    offered = 0
+    steps = 0
+
+    t0 = time.perf_counter()
+    since_step = 0
+    for arrival in gen.arrivals():
+        offered += 1
+        if pace:
+            ahead = arrival.at_s - (time.perf_counter() - t0)
+            if ahead > 0.002:
+                time.sleep(ahead)
+        prompt = pool[arrival.prompt_len]
+        s0 = time.perf_counter()
+        try:
+            router.submit(prompt, arrival.max_new_tokens,
+                          priority=arrival.priority)
+            admitted += 1
+        except BrownoutShedError:
+            shed[arrival.priority] += 1
+            shed_kinds["brownout"] += 1
+        except QueueFullError:
+            shed[arrival.priority] += 1
+            shed_kinds["queue_full"] += 1
+        except AdmissionError:
+            shed[arrival.priority] += 1
+            shed_kinds["other"] += 1
+        dt = time.perf_counter() - s0
+        lat_seen += 1
+        if len(lat) < admission_reservoir:
+            lat.append(dt)
+        else:  # reservoir sampling keeps the quantiles unbiased
+            j = lat_rng.randint(0, lat_seen - 1)
+            if j < admission_reservoir:
+                lat[j] = dt
+        since_step += 1
+        if since_step >= step_every:
+            since_step = 0
+            router.step()
+            steps += 1
+    offer_wall_s = time.perf_counter() - t0
+
+    # drain: the offered phase is over; pump until the admitted work
+    # completes or expires so the SLO verdicts cover every request
+    while router.has_work and steps < drain_max_steps:
+        router.step()
+        steps += 1
+    drain_wall_s = time.perf_counter() - t0 - offer_wall_s
+
+    lat.sort()
+    p50, p99, p999 = _quantiles(lat, (50, 99, 99.9))
+    now = time.monotonic()
+    m = router.metrics.metrics()
+    result: Dict[str, object] = {
+        "gateway_offered": offered,
+        "gateway_admitted": admitted,
+        "gateway_shed": {BAND_NAMES.get(b, str(b)): n
+                         for b, n in shed.items()},
+        "gateway_shed_kinds": dict(shed_kinds),
+        "gateway_offer_wall_s": round(offer_wall_s, 4),
+        "gateway_drain_wall_s": round(drain_wall_s, 4),
+        "gateway_qps": round(offered / max(1e-9, offer_wall_s), 1),
+        "gateway_admission_p50_us": round(p50 * 1e6, 2),
+        "gateway_admission_p99_us": round(p99 * 1e6, 2),
+        "gateway_admission_p999_us": round(p999 * 1e6, 2),
+        "gateway_router_steps": steps,
+        "gateway_completed": int(
+            m["serving_requests_completed_total"]),
+        "gateway_timed_out": int(
+            m["serving_requests_timed_out_total"]),
+        "gateway_queue_wait_p50_s": round(hist_quantile(
+            router.metrics.queue_wait_hist.snapshot(), 50), 6),
+        "gateway_queue_wait_p99_s": round(hist_quantile(
+            router.metrics.queue_wait_hist.snapshot(), 99), 6),
+    }
+    slo = getattr(router, "slo", None)
+    if slo is not None:
+        result["gateway_slo"] = slo.summary(now)
+    if otlp_exporter is not None:
+        result["gateway_otlp"] = {
+            k: v for k, v in otlp_exporter.metrics().items()}
+    return result

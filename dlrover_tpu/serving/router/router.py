@@ -48,6 +48,7 @@ from dlrover_tpu.serving.router.replica import (
     ReplicaHandle,
     ReplicaManager,
     StaleRequestError,
+    base_replica_name,
 )
 from dlrover_tpu.serving.router.scheduler import ContinuousBatchScheduler
 
@@ -84,6 +85,7 @@ class ServingRouter:
         metrics: Optional[RouterMetrics] = None,
         cancel_inflight_on_expiry: bool = False,
         brownout=None,
+        slo=None,
     ):
         # policy knob: when True, a request whose deadline passes MID-
         # GENERATION is aborted and a CANCEL is sent to its replica so
@@ -104,7 +106,19 @@ class ServingRouter:
         self.scheduler = scheduler or ContinuousBatchScheduler()
         self.manager = manager or ReplicaManager()
         self.metrics = metrics or RouterMetrics()
+        # per-priority SLO burn-rate engine (slo.SloEngine): fed by the
+        # step loop's completion/expiry stream; its pressure signal is
+        # sampled by the autoscaler next to the load windows.  None
+        # (default) keeps the historical load-only behavior.
+        self.slo = slo
         self.autoscaler = None  # attached via ServingAutoScaler(router=...)
+        # replica base name -> the control-plane trace that created it
+        # ({"trace_id", "span_id", ...attrs}): written by the autoscale
+        # trace stitcher and the fleet coordinator, read by the step
+        # loop to stamp cross-plane span links on attempt spans ("this
+        # placement landed on the replica THAT autoscale decision /
+        # fleet borrow created")
+        self.replica_origins: Dict[str, dict] = {}
         # the gateway owns the tracer (requests are traced from
         # admission); the router only needs it for fabric events and
         # failure dumps — expose it so exporters/supervisors reach one
@@ -196,6 +210,10 @@ class ServingRouter:
         with self._lock:
             # 1. deadline expiry
             for req in self.gateway.expire(now, dump=False):
+                if self.slo is not None:
+                    # an expiry IS an SLO violation: the answer never
+                    # arrived inside any target
+                    self.slo.observe_violation(req.priority, now)
                 if req.trace is not None:
                     dumps.append(
                         ("deadline_expired", req.trace.trace_id))
@@ -226,6 +244,9 @@ class ServingRouter:
                         state = ServingRequestState.TIMED_OUT
                         self.gateway.timed_out += 1
                         reason = "deadline_expired"
+                        if self.slo is not None:
+                            self.slo.observe_violation(
+                                req.priority, now)
                     req.abort(state)
                     self.recorder.record(
                         "request_cancel_inflight", rid=req.rid,
@@ -248,9 +269,25 @@ class ServingRouter:
 
             # 3a. placement DECISIONS (micro-batch per replica per
             # round); schedulable(now) keeps probation replicas
-            # (crash-loop cooldown) out of the candidate set
+            # (crash-loop cooldown) out of the candidate set.  The
+            # autoscaler's trace stitch runs FIRST so a replica that
+            # joined since the last poll has its origin registered
+            # before its first attempt links to it.
+            if self.autoscaler is not None:
+                sync = getattr(self.autoscaler, "sync_traces", None)
+                if sync is not None:
+                    sync()
             placements = self.scheduler.schedule(
                 self.gateway, self.manager.schedulable(now), now=now)
+            # cross-plane span links: an attempt landing on a replica
+            # the control plane created (autoscale scale-up, capacity-
+            # debt replacement, fleet borrow) references that decision's
+            # always-sampled trace — "why does this replica exist" one
+            # hop from "why was this request slow".  List append under
+            # the lock; no I/O (DL003).
+            if self.replica_origins:
+                for handle, req in placements:
+                    self._link_attempt_origin(handle, req)
         # 3b. placement DELIVERY outside the step lock: for a remote
         # replica, submit is a SUBMIT frame send plus a synchronous ack
         # wait — socket I/O bounded only by submit_timeout, and holding
@@ -335,9 +372,16 @@ class ServingRouter:
                     self.metrics.observe_tokens(len(req.output), now)
                     self.metrics.completed += 1
                     if req.finished_at is not None:
+                        e2e = req.finished_at - req.submitted_at
                         self.metrics.observe_e2e(
-                            req.finished_at - req.submitted_at,
-                            trace_id=_tid(req))
+                            e2e, trace_id=_tid(req))
+                        if self.slo is not None:
+                            ttft = (
+                                req.first_token_at - req.submitted_at
+                                if req.first_token_at is not None
+                                else None)
+                            self.slo.observe(
+                                req.priority, ttft, e2e, now)
                     if req.decode_step_seconds is not None:
                         self.metrics.observe_decode_step(
                             req.decode_step_seconds,
@@ -357,6 +401,15 @@ class ServingRouter:
                     self._close_engine(handle, goodbye=True)
                     self.recorder.record(
                         "replica_retired", replica=handle.name, now=now)
+                    # a deliberately-retired name leaves the fleet for
+                    # good: drop its origin so a later same-named
+                    # joiner cannot inherit a stale (likely evicted)
+                    # decision link — its OWN creation re-registers.
+                    # Deaths keep theirs: a supervisor respawn rejoins
+                    # under the same base and is still the original
+                    # decision's offspring.
+                    self.replica_origins.pop(
+                        base_replica_name(handle.name), None)
                     self.drained.append(
                         DrainedReplica(handle.name, handle.node))
 
@@ -449,6 +502,12 @@ class ServingRouter:
         # their slots and paged KV blocks to the surviving bands
         for req in self.gateway.shed_queued(
                 PRIORITY_BATCH, now=now, dump=False):
+            if self.slo is not None:
+                # a brown-out shed IS an SLO violation for its band:
+                # the user was failed by the fleet's own degradation
+                # ladder, not by their request — the burn it causes
+                # is the signal that pulls capacity back
+                self.slo.observe_violation(req.priority, now)
             if req.trace is not None:
                 dumps.append(("brownout_shed", req.trace.trace_id))
         for handle in self.manager.pumpable():
@@ -458,12 +517,35 @@ class ServingRouter:
                 del handle.inflight[erid]
                 req.abort(ServingRequestState.CANCELLED)
                 self.gateway.cancelled += 1
+                if self.slo is not None:
+                    self.slo.observe_violation(req.priority, now)
                 self.recorder.record(
                     "brownout_cancel_inflight", rid=req.rid,
                     replica=handle.name, now=now)
                 cancels.append((handle, erid))
                 if req.trace is not None:
                     dumps.append(("brownout_shed", req.trace.trace_id))
+
+    def _link_attempt_origin(self, handle: ReplicaHandle,
+                             req: ServingRequest) -> None:
+        """Stamp the W3C-shaped span link from this placement's
+        ``attempt`` span to the control-plane trace that created the
+        replica it landed on (autoscale decision, capacity-debt
+        replacement, fleet borrow).  Failed-over requests are exactly
+        the ones this pays for: their retry's attempt resolves to the
+        replacement trace, so the postmortem reads 'replica died ->
+        HERE is the decision that produced where the retry went'."""
+        if req.trace is None or req.trace.attempt is None:
+            return
+        origin = self.replica_origins.get(
+            base_replica_name(handle.name))
+        if origin is None:
+            return
+        attrs = {k: v for k, v in origin.items()
+                 if k not in ("trace_id", "span_id")}
+        req.trace.attempt.add_link(
+            origin["trace_id"], origin["span_id"],
+            rel="replica_origin", **attrs)
 
     def _record_ttft(self, req: ServingRequest, now: float) -> None:
         if req.first_token_at is not None and not req.ttft_recorded:
@@ -544,6 +626,15 @@ class ServingRouter:
             requests, dump=dumps is None, now=now)
         self.metrics.requeued += len(requests) - len(poisoned)
         self.metrics.poisoned = self.gateway.poisoned
+        if self.slo is not None:
+            for req in poisoned:
+                # the caller never gets an answer: an SLO violation.
+                # (Engine REJECTED requests deliberately are NOT fed
+                # here or at their abort site — an impossible request
+                # is the caller's 4xx, not the fleet's failure.)
+                self.slo.observe_violation(
+                    req.priority,
+                    time.monotonic() if now is None else now)
         for req in poisoned:
             if dumps is not None and req.trace is not None:
                 dumps.append(("poisoned", req.trace.trace_id))

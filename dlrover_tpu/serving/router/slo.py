@@ -1,0 +1,307 @@
+"""SLO vocabulary + burn-rate engine for the serving gateway.
+
+The load-driven autoscaler reacts to QUEUES; queues are a symptom.
+What the fleet actually promises users is per-priority **objectives**
+— a TTFT target, an end-to-end target, and the fraction of requests
+that must meet them (:class:`SloObjective`) — and what an operator
+actually pages on is the **error-budget burn rate**: how fast the
+band is consuming its allowance of slow/failed requests, measured
+over two windows (the Google SRE multi-window rule: a FAST window
+(~5 min) catches a cliff quickly, a SLOW window (~1 h) keeps a blip
+from paging — an alert needs BOTH burning).
+
+:class:`SloEngine` computes all of it from the router's own completion
+stream (the same observations that feed the traced TTFT/e2e
+histograms), using O(1)-memory time-bucketed counters per band — at
+10k QPS an event deque over a one-hour window would hold 36M entries;
+a 60-bucket ring holds 60.
+
+Exported families (per band, optionally per window):
+
+- ``serving_slo_compliance{band,window}``       — fraction of requests
+  meeting BOTH targets over the window (1.0 when idle);
+- ``serving_slo_burn_rate{band,window}``        — error-budget
+  consumption rate: 1.0 = exactly on budget, >1 = burning toward
+  exhaustion, e.g. 14.4 = the classic page-now threshold;
+- ``serving_slo_budget_remaining{band}``        — unspent error budget
+  over the slow window, 1.0 = untouched, 0.0 = exhausted.
+
+The engine's :meth:`pressure` (max over bands of the multi-window
+burn) feeds :class:`~dlrover_tpu.brain.serving.ServingScalePolicy` as
+``ServingSignal.slo_pressure`` — scale-ups fire on budget burn, not
+just queue depth: a band whose p99 TTFT is violating its objective
+scales out even while the queue stays shallow (slow replicas keep the
+queue drained *and* the users waiting).
+
+All observation paths are lock-guarded O(#bands) arithmetic with no
+allocation and no I/O — safe from under the router's step lock
+(DL003/DL007 clean).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.serving.router.gateway import (
+    PRIORITY_BATCH,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+)
+
+BAND_NAMES = {
+    PRIORITY_HIGH: "HIGH",
+    PRIORITY_NORMAL: "NORMAL",
+    PRIORITY_BATCH: "BATCH",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjective:
+    """One priority band's service-level objective."""
+
+    band: int                     # gateway priority (PRIORITY_*)
+    ttft_target_s: float          # first token within this
+    e2e_target_s: float           # completion within this
+    target: float = 0.99          # required compliance ratio
+
+    @property
+    def name(self) -> str:
+        return BAND_NAMES.get(self.band, str(self.band))
+
+    @property
+    def error_budget(self) -> float:
+        """Allowed bad fraction (1 - target), floored so a target of
+        1.0 cannot divide burn rates by zero."""
+        return max(1e-9, 1.0 - self.target)
+
+
+def default_objectives() -> Tuple[SloObjective, ...]:
+    """The stock ladder: HIGH pays for tight latency, BATCH trades it
+    for throughput — mirroring the brown-out shed order."""
+    return (
+        SloObjective(PRIORITY_HIGH, ttft_target_s=0.5,
+                     e2e_target_s=5.0, target=0.999),
+        SloObjective(PRIORITY_NORMAL, ttft_target_s=1.0,
+                     e2e_target_s=10.0, target=0.99),
+        SloObjective(PRIORITY_BATCH, ttft_target_s=5.0,
+                     e2e_target_s=60.0, target=0.95),
+    )
+
+
+class _BucketWindow:
+    """Time-bucketed (total, bad) counters over a sliding window —
+    O(buckets) memory whatever the request rate.  Buckets older than
+    the window are zeroed lazily as time advances."""
+
+    def __init__(self, window_s: float, buckets: int = 30):
+        self.window_s = float(window_s)
+        self.n = int(buckets)
+        self.span = self.window_s / self.n
+        # bucket slot -> [epoch_index, total, bad]
+        self._slots: List[List[float]] = [
+            [-1, 0, 0] for _ in range(self.n)]
+
+    def _slot(self, now: float) -> List[float]:
+        epoch = int(now / self.span)
+        slot = self._slots[epoch % self.n]
+        if slot[0] != epoch:
+            slot[0], slot[1], slot[2] = epoch, 0, 0
+        return slot
+
+    def observe(self, bad: bool, now: float) -> None:
+        slot = self._slot(now)
+        slot[1] += 1
+        if bad:
+            slot[2] += 1
+
+    def totals(self, now: float) -> Tuple[int, int]:
+        """(total, bad) over the live window."""
+        min_epoch = int(now / self.span) - self.n + 1
+        total = bad = 0
+        for epoch, t, b in self._slots:
+            if epoch >= min_epoch:
+                total += t
+                bad += b
+        return total, bad
+
+
+class _BandState:
+    def __init__(self, objective: SloObjective, fast_window: float,
+                 slow_window: float):
+        self.objective = objective
+        self.fast = _BucketWindow(fast_window, buckets=30)
+        self.slow = _BucketWindow(slow_window, buckets=60)
+        self.observed_total = 0
+        self.violations_total = 0
+
+
+class SloEngine:
+    """Per-priority objective tracking + multi-window burn rates."""
+
+    def __init__(
+        self,
+        objectives: Optional[Tuple[SloObjective, ...]] = None,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+    ):
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self._lock = threading.Lock()
+        self._bands: Dict[int, _BandState] = {}
+        for obj in (objectives or default_objectives()):
+            self._bands[obj.band] = _BandState(
+                obj, self.fast_window_s, self.slow_window_s)
+
+    def objective(self, band: int) -> Optional[SloObjective]:
+        state = self._bands.get(band)
+        return None if state is None else state.objective
+
+    # ------------------------------------------------------- observe
+    def observe(self, band: int, ttft_s: Optional[float],
+                e2e_s: float, now: float) -> None:
+        """One completed request: compliant iff BOTH targets held.
+        A missing TTFT (non-streaming legacy path) judges on e2e
+        alone rather than inventing a violation."""
+        state = self._bands.get(band)
+        if state is None:
+            return
+        obj = state.objective
+        bad = e2e_s > obj.e2e_target_s or (
+            ttft_s is not None and ttft_s > obj.ttft_target_s)
+        self._record(state, bad, now)
+
+    def observe_violation(self, band: int, now: float) -> None:
+        """A request that never produced its answer inside the SLO at
+        all — deadline expiry.  Counts as one observed, one bad."""
+        state = self._bands.get(band)
+        if state is not None:
+            self._record(state, True, now)
+
+    def _record(self, state: _BandState, bad: bool,
+                now: float) -> None:
+        with self._lock:
+            state.fast.observe(bad, now)
+            state.slow.observe(bad, now)
+            state.observed_total += 1
+            if bad:
+                state.violations_total += 1
+
+    # --------------------------------------------------------- views
+    def _window(self, state: _BandState, window: str) -> _BucketWindow:
+        return state.fast if window == "fast" else state.slow
+
+    def compliance(self, band: int, now: float,
+                   window: str = "fast") -> float:
+        state = self._bands.get(band)
+        if state is None:
+            return 1.0
+        with self._lock:
+            total, bad = self._window(state, window).totals(now)
+        return 1.0 if total == 0 else 1.0 - bad / total
+
+    def burn_rate(self, band: int, now: float,
+                  window: str = "fast") -> float:
+        """Error-budget consumption rate over the window: the bad
+        fraction divided by the allowed bad fraction.  1.0 = burning
+        exactly at budget; an idle window burns 0."""
+        state = self._bands.get(band)
+        if state is None:
+            return 0.0
+        with self._lock:
+            total, bad = self._window(state, window).totals(now)
+        if total == 0:
+            return 0.0
+        return (bad / total) / state.objective.error_budget
+
+    def budget_remaining(self, band: int, now: float) -> float:
+        """Unspent error budget over the SLOW window, clamped [0, 1]:
+        0.0 means the band has already served its whole allowance of
+        bad requests this window — every further violation is debt."""
+        state = self._bands.get(band)
+        if state is None:
+            return 1.0
+        with self._lock:
+            total, bad = state.slow.totals(now)
+        if total == 0:
+            return 1.0
+        allowed = total * state.objective.error_budget
+        return max(0.0, min(1.0, 1.0 - bad / max(1e-9, allowed)))
+
+    def pressure(self, now: float) -> float:
+        """The autoscale signal: max over bands of the MULTI-WINDOW
+        burn (min of fast and slow) — both windows must be burning,
+        so one bad second cannot trigger a scale-up but a sustained
+        violation does even while the queue stays shallow."""
+        worst = 0.0
+        for band in self._bands:
+            burn = min(self.burn_rate(band, now, "fast"),
+                       self.burn_rate(band, now, "slow"))
+            worst = max(worst, burn)
+        return worst
+
+    # ------------------------------------------------------- exports
+    def otlp_metrics(self, now: float) -> List[tuple]:
+        """``[(name, attrs, value)]`` for the OTLP labeled-gauge push
+        (the collector's ``/fleet/slo`` view reads exactly these)."""
+        out: List[tuple] = []
+        for band, state in sorted(self._bands.items()):
+            name = state.objective.name
+            for window in ("fast", "slow"):
+                attrs = {"band": name, "window": window}
+                out.append(("serving_slo_compliance", attrs,
+                            self.compliance(band, now, window)))
+                out.append(("serving_slo_burn_rate", attrs,
+                            self.burn_rate(band, now, window)))
+            out.append(("serving_slo_budget_remaining", {"band": name},
+                        self.budget_remaining(band, now)))
+        return out
+
+    def render(self) -> str:
+        """Prometheus text with band/window labels — wire via
+        ``MetricsExporter.add_text_source`` (or ``attach_router``)."""
+        import time as _time
+
+        from dlrover_tpu.utils.metric_registry import metric_help
+        from dlrover_tpu.utils.profiler import escape_label_value
+
+        now = _time.monotonic()
+        lines: List[str] = []
+        seen_help = set()
+        for name, attrs, value in self.otlp_metrics(now):
+            if name not in seen_help:
+                seen_help.add(name)
+                help_text = metric_help(name)
+                if help_text:
+                    lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+            inner = ",".join(
+                f'{k}="{escape_label_value(str(v))}"'
+                for k, v in sorted(attrs.items()))
+            lines.append(f"{name}{{{inner}}} {value:.6g}")
+        return "\n".join(lines) + "\n"
+
+    def summary(self, now: float) -> Dict[str, dict]:
+        """JSON-ready verdict per band (the bench rig's SLO report)."""
+        out: Dict[str, dict] = {}
+        for band, state in sorted(self._bands.items()):
+            obj = state.objective
+            out[obj.name] = {
+                "ttft_target_s": obj.ttft_target_s,
+                "e2e_target_s": obj.e2e_target_s,
+                "target": obj.target,
+                "observed": state.observed_total,
+                "violations": state.violations_total,
+                "compliance_fast": round(
+                    self.compliance(band, now, "fast"), 6),
+                "burn_rate_fast": round(
+                    self.burn_rate(band, now, "fast"), 4),
+                "burn_rate_slow": round(
+                    self.burn_rate(band, now, "slow"), 4),
+                "budget_remaining": round(
+                    self.budget_remaining(band, now), 6),
+                "met": self.compliance(band, now, "slow")
+                >= obj.target,
+            }
+        return out

@@ -292,6 +292,77 @@ METRIC_HELP: Dict[str, str] = {
     "dlrover_fleet_return_handoff_seconds": (
         "latest return decision -> training-resumed handoff latency"
     ),
+    # -- OTLP push pipeline (utils/otlp.OtlpExporter.metrics) ----------
+    "dlrover_otlp_shipped_total": (
+        "traces delivered to the telemetry collector — shipped + "
+        "dropped always equals traces offered (the never-block "
+        "accounting identity; periodic metric snapshots are re-reads "
+        "and count into neither)"
+    ),
+    "dlrover_otlp_dropped_total": (
+        "traces dropped instead of blocking the hot path: queue-full "
+        "drops plus batches abandoned after the push retry budget — "
+        "nonzero during a collector outage is the pipeline WORKING "
+        "as designed"
+    ),
+    "dlrover_otlp_push_errors_total": (
+        "OTLP pushes that exhausted their retry budget — rising says "
+        "the collector is down/stalling; the exporter keeps dropping "
+        "rather than buffering unboundedly"
+    ),
+    "dlrover_otlp_queue_depth": (
+        "telemetry items currently buffered for push (bounded by the "
+        "exporter's queue_capacity)"
+    ),
+    # -- SLO burn-rate engine (serving/router/slo.SloEngine; labeled ---
+    # -- band=HIGH|NORMAL|BATCH, window=fast|slow)
+    "serving_slo_compliance": (
+        "fraction of the band's requests meeting BOTH the TTFT and "
+        "e2e targets over the window (1.0 when idle); labeled "
+        'band="…",window="fast|slow"'
+    ),
+    "serving_slo_burn_rate": (
+        "error-budget consumption rate over the window: 1.0 = "
+        "burning exactly at the objective's allowance, >1 = heading "
+        "for exhaustion; the multi-window min feeds the autoscaler "
+        "as SLO pressure"
+    ),
+    "serving_slo_budget_remaining": (
+        "unspent error budget over the slow window (1.0 untouched, "
+        "0.0 exhausted — every further violation is debt); labeled "
+        'band="…"'
+    ),
+    # -- master goodput ledger (dist_master.master_metrics) ------------
+    "dlrover_master_goodput": (
+        "productive-step time over available wall time since job "
+        "start (planned-elasticity windows excluded from the "
+        "denominator) — the paper's headline metric, scrapeable"
+    ),
+    "dlrover_master_steady_goodput": (
+        "goodput measured from the FIRST step report (launch/compile "
+        "cost amortized out) — the number comparable to the 95% claim"
+    ),
+    "dlrover_master_downtime_seconds_total": (
+        "wall seconds lost to faults/restarts (planned elasticity "
+        "excluded)"
+    ),
+    "dlrover_master_planned_elasticity_seconds_total": (
+        "wall seconds inside coordinator-initiated shrink/regrow "
+        "windows — deliberate chip repurposing, not downtime"
+    ),
+    "dlrover_master_restarts_observed_total": (
+        "worker-group restarts the goodput ledger charged"
+    ),
+    "dlrover_master_rendezvous_rounds_total": (
+        "rendezvous rounds completed by the elastic-training "
+        "rendezvous manager (growth, shrink, restart each bump it)"
+    ),
+    "dlrover_master_nodes_waiting": (
+        "agents currently waiting in the rendezvous for a new round"
+    ),
+    "dlrover_master_world_size": (
+        "ranks in the current training comm world"
+    ),
     # -- xprof auto-profiling (utils/xprof_metrics.AutoProfiler) -------
     "dlrover_xprof_profiles_total": "xprof captures taken so far",
     "dlrover_xprof_last_capture_timestamp": (
@@ -331,7 +402,31 @@ NON_METRIC_SERVING_NAMES = frozenset({
     "dlrover_tpu_factory",  # multi-process queue name (constants.py)
     "serving_join",      # fleet migration trace span name (coordinator)
     "serving_joined",    # fleet debt retire reason (coordinator)
+    "serving_pressure",  # borrow-evidence trace root name (fleet)
+    "serving_slo_",      # SLO family prefix (slo.py slices field names
+                         # off it for the collector's /fleet/slo view)
 })
+
+
+#: Declared label keys per labeled metric family — the source of truth
+#: dlint's DL010 (metric-label-cardinality) checks labeled-sample
+#: construction against.  A family missing here must not be rendered
+#: with labels; a key missing from its tuple is a finding; and label
+#: VALUES must come from bounded vocabularies (worker names, states,
+#: priority bands) — never from per-request identifiers (rid, trace
+#: ids, erids) or host:port strings, which would mint one Prometheus
+#: series per request and OOM every scraper that aggregates the fleet.
+METRIC_LABELS: Dict[str, tuple] = {
+    "serving_worker_state": ("worker", "state"),
+    "serving_slo_compliance": ("band", "window"),
+    "serving_slo_burn_rate": ("band", "window"),
+    "serving_slo_budget_remaining": ("band",),
+    # per-op device time of the last captured step: op names come
+    # from the XLA module (bounded by the compiled program)
+    "dlrover_xprof_collective_seconds": ("op",),
+    "dlrover_xprof_op_seconds": ("op",),
+    "dlrover_xprof_op_count": ("op",),
+}
 
 
 def metric_help(name: str) -> Optional[str]:

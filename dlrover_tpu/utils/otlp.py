@@ -1,0 +1,419 @@
+"""OTLP-schema telemetry push: spans + metrics leave the process.
+
+Until now every process (router, elastic agent, master, fleet
+coordinator) kept its telemetry in its own ring buffer behind its own
+HTTP port — pull-only, per-process.  This module is the push half of
+the fleet observatory: an exporter that ships finished traces and
+metric snapshots as **OTLP/HTTP-JSON-shaped payloads** (the
+``resourceSpans`` / ``resourceMetrics`` envelope an OpenTelemetry
+collector speaks) to one aggregation point
+(:mod:`~dlrover_tpu.utils.telemetry_collector`), so "why was this
+request slow" is answerable across plane boundaries from a single
+queryable store.
+
+Stdlib-only, and built around one discipline — **the hot path must
+never notice the collector**:
+
+- :meth:`OtlpExporter.ship_trace` is a bounded-deque append under a
+  short lock: it never blocks, never allocates proportionally to the
+  backlog, and when the queue is full it DROPS the incoming trace and
+  counts it (``dlrover_otlp_dropped_total``) instead of growing;
+- the push itself runs on a dedicated daemon writer thread: batches
+  are drained, converted and POSTed there, behind a
+  :class:`~dlrover_tpu.common.retry.RetryPolicy` with a small attempt
+  budget and a hard deadline, so a stalling collector costs bounded
+  writer-thread time and zero router-step time;
+- a push that exhausts its retry budget counts one
+  ``dlrover_otlp_push_errors_total`` and its batch counts into
+  ``dlrover_otlp_dropped_total`` — shipped + dropped always equals
+  offered, which is the accounting identity the collector-outage
+  chaos test audits;
+- ``dlrover_otlp_shipped_total`` proves delivery; all three counters
+  are a ``metrics()`` source for the process's own ``/metrics``
+  endpoint, so the exporter's health is visible through the SAME
+  scrape surface it exists to supplement.
+
+The payloads are *schema-compatible JSON*, not protobuf: hex
+``traceId``/``spanId``, ``timeUnixNano`` strings, typed ``attributes``
+lists, ``links`` on spans, histogram dataPoints with ``bucketCounts``
+/ ``explicitBounds`` and trace-exemplars — close enough that pointing
+the endpoint at a real OTLP/HTTP collector's ``/v1/traces`` ingests
+cleanly, while the in-repo collector stays a plain json.loads.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryPolicy
+
+
+def otlp_attributes(attrs: Dict[str, object]) -> List[dict]:
+    """``{k: v}`` -> OTLP attribute list with typed values.  Values the
+    schema cannot carry natively (lists, dicts) degrade to their string
+    form — telemetry must degrade toward shipping data, not erroring."""
+    out: List[dict] = []
+    for key, value in attrs.items():
+        if isinstance(value, bool):
+            typed = {"boolValue": value}
+        elif isinstance(value, int):
+            typed = {"intValue": str(value)}
+        elif isinstance(value, float):
+            typed = {"doubleValue": value}
+        else:
+            typed = {"stringValue": str(value)}
+        out.append({"key": str(key), "value": typed})
+    return out
+
+
+def _nanos(unix_seconds: float) -> str:
+    """OTLP timeUnixNano (stringified int, per the JSON mapping)."""
+    return str(int(unix_seconds * 1e9))
+
+
+def trace_to_resource_spans(trace, resource: Dict[str, str]) -> dict:
+    """One finished :class:`~dlrover_tpu.utils.tracing.Trace` as an
+    OTLP ``resourceSpans`` entry.  Span monotonic offsets are rebased
+    onto the trace's wall anchor so cross-process stitching in the
+    collector happens on absolute time."""
+    anchor = trace.wall_anchor - trace.root.start
+    spans = []
+    for s in trace.spans:
+        end = s.end if s.end is not None else s.start
+        span = {
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "name": s.name,
+            "startTimeUnixNano": _nanos(anchor + s.start),
+            "endTimeUnixNano": _nanos(anchor + end),
+            "status": {"code": 1 if s.status == "ok" else 2,
+                       "message": s.status},
+            "attributes": otlp_attributes(s.attrs),
+        }
+        if s.parent_id:
+            span["parentSpanId"] = s.parent_id
+        links = getattr(s, "links", None)
+        if links:
+            span["links"] = [{
+                "traceId": ln["trace_id"],
+                "spanId": ln["span_id"],
+                "attributes": otlp_attributes(ln.get("attrs") or {}),
+            } for ln in links]
+        spans.append(span)
+    return {
+        "resource": {"attributes": otlp_attributes(resource)},
+        "scopeSpans": [{
+            "scope": {"name": "dlrover_tpu"},
+            "spans": spans,
+        }],
+    }
+
+
+def _gauge_metric(name: str, points: List[Tuple[dict, float]],
+                  now_unix: float) -> dict:
+    return {
+        "name": name,
+        "gauge": {"dataPoints": [{
+            "asDouble": float(value),
+            "timeUnixNano": _nanos(now_unix),
+            "attributes": otlp_attributes(attrs),
+        } for attrs, value in points]},
+    }
+
+
+def histogram_to_metric(snapshot: dict, now_unix: float) -> dict:
+    """A :meth:`~dlrover_tpu.utils.profiler.Histogram.snapshot` as an
+    OTLP histogram dataPoint, bucket exemplars carrying trace ids."""
+    exemplars = []
+    for ex in snapshot["exemplars"]:
+        if ex is None:
+            continue
+        tid, value, ts = ex
+        exemplars.append({
+            "traceId": str(tid),
+            "asDouble": float(value),
+            "timeUnixNano": _nanos(ts),
+        })
+    return {
+        "name": snapshot["name"],
+        "histogram": {
+            "aggregationTemporality": 2,  # cumulative
+            "dataPoints": [{
+                "bucketCounts": [str(c) for c in snapshot["counts"]],
+                "explicitBounds": list(snapshot["buckets"]),
+                "count": str(snapshot["count"]),
+                "sum": snapshot["sum"],
+                "timeUnixNano": _nanos(now_unix),
+                "exemplars": exemplars,
+            }],
+        },
+    }
+
+
+def _http_post(url: str, body: bytes, timeout: float) -> None:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()
+
+
+class OtlpExporter:
+    """Bounded-queue batching OTLP push pipeline (one per process).
+
+    ``endpoint`` is the collector base URL (``http://127.0.0.1:<port>``
+    — spans POST to ``<endpoint>/v1/traces``, metric snapshots to
+    ``<endpoint>/v1/metrics``).  ``endpoint=None`` leaves the exporter
+    inert (offers drop-count immediately; no thread starts), so wiring
+    can be unconditional.  ``resource`` names the process in every
+    payload (``service.name`` = router / agent / master / fleet) — the
+    collector's cross-plane stitch keys on it.
+
+    ``transport`` is injectable for tests: a
+    ``callable(url, body_bytes)`` that raises on failure.
+    """
+
+    def __init__(
+        self,
+        endpoint: Optional[str],
+        resource: Optional[Dict[str, str]] = None,
+        queue_capacity: int = 4096,
+        batch_max: int = 256,
+        flush_interval: float = 0.05,
+        metrics_interval: float = 1.0,
+        retry: Optional[RetryPolicy] = None,
+        transport: Optional[Callable[[str, bytes], None]] = None,
+        timeout: float = 2.0,
+    ):
+        self.endpoint = endpoint.rstrip("/") if endpoint else None
+        self.resource = dict(resource or {})
+        self.resource.setdefault("service.name", "dlrover")
+        self.queue_capacity = int(queue_capacity)
+        self.batch_max = int(batch_max)
+        self.flush_interval = float(flush_interval)
+        self.metrics_interval = float(metrics_interval)
+        self.timeout = float(timeout)
+        # a SMALL budget on purpose: the writer thread is shared by
+        # every later batch, and a collector outage must cost bounded
+        # writer time per batch, not the control-plane default 60s
+        self.retry = retry or RetryPolicy(
+            max_attempts=3, backoff_base=0.05, backoff_multiplier=2.0,
+            backoff_max=0.5, deadline=2.0, jitter=0.25, seed=0)
+        self._transport = transport or (
+            lambda url, body: _http_post(url, body, self.timeout))
+        self._lock = threading.Lock()
+        self._queue: Deque[tuple] = deque()
+        self._busy = False  # a popped batch is still being pushed
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._metric_sources: List[Callable[[], Dict[str, float]]] = []
+        self._labeled_sources: List[Callable[[], list]] = []
+        self._histogram_sources: List[Callable[[], list]] = []
+        self._last_metrics_push = 0.0
+        # the proof counters (metric registry: dlrover_otlp_*)
+        self.shipped_total = 0
+        self.dropped_total = 0
+        self.push_errors_total = 0
+
+    @classmethod
+    def from_env(cls, resource: Optional[Dict[str, str]] = None,
+                 **kwargs) -> "OtlpExporter":
+        """Exporter pointed at the fleet collector announced through
+        ``DLROVER_TELEMETRY_ENDPOINT`` (the base URL, e.g.
+        ``http://127.0.0.1:<port>`` from the collector's stdout
+        announce).  Unset env -> an INERT exporter (offers count as
+        drops=0, ``start()`` no-ops), so agent/master wiring is
+        unconditional."""
+        import os
+
+        from dlrover_tpu.common.constants import NodeEnv
+
+        endpoint = os.environ.get(NodeEnv.TELEMETRY_ENDPOINT) or None
+        return cls(endpoint, resource=resource, **kwargs)
+
+    # ------------------------------------------------------- hot path
+    def ship_trace(self, trace) -> bool:
+        """Enqueue a finished trace for push.  NEVER blocks: a full
+        queue drops the trace and counts it.  Safe to call from under
+        the tracer's lock (deque append under a short private lock —
+        no I/O, DL003-clean); returns whether the trace was queued."""
+        if self.endpoint is None:
+            return False
+        with self._lock:
+            if len(self._queue) >= self.queue_capacity:
+                self.dropped_total += 1
+                return False
+            self._queue.append(("trace", trace))
+        self._wake.set()
+        return True
+
+    # -------------------------------------------------- metric wiring
+    def add_metrics_source(self, fn: Callable[[], Dict[str, float]]):
+        """``fn() -> {name: value}`` gauges, snapshotted and pushed by
+        the writer thread every ``metrics_interval``."""
+        self._metric_sources.append(fn)
+
+    def add_labeled_source(self, fn: Callable[[], list]):
+        """``fn() -> [(name, attrs_dict, value)]`` — labeled gauges
+        (the SLO engine's per-band families ride this)."""
+        self._labeled_sources.append(fn)
+
+    def add_histogram_source(self, fn: Callable[[], list]):
+        """``fn() -> [Histogram]`` (objects exposing ``snapshot()``) —
+        pushed as OTLP histogram dataPoints with trace exemplars."""
+        self._histogram_sources.append(fn)
+
+    # ------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self.endpoint is None or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="otlp-exporter")
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Test hook: wait until the queue drains (or ``timeout``).
+        The queue also drains by DROPPING when the collector is down —
+        a True return means 'nothing left buffered', not 'delivered'."""
+        deadline = time.monotonic() + timeout
+        self._wake.set()
+        while time.monotonic() < deadline:
+            with self._lock:
+                # empty queue is not enough: a popped batch may still
+                # be mid-push — its accounting must land before a
+                # flusher reads the counters
+                if not self._queue and not self._busy:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -------------------------------------------------------- metrics
+    def metrics(self) -> Dict[str, float]:
+        """Prometheus source (``MetricsExporter.add_source``)."""
+        with self._lock:
+            return {
+                "dlrover_otlp_shipped_total": float(self.shipped_total),
+                "dlrover_otlp_dropped_total": float(self.dropped_total),
+                "dlrover_otlp_push_errors_total": float(
+                    self.push_errors_total),
+                "dlrover_otlp_queue_depth": float(len(self._queue)),
+            }
+
+    # -------------------------------------------------- writer thread
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval)
+            self._wake.clear()
+            try:
+                self._drain_traces()
+                self._maybe_push_metrics()
+            except Exception:  # the pipeline must outlive any payload
+                logger.warning(
+                    "otlp writer round failed; continuing",
+                    exc_info=True)
+        # best-effort final drain so short-lived processes ship
+        try:
+            self._drain_traces()
+        except Exception:
+            pass
+
+    def _drain_traces(self) -> None:
+        while True:
+            batch: List[object] = []
+            with self._lock:
+                while self._queue and len(batch) < self.batch_max:
+                    batch.append(self._queue.popleft()[1])
+                self._busy = bool(batch)
+            if not batch:
+                return
+            try:
+                payload = {"resourceSpans": [
+                    trace_to_resource_spans(t, self.resource)
+                    for t in batch
+                ]}
+                self._push("/v1/traces", payload, len(batch))
+            finally:
+                with self._lock:
+                    self._busy = False
+
+    def _maybe_push_metrics(self) -> None:
+        now = time.monotonic()
+        if now - self._last_metrics_push < self.metrics_interval:
+            return
+        if not (self._metric_sources or self._labeled_sources
+                or self._histogram_sources):
+            return
+        self._last_metrics_push = now
+        now_unix = time.time()
+        metrics: List[dict] = []
+        for src in self._metric_sources:
+            try:
+                for name, value in src().items():
+                    metrics.append(_gauge_metric(
+                        name, [({}, value)], now_unix))
+            except Exception:
+                logger.debug("otlp metric source failed", exc_info=True)
+        for src in self._labeled_sources:
+            try:
+                for name, attrs, value in src():
+                    metrics.append(_gauge_metric(
+                        name, [(attrs, value)], now_unix))
+            except Exception:
+                logger.debug("otlp labeled source failed", exc_info=True)
+        for src in self._histogram_sources:
+            try:
+                for hist in src():
+                    metrics.append(histogram_to_metric(
+                        hist.snapshot(), now_unix))
+            except Exception:
+                logger.debug("otlp histogram source failed",
+                             exc_info=True)
+        if not metrics:
+            return
+        payload = {"resourceMetrics": [{
+            "resource": {"attributes": otlp_attributes(self.resource)},
+            "scopeMetrics": [{
+                "scope": {"name": "dlrover_tpu"},
+                "metrics": metrics,
+            }],
+        }]}
+        # n_items=0: metric snapshots are periodic re-reads, never
+        # queued offers — counting them into shipped/dropped would
+        # break the traces' shipped + dropped == offered identity
+        # (push failures still count into push_errors_total)
+        self._push("/v1/metrics", payload, 0)
+
+    def _push(self, path: str, payload: dict, n_items: int) -> None:
+        body = json.dumps(payload, default=str).encode()
+        url = self.endpoint + path
+        try:
+            self.retry.call(self._transport, url, body,
+                            what=f"otlp push {path}")
+        except Exception as e:
+            with self._lock:
+                self.push_errors_total += 1
+                # shipped + dropped == offered: the failed batch is
+                # accounted as dropped, never silently vanished
+                self.dropped_total += n_items
+            logger.debug("otlp push %s failed (batch of %d dropped): %s",
+                         path, n_items, e)
+            return
+        with self._lock:
+            self.shipped_total += n_items

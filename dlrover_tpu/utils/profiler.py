@@ -214,6 +214,21 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    def snapshot(self) -> Dict[str, object]:
+        """Consistent copy of the whole distribution (counts are the
+        per-bucket NON-cumulative values; the last slot is overflow) —
+        what the OTLP exporter converts into a histogram dataPoint
+        with trace exemplars."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "exemplars": list(self._exemplars),
+                "sum": self._sum,
+                "count": self._count,
+            }
+
     @staticmethod
     def _fmt(x: float) -> str:
         return f"{x:.12g}"
@@ -375,9 +390,14 @@ class MetricsExporter:
     def attach_router(self, router) -> None:
         """One-call wiring for a ServingRouter: gauges + OpenMetrics
         latency histograms (with trace-exemplar drill-down) on
-        ``/metrics``, span traces on ``/traces*``."""
+        ``/metrics``, span traces on ``/traces*``, and — when the
+        router carries an SLO engine — the per-band
+        ``serving_slo_*`` families."""
         self.add_source(router.metrics.metrics)
         self.add_text_source(router.metrics.render_histograms)
+        slo = getattr(router, "slo", None)
+        if slo is not None:
+            self.add_text_source(slo.render)
         self.attach_tracer(router.tracer)
 
     # ---------------------------------------------------------- render
@@ -417,30 +437,48 @@ class MetricsExporter:
     def _render_traces(self, path: str) -> Optional[str]:
         if self._tracer is None:
             return None
-        if path.startswith("/traces/slowest"):
+        import urllib.parse
+
+        split = urllib.parse.urlsplit(path)
+        query = urllib.parse.parse_qs(split.query)
+
+        def q(key):
+            return (query.get(key) or [None])[0]
+
+        def q_limit(default: int) -> int:
+            # clamp: ?limit= is an operator convenience mid-incident,
+            # not a lever for unbounded serialization work
+            try:
+                return max(1, min(int(q("limit") or default), 500))
+            except ValueError:
+                return default
+
+        if split.path.startswith("/traces/slowest"):
             return json.dumps({
-                "traces": self._tracer.slowest(10),
+                "traces": self._tracer.slowest(
+                    q_limit(10), name=q("name"), status=q("status")),
             }, default=str)
-        if path.startswith("/traces/autoscale"):
+        if split.path.startswith("/traces/autoscale"):
             # control-plane traces: one per scale decision, active ones
             # included (plan -> spawn -> join spans arrive over seconds)
             return json.dumps({
-                "traces": self._tracer.traces_named("autoscale"),
+                "traces": self._tracer.traces_named(
+                    "autoscale", limit=q_limit(20)),
             }, default=str)
-        if path.startswith("/traces/chrome"):
+        if split.path.startswith("/traces/chrome"):
             # perfetto-ready trace-event JSON; ?trace_id= narrows to
             # one request (404 when it is unknown/evicted)
-            import urllib.parse
-
-            query = urllib.parse.parse_qs(
-                urllib.parse.urlsplit(path).query)
-            trace_id = (query.get("trace_id") or [None])[0]
+            trace_id = q("trace_id")
             if trace_id is not None \
                     and self._tracer.get_tree(trace_id) is None:
                 return None
             return self._tracer.export_chrome_trace(trace_id)
+        # /traces with ?name= / ?status= / ?limit= — at a 4096-entry
+        # active set the unfiltered dump is unusable mid-incident;
+        # "the failover traces, newest 20" is the real question
         return json.dumps({
-            "traces": self._tracer.finished(50),
+            "traces": self._tracer.finished(
+                q_limit(50), name=q("name"), status=q("status")),
             "flight_dumps": list(self._tracer.recorder.dumps),
         }, default=str)
 

@@ -1,0 +1,429 @@
+"""The fleet telemetry collector: one queryable store for every plane.
+
+The receiving half of the fleet observatory
+(:mod:`~dlrover_tpu.utils.otlp` is the sending half): an in-repo
+OTLP/HTTP-JSON ingest point that aggregates pushes from the serving
+router, the elastic agents, the master and the fleet coordinator into
+bounded in-memory stores, then answers the cross-plane questions no
+single process's ring buffer could:
+
+- ``POST /v1/traces``  — OTLP ``resourceSpans`` ingest; spans are
+  keyed by ``trace_id`` and tagged with the pushing process's
+  ``service.name`` resource attribute, so ONE trace whose spans were
+  emitted by the router AND the fleet coordinator stitches back into
+  one tree;
+- ``POST /v1/metrics`` — OTLP ``resourceMetrics`` ingest (gauges with
+  attributes, histograms with trace exemplars), latest value per
+  (process, name, attrs) retained;
+- ``GET /fleet/traces[?trace_id=&name=&limit=]`` — stitched span
+  trees across processes, each span annotated with the process that
+  emitted it; span links (W3C-shaped trace_id/span_id refs) ride
+  through, so a request's ``attempt`` resolves to the autoscale trace
+  that created its replica *in the collector too*;
+- ``GET /fleet/metrics`` — the latest gauge surface per process;
+- ``GET /fleet/slo`` — the SLO vocabulary view: per process, per
+  priority band, compliance / burn rates / budget remaining (read
+  from the pushed ``serving_slo_*`` families);
+- ``GET /healthz``.
+
+Port-0 + stdout announce (``DLROVER_TELEMETRY_PORT=<port>``), the
+project's race-free port idiom.  Stores are bounded (oldest trace
+evicts); ingest failures answer 400 and count — a malformed pusher
+must not take the collector down.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+import urllib.parse
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def _attr_dict(attributes: Optional[list]) -> Dict[str, object]:
+    """OTLP attribute list -> plain dict (inverse of otlp_attributes)."""
+    out: Dict[str, object] = {}
+    for item in attributes or []:
+        try:
+            key = str(item["key"])
+            value = item.get("value") or {}
+        except (TypeError, KeyError):
+            continue
+        if "stringValue" in value:
+            out[key] = value["stringValue"]
+        elif "intValue" in value:
+            try:
+                out[key] = int(value["intValue"])
+            except (TypeError, ValueError):
+                out[key] = value["intValue"]
+        elif "doubleValue" in value:
+            out[key] = value["doubleValue"]
+        elif "boolValue" in value:
+            out[key] = value["boolValue"]
+    return out
+
+
+class TelemetryStore:
+    """Bounded, lock-guarded aggregation state (separable from the
+    HTTP surface so tests can ingest/query without sockets)."""
+
+    def __init__(self, max_traces: int = 2048,
+                 max_spans_per_trace: int = 512):
+        self._lock = threading.Lock()
+        self.max_traces = int(max_traces)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        # trace_id -> {"spans": [span dicts], "t": last-ingest time}
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        # (process, name, attrs-key) -> (attrs, value, unix_ts)
+        self._gauges: Dict[Tuple[str, str, tuple], tuple] = {}
+        # (process, name) -> latest histogram dataPoint dict
+        self._histograms: Dict[Tuple[str, str], dict] = {}
+        self.ingest_errors_total = 0
+        self.spans_ingested_total = 0
+        self.metrics_ingested_total = 0
+
+    def count_ingest_error(self, n: int = 1) -> None:
+        """Lock-guarded increment — the HTTP handler runs one thread
+        per request, and an unlocked += would lose counts exactly
+        when malformed pushers arrive concurrently."""
+        with self._lock:
+            self.ingest_errors_total += int(n)
+
+    # -------------------------------------------------------- ingest
+    def ingest_traces(self, payload: dict) -> int:
+        n = 0
+        for rs in payload.get("resourceSpans") or []:
+            resource = _attr_dict(
+                (rs.get("resource") or {}).get("attributes"))
+            process = str(resource.get("service.name", "?"))
+            for scope in rs.get("scopeSpans") or []:
+                for span in scope.get("spans") or []:
+                    if self._ingest_span(span, process):
+                        n += 1
+        with self._lock:
+            self.spans_ingested_total += n
+        return n
+
+    def _ingest_span(self, span: dict, process: str) -> bool:
+        try:
+            trace_id = str(span["traceId"])
+            record = {
+                "trace_id": trace_id,
+                "span_id": str(span["spanId"]),
+                "parent_id": span.get("parentSpanId"),
+                "name": str(span.get("name", "?")),
+                "start_unix": int(span["startTimeUnixNano"]) / 1e9,
+                "end_unix": int(span["endTimeUnixNano"]) / 1e9,
+                "status": str(
+                    (span.get("status") or {}).get("message", "ok")),
+                "attrs": _attr_dict(span.get("attributes")),
+                "process": process,
+            }
+        except (KeyError, TypeError, ValueError):
+            with self._lock:
+                self.ingest_errors_total += 1
+            return False
+        links = []
+        for ln in span.get("links") or []:
+            try:
+                links.append({
+                    "trace_id": str(ln["traceId"]),
+                    "span_id": str(ln["spanId"]),
+                    "attrs": _attr_dict(ln.get("attributes")),
+                })
+            except (KeyError, TypeError):
+                continue
+        if links:
+            record["links"] = links
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                entry = {"spans": [], "t": time.time()}
+                self._traces[trace_id] = entry
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            entry["t"] = time.time()
+            self._traces.move_to_end(trace_id)
+            # re-pushed spans (a trace shipped again after more spans
+            # grafted) replace their earlier copy instead of doubling
+            entry["spans"] = [
+                s for s in entry["spans"]
+                if s["span_id"] != record["span_id"]
+            ]
+            stored = len(entry["spans"]) < self.max_spans_per_trace
+            if stored:
+                entry["spans"].append(record)
+        # a span discarded at the per-trace cap must NOT count as
+        # ingested: spans_ingested_total is the zero-lost proof the
+        # soak audits, and claiming arrival while /fleet/traces is
+        # missing spans would mask exactly the loss it exists to show
+        return stored
+
+    def ingest_metrics(self, payload: dict) -> int:
+        n = 0
+        for rm in payload.get("resourceMetrics") or []:
+            resource = _attr_dict(
+                (rm.get("resource") or {}).get("attributes"))
+            process = str(resource.get("service.name", "?"))
+            for scope in rm.get("scopeMetrics") or []:
+                for metric in scope.get("metrics") or []:
+                    n += self._ingest_metric(metric, process)
+        with self._lock:
+            self.metrics_ingested_total += n
+        return n
+
+    def _ingest_metric(self, metric: dict, process: str) -> int:
+        name = str(metric.get("name", ""))
+        if not name:
+            return 0
+        n = 0
+        gauge = metric.get("gauge") or metric.get("sum") or {}
+        for point in gauge.get("dataPoints") or []:
+            attrs = _attr_dict(point.get("attributes"))
+            try:
+                value = float(point.get("asDouble",
+                                        point.get("asInt", 0.0)))
+            except (TypeError, ValueError):
+                with self._lock:
+                    self.ingest_errors_total += 1
+                continue
+            key = (process, name,
+                   tuple(sorted((k, str(v))
+                                for k, v in attrs.items())))
+            with self._lock:
+                self._gauges[key] = (attrs, value, time.time())
+            n += 1
+        hist = metric.get("histogram") or {}
+        for point in hist.get("dataPoints") or []:
+            with self._lock:
+                self._histograms[(process, name)] = point
+            n += 1
+        return n
+
+    # --------------------------------------------------------- views
+    @staticmethod
+    def _root_name(spans: List[dict]) -> str:
+        for s in spans:
+            if s.get("parent_id") in (None, ""):
+                return s["name"]
+        return spans[0]["name"] if spans else "?"
+
+    def traces(self, trace_id: Optional[str] = None,
+               name: Optional[str] = None,
+               limit: int = 50) -> List[dict]:
+        """Stitched span trees, newest last.  ``name`` filters on the
+        ROOT span's name (request / autoscale / fleet_migration …).
+        Trees are built only for the traces actually returned — at
+        the 2048-trace cap a ?limit=20 query must cost 20 tree
+        builds, not 2048 (this endpoint exists for mid-incident use)."""
+        with self._lock:
+            if trace_id is not None:
+                picked = ([(trace_id, self._traces[trace_id])]
+                          if trace_id in self._traces else [])
+            else:
+                picked = list(self._traces.items())
+        # clamped like the router's /traces ?limit=: an operator knob
+        # for narrowing, never a lever for unbounded serialization
+        limit = max(1, min(int(limit), 500))
+        trees = []
+        for tid, entry in reversed(picked):  # newest first
+            spans = list(entry["spans"])
+            if name is not None and self._root_name(spans) != name:
+                continue
+            trees.append(self._tree(tid, spans))
+            if len(trees) >= limit:
+                break
+        trees.reverse()  # newest last, the stable view order
+        return trees
+
+    @staticmethod
+    def _tree(trace_id: str, spans: List[dict]) -> dict:
+        by_id: Dict[str, dict] = {}
+        for s in spans:
+            d = dict(s)
+            d["children"] = []
+            by_id[s["span_id"]] = d
+        roots: List[dict] = []
+        root_span: Optional[dict] = None
+        for s in spans:
+            d = by_id[s["span_id"]]
+            parent = by_id.get(s.get("parent_id") or "")
+            if parent is not None and parent is not d:
+                parent["children"].append(d)
+            else:
+                roots.append(d)
+                if s.get("parent_id") in (None, ""):
+                    root_span = d
+        head = root_span or (roots[0] if roots else None)
+        start = min((s["start_unix"] for s in spans), default=0.0)
+        end = max((s["end_unix"] for s in spans), default=start)
+        return {
+            "trace_id": trace_id,
+            "name": head["name"] if head else "?",
+            "status": head["status"] if head else "?",
+            "processes": sorted({s["process"] for s in spans}),
+            "start_unix": start,
+            "duration_s": round(end - start, 6),
+            "spans": roots,
+        }
+
+    def find_span(self, trace_id: str,
+                  span_id: str) -> Optional[dict]:
+        """Resolve a span link target — the collector-side proof that
+        a link points at telemetry that actually arrived."""
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                return None
+            for s in entry["spans"]:
+                if s["span_id"] == span_id:
+                    return dict(s)
+        return None
+
+    def metrics_view(self) -> Dict[str, Dict[str, float]]:
+        """{process: {rendered-name: value}} — labeled gauges render
+        their attrs promql-style so bands stay distinguishable."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            items = list(self._gauges.items())
+        for (process, name, _), (attrs, value, _t) in items:
+            shown = name
+            if attrs:
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(attrs.items()))
+                shown = f"{name}{{{inner}}}"
+            out.setdefault(process, {})[shown] = value
+        return out
+
+    def slo_view(self) -> Dict[str, Dict[str, dict]]:
+        """{process: {band: {objective fields}}} from the pushed
+        ``serving_slo_*`` families — the fleet's SLO pane."""
+        out: Dict[str, Dict[str, dict]] = {}
+        with self._lock:
+            items = list(self._gauges.items())
+        for (process, name, _), (attrs, value, _t) in items:
+            if not name.startswith("serving_slo_"):
+                continue
+            band = str(attrs.get("band", "?"))
+            field = name[len("serving_slo_"):]
+            window = attrs.get("window")
+            if window:
+                field = f"{field}_{window}"
+            out.setdefault(process, {}).setdefault(band, {})[field] = \
+                value
+        return out
+
+
+class TelemetryCollector:
+    """HTTP surface over a :class:`TelemetryStore` (port 0 + stdout
+    announce).  ``stall_seconds`` is the chaos knob: every request
+    handler sleeps that long first, modelling a wedged collector so
+    the exporter's never-block discipline can be proven against it."""
+
+    def __init__(self, port: int = 0, store: Optional[TelemetryStore]
+                 = None, announce: bool = True):
+        self.store = store or TelemetryStore()
+        self.stall_seconds = 0.0
+        collector = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _respond(self, code: int, body: bytes,
+                         ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                if collector.stall_seconds > 0:
+                    time.sleep(collector.stall_seconds)
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length)
+                try:
+                    payload = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    collector.store.count_ingest_error()
+                    self._respond(400, b'{"error":"bad json"}')
+                    return
+                if self.path.startswith("/v1/traces"):
+                    collector.store.ingest_traces(payload)
+                elif self.path.startswith("/v1/metrics"):
+                    collector.store.ingest_metrics(payload)
+                else:
+                    self._respond(404, b"{}")
+                    return
+                self._respond(200, b"{}")
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                if collector.stall_seconds > 0:
+                    time.sleep(collector.stall_seconds)
+                split = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(split.query)
+
+                def q(key):
+                    return (query.get(key) or [None])[0]
+
+                if split.path.startswith("/healthz"):
+                    self._respond(200, b"ok", "text/plain")
+                    return
+                if split.path.startswith("/fleet/traces"):
+                    try:
+                        limit = int(q("limit") or 50)
+                    except ValueError:
+                        limit = 50
+                    body = json.dumps({"traces": collector.store.traces(
+                        trace_id=q("trace_id"), name=q("name"),
+                        limit=limit)}, default=str)
+                elif split.path.startswith("/fleet/metrics"):
+                    body = json.dumps(
+                        {"processes": collector.store.metrics_view()},
+                        default=str)
+                elif split.path.startswith("/fleet/slo"):
+                    body = json.dumps(
+                        {"slo": collector.store.slo_view()},
+                        default=str)
+                else:
+                    self._respond(404, b"{}")
+                    return
+                self._respond(200, body.encode())
+
+            def log_message(self, *args):  # silence per-request noise
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        if announce:
+            # stdout announce, flushed: whoever spawned us reads the
+            # port the same way it reads the master/agent announces
+            print(f"{NodeEnv.TELEMETRY_ANNOUNCE_PREFIX}{self.port}",
+                  flush=True)
+
+    @property
+    def endpoint(self) -> str:
+        """The base URL exporters point at (OtlpExporter(endpoint=…))."""
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="telemetry-collector")
+        self._thread.start()
+        logger.info("telemetry collector on 127.0.0.1:%d", self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

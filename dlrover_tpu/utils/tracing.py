@@ -138,10 +138,28 @@ class Span:
     end: Optional[float] = None
     status: str = "ok"
     attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # cross-trace references (W3C-shaped: the OTLP span-link concept):
+    # each entry is {"trace_id", "span_id", "attrs"} pointing at a span
+    # in ANOTHER trace — how a failed-over request's attempt names the
+    # autoscale/replacement trace that created the replica it landed
+    # on, and how a fleet_migration trace names its demand evidence
+    links: List[Dict[str, object]] = dataclasses.field(
+        default_factory=list)
 
     @property
     def duration(self) -> Optional[float]:
         return None if self.end is None else self.end - self.start
+
+    def add_link(self, trace_id: str, span_id: str,
+                 **attrs) -> "Span":
+        """Reference a span in another trace (parenthood crosses a
+        causality boundary the tree cannot express: the linked trace
+        happened on the control plane, this span on the data plane)."""
+        self.links.append({
+            "trace_id": trace_id, "span_id": span_id,
+            "attrs": dict(attrs),
+        })
+        return self
 
     def finish(self, now: Optional[float] = None,
                status: Optional[str] = None) -> "Span":
@@ -152,7 +170,7 @@ class Span:
         return self
 
     def to_dict(self, t0: float = 0.0) -> Dict[str, object]:
-        return {
+        out = {
             "name": self.name,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
@@ -164,6 +182,9 @@ class Span:
             "status": self.status,
             "attrs": dict(self.attrs),
         }
+        if self.links:
+            out["links"] = [dict(ln) for ln in self.links]
+        return out
 
 
 class Trace:
@@ -319,6 +340,17 @@ class Tracer:
         self.orphan_spans_total = 0
         self.sampled_total = 0   # finished traces retained
         self.dropped_total = 0   # finished healthy traces sampled out
+        # OTLP push pipeline (utils/otlp.OtlpExporter), attached via
+        # attach_otlp: every RETAINED finished trace is offered to it
+        # (bounded non-blocking enqueue) right after the ring append
+        self._otlp = None
+
+    def attach_otlp(self, exporter) -> None:
+        """Ship every retained finished trace through ``exporter``
+        (``ship_trace(trace)`` — the bounded drop-never-block offer).
+        Sampled-out traces are not shipped: the sampling knob stays a
+        real cost knob across the push pipeline too."""
+        self._otlp = exporter
 
     # ----------------------------------------------------------- spans
     def start_trace(self, name: str, now: Optional[float] = None,
@@ -361,6 +393,7 @@ class Tracer:
     def finish_trace(self, root: Span, now: Optional[float] = None,
                      status: str = "ok") -> None:
         root.finish(now, status=status)
+        ship = None
         with self._lock:
             trace = self._active.pop(root.trace_id, None)
             if trace is None:
@@ -373,8 +406,13 @@ class Tracer:
                 self._ring.append(trace)
                 self.finished_total += 1
                 self.sampled_total += 1
+                ship = trace
             else:
                 self.dropped_total += 1
+        # the OTLP offer happens OUTSIDE this tracer's lock (it takes
+        # the exporter's own short queue lock; no nesting, no I/O)
+        if ship is not None and self._otlp is not None:
+            self._otlp.ship_trace(ship)
 
     def mark_incident(self, trace_id: str, reason: str = "") -> None:
         """Incident override: this trace must be retained (and its
@@ -453,19 +491,39 @@ class Tracer:
             trace = self._find_locked(trace_id)
             return None if trace is None else trace.tree()
 
-    def finished(self, limit: int = 50) -> List[Dict[str, object]]:
-        """Most recent finished traces, newest last."""
+    @staticmethod
+    def _matches(trace: "Trace", name: Optional[str],
+                 status: Optional[str]) -> bool:
+        if name is not None and trace.root.name != name:
+            return False
+        if status is not None and trace.status != status:
+            return False
+        return True
+
+    def finished(self, limit: int = 50, name: Optional[str] = None,
+                 status: Optional[str] = None
+                 ) -> List[Dict[str, object]]:
+        """Most recent finished traces, newest last.  ``name`` filters
+        on the root span, ``status`` on the terminal status — mid-
+        incident the question is "the failover traces, now", and
+        dumping a 4096-entry ring is not an answer."""
         with self._lock:
-            traces = list(self._ring)[-int(limit):]
+            traces = [t for t in self._ring
+                      if self._matches(t, name, status)][-int(limit):]
         return [t.tree() for t in traces]
 
-    def slowest(self, limit: int = 10) -> List[Dict[str, object]]:
+    def slowest(self, limit: int = 10, name: Optional[str] = None,
+                status: Optional[str] = None
+                ) -> List[Dict[str, object]]:
         """Finished traces ranked by duration, slowest first — the
         ``/traces/slowest`` debugging view: which requests blew their
-        budget, and inside which span."""
+        budget, and inside which span.  Same filters as
+        :meth:`finished`."""
         with self._lock:
             traces = sorted(
-                self._ring, key=lambda t: -t.duration)[:int(limit)]
+                (t for t in self._ring
+                 if self._matches(t, name, status)),
+                key=lambda t: -t.duration)[:int(limit)]
         return [t.tree() for t in traces]
 
     def traces_named(self, name: str,
@@ -503,6 +561,12 @@ class Tracer:
                 traces = list(self._ring) + list(self._active.values())
             events: List[Dict[str, object]] = []
             pids: Dict[str, int] = {"router": 1}
+            # span_id -> (ts_us, pid, tid) of every exported span, and
+            # the spans carrying links: resolved into flow events after
+            # the main pass so a link renders as an arrow between the
+            # linking span and its (cross-trace) target in perfetto
+            located: Dict[str, Tuple[float, int, int]] = {}
+            linkers: List[Tuple[Span, float, int, int]] = []
             for tid_n, trace in enumerate(traces):
                 parent_of = {s.span_id: s.parent_id for s in trace.spans}
                 replica_of = {
@@ -524,15 +588,44 @@ class Tracer:
                             sid = parent_of.get(sid)
                     pid = pids.setdefault(proc, len(pids) + 1)
                     end = s.end if s.end is not None else fallback_end
+                    ts = round(s.start * 1e6, 3)
                     events.append({
                         "name": s.name, "ph": "X",
-                        "ts": round(s.start * 1e6, 3),
+                        "ts": ts,
                         "dur": round(max(0.0, end - s.start) * 1e6, 3),
                         "pid": pid, "tid": tid_n,
                         "args": dict(
                             s.attrs, trace_id=trace.trace_id,
                             status=s.status),
                     })
+                    located[s.span_id] = (ts, pid, tid_n)
+                    if s.links:
+                        linkers.append((s, ts, pid, tid_n))
+        # span links as flow events: an "s" (start) at the LINKED span
+        # — the autoscale/replacement decision — flowing into an "f"
+        # (finish) at the linking span, so perfetto draws the arrow
+        # from cause to consequence.  Links whose target is not in
+        # this export (evicted, other process) are skipped: a flow
+        # event without both ends renders as clutter, not signal.
+        for s, ts, pid, tid_n in linkers:
+            for ln in s.links:
+                src = located.get(str(ln.get("span_id", "")))
+                if src is None:
+                    continue
+                flow_id = str(ln["span_id"]) + s.span_id
+                src_ts, src_pid, src_tid = src
+                events.append({
+                    "name": "span_link", "cat": "link", "ph": "s",
+                    "id": flow_id, "ts": src_ts,
+                    "pid": src_pid, "tid": src_tid,
+                    "args": dict(ln.get("attrs") or {}),
+                })
+                events.append({
+                    "name": "span_link", "cat": "link", "ph": "f",
+                    "bp": "e", "id": flow_id, "ts": ts,
+                    "pid": pid, "tid": tid_n,
+                    "args": dict(ln.get("attrs") or {}),
+                })
         for proc, pid in sorted(pids.items(), key=lambda kv: kv[1]):
             events.append({
                 "name": "process_name", "ph": "M", "ts": 0.0,

@@ -1047,6 +1047,101 @@ def test_dl009_missing_spec_next_to_enum_is_flagged(tmp_path):
     assert "SERVING_REQUEST_TRANSITIONS" in result.new[0].message
 
 
+# --------------------------------------------------------------- DL010
+_LABEL_REGISTRY = """
+    METRIC_HELP = {
+        "serving_worker_state": "per-worker supervisor state",
+        "serving_queue_depth": "requests waiting in the gateway",
+    }
+    NON_METRIC_SERVING_NAMES = frozenset()
+    METRIC_LABELS = {
+        "serving_worker_state": ("worker", "state"),
+    }
+"""
+
+
+def test_dl010_flags_undeclared_family_and_key(tmp_path):
+    result = _scan(tmp_path, {
+        "registry.py": _LABEL_REGISTRY,
+        "mod.py": '''
+            def render(name, state, shard):
+                good = (
+                    "serving_worker_state{"
+                    f'worker="{name}",state="{state}"'
+                    "} 1")
+                wrong_key = f'serving_worker_state{{shard="{shard}"}} 1'
+                no_decl = f'serving_queue_depth{{shard="{shard}"}} 3'
+                return good, wrong_key, no_decl
+        ''',
+    }, config=_dl006_config())
+    assert _codes(result) == ["DL010", "DL010"]
+    assert "'shard'" in result.new[0].message
+    assert "serving_queue_depth" in result.new[1].message
+    assert "METRIC_LABELS" in result.new[1].message
+
+
+def test_dl010_flags_unbounded_label_value_sources(tmp_path):
+    result = _scan(tmp_path, {
+        "registry.py": _LABEL_REGISTRY,
+        "mod.py": '''
+            def render(req, host, port, esc):
+                per_request = (
+                    f'serving_worker_state{{worker="{req.rid}",'
+                    f'state="x"}} 1')
+                per_endpoint = (
+                    f'serving_worker_state{{worker="{host}:{port}",'
+                    f'state="x"}} 1')
+                traced = (
+                    f'serving_worker_state{{worker="{esc(req.trace_id)}",'
+                    f'state="x"}} 1')
+                return per_request, per_endpoint, traced
+        ''',
+    }, config=_dl006_config())
+    assert _codes(result) == ["DL010", "DL010", "DL010"]
+    assert "'rid'" in result.new[0].message
+    assert "'port'" in result.new[1].message
+    assert "'trace_id'" in result.new[2].message
+
+
+def test_dl010_quiet_on_declared_keys_and_bounded_values(tmp_path):
+    result = _scan(tmp_path, {
+        "registry.py": _LABEL_REGISTRY,
+        "mod.py": '''
+            def render(workers):
+                lines = []
+                for name, state in workers:
+                    lines.append(
+                        "serving_worker_state{"
+                        f'worker="{name}",state="{state}"'
+                        "} 1")
+                return lines
+        ''',
+    }, config=_dl006_config())
+    assert _codes(result) == []
+
+
+def test_dl010_registry_self_check(tmp_path):
+    # a labeled family must be a registered metric, and its declared
+    # keys must themselves be bounded vocabulary
+    result = _scan(tmp_path, {
+        "registry.py": """
+            METRIC_HELP = {
+                "serving_worker_state": "per-worker state",
+            }
+            NON_METRIC_SERVING_NAMES = frozenset()
+            METRIC_LABELS = {
+                "serving_ghost_state": ("op",),
+                "serving_worker_state": ("trace_id",),
+            }
+        """,
+    }, config=_dl006_config())
+    codes = _codes(result)
+    assert codes.count("DL010") == 2, result.new
+    messages = " | ".join(v.message for v in result.new)
+    assert "serving_ghost_state" in messages
+    assert "'trace_id'" in messages
+
+
 # ------------------------------------------------------- summary cache
 def test_summary_cache_reused_and_invalidated_on_edit(tmp_path):
     """The whole-program summary cache is keyed by file hash: a warm

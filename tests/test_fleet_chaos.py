@@ -330,6 +330,12 @@ def test_borrow_and_return_full_cycle_zero_lost(fleet):
     handle = next(
         h for n, h in f.router.manager.replicas.items()
         if base_replica_name(n) == "host-2")
+    # ISSUE 12: while on loan, the borrowed replica's origin is the
+    # borrow trace, so request attempts landing on host-2 link back
+    # to the decision that created it (pruned again at return-drain
+    # retirement — a returned host carries no serving origin)
+    origin = f.router.replica_origins.get("host-2")
+    assert origin is not None and origin["kind"] == "fleet_borrow"
     # drain the spike so pressure falls; the return decision follows
     assert f.run(900, until=lambda: f.coord.returns_total == 1), \
         f"return never completed: {f.coord.migrations} {f.owners()}"
@@ -372,6 +378,24 @@ def test_borrow_and_return_full_cycle_zero_lost(fleet):
         {"borrow", "return"}
     assert {tr["status"] for tr in trees if tr["status"]} <= \
         {"ok", "aborted"}
+
+    # ISSUE 12 span links: the borrow trace references the pressure
+    # evidence that pulled the trigger (no autoscaler here, so a
+    # minted serving_pressure snapshot of the brown-out stage)
+    borrow_tree = next(
+        tr for tr in trees
+        if tr["spans"][0]["attrs"]["direction"] == "borrow")
+    links = borrow_tree["spans"][0].get("links") or []
+    assert links, "the borrow root must link to its demand evidence"
+    assert links[0]["attrs"]["rel"] == "evidence"
+    evidence = f.router.tracer.get_tree(links[0]["trace_id"])
+    assert evidence is not None \
+        and evidence["name"] == "serving_pressure"
+    assert evidence["spans"][0]["attrs"]["stage"] >= 1
+    # the origin registered mid-loan (asserted above) was pruned when
+    # the returned host's replica retired — no stale decision link
+    # survives for a name that left the serving fleet
+    assert "host-2" not in f.router.replica_origins
 
 
 # ------------------------------------------------------------------- F2

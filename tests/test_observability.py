@@ -573,3 +573,130 @@ def test_goodput_caps_windows_hiding_a_restart():
     # steps 2..5 credited fully (4s); step 6 at the 1s median, not 14s
     assert g["productive_s"] == pytest.approx(5.0)
     assert g["downtime_s"] == pytest.approx(19.0 - 5.0)
+
+
+# -- ISSUE 12: /traces query filtering + the master metrics endpoint ---------
+
+
+def _traced_router():
+    import numpy as np
+
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RouterMetrics,
+        ServingRouter,
+    )
+
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        metrics=RouterMetrics(window_seconds=0.5),
+    )
+    router.join_replica(
+        "r0", FakeEngine(slots=8, tokens_per_step=8, blocks=100000))
+    t = time.monotonic()
+    for i in range(6):
+        router.submit(np.full(8, i % 251, "int32"), 8, now=t)
+    # one request that can only expire: deadline already passed
+    router.submit(np.full(8, 3, "int32"), 8, timeout=-1.0, now=t)
+    router.run_until_idle()
+    return router
+
+
+def test_traces_endpoint_query_filters():
+    """/traces and /traces/slowest take ?name= / ?status= / ?limit= —
+    mid-incident "the failover traces, newest 20" must be one query,
+    not a 4096-entry dump."""
+    router = _traced_router()
+    exporter = MetricsExporter()
+    exporter.attach_router(router)
+    exporter.start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                base + path, timeout=5).read())
+
+        everything = get("/traces")["traces"]
+        assert len(everything) == 7
+        limited = get("/traces?limit=3")["traces"]
+        assert len(limited) == 3
+        ok_only = get("/traces?status=ok")["traces"]
+        assert len(ok_only) == 6
+        assert all(t["status"] == "ok" for t in ok_only)
+        timed_out = get("/traces?status=TimedOut")["traces"]
+        assert len(timed_out) == 1
+        named = get("/traces?name=request&limit=500")["traces"]
+        assert len(named) == 7
+        assert get("/traces?name=autoscale")["traces"] == []
+        slowest = get("/traces/slowest?limit=2&status=ok")["traces"]
+        assert len(slowest) == 2
+        assert all(t["status"] == "ok" for t in slowest)
+        assert slowest[0]["duration_s"] >= slowest[1]["duration_s"]
+        # a bad limit degrades to the default instead of erroring
+        assert len(get("/traces?limit=bogus")["traces"]) == 7
+    finally:
+        exporter.stop()
+
+
+def test_tracer_filters_direct():
+    from dlrover_tpu.utils.tracing import Tracer
+
+    tracer = Tracer()
+    for i, (name, status) in enumerate(
+            [("request", "ok"), ("request", "failover"),
+             ("autoscale", "ok")]):
+        root = tracer.start_trace(name, rid=i)
+        tracer.finish_trace(root, status=status)
+    assert len(tracer.finished(name="request")) == 2
+    assert len(tracer.finished(status="failover")) == 1
+    assert len(tracer.slowest(name="autoscale")) == 1
+    assert tracer.finished(name="request", status="ok")[0][
+        "status"] == "ok"
+
+
+def test_master_metrics_endpoint_serves_goodput_ledger(capsys):
+    """The ISSUE-12 satellite: the master serves /metrics (port-0 +
+    stdout announce) exposing the goodput ledger + rendezvous
+    counters with registry help text — scrapeable, not
+    JSON-artifact-only."""
+    from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+    from dlrover_tpu.scheduler.in_memory import (
+        InMemoryCluster,
+        InMemoryNodeWatcher,
+        InMemoryScaler,
+    )
+
+    cluster = InMemoryCluster()
+    master = DistributedJobMaster(
+        0, scaler=InMemoryScaler(cluster),
+        watcher=InMemoryNodeWatcher(cluster), node_num=1)
+    col = master.job_metric_collector
+    col.mark_job_start(timestamp=time.time() - 10.0)
+    col.report_global_step(1, time.time() - 8.0)
+    col.report_global_step(5, time.time() - 1.0)
+    rdzv = master.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+    rdzv.update_rdzv_params(min_nodes=1, max_nodes=1,
+                            waiting_timeout=5, node_unit=1)
+    rdzv.join_rendezvous(0, 0, 1)
+    rdzv.get_comm_world(0)
+    port = master.start_metrics_exporter(0)
+    try:
+        announced = capsys.readouterr().out
+        assert f"{NodeEnv.MASTER_METRICS_ANNOUNCE_PREFIX}{port}" \
+            in announced
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "dlrover_master_goodput " in body
+        assert "# HELP dlrover_master_goodput" in body
+        assert "dlrover_master_rendezvous_rounds_total 1.0" in body
+        assert "dlrover_master_world_size 1.0" in body
+        assert "dlrover_master_restarts_observed_total 0.0" in body
+        m = master.master_metrics()
+        assert 0.0 < m["dlrover_master_goodput"] <= 1.0
+        assert m["dlrover_master_downtime_seconds_total"] >= 0.0
+    finally:
+        master.stop_metrics_exporter()

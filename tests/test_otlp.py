@@ -1,0 +1,499 @@
+"""The fleet observatory (ISSUE 12): OTLP push pipeline, telemetry
+collector, and the open-loop gateway rig.
+
+The acceptance discipline under test: the exporter NEVER blocks or
+unboundedly buffers the hot path — a collector that is down, stalling
+or flapping costs bounded memory and counted drops
+(``dlrover_otlp_dropped_total``), never router-step latency.  The
+collector aggregates pushes from multiple processes into one
+queryable store stitched by trace_id, and span links ride through.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.retry import RetryPolicy
+from dlrover_tpu.serving.remote.worker import FakeEngine
+from dlrover_tpu.serving.router import (
+    PRIORITY_BATCH,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    ContinuousBatchScheduler,
+    RequestGateway,
+    RouterMetrics,
+    ServingRouter,
+    SloEngine,
+)
+from dlrover_tpu.serving.router.loadgen import (
+    LoadgenConfig,
+    OpenLoopGenerator,
+    run_gateway_rig,
+)
+from dlrover_tpu.utils.otlp import (
+    OtlpExporter,
+    otlp_attributes,
+    trace_to_resource_spans,
+)
+from dlrover_tpu.utils.telemetry_collector import (
+    TelemetryCollector,
+    TelemetryStore,
+)
+from dlrover_tpu.utils.tracing import Tracer
+
+
+def _fast_retry():
+    """A retry policy sized for tests: give up in well under a second
+    so outage scenarios run fast."""
+    return RetryPolicy(max_attempts=2, backoff_base=0.01,
+                       backoff_max=0.02, deadline=0.3, jitter=0.0,
+                       seed=1)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _router(slo=None, max_pending=2048, sample=1.0, replicas=2):
+    router = ServingRouter(
+        gateway=RequestGateway(max_pending=max_pending,
+                               default_timeout=3.0,
+                               trace_sample_rate=sample),
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        metrics=RouterMetrics(window_seconds=1.0),
+        slo=slo,
+    )
+    for i in range(replicas):
+        router.join_replica(
+            f"r{i}", FakeEngine(slots=16, tokens_per_step=8,
+                                blocks=100000))
+    return router
+
+
+# -- payload schema ----------------------------------------------------------
+
+
+def test_trace_payload_is_otlp_schema_shaped_with_links():
+    tracer = Tracer()
+    root = tracer.start_trace("request", rid=7, priority=1)
+    child = tracer.start_span(root, "attempt", replica="r0")
+    child.add_link("ab" * 16, "cd" * 8, rel="replica_origin",
+                   kind="autoscale")
+    child.finish()
+    tracer.finish_trace(root)
+    trace = tracer._ring[-1]
+    rs = trace_to_resource_spans(trace, {"service.name": "router"})
+    assert rs["resource"]["attributes"] == otlp_attributes(
+        {"service.name": "router"})
+    spans = rs["scopeSpans"][0]["spans"]
+    assert len(spans) == 2
+    by_name = {s["name"]: s for s in spans}
+    assert len(by_name["request"]["traceId"]) == 32
+    assert len(by_name["request"]["spanId"]) == 16
+    assert "parentSpanId" not in by_name["request"]
+    assert by_name["attempt"]["parentSpanId"] == \
+        by_name["request"]["spanId"]
+    # times are unix-nano strings, end >= start
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    link = by_name["attempt"]["links"][0]
+    assert link["traceId"] == "ab" * 16
+    assert link["spanId"] == "cd" * 8
+    attrs = {a["key"]: a["value"]["stringValue"]
+             for a in link["attributes"]}
+    assert attrs == {"rel": "replica_origin", "kind": "autoscale"}
+    # typed attribute mapping
+    typed = otlp_attributes({"i": 3, "f": 1.5, "b": True, "s": "x"})
+    kinds = {a["key"]: list(a["value"]) for a in typed}
+    assert kinds == {"i": ["intValue"], "f": ["doubleValue"],
+                     "b": ["boolValue"], "s": ["stringValue"]}
+
+
+# -- ship + aggregate --------------------------------------------------------
+
+
+def test_exporter_ships_and_collector_stitches_across_processes():
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    try:
+        # two "processes" pushing spans of the SAME trace: the
+        # router's request spans and a fleet-side span — the
+        # cross-plane stitch the collector exists for
+        router_tracer = Tracer()
+        fleet_tracer = Tracer()
+        exp_router = OtlpExporter(
+            collector.endpoint, resource={"service.name": "router"},
+            retry=_fast_retry())
+        exp_fleet = OtlpExporter(
+            collector.endpoint, resource={"service.name": "fleet"},
+            retry=_fast_retry())
+        slo = SloEngine(fast_window_s=5.0, slow_window_s=20.0)
+        slo.observe_violation(PRIORITY_NORMAL, now=time.monotonic())
+        exp_router.add_metrics_source(
+            lambda: {"serving_queue_depth": 3.0})
+        exp_router.add_labeled_source(
+            lambda: slo.otlp_metrics(time.monotonic()))
+        router_tracer.attach_otlp(exp_router)
+        fleet_tracer.attach_otlp(exp_fleet)
+        exp_router.start()
+        exp_fleet.start()
+
+        root = router_tracer.start_trace("request", rid=1)
+        attempt = router_tracer.start_span(root, "attempt",
+                                           replica="h0")
+        ev = fleet_tracer.start_trace("fleet_migration", host="h0")
+        fleet_span = fleet_tracer.start_span(ev, "serving_join")
+        fleet_span.finish()
+        # cross-plane link: the attempt references the fleet trace
+        attempt.add_link(ev.trace_id, ev.span_id, rel="replica_origin",
+                         kind="fleet_borrow")
+        attempt.finish()
+        router_tracer.finish_trace(root)
+        fleet_tracer.finish_trace(ev)
+        assert exp_router.flush() and exp_fleet.flush()
+        time.sleep(1.2)  # one metrics_interval tick
+        exp_router.flush()
+
+        # /fleet/traces: both traces present; name filter works
+        data = _get(collector.endpoint + "/fleet/traces?limit=10")
+        names = {t["name"] for t in data["traces"]}
+        assert {"request", "fleet_migration"} <= names
+        only_req = _get(collector.endpoint
+                        + "/fleet/traces?name=request")
+        assert {t["name"] for t in only_req["traces"]} == {"request"}
+        by_id = _get(collector.endpoint
+                     + f"/fleet/traces?trace_id={root.trace_id}")
+        assert len(by_id["traces"]) == 1
+        tree = by_id["traces"][0]
+        assert tree["processes"] == ["router"]
+        # the link rode through, and its target ARRIVED (pushed by
+        # the OTHER process) — resolvable in the collector
+        attempt_span = tree["spans"][0]["children"][0]
+        link = attempt_span["links"][0]
+        assert link["trace_id"] == ev.trace_id
+        target = collector.store.find_span(link["trace_id"],
+                                           link["span_id"])
+        assert target is not None and target["process"] == "fleet"
+
+        # /fleet/metrics and /fleet/slo views
+        metrics = _get(collector.endpoint + "/fleet/metrics")
+        assert metrics["processes"]["router"][
+            "serving_queue_depth"] == 3.0
+        slo_view = _get(collector.endpoint + "/fleet/slo")
+        normal = slo_view["slo"]["router"]["NORMAL"]
+        assert normal["burn_rate_fast"] > 0
+        assert "budget_remaining" in normal
+        assert exp_router.metrics()["dlrover_otlp_dropped_total"] == 0
+        # shipped counts TRACES only (one per exporter here) — metric
+        # snapshots are periodic re-reads outside the offer identity
+        assert exp_router.metrics()["dlrover_otlp_shipped_total"] == 1
+        assert exp_fleet.metrics()["dlrover_otlp_shipped_total"] == 1
+    finally:
+        exp_router.stop()
+        exp_fleet.stop()
+        collector.stop()
+
+
+def test_store_bounds_traces_and_replaces_repushed_spans():
+    store = TelemetryStore(max_traces=4)
+    for i in range(10):
+        tracer = Tracer()
+        root = tracer.start_trace("request", rid=i)
+        tracer.finish_trace(root)
+        store.ingest_traces({"resourceSpans": [trace_to_resource_spans(
+            tracer._ring[-1], {"service.name": "p"})]})
+    assert len(store.traces(limit=100)) == 4  # oldest evicted
+    # re-pushing the same trace does not duplicate its spans
+    tracer = Tracer()
+    root = tracer.start_trace("request", rid=99)
+    tracer.finish_trace(root)
+    payload = {"resourceSpans": [trace_to_resource_spans(
+        tracer._ring[-1], {"service.name": "p"})]}
+    store.ingest_traces(payload)
+    store.ingest_traces(payload)
+    tree = store.traces(trace_id=root.trace_id)[0]
+    assert len(tree["spans"]) == 1
+
+
+# -- telemetry under fire ----------------------------------------------------
+
+
+def test_collector_down_bounded_queue_counted_drops_never_blocks():
+    # nothing listens on this endpoint (port 9 is discard/closed)
+    exp = OtlpExporter("http://127.0.0.1:9", queue_capacity=64,
+                       retry=_fast_retry(), timeout=0.2)
+    exp.start()
+    try:
+        tracer = Tracer(ring_capacity=1024)
+        tracer.attach_otlp(exp)
+        offered = 300
+        worst = 0.0
+        for i in range(offered):
+            root = tracer.start_trace("request", rid=i)
+            t0 = time.perf_counter()
+            tracer.finish_trace(root)  # ship offer happens inside
+            worst = max(worst, time.perf_counter() - t0)
+        # the hot path never blocked on the dead collector
+        assert worst < 0.01, f"ship path took {worst * 1e3:.1f}ms"
+        # the queue held its bound the whole time
+        assert exp.qsize() <= 64
+        assert exp.flush(timeout=10.0), "writer must drain by dropping"
+        m = exp.metrics()
+        assert m["dlrover_otlp_shipped_total"] == 0
+        assert m["dlrover_otlp_push_errors_total"] >= 1
+        # shipped + dropped == offered: every trace is accounted
+        assert m["dlrover_otlp_dropped_total"] == offered
+    finally:
+        exp.stop()
+
+
+def test_collector_stalling_does_not_stall_the_offer_path():
+    collector = TelemetryCollector(announce=False)
+    collector.stall_seconds = 2.0  # wedged: every request hangs 2s
+    collector.start()
+    exp = OtlpExporter(collector.endpoint, queue_capacity=32,
+                       retry=_fast_retry(), timeout=0.2)
+    exp.start()
+    try:
+        tracer = Tracer()
+        tracer.attach_otlp(exp)
+        worst = 0.0
+        for i in range(100):
+            root = tracer.start_trace("request", rid=i)
+            t0 = time.perf_counter()
+            tracer.finish_trace(root)
+            worst = max(worst, time.perf_counter() - t0)
+        assert worst < 0.01, f"offer path took {worst * 1e3:.1f}ms"
+        assert exp.qsize() <= 32
+        exp.flush(timeout=10.0)
+        m = exp.metrics()
+        assert m["dlrover_otlp_dropped_total"] > 0
+        assert m["dlrover_otlp_push_errors_total"] >= 1
+    finally:
+        collector.stall_seconds = 0.0
+        exp.stop()
+        collector.stop()
+
+
+def test_collector_flapping_drops_during_outage_ships_after():
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    port = collector.port
+    exp = OtlpExporter(collector.endpoint, retry=_fast_retry(),
+                       timeout=0.5)
+    exp.start()
+    tracer = Tracer()
+    tracer.attach_otlp(exp)
+    try:
+        for i in range(5):
+            tracer.finish_trace(tracer.start_trace("request", rid=i))
+        assert exp.flush(timeout=10.0)
+        shipped_before = exp.metrics()["dlrover_otlp_shipped_total"]
+        assert shipped_before == 5
+
+        collector.stop()  # the outage
+        for i in range(5):
+            tracer.finish_trace(tracer.start_trace("request", rid=i))
+        exp.flush(timeout=10.0)
+        m = exp.metrics()
+        assert m["dlrover_otlp_dropped_total"] >= 1
+
+        # recovery on the SAME port (allow_reuse_address)
+        collector2 = TelemetryCollector(port=port, announce=False)
+        collector2.start()
+        try:
+            for i in range(5):
+                tracer.finish_trace(
+                    tracer.start_trace("request", rid=i))
+            assert exp.flush(timeout=10.0)
+            m = exp.metrics()
+            assert m["dlrover_otlp_shipped_total"] >= shipped_before + 5
+            # the accounting identity held across the flap
+            assert m["dlrover_otlp_shipped_total"] \
+                + m["dlrover_otlp_dropped_total"] == 15
+        finally:
+            collector2.stop()
+    finally:
+        exp.stop()
+
+
+def test_gateway_hot_path_unaffected_by_collector_outage():
+    """THE collector-outage acceptance, measured via the bench rig's
+    gateway-overhead measure: with the exporter pointed at a dead
+    endpoint, open-loop admission latency stays flat, the queue stays
+    bounded, and drops are counted — the hot path cannot tell."""
+    slo = SloEngine(fast_window_s=5.0, slow_window_s=20.0)
+    router = _router(slo=slo, sample=1.0)
+    exp = OtlpExporter("http://127.0.0.1:9", queue_capacity=256,
+                       retry=_fast_retry(), timeout=0.2)
+    exp.add_labeled_source(lambda: slo.otlp_metrics(time.monotonic()))
+    router.tracer.attach_otlp(exp)
+    exp.start()
+    try:
+        rig = run_gateway_rig(
+            router, LoadgenConfig(rate_qps=4000, duration_s=0.5,
+                                  seed=3),
+            otlp_exporter=exp)
+        # admission stayed microseconds-class despite the dead
+        # collector eating every push (generous absolute bound: the
+        # assertion is "no multi-ms blocking", not a perf gate)
+        assert rig["gateway_admission_p99_us"] < 5000, rig
+        assert rig["gateway_offered"] > 500
+        assert exp.qsize() <= 256
+        exp.flush(timeout=10.0)
+        m = exp.metrics()
+        assert m["dlrover_otlp_dropped_total"] > 0, \
+            "the outage must be visible as counted drops"
+        assert m["dlrover_otlp_shipped_total"] == 0
+    finally:
+        exp.stop()
+
+
+# -- the open-loop generator -------------------------------------------------
+
+
+def test_loadgen_is_seeded_and_replayable():
+    cfg = LoadgenConfig(seed=42, rate_qps=2000, duration_s=0.5)
+    a = list(OpenLoopGenerator(cfg).arrivals())
+    b = list(OpenLoopGenerator(cfg).arrivals())
+    assert a == b, "same seed must replay the exact schedule"
+    c = list(OpenLoopGenerator(
+        LoadgenConfig(seed=43, rate_qps=2000,
+                      duration_s=0.5)).arrivals())
+    assert a != c
+    # rate sanity: ~1000 arrivals for 2000qps x 0.5s
+    assert 700 < len(a) < 1400
+    # heavy-tail prompts: a real tail beyond the body
+    lens = [x.prompt_len for x in a]
+    assert min(lens) >= 8 and max(lens) > 64
+    # the priority mix covers every configured band
+    assert {x.priority for x in a} == {
+        PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_BATCH}
+    # arrivals are time-ordered and inside the horizon
+    assert all(x.at_s <= y.at_s for x, y in zip(a, a[1:]))
+    assert a[-1].at_s < 0.5
+
+
+def test_loadgen_shapes_modulate_rate():
+    base = LoadgenConfig(seed=1, rate_qps=2000, duration_s=1.0)
+    bursty = LoadgenConfig(seed=1, rate_qps=2000, duration_s=1.0,
+                           arrival="bursty", burst_factor=4.0,
+                           burst_period_s=0.5)
+    diurnal = LoadgenConfig(seed=1, rate_qps=2000, duration_s=1.0,
+                            arrival="diurnal", diurnal_period_s=1.0)
+
+    def first_half_share(cfg):
+        ts = [x.at_s for x in OpenLoopGenerator(cfg).arrivals()]
+        return sum(1 for t in ts if t % 0.5 < 0.25) / max(1, len(ts))
+
+    # bursty: the on-phase (first half of each period) dominates
+    on = [x.at_s for x in OpenLoopGenerator(bursty).arrivals()]
+    on_share = sum(
+        1 for t in on if (t % 0.5) / 0.5 < 0.5) / len(on)
+    assert on_share > 0.75, on_share
+    # diurnal: the rising half-sine (first half-period) outweighs
+    dn = [x.at_s for x in OpenLoopGenerator(diurnal).arrivals()]
+    peak_share = sum(1 for t in dn if t % 1.0 < 0.5) / len(dn)
+    assert peak_share > 0.6, peak_share
+    with pytest.raises(ValueError):
+        OpenLoopGenerator(LoadgenConfig(arrival="sawtooth"))
+
+
+def test_gateway_rig_books_balance():
+    slo = SloEngine(fast_window_s=5.0, slow_window_s=20.0)
+    router = _router(slo=slo, max_pending=256)
+    rig = run_gateway_rig(
+        router, LoadgenConfig(rate_qps=3000, duration_s=0.5, seed=5))
+    assert rig["gateway_offered"] == rig["gateway_admitted"] + sum(
+        rig["gateway_shed"].values())
+    # zero-lost: every admitted request reached a terminal answer
+    assert rig["gateway_admitted"] == rig["gateway_completed"] \
+        + rig["gateway_timed_out"]
+    assert rig["gateway_qps"] > 0
+    assert "gateway_slo" in rig
+    assert set(rig["gateway_slo"]) == {"HIGH", "NORMAL", "BATCH"}
+
+
+# -- the nightly soak --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gateway_soak_60s_at_rate_with_slo_and_zero_lost():
+    """60s open-loop at 10k+ QPS offered, telemetry pipeline live:
+    zero lost requests (admitted == completed + timed_out), bounded
+    exporter queue, SLO verdicts recorded, collector still answering
+    at the end."""
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    slo = SloEngine()
+    router = _router(slo=slo, max_pending=4096, sample=0.01,
+                     replicas=4)
+    exp = OtlpExporter(collector.endpoint,
+                       resource={"service.name": "router"},
+                       retry=_fast_retry())
+    exp.add_labeled_source(lambda: slo.otlp_metrics(time.monotonic()))
+    router.tracer.attach_otlp(exp)
+    exp.start()
+    try:
+        rig = run_gateway_rig(
+            router,
+            LoadgenConfig(rate_qps=12000, duration_s=60.0, seed=17),
+            otlp_exporter=exp)
+        assert rig["gateway_qps"] >= 10000, rig["gateway_qps"]
+        assert rig["gateway_offered"] == rig["gateway_admitted"] \
+            + sum(rig["gateway_shed"].values())
+        assert rig["gateway_admitted"] == rig["gateway_completed"] \
+            + rig["gateway_timed_out"]
+        assert exp.qsize() <= 4096
+        assert set(rig["gateway_slo"]) == {"HIGH", "NORMAL", "BATCH"}
+        # the collector survived the soak and holds fleet telemetry
+        slo_view = _get(collector.endpoint + "/fleet/slo")
+        assert "router" in slo_view["slo"]
+    finally:
+        exp.stop()
+        collector.stop()
+
+
+def test_from_env_inert_without_announce_and_live_with(monkeypatch):
+    from dlrover_tpu.common.constants import NodeEnv
+    from dlrover_tpu.utils.tracing import Tracer
+
+    monkeypatch.delenv(NodeEnv.TELEMETRY_ENDPOINT, raising=False)
+    inert = OtlpExporter.from_env(resource={"service.name": "agent"})
+    assert inert.endpoint is None
+    tracer = Tracer()
+    root = tracer.start_trace("request", rid=1)
+    tracer.finish_trace(root)
+    assert inert.ship_trace(tracer._ring[-1]) is False
+    inert.start()  # no-op, no thread
+    assert inert._thread is None
+
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    try:
+        monkeypatch.setenv(NodeEnv.TELEMETRY_ENDPOINT,
+                           collector.endpoint)
+        live = OtlpExporter.from_env(
+            resource={"service.name": "agent"},
+            retry=_fast_retry(), metrics_interval=0.05)
+        live.add_metrics_source(
+            lambda: {"dlrover_agent_restarts_total": 2.0})
+        live.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            view = {}
+            while time.monotonic() < deadline:
+                view = collector.store.metrics_view()
+                if "agent" in view:
+                    break
+                time.sleep(0.05)
+            assert view.get("agent", {}).get(
+                "dlrover_agent_restarts_total") == 2.0
+        finally:
+            live.stop()
+    finally:
+        collector.stop()

@@ -1522,6 +1522,44 @@ def test_self_healing_acceptance_fast():
         assert handle.ever_placed, \
             f"replacement {e['replacement']} never served"
 
+    # ISSUE 12: the replacements' origins are registered, and every
+    # attempt that landed on a replacement links to the replacement's
+    # always-sampled autoscale trace — "why was this request slow"
+    # resolves to "because it rode the replica THIS decision created"
+    origins = router.replica_origins
+    for e in retired:
+        assert base_replica_name(e["replacement"]) in origins, origins
+
+    def _spans(tree):
+        out = []
+
+        def walk(spans):
+            for s in spans:
+                out.append(s)
+                walk(s["children"])
+
+        walk(tree["spans"])
+        return out
+
+    linked = 0
+    for tree in router.tracer.finished(limit=512, name="request"):
+        for span in _spans(tree):
+            if span["name"] != "attempt":
+                continue
+            base = base_replica_name(
+                str(span["attrs"].get("replica", "")))
+            if base not in origins:
+                continue
+            links = span.get("links") or []
+            assert links, (tree["trace_id"], span)
+            assert links[0]["trace_id"] == \
+                origins[base]["trace_id"]
+            target = router.tracer.get_tree(links[0]["trace_id"])
+            assert target is not None \
+                and target["name"] == "autoscale"
+            linked += 1
+    assert linked > 0, "replacements served but no attempt linked"
+
     # shed ORDER: BATCH refused first, NORMAL only at stage 3, HIGH
     # admitted at every stage and NEVER lost or poisoned
     assert shed_probe["batch"] is True
@@ -1559,6 +1597,142 @@ def test_self_healing_acceptance_fast():
     assert m["serving_worker_quarantined_total"] == 2.0
     assert m["serving_requests_requeued_total"] >= 1, \
         "the replica deaths must have exercised failover"
+
+
+def test_failover_span_links_resolve_to_replacement_trace():
+    """ISSUE 12 acceptance: a replica dies with requests in flight,
+    its capacity debt launches a replacement, and every failed-over
+    request that lands on the replacement carries a span link
+    resolving to the always-sampled autoscale trace that created it —
+    visible in the /traces JSON tree and as flow events in the Chrome
+    export."""
+    from dlrover_tpu.brain.serving import ServingScalePolicy
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.common.node import Node
+    from dlrover_tpu.scheduler.in_memory import (
+        InMemoryCluster,
+        InMemoryNodeWatcher,
+        InMemoryScaler,
+    )
+    from dlrover_tpu.serving.router import (
+        ReplicaProvisioner,
+        RouterMetrics,
+        ServingAutoScaler,
+    )
+
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        metrics=RouterMetrics(window_seconds=0.5),
+    )
+    cluster = InMemoryCluster()
+    scaler = InMemoryScaler(cluster)
+    provisioner = ReplicaProvisioner(
+        router, InMemoryNodeWatcher(cluster),
+        engine_factory=lambda node: FakeEngine(
+            slots=2, tokens_per_step=1, blocks=100000))
+    sup = _StubSupervisor(
+        router=router, respawn=True, max_respawns=1,
+        respawn_window=300.0, backoff_base=0.2, backoff_max=1.0,
+        backoff_jitter=0.25, quarantine_seconds=120.0, seed=5,
+        recorder=router.recorder)
+    # debt replacement only: huge decide/cooldown keep the load
+    # policy out of the picture — the ORIGIN must be the replacement
+    # trace, not a coincidental scale-up
+    ServingAutoScaler(
+        router, scaler,
+        policy=ServingScalePolicy(min_replicas=1, max_replicas=8,
+                                  queue_high=1e9, queue_low=0.0),
+        supervisor=sup,
+        decide_interval=1e9, cooldown=1e9, min_samples=1000)
+
+    t = time.monotonic()
+    # replica 0 joins first and fills up with LONG work, so the
+    # failed-over requests can only land on the replacement later
+    cluster.create_node(Node(NodeType.SERVING_REPLICA, 0,
+                             rank_index=0))
+    provisioner.poll()
+    long_reqs = [router.submit(_prompt(i), 256, now=t)
+                 for i in range(2)]
+    router.step(now=t)
+    assert all(r.replica == "serving-replica-0" for r in long_reqs)
+    # replica 1 joins (supervised: it is about to crash-loop) and
+    # takes the short requests that will be failed over
+    cluster.create_node(Node(NodeType.SERVING_REPLICA, 1,
+                             rank_index=1))
+    provisioner.poll()
+    sup.spawn(name="serving-replica-1")
+    doomed = [router.submit(_prompt(10 + i), 8, now=t)
+              for i in range(2)]
+    router.step(now=t)
+    assert all(r.replica == "serving-replica-1" for r in doomed)
+
+    router.fail_replica("serving-replica-1")
+    for _ in range(200):
+        t += 0.1
+        _crash_current(sup)
+        sup.poll(now=t)
+        router.step(now=t)
+        provisioner.poll(timeout=0.001)
+        if all(r.state == ServingRequestState.DONE for r in doomed):
+            break
+    assert all(r.state == ServingRequestState.DONE for r in doomed)
+    assert all(r.requeues > 0 for r in doomed), \
+        "the replica death must have failed the requests over"
+    assert all(
+        r.replica and r.replica.startswith(
+            "serving-replica-replacement")
+        for r in doomed), [r.replica for r in doomed]
+
+    def spans_of(tree):
+        out = []
+
+        def walk(spans):
+            for s in spans:
+                out.append(s)
+                walk(s["children"])
+
+        walk(tree["spans"])
+        return out
+
+    tracer = router.tracer
+    link_targets = set()
+    for r in doomed:
+        tree = tracer.get_tree(r.trace.trace_id)
+        assert tree is not None
+        attempts = [s for s in spans_of(tree) if s["name"] == "attempt"]
+        # the dead attempt is closed as failover and kept in the tree
+        assert any(a["status"] == "failover" for a in attempts)
+        landed = [a for a in attempts
+                  if str(a["attrs"].get("replica", "")).startswith(
+                      "serving-replica-replacement")]
+        assert landed, attempts
+        links = landed[-1].get("links") or []
+        assert links, "the attempt must link to its replica's origin"
+        link = links[0]
+        assert link["attrs"]["rel"] == "replica_origin"
+        assert link["attrs"]["kind"] == "replacement"
+        # the quarantined source may be a respawn (#rN suffix) — the
+        # base name is the stable identity
+        assert base_replica_name(
+            link["attrs"]["replacement_for"]) == "serving-replica-1"
+        # the link RESOLVES: its target is the always-sampled
+        # replacement autoscale trace held by the same tracer
+        target = tracer.get_tree(link["trace_id"])
+        assert target is not None and target["name"] == "autoscale"
+        assert base_replica_name(
+            target["spans"][0]["attrs"]["replacement_for"]) == \
+            "serving-replica-1"
+        link_targets.add(link["trace_id"])
+
+    # the Chrome export renders every link as a flow-event pair
+    # (ph "s" at the decision, ph "f" at the attempt, same id)
+    chrome = json.loads(tracer.export_chrome_trace())
+    flows = [e for e in chrome["traceEvents"]
+             if e.get("name") == "span_link"]
+    starts = {e["id"] for e in flows if e["ph"] == "s"}
+    finishes = {e["id"] for e in flows if e["ph"] == "f"}
+    assert starts and starts == finishes
+    assert any(e["args"].get("kind") == "replacement" for e in flows)
 
 
 # -- subprocess acceptance (slow) --------------------------------------------

@@ -12,6 +12,7 @@ from dlrover_tpu.dlint.checkers import (  # noqa: F401
     FrameExhaustiveChecker,
     LockBlockingChecker,
     LockOrderingChecker,
+    MetricLabelCardinalityChecker,
     MetricRegistryChecker,
     Project,
     StateTransitionChecker,
