@@ -914,6 +914,37 @@ def main() -> None:
             f"{result.get('gateway_admission_p99_us')}us); see PERF.md",
             file=sys.stderr,
         )
+    # decode raw-speed gates (ROADMAP "decode raw-speed push"): the
+    # chunked-prefill stall bound, the decode-step bar and the int8 KV
+    # block-budget multiplier each fail the summary loudly, same
+    # contract as the pause gate — a serving regression must not drift
+    # silently run-over-run
+    if result.get("prefill_stall_ok") is False:
+        regressions.append("prefill_stall")
+        print(
+            "BENCH REGRESSION: prefill_stall_ok=false — worst "
+            f"inter-token gap {result.get('prefill_stall_p99_ms')}ms "
+            "while a max-length prompt prefills vs the 2x-decode-chunk "
+            f"bound ({result.get('prefill_stall_decode_chunk_ms')}ms "
+            "per chunk); see PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("decode_step_ok") is False:
+        regressions.append("decode_step")
+        print(
+            "BENCH REGRESSION: decode_step_ok=false — decode step "
+            f"{result.get('serving_decode_step_ms_bf16')}ms vs the "
+            f"{result.get('decode_step_bar_ms')}ms bar; see PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("kv_budget_ok") is False:
+        regressions.append("kv_budget")
+        print(
+            "BENCH REGRESSION: kv_budget_ok=false — int8 paged KV "
+            f"block budget only {result.get('kv_budget_x')}x the "
+            "native pool at the same HBM vs the 1.9x bar; see PERF.md",
+            file=sys.stderr,
+        )
     if result.get("ckpt_pause_ok") is False:
         regressions.append("ckpt_pause")
         print(

@@ -153,7 +153,11 @@ def run_spec_config() -> dict:
     """Speculative decoding on a repetitive workload: tokens committed
     per model forward (the speculation win; bar: > 1.5).  Prompt-lookup
     drafts need self-similar text, so the prompt is a repeated phrase —
-    the summarization/code-echo case speculation exists for."""
+    the summarization/code-echo case speculation exists for.  Runs
+    ``paged=True``: accepted drafts commit through ``scatter_tokens``
+    into BlockManager blocks (incl. the spec-slack overflow block), so
+    this config is the bench proof that speculation and paging compose
+    — the books-balance assert below would catch a leak."""
     import jax
     import numpy as np
 
@@ -166,7 +170,7 @@ def run_spec_config() -> dict:
     variables = model.init(jax.random.PRNGKey(0), probe)
     eng = InferenceEngine(
         cfg, variables, max_slots=4, int8=False, chunk=16,
-        temperature=0.0, speculative_k=8,
+        temperature=0.0, speculative_k=8, paged=True,
         max_len=prompt_len + gen_len, seed=0,
     )
     rng = np.random.RandomState(0)
@@ -196,14 +200,161 @@ def run_spec_config() -> dict:
         wall = time.perf_counter() - t0
         best_wall = wall if best_wall is None else min(best_wall, wall)
     wall = best_wall
+    assert eng._blockmgr.available_blocks == \
+        eng._blockmgr.num_blocks - 1, "paged spec leaked blocks"
     return {
         "serving_tokens_per_forward": round(
             eng.stats.tokens_per_forward, 2),
         "serving_spec_accept_rate": round(
-            eng.stats.spec_accepted / max(1, eng.stats.spec_proposed), 3),
+            eng.stats.spec_accept_ratio, 3),
         "serving_spec_tok_s": round(
             eng.stats.generated_tokens / wall, 1),
+        "serving_spec_paged": True,
     }
+
+
+def run_chunked_config() -> dict:
+    """The prefill-stall rig: worst inter-token gap across decoding
+    slots WHILE a max-length prompt prefills, chunked vs monolithic.
+
+    Three slots decode steadily; a max-length prompt is then admitted.
+    Unchunked, its whole prefill serializes ahead of the next decode
+    dispatch — every slot's token cadence stalls for ~the prefill
+    (~0.1s on the rig).  With ``prefill_chunk`` the prompt advances
+    one bounded chunk per step, so the worst gap is one decode chunk
+    plus one prefill chunk (the <=2-decode-chunks acceptance bound).
+    Gap = wall time of each engine step from the long admission until
+    its first token (each step emits tokens for every decoding slot,
+    so step wall IS the inter-token gap); best-of-3 of the per-trial
+    worst, like every number on this shared rig."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+
+    cfg, prompt_len, gen_len, _ = _engine_cfg()
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    long_len = min(cfg.max_seq_len - gen_len, 2048) if on_tpu else 48
+    short_len = prompt_len if on_tpu else 8
+    chunk = 8 if on_tpu else 4
+    prefill_chunk = 256 if on_tpu else 16
+    max_len = long_len + gen_len
+    model = LlamaModel(cfg)
+    probe = jax.numpy.zeros((1, 8), jax.numpy.int32)
+    variables = model.init(jax.random.PRNGKey(0), probe)
+    rng = np.random.RandomState(0)
+    shorts = rng.randint(0, cfg.vocab_size,
+                         (3, short_len)).astype(np.int32)
+    long_prompt = rng.randint(0, cfg.vocab_size,
+                              long_len).astype(np.int32)
+
+    def worst_gap(pc: int) -> tuple:
+        eng = InferenceEngine(
+            cfg, variables, max_slots=4, chunk=chunk, temperature=1.0,
+            top_k=50, max_len=max_len, prefill_chunk=pc, seed=0,
+        )
+
+        def one_trial():
+            # companions decode with budget to spare across the
+            # whole long prefill
+            rids = [eng.add_request(p, max_len - short_len)
+                    for p in shorts]
+            eng.step()
+            # decode-only reference gap (post-compile steady state)
+            t0 = time.perf_counter()
+            eng.step()
+            decode_ms = (time.perf_counter() - t0) * 1e3
+            long_rid = eng.add_request(long_prompt, 4)
+            gaps = []
+            while True:
+                t0 = time.perf_counter()
+                finished = eng.step()
+                gaps.append((time.perf_counter() - t0) * 1e3)
+                started = any(
+                    r is not None and r.rid == long_rid and r.output
+                    for r in eng._slot_req if r is not None
+                ) or any(f.rid == long_rid for f in finished)
+                if started:
+                    break
+            # drain: cancel the open-budget companions, finish the rest
+            for r in rids:
+                eng.cancel(r)
+            eng.run()
+            return max(gaps), decode_ms
+
+        one_trial()  # warmup: compiles every program shape
+        best_gap, best_decode = None, None
+        for _ in range(3):
+            g, d = one_trial()
+            best_gap = g if best_gap is None else min(best_gap, g)
+            best_decode = d if best_decode is None \
+                else min(best_decode, d)
+        return best_gap, best_decode
+
+    stall_chunked, decode_ms = worst_gap(prefill_chunk)
+    stall_unchunked, _ = worst_gap(0)
+    return {
+        # worst inter-token gap while the max-length prompt prefills
+        "prefill_stall_p99_ms": round(stall_chunked, 3),
+        "prefill_stall_unchunked_ms": round(stall_unchunked, 3),
+        "prefill_stall_decode_chunk_ms": round(decode_ms, 3),
+        "prefill_chunk_tokens": prefill_chunk,
+        # the acceptance bound: the gap stays within 2 decode chunks
+        "prefill_stall_ok": bool(stall_chunked <= 2.0 * decode_ms),
+    }
+
+
+def run_int8kv_config() -> dict:
+    """int8 paged KV: throughput + block budget at the same HBM.  The
+    budget claim is structural (kv_budget_x = how many int8 blocks fit
+    in one native block's bytes; bar >= 1.9), the throughput numbers
+    keep the quantized gather/scatter's cost honest next to the bf16
+    paged engine."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+
+    cfg, prompt_len, gen_len, n_req = _engine_cfg()
+    model = LlamaModel(cfg)
+    probe = jax.numpy.zeros((1, 8), jax.numpy.int32)
+    variables = model.init(jax.random.PRNGKey(0), probe)
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, cfg.vocab_size,
+                          (n_req, prompt_len)).astype(np.int32)
+
+    out = {}
+    for tag, kv_dtype in (("paged_bf16", None), ("paged_int8", "int8")):
+        eng = InferenceEngine(
+            cfg, variables, max_slots=8, chunk=32, temperature=1.0,
+            top_k=50, max_len=prompt_len + gen_len, paged=True,
+            kv_dtype=kv_dtype, seed=0,
+        )
+        for i in range(min(2, n_req)):
+            eng.add_request(prompts[i], gen_len)
+        eng.run()  # warmup/compile
+        best_wall = None
+        for _ in range(3):
+            eng.stats.generated_tokens = 0
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                eng.add_request(prompts[i], gen_len)
+            eng.run()
+            wall = time.perf_counter() - t0
+            best_wall = wall if best_wall is None \
+                else min(best_wall, wall)
+        out[f"serving_tok_s_{tag}"] = round(
+            n_req * gen_len / best_wall, 1)
+        out.update(_decode_step_probe(eng, tag))
+        if kv_dtype == "int8":
+            out["kv_budget_x"] = round(eng.kv_budget_x, 3)
+            out["serving_kv_quant_blocks"] = eng.kv_quant_blocks
+    # structural gate: int8 blocks per native block's HBM (>= 1.9x
+    # doubles-ish the continuous batch the placement ledger can admit)
+    out["kv_budget_ok"] = bool(out.get("kv_budget_x", 0.0) >= 1.9)
+    return out
 
 
 def run_trace_config() -> dict:
@@ -263,7 +414,8 @@ def run_trace_config() -> dict:
 
 def main() -> dict:
     out = {}
-    for mode in ("bf16", "int8", "bf16_slots1", "spec", "trace"):
+    for mode in ("bf16", "int8", "bf16_slots1", "spec", "trace",
+                 "chunked", "int8kv"):
         proc = subprocess.run(
             [sys.executable, __file__, mode],
             capture_output=True, text=True, timeout=1800,
@@ -288,6 +440,17 @@ def main() -> dict:
         out["serving_batch_scaling"] = round(
             out["serving_tok_s_bf16"] / out["serving_tok_s_bf16_slots1"],
             2)
+    # decode raw-speed gate (ROADMAP: decode step < 2ms) — judged on
+    # the TPU geometry only; the CPU fallback measures the host, not
+    # the model, so it emits no verdict rather than a fake one
+    import jax
+
+    if jax.default_backend() not in ("cpu", "gpu") \
+            and "serving_decode_step_ms_bf16" in out:
+        out["decode_step_bar_ms"] = 2.0
+        out["decode_step_ok"] = bool(
+            out["serving_decode_step_ms_bf16"]
+            <= out["decode_step_bar_ms"])
     return out
 
 
@@ -297,6 +460,10 @@ if __name__ == "__main__":
             print(json.dumps(run_spec_config()))
         elif sys.argv[1] == "trace":
             print(json.dumps(run_trace_config()))
+        elif sys.argv[1] == "chunked":
+            print(json.dumps(run_chunked_config()))
+        elif sys.argv[1] == "int8kv":
+            print(json.dumps(run_int8kv_config()))
         else:
             print(json.dumps(run_config(sys.argv[1])))
     else:

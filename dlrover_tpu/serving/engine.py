@@ -56,6 +56,14 @@ class EngineStats:
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
     prefill_calls: int = 0        # dispatches; < admissions when batched
+    prefill_admissions: int = 0   # requests admitted into prefill —
+    #                               the batched-prefill win is
+    #                               admissions/dispatches, measurable
+    #                               only with BOTH counters exposed
+    prefill_chunks: int = 0       # chunked-prefill dispatches
+    #                               (a subset of prefill_calls)
+    prefill_chunk_seconds: float = 0.0  # wall seconds in chunk
+    #                               dispatches (the stall-bound budget)
     finished_requests: int = 0
     spec_proposed: int = 0        # draft tokens sent to verification
     spec_accepted: int = 0        # draft tokens accepted
@@ -74,6 +82,14 @@ class EngineStats:
         drafts amortized forwards)."""
         return self.generated_tokens / self.decode_forwards \
             if self.decode_forwards else 0.0
+
+    @property
+    def spec_accept_ratio(self) -> float:
+        """Accepted draft tokens over proposed — the live health signal
+        of the speculation governor (``serving_spec_accept_ratio`` on
+        /metrics; ``tokens_per_forward`` is the derived win)."""
+        return self.spec_accepted / self.spec_proposed \
+            if self.spec_proposed else 0.0
 
 
 def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
@@ -106,6 +122,8 @@ class InferenceEngine:
         paged: bool = False,
         cache_blocks: Optional[int] = None,
         block_size: int = 16,
+        kv_dtype: Optional[str] = None,
+        prefill_chunk: int = 0,
         mesh: Optional[Any] = None,
         seed: int = 0,
     ):
@@ -121,7 +139,25 @@ class InferenceEngine:
         the (free) draft hit rate, and switch speculation on when
         drafts are available often enough to pay — then self-regulate:
         measured acceptance below ``spec_accept_floor`` backs off to
-        chunk decode and re-probes later."""
+        chunk decode and re-probes later.
+
+        ``prefill_chunk > 0`` enables CHUNKED prefill: a prompt whose
+        bucket exceeds the chunk is admitted into a slot immediately
+        but prefilled ``prefill_chunk`` tokens per engine step (a
+        ``real_len`` cursor survives across dispatches), interleaved
+        with the decode dispatches of the other slots — so the batch's
+        worst inter-token gap is bounded by ONE chunk's cost instead
+        of a whole max-length prefill (the Sarathi-style stall bound).
+        Cancel/failover mid-prefill reclaims the slot and its KV
+        blocks like any live slot.
+
+        ``kv_dtype="int8"`` (requires ``paged=True``) stores the K/V
+        block pools as int8 codes with per-(token, head) scales in
+        block-shaped scale pools (models/quantize machinery).  An
+        HBM-denominated ``cache_blocks`` budget is multiplied by
+        ``kv_budget_x`` (~2x for bf16 models), which is what doubles
+        the continuous batch the placement ledger can admit at fixed
+        HBM."""
         self.cfg = cfg
         self.int8 = int8
         self.chunk = int(chunk)
@@ -169,7 +205,40 @@ class InferenceEngine:
         # extra rows dynamic_update_slice would CLAMP the start and
         # silently overwrite earlier (live) cache entries
         cache_len = self.max_len + max(0, self.speculative_k)
+        self.prefill_chunk = int(prefill_chunk or 0)
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 disables)")
+        self._park_pos = 0
+        if self.prefill_chunk:
+            # PARK-ROW slack: while a slot prefills in chunks, the
+            # decode/verify dispatches of the OTHER slots still compute
+            # (and write) junk K/V for it at its frozen position.  Real
+            # writes never pass cache_len-1, so parking the slot at
+            # position `cache_len` and growing the cache by max(1, K)
+            # rows keeps every junk write — including the dense verify
+            # path's K-row block write (dynamic_update_slice clamps its
+            # start to cache_len-K, which the slack makes == park) —
+            # inside rows no live query's `key <= pos` mask can see.
+            # Paged twin: park positions map to columns past the
+            # allocation, which paged._block_offsets routes to the
+            # trash sink.
+            self._park_pos = cache_len
+            cache_len += max(1, self.speculative_k)
         self.paged = bool(paged)
+        if kv_dtype in (None, "bf16"):
+            self.kv_dtype = None
+        elif kv_dtype == "int8":
+            if not self.paged:
+                raise ValueError(
+                    "kv_dtype='int8' is a paged-pool feature "
+                    "(per-block-scale quantized K/V pools); pass "
+                    "paged=True")
+            self.kv_dtype = "int8"
+        else:
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} not supported: use None/'bf16' "
+                "(native) or 'int8'")
+        self.kv_budget_x = 1.0
         if self.paged:
             # block-pool cache (serving/paged.py): per-sequence memory
             # scales with ACTUAL lengths, concurrency is bounded by the
@@ -179,12 +248,23 @@ class InferenceEngine:
 
             self.block_size = int(block_size)
             self._max_blocks = -(-cache_len // self.block_size)
+            if self.kv_dtype == "int8":
+                from dlrover_tpu.serving.paged import (
+                    kv_budget_multiplier,
+                )
+
+                self.kv_budget_x = kv_budget_multiplier(
+                    cfg.dtype, cfg.head_dim_)
             # +1: block 0 is the trash sink (never allocated), so the
             # default must still let every slot hold a full-length
-            # sequence
-            n_blocks = int(
-                cache_blocks or self.max_slots * self._max_blocks + 1
-            )
+            # sequence.  An EXPLICIT cache_blocks is an HBM budget
+            # denominated in native-dtype blocks — int8 pools multiply
+            # it by kv_budget_x, which is the whole point of the knob
+            # (same bytes, ~2x the blocks, ~2x the continuous batch).
+            if cache_blocks:
+                n_blocks = int(int(cache_blocks) * self.kv_budget_x)
+            else:
+                n_blocks = self.max_slots * self._max_blocks + 1
             self._blockmgr = BlockManager(n_blocks, self.block_size)
             self._slot_blocks: List[Optional[List[int]]] = (
                 [None] * self.max_slots
@@ -195,13 +275,28 @@ class InferenceEngine:
             )
             kvd = (n_blocks, self.block_size,
                    cfg.num_kv_heads, cfg.head_dim_)
-            self._cache = {
-                "k_pool": [jnp.zeros(kvd, cfg.dtype)
-                           for _ in range(cfg.num_layers)],
-                "v_pool": [jnp.zeros(kvd, cfg.dtype)
-                           for _ in range(cfg.num_layers)],
-                "table": jnp.asarray(self._table_np),
-            }
+            if self.kv_dtype == "int8":
+                from dlrover_tpu.models.quantize import KV_SCALE_DTYPE
+
+                self._cache = {
+                    "k_pool": [jnp.zeros(kvd, jnp.int8)
+                               for _ in range(cfg.num_layers)],
+                    "v_pool": [jnp.zeros(kvd, jnp.int8)
+                               for _ in range(cfg.num_layers)],
+                    "k_scale": [jnp.zeros(kvd[:3], KV_SCALE_DTYPE)
+                                for _ in range(cfg.num_layers)],
+                    "v_scale": [jnp.zeros(kvd[:3], KV_SCALE_DTYPE)
+                                for _ in range(cfg.num_layers)],
+                    "table": jnp.asarray(self._table_np),
+                }
+            else:
+                self._cache = {
+                    "k_pool": [jnp.zeros(kvd, cfg.dtype)
+                               for _ in range(cfg.num_layers)],
+                    "v_pool": [jnp.zeros(kvd, cfg.dtype)
+                               for _ in range(cfg.num_layers)],
+                    "table": jnp.asarray(self._table_np),
+                }
         else:
             kvd = (self.max_slots, cache_len,
                    cfg.num_kv_heads, cfg.head_dim_)
@@ -219,8 +314,19 @@ class InferenceEngine:
             self.params, self._cache = shard_serving_state(
                 self.params, self._cache, mesh, cfg)
         self._rng = jax.random.PRNGKey(seed)
+        self._cache_len = cache_len
         # host-side slot state
         self._slot_req: List[Optional[Request]] = [None] * self.max_slots
+        # chunked-prefill cursors: _prefilling marks slots holding a
+        # request whose prompt is still being written chunk-by-chunk
+        # (excluded from decode); _prefill_pos is the real_len cursor —
+        # how many prompt tokens are already in the cache — surviving
+        # across dispatches; _prefill_rr round-robins ONE chunk per
+        # step across prefilling slots so the stall bound holds even
+        # with several long prompts in flight
+        self._prefilling = np.zeros(self.max_slots, bool)
+        self._prefill_pos = np.zeros(self.max_slots, np.int32)
+        self._prefill_rr = 0
         # per-slot incrementally-filled context (prompt + committed
         # tokens) for the speculative draft lookup — rebuilding it from
         # the output list every round would be O(n^2) per request.
@@ -262,6 +368,7 @@ class InferenceEngine:
             return out.T, tokens, positions, cache, rng
 
         paged = self.paged
+        kv_quant = self.kv_dtype == "int8"
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def insert_fn(params, cache, tokens, real_len, slots, rng):
@@ -270,7 +377,25 @@ class InferenceEngine:
             dispatch (jit caches one program per (G, bucket) pair)."""
             lp = tokens.shape[1]
             logits, ks, vs = prefill(params, cfg, tokens, real_len)
-            if paged:
+            if paged and kv_quant:
+                from dlrover_tpu.serving.paged import scatter_tokens_q
+
+                rows = jnp.take(cache["table"], slots, axis=0)  # [G, MB]
+                zero = jnp.zeros(slots.shape, jnp.int32)
+                kp, ksc, vp, vsc = [], [], [], []
+                for p, sp, k in zip(cache["k_pool"], cache["k_scale"],
+                                    ks):
+                    np_, ns_ = scatter_tokens_q(p, sp, rows, k, zero)
+                    kp.append(np_)
+                    ksc.append(ns_)
+                for p, sp, v in zip(cache["v_pool"], cache["v_scale"],
+                                    vs):
+                    np_, ns_ = scatter_tokens_q(p, sp, rows, v, zero)
+                    vp.append(np_)
+                    vsc.append(ns_)
+                new_cache = dict(cache, k_pool=kp, k_scale=ksc,
+                                 v_pool=vp, v_scale=vsc)
+            elif paged:
                 from dlrover_tpu.serving.paged import scatter_tokens
 
                 rows = jnp.take(cache["table"], slots, axis=0)  # [G, MB]
@@ -303,6 +428,29 @@ class InferenceEngine:
 
         self._chunk_fn = chunk_fn
         self._insert_fn = insert_fn
+
+        self._prefill_chunk_fn = None
+        if self.prefill_chunk:
+            from dlrover_tpu.serving.model import verify_step
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def prefill_chunk_fn(params, cache, tokens, start, slots,
+                                 last_idx, rng):
+                """ONE bounded prompt chunk for slot subset ``slots``:
+                a draft-free verify run attending to what previous
+                chunks cached (one compile — the chunk shape is fixed
+                at [G, prefill_chunk]).  ``last_idx`` picks the single
+                position whose logits feed sampling; the host uses the
+                sampled token only for the FINAL chunk."""
+                logits, cache = verify_step(
+                    params, cfg, cache, tokens, start,
+                    slots=slots, logits_index=last_idx)
+                rng, sub = jax.random.split(rng)
+                first = select_token(
+                    logits[:, 0, :], sub, temperature, top_k, top_p)
+                return cache, first, rng
+
+            self._prefill_chunk_fn = prefill_chunk_fn
 
         self._spec_fn = None
         if self.speculative_k > 1:
@@ -365,6 +513,11 @@ class InferenceEngine:
             if not free:
                 return
             bucket = _bucket(self._queue[0].prompt.size, self.buckets)
+            if self._prefill_chunk_fn is not None \
+                    and bucket > self.prefill_chunk:
+                if not self._admit_chunked(free[0]):
+                    return  # pool exhausted: keep queued, keep order
+                continue
             group: List[Request] = []
             allocs: List[Any] = []
             while (
@@ -378,14 +531,7 @@ class InferenceEngine:
                     # (bucket-padded prefill writes + gen + spec slack);
                     # pool exhaustion keeps the request QUEUED — that is
                     # the HBM-budget-bound admission paging exists for
-                    req = self._queue[0]
-                    total = max(
-                        req.prompt.size + req.max_new_tokens
-                        + max(0, self.speculative_k),
-                        bucket,
-                    )
-                    alloc = self._blockmgr.alloc_sequence(
-                        req.prompt, total)
+                    alloc = self._alloc_lifetime(self._queue[0], bucket)
                     if alloc is None:
                         break
                     allocs.append(alloc)
@@ -395,10 +541,7 @@ class InferenceEngine:
             slots = free[: len(group)]
             if self.paged:
                 for g, s in enumerate(slots):
-                    blocks, _shared = allocs[g]
-                    self._slot_blocks[s] = blocks
-                    self._table_np[s, : len(blocks)] = blocks
-                    self._table_np[s, len(blocks):] = 0
+                    self._bind_blocks(s, allocs[g][0])
                 self._push_table()
             padded = np.zeros((len(group), bucket), np.int32)
             lens = np.empty(len(group), np.int32)
@@ -414,6 +557,7 @@ class InferenceEngine:
             firsts = np.asarray(firsts)
             self.stats.prefill_seconds += time.perf_counter() - t0
             self.stats.prefill_calls += 1
+            self.stats.prefill_admissions += len(group)
             for g, (s, req) in enumerate(zip(slots, group)):
                 first = int(firsts[g])
                 self._slot_req[s] = req
@@ -427,6 +571,112 @@ class InferenceEngine:
                 self._remaining[s] = req.max_new_tokens - 1
                 self._finish_if_done(s, first)
 
+    def _alloc_lifetime(self, req: Request, bucket: int):
+        """ONE capacity formula for every admission path (batched AND
+        chunked) — and it must stay in lockstep with the router's
+        ``blocks_needed``: blocks for the request's whole lifetime,
+        i.e. max(bucket-padded prefill writes, prompt + generation +
+        speculative slack).  None = pool exhausted (caller keeps the
+        request queued)."""
+        total = max(
+            req.prompt.size + req.max_new_tokens
+            + max(0, self.speculative_k),
+            bucket,
+        )
+        return self._blockmgr.alloc_sequence(req.prompt, total)
+
+    def _bind_blocks(self, s: int, blocks: List[int]) -> None:
+        """Point slot ``s``'s table row at its allocated blocks
+        (zero-filled tail = the trash sink); the caller owns the
+        host->device table push."""
+        self._slot_blocks[s] = blocks
+        self._table_np[s, : len(blocks)] = blocks
+        self._table_np[s, len(blocks):] = 0
+
+    def _admit_chunked(self, s: int) -> bool:
+        """Admit the queue head into slot ``s`` for CHUNKED prefill:
+        blocks for the whole lifetime are allocated now (same capacity
+        formula as the router's ``blocks_needed``), but the prompt is
+        written ``prefill_chunk`` tokens per step by
+        :meth:`_advance_prefill`.  The slot is parked out of decode
+        (``_prefilling``; position = the never-read park row) until
+        the cursor reaches the prompt end.  False = pool exhausted,
+        request stays queued."""
+        req = self._queue[0]
+        if self.paged:
+            # prefix-cache hits are rewritten by the chunk program
+            # (idempotent up to program numerics: the chunked and
+            # monolithic prefill compute identical K/V modulo low-order
+            # attention rounding, so a live sharer admitted through the
+            # OTHER path sees an epsilon-level prefix perturbation, not
+            # corruption)
+            alloc = self._alloc_lifetime(
+                req, _bucket(req.prompt.size, self.buckets))
+            if alloc is None:
+                return False
+            self._bind_blocks(s, alloc[0])
+            self._table_dirty = True
+        self._queue.popleft()
+        self._slot_req[s] = req
+        self._prefilling[s] = True
+        self._prefill_pos[s] = 0
+        self._tokens[s] = 0
+        self._positions[s] = self._park_pos
+        self._remaining[s] = req.max_new_tokens
+        self.stats.prefill_admissions += 1
+        return True
+
+    def _advance_prefill(self) -> None:
+        """One bounded prompt chunk for ONE prefilling slot (round-
+        robin) — the per-step prefill budget that keeps every other
+        slot's inter-token gap bounded by a single chunk dispatch.
+        When the cursor reaches the prompt end, sample the first token
+        and hand the slot to decode."""
+        slots = [s for s in range(self.max_slots) if self._prefilling[s]]
+        if not slots:
+            return
+        s = slots[self._prefill_rr % len(slots)]
+        self._prefill_rr += 1
+        req = self._slot_req[s]
+        assert req is not None
+        start = int(self._prefill_pos[s])
+        c = self.prefill_chunk
+        end = min(start + c, req.prompt.size)
+        chunk = np.zeros((1, c), np.int32)
+        chunk[0, : end - start] = req.prompt[start:end]
+        # index (within the chunk) of the prompt's final token: only
+        # meaningful on the final chunk; clamped junk otherwise (the
+        # sampled token is discarded for non-final chunks)
+        last_idx = max(0, min(end, req.prompt.size) - 1 - start)
+        if self.paged and self._table_dirty:
+            self._push_table()
+        t0 = time.perf_counter()
+        self._cache, first, self._rng = self._prefill_chunk_fn(
+            self.params, self._cache, jnp.asarray(chunk),
+            jnp.asarray([start], jnp.int32),
+            jnp.asarray([s], jnp.int32),
+            jnp.asarray([last_idx], jnp.int32),
+            self._rng,
+        )
+        dt = time.perf_counter() - t0
+        self.stats.prefill_seconds += dt
+        self.stats.prefill_chunk_seconds += dt
+        self.stats.prefill_calls += 1
+        self.stats.prefill_chunks += 1
+        self._prefill_pos[s] = end
+        if end >= req.prompt.size:
+            first = int(np.asarray(first)[0])
+            self._prefilling[s] = False
+            req.output.append(first)
+            p = req.prompt.size
+            self._ctx_buf[s, :p] = req.prompt
+            self._ctx_buf[s, p] = first
+            self._ctx_len[s] = p + 1
+            self._tokens[s] = first
+            self._positions[s] = p
+            self._remaining[s] = req.max_new_tokens - 1
+            self._finish_if_done(s, first)
+
     def _finish_if_done(self, s: int, last_token: int) -> bool:
         req = self._slot_req[s]
         assert req is not None
@@ -435,22 +685,48 @@ class InferenceEngine:
             req.done = True
             self._finished.append(req)
             self.stats.finished_requests += 1
-            self._slot_req[s] = None
-            if self.paged and self._slot_blocks[s] is not None:
-                # blocks return to the pool (shared prefix blocks just
-                # decref; fully-released ones linger in the prefix LRU).
-                # The table row must reset to the trash block NOW: the
-                # dead slot keeps writing junk KV every step, and its
-                # freed blocks may be reallocated to a live sequence.
-                self._blockmgr.free_sequence(self._slot_blocks[s])
-                self._slot_blocks[s] = None
-                self._table_np[s, :] = 0
-                # batched: several slots often finish in one round, and
-                # a table transfer per finish would pay the host->device
-                # hop each time — dispatch sites push once when dirty
-                self._table_dirty = True
+            self._release_slot(s)
             return True
         return False
+
+    def _release_slot(self, s: int) -> None:
+        """Return slot ``s`` to the free set — completion AND
+        cancellation both land here, so a half-prefilled slot reclaims
+        exactly like a decoding one."""
+        self._slot_req[s] = None
+        self._prefilling[s] = False
+        self._prefill_pos[s] = 0
+        if self.paged and self._slot_blocks[s] is not None:
+            # blocks return to the pool (shared prefix blocks just
+            # decref; fully-released ones linger in the prefix LRU).
+            # The table row must reset to the trash block NOW: the
+            # dead slot keeps writing junk KV every step, and its
+            # freed blocks may be reallocated to a live sequence.
+            self._blockmgr.free_sequence(self._slot_blocks[s])
+            self._slot_blocks[s] = None
+            self._table_np[s, :] = 0
+            # batched: several slots often finish in one round, and
+            # a table transfer per finish would pay the host->device
+            # hop each time — dispatch sites push once when dirty
+            self._table_dirty = True
+
+    def cancel(self, rid: int) -> bool:
+        """Withdraw a request wherever it lives — the engine queue, a
+        live decode slot, or a slot still MID-CHUNKED-PREFILL (the
+        cursor state is discarded and the lifetime block allocation
+        freed) — reclaiming slot + paged KV immediately.  Always True:
+        local delivery cannot fail, and an already-finished rid is a
+        successfully-delivered no-op (the router-side cancel
+        contract)."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                return True
+        for s, req in enumerate(self._slot_req):
+            if req is not None and req.rid == rid:
+                self._release_slot(s)
+                return True
+        return True
 
     def _push_table(self) -> None:
         table = jnp.asarray(self._table_np)
@@ -462,6 +738,14 @@ class InferenceEngine:
         self._cache = dict(self._cache, table=table)
         self._table_dirty = False
 
+    @property
+    def kv_quant_blocks(self) -> int:
+        """Blocks in the int8-quantized KV pool (0 when the pool is
+        native-dtype) — the ``serving_kv_quant_blocks`` gauge."""
+        if self.paged and self.kv_dtype == "int8":
+            return self._blockmgr.num_blocks
+        return 0
+
     # ----------------------------------------------------------- step
     @property
     def has_work(self) -> bool:
@@ -469,11 +753,19 @@ class InferenceEngine:
             r is not None for r in self._slot_req)
 
     def step(self) -> List[Request]:
-        """Admit waiting requests, run one decode chunk (or speculative
-        verify), return requests finished during this step."""
+        """Admit waiting requests, advance at most ONE bounded prefill
+        chunk, run one decode chunk (or speculative verify), return
+        requests finished during this step.  The ordering IS the stall
+        bound: a max-length prompt costs every other slot one chunk
+        dispatch per decode round, never a whole prefill."""
         before = len(self._finished)
         self._admit()
-        active = np.array([r is not None for r in self._slot_req])
+        if self.prefill_chunk:
+            self._advance_prefill()
+        active = np.array([
+            r is not None and not self._prefilling[s]
+            for s, r in enumerate(self._slot_req)
+        ])
         if active.any() and self._spec_fn is not None \
                 and self._spec_state == "on":
             self._spec_step()
@@ -496,7 +788,7 @@ class InferenceEngine:
             self.stats.decode_forwards += self.chunk
             for s in range(self.max_slots):
                 req = self._slot_req[s]
-                if req is None:
+                if req is None or self._prefilling[s]:
                     continue
                 take = min(self.chunk, int(self._remaining[s]))
                 toks = out[s, :take].tolist()
@@ -532,7 +824,7 @@ class InferenceEngine:
         if self._spec_state != "watching":
             return
         for s, req in enumerate(self._slot_req):
-            if req is None:
+            if req is None or self._prefilling[s]:
                 continue
             n = int(self._ctx_len[s])
             context = self._ctx_buf[s, max(0, n - 2048):n]
@@ -558,7 +850,7 @@ class InferenceEngine:
         tokens[:, 0] = self._tokens
         draft_lens = np.zeros(self.max_slots, np.int32)
         for s, req in enumerate(self._slot_req):
-            if req is None:
+            if req is None or self._prefilling[s]:
                 continue
             n = int(self._ctx_len[s])
             context = self._ctx_buf[s, max(0, n - window):n]
@@ -583,7 +875,7 @@ class InferenceEngine:
         round_accepted = 0
         for s in range(self.max_slots):
             req = self._slot_req[s]
-            if req is None:
+            if req is None or self._prefilling[s]:
                 continue
             accepted = int(n_commit[s]) - 1
             round_proposed += int(draft_lens[s])
@@ -621,10 +913,12 @@ class InferenceEngine:
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue; returns {request_id: generated tokens}."""
         while self.has_work:
-            if self.eos_token is None and self._spec_fn is None:
+            if self.eos_token is None and self._spec_fn is None \
+                    and not self.prefill_chunk:
                 # fixed-budget drain needs a KNOWN number of dispatches;
-                # speculative acceptance makes progress data-dependent,
-                # so spec mode always goes through step()
+                # speculative acceptance makes progress data-dependent
+                # (and chunked prefill interleaves chunk dispatches),
+                # so both modes always go through step()
                 self._drain_fixed()
             else:
                 self.step()

@@ -32,7 +32,7 @@ cache state.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -183,6 +183,8 @@ def verify_step(
     cache: Dict[str, Any],
     tokens: jax.Array,             # [B, K]: last committed token + K-1 drafts
     positions: jax.Array,          # [B] position of tokens[:, 0]
+    slots: Optional[jax.Array] = None,
+    logits_index: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """Speculative VERIFY: process K tokens per slot in one dispatch and
     return next-token logits at every position ([B, K, V], cache).
@@ -203,6 +205,19 @@ def verify_step(
     caller advances the position pointer by ``a+1`` only — entries past
     it are invisible to the ``key <= pos`` mask and get overwritten
     when the sequence actually reaches them.  No rewind needed.
+
+    ``slots`` generalizes the batch dim to a SUBSET of cache slots:
+    ``tokens [G, K]`` / ``positions [G]`` operate on cache rows (or
+    paged table rows) ``slots [G]`` while the rest of the cache rides
+    along untouched — this is the chunked-prefill program (a prompt
+    chunk is exactly a draft-free K-token run attending to what the
+    previous chunks already cached), so decode, speculative verify and
+    chunk prefill stay ONE transformer program by construction.
+    ``logits_index [B or G]`` gathers a single time index per row
+    before the lm head (returns ``[*, 1, V]``): chunk prefill only
+    needs the prompt-final position's logits, and K-1 wasted
+    vocab-width matmuls per chunk is exactly the kind of cost a
+    bounded prefill chunk exists to avoid.
     """
     dtype = cfg.dtype
     d = cfg.head_dim_
@@ -213,25 +228,45 @@ def verify_step(
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
         pos_k]                                               # [B, K, d/2]
 
-    # paged cache ({"k_pool","v_pool","table"}) vs dense ({"k","v"}):
-    # same transformer loop, different cache plumbing (serving/paged.py)
+    # paged cache ({"k_pool","v_pool","table"}, int8 pools add
+    # {"k_scale","v_scale"}) vs dense ({"k","v"}): same transformer
+    # loop, different cache plumbing (serving/paged.py)
     paged = "table" in cache
+    quant = "k_scale" in cache
     if paged:
         from dlrover_tpu.serving.paged import (
             gather_blocks,
+            gather_blocks_q,
             scatter_tokens,
+            scatter_tokens_q,
         )
 
         table = cache["table"]
+        if slots is not None:
+            table = jnp.take(table, slots, axis=0)           # [G, MB]
 
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for i in range(cfg.num_layers):
         lp = _layer_weights(params["layers"], i)
         h = _rmsnorm(x, lp["input_norm"], cfg.rms_norm_eps).astype(dtype)
         q, k, v = _attn_proj(lp, h, cfg, dtype)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
-        if paged:
+        if paged and quant:
+            kp, ksc = scatter_tokens_q(
+                cache["k_pool"][i], cache["k_scale"][i], table,
+                k, positions)
+            vp, vsc = scatter_tokens_q(
+                cache["v_pool"][i], cache["v_scale"][i], table,
+                v, positions)
+            ck = gather_blocks_q(kp, ksc, table, dtype)
+            cv = gather_blocks_q(vp, vsc, table, dtype)
+            new_k.append(kp)
+            new_v.append(vp)
+            new_ks.append(ksc)
+            new_vs.append(vsc)
+        elif paged:
             kp = scatter_tokens(cache["k_pool"][i], table,
                                 k.astype(cache["k_pool"][i].dtype),
                                 positions)
@@ -242,6 +277,18 @@ def verify_step(
             cv = gather_blocks(vp, table)
             new_k.append(kp)
             new_v.append(vp)
+        elif slots is not None:
+            # dense slot-subset write: [G, K] advanced-index scatter
+            # (out-of-bounds positions drop, matching the paged trash
+            # sink), then gather the G rows back for attention
+            ck_full = cache["k"][i].at[slots[:, None], pos_k].set(
+                k.astype(cache["k"][i].dtype))
+            cv_full = cache["v"][i].at[slots[:, None], pos_k].set(
+                v.astype(cache["v"][i].dtype))
+            ck = jnp.take(ck_full, slots, axis=0)
+            cv = jnp.take(cv_full, slots, axis=0)
+            new_k.append(ck_full)
+            new_v.append(cv_full)
         else:
             ck = _write_cache(cache["k"][i], k, positions)
             cv = _write_cache(cache["v"][i], v, positions)
@@ -254,8 +301,15 @@ def verify_step(
         x = x + _mlp(lp, h, cfg, dtype)
 
     x = _rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = _lm_head(params, x.astype(dtype), cfg)           # [B, K, V]
-    if paged:
+    if logits_index is not None:
+        x = jnp.take_along_axis(
+            x, logits_index.astype(jnp.int32)[:, None, None], axis=1
+        )                                                    # [*, 1, E]
+    logits = _lm_head(params, x.astype(dtype), cfg)          # [B, K|1, V]
+    if paged and quant:
+        out_cache = dict(cache, k_pool=new_k, v_pool=new_v,
+                         k_scale=new_ks, v_scale=new_vs)
+    elif paged:
         out_cache = dict(cache, k_pool=new_k, v_pool=new_v)
     else:
         out_cache = {"k": new_k, "v": new_v}

@@ -165,6 +165,25 @@ def gather_blocks(pool: jax.Array, table: jax.Array) -> jax.Array:
     return g.reshape(b, mb * pool.shape[1], *pool.shape[2:])
 
 
+def _block_offsets(table: jax.Array, positions: jax.Array,
+                   k: int, bs: int):
+    """``(block_id [B, K], offset [B, K])`` for K consecutive positions
+    per slot.  Positions BEYOND the table row route to block 0 (the
+    trash sink) instead of gather-clamping to the last column: a
+    clamped write would land in the row's LAST listed block at a
+    wrapped offset — which for a full-length sequence is a LIVE block
+    — whereas parked/inactive slots (chunked prefill holds a slot
+    mid-prompt while decode keeps dispatching) legitimately emit
+    out-of-range junk positions that must go nowhere."""
+    mb = table.shape[1]
+    pos = positions[:, None] + jnp.arange(k)[None, :]        # [B, K]
+    col = pos // bs
+    bidx = jnp.take_along_axis(
+        table, jnp.minimum(col, mb - 1), axis=1)             # [B, K]
+    bidx = jnp.where(col < mb, bidx, 0)
+    return bidx, pos % bs
+
+
 def scatter_tokens(
     pool: jax.Array,        # [NB, bs, KV, D]
     table: jax.Array,       # [B, MB]
@@ -174,9 +193,68 @@ def scatter_tokens(
     """Write K consecutive tokens per slot into their blocks."""
     bs = pool.shape[1]
     b, k = kv.shape[:2]
-    pos = positions[:, None] + jnp.arange(k)[None, :]        # [B, K]
-    bidx = jnp.take_along_axis(table, pos // bs, axis=1)     # [B, K]
-    off = pos % bs
+    bidx, off = _block_offsets(table, positions, k, bs)
     return pool.at[bidx.reshape(-1), off.reshape(-1)].set(
         kv.reshape(b * k, *kv.shape[2:])
+    )
+
+
+# ------------------------------------------------------- int8 KV pools
+def kv_budget_multiplier(ref_dtype, head_dim: int) -> float:
+    """How many int8 blocks fit in the HBM of one ``ref_dtype`` block:
+    codes cost ``D`` bytes per (token, head) vector plus a
+    ``KV_SCALE_DTYPE`` scale — ``D * itemsize(ref) / (D +
+    itemsize(scale))``.  bf16 at D=64 -> 1.94x, D=128 -> 1.97x; the
+    engine multiplies an HBM-denominated ``cache_blocks`` budget by
+    this, which is what doubles the continuous batch at fixed HBM."""
+    from dlrover_tpu.models.quantize import KV_SCALE_DTYPE
+
+    ref = int(head_dim) * jnp.dtype(ref_dtype).itemsize
+    quant = int(head_dim) + jnp.dtype(KV_SCALE_DTYPE).itemsize
+    return ref / quant
+
+
+def scatter_tokens_q(
+    pool: jax.Array,        # [NB, bs, KV, D] int8 codes
+    scale_pool: jax.Array,  # [NB, bs, KV] per-vector scales
+    table: jax.Array,       # [B, MB]
+    kv: jax.Array,          # [B, K, KV, D] new fp entries
+    positions: jax.Array,   # [B]
+):
+    """Quantize-and-write K consecutive tokens per slot: codes into the
+    int8 pool, per-(token, head) scales into the block-shaped scale
+    pool (same index math, so a write is always self-consistent)."""
+    from dlrover_tpu.models.quantize import quantize_kv_int8
+
+    bs = pool.shape[1]
+    b, k = kv.shape[:2]
+    q, scale = quantize_kv_int8(kv)
+    bidx, off = _block_offsets(table, positions, k, bs)
+    flat_b, flat_o = bidx.reshape(-1), off.reshape(-1)
+    return (
+        pool.at[flat_b, flat_o].set(q.reshape(b * k, *q.shape[2:])),
+        scale_pool.at[flat_b, flat_o].set(
+            scale.reshape(b * k, *scale.shape[2:])),
+    )
+
+
+def gather_blocks_q(
+    pool: jax.Array,        # [NB, bs, KV, D] int8 codes
+    scale_pool: jax.Array,  # [NB, bs, KV]
+    table: jax.Array,       # [B, MB]
+    dtype,
+) -> jax.Array:
+    """Dense ``[B, MB*bs, KV, D]`` dequantized view of int8 pools — the
+    dequant fuses into the consuming attention reads, so the pool
+    streams from HBM at int8 width (the whole point: KV budget is what
+    caps the continuous batch)."""
+    from dlrover_tpu.models.quantize import dequantize_kv_int8
+
+    b, mb = table.shape
+    g = jnp.take(pool, table, axis=0)          # [B, MB, bs, KV, D]
+    s = jnp.take(scale_pool, table, axis=0)    # [B, MB, bs, KV]
+    return dequantize_kv_int8(
+        g.reshape(b, mb * pool.shape[1], *pool.shape[2:]),
+        s.reshape(b, mb * pool.shape[1], *s.shape[3:]),
+        dtype,
     )

@@ -247,10 +247,13 @@ def shard_serving_state(
     )
 
     kv_spec = P(None, None, "tp", None)  # [.., .., KV, D]
+    scale_spec = P(None, None, "tp")     # [NB, bs, KV] int8 KV scales
     sharded_cache = {}
     for key, val in cache.items():
         if key in ("k", "v", "k_pool", "v_pool"):
             sharded_cache[key] = [put(x, kv_spec) for x in val]
+        elif key in ("k_scale", "v_scale"):
+            sharded_cache[key] = [put(x, scale_spec) for x in val]
         else:
             sharded_cache[key] = put(val, P())
     return out, sharded_cache

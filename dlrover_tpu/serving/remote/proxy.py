@@ -120,6 +120,7 @@ class RemoteReplicaHandle:
         self._stats_tokens = -1
         self._stats_seq_seen = 0
         self.stale_stats_dropped = 0
+        self._engine_metrics: Optional[Dict[str, float]] = None
         self._last_frame = time.monotonic()
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
@@ -197,6 +198,16 @@ class RemoteReplicaHandle:
                     self._slots_free = int(frame.get("slots_free", 0))
                     self._blocks_free = float(
                         frame.get("blocks_free", 0.0))
+                    em = frame.get("engine_metrics")
+                    if isinstance(em, dict):
+                        # raw-speed introspection (spec accept ratio,
+                        # int8 KV pool, chunked-prefill seconds) from
+                        # engines that report it; absent on FakeEngine
+                        # workers and older senders
+                        self._engine_metrics = {
+                            str(k): float(v) for k, v in em.items()
+                            if isinstance(v, (int, float))
+                        }
             elif kind in (FrameKind.SUBMITTED, FrameKind.ERROR):
                 self._submit_replies[int(frame["rid"])] = frame
                 self._submit_cv.notify_all()
@@ -355,6 +366,18 @@ class RemoteReplicaHandle:
     def blocks_free(self) -> float:
         with self._lock:
             return 0.0 if self._dead is not None else self._blocks_free
+
+    def engine_metrics(self) -> Optional[Dict[str, float]]:
+        """Latest engine introspection dict from STATS, or None when
+        the worker's engine doesn't report one (FakeEngine).  A dead
+        replica reports None like slots_free/blocks_free report zero:
+        the fleet gauges must not keep aggregating a corpse's cached
+        numbers while its handle awaits the reap."""
+        with self._lock:
+            if self._dead is not None:
+                return None
+            em = self._engine_metrics
+            return dict(em) if em else None
 
     def blocks_needed(self, prompt_len: int,
                       max_new_tokens: int) -> Optional[float]:

@@ -446,6 +446,15 @@ class WorkerServer:
                 generated_tokens=int(
                     getattr(eng, "generated_tokens", 0)),
             )
+            # raw-speed engine introspection (spec accept ratio, int8
+            # KV pool size, chunked-prefill seconds) rides STATS so the
+            # router renders remote fleets like local ones; receivers
+            # ignore unknown keys, so old proxies stay compatible
+            em = getattr(eng, "engine_metrics", None)
+            if em is not None:
+                self._last_stats_payload["engine_metrics"] = {
+                    k: float(v) for k, v in em().items()
+                }
         # seq is assigned at SEND time (never stored in the cached
         # payload): a cached liveness resend carries stale numbers
         # under a fresh ordinal, same last-send-wins semantics as
@@ -476,6 +485,9 @@ def _build_llama_engine(args) -> object:
     return InferenceEngineAdapter(InferenceEngine(
         cfg, variables, max_slots=args.slots, chunk=4, paged=True,
         block_size=args.block_size, seed=args.seed,
+        kv_dtype=args.kv_dtype if args.kv_dtype != "bf16" else None,
+        prefill_chunk=args.prefill_chunk,
+        speculative_k=args.speculative_k,
     ))
 
 
@@ -494,6 +506,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--block-size", type=int, default=4)
     p.add_argument("--blocks", type=int, default=10_000)
     p.add_argument("--max-len", type=int, default=4096)
+    p.add_argument("--kv-dtype", choices=("bf16", "int8"),
+                   default="bf16",
+                   help="llama engine KV pool storage: int8 = "
+                        "per-block-scale quantized pools (~2x the "
+                        "block budget at the same HBM)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="llama engine: prefill long prompts this many "
+                        "tokens per step, interleaved with decode "
+                        "(bounds the batch's inter-token gap to one "
+                        "chunk; 0 = whole-bucket prefill)")
+    p.add_argument("--speculative-k", type=int, default=0,
+                   help="llama engine: prompt-lookup speculative "
+                        "decode, committing up to K tokens per "
+                        "verify dispatch (0 disables)")
     p.add_argument("--step-delay", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stats-interval", type=float,

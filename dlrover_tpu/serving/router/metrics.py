@@ -88,6 +88,12 @@ class RouterMetrics:
         # probationary replicas awaiting their replacement), written by
         # the autoscaler's debt sweep
         self.capacity_debt = 0.0
+        # raw-speed engine aggregates, written by the router's
+        # engine-metrics sweep each step (replicas whose engines report
+        # the introspection dict — local adapters and llama workers)
+        self.spec_accept_ratio = 0.0
+        self.kv_quant_blocks = 0.0
+        self.prefill_chunk_seconds = 0.0
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -159,6 +165,24 @@ class RouterMetrics:
         replicas report theirs via the worker.decode span)."""
         self.decode_step_hist.observe(seconds, trace_id=trace_id)
 
+    def observe_engine_metrics(self, dicts) -> None:
+        """Fold per-replica engine introspection dicts into the fleet
+        aggregates: accept ratio averages over reporting replicas (a
+        fleet-health fraction), the int8 pool size sums (fleet KV
+        capacity), chunk seconds sum (a counter across engines).
+        Recomputed from scratch every sweep — when the reporting
+        replicas leave the fleet the gauges must fall to zero, not
+        freeze at the dead fleet's values."""
+        dicts = [d for d in dicts if d]
+        ratios = [d["spec_accept_ratio"] for d in dicts
+                  if "spec_accept_ratio" in d]
+        self.spec_accept_ratio = (
+            sum(ratios) / len(ratios) if ratios else 0.0)
+        self.kv_quant_blocks = sum(
+            d.get("kv_quant_blocks", 0.0) for d in dicts)
+        self.prefill_chunk_seconds = sum(
+            d.get("prefill_chunk_seconds", 0.0) for d in dicts)
+
     def observe_tokens(self, n: int, now: Optional[float] = None) -> None:
         self.generated_tokens += int(n)
         self._tokens_window.observe(float(n), now)
@@ -203,6 +227,9 @@ class RouterMetrics:
             "serving_replica_probation": self.replica_probation,
             "serving_brownout_stage": self.brownout_stage,
             "serving_capacity_debt": self.capacity_debt,
+            "serving_spec_accept_ratio": self.spec_accept_ratio,
+            "serving_kv_quant_blocks": self.kv_quant_blocks,
+            "serving_prefill_chunk_seconds": self.prefill_chunk_seconds,
         }
 
     def render_histograms(self) -> str:

@@ -133,31 +133,35 @@ class InferenceEngineAdapter:
         """Withdraw a request from the engine, freeing its decode slot
         and (paged engines) its KV blocks immediately — the local twin
         of the remote worker's CANCEL handler, so in-process and remote
-        replicas reclaim capacity identically.  Covers all three places
-        the request can be: the engine admission queue, a live slot, or
-        already finished (a no-op — the withdrawal still "delivered").
-        Always returns True: local delivery cannot fail."""
-        eng = self.engine
+        replicas reclaim capacity identically.  Covers all the places
+        the request can be: the engine admission queue, a live slot
+        (decoding OR mid-chunked-prefill — the engine reclaims a
+        half-prefilled slot identically), or already finished (a
+        no-op — the withdrawal still "delivered").  Always returns
+        True: local delivery cannot fail."""
         self._stream_pos.pop(erid, None)
-        for i, req in enumerate(eng._queue):
-            if req.rid == erid:
-                del eng._queue[i]
-                return True
-        for s, req in enumerate(eng._slot_req):
-            if req is not None and req.rid == erid:
-                eng._slot_req[s] = None
-                if getattr(eng, "paged", False) \
-                        and eng._slot_blocks[s] is not None:
-                    # blocks back to the pool NOW — slot reclamation is
-                    # the whole point of cancelling mid-generation; the
-                    # table row resets to the trash block so the dead
-                    # slot stops writing KV over reallocated blocks
-                    eng._blockmgr.free_sequence(eng._slot_blocks[s])
-                    eng._slot_blocks[s] = None
-                    eng._table_np[s, :] = 0
-                    eng._table_dirty = True
-                return True
-        return True
+        return self.engine.cancel(erid)
+
+    def engine_metrics(self) -> Dict[str, float]:
+        """Raw-speed engine introspection for the router's metric
+        sweep (unprefixed keys; RouterMetrics owns the ``serving_*``
+        names).  Remote replicas report the same dict on their STATS
+        frames, so local and remote fleets render identically."""
+        eng, st = self.engine, self.engine.stats
+        out = {
+            "tokens_per_forward": st.tokens_per_forward,
+            "kv_quant_blocks": float(
+                getattr(eng, "kv_quant_blocks", 0)),
+            "prefill_chunk_seconds": st.prefill_chunk_seconds,
+            "prefill_calls": float(st.prefill_calls),
+            "prefill_admissions": float(st.prefill_admissions),
+        }
+        if st.spec_proposed:
+            # only replicas actually speculating report a ratio — a
+            # spec-disabled engine's structural 0.0 would dilute the
+            # fleet's speculation-health mean toward zero
+            out["spec_accept_ratio"] = st.spec_accept_ratio
+        return out
 
     def slots_free(self) -> int:
         eng = self.engine
@@ -277,6 +281,17 @@ class ReplicaHandle:
         block-size default)."""
         fn = getattr(self.engine, "blocks_needed", None)
         return None if fn is None else fn(prompt_len, max_new_tokens)
+
+    def engine_metrics(self) -> Optional[Dict[str, float]]:
+        """Raw-speed engine introspection (spec accept ratio, int8 KV
+        pool size, chunked-prefill seconds) when the engine reports it
+        — the router's metric sweep aggregates these across the fleet.
+        None for engines without the surface (FakeEngine)."""
+        fn = getattr(self.engine, "engine_metrics", None)
+        if fn is None:
+            return None
+        em = fn()
+        return em if em else None
 
     @property
     def schedulable(self) -> bool:

@@ -427,6 +427,15 @@ class ServingRouter:
                 replica_probation=self.manager.probation_count(now),
                 now=now,
             )
+            # raw-speed engine aggregates (spec accept ratio, int8 KV
+            # pool size, chunked-prefill seconds): plain attribute
+            # reads — local adapters read host-side stats, remote
+            # proxies return the dict cached off their last STATS
+            # frame — so this stays lock-discipline-clean
+            self.metrics.observe_engine_metrics([
+                h.engine_metrics()
+                for h in self.manager.replicas.values()
+            ])
         # autoscale OUTSIDE the step lock: a Brain-backed policy's
         # serving_plan is a synchronous control-plane RPC (30s default
         # timeout), and executing a ScalePlan spawns nodes/processes —

@@ -85,6 +85,28 @@ METRIC_HELP: Dict[str, str] = {
         "probationary replicas whose replacement node has been "
         "launched but has not joined yet — each retires exactly once"
     ),
+    # -- raw-speed engine aggregates (RouterMetrics, fed by the ------
+    # -- router's per-step engine_metrics sweep over replicas)
+    "serving_spec_accept_ratio": (
+        "speculative-decode draft acceptance: accepted draft tokens "
+        "over proposed, averaged across replicas whose engines report "
+        "it — the live health signal behind tokens-per-forward (1.0 "
+        "would mean every draft committed; the governor backs "
+        "speculation off below its floor)"
+    ),
+    "serving_kv_quant_blocks": (
+        "KV cache blocks held in int8-quantized pools across the "
+        "fleet (0 = native-dtype pools) — at the same HBM an int8 "
+        "pool holds ~2x the blocks, which is the continuous-batch "
+        "capacity the placement ledger schedules on"
+    ),
+    "serving_prefill_chunk_seconds": (
+        "cumulative wall seconds spent in bounded chunked-prefill "
+        "dispatches across the fleet — the budget that keeps one "
+        "long prompt from stalling every slot's token cadence "
+        "(compare with serving_decode_step_seconds to verify the "
+        "stall bound)"
+    ),
     "serving_rpc_retries_total": (
         "control-plane RPC retries under the typed backoff policy "
         "(common/retry) — a rising value under a steady fleet says "

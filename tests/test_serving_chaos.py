@@ -378,6 +378,65 @@ def test_adapter_cancel_frees_paged_engine_blocks():
     assert done and done[0].rid == rid3
 
 
+def test_router_cancel_mid_chunked_prefill_frees_blocks():
+    """PR 5 reclamation extended to HALF-PREFILLED slots, through the
+    full router cancel machinery: a long prompt admitted into a
+    chunked-prefill paged engine is cancelled while its real_len
+    cursor is mid-prompt — the router sweep aborts it, the engine
+    frees the slot AND the lifetime block allocation, and the books
+    balance for the traffic that follows."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+    from dlrover_tpu.serving.engine import InferenceEngine
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        InferenceEngineAdapter,
+        ServingRouter,
+    )
+
+    cfg = LlamaConfig.tiny(max_seq_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    eng = InferenceEngine(cfg, variables, max_slots=2, chunk=4,
+                          paged=True, block_size=8, prefill_chunk=16,
+                          seed=0)
+    router = ServingRouter(
+        gateway=RequestGateway(max_pending=8),
+        scheduler=ContinuousBatchScheduler(block_size=8),
+    )
+    router.join_replica("chunked", InferenceEngineAdapter(eng))
+    total = eng._blockmgr.num_blocks - 1  # minus the trash sink
+    long_prompt = np.arange(64, dtype=np.int32) % cfg.vocab_size
+    req = router.submit(long_prompt, 8)
+    # step until the engine is provably MID-prefill (cursor interior)
+    for _ in range(6):
+        router.step()
+        slot = next((s for s, r in enumerate(eng._slot_req)
+                     if r is not None), None)
+        if slot is not None and eng._prefilling[slot] \
+                and 0 < int(eng._prefill_pos[slot]) < 64:
+            break
+    assert slot is not None and eng._prefilling[slot]
+    assert req.cancel() is True
+    router.step()  # the sweep acts on the withdrawal
+    assert req.state == ServingRequestState.CANCELLED
+    assert eng._slot_req[slot] is None
+    assert not eng._prefilling[slot]
+    assert eng._blockmgr.available_blocks == total, (
+        "router cancel mid-prefill must free the lifetime blocks"
+    )
+    assert router.gateway.cancelled == 1
+    # the slot serves fresh traffic afterwards, books still balanced
+    req2 = router.submit(np.arange(12, dtype=np.int32), 4)
+    router.run_until_idle()
+    assert len(req2.output) == 4
+    assert eng._blockmgr.available_blocks == total
+
+
 def test_cancel_vs_failover_race_no_resurrection():
     """A failover racing a cancel must not resurrect the request:
     requeue_front of an already-terminal request is a no-op."""
