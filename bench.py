@@ -945,6 +945,27 @@ def main() -> None:
             "native pool at the same HBM vs the 1.9x bar; see PERF.md",
             file=sys.stderr,
         )
+    if result.get("paged_kernel_ok") is False:
+        regressions.append("paged_kernel")
+        print(
+            "BENCH REGRESSION: paged_kernel_ok=false — fused paged-"
+            "attention kernel parity "
+            f"(ok={result.get('paged_kernel_parity_ok')}) or the "
+            "attention_impl=auto pick "
+            f"({result.get('serving_attention_impl_auto')}) violated "
+            "the never-slower contract; see PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("kv4_ok") is False:
+        regressions.append("kv4")
+        print(
+            "BENCH REGRESSION: kv4_ok=false — int4 paged KV budget "
+            f"{result.get('kv_budget4_x')}x (bar 3.5x) or greedy "
+            f"agreement {result.get('kv4_greedy_agreement')} vs the "
+            "bf16 twin (bar 0.9) on the fitted chain model; see "
+            "PERF.md",
+            file=sys.stderr,
+        )
     if result.get("ckpt_pause_ok") is False:
         regressions.append("ckpt_pause")
         print(

@@ -294,6 +294,34 @@ def run_chunked_config() -> dict:
 
     stall_chunked, decode_ms = worst_gap(prefill_chunk)
     stall_unchunked, _ = worst_gap(0)
+
+    # SAME-STEP BATCHED prefill: two long prompts admitted together
+    # must reach their first tokens in the SAME number of engine
+    # steps (their chunks ride one batched verify_step dispatch per
+    # step) — round-robin one-chunk-per-step would make the second
+    # TTFT ~2x the first in step terms.  Steps, not wall: the
+    # deserialization claim is structural and this rig's wall clock
+    # is too noisy to show a 2x cleanly.
+    eng = InferenceEngine(
+        cfg, variables, max_slots=4, chunk=chunk, temperature=1.0,
+        top_k=50, max_len=max_len, prefill_chunk=prefill_chunk,
+        seed=0,
+    )
+    long2 = np.stack([long_prompt,
+                      np.roll(long_prompt, 7)]).astype(np.int32)
+    rids = [eng.add_request(p, 4) for p in long2]
+    ttft_steps = {}
+    for step_n in range(1, 4 * (long_len // prefill_chunk + 2)):
+        finished = eng.step()
+        for r in list(eng._slot_req) + list(finished):
+            if r is not None and r.rid in rids and r.output \
+                    and r.rid not in ttft_steps:
+                ttft_steps[r.rid] = step_n
+        if len(ttft_steps) == len(rids):
+            break
+    eng.run()
+    first_s = ttft_steps.get(rids[0], 0)
+    second_s = ttft_steps.get(rids[1], 0)
     return {
         # worst inter-token gap while the max-length prompt prefills
         "prefill_stall_p99_ms": round(stall_chunked, 3),
@@ -302,15 +330,19 @@ def run_chunked_config() -> dict:
         "prefill_chunk_tokens": prefill_chunk,
         # the acceptance bound: the gap stays within 2 decode chunks
         "prefill_stall_ok": bool(stall_chunked <= 2.0 * decode_ms),
+        "prefill_batch_ttft_steps_first": first_s,
+        "prefill_batch_ttft_steps_second": second_s,
+        "prefill_batch_ttft_ratio": round(
+            second_s / first_s, 3) if first_s else 0.0,
     }
 
 
-def run_int8kv_config() -> dict:
-    """int8 paged KV: throughput + block budget at the same HBM.  The
-    budget claim is structural (kv_budget_x = how many int8 blocks fit
-    in one native block's bytes; bar >= 1.9), the throughput numbers
-    keep the quantized gather/scatter's cost honest next to the bf16
-    paged engine."""
+def _paged_throughput_probe(tag: str, kv_dtype) -> tuple:
+    """ONE quantized-KV throughput rig (engine build, warmup, best-of-3
+    wall, decode-step probe) shared by the int8kv and int4kv modes —
+    the timing methodology must not fork between kv dtypes or their
+    numbers silently measure different things.  Returns (metrics dict,
+    engine) so each mode can add its dtype-specific gates."""
     import jax
     import numpy as np
 
@@ -324,36 +356,251 @@ def run_int8kv_config() -> dict:
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, cfg.vocab_size,
                           (n_req, prompt_len)).astype(np.int32)
+    eng = InferenceEngine(
+        cfg, variables, max_slots=8, chunk=32, temperature=1.0,
+        top_k=50, max_len=prompt_len + gen_len, paged=True,
+        kv_dtype=kv_dtype, seed=0,
+    )
+    for i in range(min(2, n_req)):
+        eng.add_request(prompts[i], gen_len)
+    eng.run()  # warmup/compile
+    best_wall = None
+    for _ in range(3):
+        eng.stats.generated_tokens = 0
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            eng.add_request(prompts[i], gen_len)
+        eng.run()
+        wall = time.perf_counter() - t0
+        best_wall = wall if best_wall is None else min(best_wall, wall)
+    out = {f"serving_tok_s_{tag}": round(
+        n_req * gen_len / best_wall, 1)}
+    out.update(_decode_step_probe(eng, tag))
+    return out, eng
 
+
+def run_int8kv_config() -> dict:
+    """int8 paged KV: throughput + block budget at the same HBM.  The
+    budget claim is structural (kv_budget_x = how many int8 blocks fit
+    in one native block's bytes; bar >= 1.9), the throughput numbers
+    keep the quantized gather/scatter's cost honest next to the bf16
+    paged engine."""
     out = {}
     for tag, kv_dtype in (("paged_bf16", None), ("paged_int8", "int8")):
-        eng = InferenceEngine(
-            cfg, variables, max_slots=8, chunk=32, temperature=1.0,
-            top_k=50, max_len=prompt_len + gen_len, paged=True,
-            kv_dtype=kv_dtype, seed=0,
-        )
-        for i in range(min(2, n_req)):
-            eng.add_request(prompts[i], gen_len)
-        eng.run()  # warmup/compile
-        best_wall = None
-        for _ in range(3):
-            eng.stats.generated_tokens = 0
-            t0 = time.perf_counter()
-            for i in range(n_req):
-                eng.add_request(prompts[i], gen_len)
-            eng.run()
-            wall = time.perf_counter() - t0
-            best_wall = wall if best_wall is None \
-                else min(best_wall, wall)
-        out[f"serving_tok_s_{tag}"] = round(
-            n_req * gen_len / best_wall, 1)
-        out.update(_decode_step_probe(eng, tag))
+        probe_out, eng = _paged_throughput_probe(tag, kv_dtype)
+        out.update(probe_out)
         if kv_dtype == "int8":
             out["kv_budget_x"] = round(eng.kv_budget_x, 3)
             out["serving_kv_quant_blocks"] = eng.kv_quant_blocks
     # structural gate: int8 blocks per native block's HBM (>= 1.9x
     # doubles-ish the continuous batch the placement ledger can admit)
     out["kv_budget_ok"] = bool(out.get("kv_budget_x", 0.0) >= 1.9)
+    return out
+
+
+def run_pallas_config() -> dict:
+    """The fused paged-attention kernel vs the XLA fused gather, at
+    the serving engine's real pool geometry — the evidence behind
+    ``attention_impl="auto"`` and the ``paged_kernel_ok`` gate.
+
+    Two halves, both honest about hardware:
+
+    - PARITY (every backend): kernel output vs the gather reference
+      for bf16, int8 and packed int4 pools — on CPU the kernel runs in
+      Pallas interpret mode, so a numerics regression is caught in the
+      same process that cannot measure performance;
+    - TIMINGS (TPU only): best-of-3 per impl per kv dtype via
+      ``measure_paged_attention`` on the engine's own pools (the
+      quantized rows are where the kernel's in-place code-width reads
+      beat the gather's materialize-at-bf16-width), plus the engine's
+      own build-time auto-pick.  The gate holds ``auto`` to its
+      contract: the resolved impl is the measured argmin (or the
+      always-available gather path when no measurement exists)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaModel
+    from dlrover_tpu.models.quantize import (
+        quantize_kv_int4,
+        quantize_kv_int8,
+    )
+    from dlrover_tpu.ops.pallas.paged_attention import (
+        gather_reference,
+        measure_paged_attention,
+        paged_decode_attention,
+        resolve_attention_impl,
+    )
+    from dlrover_tpu.serving.engine import InferenceEngine
+
+    cfg, prompt_len, gen_len, _ = _engine_cfg()
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    model = LlamaModel(cfg)
+    probe = jax.numpy.zeros((1, 8), jax.numpy.int32)
+    variables = model.init(jax.random.PRNGKey(0), probe)
+    eng = InferenceEngine(
+        cfg, variables, max_slots=8, chunk=8, temperature=0.0,
+        max_len=prompt_len + gen_len, paged=True, seed=0,
+    )
+    out = {"serving_attention_impl_auto": eng.attention_impl}
+    if eng.attention_impl_us:
+        out["serving_paged_auto_xla_us"] = round(
+            eng.attention_impl_us["xla"], 1)
+        out["serving_paged_auto_pallas_us"] = round(
+            eng.attention_impl_us["pallas"], 1)
+
+    # representative operands off the engine's own pool geometry
+    rng = np.random.RandomState(0)
+    d = cfg.head_dim_
+    nb = eng._blockmgr.num_blocks
+    mb = eng._max_blocks
+    bsz = eng.block_size
+    B = eng.max_slots
+    q = jnp.asarray(rng.randn(B, cfg.num_heads, d).astype(np.float32))
+    kf = jnp.asarray(
+        rng.randn(nb, bsz, cfg.num_kv_heads, d).astype(np.float32)
+        * 0.3)
+    vf = jnp.asarray(
+        rng.randn(nb, bsz, cfg.num_kv_heads, d).astype(np.float32)
+        * 0.3)
+    table = jnp.asarray(
+        (np.arange(B * mb) % max(1, nb - 1) + 1)
+        .reshape(B, mb).astype(np.int32))
+    lengths = jnp.asarray(
+        np.linspace(1, mb * bsz, B).astype(np.int32))
+
+    pools = {"bf16": (kf.astype(cfg.dtype), vf.astype(cfg.dtype),
+                      None, None)}
+    k8, ks8 = quantize_kv_int8(kf)
+    v8, vs8 = quantize_kv_int8(vf)
+    pools["int8"] = (k8, v8, ks8, vs8)
+    k4, ks4 = quantize_kv_int4(kf)
+    v4, vs4 = quantize_kv_int4(vf)
+    pools["int4"] = (k4, v4, ks4, vs4)
+
+    parity_ok = True
+    for tag, (kp, vp, ks, vs) in pools.items():
+        kern = np.asarray(paged_decode_attention(
+            q, kp, vp, table, lengths, k_scale=ks, v_scale=vs,
+            interpret=not on_tpu))
+        ref = np.asarray(gather_reference(
+            q, kp, vp, table, lengths, ks, vs))
+        err = float(np.max(np.abs(kern - ref)))
+        out[f"paged_kernel_parity_err_{tag}"] = round(err, 8)
+        scale = float(np.max(np.abs(ref))) or 1.0
+        parity_ok = parity_ok and err <= 2e-2 * scale
+        if on_tpu:
+            t = measure_paged_attention(
+                q, kp, vp, table, lengths, ks, vs, trials=5)
+            out[f"serving_paged_gather_us_{tag}"] = round(
+                t["xla"] * 1e6, 1)
+            out[f"serving_paged_kernel_us_{tag}"] = round(
+                t["pallas"] * 1e6, 1)
+    out["paged_kernel_parity_ok"] = bool(parity_ok)
+    # the auto contract: with measurements, auto picked the argmin;
+    # without (CPU), auto fell back to the gather path
+    timings = eng.attention_impl_us
+    out["paged_kernel_ok"] = bool(
+        parity_ok
+        and eng.attention_impl
+        == resolve_attention_impl("auto", timings))
+    return out
+
+
+def _fit_chain_model(steps: int = 300):
+    """A tiny D=64 model briefly FIT on a deterministic next-token
+    chain (x' = (3x + 7) mod vocab) — the greedy-agreement instrument
+    for quantized KV.  Random-init weights have near-uniform logits
+    whose argmax flips under ANY per-element noise above ~1e-2, so
+    int4's honest ~10% KV reconstruction error (the 4-bit floor on
+    Gaussian data) would read as catastrophic when the real claim
+    (KVQuant) is about TRAINED models with real margins; a fitted
+    chain model has those margins, so agreement measures what int4
+    actually breaks.  ~30s on CPU, seconds on TPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.llama import LlamaConfig, LlamaModel
+
+    vocab = 64
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=2, num_kv_heads=2, max_seq_len=128,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    model = LlamaModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    def chain(x0, n):
+        outp = [int(x0)]
+        for _ in range(n - 1):
+            outp.append((outp[-1] * 3 + 7) % vocab)
+        return np.asarray(outp, np.int32)
+
+    def batch(rng, n=32, length=33):
+        return jnp.asarray(np.stack(
+            [chain(rng.randint(0, vocab), length) for _ in range(n)]))
+
+    def loss_fn(p, toks):
+        logits = model.apply(p, toks[:, :-1])
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(
+            lp, toks[:, 1:, None], -1))
+
+    @jax.jit
+    def sgd(p, toks):
+        loss, g = jax.value_and_grad(loss_fn)(p, toks)
+        return jax.tree_util.tree_map(
+            lambda w, gw: w - 0.5 * gw, p, g), loss
+
+    rng = np.random.RandomState(0)
+    loss = None
+    for _ in range(steps):
+        params, loss = sgd(params, batch(rng))
+    return cfg, params, chain, float(loss)
+
+
+def run_int4kv_config() -> dict:
+    """int4 packed KV: block budget, throughput, and greedy agreement
+    — the ``kv4_ok`` gate.  Budget + throughput come from the bench
+    geometry (structural + honest-throughput, random weights are
+    fine); AGREEMENT comes from the briefly-fitted chain model
+    (:func:`_fit_chain_model` explains why random-init margins would
+    measure the wrong thing), greedy bf16 twin vs int4 on held-out
+    chain prompts, bar 0.9."""
+    import numpy as np
+
+    from dlrover_tpu.serving.engine import InferenceEngine
+
+    out, eng = _paged_throughput_probe("paged_int4", "int4")
+    out["kv_budget4_x"] = round(eng.kv_budget_x, 3)
+    out["serving_kv_int4_blocks"] = eng.kv4_blocks
+
+    # greedy agreement on the fitted instrument
+    fit_cfg, fit_params, chain, fit_loss = _fit_chain_model()
+    out["kv4_fit_loss"] = round(fit_loss, 5)
+    frng = np.random.RandomState(7)
+    fprompts = [chain(frng.randint(0, 64), 24) for _ in range(6)]
+
+    def gen(kv_dtype):
+        e = InferenceEngine(
+            fit_cfg, fit_params, max_slots=4, chunk=4,
+            temperature=0.0, paged=True, block_size=16,
+            kv_dtype=kv_dtype, max_len=64, seed=0)
+        rids = [e.add_request(p, 16) for p in fprompts]
+        res = e.run()
+        return [res[r] for r in rids]
+
+    agree = float(np.mean([
+        np.mean(a == b) for a, b in zip(gen(None), gen("int4"))
+    ]))
+    out["kv4_greedy_agreement"] = round(agree, 4)
+    # structural budget bar: engine multiplier >= 3.5 (bf16 models:
+    # 3.76x @ D=64, 3.88x @ D=128; fp32 CPU fallback is higher still)
+    out["kv4_ok"] = bool(
+        out["kv_budget4_x"] >= 3.5 and agree >= 0.9)
     return out
 
 
@@ -415,7 +662,7 @@ def run_trace_config() -> dict:
 def main() -> dict:
     out = {}
     for mode in ("bf16", "int8", "bf16_slots1", "spec", "trace",
-                 "chunked", "int8kv"):
+                 "chunked", "int8kv", "int4kv", "pallas"):
         proc = subprocess.run(
             [sys.executable, __file__, mode],
             capture_output=True, text=True, timeout=1800,
@@ -464,6 +711,10 @@ if __name__ == "__main__":
             print(json.dumps(run_chunked_config()))
         elif sys.argv[1] == "int8kv":
             print(json.dumps(run_int8kv_config()))
+        elif sys.argv[1] == "int4kv":
+            print(json.dumps(run_int4kv_config()))
+        elif sys.argv[1] == "pallas":
+            print(json.dumps(run_pallas_config()))
         else:
             print(json.dumps(run_config(sys.argv[1])))
     else:

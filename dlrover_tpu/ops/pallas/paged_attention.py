@@ -1,50 +1,79 @@
-"""Fused paged-attention decode kernel (TPU Pallas) — EXPERIMENTAL.
+"""Fused paged-attention decode kernel (TPU Pallas).
 
 The seam named in PERF.md: the XLA path materializes each slot's dense
-cache view (``gather_blocks``) before attention, a second full pass
-over the cache bytes that costs ~19% of the decode step at ~1.4k
-context.  This kernel reads K/V blocks IN PLACE from the pools — the
-per-block pool row is selected by a scalar-prefetched block table in
-the BlockSpec index map, so the only cache traffic is the one
-streaming read attention itself needs.
+cache view (``gather_blocks``) before attention — a second full pass
+over the cache bytes, and for quantized pools a pass at FULL bf16
+width (the gather dequantizes first, so XLA pays code-width bytes once
+to read and bf16 width again to re-stream the materialized view).
+This kernel reads K/V blocks IN PLACE from the pools and folds the
+dequant INSIDE, so an int8 pool streams at 1 byte/element and a packed
+int4 pool at 0.5 — the dense bf16 view never exists.
 
-STATUS (measured on v5e, batch 8, h2048-class heads, ~1.5k rows):
-numerically exact (parity tests) but NOT yet faster than the XLA
-gather path, so serving does not use it.  At the engine's 16-row
-blocks the grid is (B x ~92) tiny steps and per-grid-step latency
-dominates (472 us vs 86 us); at 128-row pages it reaches ~470 GB/s
-(128 us) but XLA's fused gather+attention still wins — the fusion
-already streams near peak, and this kernel's per-kv-head small dots
-under-fill the MXU.  The win would need multi-page compute blocks
-with manual double-buffered DMA (the design the in-tree TPU paged
-kernel uses); kept here with parity tests as the starting point.
+CONTRACT (supersedes the old EXPERIMENTAL/STATUS header): the serving
+engine selects this kernel through ``attention_impl`` —
+
+- ``"pallas"`` forces it, ``"xla"`` forces the fused-gather path, and
+  ``"auto"`` (the default) runs a one-shot measured comparison on the
+  engine's real pool geometry at build time and picks the faster one,
+  so auto can never select a slower impl (bench-gated as
+  ``paged_kernel_ok``; on non-TPU backends auto resolves to ``"xla"``
+  because the interpret-mode kernel is a correctness tool, not a perf
+  candidate);
+- numerically the kernel matches the gather path to float tolerance
+  for bf16, int8 and packed int4 pools (parity tests run in
+  ``interpret=True`` mode on CPU in tier-1, so a numerics regression
+  cannot hide behind missing hardware).
+
+Design — the two fixes the old STATUS header prescribed, plus the new
+leverage:
+
+1. **Multi-page compute blocks with double-buffered manual DMA.**  The
+   old kernel's grid was ``(B, MB)`` — one 16-row page per grid step,
+   so per-grid-step latency dominated (472 us vs the gather's 86 us)
+   and the per-kv-head dots under-filled the MXU.  Now the grid is
+   ``(B,)`` and each program streams its slot's pages in GROUPS of
+   ``pages_per_block`` (default 8 -> 128 key rows per compute block at
+   the engine's 16-row pages): the pools stay in HBM
+   (``memory_space=ANY``) and the kernel issues per-page async copies
+   into a 2-slot VMEM scratch, starting group ``g+1``'s DMAs before
+   computing group ``g`` — the double-buffer pattern, with the page
+   list coming from the scalar-prefetched block table.
+2. **Dequantization folded inside.**  Quantized pools ship their
+   block-shaped scale pools; codes are dequantized in VMEM right after
+   the copy lands (int4 codes unpack split-half: byte ``j`` holds code
+   ``j`` low-nibble and ``j + D/2`` high-nibble, so unpack is a
+   concatenate, not an interleave).  HBM traffic is code-width; the
+   XLA gather path cannot avoid materializing the dequantized rows.
+3. Online softmax (flash-style m/l/acc carry in VMEM scratch) over
+   ``[KV*G, pages*bs]`` score tiles per group; GQA queries regroup to
+   ``[KV, G, D]`` and each kv head's scores come from one dot against
+   its slice of the group.
 
 Scope: single-query decode (the serving engine's K=1 step — its hot
-path; speculative verify keeps the gather path).  Grid ``(B, MB)``:
-for each slot the kernel streams that slot's blocks once ([bs, KV, D]
-pool rows, every kv head together — exactly the pool's natural
-layout), runs an online-softmax (flash-style m/l/acc carry in VMEM
-scratch) over ``[KV*G, bs]`` score tiles, and masks rows past the
-slot's visible length.  GQA: queries regroup to ``[KV, G, D]`` and
-each kv head's ``[G, bs]`` scores come from one small dot against its
-slice of the block.
+path; speculative verify and prefill keep the gather path).
 
 Layout contract (matches serving/paged.py):
   q        [B, H, D]        current-token queries
-  k_pool   [NB, bs, KV, D]
-  v_pool   [NB, bs, KV, D]
+  k_pool   [NB, bs, KV, Dc] Dc = D (bf16/int8) or D//2 (packed int4)
+  v_pool   [NB, bs, KV, Dc]
+  k_scale  [NB, bs, KV]     per-(token, head) scales (quantized pools)
+  v_scale  [NB, bs, KV]
   table    [B, MB] int32    per-slot block lists (0 = trash block)
   lengths  [B]    int32     visible keys per slot (= position + 1)
 Returns [B, H, D] fp32.
 
-Blocks past the slot's length still stream (static grid) but their
+Pages past the slot's length still stream (static grid) but their
 scores are masked to -inf; with MB sized from the engine's max_len
-this is the same worst-case the dense layout always pays.
+this is the same worst-case the dense layout always pays.  The table
+is padded to a multiple of ``pages_per_block`` with trash-block zeros
+— padded pages read harmless junk that the length mask discards.
 """
 
 from __future__ import annotations
 
 import functools
+import time
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,121 +83,311 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _unpack4_f32(x: jax.Array) -> jax.Array:
+    """Packed int4 ``[..., Dc] -> f32 codes [..., 2*Dc]`` (split-half
+    layout; the int32 shifts sign-extend each nibble).  Kept local so
+    the kernel has no cross-module imports to trace."""
+    p = x.astype(jnp.int32)
+    lo = (p << 28) >> 28
+    hi = (p << 24) >> 28
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+
+
 def _decode_kernel(
     table_ref, lengths_ref,          # scalar-prefetched (SMEM)
-    q_ref, k_ref, v_ref,             # [1,KV,G,D], [1,bs,KV,D], [1,bs,KV,D]
-    o_ref,                           # [1,KV,G,D]
-    m_scr, l_scr, acc_scr,           # [KV*G], [KV*G], [KV*G, D]
-    *, block_size: int, num_blocks: int, kv_heads: int, group: int,
+    *args,
+    block_size: int, pages: int, num_groups: int,
+    kv_heads: int, group: int, head_dim: int,
+    quant: bool, packed: bool,
 ):
+    if quant:
+        (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+         kb, vb, ksb, vsb, m_scr, l_scr, acc_scr, sem, ssem) = args
+    else:
+        (q_ref, k_hbm, v_hbm, o_ref,
+         kb, vb, m_scr, l_scr, acc_scr, sem) = args
+        ks_hbm = vs_hbm = ksb = vsb = ssem = None
+
     b = pl.program_id(0)
-    j = pl.program_id(1)
+    bs, p_n = block_size, pages
+    rows = p_n * bs                   # key rows per compute group
 
-    @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
+    def _group_copies(g, slot):
+        """The per-page DMA descriptors for group ``g`` into buffer
+        ``slot`` — built identically at start() and wait() time (the
+        canonical Pallas double-buffer idiom)."""
+        copies = []
+        for j in range(p_n):          # static unroll: p_n DMAs in flight
+            page = table_ref[b, g * p_n + j]
+            copies.append(pltpu.make_async_copy(
+                k_hbm.at[page], kb.at[slot, j], sem.at[slot, j, 0]))
+            copies.append(pltpu.make_async_copy(
+                v_hbm.at[page], vb.at[slot, j], sem.at[slot, j, 1]))
+            if quant:
+                copies.append(pltpu.make_async_copy(
+                    ks_hbm.at[page], ksb.at[slot, j],
+                    ssem.at[slot, j, 0]))
+                copies.append(pltpu.make_async_copy(
+                    vs_hbm.at[page], vsb.at[slot, j],
+                    ssem.at[slot, j, 1]))
+        return copies
 
-    q = q_ref[0].astype(jnp.float32)                # [KV, G, D]
-    k = k_ref[0].astype(jnp.float32)                # [bs, KV, D]
-    v = v_ref[0].astype(jnp.float32)
-    d = q.shape[-1]
-    # per-kv-head scores: [KV, G, bs] via KV small dots (static loop)
-    scores = jnp.concatenate(
-        [
-            jax.lax.dot_general(
-                q[kvi], k[:, kvi], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            for kvi in range(kv_heads)
-        ],
-        axis=0,
-    ) / (d ** 0.5)                                  # [KV*G, bs]
-    key_pos = j * block_size + jax.lax.broadcasted_iota(
-        jnp.int32, scores.shape, 1
-    )
-    visible = key_pos < lengths_ref[b]
-    scores = jnp.where(visible, scores, _NEG_INF)
+    def start_group(g, slot):
+        for c in _group_copies(g, slot):
+            c.start()
 
-    m_prev = m_scr[...]                             # [KV*G]
-    m_new = jnp.maximum(m_prev, scores.max(axis=-1))
-    # guard the all-masked block: exp(-inf - -inf) must not NaN
-    alpha = jnp.where(m_new == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-    p = jnp.exp(scores - m_new[:, None])
-    p = jnp.where(visible, p, 0.0)
-    l_scr[...] = alpha * l_scr[...] + p.sum(axis=-1)
-    # weighted values: [KV*G, D] from KV dots [G, bs] @ [bs, D]
-    pv = jnp.concatenate(
-        [
-            jax.lax.dot_general(
-                p[kvi * group:(kvi + 1) * group], v[:, kvi],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            for kvi in range(kv_heads)
-        ],
-        axis=0,
-    )
-    acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
-    m_scr[...] = m_new
+    def wait_group(g, slot):
+        for c in _group_copies(g, slot):
+            c.wait()
 
-    @pl.when(j == num_blocks - 1)
-    def _finish():
-        denom = jnp.maximum(l_scr[...], 1e-30)
-        o_ref[0] = (acc_scr[...] / denom[:, None]).reshape(
-            kv_heads, group, d
-        ).astype(o_ref.dtype)
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+    start_group(0, 0)                 # warm-up: first group in flight
+    qf = q_ref[0].astype(jnp.float32)            # [KV, G, D]
+
+    def _dequant(raw, scale):
+        # raw [P, bs, KV, Dc] -> f32 [P, bs, KV, D]; the whole point:
+        # this runs on VMEM-resident codes AFTER the copy, so HBM only
+        # ever saw code-width bytes
+        if not quant:
+            return raw.astype(jnp.float32)
+        codes = _unpack4_f32(raw) if packed else raw.astype(jnp.float32)
+        return codes * scale.astype(jnp.float32)[..., None]
+
+    def body(g, _):
+        slot = jax.lax.rem(g, 2)
+
+        @pl.when(g + 1 < num_groups)
+        def _():                      # overlap: next group's DMA first
+            start_group(g + 1, jax.lax.rem(g + 1, 2))
+
+        wait_group(g, slot)
+        kf = _dequant(kb[slot], ksb[slot] if quant else None)
+        vf = _dequant(vb[slot], vsb[slot] if quant else None)
+        kf = kf.reshape(rows, kv_heads, head_dim)
+        vf = vf.reshape(rows, kv_heads, head_dim)
+        # per-kv-head scores: [KV*G, rows] via KV dots (static loop) —
+        # at rows = pages*bs the dot's N dim is 128+ and fills the MXU
+        scores = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    qf[kvi], kf[:, kvi], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for kvi in range(kv_heads)
+            ],
+            axis=0,
+        ) / (head_dim ** 0.5)
+        key_pos = g * rows + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        visible = key_pos < lengths_ref[b]
+        scores = jnp.where(visible, scores, _NEG_INF)
+
+        m_prev = m_scr[...]                      # [KV*G]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+        # guard the all-masked group: exp(-inf - -inf) must not NaN
+        alpha = jnp.where(m_new == _NEG_INF, 0.0,
+                          jnp.exp(m_prev - m_new))
+        p = jnp.exp(scores - m_new[:, None])
+        p = jnp.where(visible, p, 0.0)
+        l_scr[...] = alpha * l_scr[...] + p.sum(axis=-1)
+        pv = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    p[kvi * group:(kvi + 1) * group], vf[:, kvi],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for kvi in range(kv_heads)
+            ],
+            axis=0,
+        )
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
+        m_scr[...] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, num_groups, body, 0)
+    denom = jnp.maximum(l_scr[...], 1e-30)
+    o_ref[0] = (acc_scr[...] / denom[:, None]).reshape(
+        kv_heads, group, head_dim).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("pages_per_block", "interpret"))
 def paged_decode_attention(
     q: jax.Array,        # [B, H, D]
-    k_pool: jax.Array,   # [NB, bs, KV, D]
+    k_pool: jax.Array,   # [NB, bs, KV, Dc]
     v_pool: jax.Array,
     table: jax.Array,    # [B, MB] int32
     lengths: jax.Array,  # [B] int32
     *,
+    k_scale: Optional[jax.Array] = None,   # [NB, bs, KV] (quant pools)
+    v_scale: Optional[jax.Array] = None,
+    pages_per_block: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
     b, h, d = q.shape
-    nb, bs, kv, d2 = k_pool.shape
-    assert d == d2, (q.shape, k_pool.shape)
+    nb, bs, kv, dc = k_pool.shape
+    quant = k_scale is not None
+    packed = quant and dc != d
+    if packed:
+        assert dc * 2 == d, (q.shape, k_pool.shape)
+    else:
+        assert dc == d, (q.shape, k_pool.shape)
     assert h % kv == 0, (h, kv)
     g = h // kv
     mb = table.shape[1]
+    # pad the table to a multiple of the page-group size with zeros —
+    # the trash block, whose junk the length mask discards
+    p_n = max(1, min(int(pages_per_block), mb))
+    pad = (-mb) % p_n
+    if pad:
+        table = jnp.concatenate(
+            [table, jnp.zeros((b, pad), table.dtype)], axis=1)
+    num_groups = (mb + pad) // p_n
     qg = q.reshape(b, kv, g, d)
 
-    def q_map(bi, ji, table_ref, lengths_ref):
+    def q_map(bi, table_ref, lengths_ref):
         return (bi, 0, 0, 0)
 
-    def kv_map(bi, ji, table_ref, lengths_ref):
-        # the paged read: pool row straight from the prefetched table
-        return (table_ref[bi, ji], 0, 0, 0)
-
     kernel = functools.partial(
-        _decode_kernel, block_size=bs, num_blocks=mb,
-        kv_heads=kv, group=g,
+        _decode_kernel, block_size=bs, pages=p_n,
+        num_groups=num_groups, kv_heads=kv, group=g, head_dim=d,
+        quant=quant, packed=packed,
     )
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [pl.BlockSpec((1, kv, g, d), q_map), any_spec, any_spec]
+    operands = [qg, k_pool, v_pool]
+    scratch = [
+        pltpu.VMEM((2, p_n, bs, kv, dc), k_pool.dtype),
+        pltpu.VMEM((2, p_n, bs, kv, dc), v_pool.dtype),
+    ]
+    if quant:
+        in_specs += [any_spec, any_spec]
+        operands += [k_scale, v_scale]
+        scratch += [
+            pltpu.VMEM((2, p_n, bs, kv), k_scale.dtype),
+            pltpu.VMEM((2, p_n, bs, kv), v_scale.dtype),
+        ]
+    scratch += [
+        pltpu.VMEM((kv * g,), jnp.float32),
+        pltpu.VMEM((kv * g,), jnp.float32),
+        pltpu.VMEM((kv * g, d), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, p_n, 2)),
+    ]
+    if quant:
+        scratch.append(pltpu.SemaphoreType.DMA((2, p_n, 2)))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b, mb),
-            in_specs=[
-                pl.BlockSpec((1, kv, g, d), q_map),
-                pl.BlockSpec((1, bs, kv, d), kv_map),
-                pl.BlockSpec((1, bs, kv, d), kv_map),
-            ],
+            grid=(b,),
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, kv, g, d), q_map),
-            scratch_shapes=[
-                pltpu.VMEM((kv * g,), jnp.float32),
-                pltpu.VMEM((kv * g,), jnp.float32),
-                pltpu.VMEM((kv * g, d), jnp.float32),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, g, d), jnp.float32),
         interpret=interpret,
-    )(table.astype(jnp.int32), lengths.astype(jnp.int32),
-      qg, k_pool, v_pool)
+    )(table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
     return out.reshape(b, h, d)
+
+
+# ----------------------------------------------------- the XLA twin
+@functools.partial(jax.jit, static_argnames=())
+def gather_reference(
+    q: jax.Array,        # [B, H, D]
+    k_pool: jax.Array,   # [NB, bs, KV, Dc]
+    v_pool: jax.Array,
+    table: jax.Array,    # [B, MB]
+    lengths: jax.Array,  # [B]
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The fused-gather path the engine's ``attention_impl="xla"``
+    runs, as a standalone function: materialize the dense (dequantized)
+    per-slot view, then masked GQA attention — both the parity oracle
+    for the kernel and the ``"xla"`` side of the auto-pick
+    measurement.  Mirrors ``serving/model.py`` exactly: ``gather_blocks
+    [_q|_q4]`` then the unexpanded-cache einsum pair."""
+    from dlrover_tpu.serving.paged import (
+        gather_blocks,
+        gather_blocks_q,
+        gather_blocks_q4,
+    )
+
+    b, h, d = q.shape
+    kv = k_pool.shape[2]
+    g = h // kv
+    if k_scale is None:
+        ck = gather_blocks(k_pool, table).astype(jnp.float32)
+        cv = gather_blocks(v_pool, table).astype(jnp.float32)
+    elif k_pool.shape[-1] != d:
+        ck = gather_blocks_q4(k_pool, k_scale, table, jnp.float32)
+        cv = gather_blocks_q4(v_pool, v_scale, table, jnp.float32)
+    else:
+        ck = gather_blocks_q(k_pool, k_scale, table, jnp.float32)
+        cv = gather_blocks_q(v_pool, v_scale, table, jnp.float32)
+    qg = q.astype(jnp.float32).reshape(b, kv, g, d)
+    scores = jnp.einsum(
+        "bkgd,blkd->bkgl", qg, ck,
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(float(d))
+    key_pos = jnp.arange(ck.shape[1])
+    mask = key_pos[None, :] < lengths[:, None]          # [B, L]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgl,blkd->bkgd", probs, cv,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, h, d)
+
+
+# ------------------------------------------------- measured auto-pick
+def measure_paged_attention(
+    q, k_pool, v_pool, table, lengths,
+    k_scale=None, v_scale=None, trials: int = 3,
+    interpret: bool = False,
+) -> Dict[str, float]:
+    """Best-of-``trials`` wall seconds for each impl on THESE operands
+    — the one-shot measurement ``attention_impl="auto"`` runs at
+    engine build (and the bench's crossover probe).  Both sides
+    compile first; the measured runs sync via block_until_ready."""
+    impls = {
+        "xla": lambda: gather_reference(
+            q, k_pool, v_pool, table, lengths, k_scale, v_scale),
+        "pallas": lambda: paged_decode_attention(
+            q, k_pool, v_pool, table, lengths,
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret),
+    }
+    out: Dict[str, float] = {}
+    for name, fn in impls.items():
+        jax.block_until_ready(fn())          # compile outside the clock
+        best = None
+        for _ in range(max(1, trials)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        out[name] = best
+    return out
+
+
+def resolve_attention_impl(
+    requested: str, timings: Optional[Dict[str, float]],
+) -> str:
+    """The auto-pick decision, factored pure so the ``never picks a
+    slower impl`` contract is directly testable: an explicit request is
+    honored; ``auto`` with measurements picks the faster impl; ``auto``
+    without measurements (non-TPU backend, or measurement skipped)
+    falls back to the always-available gather path."""
+    if requested in ("xla", "pallas"):
+        return requested
+    if requested != "auto":
+        raise ValueError(
+            f"attention_impl={requested!r} not supported: use "
+            "'auto', 'xla' or 'pallas'")
+    if not timings:
+        return "xla"
+    return min(("xla", "pallas"), key=lambda k: timings[k])

@@ -62,6 +62,11 @@ class EngineStats:
     #                               only with BOTH counters exposed
     prefill_chunks: int = 0       # chunked-prefill dispatches
     #                               (a subset of prefill_calls)
+    prefill_chunk_slots: int = 0  # slot-chunks advanced — with
+    #                               same-step batching > chunks when
+    #                               several long prompts prefill
+    #                               together (the TTFT-deserialization
+    #                               win is slots/chunks)
     prefill_chunk_seconds: float = 0.0  # wall seconds in chunk
     #                               dispatches (the stall-bound budget)
     finished_requests: int = 0
@@ -124,6 +129,7 @@ class InferenceEngine:
         block_size: int = 16,
         kv_dtype: Optional[str] = None,
         prefill_chunk: int = 0,
+        attention_impl: str = "auto",
         mesh: Optional[Any] = None,
         seed: int = 0,
     ):
@@ -157,7 +163,22 @@ class InferenceEngine:
         HBM-denominated ``cache_blocks`` budget is multiplied by
         ``kv_budget_x`` (~2x for bf16 models), which is what doubles
         the continuous batch the placement ledger can admit at fixed
-        HBM."""
+        HBM.  ``kv_dtype="int4"`` packs two codes per byte (split-half
+        nibbles, even head_dim required) for ``kv_budget_x`` ~3.7x —
+        coarser rounding, bounded by the drift tests and the bench's
+        ``kv4_ok`` greedy-agreement gate.
+
+        ``attention_impl`` selects the paged decode attention read:
+        ``"xla"`` = fused gather (materializes the dequantized dense
+        view), ``"pallas"`` = the fused paged kernel (streams blocks
+        in place at code width, dequant folded inside), ``"auto"``
+        (default) = a one-shot measured comparison on this engine's
+        real pool geometry at build, picking the faster — so auto can
+        never select a slower impl.  Non-TPU backends resolve auto to
+        ``"xla"`` (the interpret-mode kernel is a correctness tool);
+        an explicit ``"pallas"`` is honored anywhere (interpret mode
+        off-TPU).  The resolved choice is ``self.attention_impl``,
+        the measurement (when taken) ``self.attention_impl_us``."""
         self.cfg = cfg
         self.int8 = int8
         self.chunk = int(chunk)
@@ -227,34 +248,38 @@ class InferenceEngine:
         self.paged = bool(paged)
         if kv_dtype in (None, "bf16"):
             self.kv_dtype = None
-        elif kv_dtype == "int8":
+        elif kv_dtype in ("int8", "int4"):
             if not self.paged:
                 raise ValueError(
-                    "kv_dtype='int8' is a paged-pool feature "
+                    f"kv_dtype={kv_dtype!r} is a paged-pool feature "
                     "(per-block-scale quantized K/V pools); pass "
                     "paged=True")
-            self.kv_dtype = "int8"
+            if kv_dtype == "int4" and cfg.head_dim_ % 2:
+                raise ValueError(
+                    "kv_dtype='int4' packs two codes per byte and "
+                    f"needs an even head_dim (got {cfg.head_dim_})")
+            self.kv_dtype = kv_dtype
         else:
             raise ValueError(
                 f"kv_dtype={kv_dtype!r} not supported: use None/'bf16' "
-                "(native) or 'int8'")
+                "(native), 'int8' or 'int4'")
         self.kv_budget_x = 1.0
         if self.paged:
             # block-pool cache (serving/paged.py): per-sequence memory
             # scales with ACTUAL lengths, concurrency is bounded by the
             # pool (HBM budget) instead of slots x max_len reservations,
             # and common prompt prefixes share blocks
-            from dlrover_tpu.serving.paged import BlockManager
+            from dlrover_tpu.serving.paged import (
+                BlockManager,
+                kv_budget_multiplier,
+            )
 
             self.block_size = int(block_size)
             self._max_blocks = -(-cache_len // self.block_size)
-            if self.kv_dtype == "int8":
-                from dlrover_tpu.serving.paged import (
-                    kv_budget_multiplier,
-                )
-
-                self.kv_budget_x = kv_budget_multiplier(
-                    cfg.dtype, cfg.head_dim_)
+            # THE budget function — the same source the regression
+            # test pins the router ledger to (serving/paged.py)
+            self.kv_budget_x = kv_budget_multiplier(
+                cfg.dtype, cfg.head_dim_, self.kv_dtype)
             # +1: block 0 is the trash sink (never allocated), so the
             # default must still let every slot hold a full-length
             # sequence.  An EXPLICIT cache_blocks is an HBM budget
@@ -273,9 +298,13 @@ class InferenceEngine:
             self._table_np = np.zeros(
                 (self.max_slots, self._max_blocks), np.int32
             )
+            # packed int4 pools halve the code dim (two codes/byte,
+            # split-half nibble layout — models/quantize.pack_int4)
+            code_dim = (cfg.head_dim_ // 2 if self.kv_dtype == "int4"
+                        else cfg.head_dim_)
             kvd = (n_blocks, self.block_size,
-                   cfg.num_kv_heads, cfg.head_dim_)
-            if self.kv_dtype == "int8":
+                   cfg.num_kv_heads, code_dim)
+            if self.kv_dtype in ("int8", "int4"):
                 from dlrover_tpu.models.quantize import KV_SCALE_DTYPE
 
                 self._cache = {
@@ -321,12 +350,12 @@ class InferenceEngine:
         # request whose prompt is still being written chunk-by-chunk
         # (excluded from decode); _prefill_pos is the real_len cursor —
         # how many prompt tokens are already in the cache — surviving
-        # across dispatches; _prefill_rr round-robins ONE chunk per
-        # step across prefilling slots so the stall bound holds even
-        # with several long prompts in flight
+        # across dispatches.  All prefilling slots advance one chunk
+        # per step in ONE batched dispatch (_advance_prefill), so the
+        # stall bound holds AND concurrent long prompts don't
+        # serialize each other's TTFT
         self._prefilling = np.zeros(self.max_slots, bool)
         self._prefill_pos = np.zeros(self.max_slots, np.int32)
-        self._prefill_rr = 0
         # per-slot incrementally-filled context (prompt + committed
         # tokens) for the speculative draft lookup — rebuilding it from
         # the output list every round would be O(n^2) per request.
@@ -342,7 +371,74 @@ class InferenceEngine:
         self._finished: List[Request] = []
         self._next_rid = 0
         self.stats = EngineStats()
+        # paged decode attention: gather (xla) vs fused kernel
+        # (pallas), resolved ONCE at build — "auto" measures both on
+        # this engine's real pool geometry and picks the faster
+        # (resolve_attention_impl is the pure, tested decision)
+        self.attention_impl_requested = str(attention_impl)
+        self._kernel_interpret = jax.default_backend() in ("cpu", "gpu")
+        self.attention_impl, self.attention_impl_us = \
+            self._resolve_attention()
         self._build_programs()
+
+    # ----------------------------------------------- attention impl
+    def _resolve_attention(self):
+        from dlrover_tpu.ops.pallas.paged_attention import (
+            resolve_attention_impl,
+        )
+
+        req = self.attention_impl_requested
+        if req not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"attention_impl={req!r} not supported: use 'auto', "
+                "'xla' or 'pallas'")
+        if not self.paged:
+            if req == "pallas":
+                raise ValueError(
+                    "attention_impl='pallas' reads paged block pools "
+                    "in place; pass paged=True")
+            return "xla", None
+        if req in ("xla", "pallas"):
+            return req, None
+        if self._kernel_interpret:
+            # no TPU: the interpret-mode kernel is a parity harness,
+            # not a perf candidate — auto must not "measure" it
+            return "xla", None
+        timings = self._measure_attention()
+        # stored in MICROseconds to match the attribute name (the
+        # measurement itself is perf_counter seconds)
+        return resolve_attention_impl("auto", timings), {
+            k: v * 1e6 for k, v in timings.items()}
+
+    def _measure_attention(self):
+        """One-shot timing of both paged attention impls on THIS
+        engine's pools at worst-case context (every table column
+        live): the evidence behind the auto-pick, kept on the engine
+        (``attention_impl_us``) so the bench can print it."""
+        from dlrover_tpu.ops.pallas.paged_attention import (
+            measure_paged_attention,
+        )
+
+        cfg = self.cfg
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(
+            key, (self.max_slots, cfg.num_heads, cfg.head_dim_),
+            jnp.float32).astype(cfg.dtype)
+        nb = self._blockmgr.num_blocks
+        mb = self._max_blocks
+        table = jnp.asarray(
+            (np.arange(self.max_slots * mb) % max(1, nb - 1) + 1)
+            .reshape(self.max_slots, mb).astype(np.int32))
+        lengths = jnp.full(
+            (self.max_slots,), min(self._cache_len, mb * self.block_size),
+            jnp.int32)
+        kw = {}
+        if self.kv_dtype in ("int8", "int4"):
+            kw = dict(k_scale=self._cache["k_scale"][0],
+                      v_scale=self._cache["v_scale"][0])
+        return measure_paged_attention(
+            q, self._cache["k_pool"][0], self._cache["v_pool"][0],
+            table, lengths, interpret=self._kernel_interpret, **kw)
 
     # ------------------------------------------------------------ jit
     def _build_programs(self) -> None:
@@ -350,11 +446,17 @@ class InferenceEngine:
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         n_steps = self.chunk
 
+        impl = self.attention_impl
+        kernel_interpret = self._kernel_interpret
+
         @functools.partial(jax.jit, donate_argnums=(1,))
         def chunk_fn(params, cache, tokens, positions, active, rng):
             def step(carry, _):
                 toks, pos, cache, key = carry
-                logits, cache = decode_step(params, cfg, cache, toks, pos)
+                logits, cache = decode_step(
+                    params, cfg, cache, toks, pos,
+                    attention_impl=impl,
+                    kernel_interpret=kernel_interpret)
                 key, sub = jax.random.split(key)
                 nxt = select_token(logits, sub, temperature, top_k, top_p)
                 toks = jnp.where(active, nxt.astype(toks.dtype), toks)
@@ -368,7 +470,8 @@ class InferenceEngine:
             return out.T, tokens, positions, cache, rng
 
         paged = self.paged
-        kv_quant = self.kv_dtype == "int8"
+        kv_quant = self.kv_dtype in ("int8", "int4")
+        kv_packed4 = self.kv_dtype == "int4"
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def insert_fn(params, cache, tokens, real_len, slots, rng):
@@ -378,19 +481,24 @@ class InferenceEngine:
             lp = tokens.shape[1]
             logits, ks, vs = prefill(params, cfg, tokens, real_len)
             if paged and kv_quant:
-                from dlrover_tpu.serving.paged import scatter_tokens_q
+                from dlrover_tpu.serving.paged import (
+                    scatter_tokens_q,
+                    scatter_tokens_q4,
+                )
 
+                scatter_q = (scatter_tokens_q4 if kv_packed4
+                             else scatter_tokens_q)
                 rows = jnp.take(cache["table"], slots, axis=0)  # [G, MB]
                 zero = jnp.zeros(slots.shape, jnp.int32)
                 kp, ksc, vp, vsc = [], [], [], []
                 for p, sp, k in zip(cache["k_pool"], cache["k_scale"],
                                     ks):
-                    np_, ns_ = scatter_tokens_q(p, sp, rows, k, zero)
+                    np_, ns_ = scatter_q(p, sp, rows, k, zero)
                     kp.append(np_)
                     ksc.append(ns_)
                 for p, sp, v in zip(cache["v_pool"], cache["v_scale"],
                                     vs):
-                    np_, ns_ = scatter_tokens_q(p, sp, rows, v, zero)
+                    np_, ns_ = scatter_q(p, sp, rows, v, zero)
                     vp.append(np_)
                     vsc.append(ns_)
                 new_cache = dict(cache, k_pool=kp, k_scale=ksc,
@@ -627,35 +735,45 @@ class InferenceEngine:
         return True
 
     def _advance_prefill(self) -> None:
-        """One bounded prompt chunk for ONE prefilling slot (round-
-        robin) — the per-step prefill budget that keeps every other
-        slot's inter-token gap bounded by a single chunk dispatch.
-        When the cursor reaches the prompt end, sample the first token
-        and hand the slot to decode."""
+        """One bounded prompt chunk for EVERY prefilling slot, batched
+        into a single ``verify_step`` dispatch (the ``slots=`` subset
+        machinery): rows are independent, so N concurrent long prompts
+        advance together instead of round-robining one per step —
+        which serialized their TTFTs N-fold while still paying one
+        dispatch of latency each step.  The per-step budget that
+        bounds every decoding slot's inter-token gap stays ONE chunk
+        dispatch (jit caches one program per live group size, bounded
+        by max_slots).  When a cursor reaches its prompt end, sample
+        that row's first token and hand the slot to decode."""
         slots = [s for s in range(self.max_slots) if self._prefilling[s]]
         if not slots:
             return
-        s = slots[self._prefill_rr % len(slots)]
-        self._prefill_rr += 1
-        req = self._slot_req[s]
-        assert req is not None
-        start = int(self._prefill_pos[s])
         c = self.prefill_chunk
-        end = min(start + c, req.prompt.size)
-        chunk = np.zeros((1, c), np.int32)
-        chunk[0, : end - start] = req.prompt[start:end]
-        # index (within the chunk) of the prompt's final token: only
-        # meaningful on the final chunk; clamped junk otherwise (the
-        # sampled token is discarded for non-final chunks)
-        last_idx = max(0, min(end, req.prompt.size) - 1 - start)
+        g = len(slots)
+        chunk = np.zeros((g, c), np.int32)
+        starts = np.zeros(g, np.int32)
+        last_idx = np.zeros(g, np.int32)
+        ends = np.zeros(g, np.int32)
+        for i, s in enumerate(slots):
+            req = self._slot_req[s]
+            assert req is not None
+            start = int(self._prefill_pos[s])
+            end = min(start + c, req.prompt.size)
+            chunk[i, : end - start] = req.prompt[start:end]
+            starts[i] = start
+            ends[i] = end
+            # index (within the chunk) of the prompt's final token:
+            # only meaningful on a row's final chunk; clamped junk
+            # otherwise (that row's sampled token is discarded)
+            last_idx[i] = max(0, min(end, req.prompt.size) - 1 - start)
         if self.paged and self._table_dirty:
             self._push_table()
         t0 = time.perf_counter()
-        self._cache, first, self._rng = self._prefill_chunk_fn(
+        self._cache, firsts, self._rng = self._prefill_chunk_fn(
             self.params, self._cache, jnp.asarray(chunk),
-            jnp.asarray([start], jnp.int32),
-            jnp.asarray([s], jnp.int32),
-            jnp.asarray([last_idx], jnp.int32),
+            jnp.asarray(starts),
+            jnp.asarray(slots, jnp.int32),
+            jnp.asarray(last_idx),
             self._rng,
         )
         dt = time.perf_counter() - t0
@@ -663,9 +781,15 @@ class InferenceEngine:
         self.stats.prefill_chunk_seconds += dt
         self.stats.prefill_calls += 1
         self.stats.prefill_chunks += 1
-        self._prefill_pos[s] = end
-        if end >= req.prompt.size:
-            first = int(np.asarray(first)[0])
+        self.stats.prefill_chunk_slots += g
+        firsts = np.asarray(firsts)
+        for i, s in enumerate(slots):
+            req = self._slot_req[s]
+            end = int(ends[i])
+            self._prefill_pos[s] = end
+            if end < req.prompt.size:
+                continue
+            first = int(firsts[i])
             self._prefilling[s] = False
             req.output.append(first)
             p = req.prompt.size
@@ -740,9 +864,20 @@ class InferenceEngine:
 
     @property
     def kv_quant_blocks(self) -> int:
-        """Blocks in the int8-quantized KV pool (0 when the pool is
-        native-dtype) — the ``serving_kv_quant_blocks`` gauge."""
-        if self.paged and self.kv_dtype == "int8":
+        """Blocks in a quantized (int8 OR int4) KV pool (0 when the
+        pool is native-dtype) — the ``serving_kv_quant_blocks``
+        gauge."""
+        if self.paged and self.kv_dtype in ("int8", "int4"):
+            return self._blockmgr.num_blocks
+        return 0
+
+    @property
+    def kv4_blocks(self) -> int:
+        """Blocks in a packed-int4 KV pool specifically — the
+        ``serving_kv_int4_blocks`` gauge (int4's ~3.7x budget is a
+        different capacity planning regime than int8's ~2x, so the
+        dashboard needs them apart)."""
+        if self.paged and self.kv_dtype == "int4":
             return self._blockmgr.num_blocks
         return 0
 

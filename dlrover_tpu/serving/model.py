@@ -161,6 +161,8 @@ def decode_step(
     cache: Dict[str, Any],         # {"k","v"}: per-layer LISTS of
     tokens: jax.Array,             #   [B, L, KV, D] buffers
     positions: jax.Array,          # [B] write position per slot
+    attention_impl: str = "xla",
+    kernel_interpret: bool = False,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """One decode step for all slots; returns (logits [B, V], cache).
 
@@ -170,10 +172,14 @@ def decode_step(
     invariant.  The layer loop stays python-unrolled and qkv / gate+up
     run as single fused matmuls — decode is launch/bandwidth-bound, so
     fewer, larger kernels over unsliced weights is the win (module
-    docstring).
+    docstring).  ``attention_impl="pallas"`` routes the paged-cache
+    attention read through the fused kernel (the K=1 single-query
+    path — exactly this function's case).
     """
     logits, cache = verify_step(params, cfg, cache, tokens[:, None],
-                                positions)
+                                positions,
+                                attention_impl=attention_impl,
+                                kernel_interpret=kernel_interpret)
     return logits[:, 0, :], cache
 
 
@@ -185,6 +191,8 @@ def verify_step(
     positions: jax.Array,          # [B] position of tokens[:, 0]
     slots: Optional[jax.Array] = None,
     logits_index: Optional[jax.Array] = None,
+    attention_impl: str = "xla",
+    kernel_interpret: bool = False,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """Speculative VERIFY: process K tokens per slot in one dispatch and
     return next-token logits at every position ([B, K, V], cache).
@@ -218,6 +226,15 @@ def verify_step(
     needs the prompt-final position's logits, and K-1 wasted
     vocab-width matmuls per chunk is exactly the kind of cost a
     bounded prefill chunk exists to avoid.
+
+    ``attention_impl="pallas"`` (paged caches, K=1, full batch only —
+    the decode hot path) replaces the gather-then-attend read with the
+    fused paged kernel (ops/pallas/paged_attention): blocks stream IN
+    PLACE from the pools with dequantization folded inside, so the
+    dense (bf16-width) view is never materialized.  Every other shape
+    (speculative verify, chunk prefill, slot subsets) keeps the gather
+    path; ``kernel_interpret`` runs the kernel in Pallas interpret
+    mode (the CPU parity harness).
     """
     dtype = cfg.dtype
     d = cfg.head_dim_
@@ -228,22 +245,40 @@ def verify_step(
     angles = rope_frequencies(d, cfg.max_seq_len, cfg.rope_theta)[
         pos_k]                                               # [B, K, d/2]
 
-    # paged cache ({"k_pool","v_pool","table"}, int8 pools add
-    # {"k_scale","v_scale"}) vs dense ({"k","v"}): same transformer
+    # paged cache ({"k_pool","v_pool","table"}, quantized pools add
+    # {"k_scale","v_scale"}; packed int4 pools are recognized by their
+    # half-width code dim) vs dense ({"k","v"}): same transformer
     # loop, different cache plumbing (serving/paged.py)
     paged = "table" in cache
     quant = "k_scale" in cache
+    packed4 = (
+        quant and cache["k_pool"][0].shape[-1] != d
+    )
+    use_kernel = (
+        paged and attention_impl == "pallas" and klen == 1
+        and slots is None and logits_index is None
+    )
     if paged:
         from dlrover_tpu.serving.paged import (
             gather_blocks,
             gather_blocks_q,
+            gather_blocks_q4,
             scatter_tokens,
             scatter_tokens_q,
+            scatter_tokens_q4,
         )
 
+        scatter_q = scatter_tokens_q4 if packed4 else scatter_tokens_q
+        gather_q = gather_blocks_q4 if packed4 else gather_blocks_q
         table = cache["table"]
         if slots is not None:
             table = jnp.take(table, slots, axis=0)           # [G, MB]
+    if use_kernel:
+        from dlrover_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        lengths = positions.astype(jnp.int32) + 1
 
     new_k, new_v = [], []
     new_ks, new_vs = [], []
@@ -253,15 +288,22 @@ def verify_step(
         q, k, v = _attn_proj(lp, h, cfg, dtype)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
+        ck = cv = None
         if paged and quant:
-            kp, ksc = scatter_tokens_q(
+            kp, ksc = scatter_q(
                 cache["k_pool"][i], cache["k_scale"][i], table,
                 k, positions)
-            vp, vsc = scatter_tokens_q(
+            vp, vsc = scatter_q(
                 cache["v_pool"][i], cache["v_scale"][i], table,
                 v, positions)
-            ck = gather_blocks_q(kp, ksc, table, dtype)
-            cv = gather_blocks_q(vp, vsc, table, dtype)
+            if use_kernel:
+                o = paged_decode_attention(
+                    q[:, 0], kp, vp, table, lengths,
+                    k_scale=ksc, v_scale=vsc,
+                    interpret=kernel_interpret)[:, None]
+            else:
+                ck = gather_q(kp, ksc, table, dtype)
+                cv = gather_q(vp, vsc, table, dtype)
             new_k.append(kp)
             new_v.append(vp)
             new_ks.append(ksc)
@@ -273,8 +315,13 @@ def verify_step(
             vp = scatter_tokens(cache["v_pool"][i], table,
                                 v.astype(cache["v_pool"][i].dtype),
                                 positions)
-            ck = gather_blocks(kp, table)
-            cv = gather_blocks(vp, table)
+            if use_kernel:
+                o = paged_decode_attention(
+                    q[:, 0], kp, vp, table, lengths,
+                    interpret=kernel_interpret)[:, None]
+            else:
+                ck = gather_blocks(kp, table)
+                cv = gather_blocks(vp, table)
             new_k.append(kp)
             new_v.append(vp)
         elif slots is not None:
@@ -294,8 +341,9 @@ def verify_step(
             cv = _write_cache(cache["v"][i], v, positions)
             new_k.append(ck)
             new_v.append(cv)
-        o = _attn_verify(q, ck, cv, positions, n_rep).astype(dtype)
-        o = o.reshape(b, klen, cfg.num_heads * d)
+        if not use_kernel:
+            o = _attn_verify(q, ck, cv, positions, n_rep)
+        o = o.astype(dtype).reshape(b, klen, cfg.num_heads * d)
         x = x + _mm(o, lp["wo"], dtype)
         h = _rmsnorm(x, lp["post_norm"], cfg.rms_norm_eps).astype(dtype)
         x = x + _mlp(lp, h, cfg, dtype)

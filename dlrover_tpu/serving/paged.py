@@ -199,19 +199,38 @@ def scatter_tokens(
     )
 
 
-# ------------------------------------------------------- int8 KV pools
-def kv_budget_multiplier(ref_dtype, head_dim: int) -> float:
-    """How many int8 blocks fit in the HBM of one ``ref_dtype`` block:
-    codes cost ``D`` bytes per (token, head) vector plus a
-    ``KV_SCALE_DTYPE`` scale — ``D * itemsize(ref) / (D +
-    itemsize(scale))``.  bf16 at D=64 -> 1.94x, D=128 -> 1.97x; the
-    engine multiplies an HBM-denominated ``cache_blocks`` budget by
-    this, which is what doubles the continuous batch at fixed HBM."""
+# -------------------------------------------------- quantized KV pools
+def kv_budget_multiplier(ref_dtype, head_dim: int,
+                         kv_dtype: str = "int8") -> float:
+    """THE single source of KV-budget math: how many ``kv_dtype``
+    blocks fit in the HBM of one ``ref_dtype`` block.  A quantized
+    (token, head) vector costs its code bytes (``D`` for int8, ``D/2``
+    for packed int4) plus one ``KV_SCALE_DTYPE`` scale —
+    ``D * itemsize(ref) / (code_bytes + itemsize(scale))``.
+
+    bf16 references: int8 -> 1.94x @ D=64 / 1.97x @ D=128; int4 ->
+    3.76x @ D=64 / 3.88x @ D=128 (the >= 3.5x acceptance bar).
+
+    Everything downstream derives from THIS function — the engine
+    multiplies its HBM-denominated ``cache_blocks`` budget by it
+    (``InferenceEngine.kv_budget_x``), ``alloc_sequence`` admits
+    against the multiplied pool, and the router's placement ledger
+    (``InferenceEngineAdapter.blocks_free`` locally, the worker's
+    HELLO/STATS ``blocks_free`` remotely) reads the same pool — so the
+    engine's admission and the router's placement can never disagree
+    on what a quantized pool holds (regression-tested in
+    tests/test_paged_kernel.py)."""
     from dlrover_tpu.models.quantize import KV_SCALE_DTYPE
 
+    if kv_dtype in (None, "bf16"):
+        return 1.0
+    code_bytes = {"int8": float(head_dim),
+                  "int4": head_dim / 2.0}.get(kv_dtype)
+    if code_bytes is None:
+        raise ValueError(f"kv_budget_multiplier: unknown kv_dtype "
+                         f"{kv_dtype!r}")
     ref = int(head_dim) * jnp.dtype(ref_dtype).itemsize
-    quant = int(head_dim) + jnp.dtype(KV_SCALE_DTYPE).itemsize
-    return ref / quant
+    return ref / (code_bytes + jnp.dtype(KV_SCALE_DTYPE).itemsize)
 
 
 def scatter_tokens_q(
@@ -254,6 +273,54 @@ def gather_blocks_q(
     g = jnp.take(pool, table, axis=0)          # [B, MB, bs, KV, D]
     s = jnp.take(scale_pool, table, axis=0)    # [B, MB, bs, KV]
     return dequantize_kv_int8(
+        g.reshape(b, mb * pool.shape[1], *pool.shape[2:]),
+        s.reshape(b, mb * pool.shape[1], *s.shape[3:]),
+        dtype,
+    )
+
+
+def scatter_tokens_q4(
+    pool: jax.Array,        # [NB, bs, KV, D//2] packed int4 codes
+    scale_pool: jax.Array,  # [NB, bs, KV] per-vector scales
+    table: jax.Array,       # [B, MB]
+    kv: jax.Array,          # [B, K, KV, D] new fp entries
+    positions: jax.Array,   # [B]
+):
+    """int4 twin of :func:`scatter_tokens_q`: quantize-pack-and-write K
+    consecutive tokens per slot (codes at half a byte per element,
+    per-(token, head) scales in the block-shaped scale pool — same
+    index math, so a write is always self-consistent)."""
+    from dlrover_tpu.models.quantize import quantize_kv_int4
+
+    bs = pool.shape[1]
+    b, k = kv.shape[:2]
+    q, scale = quantize_kv_int4(kv)
+    bidx, off = _block_offsets(table, positions, k, bs)
+    flat_b, flat_o = bidx.reshape(-1), off.reshape(-1)
+    return (
+        pool.at[flat_b, flat_o].set(q.reshape(b * k, *q.shape[2:])),
+        scale_pool.at[flat_b, flat_o].set(
+            scale.reshape(b * k, *scale.shape[2:])),
+    )
+
+
+def gather_blocks_q4(
+    pool: jax.Array,        # [NB, bs, KV, D//2] packed int4 codes
+    scale_pool: jax.Array,  # [NB, bs, KV]
+    table: jax.Array,       # [B, MB]
+    dtype,
+) -> jax.Array:
+    """Dense ``[B, MB*bs, KV, D]`` dequantized view of packed int4
+    pools — unpack + dequant fuse into the consuming attention reads,
+    so the pool streams from HBM at 0.5 bytes/element (the fused
+    Pallas kernel goes further and never materializes this view at
+    all; this is the XLA fallback path)."""
+    from dlrover_tpu.models.quantize import dequantize_kv_int4
+
+    b, mb = table.shape
+    g = jnp.take(pool, table, axis=0)          # [B, MB, bs, KV, D//2]
+    s = jnp.take(scale_pool, table, axis=0)    # [B, MB, bs, KV]
+    return dequantize_kv_int4(
         g.reshape(b, mb * pool.shape[1], *pool.shape[2:]),
         s.reshape(b, mb * pool.shape[1], *s.shape[3:]),
         dtype,

@@ -488,6 +488,7 @@ def _build_llama_engine(args) -> object:
         kv_dtype=args.kv_dtype if args.kv_dtype != "bf16" else None,
         prefill_chunk=args.prefill_chunk,
         speculative_k=args.speculative_k,
+        attention_impl=args.attention_impl,
     ))
 
 
@@ -506,11 +507,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--block-size", type=int, default=4)
     p.add_argument("--blocks", type=int, default=10_000)
     p.add_argument("--max-len", type=int, default=4096)
-    p.add_argument("--kv-dtype", choices=("bf16", "int8"),
+    p.add_argument("--kv-dtype", choices=("bf16", "int8", "int4"),
                    default="bf16",
                    help="llama engine KV pool storage: int8 = "
-                        "per-block-scale quantized pools (~2x the "
-                        "block budget at the same HBM)")
+                        "per-(token, head)-scale quantized pools "
+                        "(~2x the block budget at the same HBM), "
+                        "int4 = packed two-codes-per-byte pools "
+                        "(~3.7x budget; coarser rounding, bounded "
+                        "by the drift gates)")
+    p.add_argument("--attention-impl",
+                   choices=("auto", "xla", "pallas"), default="auto",
+                   help="llama engine paged decode attention: "
+                        "pallas = fused kernel reading (quantized) "
+                        "pools in place, xla = fused gather, auto = "
+                        "one-shot measured pick at engine build "
+                        "(never selects the slower impl).  Forcing "
+                        "pallas on a NON-TPU backend runs the kernel "
+                        "in interpret mode — a parity/debug harness "
+                        "whose multi-second steps can starve the "
+                        "fabric's SUBMIT-ack liveness window; auto "
+                        "refuses it off-TPU for exactly that reason")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="llama engine: prefill long prompts this many "
                         "tokens per step, interleaved with decode "

@@ -93,7 +93,13 @@ class RouterMetrics:
         # the introspection dict — local adapters and llama workers)
         self.spec_accept_ratio = 0.0
         self.kv_quant_blocks = 0.0
+        self.kv4_blocks = 0.0
         self.prefill_chunk_seconds = 0.0
+        self.paged_kernel_step_seconds = 0.0
+        # resolved paged-attention impl per reporting replica, counted
+        # into the labeled serving_attention_impl family (bounded
+        # vocabulary: "xla" | "pallas")
+        self.attention_impls: Dict[str, int] = {}
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -180,8 +186,19 @@ class RouterMetrics:
             sum(ratios) / len(ratios) if ratios else 0.0)
         self.kv_quant_blocks = sum(
             d.get("kv_quant_blocks", 0.0) for d in dicts)
+        self.kv4_blocks = sum(
+            d.get("kv4_blocks", 0.0) for d in dicts)
         self.prefill_chunk_seconds = sum(
             d.get("prefill_chunk_seconds", 0.0) for d in dicts)
+        self.paged_kernel_step_seconds = sum(
+            d.get("paged_kernel_step_seconds", 0.0) for d in dicts)
+        impls: Dict[str, int] = {}
+        for d in dicts:
+            if "attention_impl_pallas" in d:
+                key = ("pallas" if d["attention_impl_pallas"]
+                       else "xla")
+                impls[key] = impls.get(key, 0) + 1
+        self.attention_impls = impls
 
     def observe_tokens(self, n: int, now: Optional[float] = None) -> None:
         self.generated_tokens += int(n)
@@ -229,7 +246,10 @@ class RouterMetrics:
             "serving_capacity_debt": self.capacity_debt,
             "serving_spec_accept_ratio": self.spec_accept_ratio,
             "serving_kv_quant_blocks": self.kv_quant_blocks,
+            "serving_kv_int4_blocks": self.kv4_blocks,
             "serving_prefill_chunk_seconds": self.prefill_chunk_seconds,
+            "serving_paged_kernel_step_seconds":
+                self.paged_kernel_step_seconds,
         }
 
     def render_histograms(self) -> str:
@@ -240,3 +260,22 @@ class RouterMetrics:
             self.ttft_hist, self.queue_wait_hist,
             self.e2e_hist, self.decode_step_hist,
         ))
+
+    def render_labeled(self) -> str:
+        """Labeled gauge text for the /metrics scrape: replicas per
+        resolved paged-attention impl.  The ``impl`` vocabulary is
+        bounded ("xla" | "pallas" — DL010-declared in the registry);
+        both series render even at zero so a fleet-wide impl flip is a
+        visible crossover, not a disappearing line."""
+        from dlrover_tpu.utils.metric_registry import metric_help
+
+        lines = [
+            "# HELP serving_attention_impl "
+            + (metric_help("serving_attention_impl") or ""),
+            "# TYPE serving_attention_impl gauge",
+        ]
+        for impl in ("xla", "pallas"):
+            n = self.attention_impls.get(impl, 0)
+            lines.append(
+                f'serving_attention_impl{{impl="{impl}"}} {n}')
+        return "\n".join(lines) + "\n"

@@ -152,10 +152,24 @@ class InferenceEngineAdapter:
             "tokens_per_forward": st.tokens_per_forward,
             "kv_quant_blocks": float(
                 getattr(eng, "kv_quant_blocks", 0)),
+            "kv4_blocks": float(getattr(eng, "kv4_blocks", 0)),
             "prefill_chunk_seconds": st.prefill_chunk_seconds,
             "prefill_calls": float(st.prefill_calls),
             "prefill_admissions": float(st.prefill_admissions),
         }
+        if getattr(eng, "paged", False):
+            # resolved paged-attention impl (0=xla gather, 1=fused
+            # pallas kernel) + the kernel path's cumulative decode
+            # seconds — floats so the dict rides STATS frames as-is.
+            # Only PAGED engines report: a dense replica has no paged
+            # attention path at all, and counting it into the labeled
+            # serving_attention_impl{impl="xla"} series would hide
+            # the xla->pallas crossover the gauge exists to show
+            impl = getattr(eng, "attention_impl", "xla")
+            out["attention_impl_pallas"] = (
+                1.0 if impl == "pallas" else 0.0)
+            out["paged_kernel_step_seconds"] = (
+                st.decode_seconds if impl == "pallas" else 0.0)
         if st.spec_proposed:
             # only replicas actually speculating report a ratio — a
             # spec-disabled engine's structural 0.0 would dilute the

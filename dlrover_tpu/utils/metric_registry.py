@@ -107,6 +107,25 @@ METRIC_HELP: Dict[str, str] = {
         "(compare with serving_decode_step_seconds to verify the "
         "stall bound)"
     ),
+    "serving_attention_impl": (
+        "replicas per resolved paged decode-attention implementation, "
+        'labeled impl="xla|pallas" — "pallas" is the fused paged '
+        "kernel reading quantized pools in place, \"xla\" the fused-"
+        "gather fallback; attention_impl=auto measures both at engine "
+        "build and provably never picks the slower one"
+    ),
+    "serving_paged_kernel_step_seconds": (
+        "cumulative decode-step wall seconds on replicas whose "
+        "resolved attention impl is the fused Pallas paged kernel — "
+        "zero with a nonzero pallas impl count says the kernel fleet "
+        "is idle, not broken"
+    ),
+    "serving_kv_int4_blocks": (
+        "KV cache blocks held in packed-int4 pools across the fleet "
+        "(a subset of serving_kv_quant_blocks) — int4's ~3.7x budget "
+        "multiplier is a different capacity-planning regime than "
+        "int8's ~2x, so the dashboard needs the split"
+    ),
     "serving_rpc_retries_total": (
         "control-plane RPC retries under the typed backoff policy "
         "(common/retry) — a rising value under a steady fleet says "
@@ -440,6 +459,9 @@ NON_METRIC_SERVING_NAMES = frozenset({
 #: series per request and OOM every scraper that aggregates the fleet.
 METRIC_LABELS: Dict[str, tuple] = {
     "serving_worker_state": ("worker", "state"),
+    # resolved paged-attention impl: vocabulary is the closed
+    # {"xla", "pallas"} set (RouterMetrics.render_labeled)
+    "serving_attention_impl": ("impl",),
     "serving_slo_compliance": ("band", "window"),
     "serving_slo_burn_rate": ("band", "window"),
     "serving_slo_budget_remaining": ("band",),

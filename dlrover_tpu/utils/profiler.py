@@ -395,6 +395,7 @@ class MetricsExporter:
         ``serving_slo_*`` families."""
         self.add_source(router.metrics.metrics)
         self.add_text_source(router.metrics.render_histograms)
+        self.add_text_source(router.metrics.render_labeled)
         slo = getattr(router, "slo", None)
         if slo is not None:
             self.add_text_source(slo.render)

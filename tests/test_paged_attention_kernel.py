@@ -1,7 +1,9 @@
-"""Parity tests for the experimental Pallas paged-attention decode
-kernel (interpret mode on the CPU mesh; the module docstring records
-the measured TPU status — exact but not yet faster than the XLA
-gather path, so serving does not use it)."""
+"""Parity tests for the fused Pallas paged-attention decode kernel
+against a from-scratch numpy oracle (interpret mode on the CPU mesh).
+These predate the PR-14 rewrite (multi-page double-buffered DMA,
+in-kernel dequant, ``attention_impl`` auto-pick) and deliberately keep
+the independent numpy reference; the rewrite's quantized-pool and
+engine-integration parity lives in tests/test_paged_kernel.py."""
 
 import jax
 import jax.numpy as jnp

@@ -320,8 +320,10 @@ def test_kv_int8_budget_multiplier_feeds_pool_and_ledger(setup):
 def test_kv_dtype_validation(setup):
     with pytest.raises(ValueError, match="paged=True"):
         _engine(setup, kv_dtype="int8")
+    with pytest.raises(ValueError, match="paged=True"):
+        _engine(setup, kv_dtype="int4")
     with pytest.raises(ValueError, match="not supported"):
-        _engine(setup, paged=True, kv_dtype="int4")
+        _engine(setup, paged=True, kv_dtype="fp8")
 
 
 # -- speculative accept into paged KV --------------------------------------
