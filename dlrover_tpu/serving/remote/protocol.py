@@ -61,7 +61,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import msgpack
 
@@ -124,6 +124,10 @@ class FrameConnection:
         self._buf = bytearray()
         self._eof = False
         self._closed = False
+        # error stashed by recv_many when a tear lands MID-BATCH:
+        # raised by the next recv so the frames received before the
+        # tear are never lost to the error that followed them
+        self._deferred_exc: Optional[Exception] = None
 
     # ------------------------------------------------------------ send
     def send(self, kind: str, **payload) -> None:
@@ -161,6 +165,13 @@ class FrameConnection:
         first — buffered partial bytes are KEPT, so the next call
         resumes mid-frame — and ``ConnectionError`` on a torn stream
         (EOF inside a frame: the SIGKILLed-worker signature)."""
+        exc = self._deferred_exc
+        if exc is not None:
+            # a recv_many batch hit this error AFTER already receiving
+            # complete frames: those frames were delivered, the error
+            # was deferred to here so none of them could be lost
+            self._deferred_exc = None
+            raise exc
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             frame = self._parse_one()
@@ -185,6 +196,38 @@ class FrameConnection:
                 self._eof = True
                 continue
             self._buf += chunk
+
+    def recv_many(self, timeout: Optional[float] = None,
+                  max_frames: int = 256) -> Optional[List[dict]]:
+        """One blocking :meth:`recv` plus every frame already buffered
+        (or immediately readable) behind it, as one batch — the
+        reader-thread coalescing primitive: a consumer dispatches the
+        whole batch under ONE lock crossing instead of one per TOKEN
+        frame.  Built ON ``recv`` (zero-timeout tail reads), so a
+        fault-injecting subclass's per-frame ``recv`` override applies
+        to every frame in the batch — chaos coverage is not weakened
+        by batching.
+
+        Returns ``None`` on clean EOF with nothing buffered; a torn
+        stream mid-batch DEFERS its error (raised by the next call) so
+        the frames that preceded the tear are delivered, matching what
+        per-frame reads would have seen."""
+        first = self.recv(timeout=timeout)
+        if first is None:
+            return None
+        frames = [first]
+        while len(frames) < max_frames:
+            try:
+                nxt = self.recv(timeout=0)
+            except TimeoutError:
+                break  # nothing more buffered or readable right now
+            except Exception as e:  # torn mid-batch: deliver, defer
+                self._deferred_exc = e
+                break
+            if nxt is None:
+                break  # EOF at a frame boundary; next call returns None
+            frames.append(nxt)
+        return frames
 
     # ----------------------------------------------------------- close
     def half_close(self) -> None:

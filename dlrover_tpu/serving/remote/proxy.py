@@ -96,6 +96,11 @@ class RemoteReplicaHandle:
         # replica at debug — see cancel()
         self.cancel_send_failures = 0
         self._cancel_fail_logged = False
+        # batched-drain introspection: frames per lock crossing — under
+        # a token storm batches >> 1, which is the reader-coalescing
+        # win (tests assert frames_received / frame_batches grows)
+        self.frames_received = 0
+        self.frame_batches = 0
         try:
             hello = self._conn.recv(timeout=connect_timeout)
         except Exception:
@@ -131,7 +136,15 @@ class RemoteReplicaHandle:
     def _read_loop(self) -> None:
         while self._dead is None and not self._conn.closed:
             try:
-                frame = self._conn.recv(timeout=0.5)
+                # batched drain: one select wakeup scoops EVERY frame
+                # already buffered behind the first — under a token
+                # storm (N slots streaming per engine step) the
+                # dispatch below then crosses the proxy lock once per
+                # BATCH instead of once per TOKEN frame, which is
+                # exactly the contention the router's step lock used
+                # to eat (recv_many keeps per-frame fault injection:
+                # it reads frames through recv)
+                frames = self._conn.recv_many(timeout=0.5)
             except TimeoutError:
                 # no frame in 0.5s is NOT death by itself — staleness
                 # is judged against frame_timeout in step(); keep going
@@ -139,80 +152,100 @@ class RemoteReplicaHandle:
             except Exception as e:
                 self._mark_dead(f"stream torn: {e}")
                 return
-            if frame is None:
+            if frames is None:
                 self._mark_dead("worker closed the connection")
                 return
             try:
-                self._dispatch(frame)
+                self._dispatch_batch(frames)
             except Exception as e:
                 # a malformed frame (missing rid, bad field type) must
                 # kill the proxy LOUDLY, not leave a zombie reader that
                 # silently drops every subsequent frame
-                self._mark_dead(
-                    f"malformed {frame.get('kind')!r} frame: {e}")
+                self._mark_dead(f"malformed frame in batch: {e}")
                 return
 
     def _dispatch(self, frame: dict) -> None:
-        kind = frame.get("kind")
+        """Single-frame dispatch (tests drive this directly; the read
+        loop goes through :meth:`_dispatch_batch`)."""
+        self._dispatch_batch([frame])
+
+    def _dispatch_batch(self, frames: List[dict]) -> None:
         now = time.monotonic()
+        self.frames_received += len(frames)
+        self.frame_batches += 1
         with self._lock:
             self._last_frame = now
-            if kind == FrameKind.TOKEN:
-                rid = int(frame["rid"])
-                if rid in self._inflight:
-                    self._token_events.append(
-                        (rid, list(frame["tokens"]), now))
-            elif kind == FrameKind.DONE:
-                rid = int(frame["rid"])
-                if rid in self._inflight:
-                    self._inflight.discard(rid)
-                    self._finished.append(SimpleNamespace(
-                        rid=rid, output=list(frame["tokens"]),
-                        trace_spans=self._shift_spans(frame, now)))
-            elif kind == FrameKind.STATS:
-                seq = frame.get("seq")
-                seq = int(seq) if isinstance(seq, (int, float)) else None
-                gen = frame.get("generated_tokens")
-                gen = int(gen) if isinstance(gen, (int, float)) else None
+            for frame in frames:
+                self._dispatch_locked(frame, now)
+                if self._dead is not None:
+                    # a GOODBYE mid-batch closed the proxy; anything
+                    # behind it on the wire is from a peer that said
+                    # farewell first
+                    return
+
+    def _dispatch_locked(self, frame: dict, now: float) -> None:
+        kind = frame.get("kind")
+        if kind == FrameKind.TOKEN:
+            rid = int(frame["rid"])
+            if rid in self._inflight:
+                self._token_events.append(
+                    (rid, list(frame["tokens"]), now))
+        elif kind == FrameKind.DONE:
+            rid = int(frame["rid"])
+            if rid in self._inflight:
+                self._inflight.discard(rid)
+                # span shifting only when the worker actually shipped
+                # spans (sampled-in traces): a sampled-out request's
+                # DONE pays zero tracing work on this thread
+                spans = (self._shift_spans(frame, now)
+                         if frame.get("spans") else [])
+                self._finished.append(SimpleNamespace(
+                    rid=rid, output=list(frame["tokens"]),
+                    trace_spans=spans))
+        elif kind == FrameKind.STATS:
+            seq = frame.get("seq")
+            seq = int(seq) if isinstance(seq, (int, float)) else None
+            gen = frame.get("generated_tokens")
+            gen = int(gen) if isinstance(gen, (int, float)) else None
+            if seq is not None:
+                # per-send ordinal (current workers): a strict
+                # total order, so duplicates AND equal-token
+                # reorders (two snapshots with no decode step
+                # between them, e.g. around a SUBMIT) are droppable
+                stale = seq <= self._stats_seq_seen
+            else:
+                # token watermark fallback (seq-less sender): a
+                # snapshot older than one already applied must not
+                # regress the ledger — freed capacity would be
+                # forgotten or phantom capacity resurrected; equal
+                # still refreshes (cancels free slots without
+                # generating)
+                stale = gen is not None and gen < self._stats_tokens
+            if stale:
+                self.stale_stats_dropped += 1
+            else:
                 if seq is not None:
-                    # per-send ordinal (current workers): a strict
-                    # total order, so duplicates AND equal-token
-                    # reorders (two snapshots with no decode step
-                    # between them, e.g. around a SUBMIT) are droppable
-                    stale = seq <= self._stats_seq_seen
-                else:
-                    # token watermark fallback (seq-less sender): a
-                    # snapshot older than one already applied must not
-                    # regress the ledger — freed capacity would be
-                    # forgotten or phantom capacity resurrected; equal
-                    # still refreshes (cancels free slots without
-                    # generating)
-                    stale = gen is not None and gen < self._stats_tokens
-                if stale:
-                    self.stale_stats_dropped += 1
-                else:
-                    if seq is not None:
-                        self._stats_seq_seen = seq
-                    if gen is not None:
-                        self._stats_tokens = gen
-                    self._slots_free = int(frame.get("slots_free", 0))
-                    self._blocks_free = float(
-                        frame.get("blocks_free", 0.0))
-                    em = frame.get("engine_metrics")
-                    if isinstance(em, dict):
-                        # raw-speed introspection (spec accept ratio,
-                        # int8 KV pool, chunked-prefill seconds) from
-                        # engines that report it; absent on FakeEngine
-                        # workers and older senders
-                        self._engine_metrics = {
-                            str(k): float(v) for k, v in em.items()
-                            if isinstance(v, (int, float))
-                        }
-            elif kind in (FrameKind.SUBMITTED, FrameKind.ERROR):
-                self._submit_replies[int(frame["rid"])] = frame
-                self._submit_cv.notify_all()
-            elif kind == FrameKind.GOODBYE:
-                self._mark_dead("worker said goodbye", graceful=True)
+                    self._stats_seq_seen = seq
+                if gen is not None:
+                    self._stats_tokens = gen
+                self._slots_free = int(frame.get("slots_free", 0))
+                self._blocks_free = float(
+                    frame.get("blocks_free", 0.0))
+                em = frame.get("engine_metrics")
+                if isinstance(em, dict):
+                    # raw-speed introspection (spec accept ratio,
+                    # int8 KV pool, chunked-prefill seconds) from
+                    # engines that report it; absent on FakeEngine
+                    # workers and older senders
+                    self._engine_metrics = {
+                        str(k): float(v) for k, v in em.items()
+                        if isinstance(v, (int, float))
+                    }
+        elif kind in (FrameKind.SUBMITTED, FrameKind.ERROR):
+            self._submit_replies[int(frame["rid"])] = frame
+            self._submit_cv.notify_all()
+        elif kind == FrameKind.GOODBYE:
+            self._mark_dead("worker said goodbye", graceful=True)
 
     @staticmethod
     def _shift_spans(frame: dict, now: float) -> list:

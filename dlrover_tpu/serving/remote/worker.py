@@ -316,6 +316,12 @@ class WorkerServer:
                 self._trace_by_erid[erid] = {
                     "trace": tp, "t0": time.monotonic(),
                     "t_first": None, "steps": 0, "engine_s": 0.0,
+                    # the header every TOKEN/DONE frame for this
+                    # request echoes — built ONCE here: the verdict
+                    # and the parse are per-request, so the per-frame
+                    # hot path below pays a dict lookup, not a parse
+                    # or a fresh dict per frame
+                    "hdr": {"trace": tp},
                 }
             conn.send(FrameKind.SUBMITTED, rid=rid)
         elif kind == FrameKind.CANCEL:
@@ -409,9 +415,16 @@ class WorkerServer:
             return True
         return trace_sampled(parsed[0], self.trace_sample_rate)
 
+    _NO_TRACE_HEADER: dict = {}
+
     def _trace_header(self, erid: int) -> dict:
+        """Per-frame trace echo, cached per request at SUBMIT time —
+        a sampled-out request (no record) pays one dict miss per
+        frame and ships zero trace bytes; a traced one reuses the
+        SAME header dict for its whole lifetime (callers ``**`` it
+        into the frame payload, never mutate it)."""
         rec = self._trace_by_erid.get(erid)
-        return {} if rec is None else {"trace": rec["trace"]}
+        return self._NO_TRACE_HEADER if rec is None else rec["hdr"]
 
     def _trace_spans(self, rec: Optional[dict]) -> dict:
         if rec is None:

@@ -22,9 +22,14 @@ Layers (one module each):
 - :mod:`slo`       — per-priority objectives + multi-window error-
   budget burn rates; the SLO-pressure autoscale signal;
 - :mod:`loadgen`   — seeded replayable open-loop traffic generator +
-  the 10k-QPS gateway rig (bench.py --config gateway);
+  the 10k-QPS gateway rig (bench.py --config gateway) and the FULL-
+  pipeline router rig (admission -> placement -> streamed tokens ->
+  DONE; bench.py --config router);
 - :mod:`metrics`   — Prometheus gauges/counters for all of the above;
-- :mod:`router`    — the orchestrating pump.
+- :mod:`router`    — the orchestrating pump, behind the step-engine
+  seam (``step_engine="event" | "sweep"``);
+- :mod:`stepengine` — the sharded router front: N independent step
+  loops, requests partitioned by rid hash, shared brown-out view.
 """
 
 from dlrover_tpu.serving.router.brownout import (  # noqa: F401
@@ -58,4 +63,7 @@ from dlrover_tpu.serving.router.autoscale import (  # noqa: F401
 from dlrover_tpu.serving.router.slo import (  # noqa: F401
     SloEngine,
     SloObjective,
+)
+from dlrover_tpu.serving.router.stepengine import (  # noqa: F401
+    ShardedRouterFront,
 )

@@ -16,6 +16,7 @@ line.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import queue as queue_mod
 import threading
 import time
@@ -139,6 +140,18 @@ class ServingRequest:
     trace: Optional[RequestTrace] = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # cancel-event hook, stamped at admission: cancel() calls it so the
+    # router's event-driven step engine visits ONLY withdrawn requests
+    # instead of sweeping every queue + every in-flight map per step
+    _on_cancel: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    # capacity generation at which the scheduler last found NO replica
+    # able to hold this request — the incremental placement index skips
+    # it until some replica's capacity actually grows (scheduler.py)
+    sched_blocked_gen: int = dataclasses.field(
+        default=-1, repr=False, compare=False
+    )
 
     @property
     def total_len(self) -> int:
@@ -213,7 +226,18 @@ class ServingRequest:
         after, not instantly."""
         if self._done.is_set():
             return False
+        if self.cancel_requested:
+            # already pending: one event is enough — a client retrying
+            # cancel() must not inflate the cancelled counter when the
+            # event drain processes both copies of a QUEUED request
+            return True
         self.cancel_requested = True
+        cb = self._on_cancel
+        if cb is not None:
+            # enqueue the withdrawal for the event-driven sweep (a
+            # bare deque.append — atomic under the GIL, no lock, no
+            # I/O: this runs on the CLIENT's thread)
+            cb(self)
         return True
 
     def restart_stream(self) -> None:
@@ -305,6 +329,40 @@ class RequestGateway:
         # requests also count into ``rejected`` (they were refused at
         # the door, the accounting identity must keep balancing)
         self.shed_by_priority = [0 for _ in _PRIORITIES]
+        # ---- event-driven step-engine structures (ServingRouter
+        # ---- step_engine="event"; the "sweep" engine keeps the
+        # ---- historical full-scan paths and leaves these empty)
+        # whether expire()/take_cancelled() use the deadline heap and
+        # cancel-event queue below instead of scanning every queued
+        # request per step; set by the router to match its step engine
+        self.incremental = True
+        # min-heap of (deadline, tiebreak, request) — every admitted
+        # request with a deadline gets an entry (failover requeues
+        # re-push, so a consumed entry can't orphan a replayed
+        # request); consumed lazily when the deadline passes, so the
+        # expiry sweep touches only requests that are actually due
+        self._deadline_heap: List[tuple] = []
+        self._heap_seq = 0
+        # requests whose caller withdrew them (ServingRequest.cancel
+        # fires _on_cancel), drained by take_cancelled — bare deque:
+        # append is GIL-atomic from client threads
+        self._cancel_events: Deque[ServingRequest] = deque()
+        # RUNNING requests whose deadline passed, staged by expire()
+        # for the router's in-flight sweep (consumed every step; under
+        # the default let-it-finish policy the router discards them)
+        self._expired_running: List[ServingRequest] = []
+        # RUNNING requests whose caller withdrew them, staged by
+        # take_cancelled for the router's in-flight sweep
+        self._inflight_cancels: List[ServingRequest] = []
+        # queue generation: bumped on EVERY queue-content change —
+        # admissions, failover requeues, AND removals (placement,
+        # expiry, cancellation, brown-out shed).  The scheduler's
+        # short-circuit ("nothing new to place, nothing freed to place
+        # it on") keys on it; removals must bump too, because dropping
+        # a blocked request from the window's head lets requests
+        # BEHIND it into the window — an idle marker that survived the
+        # removal would starve them forever
+        self.queue_gen = 0
 
     # ----------------------------------------------------------- admit
     def submit(
@@ -375,8 +433,15 @@ class RequestGateway:
                 priority=priority, prompt_len=int(prompt.size),
                 max_new_tokens=int(max_new_tokens),
             )
+            req._on_cancel = self._cancel_events.append
+            if self.incremental and req.deadline is not None:
+                self._heap_seq += 1
+                heapq.heappush(
+                    self._deadline_heap,
+                    (req.deadline, self._heap_seq, req))
             self._queues[priority].append(req)
             self.submitted += 1
+            self.queue_gen += 1
             return req
 
     def requeue_front(
@@ -439,6 +504,16 @@ class RequestGateway:
                     req.trace.failover(
                         f"replica {dead_replica} died", now=now)
                 self._queues[req.priority].appendleft(req)
+                if self.incremental and req.deadline is not None:
+                    # the original heap entry may already have been
+                    # consumed (deadline passed while RUNNING under the
+                    # let-it-finish policy): a replay past its deadline
+                    # must still expire promptly, so re-push
+                    self._heap_seq += 1
+                    heapq.heappush(
+                        self._deadline_heap,
+                        (req.deadline, self._heap_seq, req))
+                self.queue_gen += 1
                 requeued.append(req)
         # flight-recorder dumps happen OUTSIDE the queue lock: logging
         # and tree serialization must never extend the admission
@@ -471,6 +546,7 @@ class RequestGateway:
         with self._lock:
             try:
                 self._queues[req.priority].remove(req)
+                self.queue_gen += 1
                 return True
             except ValueError:
                 return False
@@ -482,25 +558,71 @@ class RequestGateway:
         ``dump=False`` defers the flight-recorder dumps to the caller
         (the router holds its step lock here and dumps after release —
         serialization + logging must not extend ITS critical section
-        either)."""
+        either).
+
+        Two implementations behind one contract: the event engine pops
+        only DUE entries off the deadline heap (an idle step costs one
+        heap peek), the sweep engine scans every queued request — the
+        measured A/B in PERF.md is exactly this difference, at rig
+        scale."""
         now = time.monotonic() if now is None else now
         expired: List[ServingRequest] = []
         with self._lock:
-            for i, q in enumerate(self._queues):
-                # one-pass partition: per-entry deque.remove() would be
-                # O(n^2) when a stall expires a full queue at once
-                kept: Deque[ServingRequest] = deque()
-                dropped = False
-                for req in q:
-                    if req.deadline is not None and now > req.deadline:
+            if self.incremental:
+                due: List[ServingRequest] = []
+                # one request can hold SEVERAL heap entries (each
+                # failover requeue pushes one); collecting it twice
+                # here would abort/count it twice and break the books
+                # identity — dedupe by identity at collection
+                due_seen: set = set()
+                heap = self._deadline_heap
+                while heap and heap[0][0] < now:
+                    _, _, req = heapq.heappop(heap)
+                    if req.state == ServingRequestState.QUEUED:
+                        if id(req) not in due_seen:
+                            due_seen.add(id(req))
+                            due.append(req)
+                    elif req.state == ServingRequestState.RUNNING:
+                        # the router's in-flight sweep decides (abort +
+                        # CANCEL under cancel_inflight_on_expiry,
+                        # discard under let-it-finish; a later failover
+                        # requeue re-pushes a fresh entry)
+                        self._expired_running.append(req)
+                    # terminal states: the answer already exists
+                if due:
+                    # one-pass partition of ONLY the touched bands —
+                    # deque.remove per entry would be O(n^2) on a mass
+                    # expiry (a stall expiring a whole queue at once)
+                    due_ids = {id(r) for r in due}
+                    bands = {r.priority for r in due}
+                    for i in bands:
+                        self._queues[i] = deque(
+                            r for r in self._queues[i]
+                            if id(r) not in due_ids)
+                    self.queue_gen += 1
+                    for req in due:
                         req.abort(ServingRequestState.TIMED_OUT)
                         expired.append(req)
                         self.timed_out += 1
-                        dropped = True
-                    else:
-                        kept.append(req)
-                if dropped:
-                    self._queues[i] = kept
+            else:
+                for i, q in enumerate(self._queues):
+                    # one-pass partition: per-entry deque.remove()
+                    # would be O(n^2) when a stall expires a full
+                    # queue at once
+                    kept: Deque[ServingRequest] = deque()
+                    dropped = False
+                    for req in q:
+                        if req.deadline is not None \
+                                and now > req.deadline:
+                            req.abort(ServingRequestState.TIMED_OUT)
+                            expired.append(req)
+                            self.timed_out += 1
+                            dropped = True
+                        else:
+                            kept.append(req)
+                    if dropped:
+                        self._queues[i] = kept
+                        self.queue_gen += 1
         # dump outside the queue lock — the black-box readout
         # serializes the span tree and logs, neither belongs in the
         # admission path
@@ -519,22 +641,59 @@ class RequestGateway:
         Same deferral contract as :meth:`expire`: ``dump=False`` leaves
         the flight-recorder dumps to a lock-holding caller, and ``now``
         keeps recorder timestamps on the caller's (possibly synthetic)
-        clock next to the round's other events."""
+        clock next to the round's other events.
+
+        Event engine: drains the cancel-event queue (each withdrawal
+        visited once; RUNNING ones staged for the router's in-flight
+        sweep via :meth:`take_inflight_cancels`).  Sweep engine: full
+        scan of every band, as before."""
         taken: List[ServingRequest] = []
         with self._lock:
-            for i, q in enumerate(self._queues):
-                kept: Deque[ServingRequest] = deque()
-                dropped = False
-                for req in q:
-                    if req.cancel_requested:
+            if self.incremental:
+                queued: List[ServingRequest] = []
+                # belt to cancel()'s idempotence suspender: duplicate
+                # events for one request (however minted) must not
+                # count it twice
+                q_seen: set = set()
+                while self._cancel_events:
+                    req = self._cancel_events.popleft()
+                    if req.state == ServingRequestState.QUEUED:
+                        if id(req) not in q_seen:
+                            q_seen.add(id(req))
+                            queued.append(req)
+                    elif req.state == ServingRequestState.RUNNING:
+                        self._inflight_cancels.append(req)
+                    # terminal: a failover/expiry already answered
+                if queued:
+                    q_ids = {id(r) for r in queued}
+                    for i in {r.priority for r in queued}:
+                        self._queues[i] = deque(
+                            r for r in self._queues[i]
+                            if id(r) not in q_ids)
+                    self.queue_gen += 1
+                    for req in queued:
                         req.abort(ServingRequestState.CANCELLED)
                         taken.append(req)
                         self.cancelled += 1
-                        dropped = True
-                    else:
-                        kept.append(req)
-                if dropped:
-                    self._queues[i] = kept
+            else:
+                # sweep engine: a cancel event was also queued (the
+                # callback fires regardless); clear it so the deque
+                # cannot grow without a consumer
+                self._cancel_events.clear()
+                for i, q in enumerate(self._queues):
+                    kept: Deque[ServingRequest] = deque()
+                    dropped = False
+                    for req in q:
+                        if req.cancel_requested:
+                            req.abort(ServingRequestState.CANCELLED)
+                            taken.append(req)
+                            self.cancelled += 1
+                            dropped = True
+                        else:
+                            kept.append(req)
+                    if dropped:
+                        self._queues[i] = kept
+                        self.queue_gen += 1
         for req in taken:
             self.tracer.recorder.record(
                 "request_cancelled", rid=req.rid, now=now)
@@ -542,6 +701,25 @@ class RequestGateway:
                 self.tracer.flight_dump(
                     "cancelled", req.trace.trace_id, now=now)
         return taken
+
+    def take_inflight_cancels(self) -> List[ServingRequest]:
+        """RUNNING withdrawals staged by the event engine's
+        :meth:`take_cancelled` — the router aborts them and queues
+        CANCEL deliveries, visiting ONLY these instead of every
+        in-flight request on every replica each step."""
+        with self._lock:
+            taken, self._inflight_cancels = self._inflight_cancels, []
+            return taken
+
+    def take_expired_running(self) -> List[ServingRequest]:
+        """RUNNING requests whose deadline passed, staged by the event
+        engine's :meth:`expire` — consumed by the router every step
+        (acted on under ``cancel_inflight_on_expiry``, discarded under
+        the default let-it-finish policy, where a later failover
+        requeue re-arms the deadline heap)."""
+        with self._lock:
+            taken, self._expired_running = self._expired_running, []
+            return taken
 
     def shed_queued(self, priority: int,
                     now: Optional[float] = None,
@@ -561,6 +739,7 @@ class RequestGateway:
                     taken.append(req)
                     self.cancelled += 1
                 self._queues[priority] = deque()
+                self.queue_gen += 1
         for req in taken:
             self.tracer.recorder.record(
                 "brownout_shed_queued", rid=req.rid,

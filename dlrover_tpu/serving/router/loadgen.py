@@ -23,8 +23,24 @@ and drives it at the in-process serving stack:
   is wired.  The queue bound and the brown-out ladder are expected
   to bite at rate: shed requests ARE the measurement, not a failure.
 
-Everything here is driver-side; the gateway under test is the real
-one, unmodified.
+- :func:`run_router_rig` — the FULL-pipeline twin (``bench.py
+  --config router``): the same open-loop schedule driven through the
+  WHOLE serving path — admission, placement, submit, streamed tokens,
+  DONE — against a fleet of in-process engines, measuring sustained
+  **end-to-end** QPS, e2e latency percentiles from the completed
+  requests themselves, and the zero-lost/books accounting identity
+  (admitted == done + timed_out + cancelled + rejected + poisoned,
+  poisoned == 0, nothing non-terminal after the drain).  This is the
+  step loop's own perf trajectory next to the gateway's: the admission
+  rig proved the front door sustains ~15k QPS, this one holds the
+  step engine behind it to the ``router_qps_ok`` bar.  Seeded
+  mid-flight cancels (``cancel_every``) make the nightly soak exercise
+  the withdrawal machinery at rate.
+
+Everything here is driver-side; the router under test is the real
+one, unmodified — any object with ``submit``/``step``/``has_work``
+(a :class:`~dlrover_tpu.serving.router.router.ServingRouter` or the
+sharded front) drives identically.
 """
 
 from __future__ import annotations
@@ -37,6 +53,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common.constants import ServingRequestState
 from dlrover_tpu.serving.router.gateway import (
     PRIORITY_BATCH,
     PRIORITY_HIGH,
@@ -290,3 +307,159 @@ def run_gateway_rig(
         result["gateway_otlp"] = {
             k: v for k, v in otlp_exporter.metrics().items()}
     return result
+
+
+def run_router_rig(
+    router,
+    config: Optional[LoadgenConfig] = None,
+    step_every: int = 64,
+    pace: bool = True,
+    cancel_every: int = 0,
+    drain_max_steps: int = 500_000,
+    drain_timeout_s: float = 120.0,
+) -> Dict[str, object]:
+    """Replay one open-loop schedule through the WHOLE pipeline on the
+    wall clock: admission -> placement -> submit -> streamed tokens ->
+    DONE, against whatever fleet is already joined on ``router``.
+
+    Differences from :func:`run_gateway_rig`, deliberately:
+
+    - every admitted request object is KEPT and audited at the end —
+      zero-lost means zero requests outside a terminal state, and the
+      books identity is computed from the requests themselves, so the
+      rig works unchanged against a single router or the sharded
+      front (whose counters live in N shards);
+    - the headline number is sustained END-TO-END QPS: completed
+      requests over the whole wall (offer + drain) — the step loop
+      cannot hide behind a fast front door;
+    - e2e percentiles come from ``finished_at - submitted_at`` of the
+      completed requests (the router's own monotonic stamps);
+    - ``cancel_every=N`` withdraws every Nth admitted request a step
+      later (seeded by admission order, replayable): the mid-flight
+      cancel mix the nightly soak runs.
+
+    ``step_every`` bounds admissions between router rounds; a threaded
+    sharded front self-drives and its ``step()`` briefly yields
+    instead, which keeps this driver loop correct for both."""
+    cfg = config or LoadgenConfig()
+    gen = OpenLoopGenerator(cfg)
+    pool_lens = sorted({a.prompt_len for a in gen.arrivals()})
+    pool = {n: np.arange(n, dtype=np.int32) for n in pool_lens}
+
+    admitted: List[object] = []
+    shed = {band: 0 for band, _ in cfg.priority_mix}
+    shed_kinds = {"queue_full": 0, "brownout": 0, "other": 0}
+    offered = 0
+    steps = 0
+    cancelled_by_rig: List[object] = []
+    to_cancel: List[object] = []
+
+    t0 = time.perf_counter()
+    since_step = 0
+    for arrival in gen.arrivals():
+        offered += 1
+        if pace:
+            ahead = arrival.at_s - (time.perf_counter() - t0)
+            if ahead > 0.002:
+                time.sleep(ahead)
+        prompt = pool[arrival.prompt_len]
+        try:
+            req = router.submit(prompt, arrival.max_new_tokens,
+                                priority=arrival.priority)
+            admitted.append(req)
+            if cancel_every and len(admitted) % cancel_every == 0:
+                # withdraw shortly after admission: flushed on the
+                # next arrival (typically still queued — a request
+                # cannot complete before a router step) or at the next
+                # step boundary (by then often RUNNING), so both
+                # cancel paths get traffic
+                to_cancel.append(req)
+            elif to_cancel:
+                for marked in to_cancel:
+                    if marked.cancel():
+                        cancelled_by_rig.append(marked)
+                to_cancel.clear()
+        except BrownoutShedError:
+            shed[arrival.priority] += 1
+            shed_kinds["brownout"] += 1
+        except QueueFullError:
+            shed[arrival.priority] += 1
+            shed_kinds["queue_full"] += 1
+        except AdmissionError:
+            shed[arrival.priority] += 1
+            shed_kinds["other"] += 1
+        since_step += 1
+        if since_step >= step_every:
+            since_step = 0
+            router.step()
+            steps += 1
+            for req in to_cancel:
+                if req.cancel():
+                    cancelled_by_rig.append(req)
+            to_cancel.clear()
+    # a request marked on the schedule's LAST arrival has no later
+    # arrival or step boundary to flush it — withdraw it now, before
+    # the drain, so "every Nth admitted request" means every Nth
+    for req in to_cancel:
+        if req.cancel():
+            cancelled_by_rig.append(req)
+    to_cancel.clear()
+    offer_wall_s = time.perf_counter() - t0
+
+    # drain: pump until every admitted request reaches a terminal
+    # state (DONE, or the deadline/cancel machinery answers it)
+    drain_deadline = time.perf_counter() + drain_timeout_s
+    while router.has_work and steps < drain_max_steps \
+            and time.perf_counter() < drain_deadline:
+        router.step()
+        steps += 1
+    total_wall_s = time.perf_counter() - t0
+
+    # the audit, from the request objects themselves
+    by_state: Dict[str, int] = {}
+    e2e: List[float] = []
+    for req in admitted:
+        by_state[req.state] = by_state.get(req.state, 0) + 1
+        if req.state == ServingRequestState.DONE \
+                and req.finished_at is not None:
+            e2e.append(req.finished_at - req.submitted_at)
+    done = by_state.get(ServingRequestState.DONE, 0)
+    terminal = (ServingRequestState.DONE,
+                ServingRequestState.TIMED_OUT,
+                ServingRequestState.CANCELLED,
+                ServingRequestState.REJECTED,
+                ServingRequestState.POISONED)
+    lost = sum(n for state, n in by_state.items()
+               if state not in terminal)
+    poisoned = by_state.get(ServingRequestState.POISONED, 0)
+    accounted = sum(by_state.get(s, 0) for s in terminal)
+    e2e.sort()
+    p50, p99, p999 = _quantiles(e2e, (50, 99, 99.9))
+    return {
+        "router_offered": offered,
+        "router_admitted": len(admitted),
+        "router_shed": {BAND_NAMES.get(b, str(b)): n
+                        for b, n in shed.items()},
+        "router_shed_kinds": dict(shed_kinds),
+        "router_by_state": dict(sorted(by_state.items())),
+        "router_completed": done,
+        "router_cancel_attempts": len(cancelled_by_rig),
+        "router_lost": lost,
+        "router_poisoned": poisoned,
+        # the identity: every admitted request reached exactly one
+        # terminal state and nothing fell through the failover /
+        # cancel / expiry machinery
+        "router_books_ok": bool(
+            lost == 0 and accounted == len(admitted)),
+        "router_offer_wall_s": round(offer_wall_s, 4),
+        "router_total_wall_s": round(total_wall_s, 4),
+        "router_steps": steps,
+        # sustained END-TO-END throughput: completions over the whole
+        # wall — the step loop's own number
+        "router_qps": round(done / max(1e-9, total_wall_s), 1),
+        "router_offered_qps": round(
+            offered / max(1e-9, offer_wall_s), 1),
+        "router_e2e_p50_s": round(p50, 6),
+        "router_e2e_p99_s": round(p99, 6),
+        "router_e2e_p999_s": round(p999, 6),
+    }

@@ -67,6 +67,17 @@ from dlrover_tpu.utils.profiler import (
     log_buckets,
 )
 
+#: Closed label vocabulary for ``serving_step_phase_seconds`` — one
+#: histogram series per router step phase (METRIC_LABELS declares the
+#: ``phase`` key; dlint DL010 pins the family).  ``deliver`` and
+#: ``flush`` run OUTSIDE the step lock (DL007 discipline), the rest
+#: hold it — comparing their sums against
+#: ``serving_step_lock_hold_seconds`` attributes the lock's tail.
+STEP_PHASES = (
+    "expire", "cancel", "brownout", "failover", "schedule",
+    "deliver", "pump", "retire", "observe", "autoscale", "flush",
+)
+
 
 class RouterMetrics:
     """Aggregates router signals into one Prometheus-ready dict, plus
@@ -129,6 +140,28 @@ class RouterMetrics:
         self.decode_step_hist = _hist(
             "serving_decode_step_seconds",
             buckets=log_buckets(1e-4, 2.0))
+        # step-loop instrumentation (measure FIRST, then attack what
+        # the histograms name): per-critical-section lock hold time +
+        # per-phase wall time of each router step round.  µs-floor
+        # buckets — a healthy step's phases are micro- not
+        # milliseconds, and the ladder must resolve them
+        self.step_lock_hist = _hist(
+            "serving_step_lock_hold_seconds",
+            buckets=log_buckets(1e-6, 1.0))
+        self.step_phase_hists: Dict[str, Histogram] = {
+            phase: Histogram(
+                "serving_step_phase_seconds",
+                help_text=metric_help("serving_step_phase_seconds")
+                or "",
+                buckets=log_buckets(1e-6, 1.0),
+                labels={"phase": phase})
+            for phase in STEP_PHASES
+        }
+        # scheduler fast-path counters, mirrored from the scheduler by
+        # the router's observe sweep (regression surface for the
+        # incremental placement index)
+        self.sched_capacity_evals = 0.0
+        self.sched_rounds_skipped = 0.0
 
     # ------------------------------------------------------- observe
     def observe_gauges(
@@ -170,6 +203,17 @@ class RouterMetrics:
         """One engine decode step (whole-batch attribution; remote
         replicas report theirs via the worker.decode span)."""
         self.decode_step_hist.observe(seconds, trace_id=trace_id)
+
+    def observe_step_lock(self, seconds: float) -> None:
+        """One step-lock critical section's hold time."""
+        self.step_lock_hist.observe(seconds)
+
+    def observe_step_phase(self, phase: str, seconds: float) -> None:
+        """Wall seconds one router step spent in ``phase`` (must be in
+        :data:`STEP_PHASES` — the label vocabulary is closed)."""
+        hist = self.step_phase_hists.get(phase)
+        if hist is not None:
+            hist.observe(seconds)
 
     def observe_engine_metrics(self, dicts) -> None:
         """Fold per-replica engine introspection dicts into the fleet
@@ -250,16 +294,31 @@ class RouterMetrics:
             "serving_prefill_chunk_seconds": self.prefill_chunk_seconds,
             "serving_paged_kernel_step_seconds":
                 self.paged_kernel_step_seconds,
+            "serving_sched_capacity_evals_total":
+                self.sched_capacity_evals,
+            "serving_sched_rounds_skipped_total":
+                self.sched_rounds_skipped,
         }
 
     def render_histograms(self) -> str:
         """OpenMetrics histogram text with trace-exemplar drill-down —
         wire via ``MetricsExporter.add_text_source`` (or the one-call
         ``exporter.attach_router(router)``)."""
-        return "".join(h.render() for h in (
+        parts = [h.render() for h in (
             self.ttft_hist, self.queue_wait_hist,
             self.e2e_hist, self.decode_step_hist,
-        ))
+            self.step_lock_hist,
+        )]
+        # the phase histograms are ONE family fanned out by label: emit
+        # the # TYPE/# HELP header once, then each phase's samples
+        for i, phase in enumerate(STEP_PHASES):
+            text = self.step_phase_hists[phase].render()
+            if i:
+                text = "".join(
+                    line for line in text.splitlines(keepends=True)
+                    if not line.startswith("# "))
+            parts.append(text)
+        return "".join(parts)
 
     def render_labeled(self) -> str:
         """Labeled gauge text for the /metrics scrape: replicas per

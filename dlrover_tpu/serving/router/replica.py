@@ -265,6 +265,11 @@ class ReplicaHandle:
         self.probation_until = 0.0
         self.inflight: Dict[int, ServingRequest] = {}
         self.generated_tokens = 0
+        # requests whose FIRST token arrived in the latest pump —
+        # staged here so the router records TTFT by visiting only
+        # requests with news instead of sweeping every in-flight
+        # request per step (drained + cleared by ServingRouter.step)
+        self.ttft_pending: List[ServingRequest] = []
         self._failed = False
         # first-ever placement marker: the autoscale trace's last
         # milestone (plan -> spawn -> join -> FIRST PLACEMENT) keys
@@ -386,7 +391,10 @@ class ReplicaHandle:
             for erid, toks, t in drain(now):
                 req = self.inflight.get(erid)
                 if req is not None:
+                    first = req.first_token_at is None
                     req.push_tokens(toks, t)
+                    if first and req.first_token_at is not None:
+                        self.ttft_pending.append(req)
         done: List[ServingRequest] = []
         # whole-batch decode-step attribution for engines that time
         # their own step (the in-process adapter / FakeEngine); remote
@@ -398,10 +406,16 @@ class ReplicaHandle:
                 continue  # e.g. admitted before a drain started
             self.generated_tokens += len(ereq.output)
             spans = getattr(ereq, "trace_spans", None)
-            worker_step = _worker_decode_step_seconds(spans)
+            if spans:
+                worker_step = _worker_decode_step_seconds(spans)
+            else:
+                # sampled-out request: the worker shipped no spans, so
+                # the completion path pays zero span parsing/grafting
+                # — the cost the sampling knob exists to shed
+                worker_step = None
             req.decode_step_seconds = (
                 worker_step if worker_step is not None else local_step_s)
-            if req.trace is not None:
+            if req.trace is not None and spans:
                 # remote workers ship their own spans (decode steps,
                 # engine time) back on the DONE frame, already shifted
                 # to this process's clock by the proxy — graft them
@@ -418,6 +432,7 @@ class ReplicaHandle:
             for req in self.inflight.values():
                 if req.first_token_at is None:
                     req.first_token_at = now
+                    self.ttft_pending.append(req)
                     if req.trace is not None:
                         req.trace.first_token(now)
             for req in done:

@@ -19,6 +19,27 @@ The pump is deliberately synchronous and single-threaded: chaos tests
 drive it step-by-step deterministically, and a deployment that wants a
 background loop wraps :meth:`serve_forever` in a thread — concurrency
 is a caller policy, not baked in.
+
+Step engines (the data-plane raw-speed seam, ``step_engine=``):
+
+- ``"event"`` (default, the measured winner — PERF.md "Router raw
+  speed" records the A/B): expiry pops only DUE entries off the
+  gateway's deadline heap, cancellation visits only requests whose
+  caller actually withdrew them (``ServingRequest.cancel`` enqueues an
+  event), TTFT recording drains per-replica first-token events, and
+  placement runs the scheduler's incremental index — an idle step does
+  O(replicas) work instead of O(replicas x queued + inflight);
+- ``"sweep"``: the historical full-scan semantics, kept runnable so
+  the choice stays auditable (bench A/B) and equivalence-testable
+  (same seeded workload -> same terminal states, pinned in
+  tests/test_step_engine.py).
+
+Both engines observe the same step-phase histograms
+(``serving_step_phase_seconds{phase=...}``) and step-lock hold-time
+histogram (``serving_step_lock_hold_seconds``) — instrument first,
+then attack what the histograms name.  A sharded front over N
+independent routers lives in
+:mod:`dlrover_tpu.serving.router.stepengine`.
 """
 
 from __future__ import annotations
@@ -77,6 +98,9 @@ class ServingRouter:
     # summary line instead of hundreds of multi-KB records
     MAX_DUMPS_PER_STEP = 8
 
+    #: step-engine candidates behind the seam (see module docstring)
+    STEP_ENGINES = ("event", "sweep")
+
     def __init__(
         self,
         gateway: Optional[RequestGateway] = None,
@@ -86,7 +110,14 @@ class ServingRouter:
         cancel_inflight_on_expiry: bool = False,
         brownout=None,
         slo=None,
+        step_engine: str = "event",
     ):
+        if step_engine not in self.STEP_ENGINES:
+            raise ValueError(
+                f"unknown step_engine {step_engine!r} "
+                f"(one of {self.STEP_ENGINES})")
+        self.step_engine = step_engine
+        self._incremental = step_engine == "event"
         # policy knob: when True, a request whose deadline passes MID-
         # GENERATION is aborted and a CANCEL is sent to its replica so
         # the engine slot + KV blocks are reclaimed for live traffic;
@@ -103,9 +134,20 @@ class ServingRouter:
         self.brownout = brownout
         if brownout is not None:
             self.gateway.brownout = brownout
+        # sharded-front hook: when True the brown-out POLICY object is
+        # updated by an external owner (the front, with fleet-global
+        # depth/capacity) and this router only APPLIES the stage's
+        # shedding to its own shard (stepengine.ShardedRouterFront)
+        self.brownout_external = False
         self.scheduler = scheduler or ContinuousBatchScheduler()
         self.manager = manager or ReplicaManager()
         self.metrics = metrics or RouterMetrics()
+        # the step engine propagates into the gateway (deadline heap +
+        # cancel events vs full scans) and the scheduler (incremental
+        # placement index vs full rescan) — one knob, one behavior,
+        # set BEFORE any submission can reach either
+        self.gateway.incremental = self._incremental
+        self.scheduler.incremental = self._incremental
         # per-priority SLO burn-rate engine (slo.SloEngine): fed by the
         # step loop's completion/expiry stream; its pressure signal is
         # sampled by the autoscaler next to the load windows.  None
@@ -196,6 +238,8 @@ class ServingRouter:
     def step(self, now: Optional[float] = None) -> List[ServingRequest]:
         """One router round; returns the requests completed by it."""
         now = time.monotonic() if now is None else now
+        perf = time.perf_counter
+        phase = self.metrics.observe_step_phase
         # flight-recorder dumps requested during this round: flushed
         # AFTER the step lock is released — serializing span trees and
         # logging must not extend the critical section that placement
@@ -208,7 +252,9 @@ class ServingRouter:
         # dlint DL003 exists to forbid
         cancels: List[tuple] = []
         with self._lock:
-            # 1. deadline expiry
+            t_lock = t_prev = perf()
+            # 1. deadline expiry (event engine: heap-pop only DUE
+            # entries; sweep engine: scan every queued request)
             for req in self.gateway.expire(now, dump=False):
                 if self.slo is not None:
                     # an expiry IS an SLO violation: the answer never
@@ -217,6 +263,9 @@ class ServingRouter:
                 if req.trace is not None:
                     dumps.append(
                         ("deadline_expired", req.trace.trace_id))
+            t = perf()
+            phase("expire", t - t_prev)
+            t_prev = t
 
             # 1b. cancellation sweep: queued client withdrawals leave
             # the queue here; in-flight withdrawals — and, under the
@@ -226,34 +275,14 @@ class ServingRouter:
             for req in self.gateway.take_cancelled(now, dump=False):
                 if req.trace is not None:
                     dumps.append(("cancelled", req.trace.trace_id))
-            for handle in self.manager.pumpable():
-                for erid, req in list(handle.inflight.items()):
-                    expired = (
-                        self.cancel_inflight_on_expiry
-                        and req.deadline is not None
-                        and now > req.deadline
-                    )
-                    if not (req.cancel_requested or expired):
-                        continue
-                    del handle.inflight[erid]
-                    if req.cancel_requested:
-                        state = ServingRequestState.CANCELLED
-                        self.gateway.cancelled += 1
-                        reason = "cancelled"
-                    else:
-                        state = ServingRequestState.TIMED_OUT
-                        self.gateway.timed_out += 1
-                        reason = "deadline_expired"
-                        if self.slo is not None:
-                            self.slo.observe_violation(
-                                req.priority, now)
-                    req.abort(state)
-                    self.recorder.record(
-                        "request_cancel_inflight", rid=req.rid,
-                        replica=handle.name, state=state, now=now)
-                    cancels.append((handle, erid))
-                    if req.trace is not None:
-                        dumps.append((reason, req.trace.trace_id))
+            if self._incremental:
+                self._inflight_sweep_events(now, cancels, dumps)
+            else:
+                self._inflight_sweep_scan(now, cancels, dumps)
+            t = perf()
+            phase("cancel", t - t_prev)
+            t_prev = t
+
             # 1c. brown-out watermark + per-priority shedding: DECIDE
             # the stage under the step lock (pure arithmetic over the
             # live ledgers), queue the band's CANCEL deliveries for
@@ -263,9 +292,15 @@ class ServingRouter:
                 self._brownout_sweep(now, cancels, dumps)
             self.metrics.cancelled = self.gateway.cancelled
             self.metrics.timed_out = self.gateway.timed_out
+            t = perf()
+            phase("brownout", t - t_prev)
+            t_prev = t
 
             # 2. failover: reap dead replicas, requeue their in-flight
             self._reap(now, dumps=dumps)
+            t = perf()
+            phase("failover", t - t_prev)
+            t_prev = t
 
             # 3a. placement DECISIONS (micro-batch per replica per
             # round); schedulable(now) keeps probation replicas
@@ -288,6 +323,9 @@ class ServingRouter:
             if self.replica_origins:
                 for handle, req in placements:
                     self._link_attempt_origin(handle, req)
+            t = perf()
+            phase("schedule", t - t_prev)
+            self.metrics.observe_step_lock(t - t_lock)
         # 3b. placement DELIVERY outside the step lock: for a remote
         # replica, submit is a SUBMIT frame send plus a synchronous ack
         # wait — socket I/O bounded only by submit_timeout, and holding
@@ -298,6 +336,7 @@ class ServingRouter:
         # The pump is single-threaded by design (module docstring), so
         # handle/request state is safe to touch here; concurrent
         # join/fail/drain calls only mutate OTHER entries.
+        t_prev = perf()
         for handle, req in placements:
             try:
                 handle.submit(req)
@@ -358,7 +397,9 @@ class ServingRouter:
                 handle.fail()
                 with self._lock:
                     self._reap(now, extra=[req], dumps=dumps)
+        phase("deliver", perf() - t_prev)
         with self._lock:
+            t_lock = t_prev = perf()
             # 4. pump engines
             completed: List[ServingRequest] = []
             for handle in self.manager.pumpable():
@@ -387,11 +428,19 @@ class ServingRouter:
                             req.decode_step_seconds,
                             trace_id=_tid(req))
                 completed.extend(done)
-            # TTFT for still-running requests that just got their first
-            # token (completion above covers the finished ones)
+            # TTFT for still-running requests whose FIRST token arrived
+            # this round: pump stages them in handle.ttft_pending, so
+            # this visits only the requests with news — the old sweep
+            # touched every in-flight request on every replica, every
+            # step (completion above covers the finished ones)
             for handle in self.manager.pumpable():
-                for req in handle.inflight.values():
-                    self._record_ttft(req, now)
+                if handle.ttft_pending:
+                    for req in handle.ttft_pending:
+                        self._record_ttft(req, now)
+                    handle.ttft_pending.clear()
+            t = perf()
+            phase("pump", t - t_prev)
+            t_prev = t
 
             # 5. retire drained replicas (graceful scale-down, phase 2)
             for handle in list(self.manager.replicas.values()):
@@ -412,6 +461,9 @@ class ServingRouter:
                         base_replica_name(handle.name), None)
                     self.drained.append(
                         DrainedReplica(handle.name, handle.node))
+            t = perf()
+            phase("retire", t - t_prev)
+            t_prev = t
 
             # 6. gauges + autoscale
             inflight = sum(
@@ -436,6 +488,15 @@ class ServingRouter:
                 h.engine_metrics()
                 for h in self.manager.replicas.values()
             ])
+            # placement fast-path counters (regression surface for the
+            # incremental index; plain attribute reads)
+            self.metrics.sched_capacity_evals = float(
+                getattr(self.scheduler, "capacity_evals", 0))
+            self.metrics.sched_rounds_skipped = float(
+                getattr(self.scheduler, "rounds_skipped", 0))
+            t = perf()
+            phase("observe", t - t_prev)
+            self.metrics.observe_step_lock(t - t_lock)
         # autoscale OUTSIDE the step lock: a Brain-backed policy's
         # serving_plan is a synchronous control-plane RPC (30s default
         # timeout), and executing a ScalePlan spawns nodes/processes —
@@ -445,8 +506,12 @@ class ServingRouter:
         # called from here, so its own state needs no lock; the router
         # surfaces it reads (metrics, manager counts, gateway depth)
         # are each internally consistent.
+        t_prev = perf()
         if self.autoscaler is not None:
             self.autoscaler.on_step(now)
+        t = perf()
+        phase("autoscale", t - t_prev)
+        t_prev = t
         # deliver the round's CANCELs now that the lock is gone: remote
         # deliveries are frame sends (bounded by the connection's
         # send_timeout, but still I/O); local ones are slot/KV-block
@@ -472,7 +537,87 @@ class ServingRouter:
                 "flight recorder: %d more %s dumps suppressed this "
                 "step (first %d emitted)", n, reason,
                 self.MAX_DUMPS_PER_STEP)
+        phase("flush", perf() - t_prev)
         return completed
+
+    # ------------------------------------------- in-flight sweeps (1b)
+    def _inflight_abort(self, handle: ReplicaHandle, erid: int,
+                        req: ServingRequest, cancelled: bool,
+                        now: float, cancels: List[tuple],
+                        dumps: List[tuple]) -> None:
+        """Shared abort bookkeeping for an in-flight withdrawal/expiry
+        (step lock held): state flip, accounting, recorder event, the
+        CANCEL delivery queued for after lock release."""
+        del handle.inflight[erid]
+        if cancelled:
+            state = ServingRequestState.CANCELLED
+            self.gateway.cancelled += 1
+            reason = "cancelled"
+        else:
+            state = ServingRequestState.TIMED_OUT
+            self.gateway.timed_out += 1
+            reason = "deadline_expired"
+            if self.slo is not None:
+                self.slo.observe_violation(req.priority, now)
+        req.abort(state)
+        self.recorder.record(
+            "request_cancel_inflight", rid=req.rid,
+            replica=handle.name, state=state, now=now)
+        cancels.append((handle, erid))
+        if req.trace is not None:
+            dumps.append((reason, req.trace.trace_id))
+
+    def _inflight_sweep_scan(self, now: float, cancels: List[tuple],
+                             dumps: List[tuple]) -> None:
+        """Sweep engine: visit EVERY in-flight request on every replica
+        looking for withdrawals (and, under the policy, expiries) —
+        the historical O(inflight)-per-step behavior."""
+        for handle in self.manager.pumpable():
+            for erid, req in list(handle.inflight.items()):
+                expired = (
+                    self.cancel_inflight_on_expiry
+                    and req.deadline is not None
+                    and now > req.deadline
+                )
+                if not (req.cancel_requested or expired):
+                    continue
+                self._inflight_abort(
+                    handle, erid, req, req.cancel_requested,
+                    now, cancels, dumps)
+
+    def _inflight_sweep_events(self, now: float, cancels: List[tuple],
+                               dumps: List[tuple]) -> None:
+        """Event engine: visit ONLY requests with news — caller
+        withdrawals staged by the gateway's cancel-event queue, and
+        (under cancel_inflight_on_expiry) RUNNING requests whose
+        deadline-heap entry came due.  A request that reached a
+        terminal state (or failed over back to QUEUED) between the
+        event and this sweep is simply skipped: the path that moved it
+        already answered its caller."""
+        work = [(req, True)
+                for req in self.gateway.take_inflight_cancels()]
+        # drain unconditionally (the stage list must not grow under the
+        # let-it-finish policy); act only when the policy says so — a
+        # request discarded here that later fails over re-arms the
+        # deadline heap through requeue_front
+        expired = self.gateway.take_expired_running()
+        if self.cancel_inflight_on_expiry:
+            work.extend((req, False) for req in expired)
+        for req, cancelled in work:
+            if req.state != ServingRequestState.RUNNING:
+                continue
+            if not cancelled and (req.deadline is None
+                                  or now <= req.deadline):
+                continue  # popped early by a prior step's clock skew
+            handle = (self.manager.get(req.replica)
+                      if req.replica else None)
+            if handle is None:
+                continue
+            erid = req.engine_rid
+            if erid is None or handle.inflight.get(erid) is not req:
+                continue
+            self._inflight_abort(
+                handle, erid, req, cancelled, now, cancels, dumps)
 
     def _brownout_sweep(self, now: float, cancels: List[tuple],
                         dumps: List[tuple]) -> None:
@@ -480,7 +625,19 @@ class ServingRouter:
         the watermark, record stage transitions, and at stage 2+
         expiry-cancel queued and in-flight BATCH through the cancel
         machinery — decisions here, deliveries after lock release via
-        ``cancels`` (a remote CANCEL is a frame send; DL003/DL007)."""
+        ``cancels`` (a remote CANCEL is a frame send; DL003/DL007).
+
+        With ``brownout_external`` set (the sharded front), the policy
+        object is updated by its owner with FLEET-GLOBAL depth and
+        capacity; this router only applies the already-decided stage's
+        shedding to its own shard."""
+        if self.brownout_external:
+            stage = self.brownout.stage
+            self.metrics.brownout_stage = float(stage)
+            if not self.brownout.cancels_batch:
+                return
+            self._brownout_cancel_batch(now, cancels, dumps)
+            return
         capacity = 0.0
         for handle in self.manager.schedulable(now):
             try:
@@ -506,6 +663,10 @@ class ServingRouter:
         self.metrics.brownout_stage = float(stage)
         if not self.brownout.cancels_batch:
             return
+        self._brownout_cancel_batch(now, cancels, dumps)
+
+    def _brownout_cancel_batch(self, now: float, cancels: List[tuple],
+                               dumps: List[tuple]) -> None:
         # stage 2+: the BATCH band drains NOW — queued requests answer
         # their callers instead of aging out, in-flight ones return
         # their slots and paged KV blocks to the surviving bands

@@ -17,13 +17,49 @@ Placement preference order:
    still holds those blocks, making the prefill nearly free);
 2. **least loaded** — otherwise the replica with the most free slots,
    ties broken by free KV blocks.
+
+Incremental placement index (``incremental=True``, the event step
+engine's default): the historical implementation rescanned **every
+replica for every queued request in the window on every step** —
+O(replicas x queued) even when nothing changed, which continuous-
+batching systems (Orca, vLLM) show becomes THE ceiling once decode
+steps drop under a millisecond.  The index kills that product three
+ways, none of them changing placement semantics:
+
+- **capacity generation**: replica capacities are read once per round
+  (O(replicas)) and compared against the previous round; the
+  generation bumps only when some replica's free slots/blocks GREW
+  (join, completion, cancel, STATS refresh).  A request that found no
+  home is stamped with the generation it was refused at and skipped —
+  O(1) — until capacity actually grows, because nothing else can
+  change the verdict (capacity only shrinks mid-round);
+- **candidate heap**: fitting candidates come off a max-heap keyed
+  (slots_free, blocks_free) with lazy invalidation, so the common
+  first-candidate-fits case costs O(log replicas) instead of a scan;
+  walking past non-fitting entries reproduces exactly the legacy
+  "max over fitting candidates" pick (iterating in descending key
+  order, the first fit IS that max);
+- **round short-circuit**: a round that placed nothing records the
+  (queue generation, capacity generation) pair; while both are
+  unchanged the window scan itself is skipped (``rounds_skipped``).
+
+``capacity_evals`` counts (request x replica) fit evaluations — the
+regression surface: on idle entries it must NOT scale with
+replicas x queued (pinned by tests/test_step_engine.py and the
+``serving_sched_capacity_evals_total`` gauge).
+
+Tie-break note: the legacy scan breaks (slots, blocks) ties by replica
+LIST order (manager insertion), the heap by replica name — both are
+deterministic, and placement distribution (not request outcome) is the
+only thing that can differ.
 """
 
 from __future__ import annotations
 
 import hashlib
+import heapq
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -31,7 +67,8 @@ from dlrover_tpu.serving.router.gateway import RequestGateway, ServingRequest
 
 
 class ContinuousBatchScheduler:
-    """Stateless placement plus a small per-replica prefix-affinity LRU."""
+    """Stateless placement plus a small per-replica prefix-affinity LRU
+    and (``incremental=True``) the placement index above."""
 
     def __init__(
         self,
@@ -39,13 +76,35 @@ class ContinuousBatchScheduler:
         schedule_window: int = 64,
         prefix_tokens: int = 32,
         affinity_cap: int = 512,
+        incremental: bool = True,
     ):
         self.block_size = int(block_size)
         self.schedule_window = int(schedule_window)
         self.prefix_tokens = int(prefix_tokens)
         self.affinity_cap = int(affinity_cap)
+        # the step-engine seam: ServingRouter(step_engine=...) sets
+        # this to match (sweep keeps the historical full rescan)
+        self.incremental = bool(incremental)
         # replica name -> LRU of prefix keys it has recently served
         self._affinity: Dict[str, "OrderedDict[bytes, None]"] = {}
+        # reverse index: prefix key -> replica names that recently
+        # served it (bounded by the sum of the per-replica LRUs) — the
+        # affinity probe becomes a dict hit instead of a scan of every
+        # candidate's LRU
+        self._affinity_index: Dict[bytes, Set[str]] = {}
+        # ---- placement index state -----------------------------------
+        # last capacity reading per replica, POST-placement (comparing
+        # the next round's fresh read against the round-end ledger is
+        # what makes "freed capacity" detectable)
+        self._last_free: Dict[str, Tuple[float, float]] = {}
+        self._cap_gen = 0
+        # (queue_gen, cap_gen) of a round that placed nothing — while
+        # unchanged, schedule() returns [] without scanning the window
+        self._idle_marker: Optional[tuple] = None
+        # ---- regression counters -------------------------------------
+        self.capacity_evals = 0   # (request x replica) fit checks
+        self.rounds = 0
+        self.rounds_skipped = 0   # short-circuited rounds
 
     # ------------------------------------------------------------ keys
     def prefix_key(self, prompt: np.ndarray) -> Optional[bytes]:
@@ -83,14 +142,47 @@ class ContinuousBatchScheduler:
         queued) any request no replica can currently hold.  Placed
         requests get a ``placement``-decision stamp on their trace
         (replica, candidate count, affinity hit) at ``now``."""
+        self.rounds += 1
         if not replicas:
             return []
-        # local capacity ledger: placements in this round consume it
-        free = {
-            h.name: [h.slots_free(), h.blocks_free()] for h in replicas
-        }
+        # capacity read, once per replica per round; generation bumps
+        # only on GROWTH vs the previous round's post-placement ledger
+        free: Dict[str, List[float]] = {}
+        grew = False
+        for h in replicas:
+            s, b = h.slots_free(), h.blocks_free()
+            free[h.name] = [s, b]
+            last = self._last_free.get(h.name)
+            if last is None or s > last[0] or b > last[1]:
+                grew = True
+        for name in list(self._last_free):
+            if name not in free:
+                # departed (or probation-hidden) replica: forget it so
+                # its return reads as fresh capacity
+                del self._last_free[name]
+        if grew:
+            self._cap_gen += 1
+        if not self.incremental:
+            placements = self._schedule_scan_all(
+                gateway, replicas, free, now)
+        else:
+            placements = self._schedule_indexed(
+                gateway, replicas, free, now)
+        # post-placement ledger: next round's growth test must compare
+        # against what this round LEFT, or a placement+completion pair
+        # inside one step would mask the freed capacity
+        for name, f in free.items():
+            self._last_free[name] = (f[0], f[1])
+        return placements
+
+    def _schedule_scan_all(
+        self, gateway, replicas, free, now,
+    ) -> List[Tuple[object, ServingRequest]]:
+        """The legacy full rescan (step_engine="sweep"): every queued
+        request in the window probes every replica, every round."""
         placements: List[Tuple[object, ServingRequest]] = []
         for req in gateway.schedule_scan(self.schedule_window):
+            self.capacity_evals += len(replicas)
             cands = [
                 h for h in replicas
                 if free[h.name][0] > 0
@@ -112,35 +204,132 @@ class ContinuousBatchScheduler:
                 cands,
                 key=lambda h: (free[h.name][0], free[h.name][1]),
             )
-            if not gateway.remove(req):
-                continue  # expired/cancelled between scan and placement
-            free[best.name][0] -= 1
-            free[best.name][1] -= self._need(best, req)
-            if key is not None:
-                self._remember(best.name, key)
-            if req.trace is not None:
-                # the placement DECISION span: queue wait ends here and
-                # the per-replica attempt begins, carrying why this
-                # replica won (affinity vs load) and how long the
-                # request waited (the histogram's per-trace twin)
-                extra = {} if now is None else {
-                    "queued_s": round(
-                        max(0.0, now - req.enqueued_at), 6)}
-                req.trace.placed(
-                    getattr(best, "name", "?"), now=now,
-                    candidates=len(cands), affinity=affinity_hit,
-                    **extra)
-            placements.append((best, req))
+            self._commit(gateway, placements, free, best, req,
+                         len(cands), affinity_hit, now)
         return placements
+
+    def _schedule_indexed(
+        self, gateway, replicas, free, now,
+    ) -> List[Tuple[object, ServingRequest]]:
+        """The incremental path: blocked-generation skip + lazy
+        candidate max-heap (see module docstring)."""
+        queue_gen = getattr(gateway, "queue_gen", None)
+        marker = (queue_gen, self._cap_gen)
+        if queue_gen is not None and self._idle_marker == marker:
+            self.rounds_skipped += 1
+            return []
+        by_name = {h.name: h for h in replicas}
+        # max-heap by (slots, blocks), name tiebreak; entries are
+        # invalidated lazily by comparing against the live ledger
+        heap = [
+            (-f[0], -f[1], name)
+            for name, f in free.items() if f[0] > 0
+        ]
+        heapq.heapify(heap)
+        placements: List[Tuple[object, ServingRequest]] = []
+        for req in gateway.schedule_scan(self.schedule_window):
+            if req.sched_blocked_gen == self._cap_gen:
+                continue  # nothing grew since every replica refused it
+            key = self.prefix_key(req.prompt)
+            best = None
+            affinity_hit = False
+            cand_count = 0
+            if key is not None:
+                affine = self._affinity_index.get(key)
+                if affine:
+                    fitting = []
+                    for name in affine:
+                        f = free.get(name)
+                        if f is None or f[0] <= 0:
+                            continue
+                        self.capacity_evals += 1
+                        if f[1] >= self._need(by_name[name], req):
+                            fitting.append((f[0], f[1], name))
+                    if fitting:
+                        best = by_name[max(fitting)[2]]
+                        affinity_hit = True
+                        cand_count = len(fitting)
+            if best is None:
+                # pop candidates in descending (slots, blocks) order;
+                # the first FITTING one is exactly the legacy "max
+                # over fitting candidates" pick
+                skipped: List[tuple] = []
+                while heap:
+                    neg_s, neg_b, name = heapq.heappop(heap)
+                    f = free.get(name)
+                    if f is None or f[0] <= 0 or \
+                            (-neg_s, -neg_b) != (f[0], f[1]):
+                        continue  # stale entry; a fresh one exists
+                    self.capacity_evals += 1
+                    cand_count += 1
+                    if f[1] >= self._need(by_name[name], req):
+                        best = by_name[name]
+                        skipped.append((neg_s, neg_b, name))
+                        break
+                    skipped.append((neg_s, neg_b, name))
+                for entry in skipped:
+                    heapq.heappush(heap, entry)
+            if best is None:
+                req.sched_blocked_gen = self._cap_gen
+                continue
+            placed = self._commit(
+                gateway, placements, free, best, req,
+                cand_count, affinity_hit, now)
+            if placed:
+                f = free[best.name]
+                if f[0] > 0:
+                    heapq.heappush(heap, (-f[0], -f[1], best.name))
+        self._idle_marker = marker if not placements else None
+        return placements
+
+    def _commit(self, gateway, placements, free, best, req,
+                cand_count: int, affinity_hit: bool, now) -> bool:
+        """Shared placement commit: remove from the gateway, charge the
+        round-local ledger, remember affinity, stamp the trace."""
+        if not gateway.remove(req):
+            return False  # expired/cancelled between scan and placement
+        f = free[best.name]
+        f[0] -= 1
+        f[1] -= self._need(best, req)
+        key = self.prefix_key(req.prompt)
+        if key is not None:
+            self._remember(best.name, key)
+        if req.trace is not None:
+            # the placement DECISION span: queue wait ends here and
+            # the per-replica attempt begins, carrying why this
+            # replica won (affinity vs load) and how long the
+            # request waited (the histogram's per-trace twin)
+            extra = {} if now is None else {
+                "queued_s": round(
+                    max(0.0, now - req.enqueued_at), 6)}
+            req.trace.placed(
+                getattr(best, "name", "?"), now=now,
+                candidates=cand_count, affinity=affinity_hit,
+                **extra)
+        placements.append((best, req))
+        return True
 
     def _remember(self, replica: str, key: bytes) -> None:
         lru = self._affinity.setdefault(replica, OrderedDict())
         lru[key] = None
         lru.move_to_end(key)
+        self._affinity_index.setdefault(key, set()).add(replica)
         while len(lru) > self.affinity_cap:
-            lru.popitem(last=False)
+            old, _ = lru.popitem(last=False)
+            self._unindex(old, replica)
+
+    def _unindex(self, key: bytes, replica: str) -> None:
+        names = self._affinity_index.get(key)
+        if names is not None:
+            names.discard(replica)
+            if not names:
+                del self._affinity_index[key]
 
     def forget_replica(self, replica: str) -> None:
         """Drop affinity state for a departed replica (its cache is gone
         with it — routing for warmth to a fresh process is pure loss)."""
-        self._affinity.pop(replica, None)
+        lru = self._affinity.pop(replica, None)
+        if lru:
+            for key in lru:
+                self._unindex(key, replica)
+        self._last_free.pop(replica, None)

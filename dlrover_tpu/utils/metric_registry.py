@@ -183,6 +183,32 @@ METRIC_HELP: Dict[str, str] = {
         "attribution, worker-reported for remote replicas "
         "(exemplars carry trace_ids)"
     ),
+    # -- router step-loop instrumentation (RouterMetrics, fed by -------
+    # -- ServingRouter.step; the measure-first half of the data-plane
+    # -- raw-speed discipline: attack what the histograms name)
+    "serving_step_lock_hold_seconds": (
+        "step-lock hold time per critical section of one router step "
+        "— every membership call and has_work reader contends on this "
+        "lock, so its tail IS the router's responsiveness tail"
+    ),
+    "serving_step_phase_seconds": (
+        "wall seconds per router step phase, labeled phase=\"expire|"
+        "cancel|brownout|failover|schedule|deliver|pump|retire|"
+        "observe|autoscale|flush\" — where one step round's time went "
+        "(deliver/flush run OUTSIDE the step lock by the DL007 "
+        "discipline; the rest hold it)"
+    ),
+    "serving_sched_capacity_evals_total": (
+        "scheduler (request x replica) capacity-fit evaluations — the "
+        "O(replicas x queued) product the incremental placement index "
+        "exists to kill; flat across steps while queue and capacity "
+        "are unchanged proves the fast path is engaged"
+    ),
+    "serving_sched_rounds_skipped_total": (
+        "placement rounds short-circuited because nothing changed "
+        "since a round that placed nothing (same queue generation, "
+        "same capacity generation) — the idle step's O(1) proof"
+    ),
     # -- per-worker supervisor state (WorkerSupervisor.render_worker_ --
     # -- state: one labeled sample per supervised worker)
     "serving_worker_state": (
@@ -462,6 +488,9 @@ METRIC_LABELS: Dict[str, tuple] = {
     # resolved paged-attention impl: vocabulary is the closed
     # {"xla", "pallas"} set (RouterMetrics.render_labeled)
     "serving_attention_impl": ("impl",),
+    # router step phases: the closed STEP_PHASES vocabulary in
+    # serving/router/metrics.py (one histogram series per phase)
+    "serving_step_phase_seconds": ("phase",),
     "serving_slo_compliance": ("band", "window"),
     "serving_slo_burn_rate": ("band", "window"),
     "serving_slo_budget_remaining": ("band",),

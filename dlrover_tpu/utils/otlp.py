@@ -140,18 +140,28 @@ def histogram_to_metric(snapshot: dict, now_unix: float) -> dict:
             "asDouble": float(value),
             "timeUnixNano": _nanos(ts),
         })
+    point = {
+        "bucketCounts": [str(c) for c in snapshot["counts"]],
+        "explicitBounds": list(snapshot["buckets"]),
+        "count": str(snapshot["count"]),
+        "sum": snapshot["sum"],
+        "timeUnixNano": _nanos(now_unix),
+        "exemplars": exemplars,
+    }
+    labels = snapshot.get("labels") or {}
+    if labels:
+        # constant-labeled histogram series (e.g. the router's
+        # step-phase family): the label set rides as dataPoint
+        # attributes, OTLP's equivalent of the Prometheus label pairs
+        point["attributes"] = [
+            {"key": k, "value": {"stringValue": str(v)}}
+            for k, v in sorted(labels.items())
+        ]
     return {
         "name": snapshot["name"],
         "histogram": {
             "aggregationTemporality": 2,  # cumulative
-            "dataPoints": [{
-                "bucketCounts": [str(c) for c in snapshot["counts"]],
-                "explicitBounds": list(snapshot["buckets"]),
-                "count": str(snapshot["count"]),
-                "sum": snapshot["sum"],
-                "timeUnixNano": _nanos(now_unix),
-                "exemplars": exemplars,
-            }],
+            "dataPoints": [point],
         },
     }
 

@@ -173,9 +173,17 @@ class Histogram:
     from the router's hot path."""
 
     def __init__(self, name: str, help_text: str = "",
-                 buckets: Optional[tuple] = None):
+                 buckets: Optional[tuple] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help_text = help_text
+        # constant label set rendered on every sample line (next to
+        # ``le``) — how one family fans out into a BOUNDED set of
+        # series (e.g. serving_step_phase_seconds{phase="pump"}); the
+        # label keys must be declared in metric_registry.METRIC_LABELS
+        # (dlint DL010) and the values must come from closed
+        # vocabularies, never per-request identifiers
+        self.labels = dict(labels) if labels else None
         self.buckets = tuple(sorted(buckets or log_buckets()))
         self._lock = threading.Lock()
         # one slot per bucket + overflow; counts are NON-cumulative
@@ -222,6 +230,7 @@ class Histogram:
         with self._lock:
             return {
                 "name": self.name,
+                "labels": dict(self.labels) if self.labels else {},
                 "buckets": list(self.buckets),
                 "counts": list(self._counts),
                 "exemplars": list(self._exemplars),
@@ -244,11 +253,17 @@ class Histogram:
         lines = [f"# TYPE {self.name} histogram"]
         if self.help_text:
             lines.append(f"# HELP {self.name} {self.help_text}")
+        extra = ""
+        if self.labels:
+            extra = ",".join(
+                f'{k}="{escape_label_value(str(v))}"'
+                for k, v in sorted(self.labels.items())) + ","
+        plain = "{" + extra.rstrip(",") + "}" if extra else ""
         cum = 0
         bounds = [self._fmt(b) for b in self.buckets] + ["+Inf"]
         for i, le in enumerate(bounds):
             cum += counts[i]
-            line = f'{self.name}_bucket{{le="{le}"}} {cum}'
+            line = f'{self.name}_bucket{{{extra}le="{le}"}} {cum}'
             ex = exemplars[i]
             if ex is not None:
                 tid, value, ts = ex
@@ -257,8 +272,8 @@ class Histogram:
                     f"{self._fmt(value)} {ts:.3f}"
                 )
             lines.append(line)
-        lines.append(f"{self.name}_count {total}")
-        lines.append(f"{self.name}_sum {self._fmt(total_sum)}")
+        lines.append(f"{self.name}_count{plain} {total}")
+        lines.append(f"{self.name}_sum{plain} {self._fmt(total_sum)}")
         return "\n".join(lines) + "\n"
 
 

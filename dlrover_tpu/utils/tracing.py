@@ -436,6 +436,18 @@ class Tracer:
                 return True
             return trace.sampled or trace.incident
 
+    def sampling_verdict(self, trace_id: str) -> bool:
+        """The head-sampling verdict stamped at trace creation —
+        immutable for the trace's lifetime (the incident override adds
+        retention on TOP of it, it never flips it off).  Callers cache
+        it: a sampled-IN trace can then build traceparents without
+        ever re-taking this lock, which matters on the submit hot path
+        (:meth:`RequestTrace.traceparent`).  Unknown traces read as
+        sampled (degrade toward keeping data)."""
+        with self._lock:
+            trace = self._find_locked(trace_id)
+            return True if trace is None else trace.sampled
+
     # ----------------------------------------------------------- graft
     def graft(self, trace_id: str, parent_span_id: str,
               spans: List[Dict[str, object]]) -> int:
@@ -693,6 +705,12 @@ class RequestTrace:
         self.attempt: Optional[Span] = None
         self.submit: Optional[Span] = None
         self.attempts = 0
+        # the sampling verdict is fixed at creation (incident only
+        # ADDS retention), so cache it once: sampled-in traces — the
+        # common case at rate 1.0 — then skip the tracer-lock round
+        # trip on every traceparent() the submit path makes, and
+        # sampled-out ones skip worker-span graft work entirely
+        self.sampled = tracer.sampling_verdict(self.root.trace_id)
 
     @property
     def trace_id(self) -> str:
@@ -733,7 +751,10 @@ class RequestTrace:
         knob a real cost knob end to end (an incident-marked trace
         resumes propagating: the failover retry's worker spans come
         back even at 1% sampling)."""
-        if not self.tracer.should_propagate(self.root.trace_id):
+        if not self.sampled and \
+                not self.tracer.should_propagate(self.root.trace_id):
+            # only sampled-OUT traces pay the tracer-lock round trip,
+            # and only to check the incident override
             return None
         parent = self.attempt or self.root
         return format_traceparent(self.root.trace_id, parent.span_id)

@@ -1197,12 +1197,15 @@ def test_faulty_rpc_stub_fault_mapping_and_ledger():
 # -- the fast acceptance -----------------------------------------------------
 
 
-def test_chaos_acceptance_fast_matrix(workers):
+@pytest.mark.parametrize("step_engine", ["event", "sweep"])
+def test_chaos_acceptance_fast_matrix(workers, step_engine):
     """In-thread acceptance: a 200-request stream over 4 workers while
     a seeded fault schedule tears one connection, stalls another
     worker's frames, and a third dies abruptly — plus a handful of
     client cancels — completes with zero lost requests and reclaimed
-    slots everywhere."""
+    slots everywhere.  Parameterized over BOTH step-engine candidates
+    (ISSUE 15): the zero-lost/books discipline must hold identically
+    under the event-driven loop and the historical sweep."""
     tear = FaultSchedule(
         [{"op": "tear", "kind": "TOKEN", "after": 60}], seed=11)
     stall = FaultSchedule(
@@ -1211,6 +1214,7 @@ def test_chaos_acceptance_fast_matrix(workers):
     router = ServingRouter(
         scheduler=ContinuousBatchScheduler(block_size=4),
         cancel_inflight_on_expiry=True,
+        step_engine=step_engine,
     )
     fleet = {
         "torn": workers(fault_schedule=tear, slots=4,
@@ -1813,17 +1817,21 @@ needs_spawn = pytest.mark.skipif(
 
 @pytest.mark.slow
 @needs_spawn
-def test_chaos_acceptance_full_matrix_subprocess():
+@pytest.mark.parametrize("step_engine", ["event", "sweep"])
+def test_chaos_acceptance_full_matrix_subprocess(step_engine):
     """THE acceptance: real worker processes under a seeded fault
     schedule — one torn connection, one heartbeat stall, one SIGKILL,
     one crash-looping worker — serve a 200-request stream with zero
     lost requests; cancelled requests reclaim their slots; the crash
-    looper's respawn gaps strictly increase and end in quarantine."""
+    looper's respawn gaps strictly increase and end in quarantine.
+    Parameterized over both step engines (ISSUE 15): the SIGKILL
+    matrix must balance its books identically under each."""
     import signal as signal_mod
 
     router = ServingRouter(
         scheduler=ContinuousBatchScheduler(block_size=4),
         cancel_inflight_on_expiry=True,
+        step_engine=step_engine,
     )
     base_args = ["--slots", "4", "--tokens-per-step", "2",
                  "--step-delay", "0.005"]

@@ -1391,12 +1391,14 @@ def test_brownout_shed_answers_carry_retry_after_hint():
     assert bo2.expected_recovery_s(0.0) == 0.0
 
 
-def test_brownout_sheds_batch_then_normal_never_high():
+@pytest.mark.parametrize("step_engine", ["event", "sweep"])
+def test_brownout_sheds_batch_then_normal_never_high(step_engine):
     """The ordered-degradation acceptance: stage 1 rejects new BATCH,
     stage 2 expiry-cancels queued + in-flight BATCH through the cancel
     machinery, stage 3 rejects NORMAL — HIGH admits and completes
     through the whole brown-out, and recovery walks the ladder back
-    down."""
+    down.  Parameterized over both step engines (ISSUE 15): the shed
+    ORDER is a books-balance contract, not an implementation detail."""
     from dlrover_tpu.serving.router import (
         BrownoutPolicy,
         BrownoutShedError,
@@ -1407,6 +1409,7 @@ def test_brownout_sheds_batch_then_normal_never_high():
     router = ServingRouter(
         scheduler=ContinuousBatchScheduler(block_size=4),
         brownout=bo,
+        step_engine=step_engine,
     )
     eng = FakeEngine(slots=2, tokens_per_step=2)
     t = 1000.0

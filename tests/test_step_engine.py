@@ -1,0 +1,832 @@
+"""Step-engine suite (ISSUE 15): the router data-plane rebuild.
+
+Covers the seam itself (event loop vs historical sweep vs the sharded
+front), the incremental placement index's no-rescan guarantee (the
+scheduling-decision-count regression pin the acceptance criteria
+name), the event-driven cancel/expiry sweeps, batched frame drains,
+the step-phase/step-lock histograms on /metrics, the full-pipeline
+open-loop rig, and the satellites (cached worker trace headers, the
+sampled traceparent fast path).
+
+The equivalence test is the safety net under the whole refactor: the
+same seeded workload — mixed priorities, cancels, an expiry, a replica
+failure — must reach the SAME terminal state and output per submitted
+request under the old sweep, the event loop, and the sharded front.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+msgpack = pytest.importorskip(
+    "msgpack", reason="remote fabric frames are msgpack")
+
+from dlrover_tpu.common.constants import (  # noqa: E402
+    ServingRequestState,
+)
+from dlrover_tpu.serving.remote.protocol import (  # noqa: E402
+    FrameConnection,
+    FrameKind,
+)
+from dlrover_tpu.serving.remote.worker import (  # noqa: E402
+    FakeEngine,
+    WorkerServer,
+)
+from dlrover_tpu.serving.router import (  # noqa: E402
+    PRIORITY_BATCH,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    BrownoutPolicy,
+    BrownoutShedError,
+    ContinuousBatchScheduler,
+    RequestGateway,
+    RouterMetrics,
+    ServingRouter,
+    ShardedRouterFront,
+)
+from dlrover_tpu.serving.router.loadgen import (  # noqa: E402
+    LoadgenConfig,
+    run_router_rig,
+)
+from dlrover_tpu.serving.router.stepengine import shard_of  # noqa: E402
+
+
+def _prompt(i, n=8):
+    return np.full(n, i % 251, np.int32)
+
+
+def _router(step_engine, **kw):
+    return ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        step_engine=step_engine, **kw)
+
+
+# -- the seam ----------------------------------------------------------------
+
+
+def test_step_engine_validation():
+    with pytest.raises(ValueError):
+        ServingRouter(step_engine="warp")
+    r = ServingRouter(step_engine="sweep")
+    assert r.gateway.incremental is False
+    assert r.scheduler.incremental is False
+    r = ServingRouter()  # shipped default = the measured winner
+    assert r.step_engine == "event"
+    assert r.gateway.incremental is True
+    assert r.scheduler.incremental is True
+
+
+def test_sharded_front_partitions_by_rid_hash():
+    front = ShardedRouterFront(num_shards=3)
+    for i in range(3):
+        front.shards[i].join_replica(
+            f"r{i}", FakeEngine(slots=8, tokens_per_step=8))
+    reqs = [front.submit(_prompt(i), 4) for i in range(30)]
+    per_shard = [s.gateway.submitted for s in front.shards]
+    assert sum(per_shard) == 30
+    assert all(n > 0 for n in per_shard), per_shard
+    front.run_until_idle()
+    for r in reqs:
+        assert r.state == ServingRequestState.DONE
+    # the partition function itself is deterministic and total
+    assert {shard_of(rid, 3) for rid in range(100)} == {0, 1, 2}
+
+
+# -- placement fast path: the scheduling-decision-count pin ------------------
+
+
+def test_placement_idle_cost_does_not_scale():
+    """THE regression pin from the acceptance criteria: with R
+    replicas all busy and Q queued requests nothing can place, the
+    event engine's per-step placement cost must NOT scale with R x Q —
+    after the round that blocks them, further steps do ZERO capacity
+    evaluations until capacity actually grows.  The sweep twin shows
+    the product the index kills."""
+    R, Q = 32, 200
+    evals = {}
+    for engine in ("sweep", "event"):
+        router = _router(engine)
+        for i in range(R):
+            router.join_replica(
+                f"r{i}", FakeEngine(slots=1, tokens_per_step=1,
+                                    max_len=4096))
+        # pin every slot with a long job (well under max_len)
+        pins = [router.submit(_prompt(i), 2000, timeout=None)
+                for i in range(R)]
+        for _ in range(2):
+            router.step()
+        assert all(p.state == ServingRequestState.RUNNING
+                   for p in pins)
+        blocked = [router.submit(_prompt(i), 8, timeout=None)
+                   for i in range(Q)]
+        router.step()  # the round that blocks them
+        e0 = router.scheduler.capacity_evals
+        for _ in range(10):
+            router.step()
+        evals[engine] = router.scheduler.capacity_evals - e0
+        assert all(b.state == ServingRequestState.QUEUED
+                   for b in blocked)
+        if engine == "event":
+            # the round short-circuit engaged for the idle steps
+            assert router.scheduler.rounds_skipped >= 8
+        else:
+            assert router.scheduler.rounds_skipped == 0
+    assert evals["event"] == 0, (
+        f"idle entries must cost zero fit evaluations, got "
+        f"{evals['event']}")
+    # the sweep's cost is the (replicas x window) product, every step
+    assert evals["sweep"] >= 10 * R * min(Q, 64) * 0.9
+
+
+def test_capacity_growth_unblocks_requests():
+    """The flip side of the pin: blocked requests MUST re-scan as soon
+    as any replica's capacity grows — a stale blocked stamp that
+    outlives freed capacity would strand the queue."""
+    router = _router("event")
+    eng = FakeEngine(slots=1, tokens_per_step=4, max_len=4096)
+    router.join_replica("r0", eng)
+    pin = router.submit(_prompt(0), 2000, timeout=None)
+    router.step()
+    assert pin.state == ServingRequestState.RUNNING
+    blocked = [router.submit(_prompt(i), 8, timeout=None)
+               for i in range(5)]
+    for _ in range(5):
+        router.step()
+    assert all(b.state == ServingRequestState.QUEUED for b in blocked)
+    # withdraw the pin -> slot frees -> capacity generation bumps ->
+    # blocked requests place, one at a time, until all complete
+    pin.cancel()
+    deadline = time.monotonic() + 10.0
+    while router.has_work and time.monotonic() < deadline:
+        router.step()
+    assert pin.state == ServingRequestState.CANCELLED
+    for b in blocked:
+        assert b.state == ServingRequestState.DONE, (b.rid, b.state)
+
+
+def test_queue_removal_invalidates_idle_marker():
+    """Review-found starvation regression: a window full of
+    unplaceable requests blocks everything behind it; when they leave
+    the queue WITHOUT a placement or an admission (deadline expiry
+    here — cancellation and brown-out shed are the same class), the
+    scheduler's idle short-circuit must invalidate, or the now-visible
+    placeable requests behind the window starve forever while the
+    fleet sits idle."""
+    t = 3000.0
+    router = _router("event")
+    # one replica with a tiny KV budget: big requests can never fit
+    eng = FakeEngine(slots=4, tokens_per_step=4, block_size=4,
+                     blocks=20, max_len=4096)
+    router.join_replica("r0", eng, now=t)
+    # a full schedule window of unplaceable requests with a deadline
+    big = [router.submit(_prompt(i, n=64), 512, timeout=1.0, now=t)
+           for i in range(64)]
+    # placeable requests stuck BEHIND the window
+    small = [router.submit(_prompt(i), 4, timeout=None, now=t)
+             for i in range(4)]
+    router.step(now=t)
+    assert all(b.state == ServingRequestState.QUEUED for b in big)
+    assert all(s.state == ServingRequestState.QUEUED for s in small)
+    # the big ones expire out of the queue; nothing else changes —
+    # no admission, no capacity growth
+    router.step(now=t + 1.5)
+    assert all(b.state == ServingRequestState.TIMED_OUT for b in big)
+    # the smalls must now enter the window and complete
+    for _ in range(10):
+        router.step(now=t + 2.0)
+        if not router.has_work:
+            break
+    for s in small:
+        assert s.state == ServingRequestState.DONE, (s.rid, s.state)
+
+
+def test_affinity_reverse_index_consistency():
+    """Affinity placement must survive the index rebuild: a replica
+    that served a prefix wins its next request, and forgetting the
+    replica cleans the reverse index."""
+    sched = ContinuousBatchScheduler(block_size=4, prefix_tokens=8,
+                                     incremental=True)
+    gw = RequestGateway()
+    gw.incremental = True
+    engines = {name: FakeEngine(slots=4, tokens_per_step=8)
+               for name in ("a", "b")}
+
+    class H:
+        def __init__(self, name, eng):
+            self.name, self.eng = name, eng
+
+        def slots_free(self):
+            return self.eng.slots_free()
+
+        def blocks_free(self):
+            return self.eng.blocks_free()
+
+    handles = [H(n, e) for n, e in engines.items()]
+    prompt = np.arange(16, dtype=np.int32)
+    r1 = gw.submit(prompt, 4)
+    placed = sched.schedule(gw, handles)
+    assert len(placed) == 1
+    winner = placed[0][0].name
+    key = sched.prefix_key(prompt)
+    assert winner in sched._affinity_index[key]
+    # same prefix again: the warm replica must win even if the other
+    # is less loaded
+    engines[winner].active[99] = {"remaining": 1, "output": [],
+                                  "blocks": 0}
+    r2 = gw.submit(prompt, 4)
+    placed = sched.schedule(gw, handles)
+    assert placed[0][0].name == winner
+    sched.forget_replica(winner)
+    assert key not in sched._affinity_index
+    assert r1.state == r2.state  # both left the queue identically
+
+
+# -- event-driven sweeps -----------------------------------------------------
+
+
+@pytest.mark.parametrize("step_engine", ["event", "sweep"])
+def test_cancel_queued_and_inflight_accounting(step_engine):
+    """Queued and in-flight withdrawals answer their callers and
+    balance the books identically under both engines."""
+    router = _router(step_engine)
+    eng = FakeEngine(slots=2, tokens_per_step=1, max_len=4096)
+    router.join_replica("r0", eng)
+    inflight = [router.submit(_prompt(i), 100) for i in range(2)]
+    router.step()
+    assert all(r.state == ServingRequestState.RUNNING
+               for r in inflight)
+    queued = [router.submit(_prompt(i), 8) for i in range(3)]
+    assert inflight[0].cancel()
+    assert queued[1].cancel()
+    router.step()
+    assert inflight[0].state == ServingRequestState.CANCELLED
+    assert queued[1].state == ServingRequestState.CANCELLED
+    assert router.gateway.cancelled == 2
+    # the engine slot was reclaimed (CANCEL delivered locally)
+    assert inflight[0].engine_rid not in eng.active
+    # double-cancel of a terminal request is refused and changes
+    # nothing
+    assert not inflight[0].cancel()
+    router.step()
+    assert router.gateway.cancelled == 2
+
+
+def test_double_cancel_counts_once_event_engine():
+    """Review-found books regression: a client retrying cancel() (or
+    racing threads) must not inflate the cancelled counter — cancel()
+    is idempotent at the source and the event drain dedupes by
+    identity as the belt."""
+    router = _router("event")
+    router.join_replica(
+        "r0", FakeEngine(slots=1, tokens_per_step=1, max_len=4096))
+    req = router.submit(_prompt(1), 8)
+    assert req.cancel()
+    assert req.cancel()  # retry: accepted, but one event only
+    router.step()
+    assert req.state == ServingRequestState.CANCELLED
+    assert router.gateway.cancelled == 1
+    assert router.gateway.submitted == 1
+
+
+def test_duplicate_heap_entries_expire_once():
+    """Review-found books regression: a failover requeue pushes a
+    SECOND deadline-heap entry for the same request; when the deadline
+    passes while it is QUEUED, expire() must count it once, not once
+    per entry."""
+    t = 2000.0
+    gw = RequestGateway()
+    req = gw.submit(_prompt(1), 4, timeout=5.0, now=t)
+    gw.remove(req)
+    req.state = ServingRequestState.RUNNING  # placed on a replica
+    # the replica dies: requeue_front re-pushes a heap entry
+    assert gw.requeue_front([req], now=t + 1.0) == []
+    assert req.state == ServingRequestState.QUEUED
+    expired = gw.expire(now=t + 6.0)
+    assert expired == [req]
+    assert gw.timed_out == 1
+    assert req.state == ServingRequestState.TIMED_OUT
+
+
+def test_deadline_heap_expiry_edges():
+    """The event engine's heap must reproduce the sweep's strict
+    ``now > deadline`` semantics: timeout=0 expires on the NEXT step
+    (not at now == deadline), and a failover-requeued request whose
+    deadline passed while RUNNING still expires promptly."""
+    t = 1000.0
+    router = _router("event")
+    req = router.submit(_prompt(1), 4, timeout=0.0, now=t)
+    router.step(now=t)   # now == deadline: strict >, stays queued
+    assert req.state == ServingRequestState.QUEUED
+    router.step(now=t + 0.001)
+    assert req.state == ServingRequestState.TIMED_OUT
+
+    # requeue-past-deadline: RUNNING through its deadline under the
+    # let-it-finish policy, then the replica dies -> requeue -> the
+    # replay must expire, not sit in the queue forever
+    router = _router("event")
+    eng = FakeEngine(slots=1, tokens_per_step=1, max_len=4096)
+    router.join_replica("r0", eng, now=t)
+    req = router.submit(_prompt(2), 1000, timeout=5.0, now=t)
+    router.step(now=t)
+    assert req.state == ServingRequestState.RUNNING
+    router.step(now=t + 6.0)  # past deadline; policy lets it run
+    assert req.state == ServingRequestState.RUNNING
+    router.fail_replica("r0")
+    router.step(now=t + 7.0)  # failover requeues...
+    router.step(now=t + 7.1)  # ...and the re-armed heap expires it
+    assert req.state == ServingRequestState.TIMED_OUT
+
+
+def test_cancel_inflight_on_expiry_event_engine():
+    """The expiry-cancel policy rides the deadline heap: a RUNNING
+    request past its deadline aborts and frees its engine slot."""
+    t = 1000.0
+    router = _router("event", cancel_inflight_on_expiry=True)
+    eng = FakeEngine(slots=1, tokens_per_step=1, max_len=4096)
+    router.join_replica("r0", eng, now=t)
+    req = router.submit(_prompt(1), 1000, timeout=2.0, now=t)
+    router.step(now=t)
+    assert req.state == ServingRequestState.RUNNING
+    router.step(now=t + 2.5)
+    assert req.state == ServingRequestState.TIMED_OUT
+    assert req.engine_rid not in eng.active, "slot must be reclaimed"
+    assert router.gateway.timed_out == 1
+
+
+# -- equivalence: same seeded workload, same terminal states -----------------
+
+
+def _replay_workload(router):
+    """One seeded mixed workload: three priority bands, two cancels, a
+    replica failure mid-run.  Returns the per-submission-index
+    (state, output length) list — output VALUES differ legitimately
+    across engines (FakeEngine tokens encode the engine-local rid, and
+    placement distribution is allowed to differ); outcomes may not."""
+    t = 5000.0
+    engines = [FakeEngine(slots=2, tokens_per_step=2, max_len=4096)
+               for _ in range(4)]
+    for i, eng in enumerate(engines):
+        router.join_replica(f"r{i}", eng, now=t)
+    reqs = []
+    bands = [PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_NORMAL,
+             PRIORITY_BATCH]
+    for i in range(60):
+        reqs.append(router.submit(
+            _prompt(i), 8, priority=bands[i % 4],
+            timeout=None if i % 7 else 300.0, now=t))
+    for step in range(400):
+        t += 0.05
+        router.step(now=t)
+        if step == 2:
+            reqs[5].cancel()
+            reqs[40].cancel()
+        if step == 4:
+            # kill one replica: its in-flight requests fail over
+            target = (router.shard_of_replica("r1")
+                      if isinstance(router, ShardedRouterFront)
+                      else router)
+            if target is not None:
+                target.fail_replica("r1")
+        if not router.has_work:
+            break
+    return [(r.state, len(r.output)) for r in reqs]
+
+
+@pytest.mark.parametrize("candidate", ["sweep", "sharded"])
+def test_step_engine_equivalence_terminal_states(candidate):
+    """Same seeded workload -> same terminal state and output per
+    submitted request under the event loop (the shipped default) and
+    each other candidate.  Placement DISTRIBUTION may differ (the
+    index breaks capacity ties by name, shards partition replicas);
+    request OUTCOME may not."""
+    baseline = _replay_workload(_router("event"))
+    if candidate == "sweep":
+        other = _replay_workload(_router("sweep"))
+    else:
+        front = ShardedRouterFront(
+            num_shards=2, threaded=False,
+            router_factory=lambda i: _router("event"))
+        other = _replay_workload(front)
+    assert len(baseline) == len(other)
+    for i, (a, b) in enumerate(zip(baseline, other)):
+        assert a == b, f"submission {i}: event={a} {candidate}={b}"
+    # the workload exercised what it claims to
+    states = {s for s, _ in baseline}
+    assert ServingRequestState.DONE in states
+    assert ServingRequestState.CANCELLED in states
+
+
+def test_failover_equivalence_zero_lost():
+    """A replica failure mid-run balances the books under every
+    engine: every request terminal, requeues observed, zero poisoned."""
+    for make in (
+        lambda: _router("event"),
+        lambda: _router("sweep"),
+        lambda: ShardedRouterFront(
+            num_shards=2, threaded=False,
+            router_factory=lambda i: _router("event")),
+    ):
+        router = make()
+        t = 7000.0
+        for i in range(4):
+            router.join_replica(
+                f"r{i}", FakeEngine(slots=2, tokens_per_step=1,
+                                    max_len=4096), now=t)
+        reqs = [router.submit(_prompt(i), 12, now=t)
+                for i in range(40)]
+        for step in range(500):
+            t += 0.05
+            router.step(now=t)
+            if step == 3:
+                if isinstance(router, ShardedRouterFront):
+                    victim = router.replica_names[0]
+                    router.shard_of_replica(victim).fail_replica(
+                        victim)
+                else:
+                    router.fail_replica("r0")
+            if not router.has_work:
+                break
+        for r in reqs:
+            assert r.state == ServingRequestState.DONE, (
+                r.rid, r.state)
+        if isinstance(router, ShardedRouterFront):
+            counters = router.counters()
+            assert counters["serving_requests_requeued_total"] >= 1
+            assert counters["serving_requests_poisoned_total"] == 0
+        else:
+            m = router.metrics.metrics()
+            assert m["serving_requests_requeued_total"] >= 1
+            assert m["serving_requests_poisoned_total"] == 0
+
+
+# -- sharded front: threads, shared brown-out, remote chaos ------------------
+
+
+def test_sharded_front_threaded_books_balance():
+    front = ShardedRouterFront(num_shards=2, threaded=True)
+    for i in range(4):
+        front.join_replica(
+            f"r{i}", FakeEngine(slots=8, tokens_per_step=8))
+    front.start()
+    try:
+        reqs = [front.submit(_prompt(i), 8) for i in range(200)]
+        deadline = time.monotonic() + 30.0
+        while front.has_work and time.monotonic() < deadline:
+            time.sleep(0.005)
+        for r in reqs:
+            assert r.state == ServingRequestState.DONE, (
+                r.rid, r.state)
+        counters = front.counters()
+        assert counters["serving_requests_submitted_total"] == 200
+        assert counters["serving_requests_completed_total"] == 200
+    finally:
+        front.stop()
+
+
+def test_sharded_front_shared_brownout_sheds_every_shard():
+    """The shared brown-out view: the FRONT updates one policy with
+    fleet-global pressure; once the ladder enters shed_batch, EVERY
+    shard's gateway refuses BATCH — a shard with a locally-empty queue
+    must shed too (per-shard watermarks would not)."""
+    bo = BrownoutPolicy(enter_pressure=2.0, exit_pressure=0.5,
+                        dwell_seconds=0.5)
+    front = ShardedRouterFront(
+        num_shards=2, threaded=False, brownout=bo,
+        router_factory=lambda i: ServingRouter(
+            scheduler=ContinuousBatchScheduler(block_size=4)))
+    # capacity exists on shard 0 only; demand floods both queues
+    front.shards[0].join_replica(
+        "r0", FakeEngine(slots=1, tokens_per_step=1, max_len=4096))
+    t = 9000.0
+    front.step(now=t)
+    for i in range(40):
+        front.submit(_prompt(i), 500, priority=PRIORITY_NORMAL, now=t)
+    front.step(now=t)
+    front.step(now=t + 0.6)   # dwell earned -> stage 1
+    assert bo.stage == 1
+    for shard in front.shards:
+        with pytest.raises(BrownoutShedError):
+            shard.submit(_prompt(99), 4, priority=PRIORITY_BATCH,
+                         now=t + 0.7)
+    # both shards applied the externally-decided stage to metrics
+    for shard in front.shards:
+        assert shard.metrics.brownout_stage == 1.0
+
+
+class _ThreadedWorker:
+    def __init__(self, **engine_kw):
+        self.engine = FakeEngine(**engine_kw)
+        self.server = WorkerServer(self.engine)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.server.crash()
+
+
+def test_sharded_front_remote_chaos_zero_lost():
+    """The sharded twin of the chaos acceptance: remote workers behind
+    the front's independent (threaded) step loops, one killed abruptly
+    mid-stream — zero lost requests, books balance fleet-wide."""
+    from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+
+    workers = [_ThreadedWorker(slots=4, tokens_per_step=2,
+                               step_delay=0.002) for _ in range(4)]
+    front = ShardedRouterFront(num_shards=2, threaded=True)
+    try:
+        for i, w in enumerate(workers):
+            front.join_replica(
+                f"w{i}", RemoteReplicaHandle(
+                    w.server.addr, name=f"w{i}", frame_timeout=1.0))
+        front.start()
+        reqs = [front.submit(_prompt(i), 8) for i in range(120)]
+        # kill one worker once it holds in-flight requests
+        victim = None
+        deadline = time.monotonic() + 20.0
+        while victim is None and time.monotonic() < deadline:
+            for i, w in enumerate(workers):
+                shard = front.shard_of_replica(f"w{i}")
+                handle = shard.manager.get(f"w{i}") if shard else None
+                if handle is not None and handle.inflight:
+                    victim = i
+                    break
+            time.sleep(0.005)
+        assert victim is not None
+        workers[victim].stop()
+        deadline = time.monotonic() + 45.0
+        while front.has_work and time.monotonic() < deadline:
+            time.sleep(0.01)
+        lost = [r for r in reqs
+                if r.state != ServingRequestState.DONE]
+        assert not lost, [(r.rid, r.state) for r in lost]
+        counters = front.counters()
+        assert counters["serving_requests_completed_total"] == 120
+        assert counters["serving_requests_requeued_total"] >= 1
+        assert counters["serving_requests_poisoned_total"] == 0
+    finally:
+        front.stop()
+        for w in workers:
+            w.stop()
+
+
+# -- instrumentation on /metrics ---------------------------------------------
+
+
+def test_step_phase_and_lock_histograms_render():
+    """The measure-first half of the acceptance: step-lock hold time
+    and per-phase step histograms are registered families rendered on
+    the same surface as the latency histograms, with samples after one
+    step."""
+    from dlrover_tpu.serving.router.metrics import STEP_PHASES
+    from dlrover_tpu.utils.metric_registry import (
+        METRIC_HELP,
+        METRIC_LABELS,
+    )
+
+    assert "serving_step_lock_hold_seconds" in METRIC_HELP
+    assert "serving_step_phase_seconds" in METRIC_HELP
+    assert METRIC_LABELS["serving_step_phase_seconds"] == ("phase",)
+
+    router = _router("event")
+    router.join_replica("r0", FakeEngine(slots=2, tokens_per_step=4))
+    reqs = [router.submit(_prompt(i), 4) for i in range(4)]
+    deadline = time.monotonic() + 10.0
+    while router.has_work and time.monotonic() < deadline:
+        router.step()
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    text = router.metrics.render_histograms()
+    assert "serving_step_lock_hold_seconds_bucket" in text
+    # every phase renders as one labeled series of the SAME family,
+    # with exactly one TYPE header for it
+    for phasename in STEP_PHASES:
+        assert f'serving_step_phase_seconds_bucket{{phase="{phasename}"' \
+            in text, phasename
+    assert text.count("# TYPE serving_step_phase_seconds ") == 1
+    # the hot phases actually observed samples
+    assert router.metrics.step_phase_hists["pump"].count > 0
+    assert router.metrics.step_phase_hists["schedule"].count > 0
+    assert router.metrics.step_lock_hist.count > 0
+    # and the scheduler counters reached the scrape dict
+    m = router.metrics.metrics()
+    assert "serving_sched_capacity_evals_total" in m
+    assert "serving_sched_rounds_skipped_total" in m
+
+
+# -- batched frame drains ----------------------------------------------------
+
+
+def test_recv_many_batches_and_defers_mid_batch_state():
+    """recv_many returns the first frame plus everything buffered
+    behind it; a clean EOF at a frame boundary ends the batch and the
+    NEXT call reports it."""
+    import socket
+
+    a, b = socket.socketpair()
+    tx = FrameConnection(a)
+    rx = FrameConnection(b)
+    for i in range(5):
+        tx.send(FrameKind.TOKEN, rid=i, tokens=[i])
+    time.sleep(0.05)  # let the bytes land in rx's kernel buffer
+    frames = rx.recv_many(timeout=1.0)
+    assert [f["rid"] for f in frames] == [0, 1, 2, 3, 4]
+    tx.send(FrameKind.GOODBYE)
+    a.close()
+    frames = rx.recv_many(timeout=1.0)
+    assert [f["kind"] for f in frames] == [FrameKind.GOODBYE]
+    assert rx.recv_many(timeout=1.0) is None  # clean EOF
+    rx.close()
+
+
+def test_proxy_coalesces_token_storm_into_batches():
+    """Under a token storm the proxy's reader crosses its lock once
+    per BATCH: frames_received grows much faster than frame_batches,
+    and the drained events still carry every token in order."""
+    from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+
+    w = _ThreadedWorker(slots=8, tokens_per_step=4)
+    try:
+        proxy = RemoteReplicaHandle(w.server.addr, name="storm")
+        router = _router("event")
+        router.join_replica("storm", proxy)
+        reqs = [router.submit(_prompt(i), 64) for i in range(8)]
+        deadline = time.monotonic() + 30.0
+        while router.has_work and time.monotonic() < deadline:
+            router.step()
+            time.sleep(0.001)
+        for r in reqs:
+            assert r.state == ServingRequestState.DONE
+            assert len(r.output) == 64
+        assert proxy.frames_received > 50
+        assert proxy.frame_batches < proxy.frames_received, (
+            "batching never coalesced anything: "
+            f"{proxy.frame_batches} batches for "
+            f"{proxy.frames_received} frames")
+        proxy.close()
+    finally:
+        w.stop()
+
+
+# -- the full-pipeline rig ---------------------------------------------------
+
+
+def test_router_rig_full_pipeline_books_balance():
+    """The fast twin of the bench gate: a small open-loop schedule
+    through the whole pipeline — zero lost, books balancing, e2e
+    percentiles measured from the requests themselves."""
+    router = _router("event", gateway=RequestGateway(
+        max_pending=4096, default_timeout=10.0))
+    for i in range(4):
+        router.join_replica(
+            f"r{i}", FakeEngine(slots=32, tokens_per_step=8,
+                                blocks=500_000))
+    rig = run_router_rig(
+        router,
+        LoadgenConfig(rate_qps=1500, duration_s=0.5, seed=3,
+                      max_new_tokens=8))
+    assert rig["router_admitted"] > 200
+    assert rig["router_lost"] == 0
+    assert rig["router_poisoned"] == 0
+    assert rig["router_books_ok"]
+    assert rig["router_completed"] == rig["router_admitted"]
+    assert rig["router_qps"] > 0
+    assert rig["router_e2e_p99_s"] > 0
+
+
+def test_router_rig_mid_flight_cancels_keep_books():
+    """cancel_every drives the withdrawal machinery at rate: books
+    still balance with cancels in the mix."""
+    router = _router("event", gateway=RequestGateway(
+        max_pending=4096, default_timeout=10.0))
+    for i in range(2):
+        router.join_replica(
+            f"r{i}", FakeEngine(slots=8, tokens_per_step=2,
+                                blocks=500_000))
+    rig = run_router_rig(
+        router,
+        LoadgenConfig(rate_qps=800, duration_s=0.5, seed=5,
+                      max_new_tokens=16),
+        cancel_every=10)
+    assert rig["router_lost"] == 0
+    assert rig["router_poisoned"] == 0
+    assert rig["router_books_ok"]
+    assert rig["router_cancel_attempts"] > 0
+    assert rig["router_by_state"].get(
+        ServingRequestState.CANCELLED, 0) > 0
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_worker_trace_header_cached_per_request():
+    """The TOKEN-frame trace echo is built once per request, not once
+    per frame — and a sampled-out request ships no trace bytes."""
+    server = WorkerServer(FakeEngine(slots=2))
+    try:
+        server._trace_by_erid[7] = {
+            "trace": "00-" + "a" * 32 + "-" + "b" * 16 + "-01",
+            "t0": 0.0, "t_first": None, "steps": 0, "engine_s": 0.0,
+            "hdr": {"trace": "00-" + "a" * 32 + "-" + "b" * 16
+                    + "-01"},
+        }
+        h1 = server._trace_header(7)
+        h2 = server._trace_header(7)
+        assert h1 is h2, "header must be the cached per-request dict"
+        assert server._trace_header(99) == {}
+    finally:
+        server.crash()
+
+
+def test_traceparent_sampled_fast_path(monkeypatch):
+    """A sampled-IN trace builds its traceparent without consulting
+    the tracer (no lock round trip per submit); a sampled-OUT one
+    still honors the incident override through should_propagate."""
+    from dlrover_tpu.utils.tracing import RequestTrace, Tracer
+
+    tracer = Tracer(sample_rate=1.0)
+    rt = RequestTrace(tracer, 1)
+    assert rt.sampled is True
+    calls = {"n": 0}
+    real = tracer.should_propagate
+
+    def counting(trace_id):
+        calls["n"] += 1
+        return real(trace_id)
+
+    monkeypatch.setattr(tracer, "should_propagate", counting)
+    assert rt.traceparent() is not None
+    assert calls["n"] == 0, "sampled-in must skip the tracer lock"
+
+    # sampled-out: propagation denied until the incident override
+    tracer = Tracer(sample_rate=0.0)
+    rt = RequestTrace(tracer, 2)
+    assert rt.sampled is False
+    assert rt.traceparent() is None
+    tracer.mark_incident(rt.root.trace_id, "failover")
+    assert rt.traceparent() is not None
+
+
+def test_sampled_out_done_frames_skip_span_work():
+    """End-to-end: at sample_rate=0.0 a remote completion carries no
+    spans and grafts nothing — the frame path pays no tracing cost the
+    knob was meant to shed; incidents still keep their trace."""
+    from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+
+    w = _ThreadedWorker(slots=4, tokens_per_step=4)
+    try:
+        router = ServingRouter(
+            gateway=RequestGateway(trace_sample_rate=0.0),
+            scheduler=ContinuousBatchScheduler(block_size=4))
+        proxy = RemoteReplicaHandle(w.server.addr, name="w")
+        router.join_replica("w", proxy)
+        reqs = [router.submit(_prompt(i), 8) for i in range(4)]
+        deadline = time.monotonic() + 20.0
+        while router.has_work and time.monotonic() < deadline:
+            router.step()
+            time.sleep(0.002)
+        for r in reqs:
+            assert r.state == ServingRequestState.DONE
+            assert r.trace.sampled is False
+        assert router.tracer.orphan_spans_total == 0
+        # nothing retained: the knob bit end to end
+        assert router.tracer.dropped_total == 4
+        proxy.close()
+    finally:
+        w.stop()
+
+
+# -- the nightly soak --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_router_open_loop_soak_60s():
+    """Nightly: 60s of full-router open-loop traffic — a bursty
+    segment then a diurnal segment, heavy-tail prompts, mid-flight
+    cancels every 50 admissions — books balance and nothing is lost
+    or poisoned at the end of each segment."""
+    for arrival, seed in (("bursty", 11), ("diurnal", 13)):
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=8192, default_timeout=10.0,
+                trace_sample_rate=0.01),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+            metrics=RouterMetrics(window_seconds=5.0),
+        )
+        for i in range(8):
+            router.join_replica(
+                f"r{i}", FakeEngine(slots=64, tokens_per_step=8,
+                                    blocks=2_000_000))
+        rig = run_router_rig(
+            router,
+            LoadgenConfig(
+                rate_qps=4000, duration_s=30.0, seed=seed,
+                arrival=arrival, prompt_mix="heavy_tail",
+                max_new_tokens=8),
+            cancel_every=50)
+        assert rig["router_lost"] == 0, (arrival, rig)
+        assert rig["router_poisoned"] == 0, (arrival, rig)
+        assert rig["router_books_ok"], (arrival, rig)
+        assert rig["router_cancel_attempts"] > 0
+        assert rig["router_qps"] >= 1000, (arrival, rig)
