@@ -686,6 +686,126 @@ def _bench_router() -> dict:
     return out
 
 
+def _bench_tenancy() -> dict:
+    """Per-tenant QoS gate (ISSUE 16): the noisy-neighbor scenario as
+    a recorded number.  One tenant floods at ~10x its token-bucket
+    quota while two victims run their normal offered load; the gate
+    of record is the ISOLATION RATIO — the victims' e2e p99 with the
+    flood present over their solo-baseline p99 — which must stay
+    <= 2.0 with zero victim requests lost and the per-tenant books
+    balancing.  A steady two-tenant 2:1-weight backlog additionally
+    checks the WFQ service split lands within 20% of the weights.
+    """
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RequestGateway,
+        RouterMetrics,
+        ServingRouter,
+    )
+    from dlrover_tpu.serving.router.loadgen import (
+        LoadgenConfig,
+        run_router_rig,
+    )
+    from dlrover_tpu.serving.tenancy import (
+        TenantRegistry,
+        TenantSpec,
+        WfqBandQueue,
+    )
+
+    def registry() -> TenantRegistry:
+        return TenantRegistry([
+            TenantSpec("victim", weight=1.0, tenant_class="premium"),
+            TenantSpec("bystander", weight=1.0),
+            TenantSpec("flood", quota_qps=60.0, burst=16.0,
+                       weight=1.0, tenant_class="background",
+                       shed_class="first"),
+        ])
+
+    def build() -> ServingRouter:
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=8192, default_timeout=10.0,
+                trace_sample_rate=0.0, tenants=registry()),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+            metrics=RouterMetrics(window_seconds=1.0),
+        )
+        for i in range(4):
+            router.join_replica(
+                f"qos-{i}",
+                FakeEngine(slots=32, tokens_per_step=8,
+                           blocks=1_000_000))
+        return router
+
+    def config(mix, rate) -> LoadgenConfig:
+        return LoadgenConfig(
+            seed=16, rate_qps=rate, duration_s=2.0,
+            prompt_mix="fixed", prompt_min=16, max_new_tokens=8,
+            tenant_mix=mix)
+
+    out: dict = {}
+    # solo baseline: the victims' offered load with no flood at all
+    solo = run_router_rig(
+        build(), config((("victim", 0.5), ("bystander", 0.5)), 400.0),
+        step_every=32)
+    solo_p99 = max(
+        solo["router_by_tenant"]["victim"]["e2e_p99_s"],
+        solo["router_by_tenant"]["bystander"]["e2e_p99_s"])
+    # flood: SAME victim offered load (400 QPS split between them)
+    # plus the flood tenant offering ~10x its 60 QPS quota on top
+    flood = run_router_rig(
+        build(), config((("victim", 0.2), ("bystander", 0.2),
+                         ("flood", 0.6)), 1000.0),
+        step_every=32)
+    by = flood["router_by_tenant"]
+    victim_p99 = max(by["victim"]["e2e_p99_s"],
+                     by["bystander"]["e2e_p99_s"])
+    victim_lost = by["victim"]["lost"] + by["bystander"]["lost"]
+    # sub-10ms baselines are timer noise on a shared container: the
+    # ratio is floored so the gate measures isolation, not jitter
+    floor_s = 0.010
+    ratio = (max(victim_p99, floor_s)
+             / max(solo_p99, floor_s))
+    out["tenancy_solo_p99_s"] = solo_p99
+    out["tenancy_flood_victim_p99_s"] = victim_p99
+    out["tenancy_isolation_ratio"] = round(ratio, 3)
+    out["tenancy_isolation_bar"] = 2.0
+    out["tenancy_victim_lost"] = int(victim_lost)
+    out["tenancy_flood_rejected"] = int(by["flood"]["rejected"])
+    out["tenancy_books_ok"] = bool(
+        solo["router_books_ok"] and flood["router_books_ok"])
+
+    # WFQ split on a steady 2:1 backlog (policy-level, no wall clock)
+    q = WfqBandQueue(lambda t: 2.0 if t == "heavy" else 1.0)
+
+    class _R:
+        __slots__ = ("tenant",)
+
+        def __init__(self, tenant):
+            self.tenant = tenant
+
+    for _ in range(600):
+        q.append(_R("heavy"))
+        q.append(_R("light"))
+    served = {"heavy": 0, "light": 0}
+    for _ in range(300):
+        head = q.scan(1)[0]
+        q.remove(head)
+        served[head.tenant] += 1
+    wfq_ratio = served["heavy"] / max(1, served["light"])
+    out["tenancy_wfq_ratio"] = round(wfq_ratio, 3)
+    out["tenancy_wfq_ok"] = bool(abs(wfq_ratio - 2.0) / 2.0 <= 0.20)
+
+    out["tenancy_ok"] = bool(
+        ratio <= out["tenancy_isolation_bar"]
+        and victim_lost == 0
+        and by["flood"]["rejected"] > 0
+        and out["tenancy_books_ok"]
+        and out["tenancy_wfq_ok"]
+    )
+    return out
+
+
 def _bench_long_context(jax, jnp, steps: int = 4, warmup: int = 2) -> dict:
     """MFU at 16k context on one chip (the Pallas flash kernel keeps
     attention memory linear; ring attention extends past one chip).
@@ -947,6 +1067,7 @@ _CONFIG_FNS = {
     "fleet": _bench_fleet,
     "gateway": _bench_gateway,
     "router": _bench_router,
+    "tenancy": _bench_tenancy,
 }
 
 
@@ -1008,7 +1129,8 @@ def main() -> None:
         return
 
     on_tpu = _probe_tpu()
-    configs = ["primary", "ckpt", "fleet", "gateway", "router"]
+    configs = ["primary", "ckpt", "fleet", "gateway", "router",
+               "tenancy"]
     if on_tpu:
         configs += ["realistic", "longctx"]
     # a result far below the config's long-recorded band is transient
@@ -1155,6 +1277,19 @@ def main() -> None:
             "lost identity failed, or the event step engine lost the "
             "deep-queue probe to the old sweep "
             f"(ab={result.get('router_ab')}); see PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("tenancy_ok") is False:
+        regressions.append("tenancy")
+        print(
+            "BENCH REGRESSION: tenancy_ok=false — noisy-neighbor "
+            "isolation ratio "
+            f"{result.get('tenancy_isolation_ratio')} vs the "
+            f"{result.get('tenancy_isolation_bar')} bar, victim lost "
+            f"{result.get('tenancy_victim_lost')}, flood rejected "
+            f"{result.get('tenancy_flood_rejected')}, WFQ split "
+            f"{result.get('tenancy_wfq_ratio')} (bar 2:1 +/-20%); "
+            "see PERF.md",
             file=sys.stderr,
         )
     if result.get("ckpt_pause_ok") is False:

@@ -239,7 +239,7 @@ def write_baseline(path: str, violations: Iterable[Violation]) -> None:
 # names (`step`, `get`, `close`) resolve nowhere rather than smearing
 # unrelated subsystems together.
 
-SUMMARY_FORMAT_VERSION = 3  # v3: later with-items' context exprs walked under earlier items' locks
+SUMMARY_FORMAT_VERSION = 4  # v4: list-registered callbacks — attr_elems + the "elemof" typeref
 
 #: blocking-op vocabulary shared by DL003 (lexical) and DL007
 #: (transitive) — the two passes must agree on what "blocking" means.
@@ -422,7 +422,7 @@ def _class_infos(module: "ParsedModule") -> Dict[str, dict]:
             continue
         info = {"bases": [terminal_name(b) for b in node.bases
                           if terminal_name(b)],
-                "attrs": {}, "methods": []}
+                "attrs": {}, "attr_elems": {}, "methods": []}
         for stmt in node.body:
             if isinstance(stmt, ast.AnnAssign) and isinstance(
                     stmt.target, ast.Name):
@@ -467,6 +467,25 @@ def _class_infos(module: "ParsedModule") -> Dict[str, dict]:
                     elif value is not None:
                         names.extend(_value_type_names(
                             value, ann_params, local_returns))
+            # the registered-callback pattern: every
+            # ``self.<attr>.append(x)`` records x's type as an ELEMENT
+            # type of the attr, so ``for cb in self._event_callbacks``
+            # elsewhere can type the loop variable (the "elemof"
+            # typeref) and DL007 chains traverse the callback
+            for sub in ast.walk(stmt):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "append"
+                    and isinstance(sub.func.value, ast.Attribute)
+                    and isinstance(sub.func.value.value, ast.Name)
+                    and sub.func.value.value.id == "self"
+                    and len(sub.args) == 1
+                ):
+                    elems = info["attr_elems"].setdefault(
+                        sub.func.value.attr, [])
+                    elems.extend(_value_type_names(
+                        sub.args[0], ann_params, local_returns))
         out[node.name] = info
     return out
 
@@ -675,6 +694,16 @@ class _FunctionExtractor:
                         tr = self._typeref_of_call(node.iter)
                         if tr is not None:
                             self.locals[node.target.id] = tr
+                    else:
+                        # ``for cb in self._event_callbacks:`` — the
+                        # loop variable is an ELEMENT of the iterated
+                        # container; phase 2 resolves "elemof" through
+                        # the container attr's annotation or its
+                        # recorded ``.append`` element types
+                        tr = self._typeref_of(node.iter)
+                        if tr is not None:
+                            self.locals[node.target.id] = \
+                                ["elemof", tr]
                 elif isinstance(node, (ast.For, ast.Assign, ast.With,
                                        ast.AnnAssign, ast.NamedExpr)):
                     for sub in ast.walk(node):
@@ -1041,6 +1070,27 @@ class WholeProgram:
                         self._class_attr_types(base, attr, _seen))
         return out
 
+    def _class_attr_elems(self, cls_name: str, attr: str,
+                          _seen: Optional[set] = None) -> List[str]:
+        """ELEMENT types recorded for a container attribute (every
+        ``self.<attr>.append(x)`` site) — same base walk as
+        :meth:`_class_attr_types`."""
+        if _seen is None:
+            _seen = set()
+        if cls_name in _seen or len(_seen) > 16:
+            return []
+        _seen.add(cls_name)
+        out: List[str] = []
+        for entry in self.classes.get(cls_name, ()):
+            names = entry.get("attr_elems", {}).get(attr)
+            if names:
+                out.extend(names)
+            else:
+                for base in entry.get("bases", ()):
+                    out.extend(
+                        self._class_attr_elems(base, attr, _seen))
+        return out
+
     def resolve_typeref(self, tr: Optional[list],
                         depth: int = 0) -> frozenset:
         """Known-class names a type descriptor can denote."""
@@ -1061,6 +1111,23 @@ class WholeProgram:
                 out.update(
                     n for n in self._class_attr_types(cls, tr[2])
                     if n in self.classes)
+        elif form == "elemof":
+            # element of an iterated container: only attr-typed
+            # containers resolve (a local list's elements are opaque).
+            # The element vocabulary is the attr's flattened annotation
+            # names (``List[StepCallback]`` mentions StepCallback)
+            # UNION the ``.append``-recorded element types — the
+            # list-registered-callback pattern with or without an
+            # annotation on the registration list.
+            inner = tr[1]
+            if isinstance(inner, list) and inner and \
+                    inner[0] == "attrof":
+                for cls in self.resolve_typeref(inner[1], depth + 1):
+                    out.update(
+                        n for n in (
+                            self._class_attr_types(cls, inner[2])
+                            + self._class_attr_elems(cls, inner[2]))
+                        if n in self.classes)
         elif form == "ret":
             for cls in self.resolve_typeref(tr[1], depth + 1):
                 for q in self.find_method(cls, tr[2]):

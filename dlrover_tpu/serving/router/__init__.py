@@ -30,6 +30,12 @@ Layers (one module each):
   seam (``step_engine="event" | "sweep"``);
 - :mod:`stepengine` — the sharded router front: N independent step
   loops, requests partitioned by rid hash, shared brown-out view.
+
+Tenancy (who is asking, as opposed to how urgent) lives one package up
+in :mod:`dlrover_tpu.serving.tenancy` — policy + accounting with no
+router imports; the gateway wires it into admission (token-bucket
+quotas, :class:`TenantQuotaError`), within-band weighted fair
+queueing, and proportional brown-out shedding.
 """
 
 from dlrover_tpu.serving.router.brownout import (  # noqa: F401
@@ -40,10 +46,12 @@ from dlrover_tpu.serving.router.gateway import (  # noqa: F401
     PRIORITY_HIGH,
     PRIORITY_NORMAL,
     STREAM_RESTART,
+    AdmissionError,
     BrownoutShedError,
     QueueFullError,
     RequestGateway,
     ServingRequest,
+    TenantQuotaError,
 )
 from dlrover_tpu.serving.router.metrics import RouterMetrics  # noqa: F401
 from dlrover_tpu.serving.router.replica import (  # noqa: F401
@@ -66,4 +74,8 @@ from dlrover_tpu.serving.router.slo import (  # noqa: F401
 )
 from dlrover_tpu.serving.router.stepengine import (  # noqa: F401
     ShardedRouterFront,
+)
+from dlrover_tpu.serving.tenancy import (  # noqa: F401
+    TenantRegistry,
+    TenantSpec,
 )

@@ -687,7 +687,16 @@ class ServingAutoScaler:
             "queue_depth": round(sample.queue_depth, 3),
             "ttft_seconds": round(sample.ttft_seconds, 6),
             "tokens_per_sec": round(sample.tokens_per_sec, 3),
+            "slo_pressure": round(sample.slo_pressure, 4),
         }
+        slo = getattr(self.router, "slo", None)
+        if slo is not None and hasattr(slo, "class_burn_rate"):
+            # which tenant CLASS is burning when this decision fired —
+            # the postmortem's "scaled up because premium was starving"
+            # reads straight off the decision trace
+            for cls in getattr(slo, "_classes", {}):
+                window_attrs[f"class_burn_{cls}"] = round(
+                    slo.class_burn_rate(cls, now, "fast"), 4)
         tracer.start_span(
             root, "load_window", now=now,
             samples=len(self._samples), **window_attrs).finish(now)

@@ -7,10 +7,19 @@ instead of letting latency grow without bound), and the per-request
 deadline turns an unserviceable backlog into fast, explicit timeouts
 instead of silently stale answers.
 
-Three strict priority bands (HIGH > NORMAL > BATCH) with FIFO order
-inside each band; failover requeues go to the FRONT of their band so a
-replica crash never sends a half-served request to the back of the
-line.
+Three strict priority bands (HIGH > NORMAL > BATCH); WITHIN each band
+requests are weighted-fair-queued across tenants (tenancy.WfqBandQueue
+— with a single tenant the order is exactly the historical FIFO);
+failover requeues go to the FRONT of their band so a replica crash
+never sends a half-served request to the back of the line.
+
+Tenancy at the door: ``submit(tenant=...)`` resolves the id against
+the gateway's :class:`~dlrover_tpu.serving.tenancy.TenantRegistry`
+(unknown ids land on the configurable default tenant — identity can
+never crash admission) and admits through the tenant's token bucket
+(quota QPS) and queue bound; over-quota BATCH/NORMAL answer
+:class:`TenantQuotaError` with a Retry-After hint, HIGH is never
+quota-rejected — only fair-queued behind its own tags.
 """
 
 from __future__ import annotations
@@ -21,7 +30,7 @@ import queue as queue_mod
 import threading
 import time
 from collections import deque
-from typing import Deque, Iterator, List, Optional
+from typing import Deque, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -29,6 +38,11 @@ from dlrover_tpu.common.constants import (
     SERVING_REQUEST_TERMINAL_STATES,
     ServingFabric,
     ServingRequestState,
+)
+from dlrover_tpu.serving.tenancy import (
+    TenantRegistry,
+    WfqBandQueue,
+    plan_shed,
 )
 from dlrover_tpu.utils.tracing import RequestTrace, Tracer
 
@@ -39,11 +53,37 @@ _PRIORITIES = (PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_BATCH)
 
 
 class AdmissionError(RuntimeError):
-    """The gateway refused the request at the door."""
+    """The gateway refused the request at the door.
+
+    ONE Retry-After contract for every refusal class: every
+    :class:`AdmissionError` carries ``retry_after_s`` (None when the
+    gateway has no honest estimate — a validation refusal retries
+    never, a capacity refusal retries on the caller's own backoff).
+    An HTTP front end maps a non-None hint 1:1 onto a ``Retry-After``
+    header on the 503/429."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class QueueFullError(AdmissionError):
     """Bounded queue at capacity — shed load upstream."""
+
+
+class TenantQuotaError(AdmissionError):
+    """The TENANT is over its own contract (quota QPS token bucket or
+    max-queued bound) while the fleet itself may be fine — a 429, not
+    a 503.  ``retry_after_s`` is the token bucket's time-to-next-token
+    (coming back sooner cannot succeed).  HIGH-priority requests are
+    never refused here: an over-quota tenant's HIGH traffic is only
+    fair-queued behind its own WFQ tags."""
+
+    def __init__(self, message: str, tenant: str = "",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message, retry_after_s=retry_after_s)
+        self.tenant = tenant
 
 
 class BrownoutShedError(AdmissionError):
@@ -52,21 +92,18 @@ class BrownoutShedError(AdmissionError):
     instead of letting the queue bound bounce all bands equally.
     Retry later, or resubmit at a higher priority if the work is.
 
-    The answer carries the Retry-After contract so clients can back
-    off instead of hammering a shedding gateway: ``stage`` /
-    ``stage_name`` (where the ladder stands) and ``retry_after_s``
-    (the policy's best-case exit-watermark + dwell recovery estimate,
+    On top of the shared ``retry_after_s`` contract (here the policy's
+    best-case exit-watermark + dwell recovery estimate,
     :meth:`~dlrover_tpu.serving.router.brownout.BrownoutPolicy.
-    expected_recovery_s`) — an HTTP front end maps it 1:1 onto a
-    ``Retry-After`` header on the 503."""
+    expected_recovery_s`) the answer carries ``stage`` /
+    ``stage_name`` — where the ladder stands."""
 
     def __init__(self, message: str, stage: Optional[int] = None,
                  stage_name: str = "",
                  retry_after_s: Optional[float] = None):
-        super().__init__(message)
+        super().__init__(message, retry_after_s=retry_after_s)
         self.stage = stage
         self.stage_name = stage_name
-        self.retry_after_s = retry_after_s
 
 
 class RequestTimedOut(RuntimeError):
@@ -95,6 +132,10 @@ class ServingRequest:
     prompt: np.ndarray
     max_new_tokens: int
     priority: int = PRIORITY_NORMAL
+    # resolved tenant name (registry identity, never a raw unknown id
+    # — the gateway resolves at admission, so per-tenant state stays
+    # bounded by the registered set)
+    tenant: str = "default"
     deadline: Optional[float] = None       # absolute monotonic time
     submitted_at: float = 0.0
     state: str = ServingRequestState.QUEUED
@@ -152,6 +193,13 @@ class ServingRequest:
     sched_blocked_gen: int = dataclasses.field(
         default=-1, repr=False, compare=False
     )
+    # terminal-state hook, stamped at admission: finish()/abort() call
+    # it exactly once (the terminal-state guard makes re-entry a
+    # no-op) so the gateway's per-tenant in-flight accounting comes
+    # down without the router having to report every completion path
+    _on_terminal: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def total_len(self) -> int:
@@ -201,6 +249,9 @@ class ServingRequest:
             self.trace.finished(self.finished_at)
         self._events.put(("done", None))
         self._done.set()
+        cb = self._on_terminal
+        if cb is not None:
+            cb(self)
 
     def abort(self, state: str) -> None:
         if self.state in SERVING_REQUEST_TERMINAL_STATES:
@@ -214,6 +265,9 @@ class ServingRequest:
             self.trace.aborted(state)
         self._events.put(("abort", state))
         self._done.set()
+        cb = self._on_terminal
+        if cb is not None:
+            cb(self)
 
     def cancel(self) -> bool:
         """Withdraw this request (the client no longer wants the
@@ -295,12 +349,20 @@ class RequestGateway:
         max_requeues: int = ServingFabric.MAX_REQUEST_REQUEUES,
         tracer: Optional[Tracer] = None,
         trace_sample_rate: float = 1.0,
+        tenants: Optional[TenantRegistry] = None,
     ):
         self.max_pending = int(max_pending)
         self.max_prompt_len = max_prompt_len
         self.max_total_len = max_total_len
         self.default_timeout = default_timeout
         self.max_requeues = int(max_requeues)
+        # tenant identity + QoS contracts; the default registry is the
+        # trivial single-tenant fleet (everything resolves to one
+        # unmetered weight-1.0 tenant — WFQ degenerates to exact FIFO
+        # and nothing below behaves differently from pre-tenancy).  A
+        # sharded front passes ONE registry shared across its shard
+        # gateways so quotas meter fleet traffic, not per-shard slices.
+        self.tenants = tenants if tenants is not None else TenantRegistry()
         # tracing is on by default: stdlib-only dict/deque bookkeeping
         # whose memory is capped by the tracer's bounded rings, so
         # every deployment gets per-request traces without opting in.
@@ -310,8 +372,20 @@ class RequestGateway:
         self.tracer = tracer if tracer is not None else Tracer(
             sample_rate=trace_sample_rate)
         self._lock = threading.RLock()
-        self._queues: List[Deque[ServingRequest]] = [
-            deque() for _ in _PRIORITIES
+        # tenant -> queued count ACROSS bands (per-tenant max_queued is
+        # a tenant bound, not a per-band one); the band queues share
+        # and maintain it on every insert/removal
+        self._tenant_queued: Dict[str, int] = {}
+        # tenant -> admitted-and-not-yet-terminal count; in-flight =
+        # open - queued.  Incremented at admission, decremented by the
+        # request's own terminal hook (_on_terminal), so every
+        # completion path — DONE, expiry, cancel, shed, poison —
+        # balances it without router cooperation.
+        self._tenant_open: Dict[str, int] = {}
+        self._queues: List[WfqBandQueue] = [
+            WfqBandQueue(self._tenant_weight,
+                         shared_counts=self._tenant_queued)
+            for _ in _PRIORITIES
         ]
         self._next_rid = 0
         self.submitted = 0
@@ -364,6 +438,53 @@ class RequestGateway:
         # removal would starve them forever
         self.queue_gen = 0
 
+    # ---------------------------------------------------------- tenants
+    def _tenant_weight(self, tenant: str) -> float:
+        return self.tenants.resolve(tenant).weight
+
+    def _tenant_release(self, req: ServingRequest) -> None:
+        """Terminal hook (exactly once per request): the tenant's open
+        count comes down.  Runs on whatever thread drove the terminal
+        transition, sometimes already holding this gateway's lock —
+        so: plain GIL-atomic dict arithmetic only, no locking, no I/O.
+        When an in-flight-capped tenant still has queued work, the
+        freed in-flight slot is a scheduling event the placement
+        index cannot otherwise see — bump the queue generation so the
+        idle short-circuit re-scans."""
+        name = req.tenant
+        n = self._tenant_open.get(name, 0) - 1
+        if n > 0:
+            self._tenant_open[name] = n
+        else:
+            self._tenant_open.pop(name, None)
+        spec = self.tenants.resolve(name)
+        if spec.max_inflight is not None and \
+                self._tenant_queued.get(name, 0) > 0:
+            self.queue_gen += 1
+
+    def tenant_queue_depths(self) -> Dict[str, int]:
+        """Queued count per tenant across all bands (resolved names)."""
+        with self._lock:
+            return dict(self._tenant_queued)
+
+    def tenant_inflight(self, tenant: str) -> int:
+        """Admitted-but-not-queued (placed or being placed) count."""
+        return max(0, self._tenant_open.get(tenant, 0)
+                   - self._tenant_queued.get(tenant, 0))
+
+    def tenant_can_place(self, req: ServingRequest) -> bool:
+        """Scheduler gate: may this request be placed NOW without
+        breaching its tenant's max_inflight?  Plain dict reads — the
+        scheduler calls this per window entry."""
+        spec = self.tenants.resolve(req.tenant)
+        if spec.max_inflight is None:
+            return True
+        return self.tenant_inflight(spec.name) < spec.max_inflight
+
+    def tenant_class(self, tenant: str) -> str:
+        """The request's BOUNDED metric/SLO class (tenancy vocab)."""
+        return self.tenants.resolve(tenant).tenant_class
+
     # ----------------------------------------------------------- admit
     def submit(
         self,
@@ -372,6 +493,7 @@ class RequestGateway:
         priority: int = PRIORITY_NORMAL,
         timeout: Optional[float] = None,
         now: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> ServingRequest:
         """Admit a request or raise :class:`AdmissionError`.  ``timeout``
         (seconds, default ``default_timeout``) becomes an absolute
@@ -396,7 +518,13 @@ class RequestGateway:
                 f"bound {self.max_total_len}")
         now = time.monotonic() if now is None else now
         timeout = self.default_timeout if timeout is None else timeout
+        spec = self.tenants.resolve(tenant)
         with self._lock:
+            # admission checks in refusal-severity order, and EXACTLY
+            # ONE ``rejected`` count per refused submit whichever path
+            # raises — a request that is simultaneously over quota AND
+            # in a browned-out band must not double-count (the books
+            # identity: offered == admitted + rejected)
             brownout = self.brownout
             if brownout is not None and brownout.sheds_priority(priority):
                 # ordered degradation: this band is browned out while
@@ -404,6 +532,7 @@ class RequestGateway:
                 # mechanism protecting HIGH, not a capacity accident
                 self.rejected += 1
                 self.shed_by_priority[priority] += 1
+                self.tenants.count_shed(spec.name)
                 retry_after = brownout.expected_recovery_s(now)
                 raise BrownoutShedError(
                     f"priority {priority} shed at brown-out stage "
@@ -412,6 +541,33 @@ class RequestGateway:
                     stage=brownout.stage,
                     stage_name=brownout.stage_name,
                     retry_after_s=retry_after)
+            if spec.max_queued is not None and \
+                    self._tenant_queued.get(spec.name, 0) \
+                    >= spec.max_queued:
+                # the tenant's own buffer bound (all bands: a memory
+                # bound, unlike the QPS bucket below) — checked BEFORE
+                # the bucket so the refusal does not also burn a token
+                self.rejected += 1
+                self.tenants.count_quota_rejected(spec.name)
+                raise TenantQuotaError(
+                    f"tenant {spec.name!r} at max_queued "
+                    f"({spec.max_queued})", tenant=spec.name,
+                    retry_after_s=(1.0 / spec.quota_qps
+                                   if spec.quota_qps else 0.0))
+            if priority != PRIORITY_HIGH:
+                # quota QPS: BATCH/NORMAL over the tenant's token
+                # bucket are refused with the time-to-next-token hint;
+                # HIGH is NEVER quota-refused — over-quota HIGH
+                # traffic pays by fair-queueing behind its own tags
+                ok, retry_after = self.tenants.try_admit(spec, now)
+                if not ok:
+                    self.rejected += 1
+                    self.tenants.count_quota_rejected(spec.name)
+                    raise TenantQuotaError(
+                        f"tenant {spec.name!r} over quota "
+                        f"({spec.quota_qps:g} QPS); next token in "
+                        f"{retry_after:.3f}s", tenant=spec.name,
+                        retry_after_s=retry_after)
             if self.depth() >= self.max_pending:
                 self.rejected += 1
                 raise QueueFullError(
@@ -421,6 +577,7 @@ class RequestGateway:
                 prompt=prompt,
                 max_new_tokens=int(max_new_tokens),
                 priority=priority,
+                tenant=spec.name,
                 # timeout=0 means "fail unless immediately serviceable",
                 # not "no deadline" — only None disables expiry
                 deadline=(now + timeout) if timeout is not None else None,
@@ -428,6 +585,10 @@ class RequestGateway:
                 enqueued_at=now,
             )
             self._next_rid += 1
+            self.tenants.count_admitted(spec.name)
+            self._tenant_open[spec.name] = \
+                self._tenant_open.get(spec.name, 0) + 1
+            req._on_terminal = self._tenant_release
             req.trace = RequestTrace(
                 self.tracer, req.rid, now=now,
                 priority=priority, prompt_len=int(prompt.size),
@@ -536,10 +697,9 @@ class RequestGateway:
         with self._lock:
             out: List[ServingRequest] = []
             for q in self._queues:
-                for req in q:
-                    if len(out) >= window:
-                        return out
-                    out.append(req)
+                if len(out) >= window:
+                    break
+                out.extend(q.scan(window - len(out)))
             return out
 
     def remove(self, req: ServingRequest) -> bool:
@@ -590,38 +750,28 @@ class RequestGateway:
                         self._expired_running.append(req)
                     # terminal states: the answer already exists
                 if due:
-                    # one-pass partition of ONLY the touched bands —
-                    # deque.remove per entry would be O(n^2) on a mass
+                    # bulk removal from ONLY the touched bands —
+                    # per-entry remove would be O(n^2) on a mass
                     # expiry (a stall expiring a whole queue at once)
                     due_ids = {id(r) for r in due}
-                    bands = {r.priority for r in due}
-                    for i in bands:
-                        self._queues[i] = deque(
-                            r for r in self._queues[i]
-                            if id(r) not in due_ids)
+                    for i in {r.priority for r in due}:
+                        self._queues[i].discard_ids(due_ids)
                     self.queue_gen += 1
                     for req in due:
                         req.abort(ServingRequestState.TIMED_OUT)
                         expired.append(req)
                         self.timed_out += 1
             else:
-                for i, q in enumerate(self._queues):
-                    # one-pass partition: per-entry deque.remove()
-                    # would be O(n^2) when a stall expires a full
-                    # queue at once
-                    kept: Deque[ServingRequest] = deque()
-                    dropped = False
-                    for req in q:
-                        if req.deadline is not None \
-                                and now > req.deadline:
+                for q in self._queues:
+                    due = [req for req in q
+                           if req.deadline is not None
+                           and now > req.deadline]
+                    if due:
+                        q.discard_ids({id(r) for r in due})
+                        for req in due:
                             req.abort(ServingRequestState.TIMED_OUT)
                             expired.append(req)
                             self.timed_out += 1
-                            dropped = True
-                        else:
-                            kept.append(req)
-                    if dropped:
-                        self._queues[i] = kept
                         self.queue_gen += 1
         # dump outside the queue lock — the black-box readout
         # serializes the span tree and logs, neither belongs in the
@@ -667,9 +817,7 @@ class RequestGateway:
                 if queued:
                     q_ids = {id(r) for r in queued}
                     for i in {r.priority for r in queued}:
-                        self._queues[i] = deque(
-                            r for r in self._queues[i]
-                            if id(r) not in q_ids)
+                        self._queues[i].discard_ids(q_ids)
                     self.queue_gen += 1
                     for req in queued:
                         req.abort(ServingRequestState.CANCELLED)
@@ -680,19 +828,15 @@ class RequestGateway:
                 # callback fires regardless); clear it so the deque
                 # cannot grow without a consumer
                 self._cancel_events.clear()
-                for i, q in enumerate(self._queues):
-                    kept: Deque[ServingRequest] = deque()
-                    dropped = False
-                    for req in q:
-                        if req.cancel_requested:
+                for q in self._queues:
+                    withdrawn = [req for req in q
+                                 if req.cancel_requested]
+                    if withdrawn:
+                        q.discard_ids({id(r) for r in withdrawn})
+                        for req in withdrawn:
                             req.abort(ServingRequestState.CANCELLED)
                             taken.append(req)
                             self.cancelled += 1
-                            dropped = True
-                        else:
-                            kept.append(req)
-                    if dropped:
-                        self._queues[i] = kept
                         self.queue_gen += 1
         for req in taken:
             self.tracer.recorder.record(
@@ -723,23 +867,38 @@ class RequestGateway:
 
     def shed_queued(self, priority: int,
                     now: Optional[float] = None,
-                    dump: bool = True) -> List[ServingRequest]:
-        """Brown-out stage 2: expiry-cancel every QUEUED request of
+                    dump: bool = True,
+                    keep_total: Optional[int] = None
+                    ) -> List[ServingRequest]:
+        """Brown-out stage 2: expiry-cancel QUEUED requests of
         ``priority`` (the band being browned out), aborting each as
         ``CANCELLED`` through the same machinery a caller withdrawal
         uses — the caller's ``result()`` raises promptly instead of
         aging toward its deadline in a queue that will never drain.
-        Same deferral contract as :meth:`expire`."""
+        Same deferral contract as :meth:`expire`.
+
+        With a multi-tenant registry and a ``keep_total`` survivor
+        budget the sweep is PROPORTIONAL: :func:`plan_shed` takes from
+        the tenants furthest over their fair share first, so the
+        tenant that caused the brown-out pays for it.  A trivial
+        registry (or ``keep_total=None``) keeps the legacy
+        whole-band clear."""
         taken: List[ServingRequest] = []
         with self._lock:
             q = self._queues[priority]
             if q:
-                for req in q:
+                if keep_total is None or self.tenants.trivial:
+                    taken = q.clear_all()
+                else:
+                    taken = q.pop_shed(plan_shed(
+                        q.counts_by_tenant(), self.tenants,
+                        keep_total))
+                for req in taken:
                     req.abort(ServingRequestState.CANCELLED)
-                    taken.append(req)
                     self.cancelled += 1
-                self._queues[priority] = deque()
-                self.queue_gen += 1
+                    self.tenants.count_shed(req.tenant)
+                if taken:
+                    self.queue_gen += 1
         for req in taken:
             self.tracer.recorder.record(
                 "brownout_shed_queued", rid=req.rid,

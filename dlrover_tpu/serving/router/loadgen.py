@@ -61,6 +61,7 @@ from dlrover_tpu.serving.router.gateway import (
     AdmissionError,
     BrownoutShedError,
     QueueFullError,
+    TenantQuotaError,
 )
 from dlrover_tpu.serving.router.slo import BAND_NAMES
 
@@ -89,6 +90,12 @@ class LoadgenConfig:
         (PRIORITY_NORMAL, 0.6),
         (PRIORITY_BATCH, 0.3),
     )
+    # (tenant, weight) identity mix; empty = untenanted legacy traffic
+    # (arrivals carry tenant=None and submit omits the kwarg).  Tenant
+    # picks draw from their OWN seeded stream so configuring a mix
+    # cannot perturb the arrival times/prompts an existing seed
+    # replays byte-identically.
+    tenant_mix: Tuple[Tuple[str, float], ...] = ()
 
 
 @dataclasses.dataclass
@@ -97,6 +104,7 @@ class Arrival:
     prompt_len: int
     max_new_tokens: int
     priority: int
+    tenant: Optional[str] = None
 
 
 class OpenLoopGenerator:
@@ -143,6 +151,12 @@ class OpenLoopGenerator:
         rng = random.Random(cfg.seed)
         bands = [p for p, _ in cfg.priority_mix]
         weights = [w for _, w in cfg.priority_mix]
+        # tenant identity draws from a SEPARATE seeded stream: adding
+        # (or changing) a tenant mix must not move a single arrival
+        # time, prompt length or band of an already-seeded schedule
+        trng = random.Random(cfg.seed ^ 0x7E4A47)
+        tenants = [t for t, _ in cfg.tenant_mix]
+        tweights = [w for _, w in cfg.tenant_mix]
         t = 0.0
         while True:
             rate = max(1e-6, self._rate_at(t))
@@ -154,6 +168,8 @@ class OpenLoopGenerator:
                 prompt_len=self._prompt_len(rng),
                 max_new_tokens=cfg.max_new_tokens,
                 priority=rng.choices(bands, weights)[0],
+                tenant=(trng.choices(tenants, tweights)[0]
+                        if tenants else None),
             )
 
 
@@ -224,7 +240,8 @@ def run_gateway_rig(
     # keyed on the CONFIGURED mix (a custom band outside the stock
     # three must count, not KeyError mid-run)
     shed = {band: 0 for band, _ in cfg.priority_mix}
-    shed_kinds = {"queue_full": 0, "brownout": 0, "other": 0}
+    shed_kinds = {"queue_full": 0, "brownout": 0, "quota": 0,
+                  "other": 0}
     admitted = 0
     offered = 0
     steps = 0
@@ -238,10 +255,12 @@ def run_gateway_rig(
             if ahead > 0.002:
                 time.sleep(ahead)
         prompt = pool[arrival.prompt_len]
+        kw = ({"tenant": arrival.tenant}
+              if arrival.tenant is not None else {})
         s0 = time.perf_counter()
         try:
             router.submit(prompt, arrival.max_new_tokens,
-                          priority=arrival.priority)
+                          priority=arrival.priority, **kw)
             admitted += 1
         except BrownoutShedError:
             shed[arrival.priority] += 1
@@ -249,6 +268,9 @@ def run_gateway_rig(
         except QueueFullError:
             shed[arrival.priority] += 1
             shed_kinds["queue_full"] += 1
+        except TenantQuotaError:
+            shed[arrival.priority] += 1
+            shed_kinds["quota"] += 1
         except AdmissionError:
             shed[arrival.priority] += 1
             shed_kinds["other"] += 1
@@ -348,7 +370,12 @@ def run_router_rig(
 
     admitted: List[object] = []
     shed = {band: 0 for band, _ in cfg.priority_mix}
-    shed_kinds = {"queue_full": 0, "brownout": 0, "other": 0}
+    shed_kinds = {"queue_full": 0, "brownout": 0, "quota": 0,
+                  "other": 0}
+    # per-tenant refusal counts (admission raises before a request
+    # object exists, so the ARRIVAL's tenant id is the key here; the
+    # admitted-side audit below keys on the RESOLVED req.tenant)
+    tenant_rejected: Dict[str, int] = {}
     offered = 0
     steps = 0
     cancelled_by_rig: List[object] = []
@@ -363,9 +390,11 @@ def run_router_rig(
             if ahead > 0.002:
                 time.sleep(ahead)
         prompt = pool[arrival.prompt_len]
+        kw = ({"tenant": arrival.tenant}
+              if arrival.tenant is not None else {})
         try:
             req = router.submit(prompt, arrival.max_new_tokens,
-                                priority=arrival.priority)
+                                priority=arrival.priority, **kw)
             admitted.append(req)
             if cancel_every and len(admitted) % cancel_every == 0:
                 # withdraw shortly after admission: flushed on the
@@ -382,12 +411,27 @@ def run_router_rig(
         except BrownoutShedError:
             shed[arrival.priority] += 1
             shed_kinds["brownout"] += 1
+            if arrival.tenant is not None:
+                tenant_rejected[arrival.tenant] = \
+                    tenant_rejected.get(arrival.tenant, 0) + 1
         except QueueFullError:
             shed[arrival.priority] += 1
             shed_kinds["queue_full"] += 1
+            if arrival.tenant is not None:
+                tenant_rejected[arrival.tenant] = \
+                    tenant_rejected.get(arrival.tenant, 0) + 1
+        except TenantQuotaError:
+            shed[arrival.priority] += 1
+            shed_kinds["quota"] += 1
+            if arrival.tenant is not None:
+                tenant_rejected[arrival.tenant] = \
+                    tenant_rejected.get(arrival.tenant, 0) + 1
         except AdmissionError:
             shed[arrival.priority] += 1
             shed_kinds["other"] += 1
+            if arrival.tenant is not None:
+                tenant_rejected[arrival.tenant] = \
+                    tenant_rejected.get(arrival.tenant, 0) + 1
         since_step += 1
         if since_step >= step_every:
             since_step = 0
@@ -418,23 +462,53 @@ def run_router_rig(
     # the audit, from the request objects themselves
     by_state: Dict[str, int] = {}
     e2e: List[float] = []
+    terminal_states = (ServingRequestState.DONE,
+                       ServingRequestState.TIMED_OUT,
+                       ServingRequestState.CANCELLED,
+                       ServingRequestState.REJECTED,
+                       ServingRequestState.POISONED)
+    # per-RESOLVED-tenant books (raw ids are fine in this JSON report
+    # — the DL010 bound applies to metric labels, not rig summaries)
+    tenant_books: Dict[str, Dict[str, object]] = {}
     for req in admitted:
         by_state[req.state] = by_state.get(req.state, 0) + 1
-        if req.state == ServingRequestState.DONE \
-                and req.finished_at is not None:
+        done_req = (req.state == ServingRequestState.DONE
+                    and req.finished_at is not None)
+        if done_req:
             e2e.append(req.finished_at - req.submitted_at)
+        name = getattr(req, "tenant", None)
+        if name is not None:
+            book = tenant_books.setdefault(
+                name, {"admitted": 0, "done": 0, "lost": 0,
+                       "e2e": []})
+            book["admitted"] += 1
+            if done_req:
+                book["done"] += 1
+                book["e2e"].append(req.finished_at - req.submitted_at)
+            if req.state not in terminal_states:
+                book["lost"] += 1
     done = by_state.get(ServingRequestState.DONE, 0)
-    terminal = (ServingRequestState.DONE,
-                ServingRequestState.TIMED_OUT,
-                ServingRequestState.CANCELLED,
-                ServingRequestState.REJECTED,
-                ServingRequestState.POISONED)
+    terminal = terminal_states
     lost = sum(n for state, n in by_state.items()
                if state not in terminal)
     poisoned = by_state.get(ServingRequestState.POISONED, 0)
     accounted = sum(by_state.get(s, 0) for s in terminal)
     e2e.sort()
     p50, p99, p999 = _quantiles(e2e, (50, 99, 99.9))
+    by_tenant: Dict[str, Dict[str, object]] = {}
+    for name in sorted(set(tenant_books) | set(tenant_rejected)):
+        book = tenant_books.get(
+            name, {"admitted": 0, "done": 0, "lost": 0, "e2e": []})
+        tl = sorted(book["e2e"])
+        tp50, tp99, _ = _quantiles(tl, (50, 99, 99.9))
+        by_tenant[name] = {
+            "admitted": book["admitted"],
+            "done": book["done"],
+            "lost": book["lost"],
+            "rejected": tenant_rejected.get(name, 0),
+            "e2e_p50_s": round(tp50, 6),
+            "e2e_p99_s": round(tp99, 6),
+        }
     return {
         "router_offered": offered,
         "router_admitted": len(admitted),
@@ -462,4 +536,7 @@ def run_router_rig(
         "router_e2e_p50_s": round(p50, 6),
         "router_e2e_p99_s": round(p99, 6),
         "router_e2e_p999_s": round(p999, 6),
+        # per-tenant slice of the same audit (empty when untenanted);
+        # the noisy-neighbor gate reads victims' p99/lost from here
+        "router_by_tenant": by_tenant,
     }

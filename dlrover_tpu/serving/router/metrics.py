@@ -111,6 +111,13 @@ class RouterMetrics:
         # into the labeled serving_attention_impl family (bounded
         # vocabulary: "xla" | "pallas")
         self.attention_impls: Dict[str, int] = {}
+        # per-tenant-CLASS QoS gauges/counters (tenancy.TENANT_CLASSES
+        # keys only — raw tenant ids never reach a label value, DL010),
+        # written by the router's observe sweep from the gateway's
+        # registry books each step
+        self.tenant_queue_depth: Dict[str, float] = {}
+        self.tenant_shed: Dict[str, float] = {}
+        self.tenant_quota_rejected: Dict[str, float] = {}
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -244,6 +251,19 @@ class RouterMetrics:
                 impls[key] = impls.get(key, 0) + 1
         self.attention_impls = impls
 
+    def observe_tenants(
+        self,
+        queue_depth: Dict[str, float],
+        shed: Dict[str, float],
+        quota_rejected: Dict[str, float],
+    ) -> None:
+        """Per-tenant-class books, already aggregated onto the bounded
+        vocabulary by ``TenantRegistry.by_class`` — this layer never
+        sees a raw tenant id."""
+        self.tenant_queue_depth = dict(queue_depth)
+        self.tenant_shed = dict(shed)
+        self.tenant_quota_rejected = dict(quota_rejected)
+
     def observe_tokens(self, n: int, now: Optional[float] = None) -> None:
         self.generated_tokens += int(n)
         self._tokens_window.observe(float(n), now)
@@ -337,4 +357,21 @@ class RouterMetrics:
             n = self.attention_impls.get(impl, 0)
             lines.append(
                 f'serving_attention_impl{{impl="{impl}"}} {n}')
+        # tenancy families: every class in the closed vocabulary
+        # renders even at zero, so a class going dark is a visible
+        # flatline, not a disappearing series
+        from dlrover_tpu.serving.tenancy import TENANT_CLASSES
+        for name, book in (
+            ("serving_tenant_queue_depth", self.tenant_queue_depth),
+            ("serving_tenant_shed_total", self.tenant_shed),
+            ("serving_tenant_quota_rejected_total",
+             self.tenant_quota_rejected),
+        ):
+            lines.append(
+                f"# HELP {name} " + (metric_help(name) or ""))
+            lines.append(f"# TYPE {name} gauge")
+            for cls in TENANT_CLASSES:
+                lines.append(
+                    f'{name}{{tenant_class="{cls}"}} '
+                    f"{book.get(cls, 0.0):g}")
         return "\n".join(lines) + "\n"

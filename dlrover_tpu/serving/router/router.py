@@ -222,11 +222,12 @@ class ServingRouter:
         priority: int = PRIORITY_NORMAL,
         timeout: Optional[float] = None,
         now: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> ServingRequest:
         try:
             req = self.gateway.submit(
                 prompt_ids, max_new_tokens, priority=priority,
-                timeout=timeout, now=now,
+                timeout=timeout, now=now, tenant=tenant,
             )
         except Exception:
             self.metrics.rejected = self.gateway.rejected
@@ -259,7 +260,10 @@ class ServingRouter:
                 if self.slo is not None:
                     # an expiry IS an SLO violation: the answer never
                     # arrived inside any target
-                    self.slo.observe_violation(req.priority, now)
+                    self.slo.observe_violation(
+                        req.priority, now,
+                        tenant_class=self.gateway.tenant_class(
+                            req.tenant))
                 if req.trace is not None:
                     dumps.append(
                         ("deadline_expired", req.trace.trace_id))
@@ -422,7 +426,9 @@ class ServingRouter:
                                 if req.first_token_at is not None
                                 else None)
                             self.slo.observe(
-                                req.priority, ttft, e2e, now)
+                                req.priority, ttft, e2e, now,
+                                tenant_class=self.gateway
+                                .tenant_class(req.tenant))
                     if req.decode_step_seconds is not None:
                         self.metrics.observe_decode_step(
                             req.decode_step_seconds,
@@ -488,6 +494,16 @@ class ServingRouter:
                 h.engine_metrics()
                 for h in self.manager.replicas.values()
             ])
+            # per-tenant-class QoS books: the registry aggregates its
+            # per-tenant dicts onto the bounded class vocabulary here,
+            # so raw tenant ids never leave the gateway (DL010).
+            # Plain dict arithmetic — safe under the step lock.
+            tenants = self.gateway.tenants
+            self.metrics.observe_tenants(
+                tenants.by_class(self.gateway.tenant_queue_depths()),
+                tenants.by_class(tenants.shed),
+                tenants.by_class(tenants.quota_rejected),
+            )
             # placement fast-path counters (regression surface for the
             # incremental index; plain attribute reads)
             self.metrics.sched_capacity_evals = float(
@@ -558,7 +574,9 @@ class ServingRouter:
             self.gateway.timed_out += 1
             reason = "deadline_expired"
             if self.slo is not None:
-                self.slo.observe_violation(req.priority, now)
+                self.slo.observe_violation(
+                    req.priority, now,
+                    tenant_class=self.gateway.tenant_class(req.tenant))
         req.abort(state)
         self.recorder.record(
             "request_cancel_inflight", rid=req.rid,
@@ -636,14 +654,11 @@ class ServingRouter:
             self.metrics.brownout_stage = float(stage)
             if not self.brownout.cancels_batch:
                 return
-            self._brownout_cancel_batch(now, cancels, dumps)
+            self._brownout_cancel_batch(
+                now, cancels, dumps,
+                keep_total=self._brownout_keep_total(now))
             return
-        capacity = 0.0
-        for handle in self.manager.schedulable(now):
-            try:
-                capacity += handle.slots_free() + len(handle.inflight)
-            except Exception:
-                continue  # a dying replica's ledger is not capacity
+        capacity = self._capacity(now)
         prev = self.brownout.stage
         stage = self.brownout.update(now, self.gateway.depth(), capacity)
         if stage != prev:
@@ -663,21 +678,51 @@ class ServingRouter:
         self.metrics.brownout_stage = float(stage)
         if not self.brownout.cancels_batch:
             return
-        self._brownout_cancel_batch(now, cancels, dumps)
+        self._brownout_cancel_batch(
+            now, cancels, dumps,
+            keep_total=(None if self.gateway.tenants.trivial
+                        else int(capacity
+                                 * self.brownout.exit_pressure)))
+
+    def _capacity(self, now: float) -> float:
+        capacity = 0.0
+        for handle in self.manager.schedulable(now):
+            try:
+                capacity += handle.slots_free() + len(handle.inflight)
+            except Exception:
+                continue  # a dying replica's ledger is not capacity
+        return capacity
+
+    def _brownout_keep_total(self, now: float) -> Optional[int]:
+        """Multi-tenant survivor budget for a brown-out BATCH shed:
+        the queued depth at which the ladder would START de-escalating
+        (local capacity x the exit watermark).  Trivial registry →
+        None, the legacy whole-band clear."""
+        if self.gateway.tenants.trivial:
+            return None
+        return int(self._capacity(now) * self.brownout.exit_pressure)
 
     def _brownout_cancel_batch(self, now: float, cancels: List[tuple],
-                               dumps: List[tuple]) -> None:
+                               dumps: List[tuple],
+                               keep_total: Optional[int] = None
+                               ) -> None:
         # stage 2+: the BATCH band drains NOW — queued requests answer
         # their callers instead of aging out, in-flight ones return
-        # their slots and paged KV blocks to the surviving bands
+        # their slots and paged KV blocks to the surviving bands.
+        # Multi-tenant fleets shed down to ``keep_total`` instead,
+        # proportionally from the tenants furthest over fair share —
+        # the tenant that CAUSED the brown-out pays for it first.
         for req in self.gateway.shed_queued(
-                PRIORITY_BATCH, now=now, dump=False):
+                PRIORITY_BATCH, now=now, dump=False,
+                keep_total=keep_total):
             if self.slo is not None:
                 # a brown-out shed IS an SLO violation for its band:
                 # the user was failed by the fleet's own degradation
                 # ladder, not by their request — the burn it causes
                 # is the signal that pulls capacity back
-                self.slo.observe_violation(req.priority, now)
+                self.slo.observe_violation(
+                    req.priority, now,
+                    tenant_class=self.gateway.tenant_class(req.tenant))
             if req.trace is not None:
                 dumps.append(("brownout_shed", req.trace.trace_id))
         for handle in self.manager.pumpable():
@@ -688,7 +733,10 @@ class ServingRouter:
                 req.abort(ServingRequestState.CANCELLED)
                 self.gateway.cancelled += 1
                 if self.slo is not None:
-                    self.slo.observe_violation(req.priority, now)
+                    self.slo.observe_violation(
+                        req.priority, now,
+                        tenant_class=self.gateway.tenant_class(
+                            req.tenant))
                 self.recorder.record(
                     "brownout_cancel_inflight", rid=req.rid,
                     replica=handle.name, now=now)
@@ -804,7 +852,8 @@ class ServingRouter:
                 # is the caller's 4xx, not the fleet's failure.)
                 self.slo.observe_violation(
                     req.priority,
-                    time.monotonic() if now is None else now)
+                    time.monotonic() if now is None else now,
+                    tenant_class=self.gateway.tenant_class(req.tenant))
         for req in poisoned:
             if dumps is not None and req.trace is not None:
                 dumps.append(("poisoned", req.trace.trace_id))

@@ -182,6 +182,11 @@ class ContinuousBatchScheduler:
         request in the window probes every replica, every round."""
         placements: List[Tuple[object, ServingRequest]] = []
         for req in gateway.schedule_scan(self.schedule_window):
+            if not gateway.tenant_can_place(req):
+                # tenant at max_inflight: stays queued — a per-tenant
+                # cap, not a capacity fact, so no blocked-gen marking
+                # (the gateway bumps queue_gen when the tenant drains)
+                continue
             self.capacity_evals += len(replicas)
             cands = [
                 h for h in replicas
@@ -230,6 +235,12 @@ class ContinuousBatchScheduler:
         for req in gateway.schedule_scan(self.schedule_window):
             if req.sched_blocked_gen == self._cap_gen:
                 continue  # nothing grew since every replica refused it
+            if not gateway.tenant_can_place(req):
+                # per-tenant max_inflight, not replica capacity: no
+                # blocked-gen marking — the tenant's next completion
+                # (not capacity growth) unblocks it, and the gateway's
+                # terminal hook bumps queue_gen for exactly that case
+                continue
             key = self.prefix_key(req.prompt)
             best = None
             affinity_hit = False

@@ -24,7 +24,13 @@ Exported families (per band, optionally per window):
   consumption rate: 1.0 = exactly on budget, >1 = burning toward
   exhaustion, e.g. 14.4 = the classic page-now threshold;
 - ``serving_slo_budget_remaining{band}``        — unspent error budget
-  over the slow window, 1.0 = untouched, 0.0 = exhausted.
+  over the slow window, 1.0 = untouched, 0.0 = exhausted;
+- ``serving_slo_class_burn_rate{tenant_class,window}`` — the same burn
+  arithmetic per TENANT CLASS (the bounded tenancy vocabulary, never
+  raw tenant ids — DL010): a premium class burning while the band
+  aggregate looks healthy is exactly the noisy-neighbor signature,
+  and the class burns feed :meth:`SloEngine.pressure` so autoscale
+  reacts to it.
 
 The engine's :meth:`pressure` (max over bands of the multi-window
 burn) feeds :class:`~dlrover_tpu.brain.serving.ServingScalePolicy` as
@@ -49,6 +55,7 @@ from dlrover_tpu.serving.router.gateway import (
     PRIORITY_HIGH,
     PRIORITY_NORMAL,
 )
+from dlrover_tpu.serving.tenancy import TENANT_CLASSES
 
 BAND_NAMES = {
     PRIORITY_HIGH: "HIGH",
@@ -87,6 +94,46 @@ def default_objectives() -> Tuple[SloObjective, ...]:
                      e2e_target_s=10.0, target=0.99),
         SloObjective(PRIORITY_BATCH, ttft_target_s=5.0,
                      e2e_target_s=60.0, target=0.95),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassObjective:
+    """One TENANT CLASS's objective — same targets, keyed on the
+    bounded tenancy vocabulary instead of a priority band.  The class
+    dimension cuts ACROSS bands: it answers "are premium users getting
+    what premium promises" whatever priorities they submit at."""
+
+    tenant_class: str
+    ttft_target_s: float
+    e2e_target_s: float
+    target: float = 0.99
+
+    def __post_init__(self):
+        if self.tenant_class not in TENANT_CLASSES:
+            raise ValueError(
+                f"tenant_class {self.tenant_class!r} not in the "
+                f"bounded vocabulary {TENANT_CLASSES}")
+
+    @property
+    def name(self) -> str:
+        return self.tenant_class
+
+    @property
+    def error_budget(self) -> float:
+        return max(1e-9, 1.0 - self.target)
+
+
+def default_class_objectives() -> Tuple[ClassObjective, ...]:
+    """Stock per-class ladder, mirroring the band defaults: premium
+    pays for tight latency, background trades it away."""
+    return (
+        ClassObjective("premium", ttft_target_s=0.5,
+                       e2e_target_s=5.0, target=0.999),
+        ClassObjective("standard", ttft_target_s=1.0,
+                       e2e_target_s=10.0, target=0.99),
+        ClassObjective("background", ttft_target_s=5.0,
+                       e2e_target_s=60.0, target=0.95),
     )
 
 
@@ -145,6 +192,7 @@ class SloEngine:
         objectives: Optional[Tuple[SloObjective, ...]] = None,
         fast_window_s: float = 300.0,
         slow_window_s: float = 3600.0,
+        class_objectives: Optional[Tuple[ClassObjective, ...]] = None,
     ):
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
@@ -153,31 +201,57 @@ class SloEngine:
         for obj in (objectives or default_objectives()):
             self._bands[obj.band] = _BandState(
                 obj, self.fast_window_s, self.slow_window_s)
+        # tenant-CLASS states: same ring arithmetic keyed on the
+        # bounded tenancy vocabulary (never raw tenant ids — DL010)
+        self._classes: Dict[str, _BandState] = {}
+        for cobj in (class_objectives or default_class_objectives()):
+            self._classes[cobj.tenant_class] = _BandState(
+                cobj, self.fast_window_s, self.slow_window_s)
 
     def objective(self, band: int) -> Optional[SloObjective]:
         state = self._bands.get(band)
         return None if state is None else state.objective
 
+    def class_objective(self, tenant_class: str
+                        ) -> Optional[ClassObjective]:
+        state = self._classes.get(tenant_class)
+        return None if state is None else state.objective
+
     # ------------------------------------------------------- observe
     def observe(self, band: int, ttft_s: Optional[float],
-                e2e_s: float, now: float) -> None:
+                e2e_s: float, now: float,
+                tenant_class: Optional[str] = None) -> None:
         """One completed request: compliant iff BOTH targets held.
         A missing TTFT (non-streaming legacy path) judges on e2e
-        alone rather than inventing a violation."""
+        alone rather than inventing a violation.  ``tenant_class``
+        (when given) judges the same completion AGAIN against the
+        class's own objective — band and class are independent
+        promises, a request can meet one and violate the other."""
         state = self._bands.get(band)
-        if state is None:
-            return
-        obj = state.objective
-        bad = e2e_s > obj.e2e_target_s or (
-            ttft_s is not None and ttft_s > obj.ttft_target_s)
-        self._record(state, bad, now)
+        if state is not None:
+            obj = state.objective
+            bad = e2e_s > obj.e2e_target_s or (
+                ttft_s is not None and ttft_s > obj.ttft_target_s)
+            self._record(state, bad, now)
+        cstate = (self._classes.get(tenant_class)
+                  if tenant_class is not None else None)
+        if cstate is not None:
+            cobj = cstate.objective
+            cbad = e2e_s > cobj.e2e_target_s or (
+                ttft_s is not None and ttft_s > cobj.ttft_target_s)
+            self._record(cstate, cbad, now)
 
-    def observe_violation(self, band: int, now: float) -> None:
+    def observe_violation(self, band: int, now: float,
+                          tenant_class: Optional[str] = None) -> None:
         """A request that never produced its answer inside the SLO at
         all — deadline expiry.  Counts as one observed, one bad."""
         state = self._bands.get(band)
         if state is not None:
             self._record(state, True, now)
+        cstate = (self._classes.get(tenant_class)
+                  if tenant_class is not None else None)
+        if cstate is not None:
+            self._record(cstate, True, now)
 
     def _record(self, state: _BandState, bad: bool,
                 now: float) -> None:
@@ -229,15 +303,45 @@ class SloEngine:
         allowed = total * state.objective.error_budget
         return max(0.0, min(1.0, 1.0 - bad / max(1e-9, allowed)))
 
+    def class_burn_rate(self, tenant_class: str, now: float,
+                        window: str = "fast") -> float:
+        """Per-tenant-class error-budget burn (same arithmetic as
+        :meth:`burn_rate`, keyed on the bounded tenancy vocabulary)."""
+        state = self._classes.get(tenant_class)
+        if state is None:
+            return 0.0
+        with self._lock:
+            total, bad = self._window(state, window).totals(now)
+        if total == 0:
+            return 0.0
+        return (bad / total) / state.objective.error_budget
+
+    def class_compliance(self, tenant_class: str, now: float,
+                         window: str = "fast") -> float:
+        state = self._classes.get(tenant_class)
+        if state is None:
+            return 1.0
+        with self._lock:
+            total, bad = self._window(state, window).totals(now)
+        return 1.0 if total == 0 else 1.0 - bad / total
+
     def pressure(self, now: float) -> float:
-        """The autoscale signal: max over bands of the MULTI-WINDOW
-        burn (min of fast and slow) — both windows must be burning,
-        so one bad second cannot trigger a scale-up but a sustained
-        violation does even while the queue stays shallow."""
+        """The autoscale signal: max over bands AND tenant classes of
+        the MULTI-WINDOW burn (min of fast and slow) — both windows
+        must be burning, so one bad second cannot trigger a scale-up
+        but a sustained violation does even while the queue stays
+        shallow.  The class dimension is what lets a flooded premium
+        class pull capacity while the band aggregate still looks
+        healthy (its violations diluted by the flooding tenant's own
+        completions)."""
         worst = 0.0
         for band in self._bands:
             burn = min(self.burn_rate(band, now, "fast"),
                        self.burn_rate(band, now, "slow"))
+            worst = max(worst, burn)
+        for cls in self._classes:
+            burn = min(self.class_burn_rate(cls, now, "fast"),
+                       self.class_burn_rate(cls, now, "slow"))
             worst = max(worst, burn)
         return worst
 
@@ -256,6 +360,12 @@ class SloEngine:
                             self.burn_rate(band, now, window)))
             out.append(("serving_slo_budget_remaining", {"band": name},
                         self.budget_remaining(band, now)))
+        for cls in sorted(self._classes):
+            for window in ("fast", "slow"):
+                out.append((
+                    "serving_slo_class_burn_rate",
+                    {"tenant_class": cls, "window": window},
+                    self.class_burn_rate(cls, now, window)))
         return out
 
     def render(self) -> str:
@@ -303,5 +413,20 @@ class SloEngine:
                     self.budget_remaining(band, now), 6),
                 "met": self.compliance(band, now, "slow")
                 >= obj.target,
+            }
+        for cls, state in sorted(self._classes.items()):
+            cobj = state.objective
+            out[f"class:{cls}"] = {
+                "ttft_target_s": cobj.ttft_target_s,
+                "e2e_target_s": cobj.e2e_target_s,
+                "target": cobj.target,
+                "observed": state.observed_total,
+                "violations": state.violations_total,
+                "burn_rate_fast": round(
+                    self.class_burn_rate(cls, now, "fast"), 4),
+                "burn_rate_slow": round(
+                    self.class_burn_rate(cls, now, "slow"), 4),
+                "met": self.class_compliance(cls, now, "slow")
+                >= cobj.target,
             }
         return out

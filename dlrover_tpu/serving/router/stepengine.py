@@ -75,12 +75,14 @@ class ShardedRouterFront:
         brownout=None,
         threaded: bool = False,
         step_engine: str = "event",
+        tenants=None,
     ):
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1: {num_shards}")
         self.num_shards = int(num_shards)
         self.threaded = bool(threaded)
         self.brownout = brownout
+        self.tenants = tenants
         factory = router_factory or (
             lambda shard: ServingRouter(step_engine=step_engine))
         self.shards: List[ServingRouter] = [
@@ -98,6 +100,13 @@ class ShardedRouterFront:
                 shard.brownout = brownout
                 shard.gateway.brownout = brownout
                 shard.brownout_external = True
+            if tenants is not None:
+                # ONE registry object shared by every shard's gateway:
+                # quotas meter FLEET traffic (a per-shard registry
+                # would multiply every quota_qps by num_shards); the
+                # registry's own lock makes bucket consumption safe
+                # across shard threads
+                shard.gateway.tenants = tenants
         # admission ordinal for the shard hash (itertools.count.next
         # is GIL-atomic, so concurrent client submits draw distinct
         # ordinals without a lock)
@@ -133,11 +142,13 @@ class ShardedRouterFront:
     def submit(self, prompt_ids, max_new_tokens: int,
                priority: int = PRIORITY_NORMAL,
                timeout: Optional[float] = None,
-               now: Optional[float] = None) -> ServingRequest:
+               now: Optional[float] = None,
+               tenant: Optional[str] = None) -> ServingRequest:
         shard = self.shards[
             shard_of(next(self._arrivals), self.num_shards)]
         return shard.submit(prompt_ids, max_new_tokens,
-                            priority=priority, timeout=timeout, now=now)
+                            priority=priority, timeout=timeout,
+                            now=now, tenant=tenant)
 
     # ----------------------------------------------------------- pump
     def _update_shared_brownout(self, now: float) -> None:

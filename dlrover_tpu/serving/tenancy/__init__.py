@@ -1,0 +1,33 @@
+"""Per-tenant QoS: identity, quotas, fair queueing, shed policy.
+
+The gateway's priority bands answer "how urgent is this request";
+this package answers "WHO is asking" — without it one flooding client
+eats an entire band and every other tenant in it starves behind a
+queue that is, formally, perfectly FIFO-fair.
+
+Layers (policy + accounting only — no router imports, so the router
+stack can import tenancy without a cycle):
+
+- :mod:`registry` — :class:`TenantSpec` (quota QPS, queue/in-flight
+  caps, WFQ weight, SLO class, shed class) + :class:`TenantRegistry`
+  (resolution with a configurable default tenant, token-bucket quota
+  state, per-tenant accounting) and the BOUNDED ``tenant_class`` label
+  vocabulary that keeps per-tenant metrics DL010-clean;
+- :mod:`wfq` — :class:`WfqBandQueue`, the start-time-fair-queueing
+  virtual-clock heap that replaces plain FIFO *within* each priority
+  band (the same lazy-heap idiom as the gateway's deadline heap).
+
+The wiring lives in the router stack: the gateway resolves tenants and
+admits through the buckets, the scheduler's window preserves WFQ
+order, the brown-out sweep sheds proportionally by over-use, and the
+SLO engine burns per tenant class.
+"""
+
+from dlrover_tpu.serving.tenancy.registry import (  # noqa: F401
+    SHED_CLASSES,
+    TENANT_CLASSES,
+    TenantRegistry,
+    TenantSpec,
+    plan_shed,
+)
+from dlrover_tpu.serving.tenancy.wfq import WfqBandQueue  # noqa: F401

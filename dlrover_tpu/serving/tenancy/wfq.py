@@ -1,0 +1,201 @@
+"""Start-time fair queueing within one priority band.
+
+Plain FIFO inside a band means the band belongs to whoever submits
+fastest.  :class:`WfqBandQueue` replaces it with the classic SFQ
+virtual-clock discipline (Goyal et al.): each arrival is tagged
+
+``vstart  = max(band_vclock, tenant_last_vfinish)``
+``vfinish = vstart + cost / weight``        (cost = 1 request)
+
+and the band serves ascending ``vfinish``; the virtual clock advances
+to the start tag of each departing request.  A tenant that floods
+only pushes ITS OWN tags into the future — other tenants' tags stay
+near the clock and keep being served at their weight share, which is
+the whole noisy-neighbor story in two lines of arithmetic.
+
+Implementation notes (the gateway's deadline-heap idiom, adapted):
+
+- the live set is a dict ``id(request) -> (vfinish, seq, vstart,
+  request)``; removal (placement, expiry, cancel, shed) is an O(1)
+  dict pop — no heap surgery, no lazy tombstones to sweep;
+- the scheduler's window scan asks for the ``limit`` smallest tags
+  via ``heapq.nsmallest`` — O(n log limit) only on rounds the
+  placement index actually scans (the idle short-circuit keys on the
+  gateway's ``queue_gen``, which bumps on every WFQ insert AND pop);
+- failover requeues bypass the heap into a FRONT deque served before
+  any tagged arrival — a replica crash must not send a half-served
+  request behind a flood, which is the band's pre-tenancy contract;
+- with a single tenant every ``vfinish`` is strictly increasing and
+  the seq tiebreak makes the order EXACTLY FIFO — the trivial
+  registry reproduces pre-tenancy behavior bit-for-bit, which is what
+  the step-engine equivalence suite replays.
+
+Not thread-safe by itself: the owning gateway already serializes all
+queue mutation under its admission lock.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import deque
+from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
+
+Entry = Tuple[float, int, float, object]
+
+
+class WfqBandQueue:
+    """One priority band's queue, fair-ordered across tenants."""
+
+    def __init__(self, weight_of: Callable[[str], float],
+                 shared_counts: Optional[Dict[str, int]] = None):
+        #: tenant name -> WFQ weight (> 0; the registry validates)
+        self._weight_of = weight_of
+        self._front: Deque[object] = deque()
+        self._live: Dict[int, Entry] = {}
+        self._seq = 0
+        self.vclock = 0.0
+        self._last_vfinish: Dict[str, float] = {}
+        # per-band tenant -> queued count (shed planning reads this)
+        self._counts: Dict[str, int] = {}
+        # cross-band tenant -> queued count shared with the gateway's
+        # sibling bands (per-tenant max_queued is a TENANT bound, not
+        # a per-band one)
+        self._shared = shared_counts if shared_counts is not None else {}
+
+    # ------------------------------------------------------- bookkeeping
+    @staticmethod
+    def _tenant(req) -> str:
+        return getattr(req, "tenant", "default")
+
+    def _count(self, tenant: str, delta: int) -> None:
+        for book in (self._counts, self._shared):
+            n = book.get(tenant, 0) + delta
+            if n > 0:
+                book[tenant] = n
+            else:
+                book.pop(tenant, None)
+
+    # ------------------------------------------------------------ insert
+    def append(self, req) -> None:
+        """Tag and enqueue one arrival (virtual-clock discipline)."""
+        tenant = self._tenant(req)
+        weight = max(1e-9, float(self._weight_of(tenant)))
+        vstart = max(self.vclock,
+                     self._last_vfinish.get(tenant, 0.0))
+        vfinish = vstart + 1.0 / weight
+        self._last_vfinish[tenant] = vfinish
+        self._seq += 1
+        self._live[id(req)] = (vfinish, self._seq, vstart, req)
+        self._count(tenant, +1)
+
+    def appendleft(self, req) -> None:
+        """Failover requeue: ahead of every tagged arrival, untagged —
+        the request already won its place once and lost it to a crash,
+        not to fair queueing."""
+        self._front.appendleft(req)
+        self._count(self._tenant(req), +1)
+
+    # ------------------------------------------------------------ remove
+    def remove(self, req) -> None:
+        """Depart one request (placement pop, single cancel).  Raises
+        ``ValueError`` when absent — deque-compatible, the gateway's
+        remove() contract."""
+        entry = self._live.pop(id(req), None)
+        if entry is not None:
+            # SFQ: the virtual clock follows the start tag of the
+            # request entering service
+            self.vclock = max(self.vclock, entry[2])
+            self._count(self._tenant(req), -1)
+            return
+        self._front.remove(req)  # ValueError propagates when absent
+        self._count(self._tenant(req), -1)
+
+    def discard_ids(self, ids) -> None:
+        """Bulk removal by ``id(request)`` — the expiry/cancel
+        partition path (mass expiry must not be O(n) per entry)."""
+        dropped = [e for i, e in self._live.items() if i in ids]
+        for entry in dropped:
+            del self._live[id(entry[3])]
+            self._count(self._tenant(entry[3]), -1)
+        if self._front:
+            kept = deque()
+            for req in self._front:
+                if id(req) in ids:
+                    self._count(self._tenant(req), -1)
+                else:
+                    kept.append(req)
+            self._front = kept
+
+    def clear_all(self) -> List[object]:
+        """Take EVERY queued request (the legacy single-tenant
+        brown-out clear), in service order.  Counts come down one by
+        one — the shared cross-band book also carries the sibling
+        bands' entries, which must survive this band's clear."""
+        out = list(self)
+        for req in out:
+            self._count(self._tenant(req), -1)
+        self._front.clear()
+        self._live.clear()
+        return out
+
+    def pop_shed(self, plan: List[Tuple[str, int]]) -> List[object]:
+        """Take requests per a shed plan ``[(tenant, n)]``, newest
+        (largest vfinish) first within each tenant — the least
+        entitled queue positions go first; front-deque entries
+        (failover survivors) go only after a tenant's tagged queue is
+        exhausted."""
+        out: List[object] = []
+        for tenant, n in plan:
+            if n <= 0:
+                continue
+            mine = sorted(
+                (e for e in self._live.values()
+                 if self._tenant(e[3]) == tenant),
+                reverse=True)
+            for entry in mine[:n]:
+                req = entry[3]
+                del self._live[id(req)]
+                self._count(tenant, -1)
+                out.append(req)
+            n -= min(n, len(mine))
+            if n > 0 and self._front:
+                kept = deque()
+                taken = 0
+                for req in reversed(self._front):
+                    if taken < n and self._tenant(req) == tenant:
+                        taken += 1
+                        self._count(tenant, -1)
+                        out.append(req)
+                    else:
+                        kept.appendleft(req)
+                self._front = kept
+        return out
+
+    # ------------------------------------------------------------- views
+    def scan(self, limit: int) -> List[object]:
+        """The first ``limit`` requests in service order: front deque,
+        then ascending (vfinish, seq)."""
+        out: List[object] = []
+        for req in self._front:
+            if len(out) >= limit:
+                return out
+            out.append(req)
+        rest = limit - len(out)
+        if rest > 0 and self._live:
+            for entry in heapq.nsmallest(rest, self._live.values()):
+                out.append(entry[3])
+        return out
+
+    def counts_by_tenant(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+    def __iter__(self) -> Iterator[object]:
+        yield from self._front
+        for entry in sorted(self._live.values()):
+            yield entry[3]
+
+    def __len__(self) -> int:
+        return len(self._front) + len(self._live)
+
+    def __bool__(self) -> bool:
+        return bool(self._front) or bool(self._live)

@@ -399,6 +399,31 @@ METRIC_HELP: Dict[str, str] = {
         "0.0 exhausted — every further violation is debt); labeled "
         'band="…"'
     ),
+    "serving_slo_class_burn_rate": (
+        "per-TENANT-CLASS error-budget consumption rate over the "
+        "window (same arithmetic as serving_slo_burn_rate, keyed on "
+        "the bounded tenancy vocabulary — a premium class burning "
+        "while its band looks healthy is the noisy-neighbor "
+        'signature); labeled tenant_class="…",window="fast|slow"'
+    ),
+    # -- per-tenant QoS (serving/tenancy; labeled by the BOUNDED -------
+    # -- tenant_class vocabulary, never raw tenant ids — DL010)
+    "serving_tenant_queue_depth": (
+        "requests queued in the gateway per tenant class (raw tenant "
+        "ids stay in logs/traces/JSON summaries; the label vocabulary "
+        'is the closed tenancy.TENANT_CLASSES set); labeled '
+        'tenant_class="…"'
+    ),
+    "serving_tenant_shed_total": (
+        "requests refused or swept by the brown-out ladder per tenant "
+        "class (admission sheds + proportional stage-2 queue sweeps); "
+        'labeled tenant_class="…"'
+    ),
+    "serving_tenant_quota_rejected_total": (
+        "requests refused by the tenant's own QoS contract (quota QPS "
+        "token bucket or max_queued bound) per tenant class — 429s, "
+        'not fleet 503s; labeled tenant_class="…"'
+    ),
     # -- master goodput ledger (dist_master.master_metrics) ------------
     "dlrover_master_goodput": (
         "productive-step time over available wall time since job "
@@ -494,6 +519,12 @@ METRIC_LABELS: Dict[str, tuple] = {
     "serving_slo_compliance": ("band", "window"),
     "serving_slo_burn_rate": ("band", "window"),
     "serving_slo_budget_remaining": ("band",),
+    # tenancy families: values come from the closed TENANT_CLASSES
+    # vocabulary (serving/tenancy/registry.py), never raw tenant ids
+    "serving_slo_class_burn_rate": ("tenant_class", "window"),
+    "serving_tenant_queue_depth": ("tenant_class",),
+    "serving_tenant_shed_total": ("tenant_class",),
+    "serving_tenant_quota_rejected_total": ("tenant_class",),
     # per-op device time of the last captured step: op names come
     # from the XLA module (bounded by the compiled program)
     "dlrover_xprof_collective_seconds": ("op",),

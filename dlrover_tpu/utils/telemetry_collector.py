@@ -327,9 +327,14 @@ class TelemetryCollector:
     the exporter's never-block discipline can be proven against it."""
 
     def __init__(self, port: int = 0, store: Optional[TelemetryStore]
-                 = None, announce: bool = True):
+                 = None, announce: bool = True,
+                 host: str = "127.0.0.1"):
         self.store = store or TelemetryStore()
         self.stall_seconds = 0.0
+        # multi-host recipes (deploy/telemetry.yaml) bind 0.0.0.0 so
+        # routers/agents on OTHER hosts can push; the in-process test
+        # default stays loopback
+        self.host = host
         collector = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -398,7 +403,7 @@ class TelemetryCollector:
                 pass
 
         self._server = http.server.ThreadingHTTPServer(
-            ("127.0.0.1", port), Handler)
+            (host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
         if announce:
@@ -409,8 +414,11 @@ class TelemetryCollector:
 
     @property
     def endpoint(self) -> str:
-        """The base URL exporters point at (OtlpExporter(endpoint=…))."""
-        return f"http://127.0.0.1:{self.port}"
+        """The base URL exporters point at (OtlpExporter(endpoint=…)).
+        An any-interface bind still answers on loopback, so the local
+        URL stays routable for same-host pushers and tests."""
+        host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
+        return f"http://{host}:{self.port}"
 
     def start(self) -> None:
         if self._thread is not None:
@@ -419,7 +427,8 @@ class TelemetryCollector:
             target=self._server.serve_forever, daemon=True,
             name="telemetry-collector")
         self._thread.start()
-        logger.info("telemetry collector on 127.0.0.1:%d", self.port)
+        logger.info("telemetry collector on %s:%d",
+                    self.host, self.port)
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -427,3 +436,32 @@ class TelemetryCollector:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Standalone collector for the multi-host recipe
+    (``deploy/telemetry.yaml``): ``python -m
+    dlrover_tpu.utils.telemetry_collector --host 0.0.0.0 --port 4318``
+    serves until killed; every pusher on any host points
+    ``DLROVER_TELEMETRY_ENDPOINT`` at this address."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="dlrover-tpu fleet telemetry collector "
+                    "(OTLP/HTTP-JSON ingest + /fleet query surface)")
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind address (default: all interfaces)")
+    p.add_argument("--port", type=int, default=4318,
+                   help="bind port (default: 4318, the OTLP/HTTP "
+                        "convention; 0 = ephemeral + announce)")
+    args = p.parse_args(argv)
+    collector = TelemetryCollector(port=args.port, host=args.host)
+    collector.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        collector.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover — process entry point
+    main()

@@ -713,6 +713,108 @@ def test_dl007_later_with_item_runs_under_earlier_lock(tmp_path):
     assert "C.slow" in result.new[0].message
 
 
+# seven classes answer on_event: OVER the duck fan-out cap (6), so an
+# untyped receiver resolves NOWHERE — only the list-registration
+# pointer analysis can type the loop variable.  One implementation
+# blocks; the other six are harmless decoys.
+_CALLBACK_DECOYS = "".join(
+    f"""
+        class Decoy{i}:
+            def on_event(self, evt):
+                return evt
+""" for i in range(6))
+
+
+def test_dl007_traverses_list_registered_callbacks(tmp_path):
+    """The ``_event_callbacks`` pattern: callbacks are appended into a
+    list attr by a typed register() and later invoked while a lock is
+    held.  The loop variable's type comes from the append sites (the
+    "elemof" typeref), NOT duck fan-out — 7 classes define on_event,
+    past the cap — and the witness chain walks through the callback."""
+    result = _scan(tmp_path, {"mod.py": f"""
+        import time
+{_CALLBACK_DECOYS}
+        class SlowSink:
+            def on_event(self, evt):
+                time.sleep(1.0)
+
+        class Bus:
+            def __init__(self):
+                self._event_callbacks = []
+
+            def register(self, cb: SlowSink):
+                self._event_callbacks.append(cb)
+
+            def publish(self, evt):
+                with self._lock:
+                    for cb in self._event_callbacks:
+                        cb.on_event(evt)
+    """})
+    assert _codes(result) == ["DL007"]
+    msg = result.new[0].message
+    assert "SlowSink.on_event" in msg
+    assert "Bus.publish" in msg
+
+
+def test_dl007_list_callbacks_element_annotation_types_the_loop(
+        tmp_path):
+    """Same pattern through a ``List[SlowSink]`` attr annotation and
+    no append in sight (registration lives elsewhere) — the element
+    name flattened out of the annotation types the loop variable."""
+    result = _scan(tmp_path, {"mod.py": f"""
+        import time
+        from typing import List
+{_CALLBACK_DECOYS}
+        class SlowSink:
+            def on_event(self, evt):
+                time.sleep(1.0)
+
+        class Bus:
+            def __init__(self):
+                self._event_callbacks: List[SlowSink] = []
+
+            def publish(self, evt):
+                with self._lock:
+                    for cb in self._event_callbacks:
+                        cb.on_event(evt)
+    """})
+    assert _codes(result) == ["DL007"]
+    assert "SlowSink.on_event" in result.new[0].message
+
+
+def test_dl007_quiet_on_benign_registered_callbacks_and_local_lists(
+        tmp_path):
+    """Good twins: a registered callback that does NOT block stays
+    silent, and a LOCAL list's elements stay opaque — the over-cap
+    method name must not smear the blocking decoy onto it."""
+    result = _scan(tmp_path, {"mod.py": f"""
+        import time
+{_CALLBACK_DECOYS}
+        class SlowSink:
+            def on_event(self, evt):
+                time.sleep(1.0)
+
+        class QuietBus:
+            def __init__(self):
+                self._event_callbacks = []
+
+            def register(self, cb: Decoy0):
+                self._event_callbacks.append(cb)
+
+            def publish(self, evt):
+                with self._lock:
+                    for cb in self._event_callbacks:
+                        cb.on_event(evt)
+
+        class LocalListCaller:
+            def publish(self, callbacks, evt):
+                with self._lock:
+                    for cb in callbacks:
+                        cb.on_event(evt)
+    """})
+    assert _codes(result) == []
+
+
 # --------------------------------------------------------------- DL008
 def test_dl008_two_lock_cycle_names_both_witnesses(tmp_path):
     result = _scan(tmp_path, {"mod.py": """

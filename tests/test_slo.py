@@ -140,9 +140,13 @@ def test_summary_and_render_and_otlp_metrics():
     rows = slo.otlp_metrics(t + 0.5)
     names = {name for name, _, _ in rows}
     assert names == {"serving_slo_compliance", "serving_slo_burn_rate",
-                     "serving_slo_budget_remaining"}
-    bands = {attrs["band"] for _, attrs, _ in rows}
+                     "serving_slo_budget_remaining",
+                     "serving_slo_class_burn_rate"}
+    bands = {attrs["band"] for _, attrs, _ in rows if "band" in attrs}
     assert bands == {"HIGH", "NORMAL", "BATCH"}
+    classes = {attrs["tenant_class"] for _, attrs, _ in rows
+               if "tenant_class" in attrs}
+    assert classes == {"premium", "standard", "background"}
 
 
 # -- the policy signal -------------------------------------------------------
