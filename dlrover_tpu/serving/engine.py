@@ -132,6 +132,7 @@ class InferenceEngine:
         attention_impl: str = "auto",
         mesh: Optional[Any] = None,
         seed: int = 0,
+        prefix_sharing: bool = True,
     ):
         """``speculative_k > 1`` enables prompt-lookup speculative
         decoding: each dispatch verifies up to ``speculative_k - 1``
@@ -178,7 +179,13 @@ class InferenceEngine:
         ``"xla"`` (the interpret-mode kernel is a correctness tool);
         an explicit ``"pallas"`` is honored anywhere (interpret mode
         off-TPU).  The resolved choice is ``self.attention_impl``,
-        the measurement (when taken) ``self.attention_impl_us``."""
+        the measurement (when taken) ``self.attention_impl_us``.
+
+        ``prefix_sharing=False`` (paged pools only; ignored dense)
+        disables copy-on-write prefix-block sharing: every admission
+        gets fresh blocks and nothing is committed to the prefix
+        index — the control arm of the COW golden-equivalence suite
+        and the escape hatch if sharing ever misbehaves in prod."""
         self.cfg = cfg
         self.int8 = int8
         self.chunk = int(chunk)
@@ -290,7 +297,9 @@ class InferenceEngine:
                 n_blocks = int(int(cache_blocks) * self.kv_budget_x)
             else:
                 n_blocks = self.max_slots * self._max_blocks + 1
-            self._blockmgr = BlockManager(n_blocks, self.block_size)
+            self._blockmgr = BlockManager(
+                n_blocks, self.block_size,
+                sharing=bool(prefix_sharing))
             self._slot_blocks: List[Optional[List[int]]] = (
                 [None] * self.max_slots
             )
@@ -474,10 +483,18 @@ class InferenceEngine:
         kv_packed4 = self.kv_dtype == "int4"
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def insert_fn(params, cache, tokens, real_len, slots, rng):
+        def insert_fn(params, cache, tokens, real_len, slots, skip, rng):
             """Prefill a GROUP of same-bucket prompts ([G, Lp]) and
             scatter their K/V into cache slots ``slots`` [G] in one
-            dispatch (jit caches one program per (G, bucket) pair)."""
+            dispatch (jit caches one program per (G, bucket) pair).
+            ``skip`` [G] is the per-row shared-prefix length: those
+            leading positions live in SHARED (read-only) prefix blocks
+            already holding the first writer's K/V, so their writes
+            route to the trash sink (paged COW contract) — the prefill
+            COMPUTE still covers them (logits need the full prompt),
+            only the cache write is masked.  Traced, so one program
+            serves every skip value; the dense layout has no sharing
+            and ignores it."""
             lp = tokens.shape[1]
             logits, ks, vs = prefill(params, cfg, tokens, real_len)
             if paged and kv_quant:
@@ -493,12 +510,12 @@ class InferenceEngine:
                 kp, ksc, vp, vsc = [], [], [], []
                 for p, sp, k in zip(cache["k_pool"], cache["k_scale"],
                                     ks):
-                    np_, ns_ = scatter_q(p, sp, rows, k, zero)
+                    np_, ns_ = scatter_q(p, sp, rows, k, zero, skip)
                     kp.append(np_)
                     ksc.append(ns_)
                 for p, sp, v in zip(cache["v_pool"], cache["v_scale"],
                                     vs):
-                    np_, ns_ = scatter_q(p, sp, rows, v, zero)
+                    np_, ns_ = scatter_q(p, sp, rows, v, zero, skip)
                     vp.append(np_)
                     vsc.append(ns_)
                 new_cache = dict(cache, k_pool=kp, k_scale=ksc,
@@ -511,11 +528,13 @@ class InferenceEngine:
                 new_cache = dict(
                     cache,
                     k_pool=[
-                        scatter_tokens(p, rows, k.astype(p.dtype), zero)
+                        scatter_tokens(p, rows, k.astype(p.dtype),
+                                       zero, skip)
                         for p, k in zip(cache["k_pool"], ks)
                     ],
                     v_pool=[
-                        scatter_tokens(p, rows, v.astype(p.dtype), zero)
+                        scatter_tokens(p, rows, v.astype(p.dtype),
+                                       zero, skip)
                         for p, v in zip(cache["v_pool"], vs)
                     ],
                 )
@@ -635,6 +654,14 @@ class InferenceEngine:
                 == bucket
             ):
                 if self.paged:
+                    # a committed-prefix hit whose CHUNKED writer is
+                    # still mid-prefill must wait — the content is not
+                    # written yet.  (This group's own registrations are
+                    # never pending: the insert dispatch below writes
+                    # them before anything reads.)
+                    if not self._blockmgr.shared_prefix_ready(
+                            self._queue[0].prompt):
+                        break
                     # capacity gate: blocks for the whole lifetime
                     # (bucket-padded prefill writes + gen + spec slack);
                     # pool exhaustion keeps the request QUEUED — that is
@@ -656,11 +683,17 @@ class InferenceEngine:
             for g, req in enumerate(group):
                 padded[g, : req.prompt.size] = req.prompt
                 lens[g] = req.prompt.size
+            # per-row shared-prefix length: positions below it are
+            # mapped shared blocks whose K/V the first writer already
+            # holds — insert_fn masks their cache writes
+            skips = (np.asarray([a[1] for a in allocs], np.int32)
+                     if self.paged
+                     else np.zeros(len(group), np.int32))
             t0 = time.perf_counter()
             self._cache, firsts, self._rng = self._insert_fn(
                 self.params, self._cache, jnp.asarray(padded),
                 jnp.asarray(lens), jnp.asarray(slots, jnp.int32),
-                self._rng,
+                jnp.asarray(skips), self._rng,
             )
             firsts = np.asarray(firsts)
             self.stats.prefill_seconds += time.perf_counter() - t0
@@ -711,28 +744,84 @@ class InferenceEngine:
         the cursor reaches the prompt end.  False = pool exhausted,
         request stays queued."""
         req = self._queue[0]
+        start = 0
         if self.paged:
-            # prefix-cache hits are rewritten by the chunk program
-            # (idempotent up to program numerics: the chunked and
-            # monolithic prefill compute identical K/V modulo low-order
-            # attention rounding, so a live sharer admitted through the
-            # OTHER path sees an epsilon-level prefix perturbation, not
-            # corruption)
+            # never map (and warm-start past) committed blocks whose
+            # writer has not finished writing them: wait in the queue
+            # until the prefix is FILLED, then admit with a real hit
+            if not self._blockmgr.shared_prefix_ready(req.prompt):
+                return False
             alloc = self._alloc_lifetime(
                 req, _bucket(req.prompt.size, self.buckets))
             if alloc is None:
                 return False
-            self._bind_blocks(s, alloc[0])
+            blocks, shared = alloc
+            # blocks past the shared region were REGISTERED at alloc
+            # but their content arrives one chunk per step: hold other
+            # admissions off them until the cursor publishes each
+            # (mark_filled in _advance_prefill)
+            self._blockmgr.mark_pending(
+                blocks[shared // self.block_size:
+                       req.prompt.size // self.block_size])
+            if shared:
+                c = self.prefill_chunk
+                # warm start: shared blocks already hold the prefix's
+                # K/V, so the cursor begins at the last chunk boundary
+                # inside the shared region instead of 0 — the TTFT win.
+                # The clamp keeps the FINAL chunk live even when the
+                # whole prompt is shared: sampling the first token
+                # needs one real dispatch.
+                start = min((shared // c) * c,
+                            ((req.prompt.size - 1) // c) * c)
+                # the chunk program WRITES positions [start, ...), so
+                # every shared block it overlaps must diverge first
+                # (COW) — unlike batched prefill there is no write
+                # mask here (verify_step's scatter covers the whole
+                # chunk), so the contract is enforced by ownership
+                src: List[int] = []
+                dst: List[int] = []
+                for j in range(start // self.block_size,
+                               shared // self.block_size):
+                    r = self._blockmgr.cow_block(blocks[j])
+                    if r is None:
+                        # pool exhausted mid-divergence: roll the whole
+                        # admission back (cow_block already moved our
+                        # reference into blocks[j] for completed
+                        # copies, so one free_sequence balances it)
+                        self._blockmgr.free_sequence(blocks)
+                        return False
+                    new_bid, copied = r
+                    if copied:
+                        src.append(blocks[j])
+                        dst.append(new_bid)
+                        blocks[j] = new_bid
+                if src:
+                    self._copy_blocks(src, dst)
+            self._bind_blocks(s, blocks)
             self._table_dirty = True
         self._queue.popleft()
         self._slot_req[s] = req
         self._prefilling[s] = True
-        self._prefill_pos[s] = 0
+        self._prefill_pos[s] = start
         self._tokens[s] = 0
         self._positions[s] = self._park_pos
         self._remaining[s] = req.max_new_tokens
         self.stats.prefill_admissions += 1
         return True
+
+    def _copy_blocks(self, src: List[int], dst: List[int]) -> None:
+        """COW divergence copies: pool rows ``src[i] -> dst[i]`` across
+        every layer's K/V pools (and scale pools when quantized), so
+        the diverging sequence starts from the shared content it is
+        about to overwrite the tail of."""
+        si = jnp.asarray(src, jnp.int32)
+        di = jnp.asarray(dst, jnp.int32)
+        cache = dict(self._cache)
+        for key in ("k_pool", "v_pool", "k_scale", "v_scale"):
+            pools = cache.get(key)
+            if pools is not None:
+                cache[key] = [p.at[di].set(p[si]) for p in pools]
+        self._cache = cache
 
     def _advance_prefill(self) -> None:
         """One bounded prompt chunk for EVERY prefilling slot, batched
@@ -787,6 +876,15 @@ class InferenceEngine:
             req = self._slot_req[s]
             end = int(ends[i])
             self._prefill_pos[s] = end
+            if self.paged:
+                # the chunk just written completes every prompt block
+                # it crosses the end of — publish them so waiting
+                # admissions (shared_prefix_ready) can warm-start
+                bs = self.block_size
+                blocks = self._slot_blocks[s]
+                for j in range(int(starts[i]) // bs,
+                               min(end // bs, req.prompt.size // bs)):
+                    self._blockmgr.mark_filled(blocks[j])
             if end < req.prompt.size:
                 continue
             first = int(firsts[i])
@@ -880,6 +978,22 @@ class InferenceEngine:
         if self.paged and self.kv_dtype == "int4":
             return self._blockmgr.num_blocks
         return 0
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """The ``serving_prefix_*`` ledger of the paged prefix cache
+        (hits, misses, evictions, COW copies, shared blocks/tokens) —
+        {} for dense layouts, which have no sharing to account."""
+        if not self.paged:
+            return {}
+        return self._blockmgr.prefix_stats()
+
+    def prefix_heads(self, n: int = 8) -> List[str]:
+        """This replica's hottest committed prefix-head digests (hex)
+        — what the worker advertises over STATS so the router's
+        prefix-routing table can steer warm traffic here."""
+        if not self.paged:
+            return []
+        return self._blockmgr.hot_heads(n)
 
     # ----------------------------------------------------------- step
     @property

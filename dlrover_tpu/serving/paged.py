@@ -21,37 +21,58 @@ attention, prefix reuse), rebuilt TPU-style:
   first (the gather is XLA-fused with the attention reads); a fused
   Pallas paged-attention kernel is the optimization seam.
 
-Writes into SHARED (refcount > 1) prefix blocks are allowed and
-harmless by construction: a shared block is always a full prompt block
-whose content is a deterministic function of the same tokens, so any
-writer rewrites identical values.
+Shared (refcount > 1) prefix blocks are READ-ONLY — the copy-on-write
+contract (serving/prefixcache):
+
+- a full prompt block whose chained digest matches a committed block
+  is MAPPED (refcount bumped), never copied or recomputed;
+- prefill write masking (the ``skip_upto`` argument of the scatter
+  helpers) routes every write at a shared position to the trash sink,
+  so a sharer can never perturb the block it maps — readers see the
+  FIRST writer's KV bit-for-bit;
+- a sequence that must write INSIDE its shared region (chunked
+  prefill starting chunk-unaligned) first diverges those blocks via
+  :meth:`BlockManager.cow_block` — still-shared blocks are copied to
+  a fresh block, a privately-held committed block is unregistered in
+  place — and only then writes.
+
+Generated tokens, speculative-verify slack and bucket-padding junk
+all land at positions >= the prompt's full-block prefix, which the
+allocator always backs with fresh blocks — so the only writers the
+COW machinery must police are the prefill paths above.
 """
 
 from __future__ import annotations
 
-import hashlib
-from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dlrover_tpu.serving.prefixcache import PrefixBlockIndex, chain_key
 
-def _chain_key(prev: bytes, tok_bytes: bytes) -> bytes:
-    """Chained prefix-block key: a stable 128-bit blake2b digest.  Python's
-    ``hash()`` is only 64-bit and salted per process — a collision would
-    silently alias two different prefixes to one block and corrupt a live
-    sequence's attention, and salting breaks cross-restart stability."""
-    return hashlib.blake2b(prev + tok_bytes, digest_size=16).digest()
+# legacy alias: the chained digest moved to serving/prefixcache (the
+# router computes routing heads with the SAME function)
+_chain_key = chain_key
 
 
 class BlockManager:
-    """Host-side pool bookkeeping: allocation, refcounts, prefix LRU."""
+    """Host-side pool bookkeeping: allocation, refcounts, prefix COW.
 
-    def __init__(self, num_blocks: int, block_size: int):
+    Committed-prefix state (digests, content verification, the ref-0
+    LRU, head tracking, the stats ledger) lives in
+    :class:`~dlrover_tpu.serving.prefixcache.PrefixBlockIndex`; this
+    class owns ids, the free list and refcounts.  ``sharing=False``
+    disables prefix mapping entirely (every allocation gets fresh
+    blocks, nothing is committed) — the COW-off half of the golden
+    equivalence suite."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 sharing: bool = True):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.sharing = bool(sharing)
         # block 0 is the TRASH SINK, never allocated: the decode step
         # computes (and writes) junk KV for INACTIVE slots too — their
         # all-zero table rows must route those writes somewhere no live
@@ -59,36 +80,73 @@ class BlockManager:
         # slot's own row; paging needs the sentinel)
         self._free: List[int] = list(range(1, num_blocks))[::-1]
         self._ref = np.zeros(num_blocks, np.int32)
-        # chain-digest -> block id for full prompt blocks currently in
-        # the pool (referenced or lingering)
-        self._prefix: Dict[bytes, int] = {}
-        self._block_hash: Dict[int, bytes] = {}
-        # block id -> the raw token bytes it holds: a hit is only trusted
-        # after the content check (belt-and-braces on top of the 128-bit
-        # key — a false hit must never alias blocks)
-        self._block_tokens: Dict[int, bytes] = {}
-        # fully-released prefix blocks, oldest first (evictable)
-        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # committed blocks whose KV content has NOT been written yet.
+        # Batched prefill writes within the same dispatch that follows
+        # allocation, so its registrations are immediately valid; the
+        # CHUNKED path registers at alloc time but writes the prompt
+        # over many steps — the engine marks those blocks pending and
+        # clears them (mark_filled) as its cursor crosses each one, so
+        # a second sequence never warm-starts over unwritten content
+        self._pending: set = set()
+        self.index = PrefixBlockIndex()
 
     # ------------------------------------------------------------ alloc
     @property
     def available_blocks(self) -> int:
-        return len(self._free) + len(self._lru)
+        return len(self._free) + self.index.lru_count()
 
     def _take_block(self) -> Optional[int]:
         if self._free:
-            return self._free.pop()
-        if self._lru:  # evict the oldest lingering prefix block
-            bid, _ = self._lru.popitem(last=False)
-            self._block_tokens.pop(bid, None)
-            h = self._block_hash.pop(bid, None)
-            # the chain hash may have been RE-registered to a newer
-            # block after this one was orphaned — only drop the mapping
-            # if it still points at the block being evicted
-            if h is not None and self._prefix.get(h) == bid:
-                self._prefix.pop(h, None)
-            return bid
-        return None
+            bid = self._free.pop()
+        else:
+            # evict the oldest lingering prefix block (LRU); the index
+            # stages its head (if it was one) for the next advertisement
+            # drain so the router's routing entry invalidates too
+            bid = self.index.evict_one()
+        if bid is not None:
+            self._pending.discard(bid)
+        return bid
+
+    def mark_pending(self, bids: List[int]) -> None:
+        """Declare committed blocks whose KV write is IN FLIGHT (the
+        chunked-prefill registration gap).  ``shared_prefix_ready``
+        holds admissions that would map them until :meth:`mark_filled`
+        publishes each one.  Uncommitted ids (sharing disabled) are
+        ignored — nothing can map them anyway."""
+        self._pending.update(
+            b for b in bids if self.index.is_committed(b))
+
+    def mark_filled(self, bid: int) -> None:
+        """The prefill dispatch covering ``bid``'s positions landed:
+        its KV content now exists, so other sequences may warm-start
+        over it."""
+        self._pending.discard(bid)
+
+    def shared_prefix_ready(self, prompt: np.ndarray) -> bool:
+        """Would ``prompt``'s committed-prefix hits all hold WRITTEN
+        content?
+
+        Pure probe (no stats, no refcounts): walks the digest chain
+        exactly like :meth:`alloc_sequence`'s hit loop and returns
+        False iff some matching committed block is still pending —
+        i.e. the first writer's chunked prefill has not reached it
+        yet.  Callers keep the request queued and retry next step
+        rather than mapping (and warm-starting past) content that
+        does not exist."""
+        if not self.sharing or not self._pending:
+            return True
+        bs = self.block_size
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        chain = b""
+        for i in range(prompt.size // bs):
+            tok_bytes = prompt[i * bs:(i + 1) * bs].tobytes()
+            chain = chain_key(chain, tok_bytes)
+            bid = self.index.lookup(chain, tok_bytes)
+            if bid is None:
+                break
+            if bid in self._pending:
+                return False
+        return True
 
     def alloc_sequence(
         self, prompt: np.ndarray, total_len: int
@@ -99,7 +157,10 @@ class BlockManager:
         are served by refcount-bumped prefix-cache hits, or None when
         the pool cannot cover the request (caller keeps it queued)."""
         bs = self.block_size
-        prompt = np.asarray(prompt).reshape(-1)
+        # int32 normalization: digests are over raw token BYTES, and
+        # the router's head_key hashes int32 — a caller handing int64
+        # tokens must still land on the same chain
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
         n_blocks = -(-max(int(total_len), 1) // bs)
         # enforce total_len >= len(prompt) at the API boundary: a shorter
         # total_len would otherwise let len(shared) exceed n_blocks and
@@ -108,13 +169,14 @@ class BlockManager:
 
         shared: List[Tuple[bytes, int]] = []
         chain = b""
-        for i in range(full_prompt_blocks):
-            tok_bytes = prompt[i * bs:(i + 1) * bs].tobytes()
-            chain = _chain_key(chain, tok_bytes)
-            bid = self._prefix.get(chain)
-            if bid is None or self._block_tokens.get(bid) != tok_bytes:
-                break
-            shared.append((chain, bid))
+        if self.sharing:
+            for i in range(full_prompt_blocks):
+                tok_bytes = prompt[i * bs:(i + 1) * bs].tobytes()
+                chain = chain_key(chain, tok_bytes)
+                bid = self.index.lookup(chain, tok_bytes)
+                if bid is None:
+                    break
+                shared.append((chain, bid))
         need = n_blocks - len(shared)
         # reviving a shared hit that currently lingers in the LRU also
         # consumes availability (it leaves the evictable set) — without
@@ -126,8 +188,9 @@ class BlockManager:
         blocks: List[int] = []
         for chain_h, bid in shared:
             if self._ref[bid] == 0:
-                self._lru.pop(bid, None)  # revive a lingering block
+                self.index.revive(bid)  # revive a lingering block
             self._ref[bid] += 1
+            self.index.note_hit(bid, bs)
             blocks.append(bid)
         chain = shared[-1][0] if shared else b""
         for i in range(len(shared), n_blocks):
@@ -137,10 +200,11 @@ class BlockManager:
             blocks.append(bid)
             if i < full_prompt_blocks:
                 tok_bytes = prompt[i * bs:(i + 1) * bs].tobytes()
-                chain = _chain_key(chain, tok_bytes)
-                self._prefix[chain] = bid
-                self._block_hash[bid] = chain
-                self._block_tokens[bid] = tok_bytes
+                chain = chain_key(chain, tok_bytes)
+                if self.sharing:
+                    self.index.note_miss()
+                    self.index.register(
+                        chain, bid, tok_bytes, head=(i == 0))
         return blocks, len(shared) * bs
 
     def free_sequence(self, blocks: List[int]) -> None:
@@ -148,12 +212,88 @@ class BlockManager:
             self._ref[bid] -= 1
             assert self._ref[bid] >= 0
             if self._ref[bid] == 0:
-                if bid in self._block_hash:
+                if self.index.is_committed(bid) \
+                        and bid not in self._pending:
                     # prefix block: linger in the LRU for reuse
-                    self._lru[bid] = None
-                    self._lru.move_to_end(bid)
+                    self.index.linger(bid)
                 else:
+                    # uncommitted — or committed but still pending (its
+                    # chunked writer was cancelled mid-prefill): the
+                    # content is garbage, so drop the registration
+                    # instead of letting a future hit map it
+                    if self.index.is_committed(bid):
+                        self.index.forget(bid)
+                    self._pending.discard(bid)
                     self._free.append(bid)
+
+    # -------------------------------------------------------------- cow
+    def cow_block(self, bid: int) -> Optional[Tuple[int, bool]]:
+        """Divergence point: the caller is about to WRITE into ``bid``,
+        which may be shared.  Returns ``(block, copied)``:
+
+        - still shared (ref > 1): a fresh block with ref 1; ``bid``'s
+          ref comes down by one and ``copied=True`` tells the caller
+          to copy the pool rows ``bid -> block`` before writing;
+        - privately held (ref == 1) but committed: the SAME id with
+          its registration dropped (``copied=False``) — no other
+          sequence can map it mid-rewrite;
+        - None: pool exhausted (no block for the copy) — the caller
+          rolls its admission back and keeps the request queued."""
+        if self._ref[bid] > 1:
+            new = self._take_block()
+            if new is None:
+                return None
+            self._ref[bid] -= 1
+            self._ref[new] = 1
+            self.index.note_cow()
+            return new, True
+        if self.index.is_committed(bid):
+            self.index.forget(bid)
+            self._pending.discard(bid)
+        return bid, False
+
+    # ------------------------------------------------------------ books
+    def shared_block_count(self) -> int:
+        """Blocks currently mapped by more than one sequence."""
+        return int((self._ref > 1).sum())
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """The ``serving_prefix_*`` ledger for this pool."""
+        stats = self.index.stats()
+        stats["prefix_shared_blocks"] = float(self.shared_block_count())
+        return stats
+
+    def hot_heads(self, n: int = 8) -> List[str]:
+        return self.index.hot_heads(n)
+
+    def drain_evicted_heads(self) -> List[str]:
+        return self.index.drain_evicted_heads()
+
+    def check_books(self) -> bool:
+        """Assert the block books balance: every block except the
+        trash sink is in EXACTLY one of {free list, referenced,
+        ref-0 LRU}, and LRU membership implies committed.  The fuzz
+        and chaos suites call this after every interleaving — a leak
+        or double-free fails here, not three allocations later.
+        Returns True so callers can write ``assert m.check_books()``."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "free list holds dupes"
+        live = {int(b) for b in np.nonzero(self._ref > 0)[0]}
+        lru = {bid for bid in range(self.num_blocks)
+               if self.index.in_lru(bid)}
+        assert 0 not in free | live | lru, "trash sink was allocated"
+        assert not (free & live), f"free AND referenced: {free & live}"
+        assert not (free & lru), f"free AND lingering: {free & lru}"
+        assert not (live & lru), f"referenced AND lingering: {live & lru}"
+        every = free | live | lru
+        expect = set(range(1, self.num_blocks))
+        assert every == expect, (
+            f"leaked blocks: {sorted(expect - every)}; "
+            f"phantom blocks: {sorted(every - expect)}")
+        for bid in lru:
+            assert self.index.is_committed(bid), (
+                f"uncommitted block {bid} lingering in LRU")
+        return True
 
 
 # ---------------------------------------------------------------- device
@@ -166,7 +306,8 @@ def gather_blocks(pool: jax.Array, table: jax.Array) -> jax.Array:
 
 
 def _block_offsets(table: jax.Array, positions: jax.Array,
-                   k: int, bs: int):
+                   k: int, bs: int,
+                   skip_upto: Optional[jax.Array] = None):
     """``(block_id [B, K], offset [B, K])`` for K consecutive positions
     per slot.  Positions BEYOND the table row route to block 0 (the
     trash sink) instead of gather-clamping to the last column: a
@@ -174,13 +315,24 @@ def _block_offsets(table: jax.Array, positions: jax.Array,
     wrapped offset — which for a full-length sequence is a LIVE block
     — whereas parked/inactive slots (chunked prefill holds a slot
     mid-prompt while decode keeps dispatching) legitimately emit
-    out-of-range junk positions that must go nowhere."""
+    out-of-range junk positions that must go nowhere.
+
+    ``skip_upto`` [B] is the COW write mask: positions BELOW it are
+    served by shared prefix blocks (refcount > 1 — read-only by the
+    copy-on-write contract), so their writes route to the trash sink
+    too.  The trash detour is cheaper and simpler than predicating the
+    scatter itself, and the VALUES being suppressed are recomputed
+    bit-identical anyway — the mask exists so a numerically-divergent
+    rewrite (different batch geometry) can never perturb a block
+    another live sequence is reading."""
     mb = table.shape[1]
     pos = positions[:, None] + jnp.arange(k)[None, :]        # [B, K]
     col = pos // bs
     bidx = jnp.take_along_axis(
         table, jnp.minimum(col, mb - 1), axis=1)             # [B, K]
     bidx = jnp.where(col < mb, bidx, 0)
+    if skip_upto is not None:
+        bidx = jnp.where(pos < skip_upto[:, None], 0, bidx)
     return bidx, pos % bs
 
 
@@ -189,11 +341,12 @@ def scatter_tokens(
     table: jax.Array,       # [B, MB]
     kv: jax.Array,          # [B, K, KV, D] new entries
     positions: jax.Array,   # [B] position of kv[:, 0]
+    skip_upto: Optional[jax.Array] = None,  # [B] COW write mask
 ) -> jax.Array:
     """Write K consecutive tokens per slot into their blocks."""
     bs = pool.shape[1]
     b, k = kv.shape[:2]
-    bidx, off = _block_offsets(table, positions, k, bs)
+    bidx, off = _block_offsets(table, positions, k, bs, skip_upto)
     return pool.at[bidx.reshape(-1), off.reshape(-1)].set(
         kv.reshape(b * k, *kv.shape[2:])
     )
@@ -239,6 +392,7 @@ def scatter_tokens_q(
     table: jax.Array,       # [B, MB]
     kv: jax.Array,          # [B, K, KV, D] new fp entries
     positions: jax.Array,   # [B]
+    skip_upto: Optional[jax.Array] = None,  # [B] COW write mask
 ):
     """Quantize-and-write K consecutive tokens per slot: codes into the
     int8 pool, per-(token, head) scales into the block-shaped scale
@@ -248,7 +402,7 @@ def scatter_tokens_q(
     bs = pool.shape[1]
     b, k = kv.shape[:2]
     q, scale = quantize_kv_int8(kv)
-    bidx, off = _block_offsets(table, positions, k, bs)
+    bidx, off = _block_offsets(table, positions, k, bs, skip_upto)
     flat_b, flat_o = bidx.reshape(-1), off.reshape(-1)
     return (
         pool.at[flat_b, flat_o].set(q.reshape(b * k, *q.shape[2:])),
@@ -285,6 +439,7 @@ def scatter_tokens_q4(
     table: jax.Array,       # [B, MB]
     kv: jax.Array,          # [B, K, KV, D] new fp entries
     positions: jax.Array,   # [B]
+    skip_upto: Optional[jax.Array] = None,  # [B] COW write mask
 ):
     """int4 twin of :func:`scatter_tokens_q`: quantize-pack-and-write K
     consecutive tokens per slot (codes at half a byte per element,
@@ -295,7 +450,7 @@ def scatter_tokens_q4(
     bs = pool.shape[1]
     b, k = kv.shape[:2]
     q, scale = quantize_kv_int4(kv)
-    bidx, off = _block_offsets(table, positions, k, bs)
+    bidx, off = _block_offsets(table, positions, k, bs, skip_upto)
     flat_b, flat_o = bidx.reshape(-1), off.reshape(-1)
     return (
         pool.at[flat_b, flat_o].set(q.reshape(b * k, *q.shape[2:])),

@@ -1,0 +1,31 @@
+"""Global prefix cache: shared KV prefix blocks + prefix-aware routing.
+
+Two halves of one story — N requests carrying the same prompt prefix
+(a system prompt, a chat session's history) should pay for its KV
+once, fleet-wide:
+
+- :mod:`cache` — the ENGINE half: :func:`chain_key` /
+  :func:`head_key` (the chained blake2b content addressing shared
+  with ``serving/paged.py``) and :class:`PrefixBlockIndex`, the
+  host-side index of committed prefix blocks (content-verified
+  lookup, ref-0 LRU linger, hot-head tracking, the COW/hit/eviction
+  stats ledger).  ``paged.BlockManager`` owns block ids and
+  refcounts and delegates every committed-prefix decision here.
+- :mod:`table` — the ROUTER half: :class:`PrefixRoutingTable`, a
+  bounded prefix-head -> replica map fed by STATS advertisements
+  (each replica's hottest committed heads), consulted by the
+  scheduler AHEAD of the generic affinity heuristic and invalidated
+  on replica death/drain and on advertised eviction.
+
+No router or engine imports here (both sides import THIS package),
+so the dependency arrow stays one-way.
+"""
+
+from dlrover_tpu.serving.prefixcache.cache import (  # noqa: F401
+    PrefixBlockIndex,
+    chain_key,
+    head_key,
+)
+from dlrover_tpu.serving.prefixcache.table import (  # noqa: F401
+    PrefixRoutingTable,
+)

@@ -1,0 +1,215 @@
+"""Content-addressed prefix-block index: the engine half of the cache.
+
+``BlockManager`` (serving/paged.py) owns the block POOL — ids, the
+free list, refcounts.  This module owns everything about which blocks
+are COMMITTED PREFIX blocks: the chained content digests, the
+belt-and-braces token-byte verification, the ref-0 LRU that lets
+released prefix KV linger until the allocator actually needs the
+space, hot-HEAD tracking (which first-block digests are being hit —
+the advertisement the router's :class:`~dlrover_tpu.serving.
+prefixcache.table.PrefixRoutingTable` is fed from), and the stats
+ledger (hits/misses/evictions/COW copies) the ``serving_prefix_*``
+metrics export.
+
+Keys are CHAINED: block i's digest covers blocks 0..i, so a hit
+guarantees the whole prefix matches, not just one block.  A HEAD is
+the depth-1 digest (the first ``block_size`` tokens) — the router
+routes on heads because a head hit is a necessary condition for any
+deeper chain hit.
+
+Everything here is host-side in-memory bookkeeping driven by the
+engine's single-threaded step loop — no locks, no I/O (dlint
+DL003/DL007 stay trivially clean).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def chain_key(prev: bytes, tok_bytes: bytes) -> bytes:
+    """Chained prefix-block key: a stable 128-bit blake2b digest.
+    Python's ``hash()`` is only 64-bit and salted per process — a
+    collision would silently alias two different prefixes to one block
+    and corrupt a live sequence's attention, and salting breaks
+    cross-restart stability (heads must match ACROSS replicas so the
+    router can route on them)."""
+    return hashlib.blake2b(prev + tok_bytes, digest_size=16).digest()
+
+
+def head_key(prompt, block_size: int) -> Optional[str]:
+    """The HEAD digest of a prompt: the depth-1 chain key over its
+    first ``block_size`` tokens, hex-encoded (the wire/advertisement
+    form).  None when the prompt does not cover one full block — such
+    a prompt can never hit the prefix cache, so it has no head.
+
+    Tokens are normalized to int32 before hashing: the engine's
+    ``alloc_sequence`` hashes int32 token bytes, and the scheduler
+    computing a head from a client-provided array of any integer
+    dtype MUST land on the same digest or routing never matches."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    if prompt.size < block_size:
+        return None
+    return chain_key(b"", prompt[:block_size].tobytes()).hex()
+
+
+class PrefixBlockIndex:
+    """Index of committed prefix blocks for one block pool.
+
+    The owning ``BlockManager`` calls in with bare block ids; this
+    class never allocates or refcounts — it only remembers which ids
+    currently hold which verified prefix content, which of those are
+    evictable (ref 0), and what happened (the stats ledger)."""
+
+    #: evicted-head digests kept for the next STATS advertisement
+    #: drain — bounded so a cache-thrashing replica cannot grow an
+    #: unbounded list between drains
+    MAX_EVICTED_HEADS = 256
+
+    def __init__(self) -> None:
+        # chain digest -> block id for full prompt blocks currently in
+        # the pool (referenced or lingering)
+        self._prefix: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        # block id -> the raw token bytes it holds: a hit is only
+        # trusted after the content check (belt-and-braces on top of
+        # the 128-bit key — a false hit must never alias blocks)
+        self._block_tokens: Dict[int, bytes] = {}
+        # fully-released prefix blocks, oldest first (evictable)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # HEAD tracking: block id -> hex head digest for depth-1
+        # blocks, and hit counts per head (the advertisement ranking)
+        self._head_of: Dict[int, str] = {}
+        self._head_hits: Dict[str, int] = {}
+        self._evicted_heads: List[str] = []
+        # ---- stats ledger (exported as serving_prefix_* metrics)
+        self.hits = 0            # full prompt blocks served by a hit
+        self.misses = 0          # full prompt blocks that had to be built
+        self.evictions = 0       # committed blocks evicted from the LRU
+        self.cow_copies = 0      # divergence copies (BlockManager.cow_block)
+        self.revivals = 0        # ref-0 lingering blocks revived by a hit
+        self.shared_tokens = 0   # cumulative prompt tokens served shared
+
+    # ------------------------------------------------------------ lookup
+    def lookup(self, chain: bytes, tok_bytes: bytes) -> Optional[int]:
+        """Content-verified hit: the committed block holding exactly
+        ``tok_bytes`` under digest ``chain``, or None."""
+        bid = self._prefix.get(chain)
+        if bid is None or self._block_tokens.get(bid) != tok_bytes:
+            return None
+        return bid
+
+    def note_hit(self, bid: int, tokens: int) -> None:
+        self.hits += 1
+        self.shared_tokens += tokens
+        head = self._head_of.get(bid)
+        if head is not None:
+            self._head_hits[head] = self._head_hits.get(head, 0) + 1
+
+    def note_miss(self) -> None:
+        self.misses += 1
+
+    def note_cow(self) -> None:
+        self.cow_copies += 1
+
+    # ---------------------------------------------------------- register
+    def register(self, chain: bytes, bid: int, tok_bytes: bytes,
+                 head: bool) -> None:
+        """Commit ``bid`` as the block holding ``tok_bytes`` under
+        ``chain``.  ``head`` marks a depth-1 block (advertisable)."""
+        self._prefix[chain] = bid
+        self._block_hash[bid] = chain
+        self._block_tokens[bid] = tok_bytes
+        if head:
+            hx = chain.hex()
+            self._head_of[bid] = hx
+            self._head_hits.setdefault(hx, 0)
+
+    def is_committed(self, bid: int) -> bool:
+        return bid in self._block_hash
+
+    def committed_count(self) -> int:
+        return len(self._block_hash)
+
+    def forget(self, bid: int, evicted: bool = False) -> None:
+        """Drop every registration of ``bid`` (COW privatization, or
+        eviction cleanup).  ``evicted`` stages the block's head (when
+        it was one) for the next advertisement drain so the router
+        invalidates its routing entry."""
+        self._block_tokens.pop(bid, None)
+        h = self._block_hash.pop(bid, None)
+        # the chain hash may have been RE-registered to a newer block
+        # after this one was orphaned — only drop the mapping if it
+        # still points at the block being forgotten
+        if h is not None and self._prefix.get(h) == bid:
+            self._prefix.pop(h, None)
+        self._lru.pop(bid, None)
+        head = self._head_of.pop(bid, None)
+        if head is not None:
+            self._head_hits.pop(head, None)
+            if evicted and len(self._evicted_heads) < \
+                    self.MAX_EVICTED_HEADS:
+                self._evicted_heads.append(head)
+
+    # --------------------------------------------------------------- lru
+    def linger(self, bid: int) -> None:
+        """A committed block's refcount reached 0: evictable, newest
+        last."""
+        self._lru[bid] = None
+        self._lru.move_to_end(bid)
+
+    def revive(self, bid: int) -> None:
+        """A lingering block was hit again: back to referenced."""
+        # membership test, not pop-default: the stored VALUE is None,
+        # so pop(bid, None) could not tell a hit from a miss
+        if bid not in self._lru:
+            return
+        del self._lru[bid]
+        self.revivals += 1
+
+    def lru_count(self) -> int:
+        return len(self._lru)
+
+    def in_lru(self, bid: int) -> bool:
+        return bid in self._lru
+
+    def evict_one(self) -> Optional[int]:
+        """Evict the OLDEST lingering block (the allocator needs the
+        space); returns its id or None when nothing lingers."""
+        if not self._lru:
+            return None
+        bid, _ = self._lru.popitem(last=False)
+        self.evictions += 1
+        self.forget(bid, evicted=True)
+        return bid
+
+    # ------------------------------------------------------------- heads
+    def hot_heads(self, n: int = 8) -> List[str]:
+        """The ``n`` most-hit head digests still committed in the pool
+        — what this replica advertises over STATS."""
+        live = [(hits, hx) for hx, hits in self._head_hits.items()]
+        live.sort(reverse=True)
+        return [hx for _, hx in live[:n]]
+
+    def drain_evicted_heads(self) -> List[str]:
+        """Heads evicted since the last drain (advertised so the
+        router drops their routing entries); clears the list."""
+        out, self._evicted_heads = self._evicted_heads, []
+        return out
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, float]:
+        return {
+            "prefix_hits": float(self.hits),
+            "prefix_misses": float(self.misses),
+            "prefix_evictions": float(self.evictions),
+            "prefix_cow": float(self.cow_copies),
+            "prefix_revivals": float(self.revivals),
+            "prefix_shared_tokens": float(self.shared_tokens),
+            "prefix_cached_blocks": float(len(self._block_hash)),
+            "prefix_lru_blocks": float(len(self._lru)),
+        }

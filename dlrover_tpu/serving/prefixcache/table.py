@@ -1,0 +1,115 @@
+"""Prefix routing table: the router half of the global prefix cache.
+
+A bounded head-digest -> replica map the scheduler consults BEFORE its
+generic prefix-affinity heuristic: affinity remembers where a prefix
+was last PLACED, this table knows where its KV blocks are actually
+RESIDENT right now — replicas advertise their hottest committed heads
+over STATS every step, and each advertisement REPLACES the replica's
+previous set (generation semantics: a head missing from the newest
+advertisement was evicted engine-side, so its entry drops immediately
+instead of aging out).
+
+Invalidation paths:
+
+- replica death / drain / retirement: ``forget_replica`` (called from
+  ``ContinuousBatchScheduler.forget_replica``, which both the reap and
+  the retire paths already hit) drops every entry pointing at it;
+- advertised eviction: replacement semantics above;
+- capacity: a global LRU over heads bounds the table at ``cap``
+  entries whatever the fleet advertises.
+
+Plain dict/OrderedDict bookkeeping mutated only under the router's
+step lock — no locks of its own, no I/O.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Set
+
+
+class PrefixRoutingTable:
+    """Bounded, generation-aware head -> replica routing map."""
+
+    def __init__(self, cap: int = 1024):
+        self.cap = int(cap)
+        # head digest (hex) -> replica name, LRU-ordered (oldest first)
+        self._heads: "OrderedDict[str, str]" = OrderedDict()
+        # replica -> the head set of its LATEST advertisement
+        self._by_replica: Dict[str, Set[str]] = {}
+        # advertisement generation per replica (introspection: a stale
+        # entry is impossible by construction, but tests pin that the
+        # generation actually advanced)
+        self._gen: Dict[str, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    # ---------------------------------------------------------- feeding
+    def advertise(self, replica: str, heads: Iterable[str]) -> None:
+        """One replica's newest hot-head set.  REPLACES its previous
+        advertisement: heads it no longer lists were evicted on the
+        engine and their routing entries drop now."""
+        new = []
+        seen: Set[str] = set()
+        for h in heads:
+            if h not in seen:
+                seen.add(h)
+                new.append(h)
+        old = self._by_replica.get(replica, set())
+        for h in old - seen:
+            if self._heads.get(h) == replica:
+                del self._heads[h]
+                self.invalidations += 1
+        for h in new:
+            # last advertiser wins: with COW sharing the SAME head can
+            # be hot on several replicas; any of them is a warm target
+            self._heads[h] = replica
+            self._heads.move_to_end(h)
+        self._by_replica[replica] = seen
+        self._gen[replica] = self._gen.get(replica, 0) + 1
+        while len(self._heads) > self.cap:
+            h, owner = self._heads.popitem(last=False)
+            owned = self._by_replica.get(owner)
+            if owned is not None:
+                owned.discard(h)
+
+    def forget_replica(self, replica: str) -> None:
+        """Replica left the fleet (death, drain, retirement): every
+        entry pointing at it is now a route to nowhere — drop them."""
+        for h in self._by_replica.pop(replica, set()):
+            if self._heads.get(h) == replica:
+                del self._heads[h]
+                self.invalidations += 1
+        self._gen.pop(replica, None)
+
+    # ---------------------------------------------------------- queries
+    def lookup(self, head: Optional[str]) -> Optional[str]:
+        """Where is this head's KV resident?  None on miss (or for a
+        headless prompt).  A hit refreshes the entry's LRU position."""
+        if head is None:
+            return None
+        replica = self._heads.get(head)
+        if replica is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._heads.move_to_end(head)
+        return replica
+
+    def generation(self, replica: str) -> int:
+        return self._gen.get(replica, 0)
+
+    def heads_of(self, replica: str) -> List[str]:
+        return sorted(self._by_replica.get(replica, set()))
+
+    def __len__(self) -> int:
+        return len(self._heads)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "prefix_route_entries": float(len(self._heads)),
+            "prefix_route_hits": float(self.hits),
+            "prefix_route_misses": float(self.misses),
+            "prefix_route_invalidations": float(self.invalidations),
+        }

@@ -126,6 +126,7 @@ class RemoteReplicaHandle:
         self._stats_seq_seen = 0
         self.stale_stats_dropped = 0
         self._engine_metrics: Optional[Dict[str, float]] = None
+        self._prefix_heads: List[str] = []
         self._last_frame = time.monotonic()
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
@@ -241,6 +242,15 @@ class RemoteReplicaHandle:
                         str(k): float(v) for k, v in em.items()
                         if isinstance(v, (int, float))
                     }
+                heads = frame.get("prefix_heads")
+                if isinstance(heads, list):
+                    # hottest committed prefix heads (hex digests):
+                    # replacement semantics — the latest advertised
+                    # set IS the replica's current hot set, so the
+                    # router's routing table drops what vanished
+                    self._prefix_heads = [
+                        str(h) for h in heads if isinstance(h, str)
+                    ]
         elif kind in (FrameKind.SUBMITTED, FrameKind.ERROR):
             self._submit_replies[int(frame["rid"])] = frame
             self._submit_cv.notify_all()
@@ -411,6 +421,15 @@ class RemoteReplicaHandle:
                 return None
             em = self._engine_metrics
             return dict(em) if em else None
+
+    def prefix_heads(self) -> List[str]:
+        """Latest advertised hot prefix heads from STATS ([] while
+        none arrived, or once the replica is dead — a corpse must not
+        keep feeding the routing table)."""
+        with self._lock:
+            if self._dead is not None:
+                return []
+            return list(self._prefix_heads)
 
     def blocks_needed(self, prompt_len: int,
                       max_new_tokens: int) -> Optional[float]:

@@ -33,6 +33,7 @@ import numpy as np
 
 from dlrover_tpu.common.constants import ServingFabric
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.prefixcache import head_key
 from dlrover_tpu.serving.remote.protocol import FrameConnection, FrameKind
 from dlrover_tpu.utils.tracing import parse_traceparent, trace_sampled
 
@@ -57,6 +58,10 @@ class FakeEngine:
         self._next = 0
         self.active: Dict[int, dict] = {}
         self.generated_tokens = 0
+        # prompt-head hit counts: the fake's stand-in for the real
+        # engine's committed-prefix hot-head ranking, so fabric/router
+        # tests exercise prefix-routing advertisements without jax
+        self._head_hits: Dict[str, int] = {}
         # wall seconds of the most recent step() — decode-step
         # histogram attribution when this engine runs in-process
         self.last_step_seconds: Optional[float] = None
@@ -68,6 +73,9 @@ class FakeEngine:
             raise ValueError(
                 f"prompt {prompt.size} + max_new {max_new_tokens} "
                 f"exceeds engine max_len {self.max_len}")
+        head = head_key(prompt, self.block_size)
+        if head is not None:
+            self._head_hits[head] = self._head_hits.get(head, 0) + 1
         rid = self._next
         self._next += 1
         need = -(-total // self.block_size)
@@ -108,6 +116,14 @@ class FakeEngine:
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> float:
         return float(-(-(prompt_len + max_new_tokens) // self.block_size))
+
+    def prefix_heads(self, n: int = 8) -> List[str]:
+        """Hottest prompt-head digests seen by this fake (hex) — the
+        advertisement the router's prefix-routing table is fed from,
+        same surface as the real engine's committed-prefix ranking."""
+        live = sorted(((hits, hx) for hx, hits in
+                       self._head_hits.items()), reverse=True)
+        return [hx for _, hx in live[:n]]
 
     # streaming extras -------------------------------------------------
     def inflight_outputs(self) -> Dict[int, List[int]]:
@@ -468,6 +484,15 @@ class WorkerServer:
                 self._last_stats_payload["engine_metrics"] = {
                     k: float(v) for k, v in em().items()
                 }
+            # hottest committed prefix heads (hex digests) ride STATS
+            # as their own additive key — they are identities, not
+            # numbers, so they cannot live in engine_metrics' float
+            # namespace; receivers ignore unknown keys (DL004 holds)
+            heads = getattr(eng, "prefix_heads", None)
+            if heads is not None:
+                self._last_stats_payload["prefix_heads"] = [
+                    str(h) for h in heads()
+                ]
         # seq is assigned at SEND time (never stored in the cached
         # payload): a cached liveness resend carries stale numbers
         # under a fresh ordinal, same last-send-wins semantics as

@@ -440,7 +440,11 @@ class RequestGateway:
 
     # ---------------------------------------------------------- tenants
     def _tenant_weight(self, tenant: str) -> float:
-        return self.tenants.resolve(tenant).weight
+        # boosted_weight = configured WFQ weight x the tenant class's
+        # temporary SLO-burn boost (1.0 in steady state) — the router's
+        # observe phase drives the boost up while the class burns error
+        # budget and decays it back once the burn recovers
+        return self.tenants.boosted_weight(self.tenants.resolve(tenant))
 
     def _tenant_release(self, req: ServingRequest) -> None:
         """Terminal hook (exactly once per request): the tenant's open

@@ -96,6 +96,24 @@ class LoadgenConfig:
     # cannot perturb the arrival times/prompts an existing seed
     # replays byte-identically.
     tenant_mix: Tuple[Tuple[str, float], ...] = ()
+    # prompt CONTENT shape (the prefix-cache workloads):
+    # - "independent": every prompt is unrelated content (the legacy
+    #   shape; np.arange prompts, zero sharable prefix);
+    # - "chat": multi-turn conversations over ``chat_sessions``
+    #   concurrent sessions — each arrival is the next turn of a
+    #   (seeded) random session, and a turn's prompt EXTENDS the
+    #   previous turn's prompt + answer, so consecutive turns share a
+    #   growing prefix (the COW cache's bread-and-butter reuse);
+    # - "sysprompt": the shared-system-prompt flood — every arrival is
+    #   one ``system_prompt_len``-token prompt shared by ALL users
+    #   plus a unique per-user tail (the N-users-one-template shape
+    #   the dedup gate measures).
+    # Workload draws ride their OWN seeded streams: existing seeds of
+    # the "independent" shape replay byte-identically.
+    workload: str = "independent"   # independent | chat | sysprompt
+    system_prompt_len: int = 256    # chat/sysprompt shared head
+    chat_sessions: int = 8          # concurrent conversations
+    chat_turn_tokens: int = 32      # new user tokens per turn
 
 
 @dataclasses.dataclass
@@ -105,6 +123,52 @@ class Arrival:
     max_new_tokens: int
     priority: int
     tenant: Optional[str] = None
+    # prefix-workload identity (prompt CONTENT is a pure function of
+    # these + the config, via prompt_tokens): uid distinguishes users
+    # in the sysprompt flood; session/turn name the conversation slot
+    # and its turn number in the chat workload
+    uid: int = 0
+    session: int = -1
+    turn: int = 0
+
+
+def _tok_stream(n: int, salt: int) -> np.ndarray:
+    """Deterministic pseudo-token content: ``n`` int32 ids in
+    [0, 32000) from a salted multiplicative stream.  Same (n, salt) ->
+    identical array, and a longer stream with the same salt EXTENDS the
+    shorter one — which is exactly the property the chat workload needs
+    (turn t's prompt is a strict prefix-extension of turn t-1's)."""
+    if n <= 0:
+        return np.zeros(0, dtype=np.int32)
+    return ((np.arange(n, dtype=np.int64) * 2654435761
+             + salt * 40503 + 11) % 32000).astype(np.int32)
+
+
+#: salt of the fleet-wide shared system prompt (sysprompt workload)
+_SYSPROMPT_SALT = 0xC0FFEE
+#: per-session stream base salt (chat workload)
+_CHAT_SALT = 0x5E55
+
+
+def prompt_tokens(arrival: Arrival, cfg: LoadgenConfig) -> np.ndarray:
+    """The arrival's prompt CONTENT (deterministic; rigs call this
+    instead of the np.arange pool for the prefix workloads).
+
+    - chat: one salted stream per session slot, truncated at the
+      turn's length — every turn extends the previous turn's prompt;
+    - sysprompt: the shared system-prompt head (same salt for every
+      user) + a unique per-uid tail;
+    - independent: the legacy np.arange prompt."""
+    if cfg.workload == "chat":
+        return _tok_stream(
+            arrival.prompt_len, _CHAT_SALT + arrival.session)
+    if cfg.workload == "sysprompt":
+        head = _tok_stream(cfg.system_prompt_len, _SYSPROMPT_SALT)
+        tail = _tok_stream(
+            arrival.prompt_len - cfg.system_prompt_len,
+            1 + arrival.uid)
+        return np.concatenate([head, tail])
+    return np.arange(arrival.prompt_len, dtype=np.int32)
 
 
 class OpenLoopGenerator:
@@ -115,6 +179,10 @@ class OpenLoopGenerator:
         if self.config.arrival not in ("poisson", "bursty", "diurnal"):
             raise ValueError(
                 f"unknown arrival process {self.config.arrival!r}")
+        if self.config.workload not in (
+                "independent", "chat", "sysprompt"):
+            raise ValueError(
+                f"unknown workload {self.config.workload!r}")
 
     def _rate_at(self, t: float) -> float:
         cfg = self.config
@@ -157,20 +225,56 @@ class OpenLoopGenerator:
         trng = random.Random(cfg.seed ^ 0x7E4A47)
         tenants = [t for t, _ in cfg.tenant_mix]
         tweights = [w for _, w in cfg.tenant_mix]
+        # prefix-workload draws ride their own stream (same invariant
+        # as the tenant stream: the chat/sysprompt shape must not move
+        # an arrival time or band the main stream already determined)
+        wrng = random.Random(cfg.seed ^ 0xC4A7)
+        turn_of = [0] * max(1, cfg.chat_sessions)  # per-session turns
+        uid = 0
         t = 0.0
         while True:
             rate = max(1e-6, self._rate_at(t))
             t += rng.expovariate(rate)
             if t >= cfg.duration_s:
                 return
+            # the main stream's draw happens UNCONDITIONALLY so the
+            # legacy "independent" schedule replays byte-identically
+            # whatever workload is configured on top of it
+            drawn_len = self._prompt_len(rng)
+            session, turn = -1, 0
+            prompt_len = drawn_len
+            if cfg.workload == "chat":
+                session = wrng.randrange(max(1, cfg.chat_sessions))
+                turn = turn_of[session]
+                # turn t's prompt = system prompt + t completed
+                # (user turn + answer) rounds + this turn's user text;
+                # a conversation that would outgrow prompt_max resets
+                # its slot (a fresh conversation, same session stream)
+                prompt_len = (cfg.system_prompt_len
+                              + turn * (cfg.chat_turn_tokens
+                                        + cfg.max_new_tokens)
+                              + cfg.chat_turn_tokens)
+                if prompt_len > cfg.prompt_max and turn > 0:
+                    turn_of[session] = 0
+                    turn = 0
+                    prompt_len = (cfg.system_prompt_len
+                                  + cfg.chat_turn_tokens)
+                turn_of[session] = turn + 1
+            elif cfg.workload == "sysprompt":
+                # shared head + the drawn length as the unique tail
+                prompt_len = cfg.system_prompt_len + drawn_len
             yield Arrival(
                 at_s=t,
-                prompt_len=self._prompt_len(rng),
+                prompt_len=prompt_len,
                 max_new_tokens=cfg.max_new_tokens,
                 priority=rng.choices(bands, weights)[0],
                 tenant=(trng.choices(tenants, tweights)[0]
                         if tenants else None),
+                uid=uid,
+                session=session,
+                turn=turn,
             )
+            uid += 1
 
 
 def _quantiles(sorted_vals: List[float],
@@ -227,9 +331,14 @@ def run_gateway_rig(
     cfg = config or LoadgenConfig()
     gen = OpenLoopGenerator(cfg)
     # pre-built prompt pool: the rig measures the GATEWAY, and
-    # np.arange per arrival would time numpy allocation instead
-    pool_lens = sorted({a.prompt_len for a in gen.arrivals()})
-    pool = {n: np.arange(n, dtype=np.int32) for n in pool_lens}
+    # np.arange per arrival would time numpy allocation instead.
+    # Prefix workloads need CONTENT (shared heads), so they build per
+    # arrival via prompt_tokens instead — those rigs measure the
+    # cache, not the admission microseconds.
+    content = cfg.workload != "independent"
+    pool = ({} if content else
+            {n: np.arange(n, dtype=np.int32)
+             for n in sorted({a.prompt_len for a in gen.arrivals()})})
 
     # per-submit wall seconds, RESERVOIR-sampled (not first-N: on a
     # 60s soak the p99 must see the final seconds' tail, not only the
@@ -254,7 +363,8 @@ def run_gateway_rig(
             ahead = arrival.at_s - (time.perf_counter() - t0)
             if ahead > 0.002:
                 time.sleep(ahead)
-        prompt = pool[arrival.prompt_len]
+        prompt = (prompt_tokens(arrival, cfg) if content
+                  else pool[arrival.prompt_len])
         kw = ({"tenant": arrival.tenant}
               if arrival.tenant is not None else {})
         s0 = time.perf_counter()
@@ -365,8 +475,10 @@ def run_router_rig(
     instead, which keeps this driver loop correct for both."""
     cfg = config or LoadgenConfig()
     gen = OpenLoopGenerator(cfg)
-    pool_lens = sorted({a.prompt_len for a in gen.arrivals()})
-    pool = {n: np.arange(n, dtype=np.int32) for n in pool_lens}
+    content = cfg.workload != "independent"
+    pool = ({} if content else
+            {n: np.arange(n, dtype=np.int32)
+             for n in sorted({a.prompt_len for a in gen.arrivals()})})
 
     admitted: List[object] = []
     shed = {band: 0 for band, _ in cfg.priority_mix}
@@ -389,7 +501,8 @@ def run_router_rig(
             ahead = arrival.at_s - (time.perf_counter() - t0)
             if ahead > 0.002:
                 time.sleep(ahead)
-        prompt = pool[arrival.prompt_len]
+        prompt = (prompt_tokens(arrival, cfg) if content
+                  else pool[arrival.prompt_len])
         kw = ({"tenant": arrival.tenant}
               if arrival.tenant is not None else {})
         try:

@@ -107,6 +107,24 @@ class RouterMetrics:
         self.kv4_blocks = 0.0
         self.prefill_chunk_seconds = 0.0
         self.paged_kernel_step_seconds = 0.0
+        # prefix-cache fleet aggregates (engine-side COW ledger summed
+        # over reporting replicas, same sweep as the raw-speed keys)
+        self.prefix_hits = 0.0
+        self.prefix_misses = 0.0
+        self.prefix_evictions = 0.0
+        self.prefix_cow = 0.0
+        self.prefix_revivals = 0.0
+        self.prefix_shared_tokens = 0.0
+        self.prefix_shared_blocks = 0.0
+        self.prefix_cached_blocks = 0.0
+        self.prefix_lru_blocks = 0.0
+        # router-side prefix-routing table counters, mirrored from the
+        # scheduler by the observe sweep (like the sched_* counters)
+        self.prefix_route_entries = 0.0
+        self.prefix_route_hits = 0.0
+        self.prefix_route_misses = 0.0
+        self.prefix_route_invalidations = 0.0
+        self.prefix_route_placements = 0.0
         # resolved paged-attention impl per reporting replica, counted
         # into the labeled serving_attention_impl family (bounded
         # vocabulary: "xla" | "pallas")
@@ -243,6 +261,19 @@ class RouterMetrics:
             d.get("prefill_chunk_seconds", 0.0) for d in dicts)
         self.paged_kernel_step_seconds = sum(
             d.get("paged_kernel_step_seconds", 0.0) for d in dicts)
+        for attr, key in (
+            ("prefix_hits", "prefix_hits"),
+            ("prefix_misses", "prefix_misses"),
+            ("prefix_evictions", "prefix_evictions"),
+            ("prefix_cow", "prefix_cow"),
+            ("prefix_revivals", "prefix_revivals"),
+            ("prefix_shared_tokens", "prefix_shared_tokens"),
+            ("prefix_shared_blocks", "prefix_shared_blocks"),
+            ("prefix_cached_blocks", "prefix_cached_blocks"),
+            ("prefix_lru_blocks", "prefix_lru_blocks"),
+        ):
+            setattr(self, attr,
+                    sum(d.get(key, 0.0) for d in dicts))
         impls: Dict[str, int] = {}
         for d in dicts:
             if "attention_impl_pallas" in d:
@@ -318,6 +349,24 @@ class RouterMetrics:
                 self.sched_capacity_evals,
             "serving_sched_rounds_skipped_total":
                 self.sched_rounds_skipped,
+            "serving_prefix_hits_total": self.prefix_hits,
+            "serving_prefix_misses_total": self.prefix_misses,
+            "serving_prefix_evictions_total": self.prefix_evictions,
+            "serving_prefix_cow_total": self.prefix_cow,
+            "serving_prefix_revivals_total": self.prefix_revivals,
+            "serving_prefix_shared_tokens_total":
+                self.prefix_shared_tokens,
+            "serving_prefix_shared_blocks": self.prefix_shared_blocks,
+            "serving_prefix_cached_blocks": self.prefix_cached_blocks,
+            "serving_prefix_lru_blocks": self.prefix_lru_blocks,
+            "serving_prefix_route_entries": self.prefix_route_entries,
+            "serving_prefix_route_hits_total": self.prefix_route_hits,
+            "serving_prefix_route_misses_total":
+                self.prefix_route_misses,
+            "serving_prefix_route_invalidations_total":
+                self.prefix_route_invalidations,
+            "serving_prefix_route_placements_total":
+                self.prefix_route_placements,
         }
 
     def render_histograms(self) -> str:

@@ -170,12 +170,24 @@ class InferenceEngineAdapter:
                 1.0 if impl == "pallas" else 0.0)
             out["paged_kernel_step_seconds"] = (
                 st.decode_seconds if impl == "pallas" else 0.0)
+            # prefix-cache ledger (all-float, so the dict still rides
+            # STATS frames as-is); dense engines have no sharing
+            prefix = getattr(eng, "prefix_stats", None)
+            if prefix is not None:
+                out.update(prefix())
         if st.spec_proposed:
             # only replicas actually speculating report a ratio — a
             # spec-disabled engine's structural 0.0 would dilute the
             # fleet's speculation-health mean toward zero
             out["spec_accept_ratio"] = st.spec_accept_ratio
         return out
+
+    def prefix_heads(self) -> List[str]:
+        """Hottest committed prefix-head digests ([] when unpaged) —
+        the local twin of the remote worker's ``prefix_heads`` STATS
+        payload, feeding the router's prefix-routing table."""
+        fn = getattr(self.engine, "prefix_heads", None)
+        return [] if fn is None else list(fn())
 
     def slots_free(self) -> int:
         eng = self.engine
@@ -311,6 +323,20 @@ class ReplicaHandle:
             return None
         em = fn()
         return em if em else None
+
+    def prefix_heads(self) -> List[str]:
+        """This replica's advertised hot prefix heads (hex digests),
+        [] for engines without the surface — the router's observe
+        phase feeds these into the scheduler's prefix-routing table
+        every step (replacement semantics: a head that stops being
+        advertised was evicted, and its routing entry drops)."""
+        fn = getattr(self.engine, "prefix_heads", None)
+        if fn is None:
+            return []
+        try:
+            return list(fn())
+        except Exception:
+            return []
 
     @property
     def schedulable(self) -> bool:

@@ -72,6 +72,7 @@ from dlrover_tpu.serving.router.replica import (
     base_replica_name,
 )
 from dlrover_tpu.serving.router.scheduler import ContinuousBatchScheduler
+from dlrover_tpu.serving.tenancy.registry import TENANT_CLASSES
 
 
 def _tid(req: ServingRequest) -> Optional[str]:
@@ -111,6 +112,7 @@ class ServingRouter:
         brownout=None,
         slo=None,
         step_engine: str = "event",
+        tenant_spec_file: Optional[str] = None,
     ):
         if step_engine not in self.STEP_ENGINES:
             raise ValueError(
@@ -176,6 +178,17 @@ class ServingRouter:
         # scaler's node accounting drifts one node per crash
         self.dead: "deque[DrainedReplica]" = deque(maxlen=256)
         self._lock = threading.RLock()
+        # tenant QoS spec persistence (tenancy satellite): a JSON file
+        # of TenantSpec contracts loaded at construction and re-loaded
+        # live on request — SIGHUP (arm_tenant_reload_signal) or an
+        # admin endpoint both just call request_tenant_reload(); the
+        # actual file read happens at the TOP of the next step, before
+        # the step lock, so reload never does blocking I/O under it
+        # (DL003) and never races admission mid-resolve
+        self._tenant_spec_file: Optional[str] = tenant_spec_file
+        self._tenant_reload_pending = False
+        if tenant_spec_file is not None:
+            self.reload_tenants()
 
     # ------------------------------------------------------ membership
     def join_replica(self, name: str, engine, node=None,
@@ -214,6 +227,42 @@ class ServingRouter:
     def replica_names(self) -> List[str]:
         return list(self.manager.replicas)
 
+    # -------------------------------------------- tenant spec reload
+    def request_tenant_reload(self) -> None:
+        """Ask for a live tenant-spec reload; honored at the top of the
+        next :meth:`step`.  Safe from a signal handler or an admin
+        endpoint thread — it only flips a flag."""
+        self._tenant_reload_pending = True
+
+    def reload_tenants(self) -> tuple:
+        """Reload tenant specs from the configured file NOW (in place:
+        usage books survive, dropped tenants leave, quota buckets
+        re-arm).  Returns ``(registered, removed)``."""
+        if self._tenant_spec_file is None:
+            return (0, 0)
+        registered, removed = self.gateway.tenants.reload_file(
+            self._tenant_spec_file)
+        logger.info(
+            "tenant specs reloaded from %s: %d registered, %d removed",
+            self._tenant_spec_file, registered, removed)
+        return registered, removed
+
+    def arm_tenant_reload_signal(self) -> bool:
+        """Install a SIGHUP handler that requests a live tenant-spec
+        reload (deployment convenience; main thread only — returns
+        False where signals are unavailable)."""
+        try:
+            import signal
+
+            signal.signal(
+                signal.SIGHUP,
+                lambda *_: self.request_tenant_reload())
+            return True
+        except (ValueError, OSError, AttributeError):
+            # not the main thread, or a platform without SIGHUP —
+            # request_tenant_reload() stays callable directly
+            return False
+
     # --------------------------------------------------------- client
     def submit(
         self,
@@ -241,6 +290,15 @@ class ServingRouter:
         now = time.monotonic() if now is None else now
         perf = time.perf_counter
         phase = self.metrics.observe_step_phase
+        # live tenant-spec reload, OUTSIDE the step lock (file I/O):
+        # requested by SIGHUP or an admin endpoint, applied here so the
+        # new contracts are in force for this round's admissions
+        if self._tenant_reload_pending:
+            self._tenant_reload_pending = False
+            try:
+                self.reload_tenants()
+            except Exception as e:  # a bad file must not kill the pump
+                logger.warning("tenant spec reload failed: %s", e)
         # flight-recorder dumps requested during this round: flushed
         # AFTER the step lock is released — serializing span trees and
         # logging must not extend the critical section that placement
@@ -415,6 +473,10 @@ class ServingRouter:
                 for req in done:
                     self._record_ttft(req, now)
                     self.metrics.observe_tokens(len(req.output), now)
+                    # per-tenant generated-token book (usage endpoint;
+                    # plain dict arithmetic, safe under the step lock)
+                    self.gateway.tenants.note_tokens(
+                        req.tenant, len(req.output))
                     self.metrics.completed += 1
                     if req.finished_at is not None:
                         e2e = req.finished_at - req.submitted_at
@@ -494,6 +556,19 @@ class ServingRouter:
                 h.engine_metrics()
                 for h in self.manager.replicas.values()
             ])
+            # prefix-routing table feed: each replica advertises its
+            # hottest committed prefix heads (rode the same STATS frame
+            # as engine_metrics for remote replicas — plain attribute
+            # reads here).  Advertisement REPLACES the replica's head
+            # set, so a head evicted replica-side drops its route this
+            # round — the table only ever claims residency it has
+            # fresh evidence for.
+            for name, h in self.manager.replicas.items():
+                heads = h.prefix_heads()
+                if heads or self.scheduler.prefix_table.heads_of(name):
+                    self.scheduler.advertise_prefixes(name, heads)
+            for key, val in self.scheduler.prefix_route_stats().items():
+                setattr(self.metrics, key, float(val))
             # per-tenant-class QoS books: the registry aggregates its
             # per-tenant dicts onto the bounded class vocabulary here,
             # so raw tenant ids never leave the gateway (DL010).
@@ -504,6 +579,15 @@ class ServingRouter:
                 tenants.by_class(tenants.shed),
                 tenants.by_class(tenants.quota_rejected),
             )
+            # SLO-burn WFQ boost: a tenant class burning its error
+            # budget gets a temporary, bounded weight multiplier so
+            # admission favors it until the burn recovers (pure
+            # arithmetic over the SLO engine's windows — lock-clean)
+            if self.slo is not None and not tenants.trivial:
+                tenants.update_slo_boosts({
+                    cls: self.slo.class_burn_rate(cls, now)
+                    for cls in TENANT_CLASSES
+                })
             # placement fast-path counters (regression surface for the
             # incremental index; plain attribute reads)
             self.metrics.sched_capacity_evals = float(

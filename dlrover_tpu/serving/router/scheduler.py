@@ -12,10 +12,15 @@ stays queued.
 
 Placement preference order:
 
-1. **prefix affinity** — a replica that recently served the same leading
-   prompt tokens gets the request (its paged prefix cache very likely
-   still holds those blocks, making the prefill nearly free);
-2. **least loaded** — otherwise the replica with the most free slots,
+1. **prefix routing table** — a replica currently ADVERTISING the
+   request's prefix head as hot (serving/prefixcache.PrefixRoutingTable,
+   fed from STATS) gets the request: its paged pool is KNOWN to hold
+   the shared blocks right now, so the prefill maps them for free
+   (copy-on-write sharing).  Requires the scheduler's ``block_size``
+   to match the engines' — heads are depth-one block digests;
+2. **prefix affinity** — else a replica that recently served the same
+   leading prompt tokens (its cache very LIKELY still holds them);
+3. **least loaded** — otherwise the replica with the most free slots,
    ties broken by free KV blocks.
 
 Incremental placement index (``incremental=True``, the event step
@@ -63,6 +68,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from dlrover_tpu.serving.prefixcache import PrefixRoutingTable, head_key
 from dlrover_tpu.serving.router.gateway import RequestGateway, ServingRequest
 
 
@@ -101,10 +107,15 @@ class ContinuousBatchScheduler:
         # (queue_gen, cap_gen) of a round that placed nothing — while
         # unchanged, schedule() returns [] without scanning the window
         self._idle_marker: Optional[tuple] = None
+        # prefix-head -> replica routing (fed by STATS advertisements
+        # via advertise_prefixes; invalidated by forget_replica and by
+        # advertisement replacement) — consulted AHEAD of affinity
+        self.prefix_table = PrefixRoutingTable()
         # ---- regression counters -------------------------------------
         self.capacity_evals = 0   # (request x replica) fit checks
         self.rounds = 0
         self.rounds_skipped = 0   # short-circuited rounds
+        self.route_placements = 0  # placements steered by prefix_table
 
     # ------------------------------------------------------------ keys
     def prefix_key(self, prompt: np.ndarray) -> Optional[bytes]:
@@ -197,7 +208,19 @@ class ContinuousBatchScheduler:
                 continue  # stays queued; later (smaller) requests may fit
             key = self.prefix_key(req.prompt)
             affinity_hit = False
-            if key is not None:
+            route_hit = False
+            routed = self.prefix_table.lookup(
+                head_key(req.prompt, self.block_size))
+            if routed is not None:
+                # the routing table KNOWS this head's blocks are
+                # resident there right now — stronger than affinity's
+                # "recently served", so it wins when the target fits
+                target = [h for h in cands if h.name == routed]
+                if target:
+                    cands = target
+                    affinity_hit = True
+                    route_hit = True
+            if not route_hit and key is not None:
                 affine = [
                     h for h in cands
                     if key in self._affinity.get(h.name, ())
@@ -209,8 +232,10 @@ class ContinuousBatchScheduler:
                 cands,
                 key=lambda h: (free[h.name][0], free[h.name][1]),
             )
-            self._commit(gateway, placements, free, best, req,
-                         len(cands), affinity_hit, now)
+            placed = self._commit(gateway, placements, free, best, req,
+                                  len(cands), affinity_hit, now)
+            if placed and route_hit:
+                self.route_placements += 1
         return placements
 
     def _schedule_indexed(
@@ -244,8 +269,23 @@ class ContinuousBatchScheduler:
             key = self.prefix_key(req.prompt)
             best = None
             affinity_hit = False
+            route_hit = False
             cand_count = 0
-            if key is not None:
+            routed = self.prefix_table.lookup(
+                head_key(req.prompt, self.block_size))
+            if routed is not None:
+                # routed replica wins when it fits (resident blocks
+                # beat probabilistic affinity); free.get covers a
+                # routed name that is dead or hidden this round
+                f = free.get(routed)
+                if f is not None and f[0] > 0:
+                    self.capacity_evals += 1
+                    if f[1] >= self._need(by_name[routed], req):
+                        best = by_name[routed]
+                        affinity_hit = True
+                        route_hit = True
+                        cand_count = 1
+            if best is None and key is not None:
                 affine = self._affinity_index.get(key)
                 if affine:
                     fitting = []
@@ -287,6 +327,8 @@ class ContinuousBatchScheduler:
                 gateway, placements, free, best, req,
                 cand_count, affinity_hit, now)
             if placed:
+                if route_hit:
+                    self.route_placements += 1
                 f = free[best.name]
                 if f[0] > 0:
                     heapq.heappush(heap, (-f[0], -f[1], best.name))
@@ -336,11 +378,29 @@ class ContinuousBatchScheduler:
             if not names:
                 del self._affinity_index[key]
 
+    # ----------------------------------------------------- prefix route
+    def advertise_prefixes(self, replica: str, heads) -> None:
+        """Feed one replica's newest hot-head advertisement into the
+        routing table (replacement semantics: heads it stopped
+        advertising were evicted engine-side and their entries drop).
+        Called from the router's observe phase every step."""
+        self.prefix_table.advertise(replica, heads)
+
+    def prefix_route_stats(self) -> Dict[str, float]:
+        """Routing-table counters plus actual routed placements — the
+        ``serving_prefix_route_*`` metric feed."""
+        stats = self.prefix_table.stats()
+        stats["prefix_route_placements"] = float(self.route_placements)
+        return stats
+
     def forget_replica(self, replica: str) -> None:
-        """Drop affinity state for a departed replica (its cache is gone
-        with it — routing for warmth to a fresh process is pure loss)."""
+        """Drop affinity AND prefix-routing state for a departed
+        replica (its cache is gone with it — routing for warmth to a
+        fresh process is pure loss, and a routing-table entry pointing
+        at a corpse would steer every warm request into the reap)."""
         lru = self._affinity.pop(replica, None)
         if lru:
             for key in lru:
                 self._unindex(key, replica)
         self._last_free.pop(replica, None)
+        self.prefix_table.forget_replica(replica)

@@ -18,6 +18,7 @@ raw tenant ids stay in logs, traces and JSON summaries only.
 from __future__ import annotations
 
 import dataclasses
+import json
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -149,6 +150,15 @@ class TenantRegistry:
         self.admitted: Dict[str, int] = {}
         self.quota_rejected: Dict[str, int] = {}
         self.shed: Dict[str, int] = {}
+        self.tokens: Dict[str, int] = {}
+        # SLO-burn WFQ boost: tenant_class -> temporary weight
+        # multiplier (>= 1.0).  Fed by the router's observe phase from
+        # the SLO engine's per-class burn rates; a class burning its
+        # error budget gets a BOUNDED multiplier on every member
+        # tenant's WFQ weight until the burn recovers, then the boost
+        # decays geometrically back to 1.0.  Keyed on the closed
+        # TENANT_CLASSES vocabulary, never raw ids.
+        self._class_boost: Dict[str, float] = {}
         for spec in specs:
             self.register(spec)
         if self.default_tenant not in self._specs:
@@ -161,6 +171,7 @@ class TenantRegistry:
         self.admitted.setdefault(spec.name, 0)
         self.quota_rejected.setdefault(spec.name, 0)
         self.shed.setdefault(spec.name, 0)
+        self.tokens.setdefault(spec.name, 0)
         return spec
 
     def names(self) -> List[str]:
@@ -219,6 +230,132 @@ class TenantRegistry:
         for name, n in counts.items():
             out[self.resolve(name).tenant_class] += float(n)
         return out
+
+    def note_tokens(self, tenant: Optional[str], n: int) -> None:
+        """Book ``n`` generated tokens against the tenant (unknown ids
+        land on the default tenant, so the book stays bounded by the
+        registered set — same resolution rule as admission)."""
+        if n <= 0:
+            return
+        name = self.resolve(tenant).name
+        self.tokens[name] = self.tokens.get(name, 0) + int(n)
+
+    def usage_snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant usage books keyed by RAW tenant id — the JSON
+        shape the ``/tenants/usage`` endpoint serves.  Raw ids are fine
+        HERE (an on-demand JSON document, bounded by the registered
+        set); they must never become Prometheus label values (DL010)."""
+        out: Dict[str, Dict[str, object]] = {}
+        for name in sorted(self._specs):
+            spec = self._specs[name]
+            out[name] = {
+                "tenant_class": spec.tenant_class,
+                "weight": spec.weight,
+                "boosted_weight": self.boosted_weight(spec),
+                "admitted": int(self.admitted.get(name, 0)),
+                "quota_rejected": int(self.quota_rejected.get(name, 0)),
+                "shed": int(self.shed.get(name, 0)),
+                "tokens": int(self.tokens.get(name, 0)),
+            }
+        return out
+
+    # ------------------------------------------- SLO-burn weight boost
+    def update_slo_boosts(self, burns: Dict[str, float],
+                          max_boost: float = 4.0,
+                          decay: float = 0.5) -> None:
+        """Drive the per-class WFQ boost from SLO burn rates.
+
+        A class burning error budget (burn > 1.0) gets its boost raised
+        to the burn rate, clamped to ``max_boost`` and never lowered by
+        a same-round smaller burn; once the burn recovers (<= 1.0) the
+        boost decays geometrically toward 1.0 and snaps there — the
+        boost is TEMPORARY by construction, so a past incident cannot
+        permanently skew the fair queue."""
+        for cls, burn in burns.items():
+            if cls not in TENANT_CLASSES:
+                continue
+            cur = self._class_boost.get(cls, 1.0)
+            if burn > 1.0:
+                new = min(float(max_boost), max(cur, float(burn)))
+            else:
+                new = 1.0 + (cur - 1.0) * float(decay)
+                if new < 1.001:
+                    new = 1.0
+            if new <= 1.0:
+                self._class_boost.pop(cls, None)
+            else:
+                self._class_boost[cls] = new
+
+    def boost_of(self, tenant_class: str) -> float:
+        return self._class_boost.get(tenant_class, 1.0)
+
+    def boosted_weight(self, spec: TenantSpec) -> float:
+        """The WFQ weight admission should use: the spec's configured
+        weight times its class's current (bounded, decaying) boost."""
+        return spec.weight * self.boost_of(spec.tenant_class)
+
+    # ----------------------------------------------------- persistence
+    _SPEC_FIELDS = ("quota_qps", "burst", "max_queued", "max_inflight",
+                    "weight", "tenant_class", "shed_class")
+
+    def to_file(self, path: str) -> None:
+        """Persist the registered specs as JSON (atomic enough for a
+        config file: whole-document write).  Only the QoS contracts are
+        saved — usage books and quota bucket state are runtime, not
+        config."""
+        doc = {
+            "default_tenant": self.default_tenant,
+            "tenants": [
+                dict(name=s.name,
+                     **{f: getattr(s, f) for f in self._SPEC_FIELDS})
+                for s in self._specs.values()
+            ],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @staticmethod
+    def _specs_from_doc(doc: dict) -> Tuple[str, List[TenantSpec]]:
+        default = str(doc.get("default_tenant", "default"))
+        specs = []
+        for entry in doc.get("tenants", []):
+            kwargs = {k: entry[k] for k in TenantRegistry._SPEC_FIELDS
+                      if k in entry}
+            specs.append(TenantSpec(name=str(entry["name"]), **kwargs))
+        return default, specs
+
+    @classmethod
+    def from_file(cls, path: str) -> "TenantRegistry":
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        default, specs = cls._specs_from_doc(doc)
+        return cls(specs, default_tenant=default)
+
+    def reload_file(self, path: str) -> Tuple[int, int]:
+        """Live reload IN PLACE (SIGHUP / admin endpoint): specs in the
+        file are (re-)registered, registered tenants absent from it are
+        dropped — except the default tenant, which always exists.
+        Usage books for surviving tenants are kept (a config reload
+        must not zero the accounting); a re-registered spec re-arms its
+        quota bucket exactly like :meth:`register`.  The file is parsed
+        and VALIDATED before any mutation, so a malformed reload leaves
+        the live registry untouched.  Returns ``(registered,
+        removed)``."""
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        default, specs = self._specs_from_doc(doc)
+        self.default_tenant = default
+        for spec in specs:
+            self.register(spec)
+        keep = {s.name for s in specs} | {self.default_tenant}
+        removed = [n for n in self._specs if n not in keep]
+        for name in removed:
+            del self._specs[name]
+            self._buckets.pop(name, None)
+        if self.default_tenant not in self._specs:
+            self.register(TenantSpec(name=self.default_tenant))
+        return len(specs), len(removed)
 
 
 def plan_shed(counts: Dict[str, int], registry: TenantRegistry,

@@ -131,6 +131,76 @@ METRIC_HELP: Dict[str, str] = {
         "(common/retry) — a rising value under a steady fleet says "
         "the master/Brain link is flaky, not that calls are failing"
     ),
+    # -- global prefix cache: engine-side COW sharing aggregates -------
+    # -- (BlockManager.prefix_stats, summed across replicas by the
+    # -- router's engine_metrics sweep)
+    "serving_prefix_hits_total": (
+        "full prompt blocks mapped into an existing committed KV block "
+        "by chained-hash + content match instead of being recomputed — "
+        "each hit is block_size tokens of prefill skipped fleet-wide"
+    ),
+    "serving_prefix_misses_total": (
+        "full prompt blocks that found no committed twin and were "
+        "prefilled fresh (the cold half of the hit ratio)"
+    ),
+    "serving_prefix_evictions_total": (
+        "committed refcount-0 prefix blocks reclaimed LRU-first when "
+        "the free list ran dry — capacity pressure on the prefix "
+        "cache, not an error"
+    ),
+    "serving_prefix_cow_total": (
+        "copy-on-write block copies: a writer diverging inside a "
+        "shared (ref>1) block got a private copy first — the price of "
+        "sharing, paid only at actual divergence"
+    ),
+    "serving_prefix_revivals_total": (
+        "lingering refcount-0 committed blocks re-mapped by a later "
+        "request before eviction reclaimed them — the cache-works-"
+        "across-request-lifetimes signal"
+    ),
+    "serving_prefix_shared_tokens_total": (
+        "prompt tokens served from shared KV blocks instead of "
+        "prefill compute (hits x block_size)"
+    ),
+    "serving_prefix_shared_blocks": (
+        "KV blocks currently mapped by more than one live sequence "
+        "(ref>1) — the live deduplication the effective-KV-bytes-per-"
+        "user gate measures"
+    ),
+    "serving_prefix_cached_blocks": (
+        "committed (hash-indexed, content-verified) blocks currently "
+        "reachable for sharing, live or lingering"
+    ),
+    "serving_prefix_lru_blocks": (
+        "committed refcount-0 blocks lingering in the eviction LRU — "
+        "reusable capacity the allocator reclaims before failing"
+    ),
+    # -- global prefix cache: router prefix-routing table --------------
+    # -- (scheduler.PrefixRoutingTable, mirrored in the observe phase)
+    "serving_prefix_route_entries": (
+        "prefix-head -> replica routes currently held (bounded LRU; "
+        "fed by each replica's hottest committed prefix heads riding "
+        "STATS)"
+    ),
+    "serving_prefix_route_hits_total": (
+        "scheduler lookups that found a live route for a request's "
+        "prefix head — consulted AHEAD of recency affinity because "
+        "the table knows residency, affinity only guesses it"
+    ),
+    "serving_prefix_route_misses_total": (
+        "scheduler lookups with no route (cold prefix or short "
+        "prompt) — placement falls back to affinity/least-loaded"
+    ),
+    "serving_prefix_route_invalidations_total": (
+        "routes dropped for replica death/drain or because a newer "
+        "advertisement no longer carried the head (advertised "
+        "eviction) — stale routes never outlive their evidence"
+    ),
+    "serving_prefix_route_placements_total": (
+        "requests actually committed onto the replica the routing "
+        "table named (a route hit that also passed the capacity "
+        "check) — the table's end-to-end usefulness counter"
+    ),
     # -- per-request span tracing (utils/tracing.Tracer.metrics) -------
     "serving_request_trace_finished_total": (
         "request traces completed into the tracer's bounded ring"

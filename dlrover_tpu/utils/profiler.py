@@ -339,6 +339,7 @@ class MetricsExporter:
         self._sources = []  # callables returning Dict[str, float]
         self._text_sources = []  # callables returning Prometheus text
         self._tracer = None  # utils/tracing.Tracer, via attach_tracer
+        self._tenants = None  # tenancy.TenantRegistry, attach_tenants
         # a failing source must be VISIBLE: silently dropping it makes
         # a dashboard go quietly stale (satellite of ISSUE 4) — each
         # failure counts into dlrover_metrics_source_errors_total and
@@ -362,6 +363,14 @@ class MetricsExporter:
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/traces"):
                     payload = exporter._render_traces(self.path)
+                    if payload is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = payload.encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/tenants"):
+                    payload = exporter._render_tenants(self.path)
                     if payload is None:
                         self.send_response(404)
                         self.end_headers()
@@ -415,6 +424,30 @@ class MetricsExporter:
         if slo is not None:
             self.add_text_source(slo.render)
         self.attach_tracer(router.tracer)
+        tenants = getattr(getattr(router, "gateway", None),
+                          "tenants", None)
+        if tenants is not None:
+            self.attach_tenants(tenants)
+
+    def attach_tenants(self, registry) -> None:
+        """Wire a tenancy ``TenantRegistry``: enables the
+        ``/tenants/usage`` JSON view (per-RAW-tenant-id admission /
+        refusal / shed / generated-token books).  Raw ids belong here —
+        an on-demand JSON document bounded by the registered set — and
+        never on Prometheus label values (DL010)."""
+        self._tenants = registry
+
+    def _render_tenants(self, path: str) -> Optional[str]:
+        if self._tenants is None:
+            return None
+        import urllib.parse
+
+        sub = urllib.parse.urlsplit(path).path
+        if sub not in ("/tenants", "/tenants/", "/tenants/usage"):
+            return None
+        return json.dumps(
+            {"tenants": self._tenants.usage_snapshot()},
+            indent=2, sort_keys=True)
 
     # ---------------------------------------------------------- render
     def _note_source_error(self, src) -> None:
