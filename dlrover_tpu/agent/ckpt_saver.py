@@ -547,7 +547,7 @@ class AsyncCheckpointSaver:
         # recorded only once the final dir really exists, so
         # save_shm_to_storage never skips re-persisting a step that was
         # in fact never committed
-        self._last_persisted_step = step
+        self._last_persisted_step = step  # dlint: disable=DL011 GIL-atomic int store in the documented lock-free gauge design (see metrics()); a stale read only re-persists a step whose commit then dedups
         self.storage.commit(step, True)
 
     # -- failure path -----------------------------------------------------

@@ -9,9 +9,11 @@ Usage::
 
     python -m dlrover_tpu.dlint dlrover_tpu   # or: dlint dlrover_tpu
     python -m tools.dlint dlrover_tpu         # repo-checkout spelling
-    dlint --list-checkers                     # the DL001-DL009 catalog
-    dlint --explain DL007                     # one checker's contract
+    dlint --list-checkers                     # the DL001-DL013 catalog
+    dlint --explain DL011                     # one checker's contract
     dlint --call-graph dlrover_tpu            # resolved call graph
+    dlint --format sarif --output dlint.sarif # code-scanning upload
+    dlint --changed origin/main               # report changed files only
 
 See ``dlrover_tpu/dlint/checkers.py`` for what each check enforces and
 why.

@@ -82,12 +82,38 @@ DL010  metric-label-        labeled-sample construction
                             (request id, trace id, erid, host:port) —
                             unbounded cardinality mints one series per
                             request and OOMs every fleet aggregator.
+DL011  lockset-race         static Eraser: every (class, attribute)
+                            touched from >= 2 thread roots (resolved
+                            ``Thread(target=…)``/``Timer``/closure
+                            bodies, plus the ``<main>`` public
+                            surface) with at least one write and at
+                            least one LOCKED access must have a
+                            NON-EMPTY lockset intersection across
+                            all accesses; an empty one is a data
+                            race — the author locks the attribute
+                            somewhere and forgot elsewhere — and is
+                            reported with both root -> … -> access
+                            witness chains.
+DL012  resource-lifetime    acquire/release pairs declared in a
+                            ``_DLINT_RESOURCE_SPECS`` table next to
+                            the code (plus built-in shm defaults): an
+                            acquired resource must be released,
+                            returned, stored into an owner, or used
+                            as a context — on EVERY path, including
+                            the exception edge out of a ``try`` body.
+DL013  frame-schema-drift   per ``FrameKind``, literal payload keys
+                            each sender writes vs each receiver
+                            reads: sent-but-never-read and hard-
+                            subscript read-but-never-sent keys are
+                            drift unless declared (with a reason) in
+                            ``_FRAME_OPTIONAL_KEYS``.
 ====== ==================== =============================================
 
-DL001-DL006 are per-module lexical passes.  DL007-DL009 run on the
-two-phase whole-program engine in :mod:`dlrover_tpu.dlint.core`
-(per-function summaries, cached by file hash, then call-graph fixpoint
-propagation) — still pure AST, nothing imported or executed.
+DL001-DL006 are per-module lexical passes.  DL007-DL012 run on (or
+next to) the two-phase whole-program engine in
+:mod:`dlrover_tpu.dlint.core` (per-function summaries, cached by file
+hash, then call-graph fixpoint propagation); DL013 extends the DL004
+protocol machinery — still pure AST, nothing imported or executed.
 """
 
 from __future__ import annotations
@@ -151,6 +177,14 @@ class DlintConfig:
     # when at most this many do (common names resolve nowhere rather
     # than smearing unrelated subsystems together)
     duck_fanout_cap: int = 6
+    # ---------------------------------------------- DL012 / DL013
+    # module-level declaration naming a module's acquire/release pairs
+    # (the resource-lifetime spec table lives NEXT TO the code it
+    # governs, like _UNHANDLED_FRAME_KINDS does for frames)
+    resource_spec_decl: str = "_DLINT_RESOURCE_SPECS"
+    # frame payload keys that are deliberately one-sided (sent but not
+    # read), declared with a reason in the protocol module
+    frame_optional_decl: str = "_FRAME_OPTIONAL_KEYS"
 
 
 class Project:
@@ -1465,6 +1499,22 @@ class MetricLabelCardinalityChecker(Checker):
         "— every aggregator scraping the fleet OOMs exactly "
         "mid-incident, when cardinality spikes with traffic"
     )
+    EXPLAIN = (
+        "Reads the label vocabulary out of the metric registry "
+        "(`METRIC_LABELS` in the configured registry module) and then "
+        "walks every module for rendered metric families "
+        "(`serving_*{...}` / `dlrover_*{...}` f-strings and label "
+        "dicts).  Three things are findings: a label KEY whose name "
+        "is a known per-request vocabulary (request id, trace id, "
+        "host:port — the UNBOUNDED_NAMES set); a label key used at a "
+        "render site but absent from the registry's declaration for "
+        "that family; and a registry declaration that labels a family "
+        "the registry never registers.  Fix by keying the series on a "
+        "bounded vocabulary (worker name, state enum, priority band) "
+        "and carrying the per-request value in the log line instead — "
+        "a genuinely bounded source with an unlucky name takes a "
+        "`# dlint: disable=DL010 <why>`."
+    )
 
     #: identifier names whose values are per-request / per-connection
     #: — using one as a label value is the cardinality bomb this
@@ -1630,6 +1680,1016 @@ class MetricLabelCardinalityChecker(Checker):
                 yield name
 
 
+# =========================================================== DL011
+class LocksetRaceChecker(Checker):
+    CODE = "DL011"
+    NAME = "lockset-race"
+    WHY = (
+        "a shared attribute written on one thread and touched on "
+        "another with no common lock is a data race: torn ledgers, "
+        "lost updates, and the corrupted-capacity class of bug no "
+        "chaos test reproduces on demand"
+    )
+    EXPLAIN = (
+        "Static Eraser-style lockset analysis over the whole-program "
+        "summaries.  Phase 1 records every `self.<attr>` / declared-"
+        "global data access with the locks lexically held at it, plus "
+        "every thread ENTRY point (`threading.Thread(target=...)`, "
+        "`Timer`, `start_new_thread` — including closure bodies, which "
+        "get their own summaries).  Phase 2 walks the call graph from "
+        "each thread root and from `<main>` (the no-in-edge public "
+        "surface, standing in for the caller's thread); lock context "
+        "propagates through calls (a `_dispatch_locked`-style helper "
+        "only ever called under the lock inherits it: each function's "
+        "entry lockset is the intersection over all call edges of "
+        "caller context + locks held at the call site); for every "
+        "(class, attribute) touched from >= 2 distinct roots with at "
+        "least one write AND at least one lock-protected access (the "
+        "RacerD discipline filter: a never-locked attribute is a "
+        "deliberate lock-free design; the bug is the attribute the "
+        "author locks SOMEWHERE and forgot elsewhere), the lockset "
+        "INTERSECTION across all accesses must be non-empty — an "
+        "empty intersection is a race, reported "
+        "with both root -> ... -> access witness chains.  Exemptions: "
+        "`__init__` bodies (init-before-start publication), lock-named "
+        "attributes, attributes built from Queue/Lock/Event/deque "
+        "factories (the sanctioned lock-free handoffs), GIL-atomic "
+        "container ops (append/popleft/put/get...), plain constant "
+        "stores (the `self._running = False` stop-flag idiom is one "
+        "atomic bytecode), and `# dlint: disable=DL011 <reason>` on "
+        "the access line — or on the `class` line, which exempts "
+        "every attribute of that class (for fakes standing in for "
+        "another process, or per-process handle objects).  Fix by "
+        "holding one lock at every access, or by routing the handoff "
+        "through a queue/event."
+    )
+
+    MAIN_ROOT = "<main>"
+
+    def check_project(self, project):
+        program = project.program
+        spawn = program.thread_roots()
+        if not spawn:
+            return  # no second thread, no race
+        by_path = {m.rel_path: m for m in project.modules}
+        seeds = {root: [root] for root in spawn}
+        mains = program.main_entry_funcs(set(spawn))
+        if mains:
+            seeds[self.MAIN_ROOT] = mains
+        reach = program.multi_reach(seeds)
+        # PER-ROOT entry locksets (the edge table is shared): a helper
+        # locked on thread A's every call path but called bare from
+        # thread B contributes one witness WITH the lock and one
+        # without, instead of a single witness holding the (empty)
+        # all-roots intersection.
+        entry_by_root = {
+            r: program.entry_locksets(seeds[r]) for r in sorted(seeds)
+        }
+        groups: Dict[Tuple[Optional[str], str], list] = {}
+        for qual in sorted(program.functions):
+            s = program.functions[qual]
+            accs = s.get("attr_accesses", ())
+            if not accs or s["name"] == "__init__":
+                continue  # init-before-start: no peer thread yet
+            roots = sorted(r for r in seeds if qual in reach[r])
+            if not roots:
+                continue  # dead code runs on no thread
+            for a in accs:
+                if _core.lock_like_name(a["attr"]):
+                    continue
+                lex = frozenset(
+                    program.canon_lock(lk) for lk in a["locks"])
+                for root in roots:
+                    held = lex | entry_by_root[root].get(
+                        qual, frozenset())
+                    groups.setdefault((a["cls"], a["attr"]), []).append(
+                        {"qual": qual, "summary": s, "acc": a,
+                         "held": held, "roots": [root]})
+        for key in sorted(groups, key=str):
+            cls, attr = key
+            entries = groups[key]
+            csup = None
+            if cls is not None:
+                csup = next(
+                    (c for c in program.classes.get(cls, ())
+                     if c.get("dl011_sup")), None)
+            if csup is not None:
+                # class-LEVEL exemption: a reasoned disable on the
+                # ``class`` line declares the whole object process-
+                # local / single-owner.  Still re-run the decision and
+                # anchor any would-be finding AT the class line, so
+                # the exemption lands in the suppression ledger per
+                # racy attribute instead of vanishing.
+                if self._racy_writes(program, cls, attr, entries) \
+                        is not None:
+                    mod = by_path.get(csup["module"])
+                    if mod is not None:
+                        yield mod.violation(
+                            self.CODE,
+                            csup["line"],
+                            f"class-level exemption covers a cross-"
+                            f"thread race on {cls}.{attr} (no common "
+                            f"lock across threads)",
+                        )
+                continue
+            live = [e for e in entries if not e["acc"]["sup"]]
+            writes = self._racy_writes(program, cls, attr, live)
+            if writes is not None:
+                v = self._emit(program, by_path, reach, spawn, cls,
+                               attr, live, writes)
+                if v is not None:
+                    yield v
+                continue
+            if len(live) == len(entries):
+                continue
+            # quiet only BECAUSE of a suppression comment: re-run the
+            # decision with the suppressed access included and, when
+            # it fires, report anchored AT the suppressed line — the
+            # engine then files it under `suppressed`, so the comment
+            # shows up in the ledger instead of silently eating a race
+            if self._racy_writes(program, cls, attr, entries) is None:
+                continue
+            supd = next(e for e in entries if e["acc"]["sup"])
+            mod = by_path.get(supd["summary"]["module"])
+            if mod is None:
+                continue
+            ident = f"{cls}.{attr}" if cls else f"global {attr}"
+            yield mod.violation(
+                self.CODE,
+                supd["acc"]["line"],
+                f"this access completes a cross-thread race on "
+                f"{ident} (no common lock across threads)",
+            )
+
+    def _racy_writes(self, program, cls, attr, entries):
+        """The write witnesses when this access group races, else
+        None (quiet)."""
+        touched = {r for e in entries for r in e["roots"]}
+        if len(touched) < 2:
+            return None  # single-threaded attribute
+        writes = [e for e in entries if e["acc"]["rw"] == "w"]
+        if not writes or all(e["acc"]["const"] for e in writes):
+            return None  # read-only, or atomic stop-flag stores only
+        if cls is not None and set(
+                program._class_attr_types(cls, attr)
+        ) & _core.SYNC_FACTORY_NAMES:
+            return None  # the attribute IS a synchronization object
+        # RacerD's discipline filter: the bug class is the attribute
+        # the author DOES protect on some path and forgot on another.
+        # Evidence of intent is (a) a LEXICAL lock at some access, or
+        # (b) ONE access whose inherited lock context differs across
+        # REAL thread roots — a helper locked on every call path of
+        # one root and called bare from another.  ``<main>`` seeds are
+        # excluded from (b): they are no-in-edge functions standing in
+        # for "the caller's thread", and a duck-unresolvable caller
+        # (``.append``) would otherwise fabricate a bare context for
+        # an access every real caller locks.  An attribute never
+        # accessed under any lock, or whose writers are uniformly
+        # locked via their callers while readers are uniformly bare
+        # (the telemetry-snapshot idiom), is a deliberate design.
+        if not any(e["acc"]["locks"] for e in entries):
+            by_site: Dict[tuple, set] = {}
+            for e in entries:
+                if e["roots"] == [self.MAIN_ROOT]:
+                    continue
+                by_site.setdefault(
+                    (e["qual"], e["acc"]["line"]), set()
+                ).add(e["held"])
+            if not any(len(h) > 1 for h in by_site.values()):
+                return None
+        lockset = None
+        for e in entries:
+            lockset = (e["held"] if lockset is None
+                       else lockset & e["held"])
+        if lockset:
+            return None  # one lock covers every access
+        return writes
+
+    # ------------------------------------------------------- reporting
+    def _emit(self, program, by_path, reach, spawn, cls, attr,
+              entries, writes):
+        """One finding per racy (class, attr): anchored at a write in a
+        scanned module, naming BOTH thread roots with full chains."""
+        def scanned(e):
+            return e["summary"]["module"] in by_path
+
+        anchor = next(
+            (e for e in writes if not e["acc"]["const"] and scanned(e)),
+            None) or next((e for e in writes if scanned(e)), None)
+        if anchor is None:
+            return None  # every write lives outside the scanned set
+        # first root: prefer a REAL spawned thread covering the write
+        roots_a = anchor["roots"]
+        root_a = next((r for r in roots_a if r != self.MAIN_ROOT),
+                      roots_a[0])
+        # second root: a different root covering an access that
+        # actually CONFLICTS (no lock shared with the anchor) — the
+        # site the reader must fix, not just any second witness
+        candidates = [e for e in entries
+                      if any(r != root_a for r in e["roots"])
+                      and e is not anchor]
+        other = next(
+            (e for e in candidates if not (e["held"] & anchor["held"])),
+            None) or (candidates[0] if candidates else anchor)
+        root_b = next(r for r in other["roots"] if r != root_a)
+        ident = f"{cls}.{attr}" if cls else f"global {attr}"
+        held_a = ", ".join(sorted(anchor["held"])) or "no lock"
+        return by_path[anchor["summary"]["module"]].violation(
+            self.CODE,
+            anchor["acc"]["line"],
+            f"{ident} is written here under {held_a} but its accesses "
+            "share NO common lock across threads: "
+            + self._chain_text(program, spawn, root_a,
+                               reach[root_a][anchor["qual"]], anchor)
+            + " races "
+            + self._chain_text(program, spawn, root_b,
+                               reach[root_b][other["qual"]], other)
+            + " — hold one lock at every access or hand off through "
+            "a queue",
+        )
+
+    def _chain_text(self, program, spawn, root, path, entry) -> str:
+        if root == self.MAIN_ROOT:
+            start = path[0][0] if path else entry["qual"]
+            parts = [f"<main> {_short(start)}"]
+        else:
+            info = spawn[root]
+            parts = [
+                f"thread {_short(root)} (spawned at "
+                f"{info['module']}:{info['line']})"
+            ]
+        mod = {q: f["module"] for q, f in program.functions.items()}
+        for caller, line, callee in path:
+            parts.append(f"{_short(callee)} ({mod[caller]}:{line})")
+        acc = entry["acc"]
+        kind = "write" if acc["rw"] == "w" else "read"
+        parts.append(
+            f"{kind} at {entry['summary']['module']}:{acc['line']}")
+        return " -> ".join(parts)
+
+
+# =========================================================== DL012
+#: resources every tree tracks even without a module spec table: a
+#: POSIX shared-memory segment that escapes unclosed leaks /dev/shm
+#: until reboot (the resource-tracker-proof wrapper makes that
+#: deliberate — and therefore MUST be balanced by hand)
+DEFAULT_RESOURCE_SPECS: Tuple[dict, ...] = (
+    {
+        "resource": "shared-memory segment",
+        "acquire": ("SharedMemory",),
+        "release": ("close", "unlink"),
+        "owners": (),
+        "why": "an unreleased segment leaks /dev/shm until reboot",
+    },
+)
+
+#: GIL-atomic adoption calls: `container.append(x)` hands ownership of
+#: the tracked value to the container (whoever drains it releases)
+_ADOPTING_METHODS = frozenset(
+    {"append", "appendleft", "add", "put", "put_nowait", "insert"})
+
+
+class ResourceLifetimeChecker(Checker):
+    CODE = "DL012"
+    NAME = "resource-lifetime"
+    WHY = (
+        "an acquired resource (shm segment, KV block, refcount bump) "
+        "that escapes its function on some path — especially the "
+        "exception edge out of a try body — without a release is the "
+        "slow leak that kills a long-lived server"
+    )
+    EXPLAIN = (
+        "Acquire/release pairs are DECLARED in a `_DLINT_RESOURCE_"
+        "SPECS` table next to the code they govern (plus built-in "
+        "shared-memory defaults): each spec names the acquire calls "
+        "whose assigned result is a tracked resource, the release "
+        "calls that balance it, and owner containers that may adopt "
+        "it.  A tracked local must, somewhere in its function, be "
+        "released (`x.close()`, `free(x)`), returned/yielded, stored "
+        "into an attribute or adopted by a container "
+        "(`owner.append(x)`), or used as a `with` context — otherwise "
+        "the acquire line is flagged.  Exception edges: when the "
+        "acquire sits in a `try` body, the first release must be the "
+        "acquire's immediate next statement or live in that try's "
+        "`finally` — anything else leaks the resource when an "
+        "exception exits the try body mid-way.  Spec hygiene is "
+        "checked too (each entry needs acquire/release tuples and a "
+        "non-empty why).  Fix by releasing in `finally`, using "
+        "`with`, or handing the resource to its declared owner "
+        "before anything can raise."
+    )
+
+    def check_module(self, module, project):
+        cfg = project.config
+        specs, spec_errors = self._load_specs(module, cfg)
+        yield from spec_errors
+        by_acquire: Dict[str, dict] = {}
+        for spec in specs:
+            for name in spec["acquire"]:
+                by_acquire[name] = spec
+        if not by_acquire:
+            return
+        for func in ast.walk(module.tree):
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, func, by_acquire)
+
+    # ------------------------------------------------------- spec table
+    def _load_specs(self, module, cfg):
+        specs = list(DEFAULT_RESOURCE_SPECS)
+        errors = []
+        decl = None
+        for node in module.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == cfg.resource_spec_decl
+            ):
+                decl = node
+                break
+        if decl is None:
+            return specs, errors
+        if not isinstance(decl.value, (ast.Tuple, ast.List)):
+            errors.append(module.violation(
+                self.CODE, decl,
+                f"{cfg.resource_spec_decl} must be a tuple/list of "
+                "spec dicts"))
+            return specs, errors
+        for elt in decl.value.elts:
+            parsed = self._parse_spec(elt)
+            if parsed is None or not parsed.get("acquire") \
+                    or not parsed.get("release") \
+                    or not parsed.get("why", "").strip():
+                errors.append(module.violation(
+                    self.CODE, elt,
+                    f"malformed {cfg.resource_spec_decl} entry — each "
+                    "spec is a dict with 'acquire' and 'release' name "
+                    "tuples and a non-empty 'why'"))
+                continue
+            specs.append(parsed)
+        return specs, errors
+
+    @staticmethod
+    def _parse_spec(elt) -> Optional[dict]:
+        if not isinstance(elt, ast.Dict):
+            return None
+        out = {"resource": "resource", "acquire": (), "release": (),
+               "owners": (), "why": ""}
+        for k, v in zip(elt.keys, elt.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                return None
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out[k.value] = v.value
+            elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                names = tuple(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+                if len(names) != len(v.elts):
+                    return None
+                out[k.value] = names
+            else:
+                return None
+        return out
+
+    # -------------------------------------------------- value tracking
+    def _check_function(self, module, func, by_acquire):
+        acquired = self._acquire_sites(module, func, by_acquire)
+        if not acquired:
+            return
+        for names, stmt, call, spec in acquired:
+            events = self._events(func, names, spec)
+            if not events:
+                yield module.violation(
+                    self.CODE,
+                    call,
+                    f"{spec['resource']} acquired by "
+                    f"{_call_name(call)}() is never released "
+                    f"({'/'.join(spec['release'])}), returned, or "
+                    "stored into an owner — it leaks on every path",
+                )
+                continue
+            v = self._exception_edge(module, func, stmt, call, events,
+                                     spec)
+            if v is not None:
+                yield v
+
+    def _acquire_sites(self, module, func, by_acquire):
+        """``(alias_names, stmt, call, spec)`` per tracked acquire:
+        a spec'd call assigned to a plain local (possibly through
+        ``or``/ternary), with ``y = x`` and unpack aliases folded in."""
+        out = []
+        for stmt in self._own_stmts(func):
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets)
+                    == 1 and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            call = self._acquire_call(stmt.value, by_acquire)
+            if call is None:
+                continue
+            names = {stmt.targets[0].id}
+            # alias closure: y = x and `a, b = x` keep the resource
+            # reachable under new names (2 passes: order-insensitive)
+            for _ in range(2):
+                for sub in self._own_stmts(func):
+                    if not isinstance(sub, ast.Assign) or not isinstance(
+                            sub.value, ast.Name) \
+                            or sub.value.id not in names:
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+                        elif isinstance(tgt, ast.Tuple):
+                            names.update(
+                                e.id for e in tgt.elts
+                                if isinstance(e, ast.Name))
+            out.append((names, stmt, call,
+                        by_acquire[_call_name(call)]))
+        return out
+
+    @staticmethod
+    def _own_stmts(func):
+        """Statements of ``func``'s own scope (nested defs excluded)."""
+        stack = list(func.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    stack.append(child)
+                elif isinstance(child, ast.excepthandler):
+                    stack.extend(child.body)
+
+    @staticmethod
+    def _acquire_call(value, by_acquire) -> Optional[ast.Call]:
+        cands = [value]
+        if isinstance(value, ast.BoolOp):
+            cands = list(value.values)
+        elif isinstance(value, ast.IfExp):
+            cands = [value.body, value.orelse]
+        for cand in cands:
+            if isinstance(cand, ast.Call) \
+                    and _call_name(cand) in by_acquire:
+                return cand
+        return None
+
+    def _events(self, func, names, spec) -> List[ast.AST]:
+        """Every node that releases/escapes the tracked value."""
+        owners = set(spec.get("owners", ()))
+        release = set(spec["release"])
+        events = []
+
+        def is_tracked(e):
+            return isinstance(e, ast.Name) and e.id in names
+
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and any(
+                        is_tracked(n) for n in ast.walk(node.value)):
+                    events.append(node)
+            elif isinstance(node, ast.Call):
+                name = _call_name(node)
+                argvals = list(node.args) + [
+                    kw.value for kw in node.keywords]
+                if isinstance(node.func, ast.Attribute) and \
+                        is_tracked(node.func.value) and name in release:
+                    events.append(node)  # x.close()
+                elif name in release and any(
+                        is_tracked(n)
+                        for a in argvals for n in ast.walk(a)):
+                    # free(x) / mgr.free([x]) — a release call takes
+                    # the resource in any argument shape
+                    events.append(node)
+                elif isinstance(node.func, ast.Attribute) and (
+                        name in _ADOPTING_METHODS
+                        or _terminal_name(node.func.value) in owners
+                ) and any(is_tracked(a) for a in argvals):
+                    events.append(node)  # owner.append(x)
+            elif isinstance(node, ast.Assign) and any(
+                    is_tracked(n) for n in ast.walk(node.value)):
+                for tgt in node.targets:
+                    base = tgt.value if isinstance(
+                        tgt, ast.Subscript) else tgt
+                    if isinstance(base, ast.Attribute) or (
+                            isinstance(base, ast.Name)
+                            and base.id in owners):
+                        events.append(node)  # self._shm[i] = x
+                        break
+            elif isinstance(node, ast.withitem) and (
+                    is_tracked(node.context_expr) or (
+                        isinstance(node.context_expr, ast.Call)
+                        and any(is_tracked(a) for a in ast.walk(
+                            node.context_expr)))):
+                events.append(node)  # with closing(x): ...
+        return events
+
+    def _exception_edge(self, module, func, stmt, call, events, spec):
+        """Acquire inside a ``try`` body: the release must be the very
+        next statement or live in the try's ``finally`` — otherwise an
+        exception between acquire and release leaks the resource."""
+        enclosing = None
+        for anc in module.ancestors(stmt):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(anc, ast.Try) and self._in_block(
+                    anc.body, stmt, module):
+                enclosing = anc
+                break
+        if enclosing is None:
+            return None
+        for ev in events:
+            if self._in_block(enclosing.finalbody, ev, module):
+                return None  # released on every edge
+            for handler in enclosing.handlers:
+                if self._in_block(handler.body, ev, module):
+                    return None  # the except path balances it
+        # adjacent release: nothing can raise between acquire and it
+        block = self._sibling_block(module, stmt)
+        if block is not None:
+            idx = block.index(stmt)
+            if idx + 1 < len(block) and any(
+                    self._within(block[idx + 1], ev)
+                    for ev in events):
+                return None
+        first = min(events, key=lambda e: getattr(e, "lineno", 1 << 30))
+        return module.violation(
+            self.CODE,
+            call,
+            f"{spec['resource']} acquired inside a try body is only "
+            f"released on the no-exception path (first release at "
+            f"line {getattr(first, 'lineno', '?')}): an exception "
+            "raised in between escapes the try with the resource "
+            "held — release in finally, or use with",
+        )
+
+    @staticmethod
+    def _in_block(block, node, module) -> bool:
+        return any(n is node or any(d is node for d in ast.walk(n))
+                   for n in block)
+
+    @staticmethod
+    def _within(stmt, node) -> bool:
+        return stmt is node or any(d is node for d in ast.walk(stmt))
+
+    @staticmethod
+    def _sibling_block(module, stmt) -> Optional[list]:
+        parent = module.parents.get(stmt)
+        if parent is None:
+            return None
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(parent, field, None)
+            if isinstance(block, list) and stmt in block:
+                return block
+        return None
+
+
+# =========================================================== DL013
+class FrameSchemaChecker(Checker):
+    CODE = "DL013"
+    NAME = "frame-schema-drift"
+    WHY = (
+        "'unknown frame keys are ignored both ways' is forward-compat "
+        "by design — and a drift sink by accident: a key the sender "
+        "ships that no receiver reads is dead weight nobody notices, "
+        "and a hard read of a key nobody sends is a KeyError in wait"
+    )
+    EXPLAIN = (
+        "Collects, per FrameKind, the literal payload keys every "
+        "sender writes (`conn.send(FrameKind.X, key=..., **splat)` — "
+        "splats are resolved through local/attribute dict assignments "
+        "and helper returns; an unresolvable splat marks the kind "
+        "OPEN) and the keys every receiver reads, attributed through "
+        "kind-dispatch tests (`if kind == FrameKind.X:` bodies; "
+        "`!=`-guards that raise attribute the rest of the function).  "
+        "A key sent but read by NO receiver is drift unless declared "
+        "in `_FRAME_OPTIONAL_KEYS` (protocol module) with a reason; a "
+        "`frame[\"k\"]` SUBSCRIPT read of a key no sender of that "
+        "kind ships (kind closed) is a latent KeyError — `.get()` "
+        "reads are the sanctioned forward-compat form and never "
+        "flagged.  Declarations are themselves checked: a declared "
+        "key that IS read is stale, a reason is mandatory.  Fix by "
+        "deleting the dead key, reading it, or declaring it optional "
+        "with its reason."
+    )
+
+    def check_project(self, project):
+        cfg = project.config
+        scope = []
+        for suffix in (cfg.protocol_module,) + cfg.dispatch_modules:
+            mod = project.find_module(suffix)
+            if mod is not None and mod not in scope:
+                scope.append(mod)
+        if not any(project.find_module(s) for s in cfg.dispatch_modules):
+            return  # nothing that speaks the protocol is being linted
+        protocol = project.context_module(cfg.protocol_module)
+        if protocol is None:
+            return
+        kinds = FrameExhaustiveChecker._frame_kinds(
+            protocol, cfg.frame_kind_class)
+        if not kinds:
+            return
+        optional, opt_node = self._optional_decl(protocol, cfg, kinds)
+        # every configured dispatch module joins as CONTEXT even when
+        # only one file is scanned — a partial scan must still see the
+        # full sender/reader population, or every key the out-of-scan
+        # half ships or reads looks like drift.  Context modules are
+        # never reported on (the ``mod not in scope`` guards below).
+        readers = [m for m in scope]
+        for suffix in cfg.dispatch_modules:
+            mod = project.context_module(suffix)
+            if mod is not None and mod not in readers:
+                readers.append(mod)
+        if protocol not in readers:
+            readers.append(protocol)
+        sent: Dict[str, Dict[str, tuple]] = {}
+        open_kinds: Set[str] = set()
+        for mod in readers:
+            for kind, key, node, is_open in self._sends(mod, cfg, kinds):
+                if is_open:
+                    open_kinds.add(kind)
+                else:
+                    sent.setdefault(kind, {}).setdefault(
+                        key, (mod, node))
+        by_kind: Dict[str, Dict[str, str]] = {}
+        reads_any: Set[str] = set()
+        sub_reads: List[tuple] = []
+        for mod in readers:
+            kr, ra, sr = self._reads(mod, cfg, kinds)
+            for kind, keys in kr.items():
+                by_kind.setdefault(kind, {}).update(keys)
+            reads_any |= ra
+            sub_reads.extend((mod,) + t for t in sr)
+        # ---- sent-but-never-read (reported at the send site)
+        for kind in sorted(sent):
+            for key in sorted(sent[kind]):
+                if key == "kind":
+                    continue
+                mod, node = sent[kind][key]
+                if key in by_kind.get(kind, ()) or key in reads_any:
+                    continue
+                if (kind, key) in optional:
+                    continue
+                if mod not in scope:
+                    continue
+                yield mod.violation(
+                    self.CODE,
+                    node,
+                    f"frame key {key!r} is sent on {kind} but no "
+                    "receiver ever reads it — schema drift: delete "
+                    "it, read it, or declare it in "
+                    f"{cfg.frame_optional_decl} with a reason",
+                )
+        # ---- read-but-never-sent (hard subscript reads only)
+        for mod, kind, key, node in sub_reads:
+            if key == "kind" or kind in open_kinds:
+                continue
+            if kind not in sent:
+                continue  # nobody sends this kind in the scanned tree
+            if key in sent[kind] or (kind, key) in optional:
+                continue
+            if mod not in scope:
+                continue
+            yield mod.violation(
+                self.CODE,
+                node,
+                f"frame[{key!r}] is read on {kind} but no {kind} "
+                "sender ships that key — a latent KeyError; send it, "
+                "or read it with .get()",
+            )
+        # ---- declaration hygiene (when the protocol itself is linted)
+        if protocol in scope and opt_node is not None:
+            yield from self._check_decl(
+                protocol, cfg, kinds, optional, opt_node, sent,
+                open_kinds, by_kind, reads_any)
+
+    # ------------------------------------------------------- declaration
+    def _optional_decl(self, protocol, cfg, kinds):
+        value_to_name = {v: k for k, v in kinds.items()}
+        for node in ast.walk(protocol.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == cfg.frame_optional_decl
+                and isinstance(node.value, ast.Dict)
+            ):
+                table = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    pair = self._decl_pair(k, value_to_name)
+                    if pair is None:
+                        continue
+                    reason = v.value if (
+                        isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)) else ""
+                    table[pair] = (reason, k)
+                return table, node
+        return {}, None
+
+    @staticmethod
+    def _decl_pair(key_node, value_to_name):
+        if not (isinstance(key_node, ast.Tuple)
+                and len(key_node.elts) == 2):
+            return None
+        kind_e, key_e = key_node.elts
+        if isinstance(kind_e, ast.Attribute):
+            kind = kind_e.attr
+        elif isinstance(kind_e, ast.Constant) and isinstance(
+                kind_e.value, str):
+            kind = value_to_name.get(kind_e.value, kind_e.value)
+        else:
+            return None
+        if not (isinstance(key_e, ast.Constant)
+                and isinstance(key_e.value, str)):
+            return None
+        return (kind, key_e.value)
+
+    def _check_decl(self, protocol, cfg, kinds, optional, opt_node,
+                    sent, open_kinds, by_kind, reads_any):
+        for (kind, key), (reason, key_node) in sorted(
+                optional.items(), key=str):
+            line = key_node.lineno
+            if kind not in kinds:
+                yield protocol.violation(
+                    self.CODE, line,
+                    f"{cfg.frame_optional_decl} names {kind}, which "
+                    f"is not a {cfg.frame_kind_class} kind")
+                continue
+            if not reason.strip():
+                yield protocol.violation(
+                    self.CODE, line,
+                    f"{cfg.frame_optional_decl}[({kind}, {key!r})] "
+                    "has no reason — the declaration exists to "
+                    "record WHY the key is one-sided")
+            if key in by_kind.get(kind, ()) or key in reads_any:
+                yield protocol.violation(
+                    self.CODE, line,
+                    f"{cfg.frame_optional_decl} declares ({kind}, "
+                    f"{key!r}) unread but it IS read — stale "
+                    "declaration, delete it")
+            elif kind in sent and kind not in open_kinds \
+                    and key not in sent[kind]:
+                yield protocol.violation(
+                    self.CODE, line,
+                    f"{cfg.frame_optional_decl} declares ({kind}, "
+                    f"{key!r}) but no {kind} sender ships that key — "
+                    "stale declaration, delete it")
+
+    # ------------------------------------------------------------ sends
+    def _sends(self, module, cfg, kinds):
+        """Yield ``(kind_name, key, witness_node, is_open)``; an open
+        marker uses key ''."""
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "send" and node.args):
+                continue
+            kind_arg = node.args[0]
+            if not (isinstance(kind_arg, ast.Attribute)
+                    and isinstance(kind_arg.value, ast.Name)
+                    and kind_arg.value.id == cfg.frame_kind_class
+                    and kind_arg.attr in kinds):
+                continue
+            kind = kind_arg.attr
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    yield kind, kw.arg, node, False
+                    continue
+                keys, is_open = self._splat_keys(
+                    module, node, kw.value, depth=0)
+                for key in keys:
+                    yield kind, key, node, False
+                if is_open:
+                    yield kind, "", node, True
+
+    def _splat_keys(self, module, site, expr, depth) -> Tuple[set, bool]:
+        """Best-effort key set of a ``**expr`` splat.  Returns
+        ``(keys, open)`` — open means some contributor was opaque."""
+        if depth > 3:
+            return set(), True
+        if isinstance(expr, ast.Dict):
+            keys, is_open = set(), False
+            for k in expr.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str):
+                    keys.add(k.value)
+                else:
+                    is_open = True  # ** merge or computed key
+            return keys, is_open
+        if isinstance(expr, ast.Call) and isinstance(
+                expr.func, ast.Name) and expr.func.id == "dict":
+            keys, is_open = set(), bool(expr.args)
+            for kw in expr.keywords:
+                if kw.arg is None:
+                    is_open = True
+                else:
+                    keys.add(kw.arg)
+            return keys, is_open
+        if isinstance(expr, ast.IfExp):
+            k1, o1 = self._splat_keys(module, site, expr.body, depth + 1)
+            k2, o2 = self._splat_keys(module, site, expr.orelse,
+                                      depth + 1)
+            return k1 | k2, o1 or o2
+        if isinstance(expr, ast.Name):
+            return self._assigned_keys(
+                module, site, lambda t: isinstance(t, ast.Name)
+                and t.id == expr.id, depth)
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            attr = expr.attr
+            return self._assigned_keys(
+                module, site, lambda t: isinstance(t, ast.Attribute)
+                and t.attr == attr, depth)
+        if isinstance(expr, ast.Call) and isinstance(
+                expr.func, ast.Attribute) and isinstance(
+                expr.func.value, ast.Name) \
+                and expr.func.value.id in ("self", "cls"):
+            return self._returned_keys(module, expr.func.attr, depth)
+        return set(), True
+
+    def _assigned_keys(self, module, site, match, depth):
+        """Union of keys over every assignment whose target matches
+        (dict-literal/dict()/ternary values, plus ``target["k"] = v``
+        subscript stores)."""
+        keys: Set[str] = set()
+        is_open = False
+        found = False
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                if value is None:
+                    continue
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript) and match(
+                            tgt.value):
+                        found = True
+                        if isinstance(tgt.slice, ast.Constant) \
+                                and isinstance(tgt.slice.value, str):
+                            keys.add(tgt.slice.value)
+                        else:
+                            is_open = True
+                    elif match(tgt):
+                        found = True
+                        k, o = self._splat_keys(
+                            module, site, value, depth + 1)
+                        keys |= k
+                        is_open = is_open or o
+        if not found:
+            return set(), True
+        return keys, is_open
+
+    def _returned_keys(self, module, method, depth):
+        keys: Set[str] = set()
+        is_open = False
+        found = False
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == method:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) \
+                            and sub.value is not None:
+                        found = True
+                        k, o = self._splat_keys(
+                            module, sub, sub.value, depth + 1)
+                        keys |= k
+                        is_open = is_open or o
+        if not found:
+            return set(), True
+        return keys, is_open
+
+    # ------------------------------------------------------------ reads
+    def _reads(self, module, cfg, kinds):
+        """Per-module read collection: ``(by_kind, reads_any,
+        sub_reads)`` where by_kind maps kind -> {key: form} from
+        dispatch-attributed reads, reads_any is every literal dict
+        read in the module, and sub_reads are the attributed HARD
+        subscript reads ``(kind, key, node)``."""
+        by_kind: Dict[str, Dict[str, str]] = {}
+        reads_any: Set[str] = set()
+        sub_reads: List[tuple] = []
+        for node in ast.walk(module.tree):
+            got = self._literal_read(node)
+            if got is not None:
+                reads_any.add(got[1])
+        for func in ast.walk(module.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            kind_vars = self._kind_vars(func)
+            for test_if in ast.walk(func):
+                if not isinstance(test_if, ast.If):
+                    continue
+                for var, names, negated in self._kind_tests(
+                        test_if.test, kind_vars, cfg, kinds):
+                    if negated:
+                        if not self._terminates(test_if.body):
+                            continue
+                        region: Iterable[ast.AST] = ast.walk(func)
+                    else:
+                        region = (d for stmt in test_if.body
+                                  for d in ast.walk(stmt))
+                    for d in region:
+                        got = self._literal_read(d, var)
+                        if got is None:
+                            continue
+                        form, key = got
+                        for kind in names:
+                            by_kind.setdefault(kind, {})[key] = form
+                            if form == "sub":
+                                sub_reads.append((kind, key, d))
+        return by_kind, reads_any, sub_reads
+
+    @staticmethod
+    def _literal_read(node, var: Optional[str] = None):
+        """``('sub'|'get', key)`` when ``node`` reads a literal string
+        key from a dict (``x["k"]`` load / ``x.get("k", ...)``); with
+        ``var``, only reads whose receiver is that name count."""
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load) and isinstance(
+                node.slice, ast.Constant) and isinstance(
+                node.slice.value, str):
+            if var is None or (isinstance(node.value, ast.Name)
+                               and node.value.id == var):
+                return "sub", node.slice.value
+            return None
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "get" \
+                and node.args and isinstance(
+                node.args[0], ast.Constant) and isinstance(
+                node.args[0].value, str):
+            if var is None or (isinstance(node.func.value, ast.Name)
+                               and node.func.value.id == var):
+                return "get", node.args[0].value
+        return None
+
+    @staticmethod
+    def _kind_vars(func) -> Dict[str, str]:
+        """``{kind_local: frame_var}`` for ``k = frame.get("kind")`` /
+        ``k = frame["kind"]`` assignments."""
+        out = {}
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            got = FrameSchemaChecker._literal_read(node.value)
+            if got is not None and got[1] == "kind":
+                recv = (node.value.value
+                        if isinstance(node.value, ast.Subscript)
+                        else node.value.func.value)
+                if isinstance(recv, ast.Name):
+                    out[node.targets[0].id] = recv.id
+        return out
+
+    def _kind_tests(self, test, kind_vars, cfg, kinds):
+        """Yield ``(frame_var, kind_names, negated)`` for each frame-
+        kind comparison in ``test`` (BoolOp operands included)."""
+        exprs = test.values if isinstance(test, ast.BoolOp) else [test]
+        for expr in exprs:
+            if not (isinstance(expr, ast.Compare)
+                    and len(expr.ops) == 1):
+                continue
+            left = expr.left
+            var = None
+            if isinstance(left, ast.Name) and left.id in kind_vars:
+                var = kind_vars[left.id]
+            else:
+                got = self._literal_read(left)
+                if got is not None and got[1] == "kind":
+                    recv = (left.value if isinstance(left, ast.Subscript)
+                            else left.func.value)
+                    if isinstance(recv, ast.Name):
+                        var = recv.id
+            if var is None:
+                continue
+            comp = expr.comparators[0]
+            names = []
+            elts = (comp.elts if isinstance(comp, (ast.Tuple, ast.List))
+                    else [comp])
+            for e in elts:
+                if isinstance(e, ast.Attribute) and isinstance(
+                        e.value, ast.Name) \
+                        and e.value.id == cfg.frame_kind_class \
+                        and e.attr in kinds:
+                    names.append(e.attr)
+            if not names or len(names) != len(elts):
+                continue
+            op = expr.ops[0]
+            if isinstance(op, (ast.Eq, ast.In)):
+                yield var, names, False
+            elif isinstance(op, (ast.NotEq, ast.NotIn)):
+                yield var, names, True
+
+    @staticmethod
+    def _terminates(body) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Raise, ast.Return, ast.Continue, ast.Break))
+
+
 CHECKERS: Tuple[Checker, ...] = (
     ToctouPortChecker(),
     ThreadHygieneChecker(),
@@ -1641,4 +2701,7 @@ CHECKERS: Tuple[Checker, ...] = (
     LockOrderingChecker(),
     StateTransitionChecker(),
     MetricLabelCardinalityChecker(),
+    LocksetRaceChecker(),
+    ResourceLifetimeChecker(),
+    FrameSchemaChecker(),
 )

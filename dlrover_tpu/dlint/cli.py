@@ -101,16 +101,62 @@ def run_dlint(
     return DlintResult(new, suppressed, baselined, stale, parse_errors)
 
 
+def _changed_files(base: str) -> Optional[set]:
+    """Paths (cwd-relative, ``/``-normalized) of files changed vs
+    ``base``, plus untracked ones — the report filter behind
+    ``--changed``.  None when git itself fails (not a checkout)."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base],
+            capture_output=True, text=True, check=True,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, check=True,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return {
+        line.strip().replace(os.sep, "/")
+        for out in (diff.stdout, untracked.stdout)
+        for line in out.splitlines()
+        if line.strip()
+    }
+
+
+def _render_report(fmt: str, result: DlintResult) -> str:
+    if fmt == "sarif":
+        from dlrover_tpu.dlint.sarif import render_sarif
+
+        return render_sarif(result.new, CHECKERS)
+    if fmt == "json":
+        import json
+
+        return json.dumps(
+            {
+                "new": [dataclasses.asdict(v) for v in result.new],
+                "baselined": len(result.baselined),
+                "suppressed": len(result.suppressed),
+                "stale_baseline": len(result.stale_baseline),
+            },
+            indent=2,
+        ) + "\n"
+    return "".join(v.render() + "\n" for v in result.new)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dlint",
         description=(
             "Project-native static analysis for dlrover_tpu: enforces "
             "the fabric's concurrency and protocol invariants — "
-            "per-module lexical checks (DL001-DL006) plus the "
-            "whole-program pass (DL007-DL009: transitive blocking "
-            "under locks, lock-order cycles, state-machine "
-            "exhaustiveness). See tools/dlint/checkers.py for the "
+            "per-module lexical checks (DL001-DL006, DL012) plus the "
+            "whole-program passes (DL007-DL011, DL013: transitive "
+            "blocking under locks, lock-order cycles, state-machine "
+            "exhaustiveness, metric label cardinality, lockset races, "
+            "frame-schema drift). See tools/dlint/checkers.py for the "
             "catalog, `--explain DLxxx` for one checker's contract."
         ),
     )
@@ -138,9 +184,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(debug surface for DL007/DL008 findings)")
     ap.add_argument("--summary-cache", default=None, metavar="PATH",
                     help="whole-program summary cache file, keyed by "
-                         "file hash (phase 1 of DL007-DL009); pass a "
-                         "persisted path in CI to skip re-extraction "
-                         "of unchanged files")
+                         "file hash (phase 1 of the whole-program "
+                         "checkers); pass a persisted path in CI to "
+                         "skip re-extraction of unchanged files")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"),
+                    help="report format: human text (default), a json "
+                         "summary object, or SARIF 2.1.0 for code-"
+                         "scanning upload")
+    ap.add_argument("--output", default=None, metavar="FILE",
+                    help="write the report there instead of stdout "
+                         "(the text summary line still prints)")
+    ap.add_argument("--changed", nargs="?", const="HEAD",
+                    default=None, metavar="BASE",
+                    help="incremental mode: scan the WHOLE program "
+                         "(cross-module checkers keep their context) "
+                         "but report only findings in files changed "
+                         "vs BASE (git diff; default HEAD, i.e. "
+                         "uncommitted edits)")
     args = ap.parse_args(argv)
 
     if args.list_checkers:
@@ -212,8 +273,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 0
 
-    for v in result.new:
-        print(v.render())
+    if args.changed is not None:
+        changed = _changed_files(args.changed)
+        if changed is None:
+            print("dlint: --changed requires a git checkout "
+                  "(git diff failed)", file=sys.stderr)
+            return 2
+        result = dataclasses.replace(
+            result,
+            new=[v for v in result.new if v.path in changed],
+        )
+
+    report = _render_report(args.format, result)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(report)
+    elif report:
+        sys.stdout.write(report)
     for entry in result.stale_baseline:
         print(
             "dlint: stale baseline entry (fixed? delete it): "
@@ -224,7 +300,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(
         f"dlint: {len(result.new)} new violation(s), "
         f"{len(result.baselined)} baselined, "
-        f"{len(result.suppressed)} suppressed"
+        f"{len(result.suppressed)} suppressed",
+        # a json/sarif document on stdout must stay machine-parseable
+        file=sys.stderr if (args.format != "text" and not args.output)
+        else sys.stdout,
     )
     if result.new:
         return 1

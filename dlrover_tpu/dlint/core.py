@@ -239,7 +239,7 @@ def write_baseline(path: str, violations: Iterable[Violation]) -> None:
 # names (`step`, `get`, `close`) resolve nowhere rather than smearing
 # unrelated subsystems together.
 
-SUMMARY_FORMAT_VERSION = 4  # v4: list-registered callbacks — attr_elems + the "elemof" typeref
+SUMMARY_FORMAT_VERSION = 6  # v6: class line + class-level DL011 exemption
 
 #: blocking-op vocabulary shared by DL003 (lexical) and DL007
 #: (transitive) — the two passes must agree on what "blocking" means.
@@ -280,6 +280,33 @@ DUCK_FANOUT_SKIP = frozenset({
     "send", "sendall", "recv", "close", "shutdown", "connect", "bind",
     "listen", "accept", "read", "readline", "write", "flush", "seek",
 })
+
+#: single-bytecode container/queue/event operations on an attribute
+#: (``self._pending.append(x)``, ``self._stop_event.set()``): atomic
+#: under the GIL, so DL011 does not record them as racy data accesses
+#: — the Eraser-style "atomic append / queue handoff" exemption.
+ATOMIC_CONTAINER_METHODS = frozenset({
+    "append", "appendleft", "pop", "popleft", "extend", "add",
+    "discard", "remove", "insert", "clear", "put", "put_nowait",
+    "get", "get_nowait", "qsize", "empty", "full", "task_done",
+    "set", "is_set", "wait", "notify", "notify_all", "acquire",
+    "release", "setdefault", "update", "keys", "values", "items",
+    "copy",
+})
+
+#: constructor names whose instances ARE synchronization/handoff
+#: primitives: an attribute holding one of these is a channel, not
+#: shared data — DL011 exempts the whole attribute.
+SYNC_FACTORY_NAMES = frozenset({
+    "Lock", "RLock", "Semaphore", "BoundedSemaphore", "Condition",
+    "Event", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "deque",
+})
+
+#: spellings that register a callable as a THREAD ENTRY POINT — the
+#: roots DL011's reachability starts from.  ``Thread(target=f)``,
+#: ``Timer(t, f)`` and the low-level ``start_new_thread(f, ...)``.
+THREAD_SPAWN_NAMES = frozenset({"Thread", "Timer", "start_new_thread"})
 
 _EXIT_STMTS = (ast.Continue, ast.Return, ast.Raise, ast.Break)
 
@@ -422,7 +449,14 @@ def _class_infos(module: "ParsedModule") -> Dict[str, dict]:
             continue
         info = {"bases": [terminal_name(b) for b in node.bases
                           if terminal_name(b)],
-                "attrs": {}, "attr_elems": {}, "methods": []}
+                "attrs": {}, "attr_elems": {}, "methods": [],
+                # class-LEVEL DL011 exemption: a reasoned disable on
+                # the ``class`` line declares the whole object
+                # process-local / single-owner (fakes standing in for
+                # another process, per-process shm handles) — cheaper
+                # and more honest than a comment on every write
+                "line": node.lineno,
+                "dl011_sup": module.suppressed("DL011", node.lineno)}
         for stmt in node.body:
             if isinstance(stmt, ast.AnnAssign) and isinstance(
                     stmt.target, ast.Name):
@@ -610,6 +644,8 @@ class _FunctionExtractor:
         self.local_names: set = set()
         # nested helper defs with return annotations: name -> type names
         self.nested_returns: Dict[str, List[str]] = {}
+        # nested defs in OUR scope (closure thread bodies): name -> node
+        self.nested_defs: Dict[str, ast.AST] = {}
         self.summary = {
             "qualname": qualname,
             "module": module.rel_path,
@@ -623,7 +659,10 @@ class _FunctionExtractor:
             "lock_pairs": [],
             "calls": [],
             "state_writes": [],
+            "attr_accesses": [],
+            "thread_targets": [],
         }
+        self.global_names: set = set()
 
     # ------------------------------------------------------- type refs
     def _typeref_of(self, expr: ast.AST, depth: int = 0) -> Optional[list]:
@@ -662,6 +701,9 @@ class _FunctionExtractor:
         return None
 
     def _collect_locals(self) -> None:
+        for node in _own_body_nodes(self.func):
+            if isinstance(node, ast.Global):
+                self.global_names.update(node.names)
         args = self.func.args
         for a in (args.posonlyargs + args.args + args.kwonlyargs):
             self.local_names.add(a.arg)
@@ -674,6 +716,9 @@ class _FunctionExtractor:
                 names = _annotation_names(node.returns)
                 if names:
                     self.nested_returns[node.name] = names
+        for node in _own_body_nodes(self.func):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.nested_defs[node.name] = node
         # two passes so `b = a.meth()` can see `a = C()` regardless of
         # textual order (the env is flow-insensitive on purpose)
         for _ in range(2):
@@ -754,10 +799,106 @@ class _FunctionExtractor:
             return
         if isinstance(node, ast.Call):
             self._record_call(node, held)
+        elif isinstance(node, ast.Attribute):
+            self._maybe_attr_access(node, held)
+        elif isinstance(node, ast.Name) and node.id in self.global_names:
+            self._record_access(None, node.id, node, held)
         for child in ast.iter_child_nodes(node):
             self._walk(child, held)
 
+    # -------------------------------------------- shared-state accesses
+    def _maybe_attr_access(self, attr: ast.Attribute,
+                           held: tuple) -> None:
+        """Record ``self.<attr>`` data reads/writes (DL011 material).
+        Method dispatch (``self.meth(...)``) is a call, not a data
+        access; GIL-atomic container/queue/event ops on an attribute
+        (``self._pending.append(x)``) are the sanctioned lock-free
+        handoff idiom and are exempt."""
+        if not (self.cls and isinstance(attr.value, ast.Name)
+                and attr.value.id == "self"):
+            return
+        parent = self.module.parents.get(attr)
+        if isinstance(attr.ctx, ast.Load):
+            if isinstance(parent, ast.Call) and parent.func is attr:
+                return  # self.meth(...): recorded in "calls"
+            if isinstance(parent, ast.Attribute) and isinstance(
+                    parent.ctx, ast.Load):
+                gp = self.module.parents.get(parent)
+                if isinstance(gp, ast.Call) and gp.func is parent \
+                        and parent.attr in ATOMIC_CONTAINER_METHODS:
+                    return  # atomic container/queue/event op
+        self._record_access(self.cls, attr.attr, attr, held)
+
+    def _record_access(self, cls: Optional[str], name: str,
+                       node: ast.AST, held: tuple) -> None:
+        parent = self.module.parents.get(node)
+        rw = "r"
+        const_store = False
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            rw = "w"
+            # a plain constant store is a single GIL-atomic bytecode —
+            # the stop-flag idiom (`self._running = False`), not a
+            # read-modify-write race
+            if isinstance(parent, ast.Assign) and isinstance(
+                    parent.value, ast.Constant):
+                const_store = True
+        elif isinstance(parent, ast.Subscript) and parent.value is node \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)):
+            rw = "w"  # self.attr[k] = v mutates the shared container
+        self.summary["attr_accesses"].append({
+            "cls": cls,
+            "attr": name,
+            "rw": rw,
+            "line": node.lineno,
+            "locks": list(held),
+            "const": const_store,
+            "sup": self.module.suppressed("DL011", node.lineno),
+        })
+
+    def _callable_desc(self, expr: Optional[ast.AST]) -> Optional[dict]:
+        """A call descriptor for a CALLABLE REFERENCE (a thread
+        target), resolved by phase 2 exactly like a call site."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.nested_defs:
+                # a closure thread body: extract_module_summaries gives
+                # it its own summary under this <locals> qualname
+                return {"form": "nested",
+                        "qual": f"{self.qualname}.<locals>.{expr.id}"}
+            if expr.id in self.local_names:
+                return None
+            return {"form": "name", "name": expr.id}
+        if isinstance(expr, ast.Attribute):
+            obj = self._typeref_of(expr.value)
+            if obj is not None:
+                return {"form": "attr", "obj": obj,
+                        "method": expr.attr}
+            return {"form": "method", "method": expr.attr}
+        return None
+
+    def _maybe_thread_target(self, call: ast.Call) -> None:
+        name = call_name(call)
+        if name not in THREAD_SPAWN_NAMES:
+            return
+        target = None
+        if name in ("Thread", "Timer"):
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    target = kw.value
+            if target is None and name == "Timer" \
+                    and len(call.args) >= 2:
+                target = call.args[1]
+        elif call.args:
+            target = call.args[0]
+        desc = self._callable_desc(target)
+        if desc is not None:
+            self.summary["thread_targets"].append({
+                "line": call.lineno,
+                "desc": desc,
+                "repr": expr_repr(target) or terminal_name(target),
+            })
+
     def _record_call(self, call: ast.Call, held: tuple) -> None:
+        self._maybe_thread_target(call)
         op = classify_blocking(call)
         if op is not None:
             kind, detail = op
@@ -960,6 +1101,37 @@ def extract_module_summaries(
                 ex.record_state_assign(sub)
         summary["state_writes"].sort(key=lambda w: w["line"])
         functions[qual] = summary
+        # closure THREAD BODIES get their own summaries: a nested def
+        # normally runs at its own call time (skipped above), but one
+        # handed to Thread(target=...) runs on a thread of its own and
+        # DL011 must see its shared-state accesses.  Recursive: a
+        # thread body may itself spawn another closure thread.
+        work = [(ex, summary)]
+        while work:
+            outer_ex, outer_summary = work.pop()
+            for tt in outer_summary["thread_targets"]:
+                desc = tt["desc"]
+                if desc.get("form") != "nested":
+                    continue
+                nested_qual = desc["qual"]
+                if nested_qual in functions:
+                    continue
+                name = nested_qual.rsplit(".", 1)[-1]
+                sub_node = outer_ex.nested_defs.get(name)
+                if sub_node is None:
+                    continue
+                sub_ex = _FunctionExtractor(
+                    module, sub_node, outer_ex.cls, nested_qual,
+                    aliases.get(sub_node, {}), state_class,
+                    request_class)
+                sub_summary = sub_ex.run()
+                for sub in _own_body_nodes(sub_node):
+                    if isinstance(sub, ast.Assign):
+                        sub_ex.record_state_assign(sub)
+                sub_summary["state_writes"].sort(key=lambda w: w["line"])
+                sub_summary["nested"] = True
+                functions[nested_qual] = sub_summary
+                work.append((sub_ex, sub_summary))
     return {"functions": functions, "classes": classes}
 
 
@@ -1023,6 +1195,13 @@ class WholeProgram:
                 self.classes.setdefault(cname, []).append(entry)
             for qual, s in ms.get("functions", {}).items():
                 self.functions[qual] = s
+                if s.get("nested"):
+                    # closure thread bodies are reachable ONLY through
+                    # their explicit <locals> qualname (the Thread
+                    # target that named them) — never by method/global
+                    # name, or duck fan-out would smear closures over
+                    # same-named project methods
+                    continue
                 if s["cls"]:
                     self.methods_by_name.setdefault(
                         s["name"], []).append(qual)
@@ -1034,6 +1213,8 @@ class WholeProgram:
                     self.global_funcs.setdefault(
                         s["name"], []).append(qual)
         self._typeref_memo: Dict[str, frozenset] = {}
+        self._canon_lock_memo: Dict[str, str] = {}
+        self._lock_in_edges_memo: Optional[Dict[str, List[tuple]]] = None
         self._edges: Optional[List[tuple]] = None
 
     # ------------------------------------------------------- resolution
@@ -1160,7 +1341,9 @@ class WholeProgram:
         return []
 
     def resolve_call(self, summary: dict, call: dict) -> List[str]:
-        desc = call["desc"]
+        return self.resolve_desc(summary, call["desc"])
+
+    def resolve_desc(self, summary: dict, desc: dict) -> List[str]:
         form = desc["form"]
         if form == "name":
             name = desc["name"]
@@ -1186,6 +1369,9 @@ class WholeProgram:
             return self._duck_targets(desc["method"])
         if form == "method":
             return self._duck_targets(desc["method"])
+        if form == "nested":
+            qual = desc["qual"]
+            return [qual] if qual in self.functions else []
         return []
 
     # ------------------------------------------------------- call graph
@@ -1256,6 +1442,173 @@ class WholeProgram:
                 }]
         return self._propagate(init)
 
+    # ------------------------------------------- thread roots (DL011)
+    def thread_roots(self) -> Dict[str, dict]:
+        """Resolved thread entry points: root qual -> spawn site
+        (``{"module", "line", "spawner", "repr"}`` of the
+        ``Thread(target=...)`` registration that names it)."""
+        out: Dict[str, dict] = {}
+        for qual in sorted(self.functions):
+            s = self.functions[qual]
+            for tt in s.get("thread_targets", ()):
+                desc = tt["desc"]
+                # a thread ROOT must resolve PRECISELY: module function,
+                # closure body, or `self.method`.  Duck fan-out (a bare
+                # method name on an untyped receiver, e.g. stdlib
+                # `self._server.serve_forever`) would mint fake roots on
+                # every same-named method and smear "runs on a thread"
+                # across the whole tree.
+                form = desc.get("form")
+                if form == "method":
+                    continue
+                if form == "attr" and desc["obj"][0] != "class":
+                    continue
+                for target in self.resolve_desc(s, desc):
+                    out.setdefault(target, {
+                        "module": s["module"], "line": tt["line"],
+                        "spawner": qual, "repr": tt["repr"]})
+        return out
+
+    def lock_owner(self, cls_name: str, attr: str,
+                   _seen: Optional[set] = None) -> str:
+        """Base-most ancestor of ``cls_name`` that assigns ``attr``.
+        An inherited ``self._lock`` is ONE object per instance, so a
+        subclass's ``with self._lock:`` and the base's must agree on
+        lock identity — while two unrelated classes that each assign
+        their own ``_lock`` stay distinct (see :func:`_lock_canon`)."""
+        _seen = _seen if _seen is not None else set()
+        if cls_name in _seen or len(_seen) > 16:
+            return cls_name
+        _seen.add(cls_name)
+        for entry in self.classes.get(cls_name, ()):
+            for base in entry.get("bases", ()):
+                if base not in self.classes:
+                    continue
+                owner = self.lock_owner(base, attr, _seen)
+                if attr in {
+                    a for e in self.classes.get(owner, ())
+                    for a in e.get("attrs", ())
+                }:
+                    return owner
+        return cls_name
+
+    def canon_lock(self, lock_id: str) -> str:
+        """Rewrite a ``Sub.attr`` lock id to ``Base.attr`` when the
+        attribute is assigned by a base class (:meth:`lock_owner`);
+        module-level (``path:name``) and non-class ids pass through."""
+        memo = self._canon_lock_memo
+        hit = memo.get(lock_id)
+        if hit is not None:
+            return hit
+        out = lock_id
+        if ":" not in lock_id and "." in lock_id:
+            cls, _, attr = lock_id.partition(".")
+            if cls in self.classes:
+                out = f"{self.lock_owner(cls, attr)}.{attr}"
+        memo[lock_id] = out
+        return out
+
+    def _lock_in_edges(self) -> Dict[str, List[tuple]]:
+        """``callee -> [(caller, locks_held_at_call)]`` over every
+        resolved call edge — the shared substrate for per-root
+        ``entry_locksets`` fixpoints."""
+        if self._lock_in_edges_memo is None:
+            in_edges: Dict[str, List[tuple]] = {}
+            for qual in sorted(self.functions):
+                s = self.functions[qual]
+                for call in s["calls"]:
+                    held = frozenset(
+                        self.canon_lock(lk)
+                        for lk in call.get("locks_held", ())
+                    )
+                    for callee in self.resolve_call(s, call):
+                        in_edges.setdefault(callee, []).append(
+                            (qual, held))
+            self._lock_in_edges_memo = in_edges
+        return self._lock_in_edges_memo
+
+    def entry_locksets(
+        self, roots: Iterable[str]
+    ) -> Dict[str, frozenset]:
+        """Locks GUARANTEED held on entry to each function: the
+        intersection, over every resolved call edge, of the caller's
+        entry lockset plus the locks lexically held at the call site.
+        Roots (thread entries, ``<main>`` seeds) enter with nothing
+        held.  This is what makes ``_dispatch_locked``-style helpers
+        — only ever called with the lock already taken — analyzable:
+        their accesses inherit the callers' lock context instead of
+        looking bare.  Callable per thread root (the edge table is
+        built once and memoized): a helper locked on one root's call
+        path and bare on another's then shows DIFFERENT entry
+        locksets instead of their empty intersection."""
+        in_edges = self._lock_in_edges()
+        # dataflow meet-over-edges: start at TOP (None), roots at {},
+        # transfer = caller_entry | held_at_call, meet = intersection.
+        # Sets only shrink after their first value, so this terminates.
+        entry: Dict[str, Optional[frozenset]] = {
+            q: None for q in self.functions
+        }
+        for r in roots:
+            if r in entry:
+                entry[r] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for qual in self.functions:
+                cur = entry[qual]
+                for caller, held in in_edges.get(qual, ()):
+                    ctx = entry[caller]
+                    if ctx is None or caller == qual:
+                        continue
+                    val = ctx | held
+                    cur = val if cur is None else cur & val
+                if cur != entry[qual]:
+                    entry[qual] = cur
+                    changed = True
+        return {q: v for q, v in entry.items() if v}
+
+    def main_entry_funcs(self, thread_root_set: set) -> List[str]:
+        """Functions with no resolved in-edges that are not thread
+        entry points — the static stand-in for "runs on the caller's
+        (main) thread": public API surface, test entry points, CLI
+        handlers."""
+        has_in = {callee for _, _, callee, _ in self.edges()}
+        return sorted(
+            q for q in self.functions
+            if q not in has_in and q not in thread_root_set
+        )
+
+    def multi_reach(
+        self, seeds_by_root: Dict[str, List[str]]
+    ) -> Dict[str, Dict[str, list]]:
+        """One forward BFS per root: ``{root: {qual: path}}`` where
+        ``path`` is the witness chain ``[(caller, line, callee), ...]``
+        from a seed down to ``qual`` (empty for the seed itself)."""
+        from collections import deque
+
+        adj: Dict[str, List[tuple]] = {}
+        for caller, line, callee, rep in self.edges():
+            adj.setdefault(caller, []).append((callee, line, rep))
+        out: Dict[str, Dict[str, list]] = {}
+        for root, seeds in seeds_by_root.items():
+            paths: Dict[str, list] = {}
+            work: deque = deque()
+            for seed in seeds:
+                if seed in self.functions and seed not in paths:
+                    paths[seed] = []
+                    work.append(seed)
+            while work:
+                cur = work.popleft()
+                if len(paths[cur]) >= self.MAX_CHAIN:
+                    continue
+                for callee, line, rep in adj.get(cur, ()):
+                    if callee not in paths:
+                        paths[callee] = paths[cur] + [
+                            (cur, line, callee)]
+                        work.append(callee)
+            out[root] = paths
+        return out
+
 
 def build_program(
     modules: List["ParsedModule"],
@@ -1270,6 +1623,7 @@ def build_program(
     cache = load_summary_cache(cache_path)
     used: Dict[str, dict] = {}
     by_module: Dict[str, dict] = {}
+    fresh = 0
     for module in modules:
         key = summary_cache_key(salt, module.rel_path, module.source)
         entry = cache.get(key)
@@ -1277,9 +1631,12 @@ def build_program(
             entry = extract_module_summaries(
                 module, state_class=state_class,
                 request_class=request_class)
+            fresh += 1
         used[key] = entry
         by_module[module.rel_path] = entry
-    if cache_path:
+    # rewrite only on a miss or when evicting dead keys — on a fully
+    # warm run the multi-MB json dump would otherwise dominate phase 1
+    if cache_path and (fresh or len(used) != len(cache)):
         try:
             save_summary_cache(cache_path, used)
         except OSError:

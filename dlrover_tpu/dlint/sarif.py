@@ -1,0 +1,96 @@
+"""SARIF 2.1.0 serialization of a dlint run.
+
+One static-analysis interchange format so findings land in code review
+instead of a CI log: GitHub code scanning ingests this document via
+``codeql-action/upload-sarif`` and annotates the PR diff at the
+violation line.  Only the minimal-but-valid subset of the spec is
+emitted — one run, one driver, one rule per checker (indexed, so
+results carry ``ruleIndex``), one physical location per result.
+
+The document is built from plain dicts and is deliberately free of any
+repo-absolute path: artifact URIs are the scan-relative paths dlint
+already reports, with ``%SRCROOT%`` as the uriBase, which is what the
+upload action expects of a checkout-rooted scan.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+    "master/Schemata/sarif-schema-2.1.0.json"
+)
+SARIF_VERSION = "2.1.0"
+
+
+def _rule(checker) -> dict:
+    rule = {
+        "id": checker.CODE,
+        "name": checker.NAME,
+        "shortDescription": {"text": checker.WHY},
+        "defaultConfiguration": {"level": "error"},
+    }
+    explain = getattr(checker, "EXPLAIN", "")
+    if explain:
+        rule["fullDescription"] = {"text": explain}
+    return rule
+
+
+def _result(violation, rule_index: Dict[str, int]) -> dict:
+    out = {
+        "ruleId": violation.code,
+        "level": "error",
+        "message": {"text": violation.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": violation.path,
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {"startLine": max(1, violation.line)},
+                }
+            }
+        ],
+    }
+    idx = rule_index.get(violation.code)
+    if idx is not None:
+        out["ruleIndex"] = idx
+    return out
+
+
+def sarif_document(violations: List, checkers) -> dict:
+    """The full SARIF log for ``violations`` (the NEW findings of a
+    run — baselined and suppressed ones are resolved states, not
+    review annotations)."""
+    rules = [_rule(c) for c in checkers]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "dlint",
+                        "informationUri": (
+                            "https://github.com/intelligent-machine-"
+                            "learning/dlrover"
+                        ),
+                        "rules": rules,
+                    }
+                },
+                "results": [
+                    _result(v, rule_index) for v in violations
+                ],
+            }
+        ],
+    }
+
+
+def render_sarif(violations: List, checkers) -> str:
+    return json.dumps(
+        sarif_document(violations, checkers), indent=2, sort_keys=False
+    ) + "\n"

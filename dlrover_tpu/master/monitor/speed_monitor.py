@@ -33,7 +33,10 @@ class SpeedMonitor:
         self.target_worker_num = 0
 
     def set_target_worker_num(self, n: int) -> None:
-        self.target_worker_num = n
+        # the tuning loop writes this while worker_adjustment_finished
+        # reads it from the rendezvous path; share the monitor lock
+        with self._lock:
+            self.target_worker_num = n
 
     def add_running_worker(self, node_type: str, worker_id: int) -> None:
         with self._lock:
@@ -81,13 +84,14 @@ class SpeedMonitor:
             return (last.global_step - first.global_step) / dt
 
     def init_training_speed_or_not(self) -> bool:
-        return self._sample_count >= 2
+        with self._lock:
+            return self._sample_count >= 2
 
     def worker_adjustment_finished(self) -> bool:
         """All target workers are present in the recent records."""
-        if not self.target_worker_num:
-            return False
         with self._lock:
+            if not self.target_worker_num:
+                return False
             if not self._global_step_records:
                 return False
             return (

@@ -40,6 +40,22 @@ from dlrover_tpu.rl.generation import select_token
 from dlrover_tpu.serving.model import decode_step, prefill
 from dlrover_tpu.serving.params import serving_params_from_llama
 
+# dlint DL012 contract: a lifetime allocation is owned by the admitting
+# path until it is bound to a slot (whose release funnel is
+# _release_slot -> free_sequence) or rolled back — an allocation that
+# escapes _admit/_admit_chunked any other way strands its refcounts
+_DLINT_RESOURCE_SPECS = (
+    {
+        "resource": "sequence lifetime allocation",
+        "acquire": ("_alloc_lifetime", "alloc_sequence"),
+        "release": ("free_sequence", "_bind_blocks"),
+        "owners": ("allocs",),
+        "why": "an admission that drops its allocation on a bail-out "
+               "path pins every block in it until restart — the "
+               "chunked COW rollback exists exactly for this",
+    },
+)
+
 
 @dataclasses.dataclass
 class Request:

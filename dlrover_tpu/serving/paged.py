@@ -56,6 +56,21 @@ from dlrover_tpu.serving.prefixcache import PrefixBlockIndex, chain_key
 # router computes routing heads with the SAME function)
 _chain_key = chain_key
 
+# dlint DL012 contract: a block id handed out by the allocator is a
+# refcount the caller now owes — every acquire site must return it,
+# hand it to a sequence's block list, or push it back through the
+# release surface on EVERY path (including exception edges)
+_DLINT_RESOURCE_SPECS = (
+    {
+        "resource": "KV block refcount",
+        "acquire": ("_take_block", "evict_one"),
+        "release": ("free_sequence", "linger", "forget"),
+        "why": "a dropped block id leaves _ref pinned nonzero forever "
+               "— the pool shrinks by one block per leak until "
+               "alloc_sequence starves every admission",
+    },
+)
+
 
 class BlockManager:
     """Host-side pool bookkeeping: allocation, refcounts, prefix COW.

@@ -93,6 +93,13 @@ class PrefixBlockIndex:
         self.cow_copies = 0      # divergence copies (BlockManager.cow_block)
         self.revivals = 0        # ref-0 lingering blocks revived by a hit
         self.shared_tokens = 0   # cumulative prompt tokens served shared
+        self.lingers = 0         # blocks parked evictable at ref 0
+        self.forgotten = 0       # registrations dropped NOT via eviction
+        #                          (COW privatization, cancelled writers)
+        self.evicted_head_drops = 0  # head invalidations lost to the
+        #                          staging cap (router keeps a stale
+        #                          route until its TTL — visible, not
+        #                          silent)
 
     # ------------------------------------------------------------ lookup
     def lookup(self, chain: bytes, tok_bytes: bytes) -> Optional[int]:
@@ -142,6 +149,10 @@ class PrefixBlockIndex:
         invalidates its routing entry."""
         self._block_tokens.pop(bid, None)
         h = self._block_hash.pop(bid, None)
+        if h is not None and not evicted:
+            # eviction has its own counter (evict_one); this one counts
+            # the other registration-dropping paths
+            self.forgotten += 1
         # the chain hash may have been RE-registered to a newer block
         # after this one was orphaned — only drop the mapping if it
         # still points at the block being forgotten
@@ -151,14 +162,21 @@ class PrefixBlockIndex:
         head = self._head_of.pop(bid, None)
         if head is not None:
             self._head_hits.pop(head, None)
-            if evicted and len(self._evicted_heads) < \
-                    self.MAX_EVICTED_HEADS:
-                self._evicted_heads.append(head)
+            if evicted:
+                if len(self._evicted_heads) < self.MAX_EVICTED_HEADS:
+                    self._evicted_heads.append(head)
+                else:
+                    self.evicted_head_drops += 1
 
     # --------------------------------------------------------------- lru
     def linger(self, bid: int) -> None:
         """A committed block's refcount reached 0: evictable, newest
         last."""
+        if bid not in self._lru:
+            # a re-linger only refreshes recency; the counter tracks
+            # distinct park events so lingers - (revivals + evictions)
+            # stays reconcilable with the lru_blocks gauge
+            self.lingers += 1
         self._lru[bid] = None
         self._lru.move_to_end(bid)
 
@@ -210,6 +228,9 @@ class PrefixBlockIndex:
             "prefix_cow": float(self.cow_copies),
             "prefix_revivals": float(self.revivals),
             "prefix_shared_tokens": float(self.shared_tokens),
+            "prefix_lingers": float(self.lingers),
+            "prefix_forgotten": float(self.forgotten),
+            "prefix_evicted_head_drops": float(self.evicted_head_drops),
             "prefix_cached_blocks": float(len(self._block_hash)),
             "prefix_lru_blocks": float(len(self._lru)),
         }

@@ -84,6 +84,20 @@ class FrameKind:
     GOODBYE = "GOODBYE"
 
 
+# Frame keys that are sent but deliberately not read by any current
+# receiver — dlint's DL013 schema-drift checker flags every other
+# sent-but-never-read key.  Each entry carries the reason the key stays
+# on the wire anyway; an entry whose key gains a reader (or loses its
+# last sender) becomes a stale declaration and is itself flagged.
+_FRAME_OPTIONAL_KEYS = {
+    (FrameKind.HELLO, "addr"): (
+        "self-identification for wire sniffers and debug logging: the "
+        "proxy already knows the addr it dialed, but a capture of the "
+        "handshake alone must name the worker"
+    ),
+}
+
+
 class FrameProtocolError(ConnectionError):
     """The peer violated the frame protocol (oversized/truncated frame)."""
 

@@ -124,6 +124,12 @@ class RemoteReplicaHandle:
         # would regress the capacity ledger and over-place
         self._stats_tokens = -1
         self._stats_seq_seen = 0
+        # the worker's OWN view of its in-flight count (STATS
+        # "inflight"): surfaced when the worker goes silent so the
+        # failover log distinguishes "died idle" from "died holding
+        # N requests" without trusting this side's ledger, which a
+        # lost DONE frame can leave overcounted
+        self._worker_inflight = 0
         self.stale_stats_dropped = 0
         self._engine_metrics: Optional[Dict[str, float]] = None
         self._prefix_heads: List[str] = []
@@ -135,7 +141,7 @@ class RemoteReplicaHandle:
 
     # ----------------------------------------------------- reader side
     def _read_loop(self) -> None:
-        while self._dead is None and not self._conn.closed:
+        while self.dead is None and not self._conn.closed:
             try:
                 # batched drain: one select wakeup scoops EVERY frame
                 # already buffered behind the first — under a token
@@ -232,6 +238,8 @@ class RemoteReplicaHandle:
                 self._slots_free = int(frame.get("slots_free", 0))
                 self._blocks_free = float(
                     frame.get("blocks_free", 0.0))
+                self._worker_inflight = int(
+                    frame.get("inflight", 0))
                 em = frame.get("engine_metrics")
                 if isinstance(em, dict):
                     # raw-speed introspection (spec accept ratio,
@@ -386,7 +394,8 @@ class RemoteReplicaHandle:
                 raise ConnectionError(
                     f"worker {self.name} silent for "
                     f"{now - self._last_frame:.1f}s (> frame_timeout "
-                    f"{self.frame_timeout}s)")
+                    f"{self.frame_timeout}s); last STATS reported "
+                    f"{self._worker_inflight} inflight")
             finished, self._finished = self._finished, []
             return finished
 
@@ -476,11 +485,15 @@ class RemoteReplicaHandle:
     # -------------------------------------------------------- lifecycle
     @property
     def dead(self) -> Optional[str]:
-        return self._dead
+        # locked so the None -> reason transition in _mark_dead is
+        # never half-observed next to the state it guards (_inflight,
+        # _submit_replies are only consistent with _dead under _lock)
+        with self._lock:
+            return self._dead
 
     def close(self, goodbye: bool = True) -> None:
         self._closing = True
-        if goodbye and self._dead is None:
+        if goodbye and self.dead is None:
             try:
                 self._conn.send(FrameKind.GOODBYE)
                 # half-close and let the reader drain to EOF: a full

@@ -38,7 +38,7 @@ from dlrover_tpu.serving.remote.protocol import FrameConnection, FrameKind
 from dlrover_tpu.utils.tracing import parse_traceparent, trace_sampled
 
 
-class FakeEngine:
+class FakeEngine:  # dlint: disable=DL011 stands in for the remote worker PROCESS: driven only by that process's single-threaded frame loop, router-side chains reach it through duck fan-out, never at runtime
     """Deterministic engine for fabric tests and jax-less images: each
     ``step()`` appends ``tokens_per_step`` tokens (value = rid % 997) to
     every active request.  Speaks the full router engine protocol plus
@@ -188,7 +188,9 @@ class WorkerServer:
         # uses to translate them into router time
         self._trace_by_erid: Dict[int, dict] = {}
         # last consistent STATS numbers; the heartbeat thread falls
-        # back to these when a live read races an engine mutation
+        # back to these when a live read races an engine mutation.
+        # Shared by the heartbeat thread and the serve loop: outside
+        # __init__ it is ONLY read or swapped under _stats_seq_lock
         self._last_stats_payload: Dict[str, object] = dict(
             slots_free=0, blocks_free=0.0, inflight=0,
             generated_tokens=0,
@@ -466,9 +468,13 @@ class WorkerServer:
 
     def _send_stats(self, conn: FrameConnection,
                     cached: bool = False) -> None:
+        payload = None
         if not cached:
             eng = self.engine
-            self._last_stats_payload = dict(
+            # built into a LOCAL first: the heartbeat thread and the
+            # serve loop both run this, and the shared cached copy is
+            # only ever touched under _stats_seq_lock below
+            payload = dict(
                 slots_free=eng.slots_free(),
                 blocks_free=self._finite_blocks(),
                 inflight=len(self._rid_by_erid),
@@ -481,7 +487,7 @@ class WorkerServer:
             # ignore unknown keys, so old proxies stay compatible
             em = getattr(eng, "engine_metrics", None)
             if em is not None:
-                self._last_stats_payload["engine_metrics"] = {
+                payload["engine_metrics"] = {
                     k: float(v) for k, v in em().items()
                 }
             # hottest committed prefix heads (hex digests) ride STATS
@@ -490,16 +496,21 @@ class WorkerServer:
             # namespace; receivers ignore unknown keys (DL004 holds)
             heads = getattr(eng, "prefix_heads", None)
             if heads is not None:
-                self._last_stats_payload["prefix_heads"] = [
+                payload["prefix_heads"] = [
                     str(h) for h in heads()
                 ]
         # seq is assigned at SEND time (never stored in the cached
         # payload): a cached liveness resend carries stale numbers
         # under a fresh ordinal, same last-send-wins semantics as
-        # before, but now reorderable by the receiver.  Draw + send
-        # share the lock so seq order == wire order (the send itself
-        # is bounded by the connection's send_timeout)
+        # before, but now reorderable by the receiver.  Draw, payload
+        # swap and send share the lock so seq order == wire order ==
+        # snapshot order (the send itself is bounded by the
+        # connection's send_timeout); before the swap moved in here, a
+        # heartbeat and the serve loop could interleave draw and send
+        # and hand the higher seq to the OLDER snapshot
         with self._stats_seq_lock:
+            if payload is not None:
+                self._last_stats_payload = payload
             # dlint: disable=DL007 serializing the send IS this lock's contract — seq order must equal wire order, and the send is bounded by the connection's send_timeout
             conn.send(FrameKind.STATS, seq=next(self._stats_seq),
                       **self._last_stats_payload)

@@ -450,21 +450,26 @@ class RequestGateway:
         """Terminal hook (exactly once per request): the tenant's open
         count comes down.  Runs on whatever thread drove the terminal
         transition, sometimes already holding this gateway's lock —
-        so: plain GIL-atomic dict arithmetic only, no locking, no I/O.
+        which is why _lock is an RLock: re-entry is a no-op, and a
+        bare completion path (a client thread cancelling, a proxy
+        reader finishing a request) still serializes against
+        admission/expiry instead of losing a decrement or a
+        queue_gen bump to a concurrent += .  No I/O happens under it.
         When an in-flight-capped tenant still has queued work, the
         freed in-flight slot is a scheduling event the placement
         index cannot otherwise see — bump the queue generation so the
         idle short-circuit re-scans."""
-        name = req.tenant
-        n = self._tenant_open.get(name, 0) - 1
-        if n > 0:
-            self._tenant_open[name] = n
-        else:
-            self._tenant_open.pop(name, None)
-        spec = self.tenants.resolve(name)
-        if spec.max_inflight is not None and \
-                self._tenant_queued.get(name, 0) > 0:
-            self.queue_gen += 1
+        with self._lock:
+            name = req.tenant
+            n = self._tenant_open.get(name, 0) - 1
+            if n > 0:
+                self._tenant_open[name] = n
+            else:
+                self._tenant_open.pop(name, None)
+            spec = self.tenants.resolve(name)
+            if spec.max_inflight is not None and \
+                    self._tenant_queued.get(name, 0) > 0:
+                self.queue_gen += 1
 
     def tenant_queue_depths(self) -> Dict[str, int]:
         """Queued count per tenant across all bands (resolved names)."""

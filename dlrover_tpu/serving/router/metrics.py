@@ -115,6 +115,9 @@ class RouterMetrics:
         self.prefix_cow = 0.0
         self.prefix_revivals = 0.0
         self.prefix_shared_tokens = 0.0
+        self.prefix_lingers = 0.0
+        self.prefix_forgotten = 0.0
+        self.prefix_evicted_head_drops = 0.0
         self.prefix_shared_blocks = 0.0
         self.prefix_cached_blocks = 0.0
         self.prefix_lru_blocks = 0.0
@@ -268,6 +271,9 @@ class RouterMetrics:
             ("prefix_cow", "prefix_cow"),
             ("prefix_revivals", "prefix_revivals"),
             ("prefix_shared_tokens", "prefix_shared_tokens"),
+            ("prefix_lingers", "prefix_lingers"),
+            ("prefix_forgotten", "prefix_forgotten"),
+            ("prefix_evicted_head_drops", "prefix_evicted_head_drops"),
             ("prefix_shared_blocks", "prefix_shared_blocks"),
             ("prefix_cached_blocks", "prefix_cached_blocks"),
             ("prefix_lru_blocks", "prefix_lru_blocks"),
@@ -356,6 +362,10 @@ class RouterMetrics:
             "serving_prefix_revivals_total": self.prefix_revivals,
             "serving_prefix_shared_tokens_total":
                 self.prefix_shared_tokens,
+            "serving_prefix_lingers_total": self.prefix_lingers,
+            "serving_prefix_forgotten_total": self.prefix_forgotten,
+            "serving_prefix_evicted_head_drops_total":
+                self.prefix_evicted_head_drops,
             "serving_prefix_shared_blocks": self.prefix_shared_blocks,
             "serving_prefix_cached_blocks": self.prefix_cached_blocks,
             "serving_prefix_lru_blocks": self.prefix_lru_blocks,

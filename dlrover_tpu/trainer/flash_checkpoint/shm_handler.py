@@ -133,7 +133,7 @@ class ShmMeta:
     buffer: int = 0
 
 
-class SharedMemoryHandler:
+class SharedMemoryHandler:  # dlint: disable=DL011 worker restore and agent persist attach from DIFFERENT PROCESSES sharing the segment by name; each process's handler is touched by one thread
     """Two shm segments per (job, local rank) holding the flattened state
     double-buffered (generation ``g`` lives in buffer ``g % 2``)."""
 

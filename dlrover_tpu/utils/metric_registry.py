@@ -162,6 +162,23 @@ METRIC_HELP: Dict[str, str] = {
         "prompt tokens served from shared KV blocks instead of "
         "prefill compute (hits x block_size)"
     ),
+    "serving_prefix_lingers_total": (
+        "committed blocks parked evictable when their refcount hit 0 "
+        "— lingers - (revivals + evictions) reconciles against the "
+        "lru_blocks gauge, so a leak in the park/reclaim cycle shows "
+        "as drift instead of hiding"
+    ),
+    "serving_prefix_forgotten_total": (
+        "committed registrations dropped outside eviction: COW "
+        "privatization of a ref-1 block and cancelled mid-prefill "
+        "writers whose content never became trustworthy"
+    ),
+    "serving_prefix_evicted_head_drops_total": (
+        "evicted-head invalidations lost to the staging cap before "
+        "the next STATS drain — the router keeps a stale route until "
+        "its TTL; a rising value says the cap is too small for the "
+        "eviction rate"
+    ),
     "serving_prefix_shared_blocks": (
         "KV blocks currently mapped by more than one live sequence "
         "(ref>1) — the live deduplication the effective-KV-bytes-per-"

@@ -85,21 +85,27 @@ class StepTimer:
 
     def percentile(self, q: float) -> float:
         with self._lock:
-            if not self._samples:
-                return 0.0
-            ordered = sorted(self._samples)
-            idx = min(len(ordered) - 1, int(q / 100.0 * len(ordered)))
-            return ordered[idx]
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        ordered = sorted(self._samples)
+        idx = min(len(ordered) - 1, int(q / 100.0 * len(ordered)))
+        return ordered[idx]
 
     def metrics(self, prefix: str = "dlrover_step") -> Dict[str, float]:
-        return {
-            f"{prefix}_count": float(self.count),
-            f"{prefix}_seconds_ema": self.ema_seconds,
-            f"{prefix}_seconds_last": self.last_seconds,
-            f"{prefix}_seconds_p50": self.percentile(50),
-            f"{prefix}_seconds_p99": self.percentile(99),
-            f"{prefix}_seconds_total": self.total_seconds,
-        }
+        # one locked snapshot: a scrape racing observe() must never
+        # see count from one step and total/ema from the next
+        with self._lock:
+            return {
+                f"{prefix}_count": float(self.count),
+                f"{prefix}_seconds_ema": self.ema_seconds,
+                f"{prefix}_seconds_last": self.last_seconds,
+                f"{prefix}_seconds_p50": self._percentile_locked(50),
+                f"{prefix}_seconds_p99": self._percentile_locked(99),
+                f"{prefix}_seconds_total": self.total_seconds,
+            }
 
 
 class WindowGauge:

@@ -662,21 +662,27 @@ class Tracer:
             active = len(self._active)
             slowest = max(
                 (t.duration for t in self._ring), default=0.0)
+            # counters snapshot under the same lock finish_trace and
+            # graft bump them under — a scrape must not see
+            # finished_total from before a retention decision and
+            # dropped_total from after it
+            finished = self.finished_total
+            orphans = self.orphan_spans_total
+            sampled = self.sampled_total
+            dropped = self.dropped_total
         return {
-            "serving_request_trace_finished_total": float(
-                self.finished_total),
+            "serving_request_trace_finished_total": float(finished),
             "serving_request_trace_active": float(active),
             "serving_request_trace_ring_size": float(ring),
             "serving_request_trace_slowest_seconds": float(slowest),
-            "serving_request_trace_orphan_spans_total": float(
-                self.orphan_spans_total),
+            "serving_request_trace_orphan_spans_total": float(orphans),
             "serving_request_trace_flight_dumps_total": float(
                 self.recorder.dumps_total),
             # the sampling knob's proof pair: dropped > 0 says the
             # rate is biting; sampled counts what survived (incident
             # overrides included)
-            "serving_trace_sampled_total": float(self.sampled_total),
-            "serving_trace_dropped_total": float(self.dropped_total),
+            "serving_trace_sampled_total": float(sampled),
+            "serving_trace_dropped_total": float(dropped),
         }
 
 

@@ -1347,11 +1347,12 @@ def test_tools_shim_cannot_diverge_from_canonical_impl():
 
 # ---------------------------------------------------- acceptance gates
 def test_repo_package_is_dlint_clean():
-    """THE tier-1 guard: any new DL001-DL009 violation in dlrover_tpu
-    fails this test — including the whole-program pass (transitive
+    """THE tier-1 guard: any new DL001-DL013 violation in dlrover_tpu
+    fails this test — including the whole-program passes (transitive
     blocking under locks, lock-order cycles, state-machine
-    exhaustiveness).  The baseline is empty — nothing is grandfathered;
-    every in-tree suppression carries a written reason."""
+    exhaustiveness, lockset races, resource lifetimes, frame-schema
+    drift).  The baseline is empty — nothing is grandfathered; every
+    in-tree suppression carries a written reason."""
     result = run_dlint(
         [str(REPO_ROOT / "dlrover_tpu")],
         baseline_path=str(REPO_ROOT / "tools" / "dlint" / "baseline.json"),
@@ -1384,3 +1385,571 @@ def test_metrics_endpoint_renders_registry_help():
     )
     assert "# HELP serving_queue_depth" in text
     assert "serving_queue_depth 3.0" in text
+
+
+# --------------------------------------------------------------- DL011
+
+
+def test_dl011_flags_cross_thread_attr_with_no_common_lock(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
+
+            def _worker(self):
+                with self._lock:
+                    self.total = self.total + 1
+
+            def read(self):
+                return self.total + 1
+    """})
+    assert _codes(result) == ["DL011"]
+    msg = result.new[0].message
+    assert "Counter.total" in msg
+    assert "races" in msg
+    assert "thread" in msg and "<main>" in msg, \
+        "both witness chains must name their roots"
+
+
+def test_dl011_quiet_when_every_access_is_locked(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0  # init-before-start: no peer thread yet
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
+
+            def _worker(self):
+                with self._lock:
+                    self.total = self.total + 1
+
+            def read(self):
+                with self._lock:
+                    return self.total
+    """})
+    assert _codes(result) == []
+
+
+def test_dl011_entry_lockset_covers_locked_only_helpers(tmp_path):
+    good = _scan(tmp_path / "good", {"mod.py": """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = 0
+                a = threading.Thread(target=self.loop_a, daemon=True)
+                a.start()
+                b = threading.Thread(target=self.loop_b, daemon=True)
+                b.start()
+
+            def loop_a(self):
+                with self._lock:
+                    self._bump()
+
+            def loop_b(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self.items = self.items + 1
+    """})
+    assert _codes(good) == [], \
+        "a helper only ever called under the lock inherits it"
+
+    bad = _scan(tmp_path / "bad", {"mod.py": """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = 0
+                a = threading.Thread(target=self.loop_a, daemon=True)
+                a.start()
+                b = threading.Thread(target=self.loop_b, daemon=True)
+                b.start()
+
+            def loop_a(self):
+                with self._lock:
+                    self._bump()
+
+            def loop_b(self):
+                self._bump()
+
+            def _bump(self):
+                self.items = self.items + 1
+    """})
+    assert _codes(bad) == ["DL011"], \
+        "one bare call path breaks the entry-lockset guarantee"
+
+
+def test_dl011_never_locked_attr_is_deliberate_lockfree_design(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self.count = 0
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
+
+            def _worker(self):
+                self.count = self.count + 1
+
+            def read(self):
+                return self.count
+    """})
+    assert _codes(result) == [], \
+        "no access is EVER locked: the discipline filter must not fire"
+
+
+def test_dl011_suppression_with_reason_silences_the_write(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
+
+            def _worker(self):
+                with self._lock:
+                    self.total = self.total + 1
+
+            def read(self):
+                return self.total + 1  # dlint: disable=DL011 monotonic telemetry read, staleness tolerated
+    """})
+    assert _codes(result) == []
+    assert [v.code for v in result.suppressed].count("DL011") >= 1
+
+
+def test_dl011_class_level_suppression_exempts_every_attr(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class Fake:  # dlint: disable=DL011 stands in for another PROCESS, touched by one thread at runtime
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
+
+            def _worker(self):
+                with self._lock:
+                    self.total = self.total + 1
+
+            def read(self):
+                return self.total + 1
+    """})
+    assert _codes(result) == []
+    sup = [v for v in result.suppressed if v.code == "DL011"]
+    assert sup, "the class-level exemption must land in the ledger"
+    assert "Fake.total" in sup[0].message
+
+
+# --------------------------------------------------------------- DL012
+
+_SPEC_MOD_HEADER = """
+        _DLINT_RESOURCE_SPECS = (
+            {
+                "resource": "pool block",
+                "acquire": ("take",),
+                "release": ("give",),
+                "why": "a dropped block pins the pool until restart",
+            },
+        )
+"""
+
+
+def test_dl012_flags_leak_on_every_path(tmp_path):
+    result = _scan(tmp_path, {"mod.py": _SPEC_MOD_HEADER + """
+        class M:
+            def bad(self):
+                b = self.take()
+                self.fill(b)
+    """})
+    assert _codes(result) == ["DL012"]
+    assert "never released" in result.new[0].message
+
+
+def test_dl012_flags_exception_edge_out_of_try(tmp_path):
+    result = _scan(tmp_path, {"mod.py": _SPEC_MOD_HEADER + """
+        class M:
+            def bad_exc(self):
+                try:
+                    b = self.take()
+                    self.fill(b)
+                    self.give(b)
+                except ValueError:
+                    pass
+    """})
+    assert _codes(result) == ["DL012"]
+    assert "no-exception path" in result.new[0].message
+
+
+def test_dl012_quiet_on_finally_return_owner_and_with(tmp_path):
+    result = _scan(tmp_path, {"mod.py": _SPEC_MOD_HEADER + """
+        class M:
+            def good_finally(self):
+                b = self.take()
+                try:
+                    self.fill(b)
+                finally:
+                    self.give(b)
+
+            def good_try_release(self):
+                try:
+                    b = self.take()
+                    self.give(b)
+                except ValueError:
+                    pass
+
+            def good_return(self):
+                b = self.take()
+                self.fill(b)
+                return b
+
+            def good_owner_adopts(self):
+                b = self.take()
+                self.blocks.append(b)
+
+            def good_attr_store(self):
+                b = self.take()
+                self._block = b
+
+            def good_with(self):
+                b = self.take()
+                with closing(b):
+                    self.fill(b)
+    """})
+    assert _codes(result) == []
+
+
+def test_dl012_alias_and_unpack_keep_tracking(tmp_path):
+    result = _scan(tmp_path, {"mod.py": _SPEC_MOD_HEADER + """
+        class M:
+            def good_alias(self):
+                b = self.take()
+                c = b
+                self.give(c)
+
+            def bad_alias(self):
+                b = self.take()
+                c = b
+                self.fill(c)
+    """})
+    assert _codes(result) == ["DL012"]
+    assert result.new[0].line > 0
+
+
+def test_dl012_malformed_spec_entry_is_itself_flagged(tmp_path):
+    result = _scan(tmp_path, {"mod.py": """
+        _DLINT_RESOURCE_SPECS = (
+            {"resource": "block", "acquire": ("take",),
+             "release": ("give",), "why": ""},
+        )
+    """})
+    assert _codes(result) == ["DL012"]
+    assert "malformed" in result.new[0].message
+
+
+def test_dl012_suppression_on_acquire_line(tmp_path):
+    result = _scan(tmp_path, {"mod.py": _SPEC_MOD_HEADER + """
+        class M:
+            def tolerated(self):
+                b = self.take()  # dlint: disable=DL012 fuzz harness leaks on purpose to test the books
+                self.fill(b)
+    """})
+    assert _codes(result) == []
+    assert [v.code for v in result.suppressed] == ["DL012"]
+
+
+# --------------------------------------------------------------- DL013
+
+
+def _dl013_config():
+    return DlintConfig(
+        protocol_module="proto.py",
+        dispatch_modules=("sender.py", "recv.py"),
+    )
+
+
+# a single-kind protocol: DL013 fixtures stay quiet under DL004's
+# exhaustiveness pass (every kind is referenced by both halves)
+_PROTO13 = """
+    class FrameKind:
+        DATA = "DATA"
+"""
+
+
+def _cat(*parts):
+    """Join fixture fragments written at DIFFERENT base indents:
+    dedent each before joining, so ``_scan``'s whole-string dedent
+    is a no-op instead of producing an unparseable module."""
+    return "\n".join(textwrap.dedent(p) for p in parts)
+
+_SENDER = """
+    from proto import FrameKind
+
+    def send_data(conn, rid):
+        conn.send(FrameKind.DATA, rid=rid, extra=1)
+"""
+
+_RECV = """
+    from proto import FrameKind
+
+    def handle(frame):
+        kind = frame.get("kind")
+        if kind == FrameKind.DATA:
+            return frame["rid"]
+"""
+
+
+def test_dl013_flags_sent_but_never_read_key(tmp_path):
+    result = _scan(tmp_path, {
+        "proto.py": _PROTO13, "sender.py": _SENDER, "recv.py": _RECV,
+    }, config=_dl013_config())
+    assert _codes(result) == ["DL013"]
+    assert "'extra'" in result.new[0].message
+    assert "DATA" in result.new[0].message
+
+
+def test_dl013_flags_subscript_read_of_never_sent_key(tmp_path):
+    result = _scan(tmp_path, {
+        "proto.py": _PROTO13,
+        "sender.py": _SENDER,
+        "recv.py": """
+            from proto import FrameKind
+
+            def handle(frame):
+                kind = frame.get("kind")
+                if kind == FrameKind.DATA:
+                    return frame["rid"], frame["extra"], frame["nope"]
+        """,
+    }, config=_dl013_config())
+    assert _codes(result) == ["DL013"]
+    assert "'nope'" in result.new[0].message
+
+
+def test_dl013_optional_declaration_with_reason_is_quiet(tmp_path):
+    result = _scan(tmp_path, {
+        "proto.py": _cat(_PROTO13, """
+            _FRAME_OPTIONAL_KEYS = {
+                (FrameKind.DATA, "extra"):
+                    "debug payload for wire sniffers",
+            }
+        """),
+        "sender.py": _SENDER,
+        "recv.py": _RECV,
+    }, config=_dl013_config())
+    assert _codes(result) == []
+
+
+def test_dl013_stale_and_empty_reason_declarations_flagged(tmp_path):
+    stale = _scan(tmp_path / "stale", {
+        "proto.py": _cat(_PROTO13, """
+            _FRAME_OPTIONAL_KEYS = {
+                (FrameKind.DATA, "rid"): "never consumed",
+            }
+        """),
+        "sender.py": _SENDER,
+        "recv.py": _RECV,
+    }, config=_dl013_config())
+    # rid IS read -> the declaration is stale; extra stays undeclared
+    assert sorted(_codes(stale)) == ["DL013", "DL013"]
+    assert any("stale" in v.message for v in stale.new)
+
+    noreason = _scan(tmp_path / "noreason", {
+        "proto.py": _cat(_PROTO13, """
+            _FRAME_OPTIONAL_KEYS = {
+                (FrameKind.DATA, "extra"): "",
+            }
+        """),
+        "sender.py": _SENDER,
+        "recv.py": _RECV,
+    }, config=_dl013_config())
+    assert any("no reason" in v.message for v in noreason.new)
+
+
+def test_dl013_splat_senders_resolved_and_open_kinds_skipped(tmp_path):
+    resolved = _scan(tmp_path / "a", {
+        "proto.py": _PROTO13,
+        "sender.py": """
+            from proto import FrameKind
+
+            def send_data(conn, rid):
+                payload = dict(rid=rid)
+                payload["extra"] = 1
+                conn.send(FrameKind.DATA, **payload)
+        """,
+        "recv.py": _RECV,
+    }, config=_dl013_config())
+    assert _codes(resolved) == ["DL013"], \
+        "a resolvable **splat contributes its literal keys"
+
+    opaque = _scan(tmp_path / "b", {
+        "proto.py": _PROTO13,
+        "sender.py": """
+            from proto import FrameKind
+
+            def send_data(conn, payload):
+                conn.send(FrameKind.DATA, **payload)
+        """,
+        "recv.py": """
+            from proto import FrameKind
+
+            def handle(frame):
+                kind = frame.get("kind")
+                if kind == FrameKind.DATA:
+                    return frame["anything"]
+        """,
+    }, config=_dl013_config())
+    assert _codes(opaque) == [], \
+        "an unresolvable splat opens the kind: no read can be proven dead"
+
+
+def test_dl013_suppression_on_send_line(tmp_path):
+    result = _scan(tmp_path, {
+        "proto.py": _PROTO13,
+        "sender.py": """
+            from proto import FrameKind
+
+            def send_data(conn, rid):
+                conn.send(FrameKind.DATA, rid=rid, extra=1)  # dlint: disable=DL013 staged rollout, reader lands next release
+        """,
+        "recv.py": _RECV,
+    }, config=_dl013_config())
+    assert _codes(result) == []
+    assert [v.code for v in result.suppressed] == ["DL013"]
+
+
+# ------------------------------------------------- SARIF / formats
+
+
+def test_sarif_round_trip_validates_and_anchors_findings(tmp_path, capsys):
+    bad = tmp_path / "pkg" / "mod.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import socket
+
+        def pick():
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+    """))
+    out = tmp_path / "dlint.sarif"
+    code = dlint_main([str(bad.parent), "--format", "sarif",
+                       "--output", str(out)])
+    assert code == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "dlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    for required in ("DL001", "DL011", "DL012", "DL013"):
+        assert required in rule_ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    assert run["results"], "the DL001 finding must appear as a result"
+    res = run["results"][0]
+    assert res["ruleId"] == "DL001"
+    assert res["ruleIndex"] == rule_ids.index("DL001")
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uriBaseId"] == "%SRCROOT%"
+    assert loc["region"]["startLine"] > 1
+
+
+def test_sarif_on_stdout_stays_machine_parseable(tmp_path, capsys):
+    clean = tmp_path / "pkg" / "mod.py"
+    clean.parent.mkdir(parents=True)
+    clean.write_text("X = 1\n")
+    code = dlint_main([str(clean.parent), "--format", "sarif"])
+    assert code == 0
+    captured = capsys.readouterr()
+    doc = json.loads(captured.out)  # summary must be on stderr
+    assert doc["runs"][0]["results"] == []
+    assert "new violation(s)" in captured.err
+
+
+def test_json_format_reports_counts_and_violations(tmp_path, capsys):
+    bad = tmp_path / "pkg" / "mod.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import socket
+
+        def pick():
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+    """))
+    code = dlint_main([str(bad.parent), "--format", "json"])
+    assert code == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["new"][0]["code"] == "DL001"
+    assert doc["new"][0]["path"].endswith("mod.py")
+    assert set(doc) == {"new", "baselined", "suppressed",
+                        "stale_baseline"}
+
+
+def test_changed_mode_filters_to_git_diff(tmp_path, monkeypatch):
+    import subprocess
+
+    repo = tmp_path / "repo"
+    (repo / "pkg").mkdir(parents=True)
+    bad_src = textwrap.dedent("""
+        import socket
+
+        def pick():
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+    """)
+    (repo / "pkg" / "committed.py").write_text(bad_src)
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "."],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=repo, check=True,
+                       env={**os.environ, **env})
+    (repo / "pkg" / "edited.py").write_text(bad_src)
+    monkeypatch.chdir(repo)
+    # full scan sees both findings; --changed reports only the
+    # uncommitted file (whole-program context still loaded)
+    full = dlint_main(["pkg"])
+    assert full == 1
+    code = dlint_main(["pkg", "--changed", "--format", "json",
+                       "--output", "out.json"])
+    assert code == 1
+    doc = json.loads((repo / "out.json").read_text())
+    paths = [v["path"] for v in doc["new"]]
+    assert paths == ["pkg/edited.py"], paths
+
+
+def test_every_checker_has_explain_and_help(capsys):
+    from tools.dlint.checkers import CHECKERS
+
+    for checker in CHECKERS:
+        assert checker.WHY.strip(), checker.CODE
+        if checker.CODE in ("DL007", "DL008", "DL009", "DL010",
+                            "DL011", "DL012", "DL013"):
+            assert getattr(checker, "EXPLAIN", "").strip(), \
+                f"{checker.CODE} needs an --explain entry"
+        assert dlint_main(["--explain", checker.CODE]) == 0
+    assert checker.CODE == "DL013", "DL013 is the last catalog entry"

@@ -6,6 +6,7 @@ hanging_detector.py:86, xpu_timer Prometheus export)."""
 import json
 import os
 import sys
+import threading
 import time
 import urllib.request
 
@@ -214,6 +215,30 @@ def test_step_timer_stats():
     m = t.metrics()
     assert m["dlrover_step_count"] == 3.0
     assert m["dlrover_step_seconds_total"] == pytest.approx(0.6)
+
+
+def test_step_timer_metrics_snapshot_is_consistent_under_scrape():
+    """metrics() takes ONE locked snapshot (the DL011 fix): a scrape
+    racing observe() must never pair count from one step with total
+    from the next.  With a constant 0.5s sample (exact in binary
+    float), any torn snapshot breaks count * 0.5 == total."""
+    t = StepTimer(reservoir=16)
+    stop = threading.Event()
+
+    def _observe():
+        while not stop.is_set():
+            t.observe(0.5)
+
+    w = threading.Thread(target=_observe, daemon=True)
+    w.start()
+    try:
+        for _ in range(300):
+            m = t.metrics()
+            assert m["dlrover_step_count"] * 0.5 == \
+                m["dlrover_step_seconds_total"]
+    finally:
+        stop.set()
+        w.join(timeout=5)
 
 
 def test_metrics_exporter_serves_prometheus():

@@ -262,7 +262,104 @@ def test_prefix_metric_names_registered_dl006():
         if name.startswith("serving_prefix"):
             assert name in METRIC_HELP, name
     assert sum(1 for n in METRIC_HELP if n.startswith("serving_prefix")
-               ) >= 14
+               ) >= 17
+
+
+# every EVENT counter of the ledger (gauges like cached/lru_blocks are
+# derived lengths and excluded); shared_tokens rides note_hit and is
+# asserted separately
+_PREFIX_EVENTS = (
+    "prefix_hits", "prefix_misses", "prefix_evictions", "prefix_cow",
+    "prefix_revivals", "prefix_lingers", "prefix_forgotten",
+    "prefix_evicted_head_drops",
+)
+
+
+def _event_deltas(idx, mutate):
+    before = idx.stats()
+    mutate()
+    after = idx.stats()
+    return {k: after[k] - before[k] for k in _PREFIX_EVENTS
+            if after[k] != before[k]}
+
+
+def test_index_every_mutation_moves_its_counter():
+    """Metrics-parity audit: each mutation path of the index moves
+    exactly the event counters designated for it — a silent path
+    (the old counterless linger) cannot come back unnoticed."""
+    idx = PrefixBlockIndex()
+    key = chain_key(b"", b"tok")
+    assert _event_deltas(
+        idx, lambda: idx.register(key, 1, b"tok", head=True)) == {}, \
+        "register is gauge-only (cached_blocks is a derived length)"
+    assert _event_deltas(idx, lambda: idx.note_hit(1, 4)) == {
+        "prefix_hits": 1.0}
+    assert idx.stats()["prefix_shared_tokens"] == 4.0
+    assert _event_deltas(idx, idx.note_miss) == {"prefix_misses": 1.0}
+    assert _event_deltas(idx, idx.note_cow) == {"prefix_cow": 1.0}
+    assert _event_deltas(idx, lambda: idx.linger(1)) == {
+        "prefix_lingers": 1.0}
+    assert _event_deltas(idx, lambda: idx.linger(1)) == {}, \
+        "a re-linger refreshes recency, it is not a second park event"
+    assert _event_deltas(idx, lambda: idx.revive(1)) == {
+        "prefix_revivals": 1.0}
+    assert _event_deltas(idx, lambda: idx.revive(1)) == {}, \
+        "reviving a non-lingering block is a no-op"
+    assert _event_deltas(idx, lambda: idx.forget(1)) == {
+        "prefix_forgotten": 1.0}
+    assert _event_deltas(idx, lambda: idx.forget(1)) == {}, \
+        "forgetting an unregistered block moves nothing"
+    idx.register(key, 2, b"tok", head=True)
+    idx.linger(2)
+    assert _event_deltas(idx, idx.evict_one) == {
+        "prefix_evictions": 1.0}, \
+        "eviction must NOT double-count through forget()"
+
+
+def test_index_staging_cap_overflow_is_counted():
+    idx = PrefixBlockIndex()
+    for bid in range(idx.MAX_EVICTED_HEADS + 2):
+        idx.register(chain_key(b"", b"%d" % bid), bid,
+                     b"%d" % bid, head=True)
+        idx.linger(bid)
+    for _ in range(idx.MAX_EVICTED_HEADS):
+        idx.evict_one()
+    # stage is full: the next evictions lose their head invalidation
+    # and must say so
+    deltas = _event_deltas(
+        idx, lambda: (idx.evict_one(), idx.evict_one()))
+    assert deltas == {"prefix_evictions": 2.0,
+                      "prefix_evicted_head_drops": 2.0}
+    assert len(idx.drain_evicted_heads()) == idx.MAX_EVICTED_HEADS
+
+
+def test_index_event_counters_reach_router_metrics():
+    """Every ledger key must survive the observe sweep into a
+    registered ``serving_prefix_*`` name — a counter added to the
+    index but not plumbed through RouterMetrics would silently
+    flatline at 0 fleet-wide."""
+    idx = PrefixBlockIndex()
+    key = chain_key(b"", b"tok")
+    idx.register(key, 1, b"tok", head=True)
+    idx.note_hit(1, 4)
+    idx.note_miss()
+    idx.note_cow()
+    idx.linger(1)
+    idx.revive(1)
+    idx.forget(1)
+    stats = idx.stats()
+    for k in _PREFIX_EVENTS:
+        assert k in stats, k
+    m = RouterMetrics(window_seconds=1.0)
+    m.observe_engine_metrics([stats])
+    out = m.metrics()
+    for k in _PREFIX_EVENTS:
+        if stats[k] == 0.0:
+            continue
+        matches = [n for n in out
+                   if n.startswith("serving_") and k in n
+                   and out[n] == stats[k]]
+        assert matches, f"{k} did not reach a serving_prefix_* metric"
 
 
 # -------------------------------------------- router fast chaos twin

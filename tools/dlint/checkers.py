@@ -10,11 +10,14 @@ from dlrover_tpu.dlint.checkers import (  # noqa: F401
     Checker,
     DlintConfig,
     FrameExhaustiveChecker,
+    FrameSchemaChecker,
     LockBlockingChecker,
     LockOrderingChecker,
+    LocksetRaceChecker,
     MetricLabelCardinalityChecker,
     MetricRegistryChecker,
     Project,
+    ResourceLifetimeChecker,
     StateTransitionChecker,
     SwallowedExceptionChecker,
     ThreadHygieneChecker,
