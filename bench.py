@@ -452,6 +452,10 @@ def _bench_gateway() -> dict:
             exporter.add_metrics_source(router.metrics.metrics)
             exporter.add_labeled_source(
                 lambda: slo.otlp_metrics(_time.monotonic()))
+            # per-tenant-class usage counters ride the same push, so
+            # the collector's /fleet/metrics shows the QoS books the
+            # fleet actually runs with (ISSUE 19 satellite)
+            exporter.add_labeled_source(router.metrics.otlp_labeled)
             exporter.add_histogram_source(
                 lambda: [router.metrics.ttft_hist,
                          router.metrics.queue_wait_hist])
@@ -506,6 +510,93 @@ def _bench_gateway() -> dict:
         rig["gateway_admission_p99_us"]
     out["gateway_bursty_shed"] = rig["gateway_shed"]
     return out
+
+
+def _bench_profile() -> dict:
+    """Continuous-profiler overhead gate (ISSUE 19): the gateway rig
+    replayed profiler-OFF and profiler-ON (always-on ~19 Hz sampler
+    attached to the router, phase marks live) in ALTERNATING pairs,
+    best-of-3 per arm — alternation matters: machine-level drift
+    (CPU frequency, background load) between invocations is larger
+    than the 3% being measured, so both arms must sample the same
+    conditions.  The gate of record: admission p99 degrades ≤3% with
+    the profiler on (plus a 2µs absolute floor so a 30µs→31µs
+    scheduler wobble cannot fail a gate about profiler cost), and the
+    sampler must actually have sampled."""
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        BrownoutPolicy,
+        ContinuousBatchScheduler,
+        RequestGateway,
+        RouterMetrics,
+        ServingRouter,
+        SloEngine,
+    )
+    from dlrover_tpu.serving.router.loadgen import (
+        LoadgenConfig,
+        run_gateway_rig,
+    )
+    from dlrover_tpu.utils.contprof import ContinuousProfiler
+
+    def _run(with_prof: bool):
+        # same stack as the gateway rig, telemetry OFF both arms so
+        # the measured delta is the profiler's and nothing else's
+        router = ServingRouter(
+            gateway=RequestGateway(
+                max_pending=4096, default_timeout=3.0,
+                trace_sample_rate=0.01),
+            scheduler=ContinuousBatchScheduler(block_size=4),
+            metrics=RouterMetrics(window_seconds=1.0),
+            brownout=BrownoutPolicy(enter_pressure=4.0,
+                                    exit_pressure=1.0,
+                                    dwell_seconds=0.2),
+            slo=SloEngine(fast_window_s=5.0, slow_window_s=60.0),
+        )
+        for i in range(4):
+            router.join_replica(
+                f"prof-replica-{i}",
+                FakeEngine(slots=16, tokens_per_step=8,
+                           blocks=100_000))
+        prof = None
+        if with_prof:
+            prof = ContinuousProfiler(role="router", seed=3)
+            router.attach_profiler(prof)
+            prof.start()
+        try:
+            rig = run_gateway_rig(
+                router,
+                LoadgenConfig(rate_qps=15000, duration_s=2.0, seed=7))
+        finally:
+            if prof is not None:
+                prof.stop()
+        snap = prof.snapshot() if prof is not None else {}
+        return rig, snap
+
+    off_runs, on_runs = [], []
+    for _ in range(3):
+        off_runs.append(_run(False))
+        on_runs.append(_run(True))
+    off_p99 = min(r["gateway_admission_p99_us"] for r, _ in off_runs)
+    on_p99 = min(r["gateway_admission_p99_us"] for r, _ in on_runs)
+    samples = max(int(s.get("samples_total", 0)) for _, s in on_runs)
+    phases = max((len(s.get("phases") or {}) for _, s in on_runs),
+                 default=0)
+    overhead_pct = (100.0 * (on_p99 - off_p99) / off_p99
+                    if off_p99 > 0 else 0.0)
+    return {
+        "profile_off_admission_p99_us": off_p99,
+        "profile_on_admission_p99_us": on_p99,
+        "profile_off_qps": min(
+            r["gateway_qps"] for r, _ in off_runs),
+        "profile_on_qps": min(
+            r["gateway_qps"] for r, _ in on_runs),
+        "profile_samples": samples,
+        "profile_phases_attributed": phases,
+        "profile_overhead_pct": round(overhead_pct, 2),
+        "profile_overhead_bar_pct": 3.0,
+        "profile_overhead_ok": bool(
+            samples > 0 and on_p99 <= off_p99 * 1.03 + 2.0),
+    }
 
 
 def _bench_router() -> dict:
@@ -1222,6 +1313,7 @@ _CONFIG_FNS = {
     "router": _bench_router,
     "tenancy": _bench_tenancy,
     "prefix": _bench_prefix,
+    "profile": _bench_profile,
 }
 
 
@@ -1284,7 +1376,7 @@ def main() -> None:
 
     on_tpu = _probe_tpu()
     configs = ["primary", "ckpt", "fleet", "gateway", "router",
-               "tenancy", "prefix"]
+               "tenancy", "prefix", "profile"]
     if on_tpu:
         configs += ["realistic", "longctx"]
     # a result far below the config's long-recorded band is transient
@@ -1454,6 +1546,19 @@ def main() -> None:
             f"{result.get('ckpt_pause_abs_bar_s')}s (ratio "
             f"{result.get('ckpt_pause_memcpy_ratio')} vs bar "
             f"{result.get('ckpt_pause_ratio_bar')}); see PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("profile_overhead_ok") is False:
+        regressions.append("profile_overhead")
+        print(
+            "BENCH REGRESSION: profile_overhead_ok=false — gateway "
+            "admission p99 with the continuous profiler ON "
+            f"({result.get('profile_on_admission_p99_us')}µs) degraded "
+            f"{result.get('profile_overhead_pct')}% vs OFF "
+            f"({result.get('profile_off_admission_p99_us')}µs), bar "
+            f"{result.get('profile_overhead_bar_pct')}% (or the "
+            f"sampler took {result.get('profile_samples')} samples — "
+            "0 means it never ran); see PERF.md",
             file=sys.stderr,
         )
     result["bench_regressions"] = len(regressions)

@@ -435,6 +435,7 @@ class ElasticAgent:
         self._hang_detector = None
         self.metrics_exporter = None
         self.otlp_exporter = None
+        self.profiler = None  # contprof sampler, start_metrics_exporter
 
     def start_metrics_exporter(self, port: int = 0) -> int:
         """Serve the agent's self-healing counters over HTTP — the
@@ -463,6 +464,17 @@ class ElasticAgent:
             return saver.metrics()
 
         exporter.add_source(_saver_metrics)
+        # always-on sampling profiler (role "agent"): live flame at
+        # /debug/prof(+/collapsed); flight-recorder dumps (rendezvous
+        # rejoins, master outages, worker restarts) freeze a snapshot
+        # ref so an incident's CPU state survives the live tables
+        from dlrover_tpu.utils.contprof import ContinuousProfiler
+
+        prof = ContinuousProfiler(role="agent")
+        prof.start()
+        self.profiler = prof
+        exporter.attach_profiler(prof)
+        self.recorder.attach_profiler(prof)
         exporter.start()
         self.metrics_exporter = exporter
         # OTLP push into the fleet collector when one is announced
@@ -476,6 +488,7 @@ class ElasticAgent:
                       "node.rank": str(self._node_rank)})
         otlp.add_metrics_source(self.metrics)
         otlp.add_metrics_source(_saver_metrics)
+        otlp.add_profile_source(lambda: [prof.snapshot(top=64)])
         otlp.start()
         self.otlp_exporter = otlp
         exporter.add_source(otlp.metrics)
@@ -497,6 +510,10 @@ class ElasticAgent:
         if otlp is not None:
             otlp.stop()
             self.otlp_exporter = None
+        prof = getattr(self, "profiler", None)
+        if prof is not None:
+            prof.stop()
+            self.profiler = None
 
     def _count(self, name: str, n: float = 1.0) -> None:
         with self._metrics_lock:

@@ -174,6 +174,7 @@ class DistributedJobMaster:
         self.exit_reason: str = ""
         self.metrics_exporter = None  # start_metrics_exporter
         self.otlp_exporter = None
+        self.profiler = None  # contprof sampler, start_metrics_exporter
         # BO-driven runtime tuning loop: propose a ParallelConfig, let the
         # agents' ParalConfigTuner ship it to trainers, observe the speed
         # it achieves, repeat (reference: the Brain-driven auto_tunning
@@ -269,16 +270,50 @@ class DistributedJobMaster:
                 len(rdzv.current_world_ranks())),
         }
 
+    def step_skew_text(self) -> str:
+        """``dlrover_master_step_skew_seconds{rank=...}`` — per-rank
+        deviation from the fleet-median step time, from the
+        ``elapsed_time_per_step`` every GlobalStep report carries.
+        Empty until ranks report timed steps; rank labels are bounded
+        by world size (SpeedMonitor prunes departed workers)."""
+        from dlrover_tpu.utils.metric_registry import metric_help
+
+        skew = self.speed_monitor.step_skew()
+        if not skew:
+            return ""
+        name = "dlrover_master_step_skew_seconds"
+        lines = [f"# HELP {name} " + (metric_help(name) or ""),
+                 f"# TYPE {name} gauge"]
+        for rank, dev in skew.items():
+            lines.append(f'{name}{{rank="{rank}"}} {dev:.6g}')
+        return "\n".join(lines) + "\n"
+
+    def _step_skew_labeled(self) -> list:
+        """The same family for the OTLP push path (labeled-gauge
+        tuples), so ``/fleet/metrics`` shows straggler skew next to
+        the goodput ledger."""
+        return [("dlrover_master_step_skew_seconds",
+                 {"rank": str(rank)}, float(dev))
+                for rank, dev in self.speed_monitor.step_skew().items()]
+
     def start_metrics_exporter(self, port: int = 0) -> int:
         """Serve ``/metrics`` from the master process (port 0 = kernel-
         assigned, announced on stdout as
         ``DLROVER_MASTER_METRICS_PORT=<port>`` — the same race-free
         idiom as the agent exporter).  Returns the bound port."""
         from dlrover_tpu.common.constants import NodeEnv
+        from dlrover_tpu.utils.contprof import ContinuousProfiler
         from dlrover_tpu.utils.profiler import MetricsExporter
 
         exporter = MetricsExporter(port=port)
         exporter.add_source(self.master_metrics)
+        exporter.add_text_source(self.step_skew_text)
+        # always-on sampling profiler (role "master"): live flame at
+        # /debug/prof(+/collapsed), merged fleet-wide by the collector
+        prof = ContinuousProfiler(role="master")
+        prof.start()
+        self.profiler = prof
+        exporter.attach_profiler(prof)
         exporter.start()
         self.metrics_exporter = exporter
         # push the same ledger into the fleet collector when one is
@@ -288,6 +323,8 @@ class DistributedJobMaster:
         otlp = OtlpExporter.from_env(
             resource={"service.name": "master"})
         otlp.add_metrics_source(self.master_metrics)
+        otlp.add_labeled_source(self._step_skew_labeled)
+        otlp.add_profile_source(lambda: [prof.snapshot(top=64)])
         otlp.start()
         self.otlp_exporter = otlp
         exporter.add_source(otlp.metrics)
@@ -304,6 +341,9 @@ class DistributedJobMaster:
         if self.otlp_exporter is not None:
             self.otlp_exporter.stop()
             self.otlp_exporter = None
+        if getattr(self, "profiler", None) is not None:
+            self.profiler.stop()
+            self.profiler = None
 
     def run(self, poll_interval: float = 5.0) -> int:
         """Main loop (reference: dist_master.py:211-269): exit on job

@@ -45,6 +45,10 @@ class SpeedMonitor:
     def remove_running_worker(self, node_type: str, worker_id: int) -> None:
         with self._lock:
             self._workers.discard((node_type, worker_id))
+            # drop its step-time sample too: skew is a view over LIVE
+            # ranks, and a departed straggler must not keep skewing
+            # the median it is no longer part of
+            self._worker_step_times.pop(worker_id, None)
 
     @property
     def running_workers(self) -> Set[Tuple[str, int]]:
@@ -70,6 +74,37 @@ class SpeedMonitor:
             self._global_step_records.append(
                 GlobalStepRecord(global_step, timestamp, len(self._workers))
             )
+
+    def sample_worker_step(self, worker_id: int, elapsed: float) -> None:
+        """Record one rank's latest per-step wall time (the
+        ``elapsed_time_per_step`` field every GlobalStep report already
+        carries).  Non-positive samples are ignored — ranks that report
+        steps without timing them must not read as infinitely fast."""
+        try:
+            worker_id = int(worker_id)
+            elapsed = float(elapsed)
+        except (TypeError, ValueError):
+            return
+        if elapsed <= 0:
+            return
+        with self._lock:
+            self._worker_step_times[worker_id] = elapsed
+
+    def step_skew(self) -> Dict[int, float]:
+        """Per-rank deviation from the fleet-median step time (seconds;
+        positive = slower than peers) — the straggler evidence behind
+        the check_straggler RPC, as a scrapeable labeled gauge family.
+        Bounded by world size: entries are pruned when their worker is
+        removed, so rank labels can never grow without limit (DL010)."""
+        with self._lock:
+            times = dict(self._worker_step_times)
+        if not times:
+            return {}
+        ordered = sorted(times.values())
+        mid = len(ordered) // 2
+        median = (ordered[mid] if len(ordered) % 2
+                  else (ordered[mid - 1] + ordered[mid]) / 2.0)
+        return {rank: t - median for rank, t in sorted(times.items())}
 
     def running_speed(self) -> float:
         """steps/sec over the recent sample window."""

@@ -303,6 +303,13 @@ class MasterServicer:
             self._task_manager.speed_monitor.sample_global_step(
                 message.step, ts
             )
+            # per-rank step-time skew feed: the envelope names the
+            # reporting rank (req.node_id) and the message already
+            # carries its per-step wall time — together they are the
+            # dlrover_master_step_skew_seconds{rank=...} gauge family
+            self._task_manager.speed_monitor.sample_worker_step(
+                req.node_id, message.elapsed_time_per_step
+            )
             if self._job_metric_collector is not None:
                 self._job_metric_collector.report_global_step(
                     message.step, ts

@@ -133,6 +133,7 @@ class RemoteReplicaHandle:
         self.stale_stats_dropped = 0
         self._engine_metrics: Optional[Dict[str, float]] = None
         self._prefix_heads: List[str] = []
+        self._profile: Optional[dict] = None
         self._last_frame = time.monotonic()
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
@@ -250,6 +251,12 @@ class RemoteReplicaHandle:
                         str(k): float(v) for k, v in em.items()
                         if isinstance(v, (int, float))
                     }
+                prof = frame.get("profile")
+                if isinstance(prof, dict):
+                    # continuous-profiler tables from a --profile
+                    # worker: cumulative, so latest-wins replacement
+                    # is the whole merge; absent on unprofiled workers
+                    self._profile = prof
                 heads = frame.get("prefix_heads")
                 if isinstance(heads, list):
                     # hottest committed prefix heads (hex digests):
@@ -439,6 +446,16 @@ class RemoteReplicaHandle:
             if self._dead is not None:
                 return []
             return list(self._prefix_heads)
+
+    def profile_snapshot(self) -> Optional[dict]:
+        """Latest continuous-profiler snapshot the worker shipped over
+        STATS (None while none arrived — unprofiled worker — or once
+        the replica is dead: a corpse's flame must not keep merging
+        into the fleet view as if it were live)."""
+        with self._lock:
+            if self._dead is not None:
+                return None
+            return self._profile
 
     def blocks_needed(self, prompt_len: int,
                       max_new_tokens: int) -> Optional[float]:

@@ -151,8 +151,15 @@ class WorkerServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  stats_interval: float = ServingFabric.STATS_INTERVAL,
                  engine_kind: str = "fake", fault_schedule=None,
-                 trace_sample_rate: float = 1.0):
+                 trace_sample_rate: float = 1.0, profiler=None,
+                 profile_ship_interval: float = 2.0):
         self.engine = engine
+        # contprof.ContinuousProfiler (role "worker"): its folded-stack
+        # table rides STATS as an additive "profile" key, throttled to
+        # profile_ship_interval so liveness-cadence STATS stay small
+        self.profiler = profiler
+        self.profile_ship_interval = float(profile_ship_interval)
+        self._last_profile_ship = 0.0
         self.stats_interval = float(stats_interval)
         self.engine_kind = engine_kind
         # head-sampling agreement with the router: a received context
@@ -499,6 +506,19 @@ class WorkerServer:
                 payload["prefix_heads"] = [
                     str(h) for h in heads()
                 ]
+            # continuous-profiler tables ride STATS as their own
+            # additive key, throttled well below the liveness cadence
+            # (tables are cumulative, so a skipped ship loses nothing);
+            # the trimmed top-N snapshot keeps the frame small.  The
+            # throttle check is benign under the heartbeat/serve-loop
+            # race: the worst interleaving ships one extra snapshot
+            prof = self.profiler
+            if prof is not None:
+                now = time.monotonic()
+                if now - self._last_profile_ship >= \
+                        self.profile_ship_interval:
+                    self._last_profile_ship = now
+                    payload["profile"] = prof.snapshot(top=32)
         # seq is assigned at SEND time (never stored in the cached
         # payload): a cached liveness resend carries stale numbers
         # under a fresh ordinal, same last-send-wins semantics as
@@ -597,6 +617,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "honored); the verdict is deterministic per "
                         "trace_id, so both sides agree without "
                         "coordination")
+    p.add_argument("--profile", action="store_true",
+                   help="run the always-on sampling profiler "
+                        "(utils/contprof): folded-stack tables ride "
+                        "STATS frames to the router, which forwards "
+                        "them into the fleet /fleet/profile merge")
+    p.add_argument("--profile-hz", type=float, default=19.0,
+                   help="profiler sampling rate (seeded-jittered; the "
+                        "default 19 Hz avoids phase-locking periodic "
+                        "work)")
     p.add_argument("--crash-after", type=float, default=0.0,
                    help="chaos: hard-exit (rc 9) this many seconds "
                         "after startup — the crash-loop worker the "
@@ -614,11 +643,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     from dlrover_tpu.serving.remote.faults import FaultSchedule
 
+    profiler = None
+    if args.profile:
+        from dlrover_tpu.utils.contprof import ContinuousProfiler
+
+        profiler = ContinuousProfiler(
+            role="worker", hz=args.profile_hz, seed=args.seed)
+        profiler.start()
     server = WorkerServer(
         engine, host=args.host, port=args.port,
         stats_interval=args.stats_interval, engine_kind=args.engine,
         fault_schedule=FaultSchedule.from_env(),
         trace_sample_rate=args.trace_sample_rate,
+        profiler=profiler,
     )
     if args.crash_after > 0:
         # a real abrupt death (no GOODBYE, no atexit, nonzero rc): the

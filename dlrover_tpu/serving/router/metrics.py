@@ -399,6 +399,27 @@ class RouterMetrics:
             parts.append(text)
         return "".join(parts)
 
+    def otlp_labeled(self) -> list:
+        """Labeled gauges for the OTLP push path
+        (``OtlpExporter.add_labeled_source``): the per-tenant-class
+        usage counters, so the fleet collector's ``/fleet/metrics``
+        sees the QoS books and not just the local ``/tenants/usage``
+        JSON.  Same closed TENANT_CLASSES vocabulary (zero-filled) as
+        the /metrics render — raw tenant ids never leave the gateway."""
+        from dlrover_tpu.serving.tenancy import TENANT_CLASSES
+
+        out = []
+        for name, book in (
+            ("serving_tenant_queue_depth", self.tenant_queue_depth),
+            ("serving_tenant_shed_total", self.tenant_shed),
+            ("serving_tenant_quota_rejected_total",
+             self.tenant_quota_rejected),
+        ):
+            for cls in TENANT_CLASSES:
+                out.append((name, {"tenant_class": cls},
+                            float(book.get(cls, 0.0))))
+        return out
+
     def render_labeled(self) -> str:
         """Labeled gauge text for the /metrics scrape: replicas per
         resolved paged-attention impl.  The ``impl`` vocabulary is

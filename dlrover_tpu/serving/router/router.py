@@ -80,6 +80,12 @@ def _tid(req: ServingRequest) -> Optional[str]:
     return None if req.trace is None else req.trace.trace_id
 
 
+def _noop_phase(_phase) -> None:
+    """Stand-in for ContinuousProfiler.set_phase when no profiler is
+    attached — keeps the step loop's phase marks unconditional."""
+    return None
+
+
 @dataclasses.dataclass
 class DrainedReplica:
     """Lightweight record of a retired replica (the handle — and its
@@ -169,6 +175,11 @@ class ServingRouter:
         # surface
         self.tracer = self.gateway.tracer
         self.recorder = self.tracer.recorder
+        # contprof.ContinuousProfiler via attach_profiler: the step
+        # loop marks phases on it (self-time attribution next to the
+        # wall-clock phase histograms) and flight dumps freeze a
+        # snapshot ref.  None (default) costs one noop call per phase
+        self.profiler = None
         # drained-replica records awaiting pickup (the autoscaler
         # finishes node removal); bounded so unclaimed records from
         # manual drains can never accumulate without limit
@@ -189,6 +200,43 @@ class ServingRouter:
         self._tenant_reload_pending = False
         if tenant_spec_file is not None:
             self.reload_tenants()
+
+    # ------------------------------------------------------- profiling
+    def attach_profiler(self, prof) -> None:
+        """Wire a :class:`~dlrover_tpu.utils.contprof.ContinuousProfiler`
+        (role "router"): the step loop marks its phases on it so
+        samples landing mid-step attribute to a phase (self-time — the
+        wall-clock phase histograms cannot split running from
+        waiting), and every flight-recorder dump freezes a snapshot
+        ref (``profile_ref``) at incident time."""
+        self.profiler = prof
+        self.recorder.attach_profiler(prof)
+
+    def profile_snapshots(self, top: int = 64) -> List[dict]:
+        """Profiler snapshots this router can speak for: its own plus
+        the latest tables its REMOTE replicas shipped over STATS (role
+        "worker", tagged with the replica name as ``source``) — the
+        list an OTLP ``add_profile_source`` pushes so ``/fleet/profile``
+        merges ≥2 process roles through one exporter."""
+        snaps: List[dict] = []
+        prof = self.profiler
+        if prof is not None:
+            snaps.append(prof.snapshot(top=top))
+        with self._lock:
+            handles = list(self.manager.replicas.items())
+        for name, handle in handles:
+            fn = getattr(handle.engine, "profile_snapshot", None)
+            if fn is None:
+                continue
+            try:
+                snap = fn()
+            except Exception:
+                continue
+            if isinstance(snap, dict):
+                snap = dict(snap)
+                snap.setdefault("source", name)
+                snaps.append(snap)
+        return snaps
 
     # ------------------------------------------------------ membership
     def join_replica(self, name: str, engine, node=None,
@@ -290,6 +338,11 @@ class ServingRouter:
         now = time.monotonic() if now is None else now
         perf = time.perf_counter
         phase = self.metrics.observe_step_phase
+        # per-phase SELF-time attribution: mark the phase on the
+        # profiler so its samples landing on this thread mid-step know
+        # which phase they hit (noop call per phase when unattached)
+        prof = self.profiler
+        mark = prof.set_phase if prof is not None else _noop_phase
         # live tenant-spec reload, OUTSIDE the step lock (file I/O):
         # requested by SIGHUP or an admin endpoint, applied here so the
         # new contracts are in force for this round's admissions
@@ -312,6 +365,7 @@ class ServingRouter:
         cancels: List[tuple] = []
         with self._lock:
             t_lock = t_prev = perf()
+            mark("expire")
             # 1. deadline expiry (event engine: heap-pop only DUE
             # entries; sweep engine: scan every queued request)
             for req in self.gateway.expire(now, dump=False):
@@ -328,6 +382,7 @@ class ServingRouter:
             t = perf()
             phase("expire", t - t_prev)
             t_prev = t
+            mark("cancel")
 
             # 1b. cancellation sweep: queued client withdrawals leave
             # the queue here; in-flight withdrawals — and, under the
@@ -344,6 +399,7 @@ class ServingRouter:
             t = perf()
             phase("cancel", t - t_prev)
             t_prev = t
+            mark("brownout")
 
             # 1c. brown-out watermark + per-priority shedding: DECIDE
             # the stage under the step lock (pure arithmetic over the
@@ -357,12 +413,14 @@ class ServingRouter:
             t = perf()
             phase("brownout", t - t_prev)
             t_prev = t
+            mark("failover")
 
             # 2. failover: reap dead replicas, requeue their in-flight
             self._reap(now, dumps=dumps)
             t = perf()
             phase("failover", t - t_prev)
             t_prev = t
+            mark("schedule")
 
             # 3a. placement DECISIONS (micro-batch per replica per
             # round); schedulable(now) keeps probation replicas
@@ -399,6 +457,7 @@ class ServingRouter:
         # handle/request state is safe to touch here; concurrent
         # join/fail/drain calls only mutate OTHER entries.
         t_prev = perf()
+        mark("deliver")
         for handle, req in placements:
             try:
                 handle.submit(req)
@@ -462,6 +521,7 @@ class ServingRouter:
         phase("deliver", perf() - t_prev)
         with self._lock:
             t_lock = t_prev = perf()
+            mark("pump")
             # 4. pump engines
             completed: List[ServingRequest] = []
             for handle in self.manager.pumpable():
@@ -509,6 +569,7 @@ class ServingRouter:
             t = perf()
             phase("pump", t - t_prev)
             t_prev = t
+            mark("retire")
 
             # 5. retire drained replicas (graceful scale-down, phase 2)
             for handle in list(self.manager.replicas.values()):
@@ -532,6 +593,7 @@ class ServingRouter:
             t = perf()
             phase("retire", t - t_prev)
             t_prev = t
+            mark("observe")
 
             # 6. gauges + autoscale
             inflight = sum(
@@ -607,11 +669,13 @@ class ServingRouter:
         # surfaces it reads (metrics, manager counts, gateway depth)
         # are each internally consistent.
         t_prev = perf()
+        mark("autoscale")
         if self.autoscaler is not None:
             self.autoscaler.on_step(now)
         t = perf()
         phase("autoscale", t - t_prev)
         t_prev = t
+        mark("flush")
         # deliver the round's CANCELs now that the lock is gone: remote
         # deliveries are frame sends (bounded by the connection's
         # send_timeout, but still I/O); local ones are slot/KV-block
@@ -638,6 +702,7 @@ class ServingRouter:
                 "step (first %d emitted)", n, reason,
                 self.MAX_DUMPS_PER_STEP)
         phase("flush", perf() - t_prev)
+        mark(None)
         return completed
 
     # ------------------------------------------- in-flight sweeps (1b)

@@ -1,0 +1,420 @@
+"""Continuous fleet profiling: a stdlib-only always-on sampling profiler.
+
+The observatory (tracing, OTLP, SLO) says *what* happened; this module
+says *where the CPU time went* without an operator attaching anything.
+A daemon thread walks ``sys._current_frames()`` at a low rate (default
+~19 Hz — a prime-ish cadence with seeded jitter so sampling never
+phase-locks onto periodic work like stats ticks or scrape loops) and
+aggregates samples into bounded folded-stack tables per (process role,
+thread).  The tables are cheap enough to leave on for the life of the
+process, which is the point: a goodput dip or a p99 cliff is explained
+from samples that were already being taken when it happened.
+
+Three consumers share one sample stream:
+
+- ``snapshot()`` / ``collapsed()`` — JSON tables and flamegraph.pl
+  collapsed-stack text (``role;thread;mod.fn;... count``) served at
+  ``/debug/prof`` + ``/debug/prof/collapsed`` and merged fleet-wide by
+  the telemetry collector at ``/fleet/profile``.
+- ``capture_ref()`` — the incident path: a FlightRecorder dump stamps
+  a snapshot ref at dump time so the flame state *at the incident* is
+  preserved even after the live tables move on.
+- ``set_phase()`` — per-thread phase markers: the router step loop
+  marks which phase its thread is in, and samples landing on that
+  thread are attributed to the phase — per-phase *self time* next to
+  the step-phase wall-clock histograms.
+
+Wall vs wait split: a sample whose leaf frame is a known blocking
+primitive (``wait``/``select``/``recv``/...), or whose leaf frame sat
+at the *same bytecode offset* as the previous tick (parked inside a C
+call like ``time.sleep`` or ``lock.acquire``, invisible to the name
+heuristic), is off-CPU; everything else is (GIL-holding) run time.  GIL pressure itself is estimated from
+the sampler's own tick lag — the sampler thread is a scheduling probe:
+when ticks consistently land late, runnable threads are starved.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import sys
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+__all__ = ["ContinuousProfiler", "merge_folded", "DEFAULT_HZ"]
+
+DEFAULT_HZ = 19.0
+
+# Leaf co_names that mean "this thread is parked off-CPU", not burning
+# cycles: the classifier is a heuristic over stdlib blocking primitives
+# (threading/queue/socket/select/ssl/subprocess/time), good enough to
+# split flame totals into run vs wait without tracing syscalls.
+_WAIT_LEAF_NAMES = frozenset({
+    "wait", "wait_for", "sleep", "select", "poll", "epoll", "accept",
+    "acquire", "join", "recv", "recv_into", "recvfrom", "read",
+    "readinto", "readline", "getaddrinfo", "connect", "settimeout",
+    "serve_forever", "get", "dequeue", "park",
+})
+# Modules whose frames anywhere on the stack usually mean a blocking
+# wrapper (e.g. queue.Queue.get sitting in threading.Condition.wait);
+# only consulted for the LEAF frame's module.
+_WAIT_LEAF_MODULES = frozenset({
+    "select", "selectors", "socket", "ssl", "subprocess", "signal",
+})
+
+
+def _frame_label(frame) -> str:
+    """``module.func`` for one frame, degrading to the filename stem
+    when the module has no ``__name__`` (exec'd code, frozen frames)."""
+    code = frame.f_code
+    mod = frame.f_globals.get("__name__")
+    if not mod:
+        fn = code.co_filename
+        mod = fn.rsplit("/", 1)[-1].rsplit(".", 1)[0] if fn else "?"
+    return f"{mod}.{code.co_name}"
+
+
+def _is_wait_leaf(frame) -> bool:
+    if frame.f_code.co_name in _WAIT_LEAF_NAMES:
+        return True
+    mod = frame.f_globals.get("__name__") or ""
+    return mod.split(".", 1)[0] in _WAIT_LEAF_MODULES
+
+
+def merge_folded(snapshots: List[dict]) -> Dict[str, int]:
+    """Merge the ``stacks`` tables of many snapshots into one folded
+    table keyed ``role;thread;frames...`` — the fleet-view primitive
+    used by the telemetry collector's ``/fleet/profile``."""
+    merged: Dict[str, int] = {}
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        role = str(snap.get("role") or "process")
+        stacks = snap.get("stacks")
+        if not isinstance(stacks, dict):
+            continue
+        for folded, count in stacks.items():
+            try:
+                n = int(count)
+            except (TypeError, ValueError):
+                continue
+            key = f"{role};{folded}"
+            merged[key] = merged.get(key, 0) + n
+    return merged
+
+
+class ContinuousProfiler:
+    """Always-on ``sys._current_frames()`` sampler with bounded tables.
+
+    Deterministic by construction: the sampling *pass* is :meth:`tick`,
+    which tests drive directly with an injected ``frames_fn``/``clock``
+    — the daemon thread (:meth:`start`) is only a pacing loop around
+    it.  All aggregation state lives behind one lock; ``set_phase`` is
+    a plain per-thread dict write (atomic under the GIL) so marking a
+    phase costs nothing measurable on the router hot path.
+    """
+
+    def __init__(self, role: str = "process", hz: float = DEFAULT_HZ,
+                 max_depth: int = 24, max_stacks: int = 512,
+                 max_refs: int = 32, seed: int = 0,
+                 frames_fn: Optional[Callable[[], dict]] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.role = str(role)
+        self.hz = float(hz)
+        self.max_depth = int(max_depth)
+        self.max_stacks = int(max_stacks)
+        self.max_refs = int(max_refs)
+        self._frames_fn = frames_fn or sys._current_frames
+        self._clock = clock or time.monotonic
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # per-thread phase marker (router step phases); keyed by thread
+        # ident, written lock-free by the marked thread, read by ticks
+        self._phase_by_tid: Dict[int, Optional[str]] = {}
+        self._refs: "OrderedDict[str, dict]" = OrderedDict()
+        self._ref_seq = itertools.count(1)
+        with self._lock:
+            self._reset_locked()
+
+    # ----------------------------------------------------------- state
+    def _reset_locked(self) -> None:
+        self.samples_total = 0
+        self.wait_samples = 0
+        self.run_samples = 0
+        self.evicted_total = 0
+        self.tick_lag_ema = 0.0
+        self._started_at = self._clock()
+        # folded "thread;frames..." -> sample count, bounded; overflow
+        # evicts the coldest entry into the per-thread "(other)" bucket
+        self._table: Dict[str, int] = {}
+        self._threads: Dict[str, Dict[str, int]] = {}
+        self._phases: Dict[str, int] = {}
+        self._expected_tick: Optional[float] = None
+        # per-thread (leaf frame id, f_lasti) from the PREVIOUS tick:
+        # the sample-delta half of the wait estimate — a thread parked
+        # at the same bytecode offset across ticks is blocked in a C
+        # call (time.sleep, lock.acquire) the leaf-name heuristic
+        # cannot see
+        self._last_leaf: Dict[int, tuple] = {}
+        # tick-cost caches: each tick holds the GIL, so its cost lands
+        # on hot-path tail latency even at 19 Hz.  Labels are cached
+        # per code object, folded keys per (thread, code tuple), and
+        # thread names refresh only when the tid set changes — a
+        # steady-state tick allocates no new strings.  All bounded
+        # (clear-on-overflow) and rebuilt on demand.
+        self._label_cache: Dict[object, str] = {}
+        self._fold_cache: Dict[tuple, str] = {}
+        self._names: Dict[int, str] = {}
+        self._names_tids: frozenset = frozenset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_locked()
+
+    # ----------------------------------------------------- phase marks
+    def set_phase(self, phase: Optional[str]) -> None:
+        """Mark the CALLING thread as inside ``phase`` (``None`` to
+        clear).  Samples landing on this thread while the mark is set
+        are attributed to the phase — self-time, where the wall-clock
+        phase histograms cannot distinguish running from waiting."""
+        self._phase_by_tid[threading.get_ident()] = phase
+
+    # -------------------------------------------------------- sampling
+    def tick(self, now: Optional[float] = None) -> int:
+        """One sampling pass over every live thread; returns the number
+        of samples taken.  The daemon loop calls this; deterministic
+        tests call it directly."""
+        if now is None:
+            now = self._clock()
+        frames = self._frames_fn()
+        own = threading.get_ident()
+        with self._lock:
+            tids = frozenset(frames)
+            if tids != self._names_tids:
+                self._names = {t.ident: t.name
+                               for t in threading.enumerate()}
+                self._names_tids = tids
+            names = self._names
+            label_cache = self._label_cache
+            fold_cache = self._fold_cache
+            expected = self._expected_tick
+            if expected is not None:
+                # the sampler as scheduling probe: lateness of our own
+                # wake-up is the GIL/scheduler starvation signal
+                lag = max(0.0, now - expected)
+                self.tick_lag_ema += 0.2 * (lag - self.tick_lag_ema)
+            taken = 0
+            for tid, frame in frames.items():
+                if tid == own:
+                    continue  # never profile the sampler itself
+                codes: List[object] = []
+                depth = 0
+                leaf = frame
+                while frame is not None and depth < self.max_depth:
+                    codes.append(frame.f_code)
+                    frame = frame.f_back
+                    depth += 1
+                tname = names.get(tid) or f"tid-{tid}"
+                ckey = (tname, tuple(codes))
+                folded = fold_cache.get(ckey)
+                if folded is None:
+                    # cache miss: rewalk the (short) chain building
+                    # labels — frames carry the module __name__ the
+                    # code objects alone do not
+                    labels: List[str] = []
+                    f = leaf
+                    for code in codes:
+                        lab = label_cache.get(code)
+                        if lab is None:
+                            lab = _frame_label(f)
+                            if len(label_cache) >= 8192:
+                                label_cache.clear()
+                            label_cache[code] = lab
+                        labels.append(lab)
+                        f = f.f_back
+                    labels.reverse()  # outermost first, flame order
+                    folded = ";".join([tname] + labels)
+                    if len(fold_cache) >= 8192:
+                        fold_cache.clear()
+                    fold_cache[ckey] = folded
+                leaf_key = (id(leaf), getattr(leaf, "f_lasti", -1))
+                prev = self._last_leaf.get(tid)
+                self._last_leaf[tid] = leaf_key
+                waiting = _is_wait_leaf(leaf) or prev == leaf_key
+                self._record_locked(tname, folded, waiting)
+                ph = self._phase_by_tid.get(tid)
+                if ph is not None:
+                    self._phases[ph] = self._phases.get(ph, 0) + 1
+                taken += 1
+            self.samples_total += taken
+            # prune delta state for threads that exited (stays bounded
+            # by the LIVE thread count, not every thread ever seen)
+            for gone in [t for t in self._last_leaf
+                         if t not in frames]:
+                del self._last_leaf[gone]
+        return taken
+
+    def _record_locked(self, tname: str, folded: str,
+                       waiting: bool) -> None:
+        book = self._threads.setdefault(
+            tname, {"samples": 0, "wait": 0, "run": 0})
+        book["samples"] += 1
+        if waiting:
+            book["wait"] += 1
+            self.wait_samples += 1
+        else:
+            book["run"] += 1
+            self.run_samples += 1
+        if folded not in self._table:
+            # bounded table: fold coldest entries into their thread's
+            # "(other)" bucket (conserving total sample mass) until
+            # the new key fits WITHIN max_stacks — the bucket itself
+            # takes a slot, so one pop is not always enough
+            while len(self._table) >= self.max_stacks:
+                coldest = min(self._table, key=self._table.get)
+                count = self._table.pop(coldest)
+                other = coldest.split(";", 1)[0] + ";(other)"
+                if other == coldest:
+                    # the coldest IS an overflow bucket (more live
+                    # threads than max_stacks): folding it into
+                    # itself would spin — put it back and give up
+                    self._table[other] = count
+                    break
+                self._table[other] = self._table.get(other, 0) + count
+                self.evicted_total += 1
+        self._table[folded] = self._table.get(folded, 0) + 1
+
+    # ----------------------------------------------------- daemon loop
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="contprof-sampler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        period = 1.0 / max(self.hz, 0.1)
+        while not self._stop.is_set():
+            # seeded jitter (±20% of the period) keeps the sampler from
+            # phase-locking onto any periodic work in the process
+            delay = max(0.001,
+                        period * (1.0 + 0.4 * (self._rng.random() - 0.5)))
+            expected = self._clock() + delay
+            if self._stop.wait(delay):
+                break
+            with self._lock:
+                self._expected_tick = expected
+            try:
+                self.tick()
+            except Exception as exc:
+                # sampling must never take the host process down; skip
+                # the tick (a torn frames dict mid-interpreter-teardown)
+                logger.debug("contprof tick skipped: %s", exc)
+                continue
+
+    # ----------------------------------------------------------- views
+    def snapshot(self, top: Optional[int] = None) -> dict:
+        """JSON-friendly aggregate; ``top`` trims to the N hottest
+        stacks (what workers ship over STATS — small on the wire)."""
+        with self._lock:
+            stacks = dict(self._table)
+            if top is not None and len(stacks) > int(top):
+                keep = sorted(stacks.items(),
+                              key=lambda kv: (-kv[1], kv[0]))[:int(top)]
+                dropped = sum(stacks[k] for k in stacks) - \
+                    sum(c for _, c in keep)
+                stacks = dict(keep)
+                if dropped > 0:
+                    stacks["(trimmed)"] = \
+                        stacks.get("(trimmed)", 0) + dropped
+            return {
+                "role": self.role,
+                "hz": self.hz,
+                "duration_s": round(
+                    max(0.0, self._clock() - self._started_at), 6),
+                "samples_total": self.samples_total,
+                "wait_samples": self.wait_samples,
+                "run_samples": self.run_samples,
+                "evicted_total": self.evicted_total,
+                "tick_lag_ema_s": round(self.tick_lag_ema, 6),
+                "stacks": stacks,
+                "threads": {k: dict(v)
+                            for k, v in self._threads.items()},
+                "phases": dict(self._phases),
+            }
+
+    def collapsed(self) -> str:
+        """flamegraph.pl-compatible collapsed stacks, one per line:
+        ``role;thread;mod.fn;mod.fn N`` — pipe straight into
+        ``flamegraph.pl`` (or speedscope's collapsed importer)."""
+        with self._lock:
+            items = sorted(self._table.items())
+        lines = [f"{self.role};{folded} {count}"
+                 for folded, count in items]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def metrics(self) -> Dict[str, float]:
+        """Scalar gauges for the per-process ``/metrics`` endpoint."""
+        with self._lock:
+            return {
+                "dlrover_prof_samples_total": float(self.samples_total),
+                "dlrover_prof_wait_samples_total": float(
+                    self.wait_samples),
+                "dlrover_prof_run_samples_total": float(
+                    self.run_samples),
+                "dlrover_prof_stacks": float(len(self._table)),
+                "dlrover_prof_threads": float(len(self._threads)),
+                "dlrover_prof_stack_evictions_total": float(
+                    self.evicted_total),
+                "dlrover_prof_tick_lag_seconds": float(
+                    self.tick_lag_ema),
+            }
+
+    def render_phases(self) -> str:
+        """Prometheus text for phase self-time attribution — label
+        values come from the caller's closed phase vocabulary (the
+        router's STEP_PHASES), never request data (DL010)."""
+        with self._lock:
+            phases = sorted(self._phases.items())
+        if not phases:
+            return ""
+        from dlrover_tpu.utils.metric_registry import METRIC_HELP
+
+        name = "serving_prof_phase_samples"
+        lines = [f"# HELP {name} {METRIC_HELP[name]}",
+                 f"# TYPE {name} gauge"]
+        for ph, n in phases:
+            lines.append(f'{name}{{phase="{ph}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+    # -------------------------------------------------- incident refs
+    def capture_ref(self, reason: str = "") -> str:
+        """Freeze the current snapshot under a bounded ref id (the
+        FlightRecorder stamps this onto incident dumps) and return the
+        id; resolve later with :meth:`resolve_ref`."""
+        snap = self.snapshot()
+        snap["reason"] = str(reason)
+        with self._lock:
+            ref = f"prof-{next(self._ref_seq)}"
+            self._refs[ref] = snap
+            while len(self._refs) > self.max_refs:
+                self._refs.popitem(last=False)
+        return ref
+
+    def resolve_ref(self, ref: str) -> Optional[dict]:
+        with self._lock:
+            return self._refs.get(ref)

@@ -511,7 +511,48 @@ METRIC_HELP: Dict[str, str] = {
         "token bucket or max_queued bound) per tenant class — 429s, "
         'not fleet 503s; labeled tenant_class="…"'
     ),
+    # -- continuous sampling profiler (utils/contprof.py) --------------
+    "dlrover_prof_samples_total": (
+        "stack samples taken by the always-on sampling profiler since "
+        "start/reset (all threads, ~19 Hz jittered)"
+    ),
+    "dlrover_prof_wait_samples_total": (
+        "profiler samples whose leaf frame was a blocking primitive "
+        "(wait/select/recv/...) — off-CPU time"
+    ),
+    "dlrover_prof_run_samples_total": (
+        "profiler samples on-CPU (leaf frame not a known blocking "
+        "primitive) — where GIL-holding cycles go"
+    ),
+    "dlrover_prof_stacks": (
+        "distinct folded stacks currently held in the profiler's "
+        "bounded table"
+    ),
+    "dlrover_prof_threads": (
+        "distinct threads the profiler has sampled since start/reset"
+    ),
+    "dlrover_prof_stack_evictions_total": (
+        "cold folded stacks evicted into the per-thread (other) "
+        "bucket when the bounded table overflowed"
+    ),
+    "dlrover_prof_tick_lag_seconds": (
+        "EMA of the sampler thread's own wake-up lateness — a "
+        "GIL/scheduler starvation probe (runnable threads starve the "
+        "sampler exactly when they starve each other)"
+    ),
+    "serving_prof_phase_samples": (
+        "profiler samples attributed to each router step phase via "
+        "per-thread phase marks — phase SELF time (on-thread samples) "
+        "next to the serving_step_phase_seconds wall-clock histograms; "
+        'labeled phase="…" from the closed STEP_PHASES vocabulary'
+    ),
     # -- master goodput ledger (dist_master.master_metrics) ------------
+    "dlrover_master_step_skew_seconds": (
+        "per-rank step-time deviation from the fleet median "
+        "(SpeedMonitor.step_skew) — positive means the rank is slower "
+        "than its peers, the straggler evidence behind the "
+        'check_straggler RPC; labeled rank="…" bounded by world size'
+    ),
     "dlrover_master_goodput": (
         "productive-step time over available wall time since job "
         "start (planned-elasticity windows excluded from the "
@@ -612,6 +653,12 @@ METRIC_LABELS: Dict[str, tuple] = {
     "serving_tenant_queue_depth": ("tenant_class",),
     "serving_tenant_shed_total": ("tenant_class",),
     "serving_tenant_quota_rejected_total": ("tenant_class",),
+    # profiler phase self-time: values come from the closed
+    # STEP_PHASES vocabulary via ServingRouter's set_phase marks
+    "serving_prof_phase_samples": ("phase",),
+    # per-rank step skew: ranks are bounded by the training world size
+    # (SpeedMonitor prunes departed workers), never per-request ids
+    "dlrover_master_step_skew_seconds": ("rank",),
     # per-op device time of the last captured step: op names come
     # from the XLA module (bounded by the compiled program)
     "dlrover_xprof_collective_seconds": ("op",),

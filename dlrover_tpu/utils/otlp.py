@@ -225,7 +225,12 @@ class OtlpExporter:
         self._metric_sources: List[Callable[[], Dict[str, float]]] = []
         self._labeled_sources: List[Callable[[], list]] = []
         self._histogram_sources: List[Callable[[], list]] = []
+        self._profile_sources: List[Callable[[], list]] = []
         self._last_metrics_push = 0.0
+        # profiles are bigger than gauges and change slowly; push them
+        # no faster than once a second regardless of metrics_interval
+        self.profiles_interval = max(self.metrics_interval, 1.0)
+        self._last_profiles_push = 0.0
         # the proof counters (metric registry: dlrover_otlp_*)
         self.shipped_total = 0
         self.dropped_total = 0
@@ -278,6 +283,15 @@ class OtlpExporter:
         """``fn() -> [Histogram]`` (objects exposing ``snapshot()``) —
         pushed as OTLP histogram dataPoints with trace exemplars."""
         self._histogram_sources.append(fn)
+
+    def add_profile_source(self, fn: Callable[[], list]):
+        """``fn() -> [snapshot dict]`` — continuous-profiler snapshots
+        (:mod:`~dlrover_tpu.utils.contprof`), pushed to
+        ``/v1/profiles`` at a low cadence (≥1s) for the collector's
+        ``/fleet/profile`` merge.  A router's source yields its own
+        role-"router" snapshot plus the role-"worker" tables its
+        replicas shipped over STATS."""
+        self._profile_sources.append(fn)
 
     # ------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -334,6 +348,7 @@ class OtlpExporter:
             try:
                 self._drain_traces()
                 self._maybe_push_metrics()
+                self._maybe_push_profiles()
             except Exception:  # the pipeline must outlive any payload
                 logger.warning(
                     "otlp writer round failed; continuing",
@@ -409,6 +424,38 @@ class OtlpExporter:
         # break the traces' shipped + dropped == offered identity
         # (push failures still count into push_errors_total)
         self._push("/v1/metrics", payload, 0)
+
+    def _maybe_push_profiles(self) -> None:
+        now = time.monotonic()
+        if not self._profile_sources or \
+                now - self._last_profiles_push < self.profiles_interval:
+            return
+        self._last_profiles_push = now
+        snaps: List[dict] = []
+        for src in self._profile_sources:
+            try:
+                snaps.extend(s for s in src() if isinstance(s, dict))
+            except Exception:
+                logger.debug("otlp profile source failed",
+                             exc_info=True)
+        if not snaps:
+            return
+        payload = {"resourceProfiles": [{
+            "resource": {"attributes": otlp_attributes(self.resource)},
+            "profiles": snaps,
+        }]}
+        # n_items=0 for the same reason as metric snapshots: periodic
+        # re-reads of cumulative tables, never queued offers
+        self._push("/v1/profiles", payload, 0)
+
+    def flush_profiles(self) -> None:
+        """Test/shutdown hook: push the profile sources NOW, ignoring
+        the cadence — a 60s soak must not end 1s short of its last
+        snapshot landing."""
+        if self.endpoint is None:
+            return
+        self._last_profiles_push = -self.profiles_interval
+        self._maybe_push_profiles()
 
     def _push(self, path: str, payload: dict, n_items: int) -> None:
         body = json.dumps(payload, default=str).encode()

@@ -346,6 +346,7 @@ class MetricsExporter:
         self._text_sources = []  # callables returning Prometheus text
         self._tracer = None  # utils/tracing.Tracer, via attach_tracer
         self._tenants = None  # tenancy.TenantRegistry, attach_tenants
+        self._profiler = None  # contprof.ContinuousProfiler
         # a failing source must be VISIBLE: silently dropping it makes
         # a dashboard go quietly stale (satellite of ISSUE 4) — each
         # failure counts into dlrover_metrics_source_errors_total and
@@ -383,6 +384,14 @@ class MetricsExporter:
                         return
                     body = payload.encode()
                     ctype = "application/json"
+                elif self.path.startswith("/debug/prof"):
+                    rendered = exporter._render_prof(self.path)
+                    if rendered is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    payload, ctype = rendered
+                    body = payload.encode()
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -434,6 +443,37 @@ class MetricsExporter:
                           "tenants", None)
         if tenants is not None:
             self.attach_tenants(tenants)
+
+    def attach_profiler(self, prof) -> None:
+        """Wire a :class:`~dlrover_tpu.utils.contprof.ContinuousProfiler`:
+        its scalar gauges (and phase self-time samples, when phases are
+        marked) join ``/metrics``, and the live flame state is served
+        at ``/debug/prof`` (JSON snapshot; ``?ref=prof-N`` resolves an
+        incident capture) and ``/debug/prof/collapsed`` (flamegraph.pl
+        collapsed-stack text)."""
+        self._profiler = prof
+        self.add_source(prof.metrics)
+        self.add_text_source(prof.render_phases)
+
+    def _render_prof(self, path: str):
+        if self._profiler is None:
+            return None
+        import urllib.parse
+
+        split = urllib.parse.urlsplit(path)
+        if split.path.startswith("/debug/prof/collapsed"):
+            return self._profiler.collapsed(), "text/plain"
+        if split.path not in ("/debug/prof", "/debug/prof/"):
+            return None
+        query = urllib.parse.parse_qs(split.query)
+        ref = (query.get("ref") or [None])[0]
+        if ref is not None:
+            snap = self._profiler.resolve_ref(ref)
+            if snap is None:
+                return None  # unknown/evicted incident ref -> 404
+        else:
+            snap = self._profiler.snapshot()
+        return json.dumps(snap, sort_keys=True), "application/json"
 
     def attach_tenants(self, registry) -> None:
         """Wire a tenancy ``TenantRegistry``: enables the

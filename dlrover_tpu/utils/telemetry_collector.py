@@ -24,6 +24,14 @@ single process's ring buffer could:
 - ``GET /fleet/slo`` — the SLO vocabulary view: per process, per
   priority band, compliance / burn rates / budget remaining (read
   from the pushed ``serving_slo_*`` families);
+- ``POST /v1/profiles`` — continuous-profiler snapshot ingest
+  (``resourceProfiles``: per-process folded-stack tables from
+  :mod:`~dlrover_tpu.utils.contprof`), latest snapshot per
+  (process, role, source) retained;
+- ``GET /fleet/profile[?role=&since=&format=collapsed]`` — the
+  fleet flame view: folded stacks merged across every pushing
+  process, keyed ``role;thread;frames...`` — one URL answering
+  "where is the fleet spending its cycles";
 - ``GET /healthz``.
 
 Port-0 + stdout announce (``DLROVER_TELEMETRY_PORT=<port>``), the
@@ -84,9 +92,14 @@ class TelemetryStore:
         self._gauges: Dict[Tuple[str, str, tuple], tuple] = {}
         # (process, name) -> latest histogram dataPoint dict
         self._histograms: Dict[Tuple[str, str], dict] = {}
+        # (process, role, source) -> {"snapshot": dict, "t": unix_ts}
+        # — latest profiler snapshot per origin; bounded by the fleet's
+        # process count (one entry per pushing sampler), not by time
+        self._profiles: Dict[Tuple[str, str, str], dict] = {}
         self.ingest_errors_total = 0
         self.spans_ingested_total = 0
         self.metrics_ingested_total = 0
+        self.profiles_ingested_total = 0
 
     def count_ingest_error(self, n: int = 1) -> None:
         """Lock-guarded increment — the HTTP handler runs one thread
@@ -205,6 +218,74 @@ class TelemetryStore:
                 self._histograms[(process, name)] = point
             n += 1
         return n
+
+    def ingest_profiles(self, payload: dict) -> int:
+        """``resourceProfiles`` ingest: each entry carries the pushing
+        process's resource attrs plus a list of contprof snapshots
+        (``role``/``stacks``/``threads``/...).  Latest snapshot per
+        (process, role, source) wins — profiles are cumulative tables,
+        not events, so replacing is the correct merge."""
+        n = 0
+        for rp in payload.get("resourceProfiles") or []:
+            if not isinstance(rp, dict):
+                continue
+            resource = _attr_dict(
+                (rp.get("resource") or {}).get("attributes"))
+            process = str(resource.get("service.name", "?"))
+            for snap in rp.get("profiles") or []:
+                if not isinstance(snap, dict) or \
+                        not isinstance(snap.get("stacks"), dict):
+                    self.count_ingest_error()
+                    continue
+                role = str(snap.get("role") or "process")
+                source = str(snap.get("source") or process)
+                with self._lock:
+                    self._profiles[(process, role, source)] = {
+                        "snapshot": snap, "t": time.time()}
+                n += 1
+        with self._lock:
+            self.profiles_ingested_total += n
+        return n
+
+    def profile_view(self, role: Optional[str] = None,
+                     since: Optional[float] = None) -> dict:
+        """The fleet flame: folded stacks merged across every stored
+        snapshot (``role;thread;frames... -> count``), filterable by
+        ``role`` and by ingest time (``since`` = unix seconds; older
+        snapshots are left out — "the flame since the incident")."""
+        from dlrover_tpu.utils.contprof import merge_folded
+
+        with self._lock:
+            items = list(self._profiles.items())
+        picked = []
+        processes = set()
+        roles = set()
+        for (process, r, _source), entry in items:
+            if since is not None and entry["t"] < since:
+                continue
+            if role is not None and r != role:
+                continue
+            picked.append(entry["snapshot"])
+            processes.add(process)
+            roles.add(r)
+        stacks = merge_folded(picked)
+        phases: Dict[str, int] = {}
+        for snap in picked:
+            for ph, count in (snap.get("phases") or {}).items():
+                try:
+                    phases[str(ph)] = phases.get(str(ph), 0) + \
+                        int(count)
+                except (TypeError, ValueError):
+                    continue
+        return {
+            "roles": sorted(roles),
+            "processes": sorted(processes),
+            "snapshots": len(picked),
+            "samples_total": sum(
+                int(s.get("samples_total") or 0) for s in picked),
+            "stacks": stacks,
+            "phases": phases,
+        }
 
     # --------------------------------------------------------- views
     @staticmethod
@@ -361,6 +442,8 @@ class TelemetryCollector:
                     collector.store.ingest_traces(payload)
                 elif self.path.startswith("/v1/metrics"):
                     collector.store.ingest_metrics(payload)
+                elif self.path.startswith("/v1/profiles"):
+                    collector.store.ingest_profiles(payload)
                 else:
                     self._respond(404, b"{}")
                     return
@@ -394,6 +477,25 @@ class TelemetryCollector:
                     body = json.dumps(
                         {"slo": collector.store.slo_view()},
                         default=str)
+                elif split.path.startswith("/fleet/profile"):
+                    try:
+                        since = float(q("since")) \
+                            if q("since") else None
+                    except ValueError:
+                        since = None
+                    view = collector.store.profile_view(
+                        role=q("role"), since=since)
+                    if q("format") == "collapsed":
+                        # flamegraph.pl-ready text straight off the
+                        # fleet merge: curl | flamegraph.pl > fleet.svg
+                        lines = [f"{folded} {count}" for folded, count
+                                 in sorted(view["stacks"].items())]
+                        text = "\n".join(lines)
+                        self._respond(200, (text + "\n").encode()
+                                      if text else b"",
+                                      "text/plain")
+                        return
+                    body = json.dumps(view, default=str)
                 else:
                     self._respond(404, b"{}")
                     return

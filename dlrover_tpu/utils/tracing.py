@@ -267,6 +267,16 @@ class FlightRecorder:
             maxlen=int(dump_capacity))
         self.dumps_total = 0
         self._seq = 0  # monotone event counter (cursor for consumers)
+        # contprof.ContinuousProfiler via attach_profiler: every dump
+        # then carries a "profile_ref" freezing the flame state at the
+        # moment of the incident (resolve at /debug/prof?ref=...)
+        self._profiler = None
+
+    def attach_profiler(self, prof) -> None:
+        """Stamp a frozen profile snapshot ref onto every future dump —
+        the answer to "where was the CPU when this expired" survives
+        even after the live profiler tables move on."""
+        self._profiler = prof
 
     def record(self, kind: str, now: Optional[float] = None,
                **fields) -> None:
@@ -307,6 +317,12 @@ class FlightRecorder:
             "trace": trace_tree,
             "recent_events": self.events(last_events),
         }
+        prof = self._profiler
+        if prof is not None:
+            try:
+                record["profile_ref"] = prof.capture_ref(reason)
+            except Exception:  # a dump must never fail on the stamp
+                pass
         with self._lock:
             self.dumps.append(record)
             self.dumps_total += 1

@@ -1,0 +1,834 @@
+"""Continuous fleet profiling (ISSUE 19): the always-on sampling
+profiler, its fleet merge plane, and the incident capture path.
+
+The profiler is deterministic by construction — ``tick()`` is the
+whole sampling pass and takes an injected ``frames_fn``/``clock`` —
+so the unit half of this suite drives it with synthetic frame stacks
+and asserts exact folded tables, the wait/run split (both the
+leaf-name heuristic and the same-bytecode-offset sample-delta
+estimate), bounded-table eviction with conserved sample mass, and the
+collapsed-text golden.  The integration half proves the three wire
+paths: ``/debug/prof`` on the per-process exporter, the OTLP
+``/v1/profiles`` push into the collector's ``/fleet/profile`` merge,
+and the FlightRecorder incident dump carrying a resolvable snapshot
+ref.  Subprocess scenarios carry ``@pytest.mark.slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.utils.contprof import (
+    ContinuousProfiler,
+    merge_folded,
+)
+from dlrover_tpu.utils.metric_registry import (
+    METRIC_HELP,
+    METRIC_LABELS,
+)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _get_text(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.read().decode()
+
+
+# -- synthetic frames --------------------------------------------------------
+
+
+class _Code:
+    """Fake code object — a plain class, not SimpleNamespace: the
+    profiler's label cache keys on the code object, so it must hash."""
+
+    def __init__(self, name, filename):
+        self.co_name = name
+        self.co_filename = filename
+
+
+def _frame(module, func, back=None, lasti=0):
+    f = types.SimpleNamespace()
+    f.f_code = _Code(func, f"/src/{module}.py")
+    f.f_globals = {"__name__": module}
+    f.f_back = back
+    f.f_lasti = lasti
+    return f
+
+
+def _stack(*labels, lasti=0):
+    """Build a frame chain from outermost-first ``module.func`` labels
+    and return the LEAF (what ``sys._current_frames`` hands out)."""
+    frame = None
+    for lab in labels:
+        mod, fn = lab.rsplit(".", 1)
+        frame = _frame(mod, fn, back=frame, lasti=lasti)
+    return frame
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- unit: deterministic sampling -------------------------------------------
+
+
+def test_tick_builds_expected_folded_stacks_and_split():
+    clock = _FakeClock()
+    calls = {"n": 0}
+
+    def frames():
+        # fresh frame objects each tick; the busy thread advances its
+        # bytecode offset so the sample-delta estimate sees it RUN
+        calls["n"] += 1
+        return {
+            101: _stack("app.main", "app.work", lasti=calls["n"]),
+            102: _stack("svc.loop", "threading.wait"),
+        }
+
+    prof = ContinuousProfiler(role="router", frames_fn=frames,
+                              clock=clock)
+    assert prof.tick() == 2
+    clock.t += 0.05
+    assert prof.tick() == 2
+
+    snap = prof.snapshot()
+    assert snap["role"] == "router"
+    assert snap["samples_total"] == 4
+    # synthetic tids are not live threads -> "tid-<n>" naming
+    assert snap["stacks"] == {
+        "tid-101;app.main;app.work": 2,
+        "tid-102;svc.loop;threading.wait": 2,
+    }
+    # 102's leaf co_name "wait" classifies as off-CPU both ticks; 101
+    # moves its f_lasti between ticks so it stays run time
+    assert snap["wait_samples"] == 2
+    assert snap["run_samples"] == 2
+    assert snap["threads"]["tid-101"] == {
+        "samples": 2, "wait": 0, "run": 2}
+    assert snap["threads"]["tid-102"] == {
+        "samples": 2, "wait": 2, "run": 0}
+    assert snap["duration_s"] == pytest.approx(0.05)
+
+
+def test_wait_estimate_from_sample_deltas():
+    """A thread parked inside a C call (time.sleep, lock.acquire) has
+    no wait-named Python leaf — but its leaf frame sits at the SAME
+    bytecode offset tick after tick.  First sighting is run (no
+    delta yet); every repeat is wait."""
+    parked = _stack("app.main", "app.spin_forever")
+
+    prof = ContinuousProfiler(role="w",
+                              frames_fn=lambda: {7: parked},
+                              clock=_FakeClock())
+    for _ in range(4):
+        prof.tick()
+    snap = prof.snapshot()
+    assert snap["samples_total"] == 4
+    assert snap["run_samples"] == 1
+    assert snap["wait_samples"] == 3
+
+
+def test_wait_leaf_module_heuristic():
+    # a leaf inside selectors/socket is off-CPU even on first sight
+    prof = ContinuousProfiler(
+        role="w",
+        frames_fn=lambda: {
+            1: _stack("app.serve", "selectors._poll", lasti=1)},
+        clock=_FakeClock())
+    prof.tick()
+    assert prof.snapshot()["wait_samples"] == 1
+
+
+def test_max_depth_truncates_stack_walk():
+    deep = _stack(*[f"m.f{i}" for i in range(10)])
+    prof = ContinuousProfiler(role="w", max_depth=3,
+                              frames_fn=lambda: {1: deep},
+                              clock=_FakeClock())
+    prof.tick()
+    (folded,) = prof.snapshot()["stacks"]
+    # leaf-side 3 frames survive, outermost first after the reverse
+    assert folded == "tid-1;m.f7;m.f8;m.f9"
+
+
+def test_bounded_table_evicts_coldest_and_conserves_mass():
+    clock = _FakeClock()
+    current = {}
+    prof = ContinuousProfiler(role="w", max_stacks=4,
+                              frames_fn=lambda: dict(current),
+                              clock=clock)
+    # one hot stack sampled every tick + a parade of one-off stacks
+    for i in range(8):
+        current = {
+            1: _stack("hot.loop", lasti=i),
+            2: _stack(f"cold.f{i}", lasti=i),
+        }
+        prof.tick()
+    snap = prof.snapshot()
+    assert snap["evicted_total"] > 0
+    assert len(snap["stacks"]) <= 4
+    # sample mass is conserved: evictions fold into "(other)"
+    assert sum(snap["stacks"].values()) == snap["samples_total"] == 16
+    assert snap["stacks"]["tid-1;hot.loop"] == 8
+    assert snap["stacks"].get("tid-2;(other)", 0) > 0
+
+
+def test_snapshot_top_trims_into_trimmed_bucket():
+    current = {}
+    prof = ContinuousProfiler(role="w",
+                              frames_fn=lambda: dict(current),
+                              clock=_FakeClock())
+    for i in range(6):
+        weight = 6 - i  # stack i sampled (6-i) times
+        for j in range(weight):
+            current = {1: _stack(f"m.f{i}", lasti=100 * i + j)}
+            prof.tick()
+    snap = prof.snapshot(top=2)
+    assert set(snap["stacks"]) == {
+        "tid-1;m.f0", "tid-1;m.f1", "(trimmed)"}
+    assert snap["stacks"]["tid-1;m.f0"] == 6
+    assert snap["stacks"]["tid-1;m.f1"] == 5
+    # trimmed bucket carries exactly the dropped mass
+    assert sum(snap["stacks"].values()) == snap["samples_total"]
+    # the full table is untouched by a trimmed view
+    assert len(prof.snapshot()["stacks"]) == 6
+
+
+def test_collapsed_text_golden():
+    clock = _FakeClock()
+    calls = {"n": 0}
+
+    def frames():
+        calls["n"] += 1
+        return {
+            5: _stack("app.main", "app.step", lasti=calls["n"]),
+            6: _stack("app.main", lasti=calls["n"]),
+        }
+
+    prof = ContinuousProfiler(role="router", frames_fn=frames,
+                              clock=clock)
+    prof.tick()
+    prof.tick()
+    assert prof.collapsed() == (
+        "router;tid-5;app.main;app.step 2\n"
+        "router;tid-6;app.main 2\n"
+    )
+
+
+def test_reset_clears_tables():
+    prof = ContinuousProfiler(role="w",
+                              frames_fn=lambda: {1: _stack("m.f")},
+                              clock=_FakeClock())
+    prof.tick()
+    prof.reset()
+    snap = prof.snapshot()
+    assert snap["samples_total"] == 0
+    assert snap["stacks"] == {}
+
+
+def test_sampler_thread_takes_real_samples_and_skips_itself():
+    stop = threading.Event()
+
+    def busy():
+        x = 0
+        while not stop.is_set():
+            x += 1
+
+    t = threading.Thread(target=busy, name="prof-busy", daemon=True)
+    t.start()
+    prof = ContinuousProfiler(role="router", hz=200.0, seed=1)
+    prof.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while (prof.snapshot()["samples_total"] < 10
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(timeout=2.0)
+    snap = prof.snapshot()
+    assert snap["samples_total"] >= 10
+    assert "prof-busy" in snap["threads"]
+    # the sampler never profiles its own thread
+    assert "contprof-sampler" not in snap["threads"]
+    # idempotent lifecycle
+    prof.stop()
+
+
+# -- unit: phases, refs, registry --------------------------------------------
+
+
+def test_phase_attribution_and_prometheus_render():
+    prof = ContinuousProfiler(role="router", seed=1)
+    ready = threading.Event()
+    release = threading.Event()
+
+    def marked():
+        prof.set_phase("schedule")
+        ready.set()
+        release.wait(5.0)
+        prof.set_phase(None)
+
+    t = threading.Thread(target=marked, name="marked", daemon=True)
+    t.start()
+    assert ready.wait(5.0)
+    try:
+        prof.tick()
+        prof.tick()
+    finally:
+        release.set()
+        t.join(timeout=2.0)
+    snap = prof.snapshot()
+    assert snap["phases"] == {"schedule": 2}
+    text = prof.render_phases()
+    assert "# HELP serving_prof_phase_samples" in text
+    assert '# TYPE serving_prof_phase_samples gauge' in text
+    assert 'serving_prof_phase_samples{phase="schedule"} 2' in text
+    # no phases -> no text (exporters skip empty sections)
+    assert ContinuousProfiler(role="x").render_phases() == ""
+
+
+def test_capture_ref_resolves_and_ring_is_bounded():
+    prof = ContinuousProfiler(role="w", max_refs=2,
+                              frames_fn=lambda: {1: _stack("m.f")},
+                              clock=_FakeClock())
+    prof.tick()
+    refs = [prof.capture_ref(reason=f"incident-{i}") for i in range(3)]
+    assert refs == ["prof-1", "prof-2", "prof-3"]
+    assert prof.resolve_ref("prof-1") is None  # evicted, ring of 2
+    snap = prof.resolve_ref("prof-3")
+    assert snap is not None and snap["reason"] == "incident-2"
+    assert snap["stacks"] == {"tid-1;m.f": 1}
+    assert prof.resolve_ref("nope") is None
+
+
+def test_merge_folded_sums_across_roles_and_skips_malformed():
+    merged = merge_folded([
+        {"role": "router", "stacks": {"t;a": 2, "t;b": 1}},
+        {"role": "worker", "stacks": {"t;a": 3}},
+        {"role": "worker", "stacks": {"t;a": 1, "t;c": "junk"}},
+        {"role": "bad", "stacks": "not-a-dict"},
+        "not-a-snapshot",
+    ])
+    assert merged == {
+        "router;t;a": 2, "router;t;b": 1, "worker;t;a": 4}
+
+
+def test_profiler_metric_families_are_registered():
+    prof = ContinuousProfiler(role="w")
+    for name in prof.metrics():
+        assert name in METRIC_HELP, f"{name} missing from registry"
+    assert "serving_prof_phase_samples" in METRIC_HELP
+    assert METRIC_LABELS["serving_prof_phase_samples"] == ("phase",)
+    assert "dlrover_master_step_skew_seconds" in METRIC_HELP
+    assert METRIC_LABELS["dlrover_master_step_skew_seconds"] == (
+        "rank",)
+
+
+# -- exporter endpoints ------------------------------------------------------
+
+
+def test_metrics_exporter_debug_prof_endpoints():
+    from dlrover_tpu.utils.profiler import MetricsExporter
+
+    prof = ContinuousProfiler(role="agent",
+                              frames_fn=lambda: {1: _stack("m.f")},
+                              clock=_FakeClock())
+    prof.tick()
+    ref = prof.capture_ref(reason="unit")
+    exporter = MetricsExporter()
+    exporter.attach_profiler(prof)
+    exporter.start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        snap = _get_json(f"{base}/debug/prof")
+        assert snap["role"] == "agent"
+        assert snap["stacks"] == {"tid-1;m.f": 1}
+        text = _get_text(f"{base}/debug/prof/collapsed")
+        assert text == "agent;tid-1;m.f 1\n"
+        frozen = _get_json(f"{base}/debug/prof?ref={ref}")
+        assert frozen["reason"] == "unit"
+        with pytest.raises(urllib.error.HTTPError):
+            _get_json(f"{base}/debug/prof?ref=prof-999")
+        # the scalar gauges ride the normal scrape
+        body = _get_text(f"{base}/metrics")
+        assert "dlrover_prof_samples_total 1.0" in body
+    finally:
+        exporter.stop()
+
+
+def test_flight_dump_carries_resolvable_profile_ref():
+    from dlrover_tpu.utils.tracing import FlightRecorder
+
+    prof = ContinuousProfiler(role="router",
+                              frames_fn=lambda: {1: _stack("m.f")},
+                              clock=_FakeClock())
+    prof.tick()
+    rec = FlightRecorder(event_capacity=4, dump_capacity=2)
+    rec.attach_profiler(prof)
+    rec.dump("p99-cliff", {"trace_id": "t", "spans": []})
+    d = rec.dumps[-1]
+    assert d["reason"] == "p99-cliff"
+    ref = d["profile_ref"]
+    frozen = prof.resolve_ref(ref)
+    assert frozen is not None
+    assert frozen["reason"] == "p99-cliff"
+    assert frozen["stacks"] == {"tid-1;m.f": 1}
+    json.dumps(d)  # dump stays one JSON-serializable record
+
+
+# -- router wiring -----------------------------------------------------------
+
+
+class _RecordingProfiler:
+    """Just the surface the router touches: phase marks + capture."""
+
+    def __init__(self):
+        self.marks = []
+
+    def set_phase(self, phase):
+        self.marks.append(phase)
+
+    def capture_ref(self, reason=""):
+        return "prof-0"
+
+    def snapshot(self, top=None):
+        return {"role": "router", "stacks": {}}
+
+
+def test_router_step_marks_phases_and_clears_on_exit():
+    import numpy as np
+
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RequestGateway,
+        ServingRouter,
+    )
+
+    router = ServingRouter(
+        gateway=RequestGateway(),
+        scheduler=ContinuousBatchScheduler(block_size=4),
+    )
+    router.join_replica("local-0", FakeEngine(slots=4))
+    prof = _RecordingProfiler()
+    router.attach_profiler(prof)
+    router.submit(np.full(8, 3, np.int32), 8)
+    deadline = time.monotonic() + 30.0
+    while router.has_work and time.monotonic() < deadline:
+        router.step()
+    assert not router.has_work
+    marks = prof.marks
+    for phase in ("expire", "schedule", "deliver", "observe", "flush"):
+        assert phase in marks, f"step() never marked {phase}"
+    # the hot-path thread never leaves a stale mark behind
+    assert marks[-1] is None
+
+
+def test_router_profile_snapshots_include_replica_tables():
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RequestGateway,
+        ServingRouter,
+    )
+
+    class _ProfiledEngine(FakeEngine):
+        def profile_snapshot(self):
+            return {"role": "worker",
+                    "stacks": {"MainThread;w.step": 5}}
+
+    router = ServingRouter(
+        gateway=RequestGateway(),
+        scheduler=ContinuousBatchScheduler(block_size=4),
+    )
+    router.join_replica("w-0", _ProfiledEngine(slots=4))
+    own = ContinuousProfiler(role="router",
+                             frames_fn=lambda: {1: _stack("r.step")},
+                             clock=_FakeClock())
+    own.tick()
+    router.attach_profiler(own)
+    snaps = router.profile_snapshots()
+    roles = sorted(s["role"] for s in snaps)
+    assert roles == ["router", "worker"]
+    worker = [s for s in snaps if s["role"] == "worker"][0]
+    assert worker["source"] == "w-0"
+    merged = merge_folded(snaps)
+    assert merged["worker;MainThread;w.step"] == 5
+    assert merged["router;tid-1;r.step"] == 1
+
+
+# -- collector merge plane ---------------------------------------------------
+
+
+def _profile_payload(service, snaps):
+    from dlrover_tpu.utils.otlp import otlp_attributes
+
+    return {"resourceProfiles": [{
+        "resource": {"attributes": otlp_attributes(
+            {"service.name": service})},
+        "profiles": snaps,
+    }]}
+
+
+def test_store_ingests_and_merges_profiles_across_processes():
+    from dlrover_tpu.utils.telemetry_collector import TelemetryStore
+
+    store = TelemetryStore()
+    assert store.ingest_profiles(_profile_payload("router", [
+        {"role": "router", "samples_total": 3,
+         "stacks": {"t;r.step": 3}, "phases": {"schedule": 2}},
+        {"role": "worker", "source": "w-0", "samples_total": 4,
+         "stacks": {"t;w.step": 4}},
+    ])) == 2
+    assert store.ingest_profiles(_profile_payload("worker-1", [
+        {"role": "worker", "samples_total": 2,
+         "stacks": {"t;w.step": 2}},
+    ])) == 1
+    # malformed snapshots count as ingest errors, not crashes
+    before = store.ingest_errors_total
+    assert store.ingest_profiles(_profile_payload("bad", [
+        {"role": "worker", "stacks": "nope"}])) == 0
+    assert store.ingest_errors_total == before + 1
+
+    view = store.profile_view()
+    assert view["roles"] == ["router", "worker"]
+    assert view["snapshots"] == 3
+    assert view["samples_total"] == 9
+    assert view["stacks"] == {
+        "router;t;r.step": 3, "worker;t;w.step": 6}
+    assert view["phases"] == {"schedule": 2}
+
+    workers = store.profile_view(role="worker")
+    assert workers["roles"] == ["worker"]
+    assert workers["stacks"] == {"worker;t;w.step": 6}
+
+    # cumulative tables: a re-push from the same (process, role,
+    # source) REPLACES, it does not double-count
+    store.ingest_profiles(_profile_payload("worker-1", [
+        {"role": "worker", "samples_total": 7,
+         "stacks": {"t;w.step": 7}},
+    ]))
+    assert store.profile_view(
+        role="worker")["stacks"]["worker;t;w.step"] == 11
+
+    # since-filter: nothing ingested after a future timestamp
+    assert store.profile_view(since=time.time() + 60)["snapshots"] == 0
+
+
+def test_otlp_profiles_land_in_fleet_profile_endpoint():
+    from dlrover_tpu.common.retry import RetryPolicy
+    from dlrover_tpu.utils.otlp import OtlpExporter
+    from dlrover_tpu.utils.telemetry_collector import (
+        TelemetryCollector,
+    )
+
+    retry = RetryPolicy(max_attempts=2, backoff_base=0.01,
+                        backoff_max=0.02, deadline=0.3, jitter=0.0,
+                        seed=1)
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    try:
+        router_prof = ContinuousProfiler(
+            role="router", frames_fn=lambda: {1: _stack("r.step")},
+            clock=_FakeClock())
+        router_prof.tick()
+        worker_prof = ContinuousProfiler(
+            role="worker", frames_fn=lambda: {1: _stack("w.step")},
+            clock=_FakeClock())
+        worker_prof.tick()
+        worker_prof.tick()
+
+        exp_router = OtlpExporter(
+            collector.endpoint, resource={"service.name": "router"},
+            retry=retry)
+        exp_router.add_profile_source(
+            lambda: [router_prof.snapshot(top=64)])
+        exp_worker = OtlpExporter(
+            collector.endpoint, resource={"service.name": "worker-0"},
+            retry=retry)
+        exp_worker.add_profile_source(
+            lambda: [worker_prof.snapshot(top=64)])
+        exp_router.flush_profiles()
+        exp_worker.flush_profiles()
+
+        view = _get_json(f"{collector.endpoint}/fleet/profile")
+        assert view["roles"] == ["router", "worker"]
+        assert view["samples_total"] == 3
+        assert view["stacks"]["router;tid-1;r.step"] == 1
+        assert view["stacks"]["worker;tid-1;w.step"] == 2
+
+        only = _get_json(
+            f"{collector.endpoint}/fleet/profile?role=worker")
+        assert only["roles"] == ["worker"]
+
+        text = _get_text(
+            f"{collector.endpoint}/fleet/profile?format=collapsed")
+        assert "router;tid-1;r.step 1" in text.splitlines()
+    finally:
+        collector.stop()
+
+
+def test_tenant_class_counters_ride_the_otlp_metrics_path():
+    from dlrover_tpu.common.retry import RetryPolicy
+    from dlrover_tpu.serving.router import RouterMetrics
+    from dlrover_tpu.serving.tenancy import TENANT_CLASSES
+    from dlrover_tpu.utils.otlp import OtlpExporter
+    from dlrover_tpu.utils.telemetry_collector import (
+        TelemetryCollector,
+    )
+
+    rm = RouterMetrics(window_seconds=1.0)
+    labeled = rm.otlp_labeled()
+    names = {n for n, _, _ in labeled}
+    assert names == {"serving_tenant_queue_depth",
+                     "serving_tenant_shed_total",
+                     "serving_tenant_quota_rejected_total"}
+    # closed vocabulary, zero-filled: every class present, only the
+    # tenant_class label (raw tenant ids never leave the gateway)
+    for name in names:
+        classes = {a["tenant_class"] for n, a, _ in labeled
+                   if n == name}
+        assert classes == set(TENANT_CLASSES)
+
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    try:
+        exp = OtlpExporter(
+            collector.endpoint, resource={"service.name": "router"},
+            metrics_interval=0.05,
+            retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
+                              backoff_max=0.02, deadline=0.3,
+                              jitter=0.0, seed=1))
+        exp.add_labeled_source(rm.otlp_labeled)
+        exp.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            seen = {}
+            while time.monotonic() < deadline:
+                seen = collector.store.metrics_view().get("router", {})
+                if any("serving_tenant_queue_depth" in k
+                       for k in seen):
+                    break
+                time.sleep(0.05)
+        finally:
+            exp.stop()
+        assert any(k.startswith('serving_tenant_queue_depth{'
+                                'tenant_class=') for k in seen), seen
+    finally:
+        collector.stop()
+
+
+# -- master step skew --------------------------------------------------------
+
+
+def test_speed_monitor_step_skew_is_deviation_from_median():
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    mon = SpeedMonitor()
+    mon.sample_worker_step(0, 1.0)
+    mon.sample_worker_step(1, 1.2)
+    mon.sample_worker_step(2, 1.1)
+    skew = mon.step_skew()
+    assert skew[0] == pytest.approx(-0.1)
+    assert skew[1] == pytest.approx(0.1)
+    assert skew[2] == pytest.approx(0.0)
+    # junk and non-positive samples are ignored
+    mon.sample_worker_step(3, 0.0)
+    mon.sample_worker_step(4, None)
+    assert set(mon.step_skew()) == {0, 1, 2}
+    # even count: median is the average of the middle two
+    mon.sample_worker_step(3, 1.3)
+    assert mon.step_skew()[3] == pytest.approx(0.15)
+    # a removed rank stops skewing the median it left
+    mon.add_running_worker("worker", 1)
+    mon.remove_running_worker("worker", 1)
+    assert 1 not in mon.step_skew()
+    assert SpeedMonitor().step_skew() == {}
+
+
+# -- subprocess acceptance (slow) --------------------------------------------
+
+
+def _can_spawn() -> bool:
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "pass"], timeout=30, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return True
+    except Exception:
+        return False
+
+
+needs_spawn = pytest.mark.skipif(
+    not _can_spawn(), reason="cannot spawn subprocesses here")
+
+
+@pytest.mark.slow
+@needs_spawn
+def test_fleet_profile_merges_router_and_worker_subprocesses():
+    """THE acceptance: real ``--profile`` worker processes ship their
+    sample tables over STATS; the router pushes its own role-"router"
+    table plus the relayed role-"worker" tables through one OTLP
+    exporter; ``/fleet/profile`` answers with merged folded stacks
+    from BOTH process roles."""
+    import numpy as np
+
+    pytest.importorskip(
+        "msgpack", reason="remote fabric frames are msgpack")
+    from dlrover_tpu.common.constants import ServingRequestState
+    from dlrover_tpu.common.retry import RetryPolicy
+    from dlrover_tpu.serving.remote.supervisor import WorkerSupervisor
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        ServingRouter,
+    )
+    from dlrover_tpu.utils.otlp import OtlpExporter
+    from dlrover_tpu.utils.telemetry_collector import (
+        TelemetryCollector,
+    )
+
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    prof = ContinuousProfiler(role="router", hz=97.0, seed=2)
+    router.attach_profiler(prof)
+    prof.start()
+    sup = WorkerSupervisor(
+        router=router, engine="fake",
+        worker_args=["--slots", "4", "--tokens-per-step", "4",
+                     "--profile", "--profile-hz", "97"],
+        name_prefix="prof", seed=1)
+    try:
+        for _ in range(2):
+            sup.spawn()
+        reqs = [router.submit(np.full(8, i % 251, np.int32), 8)
+                for i in range(16)]
+        deadline = time.monotonic() + 60.0
+        while router.has_work and time.monotonic() < deadline:
+            router.step()
+            sup.poll()
+            time.sleep(0.002)
+        assert not router.has_work
+
+        # wait for every worker to have shipped a profile over STATS
+        def worker_tables():
+            return [s for s in router.profile_snapshots()
+                    if s.get("role") == "worker"]
+
+        while (len(worker_tables()) < 2
+               and time.monotonic() < deadline):
+            router.step()
+            time.sleep(0.05)
+        assert len(worker_tables()) >= 2
+
+        exp = OtlpExporter(
+            collector.endpoint, resource={"service.name": "router"},
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
+                              backoff_max=0.2, deadline=5.0,
+                              jitter=0.0, seed=1))
+        exp.add_profile_source(router.profile_snapshots)
+        exp.flush_profiles()
+
+        view = _get_json(f"{collector.endpoint}/fleet/profile")
+        assert set(view["roles"]) >= {"router", "worker"}
+        assert view["samples_total"] > 0
+        merged = view["stacks"]
+        assert any(k.startswith("router;") for k in merged)
+        assert any(k.startswith("worker;") for k in merged)
+        assert all(r.state == ServingRequestState.DONE for r in reqs)
+    finally:
+        prof.stop()
+        sup.shutdown()
+        collector.stop()
+
+
+@pytest.mark.slow
+def test_profile_on_gateway_soak_keeps_admitting():
+    """Nightly soak: the open-loop gateway rig with the profiler ON
+    for ``DLROVER_PROFILE_SOAK_S`` (default 60s) must keep admitting
+    >= 10k req/s, and the fleet profile plane must come out non-empty
+    — the always-on claim, measured at soak length rather than the
+    bench's 2s sprints."""
+    from dlrover_tpu.common.retry import RetryPolicy
+    from dlrover_tpu.serving.remote.worker import FakeEngine
+    from dlrover_tpu.serving.router import (
+        BrownoutPolicy,
+        ContinuousBatchScheduler,
+        RequestGateway,
+        RouterMetrics,
+        ServingRouter,
+        SloEngine,
+    )
+    from dlrover_tpu.serving.router.loadgen import (
+        LoadgenConfig,
+        run_gateway_rig,
+    )
+    from dlrover_tpu.utils.otlp import OtlpExporter
+    from dlrover_tpu.utils.telemetry_collector import (
+        TelemetryCollector,
+    )
+
+    soak_s = float(os.environ.get("DLROVER_PROFILE_SOAK_S", "60"))
+    collector = TelemetryCollector(announce=False)
+    collector.start()
+    router = ServingRouter(
+        gateway=RequestGateway(max_pending=4096, default_timeout=3.0,
+                               trace_sample_rate=0.01),
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        metrics=RouterMetrics(window_seconds=1.0),
+        brownout=BrownoutPolicy(enter_pressure=4.0,
+                                exit_pressure=1.0,
+                                dwell_seconds=0.2),
+        slo=SloEngine(fast_window_s=5.0, slow_window_s=60.0),
+    )
+    for i in range(4):
+        router.join_replica(
+            f"local-{i}",
+            FakeEngine(slots=16, tokens_per_step=8, blocks=100_000))
+    prof = ContinuousProfiler(role="router", seed=3)
+    router.attach_profiler(prof)
+    prof.start()
+    exp = OtlpExporter(
+        collector.endpoint, resource={"service.name": "router"},
+        retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
+                          backoff_max=0.2, deadline=5.0, jitter=0.0,
+                          seed=1))
+    exp.add_profile_source(router.profile_snapshots)
+    try:
+        rig = run_gateway_rig(
+            router,
+            LoadgenConfig(rate_qps=15000, duration_s=soak_s, seed=7))
+        exp.flush_profiles()
+    finally:
+        prof.stop()
+        collector_view = None
+        try:
+            collector_view = _get_json(
+                f"{collector.endpoint}/fleet/profile")
+        finally:
+            collector.stop()
+    assert rig["gateway_qps"] >= 10000, rig
+    snap = prof.snapshot()
+    assert snap["samples_total"] > 0
+    assert snap["phases"], "step phases never attributed"
+    assert collector_view is not None
+    assert collector_view["samples_total"] > 0
+    assert "router" in collector_view["roles"]
