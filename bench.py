@@ -777,6 +777,165 @@ def _bench_router() -> dict:
     return out
 
 
+def _bench_tail() -> dict:
+    """Tail-latency gate (ISSUE 20): first-done-wins hedging against a
+    seeded 10%-slow fleet, measured over the REAL remote fabric
+    (in-thread WorkerServer + proxy per replica, not local engines —
+    a slow local ``step()`` would block the whole router loop and
+    measure nothing).
+
+    One replica in ten is a straggler (decode step sleeps); the same
+    seeded workload runs twice — hedge disarmed, then armed.  Gates of
+    record: the hedged e2e p99 lands at <= 0.5x the unhedged p99, the
+    hedge fraction stays inside the cumulative budget, zero requests
+    lost either way, and every request's output is byte-identical to
+    the content-keyed expectation on BOTH runs (two racing attempts,
+    one stream).
+    """
+    import threading
+
+    import numpy as np
+
+    from dlrover_tpu.common.constants import ServingRequestState
+    from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle
+    from dlrover_tpu.serving.remote.worker import FakeEngine, WorkerServer
+    from dlrover_tpu.serving.router import (
+        ContinuousBatchScheduler,
+        RequestGateway,
+        RouterMetrics,
+        ServingRouter,
+    )
+    from dlrover_tpu.serving.router.hedge import HedgePolicy
+
+    N_REPLICAS = 10
+    N_REQUESTS = 120
+    MAX_NEW = 8
+    BUDGET = 0.2
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 250, size=8).astype(np.int32)
+               for _ in range(N_REQUESTS)]
+
+    def expected(prompt):
+        base = int(prompt.astype(np.int64).sum()) * 31 + int(prompt.size)
+        return [(base + i) % 997 for i in range(MAX_NEW)]
+
+    def run_one(hedged: bool) -> dict:
+        servers, threads = [], []
+        try:
+            router = ServingRouter(
+                gateway=RequestGateway(max_pending=8192,
+                                       default_timeout=30.0),
+                scheduler=ContinuousBatchScheduler(block_size=4),
+                metrics=RouterMetrics(window_seconds=5.0),
+                hedge=HedgePolicy(
+                    delay_floor_s=0.05, default_delay_s=0.05,
+                    budget_fraction=BUDGET, min_samples=1 << 30,
+                ) if hedged else None,
+            )
+            for i in range(N_REPLICAS):
+                # replica 0 is the seeded straggler: every decode
+                # step sleeps, so anything placed there stalls
+                engine = FakeEngine(
+                    slots=4, tokens_per_step=4, blocks=1_000_000,
+                    content_tokens=True,
+                    step_delay=0.25 if i == 0 else 0.0)
+                server = WorkerServer(engine)
+                thread = threading.Thread(
+                    target=server.serve_forever, daemon=True)
+                thread.start()
+                servers.append(server)
+                threads.append(thread)
+                router.join_replica(
+                    f"tail-{i}",
+                    RemoteReplicaHandle(server.addr, name=f"tail-{i}"))
+            # paced open-loop (offered rate well under fleet
+            # capacity): e2e latency then measures SERVICE time, the
+            # thing hedging can fix — a burst would measure queue
+            # wait, which no second attempt can shorten
+            reqs = []
+            idx = 0
+            interval = 1.0 / 60.0
+            t_start = time.monotonic()
+            deadline = t_start + 60.0
+            while ((idx < N_REQUESTS or router.has_work)
+                   and time.monotonic() < deadline):
+                now = time.monotonic()
+                while (idx < N_REQUESTS
+                       and now >= t_start + idx * interval):
+                    reqs.append(router.submit(prompts[idx], MAX_NEW))
+                    idx += 1
+                router.step()
+                time.sleep(0.001)
+            done = [r for r in reqs if r.finished_at is not None
+                    and r.state == ServingRequestState.DONE]
+            lats = [r.finished_at - r.submitted_at for r in done]
+            byte_ok = all(
+                list(r.result(timeout=0)) == expected(p)
+                for r, p in zip(done, prompts))
+            return {
+                "p99_s": float(np.percentile(lats, 99)) if lats
+                else float("inf"),
+                "mean_s": float(np.mean(lats)) if lats else float("inf"),
+                "lost": N_REQUESTS - len(done),
+                "byte_ok": bool(byte_ok and len(done) == N_REQUESTS),
+                "hedge_dispatched": router.hedge_dispatched,
+                "hedge_won": router.hedge_won,
+                "submitted": router.gateway.submitted,
+            }
+        finally:
+            for s in servers:
+                try:
+                    s.crash()
+                except Exception:
+                    pass
+
+    # interleaved best-of-2 per mode, keep-min p99: this shared CPU
+    # container's scheduler jitter lands on the tail first, and one
+    # outlier trial must not decide a ratio gate; the zero-lost and
+    # byte-identity fields must hold on EVERY trial, so they AND
+    out: dict = {}
+    best = {True: None, False: None}
+    for _trial in range(2):
+        for hedged in (False, True):
+            run = run_one(hedged)
+            prev = best[hedged]
+            best[hedged] = run if prev is None else {
+                "p99_s": min(run["p99_s"], prev["p99_s"]),
+                "mean_s": min(run["mean_s"], prev["mean_s"]),
+                "lost": run["lost"] + prev["lost"],
+                "byte_ok": run["byte_ok"] and prev["byte_ok"],
+                "hedge_dispatched": max(run["hedge_dispatched"],
+                                        prev["hedge_dispatched"]),
+                "hedge_won": max(run["hedge_won"], prev["hedge_won"]),
+                "submitted": run["submitted"],
+            }
+    un, he = best[False], best[True]
+    out["tail_unhedged_p99_s"] = round(un["p99_s"], 4)
+    out["tail_hedged_p99_s"] = round(he["p99_s"], 4)
+    out["tail_p99_ratio"] = round(
+        he["p99_s"] / max(1e-9, un["p99_s"]), 3)
+    out["tail_p99_ratio_bar"] = 0.5
+    out["tail_hedge_budget"] = BUDGET
+    # cumulative-budget accounting: dispatches over submissions, with
+    # the same floor-of-one the policy grants a minimal fleet
+    frac_cap = max(1.0, BUDGET * he["submitted"]) / he["submitted"]
+    out["tail_hedge_fraction"] = round(
+        he["hedge_dispatched"] / max(1, he["submitted"]), 3)
+    out["tail_hedge_dispatched"] = he["hedge_dispatched"]
+    out["tail_hedge_won"] = he["hedge_won"]
+    out["tail_lost"] = un["lost"] + he["lost"]
+    out["tail_byte_identical"] = bool(
+        un["byte_ok"] and he["byte_ok"])
+    out["tail_ok"] = bool(
+        out["tail_p99_ratio"] <= out["tail_p99_ratio_bar"]
+        and out["tail_hedge_fraction"] <= round(frac_cap, 3)
+        and out["tail_lost"] == 0
+        and out["tail_byte_identical"]
+        and he["hedge_dispatched"] >= 1
+    )
+    return out
+
+
 def _bench_tenancy() -> dict:
     """Per-tenant QoS gate (ISSUE 16): the noisy-neighbor scenario as
     a recorded number.  One tenant floods at ~10x its token-bucket
@@ -1311,6 +1470,7 @@ _CONFIG_FNS = {
     "fleet": _bench_fleet,
     "gateway": _bench_gateway,
     "router": _bench_router,
+    "tail": _bench_tail,
     "tenancy": _bench_tenancy,
     "prefix": _bench_prefix,
     "profile": _bench_profile,
@@ -1376,7 +1536,7 @@ def main() -> None:
 
     on_tpu = _probe_tpu()
     configs = ["primary", "ckpt", "fleet", "gateway", "router",
-               "tenancy", "prefix", "profile"]
+               "tail", "tenancy", "prefix", "profile"]
     if on_tpu:
         configs += ["realistic", "longctx"]
     # a result far below the config's long-recorded band is transient
@@ -1523,6 +1683,20 @@ def main() -> None:
             "lost identity failed, or the event step engine lost the "
             "deep-queue probe to the old sweep "
             f"(ab={result.get('router_ab')}); see PERF.md",
+            file=sys.stderr,
+        )
+    if result.get("tail_ok") is False:
+        regressions.append("tail")
+        print(
+            "BENCH REGRESSION: tail_ok=false — hedged p99 "
+            f"{result.get('tail_hedged_p99_s')}s vs unhedged "
+            f"{result.get('tail_unhedged_p99_s')}s (ratio "
+            f"{result.get('tail_p99_ratio')} vs the "
+            f"{result.get('tail_p99_ratio_bar')} bar), hedge fraction "
+            f"{result.get('tail_hedge_fraction')} (budget "
+            f"{result.get('tail_hedge_budget')}), lost "
+            f"{result.get('tail_lost')}, byte_identical "
+            f"{result.get('tail_byte_identical')}; see PERF.md",
             file=sys.stderr,
         )
     if result.get("tenancy_ok") is False:

@@ -176,6 +176,13 @@ class ServingFabric:
     STATS_INTERVAL = 0.05
     # Proxy declares a connected-but-silent worker dead past this.
     FRAME_TIMEOUT = 5.0
+    # Phi-accrual suspicion thresholds (serving/remote/phi.py): at
+    # PHI_SUSPECT the replica is demoted in placement (gray zone, no
+    # failover); at PHI_DEAD — only when a proxy's phi_kill_floor is
+    # armed — silence is suspicious enough to fail over EARLY, before
+    # FRAME_TIMEOUT (which stays the hard ceiling regardless).
+    PHI_SUSPECT = 3.0
+    PHI_DEAD = 8.0
     # Router address env var a deployed worker registers back to.
     ROUTER_ADDR_ENV = "DLROVER_ROUTER_ADDR"
     # JSON fault-injection schedule for the frame protocol

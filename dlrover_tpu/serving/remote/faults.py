@@ -60,6 +60,24 @@ A schedule is a list of fault specs (JSON-friendly dicts):
 Every firing is appended to :attr:`FaultSchedule.injected` so a chaos
 test can assert the schedule actually executed (a fault suite whose
 faults silently never fire proves nothing).
+
+Beyond the discrete per-frame ops, a schedule can also carry sustained
+**link profiles** — the gray-failure plane.  Where an op fires on the
+Nth matching frame and stops, a profile degrades EVERY matching frame
+for as long as it is armed: ``slow`` (base ``latency`` plus seeded
+uniform ``jitter`` per frame), ``lossy`` (seeded per-frame drop with
+probability ``p``), ``partition`` (blackhole every frame on the
+matching side — armed per-direction, this is the asymmetric partition:
+one direction delivers, the other doesn't), and ``flap`` (periodic
+up/down: frames deliver during the first ``duty`` fraction of each
+``period`` and drop during the rest, phase-anchored at arm time).
+Profiles are armed at construction (``profiles=[...]``), through the
+same ``DLROVER_SERVING_FAULTS`` env payload (``"profiles": [...]``)
+spawned workers inherit, or mid-run via :meth:`FaultSchedule.
+arm_profile` / :meth:`~FaultSchedule.disarm_profile` — a link that
+degrades while traffic is in flight, then heals.  Profile firings land
+in the same ``injected`` ledger tagged with ``profile``/``profile_id``
+so assertions can distinguish them from the discrete ops.
 """
 
 from __future__ import annotations
@@ -76,6 +94,8 @@ from dlrover_tpu.serving.remote.protocol import FrameConnection
 
 _OPS = ("delay", "dup", "drop", "stall", "tear", "error", "reorder")
 _SIDES = ("send", "recv")
+_PROFILES = ("slow", "lossy", "partition", "flap")
+_PROFILE_SIDES = ("send", "recv", "both")
 
 
 class FaultSchedule:
@@ -86,7 +106,8 @@ class FaultSchedule:
     torn connection keeps marching through the same schedule).
     """
 
-    def __init__(self, specs: List[Dict], seed: int = 0):
+    def __init__(self, specs: List[Dict], seed: int = 0,
+                 profiles: Optional[List[Dict]] = None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
@@ -111,6 +132,99 @@ class FaultSchedule:
             self.specs.append(spec)
         #: log of fired injections: {op, kind, side, t} per event
         self.injected: List[Dict] = []
+        #: armed link profiles, keyed by arm id (insertion-ordered so
+        #: evaluation order is deterministic)
+        self.profiles: Dict[int, Dict] = {}
+        self._next_profile_id = 1
+        for prof in (profiles or []):
+            self.arm_profile(prof)
+
+    # -------------------------------------------------- link profiles
+    def arm_profile(self, spec: Dict) -> int:
+        """Arm one sustained link profile, mid-run safe; returns the
+        arm id :meth:`disarm_profile` takes.  The flap phase anchors at
+        arm time, so two schedules armed at different moments flap on
+        their own clocks (as two real links would)."""
+        prof = dict(spec)
+        name = prof.get("profile")
+        if name not in _PROFILES:
+            raise ValueError(
+                f"unknown link profile {name!r} (one of {_PROFILES})")
+        side = prof.setdefault("side", "both")
+        if side not in _PROFILE_SIDES:
+            raise ValueError(
+                f"unknown profile side {side!r} "
+                f"(one of {_PROFILE_SIDES})")
+        prof.setdefault("kind", "*")
+        if name == "slow":
+            prof.setdefault("latency", 0.05)
+            prof.setdefault("jitter", 0.0)
+        elif name == "lossy":
+            p = float(prof.setdefault("p", 0.1))
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"lossy profile p={p} not in [0, 1]")
+        elif name == "flap":
+            period = float(prof.setdefault("period", 1.0))
+            duty = float(prof.setdefault("duty", 0.5))
+            if period <= 0.0:
+                raise ValueError("flap profile period must be > 0")
+            if not 0.0 <= duty <= 1.0:
+                raise ValueError(
+                    f"flap profile duty={duty} not in [0, 1]")
+        with self._lock:
+            pid = self._next_profile_id
+            self._next_profile_id += 1
+            prof["_armed_at"] = time.monotonic()
+            self.profiles[pid] = prof
+        return pid
+
+    def disarm_profile(self, pid: int) -> None:
+        """Heal one armed link profile (no-op if already disarmed)."""
+        with self._lock:
+            self.profiles.pop(pid, None)
+
+    def _profile_actions(self, kind: str, side: str,
+                         now: float) -> List[Dict]:
+        """Profile contributions to one frame's actions — caller holds
+        ``_lock``.  Emits the same action dicts the discrete ops do
+        (``delay``/``drop``), tagged with the profile name and arm id
+        in the ledger."""
+        fired: List[Dict] = []
+        for pid, prof in self.profiles.items():
+            if prof["side"] not in ("both", side):
+                continue
+            if prof["kind"] not in ("*", kind):
+                continue
+            name = prof["profile"]
+            if name == "slow":
+                seconds = float(prof["latency"])
+                if prof["jitter"]:
+                    seconds += float(prof["jitter"]) * self._rng.random()
+                fired.append(self._fire_profile(
+                    pid, prof, "delay", kind, side, now, seconds))
+            elif name == "lossy":
+                if self._rng.random() < float(prof["p"]):
+                    fired.append(self._fire_profile(
+                        pid, prof, "drop", kind, side, now))
+            elif name == "partition":
+                fired.append(self._fire_profile(
+                    pid, prof, "drop", kind, side, now))
+            elif name == "flap":
+                period = float(prof["period"])
+                phase = (now - prof["_armed_at"]) % period
+                if phase >= period * float(prof["duty"]):
+                    fired.append(self._fire_profile(
+                        pid, prof, "drop", kind, side, now))
+        return fired
+
+    def _fire_profile(self, pid: int, prof: Dict, op: str, kind: str,
+                      side: str, now: float,
+                      seconds: float = 0.0) -> Dict:
+        action = {"op": op, "kind": kind, "t": now, "side": side,
+                  "seconds": float(seconds),
+                  "profile": prof["profile"], "profile_id": pid}
+        self.injected.append(dict(action))
+        return action
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["FaultSchedule"]:
@@ -125,7 +239,8 @@ class FaultSchedule:
             return None
         payload = json.loads(raw)
         return cls(payload.get("faults", []),
-                   seed=int(payload.get("seed", 0)))
+                   seed=int(payload.get("seed", 0)),
+                   profiles=payload.get("profiles", []))
 
     # ------------------------------------------------------- decisions
     def actions_for(self, kind: str, side: str = "send") -> List[Dict]:
@@ -159,6 +274,11 @@ class FaultSchedule:
                         action["seconds"] += (
                             spec["jitter"] * self._rng.random())
                     fired.append(action)
+            # sustained link profiles degrade every matching frame for
+            # as long as they stay armed, composing after the discrete
+            # ops (a dup'd frame on a slow link is delayed twice, as
+            # two wire traversals would be)
+            fired.extend(self._profile_actions(kind, side, now))
         return fired
 
     def _fire(self, spec: Dict, kind: str, now: float) -> Dict:
@@ -172,6 +292,16 @@ class FaultSchedule:
         with self._lock:
             events = list(self.injected)
         return [e for e in events if op is None or e["op"] == op]
+
+    def profile_fired(self, name: Optional[str] = None) -> List[Dict]:
+        """Ledger entries contributed by link profiles (optionally one
+        profile kind) — the "did the gray failure actually degrade
+        traffic" assertion chaos tests make."""
+        with self._lock:
+            events = list(self.injected)
+        return [e for e in events
+                if "profile" in e
+                and (name is None or e["profile"] == name)]
 
 
 class FaultyFrameConnection(FrameConnection):
@@ -306,28 +436,44 @@ class FaultyRpcStub:
     wedged-master window); ``error`` raises ``RuntimeError``
     (NON-transient — the served-refusal class a retry policy must
     surface immediately).  Firings land in the shared
-    ``schedule.injected`` ledger, same contract as the frame side."""
+    ``schedule.injected`` ledger, same contract as the frame side.
+
+    Every perturbation also stamps :attr:`last_fault` with the fired
+    action, and every raised exception carries the action as its
+    ``injected_fault`` attribute — a delay/stall is otherwise
+    indistinguishable from a genuinely slow RPC at the call site, so
+    without the tag a chaos assertion cannot tell "the caller survived
+    the fault" from "the fault never fired"."""
 
     def __init__(self, stub, schedule: FaultSchedule):
         self._stub = stub
         self.schedule = schedule
+        #: the most recent fired action this stub applied (None until
+        #: the first firing) — the injected-fault tag chaos tests read
+        self.last_fault: Optional[Dict] = None
 
     def _call(self, method: str, fn, payload: bytes, timeout: float):
         for action in self.schedule.actions_for(method, side="send"):
             op = action["op"]
+            self.last_fault = dict(action)
             if op == "delay":
                 time.sleep(action["seconds"])
             elif op in ("drop", "tear"):
-                raise ConnectionError(
-                    f"fault injection: dropped {method} rpc")
+                raise self._tagged(action, ConnectionError(
+                    f"fault injection: dropped {method} rpc"))
             elif op == "stall":
-                raise TimeoutError(
-                    f"fault injection: {method} rpc stalled")
+                raise self._tagged(action, TimeoutError(
+                    f"fault injection: {method} rpc stalled"))
             elif op == "error":
-                raise RuntimeError(
-                    f"fault injection: {method} rpc served an error")
+                raise self._tagged(action, RuntimeError(
+                    f"fault injection: {method} rpc served an error"))
             # dup/reorder have no RPC meaning (unary round trips)
         return fn(payload, timeout=timeout)
+
+    @staticmethod
+    def _tagged(action: Dict, exc: Exception) -> Exception:
+        exc.injected_fault = dict(action)
+        return exc
 
     def get(self, payload: bytes, timeout: float = 0) -> bytes:
         return self._call("get", self._stub.get, payload, timeout)

@@ -1,0 +1,154 @@
+"""Phi-accrual failure detection for the remote replica fabric.
+
+The frame-timeout cliff (``now - last_frame > frame_timeout`` →
+``ConnectionError``) answers one question with one bit: alive or dead.
+Gray failures — a congested link, a degraded NIC, a GC-pausing worker
+— need a *gradient*: how suspicious is this silence, given how this
+replica has actually been talking?  The phi-accrual detector
+(Hayashibara et al., "The φ Accrual Failure Detector", SRDS 2004)
+answers with a continuous suspicion level::
+
+    phi(t) = -log10( P(silence >= t) )
+
+under a Normal fit of the replica's recent frame-interarrival history.
+phi = 1 means a 10% chance the replica is still alive and merely slow;
+phi = 3 means 0.1%.  Callers pick thresholds, not timeouts: a *suspect*
+threshold (demote in placement, keep serving in-flight work) and a
+*dead* threshold (failover), and because phi is computed from the
+replica's OWN arrival statistics, a replica that has always been
+chatty is suspected after a much shorter silence than one that has
+always been bursty — the adaptivity a fixed timeout cannot have.
+
+Determinism: the detector is pure arithmetic over the observations it
+is fed — same intervals, same silence, same phi — which is what the
+seeded chaos tests assert.  The window is bounded (``deque(maxlen)``),
+and below ``min_samples`` observations phi is 0.0: an opening silence
+on a replica with no history is not evidence of anything yet (the hard
+``frame_timeout`` ceiling still covers a worker that never speaks).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Optional
+
+#: Probabilities below this floor clamp — keeps phi finite (~30) so
+#: threshold comparisons stay well-ordered instead of hitting -log(0).
+_MIN_P = 1e-30
+
+
+class PhiAccrualDetector:
+    """Suspicion level of one peer from its frame-interarrival history.
+
+    ``observe(interval)`` feeds one gap between consecutive frames;
+    ``phi(silence)`` converts the current silence into suspicion.
+    ``min_std`` floors the fitted deviation so a metronomically regular
+    peer (std → 0) does not make any micro-jitter look like death.
+    """
+
+    def __init__(self, window: int = 128, min_samples: int = 8,
+                 min_std: float = 0.02):
+        if window < 2:
+            raise ValueError("phi window must hold >= 2 samples")
+        if min_samples < 2:
+            raise ValueError("phi min_samples must be >= 2")
+        self.min_samples = int(min_samples)
+        self.min_std = float(min_std)
+        self._intervals: deque = deque(maxlen=int(window))
+        # running sums maintained alongside the deque so mean/std are
+        # O(1) per step() poll, not O(window); the detector carries
+        # its own lock so writers (the proxy's reader thread) and
+        # readers (step()/metrics pollers on other threads) always
+        # share one lock regardless of what the caller holds
+        self._lock = threading.Lock()
+        self._sum = 0.0
+        self._sum_sq = 0.0
+
+    def observe(self, interval: float) -> None:
+        """One frame-interarrival gap, in seconds (non-positive gaps —
+        two frames drained from one recv batch — carry no timing
+        signal and are ignored)."""
+        if interval <= 0.0:
+            return
+        with self._lock:
+            if len(self._intervals) == self._intervals.maxlen:
+                old = self._intervals[0]
+                self._sum -= old
+                self._sum_sq -= old * old
+            self._intervals.append(interval)
+            self._sum += interval
+            self._sum_sq += interval * interval
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return len(self._intervals)
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._mean_locked()
+
+    def _mean_locked(self) -> float:
+        n = len(self._intervals)
+        return self._sum / n if n else 0.0
+
+    def std(self) -> float:
+        with self._lock:
+            return self._std_locked()
+
+    def _std_locked(self) -> float:
+        n = len(self._intervals)
+        if n < 2:
+            return self.min_std
+        var = max(self._sum_sq / n - (self._sum / n) ** 2, 0.0)
+        return max(math.sqrt(var), self.min_std)
+
+    def phi(self, silence: float) -> float:
+        """Suspicion after ``silence`` seconds without a frame.
+        Monotone non-decreasing in ``silence``; 0.0 until
+        ``min_samples`` intervals have been observed."""
+        if silence <= 0.0:
+            return 0.0
+        with self._lock:
+            if len(self._intervals) < self.min_samples:
+                return 0.0
+            mean = self._mean_locked()
+            std = self._std_locked()
+        # P(X >= silence) for X ~ N(mean, std): the Gaussian survival
+        # function via erfc — numerically stable far into the tail,
+        # where 1 - cdf() would round to 0
+        p = 0.5 * math.erfc((silence - mean) / (std * math.sqrt(2.0)))
+        return -math.log10(max(p, _MIN_P))
+
+    def silence_for_phi(self, target_phi: float) -> Optional[float]:
+        """The silence duration at which suspicion reaches
+        ``target_phi`` (None below ``min_samples``) — lets operators
+        sanity-check a threshold as seconds, the unit they think in."""
+        with self._lock:
+            if len(self._intervals) < self.min_samples:
+                return None
+            mean = self._mean_locked()
+            std = self._std_locked()
+        p = 10.0 ** (-float(target_phi))
+        p = min(max(p, _MIN_P), 1.0)
+        # invert the survival function: silence = mean + std * z(p)
+        z = math.sqrt(2.0) * _erfc_inv(2.0 * p)
+        return mean + std * z
+
+
+def _erfc_inv(y: float) -> float:
+    """Inverse complementary error function via bisection — math has
+    no erfcinv, and this off-hot-path helper only serves the
+    threshold-to-seconds view, so 60 halvings of a bracketed interval
+    beat carrying a rational-approximation table."""
+    y = min(max(y, 2.0 * _MIN_P), 2.0 - 2.0 * _MIN_P)
+    lo, hi = -10.0, 10.0
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if math.erfc(mid) > y:
+            lo = mid
+        else:
+            hi = mid
+    return 0.5 * (lo + hi)

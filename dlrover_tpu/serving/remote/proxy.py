@@ -31,6 +31,7 @@ import numpy as np
 
 from dlrover_tpu.common.constants import ServingFabric
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.remote.phi import PhiAccrualDetector
 from dlrover_tpu.serving.remote.protocol import (
     FrameConnection,
     FrameKind,
@@ -65,11 +66,30 @@ class RemoteReplicaHandle:
         submit_timeout: float = 5.0,
         frame_timeout: float = ServingFabric.FRAME_TIMEOUT,
         fault_schedule=None,
+        phi_suspect: float = ServingFabric.PHI_SUSPECT,
+        phi_dead: float = ServingFabric.PHI_DEAD,
+        phi_kill_floor: Optional[float] = None,
+        phi_window: int = 128,
+        phi_min_samples: int = 8,
     ):
         self.addr = addr
         self.name = name or addr
         self.submit_timeout = float(submit_timeout)
         self.frame_timeout = float(frame_timeout)
+        # phi-accrual detection (serving/remote/phi.py): a suspicion
+        # GRADIENT over frame interarrivals next to the frame_timeout
+        # cliff.  phi >= phi_suspect demotes this replica in placement
+        # (suspect property); phi >= phi_dead AND silence past
+        # phi_kill_floor fails it over EARLY — with the floor unset
+        # (the default) phi never kills, so frame_timeout remains the
+        # sole and unchanged death sentence; it stays the hard ceiling
+        # either way.
+        self.phi_suspect = float(phi_suspect)
+        self.phi_dead = float(phi_dead)
+        self.phi_kill_floor = (
+            None if phi_kill_floor is None else float(phi_kill_floor))
+        self._phi = PhiAccrualDetector(
+            window=phi_window, min_samples=phi_min_samples)
         if fault_schedule is not None:
             # chaos seam (serving/remote/faults.py): perturb this
             # proxy's router->worker frames (SUBMIT/CANCEL/GOODBYE)
@@ -182,6 +202,11 @@ class RemoteReplicaHandle:
         self.frames_received += len(frames)
         self.frame_batches += 1
         with self._lock:
+            # feed the phi detector the interarrival gap BEFORE the
+            # stamp moves: one gap per batch (frames drained together
+            # arrived together — intra-batch gaps are ~0 and carry no
+            # timing signal, observe() ignores them anyway)
+            self._phi.observe(now - self._last_frame)
             self._last_frame = now
             for frame in frames:
                 self._dispatch_locked(frame, now)
@@ -209,7 +234,11 @@ class RemoteReplicaHandle:
                          if frame.get("spans") else [])
                 self._finished.append(SimpleNamespace(
                     rid=rid, output=list(frame["tokens"]),
-                    trace_spans=spans))
+                    trace_spans=spans,
+                    # hedge attempt id echoed from SUBMIT (None from
+                    # unhedged submits and older workers) — lets the
+                    # router audit WHICH dispatch attempt won the race
+                    attempt=frame.get("attempt")))
         elif kind == FrameKind.STATS:
             seq = frame.get("seq")
             seq = int(seq) if isinstance(seq, (int, float)) else None
@@ -315,7 +344,8 @@ class RemoteReplicaHandle:
 
     # -------------------------------------------------- engine protocol
     def add_request(self, prompt, max_new_tokens: int,
-                    trace: Optional[str] = None) -> int:
+                    trace: Optional[str] = None,
+                    attempt: Optional[int] = None) -> int:
         """Synchronous SUBMIT round trip.  An engine-side rejection
         (ERROR frame) raises ``ValueError`` — the router's poison-
         request path; a torn/silent worker raises ``ConnectionError`` —
@@ -346,6 +376,11 @@ class RemoteReplicaHandle:
         try:
             try:
                 extra = {} if trace is None else {"trace": trace}
+                if attempt is not None:
+                    # hedge attempt ordinal (0 = primary dispatch,
+                    # 1+ = hedges); the worker echoes it on DONE so
+                    # the winner of a hedge race is auditable
+                    extra["attempt"] = int(attempt)
                 self._conn.send(
                     FrameKind.SUBMIT, rid=rid,
                     prompt=prompt.tolist(),
@@ -397,12 +432,22 @@ class RemoteReplicaHandle:
         with self._lock:
             if self._dead is not None:
                 raise ConnectionError(self._dead)
-            if now - self._last_frame > self.frame_timeout:
+            silence = now - self._last_frame
+            if silence > self.frame_timeout:
                 raise ConnectionError(
                     f"worker {self.name} silent for "
-                    f"{now - self._last_frame:.1f}s (> frame_timeout "
+                    f"{silence:.1f}s (> frame_timeout "
                     f"{self.frame_timeout}s); last STATS reported "
                     f"{self._worker_inflight} inflight")
+            if (self.phi_kill_floor is not None
+                    and silence >= self.phi_kill_floor):
+                phi = self._phi.phi(silence)
+                if phi >= self.phi_dead:
+                    raise ConnectionError(
+                        f"worker {self.name} phi={phi:.1f} (>= "
+                        f"phi_dead {self.phi_dead}) after "
+                        f"{silence:.2f}s silence; last STATS reported "
+                        f"{self._worker_inflight} inflight")
             finished, self._finished = self._finished, []
             return finished
 
@@ -414,9 +459,37 @@ class RemoteReplicaHandle:
         with self._lock:
             if self._dead is not None or self._finished:
                 return True
-            if time.monotonic() - self._last_frame > self.frame_timeout:
+            silence = time.monotonic() - self._last_frame
+            if silence > self.frame_timeout:
+                return True
+            if (self.phi_kill_floor is not None
+                    and silence >= self.phi_kill_floor
+                    and self._phi.phi(silence) >= self.phi_dead):
                 return True
             return bool(self._inflight)
+
+    # --------------------------------------------- suspicion gradient
+    def phi_value(self, now: Optional[float] = None) -> float:
+        """Current phi-accrual suspicion for this replica (0.0 until
+        the detector has its minimum interarrival history)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._dead is not None:
+                # already past suspicion: the failover path owns a dead
+                # proxy, and the phi gauges must stay finite
+                return 0.0
+            return self._phi.phi(now - self._last_frame)
+
+    def suspect(self, now: Optional[float] = None) -> bool:
+        """True when suspicion crosses ``phi_suspect`` but the replica
+        is not (yet) dead — the gray zone: demote in placement, keep
+        serving in-flight work, no failover."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._dead is not None:
+                return False
+            return self._phi.phi(now - self._last_frame) \
+                >= self.phi_suspect
 
     def slots_free(self) -> int:
         with self._lock:

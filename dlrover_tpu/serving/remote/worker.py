@@ -46,7 +46,8 @@ class FakeEngine:  # dlint: disable=DL011 stands in for the remote worker PROCES
 
     def __init__(self, slots: int = 4, blocks: int = 10_000,
                  block_size: int = 4, tokens_per_step: int = 4,
-                 max_len: int = 4096, step_delay: float = 0.0):
+                 max_len: int = 4096, step_delay: float = 0.0,
+                 content_tokens: bool = False):
         self.max_slots = int(slots)
         self.block_size = int(block_size)
         self.total_blocks = int(blocks)
@@ -55,6 +56,13 @@ class FakeEngine:  # dlint: disable=DL011 stands in for the remote worker PROCES
         self.max_len = int(max_len)
         # per-step sleep: lets chaos tests catch a worker MID-stream
         self.step_delay = float(step_delay)
+        # content-derived tokens: token_i = (prompt hash + i) % 997
+        # instead of rid % 997.  rid-keyed tokens differ across
+        # replicas (each proxy numbers its own submits), so hedging's
+        # byte-identical-stream gate needs tokens that are a function
+        # of the REQUEST, like a greedy LLM's — opt-in so every
+        # existing rid-based assertion stays untouched
+        self.content_tokens = bool(content_tokens)
         self._next = 0
         self.active: Dict[int, dict] = {}
         self.generated_tokens = 0
@@ -80,8 +88,13 @@ class FakeEngine:  # dlint: disable=DL011 stands in for the remote worker PROCES
         self._next += 1
         need = -(-total // self.block_size)
         self.used_blocks += need
+        base = rid
+        if self.content_tokens:
+            base = (int(prompt.astype(np.int64).sum()) * 31
+                    + int(prompt.size))
         self.active[rid] = {
-            "remaining": int(max_new_tokens), "output": [], "blocks": need,
+            "remaining": int(max_new_tokens), "output": [],
+            "blocks": need, "base": base,
         }
         return rid
 
@@ -93,7 +106,12 @@ class FakeEngine:  # dlint: disable=DL011 stands in for the remote worker PROCES
         for rid in list(self.active):
             st = self.active[rid]
             take = min(self.tokens_per_step, st["remaining"])
-            st["output"].extend([rid % 997] * take)
+            if self.content_tokens:
+                pos = len(st["output"])
+                st["output"].extend(
+                    (st["base"] + pos + i) % 997 for i in range(take))
+            else:
+                st["output"].extend([st["base"] % 997] * take)
             st["remaining"] -= take
             self.generated_tokens += take
             if st["remaining"] <= 0:
@@ -188,6 +206,10 @@ class WorkerServer:
         self._erid_by_rid: Dict[int, int] = {}
         self._rid_by_erid: Dict[int, int] = {}
         self._streamed: Dict[int, int] = {}  # erid -> tokens streamed
+        # erid -> hedge attempt ordinal from SUBMIT (absent for
+        # unhedged submits): echoed on DONE so the router can audit
+        # which dispatch attempt won a hedge race
+        self._attempt_by_erid: Dict[int, int] = {}
         # erid -> trace bookkeeping for SUBMITs that carried a
         # traceparent header: worker-side spans (request lifetime,
         # decode steps, engine time) go back on the DONE frame in THIS
@@ -258,6 +280,7 @@ class WorkerServer:
         self._rid_by_erid.clear()
         self._streamed.clear()
         self._trace_by_erid.clear()
+        self._attempt_by_erid.clear()
         conn.send(
             FrameKind.HELLO,
             addr=self.addr,
@@ -335,6 +358,9 @@ class WorkerServer:
                 return True
             self._erid_by_rid[rid] = erid
             self._rid_by_erid[erid] = rid
+            attempt = frame.get("attempt")
+            if isinstance(attempt, int):
+                self._attempt_by_erid[erid] = attempt
             tp = frame.get("trace")
             if isinstance(tp, str) and tp \
                     and self._trace_wanted(tp):
@@ -356,6 +382,7 @@ class WorkerServer:
                 self._rid_by_erid.pop(erid, None)
                 self._streamed.pop(erid, None)
                 self._trace_by_erid.pop(erid, None)
+                self._attempt_by_erid.pop(erid, None)
                 cancel = getattr(self.engine, "cancel", None)
                 if cancel is not None:
                     cancel(erid)
@@ -404,6 +431,7 @@ class WorkerServer:
             sent = self._streamed.pop(ereq.rid, 0)
             trace_kw = self._trace_header(ereq.rid)
             rec = self._trace_by_erid.pop(ereq.rid, None)
+            attempt = self._attempt_by_erid.pop(ereq.rid, None)
             if rid is None:
                 continue  # cancelled while decoding
             self._erid_by_rid.pop(rid, None)
@@ -413,9 +441,12 @@ class WorkerServer:
                           **trace_kw)
             # DONE carries the full output: authoritative completion —
             # plus this worker's spans and a sent_at clock anchor so
-            # the router can graft them into the request's trace
+            # the router can graft them into the request's trace (and
+            # the SUBMIT's hedge attempt ordinal echoed back, when one
+            # rode in)
+            attempt_kw = {} if attempt is None else {"attempt": attempt}
             conn.send(FrameKind.DONE, rid=rid, tokens=out, **trace_kw,
-                      **self._trace_spans(rec))
+                      **self._trace_spans(rec), **attempt_kw)
         if finished:
             self._send_stats(conn)
 
@@ -606,6 +637,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "decode, committing up to K tokens per "
                         "verify dispatch (0 disables)")
     p.add_argument("--step-delay", type=float, default=0.0)
+    p.add_argument("--content-tokens", action="store_true",
+                   help="fake engine: derive tokens from the prompt "
+                        "content instead of the engine-local rid, so "
+                        "two replicas produce identical streams for "
+                        "the same request (the hedging byte-equality "
+                        "gates need this)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stats-interval", type=float,
                    default=ServingFabric.STATS_INTERVAL)
@@ -640,6 +677,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             block_size=args.block_size,
             tokens_per_step=args.tokens_per_step,
             max_len=args.max_len, step_delay=args.step_delay,
+            content_tokens=args.content_tokens,
         )
     from dlrover_tpu.serving.remote.faults import FaultSchedule
 

@@ -154,6 +154,23 @@ class ServingRequest:
     first_token_at: Optional[float] = None
     ttft_recorded: bool = False            # metrics bookkeeping
     finished_at: Optional[float] = None
+    # when the current attempt was handed to its replica (stamped by
+    # ReplicaHandle.submit, cleared by failover requeue) and when the
+    # newest token arrived — together they give time-since-progress,
+    # the signal the hedging sweep compares against its adaptive delay
+    dispatched_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    # hedging stream gate: None = the single attempt streams normally;
+    # a (replica_name, engine_rid) pair = ONLY that attempt's tokens
+    # reach the client stream (the hedge attempt races silently and
+    # can still win via DONE, which flushes the full suffix); a
+    # never-matching sentinel = all incremental tokens suppressed
+    # until DONE (a promoted hedge after the primary died — its early
+    # tokens are already gone, so only the authoritative DONE flush
+    # keeps the stream byte-correct)
+    stream_owner: Optional[tuple] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
     # per-decode-step seconds of the attempt that finished this request
     # (worker-reported over the DONE frame's worker.decode span for
     # remote replicas, engine-timed for in-process ones); feeds the
@@ -217,6 +234,7 @@ class ServingRequest:
             self.first_token_at = now
             if self.trace is not None:
                 self.trace.first_token(now)
+        self.last_token_at = now
         self.output.extend(tokens)
         self._streamed += len(tokens)
         self._events.put(("tokens", list(tokens)))
@@ -300,6 +318,11 @@ class ServingRequest:
         self.first_token_at = None
         self.ttft_recorded = False
         self._streamed = 0
+        # hedging state follows the attempt, not the request: the next
+        # dispatch starts unhedged with a fresh progress clock
+        self.dispatched_at = None
+        self.last_token_at = None
+        self.stream_owner = None
         self._events.put(("restart", None))
 
     def stream(self, timeout: Optional[float] = None) -> Iterator:

@@ -1,0 +1,111 @@
+"""Adaptive request hedging: first-DONE-wins tail tolerance.
+
+"The Tail at Scale" (Dean & Barroso, CACM 2013): when a fleet is
+mostly healthy but a few percent of it is slow — the gray zone the
+phi detector demotes but deliberately does NOT kill — the p99 of the
+whole service is set by the slow few, because every request routed
+there eats the full degraded latency.  The classic fix is a *hedged
+request*: once a dispatched request has gone suspiciously long without
+progress, send a second copy to a different healthy replica and take
+whichever finishes first, cancelling the loser.
+
+Two disciplines keep hedging from becoming a load doubler:
+
+- **adaptive delay**: the hedge fires only after the time-to-next-token
+  exceeds ``delay_factor`` x the rolling fleet p99 of observed token
+  gaps (floored at ``delay_floor_s``; ``default_delay_s`` until enough
+  samples exist).  A healthy fleet's p99 is small but so is the chance
+  of crossing it; a degraded replica's stalled stream crosses it
+  quickly — the hedge rate tracks actual tail badness;
+- **budget**: at most ``budget_fraction`` of in-flight requests may be
+  hedged concurrently AND cumulative hedge dispatches stay under the
+  same fraction of primary submissions (each with a floor of one, so a
+  tiny fleet can still hedge at all).  Denials are counted
+  (``serving_hedge_budget_exhausted_total``) — a saturated budget is a
+  fleet-health signal, not a silent no-op.
+
+The router (``ServingRouter(hedge=HedgePolicy(...))``) owns the
+first-DONE-wins completion, loser CANCEL, and the dedup guards that
+keep the client stream byte-identical to an unhedged run; this module
+is only the when-to-hedge arithmetic, kept separate so the policy is
+testable without a fleet.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class HedgePolicy:
+    """When to hedge: adaptive delay + dispatch budget.
+
+    ``observe()`` is fed every inter-token gap and TTFT the router
+    records (fleet-wide: the delay adapts to what the healthy majority
+    actually does).  All state is bounded and arithmetic deterministic
+    — seeded chaos runs replay exactly.
+    """
+
+    def __init__(
+        self,
+        delay_floor_s: float = 0.05,
+        delay_factor: float = 3.0,
+        budget_fraction: float = 0.1,
+        window: int = 512,
+        default_delay_s: float = 0.25,
+        min_samples: int = 16,
+    ):
+        if delay_factor <= 0:
+            raise ValueError("delay_factor must be > 0")
+        if not 0.0 < budget_fraction <= 1.0:
+            raise ValueError(
+                f"budget_fraction {budget_fraction} not in (0, 1]")
+        self.delay_floor_s = float(delay_floor_s)
+        self.delay_factor = float(delay_factor)
+        self.budget_fraction = float(budget_fraction)
+        self.default_delay_s = float(default_delay_s)
+        self.min_samples = int(min_samples)
+        self._gaps: deque = deque(maxlen=int(window))
+
+    # -------------------------------------------------------- signals
+    def observe(self, gap_s: float) -> None:
+        """One observed progress gap (TTFT or inter-token), seconds."""
+        if gap_s >= 0.0:
+            self._gaps.append(float(gap_s))
+
+    def hedge_delay(self) -> float:
+        """Seconds without progress before a request becomes a hedge
+        candidate: ``max(floor, factor x rolling p99)``, or the
+        configured default while the window is too thin to trust."""
+        if len(self._gaps) < self.min_samples:
+            return max(self.delay_floor_s, self.default_delay_s)
+        ordered = sorted(self._gaps)
+        idx = min(len(ordered) - 1,
+                  int(0.99 * (len(ordered) - 1) + 0.5))
+        return max(self.delay_floor_s,
+                   self.delay_factor * ordered[idx])
+
+    # --------------------------------------------------------- budget
+    def allows(self, active_hedges: int, inflight: int,
+               dispatched_total: int = 0,
+               submitted_total: int = 0) -> bool:
+        """May one more hedge fire right now?  Caps concurrent hedges
+        at ``budget_fraction`` of in-flight AND cumulative dispatches
+        at the same fraction of primary submissions (floors of one:
+        a two-replica fleet must still be able to hedge its single
+        straggler)."""
+        if inflight <= 0:
+            return False
+        if active_hedges + 1 > max(
+                1.0, self.budget_fraction * inflight):
+            return False
+        if submitted_total > 0 and dispatched_total + 1 > max(
+                1.0, self.budget_fraction * submitted_total):
+            return False
+        return True
+
+    @property
+    def samples(self) -> int:
+        return len(self._gaps)
+
+
+__all__ = ["HedgePolicy"]

@@ -35,6 +35,18 @@ Gauge/counter names (stable API, documented in README + PERF.md):
   supervisor stopped respawning (respawn budget exhausted)
 - ``serving_replica_probation``  — replicas in crash-loop probation
   (joined but held out of placement during their cooldown)
+- ``serving_phi_max`` / ``serving_replica_suspect`` — gray-failure
+  detection: the fleet's worst phi-accrual suspicion level and the
+  count of replicas currently demoted in placement (suspected, or
+  inside the flap-damping hold after recovering)
+- ``serving_replica_suspect_{demotions,recoveries}_total`` and
+  ``serving_suspect_flaps_damped_total`` — suspicion lifecycle
+  counters (a flap absorbed by the hold is damped, not a transition)
+- ``serving_hedge_{dispatched,won,cancelled,budget_exhausted,
+  promoted}_total`` + ``serving_hedge_active`` — request hedging:
+  second attempts dispatched, races the hedge won, loser CANCELs,
+  budget denials, primaries-died-hedge-took-over promotions, and the
+  currently-racing count
 - ``serving_{ttft_hist,queue_wait,e2e_latency,decode_step}_seconds``
   — OpenMetrics latency histograms (``_bucket``/``_count``/``_sum``,
   log-spaced buckets) with ``trace_id`` exemplars on the buckets, so
@@ -74,7 +86,7 @@ from dlrover_tpu.utils.profiler import (
 #: hold it — comparing their sums against
 #: ``serving_step_lock_hold_seconds`` attributes the lock's tail.
 STEP_PHASES = (
-    "expire", "cancel", "brownout", "failover", "schedule",
+    "expire", "cancel", "brownout", "failover", "schedule", "hedge",
     "deliver", "pump", "retire", "observe", "autoscale", "flush",
 )
 
@@ -92,6 +104,19 @@ class RouterMetrics:
         self.replica_up = 0.0
         self.replica_draining = 0.0
         self.replica_probation = 0.0
+        # gray-failure plane (phi-accrual suspicion + hedging books),
+        # written by the router's observe sweep each step
+        self.phi_max = 0.0
+        self.replica_suspect = 0.0
+        self.suspect_demotions = 0.0
+        self.suspect_recoveries = 0.0
+        self.suspect_flaps_damped = 0.0
+        self.hedge_active = 0.0
+        self.hedge_dispatched = 0.0
+        self.hedge_won = 0.0
+        self.hedge_cancelled = 0.0
+        self.hedge_budget_exhausted = 0.0
+        self.hedge_promoted = 0.0
         # brown-out ladder position (0 normal .. 3 shed_normal),
         # written by the router's watermark sweep each step
         self.brownout_stage = 0.0
@@ -343,6 +368,21 @@ class RouterMetrics:
             "serving_worker_quarantined_total": float(
                 self.worker_quarantined),
             "serving_replica_probation": self.replica_probation,
+            "serving_phi_max": self.phi_max,
+            "serving_replica_suspect": self.replica_suspect,
+            "serving_replica_suspect_demotions_total":
+                self.suspect_demotions,
+            "serving_replica_suspect_recoveries_total":
+                self.suspect_recoveries,
+            "serving_suspect_flaps_damped_total":
+                self.suspect_flaps_damped,
+            "serving_hedge_active": self.hedge_active,
+            "serving_hedge_dispatched_total": self.hedge_dispatched,
+            "serving_hedge_won_total": self.hedge_won,
+            "serving_hedge_cancelled_total": self.hedge_cancelled,
+            "serving_hedge_budget_exhausted_total":
+                self.hedge_budget_exhausted,
+            "serving_hedge_promoted_total": self.hedge_promoted,
             "serving_brownout_stage": self.brownout_stage,
             "serving_capacity_debt": self.capacity_debt,
             "serving_spec_accept_ratio": self.spec_accept_ratio,

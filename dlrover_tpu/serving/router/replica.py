@@ -29,6 +29,7 @@ import time
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
+    SERVING_REQUEST_TERMINAL_STATES,
     ReplicaStatus,
     ServingRequestState,
 )
@@ -275,6 +276,17 @@ class ReplicaHandle:
         # kept dying right after joining is held out of placement until
         # this monotonic time — set by ReplicaManager.join
         self.probation_until = 0.0
+        # gray-zone state (phi-accrual suspicion, ReplicaManager.
+        # update_suspects): ``suspected`` mirrors the engine's raw phi
+        # verdict; ``demoted`` is the EFFECTIVE placement penalty —
+        # raw suspicion OR the flap-damping hold that keeps a
+        # recovering link demoted until ``demoted_until``, so a
+        # flapping link yields one demote/restore cycle, not one per
+        # flap.  Demotion is a placement ORDERING penalty only: the
+        # replica stays schedulable and its in-flight work continues.
+        self.suspected = False
+        self.demoted = False
+        self.demoted_until = 0.0
         self.inflight: Dict[int, ServingRequest] = {}
         self.generated_tokens = 0
         # requests whose FIRST token arrived in the latest pump —
@@ -293,10 +305,14 @@ class ReplicaHandle:
         try:
             import inspect
 
-            self._engine_takes_trace = "trace" in inspect.signature(
-                engine.add_request).parameters
+            params = inspect.signature(engine.add_request).parameters
+            self._engine_takes_trace = "trace" in params
+            # engines that can tag a submission with its hedge attempt
+            # ordinal (the remote proxy's SUBMIT frame key)
+            self._engine_takes_attempt = "attempt" in params
         except (TypeError, ValueError):
             self._engine_takes_trace = False
+            self._engine_takes_attempt = False
 
     # -------------------------------------------------------- capacity
     def slots_free(self) -> int:
@@ -337,6 +353,29 @@ class ReplicaHandle:
             return list(fn())
         except Exception:
             return []
+
+    def suspect(self, now: Optional[float] = None) -> bool:
+        """The engine's raw phi-accrual verdict (remote proxies expose
+        ``suspect()``; engines without the surface — local adapters,
+        fakes — are never suspect)."""
+        fn = getattr(self.engine, "suspect", None)
+        if fn is None:
+            return False
+        try:
+            return bool(fn(now))
+        except Exception:
+            return False
+
+    def phi_value(self, now: Optional[float] = None) -> float:
+        """Current phi suspicion from the engine (0.0 for engines
+        without a detector) — the ``serving_phi_max`` gauge's feed."""
+        fn = getattr(self.engine, "phi_value", None)
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn(now))
+        except Exception:
+            return 0.0
 
     @property
     def schedulable(self) -> bool:
@@ -389,7 +428,34 @@ class ReplicaHandle:
         req.replica = self.name
         req.engine_rid = erid
         req.state = ServingRequestState.RUNNING
+        req.dispatched_at = time.monotonic()
         self.inflight[erid] = req
+
+    def submit_hedge(self, req: ServingRequest) -> int:
+        """Dispatch a HEDGE attempt of an already-RUNNING request to
+        this replica: the engine decodes it like any other request and
+        this handle tracks it in ``inflight``, but the request's
+        routing identity (``replica``/``engine_rid``/``state``) stays
+        with the primary — first DONE wins, and the router cancels
+        whichever attempt loses.  Engines that accept an ``attempt``
+        kwarg (the remote proxy) get the attempt ordinal, which rides
+        the SUBMIT frame and comes back on DONE for auditability."""
+        if not self.schedulable:
+            raise ReplicaDeadError(f"replica {self.name} not schedulable")
+        if req.state != ServingRequestState.RUNNING:
+            # completed/aborted between the hedge decision and this
+            # delivery: racing a second copy of an answered request
+            # would waste a slot on a stream nobody reads
+            raise StaleRequestError(
+                f"request {req.rid} is {req.state}, not running")
+        if self._engine_takes_attempt:
+            erid = self.engine.add_request(
+                req.prompt, req.max_new_tokens, attempt=1)
+        else:
+            erid = self.engine.add_request(
+                req.prompt, req.max_new_tokens)
+        self.inflight[erid] = req
+        return erid
 
     def pump(self, now: Optional[float] = None) -> List[ServingRequest]:
         """One engine step; returns router requests finished by it.
@@ -416,11 +482,20 @@ class ReplicaHandle:
         if drain is not None:
             for erid, toks, t in drain(now):
                 req = self.inflight.get(erid)
-                if req is not None:
-                    first = req.first_token_at is None
-                    req.push_tokens(toks, t)
-                    if first and req.first_token_at is not None:
-                        self.ttft_pending.append(req)
+                if req is None:
+                    continue
+                owner = req.stream_owner
+                if owner is not None and owner != (self.name, erid):
+                    # hedged request, and this attempt does not own
+                    # the client stream: it races silently (it can
+                    # still WIN via DONE, whose flush delivers the
+                    # full suffix) — forwarding its tokens too would
+                    # interleave two streams into one output
+                    continue
+                first = req.first_token_at is None
+                req.push_tokens(toks, t)
+                if first and req.first_token_at is not None:
+                    self.ttft_pending.append(req)
         done: List[ServingRequest] = []
         # whole-batch decode-step attribution for engines that time
         # their own step (the in-process adapter / FakeEngine); remote
@@ -430,6 +505,14 @@ class ReplicaHandle:
             req = self.inflight.pop(ereq.rid, None)
             if req is None:
                 continue  # e.g. admitted before a drain started
+            if req.state in SERVING_REQUEST_TERMINAL_STATES:
+                # the losing attempt of a hedge race (or a completion
+                # racing a cancel): the request was already answered —
+                # finish() would no-op on the state, but it must not
+                # be double-counted into ``done`` (completed_total
+                # stays exactly one per request, the S9/S10 dedup
+                # contract extended to hedging)
+                continue
             self.generated_tokens += len(ereq.output)
             spans = getattr(ereq, "trace_spans", None)
             if spans:
@@ -527,11 +610,17 @@ class ReplicaManager:
     def __init__(self, heartbeat_timeout: float = 10.0,
                  probation_lifetime: float = 5.0,
                  probation_cooldown: float = 2.0,
-                 probation_max: float = 60.0):
+                 probation_max: float = 60.0,
+                 suspect_hold: float = 1.0):
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.probation_lifetime = float(probation_lifetime)
         self.probation_cooldown = float(probation_cooldown)
         self.probation_max = float(probation_max)
+        # gray-zone flap damping: how long a recovering (phi dropped)
+        # replica STAYS demoted, doubling per recovery like probation's
+        # cooldown — a flapping link must cost one demote/restore
+        # cycle, not an invalidation per flap period
+        self.suspect_hold = float(suspect_hold)
         self.replicas: Dict[str, ReplicaHandle] = {}
         # handles reaped by reap_dead, awaiting router post-mortem
         # (affinity cleanup + cluster-node retirement); drained by
@@ -539,7 +628,15 @@ class ReplicaManager:
         self.dead_handles: List[ReplicaHandle] = []
         # base replica name -> consecutive short-lived deaths
         self._flaps: Dict[str, int] = {}
+        # base replica name -> raw suspect->healthy recoveries (the
+        # suspicion twin of _flaps, same exponential damping)
+        self._suspect_flaps: Dict[str, int] = {}
         self._last_check: Optional[float] = None
+        # suspicion lifecycle counters, mirrored into serving_replica_
+        # suspect_* metrics by the router's observe sweep
+        self.suspect_demotions = 0
+        self.suspect_recoveries = 0
+        self.suspect_flaps_damped = 0
 
     # ------------------------------------------------------ membership
     def join(self, handle: ReplicaHandle,
@@ -579,6 +676,7 @@ class ReplicaManager:
             # unrelated later join of the same name (and the dict must
             # not grow one entry per retired name forever)
             self._flaps.pop(base_replica_name(name), None)
+            self._suspect_flaps.pop(base_replica_name(name), None)
             logger.info("serving replica %s left", name)
         return handle
 
@@ -628,6 +726,54 @@ class ReplicaManager:
         ]
 
     # --------------------------------------------------------- health
+    def update_suspects(self, now: Optional[float] = None) -> int:
+        """One suspicion sweep: poll every pumpable replica's raw phi
+        verdict and fold it into the EFFECTIVE ``demoted`` flag the
+        scheduler weights on.  Demotion follows suspicion immediately;
+        RECOVERY is damped — the demotion holds for ``suspect_hold``
+        (doubling per recovery of the same base name, capped at
+        ``probation_max``), so a link flapping faster than the hold
+        stays continuously demoted: bounded placement churn by
+        construction.  Returns the count of currently demoted replicas
+        (the ``serving_replica_suspect`` gauge)."""
+        now = time.monotonic() if now is None else now
+        demoted_count = 0
+        for handle in self.replicas.values():
+            if not handle.pumpable:
+                continue
+            raw = handle.suspect(now)
+            if raw and not handle.suspected:
+                if now >= handle.demoted_until:
+                    logger.warning(
+                        "serving replica %s suspect (phi=%.1f): "
+                        "demoted in placement, in-flight continues",
+                        handle.name, handle.phi_value(now))
+                else:
+                    # re-suspected inside the hold window: the flap the
+                    # damping exists to absorb — no new transition
+                    self.suspect_flaps_damped += 1
+            elif handle.suspected and not raw:
+                base = base_replica_name(handle.name)
+                n = self._suspect_flaps.get(base, 0) + 1
+                self._suspect_flaps[base] = n
+                hold = min(self.probation_max,
+                           self.suspect_hold * (2 ** (n - 1)))
+                handle.demoted_until = max(
+                    handle.demoted_until, now + hold)
+                self.suspect_recoveries += 1
+            handle.suspected = raw
+            demoted = raw or now < handle.demoted_until
+            if demoted and not handle.demoted:
+                self.suspect_demotions += 1
+            elif not demoted and handle.demoted:
+                logger.info(
+                    "serving replica %s recovered: full placement "
+                    "weight restored (no failover)", handle.name)
+            handle.demoted = demoted
+            if demoted:
+                demoted_count += 1
+        return demoted_count
+
     def reap_dead(self, now: Optional[float] = None
                   ) -> List[ServingRequest]:
         """Declare failed / heartbeat-stale replicas DEAD and return

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from dlrover_tpu.common.constants import (
+    SERVING_REQUEST_TERMINAL_STATES,
     ReplicaStatus,
     ServingRequestState,
 )
@@ -63,6 +64,7 @@ from dlrover_tpu.serving.router.gateway import (
     RequestGateway,
     ServingRequest,
 )
+from dlrover_tpu.serving.router.hedge import HedgePolicy
 from dlrover_tpu.serving.router.metrics import RouterMetrics
 from dlrover_tpu.serving.router.replica import (
     ReplicaDeadError,
@@ -119,6 +121,7 @@ class ServingRouter:
         slo=None,
         step_engine: str = "event",
         tenant_spec_file: Optional[str] = None,
+        hedge: Optional[HedgePolicy] = None,
     ):
         if step_engine not in self.STEP_ENGINES:
             raise ValueError(
@@ -161,6 +164,29 @@ class ServingRouter:
         # sampled by the autoscaler next to the load windows.  None
         # (default) keeps the historical load-only behavior.
         self.slo = slo
+        # gray-failure hedging ("The Tail at Scale"): when armed, the
+        # step loop re-dispatches a stalled RUNNING request to a second
+        # healthy replica — first DONE wins, the loser is CANCELled,
+        # and the client stream stays byte-identical to an unhedged
+        # run (stream_owner gate + the pump's terminal-state dedup).
+        # None (default) keeps the historical single-attempt behavior:
+        # the S1-S13 chaos rows and the step-engine equivalence suite
+        # run byte-for-byte unchanged with hedging disarmed.
+        self.hedge = hedge
+        # rid -> live hedge record ({"req", "primary_name",
+        # "primary_erid", "hedge_name", "hedge_erid"}); touched only
+        # on the single-threaded step path (decisions under the step
+        # lock, deliveries right after release — same discipline as
+        # placements)
+        self._hedges: Dict[int, dict] = {}
+        self.hedge_dispatched = 0
+        self.hedge_won = 0
+        self.hedge_cancelled = 0
+        self.hedge_budget_exhausted = 0
+        self.hedge_promoted = 0
+        # demoted-replica count from the latest suspicion sweep (the
+        # serving_replica_suspect gauge's feed)
+        self._suspect_count = 0
         self.autoscaler = None  # attached via ServingAutoScaler(router=...)
         # replica base name -> the control-plane trace that created it
         # ({"trace_id", "span_id", ...attrs}): written by the autoscale
@@ -415,7 +441,11 @@ class ServingRouter:
             t_prev = t
             mark("failover")
 
-            # 2. failover: reap dead replicas, requeue their in-flight
+            # 2. health + failover: fold each replica's raw phi
+            # verdict into its effective demotion flag (gray zone —
+            # placement weight only, NO failover), then reap the
+            # actually-dead and requeue their in-flight
+            self._suspect_count = self.manager.update_suspects(now)
             self._reap(now, dumps=dumps)
             t = perf()
             phase("failover", t - t_prev)
@@ -445,6 +475,20 @@ class ServingRouter:
                     self._link_attempt_origin(handle, req)
             t = perf()
             phase("schedule", t - t_prev)
+            t_prev = t
+            mark("hedge")
+
+            # 3h. hedge DECISIONS (arithmetic over the live ledgers,
+            # step lock held): RUNNING requests whose time-since-
+            # progress exceeds the policy's adaptive delay get a
+            # second attempt queued toward a healthy replica; the
+            # deliveries ride the out-of-lock block below exactly
+            # like placements (submit_hedge is a frame send)
+            hedge_dispatches: List[tuple] = []
+            if self.hedge is not None:
+                self._plan_hedges(now, hedge_dispatches)
+            t = perf()
+            phase("hedge", t - t_prev)
             self.metrics.observe_step_lock(t - t_lock)
         # 3b. placement DELIVERY outside the step lock: for a remote
         # replica, submit is a SUBMIT frame send plus a synchronous ack
@@ -518,6 +562,34 @@ class ServingRouter:
                 handle.fail()
                 with self._lock:
                     self._reap(now, extra=[req], dumps=dumps)
+        # 3h-delivery: hedge dispatches, also outside the lock.  A
+        # reap raced in by a placement failure above may have settled
+        # a record already — those dispatches are skipped, not sent.
+        for target, req, rec in hedge_dispatches:
+            with self._lock:
+                if self._hedges.get(req.rid) is not rec:
+                    continue
+            try:
+                rec["hedge_erid"] = target.submit_hedge(req)
+            except (StaleRequestError, ReplicaDeadError):
+                # answered, or the target went unschedulable, between
+                # decision and delivery: the request simply continues
+                # single-attempt — a hedge is an optimization, never
+                # an error path
+                self._unwind_hedge(rec)
+            except Exception:
+                logger.warning(
+                    "hedge dispatch of request %s on replica %s "
+                    "failed; failing it over", req.rid, target.name)
+                self._unwind_hedge(rec)
+                target.fail()
+                with self._lock:
+                    self._reap(now, dumps=dumps)
+            else:
+                self.recorder.record(
+                    "hedge_dispatched", rid=req.rid,
+                    primary=rec["primary_name"], replica=target.name,
+                    now=now)
         phase("deliver", perf() - t_prev)
         with self._lock:
             t_lock = t_prev = perf()
@@ -555,6 +627,16 @@ class ServingRouter:
                         self.metrics.observe_decode_step(
                             req.decode_step_seconds,
                             trace_id=_tid(req))
+                    if self.hedge is not None:
+                        self._feed_hedge_policy(req)
+                    if self._hedges:
+                        rec = self._hedges.pop(req.rid, None)
+                        if rec is not None:
+                            # first DONE wins: this handle's attempt
+                            # answered the caller; the loser is
+                            # withdrawn and CANCELled below
+                            self._resolve_hedge(
+                                rec, handle, cancels, now)
                 completed.extend(done)
             # TTFT for still-running requests whose FIRST token arrived
             # this round: pump stages them in handle.ttft_pending, so
@@ -656,6 +738,27 @@ class ServingRouter:
                 getattr(self.scheduler, "capacity_evals", 0))
             self.metrics.sched_rounds_skipped = float(
                 getattr(self.scheduler, "rounds_skipped", 0))
+            # gray-failure plane: suspicion + hedging books (plain
+            # attribute reads; phi_value is cached arithmetic on the
+            # proxy's interarrival window, no I/O under the lock)
+            self.metrics.replica_suspect = float(self._suspect_count)
+            self.metrics.phi_max = max(
+                (h.phi_value(now)
+                 for h in self.manager.replicas.values()),
+                default=0.0)
+            self.metrics.suspect_demotions = float(
+                self.manager.suspect_demotions)
+            self.metrics.suspect_recoveries = float(
+                self.manager.suspect_recoveries)
+            self.metrics.suspect_flaps_damped = float(
+                self.manager.suspect_flaps_damped)
+            self.metrics.hedge_active = float(len(self._hedges))
+            self.metrics.hedge_dispatched = float(self.hedge_dispatched)
+            self.metrics.hedge_won = float(self.hedge_won)
+            self.metrics.hedge_cancelled = float(self.hedge_cancelled)
+            self.metrics.hedge_budget_exhausted = float(
+                self.hedge_budget_exhausted)
+            self.metrics.hedge_promoted = float(self.hedge_promoted)
             t = perf()
             phase("observe", t - t_prev)
             self.metrics.observe_step_lock(t - t_lock)
@@ -714,6 +817,10 @@ class ServingRouter:
         (step lock held): state flip, accounting, recorder event, the
         CANCEL delivery queued for after lock release."""
         del handle.inflight[erid]
+        # a hedged request goes down whole: its second attempt is
+        # withdrawn too, or it would decode into a dropped stream and
+        # its DONE would race the abort
+        self._clear_hedge_attempts(req, cancels)
         if cancelled:
             state = ServingRequestState.CANCELLED
             self.gateway.cancelled += 1
@@ -878,7 +985,17 @@ class ServingRouter:
             for erid, req in list(handle.inflight.items()):
                 if req.priority != PRIORITY_BATCH:
                     continue
+                if handle.inflight.get(erid) is not req:
+                    # already withdrawn this round (a hedge mate's
+                    # clearing removed it from under the snapshot)
+                    continue
                 del handle.inflight[erid]
+                cancels.append((handle, erid))
+                if req.state in SERVING_REQUEST_TERMINAL_STATES:
+                    # the other attempt of a hedged request was
+                    # aborted first: accounted once already
+                    continue
+                self._clear_hedge_attempts(req, cancels)
                 req.abort(ServingRequestState.CANCELLED)
                 self.gateway.cancelled += 1
                 if self.slo is not None:
@@ -889,9 +1006,235 @@ class ServingRouter:
                 self.recorder.record(
                     "brownout_cancel_inflight", rid=req.rid,
                     replica=handle.name, now=now)
-                cancels.append((handle, erid))
                 if req.trace is not None:
                     dumps.append(("brownout_shed", req.trace.trace_id))
+
+    # ---------------------------------------------------- hedging (3h)
+    def _plan_hedges(self, now: float,
+                     dispatches: List[tuple]) -> None:
+        """Hedge DECISIONS (step lock held, arithmetic only): find the
+        RUNNING primary attempts whose time-since-progress exceeds the
+        policy's adaptive delay, pick a healthy (non-demoted) second
+        replica with real capacity for each, and queue the dispatch
+        for the out-of-lock delivery block.  BATCH-band requests are
+        never hedged while a brown-out is shedding: hedging doubles a
+        request's load, and the ladder exists because load already
+        won."""
+        policy = self.hedge
+        primaries = []
+        for handle in self.manager.pumpable():
+            for erid, req in handle.inflight.items():
+                # the hedge attempt of an already-hedged request also
+                # lives in an inflight map — only PRIMARY attempts
+                # (the request's own routing identity) are candidates
+                if req.engine_rid == erid and req.replica == handle.name:
+                    primaries.append((handle, erid, req))
+        if not primaries:
+            return
+        delay = policy.hedge_delay()
+        shedding = (self.brownout is not None
+                    and self.brownout.stage > 0)
+        stalled = []
+        for handle, erid, req in primaries:
+            if (req.rid in self._hedges
+                    or req.state != ServingRequestState.RUNNING
+                    or req.dispatched_at is None
+                    # a non-None owner is a promoted hedge running
+                    # DONE-flush-only: re-gating its stream to a new
+                    # attempt would deliver a suffix with no prefix
+                    or req.stream_owner is not None):
+                continue
+            if shedding and req.priority == PRIORITY_BATCH:
+                continue
+            last = (req.last_token_at if req.last_token_at is not None
+                    else req.dispatched_at)
+            if now - last > delay:
+                stalled.append((now - last, handle, erid, req))
+        # worst stall first: when the budget only covers some, it
+        # covers the requests that need it most
+        stalled.sort(key=lambda s: -s[0])
+        for stall, handle, erid, req in stalled:
+            if not policy.allows(
+                    len(self._hedges), len(primaries),
+                    dispatched_total=self.hedge_dispatched,
+                    submitted_total=self.gateway.submitted):
+                # a saturated budget is a fleet-health signal, not a
+                # silent no-op — count every denial
+                self.hedge_budget_exhausted += 1
+                break
+            target = self._hedge_target(req, now)
+            if target is None:
+                continue
+            rec = {"req": req, "primary_name": handle.name,
+                   "primary_erid": erid, "hedge_name": target.name,
+                   "hedge_erid": None}
+            # gate the client stream to the primary attempt BEFORE
+            # the second copy can emit: two attempts, one stream
+            req.stream_owner = (handle.name, erid)
+            self._hedges[req.rid] = rec
+            self.hedge_dispatched += 1
+            dispatches.append((target, req, rec))
+
+    def _hedge_target(self, req: ServingRequest,
+                      now: float) -> Optional[ReplicaHandle]:
+        """The healthiest second replica for a hedge: schedulable,
+        NOT demoted (hedging onto a gray replica buys nothing), not
+        the primary, with a free slot and the KV blocks the request
+        actually needs — fit checked against REAL capacity, the same
+        rules placement uses."""
+        best = None
+        best_key = None
+        for h in self.manager.schedulable(now):
+            if h.name == req.replica or h.demoted:
+                continue
+            try:
+                slots = h.slots_free()
+                if slots <= 0:
+                    continue
+                blocks = h.blocks_free()
+                need = h.blocks_needed(
+                    int(req.prompt.size), req.max_new_tokens)
+                if need is not None and blocks < need:
+                    continue
+            except Exception:
+                continue  # a dying replica's ledger is not capacity
+            key = (slots, blocks)
+            if best_key is None or key > best_key:
+                best, best_key = h, key
+        return best
+
+    def _unwind_hedge(self, rec: dict) -> None:
+        """A hedge dispatch failed to deliver: drop the record and
+        reopen the stream gate — the request continues single-attempt
+        (runs outside the step's critical section, so it re-takes the
+        lock for the record table)."""
+        req = rec["req"]
+        with self._lock:
+            if self._hedges.get(req.rid) is rec:
+                del self._hedges[req.rid]
+            if req.stream_owner == (rec["primary_name"],
+                                    rec["primary_erid"]):
+                req.stream_owner = None
+
+    def _clear_hedge_attempts(self, req: ServingRequest,
+                              cancels: List[tuple]) -> None:
+        """An abort path (cancel / expiry / brown-out shed) is taking
+        the request down: withdraw whichever of its attempts are still
+        in an inflight map and queue their CANCELs (step lock held)."""
+        rec = self._hedges.pop(req.rid, None)
+        if rec is None:
+            return
+        for name, erid in ((rec["primary_name"], rec["primary_erid"]),
+                           (rec["hedge_name"], rec["hedge_erid"])):
+            if erid is None:
+                continue
+            h = self.manager.get(name)
+            if h is not None and h.inflight.get(erid) is req:
+                del h.inflight[erid]
+                cancels.append((h, erid))
+
+    def _resolve_hedge(self, rec: dict, winner: ReplicaHandle,
+                       cancels: List[tuple], now: float) -> None:
+        """First DONE wins (step lock held): count the winner, pull
+        the losing attempt out of its handle's inflight map and queue
+        its CANCEL.  The loser's own DONE, if the CANCEL loses the
+        race, hits the pump's terminal-state dedup guard and is
+        dropped — completed_total stays exactly one per request."""
+        req = rec["req"]
+        if winner.name == rec["hedge_name"]:
+            self.hedge_won += 1
+        for name, erid in ((rec["primary_name"], rec["primary_erid"]),
+                           (rec["hedge_name"], rec["hedge_erid"])):
+            if erid is None:
+                continue
+            h = self.manager.get(name)
+            if h is None or h.inflight.get(erid) is not req:
+                continue
+            del h.inflight[erid]
+            cancels.append((h, erid))
+            self.hedge_cancelled += 1
+        self.recorder.record(
+            "hedge_resolved", rid=req.rid, winner=winner.name,
+            hedged_to=rec["hedge_name"], now=now)
+
+    def _settle_hedged_orphans(self, orphans: List[ServingRequest],
+                               now: float) -> List[ServingRequest]:
+        """Failover meets hedging (step lock held): a hedged request
+        appears in the orphan drain once per attempt a dead replica
+        held.  Hedge replica died -> drop the attempt, the primary
+        continues untouched (no requeue).  Primary died with the
+        hedge still live -> PROMOTE the hedge in place of requeueing:
+        the request's routing identity moves to the hedge attempt,
+        the client stream restarts, and only the authoritative DONE
+        flush delivers tokens (the attempt raced silently, so its
+        early tokens cannot be re-streamed incrementally).  Both
+        died -> one ordinary failover requeue."""
+        out: List[ServingRequest] = []
+        seen: set = set()
+        for req in orphans:
+            rec = self._hedges.get(req.rid)
+            if rec is None:
+                out.append(req)
+                continue
+            if req.rid in seen:
+                continue  # second appearance: both attempts died
+            seen.add(req.rid)
+            primary = self.manager.get(rec["primary_name"])
+            primary_live = (
+                primary is not None
+                and primary.inflight.get(rec["primary_erid"]) is req)
+            hedge = (self.manager.get(rec["hedge_name"])
+                     if rec["hedge_erid"] is not None else None)
+            hedge_live = (
+                hedge is not None
+                and hedge.inflight.get(rec["hedge_erid"]) is req)
+            if primary_live and hedge_live:
+                # defensive: neither attempt actually died (an extra
+                # orphan aliased the rid) — leave the race running
+                continue
+            del self._hedges[req.rid]
+            if primary_live:
+                # the hedge side died; the primary still decodes —
+                # reopen its stream gate and carry on
+                if req.state == ServingRequestState.RUNNING:
+                    req.stream_owner = None
+                continue
+            if hedge_live:
+                # primary died: zero lost requests WITHOUT a replay —
+                # the hedge attempt becomes the request
+                req.replica = rec["hedge_name"]
+                req.engine_rid = rec["hedge_erid"]
+                req.restart_stream()
+                # never-matching owner: incremental tokens stay
+                # suppressed; the DONE flush (streamed position just
+                # reset to 0) delivers the full output byte-correct
+                req.stream_owner = ("", -1)
+                req.dispatched_at = now
+                self.hedge_promoted += 1
+                self.recorder.record(
+                    "hedge_promoted", rid=req.rid,
+                    replica=rec["hedge_name"], now=now)
+                logger.info(
+                    "request %s: primary replica died, hedge attempt "
+                    "on %s promoted (no requeue)",
+                    req.rid, rec["hedge_name"])
+                continue
+            out.append(req)  # both attempts gone: standard failover
+        return out
+
+    def _feed_hedge_policy(self, req: ServingRequest) -> None:
+        """Completion-time progress samples for the hedge delay's
+        rolling p99: the winning attempt's TTFT and its mean
+        inter-token pace (bounded: two observations per completion)."""
+        policy = self.hedge
+        if req.dispatched_at is None or req.finished_at is None:
+            return
+        if req.first_token_at is not None:
+            policy.observe(
+                max(0.0, req.first_token_at - req.dispatched_at))
+        span = req.finished_at - req.dispatched_at
+        if req.output and span >= 0:
+            policy.observe(span / len(req.output))
 
     def _link_attempt_origin(self, handle: ReplicaHandle,
                              req: ServingRequest) -> None:
@@ -932,6 +1275,8 @@ class ServingRouter:
         are appended to ``dumps`` — the step lock is held here, and
         serializing span trees + logging belongs after its release."""
         orphans = (extra or []) + self.manager.reap_dead(now)
+        if self._hedges:
+            orphans = self._settle_hedged_orphans(orphans, now)
         self._requeue(orphans, dumps, now=now)
         for handle in self.manager.dead_handles:
             self.scheduler.forget_replica(handle.name)

@@ -83,11 +83,25 @@ class ContinuousBatchScheduler:
         prefix_tokens: int = 32,
         affinity_cap: int = 512,
         incremental: bool = True,
+        suspect_weight: float = 0.25,
     ):
         self.block_size = int(block_size)
         self.schedule_window = int(schedule_window)
         self.prefix_tokens = int(prefix_tokens)
         self.affinity_cap = int(affinity_cap)
+        # gray-zone demotion: a phi-suspect (demoted) replica's
+        # capacity is multiplied by this weight in every ORDERING
+        # comparison — least-loaded ranking, the candidate heap, the
+        # affinity pick — so new work prefers healthy replicas.  FIT
+        # checks stay on REAL capacity: a demoted replica still takes
+        # work nothing else can hold (demotion, never starvation), and
+        # since ordering can't change whether a request fits, a
+        # suspicion flip needs no index invalidation — the heap is
+        # rebuilt from the live ledger every round anyway
+        if not 0.0 <= float(suspect_weight) <= 1.0:
+            raise ValueError(
+                f"suspect_weight {suspect_weight} not in [0, 1]")
+        self.suspect_weight = float(suspect_weight)
         # the step-engine seam: ServingRouter(step_engine=...) sets
         # this to match (sweep keeps the historical full rescan)
         self.incremental = bool(incremental)
@@ -141,6 +155,12 @@ class ContinuousBatchScheduler:
             if n is not None:
                 return float(n)
         return float(self.blocks_needed(req))
+
+    def _weight(self, handle) -> float:
+        """Ordering weight of one replica: ``suspect_weight`` while
+        demoted (gray zone / flap-damping hold), else 1.0."""
+        return (self.suspect_weight
+                if getattr(handle, "demoted", False) else 1.0)
 
     # ------------------------------------------------------- schedule
     def schedule(
@@ -230,7 +250,8 @@ class ContinuousBatchScheduler:
                     affinity_hit = True
             best = max(
                 cands,
-                key=lambda h: (free[h.name][0], free[h.name][1]),
+                key=lambda h: (free[h.name][0] * self._weight(h),
+                               free[h.name][1] * self._weight(h)),
             )
             placed = self._commit(gateway, placements, free, best, req,
                                   len(cands), affinity_hit, now)
@@ -249,10 +270,14 @@ class ContinuousBatchScheduler:
             self.rounds_skipped += 1
             return []
         by_name = {h.name: h for h in replicas}
-        # max-heap by (slots, blocks), name tiebreak; entries are
-        # invalidated lazily by comparing against the live ledger
+        # demotion weights, read once per round: ordering keys are
+        # weighted so suspects sink, while fit checks below stay on
+        # the REAL ledger values
+        wt = {name: self._weight(by_name[name]) for name in free}
+        # max-heap by weighted (slots, blocks), name tiebreak; entries
+        # are invalidated lazily by comparing against the live ledger
         heap = [
-            (-f[0], -f[1], name)
+            (-f[0] * wt[name], -f[1] * wt[name], name)
             for name, f in free.items() if f[0] > 0
         ]
         heapq.heapify(heap)
@@ -295,7 +320,8 @@ class ContinuousBatchScheduler:
                             continue
                         self.capacity_evals += 1
                         if f[1] >= self._need(by_name[name], req):
-                            fitting.append((f[0], f[1], name))
+                            fitting.append((f[0] * wt[name],
+                                            f[1] * wt[name], name))
                     if fitting:
                         best = by_name[max(fitting)[2]]
                         affinity_hit = True
@@ -309,7 +335,8 @@ class ContinuousBatchScheduler:
                     neg_s, neg_b, name = heapq.heappop(heap)
                     f = free.get(name)
                     if f is None or f[0] <= 0 or \
-                            (-neg_s, -neg_b) != (f[0], f[1]):
+                            (-neg_s, -neg_b) != (f[0] * wt[name],
+                                                 f[1] * wt[name]):
                         continue  # stale entry; a fresh one exists
                     self.capacity_evals += 1
                     cand_count += 1
@@ -331,7 +358,9 @@ class ContinuousBatchScheduler:
                     self.route_placements += 1
                 f = free[best.name]
                 if f[0] > 0:
-                    heapq.heappush(heap, (-f[0], -f[1], best.name))
+                    w = wt[best.name]
+                    heapq.heappush(
+                        heap, (-f[0] * w, -f[1] * w, best.name))
         self._idle_marker = marker if not placements else None
         return placements
 

@@ -80,6 +80,67 @@ METRIC_HELP: Dict[str, str] = {
         "BATCH admissions shed, 2 queued+in-flight BATCH cancelled, "
         "3 new NORMAL admissions shed too — HIGH is never shed"
     ),
+    # -- gray-failure plane (phi-accrual suspicion + request hedging,
+    # -- fed by ServingRouter.step's observe sweep) --------------------
+    "serving_phi_max": (
+        "worst phi-accrual suspicion level across the fleet's remote "
+        "replicas (Hayashibara SRDS 2004: -log10 P(silence this long "
+        "| the replica is healthy)) — crosses phi_suspect into "
+        "demotion, phi_dead into failover"
+    ),
+    "serving_replica_suspect": (
+        "replicas currently demoted in placement by the gray-failure "
+        "detector: phi-suspect now, or inside the flap-damping hold "
+        "after a recovery — demoted replicas keep serving their "
+        "in-flight work and never fail over on suspicion alone"
+    ),
+    "serving_replica_suspect_demotions_total": (
+        "healthy->demoted transitions: a replica's interarrival phi "
+        "crossed the suspect threshold and its placement weight was "
+        "penalized (no failover, no lost requests)"
+    ),
+    "serving_replica_suspect_recoveries_total": (
+        "suspect->healthy raw transitions: the replica's phi dropped "
+        "back below the suspect threshold (full placement weight "
+        "restores after the flap-damping hold elapses)"
+    ),
+    "serving_suspect_flaps_damped_total": (
+        "re-suspicions absorbed inside the flap-damping hold: the "
+        "link flapped faster than the (exponentially growing) hold, "
+        "so the replica just stayed demoted — no placement churn"
+    ),
+    "serving_hedge_active": (
+        "requests currently racing two attempts (a hedge dispatched, "
+        "neither DONE yet) — bounded by the hedge budget fraction of "
+        "in-flight"
+    ),
+    "serving_hedge_dispatched_total": (
+        "second attempts dispatched by the hedging sweep: a RUNNING "
+        "request went longer than the adaptive hedge delay (factor x "
+        "rolling p99 progress gap) without a token, and a healthy "
+        "second replica raced it — first DONE wins"
+    ),
+    "serving_hedge_won_total": (
+        "hedge races the SECOND attempt won: the straggling primary "
+        "was beaten by the hedge replica's DONE (the tail-latency "
+        "cut hedging exists to buy)"
+    ),
+    "serving_hedge_cancelled_total": (
+        "losing hedge-race attempts withdrawn with a CANCEL after "
+        "the winner's DONE (each hedged completion cancels exactly "
+        "one loser; the loser's late DONE is deduplicated)"
+    ),
+    "serving_hedge_budget_exhausted_total": (
+        "hedge dispatches denied by the budget (concurrent hedges or "
+        "cumulative dispatches past the configured fraction) — a "
+        "saturated budget means more of the fleet is slow than "
+        "hedging can paper over"
+    ),
+    "serving_hedge_promoted_total": (
+        "hedge attempts promoted to primary because the primary "
+        "replica DIED mid-race: the request completed on the hedge "
+        "without a failover requeue (zero lost, zero replayed)"
+    ),
     "serving_capacity_debt": (
         "capacity debts currently open: quarantined workers or "
         "probationary replicas whose replacement node has been "
@@ -280,7 +341,7 @@ METRIC_HELP: Dict[str, str] = {
     ),
     "serving_step_phase_seconds": (
         "wall seconds per router step phase, labeled phase=\"expire|"
-        "cancel|brownout|failover|schedule|deliver|pump|retire|"
+        "cancel|brownout|failover|schedule|hedge|deliver|pump|retire|"
         "observe|autoscale|flush\" — where one step round's time went "
         "(deliver/flush run OUTSIDE the step lock by the DL007 "
         "discipline; the rest hold it)"

@@ -1818,6 +1818,80 @@ def test_dl013_splat_senders_resolved_and_open_kinds_skipped(tmp_path):
         "an unresolvable splat opens the kind: no read can be proven dead"
 
 
+def test_dl013_attempt_style_echo_key_read_both_sides_is_quiet(tmp_path):
+    """The hedging fabric's ``attempt`` contract in miniature: a key
+    rides the request frame, the worker reads it AND echoes it back on
+    the completion frame, and the submitter reads the echo.  Sent and
+    read on BOTH kinds -> the drift checker stays quiet with NO
+    optional-key declaration (declaring a key that is read would
+    itself be flagged stale)."""
+    result = _scan(tmp_path, {
+        "proto.py": """
+            class FrameKind:
+                SUBMIT = "SUBMIT"
+                DONE = "DONE"
+        """,
+        "sender.py": """
+            from proto import FrameKind
+
+            def submit(conn, rid, attempt):
+                conn.send(FrameKind.SUBMIT, rid=rid, attempt=attempt)
+
+            def done(conn, rid, attempt):
+                conn.send(FrameKind.DONE, rid=rid, attempt=attempt)
+        """,
+        "recv.py": """
+            from proto import FrameKind
+
+            def handle(frame):
+                kind = frame.get("kind")
+                if kind == FrameKind.SUBMIT:
+                    return frame["rid"], frame.get("attempt")
+                if kind == FrameKind.DONE:
+                    return frame["rid"], frame.get("attempt")
+        """,
+    }, config=_dl013_config())
+    assert _codes(result) == []
+
+
+def test_dl013_attempt_key_with_no_reader_flags_both_kinds(tmp_path):
+    """The drift the checker exists for: a refactor drops the attempt
+    ordinal's consumers entirely -> the key is dead freight on BOTH
+    kinds that ship it, one finding per send site.  (A key still read
+    on ANY kind is deliberately quiet everywhere: cross-kind echo
+    chains like SUBMIT->DONE stay one schema.)"""
+    result = _scan(tmp_path, {
+        "proto.py": """
+            class FrameKind:
+                SUBMIT = "SUBMIT"
+                DONE = "DONE"
+        """,
+        "sender.py": """
+            from proto import FrameKind
+
+            def submit(conn, rid, attempt):
+                conn.send(FrameKind.SUBMIT, rid=rid, attempt=attempt)
+
+            def done(conn, rid, attempt):
+                conn.send(FrameKind.DONE, rid=rid, attempt=attempt)
+        """,
+        "recv.py": """
+            from proto import FrameKind
+
+            def handle(frame):
+                kind = frame.get("kind")
+                if kind == FrameKind.SUBMIT:
+                    return frame["rid"]
+                if kind == FrameKind.DONE:
+                    return frame["rid"]
+        """,
+    }, config=_dl013_config())
+    assert sorted(_codes(result)) == ["DL013", "DL013"]
+    assert all("'attempt'" in v.message for v in result.new)
+    kinds = {v.message.split(" on ")[1].split()[0] for v in result.new}
+    assert kinds == {"SUBMIT", "DONE"}
+
+
 def test_dl013_suppression_on_send_line(tmp_path):
     result = _scan(tmp_path, {
         "proto.py": _PROTO13,

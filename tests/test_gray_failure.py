@@ -1,0 +1,816 @@
+"""Gray-failure tolerance suite (ISSUE 20): phi-accrual detection,
+latency-aware demotion with flap damping, first-done-wins request
+hedging, and the sustained link-degradation chaos plane.
+
+The acceptance bar: a sustained slow link produces ZERO failovers —
+the replica is demoted in placement while its in-flight work finishes,
+then restored when the link heals; an asymmetric partition (one
+direction blackholed, the other fine) DOES fail over with zero lost
+requests; a flapping link yields one demote/restore cycle, not one
+per flap; and a hedged straggler completes exactly once with a
+byte-identical client stream, inside the hedge budget.  Everything
+runs on the in-thread worker fabric with seeded fault schedules —
+deterministic, no subprocesses except the @slow soak.
+"""
+
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+msgpack = pytest.importorskip(
+    "msgpack", reason="remote fabric frames are msgpack")
+
+from dlrover_tpu.common.constants import (  # noqa: E402
+    ServingFabric,
+    ServingRequestState,
+)
+from dlrover_tpu.serving.remote.faults import (  # noqa: E402
+    FaultSchedule,
+    FaultyRpcStub,
+)
+from dlrover_tpu.serving.remote.phi import PhiAccrualDetector  # noqa: E402
+from dlrover_tpu.serving.remote.proxy import RemoteReplicaHandle  # noqa: E402
+from dlrover_tpu.serving.remote.worker import (  # noqa: E402
+    FakeEngine,
+    WorkerServer,
+)
+from dlrover_tpu.serving.router import (  # noqa: E402
+    ContinuousBatchScheduler,
+    ServingRouter,
+)
+from dlrover_tpu.serving.router.gateway import (  # noqa: E402
+    PRIORITY_BATCH,
+    STREAM_RESTART,
+)
+from dlrover_tpu.serving.router.hedge import HedgePolicy  # noqa: E402
+from dlrover_tpu.serving.router.replica import (  # noqa: E402
+    ReplicaHandle,
+    ReplicaManager,
+)
+
+
+def _prompt(i, n=8):
+    return np.full(n, i % 251, np.int32)
+
+
+def _expected_tokens(prompt, n):
+    """FakeEngine's content-keyed greedy output: a pure function of
+    the prompt, identical on every replica — the hedging suite's
+    stand-in for a deterministic LLM."""
+    base = int(np.asarray(prompt, np.int64).sum()) * 31 + int(
+        np.asarray(prompt).size)
+    return [(base + i) % 997 for i in range(n)]
+
+
+def _drive(router, timeout=30.0, extra=None):
+    deadline = time.monotonic() + timeout
+    while router.has_work:
+        assert time.monotonic() < deadline, (
+            f"router still busy after {timeout}s "
+            f"(depth={router.gateway.depth()})")
+        router.step()
+        if extra is not None:
+            extra()
+        time.sleep(0.002)
+
+
+def _step_until(router, cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        router.step()
+        time.sleep(0.002)
+
+
+class _ThreadedWorker:
+    def __init__(self, fault_schedule=None, **engine_kw):
+        self.engine = FakeEngine(**engine_kw)
+        self.server = WorkerServer(
+            self.engine, fault_schedule=fault_schedule)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def proxy(self, name, **kw):
+        return RemoteReplicaHandle(self.server.addr, name=name, **kw)
+
+    def stop(self):
+        self.server.crash()
+
+
+@pytest.fixture()
+def workers():
+    made = []
+
+    def factory(fault_schedule=None, **kw):
+        w = _ThreadedWorker(fault_schedule=fault_schedule, **kw)
+        made.append(w)
+        return w
+
+    yield factory
+    for w in made:
+        w.stop()
+
+
+# -- phi-accrual detector ----------------------------------------------------
+
+
+def test_phi_zero_below_min_samples_and_nonpositive_silence():
+    d = PhiAccrualDetector(window=32, min_samples=4)
+    assert d.phi(10.0) == 0.0, "no history is not evidence of death"
+    for _ in range(3):
+        d.observe(0.05)
+    assert d.phi(10.0) == 0.0
+    d.observe(0.05)
+    assert d.phi(10.0) > 0.0
+    assert d.phi(0.0) == 0.0
+    assert d.phi(-1.0) == 0.0
+    assert d.silence_for_phi(1.0) is not None
+
+
+def test_phi_monotone_in_silence():
+    import random
+
+    rng = random.Random(42)
+    d = PhiAccrualDetector(window=64, min_samples=8)
+    for _ in range(64):
+        d.observe(0.04 + 0.02 * rng.random())
+    prev = -1.0
+    for silence in [i * 0.01 for i in range(1, 120)]:
+        phi = d.phi(silence)
+        assert phi >= prev, (
+            f"phi must be monotone in silence: phi({silence})={phi} "
+            f"< previous {prev}")
+        prev = phi
+    assert prev > 8.0, "long silence must reach failover-grade phi"
+
+
+def test_phi_deterministic_for_identical_history():
+    a = PhiAccrualDetector(window=32, min_samples=4)
+    b = PhiAccrualDetector(window=32, min_samples=4)
+    feeds = [0.01, 0.03, 0.02, 0.05, 0.04, 0.02, 0.06, 0.01]
+    for x in feeds:
+        a.observe(x)
+        b.observe(x)
+    for silence in (0.01, 0.05, 0.2, 1.0, 30.0):
+        assert a.phi(silence) == b.phi(silence), \
+            "same intervals + same silence must give the same phi"
+    assert a.mean() == b.mean() and a.std() == b.std()
+
+
+def test_phi_adapts_to_cadence():
+    """A chatty replica is suspected after a much shorter silence than
+    a bursty one — the adaptivity a fixed timeout cannot have."""
+    chatty = PhiAccrualDetector(window=64, min_samples=8)
+    bursty = PhiAccrualDetector(window=64, min_samples=8)
+    for _ in range(64):
+        chatty.observe(0.01)
+        bursty.observe(0.5)
+    s_chatty = chatty.silence_for_phi(3.0)
+    s_bursty = bursty.silence_for_phi(3.0)
+    assert s_chatty < s_bursty, (
+        f"10ms cadence must suspect sooner ({s_chatty:.3f}s) than "
+        f"500ms cadence ({s_bursty:.3f}s)")
+    # and the same silence reads as far more suspicious on the
+    # chatty link
+    assert chatty.phi(0.3) > bursty.phi(0.3)
+
+
+def test_phi_min_std_floor_keeps_metronome_sane():
+    """A metronomically regular peer (std -> 0) must not make
+    micro-jitter look like death: the floored deviation keeps the
+    suspicion ramp finite and ordered."""
+    d = PhiAccrualDetector(window=32, min_samples=4, min_std=0.02)
+    for _ in range(32):
+        d.observe(0.05)
+    assert d.std() == 0.02
+    assert d.phi(0.051) < 1.0, \
+        "1ms past the mean on a zero-variance link is not suspicion"
+    assert d.phi(0.05 + 10 * 0.02) > 8.0
+
+
+def test_silence_for_phi_inverts_phi():
+    d = PhiAccrualDetector(window=64, min_samples=8)
+    for i in range(64):
+        d.observe(0.03 + 0.001 * (i % 7))
+    for target in (1.0, 3.0, 8.0):
+        s = d.silence_for_phi(target)
+        assert abs(d.phi(s) - target) < 0.05, (
+            f"phi(silence_for_phi({target})) = {d.phi(s)}")
+
+
+def test_phi_window_is_bounded_and_evicts():
+    d = PhiAccrualDetector(window=8, min_samples=2)
+    for _ in range(100):
+        d.observe(0.01)
+    assert d.samples == 8
+    for _ in range(8):
+        d.observe(0.2)
+    assert abs(d.mean() - 0.2) < 1e-9, \
+        "evicted samples must leave the running sums"
+
+
+def test_phi_ctor_validates():
+    with pytest.raises(ValueError):
+        PhiAccrualDetector(window=1)
+    with pytest.raises(ValueError):
+        PhiAccrualDetector(min_samples=1)
+
+
+# -- sustained link profiles -------------------------------------------------
+
+
+def test_slow_profile_delays_every_frame_seeded_and_tagged():
+    mk = lambda: FaultSchedule([], seed=11, profiles=[  # noqa: E731
+        {"profile": "slow", "kind": "TOKEN",
+         "latency": 0.01, "jitter": 0.005},
+    ])
+    a, b = mk(), mk()
+    da = [a.actions_for("TOKEN")[0]["seconds"] for _ in range(10)]
+    db = [b.actions_for("TOKEN")[0]["seconds"] for _ in range(10)]
+    assert da == db, "same seed must replay the same jitter sequence"
+    assert all(0.01 <= s <= 0.015 for s in da)
+    assert a.actions_for("STATS") == [], \
+        "a kind-scoped profile must not touch other frame kinds"
+    events = a.profile_fired("slow")
+    assert len(events) == 10
+    assert all(e["op"] == "delay" and e["profile_id"] >= 1
+               for e in events)
+
+
+def test_partition_profile_is_per_direction():
+    sched = FaultSchedule([], seed=0, profiles=[
+        {"profile": "partition", "side": "send"},
+    ])
+    # every send-side frame blackholes; the recv direction delivers —
+    # the ASYMMETRIC partition a simple socket close cannot model
+    assert sched.actions_for("TOKEN", side="send")[0]["op"] == "drop"
+    assert sched.actions_for("DONE", side="send")[0]["op"] == "drop"
+    assert sched.actions_for("TOKEN", side="recv") == []
+    assert all(e["side"] == "send"
+               for e in sched.profile_fired("partition"))
+
+
+def test_flap_profile_duty_cycle_and_disarm():
+    sched = FaultSchedule([], seed=0)
+    pid = sched.arm_profile(
+        {"profile": "flap", "period": 1.0, "duty": 0.4})
+    # phase anchors at arm time: the first 0.4s of each period is up
+    assert sched.actions_for("TOKEN") == [], \
+        "the up phase must deliver"
+    time.sleep(0.6)
+    acts = sched.actions_for("TOKEN")
+    assert acts and acts[0]["op"] == "drop", \
+        "the down phase must blackhole"
+    sched.disarm_profile(pid)
+    assert sched.actions_for("TOKEN") == [], \
+        "a disarmed profile must stop firing"
+    sched.disarm_profile(pid)  # idempotent
+
+
+def test_lossy_profile_seeded_determinism():
+    mk = lambda: FaultSchedule([], seed=3, profiles=[  # noqa: E731
+        {"profile": "lossy", "p": 0.5},
+    ])
+    a, b = mk(), mk()
+    pa = [bool(a.actions_for("TOKEN")) for _ in range(40)]
+    pb = [bool(b.actions_for("TOKEN")) for _ in range(40)]
+    assert pa == pb, "same seed must replay the same drop pattern"
+    assert any(pa) and not all(pa), \
+        "p=0.5 over 40 frames should both drop and deliver"
+
+
+def test_profiles_from_env_and_validation():
+    payload = {"seed": 5, "faults": [], "profiles": [
+        {"profile": "slow", "latency": 0.02},
+    ]}
+    env = {ServingFabric.FAULTS_ENV: json.dumps(payload)}
+    sched = FaultSchedule.from_env(env)
+    assert sched is not None and len(sched.profiles) == 1
+    act = sched.actions_for("TOKEN")[0]
+    assert act["op"] == "delay" and act["seconds"] == 0.02
+    with pytest.raises(ValueError):
+        FaultSchedule([], profiles=[{"profile": "wormhole"}])
+    with pytest.raises(ValueError):
+        FaultSchedule([], profiles=[
+            {"profile": "slow", "side": "sideways"}])
+    with pytest.raises(ValueError):
+        FaultSchedule([], profiles=[{"profile": "lossy", "p": 1.5}])
+    with pytest.raises(ValueError):
+        FaultSchedule([], profiles=[{"profile": "flap", "period": 0}])
+    with pytest.raises(ValueError):
+        FaultSchedule([], profiles=[{"profile": "flap", "duty": 2.0}])
+
+
+def test_rpc_stub_tags_injected_faults():
+    class _Stub:
+        def get(self, payload, timeout=30.0):
+            return b"ok"
+
+        def report(self, payload, timeout=30.0):
+            return b"ok"
+
+    err = FaultyRpcStub(_Stub(), FaultSchedule(
+        [{"op": "error", "kind": "get", "after": 1}], seed=0))
+    with pytest.raises(RuntimeError) as ei:
+        err.get(b"x")
+    assert ei.value.injected_fault["op"] == "error", \
+        "a raised fault must carry its action as injected_fault"
+    assert err.last_fault["op"] == "error"
+    slow = FaultyRpcStub(_Stub(), FaultSchedule(
+        [{"op": "delay", "kind": "get", "after": 1,
+          "seconds": 0.001}], seed=0))
+    assert slow.get(b"x") == b"ok"
+    assert slow.last_fault["op"] == "delay", (
+        "a survived delay is indistinguishable from a slow RPC "
+        "without the last_fault stamp")
+
+
+# -- detection + demotion end-to-end -----------------------------------------
+
+
+def test_slow_link_demotes_without_failover(workers):
+    """THE gray-failure scenario: a link that degrades (sustained
+    latency) must NOT fail over — the replica is demoted in placement,
+    new work prefers the healthy replica, in-flight work finishes, and
+    healing restores full weight.  Zero requeues end to end."""
+    sched = FaultSchedule([], seed=17)
+    slow = workers(fault_schedule=sched, slots=4, tokens_per_step=4)
+    ok = workers(slots=4, tokens_per_step=4)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        manager=ReplicaManager(suspect_hold=0.2, probation_max=1.0),
+    )
+    router.join_replica("slowlink", slow.proxy(
+        "slowlink", phi_min_samples=4, phi_window=64))
+    router.join_replica("ok", ok.proxy(
+        "ok", phi_min_samples=4, phi_window=64))
+    # warm both detectors on a clean link (STATS cadence + a little
+    # traffic), so the degradation is a DEPARTURE from history
+    warm = [router.submit(_prompt(i), 8) for i in range(4)]
+    _drive(router)
+    assert all(r.state == ServingRequestState.DONE for r in warm)
+    time.sleep(6 * ServingFabric.STATS_INTERVAL)
+
+    pid = sched.arm_profile(
+        {"profile": "slow", "latency": 0.35, "side": "send"})
+    handle = router.manager.get("slowlink")
+    _step_until(router, lambda: handle.demoted, timeout=10.0,
+                msg="slow link never demoted")
+    m = router.metrics.metrics()
+    assert m["serving_replica_suspect"] >= 1.0
+    assert m["serving_phi_max"] > 0.0
+    assert m["serving_replica_suspect_demotions_total"] >= 1.0
+    # placement now prefers the healthy replica: a demoted replica is
+    # an ordering penalty, not a hole in the fleet
+    probe = router.submit(_prompt(99), 8)
+    _step_until(router,
+                lambda: probe.state != ServingRequestState.QUEUED,
+                timeout=10.0, msg="probe request never placed")
+    assert probe.replica == "ok", \
+        "new work must prefer the healthy replica while demoted"
+    _drive(router, timeout=20.0)
+    assert probe.state == ServingRequestState.DONE
+
+    sched.disarm_profile(pid)
+    _step_until(router, lambda: not handle.demoted, timeout=15.0,
+                msg="healed link never restored")
+    m = router.metrics.metrics()
+    assert m["serving_replica_suspect_recoveries_total"] >= 1.0
+    # the whole episode cost ZERO failovers: both replicas alive, no
+    # requeues, nothing lost
+    assert m["serving_requests_requeued_total"] == 0
+    assert sorted(router.replica_names) == ["ok", "slowlink"]
+    assert sched.profile_fired("slow"), \
+        "the degradation must actually have fired"
+
+
+def test_asymmetric_partition_fails_over_zero_lost(workers):
+    """The OTHER side of the gradient: a partition (worker->router
+    direction blackholed while router->worker still delivers) is a
+    real failure — the frame-timeout cliff fires, the replica is
+    reaped, and every in-flight request replays elsewhere."""
+    sched = FaultSchedule([], seed=19)
+    parted = workers(fault_schedule=sched, slots=4,
+                     tokens_per_step=2, step_delay=0.01)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("parted", parted.proxy(
+        "parted", frame_timeout=0.8))
+    reqs = [router.submit(_prompt(i), 16) for i in range(4)]
+    handle = router.manager.get("parted")
+    _step_until(router, lambda: len(handle.inflight) == 4,
+                timeout=10.0, msg="requests never placed on parted")
+    backup = workers(slots=4, tokens_per_step=2)
+    router.join_replica("backup", backup.proxy("backup"))
+    sched.arm_profile({"profile": "partition", "side": "send"})
+    _drive(router, timeout=20.0)
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    for r in reqs:
+        assert r.result(timeout=0).size == 16
+    m = router.metrics.metrics()
+    assert m["serving_requests_requeued_total"] >= 1.0, \
+        "an asymmetric partition IS a failure: it must fail over"
+    assert "parted" not in router.replica_names
+    assert sched.profile_fired("partition")
+
+
+def test_phi_kill_floor_fails_over_before_frame_timeout(workers):
+    """With ``phi_kill_floor`` armed, confident phi (>= phi_dead past
+    the silence floor) fails a silent worker over long before the
+    hard ``frame_timeout`` ceiling would."""
+    sched = FaultSchedule([], seed=23)
+    doomed = workers(fault_schedule=sched, slots=4, tokens_per_step=2,
+                     step_delay=0.01)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    proxy = doomed.proxy(
+        "doomed", frame_timeout=30.0, phi_min_samples=4,
+        phi_dead=3.0, phi_kill_floor=0.3)
+    router.join_replica("doomed", proxy)
+    reqs = [router.submit(_prompt(i), 16) for i in range(2)]
+    handle = router.manager.get("doomed")
+    _step_until(router, lambda: len(handle.inflight) == 2,
+                timeout=10.0, msg="requests never placed")
+    backup = workers(slots=4, tokens_per_step=2)
+    router.join_replica("backup", backup.proxy("backup"))
+    # let the detector warm on the clean cadence, then go silent
+    deadline = time.monotonic() + 5.0
+    while proxy._phi.samples < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert proxy._phi.samples >= 4
+    sched.arm_profile({"profile": "partition", "side": "send"})
+    t0 = time.monotonic()
+    _step_until(router,
+                lambda: "doomed" not in router.replica_names,
+                timeout=10.0, msg="phi kill never fired")
+    assert time.monotonic() - t0 < 5.0, \
+        "phi must fail over far below the 30s frame timeout"
+    _drive(router, timeout=20.0)
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    assert router.metrics.metrics()[
+        "serving_requests_requeued_total"] >= 1.0
+
+
+class _FlapEngine:
+    """Engine stub whose phi verdict the test script sets directly —
+    ReplicaManager's damping logic under a precisely flapping input."""
+
+    def __init__(self):
+        self.flag = False
+        self.has_work = False
+
+    def add_request(self, prompt, max_new_tokens):
+        raise NotImplementedError
+
+    def suspect(self, now=None):
+        return self.flag
+
+    def phi_value(self, now=None):
+        return 5.0 if self.flag else 0.0
+
+    def slots_free(self):
+        return 4
+
+    def blocks_free(self):
+        return 1e9
+
+
+def test_flap_damping_bounds_placement_churn():
+    """A link flapping faster than the hold must read as ONE demotion
+    held down for the whole episode — bounded placement invalidation
+    by construction, not one demote/restore cycle per flap."""
+    eng = _FlapEngine()
+    mgr = ReplicaManager(suspect_hold=10.0, probation_max=60.0)
+    mgr.join(ReplicaHandle("flappy", eng), now=0.0)
+    handle = mgr.get("flappy")
+    eng.flag = True
+    assert mgr.update_suspects(now=1.0) == 1
+    assert mgr.suspect_demotions == 1 and handle.demoted
+    # flap hard: raw verdict flips every tick for 8 ticks
+    for t in range(2, 10):
+        eng.flag = (t % 2 == 1)
+        mgr.update_suspects(now=float(t))
+        assert handle.demoted, \
+            "the hold must keep a flapping link demoted throughout"
+    assert mgr.suspect_demotions == 1, \
+        "8 flips must not produce 8 demote transitions"
+    assert mgr.suspect_flaps_damped >= 3
+    assert mgr.suspect_recoveries >= 1
+    # the hold doubles per recovery, capped at probation_max
+    assert handle.demoted_until <= 9.0 + 60.0
+    # a genuinely healed link: the first raw-False sweep records the
+    # recovery and arms the (final) hold; once it elapses with no
+    # re-suspicion, full weight is restored
+    eng.flag = False
+    assert mgr.update_suspects(now=10.0) == 1, \
+        "recovery is damped: the hold keeps the demotion down"
+    assert mgr.update_suspects(now=handle.demoted_until + 1.0) == 0
+    assert not handle.demoted
+    # retirement clears the per-base damping history
+    mgr.remove("flappy")
+    assert not mgr._suspect_flaps
+
+
+def test_scheduler_prefers_healthy_over_demoted(workers):
+    """Demotion is an ordering penalty on placement: with equal real
+    capacity, the first pick is always the healthy replica."""
+    a = workers(slots=4, tokens_per_step=4)
+    b = workers(slots=4, tokens_per_step=4)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4))
+    router.join_replica("gray", a.proxy("gray"))
+    router.join_replica("green", b.proxy("green"))
+    # pin the demotion (update_suspects re-derives it every step from
+    # raw suspicion OR the hold window; the hold is what we pin)
+    handle = router.manager.get("gray")
+    handle.demoted_until = time.monotonic() + 60.0
+    req = router.submit(_prompt(7), 8)
+    _step_until(router,
+                lambda: req.state != ServingRequestState.QUEUED,
+                timeout=10.0, msg="request never placed")
+    assert req.replica == "green"
+    assert handle.demoted, "the hold window must read as demoted"
+    _drive(router)
+    assert req.state == ServingRequestState.DONE
+
+
+# -- hedging -----------------------------------------------------------------
+
+
+def test_hedge_policy_delay_and_budget():
+    p = HedgePolicy(delay_floor_s=0.05, delay_factor=3.0,
+                    budget_fraction=0.1, default_delay_s=0.25,
+                    min_samples=16)
+    # thin window: the configured default (never below the floor)
+    assert p.hedge_delay() == 0.25
+    for _ in range(98):
+        p.observe(0.01)
+    p.observe(0.5)
+    p.observe(0.5)
+    # p99 of {98 x 0.01, 2 x 0.5} lands on the outliers; the delay is
+    # factor x p99 (a single max in 100 samples sits ABOVE p99)
+    assert p.hedge_delay() == pytest.approx(1.5)
+    # concurrent budget: fraction of in-flight, floored at one
+    assert p.allows(0, 5, dispatched_total=0, submitted_total=100)
+    assert not p.allows(1, 5, dispatched_total=1, submitted_total=100)
+    assert not p.allows(0, 0), "an idle fleet has nothing to hedge"
+    # cumulative budget: fraction of submissions, floored at one
+    assert not p.allows(0, 5, dispatched_total=1, submitted_total=5)
+    assert p.allows(0, 5, dispatched_total=1, submitted_total=100)
+    # a two-replica fleet must still hedge its single straggler
+    assert p.allows(0, 1, dispatched_total=0, submitted_total=1)
+    with pytest.raises(ValueError):
+        HedgePolicy(budget_fraction=0.0)
+    with pytest.raises(ValueError):
+        HedgePolicy(budget_fraction=1.5)
+    with pytest.raises(ValueError):
+        HedgePolicy(delay_factor=0.0)
+
+
+def test_hedge_straggler_first_done_wins_byte_identical(workers):
+    """The tail-at-scale move: a request stuck on a straggler gets a
+    second attempt on a healthy replica; the first DONE wins, the
+    loser is cancelled, and the client stream is byte-identical to an
+    unhedged run — exactly one completion, no interleaving."""
+    slow = workers(slots=4, tokens_per_step=4, step_delay=0.3,
+                   content_tokens=True)
+    fast = workers(slots=4, tokens_per_step=4, content_tokens=True)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        hedge=HedgePolicy(delay_floor_s=0.05, default_delay_s=0.08,
+                          budget_fraction=1.0, min_samples=10_000),
+    )
+    router.join_replica("straggler", slow.proxy("straggler"))
+    req = router.submit(_prompt(5), 8)
+    _step_until(router,
+                lambda: req.state == ServingRequestState.RUNNING,
+                timeout=10.0, msg="request never placed")
+    assert req.replica == "straggler"
+    router.join_replica("healthy", fast.proxy("healthy"))
+    _drive(router, timeout=20.0)
+    assert req.state == ServingRequestState.DONE
+    expected = _expected_tokens(_prompt(5), 8)
+    assert list(req.result(timeout=0)) == expected, \
+        "the winning attempt's output is the request's output"
+    # the stream a client would have read: the same 8 tokens, once,
+    # in order — no second-attempt interleaving, no restart
+    assert list(req.stream(timeout=1.0)) == expected
+    assert router.hedge_won == 1, "the fast copy must win"
+    assert router.hedge_cancelled == 1, \
+        "the straggler's copy must be cancelled, not abandoned"
+    m = router.metrics.metrics()
+    assert m["serving_requests_completed_total"] == 1.0, \
+        "two attempts, ONE completion"
+    assert m["serving_hedge_dispatched_total"] == 1.0
+    assert m["serving_hedge_won_total"] == 1.0
+    assert m["serving_requests_requeued_total"] == 0.0, \
+        "hedging is not failover: nothing requeues"
+
+
+def test_hedge_dedup_completes_each_request_exactly_once(workers):
+    """The dedup twin: when BOTH attempts race to completion at
+    similar speed, every request still completes exactly once, with
+    the content-keyed output — whichever replica won."""
+    a = workers(slots=8, tokens_per_step=2, step_delay=0.03,
+                content_tokens=True)
+    b = workers(slots=8, tokens_per_step=2, step_delay=0.03,
+                content_tokens=True)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        hedge=HedgePolicy(delay_floor_s=0.01, default_delay_s=0.01,
+                          budget_fraction=1.0, min_samples=10_000),
+    )
+    router.join_replica("east", a.proxy("east"))
+    router.join_replica("west", b.proxy("west"))
+    reqs = [router.submit(_prompt(i), 8) for i in range(6)]
+    _drive(router, timeout=20.0)
+    for i, r in enumerate(reqs):
+        assert r.state == ServingRequestState.DONE
+        assert list(r.result(timeout=0)) == _expected_tokens(
+            _prompt(i), 8), "either attempt must yield the same bytes"
+    m = router.metrics.metrics()
+    assert m["serving_requests_completed_total"] == 6.0, \
+        "duplicate attempts must never double-complete"
+    assert router.hedge_dispatched >= 1, \
+        "the race must actually have happened"
+    assert router.hedge_won + router.hedge_cancelled >= 1
+    assert m["serving_requests_requeued_total"] == 0.0
+
+
+def test_hedge_budget_bounds_duplicate_load(workers):
+    """The budget is the safety valve: hedging every stalled request
+    on a slow fleet would double its load — the fraction cap (with a
+    floor of one) bounds duplicates and counts every denial."""
+    slow = workers(slots=8, tokens_per_step=4, step_delay=0.3)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        hedge=HedgePolicy(delay_floor_s=0.05, default_delay_s=0.05,
+                          budget_fraction=0.1, min_samples=10_000),
+    )
+    router.join_replica("molasses", slow.proxy("molasses"))
+    reqs = [router.submit(_prompt(i), 16) for i in range(5)]
+    handle = router.manager.get("molasses")
+    _step_until(router, lambda: len(handle.inflight) >= 3,
+                timeout=10.0, msg="requests never placed")
+    fast = workers(slots=8, tokens_per_step=4)
+    router.join_replica("spare", fast.proxy("spare"))
+    _drive(router, timeout=20.0)
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    # 5 in flight at 10%: concurrent cap floors to ONE hedge, and the
+    # cumulative cap (10% of 5 submissions, floored) holds it there
+    assert router.hedge_dispatched <= 1
+    assert router.hedge_budget_exhausted >= 1, \
+        "a saturated budget is a signal, not a silent no-op"
+    m = router.metrics.metrics()
+    assert m["serving_hedge_budget_exhausted_total"] >= 1.0
+
+
+def test_hedge_excludes_batch_during_brownout(workers):
+    """Hedging doubles a request's load; the brown-out ladder exists
+    because load already won.  While any shedding stage is active,
+    BATCH-band requests are never hedged — NORMAL still is."""
+    slow = workers(slots=8, tokens_per_step=4, step_delay=0.3)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        hedge=HedgePolicy(delay_floor_s=0.01, default_delay_s=0.01,
+                          budget_fraction=1.0, min_samples=10_000),
+    )
+    router.join_replica("molasses", slow.proxy("molasses"))
+    normal = router.submit(_prompt(1), 8)
+    batch = router.submit(_prompt(2), 8, priority=PRIORITY_BATCH)
+    handle = router.manager.get("molasses")
+    _step_until(router, lambda: len(handle.inflight) == 2,
+                timeout=10.0, msg="requests never placed")
+    fast = workers(slots=8, tokens_per_step=4)
+    router.join_replica("spare", fast.proxy("spare"))
+    # a shedding brown-out (stage > 0), exercised against the hedge
+    # planner directly so the stage is pinned while we observe
+    router.brownout = types.SimpleNamespace(stage=1)
+    dispatches = []
+    router._plan_hedges(time.monotonic() + 10.0, dispatches)
+    planned = {rec["req"].rid for _, _, rec in dispatches}
+    assert normal.rid in planned, \
+        "NORMAL must still hedge during a brown-out"
+    assert batch.rid not in planned, \
+        "BATCH must never hedge while shedding is active"
+    # unwind the plan and finish clean without the fake brownout
+    for _, _, rec in dispatches:
+        router._unwind_hedge(rec)
+    router.brownout = None
+    _drive(router, timeout=20.0)
+    assert normal.state == ServingRequestState.DONE
+    assert batch.state == ServingRequestState.DONE
+
+
+def test_hedge_promotion_when_primary_dies(workers):
+    """A hedge is a warm standby: when the primary dies mid-race, the
+    hedge attempt is PROMOTED to be the request's routing identity —
+    no requeue, no replay from zero, and the client still gets the
+    full output (after the stream-restart marker every failover
+    shows)."""
+    primary = workers(slots=4, tokens_per_step=4, step_delay=0.4,
+                      content_tokens=True)
+    backup = workers(slots=4, tokens_per_step=4, step_delay=0.25,
+                     content_tokens=True)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        hedge=HedgePolicy(delay_floor_s=0.03, default_delay_s=0.05,
+                          budget_fraction=1.0, min_samples=10_000),
+    )
+    router.join_replica("primary", primary.proxy(
+        "primary", frame_timeout=1.0))
+    req = router.submit(_prompt(3), 8)
+    _step_until(router,
+                lambda: req.state == ServingRequestState.RUNNING,
+                timeout=10.0, msg="request never placed")
+    router.join_replica("backup", backup.proxy("backup"))
+    _step_until(router, lambda: router.hedge_dispatched == 1
+                and router._hedges[req.rid]["hedge_erid"] is not None,
+                timeout=10.0, msg="hedge never dispatched")
+    primary.stop()
+    _drive(router, timeout=20.0)
+    assert req.state == ServingRequestState.DONE
+    expected = _expected_tokens(_prompt(3), 8)
+    assert list(req.result(timeout=0)) == expected
+    assert router.hedge_promoted == 1
+    assert router.hedge_won == 0, \
+        "promotion is adoption after death, not a race win"
+    m = router.metrics.metrics()
+    assert m["serving_hedge_promoted_total"] == 1.0
+    assert m["serving_requests_requeued_total"] == 0.0, \
+        "the live hedge absorbs the failover: nothing replays"
+    assert "primary" not in router.replica_names
+    # the stream shows one restart, then the full output
+    got = list(req.stream(timeout=1.0))
+    assert got[0] is STREAM_RESTART
+    assert got[1:] == expected
+
+
+# -- soak --------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gray_failure_soak_zero_lost(workers):
+    """Sustained mixed degradation (a flapping STATS link, a lossy
+    TOKEN link, one clean replica) under hedging: a 60-request stream
+    completes with ZERO lost requests and zero failovers — every
+    profile fires, DONE stays authoritative through token loss, and
+    flap damping keeps the suspect churn bounded."""
+    flap_sched = FaultSchedule([], seed=31, profiles=[
+        {"profile": "flap", "kind": "STATS", "period": 0.5,
+         "duty": 0.5, "side": "send"},
+    ])
+    lossy_sched = FaultSchedule([], seed=37, profiles=[
+        {"profile": "lossy", "kind": "TOKEN", "p": 0.3,
+         "side": "send"},
+    ])
+    flappy = workers(fault_schedule=flap_sched, slots=8,
+                     tokens_per_step=2, step_delay=0.02)
+    lossy = workers(fault_schedule=lossy_sched, slots=8,
+                    tokens_per_step=2, step_delay=0.02)
+    clean = workers(slots=8, tokens_per_step=2, step_delay=0.02)
+    router = ServingRouter(
+        scheduler=ContinuousBatchScheduler(block_size=4),
+        manager=ReplicaManager(suspect_hold=0.3, probation_max=2.0),
+        hedge=HedgePolicy(),
+    )
+    router.join_replica("flappy", flappy.proxy(
+        "flappy", phi_min_samples=4, phi_window=64))
+    router.join_replica("lossy", lossy.proxy("lossy"))
+    router.join_replica("clean", clean.proxy("clean"))
+    reqs = []
+    for wave in range(6):
+        reqs.extend(router.submit(_prompt(len(reqs) + i), 16)
+                    for i in range(10))
+        # pace the waves against actual drain so degraded traffic is
+        # SUSTAINED (several flap periods), not a burst that outruns
+        # the first down phase
+        deadline = time.monotonic() + 15.0
+        while sum(len(h.inflight)
+                  for h in router.manager.replicas.values()) > 8:
+            assert time.monotonic() < deadline
+            router.step()
+            time.sleep(0.002)
+    _drive(router, timeout=60.0)
+    # linger across a couple more flap periods: STATS keep flowing on
+    # an idle fleet, so the down phases demonstrably blackhole frames
+    linger = time.monotonic() + 1.2
+    while time.monotonic() < linger:
+        router.step()
+        time.sleep(0.01)
+    assert all(r.state == ServingRequestState.DONE for r in reqs)
+    for r in reqs:
+        assert r.result(timeout=0).size == 16
+    m = router.metrics.metrics()
+    assert m["serving_requests_completed_total"] == 60.0
+    assert m["serving_requests_requeued_total"] == 0.0, \
+        "gray degradation must not be treated as death"
+    assert sorted(router.replica_names) == [
+        "clean", "flappy", "lossy"]
+    assert flap_sched.profile_fired("flap")
+    assert lossy_sched.profile_fired("lossy")
